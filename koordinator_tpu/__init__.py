@@ -1,0 +1,37 @@
+"""koordinator_tpu: a TPU-native rebuild of the koordinator QoS co-location scheduler.
+
+The reference (PeterChg/koordinator, mounted at /root/reference) is a Kubernetes
+co-location scheduling system written in Go: a scheduler extending kube-scheduler with
+7 plugins, a descheduler, a node QoS agent (koordlet), SLO controllers, admission
+webhooks and a CRI runtime proxy.
+
+This package re-expresses the hot path — the per-pod x per-node Filter/Score plugin
+loop — as batched pod x node constraint tensors evaluated on TPU via JAX, while keeping
+the reference's control-plane semantics (QoS classes, priority bands, quota trees, gang
+scheduling, reservations) bit-exact where they define bindings.
+
+Layout (mirrors SURVEY.md section 2 component inventory):
+  api/            - data model: QoS, priority, resources, CRD-like objects
+                    (analog of /root/reference/apis/)
+  client/         - in-process object store + informer/watch layer
+                    (analog of pkg/client generated clientsets/informers)
+  ops/            - pure JAX kernels: loadaware, numa, quota, gang, deviceshare,
+                    reservation restore, rebalance (the tensorized plugin math)
+  models/         - composed scheduling "models": the fused full-chain batched
+                    scheduling step (flagship jittable function)
+  parallel/       - jax.sharding Mesh layout + shard_map'd multi-chip step
+  scheduler/      - frameworkext analog: extender, plugin registry, cycle driver,
+                    parity harness (analog of pkg/scheduler/)
+  descheduler/    - LowNodeLoad rebalance + migration controller (pkg/descheduler/)
+  slocontroller/  - nodemetric/noderesource/nodeslo controllers (pkg/slo-controller/)
+  quotacontroller/- ElasticQuotaProfile controller (pkg/quota-controller/)
+  webhook/        - admission mutators/validators (pkg/webhook/)
+  koordlet/       - node agent: statesinformer, metriccache, metricsadvisor,
+                    qosmanager, resourceexecutor, runtimehooks, prediction, pleg,
+                    audit (pkg/koordlet/)
+  runtimeproxy/   - CRI-interceptor analog over UDS (pkg/runtimeproxy/)
+  native/         - C++ components (perf_event binding analog of the cgo libpfm4 use)
+  utils/          - cpuset, bitmask, histogram, parallelize, sloconfig, feature gates
+"""
+
+__version__ = "0.1.0"

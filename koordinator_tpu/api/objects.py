@@ -1,0 +1,453 @@
+"""CRD-like object model.
+
+Python analogs of the reference's API types — core k8s objects (Pod, Node) plus the
+ten koordinator CRDs installed from `config/crd/bases/` (SURVEY.md section 2.7):
+NodeMetric, NodeSLO, Reservation, Device, PodGroup, ElasticQuota, PodMigrationJob,
+ClusterColocationProfile, NodeResourceTopology, ElasticQuotaProfile.
+
+These are deliberately plain dataclasses: the control plane manipulates them on host;
+`ops/packing.py` lowers snapshots of them into device tensors. Field names follow the
+reference's json tags so traces serialize compatibly. Durable state is externalized
+into these objects exactly as in the reference (SURVEY.md section 5.4): restart =
+re-list + rebuild caches.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from koordinator_tpu.api.priority import (
+    PriorityClass,
+    priority_class_by_name,
+    priority_class_by_value,
+)
+from koordinator_tpu.api.qos import QoSClass, qos_class_by_name
+from koordinator_tpu.api.resources import ResourceList
+
+# Well-known labels/annotations (reference apis/extension/constants.go:21-47 and
+# plugin-specific files; cited per constant).
+DOMAIN_PREFIX = "koordinator.sh/"
+SCHEDULING_DOMAIN_PREFIX = "scheduling.koordinator.sh"
+NODE_DOMAIN_PREFIX = "node.koordinator.sh"
+POD_DOMAIN_PREFIX = "pod.koordinator.sh"
+QUOTA_DOMAIN_PREFIX = "quota.scheduling.koordinator.sh"
+
+LABEL_POD_QOS = DOMAIN_PREFIX + "qosClass"                      # constants.go:31
+LABEL_POD_PRIORITY = DOMAIN_PREFIX + "priority"                 # constants.go:32
+LABEL_POD_PRIORITY_CLASS = DOMAIN_PREFIX + "priority-class"     # constants.go:36
+LABEL_POD_GROUP = "pod-group.scheduling.sigs.k8s.io"            # coscheduling
+ANNOTATION_RESOURCE_SPEC = SCHEDULING_DOMAIN_PREFIX + "/resource-spec"
+ANNOTATION_RESOURCE_STATUS = SCHEDULING_DOMAIN_PREFIX + "/resource-status"
+ANNOTATION_DEVICE_ALLOCATED = SCHEDULING_DOMAIN_PREFIX + "/device-allocated"
+ANNOTATION_RESERVATION_ALLOCATED = SCHEDULING_DOMAIN_PREFIX + "/reservation-allocated"
+ANNOTATION_EXTENDED_RESOURCE_SPEC = NODE_DOMAIN_PREFIX + "/extended-resource-spec"
+LABEL_QUOTA_NAME = QUOTA_DOMAIN_PREFIX + "/name"
+LABEL_QUOTA_PARENT = QUOTA_DOMAIN_PREFIX + "/parent"
+LABEL_QUOTA_IS_PARENT = QUOTA_DOMAIN_PREFIX + "/is-parent"
+LABEL_QUOTA_SHARED_WEIGHT = QUOTA_DOMAIN_PREFIX + "/shared-weight"
+LABEL_QUOTA_TREE_ID = QUOTA_DOMAIN_PREFIX + "/tree-id"
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    creation_timestamp: float = field(default_factory=time.time)
+    resource_version: int = 0
+    deletion_timestamp: Optional[float] = None
+    owner_kind: str = ""
+    owner_name: str = ""
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class PodSpec:
+    node_name: str = ""
+    scheduler_name: str = "koord-scheduler"
+    priority: Optional[int] = None
+    priority_class_name: str = ""
+    requests: ResourceList = field(default_factory=ResourceList)
+    limits: ResourceList = field(default_factory=ResourceList)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    affinity_required_node_labels: Dict[str, str] = field(default_factory=dict)
+    tolerations: List[Tuple[str, str]] = field(default_factory=list)  # (key, value)
+    overhead: ResourceList = field(default_factory=ResourceList)
+    restart_policy: str = "Always"
+    termination_grace_period_seconds: int = 30
+
+
+@dataclass
+class Pod:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    phase: str = "Pending"  # Pending/Running/Succeeded/Failed
+
+    @property
+    def qos_class(self) -> QoSClass:
+        """QoS from the koordinator.sh/qosClass label (apis/extension/qos.go)."""
+        return qos_class_by_name(self.meta.labels.get(LABEL_POD_QOS, ""))
+
+    @property
+    def priority_class(self) -> PriorityClass:
+        """Label override first, then numeric band (priority.go:74-84)."""
+        if LABEL_POD_PRIORITY_CLASS in self.meta.labels:
+            return priority_class_by_name(self.meta.labels[LABEL_POD_PRIORITY_CLASS])
+        return priority_class_by_value(self.spec.priority)
+
+    @property
+    def sub_priority(self) -> int:
+        """koordinator.sh/priority label (priority.go:107-116)."""
+        try:
+            return int(self.meta.labels.get(LABEL_POD_PRIORITY, "0") or "0")
+        except ValueError:
+            return 0
+
+    @property
+    def gang_name(self) -> str:
+        return self.meta.labels.get(LABEL_POD_GROUP, "")
+
+    @property
+    def quota_name(self) -> str:
+        return self.meta.labels.get(LABEL_QUOTA_NAME, "")
+
+    @property
+    def is_assigned(self) -> bool:
+        return bool(self.spec.node_name)
+
+    @property
+    def is_terminated(self) -> bool:
+        return self.phase in ("Succeeded", "Failed")
+
+
+@dataclass
+class Node:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    allocatable: ResourceList = field(default_factory=ResourceList)
+    capacity: ResourceList = field(default_factory=ResourceList)
+    unschedulable: bool = False
+    taints: List[Tuple[str, str]] = field(default_factory=list)  # (key, value)
+    ready: bool = True
+
+
+# ---------------------------------------------------------------------------
+# NodeMetric CR (apis/slo/v1alpha1/nodemetric_types.go)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PodMetricInfo:
+    namespace: str = ""
+    name: str = ""
+    pod_usage: ResourceList = field(default_factory=ResourceList)
+    priority_class: PriorityClass = PriorityClass.NONE
+
+
+@dataclass
+class NodeMetricInfo:
+    node_usage: ResourceList = field(default_factory=ResourceList)
+    # {duration_seconds: {"p95"|"p99"|"avg"|...: ResourceList}}
+    aggregated_node_usages: Dict[int, Dict[str, ResourceList]] = field(
+        default_factory=dict
+    )
+    # usage of system daemons outside pod cgroups
+    system_usage: ResourceList = field(default_factory=ResourceList)
+
+
+@dataclass
+class NodeMetric:
+    """Measured node utilization, reported by koordlet on an interval
+    (statesinformer/impl/states_nodemetric.go:182-210) and consumed by LoadAware,
+    LowNodeLoad, and the noderesource controller."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    update_time: float = 0.0
+    node_metric: NodeMetricInfo = field(default_factory=NodeMetricInfo)
+    pods_metric: List[PodMetricInfo] = field(default_factory=list)
+    prod_reclaimable: ResourceList = field(default_factory=ResourceList)
+    report_interval_seconds: int = 60
+    aggregate_durations: List[int] = field(default_factory=lambda: [300, 900, 1800])
+
+
+# ---------------------------------------------------------------------------
+# Reservation CR (apis/scheduling/v1alpha1/reservation_types.go)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ReservationOwner:
+    """Owner matcher: label selector and/or controller reference
+    (reservation_types.go ReservationOwner)."""
+
+    label_selector: Dict[str, str] = field(default_factory=dict)
+    controller_kind: str = ""
+    controller_name: str = ""
+    namespace: str = ""
+
+    def matches(self, pod: Pod) -> bool:
+        if self.namespace and pod.meta.namespace != self.namespace:
+            return False
+        if self.label_selector:
+            for k, v in self.label_selector.items():
+                if pod.meta.labels.get(k) != v:
+                    return False
+            return True
+        if self.controller_kind or self.controller_name:
+            return (
+                pod.meta.owner_kind == self.controller_kind
+                and pod.meta.owner_name == self.controller_name
+            )
+        return False
+
+
+@dataclass
+class Reservation:
+    """A resource pre-claim scheduled like a pod; matching pods later consume its
+    reserved resources (pkg/scheduler/plugins/reservation/)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    template: PodSpec = field(default_factory=PodSpec)
+    owners: List[ReservationOwner] = field(default_factory=list)
+    ttl_seconds: Optional[int] = None
+    expires_at: Optional[float] = None
+    allocate_once: bool = True
+    # status
+    phase: str = "Pending"  # Pending/Available/Succeeded/Failed
+    node_name: str = ""
+    allocatable: ResourceList = field(default_factory=ResourceList)
+    allocated: ResourceList = field(default_factory=ResourceList)
+    current_owners: List[str] = field(default_factory=list)  # pod keys
+
+    @property
+    def is_available(self) -> bool:
+        return self.phase == "Available" and bool(self.node_name)
+
+    def is_expired(self, now: Optional[float] = None) -> bool:
+        now = time.time() if now is None else now
+        if self.expires_at is not None:
+            return now >= self.expires_at
+        if self.ttl_seconds is not None:
+            return now >= self.meta.creation_timestamp + self.ttl_seconds
+        return False
+
+    def matches(self, pod: Pod) -> bool:
+        return any(o.matches(pod) for o in self.owners)
+
+
+# ---------------------------------------------------------------------------
+# PodGroup CR (sigs.k8s.io scheme; plugins/coscheduling)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PodGroup:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    min_member: int = 1
+    schedule_timeout_seconds: int = 600
+    # status
+    phase: str = "Pending"
+    scheduled: int = 0
+
+
+# ---------------------------------------------------------------------------
+# ElasticQuota CR (sigs.k8s.io scheme; plugins/elasticquota)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ElasticQuota:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    min: ResourceList = field(default_factory=ResourceList)
+    max: ResourceList = field(default_factory=ResourceList)
+
+    @property
+    def parent(self) -> str:
+        return self.meta.labels.get(LABEL_QUOTA_PARENT, "")
+
+    @property
+    def is_parent(self) -> bool:
+        return self.meta.labels.get(LABEL_QUOTA_IS_PARENT, "false") == "true"
+
+    @property
+    def shared_weight(self) -> Optional[ResourceList]:
+        raw = self.meta.annotations.get(LABEL_QUOTA_SHARED_WEIGHT)
+        if not raw:
+            return None
+        import json
+
+        return ResourceList({k: int(v) for k, v in json.loads(raw).items()})
+
+    @property
+    def tree_id(self) -> str:
+        return self.meta.labels.get(LABEL_QUOTA_TREE_ID, "")
+
+
+# ---------------------------------------------------------------------------
+# Device CR (apis/scheduling/v1alpha1/device_types.go)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DeviceInfo:
+    type: str = "gpu"  # gpu | rdma | fpga
+    uuid: str = ""
+    minor: int = 0
+    health: bool = True
+    resources: ResourceList = field(default_factory=ResourceList)
+    numa_node: int = -1
+
+
+@dataclass
+class Device:
+    """Per-node device inventory reported by koordlet's device collectors."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)  # name == node name
+    devices: List[DeviceInfo] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# NodeSLO CR (apis/slo/v1alpha1/nodeslo_types.go)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ResourceThresholdStrategy:
+    """resourceUsedThresholdWithBE: drives cpusuppress/evict
+    (qosmanager/plugins/cpusuppress)."""
+
+    enable: bool = False
+    cpu_suppress_threshold_percent: int = 65
+    cpu_suppress_policy: str = "cpuset"  # cpuset | cfsQuota
+    memory_evict_threshold_percent: int = 70
+    memory_evict_lower_percent: Optional[int] = None
+    cpu_evict_be_usage_threshold_percent: int = 90
+
+
+@dataclass
+class ResourceQOSStrategy:
+    """Per-QoS-class cgroup knobs (group identity, memory qos, resctrl, blkio)."""
+
+    ls_enable: bool = False
+    be_enable: bool = False
+    ls_group_identity: int = 2    # bvt.warp_ns group for LS
+    be_group_identity: int = -1   # bvt for BE
+    llc_be_percent: int = 100     # resctrl LLC ways for BE
+    mba_be_percent: int = 100     # resctrl memory-bandwidth for BE
+
+
+@dataclass
+class CPUBurstStrategy:
+    policy: str = "none"  # none | cpuBurstOnly | cfsQuotaBurstOnly | auto
+    cpu_burst_percent: int = 1000
+    cfs_quota_burst_percent: int = 300
+    cfs_quota_burst_period_seconds: int = -1
+    shared_pool_threshold_percent: int = 50
+
+
+@dataclass
+class SystemStrategy:
+    min_free_kbytes_factor: int = 100
+    watermark_scale_factor: int = 150
+    memcg_reap_enabled: bool = False
+
+
+@dataclass
+class NodeSLO:
+    """Per-node QoS strategy rendered by the nodeslo controller from the cluster
+    sloconfig ConfigMap + node overrides (pkg/slo-controller/nodeslo/)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)  # name == node name
+    resource_used_threshold_with_be: ResourceThresholdStrategy = field(
+        default_factory=ResourceThresholdStrategy
+    )
+    resource_qos_strategy: ResourceQOSStrategy = field(
+        default_factory=ResourceQOSStrategy
+    )
+    cpu_burst_strategy: CPUBurstStrategy = field(default_factory=CPUBurstStrategy)
+    system_strategy: SystemStrategy = field(default_factory=SystemStrategy)
+    extensions: Dict[str, Any] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# NodeResourceTopology CR (reported by koordlet statesinformer nodeTopo plugin)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CPUInfo:
+    cpu_id: int = 0
+    core_id: int = 0
+    socket_id: int = 0
+    numa_node_id: int = 0
+
+
+@dataclass
+class NUMAZone:
+    numa_id: int = 0
+    allocatable: ResourceList = field(default_factory=ResourceList)
+
+
+@dataclass
+class NodeResourceTopology:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)  # name == node name
+    cpus: List[CPUInfo] = field(default_factory=list)
+    zones: List[NUMAZone] = field(default_factory=list)
+    kubelet_cpu_manager_policy: str = "none"
+    # cpus already claimed by kubelet static cpu-manager (cpu ids)
+    kubelet_reserved_cpus: List[int] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# PodMigrationJob CR (apis/scheduling/v1alpha1/pod_migration_job_types.go)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PodMigrationJob:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    pod_namespace: str = ""
+    pod_name: str = ""
+    mode: str = "ReservationFirst"  # ReservationFirst | EvictDirectly
+    ttl_seconds: int = 300
+    # status
+    phase: str = "Pending"  # Pending/Running/Succeeded/Failed
+    reservation_name: str = ""
+    message: str = ""
+
+
+# ---------------------------------------------------------------------------
+# ClusterColocationProfile CR (webhook/pod/mutating/cluster_colocation_profile.go)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ClusterColocationProfile:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    namespace_selector: Dict[str, str] = field(default_factory=dict)
+    selector: Dict[str, str] = field(default_factory=dict)
+    qos_class: Optional[QoSClass] = None
+    priority_class_name: str = ""
+    koordinator_priority: Optional[int] = None
+    scheduler_name: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# ElasticQuotaProfile CR (pkg/quota-controller/profile)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ElasticQuotaProfile:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    quota_name: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    quota_labels: Dict[str, str] = field(default_factory=dict)

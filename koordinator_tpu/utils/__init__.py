@@ -1,0 +1,1 @@
+"""Shared infrastructure: analog of reference `pkg/util/` + `pkg/features/`."""

@@ -1,0 +1,141 @@
+"""Golden tests for the API layer against reference semantics tables.
+
+The reference's `apis/extension/*_test.go` files are the spec (SURVEY.md section 7
+step 1): QoS resolution, priority band mapping, resource-name translation.
+"""
+
+import numpy as np
+
+from koordinator_tpu.api import (
+    DEFAULT_PRIORITY_BY_CLASS,
+    PriorityClass,
+    QoSClass,
+    ResourceList,
+    ResourceName,
+    priority_class_by_name,
+    priority_class_by_value,
+    qos_class_by_name,
+    translate_resource_by_priority_class,
+)
+from koordinator_tpu.api.objects import (
+    LABEL_POD_PRIORITY_CLASS,
+    LABEL_POD_QOS,
+    Pod,
+    PodSpec,
+    ObjectMeta,
+    Reservation,
+    ReservationOwner,
+)
+
+
+class TestQoS:
+    def test_known_classes(self):
+        # qos.go:31-39 table
+        for name in ("LSE", "LSR", "LS", "BE", "SYSTEM"):
+            assert qos_class_by_name(name).label == name
+
+    def test_unknown_resolves_none(self):
+        assert qos_class_by_name("lse") is QoSClass.NONE
+        assert qos_class_by_name("") is QoSClass.NONE
+        assert qos_class_by_name("garbage") is QoSClass.NONE
+
+    def test_latency_sensitive_partition(self):
+        assert QoSClass.LSE.is_latency_sensitive
+        assert QoSClass.LSR.is_latency_sensitive
+        assert QoSClass.LS.is_latency_sensitive
+        assert not QoSClass.BE.is_latency_sensitive
+        assert QoSClass.BE.is_best_effort
+
+
+class TestPriority:
+    def test_band_mapping(self):
+        # priority.go:86-104 table
+        assert priority_class_by_value(9000) is PriorityClass.PROD
+        assert priority_class_by_value(9999) is PriorityClass.PROD
+        assert priority_class_by_value(7500) is PriorityClass.MID
+        assert priority_class_by_value(5000) is PriorityClass.BATCH
+        assert priority_class_by_value(3999) is PriorityClass.FREE
+        assert priority_class_by_value(8500) is PriorityClass.NONE
+        assert priority_class_by_value(0) is PriorityClass.NONE
+        assert priority_class_by_value(None) is PriorityClass.NONE
+
+    def test_label_resolution(self):
+        assert priority_class_by_name("koord-prod") is PriorityClass.PROD
+        assert priority_class_by_name("koord-batch") is PriorityClass.BATCH
+        assert priority_class_by_name("bogus") is PriorityClass.NONE
+
+    def test_label_overrides_numeric(self):
+        # priority.go:74-84: label wins over spec.priority
+        pod = Pod(
+            meta=ObjectMeta(labels={LABEL_POD_PRIORITY_CLASS: "koord-batch"}),
+            spec=PodSpec(priority=9500),
+        )
+        assert pod.priority_class is PriorityClass.BATCH
+
+    def test_defaults(self):
+        assert DEFAULT_PRIORITY_BY_CLASS[PriorityClass.PROD] == 9999
+        assert DEFAULT_PRIORITY_BY_CLASS[PriorityClass.BATCH] == 5999
+
+
+class TestResources:
+    def test_translate_by_priority_class(self):
+        # resource.go:40-59 table
+        assert (
+            translate_resource_by_priority_class(PriorityClass.BATCH, ResourceName.CPU)
+            == ResourceName.BATCH_CPU
+        )
+        assert (
+            translate_resource_by_priority_class(
+                PriorityClass.MID, ResourceName.MEMORY
+            )
+            == ResourceName.MID_MEMORY
+        )
+        assert (
+            translate_resource_by_priority_class(PriorityClass.PROD, ResourceName.CPU)
+            == ResourceName.CPU
+        )
+        assert (
+            translate_resource_by_priority_class(PriorityClass.NONE, ResourceName.CPU)
+            == ResourceName.CPU
+        )
+
+    def test_vector_roundtrip(self):
+        rl = ResourceList.of(cpu=4000, memory=8 * 1024**3, gpu_core=50, pods=110)
+        vec = rl.to_vector()
+        assert vec.dtype == np.float32
+        back = ResourceList.from_vector(vec)
+        assert back[ResourceName.CPU] == 4000
+        assert back[ResourceName.MEMORY] == 8 * 1024**3
+        assert back[ResourceName.GPU_CORE] == 50
+        assert back[ResourceName.PODS] == 110
+
+    def test_memory_packed_as_mib(self):
+        from koordinator_tpu.api.resources import RESOURCE_INDEX
+
+        rl = ResourceList.of(memory=512 * 1024**2)
+        assert rl.to_vector()[RESOURCE_INDEX[ResourceName.MEMORY]] == 512.0
+
+    def test_arithmetic(self):
+        a = ResourceList.of(cpu=1000, memory=1024**3)
+        b = ResourceList.of(cpu=250)
+        assert a.add(b)[ResourceName.CPU] == 1250
+        assert a.sub(b)[ResourceName.CPU] == 750
+        assert a.max(ResourceList.of(cpu=2000))[ResourceName.CPU] == 2000
+
+
+class TestObjects:
+    def test_pod_qos_from_label(self):
+        pod = Pod(meta=ObjectMeta(labels={LABEL_POD_QOS: "BE"}))
+        assert pod.qos_class is QoSClass.BE
+
+    def test_reservation_owner_matching(self):
+        res = Reservation(
+            owners=[ReservationOwner(label_selector={"app": "web"})],
+        )
+        assert res.matches(Pod(meta=ObjectMeta(labels={"app": "web"})))
+        assert not res.matches(Pod(meta=ObjectMeta(labels={"app": "db"})))
+
+    def test_reservation_expiry(self):
+        res = Reservation(meta=ObjectMeta(creation_timestamp=100.0), ttl_seconds=50)
+        assert not res.is_expired(now=120.0)
+        assert res.is_expired(now=151.0)

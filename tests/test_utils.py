@@ -1,0 +1,110 @@
+"""Unit tests for shared utils (cpuset/bitmask/histogram/parallelize/features)."""
+
+import pytest
+
+from koordinator_tpu.utils.bitmask import BitMask
+from koordinator_tpu.utils.cpuset import CPUSet
+from koordinator_tpu.utils.features import FeatureGate, KOORDLET_GATES
+from koordinator_tpu.utils.histogram import DecayingHistogram, HistogramOptions
+from koordinator_tpu.utils.parallelize import parallel_map
+
+
+class TestCPUSet:
+    def test_parse_and_format(self):
+        s = CPUSet.parse("0-3,7,9-11")
+        assert s.to_list() == [0, 1, 2, 3, 7, 9, 10, 11]
+        assert s.format() == "0-3,7,9-11"
+        assert CPUSet.parse("").format() == ""
+        assert CPUSet.parse("5").to_list() == [5]
+
+    def test_invalid_range(self):
+        with pytest.raises(ValueError):
+            CPUSet.parse("5-2")
+
+    def test_algebra(self):
+        a, b = CPUSet.parse("0-3"), CPUSet.parse("2-5")
+        assert a.union(b).format() == "0-5"
+        assert a.intersection(b).format() == "2-3"
+        assert a.difference(b).format() == "0-1"
+        assert CPUSet.parse("2-3").is_subset_of(a)
+
+
+class TestBitMask:
+    def test_basic(self):
+        m = BitMask([0, 2])
+        assert m.count() == 2
+        assert m.is_set(0) and m.is_set(2) and not m.is_set(1)
+        assert m.get_bits() == [0, 2]
+
+    def test_and_or(self):
+        assert BitMask([0, 1]).and_(BitMask([1, 2])).get_bits() == [1]
+        assert BitMask([0]).or_(BitMask([3])).get_bits() == [0, 3]
+
+    def test_narrower(self):
+        # fewer bits wins; ties prefer lower-numbered bits
+        assert BitMask([0]).is_narrower_than(BitMask([0, 1]))
+        assert BitMask([0]).is_narrower_than(BitMask([1]))
+        assert not BitMask([1]).is_narrower_than(BitMask([0]))
+
+
+class TestHistogram:
+    def test_percentile_basic(self):
+        opts = HistogramOptions.linear(max_value=100.0, bucket_size=1.0)
+        h = DecayingHistogram(opts, half_life_seconds=1e9)  # effectively no decay
+        for v in range(1, 101):
+            h.add_sample(float(v) - 0.5, 1.0, timestamp=0.0)
+        assert abs(h.percentile(0.5) - 50.0) <= 1.0
+        assert abs(h.percentile(0.95) - 95.0) <= 1.0
+
+    def test_decay(self):
+        opts = HistogramOptions.linear(max_value=100.0, bucket_size=1.0)
+        h = DecayingHistogram(opts, half_life_seconds=10.0)
+        h.add_sample(10.0, 1.0, timestamp=0.0)
+        h.add_sample(90.0, 1.0, timestamp=100.0)  # 2^10 heavier
+        assert h.percentile(0.5) > 80.0
+
+    def test_empty(self):
+        opts = HistogramOptions.exponential(1e9, 1.0, 2.0)
+        h = DecayingHistogram(opts)
+        assert h.is_empty()
+        assert h.percentile(0.99) == 0.0
+
+    def test_checkpoint_roundtrip(self):
+        opts = HistogramOptions.linear(max_value=10.0, bucket_size=1.0)
+        h = DecayingHistogram(opts, half_life_seconds=100.0)
+        h.add_sample(5.0, 2.0, timestamp=50.0)
+        h2 = DecayingHistogram.from_checkpoint(opts, h.to_checkpoint())
+        assert h2.percentile(0.5) == h.percentile(0.5)
+        assert h2.total_weight == h.total_weight
+
+
+class TestParallelize:
+    def test_parallel_map(self):
+        assert parallel_map(list(range(100)), lambda x: x * x) == [
+            x * x for x in range(100)
+        ]
+
+    def test_error_propagates(self):
+        def boom(x):
+            raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError):
+            parallel_map([1, 2, 3], boom)
+
+
+class TestFeatures:
+    def test_defaults_and_overrides(self):
+        g = FeatureGate({"A": True, "B": False})
+        assert g.enabled("A") and not g.enabled("B")
+        g.set_from_map({"B": True})
+        assert g.enabled("B")
+        g.reset()
+        assert not g.enabled("B")
+
+    def test_unknown_rejected(self):
+        with pytest.raises(ValueError):
+            FeatureGate({}).set_from_map({"nope": True})
+
+    def test_koordlet_gate_set(self):
+        assert KOORDLET_GATES.enabled("BECPUSuppress")
+        assert not KOORDLET_GATES.enabled("CPICollector")
