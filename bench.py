@@ -1,0 +1,150 @@
+"""Benchmark: batched TPU scheduling step vs the serial reference-semantics floor.
+
+North-star config (BASELINE.md): 10k pending pods x 5k nodes, full chain, pods
+scheduled/sec + p99 schedule latency. The serial floor is the scalar per-pod /
+per-node emulator (`scheduler/parity.py`) — the reference's own Go chain is not
+runnable here (no Go toolchain / no cluster), so the floor is the same plugin
+semantics executed the same serial way the reference executes them, on this host.
+The parity tests guarantee both paths produce identical bindings.
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": "pods/s", "vs_baseline": N}
+Detail lines go to stderr.
+
+Usage: python bench.py [--smoke] [--pods P] [--nodes N] [--serial-sample S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="tiny shapes, quick check")
+    ap.add_argument("--pods", type=int, default=None)
+    ap.add_argument("--nodes", type=int, default=None)
+    ap.add_argument("--serial-sample", type=int, default=200)
+    ap.add_argument("--iters", type=int, default=3)
+    args_cli = ap.parse_args()
+
+    num_pods = args_cli.pods or (100 if args_cli.smoke else 10_000)
+    num_nodes = args_cli.nodes or (50 if args_cli.smoke else 5_000)
+
+    import jax
+
+    from koordinator_tpu.models.scheduler_model import (
+        build_schedule_step,
+        make_inputs,
+    )
+    from koordinator_tpu.ops.loadaware import LoadAwareArgs, build_loadaware_node_state
+    from koordinator_tpu.ops.packing import pack_nodes, pack_pods
+    from koordinator_tpu.scheduler.parity import serial_schedule
+    from koordinator_tpu.testing import synth_cluster
+
+    log(f"devices: {jax.devices()}")
+    log(f"config: {num_pods} pending pods x {num_nodes} nodes (LoadAware chain)")
+
+    t0 = time.perf_counter()
+    cluster = synth_cluster(num_nodes=num_nodes, num_pods=num_pods, seed=42)
+    la = LoadAwareArgs()
+    pods = pack_pods(cluster.pods, la.resource_weights, la.estimated_scaling_factors)
+    nodes = pack_nodes(cluster.nodes)
+    nodes.extras = build_loadaware_node_state(
+        cluster.nodes,
+        cluster.node_metrics,
+        cluster.pods_by_key,
+        cluster.assigned,
+        la,
+        cluster.now,
+        pad_to=nodes.padded_size,
+    )
+    inputs = make_inputs(pods, nodes, la)
+    t_pack = time.perf_counter() - t0
+    log(f"packing: {t_pack:.3f}s (padded {pods.padded_size} x {nodes.padded_size})")
+
+    step = build_schedule_step(la)
+    t0 = time.perf_counter()
+    chosen, _ = step(inputs)
+    chosen = np.asarray(jax.block_until_ready(chosen))
+    t_compile = time.perf_counter() - t0
+    log(f"first call (compile+run): {t_compile:.3f}s")
+
+    times = []
+    for _ in range(args_cli.iters):
+        t0 = time.perf_counter()
+        chosen_j, _ = step(inputs)
+        jax.block_until_ready(chosen_j)
+        times.append(time.perf_counter() - t0)
+    t_batch = min(times)
+    scheduled = int((chosen[: pods.num_valid] >= 0).sum())
+    tpu_pps = pods.num_valid / t_batch
+    log(
+        f"batched step: {t_batch:.4f}s for {pods.num_valid} pods "
+        f"({scheduled} scheduled) -> {tpu_pps:,.0f} pods/s; "
+        f"p99 schedule latency <= batch time = {t_batch*1000:.1f}ms"
+    )
+
+    # serial floor on a sample of the same queue (per-pod cost is constant)
+    sample = min(args_cli.serial_sample, pods.num_valid)
+    sub = ScheduleInputsSlice(inputs, sample)
+    t0 = time.perf_counter()
+    chosen_serial = serial_schedule(sub, la)
+    t_serial = time.perf_counter() - t0
+    serial_pps = sample / t_serial
+    log(
+        f"serial floor: {t_serial:.3f}s for {sample} pods -> {serial_pps:,.1f} pods/s"
+    )
+
+    # parity spot check on the sample prefix
+    mism = int((chosen[:sample] != chosen_serial[:sample]).sum())
+    log(f"parity on first {sample} pods: {'OK' if mism == 0 else f'{mism} MISMATCHES'}")
+
+    ratio = tpu_pps / serial_pps if serial_pps > 0 else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": f"pods_scheduled_per_sec_{num_pods}x{num_nodes}_loadaware",
+                "value": round(tpu_pps, 1),
+                "unit": "pods/s",
+                "vs_baseline": round(ratio, 2),
+            }
+        )
+    )
+
+
+def ScheduleInputsSlice(inputs, num_pods: int):
+    """First-k-pods view of ScheduleInputs (pod axis sliced, nodes kept)."""
+    return type(inputs)(
+        fit_requests=inputs.fit_requests[:num_pods],
+        estimated=inputs.estimated[:num_pods],
+        is_prod=inputs.is_prod[:num_pods],
+        is_daemonset=inputs.is_daemonset[:num_pods],
+        pod_valid=inputs.pod_valid[:num_pods],
+        allocatable=inputs.allocatable,
+        requested=inputs.requested,
+        node_ok=inputs.node_ok,
+        la_filter_usage=inputs.la_filter_usage,
+        la_has_filter_usage=inputs.la_has_filter_usage,
+        la_filter_thresholds=inputs.la_filter_thresholds,
+        la_prod_thresholds=inputs.la_prod_thresholds,
+        la_prod_pod_usage=inputs.la_prod_pod_usage,
+        la_term_nonprod=inputs.la_term_nonprod,
+        la_term_prod=inputs.la_term_prod,
+        la_score_valid=inputs.la_score_valid,
+        la_filter_skip=inputs.la_filter_skip,
+        weights=inputs.weights,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -192,19 +192,19 @@ class ReservationOwner:
     namespace: str = ""
 
     def matches(self, pod: Pod) -> bool:
+        """All specified criteria must match (conjunction); an owner with no
+        criteria matches every pod (reference ReservationOwnerMatcher.Match,
+        pkg/util/reservation/reservation.go:402-409)."""
         if self.namespace and pod.meta.namespace != self.namespace:
             return False
-        if self.label_selector:
-            for k, v in self.label_selector.items():
-                if pod.meta.labels.get(k) != v:
-                    return False
-            return True
-        if self.controller_kind or self.controller_name:
-            return (
-                pod.meta.owner_kind == self.controller_kind
-                and pod.meta.owner_name == self.controller_name
-            )
-        return False
+        for k, v in self.label_selector.items():
+            if pod.meta.labels.get(k) != v:
+                return False
+        if self.controller_kind and pod.meta.owner_kind != self.controller_kind:
+            return False
+        if self.controller_name and pod.meta.owner_name != self.controller_name:
+            return False
+        return True
 
 
 @dataclass
@@ -276,13 +276,26 @@ class ElasticQuota:
         return self.meta.labels.get(LABEL_QUOTA_IS_PARENT, "false") == "true"
 
     @property
-    def shared_weight(self) -> Optional[ResourceList]:
-        raw = self.meta.annotations.get(LABEL_QUOTA_SHARED_WEIGHT)
-        if not raw:
-            return None
+    def shared_weight(self) -> ResourceList:
+        """Fair-sharing weight; falls back to spec.max on missing/invalid/zero
+        annotation (reference apis/extension/elastic_quota.go:89-99). Values are
+        k8s quantity strings."""
         import json
 
-        return ResourceList({k: int(v) for k, v in json.loads(raw).items()})
+        from koordinator_tpu.api.resources import ResourceName, parse_quantity
+
+        raw = self.meta.annotations.get(LABEL_QUOTA_SHARED_WEIGHT)
+        if raw:
+            try:
+                parsed = {
+                    k: parse_quantity(v, cpu=(k == ResourceName.CPU))
+                    for k, v in json.loads(raw).items()
+                }
+                if parsed and all(v > 0 for v in parsed.values()):
+                    return ResourceList(parsed)
+            except (ValueError, TypeError):
+                pass
+        return self.max.copy()
 
     @property
     def tree_id(self) -> str:

@@ -169,6 +169,46 @@ class ResourceList:
         )
 
 
+_QUANTITY_SUFFIX = {
+    "": 1,
+    "k": 10**3,
+    "M": 10**6,
+    "G": 10**9,
+    "T": 10**12,
+    "P": 10**15,
+    "E": 10**18,
+    "Ki": 1024,
+    "Mi": 1024**2,
+    "Gi": 1024**3,
+    "Ti": 1024**4,
+    "Pi": 1024**5,
+    "Ei": 1024**6,
+}
+
+
+def parse_quantity(value, cpu: bool = False) -> int:
+    """Parse a k8s resource.Quantity string ("10Gi", "500m", "2k", "1.5") into an
+    integer in wire units: milli for cpu=True, raw value otherwise (bytes/counts).
+    Accepts ints/floats as-is (already wire units)."""
+    if isinstance(value, (int, float)):
+        return int(value)
+    s = str(value).strip()
+    if not s:
+        raise ValueError("empty quantity")
+    if s.endswith("m"):
+        num = float(s[:-1])
+        milli = num
+        return int(round(milli)) if cpu else int(round(milli / 1000.0))
+    suffix = ""
+    for suf in sorted(_QUANTITY_SUFFIX, key=len, reverse=True):
+        if suf and s.endswith(suf):
+            suffix = suf
+            break
+    num = float(s[: len(s) - len(suffix)] if suffix else s)
+    raw = num * _QUANTITY_SUFFIX[suffix]
+    return int(round(raw * 1000)) if cpu else int(round(raw))
+
+
 def translate_resource_by_priority_class(
     priority_class: PriorityClass, resource: str
 ) -> Optional[str]:

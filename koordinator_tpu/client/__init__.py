@@ -1,0 +1,12 @@
+"""In-process object store + informer layer.
+
+Analog of the reference's generated clientsets/informers/listers (`pkg/client/`,
+SURVEY.md section 2.7) plus the API-server watch bus (section 5.8a). The reference's
+only cluster-wide communication channel is the Kubernetes API server; here the same
+role is played by `ObjectStore`: typed collections with resourceVersion bumping and
+subscriber callbacks, so controllers/schedulers/agents interoperate exactly as they
+do against a real API server, and tests run hermetically (the fake-clientset tier of
+the reference's test strategy, SURVEY.md section 4).
+"""
+
+from koordinator_tpu.client.store import ObjectStore, EventType, Informer  # noqa: F401

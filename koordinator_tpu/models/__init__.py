@@ -1,0 +1,7 @@
+"""Composed scheduling models: full plugin chains as single jittable functions.
+
+The flagship "model" of this framework is the fused batched scheduling step
+(`scheduler_model.py`): Filter chain + Score chain + serial-parity selection for a
+whole pending-pod batch in one compiled program. `__graft_entry__.entry()` exposes
+it for single-chip compile checks; `parallel/` shards it over a device mesh.
+"""

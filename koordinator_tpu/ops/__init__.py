@@ -1,0 +1,13 @@
+"""Pure JAX kernels: the tensorized plugin math.
+
+Each module mirrors one reference plugin's pure "function of (pod, nodeState)"
+(SURVEY.md section 7 design stance): loadaware, numa, quota, gang, deviceshare,
+reservation, rebalance. Kernels take packed arrays (see `packing.py`) and are
+side-effect free; host code owns caches and deltas.
+
+Conventions:
+  * shapes: P = padded pod batch, N = padded nodes, R = NUM_RESOURCES, K = NUMA nodes
+  * dtype: float32 scores/resources, int32 ids, bool masks
+  * padding rows are masked by `valid` flags; kernels must be padding-stable
+  * no data-dependent Python control flow — lax.cond/scan/while only
+"""

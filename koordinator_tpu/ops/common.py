@@ -1,0 +1,47 @@
+"""Shared kernel helpers reproducing Go arithmetic semantics.
+
+The reference computes scores with int64 arithmetic (floor division) and percent
+ratios with math.Round (half away from zero). Binding parity requires reproducing
+those exactly; these helpers are used by BOTH the batched kernels and the serial
+parity emulator so the two paths cannot drift.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# kube-scheduler framework.MaxNodeScore
+MAX_NODE_SCORE = 100.0
+
+
+def go_round(x):
+    """math.Round for non-negative values: half away from zero.
+
+    (jnp.round is banker's rounding — round-half-to-even — which differs on .5
+    boundaries and would flip filter decisions at exact threshold crossings.)
+    """
+    return jnp.floor(x + 0.5)
+
+
+def least_requested_score(requested, capacity):
+    """kube-scheduler leastRequestedScore (load_aware.go:389-397): 0 when capacity
+    is 0 or requested > capacity, else floor((capacity-requested)*100/capacity)."""
+    safe_cap = jnp.where(capacity > 0, capacity, 1.0)
+    raw = jnp.floor((capacity - requested) * MAX_NODE_SCORE / safe_cap)
+    return jnp.where((capacity > 0) & (requested <= capacity), raw, 0.0)
+
+
+def most_requested_score(requested, capacity):
+    """mostAllocated scorer (nodenumaresource/most_allocated.go): floor(req*100/cap),
+    0 when capacity is 0 or requested > capacity."""
+    safe_cap = jnp.where(capacity > 0, capacity, 1.0)
+    raw = jnp.floor(requested * MAX_NODE_SCORE / safe_cap)
+    return jnp.where((capacity > 0) & (requested <= capacity), raw, 0.0)
+
+
+def weighted_mean_floor(scores, weights, axis=-1):
+    """floor(sum(score*w)/sum(w)) — Go integer division of int64 sums."""
+    wsum = jnp.sum(weights)
+    safe = jnp.where(wsum > 0, wsum, 1.0)
+    out = jnp.floor(jnp.sum(scores * weights, axis=axis) / safe)
+    return jnp.where(wsum > 0, out, 0.0)

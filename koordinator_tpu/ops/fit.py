@@ -1,0 +1,51 @@
+"""NodeResourcesFit: the kube-scheduler default fit check.
+
+The reference scheduler runs koordinator plugins ALONGSIDE kube-scheduler's default
+plugins; bindings depend on the native Fit filter (requested + request <= allocatable
+per resource, pod-count included), so the batched chain reproduces it here.
+Vectorized: axes the pod doesn't request are skipped (k8s semantics).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.api.resources import RESOURCE_INDEX, ResourceName
+
+PODS_AXIS = RESOURCE_INDEX[ResourceName.PODS]
+
+
+def with_pod_count(requests: np.ndarray) -> np.ndarray:
+    """Return a copy of [P, R] requests with the pods axis set to 1 (every pod
+    consumes one pod slot in the Fit check)."""
+    out = np.array(requests, copy=True)
+    out[:, PODS_AXIS] = 1.0
+    return out
+
+
+def fit_ok_row(
+    fit_request: jnp.ndarray,   # [R] single pod (pods axis already set to 1)
+    allocatable: jnp.ndarray,   # [N, R]
+    requested: jnp.ndarray,     # [N, R] currently assigned
+) -> jnp.ndarray:
+    """[N] bool: node can fit this pod."""
+    need = fit_request[None, :]
+    ok = (need <= 0) | (requested + need <= allocatable)
+    return jnp.all(ok, axis=-1)
+
+
+def fit_ok_matrix(
+    fit_requests: jnp.ndarray,  # [P, R]
+    allocatable: jnp.ndarray,   # [N, R]
+    requested: jnp.ndarray,     # [N, R]
+) -> jnp.ndarray:
+    """[P, N] bool; computed axis-by-axis to avoid a [P, N, R] intermediate."""
+    P, R = fit_requests.shape
+    N = allocatable.shape[0]
+    ok = jnp.ones((P, N), bool)
+    for r in range(R):
+        need = fit_requests[:, r][:, None]
+        ok_r = (need <= 0) | (requested[None, :, r] + need <= allocatable[None, :, r])
+        ok = ok & ok_r
+    return ok

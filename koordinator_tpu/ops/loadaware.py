@@ -1,0 +1,371 @@
+"""LoadAware scheduling: vectorized filter + score.
+
+Reference: `pkg/scheduler/plugins/loadaware/load_aware.go` —
+  Filter (:123-171): reject nodes whose measured utilization (NodeMetric CR; instant
+    or aggregated percentile) crosses per-resource thresholds; DaemonSet pods,
+    metric-less nodes, and (optionally) expired metrics skip the check; prod pods
+    check prod-tier pod usage when prod thresholds are configured (:226-255).
+  Score (:269-335): least-allocated over estimatedUsed = estimator(pending pod)
+    + sum(estimated usage of recently-assigned pods not yet visible in metrics)
+    + adjusted measured node usage (estimated pods' actual usage deducted).
+
+TPU-first split (SURVEY.md section 7): everything that depends only on
+(node, NodeMetric, assign-cache) is precomputed per node on host into [N, R] arrays
+(`build_loadaware_node_state`); the kernels below are pure jnp over those arrays and
+are shared by the scheduler, the descheduler's LowNodeLoad, and the parity harness.
+The per-(pod,node) work on device is two [P, N] fused elementwise/reduce passes —
+no scalar plugin dispatch, no per-node goroutine fan-out.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.api.objects import Node, NodeMetric, Pod
+from koordinator_tpu.api.priority import PriorityClass
+from koordinator_tpu.api.resources import (
+    NUM_RESOURCES,
+    RESOURCE_INDEX,
+    ResourceName,
+)
+from koordinator_tpu.ops.common import go_round, least_requested_score
+from koordinator_tpu.ops.estimator import estimate_pod_used
+
+ANNOTATION_CUSTOM_USAGE_THRESHOLDS = "scheduling.koordinator.sh/usage-thresholds"
+DEFAULT_NODE_METRIC_REPORT_INTERVAL = 60.0
+
+
+@dataclass
+class LoadAwareArgs:
+    """LoadAwareSchedulingArgs with the v1beta2 defaults
+    (pkg/scheduler/apis/config/v1beta2/defaults.go:32-99)."""
+
+    filter_expired_node_metrics: bool = True
+    node_metric_expiration_seconds: float = 180.0
+    resource_weights: Dict[str, int] = field(
+        default_factory=lambda: {ResourceName.CPU: 1, ResourceName.MEMORY: 1}
+    )
+    usage_thresholds: Dict[str, int] = field(
+        default_factory=lambda: {ResourceName.CPU: 65, ResourceName.MEMORY: 95}
+    )
+    prod_usage_thresholds: Dict[str, int] = field(default_factory=dict)
+    score_according_prod_usage: bool = False
+    estimated_scaling_factors: Dict[str, int] = field(
+        default_factory=lambda: {ResourceName.CPU: 85, ResourceName.MEMORY: 70}
+    )
+    # Aggregated (percentile) profile, load_aware.go Aggregated args
+    agg_usage_thresholds: Dict[str, int] = field(default_factory=dict)
+    agg_usage_aggregation_type: str = ""       # "avg"|"p50"|"p90"|"p95"|"p99"
+    agg_usage_duration_seconds: int = 0        # 0 = longest recorded window
+    agg_score_aggregation_type: str = ""
+    agg_score_duration_seconds: int = 0
+
+    @property
+    def filter_with_aggregation(self) -> bool:
+        return bool(self.agg_usage_thresholds) and bool(self.agg_usage_aggregation_type)
+
+    @property
+    def score_with_aggregation(self) -> bool:
+        return bool(self.agg_score_aggregation_type)
+
+    def weight_vector(self) -> np.ndarray:
+        w = np.zeros(NUM_RESOURCES, np.float32)
+        for name, weight in self.resource_weights.items():
+            w[RESOURCE_INDEX[name]] = weight
+        return w
+
+
+def _thresholds_vector(thresholds: Dict[str, int]) -> np.ndarray:
+    v = np.zeros(NUM_RESOURCES, np.float32)
+    for name, t in thresholds.items():
+        v[RESOURCE_INDEX[name]] = t
+    return v
+
+
+def _get_aggregated_usage(
+    nm: NodeMetric, duration_seconds: int, agg_type: str
+) -> Optional[np.ndarray]:
+    """getTargetAggregatedUsage (helper.go:58-90): exact duration match, or the
+    longest recorded window when no duration is configured; missing type -> None."""
+    if not nm.node_metric.aggregated_node_usages:
+        return None
+    if duration_seconds:
+        windows = [duration_seconds] if duration_seconds in nm.node_metric.aggregated_node_usages else []
+    else:
+        windows = [max(nm.node_metric.aggregated_node_usages.keys())]
+    for d in windows:
+        usage = nm.node_metric.aggregated_node_usages[d].get(agg_type)
+        if usage is not None and usage:
+            return usage.to_vector()
+    return None
+
+
+def _custom_profile(
+    node: Node, args: LoadAwareArgs
+) -> Tuple[Dict[str, int], Dict[str, int], Optional[Tuple[Dict[str, int], str, int]]]:
+    """generateUsageThresholdsFilterProfile (helper.go:102-139): node annotation
+    overrides cluster args per section; aggregated profile falls back to args."""
+    usage_thr, prod_thr = args.usage_thresholds, args.prod_usage_thresholds
+    agg: Optional[Tuple[Dict[str, int], str, int]] = None
+    if args.filter_with_aggregation:
+        agg = (
+            args.agg_usage_thresholds,
+            args.agg_usage_aggregation_type,
+            args.agg_usage_duration_seconds,
+        )
+    raw = node.meta.annotations.get(ANNOTATION_CUSTOM_USAGE_THRESHOLDS)
+    if raw:
+        try:
+            data = json.loads(raw)
+        except (ValueError, TypeError):
+            return usage_thr, prod_thr, agg
+        if data.get("usageThresholds"):
+            usage_thr = {k: int(v) for k, v in data["usageThresholds"].items()}
+        if data.get("prodUsageThresholds"):
+            prod_thr = {k: int(v) for k, v in data["prodUsageThresholds"].items()}
+        custom_agg = data.get("aggregatedUsage")
+        if custom_agg and custom_agg.get("usageThresholds") and custom_agg.get(
+            "usageAggregationType"
+        ):
+            agg = (
+                {k: int(v) for k, v in custom_agg["usageThresholds"].items()},
+                custom_agg["usageAggregationType"],
+                int(custom_agg.get("usageAggregatedDurationSeconds", 0) or 0),
+            )
+    return usage_thr, prod_thr, agg
+
+
+def _is_prod_with_default(pod: Pod) -> bool:
+    """GetPodPriorityClassWithDefault: pods outside koordinator bands behave as
+    PROD for the prod-usage checks."""
+    return pod.priority_class in (PriorityClass.PROD, PriorityClass.NONE)
+
+
+def build_loadaware_node_state(
+    nodes: Sequence[Node],
+    node_metrics: Dict[str, NodeMetric],
+    pods_by_key: Dict[str, Pod],
+    assigned: Dict[str, List[Tuple[Pod, float]]],
+    args: LoadAwareArgs,
+    now: float,
+    pad_to: int,
+) -> Dict[str, np.ndarray]:
+    """Precompute per-node LoadAware terms as [N, R] / [N] arrays.
+
+    `assigned` is the podAssignCache view: node -> [(pod, assign_timestamp)] of
+    pods Reserved on the node (pod_assign_cache.go). Returns the extras dict to
+    attach to NodeBatch.
+    """
+    n_pad = pad_to
+    R = NUM_RESOURCES
+    filter_usage = np.zeros((n_pad, R), np.float32)
+    has_filter_usage = np.zeros(n_pad, bool)
+    filter_thr = np.zeros((n_pad, R), np.float32)
+    prod_thr_arr = np.zeros((n_pad, R), np.float32)
+    prod_pod_usage = np.zeros((n_pad, R), np.float32)
+    term_np = np.zeros((n_pad, R), np.float32)
+    term_pr = np.zeros((n_pad, R), np.float32)
+    score_valid = np.zeros(n_pad, bool)
+    filter_skip = np.zeros(n_pad, bool)
+
+    for i, node in enumerate(nodes):
+        nm = node_metrics.get(node.meta.name)
+        # isNodeMetricExpired (helper.go:36-41)
+        expired = (
+            nm is None
+            or nm.update_time <= 0
+            or (
+                args.node_metric_expiration_seconds > 0
+                and now - nm.update_time >= args.node_metric_expiration_seconds
+            )
+        )
+        if nm is None or (args.filter_expired_node_metrics and expired):
+            filter_skip[i] = True  # load_aware.go:135-150: allow without check
+        score_valid[i] = nm is not None and not expired
+        if nm is None:
+            continue
+
+        usage_thr, prod_thr, agg = _custom_profile(node, args)
+        if agg is not None:
+            agg_thr, agg_type, agg_dur = agg
+            filter_thr[i] = _thresholds_vector(agg_thr)
+            src = _get_aggregated_usage(nm, agg_dur, agg_type)
+        else:
+            filter_thr[i] = _thresholds_vector(usage_thr)
+            src = nm.node_metric.node_usage.to_vector() if nm.node_metric else None
+        if src is not None:
+            filter_usage[i] = src
+            has_filter_usage[i] = True
+
+        # prod filter (load_aware.go:226-255): requires PodsMetric present
+        pod_metrics_prod: Dict[str, np.ndarray] = {}
+        pod_metrics_all: Dict[str, np.ndarray] = {}
+        for pm in nm.pods_metric:
+            key = f"{pm.namespace}/{pm.name}"
+            pod = pods_by_key.get(key)
+            if pod is None:  # buildPodMetricMap: lister miss -> skip
+                continue
+            vec = pm.pod_usage.to_vector()
+            pod_metrics_all[key] = vec
+            if _is_prod_with_default(pod):
+                pod_metrics_prod[key] = vec
+        if prod_thr and nm.pods_metric:
+            prod_thr_arr[i] = _thresholds_vector(prod_thr)
+            for vec in pod_metrics_prod.values():
+                prod_pod_usage[i] += vec
+
+        # ---- score terms ----
+        report_interval = nm.report_interval_seconds or DEFAULT_NODE_METRIC_REPORT_INTERVAL
+        if args.score_with_aggregation:
+            score_src = _get_aggregated_usage(
+                nm, args.agg_score_duration_seconds, args.agg_score_aggregation_type
+            )
+        else:
+            score_src = (
+                nm.node_metric.node_usage.to_vector() if nm.node_metric else None
+            )
+
+        def assigned_term(
+            metrics: Dict[str, np.ndarray], prod_only: bool
+        ) -> Tuple[np.ndarray, set]:
+            """estimatedAssignedPodUsed (load_aware.go:337-383)."""
+            est_sum = np.zeros(R, np.float32)
+            est_pods: set = set()
+            for pod, ts in assigned.get(node.meta.name, []):
+                if prod_only and not _is_prod_with_default(pod):
+                    continue
+                key = pod.meta.key
+                pod_usage = metrics.get(key)
+                needs_estimate = (
+                    pod_usage is None
+                    or ts > nm.update_time  # missedLatestUpdateTime
+                    or (ts < nm.update_time and nm.update_time - ts < report_interval)
+                    or (args.score_with_aggregation and score_src is None)
+                )
+                if not needs_estimate:
+                    continue
+                est = estimate_pod_used(
+                    pod, args.resource_weights, args.estimated_scaling_factors
+                )
+                for native in args.resource_weights:
+                    r = RESOURCE_INDEX[native]
+                    value = est[r]
+                    if pod_usage is not None and pod_usage[r] > value:
+                        value = pod_usage[r]
+                    est_sum[r] += value
+                est_pods.add(key)
+            return est_sum, est_pods
+
+        # non-prod branch: node usage minus estimated pods' actual, plus estimates
+        est_np, est_pods_np = assigned_term(pod_metrics_all, prod_only=False)
+        term = est_np.copy()
+        if score_src is not None:
+            est_actual = np.zeros(R, np.float32)
+            for key in est_pods_np:
+                vec = pod_metrics_all.get(key)
+                if vec is not None:
+                    est_actual += vec
+            # quantity.Sub(q) only when quantity >= q (load_aware.go:316-323),
+            # decided per-resource on the whole vector
+            adjusted = np.where(score_src >= est_actual, score_src - est_actual, score_src)
+            term += adjusted
+        term_np[i] = term
+
+        # prod branch (scoreAccordingProdUsage): prod pod metrics only
+        if args.score_according_prod_usage:
+            est_pr, est_pods_pr = assigned_term(pod_metrics_prod, prod_only=True)
+            term = est_pr.copy()
+            for key, vec in pod_metrics_prod.items():
+                if key not in est_pods_pr:  # sumPodUsages excludes estimated pods
+                    term += vec
+            term_pr[i] = term
+
+    return {
+        "la_filter_usage": filter_usage,
+        "la_has_filter_usage": has_filter_usage,
+        "la_filter_thresholds": filter_thr,
+        "la_prod_thresholds": prod_thr_arr,
+        "la_prod_pod_usage": prod_pod_usage,
+        "la_term_nonprod": term_np,
+        "la_term_prod": term_pr,
+        "la_score_valid": score_valid,
+        "la_filter_skip": filter_skip,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Device kernels (pure jnp; also consumed by the serial parity emulator row-wise)
+# ---------------------------------------------------------------------------
+
+
+def loadaware_node_reject(
+    allocatable: jnp.ndarray,        # [N, R]
+    filter_usage: jnp.ndarray,       # [N, R]
+    has_filter_usage: jnp.ndarray,   # [N]
+    filter_thresholds: jnp.ndarray,  # [N, R]
+    prod_thresholds: jnp.ndarray,    # [N, R]
+    prod_pod_usage: jnp.ndarray,     # [N, R]
+    filter_skip: jnp.ndarray,        # [N]
+):
+    """Per-node reject masks; pod-independent (the pod enters only via
+    is_prod/is_daemonset, combined in `loadaware_filter`). Returns
+    (reject_nonprod[N], reject_prod[N])."""
+    checkable = (filter_thresholds > 0) & (allocatable > 0) & has_filter_usage[:, None]
+    ratio = go_round(filter_usage * 100.0 / jnp.maximum(allocatable, 1e-9))
+    reject_np = jnp.any(checkable & (ratio >= filter_thresholds), axis=-1)
+    reject_np = jnp.where(filter_skip, False, reject_np)
+
+    prod_checkable = (prod_thresholds > 0) & (allocatable > 0)
+    prod_ratio = go_round(prod_pod_usage * 100.0 / jnp.maximum(allocatable, 1e-9))
+    reject_prod_only = jnp.any(prod_checkable & (prod_ratio >= prod_thresholds), axis=-1)
+    has_prod_thr = jnp.any(prod_thresholds > 0, axis=-1)
+    # prod pods use the prod check IFF prod thresholds exist, else the normal one
+    # (load_aware.go:152-170); expired/missing metrics skip everything (:135-150)
+    reject_prod = jnp.where(has_prod_thr, reject_prod_only, reject_np)
+    reject_prod = jnp.where(filter_skip, False, reject_prod)
+    return reject_np, reject_prod
+
+
+def loadaware_filter(
+    is_prod: jnp.ndarray,       # [P]
+    is_daemonset: jnp.ndarray,  # [P]
+    reject_nonprod: jnp.ndarray,
+    reject_prod: jnp.ndarray,
+) -> jnp.ndarray:
+    """Combine per-node rejects with pod flags -> feasible[P, N]."""
+    reject = jnp.where(is_prod[:, None], reject_prod[None, :], reject_nonprod[None, :])
+    return jnp.where(is_daemonset[:, None], True, ~reject)
+
+
+def loadaware_score_terms(
+    estimated: jnp.ndarray,   # [P, R] estimator output for pending pods
+    is_prod: jnp.ndarray,     # [P]
+    term_nonprod: jnp.ndarray,  # [N, R]
+    term_prod: jnp.ndarray,     # [N, R]
+    allocatable: jnp.ndarray,   # [N, R]
+    score_valid: jnp.ndarray,   # [N]
+    weights: jnp.ndarray,       # [R]
+    score_according_prod_usage: bool,
+    weight_idx: Tuple[int, ...],
+) -> jnp.ndarray:
+    """score[P, N]: weighted least-allocated over estimatedUsed
+    (load_aware.go:283-335 + :385-397). Computed per weighted resource axis
+    (static weight_idx) to avoid a [P, N, R] intermediate."""
+    wsum = jnp.sum(weights)
+    acc = jnp.zeros((estimated.shape[0], term_nonprod.shape[0]), jnp.float32)
+    for r in weight_idx:
+        if score_according_prod_usage:
+            node_term = jnp.where(
+                is_prod[:, None], term_prod[None, :, r], term_nonprod[None, :, r]
+            )
+        else:
+            node_term = term_nonprod[None, :, r]
+        used = estimated[:, r][:, None] + node_term
+        acc = acc + weights[r] * least_requested_score(used, allocatable[None, :, r])
+    score = jnp.floor(acc / jnp.maximum(wsum, 1.0))
+    return jnp.where(score_valid[None, :], score, 0.0)

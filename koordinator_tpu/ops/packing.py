@@ -1,0 +1,181 @@
+"""Host->device packing: cluster snapshots as static-shaped arrays.
+
+The analog of the scheduler's cache/snapshot layer (nodeInfo snapshots + the
+LoadAware podAssignCache, reference `plugins/loadaware/pod_assign_cache.go`), lowered
+to bucketed, padded tensors:
+
+  PodBatch  : pending pods   [P, ...]   (P padded to a bucket size)
+  NodeBatch : cluster nodes  [N, ...]   (N padded)
+
+Bucketing keeps jit recompilation amortized while pods/nodes churn (SURVEY.md
+section 7 "hard parts: dynamic shapes"). Padding rows carry valid=False and are
+masked inside every kernel.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from koordinator_tpu.api.objects import Node, NodeMetric, Pod
+from koordinator_tpu.api.priority import PriorityClass
+from koordinator_tpu.api.resources import NUM_RESOURCES
+from koordinator_tpu.ops.estimator import estimate_node_allocatable, estimate_pod_used
+
+MIN_BUCKET = 16
+
+
+def bucket_size(n: int, minimum: int = MIN_BUCKET) -> int:
+    """Next power of two >= n (>= minimum); 10k pods and 5k nodes land on 16384/8192
+    so steady-state churn never recompiles."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class PodBatch:
+    """Packed pending pods. Row order IS the scheduling order (priority queue
+    order: priority desc, then creation/sub-priority), so kernels that honor the
+    serial contract iterate rows in order."""
+
+    keys: List[str]                      # len = num_valid
+    requests: np.ndarray                 # [P, R] float32 packed units
+    estimated: np.ndarray                # [P, R] estimator output (native axes)
+    priority: np.ndarray                 # [P] int32 numeric pod priority
+    qos: np.ndarray                      # [P] int32 QoSClass
+    prio_class: np.ndarray               # [P] int32 PriorityClass
+    is_prod: np.ndarray                  # [P] bool (priority class == PROD)
+    is_daemonset: np.ndarray             # [P] bool (owner kind DaemonSet)
+    gang_id: np.ndarray                  # [P] int32, -1 = no gang
+    quota_id: np.ndarray                 # [P] int32, -1 = no quota group
+    valid: np.ndarray                    # [P] bool
+
+    @property
+    def num_valid(self) -> int:
+        return len(self.keys)
+
+    @property
+    def padded_size(self) -> int:
+        return self.requests.shape[0]
+
+
+@dataclass
+class NodeBatch:
+    """Packed node-side state. Per-node vectors precomputed on host from Node +
+    NodeMetric + plugin caches; kernels combine them with PodBatch rows."""
+
+    names: List[str]
+    allocatable: np.ndarray              # [N, R] estimator EstimateNode
+    requested: np.ndarray                # [N, R] sum of assigned pod requests (Fit state)
+    valid: np.ndarray                    # [N] bool
+    # LoadAware terms (built by ops.loadaware.build_loadaware_node_state)
+    extras: Dict[str, np.ndarray] = field(default_factory=dict)
+
+    @property
+    def num_valid(self) -> int:
+        return len(self.names)
+
+    @property
+    def padded_size(self) -> int:
+        return self.allocatable.shape[0]
+
+
+def pack_pods(
+    pods: Sequence[Pod],
+    resource_weights: Dict[str, int],
+    scaling_factors: Dict[str, int],
+    gang_ids: Optional[Dict[str, int]] = None,
+    quota_ids: Optional[Dict[str, int]] = None,
+    pad_to: Optional[int] = None,
+) -> PodBatch:
+    """Pack pods in scheduling-queue order: priority desc, sub-priority desc,
+    creation time asc, key asc (kube-scheduler PrioritySort + coscheduling Less,
+    coscheduling.go:118)."""
+    order = sorted(
+        range(len(pods)),
+        key=lambda i: (
+            -(pods[i].spec.priority or 0),
+            -pods[i].sub_priority,
+            pods[i].meta.creation_timestamp,
+            pods[i].meta.key,
+        ),
+    )
+    pods = [pods[i] for i in order]
+    n = len(pods)
+    p = pad_to or bucket_size(n)
+    req = np.zeros((p, NUM_RESOURCES), np.float32)
+    est = np.zeros((p, NUM_RESOURCES), np.float32)
+    prio = np.zeros(p, np.int32)
+    qos = np.full(p, 5, np.int32)  # QoSClass.NONE
+    pcls = np.full(p, int(PriorityClass.NONE), np.int32)
+    prod = np.zeros(p, bool)
+    ds = np.zeros(p, bool)
+    gang = np.full(p, -1, np.int32)
+    quota = np.full(p, -1, np.int32)
+    valid = np.zeros(p, bool)
+    for i, pod in enumerate(pods):
+        req[i] = pod.spec.requests.to_vector()
+        est[i] = estimate_pod_used(pod, resource_weights, scaling_factors)
+        prio[i] = pod.spec.priority or 0
+        qos[i] = int(pod.qos_class)
+        cls = pod.priority_class
+        pcls[i] = int(cls)
+        # GetPodPriorityClassWithDefault: pods outside koordinator bands default
+        # to PROD semantics in LoadAware's prod checks
+        prod[i] = cls in (PriorityClass.PROD, PriorityClass.NONE)
+        ds[i] = pod.meta.owner_kind == "DaemonSet"
+        if gang_ids and pod.gang_name:
+            gang[i] = gang_ids.get(pod.gang_name, -1)
+        if quota_ids and pod.quota_name:
+            quota[i] = quota_ids.get(pod.quota_name, -1)
+        valid[i] = True
+    return PodBatch(
+        keys=[pd.meta.key for pd in pods],
+        requests=req,
+        estimated=est,
+        priority=prio,
+        qos=qos,
+        prio_class=pcls,
+        is_prod=prod,
+        is_daemonset=ds,
+        gang_id=gang,
+        quota_id=quota,
+        valid=valid,
+    )
+
+
+def pack_nodes(
+    nodes: Sequence[Node],
+    assigned_requests: Optional[Dict[str, np.ndarray]] = None,
+    pad_to: Optional[int] = None,
+) -> NodeBatch:
+    """Pack node allocatable + current requested (the NodeResourcesFit state)."""
+    n = len(nodes)
+    size = pad_to or bucket_size(n)
+    alloc = np.zeros((size, NUM_RESOURCES), np.float32)
+    requested = np.zeros((size, NUM_RESOURCES), np.float32)
+    valid = np.zeros(size, bool)
+    for i, node in enumerate(nodes):
+        alloc[i] = estimate_node_allocatable(node)
+        if assigned_requests is not None:
+            vec = assigned_requests.get(node.meta.name)
+            if vec is not None:
+                requested[i] = vec
+        valid[i] = True
+    return NodeBatch(
+        names=[nd.meta.name for nd in nodes],
+        allocatable=alloc,
+        requested=requested,
+        valid=valid,
+    )
+
+
+def metric_age(node_metric: Optional[NodeMetric], now: Optional[float] = None) -> float:
+    if node_metric is None or node_metric.update_time <= 0:
+        return float("inf")
+    return (time.time() if now is None else now) - node_metric.update_time

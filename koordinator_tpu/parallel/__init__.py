@@ -1,0 +1,18 @@
+"""Multi-chip scaling via jax.sharding Mesh + XLA collectives.
+
+The reference scales the pod x node evaluation with per-node goroutine fan-out and
+leader-elected replicas (SURVEY.md section 5.7-5.8). Here the same scaling rides
+the device mesh: node-state tensors shard over the "nodes" mesh axis (the analog of
+the per-node fan-out, now across chips over ICI), pod batches shard over "pods" for
+the one-shot matrix/rebalance mode, and XLA inserts the argmax/reduce collectives.
+Multi-host extends the same mesh over DCN (jax distributed initialization) — no
+NCCL/MPI analog needed.
+"""
+
+from koordinator_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    shard_inputs_nodewise,
+    shard_inputs_2d,
+    build_sharded_schedule_step,
+    build_sharded_score_matrix,
+)

@@ -1,0 +1,107 @@
+"""Mesh layout and sharded scheduling steps.
+
+Two shardings cover the framework's compute:
+
+  1. serial-parity step: node-state arrays ([N, R], [N]) sharded over ALL devices
+     on the "nodes" axis; pod arrays replicated. Each fori_loop iteration's
+     filter/score row is computed shard-locally; the argmax reduces across shards
+     (XLA all-reduce over ICI). This preserves exact serial semantics at any mesh
+     size — the distributed analog of kube-scheduler's per-node fan-out.
+
+  2. score-matrix / rebalance: 2-D mesh ("pods", "nodes"); the [P, N] score matrix
+     shards over both axes — full SPMD for the descheduler's 50k-pod global
+     rebalance (BASELINE.md config 5) and throughput mode.
+
+Multi-host: the same code runs under `jax.distributed.initialize()`; mesh axes laid
+out so "nodes" stays within a slice (ICI) and "pods" may span slices (DCN), since
+the pods axis only needs its collectives at the final argmax/top-k.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from koordinator_tpu.models.scheduler_model import (
+    ScheduleInputs,
+    build_schedule_step,
+    build_score_matrix,
+)
+from koordinator_tpu.ops.loadaware import LoadAwareArgs
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """2-D mesh ("pods", "nodes"); the nodes axis gets the larger factor (node
+    count exceeds pending-pod count in the target configs)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    pods_dim = 1
+    for f in range(int(np.sqrt(n)), 0, -1):
+        if n % f == 0:
+            pods_dim = f
+            break
+    nodes_dim = n // pods_dim
+    dev_array = np.array(devices).reshape(pods_dim, nodes_dim)
+    return Mesh(dev_array, axis_names=("pods", "nodes"))
+
+
+def _node_axis_spec(mesh: Mesh, flat: bool) -> P:
+    # serial mode shards nodes over every device (both mesh axes)
+    return P(("pods", "nodes")) if flat else P("nodes")
+
+
+def shard_inputs_nodewise(inputs: ScheduleInputs, mesh: Mesh) -> ScheduleInputs:
+    """Sharding for the serial-parity step: node arrays sharded over all devices,
+    pod arrays + weights replicated."""
+    node_spec = _node_axis_spec(mesh, flat=True)
+    pod_fields = {
+        "fit_requests",
+        "estimated",
+        "is_prod",
+        "is_daemonset",
+        "pod_valid",
+        "weights",
+    }
+
+    def put(name, arr):
+        spec = P() if name in pod_fields else node_spec
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    return ScheduleInputs(**{k: put(k, v) for k, v in inputs._asdict().items()})
+
+
+def shard_inputs_2d(inputs: ScheduleInputs, mesh: Mesh) -> ScheduleInputs:
+    """Sharding for the one-shot matrix: pods over "pods", nodes over "nodes"."""
+    pod_fields = {"fit_requests", "estimated", "is_prod", "is_daemonset", "pod_valid"}
+
+    def put(name, arr):
+        if name == "weights":
+            spec = P()
+        elif name in pod_fields:
+            spec = P("pods")
+        else:
+            spec = P("nodes")
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    return ScheduleInputs(**{k: put(k, v) for k, v in inputs._asdict().items()})
+
+
+def build_sharded_schedule_step(args: LoadAwareArgs, mesh: Mesh):
+    """Serial-parity step jitted with node-sharded in/out shardings."""
+    raw = build_schedule_step(args, jit=False)
+    node_spec = _node_axis_spec(mesh, flat=True)
+    out_shardings = (
+        NamedSharding(mesh, P()),          # chosen [P] replicated
+        NamedSharding(mesh, node_spec),    # requested [N, R]
+    )
+    return jax.jit(raw, out_shardings=out_shardings)
+
+
+def build_sharded_score_matrix(args: LoadAwareArgs, mesh: Mesh):
+    """One-shot [P, N] matrix jitted over the 2-D mesh."""
+    raw = build_score_matrix(args, jit=False)
+    out = NamedSharding(mesh, P("pods", "nodes"))
+    return jax.jit(raw, out_shardings=(out, out))

@@ -1,0 +1,7 @@
+"""Scheduler: frameworkext analog, plugin registry, batched cycle, parity harness.
+
+Analog of reference `pkg/scheduler/` (SURVEY.md section 2.2): the extender engine
+that wraps extension points, the plugins (LoadAware, NodeNUMAResource, Reservation,
+Coscheduling, ElasticQuota, DeviceShare), and the scheduling cycle driver that feeds
+the batched TPU kernels and applies bindings back to the object store.
+"""

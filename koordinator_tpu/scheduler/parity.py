@@ -1,0 +1,155 @@
+"""Serial parity emulator: the reference's per-pod plugin chain, scalar in numpy.
+
+This is the trustworthy oracle of SURVEY.md section 7 ("parity harness ... is the
+only trustworthy test"): a direct, unvectorized transcription of the reference's
+Filter/Score/Reserve semantics (load_aware.go + kube NodeResourcesFit), operating on
+the SAME packed inputs as the batched kernel. The batched step must produce
+IDENTICAL bindings on any trace. It is also the measured performance floor standing
+in for the reference's serial Go chain (BASELINE.md: baseline must be measured).
+
+Everything here is float32 numpy with the same go_round/floor arithmetic as
+ops/common.py so the two paths cannot diverge on rounding.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from koordinator_tpu.api.resources import NUM_RESOURCES
+from koordinator_tpu.models.scheduler_model import ScheduleInputs
+from koordinator_tpu.ops.fit import with_pod_count  # noqa: F401  (packing parity)
+from koordinator_tpu.ops.loadaware import LoadAwareArgs
+
+MAX_NODE_SCORE = 100.0
+
+
+def _go_round(x: np.float32) -> np.float32:
+    return np.float32(np.floor(x + np.float32(0.5)))
+
+
+def _least_requested(requested: np.float32, capacity: np.float32) -> np.float32:
+    if capacity <= 0 or requested > capacity:
+        return np.float32(0.0)
+    return np.float32(np.floor((capacity - requested) * np.float32(MAX_NODE_SCORE) / capacity))
+
+
+def serial_schedule(inputs: ScheduleInputs, args: LoadAwareArgs) -> np.ndarray:
+    """Schedule the batch pod-by-pod, node-by-node; returns chosen[P] int32."""
+    fit_requests = np.asarray(inputs.fit_requests, np.float32)
+    estimated = np.asarray(inputs.estimated, np.float32)
+    is_prod = np.asarray(inputs.is_prod)
+    is_daemonset = np.asarray(inputs.is_daemonset)
+    pod_valid = np.asarray(inputs.pod_valid)
+    allocatable = np.asarray(inputs.allocatable, np.float32)
+    requested = np.array(inputs.requested, np.float32)
+    node_ok = np.asarray(inputs.node_ok)
+    filter_usage = np.asarray(inputs.la_filter_usage, np.float32)
+    has_filter_usage = np.asarray(inputs.la_has_filter_usage)
+    filter_thr = np.asarray(inputs.la_filter_thresholds, np.float32)
+    prod_thr = np.asarray(inputs.la_prod_thresholds, np.float32)
+    prod_usage = np.asarray(inputs.la_prod_pod_usage, np.float32)
+    term_np = np.array(inputs.la_term_nonprod, np.float32)
+    term_pr = np.array(inputs.la_term_prod, np.float32)
+    score_valid = np.asarray(inputs.la_score_valid)
+    filter_skip = np.asarray(inputs.la_filter_skip)
+    weights = np.asarray(inputs.weights, np.float32)
+
+    P, R = fit_requests.shape
+    N = allocatable.shape[0]
+    weight_idx = [int(r) for r in np.nonzero(weights)[0]]
+    wsum = np.float32(weights.sum())
+    prod_mode = args.score_according_prod_usage
+    chosen = np.full(P, -1, np.int32)
+
+    def filter_loadaware(p: int, n: int) -> bool:
+        # load_aware.go:123-171
+        if is_daemonset[p]:
+            return True
+        if filter_skip[n]:
+            # expired or missing NodeMetric: allowed before any profile check
+            # (load_aware.go:135-150)
+            return True
+        prod_configured = bool((prod_thr[n] > 0).any())
+        if is_prod[p] and prod_configured:
+            # filterProdUsage (load_aware.go:226-255)
+            for r in range(R):
+                thr = prod_thr[n, r]
+                if thr == 0:
+                    continue
+                total = allocatable[n, r]
+                if total == 0:
+                    continue
+                ratio = _go_round(np.float32(prod_usage[n, r] * 100.0 / total))
+                if ratio >= thr:
+                    return False
+            return True
+        if not has_filter_usage[n]:
+            return True
+        for r in range(R):
+            thr = filter_thr[n, r]
+            if thr == 0:
+                continue
+            total = allocatable[n, r]
+            if total == 0:
+                continue
+            ratio = _go_round(np.float32(filter_usage[n, r] * 100.0 / total))
+            if ratio >= thr:
+                return False
+        return True
+
+    def filter_fit(p: int, n: int) -> bool:
+        for r in range(R):
+            need = fit_requests[p, r]
+            if need <= 0:
+                continue
+            if requested[n, r] + need > allocatable[n, r]:
+                return False
+        return True
+
+    def score_loadaware(p: int, n: int) -> np.float32:
+        # load_aware.go:269-335
+        if not score_valid[n]:
+            return np.float32(0.0)
+        acc = np.float32(0.0)
+        use_prod = prod_mode and is_prod[p]
+        for r in weight_idx:
+            term = term_pr[n, r] if use_prod else term_np[n, r]
+            used = np.float32(estimated[p, r] + term)
+            acc += np.float32(weights[r]) * _least_requested(used, allocatable[n, r])
+        return np.float32(np.floor(acc / max(wsum, np.float32(1.0))))
+
+    for p in range(P):
+        if not pod_valid[p]:
+            continue
+        best_n, best_score = -1, np.float32(-1.0)
+        for n in range(N):
+            if not node_ok[n]:
+                continue
+            if not filter_fit(p, n):
+                continue
+            if not filter_loadaware(p, n):
+                continue
+            s = score_loadaware(p, n)
+            if s > best_score:  # strict: lowest index wins ties
+                best_n, best_score = n, s
+        if best_n < 0:
+            continue
+        chosen[p] = best_n
+        # Reserve: Fit state + podAssignCache (load_aware.go:263-267)
+        requested[best_n] += fit_requests[p]
+        term_np[best_n] += estimated[p]
+        if prod_mode and is_prod[p]:
+            term_pr[best_n] += estimated[p]
+
+    return chosen
+
+
+def diff_bindings(chosen_a: np.ndarray, chosen_b: np.ndarray, keys: List[str]) -> List[str]:
+    """Human-readable diff of two binding vectors (parity failures)."""
+    out = []
+    for i, key in enumerate(keys):
+        if chosen_a[i] != chosen_b[i]:
+            out.append(f"{key}: {int(chosen_a[i])} != {int(chosen_b[i])}")
+    return out

@@ -1,0 +1,8 @@
+"""Test fixtures: synthetic clusters and trace replay.
+
+Analog of the reference's load-bearing fixtures (SURVEY.md section 4): fake
+clientset (client.ObjectStore is already in-process), scheduler-framework harness,
+and workload generators standing in for the `examples/spark-jobs` colocation traces.
+"""
+
+from koordinator_tpu.testing.synth import SynthCluster, synth_cluster  # noqa: F401

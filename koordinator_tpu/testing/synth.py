@@ -1,0 +1,172 @@
+"""Synthetic cluster/workload generator.
+
+Produces pods/nodes/NodeMetrics exercising every LoadAware branch: prod/batch/mid
+priority bands, BE/LS QoS, DaemonSet pods, zero-request pods (estimator defaults),
+limits>requests (100% scaling), expired and missing NodeMetrics, aggregated
+percentile usage, custom per-node threshold annotations, and pod metrics for the
+assign-cache adjustment paths. Deterministic via seed. Stands in for the
+reference's `examples/spark-jobs` trace in benchmarks (BASELINE.md configs).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+from koordinator_tpu.api.objects import (
+    LABEL_POD_QOS,
+    Node,
+    NodeMetric,
+    NodeMetricInfo,
+    ObjectMeta,
+    Pod,
+    PodMetricInfo,
+    PodSpec,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.ops.loadaware import ANNOTATION_CUSTOM_USAGE_THRESHOLDS
+
+GIB = 1024**3
+MIB = 1024**2
+
+
+@dataclass
+class SynthCluster:
+    nodes: List[Node]
+    pods: List[Pod]                      # pending pods (unassigned)
+    node_metrics: Dict[str, NodeMetric]  # by node name
+    pods_by_key: Dict[str, Pod]          # running pods visible to listers
+    assigned: Dict[str, List[Tuple[Pod, float]]] = field(default_factory=dict)
+    now: float = 1_000_000.0
+
+
+def synth_cluster(
+    num_nodes: int,
+    num_pods: int,
+    seed: int = 0,
+    now: float = 1_000_000.0,
+    expired_fraction: float = 0.05,
+    missing_metric_fraction: float = 0.05,
+    custom_threshold_fraction: float = 0.1,
+    aggregated_fraction: float = 0.3,
+    with_pod_metrics: bool = True,
+) -> SynthCluster:
+    rng = random.Random(seed)
+    nodes: List[Node] = []
+    node_metrics: Dict[str, NodeMetric] = {}
+    pods_by_key: Dict[str, Pod] = {}
+
+    for i in range(num_nodes):
+        cores = rng.choice([16, 32, 64, 96])
+        mem_gib = cores * rng.choice([2, 4, 8])
+        meta = ObjectMeta(name=f"node-{i}", namespace="")
+        if rng.random() < custom_threshold_fraction:
+            meta.annotations[ANNOTATION_CUSTOM_USAGE_THRESHOLDS] = (
+                '{"usageThresholds": {"cpu": %d, "memory": %d}}'
+                % (rng.choice([50, 70, 90]), rng.choice([80, 90]))
+            )
+        node = Node(
+            meta=meta,
+            allocatable=ResourceList.of(
+                cpu=cores * 1000, memory=mem_gib * GIB, pods=110
+            ),
+        )
+        nodes.append(node)
+
+        if rng.random() < missing_metric_fraction:
+            continue
+        update_time = now - rng.uniform(1, 60)
+        if rng.random() < expired_fraction:
+            update_time = now - rng.uniform(200, 400)  # beyond 180s default expiry
+        usage_cpu = int(cores * 1000 * rng.uniform(0.05, 0.9))
+        usage_mem = int(mem_gib * GIB * rng.uniform(0.05, 0.9))
+        info = NodeMetricInfo(
+            node_usage=ResourceList.of(cpu=usage_cpu, memory=usage_mem)
+        )
+        if rng.random() < aggregated_fraction:
+            info.aggregated_node_usages = {
+                300: {
+                    "p95": ResourceList.of(
+                        cpu=int(usage_cpu * 1.1), memory=int(usage_mem * 1.05)
+                    )
+                },
+                1800: {
+                    "p95": ResourceList.of(
+                        cpu=int(usage_cpu * 1.2), memory=int(usage_mem * 1.1)
+                    ),
+                    "p50": ResourceList.of(
+                        cpu=int(usage_cpu * 0.8), memory=int(usage_mem * 0.9)
+                    ),
+                },
+            }
+        nm = NodeMetric(
+            meta=ObjectMeta(name=f"node-{i}", namespace=""),
+            update_time=update_time,
+            node_metric=info,
+        )
+        if with_pod_metrics:
+            for j in range(rng.randint(0, 4)):
+                pod_name = f"running-{i}-{j}"
+                prio = rng.choice([9500, 9500, 5500, 7500])
+                running = Pod(
+                    meta=ObjectMeta(name=pod_name, namespace="default"),
+                    spec=PodSpec(node_name=f"node-{i}", priority=prio),
+                    phase="Running",
+                )
+                pods_by_key[running.meta.key] = running
+                nm.pods_metric.append(
+                    PodMetricInfo(
+                        namespace="default",
+                        name=pod_name,
+                        pod_usage=ResourceList.of(
+                            cpu=rng.randint(50, 2000),
+                            memory=rng.randint(64, 4096) * MIB,
+                        ),
+                    )
+                )
+        node_metrics[f"node-{i}"] = nm
+
+    pods: List[Pod] = []
+    for i in range(num_pods):
+        kind = rng.random()
+        if kind < 0.35:  # prod LS
+            prio, qos = 9500, "LS"
+        elif kind < 0.45:  # mid
+            prio, qos = 7500, "LS"
+        elif kind < 0.85:  # batch BE
+            prio, qos = 5500, "BE"
+        else:  # free BE
+            prio, qos = 3500, "BE"
+        cpu = rng.choice([0, 100, 250, 500, 1000, 2000, 4000])
+        mem = rng.choice([0, 128, 256, 512, 1024, 4096, 8192]) * MIB
+        limits = ResourceList()
+        if rng.random() < 0.2 and cpu:
+            limits = ResourceList.of(cpu=cpu * 2, memory=mem * 2 if mem else 0)
+        meta = ObjectMeta(
+            name=f"pod-{i}",
+            namespace="default",
+            labels={LABEL_POD_QOS: qos},
+            creation_timestamp=now - rng.uniform(0, 3600),
+        )
+        if rng.random() < 0.05:
+            meta.owner_kind = "DaemonSet"
+            meta.owner_name = "ds"
+        pods.append(
+            Pod(
+                meta=meta,
+                spec=PodSpec(
+                    priority=prio,
+                    requests=ResourceList.of(cpu=cpu, memory=mem),
+                    limits=limits,
+                ),
+            )
+        )
+
+    return SynthCluster(
+        nodes=nodes,
+        pods=pods,
+        node_metrics=node_metrics,
+        pods_by_key=pods_by_key,
+        now=now,
+    )

@@ -124,10 +124,14 @@ class DecayingHistogram:
 
     @staticmethod
     def from_checkpoint(options: HistogramOptions, data: dict) -> "DecayingHistogram":
-        h = DecayingHistogram(options, data.get("half_life", 86400.0))
         weights = data.get("weights", [])
-        if len(weights) == options.num_buckets:
-            h.weights = [float(w) for w in weights]
+        if len(weights) != options.num_buckets:
+            raise ValueError(
+                f"checkpoint has {len(weights)} buckets, options expect "
+                f"{options.num_buckets}; refusing to restore"
+            )
+        h = DecayingHistogram(options, data.get("half_life", 86400.0))
+        h.weights = [float(w) for w in weights]
         h.total_weight = float(data.get("total_weight", 0.0))
         h.reference_time = float(data.get("reference_time", 0.0))
         return h

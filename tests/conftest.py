@@ -2,25 +2,33 @@
 
 Multi-chip TPU hardware is not available in CI; sharding correctness is validated on
 8 virtual CPU devices (the driver separately dry-runs `__graft_entry__.dryrun_multichip`
-the same way). Must run before the first `import jax` anywhere in the test session.
+the same way).
+
+Note: the runtime environment pre-imports jax via sitecustomize with
+JAX_PLATFORMS=axon (the single-chip TPU tunnel), so the env var is already baked
+into jax.config by the time conftest runs. Backends initialize lazily, so flipping
+jax.config + XLA_FLAGS here (before the first jax.devices() call) still lands the
+whole test session on the virtual CPU mesh.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def cpu_devices():
-    import jax
-
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual cpu devices, got {len(devs)}"
     return devs

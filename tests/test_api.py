@@ -139,3 +139,77 @@ class TestObjects:
         res = Reservation(meta=ObjectMeta(creation_timestamp=100.0), ttl_seconds=50)
         assert not res.is_expired(now=120.0)
         assert res.is_expired(now=151.0)
+
+
+class TestQuantity:
+    def test_parse_quantity(self):
+        from koordinator_tpu.api.resources import parse_quantity
+
+        assert parse_quantity("10Gi") == 10 * 1024**3
+        assert parse_quantity("500m", cpu=True) == 500
+        assert parse_quantity("2", cpu=True) == 2000
+        assert parse_quantity("2k") == 2000
+        assert parse_quantity("1.5Gi") == int(1.5 * 1024**3)
+        assert parse_quantity(42) == 42
+
+    def test_shared_weight_fallback(self):
+        import json
+
+        from koordinator_tpu.api.objects import (
+            LABEL_QUOTA_SHARED_WEIGHT,
+            ElasticQuota,
+            ObjectMeta,
+        )
+        from koordinator_tpu.api.resources import ResourceList, ResourceName
+
+        q = ElasticQuota(
+            meta=ObjectMeta(name="q"), max=ResourceList.of(cpu=1000)
+        )
+        # absent annotation -> max
+        assert q.shared_weight[ResourceName.CPU] == 1000
+        # quantity strings parse
+        q.meta.annotations[LABEL_QUOTA_SHARED_WEIGHT] = json.dumps(
+            {"cpu": "2", "memory": "10Gi"}
+        )
+        assert q.shared_weight[ResourceName.CPU] == 2000
+        assert q.shared_weight[ResourceName.MEMORY] == 10 * 1024**3
+        # invalid -> max
+        q.meta.annotations[LABEL_QUOTA_SHARED_WEIGHT] = "not-json"
+        assert q.shared_weight[ResourceName.CPU] == 1000
+
+    def test_reservation_owner_conjunction(self):
+        from koordinator_tpu.api.objects import (
+            ObjectMeta,
+            Pod,
+            Reservation,
+            ReservationOwner,
+        )
+
+        res = Reservation(
+            owners=[
+                ReservationOwner(
+                    label_selector={"app": "web"}, controller_kind="StatefulSet"
+                )
+            ]
+        )
+        labeled = Pod(meta=ObjectMeta(labels={"app": "web"}, owner_kind="Deployment"))
+        assert not res.matches(labeled)  # selector AND controller must both match
+        both = Pod(meta=ObjectMeta(labels={"app": "web"}, owner_kind="StatefulSet"))
+        assert res.matches(both)
+        # empty owner matches everything
+        assert Reservation(owners=[ReservationOwner()]).matches(labeled)
+
+    def test_histogram_checkpoint_mismatch_rejected(self):
+        import pytest
+
+        from koordinator_tpu.utils.histogram import (
+            DecayingHistogram,
+            HistogramOptions,
+        )
+
+        h = DecayingHistogram(HistogramOptions.linear(10.0, 1.0))
+        h.add_sample(5.0, 2.0, 0.0)
+        with pytest.raises(ValueError):
+            DecayingHistogram.from_checkpoint(
+                HistogramOptions.linear(5.0, 1.0), h.to_checkpoint()
+            )

@@ -1,0 +1,69 @@
+"""Sharded execution on the 8-device virtual CPU mesh: bindings must be identical
+to single-device execution at any mesh size."""
+
+import numpy as np
+
+from koordinator_tpu.models.scheduler_model import (
+    build_schedule_step,
+    build_score_matrix,
+    make_inputs,
+)
+from koordinator_tpu.ops.loadaware import LoadAwareArgs, build_loadaware_node_state
+from koordinator_tpu.ops.packing import pack_nodes, pack_pods
+from koordinator_tpu.parallel import (
+    build_sharded_schedule_step,
+    build_sharded_score_matrix,
+    make_mesh,
+    shard_inputs_2d,
+    shard_inputs_nodewise,
+)
+from koordinator_tpu.testing import synth_cluster
+
+
+def _inputs(num_nodes=48, num_pods=64, seed=0):
+    cluster = synth_cluster(num_nodes=num_nodes, num_pods=num_pods, seed=seed)
+    args = LoadAwareArgs()
+    pods = pack_pods(cluster.pods, args.resource_weights, args.estimated_scaling_factors)
+    nodes = pack_nodes(cluster.nodes)
+    nodes.extras = build_loadaware_node_state(
+        cluster.nodes,
+        cluster.node_metrics,
+        cluster.pods_by_key,
+        cluster.assigned,
+        args,
+        cluster.now,
+        pad_to=nodes.padded_size,
+    )
+    return args, pods, make_inputs(pods, nodes, args)
+
+
+def test_mesh_shape(cpu_devices):
+    mesh = make_mesh(cpu_devices)
+    assert mesh.devices.size == 8
+    assert mesh.axis_names == ("pods", "nodes")
+    assert mesh.devices.shape == (2, 4)
+
+
+def test_sharded_serial_step_matches_single_device(cpu_devices):
+    args, pods, inputs = _inputs()
+    chosen_single = np.asarray(build_schedule_step(args)(inputs)[0])
+
+    mesh = make_mesh(cpu_devices)
+    sharded_inputs = shard_inputs_nodewise(inputs, mesh)
+    step = build_sharded_schedule_step(args, mesh)
+    chosen_sharded = np.asarray(step(sharded_inputs)[0])
+
+    np.testing.assert_array_equal(chosen_single, chosen_sharded)
+
+
+def test_sharded_score_matrix_matches(cpu_devices):
+    args, pods, inputs = _inputs(seed=3)
+    feasible_1, score_1 = build_score_matrix(args)(inputs)
+
+    mesh = make_mesh(cpu_devices)
+    sharded_inputs = shard_inputs_2d(inputs, mesh)
+    fn = build_sharded_score_matrix(args, mesh)
+    feasible_8, score_8 = fn(sharded_inputs)
+
+    np.testing.assert_array_equal(np.asarray(feasible_1), np.asarray(feasible_8))
+    np.testing.assert_array_equal(np.asarray(score_1), np.asarray(score_8))
