@@ -35,10 +35,21 @@ def main() -> None:
     ap.add_argument("--nodes", type=int, default=None)
     ap.add_argument("--serial-sample", type=int, default=200)
     ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument(
+        "--chain",
+        choices=["full", "loadaware"],
+        default="full",
+        help="full = Fit+LoadAware+NUMA+quota+gang (BASELINE config 4); "
+        "loadaware = config 1 kernel",
+    )
     args_cli = ap.parse_args()
 
     num_pods = args_cli.pods or (100 if args_cli.smoke else 10_000)
     num_nodes = args_cli.nodes or (50 if args_cli.smoke else 5_000)
+
+    if args_cli.chain == "full":
+        run_full_chain(args_cli, num_pods, num_nodes)
+        return
 
     import jax
 
@@ -120,6 +131,127 @@ def main() -> None:
             }
         )
     )
+
+
+def run_full_chain(args_cli, num_pods: int, num_nodes: int) -> None:
+    import jax
+
+    from koordinator_tpu.models.full_chain import build_full_chain_step
+    from koordinator_tpu.ops.loadaware import LoadAwareArgs
+    from koordinator_tpu.scheduler.parity import serial_schedule_full
+    from koordinator_tpu.scheduler.snapshot import build_full_chain_inputs
+    from koordinator_tpu.testing import synth_full_cluster
+
+    la = LoadAwareArgs()
+    log(f"devices: {jax.devices()}")
+    log(
+        f"config: {num_pods} pending pods x {num_nodes} nodes "
+        f"(full chain: Fit+LoadAware+NUMA+quota+gang)"
+    )
+    t0 = time.perf_counter()
+    cluster, state = synth_full_cluster(
+        num_nodes,
+        num_pods,
+        seed=42,
+        num_quotas=max(8, num_pods // 100),
+        num_gangs=max(4, num_pods // 50),
+    )
+    fc, pods, nodes, tree, gang_index, ng, ngroups = build_full_chain_inputs(
+        state, la
+    )
+    from koordinator_tpu.scheduler.snapshot import reduce_to_active_axes
+
+    fc, active_axes = reduce_to_active_axes(fc)
+    t_pack = time.perf_counter() - t0
+    log(
+        f"packing: {t_pack:.3f}s (padded {pods.padded_size} x {nodes.padded_size}, "
+        f"{len(tree.names)} quota groups, {ng} gangs, "
+        f"{len(active_axes)} active resource axes)"
+    )
+
+    step = build_full_chain_step(la, ng, ngroups, active_axes=active_axes)
+    t0 = time.perf_counter()
+    chosen, _, _ = step(fc)
+    chosen = np.asarray(jax.block_until_ready(chosen))
+    t_compile = time.perf_counter() - t0
+    log(f"first call (compile+run): {t_compile:.3f}s")
+
+    times = []
+    for _ in range(args_cli.iters):
+        t0 = time.perf_counter()
+        out = step(fc)
+        jax.block_until_ready(out[0])
+        times.append(time.perf_counter() - t0)
+    t_batch = min(times)
+    scheduled = int((chosen[: pods.num_valid] >= 0).sum())
+    tpu_pps = pods.num_valid / t_batch
+    log(
+        f"batched step: {t_batch:.4f}s for {pods.num_valid} pods "
+        f"({scheduled} scheduled) -> {tpu_pps:,.0f} pods/s; "
+        f"p99 schedule latency <= {t_batch*1000:.1f}ms"
+    )
+
+    if pods.padded_size <= 1024:
+        # small enough: run the whole serial oracle incl. permit barrier and
+        # diff the complete binding vector
+        t0 = time.perf_counter()
+        chosen_serial = serial_schedule_full(fc, la)
+        t_serial = time.perf_counter() - t0
+        serial_pps = pods.padded_size / t_serial
+        mism = int(
+            (chosen[: pods.num_valid] != chosen_serial[: pods.num_valid]).sum()
+        )
+        log(
+            f"serial floor: {t_serial:.3f}s for {pods.padded_size} pods "
+            f"-> {serial_pps:,.1f} pods/s; parity on full batch: "
+            f"{'OK' if mism == 0 else f'{mism} MISMATCHES'}"
+        )
+    else:
+        # floor timed on a pod prefix (per-pod cost is constant in N); full-batch
+        # parity is covered by tests/test_full_chain_parity.py
+        from koordinator_tpu.scheduler.parity import serial_schedule_full_core
+
+        sample = min(args_cli.serial_sample, pods.num_valid)
+        fc_slice = slice_full_chain(fc, sample)
+        t0 = time.perf_counter()
+        serial_schedule_full_core(fc_slice, la)
+        t_serial = time.perf_counter() - t0
+        serial_pps = sample / t_serial
+        log(
+            f"serial floor: {t_serial:.3f}s for {sample} pods "
+            f"-> {serial_pps:,.1f} pods/s (prefix sample)"
+        )
+
+    ratio = tpu_pps / serial_pps if serial_pps > 0 else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": f"pods_scheduled_per_sec_{num_pods}x{num_nodes}_full_chain",
+                "value": round(tpu_pps, 1),
+                "unit": "pods/s",
+                "vs_baseline": round(ratio, 2),
+            }
+        )
+    )
+
+
+def slice_full_chain(fc, num_pods: int):
+    """First-k-pods view of FullChainInputs."""
+    pod_fields = (
+        "requests",
+        "gang_id",
+        "quota_id",
+        "needs_numa",
+        "needs_bind",
+        "cores_needed",
+        "full_pcpus",
+    )
+    kwargs = {
+        k: (v[:num_pods] if k in pod_fields else v)
+        for k, v in fc._asdict().items()
+        if k != "base"
+    }
+    return type(fc)(base=ScheduleInputsSlice(fc.base, num_pods), **kwargs)
 
 
 def ScheduleInputsSlice(inputs, num_pods: int):

@@ -1,0 +1,198 @@
+"""Full plugin-chain scheduling step (BASELINE config 4).
+
+Fuses the reference's whole hot loop (SURVEY.md section 3.1) into one compiled
+program per batch:
+
+  PreFilter   gang validity (host precompute) + quota admission (in-loop, order
+              dependent) + NUMA/cpuset prechecks
+  Filter      NodeResourcesFit + LoadAware thresholds + NodeNUMAResource admit
+              (cpuset capacity, SMT alignment, NUMA topology policy)
+  Score       LoadAware least-allocated + NodeNUMAResource least-allocated,
+              equal plugin weights, summed (frameworkext RunScorePlugins
+              normalize+weighted-sum)
+  Reserve     on-device state updates: Fit requested, LoadAware assign-cache
+              deltas, NUMA zone free, bindable-cpu free, quota used
+  Permit      gang barrier as a segment-reduction post-pass (ops/gang.py)
+
+Reservation consumption and concrete device/cpuset assignment remain host-side in
+the cycle driver (scheduler/cycle.py): they run once per actual binding, not per
+pod x node. The serial parity emulator (scheduler/parity.py serial_schedule_full)
+implements the identical chain scalar-wise; bindings must match exactly.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.api.resources import NUM_RESOURCES
+from koordinator_tpu.models.scheduler_model import ScheduleInputs, _score_row
+from koordinator_tpu.ops import loadaware as la_ops
+from koordinator_tpu.ops.fit import fit_ok_row
+from koordinator_tpu.ops.gang import gang_permit_mask
+from koordinator_tpu.ops.loadaware import LoadAwareArgs
+from koordinator_tpu.ops.numa import (
+    cpuset_filter_row,
+    numa_admit_row,
+    numa_score_row,
+    numa_spread_fill,
+)
+from koordinator_tpu.ops.quota import quota_admit_row, quota_used_add_row
+
+
+class FullChainInputs(NamedTuple):
+    base: ScheduleInputs
+    # pods
+    requests: jnp.ndarray       # [P, R] raw requests (quota/NUMA accounting)
+    gang_id: jnp.ndarray        # [P] int32
+    quota_id: jnp.ndarray       # [P] int32
+    needs_numa: jnp.ndarray     # [P] bool — subject to NUMA admission
+    needs_bind: jnp.ndarray     # [P] bool — requires cpuset binding
+    cores_needed: jnp.ndarray   # [P] float — whole cpus for cpuset pods
+    full_pcpus: jnp.ndarray     # [P] bool — resolved FullPCPUs policy
+    # nodes
+    numa_free: jnp.ndarray      # [N, K, R]
+    numa_capacity: jnp.ndarray  # [N, K, R]
+    numa_policy: jnp.ndarray    # [N] int32
+    has_topology: jnp.ndarray   # [N] bool
+    bind_free: jnp.ndarray      # [N] float
+    cpus_per_core: jnp.ndarray  # [N] float
+    # quota tree
+    quota_ancestors: jnp.ndarray  # [G, D]
+    quota_used: jnp.ndarray       # [G, R]
+    quota_runtime: jnp.ndarray    # [G, R]
+    # gangs
+    gang_min_member: jnp.ndarray  # [NG]
+    gang_assumed: jnp.ndarray     # [NG]
+    gang_valid: jnp.ndarray       # [NG] bool (PreFilter validity)
+    gang_group_id: jnp.ndarray    # [NG] int32
+
+
+def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
+                          jit: bool = True, active_axes=None):
+    """FullChainInputs -> (chosen[P], requested[N, R], quota_used[G, R]).
+
+    num_gangs/num_groups are static (gang arrays are padded to them).
+    active_axes: when the inputs were sliced to the active resource axes
+    (snapshot.reduce_to_active_axes), the original axis ids, so weight indices
+    map correctly.
+    """
+    full_weights = args.weight_vector()
+    if active_axes is not None:
+        full_weights = full_weights[list(active_axes)]
+    weight_idx = tuple(int(i) for i in np.nonzero(full_weights)[0])
+    prod_mode = args.score_according_prod_usage
+
+    def step(fc: FullChainInputs):
+        inputs = fc.base
+        P = inputs.fit_requests.shape[0]
+        N = inputs.allocatable.shape[0]
+        reject_np, reject_prod = la_ops.loadaware_node_reject(
+            inputs.allocatable,
+            inputs.la_filter_usage,
+            inputs.la_has_filter_usage,
+            inputs.la_filter_thresholds,
+            inputs.la_prod_thresholds,
+            inputs.la_prod_pod_usage,
+            inputs.la_filter_skip,
+        )
+        gang_pod_ok = jnp.where(
+            fc.gang_id >= 0, fc.gang_valid[jnp.maximum(fc.gang_id, 0)], True
+        )
+
+        def body(i, state):
+            (requested, delta_np, delta_pr, numa_free, bind_free,
+             quota_used, chosen) = state
+            req_fit = inputs.fit_requests[i]
+            req = fc.requests[i]
+            est = inputs.estimated[i]
+            is_prod_i = inputs.is_prod[i]
+
+            # ---- PreFilter: gang validity + quota admission (order-dependent)
+            admit = gang_pod_ok[i] & quota_admit_row(
+                req, fc.quota_id[i], fc.quota_ancestors, quota_used, fc.quota_runtime
+            )
+
+            # ---- Filter chain
+            fit = fit_ok_row(req_fit, inputs.allocatable, requested)
+            la_reject = jnp.where(is_prod_i, reject_prod, reject_np)
+            la_ok = inputs.is_daemonset[i] | ~la_reject
+            cpuset_ok = cpuset_filter_row(
+                fc.needs_bind[i], fc.cores_needed[i], fc.full_pcpus[i],
+                fc.has_topology, bind_free, fc.cpus_per_core,
+            )
+            numa_ok, zone = numa_admit_row(
+                req, fc.needs_numa[i], numa_free, fc.numa_policy
+            )
+            feasible = (
+                inputs.node_ok & fit & la_ok & cpuset_ok & numa_ok & admit
+            )
+
+            # ---- Score chain (equal plugin weights, each already 0..100)
+            la_score = _score_row(
+                est, is_prod_i, inputs, delta_np, delta_pr, weight_idx, prod_mode
+            )
+            numa_score = numa_score_row(
+                req, requested, inputs.allocatable, inputs.weights, weight_idx,
+            )
+            score = la_score + numa_score
+            score = jnp.where(feasible, score, -1.0)
+
+            # ---- select + Reserve (row-wise state writes: O(K*R) per pod, not
+            # O(N*K*R) — the loop's memory traffic budget)
+            best = jnp.argmax(score)
+            found = (score[best] >= 0.0) & inputs.pod_valid[i]
+            fnd = found.astype(jnp.float32)
+
+            def upd_row(mat, add_row):
+                new_row = mat[best] + fnd * add_row
+                return jax.lax.dynamic_update_slice(mat, new_row[None], (best, 0))
+
+            requested = upd_row(requested, req_fit)
+            delta_np = upd_row(delta_np, est)
+            if prod_mode:
+                delta_pr = upd_row(
+                    delta_pr, jnp.where(is_prod_i, 1.0, 0.0) * est
+                )
+            new_zone_free = numa_spread_fill(numa_free[best], req, zone[best])
+            apply_numa = (found & fc.needs_numa[i]).astype(jnp.float32)
+            mixed = apply_numa * new_zone_free + (1.0 - apply_numa) * numa_free[best]
+            numa_free = jax.lax.dynamic_update_slice(
+                numa_free, mixed[None], (best, 0, 0)
+            )
+            bind_free = bind_free.at[best].add(
+                -fnd * jnp.where(fc.needs_bind[i], fc.cores_needed[i], 0.0)
+            )
+            quota_used = quota_used_add_row(
+                quota_used, req, fc.quota_id[i], fc.quota_ancestors, found
+            )
+            chosen = chosen.at[i].set(jnp.where(found, best.astype(jnp.int32), -1))
+            return (requested, delta_np, delta_pr, numa_free, bind_free,
+                    quota_used, chosen)
+
+        R = inputs.fit_requests.shape[-1]
+        init = (
+            inputs.requested,
+            jnp.zeros((N, R), jnp.float32),
+            jnp.zeros((N, R), jnp.float32),
+            fc.numa_free,
+            fc.bind_free,
+            fc.quota_used,
+            jnp.full(P, -1, jnp.int32),
+        )
+        (requested, _, _, _, _, quota_used, chosen) = jax.lax.fori_loop(
+            0, P, body, init
+        )
+
+        # ---- Permit barrier (gang group all-or-nothing)
+        keep = gang_permit_mask(
+            chosen, fc.gang_id, fc.gang_min_member, fc.gang_assumed,
+            fc.gang_group_id, num_gangs, num_groups,
+        )
+        chosen = jnp.where(keep, chosen, -1)
+        return chosen, requested, quota_used
+
+    return jax.jit(step) if jit else step

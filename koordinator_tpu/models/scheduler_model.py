@@ -33,7 +33,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from koordinator_tpu.api.resources import NUM_RESOURCES
 from koordinator_tpu.ops import loadaware as la_ops
 from koordinator_tpu.ops.common import least_requested_score
 from koordinator_tpu.ops.fit import fit_ok_matrix, fit_ok_row, with_pod_count
@@ -168,10 +167,11 @@ def build_schedule_step(args: LoadAwareArgs, jit: bool = True):
             chosen = chosen.at[i].set(jnp.where(found, best.astype(jnp.int32), -1))
             return requested, delta_np, delta_pr, chosen
 
+        R = inputs.fit_requests.shape[-1]
         init = (
             inputs.requested,
-            jnp.zeros((N, NUM_RESOURCES), jnp.float32),
-            jnp.zeros((N, NUM_RESOURCES), jnp.float32),
+            jnp.zeros((N, R), jnp.float32),
+            jnp.zeros((N, R), jnp.float32),
             jnp.full(P, -1, jnp.int32),
         )
         requested, _, _, chosen = jax.lax.fori_loop(0, P, body, init)

@@ -1,0 +1,62 @@
+"""Coscheduling (gang) feasibility as segment reductions.
+
+Reference: `pkg/scheduler/plugins/coscheduling/` —
+  * PreFilter (coscheduling.go:168 + core/core.go): reject a gang member when the
+    gang is invalid (total member count below minMember) or its schedule cycle is
+    exhausted.
+  * Permit (core/core.go:311-338): assigned members wait until every gang in the
+    gang-group reaches minMember; on timeout the whole group is rejected and
+    unreserved.
+
+Batched formulation: gang validity is a host-precomputed [NG] bool (it depends
+only on cache state, gang_cache.go:34). The Permit barrier becomes a POST-pass
+after the serial-parity selection loop: count tentative assignments per gang
+(segment-sum over the pod axis), check count + already-assumed >= minMember,
+AND across each gang-group, then strike the members of failed groups from the
+binding vector. Within the batch, members of a still-waiting gang legitimately
+hold their reserved resources (exactly like WaitingPods in the reference), so
+capacity effects of struck pods are intentionally NOT rolled back on device —
+the host applies only surviving bindings and rebuilds state next cycle.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def gang_permit_mask(
+    chosen: jnp.ndarray,        # [P] int32 node index or -1
+    gang_id: jnp.ndarray,       # [P] int32, -1 = not in a gang
+    gang_min_member: jnp.ndarray,   # [NG]
+    gang_assumed: jnp.ndarray,  # [NG] members already assumed/bound before batch
+    gang_group_id: jnp.ndarray,  # [NG] int32 gang-group (== gang idx if alone)
+    num_gangs: int,
+    num_groups: int,
+) -> jnp.ndarray:
+    """[P] bool: keep binding after the Permit barrier."""
+    import jax
+
+    in_gang = gang_id >= 0
+    gid = jnp.maximum(gang_id, 0)
+    assigned = (chosen >= 0) & in_gang
+    per_gang = jax.ops.segment_sum(
+        assigned.astype(jnp.float32), gid, num_segments=num_gangs
+    )
+    gang_ok = per_gang + gang_assumed >= gang_min_member
+    # all gangs in a gang-group must pass (core.go:311-338)
+    group_fail = jax.ops.segment_sum(
+        (~gang_ok).astype(jnp.float32), gang_group_id, num_segments=num_groups
+    )
+    group_ok = group_fail[gang_group_id] == 0  # [NG]
+    keep_gang = gang_ok & group_ok
+    return jnp.where(in_gang, keep_gang[gid], True)
+
+
+def gang_prefilter_valid(
+    gang_total_members: np.ndarray,  # [NG] pods known to the gang (cache)
+    gang_min_member: np.ndarray,     # [NG]
+) -> np.ndarray:
+    """[NG] bool host precompute: gang invalid when fewer known members than
+    minMember (core/gang.go state machine)."""
+    return gang_total_members >= gang_min_member

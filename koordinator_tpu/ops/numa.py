@@ -1,0 +1,144 @@
+"""NodeNUMAResource: NUMA-aware fit + topology-policy admit + scoring, batched.
+
+Reference: `pkg/scheduler/plugins/nodenumaresource/` —
+  * Filter (plugin.go:275-338): cpuset-capable pods need a valid CPUTopology,
+    SMT-aligned requests (FullPCPUs), enough bindable cpus; NUMA topology policy
+    admit via the topology manager (frameworkext/topologymanager/manager.go:58).
+  * Hint generation (resource_manager.go:418-532): which NUMA-node sets fit the
+    request; the merged affinity prefers the narrowest fitting mask.
+  * Scoring (scoring.go, least_allocated.go): least/most-allocated over the
+    node-level (and NUMA-level) requested vs allocatable.
+
+Batched formulation: with K NUMA zones per node (padded to MAX_NUMA), the fit
+check per policy reduces to
+  single-numa-node : exists k with req <= free[k] (choose lowest such k)
+  restricted       : total fit (a minimal fitting mask always exists then; the
+                     concrete mask is chosen host-side at Reserve)
+  best-effort/none : total fit
+so no 2^K mask enumeration is needed on device — masks only materialize host-side
+when the accumulator allocates concrete cpus (scheduler/cpu_topology.py).
+
+In-batch state for the serial-parity loop: numa_free[N, K, R] (zone free),
+bind_free[N] (bindable cpu count). Assignment updates subtract from the chosen
+zone (single-numa) or lowest-zones-first (spread fill; the reference splits per
+its allocator's choice — same deterministic rule in kernel and parity emulator).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+MAX_NUMA = 8
+
+POLICY_NONE = 0
+POLICY_SINGLE_NUMA_NODE = 1
+POLICY_RESTRICTED = 2
+POLICY_BEST_EFFORT = 3
+
+POLICY_BY_NAME = {
+    "": POLICY_NONE,
+    "None": POLICY_NONE,
+    "none": POLICY_NONE,
+    "SingleNUMANode": POLICY_SINGLE_NUMA_NODE,
+    "single-numa-node": POLICY_SINGLE_NUMA_NODE,
+    "Restricted": POLICY_RESTRICTED,
+    "restricted": POLICY_RESTRICTED,
+    "BestEffort": POLICY_BEST_EFFORT,
+    "best-effort": POLICY_BEST_EFFORT,
+}
+
+
+def numa_admit_row(
+    request: jnp.ndarray,      # [R] pod request (packed units)
+    needs_numa: jnp.ndarray,   # scalar bool: pod subject to NUMA admission
+    numa_free: jnp.ndarray,    # [N, K, R]
+    policy: jnp.ndarray,       # [N] int32
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(ok[N], zone[N]): admit + chosen zone (-1 when not single-numa).
+
+    Zero-request axes never constrain (k8s semantics).
+    """
+    req = request[None, None, :]
+    fits_zone = jnp.all((req <= 0) | (req <= numa_free), axis=-1)      # [N, K]
+    total_free = jnp.sum(numa_free, axis=1)                            # [N, R]
+    fits_total = jnp.all((request[None, :] <= 0) | (request[None, :] <= total_free), axis=-1)
+    any_zone = jnp.any(fits_zone, axis=-1)
+    first_zone = jnp.argmax(fits_zone, axis=-1).astype(jnp.int32)      # lowest k
+    single = policy == POLICY_SINGLE_NUMA_NODE
+    ok = jnp.where(single, any_zone, fits_total)
+    ok = jnp.where(policy == POLICY_NONE, True, ok)
+    ok = jnp.where(needs_numa, ok, True)
+    zone = jnp.where(single & any_zone & needs_numa, first_zone, -1)
+    return ok, zone
+
+
+def cpuset_filter_row(
+    needs_bind: jnp.ndarray,    # scalar bool: pod requires cpuset binding
+    cores_needed: jnp.ndarray,  # scalar float: whole cpus requested
+    full_pcpus: jnp.ndarray,    # scalar bool: FullPCPUs policy resolved for pod
+    has_topology: jnp.ndarray,  # [N]
+    bind_free: jnp.ndarray,     # [N] bindable cpus available
+    cpus_per_core: jnp.ndarray,  # [N]
+) -> jnp.ndarray:
+    """[N]: cpuset feasibility (plugin.go:303-338 — ErrInvalidCPUTopology,
+    ErrSMTAlignmentError, capacity)."""
+    smt_ok = ~full_pcpus | (
+        jnp.abs(jnp.remainder(cores_needed, jnp.maximum(cpus_per_core, 1.0))) < 0.5
+    )
+    ok = has_topology & smt_ok & (cores_needed <= bind_free)
+    return jnp.where(needs_bind, ok, True)
+
+
+def numa_spread_fill(
+    numa_free_n: jnp.ndarray,  # [K, R] free of the chosen node
+    request: jnp.ndarray,      # [R]
+    zone: jnp.ndarray,         # scalar int32 (-1 = spread fill)
+) -> jnp.ndarray:
+    """New [K, R] after subtracting the request: all from `zone` when single-numa,
+    else lowest-zones-first waterfall."""
+    K = numa_free_n.shape[0]
+
+    def single_case():
+        onehot = (jnp.arange(K) == zone).astype(numa_free_n.dtype)
+        return numa_free_n - onehot[:, None] * request[None, :]
+
+    def spread_case():
+        # waterfall: zone k absorbs min(free_k, remaining)
+        def body(carry, free_k):
+            remaining = carry
+            take = jnp.minimum(free_k, remaining)
+            return remaining - take, free_k - take
+
+        import jax
+
+        _, new_free = jax.lax.scan(body, request, numa_free_n)
+        return new_free
+
+    import jax
+
+    return jax.lax.cond(zone >= 0, single_case, spread_case)
+
+
+def numa_score_row(
+    request: jnp.ndarray,       # [R]
+    node_requested: jnp.ndarray,  # [N, R]
+    allocatable: jnp.ndarray,   # [N, R]
+    weights: jnp.ndarray,       # [R]
+    weight_idx: Tuple[int, ...],
+    most_allocated: bool = False,
+) -> jnp.ndarray:
+    """[N] NodeNUMAResource score: least-allocated (default) or most-allocated
+    over requested+request vs allocatable (scoring.go with the v1beta2 default
+    strategy cpu=1, memory=1)."""
+    from koordinator_tpu.ops.common import least_requested_score, most_requested_score
+
+    scorer = most_requested_score if most_allocated else least_requested_score
+    acc = jnp.zeros(allocatable.shape[0], jnp.float32)
+    wsum = jnp.sum(weights)
+    for r in weight_idx:
+        used = node_requested[:, r] + request[r]
+        acc = acc + weights[r] * scorer(used, allocatable[:, r])
+    return jnp.floor(acc / jnp.maximum(wsum, 1.0))

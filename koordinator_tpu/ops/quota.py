@@ -1,0 +1,283 @@
+"""ElasticQuota: hierarchical runtime-quota redistribution + batched admission.
+
+Reference: `pkg/scheduler/plugins/elasticquota/core/` —
+  * runtime_quota_calculator.go:111-168 `redistribution`: per (parent, resource),
+    children whose request exceeds effective-min (max(min, guarantee)) start at
+    min and share the leftover by sharedWeight in iterated rounds
+    (delta = floor(w * leftover / totalW + 0.5), capped at request, excess
+    recycled) — a fixed-point water-filling.
+  * plugin.go:210-256 + plugin_helper.go:281 `checkQuotaRecursive`: admission
+    walks the ancestor chain; every ancestor must satisfy
+    used + podRequest <= runtimeQuota on every resource.
+
+Batched formulation: all sibling groups across ALL parents are processed in one
+[G] vector per round with segment-sums by parent id (one water-filling round is a
+segment-reduce + elementwise update; the loop runs until no group changes, bounded
+by G rounds). Levels are computed top-down so a child's total is its parent's
+runtime. Admission uses a fixed-depth ancestor table ancestors[G, D] so the
+per-pod check in the serial loop is a gather + compare, and in-batch `used` deltas
+are scatter-adds along the chain.
+
+Order-dependent admission (SURVEY.md section 7 hard parts) is preserved by the
+serial-parity loop: pods are admitted in queue order against mutating `used`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.api.resources import NUM_RESOURCES
+from koordinator_tpu.ops.common import go_round
+
+MAX_QUOTA_DEPTH = 4  # root -> ... -> leaf (reference trees are shallow)
+
+
+@dataclass
+class QuotaTreeArrays:
+    """Packed quota tree (host-built, device-consumed)."""
+
+    names: List[str]
+    parent: np.ndarray        # [G] int32, -1 for roots
+    ancestors: np.ndarray     # [G, D] int32 self-then-ancestors, -1 padded
+    min: np.ndarray           # [G, R]
+    max: np.ndarray           # [G, R]
+    shared_weight: np.ndarray  # [G, R]
+    request: np.ndarray       # [G, R] sum of member pod requests
+    used: np.ndarray          # [G, R] sum of scheduled member pod requests
+    guarantee: np.ndarray     # [G, R]
+    allow_lent: np.ndarray    # [G] bool
+    level: np.ndarray         # [G] int32 depth (root=0)
+    index: Dict[str, int] = field(default_factory=dict)
+
+
+def water_fill_level(
+    total: jnp.ndarray,        # [G, R] available to each group's children
+    parent: jnp.ndarray,       # [G] int32 (-1 roots)
+    min_: jnp.ndarray,         # [G, R]
+    guarantee: jnp.ndarray,    # [G, R]
+    request: jnp.ndarray,      # [G, R]
+    shared_weight: jnp.ndarray,  # [G, R]
+    allow_lent: jnp.ndarray,   # [G]
+    level: jnp.ndarray,        # [G]
+    cur_level: int,
+    num_groups: int,
+) -> jnp.ndarray:
+    """One level of redistribution: returns runtime[G, R] for groups at cur_level
+    (other rows zero). `total[g]` must hold the parent's runtime (or cluster total
+    for roots)."""
+    G = parent.shape[0]
+    active = (level == cur_level)[:, None]  # [G, 1]
+    eff_min = jnp.maximum(min_, guarantee)
+    over = request > eff_min
+    base = jnp.where(
+        over, eff_min, jnp.where(allow_lent[:, None], request, eff_min)
+    )
+    base = jnp.where(active, base, 0.0)
+
+    # roots share the cluster total: they get a common virtual segment id G
+    seg = jnp.where(parent >= 0, parent, G)
+    adjustable0 = over & active & (shared_weight > 0)
+
+    def seg_sum(x):
+        return jax.ops.segment_sum(x, seg, num_segments=G + 1)
+
+    spent = seg_sum(base)                       # [G+1, R]
+    # per-parent leftover; total is constant within a segment (parent's runtime)
+    leftover_seg0 = jnp.maximum(
+        jax.ops.segment_max(jnp.where(active, total, -jnp.inf), seg, num_segments=G + 1)
+        - spent,
+        0.0,
+    )
+    leftover_seg0 = jnp.where(jnp.isfinite(leftover_seg0), leftover_seg0, 0.0)
+
+    def cond(state):
+        runtime, leftover_seg, adjustable, changed, it = state
+        return changed & (it < num_groups + 2)
+
+    def body(state):
+        runtime, leftover_seg, adjustable, _, it = state
+        w = jnp.where(adjustable, shared_weight, 0.0)
+        wsum_seg = seg_sum(w)                   # [G+1, R]
+        wsum = wsum_seg[seg]
+        delta = jnp.where(
+            (wsum > 0) & adjustable,
+            go_round(shared_weight * leftover_seg[seg] / jnp.maximum(wsum, 1e-9)),
+            0.0,
+        )
+        new_rt = runtime + delta
+        overshoot = jnp.maximum(new_rt - request, 0.0)
+        new_rt = jnp.minimum(new_rt, request)
+        still = adjustable & (new_rt < request) & (delta > 0)
+        # recycle overshoot within each segment for the next round
+        new_leftover_seg = seg_sum(jnp.where(adjustable, overshoot, 0.0))
+        changed = jnp.any(delta > 0) & jnp.any(still) & jnp.any(new_leftover_seg > 0)
+        return new_rt, new_leftover_seg, still, changed, it + 1
+
+    init = (base, leftover_seg0, adjustable0, jnp.any(adjustable0), 0)
+    runtime, _, _, _, _ = jax.lax.while_loop(cond, body, init)
+    return jnp.where(active, runtime, 0.0)
+
+
+def compute_runtime_quotas(tree: QuotaTreeArrays, cluster_total: np.ndarray) -> np.ndarray:
+    """Top-down runtime quota for the whole tree: [G, R] float32.
+
+    Level 0 children share cluster_total; level d children share their parent's
+    runtime. Executed as D jitted level passes (D static, tiny).
+    """
+    G = len(tree.names)
+    if G == 0:
+        return np.zeros((0, NUM_RESOURCES), np.float32)
+    parent = jnp.asarray(tree.parent)
+    runtime = jnp.zeros((G, NUM_RESOURCES), jnp.float32)
+    max_level = int(tree.level.max()) if G else 0
+    for lvl in range(max_level + 1):
+        total = jnp.where(
+            (parent >= 0)[:, None],
+            runtime[jnp.clip(parent, 0, G - 1)],
+            jnp.asarray(cluster_total, jnp.float32)[None, :],
+        )
+        rt_lvl = water_fill_level(
+            total,
+            parent,
+            jnp.asarray(tree.min),
+            jnp.asarray(tree.guarantee),
+            jnp.asarray(tree.request),
+            jnp.asarray(tree.shared_weight),
+            jnp.asarray(tree.allow_lent),
+            jnp.asarray(tree.level),
+            lvl,
+            G,
+        )
+        runtime = jnp.where((jnp.asarray(tree.level) == lvl)[:, None], rt_lvl, runtime)
+    # cap by max (runtime never exceeds max; reference setClusterTotalResource /
+    # quotaInfo semantics)
+    runtime = jnp.minimum(runtime, jnp.asarray(tree.max))
+    return np.asarray(runtime)
+
+
+def quota_admit_row(
+    request: jnp.ndarray,     # [R]
+    quota_id: jnp.ndarray,    # scalar int32 (-1 = no quota -> admitted)
+    ancestors: jnp.ndarray,   # [G, D]
+    used: jnp.ndarray,        # [G, R]
+    runtime: jnp.ndarray,     # [G, R]
+) -> jnp.ndarray:
+    """scalar bool: checkQuotaRecursive along the ancestor chain."""
+    D = ancestors.shape[1]
+    gid = jnp.maximum(quota_id, 0)
+    chain = ancestors[gid]  # [D]
+    ok = jnp.bool_(True)
+    for d in range(D):
+        g = chain[d]
+        valid = g >= 0
+        gg = jnp.maximum(g, 0)
+        fit = jnp.all((request <= 0) | (used[gg] + request <= runtime[gg]))
+        ok = ok & (~valid | fit)
+    return jnp.where(quota_id >= 0, ok, True)
+
+
+def quota_used_add_row(
+    used: jnp.ndarray,        # [G, R]
+    request: jnp.ndarray,     # [R]
+    quota_id: jnp.ndarray,    # scalar int32
+    ancestors: jnp.ndarray,   # [G, D]
+    apply: jnp.ndarray,       # scalar bool
+) -> jnp.ndarray:
+    """Scatter-add the request along the ancestor chain when apply is set."""
+    G, D = ancestors.shape
+    gid = jnp.maximum(quota_id, 0)
+    chain = ancestors[gid]
+    onehot = jnp.zeros(G, jnp.float32)
+    for d in range(D):
+        g = chain[d]
+        onehot = onehot + jnp.where(
+            (g >= 0) & (quota_id >= 0) & apply,
+            (jnp.arange(G) == jnp.maximum(g, 0)).astype(jnp.float32),
+            0.0,
+        )
+    return used + onehot[:, None] * request[None, :]
+
+
+# ---------------------------------------------------------------------------
+# Host-side tree construction (GroupQuotaManager analog, group_quota_manager.go)
+# ---------------------------------------------------------------------------
+
+
+def build_quota_tree(
+    quotas,  # Sequence[ElasticQuota]
+    pod_requests_by_quota: Optional[Dict[str, np.ndarray]] = None,
+    used_by_quota: Optional[Dict[str, np.ndarray]] = None,
+) -> QuotaTreeArrays:
+    """Pack ElasticQuota CRs into QuotaTreeArrays (topology rebuild,
+    group_quota_manager.go:425-533). Parents referenced by label; missing parents
+    become roots. Request/used aggregate child -> parent recursively
+    (:184-256)."""
+    names = [q.meta.name for q in quotas]
+    index = {n: i for i, n in enumerate(names)}
+    G = len(names)
+    parent = np.full(G, -1, np.int32)
+    for i, q in enumerate(quotas):
+        p = q.parent
+        if p and p in index:
+            parent[i] = index[p]
+    # levels
+    level = np.zeros(G, np.int32)
+    for i in range(G):
+        g, d = i, 0
+        while parent[g] >= 0 and d < MAX_QUOTA_DEPTH:
+            g = parent[g]
+            d += 1
+        level[i] = d
+    ancestors = np.full((G, MAX_QUOTA_DEPTH), -1, np.int32)
+    for i in range(G):
+        g, d = i, 0
+        while g >= 0 and d < MAX_QUOTA_DEPTH:
+            ancestors[i, d] = g
+            g = parent[g]
+            d += 1
+    min_ = np.zeros((G, NUM_RESOURCES), np.float32)
+    max_ = np.zeros((G, NUM_RESOURCES), np.float32)
+    weight = np.zeros((G, NUM_RESOURCES), np.float32)
+    request = np.zeros((G, NUM_RESOURCES), np.float32)
+    used = np.zeros((G, NUM_RESOURCES), np.float32)
+    for i, q in enumerate(quotas):
+        min_[i] = q.min.to_vector()
+        max_[i] = q.max.to_vector()
+        weight[i] = q.shared_weight.to_vector()
+        if pod_requests_by_quota:
+            vec = pod_requests_by_quota.get(q.meta.name)
+            if vec is not None:
+                request[i] = vec
+        if used_by_quota:
+            vec = used_by_quota.get(q.meta.name)
+            if vec is not None:
+                used[i] = vec
+    # aggregate request/used up the chain (deltas :184-256). A group's request
+    # contribution to its parent is capped at its own max — limitRequest
+    # semantics (quota_info.go:196-201, group_quota_manager.go:187) — otherwise
+    # an over-max group would soak up leftover its siblings should receive.
+    order = np.argsort(-level)
+    for i in order:
+        request[i] = np.minimum(request[i], max_[i])
+        if parent[i] >= 0:
+            request[parent[i]] += request[i]
+            used[parent[i]] += used[i]
+    return QuotaTreeArrays(
+        names=names,
+        parent=parent,
+        ancestors=ancestors,
+        min=min_,
+        max=max_,
+        shared_weight=weight,
+        request=request,
+        used=used,
+        guarantee=np.zeros((G, NUM_RESOURCES), np.float32),
+        allow_lent=np.ones(G, bool),
+        level=level,
+        index=index,
+    )

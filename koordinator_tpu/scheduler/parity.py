@@ -146,6 +146,209 @@ def serial_schedule(inputs: ScheduleInputs, args: LoadAwareArgs) -> np.ndarray:
     return chosen
 
 
+def serial_schedule_full(fc, args: LoadAwareArgs) -> np.ndarray:
+    """Scalar full-chain oracle: Fit + LoadAware + NUMA/cpuset + quota admission
+    in queue order, then the gang Permit barrier. Mirrors
+    models/full_chain.build_full_chain_step exactly (same float32 arithmetic)."""
+    chosen = serial_schedule_full_core(fc, args)
+    # ---- gang permit barrier
+    gang_id = np.asarray(fc.gang_id)
+    gang_min = np.asarray(fc.gang_min_member)
+    gang_assumed = np.asarray(fc.gang_assumed)
+    gang_group = np.asarray(fc.gang_group_id)
+    ng = gang_min.shape[0]
+    per_gang = np.zeros(ng)
+    for p in range(len(chosen)):
+        if gang_id[p] >= 0 and chosen[p] >= 0:
+            per_gang[gang_id[p]] += 1
+    gang_ok = per_gang + gang_assumed >= gang_min
+    group_fail = np.zeros(int(gang_group.max()) + 1 if ng else 1)
+    for g in range(ng):
+        if not gang_ok[g]:
+            group_fail[gang_group[g]] += 1
+    for p in range(len(chosen)):
+        g = gang_id[p]
+        if g >= 0 and (not gang_ok[g] or group_fail[gang_group[g]] > 0):
+            chosen[p] = -1
+    return chosen
+
+
+def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
+    inputs = fc.base
+    fit_requests = np.asarray(inputs.fit_requests, np.float32)
+    requests = np.asarray(fc.requests, np.float32)
+    estimated = np.asarray(inputs.estimated, np.float32)
+    is_prod = np.asarray(inputs.is_prod)
+    is_daemonset = np.asarray(inputs.is_daemonset)
+    pod_valid = np.asarray(inputs.pod_valid)
+    allocatable = np.asarray(inputs.allocatable, np.float32)
+    requested = np.array(inputs.requested, np.float32)
+    node_ok = np.asarray(inputs.node_ok)
+    filter_usage = np.asarray(inputs.la_filter_usage, np.float32)
+    has_filter_usage = np.asarray(inputs.la_has_filter_usage)
+    filter_thr = np.asarray(inputs.la_filter_thresholds, np.float32)
+    prod_thr = np.asarray(inputs.la_prod_thresholds, np.float32)
+    prod_usage = np.asarray(inputs.la_prod_pod_usage, np.float32)
+    term_np = np.array(inputs.la_term_nonprod, np.float32)
+    term_pr = np.array(inputs.la_term_prod, np.float32)
+    score_valid = np.asarray(inputs.la_score_valid)
+    filter_skip = np.asarray(inputs.la_filter_skip)
+    weights = np.asarray(inputs.weights, np.float32)
+    gang_id = np.asarray(fc.gang_id)
+    quota_id = np.asarray(fc.quota_id)
+    needs_numa = np.asarray(fc.needs_numa)
+    needs_bind = np.asarray(fc.needs_bind)
+    cores_needed = np.asarray(fc.cores_needed, np.float32)
+    full_pcpus = np.asarray(fc.full_pcpus)
+    numa_free = np.array(fc.numa_free, np.float32)
+    numa_policy = np.asarray(fc.numa_policy)
+    has_topology = np.asarray(fc.has_topology)
+    bind_free = np.array(fc.bind_free, np.float32)
+    cpus_per_core = np.asarray(fc.cpus_per_core, np.float32)
+    ancestors = np.asarray(fc.quota_ancestors)
+    quota_used = np.array(fc.quota_used, np.float32)
+    quota_runtime = np.asarray(fc.quota_runtime, np.float32)
+    gang_valid = np.asarray(fc.gang_valid)
+
+    P, R = fit_requests.shape
+    N, K, _ = numa_free.shape
+    weight_idx = [int(r) for r in np.nonzero(weights)[0]]
+    wsum = np.float32(weights.sum())
+    prod_mode = args.score_according_prod_usage
+    chosen = np.full(P, -1, np.int32)
+    POLICY_SINGLE = 1
+
+    def la_filter_ok(p, n):
+        if is_daemonset[p]:
+            return True
+        if filter_skip[n]:
+            return True
+        prod_configured = bool((prod_thr[n] > 0).any())
+        usage, thr = (
+            (prod_usage, prod_thr)
+            if (is_prod[p] and prod_configured)
+            else (filter_usage, filter_thr)
+        )
+        if usage is filter_usage and not has_filter_usage[n]:
+            return True
+        for r in range(R):
+            if thr[n, r] == 0 or allocatable[n, r] == 0:
+                continue
+            ratio = _go_round(np.float32(usage[n, r] * 100.0 / allocatable[n, r]))
+            if ratio >= thr[n, r]:
+                return False
+        return True
+
+    for p in range(P):
+        if not pod_valid[p]:
+            continue
+        # PreFilter: gang validity + quota admission
+        if gang_id[p] >= 0 and not gang_valid[gang_id[p]]:
+            continue
+        admit = True
+        if quota_id[p] >= 0:
+            for g in ancestors[quota_id[p]]:
+                if g < 0:
+                    continue
+                for r in range(R):
+                    if requests[p, r] > 0 and (
+                        quota_used[g, r] + requests[p, r] > quota_runtime[g, r]
+                    ):
+                        admit = False
+                        break
+                if not admit:
+                    break
+        if not admit:
+            continue
+        best_n, best_score = -1, np.float32(-1.0)
+        best_zone = -1
+        for n in range(N):
+            if not node_ok[n]:
+                continue
+            # Fit
+            if any(
+                fit_requests[p, r] > 0
+                and requested[n, r] + fit_requests[p, r] > allocatable[n, r]
+                for r in range(R)
+            ):
+                continue
+            if not la_filter_ok(p, n):
+                continue
+            # cpuset filter
+            if needs_bind[p]:
+                if not has_topology[n]:
+                    continue
+                if full_pcpus[p] and cores_needed[p] % max(cpus_per_core[n], 1.0) != 0:
+                    continue
+                if cores_needed[p] > bind_free[n]:
+                    continue
+            # NUMA admit
+            zone = -1
+            if needs_numa[p] and numa_policy[n] != 0:
+                if numa_policy[n] == POLICY_SINGLE:
+                    zone = -1
+                    for k in range(K):
+                        if all(
+                            requests[p, r] <= 0
+                            or requests[p, r] <= numa_free[n, k, r]
+                            for r in range(R)
+                        ):
+                            zone = k
+                            break
+                    if zone < 0:
+                        continue
+                else:
+                    total = numa_free[n].sum(axis=0)
+                    if any(
+                        requests[p, r] > 0 and requests[p, r] > total[r]
+                        for r in range(R)
+                    ):
+                        continue
+            # scores
+            use_prod = prod_mode and is_prod[p]
+            acc = np.float32(0.0)
+            for r in weight_idx:
+                term = term_pr[n, r] if use_prod else term_np[n, r]
+                acc += np.float32(weights[r]) * _least_requested(
+                    np.float32(estimated[p, r] + term), allocatable[n, r]
+                )
+            la_score = np.float32(np.floor(acc / max(wsum, np.float32(1.0))))
+            if not score_valid[n]:
+                la_score = np.float32(0.0)
+            acc2 = np.float32(0.0)
+            for r in weight_idx:
+                acc2 += np.float32(weights[r]) * _least_requested(
+                    np.float32(requested[n, r] + requests[p, r]), allocatable[n, r]
+                )
+            numa_score = np.float32(np.floor(acc2 / max(wsum, np.float32(1.0))))
+            s = la_score + numa_score
+            if s > best_score:
+                best_n, best_score, best_zone = n, s, zone
+        if best_n < 0:
+            continue
+        chosen[p] = best_n
+        requested[best_n] += fit_requests[p]
+        term_np[best_n] += estimated[p]
+        if prod_mode and is_prod[p]:
+            term_pr[best_n] += estimated[p]
+        if needs_numa[p]:
+            if best_zone >= 0:
+                numa_free[best_n, best_zone] -= requests[p]
+            else:
+                remaining = requests[p].copy()
+                for k in range(K):
+                    take = np.minimum(numa_free[best_n, k], remaining)
+                    numa_free[best_n, k] -= take
+                    remaining -= take
+        if needs_bind[p]:
+            bind_free[best_n] -= cores_needed[p]
+        if quota_id[p] >= 0:
+            for g in ancestors[quota_id[p]]:
+                if g >= 0:
+                    quota_used[g] += requests[p]
+    return chosen
+
+
 def diff_bindings(chosen_a: np.ndarray, chosen_b: np.ndarray, keys: List[str]) -> List[str]:
     """Human-readable diff of two binding vectors (parity failures)."""
     out = []

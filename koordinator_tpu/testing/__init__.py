@@ -5,4 +5,8 @@ clientset (client.ObjectStore is already in-process), scheduler-framework harnes
 and workload generators standing in for the `examples/spark-jobs` colocation traces.
 """
 
-from koordinator_tpu.testing.synth import SynthCluster, synth_cluster  # noqa: F401
+from koordinator_tpu.testing.synth import (  # noqa: F401
+    SynthCluster,
+    synth_cluster,
+    synth_full_cluster,
+)

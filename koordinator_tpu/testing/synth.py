@@ -170,3 +170,149 @@ def synth_cluster(
         pods_by_key=pods_by_key,
         now=now,
     )
+
+
+def synth_full_cluster(
+    num_nodes: int,
+    num_pods: int,
+    seed: int = 0,
+    num_quotas: int = 8,
+    num_gangs: int = 12,
+    topology_fraction: float = 0.7,
+    lsr_fraction: float = 0.15,
+    **kwargs,
+):
+    """SynthCluster + ClusterState exercising the full chain: NUMA topologies,
+    3-level quota tree, PodGroups, LSR cpuset pods (BASELINE configs 2-4)."""
+    import json
+
+    import numpy as np
+
+    from koordinator_tpu.api.objects import (
+        LABEL_POD_GROUP,
+        LABEL_QUOTA_NAME,
+        LABEL_QUOTA_PARENT,
+        LABEL_QUOTA_SHARED_WEIGHT,
+        ElasticQuota,
+        NodeResourceTopology,
+        NUMAZone,
+        PodGroup,
+    )
+    from koordinator_tpu.scheduler.cpu_topology import CPUAllocationState, CPUTopology
+    from koordinator_tpu.scheduler.snapshot import ClusterState
+
+    rng = random.Random(seed + 1000)
+    cluster = synth_cluster(num_nodes, num_pods, seed=seed, **kwargs)
+
+    topologies = {}
+    cpu_states = {}
+    for node in cluster.nodes:
+        if rng.random() >= topology_fraction:
+            continue
+        cores_total = node.allocatable[("cpu")] // 1000 or 16
+        cores_per_numa = max(2, int(cores_total) // (2 * 2))  # 2 numa, 2 threads
+        topo = CPUTopology.build(1, 2, cores_per_numa, 2)
+        mem = node.allocatable[("memory")]
+        cr = NodeResourceTopology(
+            meta=type(node.meta)(name=node.meta.name),
+            cpus=topo.cpus,
+            zones=[
+                NUMAZone(
+                    numa_id=k,
+                    allocatable=ResourceList.of(
+                        cpu=(len(topo.cpus) // 2) * 1000, memory=mem // 2
+                    ),
+                )
+                for k in range(2)
+            ],
+            kubelet_cpu_manager_policy=rng.choice(
+                ["none", "best-effort", "restricted", "single-numa-node"]
+            ),
+        )
+        topologies[node.meta.name] = cr
+        cpu_states[node.meta.name] = CPUAllocationState(topo)
+
+    # 3-level quota tree: root -> team-i -> job-j
+    quotas = []
+    leaf_names = []
+    if num_quotas > 0:
+        quotas.append(
+            ElasticQuota(
+                meta=type(cluster.nodes[0].meta)(name="root"),
+                min=ResourceList.of(cpu=0),
+                max=ResourceList.of(cpu=10**9, memory=2**60),
+            )
+        )
+        teams = max(1, num_quotas // 4)
+        for t in range(teams):
+            meta = type(cluster.nodes[0].meta)(name=f"team-{t}")
+            meta.labels[LABEL_QUOTA_PARENT] = "root"
+            meta.annotations[LABEL_QUOTA_SHARED_WEIGHT] = json.dumps(
+                {"cpu": str(rng.randint(1, 5)), "memory": f"{rng.randint(64, 512)}Gi"}
+            )
+            quotas.append(
+                ElasticQuota(
+                    meta=meta,
+                    min=ResourceList.of(
+                        cpu=rng.randint(8, 64) * 1000,
+                        memory=rng.randint(16, 128) * GIB,
+                    ),
+                    max=ResourceList.of(cpu=10**9, memory=2**60),
+                )
+            )
+        for q in range(num_quotas - teams - 1):
+            meta = type(cluster.nodes[0].meta)(name=f"job-{q}")
+            meta.labels[LABEL_QUOTA_PARENT] = f"team-{q % teams}"
+            quotas.append(
+                ElasticQuota(
+                    meta=meta,
+                    min=ResourceList.of(
+                        cpu=rng.randint(0, 32) * 1000,
+                        memory=rng.randint(0, 64) * GIB,
+                    ),
+                    max=ResourceList.of(
+                        cpu=rng.randint(64, 256) * 1000,
+                        memory=rng.randint(256, 1024) * GIB,
+                    ),
+                )
+            )
+            leaf_names.append(meta.name)
+
+    pod_groups = [
+        PodGroup(
+            meta=type(cluster.nodes[0].meta)(name=f"gang-{g}"),
+            min_member=rng.randint(2, 6),
+        )
+        for g in range(num_gangs)
+    ]
+
+    # decorate pods: quotas, gangs, LSR cpuset pods
+    from koordinator_tpu.api.objects import LABEL_POD_QOS
+
+    for pod in cluster.pods:
+        r = rng.random()
+        if leaf_names and r < 0.5:
+            pod.meta.labels[LABEL_QUOTA_NAME] = rng.choice(leaf_names)
+        if pod_groups and rng.random() < 0.3:
+            pod.meta.labels[LABEL_POD_GROUP] = rng.choice(pod_groups).meta.name
+        if rng.random() < lsr_fraction:
+            pod.meta.labels[LABEL_POD_QOS] = "LSR"
+            cores = rng.choice([2, 4])
+            pod.spec.requests = ResourceList.of(
+                cpu=cores * 1000, memory=pod.spec.requests[("memory")] or GIB
+            )
+            pod.spec.limits = ResourceList()
+
+    state = ClusterState(
+        nodes=cluster.nodes,
+        pending_pods=cluster.pods,
+        node_metrics=cluster.node_metrics,
+        pods_by_key=cluster.pods_by_key,
+        assigned=cluster.assigned,
+        topologies=topologies,
+        cpu_states=cpu_states,
+        quotas=quotas,
+        pod_groups=pod_groups,
+        now=cluster.now,
+    )
+    return cluster, state

@@ -1,0 +1,164 @@
+"""NUMA admit kernel + host cpuset accumulator tests."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.api.resources import (
+    NUM_RESOURCES,
+    RESOURCE_INDEX,
+    ResourceList,
+    ResourceName,
+)
+from koordinator_tpu.ops.numa import (
+    POLICY_BEST_EFFORT,
+    POLICY_NONE,
+    POLICY_SINGLE_NUMA_NODE,
+    numa_admit_row,
+    numa_spread_fill,
+)
+from koordinator_tpu.scheduler.cpu_topology import (
+    EXCLUSIVE_NUMA,
+    EXCLUSIVE_PCPU,
+    FULL_PCPUS,
+    SPREAD_BY_PCPUS,
+    CPUAllocationState,
+    CPUTopology,
+    take_cpus,
+)
+
+CPU = RESOURCE_INDEX[ResourceName.CPU]
+
+
+def _numa_free(per_node_zones):
+    """[N, K, R] from list of lists of cpu-milli frees."""
+    n = len(per_node_zones)
+    k = max(len(z) for z in per_node_zones)
+    arr = np.zeros((n, k, NUM_RESOURCES), np.float32)
+    for i, zones in enumerate(per_node_zones):
+        for j, cpu in enumerate(zones):
+            arr[i, j, CPU] = cpu
+    return jnp.asarray(arr)
+
+
+class TestNUMAAdmit:
+    def test_single_numa_node_policy(self):
+        free = _numa_free([[4000, 1000], [2000, 2000]])
+        req = jnp.asarray(ResourceList.of(cpu=3000).to_vector())
+        ok, zone = numa_admit_row(
+            req, jnp.bool_(True), free, jnp.asarray([POLICY_SINGLE_NUMA_NODE] * 2)
+        )
+        assert list(np.asarray(ok)) == [True, False]  # node1: no single zone fits
+        assert int(zone[0]) == 0
+
+    def test_total_fit_policies(self):
+        free = _numa_free([[2000, 2000]])
+        req = jnp.asarray(ResourceList.of(cpu=3000).to_vector())
+        for policy in (POLICY_BEST_EFFORT, POLICY_NONE):
+            ok, zone = numa_admit_row(
+                req, jnp.bool_(True), free, jnp.asarray([policy])
+            )
+            assert bool(ok[0])
+            assert int(zone[0]) == -1
+
+    def test_not_subject_pods_skip(self):
+        free = _numa_free([[0, 0]])
+        req = jnp.asarray(ResourceList.of(cpu=3000).to_vector())
+        ok, _ = numa_admit_row(
+            req, jnp.bool_(False), free, jnp.asarray([POLICY_SINGLE_NUMA_NODE])
+        )
+        assert bool(ok[0])
+
+    def test_spread_fill_waterfall(self):
+        free = np.zeros((2, NUM_RESOURCES), np.float32)
+        free[0, CPU], free[1, CPU] = 2000, 3000
+        req = np.zeros(NUM_RESOURCES, np.float32)
+        req[CPU] = 2500
+        out = np.asarray(
+            numa_spread_fill(jnp.asarray(free), jnp.asarray(req), jnp.int32(-1))
+        )
+        assert out[0, CPU] == 0.0 and out[1, CPU] == 2500.0
+
+    def test_single_zone_fill(self):
+        free = np.zeros((2, NUM_RESOURCES), np.float32)
+        free[0, CPU], free[1, CPU] = 4000, 3000
+        req = np.zeros(NUM_RESOURCES, np.float32)
+        req[CPU] = 2000
+        out = np.asarray(
+            numa_spread_fill(jnp.asarray(free), jnp.asarray(req), jnp.int32(1))
+        )
+        assert out[0, CPU] == 4000.0 and out[1, CPU] == 1000.0
+
+
+class TestCPUAccumulator:
+    def topo(self):
+        # 1 socket, 2 numa nodes, 4 cores/node, 2 threads -> 16 cpus
+        return CPUTopology.build(1, 2, 4, 2)
+
+    def test_full_pcpus_takes_whole_cores(self):
+        topo = self.topo()
+        state = CPUAllocationState(topo)
+        got = take_cpus(state, 4, bind_policy=FULL_PCPUS)
+        assert got is not None and len(got) == 4
+        cores = {topo.by_id[c].core_id for c in got}
+        assert len(cores) == 2  # 2 full cores of 2 threads
+        for core in cores:
+            assert all(c in got for c in topo.cores()[core])
+
+    def test_spread_by_pcpus(self):
+        topo = self.topo()
+        state = CPUAllocationState(topo)
+        got = take_cpus(state, 4, bind_policy=SPREAD_BY_PCPUS)
+        assert got is not None and len(got) == 4
+        cores = {topo.by_id[c].core_id for c in got}
+        assert len(cores) == 4  # one cpu per core
+
+    def test_exclusive_pcpu_avoids_taken_cores(self):
+        topo = self.topo()
+        state = CPUAllocationState(topo)
+        first = take_cpus(state, 2, FULL_PCPUS, EXCLUSIVE_PCPU)
+        state.add("pod-a", first, EXCLUSIVE_PCPU)
+        second = take_cpus(state, 2, FULL_PCPUS, EXCLUSIVE_PCPU)
+        assert second is not None
+        assert not first.intersection(second)
+        first_cores = {topo.by_id[c].core_id for c in first}
+        second_cores = {topo.by_id[c].core_id for c in second}
+        assert not first_cores & second_cores
+
+    def test_exclusive_numa_level(self):
+        topo = self.topo()
+        state = CPUAllocationState(topo)
+        first = take_cpus(state, 8, FULL_PCPUS, EXCLUSIVE_NUMA)
+        state.add("pod-a", first, EXCLUSIVE_NUMA)
+        numa_a = {topo.by_id[c].numa_node_id for c in first}
+        assert len(numa_a) == 1
+        second = take_cpus(state, 8, FULL_PCPUS, EXCLUSIVE_NUMA)
+        assert second is not None
+        numa_b = {topo.by_id[c].numa_node_id for c in second}
+        assert not numa_a & numa_b
+        # no room for a third exclusive numa allocation
+        state.add("pod-b", second, EXCLUSIVE_NUMA)
+        assert take_cpus(state, 2, FULL_PCPUS, EXCLUSIVE_NUMA) is None
+
+    def test_numa_affinity_restriction(self):
+        topo = self.topo()
+        state = CPUAllocationState(topo)
+        got = take_cpus(state, 4, FULL_PCPUS, numa_affinity=[1])
+        assert got is not None
+        assert {topo.by_id[c].numa_node_id for c in got} == {1}
+        assert take_cpus(state, 10, FULL_PCPUS, numa_affinity=[1]) is None
+
+    def test_insufficient_returns_none(self):
+        state = CPUAllocationState(self.topo())
+        assert take_cpus(state, 17) is None
+
+    def test_max_ref_count_sharing(self):
+        topo = self.topo()
+        state = CPUAllocationState(topo, max_ref_count=2)
+        a = take_cpus(state, 16, FULL_PCPUS)
+        state.add("pod-a", a, "")
+        b = take_cpus(state, 8, FULL_PCPUS)
+        assert b is not None and len(b) == 8  # shares up to refcount 2
+        state.add("pod-b", b, "")
+        state.remove("pod-a")
+        c = take_cpus(state, 16, FULL_PCPUS)
+        assert c is not None

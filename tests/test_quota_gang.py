@@ -1,0 +1,272 @@
+"""Quota water-filling + gang permit kernels vs scalar transcriptions of the
+reference algorithms (runtime_quota_calculator.go:111-168, core/core.go:311-338)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.objects import (
+    LABEL_QUOTA_PARENT,
+    ElasticQuota,
+    ObjectMeta,
+)
+from koordinator_tpu.api.resources import RESOURCE_INDEX, ResourceList, ResourceName
+from koordinator_tpu.ops.quota import (
+    MAX_QUOTA_DEPTH,
+    build_quota_tree,
+    compute_runtime_quotas,
+)
+
+CPU = RESOURCE_INDEX[ResourceName.CPU]
+MEM = RESOURCE_INDEX[ResourceName.MEMORY]
+
+
+def scalar_redistribution(children, total):
+    """Direct transcription of quotaTree.redistribution (Go int64 semantics)."""
+    runtime = [0.0] * len(children)
+    adjustable, total_w, left = [], 0.0, total
+    for i, c in enumerate(children):
+        m = max(c["min"], c.get("guarantee", 0.0))
+        if c["request"] > m:
+            runtime[i] = m
+            adjustable.append(i)
+            total_w += c["weight"]
+        else:
+            runtime[i] = c["request"] if c.get("allow_lent", True) else m
+        left -= runtime[i]
+
+    def iterate(left, total_w, nodes):
+        if total_w <= 0:
+            return
+        nxt, nxt_w, nxt_left = [], 0.0, 0.0
+        for i in nodes:
+            delta = math.floor(children[i]["weight"] * left / total_w + 0.5)
+            runtime[i] += delta
+            if runtime[i] < children[i]["request"]:
+                nxt.append(i)
+                nxt_w += children[i]["weight"]
+            else:
+                nxt_left += runtime[i] - children[i]["request"]
+                runtime[i] = children[i]["request"]
+        if nxt_left > 0 and nxt:
+            iterate(nxt_left, nxt_w, nxt)
+
+    if left > 0:
+        iterate(left, total_w, adjustable)
+    return runtime
+
+
+def _quota(name, cpu_min, cpu_max, parent="", weight=None):
+    meta = ObjectMeta(name=name)
+    if parent:
+        meta.labels[LABEL_QUOTA_PARENT] = parent
+    if weight is not None:
+        import json
+
+        meta.annotations[
+            "quota.scheduling.koordinator.sh/shared-weight"
+        ] = json.dumps({"cpu": str(weight // 1000)})
+    return ElasticQuota(
+        meta=meta,
+        min=ResourceList.of(cpu=cpu_min),
+        max=ResourceList.of(cpu=cpu_max),
+    )
+
+
+class TestWaterFilling:
+    @pytest.mark.parametrize(
+        "mins,requests,weights,total",
+        [
+            ([10000, 20000, 30000], [50000, 40000, 10000], [10000, 20000, 30000], 100000),
+            ([0, 0, 0], [70000, 50000, 30000], [1000, 1000, 2000], 100000),
+            ([40000, 40000], [100000, 5000], [1000, 1000], 100000),
+            ([10000], [5000], [1000], 100000),
+            ([30000, 30000, 30000, 30000], [90000, 10000, 50000, 0], [3000, 1000, 2000, 1000], 120000),
+        ],
+    )
+    def test_single_parent_matches_scalar(self, mins, requests, weights, total):
+        quotas = [
+            _quota(f"q{i}", mins[i], 10 * total, weight=weights[i])
+            for i in range(len(mins))
+        ]
+        req_by = {
+            f"q{i}": ResourceList.of(cpu=requests[i]).to_vector()
+            for i in range(len(mins))
+        }
+        tree = build_quota_tree(quotas, pod_requests_by_quota=req_by)
+        runtime = compute_runtime_quotas(
+            tree, ResourceList.of(cpu=total).to_vector()
+        )
+        children = [
+            {"min": float(mins[i]), "request": float(requests[i]),
+             "weight": float(weights[i])}
+            for i in range(len(mins))
+        ]
+        expected = scalar_redistribution(children, float(total))
+        np.testing.assert_allclose(runtime[:, CPU], expected, atol=0.5)
+
+    def test_hierarchy_parent_runtime_feeds_children(self):
+        quotas = [
+            _quota("root-a", 40000, 200000, weight=1000),
+            _quota("root-b", 40000, 200000, weight=1000),
+            _quota("leaf-a1", 10000, 200000, parent="root-a", weight=1000),
+            _quota("leaf-a2", 10000, 200000, parent="root-a", weight=3000),
+        ]
+        req_by = {
+            "leaf-a1": ResourceList.of(cpu=60000).to_vector(),
+            "leaf-a2": ResourceList.of(cpu=60000).to_vector(),
+            "root-b": ResourceList.of(cpu=20000).to_vector(),
+        }
+        tree = build_quota_tree(quotas, pod_requests_by_quota=req_by)
+        # parent request aggregates children
+        assert tree.request[tree.index["root-a"], CPU] == 120000
+        runtime = compute_runtime_quotas(
+            tree, ResourceList.of(cpu=100000).to_vector()
+        )
+        roots = scalar_redistribution(
+            [
+                {"min": 40000.0, "request": 120000.0, "weight": 1000.0},
+                {"min": 40000.0, "request": 20000.0, "weight": 1000.0},
+            ],
+            100000.0,
+        )
+        assert runtime[tree.index["root-a"], CPU] == pytest.approx(roots[0], abs=0.5)
+        assert runtime[tree.index["root-b"], CPU] == pytest.approx(roots[1], abs=0.5)
+        leaves = scalar_redistribution(
+            [
+                {"min": 10000.0, "request": 60000.0, "weight": 1000.0},
+                {"min": 10000.0, "request": 60000.0, "weight": 3000.0},
+            ],
+            roots[0],
+        )
+        assert runtime[tree.index["leaf-a1"], CPU] == pytest.approx(leaves[0], abs=0.5)
+        assert runtime[tree.index["leaf-a2"], CPU] == pytest.approx(leaves[1], abs=0.5)
+
+    def test_limit_request_capping(self):
+        """A child's request contribution is capped at its max (limitRequest,
+        quota_info.go:196-201): an over-max group must not soak up leftover its
+        sibling should receive."""
+        quotas = [
+            _quota("a", 0, 10000, weight=1000),
+            _quota("b", 0, 100000, weight=1000),
+        ]
+        tree = build_quota_tree(
+            quotas,
+            pod_requests_by_quota={
+                "a": ResourceList.of(cpu=80000).to_vector(),
+                "b": ResourceList.of(cpu=60000).to_vector(),
+            },
+        )
+        runtime = compute_runtime_quotas(tree, ResourceList.of(cpu=100000).to_vector())
+        assert runtime[tree.index["a"], CPU] == 10000.0
+        # b gets the rest of its request, not starved by a's phantom demand
+        assert runtime[tree.index["b"], CPU] == 60000.0
+
+    def test_runtime_capped_by_max(self):
+        quotas = [_quota("q0", 0, 30000, weight=1000)]
+        tree = build_quota_tree(
+            quotas,
+            pod_requests_by_quota={"q0": ResourceList.of(cpu=80000).to_vector()},
+        )
+        runtime = compute_runtime_quotas(tree, ResourceList.of(cpu=100000).to_vector())
+        assert runtime[0, CPU] == 30000.0
+
+    def test_ancestor_chain(self):
+        quotas = [
+            _quota("r", 0, 10**9),
+            _quota("m", 0, 10**9, parent="r"),
+            _quota("l", 0, 10**9, parent="m"),
+        ]
+        tree = build_quota_tree(quotas)
+        li = tree.index["l"]
+        chain = [g for g in tree.ancestors[li] if g >= 0]
+        assert chain == [tree.index["l"], tree.index["m"], tree.index["r"]]
+        assert tree.level[li] == 2
+
+
+class TestQuotaAdmission:
+    def test_admit_and_use(self):
+        import jax.numpy as jnp
+
+        from koordinator_tpu.ops.quota import quota_admit_row, quota_used_add_row
+
+        quotas = [
+            _quota("root", 0, 10**9),
+            _quota("leaf", 0, 10**9, parent="root"),
+        ]
+        tree = build_quota_tree(quotas)
+        runtime = np.zeros_like(tree.min)
+        runtime[tree.index["root"], CPU] = 10000
+        runtime[tree.index["leaf"], CPU] = 6000
+        used = jnp.asarray(tree.used)
+        req = jnp.asarray(ResourceList.of(cpu=4000).to_vector())
+        leaf = jnp.int32(tree.index["leaf"])
+        anc = jnp.asarray(tree.ancestors)
+        rt = jnp.asarray(runtime)
+
+        assert bool(quota_admit_row(req, leaf, anc, used, rt))
+        used = quota_used_add_row(used, req, leaf, anc, jnp.bool_(True))
+        # second 4000 exceeds leaf runtime 6000
+        assert not bool(quota_admit_row(req, leaf, anc, used, rt))
+        # no-quota pod always admitted
+        assert bool(quota_admit_row(req, jnp.int32(-1), anc, used, rt))
+        # root usage aggregated
+        assert float(used[tree.index["root"], CPU]) == 4000.0
+
+
+class TestGangPermit:
+    def test_permit_barrier(self):
+        import jax.numpy as jnp
+
+        from koordinator_tpu.ops.gang import gang_permit_mask
+
+        # gang 0 (min 2): both assigned -> pass; gang 1 (min 3): 1 assigned -> fail
+        chosen = jnp.asarray([0, 1, 2, -1, 5], jnp.int32)
+        gang_id = jnp.asarray([0, 0, 1, 1, -1], jnp.int32)
+        keep = gang_permit_mask(
+            chosen,
+            gang_id,
+            gang_min_member=jnp.asarray([2.0, 3.0]),
+            gang_assumed=jnp.asarray([0.0, 0.0]),
+            gang_group_id=jnp.asarray([0, 1], jnp.int32),
+            num_gangs=2,
+            num_groups=2,
+        )
+        assert list(np.asarray(keep)) == [True, True, False, False, True]
+
+    def test_gang_group_all_or_nothing(self):
+        import jax.numpy as jnp
+
+        from koordinator_tpu.ops.gang import gang_permit_mask
+
+        # two gangs in one group; gang 1 fails -> gang 0 members struck too
+        chosen = jnp.asarray([0, 1, -1], jnp.int32)
+        gang_id = jnp.asarray([0, 0, 1], jnp.int32)
+        keep = gang_permit_mask(
+            chosen,
+            gang_id,
+            gang_min_member=jnp.asarray([2.0, 1.0]),
+            gang_assumed=jnp.asarray([0.0, 0.0]),
+            gang_group_id=jnp.asarray([0, 0], jnp.int32),
+            num_gangs=2,
+            num_groups=1,
+        )
+        assert list(np.asarray(keep)) == [False, False, False]
+
+    def test_assumed_members_count(self):
+        import jax.numpy as jnp
+
+        from koordinator_tpu.ops.gang import gang_permit_mask
+
+        # min 3, 2 already assumed before the batch, 1 assigned now -> pass
+        keep = gang_permit_mask(
+            jnp.asarray([4], jnp.int32),
+            jnp.asarray([0], jnp.int32),
+            gang_min_member=jnp.asarray([3.0]),
+            gang_assumed=jnp.asarray([2.0]),
+            gang_group_id=jnp.asarray([0], jnp.int32),
+            num_gangs=1,
+            num_groups=1,
+        )
+        assert bool(keep[0])
