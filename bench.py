@@ -197,12 +197,12 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int) -> None:
         t0 = time.perf_counter()
         chosen_serial = serial_schedule_full(fc, la)
         t_serial = time.perf_counter() - t0
-        serial_pps = pods.padded_size / t_serial
+        serial_pps = pods.num_valid / t_serial
         mism = int(
             (chosen[: pods.num_valid] != chosen_serial[: pods.num_valid]).sum()
         )
         log(
-            f"serial floor: {t_serial:.3f}s for {pods.padded_size} pods "
+            f"serial floor: {t_serial:.3f}s for {pods.num_valid} pods "
             f"-> {serial_pps:,.1f} pods/s; parity on full batch: "
             f"{'OK' if mism == 0 else f'{mism} MISMATCHES'}"
         )

@@ -22,13 +22,12 @@ implements the identical chain scalar-wise; bindings must match exactly.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from koordinator_tpu.api.resources import NUM_RESOURCES
 from koordinator_tpu.models.scheduler_model import ScheduleInputs, _score_row
 from koordinator_tpu.ops import loadaware as la_ops
 from koordinator_tpu.ops.fit import fit_ok_row

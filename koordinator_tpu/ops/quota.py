@@ -112,10 +112,14 @@ def water_fill_level(
         new_rt = runtime + delta
         overshoot = jnp.maximum(new_rt - request, 0.0)
         new_rt = jnp.minimum(new_rt, request)
-        still = adjustable & (new_rt < request) & (delta > 0)
-        # recycle overshoot within each segment for the next round
+        # a child stays adjustable while below its request EVEN if this round's
+        # rounded delta was 0 — recycled overshoot must still reach it next
+        # round (reference iterationForRedistribution keeps it in `nodes`)
+        still = adjustable & (new_rt < request)
+        # next round distributes ONLY the overshoot recycled this round
+        # (undistributed rounding remainder is dropped, as in the reference)
         new_leftover_seg = seg_sum(jnp.where(adjustable, overshoot, 0.0))
-        changed = jnp.any(delta > 0) & jnp.any(still) & jnp.any(new_leftover_seg > 0)
+        changed = jnp.any(still) & jnp.any(new_leftover_seg > 0)
         return new_rt, new_leftover_seg, still, changed, it + 1
 
     init = (base, leftover_seg0, adjustable0, jnp.any(adjustable0), 0)

@@ -1,0 +1,280 @@
+"""The scheduling cycle driver: the rebuild's scheduleOne loop.
+
+Where the reference runs one pod at a time through Go plugin dispatch
+(frameworkext/framework_extender_factory.go:156-185), this driver drains the
+whole pending queue per cycle:
+
+  1. collect pending pods + unscheduled Reservation CRs (reservations ride the
+     same queue as pseudo-pods, eventhandlers/reservation_handler.go semantics)
+  2. reservation nomination pre-pass: pods matching an Available reservation are
+     host-assigned to its node (the nominator prefers reservations; reserved
+     resources are owner-restricted, so they bypass the open-capacity kernel)
+  3. snapshot -> fused full-chain kernel -> tentative bindings (exact serial
+     semantics, see models/full_chain.py)
+  4. per binding in queue order: plugin Reserve hooks (cpuset take, device pick)
+     -> PreBind annotation accumulation -> single store patch (defaultprebind)
+  5. Reserve failure vetoes the binding (unreserve earlier plugins); the pod
+     stays pending for the next cycle — mirroring the reference's assume/bind
+     error path.
+
+Compiled steps are cached by static shape signature (bucketed P/N/G/NG), so a
+steady-state cluster never recompiles.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from koordinator_tpu.api.objects import (
+    ANNOTATION_RESERVATION_ALLOCATED,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    Reservation,
+)
+from koordinator_tpu.client.store import (
+    KIND_NODE,
+    KIND_NODE_METRIC,
+    KIND_POD,
+    KIND_RESERVATION,
+    ObjectStore,
+)
+from koordinator_tpu.models.full_chain import build_full_chain_step
+from koordinator_tpu.ops.fit import with_pod_count
+from koordinator_tpu.ops.loadaware import LoadAwareArgs
+from koordinator_tpu.scheduler.frameworkext import (
+    BindResult,
+    CycleContext,
+    CycleResult,
+    FrameworkExtender,
+)
+from koordinator_tpu.scheduler.plugins import DEFAULT_PLUGINS
+from koordinator_tpu.scheduler.snapshot import (
+    ClusterState,
+    build_full_chain_inputs,
+    reduce_to_active_axes,
+)
+
+RESERVATION_POD_PREFIX = "__reservation__/"
+
+
+class Scheduler:
+    """koord-scheduler analog: batched cycles against the object store."""
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        args: Optional[LoadAwareArgs] = None,
+        scheduler_name: str = "koord-scheduler",
+    ):
+        self.store = store
+        self.args = args or LoadAwareArgs()
+        self.scheduler_name = scheduler_name
+        self.extender = FrameworkExtender(store)
+        for cls in DEFAULT_PLUGINS:
+            self.extender.register_plugin(cls())
+        self._step_cache: Dict[Tuple, object] = {}
+
+    # ------------------------------------------------------------------
+    def _pending_queue(self, now: float) -> Tuple[List[Pod], Dict[str, Reservation]]:
+        pods = [
+            p
+            for p in self.store.list(KIND_POD)
+            if not p.is_assigned
+            and not p.is_terminated
+            and p.spec.scheduler_name == self.scheduler_name
+        ]
+        reservations: Dict[str, Reservation] = {}
+        for res in self.store.list(KIND_RESERVATION):
+            if res.phase == "Pending" and not res.node_name and not res.is_expired(now):
+                pseudo = Pod(
+                    meta=ObjectMeta(
+                        name=res.meta.name,
+                        namespace="__reservation__",
+                        creation_timestamp=res.meta.creation_timestamp,
+                    ),
+                    spec=PodSpec(
+                        priority=res.template.priority or 9500,
+                        requests=res.template.requests,
+                        limits=res.template.limits,
+                    ),
+                )
+                pods.append(pseudo)
+                reservations[pseudo.meta.key] = res
+        return pods, reservations
+
+    def _assigned_requests(self, now: float) -> Dict[str, np.ndarray]:
+        """Fit state per node: assigned pods + unconsumed reserved resources.
+        Pods allocated FROM a reservation are counted inside the reservation's
+        allocatable (avoid double counting)."""
+        out: Dict[str, np.ndarray] = {}
+
+        def add(node: str, vec: np.ndarray) -> None:
+            if node in out:
+                out[node] = out[node] + vec
+            else:
+                out[node] = vec.astype(np.float32)
+
+        for pod in self.store.list(KIND_POD):
+            if not pod.is_assigned or pod.is_terminated:
+                continue
+            if ANNOTATION_RESERVATION_ALLOCATED in pod.meta.annotations:
+                continue
+            add(pod.spec.node_name, with_pod_count(pod.spec.requests.to_vector()[None])[0])
+        for res in self.store.list(KIND_RESERVATION):
+            if res.is_available and not res.is_expired(now):
+                add(res.node_name, res.allocatable.to_vector())
+        return out
+
+    def _cluster_state(self, pending: List[Pod], now: float) -> ClusterState:
+        la = self.extender.plugin("LoadAwareScheduling")
+        numa = self.extender.plugin("NodeNUMAResource")
+        quota = self.extender.plugin("ElasticQuota")
+        gang = self.extender.plugin("Coscheduling")
+        return ClusterState(
+            nodes=[n for n in self.store.list(KIND_NODE) if not n.unschedulable],
+            pending_pods=pending,
+            node_metrics={
+                m.meta.name: m for m in self.store.list(KIND_NODE_METRIC)
+            },
+            pods_by_key={p.meta.key: p for p in self.store.list(KIND_POD)},
+            assigned=la.assigned_view() if la else {},
+            assigned_requests=self._assigned_requests(now),
+            topologies=dict(numa.topologies) if numa else {},
+            cpu_states=dict(numa.cpu_states) if numa else {},
+            numa_allocated=dict(numa.numa_allocated) if numa else {},
+            quotas=quota.quota_list() if quota else [],
+            pod_groups=list(gang.pod_groups.values()) if gang else [],
+            gang_assumed=dict(gang.assumed) if gang else {},
+            now=now,
+        )
+
+    def _get_step(self, signature: Tuple, ng: int, ngroups: int, active) -> object:
+        key = (signature, ng, ngroups, tuple(active))
+        if key not in self._step_cache:
+            self._step_cache[key] = build_full_chain_step(
+                self.args, ng, ngroups, active_axes=active
+            )
+        return self._step_cache[key]
+
+    # ------------------------------------------------------------------
+    def run_cycle(self, now: Optional[float] = None) -> CycleResult:
+        t_start = time.perf_counter()
+        now = time.time() if now is None else now
+        result = CycleResult()
+        res_plugin = self.extender.plugin("Reservation")
+        if res_plugin:
+            res_plugin.expire_reservations(now)
+        pending, pending_reservations = self._pending_queue(now)
+        if not pending:
+            result.duration_seconds = time.perf_counter() - t_start
+            self.extender.monitor.record(result)
+            return result
+
+        # ---- reservation nomination pre-pass
+        remaining: List[Pod] = []
+        ctx = CycleContext(now=now)
+        for pod in pending:
+            if pod.meta.key in pending_reservations or res_plugin is None:
+                remaining.append(pod)
+                continue
+            res = res_plugin.nominate(pod, now)
+            if res is None:
+                remaining.append(pod)
+                continue
+            err = self._reserve_and_bind(pod, res.node_name, ctx, result,
+                                         via_reservation=res)
+            if err:
+                remaining.append(pod)
+        pending = remaining
+
+        # ---- batched kernel pass
+        state = self._cluster_state(pending, now)
+        if not state.nodes:
+            result.failed = [p.meta.key for p in pending]
+            result.duration_seconds = time.perf_counter() - t_start
+            self.extender.monitor.record(result)
+            return result
+        fc, pods, nodes, tree, gang_index, ng, ngroups = build_full_chain_inputs(
+            state, self.args
+        )
+        fc, active = reduce_to_active_axes(fc)
+        step = self._get_step(
+            (pods.padded_size, nodes.padded_size, fc.quota_runtime.shape[0]),
+            ng, ngroups, active,
+        )
+        t_k = time.perf_counter()
+        chosen, _, _ = step(fc)
+        chosen = np.asarray(chosen)
+        result.kernel_seconds = time.perf_counter() - t_k
+
+        # ---- apply bindings in queue order
+        by_key = {p.meta.key: p for p in pending}
+        for i, key in enumerate(pods.keys):
+            node_idx = int(chosen[i])
+            pod = by_key[key]
+            if node_idx < 0:
+                (result.rejected if pod.gang_name or pod.quota_name
+                 else result.failed).append(key)
+                continue
+            node_name = nodes.names[node_idx]
+            reservation = pending_reservations.get(key)
+            err = self._reserve_and_bind(
+                pod, node_name, ctx, result, reservation_cr=reservation
+            )
+            if err:
+                result.failed.append(key)
+
+        gang = self.extender.plugin("Coscheduling")
+        if gang:
+            gang.update_pod_group_status(self.store)
+        result.duration_seconds = time.perf_counter() - t_start
+        self.extender.monitor.record(result)
+        return result
+
+    # ------------------------------------------------------------------
+    def _reserve_and_bind(
+        self,
+        pod: Pod,
+        node_name: str,
+        ctx: CycleContext,
+        result: CycleResult,
+        via_reservation: Optional[Reservation] = None,
+        reservation_cr: Optional[Reservation] = None,
+    ) -> Optional[str]:
+        """Reserve hooks -> PreBind -> Bind; returns error to leave pod pending."""
+        if reservation_cr is not None:
+            # binding a Reservation CR itself: no plugin reserve (it only holds
+            # capacity), just set status (reservation plugin Bind, plugin.go:596)
+            reservation_cr.node_name = node_name
+            reservation_cr.phase = "Available"
+            reservation_cr.allocatable = pod.spec.requests.copy()
+            self.store.update(KIND_RESERVATION, reservation_cr)
+            result.bound.append(
+                BindResult(RESERVATION_POD_PREFIX + reservation_cr.meta.name,
+                           node_name)
+            )
+            return None
+
+        done: List = []
+        for plugin in self.extender.plugins:
+            err = plugin.reserve(pod, node_name, ctx)
+            if err:
+                for p in reversed(done):
+                    p.unreserve(pod, node_name, ctx)
+                return f"{plugin.name}: {err}"
+            done.append(plugin)
+        if via_reservation is not None:
+            res_plugin = self.extender.plugin("Reservation")
+            res_plugin.consume(pod, via_reservation, ctx)
+
+        annotations: Dict[str, str] = {}
+        for plugin in self.extender.plugins:
+            plugin.pre_bind(pod, node_name, ctx, annotations)
+        prebind = self.extender.plugin("DefaultPreBind")
+        prebind.apply_patch(pod, node_name, annotations)
+        result.bound.append(BindResult(pod.meta.key, node_name, annotations))
+        return None

@@ -1,0 +1,129 @@
+"""Framework extension engine: the host-side orchestration around the kernels.
+
+Analog of reference `pkg/scheduler/frameworkext/` (SURVEY.md section 2.2): the
+extender owns the plugin registry, runs the scheduling cycle (snapshot -> fused
+kernel -> host Reserve/PreBind/Bind), dispatches store events to plugin caches,
+and provides the monitor/debug surfaces (scheduler_monitor.go, debug.go).
+
+The kube-scheduler extension points map as:
+  PreFilter/Filter/Score -> fused into the batched kernel (models/full_chain.py)
+  Reserve/Unreserve      -> host plugin hooks (cpuset take, device pick,
+                            reservation consume) run per actual binding
+  PreBind                -> accumulated object patches applied once
+                            (defaultprebind semantics, frameworkext/interface.go:194)
+  Bind                   -> store update of pod.spec.node_name
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from koordinator_tpu.api.objects import Pod
+from koordinator_tpu.client.store import ObjectStore
+
+
+@dataclass
+class BindResult:
+    pod_key: str
+    node_name: str
+    annotations: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class CycleResult:
+    bound: List[BindResult] = field(default_factory=list)
+    failed: List[str] = field(default_factory=list)      # pod keys left pending
+    rejected: List[str] = field(default_factory=list)    # struck by permit/quota
+    duration_seconds: float = 0.0
+    kernel_seconds: float = 0.0
+
+
+class Plugin:
+    """Host-side plugin base. Kernels consume arrays the plugins contribute via
+    the snapshot builder; these hooks cover cache maintenance + per-binding
+    effects."""
+
+    name = "plugin"
+
+    def register(self, store: ObjectStore) -> None:
+        """Subscribe to store events to maintain caches."""
+
+    def reserve(self, pod: Pod, node_name: str, ctx: "CycleContext") -> Optional[str]:
+        """Claim host-side resources for a tentative binding. Return an error
+        string to veto (triggers unreserve of earlier plugins)."""
+        return None
+
+    def unreserve(self, pod: Pod, node_name: str, ctx: "CycleContext") -> None:
+        """Roll back reserve."""
+
+    def pre_bind(self, pod: Pod, node_name: str, ctx: "CycleContext",
+                 annotations: Dict[str, str]) -> None:
+        """Contribute annotations/patches to the single PreBind patch."""
+
+
+@dataclass
+class CycleContext:
+    """Per-cycle scratch shared by plugins (cycleState analog)."""
+
+    now: float
+    data: Dict[str, Any] = field(default_factory=dict)
+
+
+class SchedulerMonitor:
+    """Slow/stuck cycle watchdog (frameworkext/scheduler_monitor.go:44-108)."""
+
+    def __init__(self, timeout_seconds: float = 10.0):
+        self.timeout = timeout_seconds
+        self.history: List[Dict[str, float]] = []
+
+    def record(self, result: CycleResult) -> None:
+        self.history.append(
+            {
+                "duration": result.duration_seconds,
+                "kernel": result.kernel_seconds,
+                "bound": float(len(result.bound)),
+                "slow": float(result.duration_seconds > self.timeout),
+            }
+        )
+
+    @property
+    def slow_cycles(self) -> int:
+        return int(sum(h["slow"] for h in self.history))
+
+
+class FrameworkExtender:
+    """Plugin registry + event fan-out (framework_extender_factory.go analog)."""
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+        self.plugins: List[Plugin] = []
+        self.monitor = SchedulerMonitor()
+        self._debug_top_n = 0
+
+    def register_plugin(self, plugin: Plugin) -> None:
+        self.plugins.append(plugin)
+        plugin.register(self.store)
+
+    def plugin(self, name: str) -> Optional[Plugin]:
+        for p in self.plugins:
+            if p.name == name:
+                return p
+        return None
+
+    # debug.go analog: runtime-settable top-N score dump
+    def set_debug_top_n(self, n: int) -> None:
+        self._debug_top_n = n
+
+    def debug_scores(self, score_row: np.ndarray, node_names: List[str]) -> List[str]:
+        if self._debug_top_n <= 0:
+            return []
+        order = np.argsort(-score_row)[: self._debug_top_n]
+        return [
+            f"{node_names[i]}={score_row[i]:.0f}"
+            for i in order
+            if i < len(node_names)
+        ]

@@ -1,0 +1,89 @@
+"""Coscheduling plugin host side: the gang cache.
+
+Reference `plugins/coscheduling/core/gang_cache.go` + `gang.go`: gangs come from
+PodGroup CRs or pod annotations; track member counts, assumed (bound) members,
+schedule-cycle state, and gang-groups (annotation listing gangs that must be
+co-admitted). The Permit barrier itself is the device-side post-pass
+(ops/gang.py); this cache feeds it."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+from koordinator_tpu.api.objects import Pod, PodGroup
+from koordinator_tpu.client.store import (
+    KIND_POD,
+    KIND_POD_GROUP,
+    EventType,
+    ObjectStore,
+)
+from koordinator_tpu.scheduler.frameworkext import CycleContext, Plugin
+
+ANNOTATION_GANG_GROUPS = "gang.scheduling.koordinator.sh/groups"
+
+
+class CoschedulingPlugin(Plugin):
+    name = "Coscheduling"
+
+    def __init__(self) -> None:
+        self.pod_groups: Dict[str, PodGroup] = {}
+        self.assumed: Dict[str, int] = {}     # gang -> bound member count
+        self.members: Dict[str, int] = {}     # gang -> known member count
+
+    def register(self, store: ObjectStore) -> None:
+        store.subscribe(KIND_POD_GROUP, self._on_pod_group)
+        store.subscribe(KIND_POD, self._on_pod)
+
+    def _on_pod_group(self, ev: EventType, pg: PodGroup, old) -> None:
+        if ev is EventType.DELETED:
+            self.pod_groups.pop(pg.meta.name, None)
+        else:
+            self.pod_groups[pg.meta.name] = pg
+
+    def _on_pod(self, ev: EventType, pod: Pod, old) -> None:
+        gang = pod.gang_name
+        if not gang:
+            return
+        if ev is EventType.ADDED:
+            self.members[gang] = self.members.get(gang, 0) + 1
+            if pod.is_assigned and not pod.is_terminated:
+                self.assumed[gang] = self.assumed.get(gang, 0) + 1
+        elif ev is EventType.MODIFIED:
+            was = old is not None and old.is_assigned and not old.is_terminated
+            now = pod.is_assigned and not pod.is_terminated
+            if now and not was:
+                self.assumed[gang] = self.assumed.get(gang, 0) + 1
+            elif was and not now:
+                self.assumed[gang] = max(0, self.assumed.get(gang, 0) - 1)
+        elif ev is EventType.DELETED:
+            self.members[gang] = max(0, self.members.get(gang, 0) - 1)
+            if pod.is_assigned and not pod.is_terminated:
+                self.assumed[gang] = max(0, self.assumed.get(gang, 0) - 1)
+
+    def gang_groups(self, gang_name: str) -> List[str]:
+        """Gangs co-admitted with this one (annotation on the PodGroup)."""
+        pg = self.pod_groups.get(gang_name)
+        if pg is None:
+            return [gang_name]
+        raw = pg.meta.annotations.get(ANNOTATION_GANG_GROUPS)
+        if not raw:
+            return [gang_name]
+        try:
+            groups = json.loads(raw)
+            return list(groups) if groups else [gang_name]
+        except (ValueError, TypeError):
+            return [gang_name]
+
+    def update_pod_group_status(self, store: ObjectStore) -> None:
+        """PodGroup status controller analog (controller/podgroup.go:55-313)."""
+        for pg in self.pod_groups.values():
+            scheduled = self.assumed.get(pg.meta.name, 0)
+            phase = (
+                "Scheduled"
+                if scheduled >= pg.min_member
+                else ("Scheduling" if scheduled else "Pending")
+            )
+            if pg.scheduled != scheduled or pg.phase != phase:
+                pg.scheduled, pg.phase = scheduled, phase
+                store.update(KIND_POD_GROUP, pg)

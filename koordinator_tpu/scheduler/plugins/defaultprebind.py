@@ -1,0 +1,38 @@
+"""DefaultPreBind: apply accumulated object patches once.
+
+Reference `plugins/defaultprebind/plugin.go` implementing PreBindExtensions
+(frameworkext/interface.go:194-197): every plugin contributes annotations during
+PreBind; this plugin merges them into ONE store update per pod (one apiserver
+patch in the reference) together with the binding itself."""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict
+
+from koordinator_tpu.api.objects import Pod
+from koordinator_tpu.client.store import KIND_POD, ObjectStore
+from koordinator_tpu.scheduler.frameworkext import CycleContext, Plugin
+
+
+class DefaultPreBindPlugin(Plugin):
+    name = "DefaultPreBind"
+
+    def __init__(self) -> None:
+        self._store: ObjectStore = None  # type: ignore[assignment]
+
+    def register(self, store: ObjectStore) -> None:
+        self._store = store
+
+    def apply_patch(self, pod: Pod, node_name: str,
+                    annotations: Dict[str, str]) -> None:
+        # patch a COPY: watch subscribers diff old vs new, and in-place mutation
+        # of the stored object would make them indistinguishable (the reference
+        # patches via the apiserver, which has the same copy semantics)
+        patched = copy.deepcopy(pod)
+        patched.meta.annotations.update(annotations)
+        patched.spec.node_name = node_name
+        self._store.update(KIND_POD, patched)
+        # keep the caller's object coherent for later hooks in this cycle
+        pod.meta.annotations.update(annotations)
+        pod.spec.node_name = node_name

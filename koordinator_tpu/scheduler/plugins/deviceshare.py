@@ -1,0 +1,130 @@
+"""DeviceShare plugin host side: device cache + concrete allocation.
+
+Reference `plugins/deviceshare/`: Device CRs describe per-node GPU/RDMA/FPGA
+inventory; fractional GPU requests (gpu-core percent, gpu-memory[-ratio],
+device_share.go:38-46); Filter checks aggregate device capacity (covered by the
+GPU resource axes in the batched Fit); Reserve picks concrete device minors
+(device_allocator.go) honoring NUMA affinity when present; PreBind writes the
+allocation annotation (plugin.go:475)."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from koordinator_tpu.api.objects import (
+    ANNOTATION_DEVICE_ALLOCATED,
+    Device,
+    DeviceInfo,
+    Pod,
+)
+from koordinator_tpu.api.resources import ResourceName
+from koordinator_tpu.client.store import KIND_DEVICE, EventType, ObjectStore
+from koordinator_tpu.scheduler.frameworkext import CycleContext, Plugin
+
+
+def pod_gpu_request(pod: Pod) -> Dict[str, int]:
+    """Normalize the GPU request forms (apis/extension/device_share.go):
+    nvidia.com/gpu: N  ->  core N*100, memory-ratio N*100
+    gpu-core/gpu-memory-ratio/gpu-memory given directly otherwise."""
+    req = pod.spec.requests
+    whole = req[ResourceName.GPU]
+    if whole:
+        return {"core": whole * 100, "memory_ratio": whole * 100}
+    out: Dict[str, int] = {}
+    if req[ResourceName.GPU_CORE]:
+        out["core"] = req[ResourceName.GPU_CORE]
+    if req[ResourceName.GPU_MEMORY_RATIO]:
+        out["memory_ratio"] = req[ResourceName.GPU_MEMORY_RATIO]
+    if req[ResourceName.GPU_MEMORY]:
+        out["memory"] = req[ResourceName.GPU_MEMORY]
+    return out
+
+
+class DeviceSharePlugin(Plugin):
+    name = "DeviceShare"
+
+    def __init__(self) -> None:
+        self.devices: Dict[str, Device] = {}          # node -> Device CR
+        # node -> minor -> {"core": used, "memory_ratio": used, "memory": used}
+        self.allocated: Dict[str, Dict[int, Dict[str, int]]] = {}
+        self.by_pod: Dict[str, List[dict]] = {}
+
+    def register(self, store: ObjectStore) -> None:
+        store.subscribe(KIND_DEVICE, self._on_device)
+
+    def _on_device(self, ev: EventType, dev: Device, old) -> None:
+        if ev is EventType.DELETED:
+            self.devices.pop(dev.meta.name, None)
+        else:
+            self.devices[dev.meta.name] = dev
+
+    def _gpu_infos(self, node: str) -> List[DeviceInfo]:
+        dev = self.devices.get(node)
+        if dev is None:
+            return []
+        return [d for d in dev.devices if d.type == "gpu" and d.health]
+
+    def reserve(self, pod: Pod, node_name: str, ctx: CycleContext) -> Optional[str]:
+        want = pod_gpu_request(pod)
+        if not want:
+            return None
+        gpus = self._gpu_infos(node_name)
+        if not gpus:
+            return "no healthy gpu on node"
+        node_alloc = self.allocated.setdefault(node_name, {})
+        remaining_core = want.get("core", 0)
+        picks: List[dict] = []
+        # full GPUs first (multiples of 100 core), then best-fit fractional
+        # (device_allocator.go preference: pack fractional, keep whole GPUs free)
+        order = sorted(
+            gpus,
+            key=lambda g: (
+                -node_alloc.get(g.minor, {}).get("core", 0),  # fuller first
+                g.minor,
+            ),
+        )
+        for g in order:
+            if remaining_core <= 0:
+                break
+            used = node_alloc.setdefault(
+                g.minor, {"core": 0, "memory_ratio": 0, "memory": 0}
+            )
+            free_core = 100 - used["core"]
+            if free_core <= 0:
+                continue
+            take = min(free_core, remaining_core)
+            if remaining_core > 100 and take < 100:
+                continue  # whole-gpu requests need whole gpus
+            used["core"] += take
+            ratio = want.get("memory_ratio", take)
+            mem = want.get("memory", 0)
+            used["memory_ratio"] += min(ratio, take if want.get("core") else ratio)
+            used["memory"] += mem
+            picks.append({"minor": g.minor, "core": take, "memory": mem})
+            remaining_core -= take
+        if remaining_core > 0:
+            # roll back partial picks
+            for p in picks:
+                node_alloc[p["minor"]]["core"] -= p["core"]
+                node_alloc[p["minor"]]["memory"] -= p["memory"]
+            return "insufficient gpu capacity"
+        self.by_pod[pod.meta.key] = picks
+        return None
+
+    def unreserve(self, pod: Pod, node_name: str, ctx: CycleContext) -> None:
+        picks = self.by_pod.pop(pod.meta.key, None)
+        if not picks:
+            return
+        node_alloc = self.allocated.get(node_name, {})
+        for p in picks:
+            used = node_alloc.get(p["minor"])
+            if used:
+                used["core"] -= p["core"]
+                used["memory"] -= p["memory"]
+
+    def pre_bind(self, pod: Pod, node_name: str, ctx: CycleContext,
+                 annotations: Dict[str, str]) -> None:
+        picks = self.by_pod.get(pod.meta.key)
+        if picks:
+            annotations[ANNOTATION_DEVICE_ALLOCATED] = json.dumps({"gpu": picks})

@@ -1,0 +1,104 @@
+"""ElasticQuota plugin host side: the group quota manager cache.
+
+Reference `plugins/elasticquota/core/group_quota_manager.go`: maintains the
+quota tree from ElasticQuota CRs, tracks request/used deltas as pods come and
+go, and exposes the packed tree to the admission kernel (ops/quota.py). Also
+hosts the overuse revoke walk (quota_overuse_revoke.go)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from koordinator_tpu.api.objects import ElasticQuota, Pod
+from koordinator_tpu.api.resources import NUM_RESOURCES
+from koordinator_tpu.client.store import (
+    KIND_ELASTIC_QUOTA,
+    KIND_POD,
+    EventType,
+    ObjectStore,
+)
+from koordinator_tpu.scheduler.frameworkext import CycleContext, Plugin
+
+
+class ElasticQuotaPlugin(Plugin):
+    name = "ElasticQuota"
+
+    def __init__(self) -> None:
+        self.quotas: Dict[str, ElasticQuota] = {}
+        self.used: Dict[str, np.ndarray] = {}     # leaf quota -> used vector
+        self.pending: Dict[str, np.ndarray] = {}  # leaf quota -> pending requests
+
+    def register(self, store: ObjectStore) -> None:
+        store.subscribe(KIND_ELASTIC_QUOTA, self._on_quota)
+        store.subscribe(KIND_POD, self._on_pod)
+
+    def _on_quota(self, ev: EventType, q: ElasticQuota, old) -> None:
+        if ev is EventType.DELETED:
+            self.quotas.pop(q.meta.name, None)
+        else:
+            self.quotas[q.meta.name] = q
+
+    def _vec(self, cache: Dict[str, np.ndarray], name: str) -> np.ndarray:
+        if name not in cache:
+            cache[name] = np.zeros(NUM_RESOURCES, np.float32)
+        return cache[name]
+
+    def _on_pod(self, ev: EventType, pod: Pod, old) -> None:
+        name = pod.quota_name
+        if not name:
+            return
+        vec = pod.spec.requests.to_vector()
+        if ev is EventType.ADDED:
+            if pod.is_assigned and not pod.is_terminated:
+                self._vec(self.used, name)
+                self.used[name] += vec
+            elif not pod.is_terminated:
+                self._vec(self.pending, name)
+                self.pending[name] += vec
+        elif ev is EventType.MODIFIED and old is not None:
+            was = old.is_assigned and not old.is_terminated
+            now = pod.is_assigned and not pod.is_terminated
+            if now and not was:
+                self._vec(self.used, name)
+                self.used[name] += vec
+                self._vec(self.pending, name)
+                self.pending[name] = np.maximum(self.pending[name] - vec, 0.0)
+            elif was and not now:
+                self._vec(self.used, name)
+                self.used[name] = np.maximum(self.used[name] - vec, 0.0)
+        elif ev is EventType.DELETED:
+            cache = self.used if (pod.is_assigned and not pod.is_terminated) else self.pending
+            self._vec(cache, name)
+            cache[name] = np.maximum(cache[name] - vec, 0.0)
+
+    def quota_list(self) -> List[ElasticQuota]:
+        return list(self.quotas.values())
+
+    # quota_overuse_revoke.go analog: pods to evict when a group exceeds runtime
+    def find_overuse_victims(
+        self, runtime_by_name: Dict[str, np.ndarray], pods: List[Pod]
+    ) -> List[Pod]:
+        victims: List[Pod] = []
+        for name, used in self.used.items():
+            runtime = runtime_by_name.get(name)
+            if runtime is None:
+                continue
+            over = np.maximum(used - runtime, 0.0)
+            if not (over > 0).any():
+                continue
+            members = sorted(
+                (
+                    p
+                    for p in pods
+                    if p.quota_name == name and p.is_assigned and not p.is_terminated
+                ),
+                key=lambda p: (p.spec.priority or 0, -p.meta.creation_timestamp),
+            )
+            for pod in members:
+                if not (over > 0).any():
+                    break
+                victims.append(pod)
+                over = over - pod.spec.requests.to_vector()
+        return victims

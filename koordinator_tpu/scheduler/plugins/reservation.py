@@ -1,0 +1,123 @@
+"""Reservation plugin host side.
+
+Reference `plugins/reservation/`: Reservation CRs are scheduled like pods
+(eventhandlers sync them into the cache as fake reservation-pods); Available
+reservations pre-claim node resources; pods matching an owner consume reserved
+resources (nominator.go picks which one); expired reservations are garbage
+collected (controller/controller.go).
+
+TPU rebuild v1: the cycle driver schedules Reservation CRs through the same
+batched kernel (their template requests ride the pod batch); matching pods are
+nominated to their reservation's node host-side BEFORE the kernel pass (the
+reference nominator also prefers reservations over open capacity), consuming
+from the reservation's free resources. A matched pod bypasses Filter thresholds
+the way the reference's reservation-restore transformer returns reserved
+resources to the node snapshot."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from koordinator_tpu.api.objects import (
+    ANNOTATION_RESERVATION_ALLOCATED,
+    Pod,
+    Reservation,
+)
+from koordinator_tpu.client.store import (
+    KIND_RESERVATION,
+    EventType,
+    ObjectStore,
+)
+from koordinator_tpu.scheduler.frameworkext import CycleContext, Plugin
+
+
+class ReservationPlugin(Plugin):
+    name = "Reservation"
+
+    def __init__(self) -> None:
+        self.by_name: Dict[str, Reservation] = {}
+        self.by_node: Dict[str, List[str]] = {}
+        self._store: Optional[ObjectStore] = None
+
+    def register(self, store: ObjectStore) -> None:
+        self._store = store
+        store.subscribe(KIND_RESERVATION, self._on_reservation)
+
+    def _on_reservation(self, ev: EventType, res: Reservation, old) -> None:
+        key = res.meta.name
+        if ev is EventType.DELETED:
+            prev = self.by_name.pop(key, None)
+            if prev and prev.node_name:
+                nodes = self.by_node.get(prev.node_name, [])
+                if key in nodes:
+                    nodes.remove(key)
+            return
+        prev = self.by_name.get(key)
+        if prev and prev.node_name and prev.node_name != res.node_name:
+            nodes = self.by_node.get(prev.node_name, [])
+            if key in nodes:
+                nodes.remove(key)
+        self.by_name[key] = res
+        if res.node_name:
+            nodes = self.by_node.setdefault(res.node_name, [])
+            if key not in nodes:
+                nodes.append(key)
+
+    # -- nomination (nominator.go analog) -----------------------------------
+    def nominate(self, pod: Pod, now: float) -> Optional[Reservation]:
+        """Pick the matching Available reservation with enough free resources;
+        earliest-created wins (deterministic)."""
+        candidates = []
+        req = pod.spec.requests
+        for res in self.by_name.values():
+            if not res.is_available or res.is_expired(now):
+                continue
+            if res.allocate_once and res.current_owners:
+                continue
+            if not res.matches(pod):
+                continue
+            free = res.allocatable.sub(res.allocated)
+            if any(req[r] > free[r] for r in req):
+                continue
+            candidates.append(res)
+        if not candidates:
+            return None
+        candidates.sort(key=lambda r: (r.meta.creation_timestamp, r.meta.name))
+        return candidates[0]
+
+    def consume(self, pod: Pod, res: Reservation, ctx: CycleContext) -> None:
+        res.allocated = res.allocated.add(pod.spec.requests)
+        res.current_owners.append(pod.meta.key)
+        ctx.data.setdefault("reservation_of", {})[pod.meta.key] = res.meta.name
+        if self._store is not None:
+            self._store.update(KIND_RESERVATION, res)
+
+    def unreserve(self, pod: Pod, node_name: str, ctx: CycleContext) -> None:
+        res_name = ctx.data.get("reservation_of", {}).pop(pod.meta.key, None)
+        if res_name and res_name in self.by_name:
+            res = self.by_name[res_name]
+            res.allocated = res.allocated.sub(pod.spec.requests)
+            if pod.meta.key in res.current_owners:
+                res.current_owners.remove(pod.meta.key)
+
+    def pre_bind(self, pod: Pod, node_name: str, ctx: CycleContext,
+                 annotations: Dict[str, str]) -> None:
+        res_name = ctx.data.get("reservation_of", {}).get(pod.meta.key)
+        if res_name:
+            annotations[ANNOTATION_RESERVATION_ALLOCATED] = res_name
+
+    # -- GC controller (controller/controller.go analog) --------------------
+    def expire_reservations(self, now: Optional[float] = None) -> List[str]:
+        """Mark expired reservations Failed; returns expired names."""
+        now = time.time() if now is None else now
+        expired = []
+        for res in self.by_name.values():
+            if res.phase in ("Pending", "Available") and res.is_expired(now):
+                res.phase = "Failed"
+                expired.append(res.meta.name)
+                if self._store is not None:
+                    self._store.update(KIND_RESERVATION, res)
+        return expired

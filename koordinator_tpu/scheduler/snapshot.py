@@ -236,8 +236,9 @@ def build_full_chain_inputs(
                 LABEL_NUMA_TOPOLOGY_POLICY, topo_cr.kubelet_cpu_manager_policy
             )
             numa_policy[i] = POLICY_BY_NAME.get(policy_name, POLICY_NONE)
-            for zone in topo_cr.zones[:MAX_NUMA]:
-                numa_capacity[i, zone.numa_id] = zone.allocatable.to_vector()
+            for zone in topo_cr.zones:
+                if 0 <= zone.numa_id < MAX_NUMA:
+                    numa_capacity[i, zone.numa_id] = zone.allocatable.to_vector()
             alloc = state.numa_allocated.get(name)
             numa_free[i] = numa_capacity[i] - (alloc if alloc is not None else 0.0)
             cpu_state = state.cpu_states.get(name)

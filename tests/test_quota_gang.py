@@ -83,6 +83,9 @@ class TestWaterFilling:
             ([40000, 40000], [100000, 5000], [1000, 1000], 100000),
             ([10000], [5000], [1000], 100000),
             ([30000, 30000, 30000, 30000], [90000, 10000, 50000, 0], [3000, 1000, 2000, 1000], 120000),
+            # zero-delta sibling: A's huge weight rounds B's round-1 delta to 0;
+            # B must still receive A's recycled overshoot in round 2
+            ([0, 0], [10, 100], [100000, 1], 50),
         ],
     )
     def test_single_parent_matches_scalar(self, mins, requests, weights, total):

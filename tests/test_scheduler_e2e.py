@@ -1,0 +1,303 @@
+"""End-to-end scheduler tests: store -> cycles -> bindings + annotations.
+
+The scheduler-framework harness tier of the reference's test strategy
+(SURVEY.md section 4): full Filter/Score cycles in-process against the fake
+store, including reservations, cpuset allocation, gangs, and quota admission."""
+
+import json
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.objects import (
+    ANNOTATION_DEVICE_ALLOCATED,
+    ANNOTATION_RESERVATION_ALLOCATED,
+    ANNOTATION_RESOURCE_STATUS,
+    LABEL_POD_GROUP,
+    LABEL_POD_QOS,
+    LABEL_QUOTA_NAME,
+    Device,
+    DeviceInfo,
+    Node,
+    NodeMetric,
+    NodeMetricInfo,
+    NodeResourceTopology,
+    NUMAZone,
+    ObjectMeta,
+    Pod,
+    PodGroup,
+    PodSpec,
+    Reservation,
+    ReservationOwner,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client.store import (
+    KIND_DEVICE,
+    KIND_ELASTIC_QUOTA,
+    KIND_NODE,
+    KIND_NODE_METRIC,
+    KIND_NODE_TOPOLOGY,
+    KIND_POD,
+    KIND_POD_GROUP,
+    KIND_RESERVATION,
+    ObjectStore,
+)
+from koordinator_tpu.scheduler.cpu_topology import CPUTopology
+from koordinator_tpu.scheduler.cycle import Scheduler
+
+GIB = 1024**3
+NOW = 1_000_000.0
+
+
+def make_store(num_nodes=4, cores=16, mem_gib=64, with_topology=True,
+               with_metrics=True):
+    store = ObjectStore()
+    for i in range(num_nodes):
+        store.add(
+            KIND_NODE,
+            Node(
+                meta=ObjectMeta(name=f"node-{i}", namespace=""),
+                allocatable=ResourceList.of(
+                    cpu=cores * 1000, memory=mem_gib * GIB, pods=110
+                ),
+            ),
+        )
+        if with_metrics:
+            store.add(
+                KIND_NODE_METRIC,
+                NodeMetric(
+                    meta=ObjectMeta(name=f"node-{i}", namespace=""),
+                    update_time=NOW - 10,
+                    node_metric=NodeMetricInfo(
+                        node_usage=ResourceList.of(cpu=1000, memory=2 * GIB)
+                    ),
+                ),
+            )
+        if with_topology:
+            topo = CPUTopology.build(1, 2, cores // 4, 2)
+            store.add(
+                KIND_NODE_TOPOLOGY,
+                NodeResourceTopology(
+                    meta=ObjectMeta(name=f"node-{i}", namespace=""),
+                    cpus=topo.cpus,
+                    zones=[
+                        NUMAZone(
+                            numa_id=k,
+                            allocatable=ResourceList.of(
+                                cpu=cores * 500, memory=mem_gib * GIB // 2
+                            ),
+                        )
+                        for k in range(2)
+                    ],
+                ),
+            )
+    return store
+
+
+def pend_pod(store, name, cpu=1000, mem=GIB, qos="LS", prio=9500, labels=None):
+    pod = Pod(
+        meta=ObjectMeta(
+            name=name, labels={LABEL_POD_QOS: qos, **(labels or {})},
+            creation_timestamp=NOW,
+        ),
+        spec=PodSpec(priority=prio, requests=ResourceList.of(cpu=cpu, memory=mem)),
+    )
+    store.add(KIND_POD, pod)
+    return pod
+
+
+class TestSchedulerE2E:
+    def test_basic_binding(self):
+        store = make_store()
+        sched = Scheduler(store)
+        for i in range(8):
+            pend_pod(store, f"p{i}")
+        result = sched.run_cycle(now=NOW)
+        assert len(result.bound) == 8
+        for pod in store.list(KIND_POD):
+            assert pod.spec.node_name.startswith("node-")
+
+    def test_spreading_by_load(self):
+        store = make_store(num_nodes=4)
+        sched = Scheduler(store)
+        for i in range(8):
+            pend_pod(store, f"p{i}", cpu=4000, mem=8 * GIB)
+        sched.run_cycle(now=NOW)
+        per_node = {}
+        for pod in store.list(KIND_POD):
+            per_node[pod.spec.node_name] = per_node.get(pod.spec.node_name, 0) + 1
+        assert len(per_node) == 4  # least-allocated spreads
+
+    def test_lsr_pod_gets_cpuset_annotation(self):
+        store = make_store()
+        sched = Scheduler(store)
+        pend_pod(store, "lsr-pod", cpu=4000, qos="LSR")
+        result = sched.run_cycle(now=NOW)
+        assert len(result.bound) == 1
+        pod = store.list(KIND_POD)[0]
+        status = json.loads(pod.meta.annotations[ANNOTATION_RESOURCE_STATUS])
+        from koordinator_tpu.utils.cpuset import CPUSet
+
+        cpus = CPUSet.parse(status["cpuset"])
+        assert len(cpus) == 4
+
+    def test_second_cycle_sees_first_assignments(self):
+        store = make_store(num_nodes=2, cores=8, mem_gib=16)
+        sched = Scheduler(store)
+        pend_pod(store, "a", cpu=6000, mem=12 * GIB)
+        sched.run_cycle(now=NOW)
+        pend_pod(store, "b", cpu=6000, mem=12 * GIB)
+        sched.run_cycle(now=NOW)
+        nodes = {p.spec.node_name for p in store.list(KIND_POD)}
+        assert len(nodes) == 2  # b cannot fit next to a
+
+    def test_unschedulable_pod_stays_pending(self):
+        store = make_store(num_nodes=1, cores=4, mem_gib=8)
+        sched = Scheduler(store)
+        pend_pod(store, "huge", cpu=64000, mem=256 * GIB)
+        result = sched.run_cycle(now=NOW)
+        assert result.bound == []
+        assert "default/huge" in result.failed
+        assert store.list(KIND_POD)[0].spec.node_name == ""
+
+    def test_reservation_lifecycle(self):
+        store = make_store(num_nodes=2, cores=8, mem_gib=16)
+        sched = Scheduler(store)
+        store.add(
+            KIND_RESERVATION,
+            Reservation(
+                meta=ObjectMeta(name="resv-web", namespace="",
+                                creation_timestamp=NOW),
+                template=PodSpec(
+                    priority=9500,
+                    requests=ResourceList.of(cpu=6000, memory=12 * GIB),
+                ),
+                owners=[ReservationOwner(label_selector={"app": "web"})],
+                allocate_once=True,
+            ),
+        )
+        # cycle 1: reservation gets scheduled and becomes Available
+        r1 = sched.run_cycle(now=NOW)
+        res = store.list(KIND_RESERVATION)[0]
+        assert res.phase == "Available"
+        assert res.node_name
+        reserved_node = res.node_name
+
+        # filler pods cannot take the reserved capacity
+        for i in range(2):
+            pend_pod(store, f"filler-{i}", cpu=6000, mem=12 * GIB)
+        sched.run_cycle(now=NOW)
+        fillers = [p for p in store.list(KIND_POD) if "filler" in p.meta.name]
+        assert all(p.spec.node_name != reserved_node for p in fillers if p.is_assigned)
+
+        # the owner pod consumes the reservation on its node
+        pend_pod(store, "web-pod", cpu=6000, mem=12 * GIB,
+                 labels={"app": "web"})
+        sched.run_cycle(now=NOW)
+        web = next(p for p in store.list(KIND_POD) if p.meta.name == "web-pod")
+        assert web.spec.node_name == reserved_node
+        assert web.meta.annotations[ANNOTATION_RESERVATION_ALLOCATED] == "resv-web"
+        res = store.list(KIND_RESERVATION)[0]
+        assert "default/web-pod" in res.current_owners
+
+    def test_reservation_expiry(self):
+        store = make_store(num_nodes=1)
+        sched = Scheduler(store)
+        store.add(
+            KIND_RESERVATION,
+            Reservation(
+                meta=ObjectMeta(name="resv-old", namespace="",
+                                creation_timestamp=NOW - 500),
+                template=PodSpec(requests=ResourceList.of(cpu=1000, memory=GIB)),
+                owners=[ReservationOwner(label_selector={"app": "x"})],
+                ttl_seconds=100,
+            ),
+        )
+        sched.run_cycle(now=NOW)
+        assert store.list(KIND_RESERVATION)[0].phase == "Failed"
+
+    def test_gang_waits_for_min_member(self):
+        store = make_store(num_nodes=2, cores=8, mem_gib=16)
+        sched = Scheduler(store)
+        store.add(
+            KIND_POD_GROUP,
+            PodGroup(meta=ObjectMeta(name="g1", namespace="default"),
+                     min_member=3),
+        )
+        for i in range(2):  # only 2 of 3 members exist -> gang invalid
+            pend_pod(store, f"gang-{i}", cpu=1000,
+                     labels={LABEL_POD_GROUP: "g1"})
+        result = sched.run_cycle(now=NOW)
+        assert len(result.bound) == 0
+        # third member arrives -> whole gang schedules
+        pend_pod(store, "gang-2", cpu=1000, labels={LABEL_POD_GROUP: "g1"})
+        result = sched.run_cycle(now=NOW)
+        assert len(result.bound) == 3
+        pg = store.list(KIND_POD_GROUP)[0]
+        assert pg.phase == "Scheduled"
+
+    def test_quota_admission_blocks_overuse(self):
+        from koordinator_tpu.api.objects import ElasticQuota
+
+        store = make_store(num_nodes=4)
+        sched = Scheduler(store)
+        store.add(
+            KIND_ELASTIC_QUOTA,
+            ElasticQuota(
+                meta=ObjectMeta(name="small-q", namespace="default"),
+                min=ResourceList.of(cpu=0),
+                max=ResourceList.of(cpu=2000, memory=4 * GIB),
+            ),
+        )
+        for i in range(4):
+            pend_pod(store, f"q-{i}", cpu=1000, mem=GIB,
+                     labels={LABEL_QUOTA_NAME: "small-q"})
+        result = sched.run_cycle(now=NOW)
+        assert len(result.bound) == 2  # max cpu 2000 admits exactly 2
+        assert len(result.rejected) == 2
+
+    def test_gpu_pod_gets_device_annotation(self):
+        store = make_store(num_nodes=1)
+        node = store.list(KIND_NODE)[0]
+        node.allocatable = node.allocatable.add(
+            ResourceList.of(gpu_core=200, gpu_memory=32 * GIB, gpu_memory_ratio=200)
+        )
+        store.update(KIND_NODE, node)
+        store.add(
+            KIND_DEVICE,
+            Device(
+                meta=ObjectMeta(name="node-0", namespace=""),
+                devices=[
+                    DeviceInfo(type="gpu", minor=0,
+                               resources=ResourceList.of(gpu_core=100)),
+                    DeviceInfo(type="gpu", minor=1,
+                               resources=ResourceList.of(gpu_core=100)),
+                ],
+            ),
+        )
+        sched = Scheduler(store)
+        pod = Pod(
+            meta=ObjectMeta(name="gpu-pod", labels={LABEL_POD_QOS: "LS"},
+                            creation_timestamp=NOW),
+            spec=PodSpec(
+                priority=9500,
+                requests=ResourceList.of(
+                    cpu=1000, memory=GIB, gpu_core=50, gpu_memory_ratio=50
+                ),
+            ),
+        )
+        store.add(KIND_POD, pod)
+        result = sched.run_cycle(now=NOW)
+        assert len(result.bound) == 1
+        alloc = json.loads(
+            store.list(KIND_POD)[0].meta.annotations[ANNOTATION_DEVICE_ALLOCATED]
+        )
+        assert alloc["gpu"][0]["core"] == 50
+
+    def test_monitor_records_cycles(self):
+        store = make_store(num_nodes=1)
+        sched = Scheduler(store)
+        pend_pod(store, "p")
+        sched.run_cycle(now=NOW)
+        assert len(sched.extender.monitor.history) == 1
+        assert sched.extender.monitor.slow_cycles == 0
