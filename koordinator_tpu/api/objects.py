@@ -453,6 +453,12 @@ class ClusterColocationProfile:
     annotations: Dict[str, str] = field(default_factory=dict)
 
 
+@dataclass
+class ConfigMap:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+
+
 # ---------------------------------------------------------------------------
 # ElasticQuotaProfile CR (pkg/quota-controller/profile)
 # ---------------------------------------------------------------------------

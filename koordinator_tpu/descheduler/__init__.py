@@ -1,0 +1,11 @@
+"""koord-descheduler (analog of reference `pkg/descheduler/`, SURVEY.md 2.5):
+profile-driven Deschedule/Balance plugin runner, the LowNodeLoad utilization
+balancer (vectorized node classification + the scheduler's score-matrix kernel
+for target selection), and the arbitration-gated MigrationController."""
+
+from koordinator_tpu.descheduler.lownodeload import LowNodeLoad  # noqa: F401
+from koordinator_tpu.descheduler.migration import (  # noqa: F401
+    Arbitrator,
+    MigrationController,
+)
+from koordinator_tpu.descheduler.descheduler import Descheduler  # noqa: F401

@@ -1,0 +1,30 @@
+"""Descheduler profile runner.
+
+Analog of reference `pkg/descheduler/descheduler.go` + `framework/types.go:76-96`
+(DeschedulePlugin/BalancePlugin interfaces + profiles): runs registered balance
+plugins each interval, then drives the migration controller."""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from koordinator_tpu.client.store import ObjectStore
+from koordinator_tpu.descheduler.lownodeload import LowNodeLoad, LowNodeLoadArgs
+from koordinator_tpu.descheduler.migration import MigrationController
+
+
+class Descheduler:
+    def __init__(self, store: ObjectStore,
+                 low_node_load_args: Optional[LowNodeLoadArgs] = None):
+        self.store = store
+        self.balance_plugins = [LowNodeLoad(store, low_node_load_args)]
+        self.migration = MigrationController(store)
+
+    def run_once(self, now: Optional[float] = None) -> dict:
+        now = time.time() if now is None else now
+        jobs = []
+        for plugin in self.balance_plugins:
+            jobs.extend(plugin.balance(now))
+        transitions = self.migration.reconcile(now)
+        return {"jobs_created": len(jobs), "migration_transitions": transitions}

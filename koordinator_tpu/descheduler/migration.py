@@ -1,0 +1,183 @@
+"""Migration controller + arbitrator.
+
+Analog of reference `pkg/descheduler/controllers/migration/`:
+  * Arbitrator (arbitrator/arbitrator.go:46-200): sorts pending jobs (creation
+    time) and filters by blast-radius rate limits — max concurrent migrations
+    per node / namespace / workload owner.
+  * Reconciler (controller.go:241-383): per job, ReservationFirst mode creates
+    a Reservation for the victim's replacement, waits for it to be scheduled
+    (Available), then evicts the victim; EvictDirectly skips the reserve leg.
+    Jobs expire after their TTL.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from koordinator_tpu.api.objects import (
+    ObjectMeta,
+    Pod,
+    PodMigrationJob,
+    PodSpec,
+    Reservation,
+    ReservationOwner,
+)
+from koordinator_tpu.client.store import (
+    KIND_POD,
+    KIND_POD_MIGRATION_JOB,
+    KIND_RESERVATION,
+    ObjectStore,
+)
+
+
+@dataclass
+class ArbitratorArgs:
+    max_migrating_per_node: int = 2
+    max_migrating_per_namespace: int = 10
+    max_migrating_per_workload: int = 1
+
+
+class Arbitrator:
+    def __init__(self, store: ObjectStore, args: Optional[ArbitratorArgs] = None):
+        self.store = store
+        self.args = args or ArbitratorArgs()
+
+    def arbitrate(self, jobs: List[PodMigrationJob]) -> List[PodMigrationJob]:
+        """Sort + rate-limit filter; returns the admitted subset in order."""
+        running = [
+            j for j in self.store.list(KIND_POD_MIGRATION_JOB)
+            if j.phase == "Running"
+        ]
+        per_node: Dict[str, int] = {}
+        per_ns: Dict[str, int] = {}
+        per_workload: Dict[str, int] = {}
+
+        def pod_of(job: PodMigrationJob) -> Optional[Pod]:
+            return self.store.get(KIND_POD, f"{job.pod_namespace}/{job.pod_name}")
+
+        for j in running:
+            pod = pod_of(j)
+            if pod is None:
+                continue
+            per_node[pod.spec.node_name] = per_node.get(pod.spec.node_name, 0) + 1
+            per_ns[pod.meta.namespace] = per_ns.get(pod.meta.namespace, 0) + 1
+            wl = f"{pod.meta.owner_kind}/{pod.meta.owner_name}"
+            per_workload[wl] = per_workload.get(wl, 0) + 1
+
+        admitted: List[PodMigrationJob] = []
+        for job in sorted(jobs, key=lambda j: (j.meta.creation_timestamp, j.meta.key)):
+            pod = pod_of(job)
+            if pod is None or not pod.is_assigned or pod.is_terminated:
+                continue
+            node = pod.spec.node_name
+            ns = pod.meta.namespace
+            wl = f"{pod.meta.owner_kind}/{pod.meta.owner_name}"
+            if per_node.get(node, 0) >= self.args.max_migrating_per_node:
+                continue
+            if per_ns.get(ns, 0) >= self.args.max_migrating_per_namespace:
+                continue
+            if pod.meta.owner_kind and per_workload.get(wl, 0) >= self.args.max_migrating_per_workload:
+                continue
+            per_node[node] = per_node.get(node, 0) + 1
+            per_ns[ns] = per_ns.get(ns, 0) + 1
+            per_workload[wl] = per_workload.get(wl, 0) + 1
+            admitted.append(job)
+        return admitted
+
+
+class MigrationController:
+    def __init__(self, store: ObjectStore, arbitrator: Optional[Arbitrator] = None):
+        self.store = store
+        self.arbitrator = arbitrator or Arbitrator(store)
+
+    def reconcile(self, now: Optional[float] = None) -> int:
+        """One pass over migration jobs; returns state transitions."""
+        now = time.time() if now is None else now
+        changes = 0
+        pending = [
+            j for j in self.store.list(KIND_POD_MIGRATION_JOB)
+            if j.phase == "Pending"
+        ]
+        for job in self.arbitrator.arbitrate(pending):
+            job.phase = "Running"
+            self.store.update(KIND_POD_MIGRATION_JOB, job)
+            changes += 1
+
+        for job in self.store.list(KIND_POD_MIGRATION_JOB):
+            if job.phase != "Running":
+                continue
+            if now - job.meta.creation_timestamp > job.ttl_seconds:
+                job.phase = "Failed"
+                job.message = "timeout"
+                self.store.update(KIND_POD_MIGRATION_JOB, job)
+                changes += 1
+                continue
+            pod = self.store.get(KIND_POD, f"{job.pod_namespace}/{job.pod_name}")
+            if pod is None or not pod.is_assigned or pod.is_terminated:
+                job.phase = "Succeeded" if pod is None or pod.is_terminated else job.phase
+                self.store.update(KIND_POD_MIGRATION_JOB, job)
+                changes += 1
+                continue
+            if job.mode == "ReservationFirst":
+                changes += self._reserve_then_evict(job, pod, now)
+            else:
+                self._evict(pod, job)
+                job.phase = "Succeeded"
+                self.store.update(KIND_POD_MIGRATION_JOB, job)
+                changes += 1
+        return changes
+
+    def _reserve_then_evict(self, job: PodMigrationJob, pod: Pod, now: float) -> int:
+        if not job.reservation_name:
+            # create the replacement reservation (controller.go:763-846)
+            res = Reservation(
+                meta=ObjectMeta(
+                    name=f"migrate-{pod.meta.namespace}-{pod.meta.name}",
+                    namespace="",
+                    creation_timestamp=now,
+                ),
+                template=PodSpec(
+                    priority=pod.spec.priority,
+                    requests=pod.spec.requests.copy(),
+                ),
+                owners=[
+                    ReservationOwner(
+                        controller_kind=pod.meta.owner_kind,
+                        controller_name=pod.meta.owner_name,
+                        namespace=pod.meta.namespace,
+                    )
+                    if pod.meta.owner_kind
+                    else ReservationOwner(label_selector=dict(pod.meta.labels))
+                ],
+                ttl_seconds=job.ttl_seconds,
+            )
+            if self.store.get(KIND_RESERVATION, res.meta.key) is None:
+                self.store.add(KIND_RESERVATION, res)
+            job.reservation_name = res.meta.name
+            self.store.update(KIND_POD_MIGRATION_JOB, job)
+            return 1
+        res = self.store.get(KIND_RESERVATION, f"/{job.reservation_name}")
+        if res is None or res.phase == "Failed":
+            job.phase = "Failed"
+            job.message = "reservation failed or lost"
+            self.store.update(KIND_POD_MIGRATION_JOB, job)
+            return 1
+        if not res.is_available:
+            return 0  # wait for the scheduler to bind the reservation
+        # replacement capacity secured away from the source -> evict
+        if res.node_name == pod.spec.node_name:
+            job.phase = "Failed"
+            job.message = "reservation landed on the source node"
+            self.store.update(KIND_POD_MIGRATION_JOB, job)
+            return 1
+        self._evict(pod, job)
+        job.phase = "Succeeded"
+        self.store.update(KIND_POD_MIGRATION_JOB, job)
+        return 1
+
+    def _evict(self, pod: Pod, job: PodMigrationJob) -> None:
+        pod.phase = "Failed"
+        pod.meta.annotations["koordinator.sh/evicted"] = f"migration/{job.meta.name}"
+        self.store.update(KIND_POD, pod)

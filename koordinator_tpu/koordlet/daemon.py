@@ -1,0 +1,76 @@
+"""koordlet Daemon: module wiring + run loop.
+
+Analog of reference `pkg/koordlet/koordlet.go:70-188`: NewDaemon builds
+executor -> metriccache -> statesinformer -> metricsadvisor -> prediction ->
+qosmanager -> runtimehooks; Run starts them in dependency order. `run_once(now)`
+drives one tick of everything (tests and the driver call it directly; `run`
+loops it on an interval)."""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from koordinator_tpu.client.store import ObjectStore
+from koordinator_tpu.koordlet.audit import Auditor
+from koordinator_tpu.koordlet.metriccache import MetricCache
+from koordinator_tpu.koordlet.metricsadvisor import MetricsAdvisor
+from koordinator_tpu.koordlet.pleg import Pleg
+from koordinator_tpu.koordlet.prediction import PeakPredictServer
+from koordinator_tpu.koordlet.qosmanager import QoSManager
+from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
+from koordinator_tpu.koordlet.runtimehooks import RuntimeHooks
+from koordinator_tpu.koordlet.statesinformer import StatesInformer
+from koordinator_tpu.koordlet.util import system as sysutil
+from koordinator_tpu.koordlet import metriccache as mc
+
+
+class Daemon:
+    def __init__(self, store: ObjectStore, node_name: str,
+                 config: Optional[sysutil.SystemConfig] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 report_interval_seconds: int = 60):
+        self.config = config or sysutil.CONFIG
+        self.auditor = Auditor()
+        self.executor = ResourceUpdateExecutor(self.config, self.auditor)
+        self.metric_cache = MetricCache()
+        self.states_informer = StatesInformer(
+            store, node_name, self.metric_cache,
+            report_interval_seconds=report_interval_seconds,
+        )
+        self.metrics_advisor = MetricsAdvisor(
+            self.states_informer, self.metric_cache, self.config
+        )
+        self.prediction = PeakPredictServer(checkpoint_dir)
+        self.qos_manager = QoSManager(
+            store, self.states_informer, self.metric_cache, self.executor
+        )
+        self.runtime_hooks = RuntimeHooks(self.states_informer, self.executor)
+        self.pleg = Pleg(self.config)
+
+    def run_once(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        self.pleg.tick()
+        self.metrics_advisor.collect_once(now)
+        for pod in self.states_informer.get_all_pods():
+            cpu = self.metric_cache.query(
+                mc.POD_CPU_USAGE, "latest", now=now, pod=pod.meta.key
+            )
+            mem = self.metric_cache.query(
+                mc.POD_MEMORY_USAGE, "latest", now=now, pod=pod.meta.key
+            )
+            if cpu is not None or mem is not None:
+                self.prediction.update(
+                    pod.meta.uid or pod.meta.key, cpu or 0.0, mem or 0.0, now
+                )
+        self.states_informer.sync_node_metric(now)
+        self.qos_manager.run_once(now)
+        self.runtime_hooks.reconcile()
+
+    def run(self, interval_seconds: float = 10.0, max_ticks: Optional[int] = None) -> None:
+        ticks = 0
+        while max_ticks is None or ticks < max_ticks:
+            self.run_once()
+            self.prediction.checkpoint()
+            ticks += 1
+            time.sleep(interval_seconds)

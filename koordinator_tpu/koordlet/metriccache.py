@@ -1,0 +1,110 @@
+"""Metric cache: the node-local TSDB + static-info KV store.
+
+Analog of reference `pkg/koordlet/metriccache/` (embedded Prometheus tsdb + gob
+KV, metric_cache.go:56-79): time-series keyed by (metric, labels) with windowed
+aggregate queries (avg/p50/p90/p95/p99/latest/count), bounded retention.
+Numpy-backed percentile math so the NodeMetric reporter's aggregated usages are
+consistent with the scheduler's percentile semantics.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Deque, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+# canonical metric names (metric_resources.go)
+NODE_CPU_USAGE = "node_cpu_usage"            # cores
+NODE_MEMORY_USAGE = "node_memory_usage"      # bytes
+POD_CPU_USAGE = "pod_cpu_usage"
+POD_MEMORY_USAGE = "pod_memory_usage"
+CONTAINER_CPU_USAGE = "container_cpu_usage"
+CONTAINER_MEMORY_USAGE = "container_memory_usage"
+BE_CPU_USAGE = "be_cpu_usage"
+SYS_CPU_USAGE = "sys_cpu_usage"
+NODE_CPU_PSI_FULL_AVG10 = "node_cpu_psi_full_avg10"
+NODE_MEM_PSI_FULL_AVG10 = "node_mem_psi_full_avg10"
+POD_CPI = "pod_cpi"
+HOST_APP_CPU_USAGE = "host_app_cpu_usage"
+
+NODE_CPU_INFO_KEY = "node_cpu_info"
+NODE_NUMA_INFO_KEY = "node_numa_info"
+
+
+@dataclass(frozen=True)
+class SeriesKey:
+    metric: str
+    labels: Tuple[Tuple[str, str], ...] = ()
+
+    @staticmethod
+    def of(metric: str, **labels: str) -> "SeriesKey":
+        return SeriesKey(metric, tuple(sorted(labels.items())))
+
+
+class MetricCache:
+    def __init__(self, retention_seconds: float = 1800.0):
+        self.retention = retention_seconds
+        self._lock = threading.RLock()
+        self._series: Dict[SeriesKey, Deque[Tuple[float, float]]] = {}
+        self._kv: Dict[str, Any] = {}
+
+    # -- samples -------------------------------------------------------------
+    def add_sample(self, metric: str, value: float,
+                   timestamp: Optional[float] = None, **labels: str) -> None:
+        ts = time.time() if timestamp is None else timestamp
+        key = SeriesKey.of(metric, **labels)
+        with self._lock:
+            q = self._series.setdefault(key, deque())
+            q.append((ts, float(value)))
+            cutoff = ts - self.retention
+            while q and q[0][0] < cutoff:
+                q.popleft()
+
+    def _values(self, metric: str, window: Optional[float], now: Optional[float],
+                **labels: str) -> List[float]:
+        key = SeriesKey.of(metric, **labels)
+        with self._lock:
+            q = self._series.get(key)
+            if not q:
+                return []
+            if window is None:
+                return [v for _, v in q]
+            now = time.time() if now is None else now
+            cutoff = now - window
+            return [v for ts, v in q if ts >= cutoff]
+
+    def query(self, metric: str, agg: str = "latest",
+              window: Optional[float] = None, now: Optional[float] = None,
+              **labels: str) -> Optional[float]:
+        vals = self._values(metric, window, now, **labels)
+        if not vals:
+            return None
+        if agg == "latest":
+            return vals[-1]
+        if agg == "avg":
+            return float(np.mean(vals))
+        if agg == "count":
+            return float(len(vals))
+        if agg.startswith("p") and agg[1:].isdigit():
+            return float(np.percentile(vals, int(agg[1:])))
+        raise ValueError(f"unknown aggregation {agg!r}")
+
+    def series_labels(self, metric: str) -> List[Dict[str, str]]:
+        with self._lock:
+            return [
+                dict(k.labels) for k in self._series if k.metric == metric
+            ]
+
+    # -- KV (static info) ------------------------------------------------------
+    def set_kv(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._kv[key] = value
+
+    def get_kv(self, key: str) -> Optional[Any]:
+        with self._lock:
+            return self._kv.get(key)

@@ -1,0 +1,139 @@
+"""Metrics advisor: the collector framework.
+
+Analog of reference `pkg/koordlet/metricsadvisor/` (framework/plugin.go:25-48 +
+collectors): each collector owns a tick; `collect_once(now)` makes the whole
+advisor drivable from tests and from the Daemon loop alike. Rate metrics (cpu)
+are derived from cumulative counters between ticks, exactly like the cgroup
+cpuacct/proc-stat based collectors in the reference.
+
+Collectors: noderesource, podresource (+containers), beresource, sysresource,
+psi, performance (CPI via the native perf binding when enabled).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.statesinformer import StatesInformer
+from koordinator_tpu.koordlet.util import system as sysutil
+from koordinator_tpu.utils.features import KOORDLET_GATES
+
+
+def pod_qos_dir(pod) -> str:
+    """k8s cgroup QoS class dir for a pod (guaranteed pods sit under kubepods)."""
+    qos = pod.qos_class
+    if qos == QoSClass.BE:
+        return sysutil.QOS_BESTEFFORT
+    if not pod.spec.requests or pod.spec.requests != pod.spec.limits:
+        return sysutil.QOS_BURSTABLE
+    return sysutil.QOS_GUARANTEED
+
+
+class MetricsAdvisor:
+    def __init__(self, informer: StatesInformer, cache: mc.MetricCache,
+                 config: Optional[sysutil.SystemConfig] = None):
+        self.informer = informer
+        self.cache = cache
+        self.config = config or sysutil.CONFIG
+        self._last_cpu: Dict[str, tuple] = {}  # key -> (ts, cumulative_ns)
+        self._last_proc: Optional[tuple] = None  # (ts, total, idle)
+        self.perf_reader = None  # set by Daemon when CPICollector enabled
+
+    # -- helpers -------------------------------------------------------------
+    def _cpu_rate(self, key: str, now: float, cumulative_ns: Optional[int]) -> Optional[float]:
+        if cumulative_ns is None:
+            return None
+        prev = self._last_cpu.get(key)
+        self._last_cpu[key] = (now, cumulative_ns)
+        if prev is None or now <= prev[0]:
+            return None
+        return max(0.0, (cumulative_ns - prev[1]) / 1e9 / (now - prev[0]))
+
+    # -- collectors ----------------------------------------------------------
+    def collect_node_resource(self, now: float) -> None:
+        stat = sysutil.read_proc_stat_cpu(self.config)
+        if stat is not None:
+            total, idle = stat
+            prev = self._last_proc
+            self._last_proc = (now, total, idle)
+            if prev is not None and total > prev[1]:
+                busy_frac = 1.0 - (idle - prev[2]) / (total - prev[1])
+                node = self.informer.get_node()
+                cores = (
+                    node.allocatable.get("cpu", 0) / 1000.0 if node else 1.0
+                ) or 1.0
+                self.cache.add_sample(
+                    mc.NODE_CPU_USAGE, busy_frac * cores, now
+                )
+        mem = sysutil.read_meminfo(self.config)
+        if mem:
+            total_b = mem.get("MemTotal", 0)
+            avail = mem.get("MemAvailable", mem.get("MemFree", 0))
+            if total_b:
+                self.cache.add_sample(mc.NODE_MEMORY_USAGE, total_b - avail, now)
+
+    def collect_pod_resource(self, now: float) -> None:
+        for pod in self.informer.get_all_pods():
+            rel = self.config.pod_relative_path(pod_qos_dir(pod), pod.meta.uid or pod.meta.name)
+            cpu_ns = sysutil.read_cpu_usage_ns(rel, self.config)
+            rate = self._cpu_rate(f"pod/{pod.meta.key}", now, cpu_ns)
+            if rate is not None:
+                self.cache.add_sample(mc.POD_CPU_USAGE, rate, now, pod=pod.meta.key)
+            mem_b = sysutil.read_memory_usage_bytes(rel, self.config)
+            if mem_b is not None:
+                self.cache.add_sample(mc.POD_MEMORY_USAGE, mem_b, now, pod=pod.meta.key)
+
+    def collect_be_resource(self, now: float) -> None:
+        rel = self.config.qos_relative_path(sysutil.QOS_BESTEFFORT)
+        cpu_ns = sysutil.read_cpu_usage_ns(rel, self.config)
+        rate = self._cpu_rate("be_root", now, cpu_ns)
+        if rate is not None:
+            self.cache.add_sample(mc.BE_CPU_USAGE, rate, now)
+
+    def collect_sys_resource(self, now: float) -> None:
+        """system usage = node usage - sum(pod usage) (sysresource collector)."""
+        node = self.cache.query(mc.NODE_CPU_USAGE, "latest", now=now)
+        if node is None:
+            return
+        pod_sum = 0.0
+        for labels in self.cache.series_labels(mc.POD_CPU_USAGE):
+            v = self.cache.query(mc.POD_CPU_USAGE, "latest", now=now, **labels)
+            pod_sum += v or 0.0
+        self.cache.add_sample(mc.SYS_CPU_USAGE, max(0.0, node - pod_sum), now)
+
+    def collect_psi(self, now: float) -> None:
+        if not KOORDLET_GATES.enabled("PSICollector"):
+            return
+        psi = sysutil.read_psi("", sysutil.CPU_PRESSURE, self.config)
+        if psi is not None:
+            self.cache.add_sample(mc.NODE_CPU_PSI_FULL_AVG10, psi.full_avg10, now)
+        psi = sysutil.read_psi("", sysutil.MEMORY_PRESSURE, self.config)
+        if psi is not None:
+            self.cache.add_sample(mc.NODE_MEM_PSI_FULL_AVG10, psi.full_avg10, now)
+
+    def collect_performance(self, now: float) -> None:
+        """CPI per pod via the native perf_event binding (performance collector,
+        performance_collector_linux.go:46-101; gated like Libpfm4/CPICollector)."""
+        if not KOORDLET_GATES.enabled("CPICollector") or self.perf_reader is None:
+            return
+        for pod in self.informer.get_all_pods():
+            sample = self.perf_reader(pod)
+            if sample is None:
+                continue
+            cycles, instructions = sample
+            if instructions > 0:
+                self.cache.add_sample(
+                    mc.POD_CPI, cycles / instructions, now, pod=pod.meta.key
+                )
+
+    def collect_once(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        self.collect_node_resource(now)
+        self.collect_pod_resource(now)
+        self.collect_be_resource(now)
+        self.collect_sys_resource(now)
+        self.collect_psi(now)
+        self.collect_performance(now)

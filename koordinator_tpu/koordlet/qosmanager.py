@@ -1,0 +1,334 @@
+"""QoS manager: runtime enforcement strategy plugins.
+
+Analog of reference `pkg/koordlet/qosmanager/` (registry plugins/register.go:36-46):
+each strategy reads statesinformer + metriccache and enforces through the
+resource executor. Implemented strategies:
+
+  * cpusuppress  (plugins/cpusuppress/cpu_suppress.go:240-321, formula :138-164):
+      suppress(BE) = capacity * thresholdPercent - podNonBEUsed - systemUsed
+      applied as the BE root cpuset size (paired HT cores, spread over NUMA) or
+      as cfs quota, with recovery when the policy flips.
+  * cpuevict     (BE eviction when BE cpu satisfaction is below threshold)
+  * memoryevict  (BE eviction when node memory utilization crosses threshold)
+  * cpuburst     (cfs burst for LS containers, plugins/cpuburst/)
+  * resctrl      (LLC ways / MBA percent per QoS class via resctrl fs)
+  * cgreconcile  (cpu.shares / memory guarantees per QoS cgroup)
+
+An `Evictor` mirrors the shared eviction helper (framework/context.go:42-90):
+victims sorted BE-first by priority then usage.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from koordinator_tpu.api.objects import NodeSLO, Pod
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.client.store import KIND_POD, ObjectStore
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.metricsadvisor import pod_qos_dir
+from koordinator_tpu.koordlet.resourceexecutor import (
+    ResourceUpdateExecutor,
+    ResourceUpdater,
+)
+from koordinator_tpu.koordlet.statesinformer import StatesInformer
+from koordinator_tpu.koordlet.util import system as sysutil
+from koordinator_tpu.utils.cpuset import CPUSet
+from koordinator_tpu.utils.features import KOORDLET_GATES
+
+
+class Evictor:
+    """Shared BE eviction helper (qosmanager/framework/context.go:42-90)."""
+
+    def __init__(self, store: ObjectStore, informer: StatesInformer,
+                 cache: mc.MetricCache):
+        self.store = store
+        self.informer = informer
+        self.cache = cache
+        self.evicted: List[str] = []
+
+    def be_victims_by_usage(self) -> List[Pod]:
+        pods = [
+            p for p in self.informer.get_all_pods()
+            if p.qos_class == QoSClass.BE
+        ]
+
+        def usage(p: Pod) -> float:
+            return self.cache.query(mc.POD_CPU_USAGE, "latest", pod=p.meta.key) or 0.0
+
+        # lowest priority first, then highest usage (framework helper sort)
+        return sorted(pods, key=lambda p: ((p.spec.priority or 0), -usage(p)))
+
+    def evict(self, pod: Pod, reason: str) -> None:
+        pod.phase = "Failed"
+        pod.meta.annotations["koordinator.sh/evicted"] = reason
+        self.store.update(KIND_POD, pod)
+        self.evicted.append(pod.meta.key)
+
+
+@dataclass
+class QOSStrategyContext:
+    informer: StatesInformer
+    cache: mc.MetricCache
+    executor: ResourceUpdateExecutor
+    evictor: Evictor
+    metric_collect_interval: float = 60.0
+
+
+class CPUSuppress:
+    """BE cpu suppression (cpusuppress plugin)."""
+
+    name = "cpusuppress"
+    MIN_SUPPRESS_CPUS = 2  # reference beMinCPU
+
+    def __init__(self, ctx: QOSStrategyContext):
+        self.ctx = ctx
+        self.policy_in_use: Optional[str] = None
+
+    def _suppress_cpus(self, slo: NodeSLO, now: float) -> Optional[float]:
+        node = self.ctx.informer.get_node()
+        if node is None:
+            return None
+        threshold = slo.resource_used_threshold_with_be.cpu_suppress_threshold_percent
+        capacity = node.allocatable.get("cpu", 0) / 1000.0
+        node_usage = self.ctx.cache.query(
+            mc.NODE_CPU_USAGE, "latest", self.ctx.metric_collect_interval, now
+        )
+        if node_usage is None:
+            return None
+        # podNonBEUsed + systemUsed = nodeUsage - BE usage
+        be_usage = self.ctx.cache.query(
+            mc.BE_CPU_USAGE, "latest", self.ctx.metric_collect_interval, now
+        ) or 0.0
+        non_be_used = max(0.0, node_usage - be_usage)
+        suppress = capacity * threshold / 100.0 - non_be_used
+        return max(suppress, float(self.MIN_SUPPRESS_CPUS))
+
+    def run(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        slo = self.ctx.informer.get_node_slo()
+        be_rel = self.ctx.executor.config.qos_relative_path(sysutil.QOS_BESTEFFORT)
+        if not (KOORDLET_GATES.enabled("BECPUSuppress")
+                and slo.resource_used_threshold_with_be.enable):
+            self._recover(be_rel)
+            return
+        suppress = self._suppress_cpus(slo, now)
+        if suppress is None:
+            return
+        node = self.ctx.informer.get_node()
+        total_cpus = int((node.allocatable.get("cpu", 0)) // 1000) if node else 0
+        if slo.resource_used_threshold_with_be.cpu_suppress_policy == "cfsQuota":
+            period = 100000
+            quota = max(int(suppress * period), period // 100)
+            self.ctx.executor.update(
+                ResourceUpdater(be_rel, sysutil.CPU_CFS_QUOTA, str(quota))
+            )
+            self.policy_in_use = "cfsQuota"
+        else:
+            # cpuset policy: round up, at least 2, paired HT cores from the top
+            want = min(max(int(math.ceil(suppress)), self.MIN_SUPPRESS_CPUS),
+                       max(total_cpus, self.MIN_SUPPRESS_CPUS))
+            cpus = CPUSet(range(want))  # cpu ids 0..want-1 (paired cores first)
+            self.ctx.executor.update(
+                ResourceUpdater(be_rel, sysutil.CPUSET_CPUS, cpus.format())
+            )
+            self.policy_in_use = "cpuset"
+
+    def _recover(self, be_rel: str) -> None:
+        if self.policy_in_use == "cfsQuota":
+            self.ctx.executor.update(
+                ResourceUpdater(be_rel, sysutil.CPU_CFS_QUOTA, "-1")
+            )
+        elif self.policy_in_use == "cpuset":
+            node = self.ctx.informer.get_node()
+            if node is not None:
+                total = int(node.allocatable.get("cpu", 0) // 1000)
+                if total:
+                    self.ctx.executor.update(
+                        ResourceUpdater(
+                            be_rel, sysutil.CPUSET_CPUS,
+                            CPUSet(range(total)).format(),
+                        )
+                    )
+        self.policy_in_use = None
+
+
+class CPUEvict:
+    """Evict BE pods when BE cpu satisfaction is below threshold
+    (plugins/cpuevict)."""
+
+    name = "cpuevict"
+
+    def __init__(self, ctx: QOSStrategyContext):
+        self.ctx = ctx
+
+    def run(self, now: Optional[float] = None) -> None:
+        if not KOORDLET_GATES.enabled("BECPUEvict"):
+            return
+        now = time.time() if now is None else now
+        slo = self.ctx.informer.get_node_slo()
+        thr = slo.resource_used_threshold_with_be
+        if not thr.enable:
+            return
+        be_usage = self.ctx.cache.query(mc.BE_CPU_USAGE, "avg", 300, now)
+        node = self.ctx.informer.get_node()
+        if be_usage is None or node is None:
+            return
+        capacity = node.allocatable.get("cpu", 0) / 1000.0
+        if capacity and be_usage / capacity * 100 >= thr.cpu_evict_be_usage_threshold_percent:
+            victims = self.ctx.evictor.be_victims_by_usage()
+            if victims:
+                self.ctx.evictor.evict(victims[0], "BECPUEvict")
+
+
+class MemoryEvict:
+    """Evict BE pods on node memory pressure (plugins/memoryevict)."""
+
+    name = "memoryevict"
+
+    def __init__(self, ctx: QOSStrategyContext):
+        self.ctx = ctx
+
+    def run(self, now: Optional[float] = None) -> None:
+        if not KOORDLET_GATES.enabled("BEMemoryEvict"):
+            return
+        now = time.time() if now is None else now
+        slo = self.ctx.informer.get_node_slo()
+        thr = slo.resource_used_threshold_with_be
+        if not thr.enable:
+            return
+        node = self.ctx.informer.get_node()
+        mem_usage = self.ctx.cache.query(mc.NODE_MEMORY_USAGE, "latest", now=now)
+        if node is None or mem_usage is None:
+            return
+        capacity = node.allocatable.get("memory", 0)
+        if not capacity:
+            return
+        util = mem_usage / capacity * 100
+        if util < thr.memory_evict_threshold_percent:
+            return
+        lower = thr.memory_evict_lower_percent or (thr.memory_evict_threshold_percent - 2)
+        to_release = (util - lower) / 100.0 * capacity
+        released = 0.0
+        for victim in self.ctx.evictor.be_victims_by_usage():
+            if released >= to_release:
+                break
+            released += self.ctx.cache.query(
+                mc.POD_MEMORY_USAGE, "latest", pod=victim.meta.key
+            ) or 0.0
+            self.ctx.evictor.evict(victim, "BEMemoryEvict")
+
+
+class CPUBurst:
+    """cfs burst for LS pods (plugins/cpuburst)."""
+
+    name = "cpuburst"
+
+    def __init__(self, ctx: QOSStrategyContext):
+        self.ctx = ctx
+
+    def run(self, now: Optional[float] = None) -> None:
+        if not KOORDLET_GATES.enabled("CPUBurst"):
+            return
+        slo = self.ctx.informer.get_node_slo()
+        strategy = slo.cpu_burst_strategy
+        if strategy.policy == "none":
+            return
+        for pod in self.ctx.informer.get_all_pods():
+            if not pod.qos_class.is_latency_sensitive:
+                continue
+            limit_milli = pod.spec.limits.get("cpu", 0)
+            if limit_milli <= 0:
+                continue
+            rel = self.ctx.executor.config.pod_relative_path(
+                pod_qos_dir(pod), pod.meta.uid or pod.meta.name
+            )
+            if strategy.policy in ("cpuBurstOnly", "auto"):
+                burst_us = int(
+                    limit_milli / 1000.0 * 100000
+                    * strategy.cpu_burst_percent / 100.0
+                )
+                self.ctx.executor.update(
+                    ResourceUpdater(rel, sysutil.CPU_CFS_BURST, str(burst_us), level=1)
+                )
+
+
+class ResctrlReconcile:
+    """LLC / memory-bandwidth isolation via resctrl groups (plugins/resctrl).
+
+    Creates BE/LS resctrl groups and writes schemata lines with the configured
+    LLC way-percentage and MBA percent."""
+
+    name = "resctrl"
+
+    def __init__(self, ctx: QOSStrategyContext, cache_ways: int = 12):
+        self.ctx = ctx
+        self.cache_ways = cache_ways
+
+    def run(self, now: Optional[float] = None) -> None:
+        if not KOORDLET_GATES.enabled("RdtResctrl"):
+            return
+        slo = self.ctx.informer.get_node_slo()
+        qos = slo.resource_qos_strategy
+        if not qos.be_enable:
+            return
+        root = self.ctx.executor.config.resctrl_root()
+        ways = max(1, int(self.cache_ways * qos.llc_be_percent / 100))
+        mask = (1 << ways) - 1
+        schemata = f"L3:0={mask:x}\nMB:0={qos.mba_be_percent}\n"
+        sysutil.write_file(f"{root}/BE/schemata", schemata)
+        self.ctx.executor.auditor.record(
+            "info", "node", "resctrl_write", group="BE", schemata=schemata.strip()
+        )
+
+
+class CgroupReconcile:
+    """Baseline per-QoS cgroup parameters (plugins/cgreconcile): cpu.shares and
+    memory protection per QoS class."""
+
+    name = "cgreconcile"
+    CPU_SHARES_BY_QOS = {
+        QoSClass.LSE: 4096, QoSClass.LSR: 4096, QoSClass.LS: 2048,
+        QoSClass.BE: 2,
+    }
+
+    def __init__(self, ctx: QOSStrategyContext):
+        self.ctx = ctx
+
+    def run(self, now: Optional[float] = None) -> None:
+        if not KOORDLET_GATES.enabled("CgroupReconcile"):
+            return
+        for pod in self.ctx.informer.get_all_pods():
+            shares = self.CPU_SHARES_BY_QOS.get(pod.qos_class)
+            if shares is None:
+                continue
+            rel = self.ctx.executor.config.pod_relative_path(
+                pod_qos_dir(pod), pod.meta.uid or pod.meta.name
+            )
+            self.ctx.executor.update(
+                ResourceUpdater(rel, sysutil.CPU_SHARES, str(shares), level=1)
+            )
+
+
+class QoSManager:
+    """Strategy loop (qosmanager framework)."""
+
+    def __init__(self, store: ObjectStore, informer: StatesInformer,
+                 cache: mc.MetricCache, executor: ResourceUpdateExecutor):
+        self.evictor = Evictor(store, informer, cache)
+        self.ctx = QOSStrategyContext(informer, cache, executor, self.evictor)
+        self.strategies = [
+            CPUSuppress(self.ctx),
+            CPUEvict(self.ctx),
+            MemoryEvict(self.ctx),
+            CPUBurst(self.ctx),
+            ResctrlReconcile(self.ctx),
+            CgroupReconcile(self.ctx),
+        ]
+
+    def run_once(self, now: Optional[float] = None) -> None:
+        for strategy in self.strategies:
+            strategy.run(now)

@@ -1,0 +1,196 @@
+"""Runtime hooks: container-lifecycle resource injection.
+
+Analog of reference `pkg/koordlet/runtimehooks/` (runtimehooks.go:35-77): a hook
+registry applied in three modes —
+  (a) proxy: invoked by the runtime-proxy gRPC interceptor per CRI call
+      (runtimeproxy/ hands us a ContainerContext, we mutate it)
+  (b) NRI: same hooks behind containerd's NRI (mode wiring only differs)
+  (c) standalone reconciler (reconciler/reconciler.go): watch pods, write
+      cgroups directly via the executor — always-on backstop.
+
+Hooks (feature-gated, config.go:38-100):
+  * groupidentity : bvt.warp_ns per QoS class (hooks/groupidentity)
+  * cpuset        : apply the scheduler's resource-status annotation
+  * batchresource : cfs quota + memory limits from batch-cpu/batch-memory
+  * gpu           : device env injection (NVIDIA_VISIBLE_DEVICES)
+  * cpunormalization: scale cfs quota by the node's cpu-normalization ratio
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from koordinator_tpu.api.objects import (
+    ANNOTATION_DEVICE_ALLOCATED,
+    ANNOTATION_RESOURCE_STATUS,
+    Pod,
+)
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.api.resources import ResourceName
+from koordinator_tpu.koordlet.metricsadvisor import pod_qos_dir
+from koordinator_tpu.koordlet.resourceexecutor import (
+    ResourceUpdateExecutor,
+    ResourceUpdater,
+)
+from koordinator_tpu.koordlet.statesinformer import StatesInformer
+from koordinator_tpu.koordlet.util import system as sysutil
+
+ANNOTATION_CPU_NORMALIZATION_RATIO = "node.koordinator.sh/cpu-normalization-ratio"
+
+# bvt.warp_ns values per QoS (groupidentity defaults: LS=2, BE=-1)
+BVT_BY_QOS = {
+    QoSClass.LSE: 2,
+    QoSClass.LSR: 2,
+    QoSClass.LS: 2,
+    QoSClass.SYSTEM: 0,
+    QoSClass.BE: -1,
+    QoSClass.NONE: 0,
+}
+
+
+@dataclass
+class ContainerContext:
+    """Mutable view of a container's runtime config (protocol/ adapters)."""
+
+    pod: Pod
+    cgroup_parent: str
+    env: Dict[str, str] = field(default_factory=dict)
+    cgroup_writes: List[ResourceUpdater] = field(default_factory=list)
+
+    def add_write(self, resource: str, value: str, level: int = 2) -> None:
+        self.cgroup_writes.append(
+            ResourceUpdater(self.cgroup_parent, resource, value, level)
+        )
+
+
+class Hook:
+    name = "hook"
+
+    def apply(self, ctx: ContainerContext) -> None:
+        raise NotImplementedError
+
+
+class GroupIdentityHook(Hook):
+    name = "GroupIdentity"
+
+    def apply(self, ctx: ContainerContext) -> None:
+        bvt = BVT_BY_QOS.get(ctx.pod.qos_class, 0)
+        ctx.add_write(sysutil.CPU_BVT_WARP_NS, str(bvt))
+
+
+class CPUSetHook(Hook):
+    name = "CPUSetAllocator"
+
+    def apply(self, ctx: ContainerContext) -> None:
+        raw = ctx.pod.meta.annotations.get(ANNOTATION_RESOURCE_STATUS)
+        if not raw:
+            return
+        try:
+            status = json.loads(raw)
+        except (ValueError, TypeError):
+            return
+        cpuset = status.get("cpuset")
+        if cpuset:
+            ctx.add_write(sysutil.CPUSET_CPUS, cpuset)
+
+
+class BatchResourceHook(Hook):
+    name = "BatchResource"
+
+    def apply(self, ctx: ContainerContext) -> None:
+        req = ctx.pod.spec.requests
+        limits = ctx.pod.spec.limits
+        batch_cpu = limits.get(ResourceName.BATCH_CPU) or req.get(ResourceName.BATCH_CPU)
+        batch_mem = limits.get(ResourceName.BATCH_MEMORY) or req.get(
+            ResourceName.BATCH_MEMORY
+        )
+        if batch_cpu:
+            period = 100000
+            ctx.add_write(sysutil.CPU_CFS_QUOTA, str(int(batch_cpu / 1000 * period)))
+        if batch_mem:
+            ctx.add_write(sysutil.MEMORY_LIMIT, str(int(batch_mem)))
+
+
+class GPUEnvHook(Hook):
+    name = "GPUEnv"
+
+    def apply(self, ctx: ContainerContext) -> None:
+        raw = ctx.pod.meta.annotations.get(ANNOTATION_DEVICE_ALLOCATED)
+        if not raw:
+            return
+        try:
+            alloc = json.loads(raw)
+        except (ValueError, TypeError):
+            return
+        gpus = alloc.get("gpu") or []
+        if gpus:
+            ctx.env["NVIDIA_VISIBLE_DEVICES"] = ",".join(
+                str(g["minor"]) for g in gpus
+            )
+            core = sum(g.get("core", 0) for g in gpus)
+            if core and core % 100 != 0:
+                ctx.env["CUDA_MPS_ACTIVE_THREAD_PERCENTAGE"] = str(core)
+
+
+class CPUNormalizationHook(Hook):
+    name = "CPUNormalization"
+
+    def __init__(self, informer: StatesInformer):
+        self.informer = informer
+
+    def apply(self, ctx: ContainerContext) -> None:
+        node = self.informer.get_node()
+        if node is None:
+            return
+        raw = node.meta.annotations.get(ANNOTATION_CPU_NORMALIZATION_RATIO)
+        if not raw:
+            return
+        try:
+            ratio = float(raw)
+        except ValueError:
+            return
+        if ratio <= 0 or ratio == 1.0:
+            return
+        cpu_limit = ctx.pod.spec.limits.get(ResourceName.CPU)
+        if cpu_limit:
+            period = 100000
+            quota = int(cpu_limit / 1000.0 * period / ratio)
+            ctx.add_write(sysutil.CPU_CFS_QUOTA, str(quota))
+
+
+DEFAULT_HOOKS = (GroupIdentityHook, CPUSetHook, BatchResourceHook, GPUEnvHook)
+
+
+class RuntimeHooks:
+    """Hook runner: proxy-mode entry (run_hooks) + standalone reconciler."""
+
+    def __init__(self, informer: StatesInformer, executor: ResourceUpdateExecutor):
+        self.informer = informer
+        self.executor = executor
+        self.hooks: List[Hook] = [cls() for cls in DEFAULT_HOOKS]
+        self.hooks.append(CPUNormalizationHook(informer))
+
+    def run_hooks(self, ctx: ContainerContext) -> ContainerContext:
+        """Proxy/NRI-mode: mutate the container context; the caller (runtime
+        proxy or NRI adapter) applies the response to the real runtime call."""
+        for hook in self.hooks:
+            hook.apply(ctx)
+        return ctx
+
+    def reconcile(self) -> int:
+        """Standalone reconciler backstop (reconciler.go:144): apply hook output
+        directly through the executor for every local pod; returns writes."""
+        wrote = 0
+        for pod in self.informer.get_all_pods():
+            if not pod.is_assigned:
+                continue
+            rel = self.executor.config.pod_relative_path(
+                pod_qos_dir(pod), pod.meta.uid or pod.meta.name
+            )
+            ctx = ContainerContext(pod=pod, cgroup_parent=rel)
+            self.run_hooks(ctx)
+            shrink = [u for u in ctx.cgroup_writes]
+            wrote += self.executor.leveled_update_batch(shrink, increase=False)
+        return wrote

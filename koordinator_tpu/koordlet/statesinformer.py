@@ -1,0 +1,171 @@
+"""States informer: node-local state plugins + NodeMetric/NodeTopo reporting.
+
+Analog of reference `pkg/koordlet/statesinformer/` (registry impl/registry.go:21-28):
+  * node/pods/nodeslo informers: local views of the store (the kubelet-stub +
+    CRD informers of the reference), with callback fan-out to subscribers
+    (api.go:94-108) on state changes
+  * nodemetric reporter (impl/states_nodemetric.go:182-210): aggregates the
+    metric cache into the NodeMetric CR status on an interval (avg + percentile
+    windows)
+  * nodetopo reporter: publishes NodeResourceTopology from machine info.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from koordinator_tpu.api.objects import (
+    Node,
+    NodeMetric,
+    NodeMetricInfo,
+    NodeResourceTopology,
+    NodeSLO,
+    ObjectMeta,
+    Pod,
+    PodMetricInfo,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client.store import (
+    KIND_NODE,
+    KIND_NODE_METRIC,
+    KIND_NODE_SLO,
+    KIND_NODE_TOPOLOGY,
+    KIND_POD,
+    EventType,
+    ObjectStore,
+)
+from koordinator_tpu.koordlet import metriccache as mc
+
+CALLBACK_NODE_SLO = "nodeslo"
+CALLBACK_PODS = "pods"
+CALLBACK_NODE = "node"
+
+
+class StatesInformer:
+    def __init__(self, store: ObjectStore, node_name: str,
+                 cache: mc.MetricCache,
+                 report_interval_seconds: int = 60,
+                 aggregate_windows=(300, 900, 1800)):
+        self.store = store
+        self.node_name = node_name
+        self.cache = cache
+        self.report_interval = report_interval_seconds
+        self.aggregate_windows = tuple(aggregate_windows)
+        self._callbacks: Dict[str, List[Callable]] = {}
+        self._last_report = 0.0
+        store.subscribe(KIND_POD, self._on_pod)
+        store.subscribe(KIND_NODE_SLO, self._on_nodeslo)
+        store.subscribe(KIND_NODE, self._on_node)
+
+    # -- local views ---------------------------------------------------------
+    def get_node(self) -> Optional[Node]:
+        return self.store.get(KIND_NODE, f"/{self.node_name}")
+
+    def get_node_slo(self) -> NodeSLO:
+        slo = self.store.get(KIND_NODE_SLO, f"/{self.node_name}")
+        return slo if slo is not None else NodeSLO(
+            meta=ObjectMeta(name=self.node_name, namespace="")
+        )
+
+    def get_all_pods(self) -> List[Pod]:
+        return [
+            p
+            for p in self.store.list(KIND_POD)
+            if p.spec.node_name == self.node_name and not p.is_terminated
+        ]
+
+    # -- callbacks (api.go RegisterCallbacks) --------------------------------
+    def register_callback(self, kind: str, fn: Callable) -> None:
+        self._callbacks.setdefault(kind, []).append(fn)
+
+    def _fire(self, kind: str, obj) -> None:
+        for fn in self._callbacks.get(kind, []):
+            fn(obj)
+
+    def _on_pod(self, ev: EventType, pod: Pod, old) -> None:
+        if pod.spec.node_name == self.node_name:
+            self._fire(CALLBACK_PODS, pod)
+
+    def _on_nodeslo(self, ev: EventType, slo: NodeSLO, old) -> None:
+        if slo.meta.name == self.node_name:
+            self._fire(CALLBACK_NODE_SLO, slo)
+
+    def _on_node(self, ev: EventType, node: Node, old) -> None:
+        if node.meta.name == self.node_name:
+            self._fire(CALLBACK_NODE, node)
+
+    # -- NodeMetric reporter (states_nodemetric.go) --------------------------
+    def sync_node_metric(self, now: Optional[float] = None) -> Optional[NodeMetric]:
+        now = time.time() if now is None else now
+        if now - self._last_report < self.report_interval:
+            return None
+        self._last_report = now
+
+        def usage(window: Optional[float], agg: str) -> ResourceList:
+            cpu = self.cache.query(mc.NODE_CPU_USAGE, agg, window, now)
+            mem = self.cache.query(mc.NODE_MEMORY_USAGE, agg, window, now)
+            return ResourceList.of(
+                cpu=int((cpu or 0.0) * 1000), memory=int(mem or 0)
+            )
+
+        info = NodeMetricInfo(
+            node_usage=usage(self.report_interval * 2, "avg"),
+            system_usage=ResourceList.of(
+                cpu=int(
+                    (self.cache.query(mc.SYS_CPU_USAGE, "avg",
+                                      self.report_interval * 2, now) or 0.0)
+                    * 1000
+                )
+            ),
+            aggregated_node_usages={
+                w: {
+                    agg: usage(float(w), agg)
+                    for agg in ("avg", "p50", "p90", "p95", "p99")
+                }
+                for w in self.aggregate_windows
+            },
+        )
+        pods_metric = []
+        for pod in self.get_all_pods():
+            cpu = self.cache.query(
+                mc.POD_CPU_USAGE, "avg", self.report_interval * 2, now,
+                pod=pod.meta.key,
+            )
+            memv = self.cache.query(
+                mc.POD_MEMORY_USAGE, "avg", self.report_interval * 2, now,
+                pod=pod.meta.key,
+            )
+            if cpu is None and memv is None:
+                continue
+            pods_metric.append(
+                PodMetricInfo(
+                    namespace=pod.meta.namespace,
+                    name=pod.meta.name,
+                    pod_usage=ResourceList.of(
+                        cpu=int((cpu or 0.0) * 1000), memory=int(memv or 0)
+                    ),
+                    priority_class=pod.priority_class,
+                )
+            )
+        nm = self.store.get(KIND_NODE_METRIC, f"/{self.node_name}")
+        if nm is None:
+            nm = NodeMetric(meta=ObjectMeta(name=self.node_name, namespace=""))
+            self.store.add(KIND_NODE_METRIC, nm)
+        nm.update_time = now
+        nm.node_metric = info
+        nm.pods_metric = pods_metric
+        nm.report_interval_seconds = self.report_interval
+        nm.aggregate_durations = list(self.aggregate_windows)
+        self.store.update(KIND_NODE_METRIC, nm)
+        return nm
+
+    # -- NodeResourceTopology reporter (states_nodetopo) ---------------------
+    def sync_node_topology(self, topo_cr: NodeResourceTopology) -> None:
+        topo_cr.meta.name = self.node_name
+        topo_cr.meta.namespace = ""
+        existing = self.store.get(KIND_NODE_TOPOLOGY, f"/{self.node_name}")
+        if existing is None:
+            self.store.add(KIND_NODE_TOPOLOGY, topo_cr)
+        else:
+            self.store.update(KIND_NODE_TOPOLOGY, topo_cr)

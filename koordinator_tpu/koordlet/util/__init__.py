@@ -1,0 +1,1 @@
+"""koordlet kernel-interface utilities (reference `pkg/koordlet/util/`)."""

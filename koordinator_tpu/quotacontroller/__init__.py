@@ -1,0 +1,3 @@
+"""Quota controller (reference `pkg/quota-controller/`)."""
+
+from koordinator_tpu.quotacontroller.profile import QuotaProfileController  # noqa: F401

@@ -97,7 +97,11 @@ class Scheduler:
                         creation_timestamp=res.meta.creation_timestamp,
                     ),
                     spec=PodSpec(
-                        priority=res.template.priority or 9500,
+                        priority=(
+                            res.template.priority
+                            if res.template.priority is not None
+                            else 9500
+                        ),
                         requests=res.template.requests,
                         limits=res.template.limits,
                     ),
@@ -109,7 +113,9 @@ class Scheduler:
     def _assigned_requests(self, now: float) -> Dict[str, np.ndarray]:
         """Fit state per node: assigned pods + unconsumed reserved resources.
         Pods allocated FROM a reservation are counted inside the reservation's
-        allocatable (avoid double counting)."""
+        allocatable (avoid double counting) — but only while that reservation
+        itself is still counted; once it expires/fails, its owner pods must be
+        accounted directly or the node silently overcommits."""
         out: Dict[str, np.ndarray] = {}
 
         def add(node: str, vec: np.ndarray) -> None:
@@ -118,15 +124,18 @@ class Scheduler:
             else:
                 out[node] = vec.astype(np.float32)
 
+        counted_reservations = set()
+        for res in self.store.list(KIND_RESERVATION):
+            if res.is_available and not res.is_expired(now):
+                counted_reservations.add(res.meta.name)
+                add(res.node_name, res.allocatable.to_vector())
         for pod in self.store.list(KIND_POD):
             if not pod.is_assigned or pod.is_terminated:
                 continue
-            if ANNOTATION_RESERVATION_ALLOCATED in pod.meta.annotations:
+            res_name = pod.meta.annotations.get(ANNOTATION_RESERVATION_ALLOCATED)
+            if res_name and res_name in counted_reservations:
                 continue
             add(pod.spec.node_name, with_pod_count(pod.spec.requests.to_vector()[None])[0])
-        for res in self.store.list(KIND_RESERVATION):
-            if res.is_available and not res.is_expired(now):
-                add(res.node_name, res.allocatable.to_vector())
         return out
 
     def _cluster_state(self, pending: List[Pod], now: float) -> ClusterState:
@@ -174,11 +183,18 @@ class Scheduler:
             self.extender.monitor.record(result)
             return result
 
-        # ---- reservation nomination pre-pass
+        # ---- reservation nomination pre-pass. Gang/quota pods are excluded:
+        # their admission barriers live in the batched kernel, and binding them
+        # here would bypass min-member and quota checks.
         remaining: List[Pod] = []
         ctx = CycleContext(now=now)
         for pod in pending:
-            if pod.meta.key in pending_reservations or res_plugin is None:
+            if (
+                pod.meta.key in pending_reservations
+                or res_plugin is None
+                or pod.gang_name
+                or pod.quota_name
+            ):
                 remaining.append(pod)
                 continue
             res = res_plugin.nominate(pod, now)
@@ -190,6 +206,10 @@ class Scheduler:
             if err:
                 remaining.append(pod)
         pending = remaining
+        if not pending:
+            result.duration_seconds = time.perf_counter() - t_start
+            self.extender.monitor.record(result)
+            return result
 
         # ---- batched kernel pass
         state = self._cluster_state(pending, now)

@@ -74,25 +74,34 @@ class CycleContext:
 
 
 class SchedulerMonitor:
-    """Slow/stuck cycle watchdog (frameworkext/scheduler_monitor.go:44-108)."""
+    """Slow/stuck cycle watchdog (frameworkext/scheduler_monitor.go:44-108).
+    History is a bounded window; totals are running counters so a long-running
+    scheduler never grows unbounded."""
 
-    def __init__(self, timeout_seconds: float = 10.0):
+    def __init__(self, timeout_seconds: float = 10.0, history_size: int = 512):
+        from collections import deque
+
         self.timeout = timeout_seconds
-        self.history: List[Dict[str, float]] = []
+        self.history = deque(maxlen=history_size)
+        self.total_cycles = 0
+        self._slow_cycles = 0
 
     def record(self, result: CycleResult) -> None:
+        slow = result.duration_seconds > self.timeout
+        self.total_cycles += 1
+        self._slow_cycles += int(slow)
         self.history.append(
             {
                 "duration": result.duration_seconds,
                 "kernel": result.kernel_seconds,
                 "bound": float(len(result.bound)),
-                "slow": float(result.duration_seconds > self.timeout),
+                "slow": float(slow),
             }
         )
 
     @property
     def slow_cycles(self) -> int:
-        return int(sum(h["slow"] for h in self.history))
+        return self._slow_cycles
 
 
 class FrameworkExtender:

@@ -84,6 +84,7 @@ class DeviceSharePlugin(Plugin):
                 g.minor,
             ),
         )
+        total_core = max(want.get("core", 0), 1)
         for g in order:
             if remaining_core <= 0:
                 break
@@ -96,21 +97,31 @@ class DeviceSharePlugin(Plugin):
             take = min(free_core, remaining_core)
             if remaining_core > 100 and take < 100:
                 continue  # whole-gpu requests need whole gpus
+            # memory/ratio are split across picks in proportion to core take
+            ratio_share = int(want.get("memory_ratio", take) * take / total_core)
+            mem_share = int(want.get("memory", 0) * take / total_core)
             used["core"] += take
-            ratio = want.get("memory_ratio", take)
-            mem = want.get("memory", 0)
-            used["memory_ratio"] += min(ratio, take if want.get("core") else ratio)
-            used["memory"] += mem
-            picks.append({"minor": g.minor, "core": take, "memory": mem})
+            used["memory_ratio"] += ratio_share
+            used["memory"] += mem_share
+            picks.append(
+                {"minor": g.minor, "core": take, "memory": mem_share,
+                 "memory_ratio": ratio_share}
+            )
             remaining_core -= take
         if remaining_core > 0:
-            # roll back partial picks
             for p in picks:
-                node_alloc[p["minor"]]["core"] -= p["core"]
-                node_alloc[p["minor"]]["memory"] -= p["memory"]
+                self._release(node_alloc, p)
             return "insufficient gpu capacity"
         self.by_pod[pod.meta.key] = picks
         return None
+
+    @staticmethod
+    def _release(node_alloc: Dict[int, Dict[str, int]], pick: dict) -> None:
+        used = node_alloc.get(pick["minor"])
+        if used:
+            used["core"] -= pick["core"]
+            used["memory"] -= pick["memory"]
+            used["memory_ratio"] -= pick.get("memory_ratio", 0)
 
     def unreserve(self, pod: Pod, node_name: str, ctx: CycleContext) -> None:
         picks = self.by_pod.pop(pod.meta.key, None)
@@ -118,10 +129,7 @@ class DeviceSharePlugin(Plugin):
             return
         node_alloc = self.allocated.get(node_name, {})
         for p in picks:
-            used = node_alloc.get(p["minor"])
-            if used:
-                used["core"] -= p["core"]
-                used["memory"] -= p["memory"]
+            self._release(node_alloc, p)
 
     def pre_bind(self, pod: Pod, node_name: str, ctx: CycleContext,
                  annotations: Dict[str, str]) -> None:

@@ -45,33 +45,37 @@ class ElasticQuotaPlugin(Plugin):
             cache[name] = np.zeros(NUM_RESOURCES, np.float32)
         return cache[name]
 
+    @staticmethod
+    def _bucket(pod: Pod) -> Optional[str]:
+        """Which cache a pod contributes to: pending (unassigned, live), used
+        (assigned, live), or none (terminated)."""
+        if pod.is_terminated:
+            return None
+        return "used" if pod.is_assigned else "pending"
+
+    def _apply(self, name: str, bucket: Optional[str], vec: np.ndarray,
+               sign: float) -> None:
+        if bucket is None:
+            return
+        cache = self.used if bucket == "used" else self.pending
+        self._vec(cache, name)
+        cache[name] = np.maximum(cache[name] + sign * vec, 0.0)
+
     def _on_pod(self, ev: EventType, pod: Pod, old) -> None:
         name = pod.quota_name
         if not name:
             return
         vec = pod.spec.requests.to_vector()
         if ev is EventType.ADDED:
-            if pod.is_assigned and not pod.is_terminated:
-                self._vec(self.used, name)
-                self.used[name] += vec
-            elif not pod.is_terminated:
-                self._vec(self.pending, name)
-                self.pending[name] += vec
-        elif ev is EventType.MODIFIED and old is not None:
-            was = old.is_assigned and not old.is_terminated
-            now = pod.is_assigned and not pod.is_terminated
-            if now and not was:
-                self._vec(self.used, name)
-                self.used[name] += vec
-                self._vec(self.pending, name)
-                self.pending[name] = np.maximum(self.pending[name] - vec, 0.0)
-            elif was and not now:
-                self._vec(self.used, name)
-                self.used[name] = np.maximum(self.used[name] - vec, 0.0)
+            self._apply(name, self._bucket(pod), vec, +1.0)
+        elif ev is EventType.MODIFIED:
+            old_bucket = self._bucket(old) if old is not None else None
+            new_bucket = self._bucket(pod)
+            if old_bucket != new_bucket:
+                self._apply(name, old_bucket, vec, -1.0)
+                self._apply(name, new_bucket, vec, +1.0)
         elif ev is EventType.DELETED:
-            cache = self.used if (pod.is_assigned and not pod.is_terminated) else self.pending
-            self._vec(cache, name)
-            cache[name] = np.maximum(cache[name] - vec, 0.0)
+            self._apply(name, self._bucket(pod), vec, -1.0)
 
     def quota_list(self) -> List[ElasticQuota]:
         return list(self.quotas.values())

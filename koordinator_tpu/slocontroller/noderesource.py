@@ -1,0 +1,218 @@
+"""NodeResource controller: the colocation resource pipeline, vectorized.
+
+Analog of `pkg/slo-controller/noderesource/` (controller :72-165, batchresource
+plugin + util.go:38-66, midresource, degrade :467-485). The per-node formula
+
+  System.Used        = max(Node.Used - Pod(All).Used, Node.Anno.Reserved)
+  Batch.Alloc[usage] = max(Node.Total*(reclaim%/100) - Node.Reserved
+                           - System.Used - Pod(HP).Used, 0)
+  Batch.Alloc[request]        likewise with requests and System.Reserved
+  Batch.Alloc[maxUsageRequest] likewise with max(request, used)
+  Mid.Alloc          = min(ProdReclaimable, Node.Total * mid%/100)
+
+is identical for every node — SURVEY.md 7's "already pure tensor math over
+ResourceLists" — so the whole cluster reconciles in ONE jitted [N, R] pass
+instead of the reference's per-node reconcile loop. Stale NodeMetrics degrade
+the node (batch resources reset to zero) per the degrade window.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.api.objects import Node, NodeMetric, Pod
+from koordinator_tpu.api.priority import PriorityClass
+from koordinator_tpu.api.resources import (
+    NUM_RESOURCES,
+    RESOURCE_INDEX,
+    ResourceList,
+    ResourceName,
+)
+from koordinator_tpu.client.store import (
+    KIND_NODE,
+    KIND_NODE_METRIC,
+    KIND_POD,
+    ObjectStore,
+)
+from koordinator_tpu.utils.sloconfig import (
+    POLICY_MAX_USAGE_REQUEST,
+    POLICY_REQUEST,
+    ColocationConfig,
+)
+
+CPU = RESOURCE_INDEX[ResourceName.CPU]
+MEM = RESOURCE_INDEX[ResourceName.MEMORY]
+ANNOTATION_NODE_RESERVATION = "node.koordinator.sh/reservation"
+
+
+@functools.partial(jax.jit, static_argnames=("cpu_policy", "memory_policy"))
+def _batch_mid_kernel(
+    capacity,            # [N, R]
+    node_reserved,       # [N, R]
+    system_reserved,     # [N, R]
+    node_used,           # [N, R]
+    pod_all_used,        # [N, R]
+    pod_hp_used,         # [N, R]
+    pod_hp_request,      # [N, R]
+    pod_hp_max_used_req,  # [N, R]
+    prod_reclaimable,    # [N, R]
+    reclaim_percent,     # [N, R] (cpu/mem thresholds broadcast per axis)
+    mid_percent,         # [N, R]
+    degraded,            # [N] bool
+    cpu_policy: str,
+    memory_policy: str,
+):
+    reclaimable_capacity = capacity * reclaim_percent / 100.0
+    system_used = jnp.maximum(node_used - pod_all_used, 0.0)
+    system_used = jnp.maximum(system_used, system_reserved)
+    by_usage = jnp.maximum(
+        reclaimable_capacity - node_reserved - system_used - pod_hp_used, 0.0
+    )
+    by_request = jnp.maximum(
+        reclaimable_capacity - node_reserved - system_reserved - pod_hp_request, 0.0
+    )
+    by_max = jnp.maximum(
+        reclaimable_capacity - node_reserved - system_used - pod_hp_max_used_req, 0.0
+    )
+
+    def pick(policy):
+        if policy == POLICY_REQUEST:
+            return by_request
+        if policy == POLICY_MAX_USAGE_REQUEST:
+            return by_max
+        return by_usage
+
+    batch = by_usage
+    batch = batch.at[:, CPU].set(pick(cpu_policy)[:, CPU])
+    batch = batch.at[:, MEM].set(pick(memory_policy)[:, MEM])
+    batch = jnp.where(degraded[:, None], 0.0, batch)
+    mid = jnp.minimum(prod_reclaimable, capacity * mid_percent / 100.0)
+    mid = jnp.where(degraded[:, None], 0.0, jnp.maximum(mid, 0.0))
+    return batch, mid
+
+
+class NodeResourceController:
+    def __init__(self, store: ObjectStore, config: Optional[ColocationConfig] = None):
+        self.store = store
+        self.config = config or ColocationConfig()
+
+    # -- host gather ---------------------------------------------------------
+    def _gather(self, nodes: List[Node], now: float):
+        N = len(nodes)
+        R = NUM_RESOURCES
+        capacity = np.zeros((N, R), np.float32)
+        node_reserved = np.zeros((N, R), np.float32)
+        system_reserved = np.zeros((N, R), np.float32)
+        node_used = np.zeros((N, R), np.float32)
+        pod_all_used = np.zeros((N, R), np.float32)
+        pod_hp_used = np.zeros((N, R), np.float32)
+        pod_hp_request = np.zeros((N, R), np.float32)
+        pod_hp_max = np.zeros((N, R), np.float32)
+        prod_reclaimable = np.zeros((N, R), np.float32)
+        reclaim = np.zeros((N, R), np.float32)
+        mid_pct = np.zeros((N, R), np.float32)
+        degraded = np.zeros(N, bool)
+
+        pods_by_node: Dict[str, List[Pod]] = {}
+        for pod in self.store.list(KIND_POD):
+            if pod.is_assigned and not pod.is_terminated:
+                pods_by_node.setdefault(pod.spec.node_name, []).append(pod)
+
+        for i, node in enumerate(nodes):
+            strategy = self.config.strategy_for_node(node.meta.labels)
+            capacity[i] = node.capacity.to_vector() if node.capacity else node.allocatable.to_vector()
+            reclaim[i, CPU] = strategy.cpu_reclaim_threshold_percent
+            reclaim[i, MEM] = strategy.memory_reclaim_threshold_percent
+            mid_pct[i, CPU] = strategy.mid_cpu_threshold_percent
+            mid_pct[i, MEM] = strategy.mid_memory_threshold_percent
+            raw = node.meta.annotations.get(ANNOTATION_NODE_RESERVATION)
+            if raw:
+                import json
+
+                try:
+                    data = json.loads(raw)
+                    from koordinator_tpu.api.resources import parse_quantity
+
+                    node_reserved[i] = ResourceList(
+                        {
+                            k: parse_quantity(v, cpu=(k == ResourceName.CPU))
+                            for k, v in data.get("resources", {}).items()
+                        }
+                    ).to_vector()
+                except (ValueError, TypeError):
+                    pass
+            nm: Optional[NodeMetric] = self.store.get(
+                KIND_NODE_METRIC, f"/{node.meta.name}"
+            )
+            if nm is None or nm.update_time <= 0:
+                degraded[i] = True
+                continue
+            if now - nm.update_time > strategy.degrade_time_minutes * 60:
+                degraded[i] = True  # degrade on stale metrics (plugin.go:467-485)
+                continue
+            node_used[i] = nm.node_metric.node_usage.to_vector()
+            prod_reclaimable[i] = nm.prod_reclaimable.to_vector()
+            pod_usage = {
+                f"{pm.namespace}/{pm.name}": pm.pod_usage.to_vector()
+                for pm in nm.pods_metric
+            }
+            for pod in pods_by_node.get(node.meta.name, []):
+                used = pod_usage.get(pod.meta.key)
+                if used is not None:
+                    pod_all_used[i] += used
+                cls = pod.priority_class
+                if cls in (PriorityClass.PROD, PriorityClass.MID, PriorityClass.NONE):
+                    req = pod.spec.requests.to_vector()
+                    u = used if used is not None else np.zeros(R, np.float32)
+                    pod_hp_used[i] += u
+                    pod_hp_request[i] += req
+                    pod_hp_max[i] += np.maximum(req, u)
+        return (capacity, node_reserved, system_reserved, node_used, pod_all_used,
+                pod_hp_used, pod_hp_request, pod_hp_max, prod_reclaimable,
+                reclaim, mid_pct, degraded)
+
+    # -- reconcile -----------------------------------------------------------
+    def reconcile(self, now: Optional[float] = None) -> int:
+        now = time.time() if now is None else now
+        nodes = self.store.list(KIND_NODE)
+        if not nodes:
+            return 0
+        arrays = self._gather(nodes, now)
+        strategy = self.config.cluster_strategy
+        batch, mid = _batch_mid_kernel(
+            *[jnp.asarray(a) for a in arrays],
+            cpu_policy=strategy.cpu_calculate_policy,
+            memory_policy=strategy.memory_calculate_policy,
+        )
+        batch, mid = np.asarray(batch), np.asarray(mid)
+        changes = 0
+        for i, node in enumerate(nodes):
+            update = ResourceList.of(
+                batch_cpu=int(batch[i, CPU]),
+                batch_memory=int(batch[i, MEM]) * 1024 * 1024,
+                mid_cpu=int(mid[i, CPU]),
+                mid_memory=int(mid[i, MEM]) * 1024 * 1024,
+            )
+            merged = dict(node.allocatable.quantities)
+            changed = False
+            for name in (
+                ResourceName.BATCH_CPU,
+                ResourceName.BATCH_MEMORY,
+                ResourceName.MID_CPU,
+                ResourceName.MID_MEMORY,
+            ):
+                val = update[name]
+                if merged.get(name, 0) != val:
+                    merged[name] = val
+                    changed = True
+            if changed:
+                node.allocatable = ResourceList(merged)
+                self.store.update(KIND_NODE, node)
+                changes += 1
+        return changes

@@ -1,0 +1,131 @@
+"""Cluster colocation strategy config: analog of `pkg/util/sloconfig/` +
+`apis/configuration/`.
+
+The slo-controller-config ConfigMap carries a cluster-wide ColocationStrategy
+plus per-nodepool (node-selector) overrides; the nodeslo controller renders
+per-node NodeSLO CRs from it and the noderesource controller reads the
+thresholds/policies for the batch/mid calculations."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Tuple
+
+CONFIG_MAP_NAME = "slo-controller-config"
+COLOCATION_CONFIG_KEY = "colocation-config"
+
+POLICY_USAGE = "usage"
+POLICY_REQUEST = "request"
+POLICY_MAX_USAGE_REQUEST = "maxUsageRequest"
+
+
+@dataclass
+class ColocationStrategy:
+    """Defaults mirror sloconfig defaults (colocation_config.go)."""
+
+    enable: bool = False
+    cpu_reclaim_threshold_percent: int = 60
+    memory_reclaim_threshold_percent: int = 65
+    mid_cpu_threshold_percent: int = 10
+    mid_memory_threshold_percent: int = 10
+    degrade_time_minutes: int = 15
+    update_time_threshold_seconds: int = 300
+    resource_disk_reclaim_ratio: float = 0.0
+    cpu_calculate_policy: str = POLICY_USAGE
+    memory_calculate_policy: str = POLICY_USAGE
+    metric_aggregate_duration_seconds: int = 300
+
+    @staticmethod
+    def from_dict(data: Dict) -> "ColocationStrategy":
+        s = ColocationStrategy()
+        mapping = {
+            "enable": "enable",
+            "cpuReclaimThresholdPercent": "cpu_reclaim_threshold_percent",
+            "memoryReclaimThresholdPercent": "memory_reclaim_threshold_percent",
+            "midCPUThresholdPercent": "mid_cpu_threshold_percent",
+            "midMemoryThresholdPercent": "mid_memory_threshold_percent",
+            "degradeTimeMinutes": "degrade_time_minutes",
+            "updateTimeThresholdSeconds": "update_time_threshold_seconds",
+            "cpuCalculatePolicy": "cpu_calculate_policy",
+            "memoryCalculatePolicy": "memory_calculate_policy",
+        }
+        for k, attr in mapping.items():
+            if k in data:
+                setattr(s, attr, data[k])
+        return s
+
+
+@dataclass
+class NodeStrategy:
+    """Per-nodepool override: node label selector + strategy patch."""
+
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    strategy: Dict = field(default_factory=dict)
+
+
+@dataclass
+class ColocationConfig:
+    cluster_strategy: ColocationStrategy = field(default_factory=ColocationStrategy)
+    node_strategies: List[NodeStrategy] = field(default_factory=list)
+
+    def strategy_for_node(self, node_labels: Dict[str, str]) -> ColocationStrategy:
+        """Cluster strategy patched by the first matching node strategy."""
+        merged = self.cluster_strategy
+        for ns in self.node_strategies:
+            if all(node_labels.get(k) == v for k, v in ns.node_selector.items()):
+                merged = replace(merged)
+                patched = ColocationStrategy.from_dict(ns.strategy)
+                for k in ns.strategy:
+                    attr = {
+                        "enable": "enable",
+                        "cpuReclaimThresholdPercent": "cpu_reclaim_threshold_percent",
+                        "memoryReclaimThresholdPercent": "memory_reclaim_threshold_percent",
+                        "midCPUThresholdPercent": "mid_cpu_threshold_percent",
+                        "midMemoryThresholdPercent": "mid_memory_threshold_percent",
+                        "degradeTimeMinutes": "degrade_time_minutes",
+                        "updateTimeThresholdSeconds": "update_time_threshold_seconds",
+                        "cpuCalculatePolicy": "cpu_calculate_policy",
+                        "memoryCalculatePolicy": "memory_calculate_policy",
+                    }.get(k)
+                    if attr:
+                        setattr(merged, attr, getattr(patched, attr))
+                break
+        return merged
+
+
+def parse_colocation_config(config_map_data: Dict[str, str]) -> Tuple[ColocationConfig, Optional[str]]:
+    """Parse + validate the configmap payload; returns (config, error)."""
+    raw = config_map_data.get(COLOCATION_CONFIG_KEY)
+    if not raw:
+        return ColocationConfig(), None
+    try:
+        data = json.loads(raw)
+    except (ValueError, TypeError) as e:
+        return ColocationConfig(), f"invalid colocation-config json: {e}"
+    cfg = ColocationConfig(cluster_strategy=ColocationStrategy.from_dict(data))
+    for ns in data.get("nodeConfigs", []):
+        cfg.node_strategies.append(
+            NodeStrategy(
+                node_selector=ns.get("nodeSelector", {}),
+                strategy={k: v for k, v in ns.items() if k != "nodeSelector"},
+            )
+        )
+    err = validate_colocation_config(cfg)
+    return cfg, err
+
+
+def validate_colocation_config(cfg: ColocationConfig) -> Optional[str]:
+    """ConfigMap webhook validation analog (pkg/webhook/cm/)."""
+    s = cfg.cluster_strategy
+    for name, v in (
+        ("cpuReclaimThresholdPercent", s.cpu_reclaim_threshold_percent),
+        ("memoryReclaimThresholdPercent", s.memory_reclaim_threshold_percent),
+        ("midCPUThresholdPercent", s.mid_cpu_threshold_percent),
+        ("midMemoryThresholdPercent", s.mid_memory_threshold_percent),
+    ):
+        if not 0 <= v <= 100:
+            return f"{name} must be in [0, 100], got {v}"
+    if s.degrade_time_minutes <= 0:
+        return "degradeTimeMinutes must be positive"
+    return None

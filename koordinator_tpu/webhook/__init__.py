@@ -1,0 +1,3 @@
+"""Admission webhooks (analog of reference `pkg/webhook/`, SURVEY.md 2.4)."""
+
+from koordinator_tpu.webhook.server import AdmissionServer, AdmissionError  # noqa: F401

@@ -1,0 +1,206 @@
+"""Admission webhook framework + handlers.
+
+Analog of reference `pkg/webhook/` (server.go + per-GVK registration):
+  * pod mutating: ClusterColocationProfile application — inject QoS label,
+    priority class/value, scheduler name, labels/annotations, and translate
+    requests to batch-*/mid-* extended resources
+    (pod/mutating/cluster_colocation_profile.go:53-259 + :157-259); the
+    original requests are recorded in the extended-resource-spec annotation for
+    koordlet/runtime-proxy (mutating/extended_resource_spec.go).
+  * pod validating: QoS/priority combination rules + resource consistency
+    (pod/validating/).
+  * elasticquota mutating/validating: tree guard rails (webhook/elasticquota/):
+    parent existence, min <= max, parent-child min consistency, forbidden
+    modifications.
+  * node validating: resource amplification annotations (webhook/node/).
+  * configmap validating: sloconfig schema (webhook/cm/ via utils/sloconfig).
+
+Wired into the store as admission interceptors: `admit(kind, obj)` runs
+mutators then validators; store helpers in tests call it before add/update
+(the reference's apiserver does the same).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List, Optional
+
+from koordinator_tpu.api.objects import (
+    ANNOTATION_EXTENDED_RESOURCE_SPEC,
+    ClusterColocationProfile,
+    ConfigMap,
+    ElasticQuota,
+    LABEL_POD_PRIORITY,
+    LABEL_POD_QOS,
+    Node,
+    Pod,
+)
+from koordinator_tpu.api.priority import (
+    DEFAULT_PRIORITY_BY_CLASS,
+    PriorityClass,
+    priority_class_by_name,
+)
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.api.resources import (
+    ResourceList,
+    ResourceName,
+    translate_resource_by_priority_class,
+)
+from koordinator_tpu.client.store import (
+    KIND_COLOCATION_PROFILE,
+    KIND_ELASTIC_QUOTA,
+    ObjectStore,
+)
+from koordinator_tpu.utils.features import MANAGER_GATES
+from koordinator_tpu.utils.sloconfig import (
+    COLOCATION_CONFIG_KEY,
+    CONFIG_MAP_NAME,
+    parse_colocation_config,
+)
+
+
+class AdmissionError(Exception):
+    """Admission denied (apiserver 4xx analog)."""
+
+
+class AdmissionServer:
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    # ------------------------------------------------------------------
+    def admit_pod_create(self, pod: Pod) -> Pod:
+        if MANAGER_GATES.enabled("PodMutatingWebhook"):
+            self.mutate_pod(pod)
+        if MANAGER_GATES.enabled("PodValidatingWebhook"):
+            self.validate_pod(pod)
+        return pod
+
+    # -- pod mutating ---------------------------------------------------
+    def _matching_profile(self, pod: Pod) -> Optional[ClusterColocationProfile]:
+        for profile in sorted(
+            self.store.list(KIND_COLOCATION_PROFILE), key=lambda p: p.meta.name
+        ):
+            if profile.selector and not all(
+                pod.meta.labels.get(k) == v for k, v in profile.selector.items()
+            ):
+                continue
+            return profile
+        return None
+
+    def mutate_pod(self, pod: Pod) -> None:
+        """cluster_colocation_profile.go:53-259."""
+        profile = self._matching_profile(pod)
+        if profile is not None:
+            pod.meta.labels.update(profile.labels)
+            pod.meta.annotations.update(profile.annotations)
+            if profile.qos_class is not None:
+                pod.meta.labels[LABEL_POD_QOS] = profile.qos_class.label
+            if profile.scheduler_name:
+                pod.spec.scheduler_name = profile.scheduler_name
+            if profile.priority_class_name:
+                pod.spec.priority_class_name = profile.priority_class_name
+                cls = priority_class_by_name(profile.priority_class_name)
+                if cls is not PriorityClass.NONE and pod.spec.priority is None:
+                    pod.spec.priority = DEFAULT_PRIORITY_BY_CLASS[cls]
+            if profile.koordinator_priority is not None:
+                pod.meta.labels[LABEL_POD_PRIORITY] = str(profile.koordinator_priority)
+        self.mutate_extended_resources(pod)
+
+    def mutate_extended_resources(self, pod: Pod) -> None:
+        """requests cpu/memory -> batch-*/mid-* for BATCH/MID pods
+        (:157-259), recording the original spec in the annotation."""
+        if MANAGER_GATES.enabled("ColocationProfileSkipMutatingResources"):
+            return
+        cls = pod.priority_class
+        if cls not in (PriorityClass.BATCH, PriorityClass.MID):
+            return
+        original: Dict[str, Dict[str, int]] = {}
+        for source in (pod.spec.requests, pod.spec.limits):
+            moved = {}
+            for name in (ResourceName.CPU, ResourceName.MEMORY):
+                val = source[name]
+                if not val:
+                    continue
+                target = translate_resource_by_priority_class(cls, name)
+                moved[name] = (target, val)
+            for name, (target, val) in moved.items():
+                del source.quantities[name]
+                source.quantities[target] = val
+            if moved and source is pod.spec.requests:
+                original["requests"] = {t: v for (t, v) in moved.values()}
+        if original:
+            pod.meta.annotations[ANNOTATION_EXTENDED_RESOURCE_SPEC] = json.dumps(
+                {"containers": {"main": original}}
+            )
+
+    # -- pod validating -------------------------------------------------
+    def validate_pod(self, pod: Pod) -> None:
+        """pod/validating: QoS x priority-class consistency rules."""
+        qos = pod.qos_class
+        cls = pod.priority_class
+        if qos is QoSClass.BE and cls == PriorityClass.PROD:
+            raise AdmissionError("BE pods cannot use koord-prod priority")
+        if qos in (QoSClass.LSE, QoSClass.LSR):
+            if cls in (PriorityClass.BATCH, PriorityClass.FREE):
+                raise AdmissionError(
+                    f"{qos.label} pods cannot use {cls.label} priority"
+                )
+            cpu = pod.spec.requests[ResourceName.CPU]
+            if cpu % 1000 != 0:
+                raise AdmissionError(
+                    f"{qos.label} pods must request whole cpus, got {cpu}m"
+                )
+        be_resources = pod.spec.requests[ResourceName.BATCH_CPU] or pod.spec.requests[
+            ResourceName.BATCH_MEMORY
+        ]
+        if be_resources and cls not in (PriorityClass.BATCH, PriorityClass.FREE, PriorityClass.NONE):
+            raise AdmissionError("batch resources require koord-batch/free priority")
+
+    # -- elasticquota ---------------------------------------------------
+    def validate_elastic_quota(self, quota: ElasticQuota,
+                               old: Optional[ElasticQuota] = None) -> None:
+        """webhook/elasticquota guard rails."""
+        for name, mn in quota.min.quantities.items():
+            mx = quota.max.get(name, 0)
+            if mx and mn > mx:
+                raise AdmissionError(f"min[{name}]={mn} exceeds max={mx}")
+        parent_name = quota.parent
+        if parent_name:
+            parent = None
+            for q in self.store.list(KIND_ELASTIC_QUOTA):
+                if q.meta.name == parent_name:
+                    parent = q
+                    break
+            if parent is None:
+                raise AdmissionError(f"parent quota {parent_name!r} does not exist")
+            if not parent.is_parent:
+                raise AdmissionError(f"quota {parent_name!r} is not a parent group")
+            for name, mn in quota.min.quantities.items():
+                pmn = parent.min.get(name, 0)
+                if pmn and mn > pmn:
+                    raise AdmissionError(
+                        f"child min[{name}]={mn} exceeds parent min={pmn}"
+                    )
+        if old is not None and MANAGER_GATES.enabled("ElasticQuotaImmutableAnnotations"):
+            if old.tree_id and quota.tree_id != old.tree_id:
+                raise AdmissionError("quota tree-id is immutable")
+
+    # -- node -----------------------------------------------------------
+    def validate_node(self, node: Node) -> None:
+        raw = node.meta.annotations.get("node.koordinator.sh/cpu-normalization-ratio")
+        if raw:
+            try:
+                ratio = float(raw)
+            except ValueError:
+                raise AdmissionError("cpu-normalization-ratio must be a number")
+            if not 0.1 <= ratio <= 10:
+                raise AdmissionError("cpu-normalization-ratio out of range [0.1, 10]")
+
+    # -- configmap ------------------------------------------------------
+    def validate_config_map(self, cm: ConfigMap) -> None:
+        if cm.meta.name != CONFIG_MAP_NAME:
+            return
+        if COLOCATION_CONFIG_KEY in cm.data:
+            _, err = parse_colocation_config(cm.data)
+            if err:
+                raise AdmissionError(err)

@@ -1,0 +1,200 @@
+"""Descheduler: node classification, migration arbitration, and the full
+reserve-then-evict loop interlocking with the scheduler."""
+
+import numpy as np
+
+from koordinator_tpu.api.objects import (
+    LABEL_POD_QOS,
+    Node,
+    NodeMetric,
+    NodeMetricInfo,
+    ObjectMeta,
+    Pod,
+    PodMigrationJob,
+    PodSpec,
+)
+from koordinator_tpu.api.resources import ResourceList, ResourceName
+from koordinator_tpu.client.store import (
+    KIND_NODE,
+    KIND_NODE_METRIC,
+    KIND_POD,
+    KIND_POD_MIGRATION_JOB,
+    KIND_RESERVATION,
+    ObjectStore,
+)
+from koordinator_tpu.descheduler import Arbitrator, Descheduler, MigrationController
+from koordinator_tpu.descheduler.lownodeload import (
+    LowNodeLoad,
+    LowNodeLoadArgs,
+    classify_nodes,
+)
+from koordinator_tpu.descheduler.migration import ArbitratorArgs
+from koordinator_tpu.scheduler.cycle import Scheduler
+
+GIB = 1024**3
+NOW = 1_000_000.0
+
+
+def _node(store, name, cores=16, usage_frac=None):
+    node = Node(
+        meta=ObjectMeta(name=name, namespace=""),
+        allocatable=ResourceList.of(cpu=cores * 1000, memory=64 * GIB, pods=110),
+    )
+    store.add(KIND_NODE, node)
+    if usage_frac is not None:
+        store.add(
+            KIND_NODE_METRIC,
+            NodeMetric(
+                meta=ObjectMeta(name=name, namespace=""),
+                update_time=NOW - 10,
+                node_metric=NodeMetricInfo(
+                    node_usage=ResourceList.of(
+                        cpu=int(cores * 1000 * usage_frac),
+                        memory=int(64 * GIB * 0.3),
+                    )
+                ),
+            ),
+        )
+    return node
+
+
+def _running_pod(store, name, node, cpu=2000, prio=5500, owner=("ReplicaSet", "rs1")):
+    pod = Pod(
+        meta=ObjectMeta(name=name, labels={LABEL_POD_QOS: "BE"},
+                        owner_kind=owner[0], owner_name=owner[1],
+                        creation_timestamp=NOW),
+        spec=PodSpec(node_name=node, priority=prio,
+                     requests=ResourceList.of(cpu=cpu, memory=4 * GIB)),
+        phase="Running",
+    )
+    store.add(KIND_POD, pod)
+    return pod
+
+
+class TestClassification:
+    def test_classify(self):
+        from koordinator_tpu.api.resources import NUM_RESOURCES, RESOURCE_INDEX
+
+        usage = np.zeros((3, NUM_RESOURCES), np.float32)
+        cpu = RESOURCE_INDEX[ResourceName.CPU]
+        usage[0, cpu] = 30.0   # low
+        usage[1, cpu] = 60.0   # mid
+        usage[2, cpu] = 90.0   # high
+        low_thr = np.zeros(NUM_RESOURCES, np.float32)
+        high_thr = np.zeros(NUM_RESOURCES, np.float32)
+        low_thr[cpu], high_thr[cpu] = 45.0, 70.0
+        low, high = classify_nodes(
+            usage, np.ones(3, bool), low_thr, high_thr
+        )
+        assert list(low) == [True, False, False]
+        assert list(high) == [False, False, True]
+
+    def test_no_metric_not_classified(self):
+        from koordinator_tpu.api.resources import NUM_RESOURCES
+
+        low, high = classify_nodes(
+            np.zeros((1, NUM_RESOURCES), np.float32),
+            np.zeros(1, bool),
+            np.full(NUM_RESOURCES, 45, np.float32),
+            np.full(NUM_RESOURCES, 70, np.float32),
+        )
+        assert not low[0] and not high[0]
+
+
+class TestLowNodeLoad:
+    def test_creates_jobs_for_high_nodes(self):
+        store = ObjectStore()
+        _node(store, "hot", usage_frac=0.9)
+        _node(store, "cold", usage_frac=0.2)
+        for i in range(3):
+            _running_pod(store, f"be-{i}", "hot", owner=("ReplicaSet", f"rs{i}"))
+        jobs = LowNodeLoad(store).balance(now=NOW)
+        assert jobs, "no migration jobs created for the hot node"
+        assert all(
+            store.get(KIND_POD, f"{j.pod_namespace}/{j.pod_name}").spec.node_name
+            == "hot"
+            for j in jobs
+        )
+
+    def test_no_jobs_without_low_nodes(self):
+        store = ObjectStore()
+        _node(store, "hot1", usage_frac=0.9)
+        _node(store, "hot2", usage_frac=0.9)
+        _running_pod(store, "p", "hot1")
+        assert LowNodeLoad(store).balance(now=NOW) == []
+
+
+class TestArbitrator:
+    def test_rate_limits(self):
+        store = ObjectStore()
+        _node(store, "n1", usage_frac=0.9)
+        pods = [
+            _running_pod(store, f"p{i}", "n1", owner=("ReplicaSet", "shared-rs"))
+            for i in range(4)
+        ]
+        jobs = [
+            PodMigrationJob(
+                meta=ObjectMeta(name=f"j{i}", namespace="koordinator-system",
+                                creation_timestamp=NOW + i),
+                pod_namespace="default", pod_name=f"p{i}",
+            )
+            for i in range(4)
+        ]
+        arb = Arbitrator(store, ArbitratorArgs(max_migrating_per_node=2,
+                                               max_migrating_per_workload=1))
+        admitted = arb.arbitrate(jobs)
+        # workload cap (1) binds before the node cap (2)
+        assert len(admitted) == 1
+        assert admitted[0].meta.name == "j0"  # earliest first
+
+
+class TestMigrationEndToEnd:
+    def test_reserve_then_evict_with_scheduler(self):
+        store = ObjectStore()
+        from tests.test_scheduler_e2e import make_store  # reuse fixtures
+
+        # hot node with a movable BE pod + cold empty node with metrics
+        store = make_store(num_nodes=2, cores=16, mem_gib=64)
+        hot_metric = store.get(KIND_NODE_METRIC, "/node-0")
+        hot_metric.node_metric.node_usage = ResourceList.of(
+            cpu=15_000, memory=20 * GIB
+        )
+        store.update(KIND_NODE_METRIC, hot_metric)
+        victim = _running_pod(store, "victim", "node-0", cpu=4000)
+
+        desched = Descheduler(store)
+        sched = Scheduler(store)
+
+        out1 = desched.run_once(now=NOW)
+        assert out1["jobs_created"] == 1
+        # job running, reservation created but not yet scheduled
+        desched.run_once(now=NOW + 1)
+        res = store.list(KIND_RESERVATION)[0]
+        assert res.phase == "Pending"
+
+        sched.run_cycle(now=NOW + 2)  # scheduler binds the reservation
+        res = store.list(KIND_RESERVATION)[0]
+        assert res.is_available
+        assert res.node_name == "node-1"  # not the hot source
+
+        desched.run_once(now=NOW + 3)  # now the victim is evicted
+        job = store.list(KIND_POD_MIGRATION_JOB)[0]
+        assert job.phase == "Succeeded"
+        victim = store.get(KIND_POD, "default/victim")
+        assert victim.phase == "Failed"
+        assert "migration" in victim.meta.annotations["koordinator.sh/evicted"]
+
+    def test_job_timeout(self):
+        store = ObjectStore()
+        _node(store, "n1", usage_frac=0.5)
+        _running_pod(store, "p", "n1")
+        job = PodMigrationJob(
+            meta=ObjectMeta(name="j", namespace="koordinator-system",
+                            creation_timestamp=NOW),
+            pod_namespace="default", pod_name="p", ttl_seconds=100,
+        )
+        store.add(KIND_POD_MIGRATION_JOB, job)
+        ctrl = MigrationController(store)
+        ctrl.reconcile(now=NOW + 1)   # admitted -> Running
+        ctrl.reconcile(now=NOW + 200)  # TTL exceeded
+        assert store.list(KIND_POD_MIGRATION_JOB)[0].phase == "Failed"

@@ -1,0 +1,307 @@
+"""koordlet tests against the fake /sys + /proc + cgroup tree (the reference's
+FileTestUtil pattern): metrics pipeline, NodeMetric reporting, QoS enforcement,
+runtime hooks, prediction, pleg, audit."""
+
+import json
+
+import pytest
+
+from koordinator_tpu.api.objects import (
+    ANNOTATION_RESOURCE_STATUS,
+    LABEL_POD_QOS,
+    Node,
+    NodeSLO,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceThresholdStrategy,
+)
+from koordinator_tpu.api.resources import ResourceList, ResourceName
+from koordinator_tpu.client.store import (
+    KIND_NODE,
+    KIND_NODE_METRIC,
+    KIND_NODE_SLO,
+    KIND_POD,
+    ObjectStore,
+)
+from koordinator_tpu.koordlet.daemon import Daemon
+from koordinator_tpu.koordlet.util import system as sysutil
+from koordinator_tpu.koordlet.util.system import FakeFS
+
+GIB = 1024**3
+NOW = 1_000_000.0
+
+
+@pytest.fixture
+def fs():
+    f = FakeFS(use_cgroup_v2=True)
+    yield f
+    f.cleanup()
+
+
+def setup_node(store, fs, cores=16, mem_gib=64):
+    store.add(
+        KIND_NODE,
+        Node(
+            meta=ObjectMeta(name="node-0", namespace=""),
+            allocatable=ResourceList.of(cpu=cores * 1000, memory=mem_gib * GIB),
+        ),
+    )
+    # /proc/stat: user nice system idle ... (jiffies)
+    fs.set_proc("stat", "cpu  1000 0 1000 8000 0 0 0 0 0 0\n")
+    fs.set_proc(
+        "meminfo",
+        "MemTotal: %d kB\nMemFree: %d kB\nMemAvailable: %d kB\n"
+        % (mem_gib * GIB // 1024, 32 * GIB // 1024, 48 * GIB // 1024),
+    )
+    fs.set_cgroup("", sysutil.CPU_PRESSURE,
+                  "some avg10=1.50 avg60=1.00 avg300=0.50 total=12345\n"
+                  "full avg10=0.50 avg60=0.30 avg300=0.10 total=2345\n")
+    fs.set_cgroup("", sysutil.MEMORY_PRESSURE,
+                  "some avg10=0.00 avg60=0.00 avg300=0.00 total=0\n"
+                  "full avg10=0.00 avg60=0.00 avg300=0.00 total=0\n")
+
+
+def add_pod(store, fs, name, qos="LS", cpu=2000, mem=2 * GIB, uid=None,
+            cpu_usage_us=10_000_000, mem_usage=GIB, annotations=None):
+    uid = uid or name
+    pod = Pod(
+        meta=ObjectMeta(name=name, uid=uid, labels={LABEL_POD_QOS: qos},
+                        annotations=annotations or {}),
+        spec=PodSpec(
+            node_name="node-0",
+            requests=ResourceList.of(cpu=cpu, memory=mem),
+            limits=ResourceList.of(cpu=cpu, memory=mem),
+        ),
+        phase="Running",
+    )
+    store.add(KIND_POD, pod)
+    qos_dir = sysutil.QOS_BESTEFFORT if qos == "BE" else ""
+    rel = fs.config.pod_relative_path(qos_dir, uid)
+    fs.set_cgroup(rel, sysutil.CPU_STAT, f"usage_usec {cpu_usage_us}\n")
+    fs.set_cgroup(rel, sysutil.MEMORY_USAGE, str(mem_usage))
+    return pod
+
+
+class TestMetricsPipeline:
+    def test_node_and_pod_metrics_collected(self, fs):
+        store = ObjectStore()
+        setup_node(store, fs)
+        add_pod(store, fs, "p1", cpu_usage_us=10_000_000)
+        daemon = Daemon(store, "node-0", fs.config, report_interval_seconds=0)
+        daemon.run_once(now=NOW)
+        # advance counters: +2 cores of pod usage over 10s; node 50% busy
+        # (delta total = 8000 jiffies, delta idle = 4000)
+        fs.set_proc("stat", "cpu  3000 0 3000 12000 0 0 0 0 0 0\n")
+        rel = fs.config.pod_relative_path("", "p1")
+        fs.set_cgroup(rel, sysutil.CPU_STAT, f"usage_usec {10_000_000 + 20_000_000}\n")
+        daemon.run_once(now=NOW + 10)
+
+        from koordinator_tpu.koordlet import metriccache as mc
+
+        pod_cpu = daemon.metric_cache.query(
+            mc.POD_CPU_USAGE, "latest", pod="default/p1"
+        )
+        assert pod_cpu == pytest.approx(2.0, rel=0.01)
+        node_cpu = daemon.metric_cache.query(mc.NODE_CPU_USAGE, "latest")
+        assert node_cpu == pytest.approx(16 * 0.5, rel=0.01)  # 50% busy of 16
+        psi = daemon.metric_cache.query(mc.NODE_CPU_PSI_FULL_AVG10, "latest")
+        assert psi == 0.5
+
+    def test_node_metric_cr_reported(self, fs):
+        store = ObjectStore()
+        setup_node(store, fs)
+        add_pod(store, fs, "p1")
+        daemon = Daemon(store, "node-0", fs.config, report_interval_seconds=0)
+        daemon.run_once(now=NOW)
+        fs.set_proc("stat", "cpu  3000 0 3000 12000 0 0 0 0 0 0\n")
+        daemon.run_once(now=NOW + 10)
+        nm = store.get(KIND_NODE_METRIC, "/node-0")
+        assert nm is not None
+        assert nm.update_time == NOW + 10
+        assert nm.node_metric.node_usage.get("cpu") > 0
+        assert any(pm.name == "p1" for pm in nm.pods_metric)
+        assert 300 in nm.node_metric.aggregated_node_usages
+        assert "p95" in nm.node_metric.aggregated_node_usages[300]
+
+
+class TestQoSManager:
+    def test_cpusuppress_writes_be_cpuset(self, fs):
+        store = ObjectStore()
+        setup_node(store, fs)
+        slo = NodeSLO(
+            meta=ObjectMeta(name="node-0", namespace=""),
+            resource_used_threshold_with_be=ResourceThresholdStrategy(
+                enable=True, cpu_suppress_threshold_percent=65
+            ),
+        )
+        store.add(KIND_NODE_SLO, slo)
+        add_pod(store, fs, "ls", qos="LS", cpu_usage_us=0)
+        add_pod(store, fs, "be", qos="BE", cpu_usage_us=0)
+        be_rel = fs.config.qos_relative_path(sysutil.QOS_BESTEFFORT)
+        fs.set_cgroup(be_rel, sysutil.CPU_STAT, "usage_usec 0\n")
+        daemon = Daemon(store, "node-0", fs.config, report_interval_seconds=0)
+        daemon.run_once(now=NOW)
+        fs.set_proc("stat", "cpu  5000 0 5000 8000 0 0 0 0 0 0\n")  # ~55% busy
+        daemon.run_once(now=NOW + 10)
+        raw = fs.get_cgroup(be_rel, sysutil.CPUSET_CPUS)
+        assert raw is not None
+        from koordinator_tpu.utils.cpuset import CPUSet
+
+        got = len(CPUSet.parse(raw))
+        # suppress = 16*0.65 - nonBE used (~8.8 cores) ~ 1.6 -> min 2
+        assert 2 <= got < 16
+
+    def test_memory_evict_gated_by_feature(self, fs):
+        store = ObjectStore()
+        setup_node(store, fs)
+        slo = NodeSLO(
+            meta=ObjectMeta(name="node-0", namespace=""),
+            resource_used_threshold_with_be=ResourceThresholdStrategy(
+                enable=True, memory_evict_threshold_percent=10
+            ),
+        )
+        store.add(KIND_NODE_SLO, slo)
+        add_pod(store, fs, "be", qos="BE")
+        daemon = Daemon(store, "node-0", fs.config, report_interval_seconds=0)
+        daemon.run_once(now=NOW)  # gate off by default -> no eviction
+        assert daemon.qos_manager.evictor.evicted == []
+
+        from koordinator_tpu.utils.features import KOORDLET_GATES
+
+        KOORDLET_GATES.set_from_map({"BEMemoryEvict": True})
+        try:
+            daemon.run_once(now=NOW + 10)
+            assert "default/be" in daemon.qos_manager.evictor.evicted
+        finally:
+            KOORDLET_GATES.reset()
+
+
+class TestRuntimeHooks:
+    def test_reconciler_applies_bvt_cpuset_batch(self, fs):
+        store = ObjectStore()
+        setup_node(store, fs)
+        pod = add_pod(
+            store, fs, "lsr", qos="LSR",
+            annotations={ANNOTATION_RESOURCE_STATUS: json.dumps({"cpuset": "0-3"})},
+        )
+        be = Pod(
+            meta=ObjectMeta(name="batch", uid="batch",
+                            labels={LABEL_POD_QOS: "BE"}),
+            spec=PodSpec(
+                node_name="node-0",
+                requests=ResourceList.of(batch_cpu=2000, batch_memory=GIB),
+                limits=ResourceList.of(batch_cpu=2000, batch_memory=GIB),
+            ),
+            phase="Running",
+        )
+        store.add(KIND_POD, be)
+        daemon = Daemon(store, "node-0", fs.config, report_interval_seconds=0)
+        daemon.run_once(now=NOW)
+        lsr_rel = fs.config.pod_relative_path("", "lsr")
+        assert fs.get_cgroup(lsr_rel, sysutil.CPU_BVT_WARP_NS) == "2"
+        assert fs.get_cgroup(lsr_rel, sysutil.CPUSET_CPUS) == "0-3"
+        be_rel = fs.config.pod_relative_path(sysutil.QOS_BESTEFFORT, "batch")
+        assert fs.get_cgroup(be_rel, sysutil.CPU_BVT_WARP_NS) == "-1"
+        assert fs.get_cgroup(be_rel, sysutil.CPU_CFS_QUOTA) == "200000"
+        assert fs.get_cgroup(be_rel, sysutil.MEMORY_LIMIT) == str(GIB)
+
+    def test_gpu_env_injection(self, fs):
+        from koordinator_tpu.api.objects import ANNOTATION_DEVICE_ALLOCATED
+        from koordinator_tpu.koordlet.runtimehooks import ContainerContext
+
+        store = ObjectStore()
+        setup_node(store, fs)
+        pod = add_pod(
+            store, fs, "gpu", qos="LS",
+            annotations={
+                ANNOTATION_DEVICE_ALLOCATED: json.dumps(
+                    {"gpu": [{"minor": 1, "core": 50}]}
+                )
+            },
+        )
+        daemon = Daemon(store, "node-0", fs.config, report_interval_seconds=0)
+        ctx = ContainerContext(pod=pod, cgroup_parent="x")
+        daemon.runtime_hooks.run_hooks(ctx)
+        assert ctx.env["NVIDIA_VISIBLE_DEVICES"] == "1"
+        assert ctx.env["CUDA_MPS_ACTIVE_THREAD_PERCENTAGE"] == "50"
+
+
+class TestInfraPieces:
+    def test_executor_cache_suppresses_redundant_writes(self, fs):
+        from koordinator_tpu.koordlet.resourceexecutor import (
+            ResourceUpdateExecutor,
+            ResourceUpdater,
+        )
+
+        ex = ResourceUpdateExecutor(fs.config)
+        up = ResourceUpdater("kubepods", sysutil.CPU_SHARES, "1024")
+        assert ex.update(up) is True
+        assert ex.update(up) is False  # cached
+        assert ex.update(up, force=True) is True
+        assert len(ex.auditor) == 2
+
+    def test_pleg_detects_pod_dirs(self, fs):
+        from koordinator_tpu.koordlet.pleg import Pleg
+
+        pleg = Pleg(fs.config)
+        events = []
+        pleg.add_handler(events.append)
+        fs.set_cgroup("kubepods/podx", sysutil.CPU_SHARES, "2")
+        pleg.tick()  # baseline
+        fs.set_cgroup("kubepods/pody", sysutil.CPU_SHARES, "2")
+        out = pleg.tick()
+        assert [e.event_type for e in out] == ["pod_added"]
+        assert "pody" in out[0].pod_dir
+
+    def test_prediction_checkpoint_roundtrip(self, tmp_path):
+        from koordinator_tpu.koordlet.prediction import PeakPredictServer
+
+        p = PeakPredictServer(str(tmp_path))
+        for i in range(100):
+            p.update("uid-1", 2.0, 4 * GIB, timestamp=NOW + i * 60)
+        peak = p.predict_peak("uid-1", now=NOW + 100 * 60)
+        assert peak is not None
+        assert peak[0] >= 2.0
+        p.checkpoint()
+        p2 = PeakPredictServer(str(tmp_path))
+        assert p2.predict_peak("uid-1", now=NOW + 100 * 60) == peak
+
+    def test_prediction_cold_start(self):
+        from koordinator_tpu.koordlet.prediction import PeakPredictServer
+
+        p = PeakPredictServer()
+        p.update("uid-1", 1.0, GIB, timestamp=NOW)
+        assert p.predict_peak("uid-1", now=NOW + 60) is None  # cold start
+
+    def test_psi_parse(self):
+        psi = sysutil.parse_psi(
+            "some avg10=1.50 avg60=1.00 avg300=0.50 total=12345\n"
+            "full avg10=0.25 avg60=0.10 avg300=0.05 total=999\n"
+        )
+        assert psi.some_avg10 == 1.5
+        assert psi.full_total_us == 999
+
+    def test_cgroup_v1_paths(self):
+        cfg = sysutil.SystemConfig(cgroup_root_dir="/cg", use_cgroup_v2=False)
+        assert (
+            cfg.cgroup_file_path("kubepods/besteffort", sysutil.CPUSET_CPUS)
+            == "/cg/cpuset/kubepods/besteffort/cpuset.cpus"
+        )
+        cfg2 = sysutil.SystemConfig(cgroup_root_dir="/cg", use_cgroup_v2=True)
+        assert (
+            cfg2.cgroup_file_path("kubepods", sysutil.MEMORY_LIMIT)
+            == "/cg/kubepods/memory.max"
+        )
+
+    def test_daemon_auditor_receives_executor_writes(self, fs):
+        """Regression: passing an (empty, falsy) Auditor must not be replaced
+        by a fresh one inside the executor."""
+        store = ObjectStore()
+        setup_node(store, fs)
+        add_pod(store, fs, "p1")
+        daemon = Daemon(store, "node-0", fs.config, report_interval_seconds=0)
+        daemon.run_once(now=NOW)
+        assert len(daemon.auditor) > 0
+        events, _ = daemon.auditor.query()
+        assert any(e.operation == "cgroup_write" for e in events)

@@ -1,0 +1,329 @@
+"""koord-manager components: slo controllers, quota profile controller,
+admission webhooks."""
+
+import json
+
+import pytest
+
+from koordinator_tpu.api.objects import (
+    ANNOTATION_EXTENDED_RESOURCE_SPEC,
+    ClusterColocationProfile,
+    ConfigMap,
+    ElasticQuota,
+    LABEL_POD_QOS,
+    LABEL_QUOTA_IS_PARENT,
+    LABEL_QUOTA_PARENT,
+    ElasticQuotaProfile,
+    Node,
+    NodeMetric,
+    NodeMetricInfo,
+    ObjectMeta,
+    Pod,
+    PodMetricInfo,
+    PodSpec,
+)
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.api.resources import ResourceList, ResourceName
+from koordinator_tpu.client.store import (
+    KIND_COLOCATION_PROFILE,
+    KIND_CONFIG_MAP,
+    KIND_ELASTIC_QUOTA,
+    KIND_NODE,
+    KIND_NODE_METRIC,
+    KIND_NODE_SLO,
+    KIND_POD,
+    KIND_QUOTA_PROFILE,
+    ObjectStore,
+)
+from koordinator_tpu.quotacontroller import QuotaProfileController
+from koordinator_tpu.slocontroller import (
+    NodeMetricController,
+    NodeResourceController,
+    NodeSLOController,
+)
+from koordinator_tpu.utils.sloconfig import ColocationConfig, ColocationStrategy
+from koordinator_tpu.webhook import AdmissionError, AdmissionServer
+
+GIB = 1024**3
+NOW = 1_000_000.0
+
+
+def _node(store, name="node-0", cores=100, mem_gib=400, labels=None):
+    node = Node(
+        meta=ObjectMeta(name=name, namespace="", labels=labels or {}),
+        allocatable=ResourceList.of(cpu=cores * 1000, memory=mem_gib * GIB),
+        capacity=ResourceList.of(cpu=cores * 1000, memory=mem_gib * GIB),
+    )
+    store.add(KIND_NODE, node)
+    return node
+
+
+class TestNodeMetricController:
+    def test_creates_and_gc(self):
+        store = ObjectStore()
+        _node(store, "a")
+        _node(store, "b")
+        ctrl = NodeMetricController(store)
+        assert ctrl.reconcile() == 2
+        assert store.get(KIND_NODE_METRIC, "/a") is not None
+        store.delete(KIND_NODE, "/b")
+        assert ctrl.reconcile() == 1
+        assert store.get(KIND_NODE_METRIC, "/b") is None
+
+
+class TestNodeResourceController:
+    def _with_metric(self, store, node, cpu_used=50_000, mem_used=200 * GIB,
+                     pods=()):
+        nm = NodeMetric(
+            meta=ObjectMeta(name=node.meta.name, namespace=""),
+            update_time=NOW - 60,
+            node_metric=NodeMetricInfo(
+                node_usage=ResourceList.of(cpu=cpu_used, memory=mem_used)
+            ),
+            pods_metric=list(pods),
+        )
+        store.add(KIND_NODE_METRIC, nm)
+        return nm
+
+    def test_batch_formula(self):
+        store = ObjectStore()
+        node = _node(store)  # 100 cores, 400 GiB
+        # one prod pod using 30 cores / 100 GiB, requesting 40 cores / 150 GiB
+        pod = Pod(
+            meta=ObjectMeta(name="prod", labels={LABEL_POD_QOS: "LS"}),
+            spec=PodSpec(
+                node_name="node-0",
+                priority=9500,
+                requests=ResourceList.of(cpu=40_000, memory=150 * GIB),
+            ),
+            phase="Running",
+        )
+        store.add(KIND_POD, pod)
+        self._with_metric(
+            store, node, cpu_used=35_000, mem_used=120 * GIB,
+            pods=[
+                PodMetricInfo(
+                    namespace="default", name="prod",
+                    pod_usage=ResourceList.of(cpu=30_000, memory=100 * GIB),
+                )
+            ],
+        )
+        cfg = ColocationConfig(
+            cluster_strategy=ColocationStrategy(
+                enable=True,
+                cpu_reclaim_threshold_percent=65,
+                memory_reclaim_threshold_percent=65,
+            )
+        )
+        ctrl = NodeResourceController(store, cfg)
+        assert ctrl.reconcile(now=NOW) == 1
+        node = store.get(KIND_NODE, "/node-0")
+        # batch cpu = 100000*0.65 - systemUsed(35000-30000=5000) - podHPUsed(30000)
+        assert node.allocatable[ResourceName.BATCH_CPU] == 65_000 - 5_000 - 30_000
+        # batch mem = 400GiB*0.65 - (120-100)GiB - 100GiB = 140 GiB
+        expected_mem = int(400 * 0.65 - 20 - 100)
+        assert node.allocatable[ResourceName.BATCH_MEMORY] == expected_mem * GIB
+
+    def test_degrade_on_stale_metric(self):
+        store = ObjectStore()
+        node = _node(store)
+        nm = self._with_metric(store, node)
+        nm.update_time = NOW - 3600  # stale beyond 15min degrade window
+        store.update(KIND_NODE_METRIC, nm)
+        ctrl = NodeResourceController(
+            store, ColocationConfig(ColocationStrategy(enable=True))
+        )
+        ctrl.reconcile(now=NOW)
+        node = store.get(KIND_NODE, "/node-0")
+        assert node.allocatable[ResourceName.BATCH_CPU] == 0
+        assert node.allocatable[ResourceName.BATCH_MEMORY] == 0
+
+    def test_request_policy_for_memory(self):
+        store = ObjectStore()
+        node = _node(store)
+        pod = Pod(
+            meta=ObjectMeta(name="prod", labels={LABEL_POD_QOS: "LS"}),
+            spec=PodSpec(
+                node_name="node-0", priority=9500,
+                requests=ResourceList.of(cpu=40_000, memory=150 * GIB),
+            ),
+            phase="Running",
+        )
+        store.add(KIND_POD, pod)
+        self._with_metric(store, node, cpu_used=35_000, mem_used=120 * GIB,
+                          pods=[PodMetricInfo(namespace="default", name="prod",
+                                              pod_usage=ResourceList.of(cpu=30_000, memory=100 * GIB))])
+        cfg = ColocationConfig(
+            ColocationStrategy(enable=True, memory_calculate_policy="request",
+                               memory_reclaim_threshold_percent=100)
+        )
+        NodeResourceController(store, cfg).reconcile(now=NOW)
+        node = store.get(KIND_NODE, "/node-0")
+        # by request: 400GiB - podHPReq(150GiB) = 250GiB
+        assert node.allocatable[ResourceName.BATCH_MEMORY] == 250 * GIB
+
+
+class TestNodeSLOController:
+    def test_render_from_configmap(self):
+        store = ObjectStore()
+        _node(store, "a", labels={"pool": "batch"})
+        _node(store, "b")
+        store.add(
+            KIND_CONFIG_MAP,
+            ConfigMap(
+                meta=ObjectMeta(name="slo-controller-config",
+                                namespace="koordinator-system"),
+                data={
+                    "resource-threshold-config": json.dumps(
+                        {
+                            "clusterStrategy": {
+                                "enable": True,
+                                "cpuSuppressThresholdPercent": 60,
+                            },
+                            "nodeStrategies": [
+                                {
+                                    "nodeSelector": {"pool": "batch"},
+                                    "cpuSuppressThresholdPercent": 80,
+                                }
+                            ],
+                        }
+                    )
+                },
+            ),
+        )
+        ctrl = NodeSLOController(store)
+        assert ctrl.reconcile() == 2
+        slo_a = store.get(KIND_NODE_SLO, "/a")
+        slo_b = store.get(KIND_NODE_SLO, "/b")
+        assert slo_a.resource_used_threshold_with_be.cpu_suppress_threshold_percent == 80
+        assert slo_b.resource_used_threshold_with_be.cpu_suppress_threshold_percent == 60
+        assert slo_a.resource_used_threshold_with_be.enable
+        # idempotent
+        assert ctrl.reconcile() == 0
+
+
+class TestQuotaProfileController:
+    def test_generate_quota_from_node_group(self):
+        store = ObjectStore()
+        _node(store, "a", cores=10, mem_gib=40, labels={"zone": "z1"})
+        _node(store, "b", cores=10, mem_gib=40, labels={"zone": "z1"})
+        _node(store, "c", cores=10, mem_gib=40, labels={"zone": "z2"})
+        profile = ElasticQuotaProfile(
+            meta=ObjectMeta(name="profile-z1", namespace="default",
+                            annotations={"quota.scheduling.koordinator.sh/total-resource-ratio": "0.9"}),
+            quota_name="quota-z1",
+            node_selector={"zone": "z1"},
+        )
+        store.add(KIND_QUOTA_PROFILE, profile)
+        ctrl = QuotaProfileController(store)
+        assert ctrl.reconcile() == 1
+        quota = store.get(KIND_ELASTIC_QUOTA, "default/quota-z1")
+        assert quota is not None
+        assert quota.min[ResourceName.CPU] == int(20_000 * 0.9)
+        assert quota.is_parent
+        # node change refreshes
+        _node(store, "d", cores=10, mem_gib=40, labels={"zone": "z1"})
+        assert ctrl.reconcile() == 1
+        assert store.get(
+            KIND_ELASTIC_QUOTA, "default/quota-z1"
+        ).min[ResourceName.CPU] == int(30_000 * 0.9)
+
+
+class TestWebhooks:
+    def test_colocation_profile_mutation(self):
+        store = ObjectStore()
+        store.add(
+            KIND_COLOCATION_PROFILE,
+            ClusterColocationProfile(
+                meta=ObjectMeta(name="batch-profile"),
+                selector={"koordinator-colocation": "true"},
+                qos_class=QoSClass.BE,
+                priority_class_name="koord-batch",
+                scheduler_name="koord-scheduler",
+                labels={"injected": "yes"},
+            ),
+        )
+        server = AdmissionServer(store)
+        pod = Pod(
+            meta=ObjectMeta(name="spark", labels={"koordinator-colocation": "true"}),
+            spec=PodSpec(requests=ResourceList.of(cpu=4000, memory=8 * GIB),
+                         limits=ResourceList.of(cpu=4000, memory=8 * GIB)),
+        )
+        server.admit_pod_create(pod)
+        assert pod.qos_class is QoSClass.BE
+        assert pod.spec.priority == 5999
+        assert pod.meta.labels["injected"] == "yes"
+        # requests translated to batch resources
+        assert pod.spec.requests[ResourceName.CPU] == 0
+        assert pod.spec.requests[ResourceName.BATCH_CPU] == 4000
+        assert pod.spec.requests[ResourceName.BATCH_MEMORY] == 8 * GIB
+        assert ANNOTATION_EXTENDED_RESOURCE_SPEC in pod.meta.annotations
+
+    def test_pod_validation_rules(self):
+        server = AdmissionServer(ObjectStore())
+        bad = Pod(
+            meta=ObjectMeta(name="x", labels={LABEL_POD_QOS: "BE"}),
+            spec=PodSpec(priority=9500),
+        )
+        with pytest.raises(AdmissionError):
+            server.validate_pod(bad)
+        frac = Pod(
+            meta=ObjectMeta(name="y", labels={LABEL_POD_QOS: "LSR"}),
+            spec=PodSpec(priority=9500,
+                         requests=ResourceList.of(cpu=1500)),
+        )
+        with pytest.raises(AdmissionError):
+            server.validate_pod(frac)
+        ok = Pod(
+            meta=ObjectMeta(name="z", labels={LABEL_POD_QOS: "LSR"}),
+            spec=PodSpec(priority=9500, requests=ResourceList.of(cpu=2000)),
+        )
+        server.validate_pod(ok)
+
+    def test_quota_validation(self):
+        store = ObjectStore()
+        server = AdmissionServer(store)
+        with pytest.raises(AdmissionError):
+            server.validate_elastic_quota(
+                ElasticQuota(
+                    meta=ObjectMeta(name="bad"),
+                    min=ResourceList.of(cpu=2000),
+                    max=ResourceList.of(cpu=1000),
+                )
+            )
+        orphan = ElasticQuota(
+            meta=ObjectMeta(name="child",
+                            labels={LABEL_QUOTA_PARENT: "nonexistent"}),
+        )
+        with pytest.raises(AdmissionError):
+            server.validate_elastic_quota(orphan)
+        store.add(
+            KIND_ELASTIC_QUOTA,
+            ElasticQuota(
+                meta=ObjectMeta(name="parent", namespace="default",
+                                labels={LABEL_QUOTA_IS_PARENT: "true"}),
+                min=ResourceList.of(cpu=10_000),
+            ),
+        )
+        child = ElasticQuota(
+            meta=ObjectMeta(name="child", namespace="default",
+                            labels={LABEL_QUOTA_PARENT: "parent"}),
+            min=ResourceList.of(cpu=5000),
+        )
+        server.validate_elastic_quota(child)
+
+    def test_configmap_validation(self):
+        server = AdmissionServer(ObjectStore())
+        bad = ConfigMap(
+            meta=ObjectMeta(name="slo-controller-config"),
+            data={"colocation-config": json.dumps(
+                {"cpuReclaimThresholdPercent": 150}
+            )},
+        )
+        with pytest.raises(AdmissionError):
+            server.validate_config_map(bad)
+        good = ConfigMap(
+            meta=ObjectMeta(name="slo-controller-config"),
+            data={"colocation-config": json.dumps({"enable": True})},
+        )
+        server.validate_config_map(good)
