@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Regenerate protobuf message classes (analog of reference hack/generate-runtime.sh).
+# grpc service stubs are hand-wired (no grpc_tools in the image), so only
+# --python_out is needed.
+set -euo pipefail
+cd "$(dirname "$0")/../koordinator_tpu/runtimeproxy"
+protoc --python_out=. -I. api.proto
+cd ../scheduler
+protoc --python_out=. -I. sidecar.proto
+echo "generated api_pb2.py + sidecar_pb2.py"

@@ -115,10 +115,14 @@ class MigrationController:
                 changes += 1
                 continue
             pod = self.store.get(KIND_POD, f"{job.pod_namespace}/{job.pod_name}")
-            if pod is None or not pod.is_assigned or pod.is_terminated:
-                job.phase = "Succeeded" if pod is None or pod.is_terminated else job.phase
+            if pod is None or pod.is_terminated:
+                job.phase = "Succeeded"
                 self.store.update(KIND_POD_MIGRATION_JOB, job)
                 changes += 1
+                continue
+            if not pod.is_assigned:
+                # pod fell back to pending (binding rolled back): wait without
+                # rewriting the unchanged job every pass
                 continue
             if job.mode == "ReservationFirst":
                 changes += self._reserve_then_evict(job, pod, now)

@@ -41,6 +41,12 @@ class Daemon:
         self.metrics_advisor = MetricsAdvisor(
             self.states_informer, self.metric_cache, self.config
         )
+        from koordinator_tpu.utils.features import KOORDLET_GATES
+
+        if KOORDLET_GATES.enabled("CPICollector"):
+            from koordinator_tpu.native.perf import build_cgroup_perf_reader
+
+            self.metrics_advisor.perf_reader = build_cgroup_perf_reader(self.config)
         self.prediction = PeakPredictServer(checkpoint_dir)
         self.qos_manager = QoSManager(
             store, self.states_informer, self.metric_cache, self.executor

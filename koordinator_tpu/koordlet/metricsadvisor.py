@@ -119,7 +119,8 @@ class MetricsAdvisor:
         performance_collector_linux.go:46-101; gated like Libpfm4/CPICollector)."""
         if not KOORDLET_GATES.enabled("CPICollector") or self.perf_reader is None:
             return
-        for pod in self.informer.get_all_pods():
+        pods = self.informer.get_all_pods()
+        for pod in pods:
             sample = self.perf_reader(pod)
             if sample is None:
                 continue
@@ -128,6 +129,9 @@ class MetricsAdvisor:
                 self.cache.add_sample(
                     mc.POD_CPI, cycles / instructions, now, pod=pod.meta.key
                 )
+        gc = getattr(self.perf_reader, "gc", None)
+        if gc is not None:
+            gc(p.meta.key for p in pods)
 
     def collect_once(self, now: Optional[float] = None) -> None:
         now = time.time() if now is None else now

@@ -54,6 +54,7 @@ class StatesInformer:
         self.aggregate_windows = tuple(aggregate_windows)
         self._callbacks: Dict[str, List[Callable]] = {}
         self._last_report = 0.0
+        self._pods_by_uid: Dict[str, Pod] = {}
         store.subscribe(KIND_POD, self._on_pod)
         store.subscribe(KIND_NODE_SLO, self._on_nodeslo)
         store.subscribe(KIND_NODE, self._on_node)
@@ -83,9 +84,20 @@ class StatesInformer:
         for fn in self._callbacks.get(kind, []):
             fn(obj)
 
+    def get_pod_by_uid(self, uid: str) -> Optional[Pod]:
+        """O(1) lookup for the hook server's per-RPC critical path."""
+        return self._pods_by_uid.get(uid)
+
     def _on_pod(self, ev: EventType, pod: Pod, old) -> None:
-        if pod.spec.node_name == self.node_name:
-            self._fire(CALLBACK_PODS, pod)
+        if pod.spec.node_name != self.node_name:
+            return
+        uid = pod.meta.uid
+        if uid:
+            if ev is EventType.DELETED:
+                self._pods_by_uid.pop(uid, None)
+            else:
+                self._pods_by_uid[uid] = pod
+        self._fire(CALLBACK_PODS, pod)
 
     def _on_nodeslo(self, ev: EventType, slo: NodeSLO, old) -> None:
         if slo.meta.name == self.node_name:

@@ -1,0 +1,3 @@
+"""Native C++ components (reference SURVEY.md 2.1: the cgo/libpfm4 binding is
+the reference's one native component; rebuilt here as a direct
+perf_event_open(2) syscall binding in C++ with a ctypes Python wrapper)."""

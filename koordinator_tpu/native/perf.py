@@ -1,0 +1,174 @@
+"""ctypes binding for the native perf counter reader (libkoordperf.so).
+
+Python side of the reference's libpfm4 cgo component (perf_group_linux.go):
+opens a cycles+instructions group per cgroup (or the current process), reads
+cumulative counters, computes CPI. Degrades gracefully — if the library isn't
+built or perf_event_open is denied (containers commonly set
+perf_event_paranoid), `available()` is False and the CPI collector stays off,
+matching the Libpfm4/CPICollector feature-gate behavior."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+_LIB_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_LIB_DIR, "libkoordperf.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def build(timeout: int = 120) -> bool:
+    """Compile libkoordperf.so via the Makefile. Deliberately NOT called from
+    the load path: the daemon must never block on a compiler at startup — run
+    this from packaging/tests (`make -C koordinator_tpu/native`)."""
+    try:
+        subprocess.run(
+            ["make", "-C", _LIB_DIR, "-s"],
+            check=True,
+            capture_output=True,
+            timeout=timeout,
+        )
+        return True
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.koordperf_open_group.restype = ctypes.c_long
+    lib.koordperf_open_group.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.koordperf_read.restype = ctypes.c_int
+    lib.koordperf_read.argtypes = [
+        ctypes.c_long,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.koordperf_close.restype = None
+    lib.koordperf_close.argtypes = [ctypes.c_long]
+    _lib = lib
+    return lib
+
+
+class PerfGroup:
+    """One cycles+instructions counter group."""
+
+    def __init__(self, handle: int):
+        self._handle = handle
+
+    @staticmethod
+    def open_self(cpu: int = -1) -> Optional["PerfGroup"]:
+        """Counters for the current process (any cpu)."""
+        lib = _load()
+        if lib is None:
+            return None
+        handle = lib.koordperf_open_group(0, cpu, 0)
+        return PerfGroup(handle) if handle > 0 else None
+
+    @staticmethod
+    def open_cgroup(cgroup_dir: str, cpu: int = 0) -> Optional["PerfGroup"]:
+        """Counters for a cgroup (per-cpu, as perf requires for cgroup mode)."""
+        lib = _load()
+        if lib is None:
+            return None
+        try:
+            fd = os.open(cgroup_dir, os.O_RDONLY)
+        except OSError:
+            return None
+        handle = lib.koordperf_open_group(fd, cpu, 1)
+        os.close(fd)
+        return PerfGroup(handle) if handle > 0 else None
+
+    def read(self) -> Optional[Tuple[int, int]]:
+        """(cycles, instructions), cumulative since open."""
+        lib = _load()
+        if lib is None or self._handle <= 0:
+            return None
+        cycles = ctypes.c_uint64()
+        instructions = ctypes.c_uint64()
+        rc = lib.koordperf_read(
+            self._handle, ctypes.byref(cycles), ctypes.byref(instructions)
+        )
+        if rc != 0:
+            return None
+        return cycles.value, instructions.value
+
+    def cpi(self) -> Optional[float]:
+        sample = self.read()
+        if not sample or sample[1] == 0:
+            return None
+        return sample[0] / sample[1]
+
+    def close(self) -> None:
+        lib = _load()
+        if lib is not None and self._handle > 0:
+            lib.koordperf_close(self._handle)
+            self._handle = 0
+
+
+def available() -> bool:
+    """True when the native lib loads AND the kernel permits perf events."""
+    g = PerfGroup.open_self()
+    if g is None:
+        return False
+    ok = g.read() is not None
+    g.close()
+    return ok
+
+
+class CgroupPerfReader:
+    """Per-pod CPI sampler used by the performance collector
+    (metricsadvisor.collect_performance): one perf group per pod cgroup,
+    per-tick (cycles, instructions) deltas. `gc(live_keys)` closes groups for
+    departed pods — without it, pod churn leaks perf-event fds until EMFILE."""
+
+    def __init__(self, config):
+        self.config = config
+        self.groups = {}
+        self.last = {}
+
+    def __call__(self, pod):
+        from koordinator_tpu.koordlet.metricsadvisor import pod_qos_dir
+
+        rel = self.config.pod_relative_path(
+            pod_qos_dir(pod), pod.meta.uid or pod.meta.name
+        )
+        path = self.config.cgroup_file_path(rel, "cpu.max")
+        cgroup_dir = os.path.dirname(path)
+        key = pod.meta.key
+        if key not in self.groups:
+            g = PerfGroup.open_cgroup(cgroup_dir)
+            if g is None:
+                return None
+            self.groups[key] = g
+        sample = self.groups[key].read()
+        if sample is None:
+            return None
+        prev = self.last.get(key, (0, 0))
+        self.last[key] = sample
+        return (sample[0] - prev[0], sample[1] - prev[1])
+
+    def gc(self, live_keys) -> None:
+        live = set(live_keys)
+        for key in list(self.groups):
+            if key not in live:
+                self.groups.pop(key).close()
+                self.last.pop(key, None)
+
+    def close(self) -> None:
+        self.gc(())
+
+
+def build_cgroup_perf_reader(config):
+    """CgroupPerfReader, or None when perf is unusable on this host."""
+    if not available():
+        return None
+    return CgroupPerfReader(config)

@@ -98,7 +98,11 @@ class DeviceSharePlugin(Plugin):
             if remaining_core > 100 and take < 100:
                 continue  # whole-gpu requests need whole gpus
             # memory/ratio are split across picks in proportion to core take
-            ratio_share = int(want.get("memory_ratio", take) * take / total_core)
+            # (the implicit ratio default follows the core request: total_core,
+            # NOT take — proportional split then yields `take` per pick)
+            ratio_share = int(
+                want.get("memory_ratio", total_core) * take / total_core
+            )
             mem_share = int(want.get("memory", 0) * take / total_core)
             used["core"] += take
             used["memory_ratio"] += ratio_share

@@ -1,0 +1,156 @@
+"""Batched scheduling sidecar: gRPC service around the fused kernel.
+
+Server side runs next to the TPU; the host scheduler (the reference's Go event
+loop, or our Python cycle driver on another machine) packs its caches into
+tensors and calls ScheduleBatch. Step functions are cached by (shapes, gangs,
+flags) exactly like the in-process cycle driver."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from koordinator_tpu.models.full_chain import FullChainInputs, build_full_chain_step
+from koordinator_tpu.models.scheduler_model import ScheduleInputs
+from koordinator_tpu.ops.loadaware import LoadAwareArgs
+from koordinator_tpu.scheduler import sidecar_pb2
+
+SERVICE_NAME = "koordinator.scheduler.v1.BatchedScheduler"
+
+_DTYPES = {"float32": np.float32, "int32": np.int32, "bool": np.bool_}
+
+
+def tensor_to_np(t: sidecar_pb2.Tensor) -> np.ndarray:
+    arr = np.frombuffer(t.data, dtype=_DTYPES[t.dtype])
+    return arr.reshape(tuple(t.shape)).copy()
+
+
+def np_to_tensor(a: np.ndarray) -> sidecar_pb2.Tensor:
+    a = np.asarray(a)
+    dtype = {"float32": "float32", "int32": "int32", "bool": "bool"}[str(a.dtype)]
+    return sidecar_pb2.Tensor(shape=list(a.shape), dtype=dtype, data=a.tobytes())
+
+
+def pack_request(fc: FullChainInputs, num_gangs: int, num_groups: int,
+                 args: LoadAwareArgs, active_axes=None,
+                 snapshot_version: int = 0) -> sidecar_pb2.ScheduleBatchRequest:
+    req = sidecar_pb2.ScheduleBatchRequest(
+        num_gangs=num_gangs,
+        num_groups=num_groups,
+        score_according_prod_usage=args.score_according_prod_usage,
+        snapshot_version=snapshot_version,
+    )
+    if active_axes is not None:
+        req.active_axes.extend(int(a) for a in active_axes)
+    for name, value in fc.base._asdict().items():
+        req.inputs[f"base.{name}"].CopyFrom(np_to_tensor(np.asarray(value)))
+    for name, value in fc._asdict().items():
+        if name == "base":
+            continue
+        req.inputs[name].CopyFrom(np_to_tensor(np.asarray(value)))
+    return req
+
+
+def unpack_request(req: sidecar_pb2.ScheduleBatchRequest) -> Tuple[FullChainInputs, LoadAwareArgs]:
+    import jax.numpy as jnp
+
+    base_kwargs = {}
+    fc_kwargs = {}
+    for name, tensor in req.inputs.items():
+        arr = jnp.asarray(tensor_to_np(tensor))
+        if name.startswith("base."):
+            base_kwargs[name[5:]] = arr
+        else:
+            fc_kwargs[name] = arr
+    fc = FullChainInputs(base=ScheduleInputs(**base_kwargs), **fc_kwargs)
+    args = LoadAwareArgs(score_according_prod_usage=req.score_according_prod_usage)
+    return fc, args
+
+
+class SidecarServer:
+    """Request handler; transport added by serve_sidecar."""
+
+    def __init__(self) -> None:
+        self._steps: Dict[Tuple, object] = {}
+
+    def ScheduleBatch(self, request: sidecar_pb2.ScheduleBatchRequest):
+        import time
+
+        fc, args = unpack_request(request)
+        active = tuple(request.active_axes) or None
+        key = (
+            fc.base.fit_requests.shape,
+            fc.numa_free.shape,
+            fc.quota_runtime.shape,
+            int(request.num_gangs),
+            int(request.num_groups),
+            request.score_according_prod_usage,
+            active,
+        )
+        if key not in self._steps:
+            self._steps[key] = build_full_chain_step(
+                args, int(request.num_gangs), int(request.num_groups),
+                active_axes=list(active) if active else None,
+            )
+        t0 = time.perf_counter()
+        chosen, requested, quota_used = self._steps[key](fc)
+        chosen = np.asarray(chosen)
+        dt = time.perf_counter() - t0
+        return sidecar_pb2.ScheduleBatchResponse(
+            chosen=np_to_tensor(chosen),
+            requested=np_to_tensor(np.asarray(requested)),
+            quota_used=np_to_tensor(np.asarray(quota_used)),
+            snapshot_version=request.snapshot_version,
+            kernel_seconds=dt,
+        )
+
+
+def serve_sidecar(address: str, server_impl: Optional[SidecarServer] = None):
+    """Start the gRPC server; address like 'unix:///tmp/x.sock' or '[::]:50051'."""
+    import grpc
+    from concurrent import futures
+
+    impl = server_impl or SidecarServer()
+    handler = grpc.method_handlers_generic_handler(
+        SERVICE_NAME,
+        {
+            "ScheduleBatch": grpc.unary_unary_rpc_method_handler(
+                lambda req, ctx: impl.ScheduleBatch(req),
+                request_deserializer=sidecar_pb2.ScheduleBatchRequest.FromString,
+                response_serializer=sidecar_pb2.ScheduleBatchResponse.SerializeToString,
+            )
+        },
+    )
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=2),
+        options=[("grpc.max_receive_message_length", 1 << 30),
+                 ("grpc.max_send_message_length", 1 << 30)],
+    )
+    server.add_generic_rpc_handlers((handler,))
+    server.add_insecure_port(address)
+    server.start()
+    return server
+
+
+class SidecarClient:
+    def __init__(self, address: str, timeout_seconds: float = 120.0):
+        import grpc
+
+        self._channel = grpc.insecure_channel(
+            address,
+            options=[("grpc.max_receive_message_length", 1 << 30),
+                     ("grpc.max_send_message_length", 1 << 30)],
+        )
+        self._timeout = timeout_seconds
+        self._call = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/ScheduleBatch",
+            request_serializer=sidecar_pb2.ScheduleBatchRequest.SerializeToString,
+            response_deserializer=sidecar_pb2.ScheduleBatchResponse.FromString,
+        )
+
+    def schedule_batch(self, request) -> sidecar_pb2.ScheduleBatchResponse:
+        return self._call(request, timeout=self._timeout)
+
+    def close(self) -> None:
+        self._channel.close()

@@ -139,12 +139,18 @@ class NodeResourceController:
                     data = json.loads(raw)
                     from koordinator_tpu.api.resources import parse_quantity
 
-                    node_reserved[i] = ResourceList(
-                        {
-                            k: parse_quantity(v, cpu=(k == ResourceName.CPU))
-                            for k, v in data.get("resources", {}).items()
-                        }
-                    ).to_vector()
+                    def to_vec(section):
+                        return ResourceList(
+                            {
+                                k: parse_quantity(v, cpu=(k == ResourceName.CPU))
+                                for k, v in section.items()
+                            }
+                        ).to_vector()
+
+                    node_reserved[i] = to_vec(data.get("resources", {}))
+                    # the system daemons' reserve feeds both the system-used
+                    # floor and the by-request policy subtrahend
+                    system_reserved[i] = to_vec(data.get("systemResources", {}))
                 except (ValueError, TypeError):
                     pass
             nm: Optional[NodeMetric] = self.store.get(

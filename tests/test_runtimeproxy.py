@@ -1,0 +1,165 @@
+"""Runtime proxy: CRI interception -> hook server -> merged runtime calls,
+over both in-process and real gRPC/UDS transports."""
+
+import json
+import os
+import tempfile
+
+import pytest
+
+from koordinator_tpu.api.objects import (
+    ANNOTATION_RESOURCE_STATUS,
+    LABEL_POD_QOS,
+    Node,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client.store import KIND_NODE, KIND_POD, ObjectStore
+from koordinator_tpu.koordlet.daemon import Daemon
+from koordinator_tpu.koordlet.hookserver import HookHandler
+from koordinator_tpu.koordlet.util.system import FakeFS
+from koordinator_tpu.runtimeproxy import (
+    FailurePolicy,
+    FakeRuntimeBackend,
+    InProcessHookClient,
+    RuntimeProxy,
+)
+from koordinator_tpu.runtimeproxy import api_pb2
+from koordinator_tpu.runtimeproxy.hookclient import HookClient, serve_hook_service
+
+GIB = 1024**3
+
+
+@pytest.fixture
+def node_setup():
+    fs = FakeFS()
+    store = ObjectStore()
+    store.add(
+        KIND_NODE,
+        Node(meta=ObjectMeta(name="node-0", namespace=""),
+             allocatable=ResourceList.of(cpu=16000, memory=64 * GIB)),
+    )
+    pod = Pod(
+        meta=ObjectMeta(
+            name="lsr-pod", uid="uid-1", labels={LABEL_POD_QOS: "LSR"},
+            annotations={ANNOTATION_RESOURCE_STATUS: json.dumps({"cpuset": "0-3"})},
+        ),
+        spec=PodSpec(node_name="node-0",
+                     requests=ResourceList.of(cpu=4000, memory=8 * GIB),
+                     limits=ResourceList.of(cpu=4000, memory=8 * GIB)),
+        phase="Running",
+    )
+    store.add(KIND_POD, pod)
+    daemon = Daemon(store, "node-0", fs.config, report_interval_seconds=0)
+    handler = HookHandler(daemon.runtime_hooks)
+    yield store, daemon, handler
+    fs.cleanup()
+
+
+def _pod_meta():
+    return api_pb2.PodSandboxMeta(
+        name="lsr-pod", namespace="default", uid="uid-1",
+        labels={LABEL_POD_QOS: "LSR"},
+        cgroup_parent="kubepods/poduid-1",
+    )
+
+
+class TestInProcess:
+    def test_create_container_merges_hook_response(self, node_setup):
+        store, daemon, handler = node_setup
+        backend = FakeRuntimeBackend()
+        proxy = RuntimeProxy(InProcessHookClient(handler), backend)
+        proxy.run_pod_sandbox(_pod_meta())
+        merged, env = proxy.create_container(
+            "uid-1",
+            api_pb2.ContainerMeta(name="main", id="c1"),
+            resources=api_pb2.LinuxContainerResources(cpu_shares=1024),
+        )
+        assert merged.cpuset_cpus == "0-3"       # scheduler's cpuset applied
+        assert merged.cpu_bvt_warp_ns == 2       # LSR group identity
+        assert merged.cpu_shares == 1024         # original preserved
+        assert [c.method for c in backend.calls] == ["RunPodSandbox", "CreateContainer"]
+
+    def test_stop_container_uses_store(self, node_setup):
+        _, _, handler = node_setup
+        backend = FakeRuntimeBackend()
+        proxy = RuntimeProxy(InProcessHookClient(handler), backend)
+        proxy.run_pod_sandbox(_pod_meta())
+        proxy.create_container("uid-1", api_pb2.ContainerMeta(name="main", id="c1"))
+        proxy.stop_container("c1")
+        assert backend.calls[-1].method == "StopContainer"
+        assert backend.calls[-1].pod_name == "lsr-pod"
+        assert "c1" not in proxy.container_store
+
+    def test_failure_policy(self, node_setup):
+        class Broken:
+            def call(self, method, request):
+                raise RuntimeError("hook server down")
+
+        backend = FakeRuntimeBackend()
+        proxy = RuntimeProxy(Broken(), backend, FailurePolicy.IGNORE)
+        merged = proxy.run_pod_sandbox(_pod_meta())  # ignored: forwards as-is
+        assert backend.calls[0].method == "RunPodSandbox"
+
+        proxy_fail = RuntimeProxy(Broken(), FakeRuntimeBackend(), FailurePolicy.FAIL)
+        with pytest.raises(RuntimeError):
+            proxy_fail.run_pod_sandbox(_pod_meta())
+
+
+class TestGRPCOverUDS:
+    def test_full_grpc_roundtrip(self, node_setup):
+        _, _, handler = node_setup
+        sock = os.path.join(tempfile.mkdtemp(), "koordlet.sock")
+        server = serve_hook_service(handler, sock)
+        try:
+            client = HookClient(sock)
+            backend = FakeRuntimeBackend()
+            proxy = RuntimeProxy(client, backend)
+            proxy.run_pod_sandbox(_pod_meta())
+            merged, env = proxy.create_container(
+                "uid-1", api_pb2.ContainerMeta(name="main", id="c1")
+            )
+            assert merged.cpuset_cpus == "0-3"
+            assert merged.cpu_bvt_warp_ns == 2
+            client.close()
+        finally:
+            server.stop(0)
+
+
+class TestSidecar:
+    def test_sidecar_grpc_roundtrip(self):
+        """Full batched scheduling over the gRPC sidecar channel matches the
+        in-process kernel result."""
+        import numpy as np
+
+        from koordinator_tpu.models.full_chain import build_full_chain_step
+        from koordinator_tpu.ops.loadaware import LoadAwareArgs
+        from koordinator_tpu.scheduler.sidecar import (
+            SidecarClient,
+            pack_request,
+            serve_sidecar,
+            tensor_to_np,
+        )
+        from koordinator_tpu.scheduler.snapshot import build_full_chain_inputs
+        from koordinator_tpu.testing import synth_full_cluster
+
+        args = LoadAwareArgs()
+        cluster, state = synth_full_cluster(15, 30, seed=17)
+        fc, pods, nodes, tree, gi, ng, ngroups = build_full_chain_inputs(state, args)
+        local = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+
+        sock = os.path.join(tempfile.mkdtemp(), "sidecar.sock")
+        server = serve_sidecar(f"unix://{sock}")
+        try:
+            client = SidecarClient(f"unix://{sock}")
+            req = pack_request(fc, ng, ngroups, args, snapshot_version=7)
+            res = client.schedule_batch(req)
+            remote = tensor_to_np(res.chosen)
+            np.testing.assert_array_equal(local, remote)
+            assert res.snapshot_version == 7
+            assert res.kernel_seconds > 0
+            client.close()
+        finally:
+            server.stop(0)
