@@ -156,6 +156,9 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int) -> None:
         num_quotas=max(8, num_pods // 100),
         num_gangs=max(4, num_pods // 50),
     )
+    t_synth = time.perf_counter() - t0
+    log(f"synth fixture: {t_synth:.3f}s (not framework cost)")
+    t0 = time.perf_counter()
     fc, pods, nodes, tree, gang_index, ng, ngroups = build_full_chain_inputs(
         state, la
     )

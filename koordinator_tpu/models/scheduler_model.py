@@ -66,27 +66,29 @@ class ScheduleInputs(NamedTuple):
 
 
 def make_inputs(pods: PodBatch, nodes: NodeBatch, args: LoadAwareArgs) -> ScheduleInputs:
+    # host numpy throughout: the jitted step does the single H2D transfer;
+    # eager jnp.asarray here would round-trip via reduce_to_active_axes
     ex = nodes.extras
     node_ok = np.asarray(nodes.valid)
     return ScheduleInputs(
-        fit_requests=jnp.asarray(with_pod_count(pods.requests)),
-        estimated=jnp.asarray(pods.estimated),
-        is_prod=jnp.asarray(pods.is_prod),
-        is_daemonset=jnp.asarray(pods.is_daemonset),
-        pod_valid=jnp.asarray(pods.valid),
-        allocatable=jnp.asarray(nodes.allocatable),
-        requested=jnp.asarray(nodes.requested),
-        node_ok=jnp.asarray(node_ok),
-        la_filter_usage=jnp.asarray(ex["la_filter_usage"]),
-        la_has_filter_usage=jnp.asarray(ex["la_has_filter_usage"]),
-        la_filter_thresholds=jnp.asarray(ex["la_filter_thresholds"]),
-        la_prod_thresholds=jnp.asarray(ex["la_prod_thresholds"]),
-        la_prod_pod_usage=jnp.asarray(ex["la_prod_pod_usage"]),
-        la_term_nonprod=jnp.asarray(ex["la_term_nonprod"]),
-        la_term_prod=jnp.asarray(ex["la_term_prod"]),
-        la_score_valid=jnp.asarray(ex["la_score_valid"]),
-        la_filter_skip=jnp.asarray(ex["la_filter_skip"]),
-        weights=jnp.asarray(args.weight_vector()),
+        fit_requests=np.asarray(with_pod_count(pods.requests)),
+        estimated=np.asarray(pods.estimated),
+        is_prod=np.asarray(pods.is_prod),
+        is_daemonset=np.asarray(pods.is_daemonset),
+        pod_valid=np.asarray(pods.valid),
+        allocatable=np.asarray(nodes.allocatable),
+        requested=np.asarray(nodes.requested),
+        node_ok=np.asarray(node_ok),
+        la_filter_usage=np.asarray(ex["la_filter_usage"]),
+        la_has_filter_usage=np.asarray(ex["la_has_filter_usage"]),
+        la_filter_thresholds=np.asarray(ex["la_filter_thresholds"]),
+        la_prod_thresholds=np.asarray(ex["la_prod_thresholds"]),
+        la_prod_pod_usage=np.asarray(ex["la_prod_pod_usage"]),
+        la_term_nonprod=np.asarray(ex["la_term_nonprod"]),
+        la_term_prod=np.asarray(ex["la_term_prod"]),
+        la_score_valid=np.asarray(ex["la_score_valid"]),
+        la_filter_skip=np.asarray(ex["la_filter_skip"]),
+        weights=np.asarray(args.weight_vector()),
     )
 
 

@@ -23,6 +23,13 @@ def go_round(x):
     return jnp.floor(x + 0.5)
 
 
+def go_round_np(x):
+    """Host-numpy twin of go_round (same half-away-from-zero semantics)."""
+    import numpy as np
+
+    return np.floor(x + 0.5)
+
+
 def least_requested_score(requested, capacity):
     """kube-scheduler leastRequestedScore (load_aware.go:389-397): 0 when capacity
     is 0 or requested > capacity, else floor((capacity-requested)*100/capacity)."""

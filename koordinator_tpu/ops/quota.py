@@ -27,12 +27,11 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from koordinator_tpu.api.resources import NUM_RESOURCES
-from koordinator_tpu.ops.common import go_round
+from koordinator_tpu.ops.common import go_round_np
 
 MAX_QUOTA_DEPTH = 4  # root -> ... -> leaf (reference trees are shallow)
 
@@ -56,112 +55,109 @@ class QuotaTreeArrays:
 
 
 def water_fill_level(
-    total: jnp.ndarray,        # [G, R] available to each group's children
-    parent: jnp.ndarray,       # [G] int32 (-1 roots)
-    min_: jnp.ndarray,         # [G, R]
-    guarantee: jnp.ndarray,    # [G, R]
-    request: jnp.ndarray,      # [G, R]
-    shared_weight: jnp.ndarray,  # [G, R]
-    allow_lent: jnp.ndarray,   # [G]
-    level: jnp.ndarray,        # [G]
+    total: np.ndarray,         # [G, R] available to each group's children
+    parent: np.ndarray,        # [G] int32 (-1 roots)
+    min_: np.ndarray,          # [G, R]
+    guarantee: np.ndarray,     # [G, R]
+    request: np.ndarray,       # [G, R]
+    shared_weight: np.ndarray,  # [G, R]
+    allow_lent: np.ndarray,    # [G]
+    level: np.ndarray,         # [G]
     cur_level: int,
     num_groups: int,
-) -> jnp.ndarray:
+) -> np.ndarray:
     """One level of redistribution: returns runtime[G, R] for groups at cur_level
     (other rows zero). `total[g]` must hold the parent's runtime (or cluster total
-    for roots)."""
+    for roots).
+
+    Host numpy, NOT a device kernel: the quota tree is control-plane scale
+    (G ~ 10^2) and this runs at snapshot-build time on every reconcile — jitting
+    it costs 10^4x its runtime in per-shape XLA compiles. The per-pod admission
+    side (quota_admit_row / quota_used_add_row) stays in-kernel where the
+    pod-axis batching lives."""
     G = parent.shape[0]
     active = (level == cur_level)[:, None]  # [G, 1]
-    eff_min = jnp.maximum(min_, guarantee)
+    eff_min = np.maximum(min_, guarantee)
     over = request > eff_min
-    base = jnp.where(
-        over, eff_min, jnp.where(allow_lent[:, None], request, eff_min)
-    )
-    base = jnp.where(active, base, 0.0)
+    base = np.where(over, eff_min, np.where(allow_lent[:, None], request, eff_min))
+    base = np.where(active, base, 0.0)
 
     # roots share the cluster total: they get a common virtual segment id G
-    seg = jnp.where(parent >= 0, parent, G)
-    adjustable0 = over & active & (shared_weight > 0)
+    seg = np.where(parent >= 0, parent, G)
+    adjustable = over & active & (shared_weight > 0)
 
     def seg_sum(x):
-        return jax.ops.segment_sum(x, seg, num_segments=G + 1)
+        out = np.zeros((G + 1, x.shape[1]), x.dtype)
+        np.add.at(out, seg, x)
+        return out
 
     spent = seg_sum(base)                       # [G+1, R]
     # per-parent leftover; total is constant within a segment (parent's runtime)
-    leftover_seg0 = jnp.maximum(
-        jax.ops.segment_max(jnp.where(active, total, -jnp.inf), seg, num_segments=G + 1)
-        - spent,
-        0.0,
-    )
-    leftover_seg0 = jnp.where(jnp.isfinite(leftover_seg0), leftover_seg0, 0.0)
+    seg_total = np.full((G + 1, total.shape[1]), -np.inf, total.dtype)
+    np.maximum.at(seg_total, seg, np.where(active, total, -np.inf))
+    leftover_seg = np.maximum(seg_total - spent, 0.0)
+    leftover_seg[~np.isfinite(leftover_seg)] = 0.0
 
-    def cond(state):
-        runtime, leftover_seg, adjustable, changed, it = state
-        return changed & (it < num_groups + 2)
-
-    def body(state):
-        runtime, leftover_seg, adjustable, _, it = state
-        w = jnp.where(adjustable, shared_weight, 0.0)
-        wsum_seg = seg_sum(w)                   # [G+1, R]
-        wsum = wsum_seg[seg]
-        delta = jnp.where(
+    runtime = base
+    for _ in range(num_groups + 2):
+        if not adjustable.any() or not (leftover_seg > 0).any():
+            break
+        w = np.where(adjustable, shared_weight, 0.0)
+        wsum = seg_sum(w)[seg]                  # [G, R]
+        delta = np.where(
             (wsum > 0) & adjustable,
-            go_round(shared_weight * leftover_seg[seg] / jnp.maximum(wsum, 1e-9)),
+            go_round_np(shared_weight * leftover_seg[seg] / np.maximum(wsum, 1e-9)),
             0.0,
         )
         new_rt = runtime + delta
-        overshoot = jnp.maximum(new_rt - request, 0.0)
-        new_rt = jnp.minimum(new_rt, request)
+        overshoot = np.maximum(new_rt - request, 0.0)
+        new_rt = np.minimum(new_rt, request)
         # a child stays adjustable while below its request EVEN if this round's
         # rounded delta was 0 — recycled overshoot must still reach it next
         # round (reference iterationForRedistribution keeps it in `nodes`)
         still = adjustable & (new_rt < request)
         # next round distributes ONLY the overshoot recycled this round
         # (undistributed rounding remainder is dropped, as in the reference)
-        new_leftover_seg = seg_sum(jnp.where(adjustable, overshoot, 0.0))
-        changed = jnp.any(still) & jnp.any(new_leftover_seg > 0)
-        return new_rt, new_leftover_seg, still, changed, it + 1
-
-    init = (base, leftover_seg0, adjustable0, jnp.any(adjustable0), 0)
-    runtime, _, _, _, _ = jax.lax.while_loop(cond, body, init)
-    return jnp.where(active, runtime, 0.0)
+        leftover_seg = seg_sum(np.where(adjustable, overshoot, 0.0))
+        runtime = new_rt
+        adjustable = still
+    return np.where(active, runtime, 0.0).astype(np.float32)
 
 
 def compute_runtime_quotas(tree: QuotaTreeArrays, cluster_total: np.ndarray) -> np.ndarray:
     """Top-down runtime quota for the whole tree: [G, R] float32.
 
     Level 0 children share cluster_total; level d children share their parent's
-    runtime. Executed as D jitted level passes (D static, tiny).
-    """
+    runtime. Host numpy (see water_fill_level for why)."""
     G = len(tree.names)
     if G == 0:
         return np.zeros((0, NUM_RESOURCES), np.float32)
-    parent = jnp.asarray(tree.parent)
-    runtime = jnp.zeros((G, NUM_RESOURCES), jnp.float32)
+    parent = tree.parent
+    runtime = np.zeros((G, NUM_RESOURCES), np.float32)
     max_level = int(tree.level.max()) if G else 0
+    total_row = np.asarray(cluster_total, np.float32)
     for lvl in range(max_level + 1):
-        total = jnp.where(
+        total = np.where(
             (parent >= 0)[:, None],
-            runtime[jnp.clip(parent, 0, G - 1)],
-            jnp.asarray(cluster_total, jnp.float32)[None, :],
+            runtime[np.clip(parent, 0, G - 1)],
+            total_row[None, :],
         )
         rt_lvl = water_fill_level(
             total,
             parent,
-            jnp.asarray(tree.min),
-            jnp.asarray(tree.guarantee),
-            jnp.asarray(tree.request),
-            jnp.asarray(tree.shared_weight),
-            jnp.asarray(tree.allow_lent),
-            jnp.asarray(tree.level),
+            tree.min,
+            tree.guarantee,
+            tree.request,
+            tree.shared_weight,
+            tree.allow_lent,
+            tree.level,
             lvl,
             G,
         )
-        runtime = jnp.where((jnp.asarray(tree.level) == lvl)[:, None], rt_lvl, runtime)
+        runtime = np.where((tree.level == lvl)[:, None], rt_lvl, runtime)
     # cap by max (runtime never exceeds max; reference setClusterTotalResource /
     # quotaInfo semantics)
-    runtime = jnp.minimum(runtime, jnp.asarray(tree.max))
-    return np.asarray(runtime)
+    return np.minimum(runtime, tree.max).astype(np.float32)
 
 
 def quota_admit_row(

@@ -13,7 +13,6 @@ import json
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-import jax.numpy as jnp
 import numpy as np
 
 from koordinator_tpu.api.objects import (
@@ -64,10 +63,11 @@ def reduce_to_active_axes(fc: FullChainInputs):
     ):
         active |= (arr != 0).any(axis=tuple(range(arr.ndim - 1)))
     idx = np.nonzero(active)[0]
-    take = jnp.asarray(idx)
 
     def cut(arr):
-        return jnp.take(arr, take, axis=-1)
+        # host-side slice: arrays are still numpy at pack time and device ops
+        # here would trigger per-shape XLA compiles before the step even runs
+        return np.take(np.asarray(arr), idx, axis=-1)
 
     r_fields_base = {
         "fit_requests", "estimated", "allocatable", "requested",
@@ -259,31 +259,31 @@ def build_full_chain_inputs(
     G = max(1, len(tree.names))
     fc = FullChainInputs(
         base=base,
-        requests=jnp.asarray(pods.requests),
-        gang_id=jnp.asarray(pods.gang_id),
-        quota_id=jnp.asarray(pods.quota_id),
-        needs_numa=jnp.asarray(needs_numa),
-        needs_bind=jnp.asarray(needs_bind),
-        cores_needed=jnp.asarray(cores_needed),
-        full_pcpus=jnp.asarray(full_pcpus),
-        numa_free=jnp.asarray(numa_free),
-        numa_capacity=jnp.asarray(numa_capacity),
-        numa_policy=jnp.asarray(numa_policy),
-        has_topology=jnp.asarray(has_topology),
-        bind_free=jnp.asarray(bind_free),
-        cpus_per_core=jnp.asarray(cpus_per_core),
-        quota_ancestors=jnp.asarray(
+        requests=np.asarray(pods.requests),
+        gang_id=np.asarray(pods.gang_id),
+        quota_id=np.asarray(pods.quota_id),
+        needs_numa=np.asarray(needs_numa),
+        needs_bind=np.asarray(needs_bind),
+        cores_needed=np.asarray(cores_needed),
+        full_pcpus=np.asarray(full_pcpus),
+        numa_free=np.asarray(numa_free),
+        numa_capacity=np.asarray(numa_capacity),
+        numa_policy=np.asarray(numa_policy),
+        has_topology=np.asarray(has_topology),
+        bind_free=np.asarray(bind_free),
+        cpus_per_core=np.asarray(cpus_per_core),
+        quota_ancestors=np.asarray(
             tree.ancestors
             if tree.names
             else np.full((1, MAX_QUOTA_DEPTH), -1, np.int32)
         ),
-        quota_used=jnp.asarray(
+        quota_used=np.asarray(
             tree.used if tree.names else np.zeros((1, NUM_RESOURCES), np.float32)
         ),
-        quota_runtime=jnp.asarray(runtime if tree.names else np.zeros((1, NUM_RESOURCES), np.float32)),
-        gang_min_member=jnp.asarray(gang_min),
-        gang_assumed=jnp.asarray(gang_assumed),
-        gang_valid=jnp.asarray(gang_valid),
-        gang_group_id=jnp.asarray(gang_group),
+        quota_runtime=np.asarray(runtime if tree.names else np.zeros((1, NUM_RESOURCES), np.float32)),
+        gang_min_member=np.asarray(gang_min),
+        gang_assumed=np.asarray(gang_assumed),
+        gang_valid=np.asarray(gang_valid),
+        gang_group_id=np.asarray(gang_group),
     )
     return fc, pods, nodes, tree, gang_index, ng, ng
