@@ -48,6 +48,8 @@ LABEL_QUOTA_PARENT = QUOTA_DOMAIN_PREFIX + "/parent"
 LABEL_QUOTA_IS_PARENT = QUOTA_DOMAIN_PREFIX + "/is-parent"
 LABEL_QUOTA_SHARED_WEIGHT = QUOTA_DOMAIN_PREFIX + "/shared-weight"
 LABEL_QUOTA_TREE_ID = QUOTA_DOMAIN_PREFIX + "/tree-id"
+LABEL_QUOTA_ALLOW_LENT = QUOTA_DOMAIN_PREFIX + "/allow-lent-resource"
+ANNOTATION_QUOTA_GUARANTEED = QUOTA_DOMAIN_PREFIX + "/guaranteed"
 
 
 @dataclass
@@ -287,15 +289,44 @@ class ElasticQuota:
         raw = self.meta.annotations.get(LABEL_QUOTA_SHARED_WEIGHT)
         if raw:
             try:
-                parsed = {
-                    k: parse_quantity(v, cpu=(k == ResourceName.CPU))
-                    for k, v in json.loads(raw).items()
-                }
-                if parsed and all(v > 0 for v in parsed.values()):
-                    return ResourceList(parsed)
+                data = json.loads(raw)
+                if isinstance(data, dict):
+                    parsed = {
+                        k: parse_quantity(v, cpu=(k == ResourceName.CPU))
+                        for k, v in data.items()
+                    }
+                    if parsed and all(v > 0 for v in parsed.values()):
+                        return ResourceList(parsed)
             except (ValueError, TypeError):
                 pass
         return self.max.copy()
+
+    @property
+    def allow_lent_resource(self) -> bool:
+        """Whether unused min may be lent to siblings
+        (apis/extension/elastic_quota.go:70-72: anything but "false")."""
+        return self.meta.labels.get(LABEL_QUOTA_ALLOW_LENT, "") != "false"
+
+    @property
+    def guaranteed(self) -> ResourceList:
+        """Floor the runtime never drops below
+        (apis/extension/elastic_quota.go:150-157)."""
+        import json
+
+        from koordinator_tpu.api.resources import ResourceName, parse_quantity
+
+        raw = self.meta.annotations.get(ANNOTATION_QUOTA_GUARANTEED)
+        if raw:
+            try:
+                data = json.loads(raw)
+                if isinstance(data, dict):
+                    return ResourceList({
+                        k: parse_quantity(v, cpu=(k == ResourceName.CPU))
+                        for k, v in data.items()
+                    })
+            except (ValueError, TypeError):
+                pass
+        return ResourceList()
 
     @property
     def tree_id(self) -> str:

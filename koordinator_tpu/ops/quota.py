@@ -111,7 +111,10 @@ def water_fill_level(
         )
         new_rt = runtime + delta
         overshoot = np.maximum(new_rt - request, 0.0)
-        new_rt = np.minimum(new_rt, request)
+        # only adjustable (over-requesting) rows clamp to request; a non-lent
+        # sibling sits at eff_min > request and must keep it
+        # (runtime_quota_calculator.go:128-134 keeps runtimeQuota = min there)
+        new_rt = np.where(adjustable, np.minimum(new_rt, request), runtime)
         # a child stays adjustable while below its request EVEN if this round's
         # rounded delta was 0 — recycled overshoot must still reach it next
         # round (reference iterationForRedistribution keeps it in `nodes`)
@@ -245,10 +248,14 @@ def build_quota_tree(
     weight = np.zeros((G, NUM_RESOURCES), np.float32)
     request = np.zeros((G, NUM_RESOURCES), np.float32)
     used = np.zeros((G, NUM_RESOURCES), np.float32)
+    guarantee = np.zeros((G, NUM_RESOURCES), np.float32)
+    allow_lent = np.ones(G, bool)
     for i, q in enumerate(quotas):
         min_[i] = q.min.to_vector()
         max_[i] = q.max.to_vector()
         weight[i] = q.shared_weight.to_vector()
+        guarantee[i] = q.guaranteed.to_vector()
+        allow_lent[i] = q.allow_lent_resource
         if pod_requests_by_quota:
             vec = pod_requests_by_quota.get(q.meta.name)
             if vec is not None:
@@ -276,8 +283,8 @@ def build_quota_tree(
         shared_weight=weight,
         request=request,
         used=used,
-        guarantee=np.zeros((G, NUM_RESOURCES), np.float32),
-        allow_lent=np.ones(G, bool),
+        guarantee=guarantee,
+        allow_lent=allow_lent,
         level=level,
         index=index,
     )

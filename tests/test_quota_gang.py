@@ -109,6 +109,49 @@ class TestWaterFilling:
         expected = scalar_redistribution(children, float(total))
         np.testing.assert_allclose(runtime[:, CPU], expected, atol=0.5)
 
+    def test_non_lent_sibling_keeps_min_through_redistribution(self):
+        # q0 over-requests and iterates; q1 (allow-lent=false) under-requests
+        # but must keep runtime = min, not be clamped to its request
+        # (runtime_quota_calculator.go:128-134)
+        from koordinator_tpu.api.objects import LABEL_QUOTA_ALLOW_LENT
+
+        q0 = _quota("q0", 10000, 1000000, weight=10000)
+        q1 = _quota("q1", 40000, 1000000, weight=10000)
+        q1.meta.labels[LABEL_QUOTA_ALLOW_LENT] = "false"
+        req_by = {
+            "q0": ResourceList.of(cpu=90000).to_vector(),
+            "q1": ResourceList.of(cpu=5000).to_vector(),
+        }
+        tree = build_quota_tree([q0, q1], pod_requests_by_quota=req_by)
+        runtime = compute_runtime_quotas(
+            tree, ResourceList.of(cpu=100000).to_vector()
+        )
+        assert runtime[1, CPU] == 40000.0  # non-lent keeps its min
+        assert runtime[0, CPU] == 60000.0  # the rest goes to the over-requester
+
+    def test_guarantee_raises_effective_min(self):
+        import json
+
+        from koordinator_tpu.api.objects import ANNOTATION_QUOTA_GUARANTEED
+
+        q0 = _quota("q0", 10000, 1000000, weight=10000)
+        q0.meta.annotations[ANNOTATION_QUOTA_GUARANTEED] = json.dumps(
+            {"cpu": "30"}
+        )
+        q1 = _quota("q1", 10000, 1000000, weight=10000)
+        req_by = {
+            "q0": ResourceList.of(cpu=100000).to_vector(),
+            "q1": ResourceList.of(cpu=100000).to_vector(),
+        }
+        tree = build_quota_tree([q0, q1], pod_requests_by_quota=req_by)
+        runtime = compute_runtime_quotas(
+            tree, ResourceList.of(cpu=40000).to_vector()
+        )
+        # guarantee=30000 floors q0's base; q1 starts at min=10000 and the
+        # leftover 0 means bases stand
+        assert runtime[0, CPU] == 30000.0
+        assert runtime[1, CPU] == 10000.0
+
     def test_hierarchy_parent_runtime_feeds_children(self):
         quotas = [
             _quota("root-a", 40000, 200000, weight=1000),

@@ -1,0 +1,225 @@
+"""Topology-manager hint-merge tests, mirroring the reference's
+frameworkext/topologymanager/policy_*_test.go cases."""
+
+import numpy as np
+
+from koordinator_tpu.api.objects import (
+    CPUInfo,
+    NodeResourceTopology,
+    NUMAZone,
+    ObjectMeta,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.scheduler.topologymanager import (
+    POLICY_BEST_EFFORT,
+    POLICY_NONE,
+    POLICY_RESTRICTED,
+    POLICY_SINGLE_NUMA_NODE,
+    NUMATopologyHint,
+    TopologyManager,
+    generate_fit_hints,
+    merge_hints,
+)
+from koordinator_tpu.utils.bitmask import BitMask
+
+
+def hint(bits, preferred=True, score=0):
+    return NUMATopologyHint(BitMask(bits), preferred, score)
+
+
+class TestMergeHints:
+    def test_none_policy_always_admits(self):
+        best, admit = merge_hints(
+            POLICY_NONE, [0, 1], [{"cpu": [hint([1], preferred=False)]}]
+        )
+        assert admit
+        assert best.affinity is None
+
+    def test_best_effort_always_admits(self):
+        # no provider can place -> still admitted, non-preferred default hint
+        best, admit = merge_hints(POLICY_BEST_EFFORT, [0, 1], [{"cpu": []}])
+        assert admit
+        assert not best.preferred
+
+    def test_restricted_requires_preferred(self):
+        best, admit = merge_hints(
+            POLICY_RESTRICTED, [0, 1], [{"cpu": [hint([0], preferred=False)]}]
+        )
+        assert not admit
+        best, admit = merge_hints(
+            POLICY_RESTRICTED, [0, 1], [{"cpu": [hint([0], preferred=True)]}]
+        )
+        assert admit
+        assert best.affinity == BitMask([0])
+
+    def test_narrowest_preferred_wins(self):
+        best, admit = merge_hints(
+            POLICY_BEST_EFFORT,
+            [0, 1],
+            [{"cpu": [hint([0, 1]), hint([1])]}],
+        )
+        assert admit
+        assert best.affinity == BitMask([1])
+
+    def test_cross_provider_and(self):
+        # provider A can use {0} or {0,1}; provider B only {0,1}:
+        # the AND of {0} x {0,1} = {0} is the narrowest preferred merge
+        best, admit = merge_hints(
+            POLICY_RESTRICTED,
+            [0, 1],
+            [
+                {"cpu": [hint([0]), hint([0, 1])]},
+                {"gpu": [hint([0, 1])]},
+            ],
+        )
+        assert admit
+        assert best.affinity == BitMask([0])
+
+    def test_conflicting_single_zones_not_preferred(self):
+        # A wants zone 0 only, B wants zone 1 only -> empty AND is skipped;
+        # merged best falls back to non-preferred -> restricted rejects
+        best, admit = merge_hints(
+            POLICY_RESTRICTED,
+            [0, 1],
+            [{"cpu": [hint([0])]}, {"gpu": [hint([1])]}],
+        )
+        assert not admit
+
+    def test_single_numa_node_filters_wide_hints(self):
+        # only a two-zone placement fits -> single-numa-node rejects
+        best, admit = merge_hints(
+            POLICY_SINGLE_NUMA_NODE, [0, 1], [{"cpu": [hint([0, 1])]}]
+        )
+        assert not admit
+
+    def test_single_numa_node_admits_one_zone(self):
+        best, admit = merge_hints(
+            POLICY_SINGLE_NUMA_NODE,
+            [0, 1],
+            [{"cpu": [hint([0, 1]), hint([1])]}],
+        )
+        assert admit
+        assert best.affinity == BitMask([1])
+
+    def test_single_numa_dont_care_collapses_to_none(self):
+        # provider has no preference -> default affinity collapses to nil hint
+        best, admit = merge_hints(POLICY_SINGLE_NUMA_NODE, [0, 1], [None])
+        assert admit
+        assert best.affinity is None
+
+    def test_missing_provider_hints_are_dont_care(self):
+        best, admit = merge_hints(
+            POLICY_RESTRICTED, [0, 1], [None, {"cpu": [hint([1])]}]
+        )
+        assert admit
+        assert best.affinity == BitMask([1])
+
+    def test_score_breaks_width_ties(self):
+        # reference semantics (policy.go:171-177): a later equal-width hint
+        # replaces the best only when it is NOT narrower yet scores higher;
+        # a narrower (lower-bit) hint replaces unconditionally.
+        best, admit = merge_hints(
+            POLICY_BEST_EFFORT,
+            [0, 1],
+            [{"cpu": [hint([0], score=1), hint([1], score=9)]}],
+        )
+        assert admit
+        assert best.affinity == BitMask([1])  # same width, higher score wins
+        best, admit = merge_hints(
+            POLICY_BEST_EFFORT,
+            [0, 1],
+            [{"cpu": [hint([1], score=9), hint([0], score=1)]}],
+        )
+        assert best.affinity == BitMask([0])  # narrower-by-bit replaces
+
+
+class TestGenerateFitHints:
+    def test_minimal_width_preferred(self):
+        zone_free = np.zeros((2, 16), np.float32)
+        zone_free[0, 0] = 2000.0
+        zone_free[1, 0] = 4000.0
+        req = np.zeros(16, np.float32)
+        req[0] = 3000.0
+        hints = generate_fit_hints(req, zone_free, [0, 1])
+        by_mask = {h.affinity.to_int(): h for h in hints}
+        assert by_mask[0b10].preferred  # zone 1 alone fits -> minimal width
+        assert not by_mask[0b11].preferred
+
+    def test_no_fit_returns_empty(self):
+        zone_free = np.zeros((2, 16), np.float32)
+        req = np.zeros(16, np.float32)
+        req[0] = 1000.0
+        assert generate_fit_hints(req, zone_free, [0, 1]) == []
+
+
+class TestPluginIntegration:
+    def _topology(self, name, zone_cpus):
+        zones = [
+            NUMAZone(numa_id=i, allocatable=ResourceList.of(cpu=c))
+            for i, c in enumerate(zone_cpus)
+        ]
+        cpus = [
+            CPUInfo(cpu_id=i, core_id=i, socket_id=0, numa_node_id=0)
+            for i in range(4)
+        ]
+        return NodeResourceTopology(
+            meta=ObjectMeta(name=name), cpus=cpus, zones=zones
+        )
+
+    def _make(self, policy, zone_cpus):
+        from koordinator_tpu.api.objects import Node
+        from koordinator_tpu.client.store import (
+            KIND_NODE,
+            KIND_NODE_TOPOLOGY,
+            ObjectStore,
+        )
+        from koordinator_tpu.scheduler.plugins.nodenumaresource import (
+            LABEL_NUMA_TOPOLOGY_POLICY,
+            NodeNUMAResourcePlugin,
+        )
+
+        store = ObjectStore()
+        plugin = NodeNUMAResourcePlugin()
+        plugin.register(store)
+        node = Node(meta=ObjectMeta(name="n0", namespace="", labels={
+            LABEL_NUMA_TOPOLOGY_POLICY: policy,
+        }))
+        store.add(KIND_NODE, node)
+        store.add(KIND_NODE_TOPOLOGY, self._topology("n0", zone_cpus))
+        return store, plugin
+
+    def _pod(self, cpu_milli):
+        from koordinator_tpu.api.objects import Pod, PodSpec
+
+        return Pod(
+            meta=ObjectMeta(name="p0", namespace="default"),
+            spec=PodSpec(requests=ResourceList.of(cpu=cpu_milli)),
+        )
+
+    def test_restricted_rejects_unfittable(self):
+        from koordinator_tpu.scheduler.frameworkext import CycleContext
+
+        store, plugin = self._make("restricted", [1000, 1000])
+        err = plugin.reserve(self._pod(8000), "n0", CycleContext(now=0.0))
+        assert err is not None and "NUMA" in err
+
+    def test_single_numa_allocates_into_chosen_zone(self):
+        from koordinator_tpu.scheduler.frameworkext import CycleContext
+
+        store, plugin = self._make("single-numa-node", [1000, 4000])
+        ctx = CycleContext(now=0.0)
+        pod = self._pod(3000)
+        assert plugin.reserve(pod, "n0", ctx) is None
+        alloc = plugin.numa_allocated["n0"]
+        # zone 1 is the only single zone that fits
+        assert alloc[1, 0] == 3000.0
+        assert alloc[0, 0] == 0.0
+        plugin.unreserve(pod, "n0", ctx)
+        assert plugin.numa_allocated["n0"].sum() == 0.0
+
+    def test_none_policy_skips_admit(self):
+        from koordinator_tpu.scheduler.frameworkext import CycleContext
+
+        store, plugin = self._make("", [1000, 1000])
+        # kubelet policy "none": a request larger than any zone still reserves
+        assert plugin.reserve(self._pod(1500), "n0", CycleContext(now=0.0)) is None
