@@ -29,8 +29,15 @@ class Daemon:
     def __init__(self, store: ObjectStore, node_name: str,
                  config: Optional[sysutil.SystemConfig] = None,
                  checkpoint_dir: Optional[str] = None,
-                 report_interval_seconds: int = 60):
+                 report_interval_seconds: int = 60,
+                 autodetect_cgroups: bool = False):
         self.config = config or sysutil.CONFIG
+        if autodetect_cgroups:
+            # probe the real node layout (koordlet.go does this at startup
+            # via system.InitSupportConfigs); explicit configs (tests/FakeFS)
+            # skip it
+            self.config.use_cgroup_v2 = sysutil.detect_cgroup_version(self.config)
+            self.config.cgroup_driver = sysutil.detect_cgroup_driver(self.config)
         self.auditor = Auditor()
         self.executor = ResourceUpdateExecutor(self.config, self.auditor)
         self.metric_cache = MetricCache()

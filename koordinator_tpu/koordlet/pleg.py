@@ -8,10 +8,15 @@ pod-informer resync)."""
 from __future__ import annotations
 
 import os
+import re
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Set
 
 from koordinator_tpu.koordlet.util import system as sysutil
+
+# pod cgroup dirs: "pod<uid>" (cgroupfs) or "kubepods[-<qos>]-pod<uid>.slice"
+# (systemd driver)
+_POD_DIR = re.compile(r"^(pod|kubepods(-[a-z]+)?-pod)")
 
 
 @dataclass(frozen=True)
@@ -41,7 +46,7 @@ class Pleg:
             qos_dir = os.path.join(root, self.config.qos_relative_path(qos))
             try:
                 for entry in os.listdir(qos_dir):
-                    if entry.startswith("pod"):
+                    if _POD_DIR.match(entry):
                         found.add(os.path.join(self.config.qos_relative_path(qos), entry))
             except OSError:
                 continue

@@ -74,6 +74,11 @@ QOS_BESTEFFORT = "besteffort"
 QOS_BURSTABLE = "burstable"
 QOS_GUARANTEED = ""  # guaranteed pods sit directly under kubepods
 
+# cgroup drivers (cgroup_driver.go): kubelet either lays pods out as plain
+# dirs (cgroupfs) or as systemd slices/scopes (systemd)
+DRIVER_CGROUPFS = "cgroupfs"
+DRIVER_SYSTEMD = "systemd"
+
 
 @dataclass
 class SystemConfig:
@@ -84,18 +89,36 @@ class SystemConfig:
     sys_root_dir: str = "/sys"
     use_cgroup_v2: bool = True
     cgroup_kube_root: str = "kubepods"
+    cgroup_driver: str = DRIVER_CGROUPFS
 
     def qos_relative_path(self, qos_class: str) -> str:
         """kubepods[.slice]/<qos> relative dir for a k8s QoS class."""
+        if self.cgroup_driver == DRIVER_SYSTEMD:
+            root = f"{self.cgroup_kube_root}.slice"
+            if qos_class in ("", QOS_GUARANTEED):
+                return root
+            return os.path.join(
+                root, f"{self.cgroup_kube_root}-{qos_class}.slice")
         if qos_class in ("", QOS_GUARANTEED):
             return self.cgroup_kube_root
         return os.path.join(self.cgroup_kube_root, qos_class)
 
     def pod_relative_path(self, qos_class: str, pod_uid: str) -> str:
+        if self.cgroup_driver == DRIVER_SYSTEMD:
+            uid = pod_uid.replace("-", "_")
+            prefix = self.cgroup_kube_root
+            if qos_class not in ("", QOS_GUARANTEED):
+                prefix = f"{prefix}-{qos_class}"
+            return os.path.join(
+                self.qos_relative_path(qos_class), f"{prefix}-pod{uid}.slice")
         return os.path.join(self.qos_relative_path(qos_class), f"pod{pod_uid}")
 
     def container_relative_path(self, qos_class: str, pod_uid: str,
                                 container_id: str) -> str:
+        if self.cgroup_driver == DRIVER_SYSTEMD:
+            return os.path.join(
+                self.pod_relative_path(qos_class, pod_uid),
+                f"cri-containerd-{container_id}.scope")
         return os.path.join(self.pod_relative_path(qos_class, pod_uid), container_id)
 
     def cgroup_file_path(self, relative_dir: str, resource: str) -> str:
@@ -110,6 +133,27 @@ class SystemConfig:
 
     def resctrl_root(self) -> str:
         return os.path.join(self.sys_root_dir, "fs", "resctrl")
+
+
+def detect_cgroup_driver(config: "SystemConfig") -> str:
+    """Probe the cgroup tree for kubepods.slice vs kubepods
+    (cgroup_driver.go GetCgroupDriver semantics: look at which layout the
+    kubelet actually created)."""
+    roots = ([config.cgroup_root_dir] if config.use_cgroup_v2 else
+             [os.path.join(config.cgroup_root_dir, sub)
+              for sub in ("cpu", "memory", "cpuset")])
+    for root in roots:
+        if os.path.isdir(os.path.join(root, f"{config.cgroup_kube_root}.slice")):
+            return DRIVER_SYSTEMD
+        if os.path.isdir(os.path.join(root, config.cgroup_kube_root)):
+            return DRIVER_CGROUPFS
+    return DRIVER_CGROUPFS
+
+
+def detect_cgroup_version(config: "SystemConfig") -> bool:
+    """True if the unified (v2) hierarchy is mounted at the cgroup root."""
+    return os.path.isfile(os.path.join(config.cgroup_root_dir,
+                                       "cgroup.controllers"))
 
 
 # module-level active config (reference's system.Conf global)

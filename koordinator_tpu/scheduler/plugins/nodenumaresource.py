@@ -34,16 +34,13 @@ from koordinator_tpu.scheduler.cpu_topology import (
     take_cpus,
 )
 from koordinator_tpu.scheduler.frameworkext import CycleContext, Plugin
-from koordinator_tpu.scheduler.snapshot import (
-    LABEL_NUMA_TOPOLOGY_POLICY,
-    _pod_cpuset_flags,
-)
+from koordinator_tpu.scheduler.snapshot import _pod_cpuset_flags
 from koordinator_tpu.scheduler.topologymanager import (
     POLICY_NONE,
     NUMATopologyHint,
     TopologyManager,
-    canonical_policy,
     generate_fit_hints,
+    resolve_numa_policy,
 )
 
 
@@ -69,12 +66,16 @@ class NodeNUMAResourcePlugin(Plugin):
         store.subscribe(KIND_POD, self._on_pod)
 
     def _on_pod(self, ev: EventType, pod: Pod, old) -> None:
-        """Release zone accounting when an assigned pod dies (the reference
-        frees allocations on pod delete events via its resource manager cache)."""
+        """Release zone + cpuset accounting when an assigned pod dies (the
+        reference frees allocations on pod delete events via its resource
+        manager cache)."""
         if ev is EventType.DELETED or pod.is_terminated:
             node = pod.spec.node_name
             if node:
                 self._release_zone_alloc(node, pod.meta.key)
+                state = self.cpu_states.get(node)
+                if state is not None:
+                    state.remove(pod.meta.key)
 
     def _on_topology(self, ev: EventType, cr: NodeResourceTopology, old) -> None:
         name = cr.meta.name
@@ -100,22 +101,17 @@ class NodeNUMAResourcePlugin(Plugin):
     # -- NUMATopologyHintProvider (topologymanager.py) -----------------
     def node_policy(self, node_name: str) -> str:
         """Policy from the node label, falling back to the reported kubelet
-        cpu-manager policy (snapshot.py keeps the same precedence)."""
+        cpu-manager policy (shared precedence helper with the snapshot packer)."""
         topo = self.topologies.get(node_name)
-        label = ""
+        labels = {}
         if self.store is not None:
             from koordinator_tpu.client.store import KIND_NODE
 
             node = self.store.get(KIND_NODE, f"/{node_name}")
-            if node is not None and LABEL_NUMA_TOPOLOGY_POLICY in node.meta.labels:
-                # an explicitly empty label means "none", exactly as the
-                # snapshot packer resolves it — kernel and host must agree
-                return canonical_policy(
-                    node.meta.labels[LABEL_NUMA_TOPOLOGY_POLICY]
-                )
-        if not label and topo is not None:
-            label = topo.kubelet_cpu_manager_policy
-        return canonical_policy(label)
+            if node is not None:
+                labels = node.meta.labels
+        kubelet_policy = topo.kubelet_cpu_manager_policy if topo else ""
+        return resolve_numa_policy(labels, kubelet_policy)
 
     def _numa_ids(self, topo: NodeResourceTopology) -> list:
         # zones beyond MAX_NUMA are dropped, matching the snapshot packer

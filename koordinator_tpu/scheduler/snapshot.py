@@ -91,8 +91,11 @@ def ScheduleInputsReplace(base, updates):
     d.update(updates)
     return type(base)(**d)
 
-# node label overriding the NUMA topology policy (apis/extension NodeNUMAResource)
-LABEL_NUMA_TOPOLOGY_POLICY = "node.koordinator.sh/numa-topology-policy"
+# re-exported for existing importers; canonical home is topologymanager.py
+from koordinator_tpu.scheduler.topologymanager import (  # noqa: E402
+    LABEL_NUMA_TOPOLOGY_POLICY,
+    resolve_numa_policy,
+)
 
 
 @dataclass
@@ -232,8 +235,8 @@ def build_full_chain_inputs(
         topo_cr = state.topologies.get(name)
         if topo_cr is not None and topo_cr.cpus:
             has_topology[i] = True
-            policy_name = node.meta.labels.get(
-                LABEL_NUMA_TOPOLOGY_POLICY, topo_cr.kubelet_cpu_manager_policy
+            policy_name = resolve_numa_policy(
+                node.meta.labels, topo_cr.kubelet_cpu_manager_policy
             )
             numa_policy[i] = POLICY_BY_NAME.get(policy_name, POLICY_NONE)
             for zone in topo_cr.zones:

@@ -27,6 +27,11 @@ from typing import Dict, List, Optional, Protocol, Sequence, Tuple
 
 from koordinator_tpu.utils.bitmask import BitMask
 
+# node label selecting the NUMA topology policy (apis/extension); defined here
+# (not in snapshot.py) so both the snapshot packer and host plugins import it
+# without a cycle
+LABEL_NUMA_TOPOLOGY_POLICY = "node.koordinator.sh/numa-topology-policy"
+
 POLICY_NONE = "none"
 POLICY_BEST_EFFORT = "best-effort"
 POLICY_RESTRICTED = "restricted"
@@ -47,6 +52,15 @@ _CANON = {
 
 def canonical_policy(name: str) -> str:
     return _CANON.get(name, POLICY_NONE)
+
+
+def resolve_numa_policy(node_labels, kubelet_policy: str) -> str:
+    """Label-vs-kubelet-policy precedence, shared by the snapshot packer and
+    the host plugin (snapshot.py packs the same rule into the device tensors;
+    the two must agree): an explicit label — even an empty one — wins over the
+    reported kubelet cpu-manager policy."""
+    return canonical_policy(
+        node_labels.get(LABEL_NUMA_TOPOLOGY_POLICY, kubelet_policy))
 
 
 @dataclass

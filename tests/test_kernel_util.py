@@ -1,0 +1,192 @@
+"""Tests for the kernel interface layer: resctrl, core-sched, kidled,
+machine-info discovery, cgroup drivers (reference pkg/koordlet/util/system)."""
+
+import os
+
+import pytest
+
+from koordinator_tpu.koordlet.util import coresched, kidled, machineinfo, resctrl
+from koordinator_tpu.koordlet.util import system as sysutil
+
+
+@pytest.fixture()
+def fs():
+    f = sysutil.FakeFS()
+    yield f
+    f.cleanup()
+
+
+class TestResctrl:
+    def test_parse_and_format_schemata(self):
+        s = resctrl.parse_schemata("L3:0=fffff;1=fffff\nMB:0=100;1=100\n")
+        assert s.l3_masks == {0: 0xFFFFF, 1: 0xFFFFF}
+        assert s.mb_percents == {0: 100, 1: 100}
+        assert s.l3_num_ways == 20
+        round_trip = resctrl.parse_schemata(s.format())
+        assert round_trip.l3_masks == s.l3_masks
+        assert round_trip.mb_percents == s.mb_percents
+
+    def test_l3_mask_full_range(self):
+        assert resctrl.calculate_l3_mask(20, 0, 100) == 0xFFFFF
+
+    def test_l3_mask_be_slice_contiguous_and_nonempty(self):
+        mask = resctrl.calculate_l3_mask(20, 0, 30)
+        assert mask == 0x3F  # ceil(20*0.3)=6 ways
+        tiny = resctrl.calculate_l3_mask(4, 0, 1)
+        assert tiny == 0x1  # at least one way
+        # contiguity: mask+lsb must be a power of two
+        m = resctrl.calculate_l3_mask(11, 40, 80)
+        lsb = m & -m
+        assert ((m // lsb) + 1) & (m // lsb) == 0
+
+    def test_l3_mask_invalid_range(self):
+        with pytest.raises(ValueError):
+            resctrl.calculate_l3_mask(20, 50, 50)
+
+    def test_group_lifecycle_on_fakefs(self, fs):
+        iface = resctrl.ResctrlInterface(fs.config)
+        assert not iface.available()
+        root_schemata = resctrl.Schemata(l3_masks={0: 0xFFF}, mb_percents={0: 100})
+        sysutil.write_file(
+            os.path.join(iface.group_dir(""), "schemata"), root_schemata.format())
+        assert iface.available()
+        assert iface.num_l3_ways() == 12
+
+        be = resctrl.Schemata(
+            l3_masks={0: resctrl.calculate_l3_mask(12, 0, 30)},
+            mb_percents={0: 30})
+        assert iface.write_schemata(resctrl.BE_GROUP, be)
+        got = iface.read_schemata(resctrl.BE_GROUP)
+        assert got.l3_masks == {0: 0xF}
+        assert got.mb_percents == {0: 30}
+
+        assert iface.add_tasks(resctrl.BE_GROUP, [101, 102])
+        assert iface.add_tasks(resctrl.BE_GROUP, [103])
+        assert iface.read_tasks(resctrl.BE_GROUP) == [101, 102, 103]
+
+
+class TestCoreSched:
+    def test_fake_cookie_lifecycle(self):
+        cs = coresched.FakeCoreSched()
+        assert cs.supported()
+        assert cs.get_cookie(1) == 0
+        assert cs.create_cookie(1)
+        c1 = cs.get_cookie(1)
+        assert c1 != 0
+        assert cs.share_from(1, [2, 3]) == []
+        assert cs.get_cookie(2) == c1 == cs.get_cookie(3)
+        assert cs.create_cookie(4)
+        assert cs.get_cookie(4) != c1
+        assert cs.clear_cookie(2)
+        assert cs.get_cookie(2) == 0
+
+    def test_share_from_unknown_source_fails_all(self):
+        cs = coresched.FakeCoreSched()
+        assert cs.share_from(99, [1, 2]) == [1, 2]
+
+    def test_default_interface_is_real_and_probes(self):
+        iface = coresched.default_interface()
+        assert isinstance(iface, coresched.SystemCoreSched)
+        # supported() must not raise regardless of kernel capability
+        assert iface.supported() in (True, False)
+
+
+class TestKidled:
+    STATS = (
+        "# version: 1.0\n"
+        "# scans: 1380\n"
+        "# scan_period_in_seconds: 120\n"
+        "# buckets: 1,2,5,15,30,60,120,240\n"
+        "#   page_scans   idle_pages\n"
+        "csei 0 0 0 0 0 0 0 1048576\n"
+        "dsei 0 0 0 0 0 0 0 0\n"
+        "cfei 262144 0 0 0 0 524288 0 2097152\n"
+    )
+
+    def test_parse(self):
+        s = kidled.parse_idle_page_stats(self.STATS)
+        assert s.scan_period_s == 120
+        assert s.scans == 1380
+        assert s.buckets == [1, 2, 5, 15, 30, 60, 120, 240]
+        assert s.rows["csei"][-1] == 1048576
+
+    def test_cold_bytes_boundary(self):
+        s = kidled.parse_idle_page_stats(self.STATS)
+        # boundary 3600s -> buckets >= 30 periods (30*120=3600)
+        assert s.cold_bytes(3600) == 1048576 + 524288 + 2097152
+        # boundary above max bucket age -> only the 240-period column
+        assert s.cold_bytes(240 * 120) == 1048576 + 2097152
+        # boundary beyond any bucket -> nothing
+        assert s.cold_bytes(10**9) == 0
+
+    def test_interface_on_fakefs(self, fs):
+        iface = kidled.KidledInterface(fs.config)
+        assert not iface.supported()
+        assert iface.enable(scan_period_s=120)
+        assert iface.supported() and iface.enabled()
+        assert iface.scan_period_s() == 120
+        rel = fs.config.pod_relative_path(sysutil.QOS_BESTEFFORT, "uid1")
+        fs.set_cgroup(rel, kidled.IDLE_PAGE_STATS, self.STATS)
+        assert iface.pod_cold_bytes(rel, cold_boundary_s=3600) == 3670016
+
+
+class TestMachineInfo:
+    def test_discover_fake_machine(self, fs):
+        machineinfo.write_fake_machine(
+            fs, num_sockets=2, nodes_per_socket=2, cores_per_node=4)
+        info = machineinfo.discover(fs.config)
+        assert info is not None
+        topo = info.topology
+        assert topo.num_cpus == 2 * 2 * 4 * 2
+        assert topo.num_numa_nodes == 4
+        assert topo.cpus_per_core == 2
+        # SMT siblings stay on one core and one numa node
+        for core_id, cpus in topo.cores().items():
+            assert len(cpus) == 2
+        assert len(info.numa_mem) == 4
+        assert all(m.total_bytes == 32 << 30 for m in info.numa_mem.values())
+
+    def test_discover_missing_tree(self, fs):
+        assert machineinfo.discover(fs.config) is None
+
+
+class TestPlegSystemd:
+    def test_pleg_sees_systemd_pod_slices(self):
+        from koordinator_tpu.koordlet.pleg import Pleg
+
+        f = sysutil.FakeFS()
+        try:
+            f.config.cgroup_driver = sysutil.DRIVER_SYSTEMD
+            pleg = Pleg(f.config)
+            assert pleg.tick() == []  # baseline scan
+            rel = f.config.pod_relative_path(sysutil.QOS_BESTEFFORT, "ab-12")
+            f.set_cgroup(rel, sysutil.CPU_WEIGHT, "10")
+            events = pleg.tick()
+            assert [e.event_type for e in events] == ["pod_added"]
+            assert "podab_12.slice" in events[0].pod_dir
+        finally:
+            f.cleanup()
+
+
+class TestCgroupDriver:
+    def test_systemd_paths(self):
+        cfg = sysutil.SystemConfig(cgroup_driver=sysutil.DRIVER_SYSTEMD)
+        rel = cfg.pod_relative_path(sysutil.QOS_BESTEFFORT, "ab-12")
+        assert rel == ("kubepods.slice/kubepods-besteffort.slice/"
+                       "kubepods-besteffort-podab_12.slice")
+        cdir = cfg.container_relative_path(sysutil.QOS_BESTEFFORT, "ab-12", "c1")
+        assert cdir.endswith("cri-containerd-c1.scope")
+        # guaranteed sits right under kubepods.slice
+        assert cfg.pod_relative_path("", "x") == (
+            "kubepods.slice/kubepods-podx.slice")
+
+    def test_detect_driver_and_version(self, fs):
+        cfg = fs.config
+        assert sysutil.detect_cgroup_driver(cfg) == sysutil.DRIVER_CGROUPFS
+        os.makedirs(os.path.join(cfg.cgroup_root_dir, "kubepods.slice"))
+        assert sysutil.detect_cgroup_driver(cfg) == sysutil.DRIVER_SYSTEMD
+        assert not sysutil.detect_cgroup_version(cfg)
+        sysutil.write_file(
+            os.path.join(cfg.cgroup_root_dir, "cgroup.controllers"),
+            "cpu io memory")
+        assert sysutil.detect_cgroup_version(cfg)

@@ -174,8 +174,10 @@ class TestPluginIntegration:
             ObjectStore,
         )
         from koordinator_tpu.scheduler.plugins.nodenumaresource import (
-            LABEL_NUMA_TOPOLOGY_POLICY,
             NodeNUMAResourcePlugin,
+        )
+        from koordinator_tpu.scheduler.snapshot import (
+            LABEL_NUMA_TOPOLOGY_POLICY,
         )
 
         store = ObjectStore()
