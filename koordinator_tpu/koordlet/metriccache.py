@@ -31,9 +31,17 @@ NODE_CPU_PSI_FULL_AVG10 = "node_cpu_psi_full_avg10"
 NODE_MEM_PSI_FULL_AVG10 = "node_mem_psi_full_avg10"
 POD_CPI = "pod_cpi"
 HOST_APP_CPU_USAGE = "host_app_cpu_usage"
+HOST_APP_MEMORY_USAGE = "host_app_memory_usage"
+POD_PAGECACHE = "pod_pagecache"              # bytes of page cache per pod
+POD_COLD_MEMORY = "pod_cold_memory"          # kidled cold bytes per pod
+POD_CPU_THROTTLED_RATIO = "pod_cpu_throttled_ratio"  # nr_throttled/nr_periods
+NODE_FS_USED_BYTES = "node_fs_used_bytes"
+NODE_FS_TOTAL_BYTES = "node_fs_total_bytes"
+NODE_DISK_IO_TICKS = "node_disk_io_ticks"    # per-device busy-ms counter delta
 
 NODE_CPU_INFO_KEY = "node_cpu_info"
 NODE_NUMA_INFO_KEY = "node_numa_info"
+NODE_STORAGE_INFO_KEY = "node_storage_info"
 
 
 @dataclass(frozen=True)

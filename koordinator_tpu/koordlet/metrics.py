@@ -1,0 +1,142 @@
+"""Prometheus-style metrics registry for the node agent.
+
+Analog of reference `pkg/koordlet/metrics/`: gauges/counters for QoS actions
+(BE suppress level, evictions, CPI, PSI) labeled by node/pod, with a text
+exposition format so any scraper (or test) can read the agent's state. The
+control-plane components register their own metrics in the same registry
+class (`pkg/scheduler/metrics/`, `pkg/descheduler/metrics/` analogs reuse
+Registry instances).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _lk(labels: Dict[str, str]) -> _LabelKey:
+    return tuple(sorted(labels.items()))
+
+
+class _Metric:
+    def __init__(self, name: str, help_text: str, kind: str):
+        self.name = name
+        self.help = help_text
+        self.kind = kind
+        self._lock = threading.Lock()
+        self._values: Dict[_LabelKey, float] = {}
+
+    def _set(self, labels: Dict[str, str], value: float) -> None:
+        with self._lock:
+            self._values[_lk(labels)] = value
+
+    def _add(self, labels: Dict[str, str], delta: float) -> None:
+        key = _lk(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + delta
+
+    def get(self, **labels: str) -> Optional[float]:
+        with self._lock:
+            return self._values.get(_lk(labels))
+
+    def samples(self) -> List[Tuple[Dict[str, str], float]]:
+        with self._lock:
+            return [(dict(k), v) for k, v in sorted(self._values.items())]
+
+    def clear(self, **labels: str) -> None:
+        with self._lock:
+            self._values.pop(_lk(labels), None)
+
+
+class Gauge(_Metric):
+    def __init__(self, name: str, help_text: str = ""):
+        super().__init__(name, help_text, "gauge")
+
+    def set(self, value: float, **labels: str) -> None:
+        self._set(labels, value)
+
+
+class Counter(_Metric):
+    def __init__(self, name: str, help_text: str = ""):
+        super().__init__(name, help_text, "counter")
+
+    def inc(self, delta: float = 1.0, **labels: str) -> None:
+        self._add(labels, delta)
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        return self._register(Gauge(name, help_text))
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        return self._register(Counter(name, help_text))
+
+    def _register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                if existing.kind != metric.kind:
+                    raise ValueError(
+                        f"metric {metric.name} re-registered as {metric.kind}, "
+                        f"was {existing.kind}")
+                return existing
+            self._metrics[metric.name] = metric
+            return metric
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def expose(self) -> str:
+        """Prometheus text exposition format."""
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        lines: List[str] = []
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for labels, value in m.samples():
+                if labels:
+                    body = ",".join(
+                        f'{k}="{v}"' for k, v in sorted(labels.items()))
+                    lines.append(f"{m.name}{{{body}}} {value:g}")
+                else:
+                    lines.append(f"{m.name} {value:g}")
+        return "\n".join(lines) + "\n"
+
+
+# the agent-wide default registry and its well-known metrics
+# (pkg/koordlet/metrics/{common,resource_summary,qos}.go)
+REGISTRY = Registry()
+
+BE_SUPPRESS_CPU_CORES = REGISTRY.gauge(
+    "koordlet_be_suppress_cpu_cores",
+    "CPU cores the BE tier is currently suppressed to")
+POD_EVICTION_TOTAL = REGISTRY.counter(
+    "koordlet_pod_eviction_total",
+    "Pods evicted by qosmanager, labeled by reason")
+CONTAINER_CPI = REGISTRY.gauge(
+    "koordlet_container_cpi",
+    "Cycles per instruction, labeled by pod")
+NODE_CPU_PSI_FULL_AVG10 = REGISTRY.gauge(
+    "koordlet_node_cpu_psi_full_avg10",
+    "Node cpu full-stall pressure, 10s average")
+NODE_MEM_PSI_FULL_AVG10 = REGISTRY.gauge(
+    "koordlet_node_mem_psi_full_avg10",
+    "Node memory full-stall pressure, 10s average")
+NODE_RESOURCE_ALLOCATABLE = REGISTRY.gauge(
+    "koordlet_node_resource_allocatable",
+    "Node allocatable, labeled by resource")
+CPU_BURST_TOTAL = REGISTRY.counter(
+    "koordlet_cpu_burst_total",
+    "cfs burst applications, labeled by pod")
+RESCTRL_UPDATE_TOTAL = REGISTRY.counter(
+    "koordlet_resctrl_update_total",
+    "resctrl schemata updates, labeled by group")

@@ -29,12 +29,14 @@ from koordinator_tpu.api.objects import NodeSLO, Pod
 from koordinator_tpu.api.qos import QoSClass
 from koordinator_tpu.client.store import KIND_POD, ObjectStore
 from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet import metrics as koordlet_metrics
 from koordinator_tpu.koordlet.metricsadvisor import pod_qos_dir
 from koordinator_tpu.koordlet.resourceexecutor import (
     ResourceUpdateExecutor,
     ResourceUpdater,
 )
 from koordinator_tpu.koordlet.statesinformer import StatesInformer
+from koordinator_tpu.koordlet.util import resctrl as resctrl_util
 from koordinator_tpu.koordlet.util import system as sysutil
 from koordinator_tpu.utils.cpuset import CPUSet
 from koordinator_tpu.utils.features import KOORDLET_GATES
@@ -67,6 +69,7 @@ class Evictor:
         pod.meta.annotations["koordinator.sh/evicted"] = reason
         self.store.update(KIND_POD, pod)
         self.evicted.append(pod.meta.key)
+        koordlet_metrics.POD_EVICTION_TOTAL.inc(reason=reason)
 
 
 @dataclass
@@ -127,6 +130,7 @@ class CPUSuppress:
                 ResourceUpdater(be_rel, sysutil.CPU_CFS_QUOTA, str(quota))
             )
             self.policy_in_use = "cfsQuota"
+            koordlet_metrics.BE_SUPPRESS_CPU_CORES.set(quota / period)
         else:
             # cpuset policy: round up, at least 2, paired HT cores from the top
             want = min(max(int(math.ceil(suppress)), self.MIN_SUPPRESS_CPUS),
@@ -136,6 +140,7 @@ class CPUSuppress:
                 ResourceUpdater(be_rel, sysutil.CPUSET_CPUS, cpus.format())
             )
             self.policy_in_use = "cpuset"
+            koordlet_metrics.BE_SUPPRESS_CPU_CORES.set(float(want))
 
     def _recover(self, be_rel: str) -> None:
         if self.policy_in_use == "cfsQuota":
@@ -254,6 +259,7 @@ class CPUBurst:
                 self.ctx.executor.update(
                     ResourceUpdater(rel, sysutil.CPU_CFS_BURST, str(burst_us), level=1)
                 )
+                koordlet_metrics.CPU_BURST_TOTAL.inc(pod=pod.meta.key)
 
 
 class ResctrlReconcile:
@@ -266,7 +272,9 @@ class ResctrlReconcile:
 
     def __init__(self, ctx: QOSStrategyContext, cache_ways: int = 12):
         self.ctx = ctx
+        # fallback way count when the root schemata isn't readable
         self.cache_ways = cache_ways
+        self.iface = resctrl_util.ResctrlInterface(ctx.executor.config)
 
     def run(self, now: Optional[float] = None) -> None:
         if not KOORDLET_GATES.enabled("RdtResctrl"):
@@ -275,13 +283,17 @@ class ResctrlReconcile:
         qos = slo.resource_qos_strategy
         if not qos.be_enable:
             return
-        root = self.ctx.executor.config.resctrl_root()
-        ways = max(1, int(self.cache_ways * qos.llc_be_percent / 100))
-        mask = (1 << ways) - 1
-        schemata = f"L3:0={mask:x}\nMB:0={qos.mba_be_percent}\n"
-        sysutil.write_file(f"{root}/BE/schemata", schemata)
+        num_ways = self.iface.num_l3_ways() or self.cache_ways
+        schemata = resctrl_util.Schemata(
+            l3_masks={0: resctrl_util.calculate_l3_mask(
+                num_ways, 0, max(1, qos.llc_be_percent))},
+            mb_percents={0: qos.mba_be_percent},
+        )
+        self.iface.write_schemata(resctrl_util.BE_GROUP, schemata)
+        koordlet_metrics.RESCTRL_UPDATE_TOTAL.inc(group="BE")
         self.ctx.executor.auditor.record(
-            "info", "node", "resctrl_write", group="BE", schemata=schemata.strip()
+            "info", "node", "resctrl_write", group="BE",
+            schemata=schemata.format().strip()
         )
 
 

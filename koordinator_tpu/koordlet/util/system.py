@@ -87,6 +87,7 @@ class SystemConfig:
     cgroup_root_dir: str = "/sys/fs/cgroup"
     proc_root_dir: str = "/proc"
     sys_root_dir: str = "/sys"
+    fs_root_dir: str = "/"  # root volume for storage usage metrics
     use_cgroup_v2: bool = True
     cgroup_kube_root: str = "kubepods"
     cgroup_driver: str = DRIVER_CGROUPFS
@@ -306,6 +307,7 @@ class FakeFS:
             cgroup_root_dir=os.path.join(self.root, "cgroup"),
             proc_root_dir=os.path.join(self.root, "proc"),
             sys_root_dir=os.path.join(self.root, "sys"),
+            fs_root_dir=self.root,
             use_cgroup_v2=use_cgroup_v2,
         )
 

@@ -76,6 +76,7 @@ KOORDLET_GATES = FeatureGate(
         "CPUSuppress": True,
         "CgroupV2": True,
         "ColdPageCollector": False,
+        "PageCacheCollector": True,
         "CoreSched": False,
         "BlkIOReconcile": False,
         "TerwayQoS": False,

@@ -1,0 +1,198 @@
+"""Tests for the expanded metricsadvisor collector profile and the koordlet
+metrics registry (reference pkg/koordlet/metricsadvisor collectors +
+pkg/koordlet/metrics)."""
+
+import pytest
+
+from koordinator_tpu.api.objects import (
+    LABEL_POD_QOS,
+    Node,
+    NodeSLO,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client.store import (
+    KIND_NODE,
+    KIND_NODE_SLO,
+    KIND_POD,
+    ObjectStore,
+)
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet import metrics as km
+from koordinator_tpu.koordlet.metricsadvisor import MetricsAdvisor
+from koordinator_tpu.koordlet.metriccache import MetricCache
+from koordinator_tpu.koordlet.statesinformer import StatesInformer
+from koordinator_tpu.koordlet.util import kidled as kidled_util
+from koordinator_tpu.koordlet.util import machineinfo
+from koordinator_tpu.koordlet.util import system as sysutil
+from koordinator_tpu.koordlet.util.system import FakeFS
+from koordinator_tpu.utils.features import KOORDLET_GATES
+
+GIB = 1024**3
+NOW = 1_000_000.0
+
+
+@pytest.fixture
+def fs():
+    f = FakeFS(use_cgroup_v2=True)
+    yield f
+    f.cleanup()
+
+
+def build(fs, pods=()):
+    store = ObjectStore()
+    store.add(KIND_NODE, Node(
+        meta=ObjectMeta(name="node-0", namespace=""),
+        allocatable=ResourceList.of(cpu=16_000, memory=64 * GIB)))
+    for pod in pods:
+        store.add(KIND_POD, pod)
+    cache = MetricCache()
+    informer = StatesInformer(store, "node-0", cache)
+    advisor = MetricsAdvisor(informer, cache, fs.config)
+    return store, cache, informer, advisor
+
+
+def mk_pod(name, qos="LS"):
+    return Pod(
+        meta=ObjectMeta(name=name, uid=name, labels={LABEL_POD_QOS: qos}),
+        spec=PodSpec(node_name="node-0",
+                     requests=ResourceList.of(cpu=2000, memory=2 * GIB),
+                     limits=ResourceList.of(cpu=2000, memory=2 * GIB)),
+        phase="Running")
+
+
+class TestNewCollectors:
+    def test_nodeinfo_kv(self, fs):
+        machineinfo.write_fake_machine(fs, 1, 2, 4)
+        _, cache, _, advisor = build(fs)
+        advisor.collect_node_info(NOW)
+        topo = cache.get_kv(mc.NODE_CPU_INFO_KEY)
+        assert topo is not None and topo.num_cpus == 16
+        assert len(cache.get_kv(mc.NODE_NUMA_INFO_KEY)) == 2
+        # collected once only
+        advisor.collect_node_info(NOW + 60)
+        assert cache.get_kv(mc.NODE_CPU_INFO_KEY) is topo
+
+    def test_pagecache(self, fs):
+        pod = mk_pod("p1")
+        _, cache, _, advisor = build(fs, [pod])
+        rel = fs.config.pod_relative_path("", "p1")
+        fs.set_cgroup(rel, sysutil.MEMORY_STAT,
+                      "anon 1048576\nfile 2097152\nkernel 4096\n")
+        advisor.collect_pagecache(NOW)
+        assert cache.query(mc.POD_PAGECACHE, "latest",
+                           pod=pod.meta.key) == 2097152
+
+    def test_pod_throttled_ratio_needs_two_ticks(self, fs):
+        pod = mk_pod("p1")
+        _, cache, _, advisor = build(fs, [pod])
+        rel = fs.config.pod_relative_path("", "p1")
+        fs.set_cgroup(rel, sysutil.CPU_STAT,
+                      "usage_usec 1000\nnr_periods 100\nnr_throttled 10\n")
+        advisor.collect_pod_throttled(NOW)
+        assert cache.query(mc.POD_CPU_THROTTLED_RATIO, "latest",
+                           pod=pod.meta.key) is None
+        fs.set_cgroup(rel, sysutil.CPU_STAT,
+                      "usage_usec 2000\nnr_periods 200\nnr_throttled 60\n")
+        advisor.collect_pod_throttled(NOW + 60)
+        # delta 50 throttled / 100 periods
+        assert cache.query(mc.POD_CPU_THROTTLED_RATIO, "latest",
+                           pod=pod.meta.key) == pytest.approx(0.5)
+
+    def test_cold_memory_collector(self, fs):
+        pod = mk_pod("p1", qos="BE")
+        _, cache, _, advisor = build(fs, [pod])
+        kidled_util.KidledInterface(fs.config).enable(scan_period_s=120)
+        rel = fs.config.pod_relative_path(sysutil.QOS_BESTEFFORT, "p1")
+        fs.set_cgroup(rel, kidled_util.IDLE_PAGE_STATS,
+                      "# version: 1.0\n# scans: 10\n"
+                      "# scan_period_in_seconds: 120\n"
+                      "# buckets: 1,2,5,15,30,60,120,240\n"
+                      "cfei 0 0 0 4096 0 0 0 8192\n")
+        advisor.collect_cold_memory(NOW)
+        # boundary 300s -> buckets >= 5 periods: 4096 + 8192
+        assert cache.query(mc.POD_COLD_MEMORY, "latest",
+                           pod=pod.meta.key) == 12288
+
+    def test_host_application_collector(self, fs):
+        store, cache, _, advisor = build(fs)
+        store.add(KIND_NODE_SLO, NodeSLO(
+            meta=ObjectMeta(name="node-0", namespace=""),
+            extensions={"hostApplications": [
+                {"name": "nginx", "cgroupPath": "host-latency-sensitive/nginx"},
+            ]}))
+        fs.set_cgroup("host-latency-sensitive/nginx", sysutil.CPU_STAT,
+                      "usage_usec 1000000\n")
+        fs.set_cgroup("host-latency-sensitive/nginx", sysutil.MEMORY_USAGE,
+                      str(GIB))
+        advisor.collect_host_application(NOW)
+        assert cache.query(mc.HOST_APP_MEMORY_USAGE, "latest",
+                           app="nginx") == GIB
+        # cpu rate needs a second tick
+        fs.set_cgroup("host-latency-sensitive/nginx", sysutil.CPU_STAT,
+                      "usage_usec 2000000\n")
+        advisor.collect_host_application(NOW + 10)
+        assert cache.query(mc.HOST_APP_CPU_USAGE, "latest",
+                           app="nginx") == pytest.approx(0.1)
+
+    def test_storage_collector(self, fs):
+        _, cache, _, advisor = build(fs)
+        fs.set_proc("diskstats",
+                    " 259 0 nvme0n1 1 0 1 1 1 0 1 1 0 5000 10\n")
+        advisor.collect_node_storage_info(NOW)
+        advisor.collect_node_storage_info(NOW + 10)  # rate needs two ticks
+        assert cache.query(mc.NODE_FS_TOTAL_BYTES, "latest") > 0
+        assert cache.query(mc.NODE_FS_USED_BYTES, "latest") >= 0
+
+    def test_profile_respects_gates(self, fs):
+        pod = mk_pod("p1", qos="BE")
+        _, cache, _, advisor = build(fs, [pod])
+        kidled_util.KidledInterface(fs.config).enable(scan_period_s=120)
+        rel = fs.config.pod_relative_path(sysutil.QOS_BESTEFFORT, "p1")
+        fs.set_cgroup(rel, kidled_util.IDLE_PAGE_STATS,
+                      "# scan_period_in_seconds: 120\n"
+                      "# buckets: 1,2,5,15,30,60,120,240\n"
+                      "cfei 0 0 0 0 0 0 0 8192\n")
+        assert not KOORDLET_GATES.enabled("ColdPageCollector")
+        advisor.collect_once(NOW)
+        assert cache.query(mc.POD_COLD_MEMORY, "latest",
+                           pod=pod.meta.key) is None
+
+
+class TestMetricsRegistry:
+    def test_gauge_counter_and_exposition(self):
+        reg = km.Registry()
+        g = reg.gauge("test_gauge", "a gauge")
+        c = reg.counter("test_counter", "a counter")
+        g.set(2.5, node="n1")
+        c.inc(reason="mem")
+        c.inc(reason="mem")
+        c.inc(reason="cpu")
+        assert g.get(node="n1") == 2.5
+        assert c.get(reason="mem") == 2.0
+        text = reg.expose()
+        assert "# TYPE test_gauge gauge" in text
+        assert 'test_counter{reason="mem"} 2' in text
+
+    def test_reregistration_returns_same_metric(self):
+        reg = km.Registry()
+        g1 = reg.gauge("g")
+        g2 = reg.gauge("g")
+        assert g1 is g2
+        with pytest.raises(ValueError):
+            reg.counter("g")
+
+    def test_qos_actions_recorded(self, fs):
+        km.POD_EVICTION_TOTAL.clear(reason="test_mem")
+        from koordinator_tpu.koordlet.qosmanager import Evictor
+
+        store = ObjectStore()
+        pod = mk_pod("victim", qos="BE")
+        store.add(KIND_POD, pod)
+        cache = MetricCache()
+        informer = StatesInformer(store, "node-0", cache)
+        evictor = Evictor(store, informer, cache)
+        evictor.evict(pod, "test_mem")
+        assert km.POD_EVICTION_TOTAL.get(reason="test_mem") == 1.0
