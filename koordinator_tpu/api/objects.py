@@ -384,6 +384,9 @@ class ResourceQOSStrategy:
     be_group_identity: int = -1   # bvt for BE
     llc_be_percent: int = 100     # resctrl LLC ways for BE
     mba_be_percent: int = 100     # resctrl memory-bandwidth for BE
+    blkio_enable: bool = False    # per-QoS io weights (blkioQOS)
+    ls_blkio_weight: int = 500    # io.weight / blkio.bfq.weight for LS tier
+    be_blkio_weight: int = 100    # and for BE tier
 
 
 @dataclass

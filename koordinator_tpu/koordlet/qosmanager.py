@@ -159,6 +159,7 @@ class CPUSuppress:
                         )
                     )
         self.policy_in_use = None
+        koordlet_metrics.BE_SUPPRESS_CPU_CORES.clear()
 
 
 class CPUEvict:
@@ -284,9 +285,11 @@ class ResctrlReconcile:
         if not qos.be_enable:
             return
         num_ways = self.iface.num_l3_ways() or self.cache_ways
+        # tolerate out-of-range config (mis-rendered sloconfig) rather than
+        # crashing the whole strategy loop
+        percent = min(100, max(1, qos.llc_be_percent))
         schemata = resctrl_util.Schemata(
-            l3_masks={0: resctrl_util.calculate_l3_mask(
-                num_ways, 0, max(1, qos.llc_be_percent))},
+            l3_masks={0: resctrl_util.calculate_l3_mask(num_ways, 0, percent)},
             mb_percents={0: qos.mba_be_percent},
         )
         self.iface.write_schemata(resctrl_util.BE_GROUP, schemata)
@@ -325,6 +328,78 @@ class CgroupReconcile:
             )
 
 
+class BlkIOReconcile:
+    """Per-QoS-tier block-IO weights (plugins/blkio): LS gets a high io.weight
+    (v2; blkio.bfq.weight on v1 via the resource table translation), BE a low
+    one, so BE IO yields under contention."""
+
+    name = "blkio"
+
+    def __init__(self, ctx: QOSStrategyContext):
+        self.ctx = ctx
+
+    def run(self, now: Optional[float] = None) -> None:
+        if not KOORDLET_GATES.enabled("BlkIOReconcile"):
+            return
+        slo = self.ctx.informer.get_node_slo()
+        qos = slo.resource_qos_strategy
+        if not qos.blkio_enable:
+            return
+        # tier dirs first (besteffort/burstable; NOT the kubepods root —
+        # guaranteed pods are its direct children and boosting the root
+        # would change kubepods-vs-system weighting instead)
+        for qos_dir, weight in (
+            (sysutil.QOS_BURSTABLE, qos.ls_blkio_weight),
+            (sysutil.QOS_BESTEFFORT, qos.be_blkio_weight),
+        ):
+            rel = self.ctx.executor.config.qos_relative_path(qos_dir)
+            self.ctx.executor.update(
+                ResourceUpdater(rel, sysutil.BLKIO_WEIGHT, str(weight))
+            )
+        # guaranteed pods get the LS weight on their own pod dirs
+        for pod in self.ctx.informer.get_all_pods():
+            if pod_qos_dir(pod) != sysutil.QOS_GUARANTEED:
+                continue
+            weight = (qos.be_blkio_weight
+                      if pod.qos_class == QoSClass.BE else qos.ls_blkio_weight)
+            rel = self.ctx.executor.config.pod_relative_path(
+                sysutil.QOS_GUARANTEED, pod.meta.uid or pod.meta.name)
+            self.ctx.executor.update(
+                ResourceUpdater(rel, sysutil.BLKIO_WEIGHT, str(weight), level=1)
+            )
+
+
+class SystemReconcile:
+    """Node-level memory watermark tuning (plugins/sysreconcile): writes
+    /proc/sys/vm knobs from the NodeSLO system strategy so reclaim starts
+    early enough to protect LS pods from BE memory bursts."""
+
+    name = "sysreconcile"
+
+    def __init__(self, ctx: QOSStrategyContext):
+        self.ctx = ctx
+
+    def run(self, now: Optional[float] = None) -> None:
+        if not KOORDLET_GATES.enabled("SystemConfig"):
+            return
+        slo = self.ctx.informer.get_node_slo()
+        strategy = slo.system_strategy
+        cfg = self.ctx.executor.config
+        mem = sysutil.read_meminfo(cfg)
+        total_kb = mem.get("MemTotal", 0) // 1024
+        if total_kb:
+            # factor is per-ten-thousand of total memory
+            min_free = total_kb * strategy.min_free_kbytes_factor // 10_000
+            sysutil.write_file(
+                cfg.proc_path("sys/vm/min_free_kbytes"), str(min_free))
+        sysutil.write_file(
+            cfg.proc_path("sys/vm/watermark_scale_factor"),
+            str(strategy.watermark_scale_factor))
+        self.ctx.executor.auditor.record(
+            "info", "node", "sysreconcile",
+            watermark_scale_factor=str(strategy.watermark_scale_factor))
+
+
 class QoSManager:
     """Strategy loop (qosmanager framework)."""
 
@@ -339,6 +414,8 @@ class QoSManager:
             CPUBurst(self.ctx),
             ResctrlReconcile(self.ctx),
             CgroupReconcile(self.ctx),
+            BlkIOReconcile(self.ctx),
+            SystemReconcile(self.ctx),
         ]
 
     def run_once(self, now: Optional[float] = None) -> None:

@@ -45,6 +45,8 @@ CPU_PRESSURE = "cpu.pressure"
 MEMORY_PRESSURE = "memory.pressure"
 IO_PRESSURE = "io.pressure"
 BLKIO_WEIGHT = "blkio.bfq.weight"
+IO_WEIGHT = "io.weight"                  # v2
+IO_MAX = "io.max"                        # v2 "<maj:min> rbps=N wbps=N riops=N wiops=N"
 
 # v1 files live under a subsystem directory; v2 files under the unified dir
 _V1_SUBSYSTEM = {
@@ -68,6 +70,7 @@ V1_TO_V2 = {
     CPU_CFS_QUOTA: CPU_MAX,
     CPU_CFS_PERIOD: CPU_MAX,
     CPU_SHARES: CPU_WEIGHT,
+    BLKIO_WEIGHT: IO_WEIGHT,
 }
 
 QOS_BESTEFFORT = "besteffort"

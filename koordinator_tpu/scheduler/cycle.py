@@ -237,8 +237,12 @@ class Scheduler:
             node_idx = int(chosen[i])
             pod = by_key[key]
             if node_idx < 0:
-                (result.rejected if pod.gang_name or pod.quota_name
-                 else result.failed).append(key)
+                if pod.gang_name or pod.quota_name:
+                    result.rejected.append(key)
+                    self.extender.error_handlers.dispatch(pod, "admission rejected")
+                else:
+                    result.failed.append(key)
+                    self.extender.error_handlers.dispatch(pod, "no feasible node")
                 continue
             node_name = nodes.names[node_idx]
             reservation = pending_reservations.get(key)
@@ -247,6 +251,7 @@ class Scheduler:
             )
             if err:
                 result.failed.append(key)
+                self.extender.error_handlers.dispatch(pod, err)
 
         gang = self.extender.plugin("Coscheduling")
         if gang:

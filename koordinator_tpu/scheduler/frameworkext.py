@@ -104,6 +104,105 @@ class SchedulerMonitor:
         return self._slow_cycles
 
 
+class ErrorHandlerDispatcher:
+    """Scheduling-failure dispatch chain (frameworkext/errorhandler_dispatcher.go):
+    pre-handlers run in registration order until one consumes the failure;
+    unconsumed failures fall through to the default handler (requeue)."""
+
+    def __init__(self, history_size: int = 1024) -> None:
+        from collections import deque
+
+        self._handlers: List[Callable[[Pod, str], bool]] = []
+        self.default_handler: Optional[Callable[[Pod, str], None]] = None
+        # bounded (pod_key, reason) audit trail: permanently-pending pods
+        # dispatch every cycle, so an unbounded list would leak
+        self.failures = deque(maxlen=history_size)
+
+    def register(self, handler: Callable[[Pod, str], bool]) -> None:
+        self._handlers.append(handler)
+
+    def dispatch(self, pod: Pod, reason: str) -> None:
+        self.failures.append((pod.meta.key, reason))
+        for handler in self._handlers:
+            if handler(pod, reason):
+                return
+        if self.default_handler is not None:
+            self.default_handler(pod, reason)
+
+
+class ServicesEngine:
+    """Per-plugin debug/API endpoints (frameworkext/services/services.go:44-53):
+    plugins expose callables under /apis/v1/plugins/<plugin>/<endpoint>, and
+    /apis/v1/nodes/<name> dumps a node's scheduling view. `handle(path)` is the
+    routing core; `serve()` wraps it in a ThreadingHTTPServer for live use."""
+
+    def __init__(self, extender: "FrameworkExtender"):
+        self.extender = extender
+
+    def handle(self, path: str) -> Any:
+        parts = [p for p in path.split("/") if p]
+        if parts[:2] != ["apis", "v1"]:
+            raise KeyError(f"unknown path {path!r}")
+        if len(parts) == 4 and parts[2] == "nodes":
+            return self._dump_node(parts[3])
+        if len(parts) >= 5 and parts[2] == "plugins":
+            plugin = self.extender.plugin(parts[3])
+            if plugin is None:
+                raise KeyError(f"unknown plugin {parts[3]!r}")
+            services = getattr(plugin, "services", None)
+            endpoints = services() if callable(services) else {}
+            if parts[4] not in endpoints:
+                raise KeyError(f"plugin {parts[3]!r} has no endpoint {parts[4]!r}")
+            return endpoints[parts[4]]()
+        raise KeyError(f"unknown path {path!r}")
+
+    def _dump_node(self, name: str) -> Dict[str, Any]:
+        from koordinator_tpu.client.store import KIND_NODE, KIND_POD
+
+        node = self.extender.store.get(KIND_NODE, f"/{name}")
+        if node is None:
+            raise KeyError(f"unknown node {name!r}")
+        pods = [
+            p.meta.key
+            for p in self.extender.store.list(KIND_POD)
+            if p.spec.node_name == name and not p.is_terminated
+        ]
+        return {
+            "name": name,
+            "allocatable": dict(node.allocatable.quantities),
+            "pods": sorted(pods),
+        }
+
+    def serve(self, port: int = 0):
+        """Start an HTTP server exposing handle(); returns (server, thread)."""
+        import json
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        engine = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API)
+                try:
+                    route = self.path.split("?", 1)[0]
+                    payload = json.dumps(engine.handle(route)).encode()
+                    self.send_response(200)
+                except KeyError as e:
+                    payload = json.dumps({"error": str(e)}).encode()
+                    self.send_response(404)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *args):  # silence per-request stderr noise
+                pass
+
+        server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        return server, thread
+
+
 class FrameworkExtender:
     """Plugin registry + event fan-out (framework_extender_factory.go analog)."""
 
@@ -111,6 +210,8 @@ class FrameworkExtender:
         self.store = store
         self.plugins: List[Plugin] = []
         self.monitor = SchedulerMonitor()
+        self.error_handlers = ErrorHandlerDispatcher()
+        self.services = ServicesEngine(self)
         self._debug_top_n = 0
 
     def register_plugin(self, plugin: Plugin) -> None:

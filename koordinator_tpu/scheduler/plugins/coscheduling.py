@@ -35,6 +35,19 @@ class CoschedulingPlugin(Plugin):
         store.subscribe(KIND_POD_GROUP, self._on_pod_group)
         store.subscribe(KIND_POD, self._on_pod)
 
+    def services(self):
+        """frameworkext services endpoints (/apis/v1/plugins/Coscheduling/...)."""
+        return {
+            "gangs": lambda: {
+                name: {
+                    "min_member": pg.min_member,
+                    "members": self.members.get(name, 0),
+                    "assumed": self.assumed.get(name, 0),
+                }
+                for name, pg in sorted(self.pod_groups.items())
+            }
+        }
+
     def _on_pod_group(self, ev: EventType, pg: PodGroup, old) -> None:
         if ev is EventType.DELETED:
             self.pod_groups.pop(pg.meta.name, None)

@@ -34,6 +34,19 @@ class ElasticQuotaPlugin(Plugin):
         store.subscribe(KIND_ELASTIC_QUOTA, self._on_quota)
         store.subscribe(KIND_POD, self._on_pod)
 
+    def services(self):
+        """frameworkext services endpoints (/apis/v1/plugins/ElasticQuota/...)."""
+        return {
+            "quotas": lambda: {
+                name: {
+                    "min": dict(q.min.quantities),
+                    "max": dict(q.max.quantities),
+                    "used": self.used.get(name, np.zeros(NUM_RESOURCES)).tolist(),
+                }
+                for name, q in sorted(self.quotas.items())
+            }
+        }
+
     def _on_quota(self, ev: EventType, q: ElasticQuota, old) -> None:
         if ev is EventType.DELETED:
             self.quotas.pop(q.meta.name, None)

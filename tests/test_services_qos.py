@@ -1,0 +1,195 @@
+"""Tests for the frameworkext services engine + error-handler dispatcher and
+the blkio/sysreconcile QoS plugins (reference frameworkext/services,
+errorhandler_dispatcher.go, qosmanager plugins blkio + sysreconcile)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from koordinator_tpu.api.objects import (
+    LABEL_POD_QOS,
+    Node,
+    NodeSLO,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceQOSStrategy,
+    SystemStrategy,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client.store import (
+    KIND_NODE,
+    KIND_NODE_SLO,
+    KIND_POD,
+    ObjectStore,
+)
+from koordinator_tpu.koordlet.daemon import Daemon
+from koordinator_tpu.koordlet.util import system as sysutil
+from koordinator_tpu.koordlet.util.system import FakeFS
+from koordinator_tpu.scheduler.cycle import Scheduler
+from koordinator_tpu.scheduler.frameworkext import ErrorHandlerDispatcher
+from koordinator_tpu.utils.features import KOORDLET_GATES
+
+GIB = 1024**3
+NOW = 1_000_000.0
+
+
+@pytest.fixture
+def fs():
+    f = FakeFS(use_cgroup_v2=True)
+    yield f
+    f.cleanup()
+
+
+def _mk_slo(**kwargs):
+    return NodeSLO(meta=ObjectMeta(name="node-0", namespace=""), **kwargs)
+
+
+def _mk_node_env(store, fs, mem_gib=64):
+    store.add(KIND_NODE, Node(
+        meta=ObjectMeta(name="node-0", namespace=""),
+        allocatable=ResourceList.of(cpu=16_000, memory=mem_gib * GIB)))
+    fs.set_proc("stat", "cpu  1000 0 1000 8000 0 0 0 0 0 0\n")
+    fs.set_proc("meminfo",
+                f"MemTotal: {mem_gib * GIB // 1024} kB\n"
+                f"MemFree: {mem_gib * GIB // 2048} kB\n")
+
+
+class TestBlkIOReconcile:
+    def test_writes_per_tier_weights(self, fs):
+        store = ObjectStore()
+        _mk_node_env(store, fs)
+        store.add(KIND_NODE_SLO, _mk_slo(
+            resource_qos_strategy=ResourceQOSStrategy(
+                blkio_enable=True, ls_blkio_weight=500, be_blkio_weight=50)))
+        daemon = Daemon(store, "node-0", fs.config, report_interval_seconds=0)
+        KOORDLET_GATES.set_from_map({"BlkIOReconcile": True})
+        try:
+            daemon.run_once(now=NOW)
+        finally:
+            KOORDLET_GATES.reset()
+        be_rel = fs.config.qos_relative_path(sysutil.QOS_BESTEFFORT)
+        burstable_rel = fs.config.qos_relative_path(sysutil.QOS_BURSTABLE)
+        # v2 tree: blkio.bfq.weight translates to io.weight
+        assert fs.get_cgroup(be_rel, sysutil.BLKIO_WEIGHT) == "50"
+        assert fs.get_cgroup(burstable_rel, sysutil.BLKIO_WEIGHT) == "500"
+
+    def test_disabled_without_gate_or_strategy(self, fs):
+        store = ObjectStore()
+        _mk_node_env(store, fs)
+        store.add(KIND_NODE_SLO, _mk_slo(
+            resource_qos_strategy=ResourceQOSStrategy(blkio_enable=True)))
+        daemon = Daemon(store, "node-0", fs.config, report_interval_seconds=0)
+        daemon.run_once(now=NOW)  # gate off by default
+        be_rel = fs.config.qos_relative_path(sysutil.QOS_BESTEFFORT)
+        assert fs.get_cgroup(be_rel, sysutil.BLKIO_WEIGHT) is None
+
+
+class TestSystemReconcile:
+    def test_writes_vm_knobs(self, fs):
+        store = ObjectStore()
+        _mk_node_env(store, fs, mem_gib=64)
+        store.add(KIND_NODE_SLO, _mk_slo(
+            system_strategy=SystemStrategy(
+                min_free_kbytes_factor=100, watermark_scale_factor=200)))
+        daemon = Daemon(store, "node-0", fs.config, report_interval_seconds=0)
+        KOORDLET_GATES.set_from_map({"SystemConfig": True})
+        try:
+            daemon.run_once(now=NOW)
+        finally:
+            KOORDLET_GATES.reset()
+        total_kb = 64 * GIB // 1024
+        want_min_free = total_kb * 100 // 10_000
+        assert sysutil.read_file(
+            fs.config.proc_path("sys/vm/min_free_kbytes")) == str(want_min_free)
+        assert sysutil.read_file(
+            fs.config.proc_path("sys/vm/watermark_scale_factor")) == "200"
+
+
+class TestErrorHandlerDispatcher:
+    def _pod(self, name):
+        return Pod(meta=ObjectMeta(name=name))
+
+    def test_chain_and_default(self):
+        d = ErrorHandlerDispatcher()
+        seen = []
+        d.register(lambda pod, r: (seen.append(("h1", pod.meta.name)),
+                                   r == "handled-by-1")[1])
+        fallback = []
+        d.default_handler = lambda pod, r: fallback.append(pod.meta.name)
+        d.dispatch(self._pod("a"), "handled-by-1")
+        d.dispatch(self._pod("b"), "unhandled")
+        assert [s[1] for s in seen] == ["a", "b"]
+        assert fallback == ["b"]
+        assert [f[1] for f in d.failures] == ["handled-by-1", "unhandled"]
+
+    def test_cycle_dispatches_unschedulable(self):
+        store = ObjectStore()
+        # node too small for the pod -> no feasible node
+        store.add(KIND_NODE, Node(
+            meta=ObjectMeta(name="node-0", namespace=""),
+            allocatable=ResourceList.of(cpu=1000, memory=GIB)))
+        sched = Scheduler(store)
+        reasons = []
+        sched.extender.error_handlers.register(
+            lambda pod, r: (reasons.append((pod.meta.name, r)), True)[1])
+        store.add(KIND_POD, Pod(
+            meta=ObjectMeta(name="big", labels={LABEL_POD_QOS: "LS"}),
+            spec=PodSpec(requests=ResourceList.of(cpu=64_000, memory=GIB))))
+        result = sched.run_cycle(now=NOW)
+        assert result.failed == ["default/big"]
+        assert reasons and reasons[0][0] == "big"
+
+
+class TestServicesEngine:
+    def _sched(self):
+        store = ObjectStore()
+        store.add(KIND_NODE, Node(
+            meta=ObjectMeta(name="node-0", namespace=""),
+            allocatable=ResourceList.of(cpu=8000, memory=16 * GIB)))
+        store.add(KIND_POD, Pod(
+            meta=ObjectMeta(name="p1", labels={LABEL_POD_QOS: "LS"}),
+            spec=PodSpec(node_name="node-0",
+                         requests=ResourceList.of(cpu=1000, memory=GIB)),
+            phase="Running"))
+        return Scheduler(store)
+
+    def test_node_dump(self):
+        sched = self._sched()
+        out = sched.extender.services.handle("/apis/v1/nodes/node-0")
+        assert out["name"] == "node-0"
+        assert out["pods"] == ["default/p1"]
+        assert out["allocatable"]["cpu"] == 8000
+
+    def test_plugin_endpoints(self):
+        sched = self._sched()
+        quotas = sched.extender.services.handle(
+            "/apis/v1/plugins/ElasticQuota/quotas")
+        assert quotas == {}
+        gangs = sched.extender.services.handle(
+            "/apis/v1/plugins/Coscheduling/gangs")
+        assert gangs == {}
+        with pytest.raises(KeyError):
+            sched.extender.services.handle("/apis/v1/plugins/Nope/x")
+        with pytest.raises(KeyError):
+            sched.extender.services.handle("/apis/v1/plugins/ElasticQuota/nope")
+
+    def test_http_serving(self):
+        sched = self._sched()
+        server, _ = sched.extender.services.serve(port=0)
+        try:
+            port = server.server_address[1]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/apis/v1/nodes/node-0") as resp:
+                body = json.load(resp)
+            assert body["pods"] == ["default/p1"]
+            # 404 on unknown path
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/apis/v1/nodes/ghost")
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            server.shutdown()
