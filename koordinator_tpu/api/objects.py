@@ -252,7 +252,7 @@ class Reservation:
 class PodGroup:
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     min_member: int = 1
-    schedule_timeout_seconds: int = 600
+    schedule_timeout_seconds: int = 0  # 0 = use CoschedulingArgs.defaultTimeout
     # status
     phase: str = "Pending"
     scheduled: int = 0

@@ -69,13 +69,49 @@ class Scheduler:
         store: ObjectStore,
         args: Optional[LoadAwareArgs] = None,
         scheduler_name: str = "koord-scheduler",
+        config: Optional["SchedulerConfiguration"] = None,
     ):
+        from koordinator_tpu.scheduler.config import SchedulerConfiguration
+        from koordinator_tpu.scheduler.plugins.reservation import (
+            ReservationController,
+        )
+
+        self.config = config or SchedulerConfiguration()
+        self.config.validate()
         self.store = store
-        self.args = args or LoadAwareArgs()
+        # explicit args win over config (older call sites pass args directly)
+        self.args = args or self.config.load_aware
         self.scheduler_name = scheduler_name
         self.extender = FrameworkExtender(store)
+        numa_args = self.config.node_numa_resource
+        plugin_kwargs = {
+            "NodeNUMAResource": dict(
+                max_ref_count=numa_args.max_ref_count,
+                default_cpu_bind_policy=numa_args.default_cpu_bind_policy,
+                numa_allocate_strategy=numa_args.numa_allocate_strategy,
+            ),
+            "Coscheduling": dict(
+                default_timeout_seconds=self.config.coscheduling.default_timeout_seconds,
+            ),
+            "DeviceShare": dict(
+                scoring_strategy=self.config.device_share.scoring_strategy,
+            ),
+        }
         for cls in DEFAULT_PLUGINS:
-            self.extender.register_plugin(cls())
+            plugin = cls(**plugin_kwargs.get(cls.name, {}))
+            self.extender.register_plugin(plugin)
+        res_plugin = self.extender.plugin("Reservation")
+        self.reservation_controller = (
+            ReservationController(
+                res_plugin, store,
+                self.config.reservation.gc_duration_seconds)
+            if res_plugin else None
+        )
+        quota_plugin = self.extender.plugin("ElasticQuota")
+        self.quota_revoke_controller = (
+            quota_plugin.revoke_controller(store, self.config.elastic_quota)
+            if quota_plugin else None
+        )
         self._step_cache: Dict[Tuple, object] = {}
 
     # ------------------------------------------------------------------
@@ -175,9 +211,27 @@ class Scheduler:
         now = time.time() if now is None else now
         result = CycleResult()
         res_plugin = self.extender.plugin("Reservation")
-        if res_plugin:
-            res_plugin.expire_reservations(now)
+        if self.reservation_controller is not None:
+            self.reservation_controller.reconcile(now)
+        if self.quota_revoke_controller is not None:
+            self.quota_revoke_controller.reconcile(now)
         pending, pending_reservations = self._pending_queue(now)
+        # permit-timeout rejection: pods of terminally-failed gangs never
+        # re-enter admission (gang.go WaitingPods timeout semantics)
+        gang_plugin = self.extender.plugin("Coscheduling")
+        if gang_plugin is not None:
+            gang_plugin.update_pod_group_status(self.store, now)
+            dead_gangs = set(gang_plugin.timed_out_gangs())
+            if dead_gangs:
+                kept = []
+                for pod in pending:
+                    if pod.gang_name in dead_gangs:
+                        result.rejected.append(pod.meta.key)
+                        self.extender.error_handlers.dispatch(
+                            pod, "gang schedule timeout")
+                    else:
+                        kept.append(pod)
+                pending = kept
         if not pending:
             result.duration_seconds = time.perf_counter() - t_start
             self.extender.monitor.record(result)
@@ -253,9 +307,8 @@ class Scheduler:
                 result.failed.append(key)
                 self.extender.error_handlers.dispatch(pod, err)
 
-        gang = self.extender.plugin("Coscheduling")
-        if gang:
-            gang.update_pod_group_status(self.store)
+        if gang_plugin is not None:
+            gang_plugin.update_pod_group_status(self.store, now)
         result.duration_seconds = time.perf_counter() - t_start
         self.extender.monitor.record(result)
         return result

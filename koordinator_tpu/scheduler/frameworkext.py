@@ -190,6 +190,10 @@ class ServicesEngine:
                 except KeyError as e:
                     payload = json.dumps({"error": str(e)}).encode()
                     self.send_response(404)
+                except Exception as e:  # debug surface must answer, not drop
+                    payload = json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode()
+                    self.send_response(500)
                 self.send_header("Content-Type", "application/json")
                 self.end_headers()
                 self.wfile.write(payload)

@@ -9,7 +9,7 @@ co-admitted). The Permit barrier itself is the device-side post-pass
 from __future__ import annotations
 
 import json
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from koordinator_tpu.api.objects import Pod, PodGroup
 from koordinator_tpu.client.store import (
@@ -26,10 +26,17 @@ ANNOTATION_GANG_GROUPS = "gang.scheduling.koordinator.sh/groups"
 class CoschedulingPlugin(Plugin):
     name = "Coscheduling"
 
-    def __init__(self) -> None:
+    def __init__(self, default_timeout_seconds: float = 600.0) -> None:
         self.pod_groups: Dict[str, PodGroup] = {}
         self.assumed: Dict[str, int] = {}     # gang -> bound member count
         self.members: Dict[str, int] = {}     # gang -> known member count
+        # CoschedulingArgs.defaultTimeout: used when the PodGroup doesn't set
+        # its own scheduleTimeoutSeconds
+        self.default_timeout_seconds = default_timeout_seconds
+        # gangs that reached min-member at least once: a running gang that
+        # loses a member must NOT be timeout-failed (it is rescheduling, not
+        # stuck); rebuilt from observed Scheduled phase after restart
+        self._ever_scheduled: set = set()
 
     def register(self, store: ObjectStore) -> None:
         store.subscribe(KIND_POD_GROUP, self._on_pod_group)
@@ -88,15 +95,42 @@ class CoschedulingPlugin(Plugin):
         except (ValueError, TypeError):
             return [gang_name]
 
-    def update_pod_group_status(self, store: ObjectStore) -> None:
-        """PodGroup status controller analog (controller/podgroup.go:55-313)."""
+    def update_pod_group_status(self, store: ObjectStore,
+                                now: Optional[float] = None) -> None:
+        """PodGroup status controller analog (controller/podgroup.go:55-313):
+        phase progression Pending -> Scheduling -> Scheduled, plus timeout —
+        a gang that hasn't reached min-member within its schedule timeout
+        (from creation) is marked Failed, and stays Failed (terminal)."""
+        import time as _time
+
+        now = _time.time() if now is None else now
         for pg in self.pod_groups.values():
-            scheduled = self.assumed.get(pg.meta.name, 0)
-            phase = (
-                "Scheduled"
-                if scheduled >= pg.min_member
-                else ("Scheduling" if scheduled else "Pending")
-            )
+            name = pg.meta.name
+            scheduled = self.assumed.get(name, 0)
+            if pg.phase == "Scheduled":  # restart recovery of the latch
+                self._ever_scheduled.add(name)
+            timeout = pg.schedule_timeout_seconds or self.default_timeout_seconds
+            if scheduled >= pg.min_member:
+                phase = "Scheduled"
+                self._ever_scheduled.add(name)
+            elif name in self._ever_scheduled:
+                # once-scheduled gangs are rescheduling, never timeout-failed
+                phase = "Scheduling" if scheduled else "Pending"
+            elif pg.phase == "Failed":
+                phase = "Failed"
+            elif (timeout > 0 and pg.meta.creation_timestamp
+                  and now - pg.meta.creation_timestamp > timeout):
+                phase = "Failed"
+            elif scheduled:
+                phase = "Scheduling"
+            else:
+                phase = "Pending"
             if pg.scheduled != scheduled or pg.phase != phase:
                 pg.scheduled, pg.phase = scheduled, phase
                 store.update(KIND_POD_GROUP, pg)
+
+    def timed_out_gangs(self) -> List[str]:
+        """Gangs whose PodGroup is terminally Failed — the cycle driver
+        excludes their pods from admission (permit timeout rejection)."""
+        return [name for name, pg in self.pod_groups.items()
+                if pg.phase == "Failed"]

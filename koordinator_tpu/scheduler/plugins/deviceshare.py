@@ -44,11 +44,12 @@ def pod_gpu_request(pod: Pod) -> Dict[str, int]:
 class DeviceSharePlugin(Plugin):
     name = "DeviceShare"
 
-    def __init__(self) -> None:
+    def __init__(self, scoring_strategy: str = "MostAllocated") -> None:
         self.devices: Dict[str, Device] = {}          # node -> Device CR
         # node -> minor -> {"core": used, "memory_ratio": used, "memory": used}
         self.allocated: Dict[str, Dict[int, Dict[str, int]]] = {}
         self.by_pod: Dict[str, List[dict]] = {}
+        self.scoring_strategy = scoring_strategy
 
     def register(self, store: ObjectStore) -> None:
         store.subscribe(KIND_DEVICE, self._on_device)
@@ -75,12 +76,14 @@ class DeviceSharePlugin(Plugin):
         node_alloc = self.allocated.setdefault(node_name, {})
         remaining_core = want.get("core", 0)
         picks: List[dict] = []
-        # full GPUs first (multiples of 100 core), then best-fit fractional
-        # (device_allocator.go preference: pack fractional, keep whole GPUs free)
+        # DeviceShareArgs.scoringStrategy: MostAllocated packs fractional
+        # requests onto fuller GPUs (keeps whole GPUs free for whole-GPU
+        # pods, device_allocator.go preference); LeastAllocated spreads
+        sign = -1 if self.scoring_strategy == "MostAllocated" else 1
         order = sorted(
             gpus,
             key=lambda g: (
-                -node_alloc.get(g.minor, {}).get("core", 0),  # fuller first
+                sign * node_alloc.get(g.minor, {}).get("core", 0),
                 g.minor,
             ),
         )

@@ -93,6 +93,9 @@ class ElasticQuotaPlugin(Plugin):
     def quota_list(self) -> List[ElasticQuota]:
         return list(self.quotas.values())
 
+    def revoke_controller(self, store: ObjectStore, args) -> "QuotaOveruseRevokeController":
+        return QuotaOveruseRevokeController(self, store, args)
+
     # quota_overuse_revoke.go analog: pods to evict when a group exceeds runtime
     def find_overuse_victims(
         self, runtime_by_name: Dict[str, np.ndarray], pods: List[Pod]
@@ -119,3 +122,71 @@ class ElasticQuotaPlugin(Plugin):
                 victims.append(pod)
                 over = over - pod.spec.requests.to_vector()
         return victims
+
+
+class QuotaOveruseRevokeController:
+    """Overuse revocation loop (quota_overuse_revoke.go): every
+    revokePodInterval, recompute runtime quotas from the live tree and evict
+    members of groups whose used exceeds runtime — but only after the group
+    has been continuously over-quota for delayEvictTime (grace for transient
+    overshoot after a min shrink). Gated by ElasticQuotaArgs.monitorAllQuotas."""
+
+    def __init__(self, plugin: ElasticQuotaPlugin, store: ObjectStore, args):
+        self.plugin = plugin
+        self.store = store
+        self.args = args
+        self._last_run: float = 0.0
+        self._over_since: Dict[str, float] = {}
+
+    def _runtime_by_name(self) -> Dict[str, np.ndarray]:
+        from koordinator_tpu.api.resources import ResourceList
+        from koordinator_tpu.client.store import KIND_NODE
+        from koordinator_tpu.ops.quota import build_quota_tree, compute_runtime_quotas
+
+        quotas = self.plugin.quota_list()
+        if not quotas:
+            return {}
+        total = ResourceList()
+        for node in self.store.list(KIND_NODE):
+            total = total.add(node.allocatable)
+        tree = build_quota_tree(
+            quotas,
+            pod_requests_by_quota=self.plugin.pending,
+            used_by_quota=self.plugin.used,
+        )
+        runtime = compute_runtime_quotas(tree, total.to_vector())
+        return {q.meta.name: runtime[i] for i, q in enumerate(quotas)}
+
+    def reconcile(self, now: float) -> List[str]:
+        """Returns keys of evicted pods."""
+        if not self.args.monitor_all_quotas:
+            return []
+        if now - self._last_run < self.args.revoke_pod_interval_seconds:
+            return []
+        self._last_run = now
+        runtime = self._runtime_by_name()
+        if not runtime:
+            return []
+        # grace tracking: a group only becomes revocable after delayEvictTime
+        revocable: Dict[str, np.ndarray] = {}
+        for name, used in self.plugin.used.items():
+            rt = runtime.get(name)
+            if rt is None:
+                continue
+            if (np.maximum(used - rt, 0.0) > 0).any():
+                since = self._over_since.setdefault(name, now)
+                if now - since >= self.args.delay_evict_time_seconds:
+                    revocable[name] = rt
+            else:
+                self._over_since.pop(name, None)
+        if not revocable:
+            return []
+        pods = [p for p in self.store.list(KIND_POD)]
+        victims = self.plugin.find_overuse_victims(revocable, pods)
+        evicted = []
+        for pod in victims:
+            pod.phase = "Failed"
+            pod.meta.annotations["koordinator.sh/evicted"] = "quota-overused"
+            self.store.update(KIND_POD, pod)
+            evicted.append(pod.meta.key)
+        return evicted

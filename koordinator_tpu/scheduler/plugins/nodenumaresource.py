@@ -47,8 +47,12 @@ from koordinator_tpu.scheduler.topologymanager import (
 class NodeNUMAResourcePlugin(Plugin):
     name = "NodeNUMAResource"
 
-    def __init__(self, max_ref_count: int = 1) -> None:
+    def __init__(self, max_ref_count: int = 1,
+                 default_cpu_bind_policy: str = FULL_PCPUS,
+                 numa_allocate_strategy: str = "MostAllocated") -> None:
         self.max_ref_count = max_ref_count
+        self.default_cpu_bind_policy = default_cpu_bind_policy
+        self.numa_allocate_strategy = numa_allocate_strategy
         self.cpu_states: Dict[str, CPUAllocationState] = {}
         self.topologies: Dict[str, NodeResourceTopology] = {}
         self.numa_allocated: Dict[str, np.ndarray] = {}
@@ -164,7 +168,8 @@ class NodeNUMAResourcePlugin(Plugin):
                     if err:
                         self._pending_affinity.pop(pod.meta.key, None)
                         return err
-        needs_bind, cores, full_pcpus = _pod_cpuset_flags(pod)
+        needs_bind, cores, full_pcpus = _pod_cpuset_flags(
+            pod, self.default_cpu_bind_policy)
         if not needs_bind:
             self._track_numa(pod, node_name, add=True)
             return None
@@ -176,6 +181,7 @@ class NodeNUMAResourcePlugin(Plugin):
             state,
             int(cores),
             bind_policy=FULL_PCPUS if full_pcpus else SPREAD_BY_PCPUS,
+            numa_strategy=self.numa_allocate_strategy,
         )
         if got is None:
             self._pending_affinity.pop(pod.meta.key, None)

@@ -121,3 +121,40 @@ class ReservationPlugin(Plugin):
                 if self._store is not None:
                     self._store.update(KIND_RESERVATION, res)
         return expired
+
+
+class ReservationController:
+    """Expiry + GC controller (plugins/reservation/controller/controller.go):
+    each reconcile pass expires overdue Pending/Available reservations (via
+    the plugin, which owns the cache), marks fully-allocated allocate-once
+    reservations Succeeded, and deletes terminal (Failed/Succeeded)
+    reservations once they have been terminal for gc_duration_seconds."""
+
+    def __init__(self, plugin: ReservationPlugin, store: ObjectStore,
+                 gc_duration_seconds: float = 24 * 3600.0):
+        self.plugin = plugin
+        self.store = store
+        self.gc_duration = gc_duration_seconds
+        self._terminal_since: Dict[str, float] = {}
+
+    def reconcile(self, now: Optional[float] = None) -> Dict[str, List[str]]:
+        now = time.time() if now is None else now
+        expired = self.plugin.expire_reservations(now)
+        succeeded: List[str] = []
+        deleted: List[str] = []
+        for res in list(self.plugin.by_name.values()):
+            # allocate-once reservations that have been consumed are done
+            if (res.phase == "Available" and res.allocate_once
+                    and res.current_owners):
+                res.phase = "Succeeded"
+                succeeded.append(res.meta.name)
+                self.store.update(KIND_RESERVATION, res)
+            if res.phase in ("Failed", "Succeeded"):
+                since = self._terminal_since.setdefault(res.meta.name, now)
+                if now - since >= self.gc_duration:
+                    self.store.delete(KIND_RESERVATION, res.meta.key)
+                    self._terminal_since.pop(res.meta.name, None)
+                    deleted.append(res.meta.name)
+            else:
+                self._terminal_since.pop(res.meta.name, None)
+        return {"expired": expired, "succeeded": succeeded, "deleted": deleted}

@@ -1,0 +1,243 @@
+"""Tests for scheduler componentconfig (defaults/validation/strict decode),
+the Reservation GC controller, and PodGroup timeout handling (reference
+pkg/scheduler/apis/config, plugins/reservation/controller,
+plugins/coscheduling/controller/podgroup.go)."""
+
+import pytest
+
+from koordinator_tpu.api.objects import (
+    LABEL_POD_GROUP,
+    LABEL_POD_QOS,
+    Node,
+    ObjectMeta,
+    Pod,
+    PodGroup,
+    PodSpec,
+    Reservation,
+    ReservationOwner,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client.store import (
+    KIND_NODE,
+    KIND_POD,
+    KIND_POD_GROUP,
+    KIND_RESERVATION,
+    ObjectStore,
+)
+from koordinator_tpu.scheduler import config as schedcfg
+from koordinator_tpu.scheduler.cycle import Scheduler
+from koordinator_tpu.scheduler.plugins.reservation import (
+    ReservationController,
+    ReservationPlugin,
+)
+
+GIB = 1024**3
+NOW = 1_000_000.0
+
+
+class TestComponentConfig:
+    def test_defaults_validate(self):
+        schedcfg.SchedulerConfiguration().validate()
+
+    def test_invalid_fields_aggregate(self):
+        cfg = schedcfg.SchedulerConfiguration()
+        cfg.node_numa_resource.default_cpu_bind_policy = "Bogus"
+        cfg.coscheduling.default_timeout_seconds = -1
+        cfg.load_aware.usage_thresholds = {"cpu": 150}
+        with pytest.raises(schedcfg.ConfigValidationError) as e:
+            cfg.validate()
+        assert len(e.value.errors) == 3
+
+    def test_from_dict_defaults_and_overrides(self):
+        cfg = schedcfg.from_dict({
+            "Reservation": {"gc_duration_seconds": 60.0},
+            "Coscheduling": {},
+        })
+        assert cfg.reservation.gc_duration_seconds == 60.0
+        assert cfg.reservation.min_candidate_nodes_percentage == 10  # default
+        assert cfg.coscheduling.default_timeout_seconds == 600.0
+
+    def test_from_dict_strict(self):
+        with pytest.raises(schedcfg.ConfigValidationError) as e:
+            schedcfg.from_dict({
+                "NopePlugin": {},
+                "Reservation": {"bogus_field": 1},
+            })
+        assert len(e.value.errors) == 2
+
+    def test_scheduler_wires_config(self):
+        store = ObjectStore()
+        cfg = schedcfg.SchedulerConfiguration()
+        cfg.node_numa_resource.max_ref_count = 3
+        cfg.reservation.gc_duration_seconds = 1.0
+        sched = Scheduler(store, config=cfg)
+        assert sched.extender.plugin("NodeNUMAResource").max_ref_count == 3
+        assert sched.reservation_controller.gc_duration == 1.0
+
+    def test_scheduler_rejects_invalid_config(self):
+        cfg = schedcfg.SchedulerConfiguration()
+        cfg.device_share.scoring_strategy = "Bogus"
+        with pytest.raises(schedcfg.ConfigValidationError):
+            Scheduler(ObjectStore(), config=cfg)
+
+
+def _reservation(name, phase="Pending", node="", ttl=None, created=NOW,
+                 allocate_once=True, owners=()):
+    return Reservation(
+        meta=ObjectMeta(name=name, namespace="", creation_timestamp=created),
+        template=PodSpec(requests=ResourceList.of(cpu=1000, memory=GIB)),
+        owners=list(owners) or [ReservationOwner()],
+        ttl_seconds=ttl, phase=phase, node_name=node,
+        allocatable=ResourceList.of(cpu=1000, memory=GIB))
+
+
+class TestReservationController:
+    def _setup(self, gc=100.0):
+        store = ObjectStore()
+        plugin = ReservationPlugin()
+        plugin.register(store)
+        ctl = ReservationController(plugin, store, gc_duration_seconds=gc)
+        return store, plugin, ctl
+
+    def test_expire_then_gc(self):
+        store, plugin, ctl = self._setup(gc=100.0)
+        store.add(KIND_RESERVATION,
+                  _reservation("r1", ttl=50, created=NOW - 60))
+        out = ctl.reconcile(NOW)
+        assert out["expired"] == ["r1"]
+        assert store.get(KIND_RESERVATION, "/r1").phase == "Failed"
+        # still within gc window
+        assert ctl.reconcile(NOW + 50)["deleted"] == []
+        assert ctl.reconcile(NOW + 101)["deleted"] == ["r1"]
+        assert store.get(KIND_RESERVATION, "/r1") is None
+
+    def test_allocate_once_consumed_succeeds(self):
+        store, plugin, ctl = self._setup()
+        res = _reservation("r2", phase="Available", node="node-0")
+        res.current_owners = ["default/p1"]
+        store.add(KIND_RESERVATION, res)
+        out = ctl.reconcile(NOW)
+        assert out["succeeded"] == ["r2"]
+        assert store.get(KIND_RESERVATION, "/r2").phase == "Succeeded"
+
+    def test_live_reservation_untouched(self):
+        store, plugin, ctl = self._setup()
+        store.add(KIND_RESERVATION,
+                  _reservation("r3", phase="Available", node="node-0",
+                               allocate_once=False))
+        out = ctl.reconcile(NOW + 10_000)
+        assert out == {"expired": [], "succeeded": [], "deleted": []}
+
+
+class TestPodGroupTimeout:
+    def _gang_pod(self, name, gang):
+        return Pod(
+            meta=ObjectMeta(name=name, creation_timestamp=NOW - 700,
+                            labels={LABEL_POD_QOS: "LS",
+                                    LABEL_POD_GROUP: gang}),
+            spec=PodSpec(requests=ResourceList.of(cpu=1000, memory=GIB)))
+
+    def test_timed_out_gang_rejected(self):
+        store = ObjectStore()
+        store.add(KIND_NODE, Node(
+            meta=ObjectMeta(name="node-0", namespace=""),
+            allocatable=ResourceList.of(cpu=32_000, memory=64 * GIB)))
+        # gang created 700s ago with 600s timeout and unreachable min_member
+        store.add(KIND_POD_GROUP, PodGroup(
+            meta=ObjectMeta(name="gang-a", creation_timestamp=NOW - 700),
+            min_member=5, schedule_timeout_seconds=600))
+        store.add(KIND_POD, self._gang_pod("m1", "gang-a"))
+        sched = Scheduler(store)
+        result = sched.run_cycle(now=NOW)
+        assert result.rejected == ["default/m1"]
+        assert store.get(KIND_POD_GROUP, "default/gang-a").phase == "Failed"
+        # failure reason recorded through the dispatcher
+        assert ("default/m1", "gang schedule timeout") in list(
+            sched.extender.error_handlers.failures)
+
+    def test_once_scheduled_gang_never_timeout_failed(self):
+        """A gang that reached min-member must not be failed when a member
+        later terminates, no matter how old the PodGroup is."""
+        store = ObjectStore()
+        store.add(KIND_POD_GROUP, PodGroup(
+            meta=ObjectMeta(name="gang-c", creation_timestamp=NOW - 10_000),
+            min_member=2, schedule_timeout_seconds=600))
+        sched = Scheduler(store)
+        gang = sched.extender.plugin("Coscheduling")
+        gang.assumed["gang-c"] = 2
+        gang.update_pod_group_status(store, NOW)
+        assert store.get(KIND_POD_GROUP, "default/gang-c").phase == "Scheduled"
+        gang.assumed["gang-c"] = 1  # member died
+        gang.update_pod_group_status(store, NOW + 100)
+        assert store.get(KIND_POD_GROUP, "default/gang-c").phase == "Scheduling"
+        assert gang.timed_out_gangs() == []
+
+    def test_default_timeout_from_config(self):
+        import koordinator_tpu.scheduler.config as schedcfg_mod
+
+        store = ObjectStore()
+        cfg = schedcfg_mod.SchedulerConfiguration()
+        cfg.coscheduling.default_timeout_seconds = 50.0
+        # PodGroup leaves scheduleTimeoutSeconds unset (0) -> config default
+        store.add(KIND_POD_GROUP, PodGroup(
+            meta=ObjectMeta(name="gang-d", creation_timestamp=NOW - 60),
+            min_member=2))
+        sched = Scheduler(store, config=cfg)
+        gang = sched.extender.plugin("Coscheduling")
+        gang.update_pod_group_status(store, NOW)
+        assert store.get(KIND_POD_GROUP, "default/gang-d").phase == "Failed"
+
+
+class TestQuotaOveruseRevoke:
+    def test_revoke_after_grace(self):
+        from koordinator_tpu.api.objects import (
+            LABEL_QUOTA_NAME,
+            ElasticQuota,
+        )
+        from koordinator_tpu.client.store import KIND_ELASTIC_QUOTA
+        from koordinator_tpu.scheduler.config import SchedulerConfiguration
+
+        store = ObjectStore()
+        store.add(KIND_NODE, Node(
+            meta=ObjectMeta(name="node-0", namespace=""),
+            allocatable=ResourceList.of(cpu=8000, memory=16 * GIB)))
+        store.add(KIND_ELASTIC_QUOTA, ElasticQuota(
+            meta=ObjectMeta(name="team-a", namespace=""),
+            min=ResourceList.of(cpu=1000, memory=GIB),
+            max=ResourceList.of(cpu=2000, memory=2 * GIB)))
+        cfg = SchedulerConfiguration()
+        cfg.elastic_quota.monitor_all_quotas = True
+        cfg.elastic_quota.delay_evict_time_seconds = 100.0
+        cfg.elastic_quota.revoke_pod_interval_seconds = 1.0
+        sched = Scheduler(store, config=cfg)
+        # a running pod way over the group's max (and hence over runtime)
+        store.add(KIND_POD, Pod(
+            meta=ObjectMeta(name="hog", labels={LABEL_POD_QOS: "LS",
+                                                LABEL_QUOTA_NAME: "team-a"}),
+            spec=PodSpec(node_name="node-0",
+                         requests=ResourceList.of(cpu=4000, memory=4 * GIB)),
+            phase="Running"))
+        ctl = sched.quota_revoke_controller
+        assert ctl.reconcile(NOW) == []          # grace period
+        assert ctl.reconcile(NOW + 50) == []     # still within grace
+        evicted = ctl.reconcile(NOW + 150)
+        assert evicted == ["default/hog"]
+        assert store.get(KIND_POD, "default/hog").phase == "Failed"
+
+    def test_disabled_by_default(self):
+        store = ObjectStore()
+        sched = Scheduler(store)
+        assert sched.quota_revoke_controller.reconcile(NOW) == []
+
+
+class TestPodGroupWithinTimeout:
+    def test_gang_within_timeout_not_failed(self):
+        store = ObjectStore()
+        store.add(KIND_POD_GROUP, PodGroup(
+            meta=ObjectMeta(name="gang-b", creation_timestamp=NOW - 10),
+            min_member=2, schedule_timeout_seconds=600))
+        sched = Scheduler(store)
+        gang = sched.extender.plugin("Coscheduling")
+        gang.update_pod_group_status(store, NOW)
+        assert store.get(KIND_POD_GROUP, "default/gang-b").phase == "Pending"
+        assert gang.timed_out_gangs() == []
