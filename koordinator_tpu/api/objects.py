@@ -469,6 +469,22 @@ class PodMigrationJob:
     message: str = ""
 
 
+@dataclass
+class PodDisruptionBudget:
+    """policy/v1 PDB subset the eviction helpers honor
+    (pkg/descheduler/evictions respects PDBs before evicting)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Dict[str, str] = field(default_factory=dict)  # label selector
+    min_available: Optional[int] = None
+    max_unavailable: Optional[int] = None
+
+    def matches(self, pod: "Pod") -> bool:
+        if pod.meta.namespace != self.meta.namespace:
+            return False
+        return all(pod.meta.labels.get(k) == v for k, v in self.selector.items())
+
+
 # ---------------------------------------------------------------------------
 # ClusterColocationProfile CR (webhook/pod/mutating/cluster_colocation_profile.go)
 # ---------------------------------------------------------------------------

@@ -48,6 +48,7 @@ KIND_POD_MIGRATION_JOB = "PodMigrationJob"
 KIND_COLOCATION_PROFILE = "ClusterColocationProfile"
 KIND_QUOTA_PROFILE = "ElasticQuotaProfile"
 KIND_CONFIG_MAP = "ConfigMap"
+KIND_PDB = "PodDisruptionBudget"
 
 ALL_KINDS = (
     KIND_POD,
@@ -63,6 +64,7 @@ ALL_KINDS = (
     KIND_COLOCATION_PROFILE,
     KIND_QUOTA_PROFILE,
     KIND_CONFIG_MAP,
+    KIND_PDB,
 )
 
 

@@ -88,9 +88,17 @@ class Arbitrator:
 
 
 class MigrationController:
-    def __init__(self, store: ObjectStore, arbitrator: Optional[Arbitrator] = None):
+    def __init__(self, store: ObjectStore, arbitrator: Optional[Arbitrator] = None,
+                 evictor: Optional[object] = None):
+        from koordinator_tpu.descheduler.evictions import (
+            ControllerFinder,
+            EvictionAPIEvictor,
+        )
+
         self.store = store
         self.arbitrator = arbitrator or Arbitrator(store)
+        self.evictor = evictor or EvictionAPIEvictor(store)
+        self.finder = ControllerFinder(store)
 
     def reconcile(self, now: Optional[float] = None) -> int:
         """One pass over migration jobs; returns state transitions."""
@@ -109,10 +117,7 @@ class MigrationController:
             if job.phase != "Running":
                 continue
             if now - job.meta.creation_timestamp > job.ttl_seconds:
-                job.phase = "Failed"
-                job.message = "timeout"
-                self.store.update(KIND_POD_MIGRATION_JOB, job)
-                changes += 1
+                changes += self._fail(job, "timeout")
                 continue
             pod = self.store.get(KIND_POD, f"{job.pod_namespace}/{job.pod_name}")
             if pod is None or pod.is_terminated:
@@ -127,11 +132,37 @@ class MigrationController:
             if job.mode == "ReservationFirst":
                 changes += self._reserve_then_evict(job, pod, now)
             else:
-                self._evict(pod, job)
-                job.phase = "Succeeded"
-                self.store.update(KIND_POD_MIGRATION_JOB, job)
-                changes += 1
+                changes += self._finish_with_eviction(job, pod)
         return changes
+
+    def _finish_with_eviction(self, job: PodMigrationJob, pod: Pod) -> int:
+        """Evict through the configured evictor; a blocked eviction fails the
+        job with the block reason (PDB violation, non-evictable pod)."""
+        from koordinator_tpu.descheduler.evictions import EvictionBlocked
+
+        # single-replica workload guard (controllerfinder): evicting the only
+        # healthy member would take the workload to zero
+        workload = self.finder.workload_of(pod)
+        if workload.workload and workload.healthy <= 1:
+            return self._fail(job, "workload has a single healthy replica")
+        try:
+            self.evictor.evict(pod, f"migration/{job.meta.name}")
+        except EvictionBlocked as e:
+            return self._fail(job, str(e))
+        job.phase = "Succeeded"
+        self.store.update(KIND_POD_MIGRATION_JOB, job)
+        return 1
+
+    def _fail(self, job: PodMigrationJob, message: str) -> int:
+        """Fail the job, releasing its replacement reservation if one was
+        created (the reference controller aborts the reservation with the
+        job; leaving it Available would strand owner-locked capacity)."""
+        if job.reservation_name:
+            self.store.delete(KIND_RESERVATION, f"/{job.reservation_name}")
+        job.phase = "Failed"
+        job.message = message
+        self.store.update(KIND_POD_MIGRATION_JOB, job)
+        return 1
 
     def _reserve_then_evict(self, job: PodMigrationJob, pod: Pod, now: float) -> int:
         if not job.reservation_name:
@@ -164,24 +195,10 @@ class MigrationController:
             return 1
         res = self.store.get(KIND_RESERVATION, f"/{job.reservation_name}")
         if res is None or res.phase == "Failed":
-            job.phase = "Failed"
-            job.message = "reservation failed or lost"
-            self.store.update(KIND_POD_MIGRATION_JOB, job)
-            return 1
+            return self._fail(job, "reservation failed or lost")
         if not res.is_available:
             return 0  # wait for the scheduler to bind the reservation
         # replacement capacity secured away from the source -> evict
         if res.node_name == pod.spec.node_name:
-            job.phase = "Failed"
-            job.message = "reservation landed on the source node"
-            self.store.update(KIND_POD_MIGRATION_JOB, job)
-            return 1
-        self._evict(pod, job)
-        job.phase = "Succeeded"
-        self.store.update(KIND_POD_MIGRATION_JOB, job)
-        return 1
-
-    def _evict(self, pod: Pod, job: PodMigrationJob) -> None:
-        pod.phase = "Failed"
-        pod.meta.annotations["koordinator.sh/evicted"] = f"migration/{job.meta.name}"
-        self.store.update(KIND_POD, pod)
+            return self._fail(job, "reservation landed on the source node")
+        return self._finish_with_eviction(job, pod)

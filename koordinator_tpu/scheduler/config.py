@@ -34,10 +34,11 @@ class ConfigValidationError(ValueError):
 
 @dataclass
 class NodeNUMAResourceArgs:
-    """types.go NodeNUMAResourceArgs."""
+    """types.go NodeNUMAResourceArgs. (The reference's scoringStrategy field
+    has no analog here: node scoring happens in the batched kernel, not the
+    host plugin — only knobs with real consumers are exposed.)"""
 
     default_cpu_bind_policy: str = FULL_PCPUS
-    scoring_strategy: str = "LeastAllocated"  # LeastAllocated | MostAllocated
     numa_allocate_strategy: str = NUMA_MOST_ALLOCATED
     max_ref_count: int = 1
 
@@ -46,8 +47,6 @@ class NodeNUMAResourceArgs:
         if self.default_cpu_bind_policy not in (FULL_PCPUS, SPREAD_BY_PCPUS):
             errs.append(
                 f"defaultCPUBindPolicy: unknown {self.default_cpu_bind_policy!r}")
-        if self.scoring_strategy not in ("LeastAllocated", "MostAllocated"):
-            errs.append(f"scoringStrategy: unknown {self.scoring_strategy!r}")
         if self.numa_allocate_strategy not in (
                 NUMA_MOST_ALLOCATED, NUMA_LEAST_ALLOCATED):
             errs.append(
@@ -59,22 +58,15 @@ class NodeNUMAResourceArgs:
 
 @dataclass
 class ReservationArgs:
-    """types.go ReservationArgs."""
+    """types.go ReservationArgs. (Candidate-node sampling knobs from the
+    reference don't apply — the batched kernel evaluates every node.)"""
 
-    enable_preemption: bool = False
-    min_candidate_nodes_percentage: int = 10
-    min_candidate_nodes_absolute: int = 100
     gc_duration_seconds: float = 24 * 3600.0
 
     def validate(self) -> List[str]:
-        errs = []
-        if not (0 <= self.min_candidate_nodes_percentage <= 100):
-            errs.append("minCandidateNodesPercentage: must be in [0,100]")
-        if self.min_candidate_nodes_absolute < 0:
-            errs.append("minCandidateNodesAbsolute: must be >= 0")
         if self.gc_duration_seconds <= 0:
-            errs.append("gcDurationSeconds: must be > 0")
-        return errs
+            return ["gcDurationSeconds: must be > 0"]
+        return []
 
 
 @dataclass
@@ -114,7 +106,6 @@ class CoschedulingArgs:
 class DeviceShareArgs:
     """types.go DeviceShareArgs."""
 
-    allocator: str = ""  # "" = default device allocator
     # MostAllocated packs fractional GPU requests (the reference allocator's
     # default preference); LeastAllocated spreads them
     scoring_strategy: str = "MostAllocated"

@@ -77,10 +77,12 @@ class Scheduler:
         )
 
         self.config = config or SchedulerConfiguration()
-        self.config.validate()
         self.store = store
-        # explicit args win over config (older call sites pass args directly)
+        # explicit args win over config (older call sites pass args directly);
+        # validate what will actually be used
         self.args = args or self.config.load_aware
+        self.config.load_aware = self.args
+        self.config.validate()
         self.scheduler_name = scheduler_name
         self.extender = FrameworkExtender(store)
         numa_args = self.config.node_numa_resource

@@ -58,6 +58,9 @@ class CoschedulingPlugin(Plugin):
     def _on_pod_group(self, ev: EventType, pg: PodGroup, old) -> None:
         if ev is EventType.DELETED:
             self.pod_groups.pop(pg.meta.name, None)
+            # a recreated gang with the same name is a fresh gang: it must be
+            # timeout-eligible again (also bounds the latch set's growth)
+            self._ever_scheduled.discard(pg.meta.name)
         else:
             self.pod_groups[pg.meta.name] = pg
 

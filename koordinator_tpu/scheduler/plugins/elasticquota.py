@@ -131,10 +131,15 @@ class QuotaOveruseRevokeController:
     has been continuously over-quota for delayEvictTime (grace for transient
     overshoot after a min shrink). Gated by ElasticQuotaArgs.monitorAllQuotas."""
 
-    def __init__(self, plugin: ElasticQuotaPlugin, store: ObjectStore, args):
+    def __init__(self, plugin: ElasticQuotaPlugin, store: ObjectStore, args,
+                 evictor=None):
+        from koordinator_tpu.descheduler.evictions import EvictionAPIEvictor
+
         self.plugin = plugin
         self.store = store
         self.args = args
+        # evictions route through the shared PDB/evictability machinery
+        self.evictor = evictor or EvictionAPIEvictor(store)
         self._last_run: float = 0.0
         self._over_since: Dict[str, float] = {}
 
@@ -181,12 +186,15 @@ class QuotaOveruseRevokeController:
                 self._over_since.pop(name, None)
         if not revocable:
             return []
+        from koordinator_tpu.descheduler.evictions import EvictionBlocked
+
         pods = [p for p in self.store.list(KIND_POD)]
         victims = self.plugin.find_overuse_victims(revocable, pods)
         evicted = []
         for pod in victims:
-            pod.phase = "Failed"
-            pod.meta.annotations["koordinator.sh/evicted"] = "quota-overused"
-            self.store.update(KIND_POD, pod)
+            try:
+                self.evictor.evict(pod, "quota-overused")
+            except EvictionBlocked:
+                continue  # PDB / non-evictable: spare this member
             evicted.append(pod.meta.key)
         return evicted

@@ -54,7 +54,6 @@ class TestComponentConfig:
             "Coscheduling": {},
         })
         assert cfg.reservation.gc_duration_seconds == 60.0
-        assert cfg.reservation.min_candidate_nodes_percentage == 10  # default
         assert cfg.coscheduling.default_timeout_seconds == 600.0
 
     def test_from_dict_strict(self):
@@ -212,8 +211,10 @@ class TestQuotaOveruseRevoke:
         sched = Scheduler(store, config=cfg)
         # a running pod way over the group's max (and hence over runtime)
         store.add(KIND_POD, Pod(
-            meta=ObjectMeta(name="hog", labels={LABEL_POD_QOS: "LS",
-                                                LABEL_QUOTA_NAME: "team-a"}),
+            meta=ObjectMeta(name="hog", owner_kind="ReplicaSet",
+                            owner_name="rs-hog",
+                            labels={LABEL_POD_QOS: "LS",
+                                    LABEL_QUOTA_NAME: "team-a"}),
             spec=PodSpec(node_name="node-0",
                          requests=ResourceList.of(cpu=4000, memory=4 * GIB)),
             phase="Running"))

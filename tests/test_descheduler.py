@@ -161,6 +161,9 @@ class TestMigrationEndToEnd:
         )
         store.update(KIND_NODE_METRIC, hot_metric)
         victim = _running_pod(store, "victim", "node-0", cpu=4000)
+        # second healthy replica: the controllerfinder guard refuses to evict
+        # a workload's only member
+        _running_pod(store, "victim-peer", "node-1", cpu=1000)
 
         desched = Descheduler(store)
         sched = Scheduler(store)

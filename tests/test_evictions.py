@@ -1,0 +1,173 @@
+"""Tests for the descheduler eviction machinery: controllerfinder,
+evictability filter, PDB enforcement, evictor variants (reference
+pkg/descheduler/evictions, controllers/migration/{evictor,controllerfinder})."""
+
+import pytest
+
+from koordinator_tpu.api.objects import (
+    LABEL_POD_QOS,
+    ObjectMeta,
+    Pod,
+    PodDisruptionBudget,
+    PodMigrationJob,
+    PodSpec,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client.store import (
+    KIND_PDB,
+    KIND_POD,
+    KIND_POD_MIGRATION_JOB,
+    ObjectStore,
+)
+from koordinator_tpu.descheduler.evictions import (
+    ANNOTATION_EVICTABLE,
+    ANNOTATION_SOFT_EVICTION,
+    ControllerFinder,
+    DeleteEvictor,
+    EvictionAPIEvictor,
+    EvictionBlocked,
+    SoftEvictor,
+    check_pdbs,
+    is_evictable,
+)
+from koordinator_tpu.descheduler.migration import MigrationController
+
+GIB = 1024**3
+NOW = 1_000_000.0
+
+
+def mk_pod(name, owner=("ReplicaSet", "rs1"), labels=None, phase="Running",
+           node="n1", prio=5500, annotations=None):
+    return Pod(
+        meta=ObjectMeta(name=name, owner_kind=owner[0], owner_name=owner[1],
+                        labels={LABEL_POD_QOS: "BE", **(labels or {})},
+                        annotations=annotations or {},
+                        creation_timestamp=NOW),
+        spec=PodSpec(node_name=node, priority=prio,
+                     requests=ResourceList.of(cpu=1000, memory=GIB)),
+        phase=phase)
+
+
+class TestControllerFinder:
+    def test_workload_members_and_health(self):
+        store = ObjectStore()
+        for i, phase in enumerate(["Running", "Running", "Failed"]):
+            store.add(KIND_POD, mk_pod(f"p{i}", phase=phase))
+        store.add(KIND_POD, mk_pod("other", owner=("ReplicaSet", "rs2")))
+        finder = ControllerFinder(store)
+        wl = finder.workload_of(store.get(KIND_POD, "default/p0"))
+        assert wl.workload == "ReplicaSet/rs1"
+        assert wl.replicas == 3
+        assert wl.healthy == 2
+
+    def test_bare_pod(self):
+        store = ObjectStore()
+        pod = mk_pod("solo", owner=("", ""))
+        store.add(KIND_POD, pod)
+        wl = ControllerFinder(store).workload_of(pod)
+        assert wl.workload == "" and wl.replicas == 1 and wl.healthy == 1
+
+
+class TestEvictability:
+    def test_filter_chain(self):
+        assert is_evictable(mk_pod("ok"))[0]
+        assert not is_evictable(mk_pod("ds", owner=("DaemonSet", "d")))[0]
+        assert not is_evictable(mk_pod("bare", owner=("", "")))[0]
+        assert not is_evictable(mk_pod("crit", prio=2_000_000_000))[0]
+        assert not is_evictable(mk_pod("done", phase="Succeeded"))[0]
+        # explicit annotation overrides in both directions
+        assert is_evictable(mk_pod("forced", owner=("", ""),
+                                   annotations={ANNOTATION_EVICTABLE: "true"}))[0]
+        assert not is_evictable(mk_pod("pinned",
+                                       annotations={ANNOTATION_EVICTABLE: "false"}))[0]
+
+
+class TestPDB:
+    def _store(self, n_healthy, min_available=None, max_unavailable=None):
+        store = ObjectStore()
+        for i in range(n_healthy):
+            store.add(KIND_POD, mk_pod(f"p{i}", labels={"app": "web"}))
+        store.add(KIND_PDB, PodDisruptionBudget(
+            meta=ObjectMeta(name="pdb"),
+            selector={"app": "web"},
+            min_available=min_available, max_unavailable=max_unavailable))
+        return store
+
+    def test_min_available_blocks(self):
+        store = self._store(2, min_available=2)
+        pod = store.get(KIND_POD, "default/p0")
+        assert check_pdbs(store, pod) is not None
+        with pytest.raises(EvictionBlocked):
+            EvictionAPIEvictor(store).evict(pod, "test")
+
+    def test_min_available_allows_with_headroom(self):
+        store = self._store(3, min_available=2)
+        pod = store.get(KIND_POD, "default/p0")
+        assert check_pdbs(store, pod) is None
+        EvictionAPIEvictor(store).evict(pod, "test")
+        assert store.get(KIND_POD, "default/p0").phase == "Failed"
+
+    def test_max_unavailable(self):
+        store = self._store(2, max_unavailable=1)
+        pod = store.get(KIND_POD, "default/p0")
+        assert check_pdbs(store, pod) is None  # 0+1 <= 1
+        EvictionAPIEvictor(store).evict(pod, "test")
+        other = store.get(KIND_POD, "default/p1")
+        assert check_pdbs(store, other) is not None  # 1+1 > 1
+
+    def test_non_matching_pdb_ignored(self):
+        store = self._store(1, min_available=1)
+        outsider = mk_pod("out", labels={"app": "db"})
+        store.add(KIND_POD, outsider)
+        assert check_pdbs(store, outsider) is None
+
+
+class TestEvictorVariants:
+    def test_delete_evictor_removes_pod_and_skips_pdb(self):
+        store = ObjectStore()
+        store.add(KIND_POD, mk_pod("p0", labels={"app": "web"}))
+        store.add(KIND_PDB, PodDisruptionBudget(
+            meta=ObjectMeta(name="pdb"), selector={"app": "web"},
+            min_available=1))
+        pod = store.get(KIND_POD, "default/p0")
+        DeleteEvictor(store).evict(pod, "forced")
+        assert store.get(KIND_POD, "default/p0") is None
+
+    def test_soft_evictor_annotates_only(self):
+        store = ObjectStore()
+        store.add(KIND_POD, mk_pod("p0"))
+        pod = store.get(KIND_POD, "default/p0")
+        SoftEvictor(store).evict(pod, "drain")
+        got = store.get(KIND_POD, "default/p0")
+        assert got.phase == "Running"
+        assert got.meta.annotations[ANNOTATION_SOFT_EVICTION] == "drain"
+
+
+class TestMigrationEvictionIntegration:
+    def test_pdb_blocked_migration_fails_job(self):
+        store = ObjectStore()
+        for i in range(2):
+            store.add(KIND_POD, mk_pod(f"p{i}", labels={"app": "web"}))
+        store.add(KIND_PDB, PodDisruptionBudget(
+            meta=ObjectMeta(name="pdb"), selector={"app": "web"},
+            min_available=2))
+        store.add(KIND_POD_MIGRATION_JOB, PodMigrationJob(
+            meta=ObjectMeta(name="job", creation_timestamp=NOW),
+            pod_namespace="default", pod_name="p0", mode="EvictDirectly"))
+        ctl = MigrationController(store)
+        ctl.reconcile(now=NOW)
+        job = store.get(KIND_POD_MIGRATION_JOB, "default/job")
+        assert job.phase == "Failed"
+        assert "pdb" in job.message
+
+    def test_single_replica_guard(self):
+        store = ObjectStore()
+        store.add(KIND_POD, mk_pod("only"))
+        store.add(KIND_POD_MIGRATION_JOB, PodMigrationJob(
+            meta=ObjectMeta(name="job", creation_timestamp=NOW),
+            pod_namespace="default", pod_name="only", mode="EvictDirectly"))
+        ctl = MigrationController(store)
+        ctl.reconcile(now=NOW)
+        job = store.get(KIND_POD_MIGRATION_JOB, "default/job")
+        assert job.phase == "Failed"
+        assert "single healthy replica" in job.message
