@@ -68,14 +68,15 @@ class ControllerFinder:
 
 
 def is_evictable(pod: Pod) -> Tuple[bool, str]:
-    """(ok, reason). defaultevictor filter chain."""
+    """(ok, reason). defaultevictor filter chain. A terminated pod is never
+    evictable — that check precedes even the force annotation."""
+    if pod.is_terminated:
+        return False, "pod already terminated"
     ann = pod.meta.annotations.get(ANNOTATION_EVICTABLE)
     if ann == "false":
         return False, "eviction disabled by annotation"
     if ann == "true":
         return True, ""
-    if pod.is_terminated:
-        return False, "pod already terminated"
     if pod.meta.owner_kind == "DaemonSet":
         return False, "daemonset pod"
     if not pod.meta.owner_kind:
@@ -94,14 +95,8 @@ def check_pdbs(store: ObjectStore, pod: Pod) -> Optional[str]:
     ]
     if not pdbs:
         return None
-    matching_cache: Dict[str, List[Pod]] = {}
     for pdb in pdbs:
-        key = pdb.meta.key
-        if key not in matching_cache:
-            matching_cache[key] = [
-                p for p in store.list(KIND_POD) if pdb.matches(p)
-            ]
-        matching = matching_cache[key]
+        matching = [p for p in store.list(KIND_POD) if pdb.matches(p)]
         healthy = sum(1 for p in matching if not p.is_terminated)
         if pdb.min_available is not None and healthy - 1 < pdb.min_available:
             return (f"pdb {pdb.meta.key}: healthy {healthy}-1 < "
@@ -116,7 +111,8 @@ def check_pdbs(store: ObjectStore, pod: Pod) -> Optional[str]:
 
 class EvictionAPIEvictor:
     """Default evictor: evictability + PDB guard, then terminate the pod the
-    way the eviction subresource does."""
+    way the eviction subresource does. Subclasses override `respects_pdb`
+    and `_terminate` only; the guard chain stays in one place."""
 
     name = "EvictionAPI"
     respects_pdb = True
@@ -132,6 +128,9 @@ class EvictionAPIEvictor:
             violated = check_pdbs(self.store, pod)
             if violated:
                 raise EvictionBlocked(violated)
+        self._terminate(pod, reason)
+
+    def _terminate(self, pod: Pod, reason: str) -> None:
         pod.phase = "Failed"
         pod.meta.annotations["koordinator.sh/evicted"] = reason
         self.store.update(KIND_POD, pod)
@@ -143,10 +142,7 @@ class DeleteEvictor(EvictionAPIEvictor):
     name = "Delete"
     respects_pdb = False
 
-    def evict(self, pod: Pod, reason: str) -> None:
-        ok, why = is_evictable(pod)
-        if not ok:
-            raise EvictionBlocked(why)
+    def _terminate(self, pod: Pod, reason: str) -> None:
         self.store.delete(KIND_POD, pod.meta.key)
 
 

@@ -76,13 +76,17 @@ class Scheduler:
             ReservationController,
         )
 
-        self.config = config or SchedulerConfiguration()
-        self.store = store
+        import dataclasses as _dc
+
+        base = config or SchedulerConfiguration()
         # explicit args win over config (older call sites pass args directly);
-        # validate what will actually be used
-        self.args = args or self.config.load_aware
-        self.config.load_aware = self.args
+        # keep a private copy so the caller's config object is never mutated,
+        # and validate what will actually be used
+        self.config = (_dc.replace(base, load_aware=args)
+                       if args is not None else base)
         self.config.validate()
+        self.store = store
+        self.args = self.config.load_aware
         self.scheduler_name = scheduler_name
         self.extender = FrameworkExtender(store)
         numa_args = self.config.node_numa_resource

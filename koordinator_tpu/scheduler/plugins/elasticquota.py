@@ -188,13 +188,27 @@ class QuotaOveruseRevokeController:
             return []
         from koordinator_tpu.descheduler.evictions import EvictionBlocked
 
-        pods = [p for p in self.store.list(KIND_POD)]
-        victims = self.plugin.find_overuse_victims(revocable, pods)
+        pods = self.store.list(KIND_POD)
         evicted = []
-        for pod in victims:
-            try:
-                self.evictor.evict(pod, "quota-overused")
-            except EvictionBlocked:
-                continue  # PDB / non-evictable: spare this member
-            evicted.append(pod.meta.key)
+        # walk EVERY member of each over-quota group in victim order, not just
+        # the minimal victim set: a blocked member (PDB / non-evictable) must
+        # not shield the group from reclamation — the next member is tried
+        for name, rt in revocable.items():
+            over = np.maximum(self.plugin.used.get(name, 0.0) - rt, 0.0)
+            members = sorted(
+                (p for p in pods
+                 if p.quota_name == name and p.is_assigned
+                 and not p.is_terminated),
+                key=lambda p: (p.spec.priority or 0,
+                               -p.meta.creation_timestamp),
+            )
+            for pod in members:
+                if not (over > 0).any():
+                    break
+                try:
+                    self.evictor.evict(pod, "quota-overused")
+                except EvictionBlocked:
+                    continue  # spared; try the next member
+                evicted.append(pod.meta.key)
+                over = over - pod.spec.requests.to_vector()
         return evicted

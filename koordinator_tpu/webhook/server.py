@@ -49,6 +49,7 @@ from koordinator_tpu.api.resources import (
 from koordinator_tpu.client.store import (
     KIND_COLOCATION_PROFILE,
     KIND_ELASTIC_QUOTA,
+    KIND_QUOTA_PROFILE,
     ObjectStore,
 )
 from koordinator_tpu.utils.features import MANAGER_GATES
@@ -87,8 +88,34 @@ class AdmissionServer:
             return profile
         return None
 
+    def mutate_pod_quota_tree_affinity(self, pod: Pod) -> None:
+        """multi_quota_tree_affinity.go:37-110: a pod whose quota belongs to a
+        tree gets the tree profile's node selector injected so it can only
+        land on that tree's nodes."""
+        if not MANAGER_GATES.enabled("MultiQuotaTree"):
+            return
+        quota_name = pod.quota_name or pod.meta.namespace
+        quota = None
+        for q in self.store.list(KIND_ELASTIC_QUOTA):
+            if q.meta.name == quota_name:
+                quota = q
+                break
+        if quota is None or not quota.tree_id:
+            return
+        from koordinator_tpu.api.objects import LABEL_QUOTA_TREE_ID
+
+        for profile in sorted(self.store.list(KIND_QUOTA_PROFILE),
+                              key=lambda p: p.meta.name):
+            if profile.quota_labels.get(LABEL_QUOTA_TREE_ID) != quota.tree_id:
+                continue
+            if profile.node_selector:  # first profile WITH a selector wins
+                for k, v in profile.node_selector.items():
+                    pod.spec.node_selector.setdefault(k, v)
+                return
+
     def mutate_pod(self, pod: Pod) -> None:
-        """cluster_colocation_profile.go:53-259."""
+        """cluster_colocation_profile.go:53-259. (Tree affinity runs AFTER the
+        profile so a profile-injected quota-name label is honored.)"""
         profile = self._matching_profile(pod)
         if profile is not None:
             pod.meta.labels.update(profile.labels)
@@ -104,6 +131,7 @@ class AdmissionServer:
                     pod.spec.priority = DEFAULT_PRIORITY_BY_CLASS[cls]
             if profile.koordinator_priority is not None:
                 pod.meta.labels[LABEL_POD_PRIORITY] = str(profile.koordinator_priority)
+        self.mutate_pod_quota_tree_affinity(pod)
         self.mutate_extended_resources(pod)
 
     def mutate_extended_resources(self, pod: Pod) -> None:
@@ -155,6 +183,12 @@ class AdmissionServer:
         ]
         if be_resources and cls not in (PriorityClass.BATCH, PriorityClass.FREE, PriorityClass.NONE):
             raise AdmissionError("batch resources require koord-batch/free priority")
+        # resource verify (pod/validating resource checks): limits bound requests
+        for name, req in pod.spec.requests.quantities.items():
+            limit = pod.spec.limits.get(name, 0)
+            if limit and req > limit:
+                raise AdmissionError(
+                    f"request[{name}]={req} exceeds limit={limit}")
 
     # -- elasticquota ---------------------------------------------------
     def validate_elastic_quota(self, quota: ElasticQuota,
@@ -185,7 +219,90 @@ class AdmissionServer:
             if old.tree_id and quota.tree_id != old.tree_id:
                 raise AdmissionError("quota tree-id is immutable")
 
+    def validate_elastic_quota_delete(self, quota: ElasticQuota) -> None:
+        """Deletion guard (webhook/elasticquota): a parent group with child
+        quotas cannot be deleted (the orphans would silently detach from the
+        tree and escape their ancestors' limits)."""
+        if not quota.is_parent:
+            return
+        children = [
+            q.meta.name
+            for q in self.store.list(KIND_ELASTIC_QUOTA)
+            if q.parent == quota.meta.name and q.meta.name != quota.meta.name
+        ]
+        if children:
+            raise AdmissionError(
+                f"quota {quota.meta.name!r} still has children: "
+                f"{sorted(children)}")
+
+    # -- generic dispatch ----------------------------------------------
+    def admit(self, kind: str, obj, old=None, delete: bool = False):
+        """Run the registered mutators + validators for a kind (server.go's
+        per-GVK handler registration, flattened)."""
+        from koordinator_tpu.client.store import (
+            KIND_CONFIG_MAP,
+            KIND_NODE,
+            KIND_POD,
+        )
+
+        if kind == KIND_POD and not delete:
+            return self.admit_pod_create(obj)
+        if kind == KIND_ELASTIC_QUOTA:
+            if delete:
+                if MANAGER_GATES.enabled("ElasticQuotaValidatingWebhook"):
+                    self.validate_elastic_quota_delete(obj)
+            elif MANAGER_GATES.enabled("ElasticQuotaValidatingWebhook"):
+                self.validate_elastic_quota(obj, old)
+        elif kind == KIND_NODE and not delete:
+            if MANAGER_GATES.enabled("NodeMutatingWebhook"):
+                self.mutate_node(obj, old)
+            if MANAGER_GATES.enabled("NodeValidatingWebhook"):
+                self.validate_node(obj)
+        elif kind == KIND_CONFIG_MAP and not delete:
+            if MANAGER_GATES.enabled("ConfigMapValidatingWebhook"):
+                self.validate_config_map(obj)
+        return obj
+
     # -- node -----------------------------------------------------------
+    AMPLIFICATION_RATIO_ANNOTATION = (
+        "node.koordinator.sh/resource-amplification-ratio")
+    RAW_ALLOCATABLE_ANNOTATION = "node.koordinator.sh/raw-allocatable"
+    _AMPLIFIABLE = (ResourceName.CPU, ResourceName.MEMORY)
+
+    def mutate_node(self, node: Node, old: Optional[Node] = None) -> None:
+        """Resource amplification (webhook/node/plugins/resourceamplification):
+        allocatable = kubelet-reported raw allocatable x per-resource ratio.
+        The raw values are remembered in an annotation so repeated admissions
+        don't compound the ratio; a kubelet allocatable change refreshes them.
+        Clearing the ratio annotation restores raw allocatable."""
+        ann = node.meta.annotations
+        raw_ratio = ann.get(self.AMPLIFICATION_RATIO_ANNOTATION, "")
+        if not raw_ratio:
+            saved = ann.pop(self.RAW_ALLOCATABLE_ANNOTATION, None)
+            if saved:  # feature switched off: restore kubelet values
+                for name, val in json.loads(saved).items():
+                    node.allocatable.quantities[name] = int(val)
+            return
+        try:
+            ratios = json.loads(raw_ratio)
+        except ValueError:
+            raise AdmissionError("resource-amplification-ratio is not JSON")
+        supported_changed = old is not None and any(
+            old.allocatable.get(r) != node.allocatable.get(r)
+            for r in self._AMPLIFIABLE
+        )
+        if self.RAW_ALLOCATABLE_ANNOTATION not in ann or supported_changed:
+            raw = {r: node.allocatable.get(r)
+                   for r in self._AMPLIFIABLE if node.allocatable.get(r)}
+            ann[self.RAW_ALLOCATABLE_ANNOTATION] = json.dumps(raw)
+        original = json.loads(ann[self.RAW_ALLOCATABLE_ANNOTATION])
+        for name in self._AMPLIFIABLE:
+            ratio = ratios.get(name)
+            if ratio is None or float(ratio) <= 1 or name not in original:
+                continue
+            node.allocatable.quantities[name] = int(
+                original[name] * float(ratio))
+
     def validate_node(self, node: Node) -> None:
         raw = node.meta.annotations.get("node.koordinator.sh/cpu-normalization-ratio")
         if raw:

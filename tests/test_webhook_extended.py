@@ -1,0 +1,170 @@
+"""Tests for the extended webhook handlers: node resource amplification,
+multi-quota-tree affinity injection, resource verify, quota deletion guard,
+and the generic admit dispatcher (reference pkg/webhook/node/plugins/
+resourceamplification, pod/mutating/multi_quota_tree_affinity.go,
+webhook/elasticquota)."""
+
+import json
+
+import pytest
+
+from koordinator_tpu.api.objects import (
+    LABEL_POD_QOS,
+    LABEL_QUOTA_IS_PARENT,
+    LABEL_QUOTA_NAME,
+    LABEL_QUOTA_PARENT,
+    LABEL_QUOTA_TREE_ID,
+    ElasticQuota,
+    ElasticQuotaProfile,
+    Node,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from koordinator_tpu.api.resources import ResourceList, ResourceName
+from koordinator_tpu.client.store import (
+    KIND_ELASTIC_QUOTA,
+    KIND_NODE,
+    KIND_QUOTA_PROFILE,
+    ObjectStore,
+)
+from koordinator_tpu.utils.features import MANAGER_GATES
+from koordinator_tpu.webhook.server import AdmissionError, AdmissionServer
+
+GIB = 1024**3
+RATIO_ANN = AdmissionServer.AMPLIFICATION_RATIO_ANNOTATION
+RAW_ANN = AdmissionServer.RAW_ALLOCATABLE_ANNOTATION
+
+
+def mk_node(cpu=16_000, mem=64 * GIB, annotations=None):
+    return Node(meta=ObjectMeta(name="n0", namespace="",
+                                annotations=annotations or {}),
+                allocatable=ResourceList.of(cpu=cpu, memory=mem))
+
+
+class TestNodeAmplification:
+    def test_amplify_and_remember_raw(self):
+        srv = AdmissionServer(ObjectStore())
+        node = mk_node(annotations={RATIO_ANN: json.dumps({"cpu": 2.0})})
+        srv.mutate_node(node)
+        assert node.allocatable.get(ResourceName.CPU) == 32_000
+        assert node.allocatable.get(ResourceName.MEMORY) == 64 * GIB  # no ratio
+        raw = json.loads(node.meta.annotations[RAW_ANN])
+        assert raw[ResourceName.CPU] == 16_000
+
+    def test_repeat_admission_does_not_compound(self):
+        srv = AdmissionServer(ObjectStore())
+        node = mk_node(annotations={RATIO_ANN: json.dumps({"cpu": 2.0})})
+        srv.mutate_node(node)
+        before = node.allocatable.get(ResourceName.CPU)
+        srv.mutate_node(node, old=node)
+        assert node.allocatable.get(ResourceName.CPU) == before == 32_000
+
+    def test_kubelet_change_refreshes_raw(self):
+        srv = AdmissionServer(ObjectStore())
+        node = mk_node(annotations={RATIO_ANN: json.dumps({"cpu": 2.0})})
+        srv.mutate_node(node)
+        # kubelet reduces allocatable (more reserved): cpu raw becomes 8000
+        old = mk_node(cpu=32_000, annotations=dict(node.meta.annotations))
+        node.allocatable.quantities[ResourceName.CPU] = 8_000
+        srv.mutate_node(node, old=old)
+        assert json.loads(node.meta.annotations[RAW_ANN])[ResourceName.CPU] == 8_000
+        assert node.allocatable.get(ResourceName.CPU) == 16_000
+
+    def test_clearing_ratio_restores_raw(self):
+        srv = AdmissionServer(ObjectStore())
+        node = mk_node(annotations={RATIO_ANN: json.dumps({"cpu": 2.0})})
+        srv.mutate_node(node)
+        del node.meta.annotations[RATIO_ANN]
+        srv.mutate_node(node)
+        assert node.allocatable.get(ResourceName.CPU) == 16_000
+        assert RAW_ANN not in node.meta.annotations
+
+    def test_ratio_below_one_ignored(self):
+        srv = AdmissionServer(ObjectStore())
+        node = mk_node(annotations={RATIO_ANN: json.dumps({"cpu": 0.5})})
+        srv.mutate_node(node)
+        assert node.allocatable.get(ResourceName.CPU) == 16_000
+
+    def test_bad_json_rejected(self):
+        srv = AdmissionServer(ObjectStore())
+        node = mk_node(annotations={RATIO_ANN: "not-json"})
+        with pytest.raises(AdmissionError):
+            srv.mutate_node(node)
+
+
+class TestQuotaTreeAffinity:
+    def _setup(self):
+        store = ObjectStore()
+        store.add(KIND_ELASTIC_QUOTA, ElasticQuota(
+            meta=ObjectMeta(name="team-a", namespace="",
+                            labels={LABEL_QUOTA_TREE_ID: "tree-1"}),
+            min=ResourceList.of(cpu=1000)))
+        store.add(KIND_QUOTA_PROFILE, ElasticQuotaProfile(
+            meta=ObjectMeta(name="prof-1"),
+            quota_name="team-a",
+            node_selector={"zone": "z1"},
+            quota_labels={LABEL_QUOTA_TREE_ID: "tree-1"}))
+        return store, AdmissionServer(store)
+
+    def test_selector_injected(self):
+        store, srv = self._setup()
+        pod = Pod(meta=ObjectMeta(name="p",
+                                  labels={LABEL_POD_QOS: "LS",
+                                          LABEL_QUOTA_NAME: "team-a"}),
+                  spec=PodSpec(requests=ResourceList.of(cpu=1000)))
+        srv.mutate_pod(pod)
+        assert pod.spec.node_selector == {"zone": "z1"}
+
+    def test_existing_selector_not_overwritten(self):
+        store, srv = self._setup()
+        pod = Pod(meta=ObjectMeta(name="p",
+                                  labels={LABEL_POD_QOS: "LS",
+                                          LABEL_QUOTA_NAME: "team-a"}),
+                  spec=PodSpec(requests=ResourceList.of(cpu=1000),
+                               node_selector={"zone": "keep"}))
+        srv.mutate_pod(pod)
+        assert pod.spec.node_selector["zone"] == "keep"
+
+    def test_no_tree_no_injection(self):
+        store, srv = self._setup()
+        pod = Pod(meta=ObjectMeta(name="p", labels={LABEL_POD_QOS: "LS"}),
+                  spec=PodSpec(requests=ResourceList.of(cpu=1000)))
+        srv.mutate_pod(pod)
+        assert pod.spec.node_selector == {}
+
+
+class TestResourceVerifyAndQuotaDelete:
+    def test_request_over_limit_rejected(self):
+        srv = AdmissionServer(ObjectStore())
+        pod = Pod(meta=ObjectMeta(name="p", labels={LABEL_POD_QOS: "LS"}),
+                  spec=PodSpec(requests=ResourceList.of(cpu=4000),
+                               limits=ResourceList.of(cpu=2000)))
+        with pytest.raises(AdmissionError, match="exceeds limit"):
+            srv.validate_pod(pod)
+
+    def test_parent_with_children_cannot_be_deleted(self):
+        store = ObjectStore()
+        parent = ElasticQuota(meta=ObjectMeta(
+            name="root", namespace="",
+            labels={LABEL_QUOTA_IS_PARENT: "true"}))
+        child = ElasticQuota(meta=ObjectMeta(
+            name="leaf", namespace="",
+            labels={LABEL_QUOTA_PARENT: "root"}))
+        store.add(KIND_ELASTIC_QUOTA, parent)
+        store.add(KIND_ELASTIC_QUOTA, child)
+        srv = AdmissionServer(store)
+        with pytest.raises(AdmissionError, match="children"):
+            srv.validate_elastic_quota_delete(parent)
+        srv.validate_elastic_quota_delete(child)  # leaves delete fine
+
+    def test_admit_dispatcher(self):
+        store = ObjectStore()
+        srv = AdmissionServer(store)
+        MANAGER_GATES.set_from_map({"NodeMutatingWebhook": True})
+        try:
+            node = mk_node(annotations={RATIO_ANN: json.dumps({"cpu": 2.0})})
+            srv.admit(KIND_NODE, node)
+            assert node.allocatable.get(ResourceName.CPU) == 32_000
+        finally:
+            MANAGER_GATES.reset()
