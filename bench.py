@@ -83,7 +83,16 @@ def main() -> None:
     t_pack = time.perf_counter() - t0
     log(f"packing: {t_pack:.3f}s (padded {pods.padded_size} x {nodes.padded_size})")
 
-    step = build_schedule_step(la)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        # VMEM-resident Pallas kernel (ops/pallas_step.py): ~3x the XLA
+        # fori_loop at 10k x 5k, bit-identical bindings
+        from koordinator_tpu.ops.pallas_step import build_pallas_schedule_step
+
+        step = build_pallas_schedule_step(la)
+        log("using pallas schedule step")
+    else:
+        step = build_schedule_step(la)
     t0 = time.perf_counter()
     chosen, _ = step(inputs)
     chosen = np.asarray(jax.block_until_ready(chosen))

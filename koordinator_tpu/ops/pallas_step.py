@@ -1,0 +1,194 @@
+"""Pallas TPU kernel for the serial-parity scheduling step.
+
+The XLA path (models/scheduler_model.build_schedule_step) expresses the
+sequential pod loop as `lax.fori_loop`; every iteration re-reads the [N, R]
+node state from wherever XLA materialized it. This kernel instead runs the
+WHOLE pod loop inside one `pallas_call` with the node state pinned in VMEM:
+
+  * grid = (P,) — TPU grids are sequential, so scratch buffers carry the
+    running state (requested, LoadAware assign-cache deltas) from pod i to
+    pod i+1 with zero HBM round-trips;
+  * node arrays are laid out transposed [R, N] so the N axis rides the
+    128-wide lanes (R <= 16 sublanes, f32 min tile is (8, 128));
+  * per-pod rows ([1, R] blocks) stream in; per-pod scalars sit in SMEM.
+
+Semantics are bit-identical to the XLA step (same go_round / floor-division
+helpers, same first-max tie-break); tests/test_pallas_step.py diffs the two
+paths on randomized clusters. VMEM budget: ~8 [R, N] f32 arrays — N up to
+~20k fits the 16 MB/core budget at R = 16.
+
+Reference anchor: the loop this replaces is the scheduleOne Filter+Score
+fan-out (SURVEY.md section 3.1); state carried corresponds to the Fit
+`requested` cache and LoadAware's podAssignCache estimates
+(plugins/loadaware/pod_assign_cache.go).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from koordinator_tpu.ops import loadaware as la_ops
+from koordinator_tpu.ops.loadaware import LoadAwareArgs
+
+MAX_NODE_SCORE = 100.0
+
+
+def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int):
+    wsum = float(max(weights.sum(), 1.0))
+
+    weight_consts = [(r, float(v)) for r, v in enumerate(weights) if v]
+
+    def kernel(
+        prod_ref, valid_ref, ds_ref,                     # [P] SMEM scalars
+        req_ref, est_ref,                                # [R, P] VMEM (full)
+        alloc_ref, req0_ref, term_np_ref, term_pr_ref,   # [R, N] VMEM
+        lafeas_np_ref, lafeas_pr_ref, node_ok_ref, score_valid_ref,  # [1, N]
+        chosen_ref,                                      # [8, 1] int32 out blocks
+        requested_ref,                                   # [R, N] f32 out (carried)
+        dnp_ref, dpr_ref,                                # [R, N] scratch
+    ):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            requested_ref[:] = req0_ref[:]
+            dnp_ref[:] = jnp.zeros_like(dnp_ref)
+            dpr_ref[:] = jnp.zeros_like(dpr_ref)
+
+        prod = prod_ref[i] > 0
+        # pod i's column via a lane one-hot (TPU block shapes can't carve a
+        # [1, R] row, and dynamic lane slicing relayouts; the masked reduce is
+        # a few hundred VPU flops)
+        P_pad = req_ref.shape[1]
+        pod_mask = (jax.lax.broadcasted_iota(jnp.int32, (1, P_pad), 1) == i
+                    ).astype(jnp.float32)                # [1, P]
+        need = jnp.sum(req_ref[:] * pod_mask, axis=1, keepdims=True)  # [R, 1]
+        est = jnp.sum(est_ref[:] * pod_mask, axis=1, keepdims=True)   # [R, 1]
+        alloc = alloc_ref[:]                             # [R, N]
+        requested = requested_ref[:]
+
+        # NodeResourcesFit (ops/fit.fit_ok_row semantics)
+        fit = jnp.all((need <= 0) | (requested + need <= alloc), axis=0)  # [N]
+
+        # LoadAware least-allocated score with in-batch deltas
+        if prod_mode:
+            base = jnp.where(prod, term_pr_ref[:] + dpr_ref[:],
+                             term_np_ref[:] + dnp_ref[:])
+        else:
+            base = term_np_ref[:] + dnp_ref[:]
+        used = est + base                                # [R, N] (est is [R, 1])
+        safe_cap = jnp.where(alloc > 0, alloc, 1.0)
+        per_r = jnp.floor((alloc - used) * MAX_NODE_SCORE / safe_cap)
+        per_r = jnp.where((alloc > 0) & (used <= alloc), per_r, 0.0)
+        # weights are static (baked as Python floats: SMEM only serves scalars)
+        acc = jnp.zeros((1, per_r.shape[1]), jnp.float32)
+        for r, wv in weight_consts:
+            acc = acc + wv * per_r[r:r + 1, :]
+        score = jnp.floor(acc[0] / wsum)
+        score = jnp.where(score_valid_ref[0, :] > 0, score, 0.0)
+
+        la_feas = jnp.where(prod, lafeas_pr_ref[0, :], lafeas_np_ref[0, :]) > 0
+        la_ok = la_feas | (ds_ref[i] > 0)
+        feasible = (node_ok_ref[0, :] > 0) & fit & la_ok
+        score = jnp.where(feasible, score, -1.0)
+
+        # lowest-index max, computed explicitly: Mosaic's argmax does not
+        # guarantee first-occurrence on ties, and the binding contract
+        # (reference selectHost determinism) hangs on this tie-break
+        maxv = jnp.max(score)
+        iota = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)[0]
+        best = jnp.min(jnp.where(score == maxv, iota, jnp.int32(N))
+                       ).astype(jnp.int32)
+        found = (maxv >= 0.0) & (valid_ref[i] > 0)
+        sel = ((iota == best) & found).astype(jnp.float32)   # [N]
+
+        requested_ref[:] = requested + sel[None, :] * need
+        est_add = sel[None, :] * est
+        dnp_ref[:] = dnp_ref[:] + est_add
+        if prod_mode:
+            dpr_ref[:] = dpr_ref[:] + jnp.where(prod, 1.0, 0.0) * est_add
+        picked = jnp.where(found, best, jnp.int32(-1))
+        chosen_ref[pl.dslice(i % 8, 1), :] = picked.reshape(1, 1)
+
+    return kernel
+
+
+def build_pallas_schedule_step(args: LoadAwareArgs, interpret: bool = False,
+                               jit: bool = True):
+    """ScheduleInputs -> (chosen [P] int32, requested [N, R] f32), same
+    contract as models.scheduler_model.build_schedule_step, computed by the
+    VMEM-resident Pallas kernel. `interpret=True` runs the kernel in the
+    Pallas interpreter (CPU parity tests)."""
+    prod_mode = args.score_according_prod_usage
+    weights = np.asarray(args.weight_vector(), np.float32)
+
+    def step(inputs) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        P, R = inputs.fit_requests.shape
+        N = inputs.allocatable.shape[0]
+        reject_np, reject_prod = la_ops.loadaware_node_reject(
+            inputs.allocatable,
+            inputs.la_filter_usage,
+            inputs.la_has_filter_usage,
+            inputs.la_filter_thresholds,
+            inputs.la_prod_thresholds,
+            inputs.la_prod_pod_usage,
+            inputs.la_filter_skip,
+        )
+        f32 = lambda x: jnp.asarray(x, jnp.float32)  # noqa: E731
+        row = lambda x: f32(x)[None, :]  # noqa: E731
+        # pods padded to a multiple of 8 so the (8, 1) chosen blocks divide P
+        P_pad = -(-P // 8) * 8
+        pad_p = [(0, P_pad - P)]
+
+        def pods_t(x):  # [P, R] -> [R, P_pad]
+            return jnp.pad(f32(x), pad_p + [(0, 0)]).T
+
+        kernel = _make_kernel(weights, prod_mode, N)
+        grid_inputs = (
+            jnp.pad(f32(inputs.is_prod), pad_p),
+            jnp.pad(f32(inputs.pod_valid), pad_p),  # padding invalid => -1
+            jnp.pad(f32(inputs.is_daemonset), pad_p),
+            pods_t(inputs.fit_requests), pods_t(inputs.estimated),
+            f32(inputs.allocatable).T, f32(inputs.requested).T,
+            f32(inputs.la_term_nonprod).T, f32(inputs.la_term_prod).T,
+            row(~reject_np), row(~reject_prod),
+            row(inputs.node_ok), row(inputs.la_score_valid),
+        )
+        smem = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
+        full = lambda shape: pl.BlockSpec(shape, lambda i: (0, 0))  # noqa: E731
+        chosen, requested_t = pl.pallas_call(
+            kernel,
+            grid=(P_pad,),
+            in_specs=[
+                smem(), smem(), smem(),
+                full((R, P_pad)), full((R, P_pad)),
+                full((R, N)), full((R, N)), full((R, N)), full((R, N)),
+                full((1, N)), full((1, N)), full((1, N)), full((1, N)),
+            ],
+            out_specs=[
+                pl.BlockSpec((8, 1), lambda i: (i // 8, 0)),
+                full((R, N)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((P_pad, 1), jnp.int32),
+                jax.ShapeDtypeStruct((R, N), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((R, N), jnp.float32),
+                pltpu.VMEM((R, N), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",),
+            ),
+            interpret=interpret,
+        )(*grid_inputs)
+        return chosen[:P, 0], requested_t.T
+
+    return jax.jit(step) if jit else step

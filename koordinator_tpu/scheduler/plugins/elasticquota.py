@@ -96,32 +96,16 @@ class ElasticQuotaPlugin(Plugin):
     def revoke_controller(self, store: ObjectStore, args) -> "QuotaOveruseRevokeController":
         return QuotaOveruseRevokeController(self, store, args)
 
-    # quota_overuse_revoke.go analog: pods to evict when a group exceeds runtime
-    def find_overuse_victims(
-        self, runtime_by_name: Dict[str, np.ndarray], pods: List[Pod]
-    ) -> List[Pod]:
-        victims: List[Pod] = []
-        for name, used in self.used.items():
-            runtime = runtime_by_name.get(name)
-            if runtime is None:
-                continue
-            over = np.maximum(used - runtime, 0.0)
-            if not (over > 0).any():
-                continue
-            members = sorted(
-                (
-                    p
-                    for p in pods
-                    if p.quota_name == name and p.is_assigned and not p.is_terminated
-                ),
-                key=lambda p: (p.spec.priority or 0, -p.meta.creation_timestamp),
-            )
-            for pod in members:
-                if not (over > 0).any():
-                    break
-                victims.append(pod)
-                over = over - pod.spec.requests.to_vector()
-        return victims
+    @staticmethod
+    def victim_order(name: str, pods: List[Pod]) -> List[Pod]:
+        """The overuse victim ordering (quota_overuse_revoke.go): live assigned
+        members of the group, lowest priority first, youngest first within a
+        priority. Single home for the policy — the revoke controller walks it."""
+        return sorted(
+            (p for p in pods
+             if p.quota_name == name and p.is_assigned and not p.is_terminated),
+            key=lambda p: (p.spec.priority or 0, -p.meta.creation_timestamp),
+        )
 
 
 class QuotaOveruseRevokeController:
@@ -195,14 +179,7 @@ class QuotaOveruseRevokeController:
         # not shield the group from reclamation — the next member is tried
         for name, rt in revocable.items():
             over = np.maximum(self.plugin.used.get(name, 0.0) - rt, 0.0)
-            members = sorted(
-                (p for p in pods
-                 if p.quota_name == name and p.is_assigned
-                 and not p.is_terminated),
-                key=lambda p: (p.spec.priority or 0,
-                               -p.meta.creation_timestamp),
-            )
-            for pod in members:
+            for pod in self.plugin.victim_order(name, pods):
                 if not (over > 0).any():
                     break
                 try:

@@ -287,6 +287,10 @@ class AdmissionServer:
             ratios = json.loads(raw_ratio)
         except ValueError:
             raise AdmissionError("resource-amplification-ratio is not JSON")
+        # old-vs-new compares whatever the cluster stored (amplified) against
+        # the incoming values; a kubelet raw update that happens to equal the
+        # old amplified value is missed — the reference has the identical
+        # documented limitation (resource_amplification.go "FIXME 1")
         supported_changed = old is not None and any(
             old.allocatable.get(r) != node.allocatable.get(r)
             for r in self._AMPLIFIABLE
