@@ -54,7 +54,7 @@ def main() -> None:
     import jax
 
     from koordinator_tpu.models.scheduler_model import (
-        build_schedule_step,
+        build_best_schedule_step,
         make_inputs,
     )
     from koordinator_tpu.ops.loadaware import LoadAwareArgs, build_loadaware_node_state
@@ -83,16 +83,7 @@ def main() -> None:
     t_pack = time.perf_counter() - t0
     log(f"packing: {t_pack:.3f}s (padded {pods.padded_size} x {nodes.padded_size})")
 
-    on_tpu = jax.devices()[0].platform == "tpu"
-    if on_tpu:
-        # VMEM-resident Pallas kernel (ops/pallas_step.py): ~3x the XLA
-        # fori_loop at 10k x 5k, bit-identical bindings
-        from koordinator_tpu.ops.pallas_step import build_pallas_schedule_step
-
-        step = build_pallas_schedule_step(la)
-        log("using pallas schedule step")
-    else:
-        step = build_schedule_step(la)
+    step = build_best_schedule_step(la)  # pallas on TPU, XLA elsewhere
     t0 = time.perf_counter()
     chosen, _ = step(inputs)
     chosen = np.asarray(jax.block_until_ready(chosen))
@@ -145,7 +136,7 @@ def main() -> None:
 def run_full_chain(args_cli, num_pods: int, num_nodes: int) -> None:
     import jax
 
-    from koordinator_tpu.models.full_chain import build_full_chain_step
+    from koordinator_tpu.models.full_chain import build_best_full_chain_step
     from koordinator_tpu.ops.loadaware import LoadAwareArgs
     from koordinator_tpu.scheduler.parity import serial_schedule_full
     from koordinator_tpu.scheduler.snapshot import build_full_chain_inputs
@@ -181,7 +172,7 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int) -> None:
         f"{len(active_axes)} active resource axes)"
     )
 
-    step = build_full_chain_step(la, ng, ngroups, active_axes=active_axes)
+    step = build_best_full_chain_step(la, ng, ngroups, active_axes=active_axes)
     t0 = time.perf_counter()
     chosen, _, _ = step(fc)
     chosen = np.asarray(jax.block_until_ready(chosen))

@@ -195,3 +195,19 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
         return chosen, requested, quota_used
 
     return jax.jit(step) if jit else step
+
+
+def build_best_full_chain_step(args: LoadAwareArgs, num_gangs: int,
+                               num_groups: int, active_axes=None):
+    """Backend-aware selector: the VMEM-resident Pallas kernel on TPU
+    (ops/pallas_full_chain.py, ~20x the fori_loop at 10k x 5k), the XLA
+    step elsewhere. Same contract, bit-identical bindings."""
+    if jax.default_backend() == "tpu":
+        from koordinator_tpu.ops.pallas_full_chain import (
+            build_pallas_full_chain_step,
+        )
+
+        return build_pallas_full_chain_step(
+            args, num_gangs, num_groups, active_axes=active_axes)
+    return build_full_chain_step(args, num_gangs, num_groups,
+                                 active_axes=active_axes)

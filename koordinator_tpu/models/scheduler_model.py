@@ -182,6 +182,17 @@ def build_schedule_step(args: LoadAwareArgs, jit: bool = True):
     return jax.jit(step) if jit else step
 
 
+def build_best_schedule_step(args: LoadAwareArgs):
+    """Backend-aware selector: the VMEM-resident Pallas kernel on TPU
+    (ops/pallas_step.py, ~3x the fori_loop at 10k x 5k), the XLA step
+    elsewhere. Same contract, bit-identical bindings."""
+    if jax.default_backend() == "tpu":
+        from koordinator_tpu.ops.pallas_step import build_pallas_schedule_step
+
+        return build_pallas_schedule_step(args)
+    return build_schedule_step(args)
+
+
 def build_score_matrix(args: LoadAwareArgs, jit: bool = True):
     """One-shot [P, N] (feasible, score) with no assignment feedback."""
     prod_mode = args.score_according_prod_usage

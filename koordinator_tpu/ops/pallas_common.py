@@ -1,0 +1,104 @@
+"""Shared fragments for the VMEM-resident Pallas scheduling kernels.
+
+Both kernels (ops/pallas_step.py LoadAware-only, ops/pallas_full_chain.py
+full chain) carry the bit-identical-bindings contract against the XLA steps;
+the logic they share lives here as plain-Python helpers called from inside
+the kernel bodies, so a fix lands in both at once.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+MAX_NODE_SCORE = 100.0
+
+
+def weight_consts(weights: np.ndarray) -> List[Tuple[int, float]]:
+    """Static (axis, weight) pairs baked into the kernel as Python floats —
+    SMEM only serves scalars, so weights can't ride a vector input."""
+    return [(r, float(v)) for r, v in enumerate(weights) if v]
+
+
+def pod_column(ref, pod_mask) -> jnp.ndarray:
+    """Extract pod i's [R, 1] column from an [R, P] array via the lane
+    one-hot `pod_mask` ([1, P]). TPU block shapes can't carve a [1, R] row
+    and dynamic lane slicing relayouts; the masked reduce is a few hundred
+    VPU flops."""
+    return jnp.sum(ref[:] * pod_mask, axis=1, keepdims=True)
+
+
+def make_pod_mask(i, P_pad: int) -> jnp.ndarray:
+    return (jax.lax.broadcasted_iota(jnp.int32, (1, P_pad), 1) == i
+            ).astype(jnp.float32)
+
+
+def fit_ok(need, requested, alloc) -> jnp.ndarray:
+    """[N] NodeResourcesFit over [R, N] state (ops/fit.fit_ok_row)."""
+    return jnp.all((need <= 0) | (requested + need <= alloc), axis=0)
+
+
+def least_requested(alloc, used) -> jnp.ndarray:
+    """[R, N] per-resource leastRequestedScore (ops/common semantics)."""
+    safe_cap = jnp.where(alloc > 0, alloc, 1.0)
+    per_r = jnp.floor((alloc - used) * MAX_NODE_SCORE / safe_cap)
+    return jnp.where((alloc > 0) & (used <= alloc), per_r, 0.0)
+
+
+def weighted_floor_score(per_r, consts, wsum: float) -> jnp.ndarray:
+    """[N] floor(sum_r w_r*score_r / wsum) with static weights."""
+    acc = jnp.zeros((1, per_r.shape[1]), jnp.float32)
+    for r, wv in consts:
+        acc = acc + wv * per_r[r:r + 1, :]
+    return jnp.floor(acc[0] / wsum)
+
+
+def lowest_index_max(score, N: int):
+    """(best, maxv, iota): lowest-index max, computed explicitly — Mosaic's
+    argmax does not guarantee first-occurrence on ties, and the binding
+    contract (reference selectHost determinism) hangs on this tie-break."""
+    maxv = jnp.max(score)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)[0]
+    best = jnp.min(jnp.where(score == maxv, iota, jnp.int32(N))
+                   ).astype(jnp.int32)
+    return best, maxv, iota
+
+
+def store_chosen(chosen_ref, i, best, found) -> None:
+    """Write pod i's pick into its (8, 1) output block row."""
+    picked = jnp.where(found, best, jnp.int32(-1))
+    chosen_ref[pl.dslice(i % 8, 1), :] = picked.reshape(1, 1)
+
+
+# ---- wrapper-side packing helpers ----------------------------------------
+
+smem_spec = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
+
+
+def full_spec(shape):
+    return pl.BlockSpec(shape, lambda i: (0, 0))
+
+
+def chosen_spec():
+    return pl.BlockSpec((8, 1), lambda i: (i // 8, 0))
+
+
+def f32(x) -> jnp.ndarray:
+    return jnp.asarray(x, jnp.float32)
+
+
+def row(x) -> jnp.ndarray:
+    return f32(x)[None, :]
+
+
+def pad_pods(P: int):
+    """(P_pad, pad_spec): pods padded to a multiple of 8 so the (8, 1)
+    chosen blocks divide the grid; padded entries have pod_valid == 0."""
+    P_pad = -(-P // 8) * 8
+    return P_pad, [(0, P_pad - P)]

@@ -1,0 +1,319 @@
+"""Pallas TPU kernel for the FULL plugin-chain scheduling step.
+
+Extends ops/pallas_step.py's VMEM-resident sequential loop to the whole chain
+(models/full_chain.py): Fit + LoadAware + NodeNUMAResource (cpuset capacity,
+SMT alignment, topology-policy admit, zone accounting) + ElasticQuota
+admission — all state carried in VMEM across the (P,) grid. The gang Permit
+barrier remains an XLA post-pass (one segment reduction per batch).
+
+Layout choices (TPU lanes are 128 wide; f32 tile (8, 128)):
+  * node arrays transposed [R, N] — nodes on lanes;
+  * NUMA free state as one [K*R, N] buffer; zone k is the static row slice
+    [k*R:(k+1)*R] (no 3D reductions needed — K is a static python loop);
+  * quota tree in [R, G] lane layout — groups on lanes — so the per-pod
+    request column [R, 1] broadcasts against (used, runtime) directly, and
+    the ancestor-chain walk becomes one dynamic-sublane row slice of a
+    host-precomputed [G, G] ancestor-closure matrix;
+  * per-pod scalars (quota id, flags) in SMEM; per-pod vectors extracted from
+    [R, P] arrays by a lane one-hot reduce.
+
+Bindings are bit-identical to the XLA step — tests/test_pallas_full_chain.py
+diffs them across NUMA/quota/gang configs, including the explicit
+lowest-index-max tie-break Mosaic's argmax does not guarantee.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from koordinator_tpu.models.full_chain import FullChainInputs
+from koordinator_tpu.ops import loadaware as la_ops
+from koordinator_tpu.ops import pallas_common as pc
+from koordinator_tpu.ops.gang import gang_permit_mask
+from koordinator_tpu.ops.loadaware import LoadAwareArgs
+from koordinator_tpu.ops.numa import POLICY_NONE, POLICY_SINGLE_NUMA_NODE
+
+def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
+                 K: int, G: int):
+    wsum = float(max(weights.sum(), 1.0))
+    consts = pc.weight_consts(weights)
+
+    def kernel(
+        # --- SMEM per-pod scalars
+        prod_ref, valid_ref, ds_ref, gangok_ref,
+        needsnuma_ref, needsbind_ref, fullpcpus_ref, cores_ref,  # f32 [P]
+        qid_ref,                                                  # int32 [P]
+        # --- VMEM pod columns [R, P]
+        fitreq_ref, rawreq_ref, est_ref,
+        # --- VMEM node state [R, N]
+        alloc_ref, req0_ref, term_np_ref, term_pr_ref,
+        # --- VMEM node rows [1, N]
+        lafeas_np_ref, lafeas_pr_ref, node_ok_ref, score_valid_ref,
+        has_topo_ref, bindfree0_ref, cpc_ref, policy_ref,
+        # --- VMEM numa [K*R, N] / quota [G, G] + [R, G]
+        numafree0_ref, anc_ref, qused0_ref, qruntime_ref,
+        # --- outputs
+        chosen_ref,                 # (8, 1) int32 blocks over [P_pad, 1]
+        requested_ref,              # [R, N] (carried)
+        qused_ref,                  # [R, G] (carried)
+        # --- scratch
+        dnp_ref, dpr_ref,           # [R, N]
+        numa_ref,                   # [K*R, N]
+        bindfree_ref,               # [1, N]
+    ):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            requested_ref[:] = req0_ref[:]
+            dnp_ref[:] = jnp.zeros_like(dnp_ref)
+            dpr_ref[:] = jnp.zeros_like(dpr_ref)
+            numa_ref[:] = numafree0_ref[:]
+            bindfree_ref[:] = bindfree0_ref[:]
+            qused_ref[:] = qused0_ref[:]
+
+        prod = prod_ref[i] > 0
+        needs_numa = needsnuma_ref[i] > 0
+        needs_bind = needsbind_ref[i] > 0
+        full_pcpus = fullpcpus_ref[i] > 0
+        cores = cores_ref[i]
+        gid = qid_ref[i]
+        has_quota = gid >= 0
+
+        pod_mask = pc.make_pod_mask(i, fitreq_ref.shape[1])
+        fit_need = pc.pod_column(fitreq_ref, pod_mask)
+        raw_req = pc.pod_column(rawreq_ref, pod_mask)
+        est = pc.pod_column(est_ref, pod_mask)                        # [R, 1]
+
+        alloc = alloc_ref[:]
+        requested = requested_ref[:]
+
+        # ---- PreFilter: quota admission along the ancestor closure row
+        anc_row = anc_ref[pl.dslice(jnp.maximum(gid, 0), 1), :]      # [1, G]
+        qused = qused_ref[:]                                         # [R, G]
+        # f32 throughout: Mosaic can't truncate narrow bool vectors (G lanes)
+        viol = jnp.max(
+            jnp.where((raw_req > 0) & (qused + raw_req > qruntime_ref[:]),
+                      1.0, 0.0),
+            axis=0, keepdims=True)                                   # [1, G]
+        quota_ok = jnp.sum(anc_row * viol) <= 0.0
+        admit = (gangok_ref[i] > 0) & (quota_ok | ~has_quota)
+
+        # ---- Filter: Fit
+        fit = pc.fit_ok(fit_need, requested, alloc)                  # [N]
+        # ---- Filter: LoadAware thresholds
+        la_feas = jnp.where(prod, lafeas_pr_ref[0, :], lafeas_np_ref[0, :]) > 0
+        la_ok = la_feas | (ds_ref[i] > 0)
+        # ---- Filter: cpuset capacity + SMT alignment
+        cpc = jnp.maximum(cpc_ref[0, :], 1.0)
+        smt_ok = (~full_pcpus) | (
+            jnp.abs(jnp.remainder(cores, cpc)) < 0.5)
+        # f32-valued selects throughout the filter chain: Mosaic cannot
+        # truncate/select narrow bool vectors
+        cpuset_ok_f = jnp.where(
+            (has_topo_ref[0, :] > 0) & smt_ok & (cores <= bindfree_ref[0, :]),
+            1.0, 0.0)
+        cpuset_ok = jnp.where(needs_bind, cpuset_ok_f, 1.0) > 0
+        # ---- Filter: NUMA topology admit (ops/numa.numa_admit_row semantics)
+        total_free = jnp.zeros((R, alloc.shape[1]), jnp.float32)
+        zone = jnp.full((alloc.shape[1],), K, jnp.int32)
+        for k in range(K - 1, -1, -1):
+            free_k = numa_ref[k * R:(k + 1) * R, :]                  # [R, N]
+            total_free = total_free + free_k
+            fits_k = jnp.all((raw_req <= 0) | (raw_req <= free_k), axis=0)
+            zone = jnp.where(fits_k, jnp.int32(k), zone)             # lowest k
+        fits_total = jnp.all((raw_req <= 0) | (raw_req <= total_free), axis=0)
+        policy = policy_ref[0, :]
+        any_zone_f = jnp.where(zone < K, 1.0, 0.0)
+        fits_total_f = jnp.where(fits_total, 1.0, 0.0)
+        numa_ok_f = jnp.where(policy == POLICY_SINGLE_NUMA_NODE,
+                              any_zone_f, fits_total_f)
+        numa_ok_f = jnp.where(policy == POLICY_NONE, 1.0, numa_ok_f)
+        numa_ok = jnp.where(needs_numa, numa_ok_f, 1.0) > 0
+
+        feasible = ((node_ok_ref[0, :] > 0) & fit & la_ok & cpuset_ok
+                    & numa_ok & admit)
+
+        # ---- Score: LoadAware + NodeNUMAResource least-allocated
+        if prod_mode:
+            base = jnp.where(prod, term_pr_ref[:] + dpr_ref[:],
+                             term_np_ref[:] + dnp_ref[:])
+        else:
+            base = term_np_ref[:] + dnp_ref[:]
+        la_per_r = pc.least_requested(alloc, est + base)
+        nu_per_r = pc.least_requested(alloc, requested + raw_req)
+        la_score = pc.weighted_floor_score(la_per_r, consts, wsum)
+        la_score = jnp.where(score_valid_ref[0, :] > 0, la_score, 0.0)
+        score = la_score + pc.weighted_floor_score(nu_per_r, consts, wsum)
+        score = jnp.where(feasible, score, -1.0)
+
+        best, maxv, iota = pc.lowest_index_max(score, alloc.shape[1])
+        found = (maxv >= 0.0) & (valid_ref[i] > 0)
+        sel = ((iota == best) & found).astype(jnp.float32)           # [N]
+
+        # ---- Reserve: state updates
+        requested_ref[:] = requested + sel[None, :] * fit_need
+        est_add = sel[None, :] * est
+        dnp_ref[:] = dnp_ref[:] + est_add
+        if prod_mode:
+            dpr_ref[:] = dpr_ref[:] + jnp.where(prod, 1.0, 0.0) * est_add
+        bindfree_ref[:] = bindfree_ref[:] - (
+            sel * jnp.where(needs_bind, cores, 0.0))[None, :]
+        # numa: single-zone subtract + lowest-zones-first waterfall (disjoint).
+        # Only the SingleNUMANode policy pins a zone (numa_admit_row returns
+        # zone = -1 otherwise); every other policy spread-fills.
+        apply_numa = sel * jnp.where(needs_numa, 1.0, 0.0)           # [N]
+        single_m = apply_numa * jnp.where(
+            (policy == POLICY_SINGLE_NUMA_NODE) & (zone < K), 1.0, 0.0)
+        spread_m = apply_numa - single_m
+        remaining = raw_req * spread_m[None, :]                      # [R, N]
+        for k in range(K):
+            free_k = numa_ref[k * R:(k + 1) * R, :]
+            zone_m = (single_m * jnp.where(zone == k, 1.0, 0.0))[None, :]
+            free_k = free_k - raw_req * zone_m
+            take = jnp.minimum(free_k, remaining)
+            numa_ref[k * R:(k + 1) * R, :] = free_k - take
+            remaining = remaining - take
+        # quota: add along the ancestor closure
+        q_apply = jnp.where(found & has_quota, 1.0, 0.0)
+        qused_ref[:] = qused + raw_req * anc_row * q_apply
+
+        pc.store_chosen(chosen_ref, i, best, found)
+
+    return kernel
+
+
+def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
+                                 num_groups: int, interpret: bool = False,
+                                 jit: bool = True, active_axes=None):
+    """FullChainInputs -> (chosen[P], requested[N, R], quota_used[G, R]);
+    same contract as models.full_chain.build_full_chain_step."""
+    full_weights = args.weight_vector()
+    if active_axes is not None:
+        full_weights = full_weights[list(active_axes)]
+    weights = np.asarray(full_weights, np.float32)
+    prod_mode = args.score_according_prod_usage
+
+    def step(fc: FullChainInputs) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        inputs = fc.base
+        P, R = inputs.fit_requests.shape
+        N = inputs.allocatable.shape[0]
+        K = fc.numa_free.shape[1]
+        G = fc.quota_used.shape[0]
+        G_eff = max(G, 1)
+        reject_np, reject_prod = la_ops.loadaware_node_reject(
+            inputs.allocatable,
+            inputs.la_filter_usage,
+            inputs.la_has_filter_usage,
+            inputs.la_filter_thresholds,
+            inputs.la_prod_thresholds,
+            inputs.la_prod_pod_usage,
+            inputs.la_filter_skip,
+        )
+        gang_pod_ok = jnp.where(
+            fc.gang_id >= 0, fc.gang_valid[jnp.maximum(fc.gang_id, 0)], True
+        )
+        # ancestor closure computed traceably (inputs may be tracers under jit):
+        # closure[g, a] = 1 iff a appears in g's chain (-1 padding never matches)
+        if G:
+            anc = jnp.any(
+                fc.quota_ancestors[:, :, None]
+                == jnp.arange(G, dtype=fc.quota_ancestors.dtype)[None, None, :],
+                axis=1,
+            ).astype(jnp.float32)
+        else:
+            anc = jnp.zeros((1, 1), jnp.float32)
+
+        f32, row = pc.f32, pc.row
+        P_pad, pad_p = pc.pad_pods(P)
+        spad = lambda x: jnp.pad(f32(x), pad_p)  # noqa: E731
+
+        def pods_t(x):  # [P, R] -> [R, P_pad]
+            return jnp.pad(f32(x), pad_p + [(0, 0)]).T
+
+        # numa [N, K, R] -> [K*R, N]
+        numa0 = jnp.transpose(f32(fc.numa_free), (1, 2, 0)).reshape(K * R, N)
+        # quota lane axis padded to >= 128: Mosaic cannot truncate the narrow
+        # bool vectors that comparisons on a (R, G<128) block would produce.
+        # Padding runtime with +inf keeps phantom groups from ever violating.
+        G_lane = max(128, -(-G_eff // 128) * 128)
+        if G:
+            qused0 = jnp.pad(f32(fc.quota_used).T, [(0, 0), (0, G_lane - G)])
+            qruntime = jnp.pad(f32(fc.quota_runtime).T,
+                               [(0, 0), (0, G_lane - G)],
+                               constant_values=jnp.inf)
+            qid = jnp.asarray(fc.quota_id, jnp.int32)
+        else:
+            qused0 = jnp.zeros((R, G_lane), jnp.float32)
+            qruntime = jnp.full((R, G_lane), jnp.inf, jnp.float32)
+            qid = jnp.full(P, -1, jnp.int32)
+        anc = jnp.pad(anc, [(0, max(8 - G_eff, 0)), (0, G_lane - anc.shape[1])])
+
+        kernel = _make_kernel(weights, prod_mode, N, R, K, G_eff)
+        grid_inputs = (
+            spad(inputs.is_prod), spad(inputs.pod_valid),
+            spad(inputs.is_daemonset), spad(gang_pod_ok),
+            spad(fc.needs_numa), spad(fc.needs_bind),
+            spad(fc.full_pcpus), spad(fc.cores_needed),
+            jnp.pad(qid, pad_p, constant_values=-1),
+            pods_t(inputs.fit_requests), pods_t(fc.requests),
+            pods_t(inputs.estimated),
+            f32(inputs.allocatable).T, f32(inputs.requested).T,
+            f32(inputs.la_term_nonprod).T, f32(inputs.la_term_prod).T,
+            row(~reject_np), row(~reject_prod),
+            row(inputs.node_ok), row(inputs.la_score_valid),
+            row(fc.has_topology), row(fc.bind_free), row(fc.cpus_per_core),
+            jnp.asarray(fc.numa_policy, jnp.int32)[None, :],
+            numa0, jnp.asarray(anc, jnp.float32), qused0, qruntime,
+        )
+        smem, full = pc.smem_spec, pc.full_spec
+        chosen, requested_t, qused_t = pl.pallas_call(
+            kernel,
+            grid=(P_pad,),
+            in_specs=(
+                [smem()] * 9
+                + [full((R, P_pad))] * 3
+                + [full((R, N))] * 4
+                + [full((1, N))] * 8
+                + [full((K * R, N)), full((max(G_eff, 8), G_lane)),
+                   full((R, G_lane)), full((R, G_lane))]
+            ),
+            out_specs=[
+                pc.chosen_spec(),
+                full((R, N)),
+                full((R, G_lane)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((P_pad, 1), jnp.int32),
+                jax.ShapeDtypeStruct((R, N), jnp.float32),
+                jax.ShapeDtypeStruct((R, G_lane), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((R, N), jnp.float32),
+                pltpu.VMEM((R, N), jnp.float32),
+                pltpu.VMEM((K * R, N), jnp.float32),
+                pltpu.VMEM((1, N), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",),
+            ),
+            interpret=interpret,
+        )(*grid_inputs)
+        chosen = chosen[:P, 0]
+
+        # ---- Permit barrier (XLA post-pass, once per batch)
+        keep = gang_permit_mask(
+            chosen, fc.gang_id, fc.gang_min_member, fc.gang_assumed,
+            fc.gang_group_id, num_gangs, num_groups,
+        )
+        chosen = jnp.where(keep, chosen, -1)
+        quota_used = qused_t[:, :G].T if G else fc.quota_used
+        return chosen, requested_t.T, quota_used
+
+    return jax.jit(step) if jit else step

@@ -25,7 +25,6 @@ fan-out (SURVEY.md section 3.1); state carried corresponds to the Fit
 
 from __future__ import annotations
 
-import functools
 from typing import Tuple
 
 import jax
@@ -35,15 +34,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from koordinator_tpu.ops import loadaware as la_ops
+from koordinator_tpu.ops import pallas_common as pc
 from koordinator_tpu.ops.loadaware import LoadAwareArgs
-
-MAX_NODE_SCORE = 100.0
 
 
 def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int):
     wsum = float(max(weights.sum(), 1.0))
-
-    weight_consts = [(r, float(v)) for r, v in enumerate(weights) if v]
+    consts = pc.weight_consts(weights)
 
     def kernel(
         prod_ref, valid_ref, ds_ref,                     # [P] SMEM scalars
@@ -63,19 +60,12 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int):
             dpr_ref[:] = jnp.zeros_like(dpr_ref)
 
         prod = prod_ref[i] > 0
-        # pod i's column via a lane one-hot (TPU block shapes can't carve a
-        # [1, R] row, and dynamic lane slicing relayouts; the masked reduce is
-        # a few hundred VPU flops)
-        P_pad = req_ref.shape[1]
-        pod_mask = (jax.lax.broadcasted_iota(jnp.int32, (1, P_pad), 1) == i
-                    ).astype(jnp.float32)                # [1, P]
-        need = jnp.sum(req_ref[:] * pod_mask, axis=1, keepdims=True)  # [R, 1]
-        est = jnp.sum(est_ref[:] * pod_mask, axis=1, keepdims=True)   # [R, 1]
+        pod_mask = pc.make_pod_mask(i, req_ref.shape[1])
+        need = pc.pod_column(req_ref, pod_mask)          # [R, 1]
+        est = pc.pod_column(est_ref, pod_mask)           # [R, 1]
         alloc = alloc_ref[:]                             # [R, N]
         requested = requested_ref[:]
-
-        # NodeResourcesFit (ops/fit.fit_ok_row semantics)
-        fit = jnp.all((need <= 0) | (requested + need <= alloc), axis=0)  # [N]
+        fit = pc.fit_ok(need, requested, alloc)          # [N]
 
         # LoadAware least-allocated score with in-batch deltas
         if prod_mode:
@@ -83,15 +73,8 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int):
                              term_np_ref[:] + dnp_ref[:])
         else:
             base = term_np_ref[:] + dnp_ref[:]
-        used = est + base                                # [R, N] (est is [R, 1])
-        safe_cap = jnp.where(alloc > 0, alloc, 1.0)
-        per_r = jnp.floor((alloc - used) * MAX_NODE_SCORE / safe_cap)
-        per_r = jnp.where((alloc > 0) & (used <= alloc), per_r, 0.0)
-        # weights are static (baked as Python floats: SMEM only serves scalars)
-        acc = jnp.zeros((1, per_r.shape[1]), jnp.float32)
-        for r, wv in weight_consts:
-            acc = acc + wv * per_r[r:r + 1, :]
-        score = jnp.floor(acc[0] / wsum)
+        per_r = pc.least_requested(alloc, est + base)
+        score = pc.weighted_floor_score(per_r, consts, wsum)
         score = jnp.where(score_valid_ref[0, :] > 0, score, 0.0)
 
         la_feas = jnp.where(prod, lafeas_pr_ref[0, :], lafeas_np_ref[0, :]) > 0
@@ -99,13 +82,7 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int):
         feasible = (node_ok_ref[0, :] > 0) & fit & la_ok
         score = jnp.where(feasible, score, -1.0)
 
-        # lowest-index max, computed explicitly: Mosaic's argmax does not
-        # guarantee first-occurrence on ties, and the binding contract
-        # (reference selectHost determinism) hangs on this tie-break
-        maxv = jnp.max(score)
-        iota = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)[0]
-        best = jnp.min(jnp.where(score == maxv, iota, jnp.int32(N))
-                       ).astype(jnp.int32)
+        best, maxv, iota = pc.lowest_index_max(score, N)
         found = (maxv >= 0.0) & (valid_ref[i] > 0)
         sel = ((iota == best) & found).astype(jnp.float32)   # [N]
 
@@ -114,8 +91,7 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int):
         dnp_ref[:] = dnp_ref[:] + est_add
         if prod_mode:
             dpr_ref[:] = dpr_ref[:] + jnp.where(prod, 1.0, 0.0) * est_add
-        picked = jnp.where(found, best, jnp.int32(-1))
-        chosen_ref[pl.dslice(i % 8, 1), :] = picked.reshape(1, 1)
+        pc.store_chosen(chosen_ref, i, best, found)
 
     return kernel
 
@@ -141,11 +117,8 @@ def build_pallas_schedule_step(args: LoadAwareArgs, interpret: bool = False,
             inputs.la_prod_pod_usage,
             inputs.la_filter_skip,
         )
-        f32 = lambda x: jnp.asarray(x, jnp.float32)  # noqa: E731
-        row = lambda x: f32(x)[None, :]  # noqa: E731
-        # pods padded to a multiple of 8 so the (8, 1) chosen blocks divide P
-        P_pad = -(-P // 8) * 8
-        pad_p = [(0, P_pad - P)]
+        f32, row = pc.f32, pc.row
+        P_pad, pad_p = pc.pad_pods(P)
 
         def pods_t(x):  # [P, R] -> [R, P_pad]
             return jnp.pad(f32(x), pad_p + [(0, 0)]).T
@@ -161,8 +134,7 @@ def build_pallas_schedule_step(args: LoadAwareArgs, interpret: bool = False,
             row(~reject_np), row(~reject_prod),
             row(inputs.node_ok), row(inputs.la_score_valid),
         )
-        smem = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
-        full = lambda shape: pl.BlockSpec(shape, lambda i: (0, 0))  # noqa: E731
+        smem, full = pc.smem_spec, pc.full_spec
         chosen, requested_t = pl.pallas_call(
             kernel,
             grid=(P_pad,),
@@ -173,7 +145,7 @@ def build_pallas_schedule_step(args: LoadAwareArgs, interpret: bool = False,
                 full((1, N)), full((1, N)), full((1, N)), full((1, N)),
             ],
             out_specs=[
-                pl.BlockSpec((8, 1), lambda i: (i // 8, 0)),
+                pc.chosen_spec(),
                 full((R, N)),
             ],
             out_shape=[

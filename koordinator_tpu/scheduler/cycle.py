@@ -42,7 +42,7 @@ from koordinator_tpu.client.store import (
     KIND_RESERVATION,
     ObjectStore,
 )
-from koordinator_tpu.models.full_chain import build_full_chain_step
+from koordinator_tpu.models.full_chain import build_best_full_chain_step
 from koordinator_tpu.ops.fit import with_pod_count
 from koordinator_tpu.ops.loadaware import LoadAwareArgs
 from koordinator_tpu.scheduler.frameworkext import (
@@ -206,7 +206,7 @@ class Scheduler:
     def _get_step(self, signature: Tuple, ng: int, ngroups: int, active) -> object:
         key = (signature, ng, ngroups, tuple(active))
         if key not in self._step_cache:
-            self._step_cache[key] = build_full_chain_step(
+            self._step_cache[key] = build_best_full_chain_step(
                 self.args, ng, ngroups, active_axes=active
             )
         return self._step_cache[key]
