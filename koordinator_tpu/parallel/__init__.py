@@ -16,3 +16,7 @@ from koordinator_tpu.parallel.mesh import (  # noqa: F401
     build_sharded_schedule_step,
     build_sharded_score_matrix,
 )
+from koordinator_tpu.parallel.full_chain_mesh import (  # noqa: F401
+    build_sharded_full_chain_step,
+    shard_full_chain_inputs,
+)

@@ -1,0 +1,89 @@
+"""Sharded FULL plugin-chain step: the flagship kernel over a device mesh.
+
+Distributes the fused chain of models/full_chain.py — gang PreFilter, quota
+admission, Fit/LoadAware/cpuset/NUMA filters, LoadAware+NUMA scoring, serial
+Reserve, gang Permit barrier — the same way the base serial-parity step is
+distributed (parallel/mesh.py): the distributed analog of the reference's
+per-node goroutine fan-out at
+/root/reference/pkg/scheduler/frameworkext/framework_extender.go:204.
+
+Layout:
+  * node-axis state sharded over ALL mesh devices ("pods"+"nodes" axes flat):
+    allocatable/requested/usage [N, R], NUMA free/capacity [N, K, R], cpuset
+    bind state [N] — each fori_loop iteration's filter+score row is computed
+    shard-locally and the argmax reduces across shards (ICI all-reduce).
+  * pod arrays replicated ([P, ...] is small: the batch, not the cluster).
+  * quota tree replicated ([G, R] is tiny); the order-dependent admission check
+    and used-rollup run identically on every shard, so the carried quota state
+    never needs a collective.
+  * gang arrays replicated; the Permit barrier is a segment reduction over the
+    replicated `chosen` vector, computed post-loop on every shard.
+
+Bindings are bit-identical to the single-device step at any mesh size: the
+per-shard score rows are the same values the unsharded kernel computes, and
+argmax tie-breaking (lowest node index) is preserved by XLA's cross-shard
+argmax reduction over the global index space.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from koordinator_tpu.models.full_chain import (
+    FullChainInputs,
+    build_full_chain_step,
+)
+from koordinator_tpu.ops.loadaware import LoadAwareArgs
+from koordinator_tpu.parallel.mesh import _node_axis_spec, shard_inputs_nodewise
+
+# FullChainInputs fields indexed [N, ...] (sharded); everything else (pods,
+# quota tree, gangs) is replicated.
+_FC_NODE_FIELDS = frozenset(
+    {
+        "numa_free",
+        "numa_capacity",
+        "numa_policy",
+        "has_topology",
+        "bind_free",
+        "cpus_per_core",
+    }
+)
+
+
+def shard_full_chain_inputs(fc: FullChainInputs, mesh: Mesh) -> FullChainInputs:
+    """Place FullChainInputs on the mesh: node state sharded over all devices,
+    pod/quota/gang state replicated."""
+    node_spec = _node_axis_spec(mesh, flat=True)
+    base = shard_inputs_nodewise(fc.base, mesh)
+
+    def put(name, arr):
+        spec = node_spec if name in _FC_NODE_FIELDS else P()
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    rest = {k: put(k, v) for k, v in fc._asdict().items() if k != "base"}
+    return FullChainInputs(base=base, **rest)
+
+
+def build_sharded_full_chain_step(
+    args: LoadAwareArgs,
+    num_gangs: int,
+    num_groups: int,
+    mesh: Mesh,
+    active_axes=None,
+):
+    """Full-chain step jitted with node-sharded in/out shardings.
+
+    Same contract as build_full_chain_step:
+    FullChainInputs -> (chosen[P], requested[N, R], quota_used[G, R]).
+    """
+    raw = build_full_chain_step(
+        args, num_gangs, num_groups, jit=False, active_axes=active_axes
+    )
+    node_spec = _node_axis_spec(mesh, flat=True)
+    out_shardings = (
+        NamedSharding(mesh, P()),          # chosen [P] replicated
+        NamedSharding(mesh, node_spec),    # requested [N, R] node-sharded
+        NamedSharding(mesh, P()),          # quota_used [G, R] replicated
+    )
+    return jax.jit(raw, out_shardings=out_shardings)
