@@ -67,6 +67,22 @@ class ControllerFinder:
             f"{pod.meta.owner_kind}/{pod.meta.owner_name}", members, healthy)
 
 
+def terminate_pod(store: ObjectStore, pod: Pod, annotation: str,
+                  reason: str) -> Pod:
+    """Mark a pod Failed through the store, via a COPY: the store holds live
+    references, so mutating the stored object in place would make the MODIFIED
+    event's old==new and hide the phase transition from subscribers (quota
+    used rollback, assign caches). Single home for that invariant — eviction
+    and preemption both route here."""
+    import copy
+
+    updated = copy.deepcopy(pod)
+    updated.phase = "Failed"
+    updated.meta.annotations[annotation] = reason
+    store.update(KIND_POD, updated)
+    return updated
+
+
 def is_evictable(pod: Pod) -> Tuple[bool, str]:
     """(ok, reason). defaultevictor filter chain. A terminated pod is never
     evictable — that check precedes even the force annotation."""
@@ -131,9 +147,7 @@ class EvictionAPIEvictor:
         self._terminate(pod, reason)
 
     def _terminate(self, pod: Pod, reason: str) -> None:
-        pod.phase = "Failed"
-        pod.meta.annotations["koordinator.sh/evicted"] = reason
-        self.store.update(KIND_POD, pod)
+        terminate_pod(self.store, pod, "koordinator.sh/evicted", reason)
 
 
 class DeleteEvictor(EvictionAPIEvictor):

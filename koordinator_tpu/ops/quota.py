@@ -211,6 +211,24 @@ def quota_used_add_row(
 # ---------------------------------------------------------------------------
 
 
+def merge_group_request(
+    pending_by_quota: Dict[str, np.ndarray],
+    used_by_quota: Dict[str, np.ndarray],
+) -> Dict[str, np.ndarray]:
+    """Group request = pending + used: EVERY member pod counts toward the
+    group's demand (GroupQuotaManager.updatePodRequestNoLock,
+    group_quota_manager.go:184-256), not just the unscheduled ones. Single
+    home for the rule — the snapshot builder, preemptor, and revoke
+    controller all derive runtime quotas from it."""
+    out: Dict[str, np.ndarray] = {k: v.copy() for k, v in pending_by_quota.items()}
+    for k, v in used_by_quota.items():
+        if k in out:
+            out[k] = out[k] + v
+        else:
+            out[k] = v.copy()
+    return out
+
+
 def build_quota_tree(
     quotas,  # Sequence[ElasticQuota]
     pod_requests_by_quota: Optional[Dict[str, np.ndarray]] = None,

@@ -118,6 +118,11 @@ class Scheduler:
             quota_plugin.revoke_controller(store, self.config.elastic_quota)
             if quota_plugin else None
         )
+        from koordinator_tpu.scheduler.preempt import QuotaPreemptor
+
+        self.preemptor = (
+            QuotaPreemptor(store, quota_plugin) if quota_plugin else None
+        )
         self._step_cache: Dict[Tuple, object] = {}
 
     # ------------------------------------------------------------------
@@ -272,12 +277,61 @@ class Scheduler:
             return result
 
         # ---- batched kernel pass
+        rejected_pods, failed_pods = self._batch_pass(
+            pending, now, ctx, result, pending_reservations
+        )
+
+        # ---- PostFilter: ElasticQuota preemption (preempt.go). Quota-rejected
+        # non-gang pods try to reclaim from lower-priority same-group members;
+        # if any round evicts victims, one kernel rerun retries every pod that
+        # is still unbound (the reference's nominate-then-reschedule collapses
+        # into an in-cycle retry because victims terminate synchronously here).
+        if self.preemptor is not None and rejected_pods:
+            any_victims = False
+            for pod in rejected_pods:
+                if not pod.quota_name or pod.gang_name:
+                    continue
+                round_ = self.preemptor.preempt(pod)
+                if round_ is not None:
+                    any_victims = True
+                    result.preempted_victims.extend(round_.victim_keys)
+            if any_victims:
+                retry = rejected_pods + [p for p, _ in failed_pods]
+                rejected_pods, failed_pods = self._batch_pass(
+                    retry, now, ctx, result, pending_reservations
+                )
+
+        for pod in rejected_pods:
+            result.rejected.append(pod.meta.key)
+            self.extender.error_handlers.dispatch(pod, "admission rejected")
+        for pod, reason in failed_pods:
+            result.failed.append(pod.meta.key)
+            self.extender.error_handlers.dispatch(pod, reason)
+
+        if gang_plugin is not None:
+            gang_plugin.update_pod_group_status(self.store, now)
+        result.duration_seconds = time.perf_counter() - t_start
+        self.extender.monitor.record(result)
+        return result
+
+    # ------------------------------------------------------------------
+    def _batch_pass(
+        self,
+        pending: List[Pod],
+        now: float,
+        ctx: CycleContext,
+        result: CycleResult,
+        pending_reservations: Dict[str, Reservation],
+    ) -> Tuple[List[Pod], List[Pod]]:
+        """One snapshot -> kernel -> bind pass. Appends bindings to `result`
+        and returns (rejected_pods, failed) still unbound — `failed` carries
+        (pod, reason) so Reserve/PreBind veto reasons survive to dispatch —
+        the caller decides whether to retry them (preemption) or record them."""
+        rejected_pods: List[Pod] = []
+        failed_pods: List[Tuple[Pod, str]] = []
         state = self._cluster_state(pending, now)
         if not state.nodes:
-            result.failed = [p.meta.key for p in pending]
-            result.duration_seconds = time.perf_counter() - t_start
-            self.extender.monitor.record(result)
-            return result
+            return rejected_pods, [(p, "no schedulable node") for p in pending]
         fc, pods, nodes, tree, gang_index, ng, ngroups = build_full_chain_inputs(
             state, self.args
         )
@@ -289,20 +343,18 @@ class Scheduler:
         t_k = time.perf_counter()
         chosen, _, _ = step(fc)
         chosen = np.asarray(chosen)
-        result.kernel_seconds = time.perf_counter() - t_k
+        result.kernel_seconds += time.perf_counter() - t_k
 
-        # ---- apply bindings in queue order
+        # apply bindings in queue order
         by_key = {p.meta.key: p for p in pending}
         for i, key in enumerate(pods.keys):
             node_idx = int(chosen[i])
             pod = by_key[key]
             if node_idx < 0:
                 if pod.gang_name or pod.quota_name:
-                    result.rejected.append(key)
-                    self.extender.error_handlers.dispatch(pod, "admission rejected")
+                    rejected_pods.append(pod)
                 else:
-                    result.failed.append(key)
-                    self.extender.error_handlers.dispatch(pod, "no feasible node")
+                    failed_pods.append((pod, "no feasible node"))
                 continue
             node_name = nodes.names[node_idx]
             reservation = pending_reservations.get(key)
@@ -310,14 +362,8 @@ class Scheduler:
                 pod, node_name, ctx, result, reservation_cr=reservation
             )
             if err:
-                result.failed.append(key)
-                self.extender.error_handlers.dispatch(pod, err)
-
-        if gang_plugin is not None:
-            gang_plugin.update_pod_group_status(self.store, now)
-        result.duration_seconds = time.perf_counter() - t_start
-        self.extender.monitor.record(result)
-        return result
+                failed_pods.append((pod, err))
+        return rejected_pods, failed_pods
 
     # ------------------------------------------------------------------
     def _reserve_and_bind(

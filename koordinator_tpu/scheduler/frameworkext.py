@@ -38,6 +38,7 @@ class CycleResult:
     bound: List[BindResult] = field(default_factory=list)
     failed: List[str] = field(default_factory=list)      # pod keys left pending
     rejected: List[str] = field(default_factory=list)    # struck by permit/quota
+    preempted_victims: List[str] = field(default_factory=list)  # quota PostFilter
     duration_seconds: float = 0.0
     kernel_seconds: float = 0.0
 

@@ -93,6 +93,12 @@ class ElasticQuotaPlugin(Plugin):
     def quota_list(self) -> List[ElasticQuota]:
         return list(self.quotas.values())
 
+    def request_by_quota(self) -> Dict[str, np.ndarray]:
+        """Group demand from the live caches (see ops.quota.merge_group_request)."""
+        from koordinator_tpu.ops.quota import merge_group_request
+
+        return merge_group_request(self.pending, self.used)
+
     def revoke_controller(self, store: ObjectStore, args) -> "QuotaOveruseRevokeController":
         return QuotaOveruseRevokeController(self, store, args)
 
@@ -140,7 +146,7 @@ class QuotaOveruseRevokeController:
             total = total.add(node.allocatable)
         tree = build_quota_tree(
             quotas,
-            pod_requests_by_quota=self.plugin.pending,
+            pod_requests_by_quota=self.plugin.request_by_quota(),
             used_by_quota=self.plugin.used,
         )
         runtime = compute_runtime_quotas(tree, total.to_vector())

@@ -1,0 +1,207 @@
+"""ElasticQuota PostFilter preemption.
+
+Reference: `pkg/scheduler/plugins/elasticquota/preempt.go:1-294` (+
+`candidate.go`). Semantics kept:
+
+  * canPreempt (preempt.go:276-294): a victim must belong to the SAME quota
+    group as the preemptor, have strictly lower priority, and not carry
+    `quota.scheduling.koordinator.sh/preemptible: "false"`
+    (extension.IsPodNonPreemptible, apis/extension/elastic_quota.go:82-84).
+  * usedLimit check (preempt.go:189-200): preemption frees quota `used` until
+    used + podRequest <= runtimeQuota holds on EVERY ancestor of the group
+    (the same recursive rule the admission kernel enforces, ops/quota.py).
+  * minimal victim set with reprieve (preempt.go:154-215): tentatively remove
+    all candidates, then re-add ("reprieve") from the most important down while
+    the preemptor still fits. PDB-violating candidates are reprieved FIRST so
+    the selected victims prefer pods whose budgets have headroom; as in
+    upstream preemption, a PDB is advisory here — a violating victim is still
+    evicted when no non-violating set suffices.
+
+Architecture note (TPU-first): victim selection is host control-plane work
+(G ~ 10^2 groups, member lists are small); the *retry* after eviction is the
+batched kernel itself — the cycle driver reruns the fused full-chain step once
+after a successful preemption round, so a starved min-guaranteed group reclaims
+within the same cycle instead of waiting for the next one.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from koordinator_tpu.api.objects import QUOTA_DOMAIN_PREFIX, Pod
+from koordinator_tpu.api.resources import NUM_RESOURCES, ResourceList
+from koordinator_tpu.client.store import KIND_NODE, KIND_POD, ObjectStore
+from koordinator_tpu.ops.quota import build_quota_tree, compute_runtime_quotas
+
+LABEL_PREEMPTIBLE = QUOTA_DOMAIN_PREFIX + "/preemptible"
+
+
+def is_pod_non_preemptible(pod: Pod) -> bool:
+    """extension.IsPodNonPreemptible (elastic_quota.go:82-84)."""
+    return pod.meta.labels.get(LABEL_PREEMPTIBLE, "") == "false"
+
+
+@dataclass
+class PreemptionRound:
+    """Outcome of one PostFilter pass."""
+
+    preemptor_key: str
+    quota_name: str
+    victim_keys: List[str] = field(default_factory=list)
+
+
+class QuotaPreemptor:
+    """PostFilter path: evict lower-priority same-group pods to free quota."""
+
+    def __init__(self, store: ObjectStore, quota_plugin) -> None:
+        self.store = store
+        self.plugin = quota_plugin
+
+    # -- tree snapshot -------------------------------------------------
+    def _tree_state(self):
+        """(names->id, ancestors[G, D], used[G, R], runtime[G, R]) from the
+        live quota caches — the PostFilterState snapshot (plugin.go:57-72)."""
+        quotas = self.plugin.quota_list()
+        if not quotas:
+            return None
+        tree = build_quota_tree(
+            quotas,
+            pod_requests_by_quota=self.plugin.request_by_quota(),
+            used_by_quota=self.plugin.used,
+        )
+        total = ResourceList()
+        for node in self.store.list(KIND_NODE):
+            total = total.add(node.allocatable)
+        runtime = compute_runtime_quotas(tree, total.to_vector())
+        return tree.index, tree.ancestors, tree.used.copy(), runtime
+
+    # -- candidate selection -------------------------------------------
+    def _candidates(self, preemptor: Pod) -> List[Pod]:
+        """canPreempt filter: live assigned members of the preemptor's quota
+        group with strictly lower priority, not marked non-preemptible."""
+        pri = preemptor.spec.priority or 0
+        quota = preemptor.quota_name
+        return [
+            p
+            for p in self.store.list(KIND_POD)
+            if p.quota_name == quota
+            and p.is_assigned
+            and not p.is_terminated
+            and (p.spec.priority or 0) < pri
+            and not is_pod_non_preemptible(p)
+        ]
+
+    @staticmethod
+    def _importance_key(pod: Pod):
+        """util.MoreImportantPod order: higher priority first, then longer
+        running (older) first. Reprieve walks this order, so the final victims
+        are the least important members."""
+        return (-(pod.spec.priority or 0), pod.meta.creation_timestamp)
+
+    def _fits(self, req: np.ndarray, chain: np.ndarray, used: np.ndarray,
+              runtime: np.ndarray, freed: np.ndarray) -> bool:
+        """checkQuotaRecursive with `freed` subtracted along the chain."""
+        for g in chain:
+            if g < 0:
+                continue
+            avail_used = np.maximum(used[g] - freed, 0.0)
+            if ((req > 0) & (avail_used + req > runtime[g])).any():
+                return False
+        return True
+
+    # -- the PostFilter entry ------------------------------------------
+    def select_victims(self, preemptor: Pod) -> Optional[List[Pod]]:
+        """Minimal victim set freeing enough quota for `preemptor`, or None if
+        preemption cannot help (no candidates / still over limit with all of
+        them gone — preempt.go:149-163)."""
+        state = self._tree_state()
+        if state is None:
+            return None
+        index, ancestors, used, runtime = state
+        gid = index.get(preemptor.quota_name)
+        if gid is None:
+            return None
+        chain = ancestors[gid]
+        req = preemptor.spec.requests.to_vector()
+        if self._fits(req, chain, used, runtime, np.zeros(NUM_RESOURCES)):
+            return None  # admission failure wasn't quota-driven
+
+        candidates = self._candidates(preemptor)
+        if not candidates:
+            return None
+        freed_all = np.zeros(NUM_RESOURCES, np.float32)
+        for c in candidates:
+            freed_all += c.spec.requests.to_vector()
+        if not self._fits(req, chain, used, runtime, freed_all):
+            return None  # even evicting every candidate can't make room
+
+        # classify by PDB headroom with a shared budget across the sorted list
+        # (filterPodsWithPDBViolation keeps a pdbsAllowed counter, not a
+        # per-pod check — two victims sharing one budget must not both pass)
+        ordered = sorted(candidates, key=self._importance_key)
+        violating, non_violating = self._split_by_pdb(ordered)
+
+        victims: List[Pod] = []
+        freed = freed_all.copy()
+        for c in violating + non_violating:
+            # reprieve: add c back unless the preemptor then stops fitting
+            without = freed - c.spec.requests.to_vector()
+            if self._fits(req, chain, used, runtime, without):
+                freed = without
+            else:
+                victims.append(c)
+        return victims or None
+
+    def _split_by_pdb(self, ordered: List[Pod]):
+        """Stable split into (violating, non_violating) with shared
+        DisruptionsAllowed budgets (preempt.go:219-268)."""
+        from koordinator_tpu.client.store import KIND_PDB
+
+        pdbs = list(self.store.list(KIND_PDB))
+        if not pdbs:
+            return [], list(ordered)
+        pods = list(self.store.list(KIND_POD))
+        allowed: Dict[int, int] = {}
+        for i, pdb in enumerate(pdbs):
+            matching = [p for p in pods if pdb.matches(p)]
+            healthy = sum(1 for p in matching if not p.is_terminated)
+            if pdb.min_available is not None:
+                allowed[i] = healthy - pdb.min_available
+            elif pdb.max_unavailable is not None:
+                unavailable = len(matching) - healthy
+                allowed[i] = pdb.max_unavailable - unavailable
+            else:
+                allowed[i] = 0
+        violating, non_violating = [], []
+        for pod in ordered:
+            violated = False
+            for i, pdb in enumerate(pdbs):
+                if not pdb.matches(pod):
+                    continue
+                allowed[i] -= 1
+                if allowed[i] < 0:
+                    violated = True
+            (violating if violated else non_violating).append(pod)
+        return violating, non_violating
+
+    def preempt(self, preemptor: Pod) -> Optional[PreemptionRound]:
+        """Run one PostFilter round: select victims and terminate them (the
+        reference DeletePods the victims and nominates the preemptor; here the
+        cycle driver's immediate kernel rerun replaces nomination)."""
+        victims = self.select_victims(preemptor)
+        if not victims:
+            return None
+        round_ = PreemptionRound(
+            preemptor_key=preemptor.meta.key, quota_name=preemptor.quota_name
+        )
+        from koordinator_tpu.descheduler.evictions import terminate_pod
+
+        for v in victims:
+            terminate_pod(
+                self.store, v, "koordinator.sh/preempted-by", preemptor.meta.key
+            )
+            round_.victim_keys.append(v.meta.key)
+        return round_

@@ -36,6 +36,7 @@ from koordinator_tpu.ops.quota import (
     QuotaTreeArrays,
     build_quota_tree,
     compute_runtime_quotas,
+    merge_group_request,
 )
 from koordinator_tpu.scheduler.cpu_topology import CPUAllocationState, FULL_PCPUS
 
@@ -160,6 +161,10 @@ def build_full_chain_inputs(
         if q and pod.is_assigned and not pod.is_terminated:
             used_by_quota.setdefault(q, np.zeros(NUM_RESOURCES, np.float32))
             used_by_quota[q] += pod.spec.requests.to_vector()
+    # group request counts EVERY member pod — running AND pending; a
+    # pending-only request would understate runtime for groups with running
+    # usage and deny admission their min already guarantees
+    pod_req_by_quota = merge_group_request(pod_req_by_quota, used_by_quota)
     tree = build_quota_tree(state.quotas, pod_req_by_quota, used_by_quota)
     if state.cluster_total is None:
         total = np.zeros(NUM_RESOURCES, np.float32)

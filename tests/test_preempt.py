@@ -1,0 +1,206 @@
+"""ElasticQuota PostFilter preemption (ref preempt.go): a starved
+higher-priority pod reclaims quota from lower-priority same-group members
+within ONE scheduling cycle."""
+
+import numpy as np
+
+from koordinator_tpu.api.objects import (
+    LABEL_POD_QOS,
+    LABEL_QUOTA_NAME,
+    ElasticQuota,
+    Node,
+    NodeMetric,
+    NodeMetricInfo,
+    ObjectMeta,
+    Pod,
+    PodDisruptionBudget,
+    PodSpec,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client.store import (
+    KIND_ELASTIC_QUOTA,
+    KIND_NODE,
+    KIND_NODE_METRIC,
+    KIND_PDB,
+    KIND_POD,
+    ObjectStore,
+)
+from koordinator_tpu.scheduler.cycle import Scheduler
+from koordinator_tpu.scheduler.preempt import LABEL_PREEMPTIBLE
+
+GIB = 1024**3
+NOW = 1_000_000.0
+
+
+def _store(num_nodes=2, cores=16):
+    store = ObjectStore()
+    for i in range(num_nodes):
+        store.add(KIND_NODE, Node(
+            meta=ObjectMeta(name=f"node-{i}", namespace=""),
+            allocatable=ResourceList.of(
+                cpu=cores * 1000, memory=64 * GIB, pods=110),
+        ))
+        store.add(KIND_NODE_METRIC, NodeMetric(
+            meta=ObjectMeta(name=f"node-{i}", namespace=""),
+            update_time=NOW - 10,
+            node_metric=NodeMetricInfo(
+                node_usage=ResourceList.of(cpu=1000, memory=2 * GIB)),
+        ))
+    return store
+
+
+def _quota(store, name="team-a", cpu=4000, mem=16 * GIB, min_cpu=4000):
+    store.add(KIND_ELASTIC_QUOTA, ElasticQuota(
+        meta=ObjectMeta(name=name, namespace="default"),
+        min=ResourceList.of(cpu=min_cpu, memory=mem),
+        max=ResourceList.of(cpu=cpu, memory=mem),
+    ))
+
+
+def _pod(store, name, cpu=1000, prio=9500, quota="team-a", node=None,
+         labels=None, created=NOW - 100.0):
+    pod = Pod(
+        meta=ObjectMeta(
+            name=name,
+            labels={LABEL_POD_QOS: "LS", LABEL_QUOTA_NAME: quota,
+                    **(labels or {})},
+            creation_timestamp=created,
+        ),
+        spec=PodSpec(priority=prio,
+                     requests=ResourceList.of(cpu=cpu, memory=GIB)),
+    )
+    if node is not None:
+        pod.spec.node_name = node
+        pod.phase = "Running"
+    store.add(KIND_POD, pod)
+    return pod
+
+
+class TestQuotaPreemption:
+    def test_starved_high_priority_pod_reclaims_in_one_cycle(self):
+        store = _store()
+        _quota(store, cpu=4000)
+        sched = Scheduler(store)
+        # fill the group's quota with low-priority members
+        for i in range(4):
+            _pod(store, f"low-{i}", cpu=1000, prio=6000, node="node-0")
+        # a higher-priority pod arrives with zero quota headroom
+        high = _pod(store, "high", cpu=2000, prio=9500)
+        result = sched.run_cycle(now=NOW)
+        # preemption evicted enough low-prio members and bound the pod
+        assert any(b.pod_key == high.meta.key for b in result.bound)
+        assert len(result.preempted_victims) == 2
+        assert not result.rejected
+        for key in result.preempted_victims:
+            victim = store.get(KIND_POD, key)
+            assert victim.is_terminated
+            assert victim.meta.annotations["koordinator.sh/preempted-by"] == (
+                high.meta.key
+            )
+
+    def test_minimal_victim_set(self):
+        """Only as many victims as needed are evicted (reprieve pass)."""
+        store = _store()
+        _quota(store, cpu=4000)
+        sched = Scheduler(store)
+        for i in range(4):
+            _pod(store, f"low-{i}", cpu=1000, prio=6000, node="node-0")
+        _pod(store, "high", cpu=1000, prio=9500)
+        result = sched.run_cycle(now=NOW)
+        assert len(result.preempted_victims) == 1
+
+    def test_least_important_victim_chosen(self):
+        """Victims come from the bottom of the importance order."""
+        store = _store()
+        _quota(store, cpu=2000)
+        sched = Scheduler(store)
+        _pod(store, "mid", cpu=1000, prio=8000, node="node-0")
+        _pod(store, "lowest", cpu=1000, prio=3000, node="node-0")
+        _pod(store, "high", cpu=1000, prio=9500)
+        result = sched.run_cycle(now=NOW)
+        assert result.preempted_victims == ["default/lowest"]
+
+    def test_equal_or_higher_priority_never_preempted(self):
+        store = _store()
+        _quota(store, cpu=2000)
+        sched = Scheduler(store)
+        _pod(store, "peer-a", cpu=1000, prio=9500, node="node-0")
+        _pod(store, "peer-b", cpu=1000, prio=9800, node="node-0")
+        _pod(store, "high", cpu=1000, prio=9500)
+        result = sched.run_cycle(now=NOW)
+        assert not result.preempted_victims
+        assert result.rejected == ["default/high"]
+
+    def test_non_preemptible_label_respected(self):
+        store = _store()
+        _quota(store, cpu=2000)
+        sched = Scheduler(store)
+        for i in range(2):
+            _pod(store, f"low-{i}", cpu=1000, prio=6000, node="node-0",
+                 labels={LABEL_PREEMPTIBLE: "false"})
+        _pod(store, "high", cpu=1000, prio=9500)
+        result = sched.run_cycle(now=NOW)
+        assert not result.preempted_victims
+        assert result.rejected == ["default/high"]
+
+    def test_other_quota_group_never_preempted(self):
+        """canPreempt requires the same quota group (preempt.go:276-294):
+        cross-group reclaim rides runtime-quota recalc + overuse revoke, not
+        PostFilter."""
+        store = _store()
+        _quota(store, "team-a", cpu=2000, min_cpu=2000)
+        _quota(store, "team-b", cpu=2000, min_cpu=0)
+        sched = Scheduler(store)
+        _pod(store, "b-low-0", cpu=1000, prio=3000, quota="team-b",
+             node="node-0")
+        _pod(store, "a-full-0", cpu=1000, prio=6000, node="node-0")
+        _pod(store, "a-full-1", cpu=1000, prio=6000, node="node-0")
+        _pod(store, "a-high", cpu=1000, prio=9500)
+        result = sched.run_cycle(now=NOW)
+        # victims only from team-a, never team-b
+        assert result.preempted_victims == ["default/a-full-0"] or \
+            result.preempted_victims == ["default/a-full-1"]
+        assert store.get(KIND_POD, "default/b-low-0").phase == "Running"
+
+    def test_pdb_covered_pod_spared_when_alternative_exists(self):
+        """PDB-violating candidates are reprieved first: the victim is the pod
+        whose eviction keeps every budget intact."""
+        store = _store()
+        _quota(store, cpu=2000)
+        sched = Scheduler(store)
+        # two equal-priority members; one protected by a tight PDB
+        _pod(store, "protected", cpu=1000, prio=6000, node="node-0",
+             labels={"app": "web"})
+        _pod(store, "expendable", cpu=1000, prio=6000, node="node-0")
+        store.add(KIND_PDB, PodDisruptionBudget(
+            meta=ObjectMeta(name="web-pdb", namespace="default"),
+            selector={"app": "web"}, min_available=1))
+        _pod(store, "high", cpu=1000, prio=9500)
+        result = sched.run_cycle(now=NOW)
+        assert result.preempted_victims == ["default/expendable"]
+        assert store.get(KIND_POD, "default/protected").phase == "Running"
+
+    def test_no_preemption_when_nothing_can_help(self):
+        """Even evicting every candidate cannot make room -> no eviction."""
+        store = _store()
+        _quota(store, cpu=2000)
+        sched = Scheduler(store)
+        _pod(store, "low", cpu=1000, prio=6000, node="node-0")
+        _pod(store, "huge", cpu=4000, prio=9500)  # exceeds group max alone
+        result = sched.run_cycle(now=NOW)
+        assert not result.preempted_victims
+        assert store.get(KIND_POD, "default/low").phase == "Running"
+
+    def test_quota_used_cache_rolls_after_preemption(self):
+        """The quota tree sees the freed usage in the same cycle."""
+        store = _store()
+        _quota(store, cpu=2000)
+        sched = Scheduler(store)
+        _pod(store, "low", cpu=2000, prio=6000, node="node-0")
+        _pod(store, "high", cpu=2000, prio=9500)
+        result = sched.run_cycle(now=NOW)
+        assert result.preempted_victims == ["default/low"]
+        quota_plugin = sched.extender.plugin("ElasticQuota")
+        used = quota_plugin.used.get("team-a")
+        # only the newly-bound high-prio pod's usage remains
+        assert used is not None and used[0] == 2000.0
