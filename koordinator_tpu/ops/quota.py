@@ -52,6 +52,10 @@ class QuotaTreeArrays:
     allow_lent: np.ndarray    # [G] bool
     level: np.ndarray         # [G] int32 depth (root=0)
     index: Dict[str, int] = field(default_factory=dict)
+    # per-group enable flag for min-quota scaling; the reference's manager flag
+    # is global-on (group_quota_manager.go:86) but the ScaleMinQuotaManager
+    # tracks both categories, so the mask is kept per group
+    enable_min_scale: np.ndarray = field(default_factory=lambda: np.zeros(0, bool))
 
 
 def water_fill_level(
@@ -127,11 +131,58 @@ def water_fill_level(
     return np.where(active, runtime, 0.0).astype(np.float32)
 
 
-def compute_runtime_quotas(tree: QuotaTreeArrays, cluster_total: np.ndarray) -> np.ndarray:
+def scaled_min_level(
+    total: np.ndarray,    # [G, R] each group's parent-available total
+    parent: np.ndarray,   # [G]
+    min_: np.ndarray,     # [G, R] original min
+    enable: np.ndarray,   # [G] bool — group participates in scaling
+    level: np.ndarray,    # [G]
+    cur_level: int,
+) -> np.ndarray:
+    """AutoScaleMin for groups at cur_level
+    (core/scale_minquota_when_over_root_res.go:103-160): per (parent, resource)
+    where the children's min sum exceeds the parent's total, enable-scale
+    children split max(0, total - disabledSum) proportionally to their original
+    min (truncated, as the reference's int64 conversion does); disable-scale
+    children always keep their original min."""
+    G, R = min_.shape
+    active = level == cur_level
+    seg = np.where(parent >= 0, parent, G)
+
+    def seg_sum(mask):
+        out = np.zeros((G + 1, R), np.float64)
+        rows = active & mask
+        np.add.at(out, seg[rows], min_[rows])
+        return out
+
+    en_sum = seg_sum(enable)
+    dis_sum = seg_sum(~enable)
+    # per-segment total (constant within a segment: the parent's runtime)
+    seg_total = np.full((G + 1, R), -np.inf)
+    np.maximum.at(seg_total, seg[active], total[active])
+    seg_total[~np.isfinite(seg_total)] = 0.0
+
+    need_scale = (en_sum + dis_sum) > seg_total          # [G+1, R]
+    avail = np.maximum(seg_total - dis_sum, 0.0)
+    scaled = np.floor(
+        avail[seg] * min_ / np.maximum(en_sum[seg], 1e-9)
+    )
+    use = active[:, None] & enable[:, None] & need_scale[seg]
+    return np.where(use, scaled, min_).astype(np.float32)
+
+
+def compute_runtime_quotas(
+    tree: QuotaTreeArrays,
+    cluster_total: np.ndarray,
+    scale_min_enabled: bool = True,
+) -> np.ndarray:
     """Top-down runtime quota for the whole tree: [G, R] float32.
 
     Level 0 children share cluster_total; level d children share their parent's
-    runtime. Host numpy (see water_fill_level for why)."""
+    runtime. When scale_min_enabled (the manager default,
+    group_quota_manager.go:86), each level's mins are first auto-scaled where
+    the siblings' min sum exceeds the parent total. Host numpy (see
+    water_fill_level for why)."""
     G = len(tree.names)
     if G == 0:
         return np.zeros((0, NUM_RESOURCES), np.float32)
@@ -139,16 +190,26 @@ def compute_runtime_quotas(tree: QuotaTreeArrays, cluster_total: np.ndarray) -> 
     runtime = np.zeros((G, NUM_RESOURCES), np.float32)
     max_level = int(tree.level.max()) if G else 0
     total_row = np.asarray(cluster_total, np.float32)
+    enable = (
+        tree.enable_min_scale
+        if tree.enable_min_scale.shape[0] == G
+        else np.ones(G, bool)
+    )
     for lvl in range(max_level + 1):
         total = np.where(
             (parent >= 0)[:, None],
             runtime[np.clip(parent, 0, G - 1)],
             total_row[None, :],
         )
+        min_eff = (
+            scaled_min_level(total, parent, tree.min, enable, tree.level, lvl)
+            if scale_min_enabled
+            else tree.min
+        )
         rt_lvl = water_fill_level(
             total,
             parent,
-            tree.min,
+            min_eff,
             tree.guarantee,
             tree.request,
             tree.shared_weight,
@@ -305,4 +366,5 @@ def build_quota_tree(
         allow_lent=allow_lent,
         level=level,
         index=index,
+        enable_min_scale=np.ones(G, bool),
     )

@@ -287,14 +287,13 @@ class Scheduler:
         # is still unbound (the reference's nominate-then-reschedule collapses
         # into an in-cycle retry because victims terminate synchronously here).
         if self.preemptor is not None and rejected_pods:
+            quota_rejected = [
+                p for p in rejected_pods if p.quota_name and not p.gang_name
+            ]
             any_victims = False
-            for pod in rejected_pods:
-                if not pod.quota_name or pod.gang_name:
-                    continue
-                round_ = self.preemptor.preempt(pod)
-                if round_ is not None:
-                    any_victims = True
-                    result.preempted_victims.extend(round_.victim_keys)
+            for round_ in self.preemptor.post_filter(quota_rejected):
+                any_victims = True
+                result.preempted_victims.extend(round_.victim_keys)
             if any_victims:
                 retry = rejected_pods + [p for p, _ in failed_pods]
                 rejected_pods, failed_pods = self._batch_pass(
@@ -322,7 +321,7 @@ class Scheduler:
         ctx: CycleContext,
         result: CycleResult,
         pending_reservations: Dict[str, Reservation],
-    ) -> Tuple[List[Pod], List[Pod]]:
+    ) -> Tuple[List[Pod], List[Tuple[Pod, str]]]:
         """One snapshot -> kernel -> bind pass. Appends bindings to `result`
         and returns (rejected_pods, failed) still unbound — `failed` carries
         (pod, reason) so Reserve/PreBind veto reasons survive to dispatch —

@@ -99,6 +99,31 @@ class ElasticQuotaPlugin(Plugin):
 
         return merge_group_request(self.pending, self.used)
 
+    def tree_snapshot(self, store: ObjectStore):
+        """(tree, runtime[G, R]) from the live caches + node totals — the one
+        shared snapshot the revoke controller and the preemptor both derive
+        runtime quotas from. Returns None when no quotas exist."""
+        from koordinator_tpu.api.resources import ResourceList
+        from koordinator_tpu.client.store import KIND_NODE
+        from koordinator_tpu.ops.quota import (
+            build_quota_tree,
+            compute_runtime_quotas,
+        )
+
+        quotas = self.quota_list()
+        if not quotas:
+            return None
+        total = ResourceList()
+        for node in store.list(KIND_NODE):
+            total = total.add(node.allocatable)
+        tree = build_quota_tree(
+            quotas,
+            pod_requests_by_quota=self.request_by_quota(),
+            used_by_quota=self.used,
+        )
+        runtime = compute_runtime_quotas(tree, total.to_vector())
+        return tree, runtime
+
     def revoke_controller(self, store: ObjectStore, args) -> "QuotaOveruseRevokeController":
         return QuotaOveruseRevokeController(self, store, args)
 
@@ -134,23 +159,11 @@ class QuotaOveruseRevokeController:
         self._over_since: Dict[str, float] = {}
 
     def _runtime_by_name(self) -> Dict[str, np.ndarray]:
-        from koordinator_tpu.api.resources import ResourceList
-        from koordinator_tpu.client.store import KIND_NODE
-        from koordinator_tpu.ops.quota import build_quota_tree, compute_runtime_quotas
-
-        quotas = self.plugin.quota_list()
-        if not quotas:
+        snap = self.plugin.tree_snapshot(self.store)
+        if snap is None:
             return {}
-        total = ResourceList()
-        for node in self.store.list(KIND_NODE):
-            total = total.add(node.allocatable)
-        tree = build_quota_tree(
-            quotas,
-            pod_requests_by_quota=self.plugin.request_by_quota(),
-            used_by_quota=self.plugin.used,
-        )
-        runtime = compute_runtime_quotas(tree, total.to_vector())
-        return {q.meta.name: runtime[i] for i, q in enumerate(quotas)}
+        tree, runtime = snap
+        return {name: runtime[i] for i, name in enumerate(tree.names)}
 
     def reconcile(self, now: float) -> List[str]:
         """Returns keys of evicted pods."""

@@ -16,6 +16,10 @@ Reference: `pkg/scheduler/plugins/elasticquota/preempt.go:1-294` (+
     the selected victims prefer pods whose budgets have headroom; as in
     upstream preemption, a PDB is advisory here — a violating victim is still
     evicted when no non-violating set suffices.
+  * nominated-pod accounting (PostFilterState, plugin.go:57-72): within one
+    PostFilter pass, earlier preemptors' requests count as used for later
+    ones, so two starved pods in one group each claim their own victims
+    instead of the second seeing phantom headroom.
 
 Architecture note (TPU-first): victim selection is host control-plane work
 (G ~ 10^2 groups, member lists are small); the *retry* after eviction is the
@@ -27,14 +31,12 @@ within the same cycle instead of waiting for the next one.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from koordinator_tpu.api.objects import QUOTA_DOMAIN_PREFIX, Pod
-from koordinator_tpu.api.resources import NUM_RESOURCES, ResourceList
-from koordinator_tpu.client.store import KIND_NODE, KIND_POD, ObjectStore
-from koordinator_tpu.ops.quota import build_quota_tree, compute_runtime_quotas
+from koordinator_tpu.client.store import KIND_POD, ObjectStore
 
 LABEL_PREEMPTIBLE = QUOTA_DOMAIN_PREFIX + "/preemptible"
 
@@ -46,7 +48,7 @@ def is_pod_non_preemptible(pod: Pod) -> bool:
 
 @dataclass
 class PreemptionRound:
-    """Outcome of one PostFilter pass."""
+    """Outcome of one preemptor's PostFilter attempt."""
 
     preemptor_key: str
     quota_name: str
@@ -59,24 +61,6 @@ class QuotaPreemptor:
     def __init__(self, store: ObjectStore, quota_plugin) -> None:
         self.store = store
         self.plugin = quota_plugin
-
-    # -- tree snapshot -------------------------------------------------
-    def _tree_state(self):
-        """(names->id, ancestors[G, D], used[G, R], runtime[G, R]) from the
-        live quota caches — the PostFilterState snapshot (plugin.go:57-72)."""
-        quotas = self.plugin.quota_list()
-        if not quotas:
-            return None
-        tree = build_quota_tree(
-            quotas,
-            pod_requests_by_quota=self.plugin.request_by_quota(),
-            used_by_quota=self.plugin.used,
-        )
-        total = ResourceList()
-        for node in self.store.list(KIND_NODE):
-            total = total.add(node.allocatable)
-        runtime = compute_runtime_quotas(tree, total.to_vector())
-        return tree.index, tree.ancestors, tree.used.copy(), runtime
 
     # -- candidate selection -------------------------------------------
     def _candidates(self, preemptor: Pod) -> List[Pod]:
@@ -101,7 +85,8 @@ class QuotaPreemptor:
         are the least important members."""
         return (-(pod.spec.priority or 0), pod.meta.creation_timestamp)
 
-    def _fits(self, req: np.ndarray, chain: np.ndarray, used: np.ndarray,
+    @staticmethod
+    def _fits(req: np.ndarray, chain: np.ndarray, used: np.ndarray,
               runtime: np.ndarray, freed: np.ndarray) -> bool:
         """checkQuotaRecursive with `freed` subtracted along the chain."""
         for g in chain:
@@ -112,27 +97,21 @@ class QuotaPreemptor:
                 return False
         return True
 
-    # -- the PostFilter entry ------------------------------------------
-    def select_victims(self, preemptor: Pod) -> Optional[List[Pod]]:
-        """Minimal victim set freeing enough quota for `preemptor`, or None if
-        preemption cannot help (no candidates / still over limit with all of
-        them gone — preempt.go:149-163)."""
-        state = self._tree_state()
-        if state is None:
-            return None
-        index, ancestors, used, runtime = state
-        gid = index.get(preemptor.quota_name)
-        if gid is None:
-            return None
-        chain = ancestors[gid]
-        req = preemptor.spec.requests.to_vector()
-        if self._fits(req, chain, used, runtime, np.zeros(NUM_RESOURCES)):
-            return None  # admission failure wasn't quota-driven
-
+    def _select_victims(
+        self,
+        preemptor: Pod,
+        req: np.ndarray,
+        chain: np.ndarray,
+        used: np.ndarray,     # [G, R] incl. inflight nominations
+        runtime: np.ndarray,  # [G, R]
+    ) -> Optional[List[Pod]]:
+        """Minimal victim set freeing enough quota, or None if preemption
+        cannot help (no candidates / still over limit with all of them gone —
+        preempt.go:149-163)."""
         candidates = self._candidates(preemptor)
         if not candidates:
             return None
-        freed_all = np.zeros(NUM_RESOURCES, np.float32)
+        freed_all = np.zeros(req.shape, np.float32)
         for c in candidates:
             freed_all += c.spec.requests.to_vector()
         if not self._fits(req, chain, used, runtime, freed_all):
@@ -187,21 +166,64 @@ class QuotaPreemptor:
             (violating if violated else non_violating).append(pod)
         return violating, non_violating
 
-    def preempt(self, preemptor: Pod) -> Optional[PreemptionRound]:
-        """Run one PostFilter round: select victims and terminate them (the
-        reference DeletePods the victims and nominates the preemptor; here the
-        cycle driver's immediate kernel rerun replaces nomination)."""
-        victims = self.select_victims(preemptor)
-        if not victims:
-            return None
-        round_ = PreemptionRound(
-            preemptor_key=preemptor.meta.key, quota_name=preemptor.quota_name
-        )
-        from koordinator_tpu.descheduler.evictions import terminate_pod
+    # -- the PostFilter entry ------------------------------------------
+    def post_filter(self, rejected: List[Pod]) -> List[PreemptionRound]:
+        """One PostFilter pass over every quota-rejected pod, in queue order.
 
-        for v in victims:
-            terminate_pod(
-                self.store, v, "koordinator.sh/preempted-by", preemptor.meta.key
+        The tree snapshot is built once and only rebuilt after a round that
+        actually evicted (store `used` changed); earlier preemptors' requests
+        ride an inflight ledger so later ones see them as used
+        (PostFilterState nominated-pod accounting). The cycle driver reruns
+        the batched kernel afterwards — victims terminate synchronously, so
+        the retry binds the preemptors."""
+        rounds: List[PreemptionRound] = []
+        snap = self.plugin.tree_snapshot(self.store)
+        if snap is None:
+            return rounds
+        tree, runtime = snap
+        inflight: List[Tuple[str, np.ndarray]] = []  # (quota, request)
+
+        def used_with_inflight() -> np.ndarray:
+            extra = tree.used.copy()
+            for qname, vec in inflight:
+                gid = tree.index.get(qname)
+                if gid is None:
+                    continue
+                for g in tree.ancestors[gid]:
+                    if g >= 0:
+                        extra[g] += vec
+            return extra
+
+        for pod in rejected:
+            gid = tree.index.get(pod.quota_name)
+            if gid is None:
+                continue
+            chain = tree.ancestors[gid]
+            req = pod.spec.requests.to_vector()
+            used = used_with_inflight()
+            if self._fits(req, chain, used, runtime, np.zeros_like(req)):
+                # headroom exists (an earlier eviction already freed it):
+                # the pod binds on retry; account it for later preemptors
+                inflight.append((pod.quota_name, req))
+                continue
+            victims = self._select_victims(pod, req, chain, used, runtime)
+            if not victims:
+                continue
+            round_ = PreemptionRound(
+                preemptor_key=pod.meta.key, quota_name=pod.quota_name
             )
-            round_.victim_keys.append(v.meta.key)
-        return round_
+            from koordinator_tpu.descheduler.evictions import terminate_pod
+
+            for v in victims:
+                terminate_pod(
+                    self.store, v, "koordinator.sh/preempted-by", pod.meta.key
+                )
+                round_.victim_keys.append(v.meta.key)
+            rounds.append(round_)
+            inflight.append((pod.quota_name, req))
+            # evictions changed store-backed used (and group request): rebuild
+            snap = self.plugin.tree_snapshot(self.store)
+            if snap is None:
+                break
+            tree, runtime = snap
+        return rounds

@@ -191,6 +191,25 @@ class TestQuotaPreemption:
         assert not result.preempted_victims
         assert store.get(KIND_POD, "default/low").phase == "Running"
 
+    def test_two_starved_pods_each_claim_victims_in_one_cycle(self):
+        """Nominated-pod accounting (PostFilterState analog): the second
+        preemptor must NOT see the first one's freed headroom as its own —
+        both evict their own victims and both bind in the same cycle."""
+        store = _store()
+        _quota(store, cpu=2000)
+        sched = Scheduler(store)
+        _pod(store, "low-0", cpu=1000, prio=6000, node="node-0")
+        _pod(store, "low-1", cpu=1000, prio=6000, node="node-0")
+        _pod(store, "high-a", cpu=1000, prio=9500)
+        _pod(store, "high-b", cpu=1000, prio=9500)
+        result = sched.run_cycle(now=NOW)
+        assert sorted(result.preempted_victims) == [
+            "default/low-0", "default/low-1"
+        ]
+        bound = {b.pod_key for b in result.bound}
+        assert {"default/high-a", "default/high-b"} <= bound
+        assert not result.rejected
+
     def test_quota_used_cache_rolls_after_preemption(self):
         """The quota tree sees the freed usage in the same cycle."""
         store = _store()

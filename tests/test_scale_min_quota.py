@@ -1,0 +1,127 @@
+"""Min-quota auto-scaling when sibling mins exceed the parent's resource
+(ref core/scale_minquota_when_over_root_res.go + its test)."""
+
+import numpy as np
+
+from koordinator_tpu.api.objects import ElasticQuota, ObjectMeta
+from koordinator_tpu.api.resources import NUM_RESOURCES, ResourceList
+from koordinator_tpu.ops.quota import (
+    build_quota_tree,
+    compute_runtime_quotas,
+    scaled_min_level,
+)
+
+CPU, MEM = 0, 1
+
+
+def _quota(name, min_cpu, max_cpu, parent=None):
+    from koordinator_tpu.api.objects import LABEL_QUOTA_PARENT
+
+    labels = {LABEL_QUOTA_PARENT: parent} if parent else {}
+    return ElasticQuota(
+        meta=ObjectMeta(name=name, namespace="default", labels=labels),
+        min=ResourceList.of(cpu=min_cpu),
+        max=ResourceList.of(cpu=max_cpu, memory=2**40),
+    )
+
+
+def test_no_scaling_when_min_fits():
+    """Sum(min) <= total: original mins are kept (getScaledMinQuota returns
+    the original when no dimension needs scaling)."""
+    quotas = [_quota("a", 50, 1000), _quota("b", 50, 1000)]
+    tree = build_quota_tree(quotas)
+    total = np.zeros(NUM_RESOURCES, np.float32)
+    total[CPU] = 200.0
+    parent = tree.parent
+    lvl_total = np.broadcast_to(total, tree.min.shape).copy()
+    scaled = scaled_min_level(
+        lvl_total, parent, tree.min, np.ones(2, bool), tree.level, 0
+    )
+    np.testing.assert_array_equal(scaled, tree.min)
+
+
+def test_proportional_scaling_when_over_total():
+    """Sum(min)=300 > total=200: each enabled child's min scales by 200/300."""
+    quotas = [_quota("a", 100, 1000), _quota("b", 200, 1000)]
+    tree = build_quota_tree(quotas)
+    total = np.zeros(NUM_RESOURCES, np.float32)
+    total[CPU] = 200.0
+    lvl_total = np.broadcast_to(total, tree.min.shape).copy()
+    scaled = scaled_min_level(
+        lvl_total, tree.parent, tree.min, np.ones(2, bool), tree.level, 0
+    )
+    assert scaled[0, CPU] == np.floor(200.0 * 100 / 300)  # 66
+    assert scaled[1, CPU] == np.floor(200.0 * 200 / 300)  # 133
+
+
+def test_zero_total_scales_to_zero():
+    quotas = [_quota("a", 100, 1000)]
+    tree = build_quota_tree(quotas)
+    lvl_total = np.zeros(tree.min.shape, np.float32)
+    scaled = scaled_min_level(
+        lvl_total, tree.parent, tree.min, np.ones(1, bool), tree.level, 0
+    )
+    assert scaled[0, CPU] == 0.0
+
+
+def test_disabled_children_keep_min():
+    """disableScale children keep min; enabled ones share the remainder
+    (the ensure-disableScale-first branch)."""
+    quotas = [_quota("keep", 150, 1000), _quota("scale-a", 100, 1000),
+              _quota("scale-b", 100, 1000)]
+    tree = build_quota_tree(quotas)
+    enable = np.array([False, True, True])
+    total = np.zeros(NUM_RESOURCES, np.float32)
+    total[CPU] = 250.0  # sum(min)=350 > 250; avail to scalers = 100
+    lvl_total = np.broadcast_to(total, tree.min.shape).copy()
+    scaled = scaled_min_level(
+        lvl_total, tree.parent, tree.min, enable, tree.level, 0
+    )
+    assert scaled[0, CPU] == 150.0          # disabled: untouched
+    assert scaled[1, CPU] == 50.0           # 100 * 100/200
+    assert scaled[2, CPU] == 50.0
+
+
+def test_runtime_quota_respects_scaled_min():
+    """End-to-end: two roots with Sum(min) > cluster total get water-filled
+    from the SCALED mins, so the runtime split follows the min ratio instead
+    of overcommitting the root resource."""
+    quotas = [_quota("a", 300, 2**30), _quota("b", 100, 2**30)]
+    # both demand far beyond min
+    req = {"default-a": None}
+    tree = build_quota_tree(
+        quotas,
+        pod_requests_by_quota={
+            "a": np.full(NUM_RESOURCES, 0, np.float32),
+            "b": np.full(NUM_RESOURCES, 0, np.float32),
+        },
+    )
+    tree.request[:, CPU] = [300.0, 100.0]
+    total = np.zeros(NUM_RESOURCES, np.float32)
+    total[CPU] = 200.0
+    runtime = compute_runtime_quotas(tree, total)
+    # scaled mins: floor(200*300/400)=150, floor(200*100/400)=50
+    assert runtime[0, CPU] == 150.0
+    assert runtime[1, CPU] == 50.0
+    # without scaling the mins would overcommit: 300+100 > 200
+    runtime_off = compute_runtime_quotas(tree, total, scale_min_enabled=False)
+    assert runtime_off[:, CPU].sum() > 200.0
+
+
+def test_nested_level_scaling_uses_parent_runtime():
+    """A child level scales against its PARENT's runtime, not the cluster
+    total (the update loop walks levels top-down)."""
+    quotas = [
+        _quota("root", 100, 100),
+        _quota("kid-a", 80, 2**30, parent="root"),
+        _quota("kid-b", 80, 2**30, parent="root"),
+    ]
+    tree = build_quota_tree(quotas)
+    tree.request[:, CPU] = [100.0, 80.0, 80.0]
+    total = np.zeros(NUM_RESOURCES, np.float32)
+    total[CPU] = 1000.0
+    runtime = compute_runtime_quotas(tree, total)
+    assert runtime[0, CPU] == 100.0
+    # kids' mins (80+80=160) scale to the root's runtime 100: floor(100*80/160)
+    assert runtime[1, CPU] == 50.0
+    assert runtime[2, CPU] == 50.0
