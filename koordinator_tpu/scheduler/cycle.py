@@ -106,6 +106,12 @@ class Scheduler:
         for cls in DEFAULT_PLUGINS:
             plugin = cls(**plugin_kwargs.get(cls.name, {}))
             self.extender.register_plugin(plugin)
+        # DeviceShare contributes NUMA hints to the shared topology admit
+        # (GetPodTopologyHints, deviceshare/topology_hint.go:33)
+        numa_plugin = self.extender.plugin("NodeNUMAResource")
+        device_plugin = self.extender.plugin("DeviceShare")
+        if numa_plugin is not None and device_plugin is not None:
+            numa_plugin.topology_manager.register_provider(device_plugin)
         res_plugin = self.extender.plugin("Reservation")
         self.reservation_controller = (
             ReservationController(

@@ -202,9 +202,13 @@ class QuotaPreemptor:
             req = pod.spec.requests.to_vector()
             used = used_with_inflight()
             if self._fits(req, chain, used, runtime, np.zeros_like(req)):
-                # headroom exists (an earlier eviction already freed it):
-                # the pod binds on retry; account it for later preemptors
-                inflight.append((pod.quota_name, req))
+                # quota headroom exists. If an earlier round freed it, the pod
+                # will bind on retry — account it for later preemptors. With
+                # no evictions yet, the rejection wasn't quota-driven (node
+                # fit etc.): adding it to the ledger would make later
+                # preemptors evict victims for a pod that still can't bind.
+                if rounds:
+                    inflight.append((pod.quota_name, req))
                 continue
             victims = self._select_victims(pod, req, chain, used, runtime)
             if not victims:

@@ -1,0 +1,247 @@
+"""DeviceShare: per-type handlers (GPU/RDMA/FPGA), fractional + whole-GPU
+mixes, memory-only requests, NUMA hints, and joint GPU+RDMA allocation
+(ref plugins/deviceshare/device_allocator.go, topology_hint.go)."""
+
+import json
+
+import pytest
+
+from koordinator_tpu.api.objects import (
+    ANNOTATION_DEVICE_ALLOCATED,
+    Device,
+    DeviceInfo,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from koordinator_tpu.api.resources import ResourceList, ResourceName
+from koordinator_tpu.client.store import KIND_DEVICE, ObjectStore
+from koordinator_tpu.scheduler.frameworkext import CycleContext
+from koordinator_tpu.scheduler.plugins.deviceshare import (
+    DeviceSharePlugin,
+    pod_device_requests,
+)
+from koordinator_tpu.scheduler.topologymanager import BitMask, NUMATopologyHint
+
+GIB = 1024**3
+
+
+def _plugin(num_gpus=4, num_rdma=2, gpu_numa=None, rdma_numa=None,
+            gpu_mem=16 * GIB):
+    store = ObjectStore()
+    plugin = DeviceSharePlugin()
+    plugin.register(store)
+    devices = []
+    for i in range(num_gpus):
+        numa = gpu_numa[i] if gpu_numa else -1
+        devices.append(DeviceInfo(
+            type="gpu", minor=i, numa_node=numa,
+            resources=ResourceList.of(gpu_core=100, gpu_memory=gpu_mem)))
+    for i in range(num_rdma):
+        numa = rdma_numa[i] if rdma_numa else -1
+        devices.append(DeviceInfo(type="rdma", minor=i, numa_node=numa))
+    store.add(KIND_DEVICE, Device(meta=ObjectMeta(name="node-0", namespace=""),
+                                  devices=devices))
+    return plugin, store
+
+
+def _pod(name="p", **resources):
+    return Pod(meta=ObjectMeta(name=name),
+               spec=PodSpec(requests=ResourceList.of(**resources)))
+
+
+class TestRequests:
+    def test_whole_gpu_form(self):
+        pod = _pod(gpu=2)
+        want = pod_device_requests(pod)
+        assert want == {"gpu": {"core": 200, "memory_ratio": 200}}
+
+    def test_rdma_fpga_counts(self):
+        pod = _pod(rdma=1, fpga=2)
+        assert pod_device_requests(pod) == {
+            "rdma": {"count": 1}, "fpga": {"count": 2}}
+
+
+class TestGPUAllocation:
+    def test_whole_plus_fractional_mix(self):
+        """Fractional pods pack (MostAllocated) so whole-GPU pods still fit."""
+        plugin, _ = _plugin(num_gpus=2)
+        ctx = CycleContext(now=0.0)
+        frac_a = _pod("frac-a", gpu_core=30, gpu_memory_ratio=30)
+        frac_b = _pod("frac-b", gpu_core=30, gpu_memory_ratio=30)
+        assert plugin.reserve(frac_a, "node-0", ctx) is None
+        assert plugin.reserve(frac_b, "node-0", ctx) is None
+        # both fractions packed onto one GPU
+        a = plugin.by_pod[frac_a.meta.key]["gpu"][0]["minor"]
+        b = plugin.by_pod[frac_b.meta.key]["gpu"][0]["minor"]
+        assert a == b
+        whole = _pod("whole", gpu=1)
+        assert plugin.reserve(whole, "node-0", ctx) is None
+        w = plugin.by_pod[whole.meta.key]["gpu"][0]
+        assert w["minor"] != a and w["core"] == 100
+
+    def test_whole_gpu_skips_partially_used(self):
+        """A 2-GPU request must not strand on partially-free GPUs."""
+        plugin, _ = _plugin(num_gpus=3)
+        ctx = CycleContext(now=0.0)
+        assert plugin.reserve(
+            _pod("frac", gpu_core=10, gpu_memory_ratio=10), "node-0", ctx
+        ) is None
+        two = _pod("two", gpu=2)
+        assert plugin.reserve(two, "node-0", ctx) is None
+        minors = {p["minor"] for p in plugin.by_pod[two.meta.key]["gpu"]}
+        assert len(minors) == 2
+        frac_minor = plugin.by_pod["default/frac"]["gpu"][0]["minor"]
+        assert frac_minor not in minors
+
+    def test_insufficient_whole_gpus(self):
+        plugin, _ = _plugin(num_gpus=2)
+        ctx = CycleContext(now=0.0)
+        assert plugin.reserve(
+            _pod("frac", gpu_core=10, gpu_memory_ratio=10), "node-0", ctx
+        ) is None
+        err = plugin.reserve(_pod("two", gpu=2), "node-0", ctx)
+        assert err == "insufficient whole gpus"
+        # failed reserve rolled back: nothing leaked
+        assert "default/two" not in plugin.by_pod
+
+    def test_memory_only_request_allocates(self):
+        """gpu-memory without gpu-core must still pick a device
+        (round-1 gap: memory-only requests bypassed allocation)."""
+        plugin, _ = _plugin(num_gpus=2, gpu_mem=16 * GIB)
+        ctx = CycleContext(now=0.0)
+        pod = _pod("memonly", gpu_memory=8 * GIB)
+        assert plugin.reserve(pod, "node-0", ctx) is None
+        pick = plugin.by_pod[pod.meta.key]["gpu"][0]
+        assert pick["memory"] == 8 * GIB and pick["core"] == 0
+
+    def test_memory_only_capacity_respected(self):
+        plugin, _ = _plugin(num_gpus=1, gpu_mem=8 * GIB)
+        ctx = CycleContext(now=0.0)
+        assert plugin.reserve(
+            _pod("m1", gpu_memory=6 * GIB), "node-0", ctx) is None
+        err = plugin.reserve(_pod("m2", gpu_memory=6 * GIB), "node-0", ctx)
+        assert err == "insufficient gpu capacity"
+
+    def test_memory_only_blocks_whole_gpu(self):
+        """Memory and memory-ratio are views of one capacity: a memory-only
+        grant must stop a later whole-GPU grant on the same device."""
+        plugin, _ = _plugin(num_gpus=1, gpu_mem=16 * GIB)
+        ctx = CycleContext(now=0.0)
+        assert plugin.reserve(
+            _pod("memonly", gpu_memory=8 * GIB), "node-0", ctx) is None
+        err = plugin.reserve(_pod("whole", gpu=1), "node-0", ctx)
+        assert err == "insufficient gpu capacity"
+
+    def test_ratio_and_memory_axes_stay_in_sync(self):
+        """A ratio grant books the equivalent bytes and vice versa, so the
+        two forms cannot double-book the device's memory."""
+        plugin, _ = _plugin(num_gpus=1, gpu_mem=16 * GIB)
+        ctx = CycleContext(now=0.0)
+        assert plugin.reserve(
+            _pod("ratio", gpu_core=50, gpu_memory_ratio=75), "node-0", ctx
+        ) is None
+        # 75% of 16GiB booked as bytes too: a 8GiB memory-only ask must fail
+        err = plugin.reserve(_pod("mem", gpu_memory=8 * GIB), "node-0", ctx)
+        assert err == "insufficient gpu capacity"
+
+    def test_invalid_core_above_100(self):
+        plugin, _ = _plugin()
+        err = plugin.reserve(
+            _pod("bad", gpu_core=150, gpu_memory_ratio=150), "node-0",
+            CycleContext(now=0.0))
+        assert "multiple of 100" in err
+
+    def test_unreserve_releases(self):
+        plugin, _ = _plugin(num_gpus=1)
+        ctx = CycleContext(now=0.0)
+        pod = _pod("p", gpu=1)
+        assert plugin.reserve(pod, "node-0", ctx) is None
+        plugin.unreserve(pod, "node-0", ctx)
+        assert plugin.reserve(_pod("q", gpu=1), "node-0", ctx) is None
+
+
+class TestRDMAAndJoint:
+    def test_rdma_whole_device(self):
+        plugin, _ = _plugin(num_rdma=2)
+        ctx = CycleContext(now=0.0)
+        pod = _pod("r", rdma=1)
+        assert plugin.reserve(pod, "node-0", ctx) is None
+        assert len(plugin.by_pod[pod.meta.key]["rdma"]) == 1
+        assert plugin.reserve(_pod("r2", rdma=2), "node-0", ctx) == (
+            "insufficient rdma devices")
+
+    def test_joint_gpu_rdma_numa_aligned(self):
+        """RDMA picks prefer the NUMA node of the allocated GPUs
+        (jointAllocate, device_allocator.go:278-331)."""
+        plugin, _ = _plugin(
+            num_gpus=2, num_rdma=2, gpu_numa=[0, 1], rdma_numa=[0, 1])
+        ctx = CycleContext(now=0.0)
+        # force the GPU onto numa 1 by occupying gpu 0
+        assert plugin.reserve(
+            _pod("filler", gpu_core=100, gpu_memory_ratio=100), "node-0", ctx
+        ) is None
+        pod = _pod("joint", gpu=1, rdma=1)
+        assert plugin.reserve(pod, "node-0", ctx) is None
+        gpu_pick = plugin.by_pod[pod.meta.key]["gpu"][0]
+        rdma_pick = plugin.by_pod[pod.meta.key]["rdma"][0]
+        assert gpu_pick["minor"] == 1
+        assert rdma_pick["minor"] == 1  # numa 1, same as the gpu
+
+    def test_prebind_annotation_covers_all_types(self):
+        plugin, _ = _plugin(num_gpus=1, num_rdma=1)
+        ctx = CycleContext(now=0.0)
+        pod = _pod("j", gpu=1, rdma=1)
+        assert plugin.reserve(pod, "node-0", ctx) is None
+        ann = {}
+        plugin.pre_bind(pod, "node-0", ctx, ann)
+        alloc = json.loads(ann[ANNOTATION_DEVICE_ALLOCATED])
+        assert alloc["gpu"][0]["core"] == 100
+        assert alloc["rdma"][0]["minor"] == 0
+
+
+class TestTopologyHints:
+    def test_hints_prefer_single_numa(self):
+        plugin, _ = _plugin(num_gpus=4, gpu_numa=[0, 0, 1, 1])
+        hints = plugin.get_pod_topology_hints(_pod("p", gpu=2), "node-0")
+        gpu_hints = hints["device/gpu"]
+        masks = {tuple(h.affinity.get_bits()): h.preferred for h in gpu_hints}
+        # both single-node placements fit and are preferred
+        assert masks[(0,)] and masks[(1,)]
+        assert not masks[(0, 1)]
+
+    def test_hints_widen_when_single_node_cannot_fit(self):
+        plugin, _ = _plugin(num_gpus=2, gpu_numa=[0, 1])
+        hints = plugin.get_pod_topology_hints(_pod("p", gpu=2), "node-0")
+        gpu_hints = hints["device/gpu"]
+        assert len(gpu_hints) == 1
+        assert tuple(gpu_hints[0].affinity.get_bits()) == (0, 1)
+        assert gpu_hints[0].preferred
+
+    def test_no_topology_is_dont_care(self):
+        plugin, _ = _plugin(num_gpus=2)  # numa_node -1 everywhere
+        hints = plugin.get_pod_topology_hints(_pod("p", gpu=1), "node-0")
+        assert hints["device/gpu"] is None
+
+    def test_memory_only_hints_respect_memory(self):
+        """Hints must not prefer a NUMA node whose GPUs are memory-full."""
+        plugin, _ = _plugin(num_gpus=2, gpu_numa=[0, 1], gpu_mem=16 * GIB)
+        ctx = CycleContext(now=0.0)
+        # fill gpu 0's memory (numa 0)
+        assert plugin.reserve(
+            _pod("filler", gpu_memory=16 * GIB), "node-0", ctx) is None
+        assert plugin.by_pod["default/filler"]["gpu"][0]["minor"] == 0
+        hints = plugin.get_pod_topology_hints(
+            _pod("p", gpu_memory=8 * GIB), "node-0")
+        masks = {tuple(h.affinity.get_bits()) for h in hints["device/gpu"]
+                 if h.preferred and h.affinity.count() == 1}
+        assert masks == {(1,)}
+
+    def test_affinity_restricts_reserve(self):
+        """The merged affinity from the topologymanager confines picks."""
+        plugin, _ = _plugin(num_gpus=2, gpu_numa=[0, 1])
+        ctx = CycleContext(now=0.0)
+        pod = _pod("pinned", gpu=1)
+        plugin.allocate(pod, "node-0", NUMATopologyHint(BitMask([1]), True))
+        assert plugin.reserve(pod, "node-0", ctx) is None
+        assert plugin.by_pod[pod.meta.key]["gpu"][0]["minor"] == 1

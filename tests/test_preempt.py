@@ -210,6 +210,21 @@ class TestQuotaPreemption:
         assert {"default/high-a", "default/high-b"} <= bound
         assert not result.rejected
 
+    def test_node_fit_rejection_does_not_inflate_ledger(self):
+        """A quota pod rejected for NODE capacity (its quota has headroom)
+        must not enter the inflight ledger and trigger over-eviction for a
+        genuinely starved sibling."""
+        store = _store(num_nodes=1, cores=2)  # tiny node: 2000m cpu
+        _quota(store, cpu=8000, min_cpu=8000)
+        sched = Scheduler(store)
+        # node full with a low-prio member; quota far from its limit
+        _pod(store, "running", cpu=2000, prio=6000, node="node-0")
+        # this pod fits the quota but no node can hold it
+        _pod(store, "too-big", cpu=4000, prio=9500)
+        result = sched.run_cycle(now=NOW)
+        assert not result.preempted_victims
+        assert store.get(KIND_POD, "default/running").phase == "Running"
+
     def test_quota_used_cache_rolls_after_preemption(self):
         """The quota tree sees the freed usage in the same cycle."""
         store = _store()

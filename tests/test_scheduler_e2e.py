@@ -294,6 +294,53 @@ class TestSchedulerE2E:
         )
         assert alloc["gpu"][0]["core"] == 50
 
+    def test_joint_gpu_rdma_pod_end_to_end(self):
+        """Full cycle with a GPU+RDMA pod: kernel coarse-fit on the rdma axis,
+        joint NUMA-aligned device picks, annotation carries both types."""
+        store = make_store(num_nodes=1)
+        node = store.list(KIND_NODE)[0]
+        node.allocatable = node.allocatable.add(
+            ResourceList.of(gpu=2, gpu_core=200, gpu_memory=32 * GIB,
+                            gpu_memory_ratio=200, rdma=2)
+        )
+        store.update(KIND_NODE, node)
+        store.add(
+            KIND_DEVICE,
+            Device(
+                meta=ObjectMeta(name="node-0", namespace=""),
+                devices=[
+                    DeviceInfo(type="gpu", minor=0, numa_node=0,
+                               resources=ResourceList.of(
+                                   gpu_core=100, gpu_memory=16 * GIB)),
+                    DeviceInfo(type="gpu", minor=1, numa_node=1,
+                               resources=ResourceList.of(
+                                   gpu_core=100, gpu_memory=16 * GIB)),
+                    DeviceInfo(type="rdma", minor=0, numa_node=0),
+                    DeviceInfo(type="rdma", minor=1, numa_node=1),
+                ],
+            ),
+        )
+        sched = Scheduler(store)
+        pod = Pod(
+            meta=ObjectMeta(name="joint-pod", labels={LABEL_POD_QOS: "LS"},
+                            creation_timestamp=NOW),
+            spec=PodSpec(
+                priority=9500,
+                requests=ResourceList.of(
+                    cpu=1000, memory=GIB, gpu=1, rdma=1
+                ),
+            ),
+        )
+        store.add(KIND_POD, pod)
+        result = sched.run_cycle(now=NOW)
+        assert len(result.bound) == 1
+        alloc = json.loads(
+            store.list(KIND_POD)[0].meta.annotations[ANNOTATION_DEVICE_ALLOCATED]
+        )
+        assert alloc["gpu"][0]["core"] == 100
+        # joint allocation: rdma rides the gpu's numa node
+        assert alloc["rdma"][0]["minor"] == alloc["gpu"][0]["minor"]
+
     def test_monitor_records_cycles(self):
         store = make_store(num_nodes=1)
         sched = Scheduler(store)
