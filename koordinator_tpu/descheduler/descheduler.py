@@ -1,30 +1,77 @@
 """Descheduler profile runner.
 
-Analog of reference `pkg/descheduler/descheduler.go` + `framework/types.go:76-96`
-(DeschedulePlugin/BalancePlugin interfaces + profiles): runs registered balance
-plugins each interval, then drives the migration controller."""
+Analog of reference `pkg/descheduler/descheduler.go` + `pkg/descheduler/profile/`:
+each configured profile owns a plugin set (Deschedule/Balance/Evict/Filter,
+framework/types.go:32-110) and runs every interval — Deschedule plugins first,
+then Balance plugins — followed by the migration controller that executes the
+PodMigrationJob CRs the plugins created (reserve-then-evict)."""
 
 from __future__ import annotations
 
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
+import koordinator_tpu.descheduler.plugins_k8s  # noqa: F401  (registers plugins)
 from koordinator_tpu.client.store import ObjectStore
-from koordinator_tpu.descheduler.lownodeload import LowNodeLoad, LowNodeLoadArgs
+from koordinator_tpu.descheduler.framework import Profile, ProfileConfig
+from koordinator_tpu.descheduler.lownodeload import LowNodeLoadArgs
 from koordinator_tpu.descheduler.migration import MigrationController
+
+DEFAULT_PROFILE = ProfileConfig(
+    name="koord-descheduler",
+    balance=["LowNodeLoad"],
+)
 
 
 class Descheduler:
-    def __init__(self, store: ObjectStore,
-                 low_node_load_args: Optional[LowNodeLoadArgs] = None):
+    def __init__(
+        self,
+        store: ObjectStore,
+        low_node_load_args: Optional[LowNodeLoadArgs] = None,
+        profiles: Optional[List[ProfileConfig]] = None,
+    ):
         self.store = store
-        self.balance_plugins = [LowNodeLoad(store, low_node_load_args)]
+        if profiles is None:
+            profiles = [DEFAULT_PROFILE]
+        if low_node_load_args is not None:
+            import dataclasses
+
+            profiles = [
+                dataclasses.replace(
+                    p,
+                    plugin_args={
+                        **p.plugin_args,
+                        "LowNodeLoad": dataclasses.asdict(low_node_load_args),
+                    },
+                )
+                if "LowNodeLoad" in p.balance
+                else p
+                for p in profiles
+            ]
+        self.profiles = [Profile(cfg, store) for cfg in profiles]
         self.migration = MigrationController(store)
 
     def run_once(self, now: Optional[float] = None) -> dict:
+        from koordinator_tpu.client.store import KIND_POD_MIGRATION_JOB
+
         now = time.time() if now is None else now
-        jobs = []
-        for plugin in self.balance_plugins:
-            jobs.extend(plugin.balance(now))
+        statuses: Dict[str, Dict[str, Optional[str]]] = {}
+        evicted_before = {
+            p.config.name: p.handle.evicted_count for p in self.profiles
+        }
+        jobs_before = len(self.store.list(KIND_POD_MIGRATION_JOB))
+        for profile in self.profiles:
+            statuses[profile.config.name] = {
+                name: s.err for name, s in profile.run(now).items()
+            }
+        jobs_created = len(self.store.list(KIND_POD_MIGRATION_JOB)) - jobs_before
         transitions = self.migration.reconcile(now)
-        return {"jobs_created": len(jobs), "migration_transitions": transitions}
+        return {
+            "jobs_created": jobs_created,
+            "migration_transitions": transitions,
+            "profiles": statuses,
+            "evicted": {
+                p.config.name: p.handle.evicted_count - evicted_before[p.config.name]
+                for p in self.profiles
+            },
+        }

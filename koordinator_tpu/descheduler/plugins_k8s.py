@@ -1,0 +1,159 @@
+"""Vendored-style descheduler plugins, implemented natively.
+
+Reference routes these through the sigs.k8s.io/descheduler adaptor
+(`pkg/descheduler/framework/plugins/kubernetes/plugin.go:60-`); here they run
+directly against the ObjectStore through the profile Handle's
+Filter -> PreEvictionFilter -> Evict chain.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List
+
+from koordinator_tpu.api.objects import Node, Pod
+from koordinator_tpu.client.store import KIND_POD, ObjectStore
+from koordinator_tpu.descheduler.framework import (
+    BalancePlugin,
+    DeschedulePlugin,
+    Status,
+    register_plugin,
+)
+
+
+def _live_assigned(store: ObjectStore) -> List[Pod]:
+    return [
+        p for p in store.list(KIND_POD)
+        if p.is_assigned and not p.is_terminated
+    ]
+
+
+def node_matches_pod(node: Node, pod: Pod) -> bool:
+    """nodeSelector + required node affinity against current node labels
+    (nodeaffinity.go utils.PodMatchesNodeSelectorAndAffinityTerms)."""
+    for k, v in pod.spec.node_selector.items():
+        if node.meta.labels.get(k) != v:
+            return False
+    for k, v in pod.spec.affinity_required_node_labels.items():
+        if node.meta.labels.get(k) != v:
+            return False
+    return True
+
+
+class RemovePodsViolatingNodeAffinity(DeschedulePlugin):
+    """Evict pods whose node no longer satisfies their required node
+    affinity/selector (sigs.k8s.io removepodsviolatingnodeaffinity:
+    requiredDuringSchedulingIgnoredDuringExecution re-checked at runtime).
+    Only evicts when some OTHER node currently matches, so the pod has
+    somewhere to go (the upstream feasibility pre-check)."""
+
+    name = "RemovePodsViolatingNodeAffinity"
+
+    def __init__(self, store: ObjectStore, args: dict = None) -> None:
+        self.store = store
+        self.handle = None  # injected by Profile
+
+    def deschedule(self, nodes: List[Node], now: float) -> Status:
+        by_name = {n.meta.name: n for n in nodes}
+        for pod in _live_assigned(self.store):
+            if not pod.spec.node_selector and \
+                    not pod.spec.affinity_required_node_labels:
+                continue
+            node = by_name.get(pod.spec.node_name)
+            if node is None or node_matches_pod(node, pod):
+                continue
+            if not any(
+                node_matches_pod(n, pod)
+                for n in nodes
+                if n.meta.name != pod.spec.node_name and not n.unschedulable
+            ):
+                continue  # nowhere to go; leave it running
+            self.handle.evict(pod, self.name, "node affinity violated")
+        return Status()
+
+
+class RemoveDuplicates(BalancePlugin):
+    """Spread duplicate workload replicas: when one node runs more than one
+    replica of the same controller and spare nodes exist, evict the extras so
+    the scheduler can spread them (sigs.k8s.io removeduplicates)."""
+
+    name = "RemoveDuplicates"
+
+    def __init__(self, store: ObjectStore, args: dict = None) -> None:
+        self.store = store
+        self.handle = None
+
+    def balance(self, nodes: List[Node], now: float) -> Status:
+        schedulable = [n for n in nodes if not n.unschedulable]
+        if len(schedulable) < 2:
+            return Status()
+        # (namespace, owner) -> node -> replicas
+        groups: Dict[tuple, Dict[str, List[Pod]]] = defaultdict(
+            lambda: defaultdict(list)
+        )
+        for pod in _live_assigned(self.store):
+            if not pod.meta.owner_kind or not pod.meta.owner_name:
+                continue
+            key = (pod.meta.namespace, pod.meta.owner_kind, pod.meta.owner_name)
+            groups[key][pod.spec.node_name].append(pod)
+        for key, by_node in groups.items():
+            for node_name, replicas in by_node.items():
+                if len(replicas) <= 1:
+                    continue
+                # keep the oldest replica; evict the rest (upstream keeps one
+                # per node and lets the scheduler respread) — but only when
+                # some OTHER schedulable node can host the pod, else the
+                # evict/reschedule-back loop churns the workload forever
+                replicas.sort(key=lambda p: p.meta.creation_timestamp)
+                for pod in replicas[1:]:
+                    if not any(
+                        n.meta.name != node_name and node_matches_pod(n, pod)
+                        for n in schedulable
+                    ):
+                        continue
+                    self.handle.evict(pod, self.name, "duplicate replica")
+        return Status()
+
+
+def register_defaults() -> None:
+    """Install the built-in plugin set into the framework registry."""
+    from koordinator_tpu.descheduler.framework import DefaultEvictor
+    from koordinator_tpu.descheduler.lownodeload import (
+        LowNodeLoad,
+        LowNodeLoadArgs,
+    )
+
+    register_plugin("DefaultEvictor", lambda store, args: DefaultEvictor(store))
+    register_plugin(
+        "RemovePodsViolatingNodeAffinity",
+        lambda store, args: RemovePodsViolatingNodeAffinity(store, args),
+    )
+    register_plugin(
+        "RemoveDuplicates", lambda store, args: RemoveDuplicates(store, args)
+    )
+    register_plugin(
+        "LowNodeLoad",
+        lambda store, args: _LowNodeLoadAdapter(
+            store, LowNodeLoadArgs(**args) if args else None
+        ),
+    )
+
+
+class _LowNodeLoadAdapter(BalancePlugin):
+    """BalancePlugin facade over the batched LowNodeLoad classifier (it
+    creates PodMigrationJob CRs; the migration controller evicts)."""
+
+    name = "LowNodeLoad"
+
+    def __init__(self, store: ObjectStore, args=None) -> None:
+        from koordinator_tpu.descheduler.lownodeload import LowNodeLoad
+
+        self.inner = LowNodeLoad(store, args)
+        self.handle = None
+
+    def balance(self, nodes, now: float) -> Status:
+        self.inner.balance(now)
+        return Status()
+
+
+register_defaults()

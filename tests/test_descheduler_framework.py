@@ -1,0 +1,190 @@
+"""Descheduler plugin framework: profiles, the four plugin interfaces, the
+defaultevictor chain, and the vendored-style plugins
+(ref pkg/descheduler/framework/types.go:32-110, profile/)."""
+
+import pytest
+
+from koordinator_tpu.api.objects import (
+    Node,
+    ObjectMeta,
+    Pod,
+    PodDisruptionBudget,
+    PodSpec,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client.store import (
+    KIND_NODE,
+    KIND_PDB,
+    KIND_POD,
+    ObjectStore,
+)
+from koordinator_tpu.descheduler.descheduler import Descheduler
+from koordinator_tpu.descheduler.framework import (
+    Profile,
+    ProfileConfig,
+    registered_plugins,
+)
+
+GIB = 1024**3
+NOW = 1_000_000.0
+
+
+def _node(store, name, labels=None, unschedulable=False):
+    store.add(KIND_NODE, Node(
+        meta=ObjectMeta(name=name, namespace="", labels=labels or {}),
+        allocatable=ResourceList.of(cpu=16000, memory=64 * GIB, pods=110),
+        unschedulable=unschedulable,
+    ))
+
+
+def _pod(store, name, node=None, owner=("ReplicaSet", "web"), selector=None,
+         labels=None, created=NOW - 100.0):
+    pod = Pod(
+        meta=ObjectMeta(name=name, labels=labels or {},
+                        owner_kind=owner[0] if owner else "",
+                        owner_name=owner[1] if owner else "",
+                        creation_timestamp=created),
+        spec=PodSpec(requests=ResourceList.of(cpu=1000, memory=GIB),
+                     node_selector=selector or {}),
+    )
+    if node:
+        pod.spec.node_name = node
+        pod.phase = "Running"
+    store.add(KIND_POD, pod)
+    return pod
+
+
+def test_builtin_plugins_registered():
+    names = registered_plugins()
+    for expect in ("DefaultEvictor", "LowNodeLoad", "RemoveDuplicates",
+                   "RemovePodsViolatingNodeAffinity"):
+        assert expect in names
+
+
+def test_unknown_plugin_rejected():
+    store = ObjectStore()
+    with pytest.raises(ValueError, match="not registered"):
+        Profile(ProfileConfig(deschedule=["NoSuchPlugin"]), store)
+
+
+class TestNodeAffinityPlugin:
+    def _store(self):
+        store = ObjectStore()
+        _node(store, "node-a", labels={"zone": "east"})
+        _node(store, "node-b", labels={"zone": "west"})
+        return store
+
+    def test_evicts_when_affinity_violated_and_alternative_exists(self):
+        store = self._store()
+        pod = _pod(store, "p", node="node-a", selector={"zone": "west"})
+        profile = Profile(ProfileConfig(
+            deschedule=["RemovePodsViolatingNodeAffinity"]), store)
+        profile.run(NOW)
+        assert store.get(KIND_POD, pod.meta.key).is_terminated
+
+    def test_keeps_pod_when_no_alternative(self):
+        store = self._store()
+        pod = _pod(store, "p", node="node-a", selector={"zone": "north"})
+        profile = Profile(ProfileConfig(
+            deschedule=["RemovePodsViolatingNodeAffinity"]), store)
+        profile.run(NOW)
+        assert not store.get(KIND_POD, pod.meta.key).is_terminated
+
+    def test_keeps_matching_pod(self):
+        store = self._store()
+        pod = _pod(store, "p", node="node-a", selector={"zone": "east"})
+        profile = Profile(ProfileConfig(
+            deschedule=["RemovePodsViolatingNodeAffinity"]), store)
+        profile.run(NOW)
+        assert not store.get(KIND_POD, pod.meta.key).is_terminated
+
+
+class TestRemoveDuplicates:
+    def test_extra_replicas_evicted(self):
+        store = ObjectStore()
+        _node(store, "node-a")
+        _node(store, "node-b")
+        oldest = _pod(store, "r0", node="node-a", created=NOW - 500)
+        _pod(store, "r1", node="node-a")
+        _pod(store, "r2", node="node-a")
+        profile = Profile(ProfileConfig(balance=["RemoveDuplicates"]), store)
+        profile.run(NOW)
+        survivors = [p for p in store.list(KIND_POD) if not p.is_terminated]
+        assert [p.meta.name for p in survivors] == ["r0"]
+        assert oldest.meta.key == survivors[0].meta.key
+
+    def test_single_node_cluster_untouched(self):
+        store = ObjectStore()
+        _node(store, "node-a")
+        _pod(store, "r0", node="node-a")
+        _pod(store, "r1", node="node-a")
+        profile = Profile(ProfileConfig(balance=["RemoveDuplicates"]), store)
+        profile.run(NOW)
+        assert all(not p.is_terminated for p in store.list(KIND_POD))
+
+    def test_no_eviction_when_no_other_node_matches(self):
+        """Duplicates pinned by selector to their node are left alone —
+        evicting them would only churn (scheduler puts them right back)."""
+        store = ObjectStore()
+        _node(store, "node-a", labels={"zone": "east"})
+        _node(store, "node-b", labels={"zone": "west"})
+        _pod(store, "r0", node="node-a", selector={"zone": "east"})
+        _pod(store, "r1", node="node-a", selector={"zone": "east"})
+        profile = Profile(ProfileConfig(balance=["RemoveDuplicates"]), store)
+        profile.run(NOW)
+        assert all(not p.is_terminated for p in store.list(KIND_POD))
+
+    def test_bare_pods_ignored(self):
+        store = ObjectStore()
+        _node(store, "node-a")
+        _node(store, "node-b")
+        _pod(store, "b0", node="node-a", owner=None)
+        _pod(store, "b1", node="node-a", owner=None)
+        profile = Profile(ProfileConfig(balance=["RemoveDuplicates"]), store)
+        profile.run(NOW)
+        assert all(not p.is_terminated for p in store.list(KIND_POD))
+
+
+class TestEvictorChain:
+    def test_pdb_blocks_through_handle(self):
+        """The profile Handle runs Filter -> PreEvictionFilter -> Evict;
+        a tight PDB stops the eviction."""
+        store = ObjectStore()
+        _node(store, "node-a")
+        _node(store, "node-b")
+        _pod(store, "r0", node="node-a", labels={"app": "web"})
+        _pod(store, "r1", node="node-a", labels={"app": "web"})
+        store.add(KIND_PDB, PodDisruptionBudget(
+            meta=ObjectMeta(name="pdb", namespace="default"),
+            selector={"app": "web"}, min_available=2))
+        profile = Profile(ProfileConfig(balance=["RemoveDuplicates"]), store)
+        profile.run(NOW)
+        assert all(not p.is_terminated for p in store.list(KIND_POD))
+
+
+class TestTwoProfiles:
+    def test_per_profile_plugin_sets(self):
+        """Two profiles with disjoint plugin sets both run in one pass."""
+        store = ObjectStore()
+        _node(store, "node-a", labels={"zone": "east"})
+        _node(store, "node-b", labels={"zone": "west"})
+        # affinity violation for profile 1
+        moved = _pod(store, "moved", node="node-a", selector={"zone": "west"},
+                     owner=("ReplicaSet", "api"))
+        # duplicates for profile 2
+        _pod(store, "r0", node="node-b", created=NOW - 500)
+        _pod(store, "r1", node="node-b")
+        desched = Descheduler(store, profiles=[
+            ProfileConfig(name="affinity",
+                          deschedule=["RemovePodsViolatingNodeAffinity"]),
+            ProfileConfig(name="dedupe", balance=["RemoveDuplicates"]),
+        ])
+        out = desched.run_once(now=NOW)
+        assert out["evicted"]["affinity"] == 1
+        assert out["evicted"]["dedupe"] == 1
+        assert store.get(KIND_POD, moved.meta.key).is_terminated
+        survivors = sorted(
+            p.meta.name for p in store.list(KIND_POD) if not p.is_terminated
+        )
+        assert survivors == ["r0"]
+        assert "affinity" in out["profiles"] and "dedupe" in out["profiles"]
