@@ -8,12 +8,14 @@ loops it on an interval)."""
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Optional
 
 from koordinator_tpu.client.store import ObjectStore
 from koordinator_tpu.koordlet.audit import Auditor
 from koordinator_tpu.koordlet.metriccache import MetricCache
+from koordinator_tpu.koordlet.server import KoordletServer
 from koordinator_tpu.koordlet.metricsadvisor import MetricsAdvisor
 from koordinator_tpu.koordlet.pleg import Pleg
 from koordinator_tpu.koordlet.prediction import PeakPredictServer
@@ -40,7 +42,18 @@ class Daemon:
             self.config.cgroup_driver = sysutil.detect_cgroup_driver(self.config)
         self.auditor = Auditor()
         self.executor = ResourceUpdateExecutor(self.config, self.auditor)
-        self.metric_cache = MetricCache()
+        # metriccache persists next to the prediction checkpoints so the
+        # NodeMetric aggregation window survives agent restarts
+        # (tsdb_storage.go:32-46)
+        metric_storage = (
+            os.path.join(checkpoint_dir, "metriccache.pkl")
+            if checkpoint_dir else None
+        )
+        from koordinator_tpu.koordlet.metrics import REGISTRY
+
+        self.metric_cache = MetricCache(storage_path=metric_storage)
+        self.api_server = KoordletServer(self.auditor,
+                                         metrics_registry=REGISTRY)
         self.states_informer = StatesInformer(
             store, node_name, self.metric_cache,
             report_interval_seconds=report_interval_seconds,
@@ -79,6 +92,7 @@ class Daemon:
         self.states_informer.sync_node_metric(now)
         self.qos_manager.run_once(now)
         self.runtime_hooks.reconcile()
+        self.metric_cache.maybe_flush(now)
 
     def run(self, interval_seconds: float = 10.0, max_ticks: Optional[int] = None) -> None:
         ticks = 0

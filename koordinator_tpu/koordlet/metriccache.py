@@ -1,15 +1,23 @@
 """Metric cache: the node-local TSDB + static-info KV store.
 
 Analog of reference `pkg/koordlet/metriccache/` (embedded Prometheus tsdb + gob
-KV, metric_cache.go:56-79): time-series keyed by (metric, labels) with windowed
-aggregate queries (avg/p50/p90/p95/p99/latest/count), bounded retention.
-Numpy-backed percentile math so the NodeMetric reporter's aggregated usages are
-consistent with the scheduler's percentile semantics.
+KV, metric_cache.go:56-79, tsdb_storage.go:32-46): time-series keyed by
+(metric, labels) with windowed aggregate queries
+(avg/p50/p90/p95/p99/latest/count), bounded retention. Numpy-backed percentile
+math so the NodeMetric reporter's aggregated usages are consistent with the
+scheduler's percentile semantics.
+
+Persistence: the reference's TSDB lives on disk and survives agent restarts;
+here an atomic pickle snapshot (tmp + rename) is written every
+flush_interval_seconds and restored on construction, so the NodeMetric
+aggregation window (and the static-info KV) carries across restarts.
 """
 
 from __future__ import annotations
 
 import bisect
+import os
+import pickle
 import threading
 import time
 from collections import deque
@@ -55,11 +63,84 @@ class SeriesKey:
 
 
 class MetricCache:
-    def __init__(self, retention_seconds: float = 1800.0):
+    def __init__(self, retention_seconds: float = 1800.0,
+                 storage_path: Optional[str] = None,
+                 flush_interval_seconds: float = 60.0):
         self.retention = retention_seconds
+        self.storage_path = storage_path
+        self.flush_interval = flush_interval_seconds
+        self._last_flush = 0.0
         self._lock = threading.RLock()
         self._series: Dict[SeriesKey, Deque[Tuple[float, float]]] = {}
         self._kv: Dict[str, Any] = {}
+        if storage_path:
+            self._restore()
+
+    # -- persistence (tsdb_storage.go analog) --------------------------------
+    def _restore(self) -> None:
+        # a bad snapshot must never crash-loop agent startup: ANY failure
+        # (unpickling, moved classes -> AttributeError, malformed keys ->
+        # TypeError) degrades to an empty cache, as the reference does when
+        # the TSDB dir is unusable
+        try:
+            with open(self.storage_path, "rb") as f:
+                snap = pickle.load(f)
+            series = snap.get("series", {})
+            # retention anchored to the newest persisted sample, not wall
+            # clock: keeps the window intact across clock skew and makes
+            # restore deterministic for replayed timelines; add_sample prunes
+            # from there
+            latest = max(
+                (pts[-1][0] for pts in series.values() if pts), default=0.0
+            )
+            cutoff = latest - self.retention
+            restored = {}
+            for key_parts, points in series.items():
+                kept = [(ts, v) for ts, v in points if ts >= cutoff]
+                if kept:
+                    restored[SeriesKey(*key_parts)] = deque(kept)
+            kv = dict(snap.get("kv", {}))
+        except Exception:
+            return
+        with self._lock:
+            self._series.update(restored)
+            self._kv.update(kv)
+
+    def flush(self, now: Optional[float] = None) -> bool:
+        """Atomic snapshot to disk (tmp + rename): a crash mid-write never
+        corrupts the previous snapshot. I/O failures (disk full, unwritable
+        dir) are swallowed — persistence is best-effort and must never kill
+        the agent loop; _last_flush still advances so a bad disk isn't
+        retried every tick."""
+        if not self.storage_path:
+            return False
+        now = time.time() if now is None else now
+        with self._lock:
+            snap = {
+                "series": {
+                    (k.metric, k.labels): list(q)
+                    for k, q in self._series.items()
+                },
+                "kv": dict(self._kv),
+            }
+            self._last_flush = now
+        tmp = self.storage_path + ".tmp"
+        try:
+            os.makedirs(os.path.dirname(self.storage_path) or ".", exist_ok=True)
+            with open(tmp, "wb") as f:
+                pickle.dump(snap, f)
+            os.replace(tmp, self.storage_path)
+        except OSError:
+            return False
+        return True
+
+    def maybe_flush(self, now: Optional[float] = None) -> bool:
+        """Periodic flush hook for the daemon loop."""
+        now = time.time() if now is None else now
+        if not self.storage_path or now - self._last_flush < self.flush_interval:
+            return False
+        self.flush(now)
+        return True
 
     # -- samples -------------------------------------------------------------
     def add_sample(self, metric: str, value: float,

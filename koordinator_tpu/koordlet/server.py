@@ -1,0 +1,89 @@
+"""Koordlet node API server: audit query + metrics + health.
+
+Analog of reference `pkg/koordlet/audit/auditor.go:130-246` (HTTP query with
+opaque-token paging, ?size= page control) plus the agent's metrics/healthz
+endpoints. Routing core is `handle(path, query)` so tests drive it without
+sockets; `serve()` wraps it in a ThreadingHTTPServer.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from koordinator_tpu.koordlet.audit import Auditor
+
+
+class KoordletServer:
+    def __init__(self, auditor: Auditor, metrics_registry=None):
+        self.auditor = auditor
+        self.metrics_registry = metrics_registry
+
+    # -- routing core ---------------------------------------------------
+    def handle(self, path: str, query: Optional[Dict[str, str]] = None
+               ) -> Tuple[int, str, str]:
+        """(status, content_type, body)."""
+        query = query or {}
+        parts = [p for p in path.split("/") if p]
+        if parts == ["healthz"]:
+            return 200, "text/plain", "ok"
+        if parts == ["apis", "v1", "audit"]:
+            return self._audit(query)
+        if parts == ["metrics"] and self.metrics_registry is not None:
+            return 200, "text/plain; version=0.0.4", self.metrics_registry.expose()
+        return 404, "text/plain", f"unknown path {path!r}"
+
+    def _audit(self, query: Dict[str, str]) -> Tuple[int, str, str]:
+        """Token-paged audit events (auditor.go:130-246): ?token=&size=.
+        The response carries next_token; an empty page returns the same token
+        so pollers can resume."""
+        try:
+            token = int(query.get("token", "0") or "0")
+            size = max(0, min(int(query.get("size", "100") or "100"), 1000))
+        except ValueError:
+            return 400, "text/plain", "token/size must be integers"
+        events, next_token = self.auditor.query(token=token, limit=size)
+        body = json.dumps({
+            "events": [
+                {
+                    "seq": e.seq,
+                    "timestamp": e.timestamp,
+                    "level": e.level,
+                    "group": e.group,
+                    "operation": e.operation,
+                    "detail": e.detail,
+                }
+                for e in events
+            ],
+            "next_token": next_token,
+        })
+        return 200, "application/json", body
+
+    # -- live server ----------------------------------------------------
+    def serve(self, port: int = 0):
+        """Start the HTTP server; returns (server, thread)."""
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                url = urlparse(self.path)
+                q = {k: v[0] for k, v in parse_qs(url.query).items()}
+                status, ctype, body = outer.handle(url.path, q)
+                payload = body.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, fmt, *args):  # silence
+                pass
+
+        server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        return server, thread

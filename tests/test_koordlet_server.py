@@ -1,0 +1,131 @@
+"""Metriccache persistence (tsdb_storage.go analog) and the koordlet API
+server's token-paged audit endpoint (auditor.go:130-246)."""
+
+import json
+import urllib.request
+
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.audit import Auditor
+from koordinator_tpu.koordlet.metriccache import MetricCache
+from koordinator_tpu.koordlet.server import KoordletServer
+
+NOW = 1_000_000.0
+
+
+class TestMetricCachePersistence:
+    def test_restart_keeps_aggregation_window(self, tmp_path):
+        path = str(tmp_path / "metriccache.pkl")
+        cache = MetricCache(storage_path=path)
+        for i in range(10):
+            cache.add_sample(mc.NODE_CPU_USAGE, 4.0 + i * 0.1,
+                            timestamp=NOW - 100 + i * 10)
+        cache.set_kv(mc.NODE_CPU_INFO_KEY, {"cores": 16})
+        cache.flush(now=NOW)
+
+        # simulated agent restart
+        cache2 = MetricCache(storage_path=path)
+        p95 = cache2.query(mc.NODE_CPU_USAGE, "p95", window=300, now=NOW)
+        p95_orig = cache.query(mc.NODE_CPU_USAGE, "p95", window=300, now=NOW)
+        assert p95 == p95_orig
+        assert cache2.get_kv(mc.NODE_CPU_INFO_KEY) == {"cores": 16}
+
+    def test_restore_drops_expired_samples(self, tmp_path):
+        """Restore-time pruning: flush with a LARGE retention (both samples
+        survive in the snapshot), restore with a SMALL one — the restore
+        cutoff (newest sample - retention) must drop the old point."""
+        path = str(tmp_path / "metriccache.pkl")
+        cache = MetricCache(storage_path=path, retention_seconds=10_000)
+        cache.add_sample(mc.NODE_CPU_USAGE, 1.0, timestamp=NOW - 3000)
+        cache.add_sample(mc.NODE_CPU_USAGE, 2.0, timestamp=NOW)
+        cache.flush(now=NOW)
+        assert cache._values(mc.NODE_CPU_USAGE, None, None) == [1.0, 2.0]
+        cache2 = MetricCache(storage_path=path, retention_seconds=60)
+        vals = cache2._values(mc.NODE_CPU_USAGE, None, None)
+        assert vals == [2.0]
+
+    def test_flush_failure_never_raises(self, tmp_path):
+        """Disk trouble degrades to a skipped snapshot, not an agent crash."""
+        blocker = tmp_path / "blocker"
+        blocker.write_text("a file where a directory is needed")
+        cache = MetricCache(storage_path=str(blocker / "m.pkl"))
+        cache.add_sample(mc.NODE_CPU_USAGE, 1.0, timestamp=NOW)
+        assert cache.flush(now=NOW) is False
+
+    def test_negative_size_clamped(self):
+        auditor = Auditor()
+        for i in range(5):
+            auditor.record("info", "node", "w")
+        server = KoordletServer(auditor)
+        status, _, body = server.handle("/apis/v1/audit", {"size": "-1"})
+        assert status == 200
+        assert json.loads(body)["events"] == []
+
+    def test_corrupt_snapshot_ignored(self, tmp_path):
+        path = str(tmp_path / "metriccache.pkl")
+        with open(path, "wb") as f:
+            f.write(b"not a pickle")
+        cache = MetricCache(storage_path=path)  # must not raise
+        assert cache.query(mc.NODE_CPU_USAGE) is None
+
+    def test_maybe_flush_interval(self, tmp_path):
+        path = str(tmp_path / "metriccache.pkl")
+        cache = MetricCache(storage_path=path, flush_interval_seconds=60)
+        cache.add_sample(mc.NODE_CPU_USAGE, 1.0, timestamp=NOW)
+        assert cache.maybe_flush(now=NOW) is True
+        assert cache.maybe_flush(now=NOW + 10) is False
+        assert cache.maybe_flush(now=NOW + 61) is True
+
+
+class TestAuditEndpoint:
+    def _server(self):
+        auditor = Auditor()
+        for i in range(5):
+            auditor.record("info", "node", "cgroup_write",
+                           file=f"/sys/fs/cgroup/f{i}", value=str(i))
+        return KoordletServer(auditor), auditor
+
+    def test_token_paging(self):
+        server, _ = self._server()
+        status, ctype, body = server.handle("/apis/v1/audit", {"size": "2"})
+        assert status == 200 and ctype == "application/json"
+        page1 = json.loads(body)
+        assert [e["seq"] for e in page1["events"]] == [1, 2]
+        token = page1["next_token"]
+        _, _, body2 = server.handle(
+            "/apis/v1/audit", {"token": str(token), "size": "2"})
+        page2 = json.loads(body2)
+        assert [e["seq"] for e in page2["events"]] == [3, 4]
+        # exhausted page returns same token so pollers can resume
+        _, _, body3 = server.handle(
+            "/apis/v1/audit", {"token": "5", "size": "2"})
+        page3 = json.loads(body3)
+        assert page3["events"] == [] and page3["next_token"] == 5
+
+    def test_bad_params(self):
+        server, _ = self._server()
+        status, _, _ = server.handle("/apis/v1/audit", {"token": "x"})
+        assert status == 400
+
+    def test_unknown_path_404(self):
+        server, _ = self._server()
+        status, _, _ = server.handle("/apis/v1/nothing", {})
+        assert status == 404
+
+    def test_live_http_roundtrip(self):
+        """Real socket: curl-able audit page."""
+        server, auditor = self._server()
+        httpd, thread = server.serve(port=0)
+        try:
+            port = httpd.server_address[1]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/apis/v1/audit?size=3", timeout=5
+            ) as resp:
+                page = json.loads(resp.read())
+            assert len(page["events"]) == 3
+            assert page["events"][0]["operation"] == "cgroup_write"
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5
+            ) as resp:
+                assert resp.read() == b"ok"
+        finally:
+            httpd.shutdown()
