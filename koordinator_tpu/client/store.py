@@ -49,6 +49,7 @@ KIND_COLOCATION_PROFILE = "ClusterColocationProfile"
 KIND_QUOTA_PROFILE = "ElasticQuotaProfile"
 KIND_CONFIG_MAP = "ConfigMap"
 KIND_PDB = "PodDisruptionBudget"
+KIND_LEASE = "Lease"  # coordination.k8s.io leader-election lease
 
 ALL_KINDS = (
     KIND_POD,
@@ -65,6 +66,7 @@ ALL_KINDS = (
     KIND_QUOTA_PROFILE,
     KIND_CONFIG_MAP,
     KIND_PDB,
+    KIND_LEASE,
 )
 
 

@@ -1,0 +1,138 @@
+"""Leader election: only the lease holder runs control loops; a standby takes
+over when the leader stops renewing (ref cmd/koord-scheduler/app/server.go:227-256)."""
+
+from koordinator_tpu.api.objects import Node, NodeMetric, NodeMetricInfo, ObjectMeta
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client.leaderelection import (
+    ElectedRunner,
+    Lease,
+    LeaderElector,
+)
+from koordinator_tpu.client.store import (
+    KIND_LEASE,
+    KIND_NODE,
+    KIND_NODE_METRIC,
+    ObjectStore,
+)
+
+NOW = 1_000_000.0
+GIB = 1024**3
+
+
+def _electors(store, n=2, duration=15.0, **kw):
+    return [
+        LeaderElector(store, "koord-scheduler", f"replica-{i}",
+                      lease_duration_seconds=duration, **kw)
+        for i in range(n)
+    ]
+
+
+class TestLeaderElector:
+    def test_first_tick_acquires(self):
+        store = ObjectStore()
+        a, b = _electors(store)
+        assert a.tick(NOW) is True
+        assert b.tick(NOW) is False
+        lease = store.get(KIND_LEASE, "/koord-scheduler")
+        assert lease.holder_identity == "replica-0"
+
+    def test_leader_renews_and_standby_waits(self):
+        store = ObjectStore()
+        a, b = _electors(store)
+        a.tick(NOW)
+        for t in range(1, 10):
+            assert a.tick(NOW + t) is True
+            assert b.tick(NOW + t) is False
+        assert store.get(KIND_LEASE, "/koord-scheduler").renew_time == NOW + 9
+
+    def test_failover_on_lease_expiry(self):
+        store = ObjectStore()
+        a, b = _electors(store, duration=15.0)
+        a.tick(NOW)
+        # leader dies (stops ticking); standby keeps polling
+        assert b.tick(NOW + 10) is False          # not yet expired
+        assert b.tick(NOW + 16) is True           # took over
+        lease = store.get(KIND_LEASE, "/koord-scheduler")
+        assert lease.holder_identity == "replica-1"
+        assert lease.lease_transitions == 1
+        # the old leader comes back: renew CAS fails, it demotes itself
+        assert a.tick(NOW + 17) is False
+
+    def test_voluntary_release_hands_off_immediately(self):
+        store = ObjectStore()
+        a, b = _electors(store)
+        a.tick(NOW)
+        a.release(NOW + 1)
+        assert a.is_leader is False
+        assert b.tick(NOW + 1) is True
+
+    def test_callbacks_fire_on_transitions(self):
+        store = ObjectStore()
+        events = []
+        a = LeaderElector(store, "l", "a",
+                          lease_duration_seconds=10,
+                          on_started_leading=lambda: events.append("a-start"),
+                          on_stopped_leading=lambda: events.append("a-stop"))
+        b = LeaderElector(store, "l", "b", lease_duration_seconds=10,
+                          on_started_leading=lambda: events.append("b-start"))
+        a.tick(NOW)
+        b.tick(NOW)
+        b.tick(NOW + 11)   # takes over
+        a.tick(NOW + 12)   # discovers loss
+        assert events == ["a-start", "b-start", "a-stop"]
+
+
+class TestElectedScheduler:
+    """Two Scheduler instances, one store: only the leader runs cycles;
+    failover moves the cycle-running to the standby."""
+
+    def _store(self):
+        store = ObjectStore()
+        store.add(KIND_NODE, Node(
+            meta=ObjectMeta(name="node-0", namespace=""),
+            allocatable=ResourceList.of(cpu=16000, memory=64 * GIB, pods=110)))
+        store.add(KIND_NODE_METRIC, NodeMetric(
+            meta=ObjectMeta(name="node-0", namespace=""),
+            update_time=NOW - 10,
+            node_metric=NodeMetricInfo(
+                node_usage=ResourceList.of(cpu=1000, memory=GIB))))
+        return store
+
+    def test_only_leader_schedules_and_failover_works(self):
+        from koordinator_tpu.api.objects import (
+            LABEL_POD_QOS, Pod, PodSpec)
+        from koordinator_tpu.client.store import KIND_POD
+        from koordinator_tpu.scheduler.cycle import Scheduler
+
+        store = self._store()
+        sched_a = Scheduler(store)
+        sched_b = Scheduler(store)
+        runner_a = ElectedRunner(
+            LeaderElector(store, "koord-scheduler", "a",
+                          lease_duration_seconds=15),
+            lambda now: sched_a.run_cycle(now=now))
+        runner_b = ElectedRunner(
+            LeaderElector(store, "koord-scheduler", "b",
+                          lease_duration_seconds=15),
+            lambda now: sched_b.run_cycle(now=now))
+
+        def pend(name):
+            store.add(KIND_POD, Pod(
+                meta=ObjectMeta(name=name, labels={LABEL_POD_QOS: "LS"},
+                                creation_timestamp=NOW),
+                spec=PodSpec(priority=9500,
+                             requests=ResourceList.of(cpu=1000, memory=GIB))))
+
+        pend("p0")
+        assert runner_a.tick(NOW) is True
+        assert runner_b.tick(NOW) is False
+        assert store.get(KIND_POD, "default/p0").is_assigned
+        assert (runner_a.runs, runner_b.runs) == (1, 0)
+
+        # replica A dies; B picks up the next pod after the lease expires
+        pend("p1")
+        assert runner_b.tick(NOW + 5) is False
+        assert not store.get(KIND_POD, "default/p1").is_assigned
+        assert runner_b.tick(NOW + 20) is True
+        assert store.get(KIND_POD, "default/p1").is_assigned
+        assert runner_b.runs == 1
