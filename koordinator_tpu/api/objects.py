@@ -128,6 +128,14 @@ class Pod:
     def is_terminated(self) -> bool:
         return self.phase in ("Succeeded", "Failed")
 
+    @property
+    def is_healthy(self) -> bool:
+        """policy/v1 currentHealthy counts pods with the Ready condition;
+        here that means scheduled and Running — a Pending/unassigned pod must
+        NOT shore up a PodDisruptionBudget (disruption controller,
+        pkg/controller/disruption in upstream k8s)."""
+        return self.is_assigned and self.phase == "Running"
+
 
 @dataclass
 class Node:

@@ -111,17 +111,21 @@ def check_pdbs(store: ObjectStore, pod: Pod) -> Optional[str]:
     ]
     if not pdbs:
         return None
+    # evicting an already-unhealthy pod consumes no budget: the healthy
+    # count does not drop and the unavailable count does not grow
+    cost = 1 if pod.is_healthy else 0
     for pdb in pdbs:
         matching = [p for p in store.list(KIND_POD) if pdb.matches(p)]
-        healthy = sum(1 for p in matching if not p.is_terminated)
-        if pdb.min_available is not None and healthy - 1 < pdb.min_available:
-            return (f"pdb {pdb.meta.key}: healthy {healthy}-1 < "
+        healthy = sum(1 for p in matching if p.is_healthy)
+        if (pdb.min_available is not None
+                and healthy - cost < pdb.min_available):
+            return (f"pdb {pdb.meta.key}: healthy {healthy}-{cost} < "
                     f"minAvailable {pdb.min_available}")
         if pdb.max_unavailable is not None:
             unavailable = len(matching) - healthy
-            if unavailable + 1 > pdb.max_unavailable:
-                return (f"pdb {pdb.meta.key}: unavailable {unavailable}+1 > "
-                        f"maxUnavailable {pdb.max_unavailable}")
+            if unavailable + cost > pdb.max_unavailable:
+                return (f"pdb {pdb.meta.key}: unavailable {unavailable}+{cost}"
+                        f" > maxUnavailable {pdb.max_unavailable}")
     return None
 
 

@@ -20,6 +20,18 @@ def _lk(labels: Dict[str, str]) -> _LabelKey:
     return tuple(sorted(labels.items()))
 
 
+def _escape_label(value: str) -> str:
+    """Prometheus exposition: label values escape backslash, double-quote and
+    line-feed (exposition_formats spec; client_golang expfmt.go)."""
+    return (str(value).replace("\\", "\\\\")
+            .replace('"', '\\"').replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    """HELP lines escape backslash and line-feed only (quote is label-only)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 class _Metric:
     def __init__(self, name: str, help_text: str, kind: str):
         self.name = name
@@ -100,12 +112,13 @@ class Registry:
         lines: List[str] = []
         for m in metrics:
             if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
+                lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
             lines.append(f"# TYPE {m.name} {m.kind}")
             for labels, value in m.samples():
                 if labels:
                     body = ",".join(
-                        f'{k}="{v}"' for k, v in sorted(labels.items()))
+                        f'{k}="{_escape_label(v)}"'
+                        for k, v in sorted(labels.items()))
                     lines.append(f"{m.name}{{{body}}} {value:g}")
                 else:
                     lines.append(f"{m.name} {value:g}")

@@ -146,7 +146,7 @@ class QuotaPreemptor:
         allowed: Dict[int, int] = {}
         for i, pdb in enumerate(pdbs):
             matching = [p for p in pods if pdb.matches(p)]
-            healthy = sum(1 for p in matching if not p.is_terminated)
+            healthy = sum(1 for p in matching if p.is_healthy)
             if pdb.min_available is not None:
                 allowed[i] = healthy - pdb.min_available
             elif pdb.max_unavailable is not None:
@@ -158,7 +158,9 @@ class QuotaPreemptor:
         for pod in ordered:
             violated = False
             for i, pdb in enumerate(pdbs):
-                if not pdb.matches(pod):
+                # an unhealthy victim consumes no budget and can never
+                # violate: evicting it leaves the healthy count unchanged
+                if not pdb.matches(pod) or not pod.is_healthy:
                     continue
                 allowed[i] -= 1
                 if allowed[i] < 0:

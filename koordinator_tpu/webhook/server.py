@@ -23,6 +23,7 @@ mutators then validators; store helpers in tests call it before add/update
 from __future__ import annotations
 
 import json
+import math
 from typing import Callable, Dict, List, Optional
 
 from koordinator_tpu.api.objects import (
@@ -287,6 +288,10 @@ class AdmissionServer:
             ratios = json.loads(raw_ratio)
         except ValueError:
             raise AdmissionError("resource-amplification-ratio is not JSON")
+        if not isinstance(ratios, dict):
+            raise AdmissionError(
+                "resource-amplification-ratio must be a JSON object of "
+                "resource name to ratio")
         # old-vs-new compares whatever the cluster stored (amplified) against
         # the incoming values; a kubelet raw update that happens to equal the
         # old amplified value is missed — the reference has the identical
@@ -302,10 +307,19 @@ class AdmissionServer:
         original = json.loads(ann[self.RAW_ALLOCATABLE_ANNOTATION])
         for name in self._AMPLIFIABLE:
             ratio = ratios.get(name)
-            if ratio is None or float(ratio) <= 1 or name not in original:
+            if ratio is None:
                 continue
-            node.allocatable.quantities[name] = int(
-                original[name] * float(ratio))
+            try:
+                ratio = float(ratio)
+            except (TypeError, ValueError):
+                raise AdmissionError(
+                    f"resource-amplification-ratio[{name}] is not a number")
+            if not math.isfinite(ratio):
+                raise AdmissionError(
+                    f"resource-amplification-ratio[{name}] is not finite")
+            if ratio <= 1 or name not in original:
+                continue
+            node.allocatable.quantities[name] = int(original[name] * ratio)
 
     def validate_node(self, node: Node) -> None:
         raw = node.meta.annotations.get("node.koordinator.sh/cpu-normalization-ratio")

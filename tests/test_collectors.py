@@ -176,6 +176,21 @@ class TestMetricsRegistry:
         assert "# TYPE test_gauge gauge" in text
         assert 'test_counter{reason="mem"} 2' in text
 
+    def test_label_value_escaping(self):
+        # exposition format: backslash, double-quote and newline in label
+        # values must be escaped or the scrape output is invalid
+        reg = km.Registry()
+        g = reg.gauge("esc_gauge")
+        g.set(1.0, pod='ns/we"ird\\pod\nx')
+        text = reg.expose()
+        assert 'pod="ns/we\\"ird\\\\pod\\nx"' in text
+        assert "\n" not in text.split("esc_gauge{", 1)[1].split("}", 1)[0]
+        # HELP lines escape backslash and newline too
+        reg.gauge("esc_help", "multi\nline \\help")
+        help_line = [l for l in reg.expose().splitlines()
+                     if l.startswith("# HELP esc_help")][0]
+        assert help_line == "# HELP esc_help multi\\nline \\\\help"
+
     def test_reregistration_returns_same_metric(self):
         reg = km.Registry()
         g1 = reg.gauge("g")

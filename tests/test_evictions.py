@@ -121,6 +121,43 @@ class TestPDB:
         store.add(KIND_POD, outsider)
         assert check_pdbs(store, outsider) is None
 
+    def test_pending_pods_are_not_healthy(self):
+        # 2 running + 2 pending, minAvailable=2: policy/v1 counts only ready
+        # pods as healthy, so evicting a running pod must be blocked even
+        # though 4 pods are "not terminated".
+        store = self._store(2, min_available=2)
+        for i in range(2):
+            store.add(KIND_POD, mk_pod(f"pend{i}", labels={"app": "web"},
+                                       phase="Pending", node=""))
+        pod = store.get(KIND_POD, "default/p0")
+        assert check_pdbs(store, pod) is not None
+
+    def test_unassigned_running_phase_not_healthy(self):
+        store = self._store(2, min_available=2)
+        # phase says Running but never scheduled: still not healthy
+        store.add(KIND_POD, mk_pod("ghost", labels={"app": "web"}, node=""))
+        pod = store.get(KIND_POD, "default/p0")
+        assert check_pdbs(store, pod) is not None
+
+    def test_evicting_unhealthy_pod_consumes_no_budget(self):
+        # 2 running + 1 pending, minAvailable=2: the pending victim does not
+        # lower the healthy count, so its eviction must be ALLOWED even
+        # though the budget has zero headroom
+        store = self._store(2, min_available=2)
+        pending = mk_pod("pend", labels={"app": "web"}, phase="Pending",
+                         node="")
+        store.add(KIND_POD, pending)
+        assert check_pdbs(store, pending) is None
+        # same for maxUnavailable: an already-unavailable victim adds nothing
+        # (2 running + 1 pending, maxUnavailable=1: the pending pod already
+        # uses the budget, so only its own zero-cost eviction is allowed)
+        store2 = self._store(2, max_unavailable=1)
+        pending2 = mk_pod("pend", labels={"app": "web"}, phase="Pending",
+                          node="")
+        store2.add(KIND_POD, pending2)
+        assert check_pdbs(store2, pending2) is None
+        assert check_pdbs(store2, store2.get(KIND_POD, "default/p0")) is not None
+
 
 class TestEvictorVariants:
     def test_delete_evictor_removes_pod_and_skips_pdb(self):

@@ -180,6 +180,33 @@ class TestQuotaPreemption:
         assert result.preempted_victims == ["default/expendable"]
         assert store.get(KIND_POD, "default/protected").phase == "Running"
 
+    def test_pending_pods_do_not_shore_up_pdb(self):
+        """policy/v1 healthy count: a Pending pod matching the PDB selector
+        must not be counted as healthy, so the budget is tighter than the raw
+        pod count suggests and the protected pod stays spared."""
+        store = _store()
+        _quota(store, cpu=2000)
+        sched = Scheduler(store)
+        _pod(store, "protected", cpu=1000, prio=6000, node="node-0",
+             labels={"app": "web"})
+        _pod(store, "expendable", cpu=1000, prio=6000, node="node-0")
+        # two Pending pods that match the selector; with the old
+        # not-terminated counting they would absorb the disruption budget
+        # and make "protected" look safely evictable
+        for i in range(2):
+            p = Pod(meta=ObjectMeta(
+                name=f"pending-{i}", labels={"app": "web"},
+                creation_timestamp=NOW),
+                spec=PodSpec(requests=ResourceList.of(cpu=100)))
+            store.add(KIND_POD, p)
+        store.add(KIND_PDB, PodDisruptionBudget(
+            meta=ObjectMeta(name="web-pdb", namespace="default"),
+            selector={"app": "web"}, min_available=1))
+        _pod(store, "high", cpu=1000, prio=9500)
+        result = sched.run_cycle(now=NOW)
+        assert result.preempted_victims == ["default/expendable"]
+        assert store.get(KIND_POD, "default/protected").phase == "Running"
+
     def test_no_preemption_when_nothing_can_help(self):
         """Even evicting every candidate cannot make room -> no eviction."""
         store = _store()

@@ -92,6 +92,30 @@ class TestNodeAmplification:
         with pytest.raises(AdmissionError):
             srv.mutate_node(node)
 
+    def test_non_object_json_rejected(self):
+        # valid JSON but not an object: must be an admission error, not an
+        # AttributeError escaping the handler
+        srv = AdmissionServer(ObjectStore())
+        node = mk_node(annotations={RATIO_ANN: json.dumps("5")})
+        with pytest.raises(AdmissionError):
+            srv.mutate_node(node)
+        node = mk_node(annotations={RATIO_ANN: json.dumps([2.0])})
+        with pytest.raises(AdmissionError):
+            srv.mutate_node(node)
+
+    def test_non_numeric_ratio_rejected(self):
+        srv = AdmissionServer(ObjectStore())
+        node = mk_node(annotations={RATIO_ANN: json.dumps({"cpu": "x"})})
+        with pytest.raises(AdmissionError):
+            srv.mutate_node(node)
+        for nonfinite in ("inf", "nan", 1e400):
+            node = mk_node(annotations={RATIO_ANN: json.dumps({"cpu": nonfinite})})
+            with pytest.raises(AdmissionError):
+                srv.mutate_node(node)
+        node = mk_node(annotations={RATIO_ANN: json.dumps({"cpu": None})})
+        srv.mutate_node(node)  # explicit null = no ratio for cpu
+        assert node.allocatable.get(ResourceName.CPU) == 16_000
+
 
 class TestQuotaTreeAffinity:
     def _setup(self):
