@@ -198,16 +198,42 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
 
 
 def build_best_full_chain_step(args: LoadAwareArgs, num_gangs: int,
-                               num_groups: int, active_axes=None):
+                               num_groups: int, active_axes=None,
+                               vmem_budget_bytes=None):
     """Backend-aware selector: the VMEM-resident Pallas kernel on TPU
     (ops/pallas_full_chain.py, ~20x the fori_loop at 10k x 5k), the XLA
-    step elsewhere. Same contract, bit-identical bindings."""
-    if jax.default_backend() == "tpu":
-        from koordinator_tpu.ops.pallas_full_chain import (
-            build_pallas_full_chain_step,
-        )
+    step elsewhere. Same contract, bit-identical bindings.
 
-        return build_pallas_full_chain_step(
-            args, num_gangs, num_groups, active_axes=active_axes)
-    return build_full_chain_step(args, num_gangs, num_groups,
-                                 active_axes=active_axes)
+    The Pallas kernel pins all node/NUMA/quota state in VMEM, so its reach
+    is bounded (~20k nodes at R=16, less with NUMA zones and quota groups);
+    past the budget the per-call dispatch degrades to the XLA step instead
+    of failing to compile. Shapes are static under jit, so the dispatch
+    happens at trace time and costs nothing per step."""
+    xla_step = build_full_chain_step(args, num_gangs, num_groups,
+                                     active_axes=active_axes)
+    if jax.default_backend() != "tpu":
+        return xla_step
+    from koordinator_tpu.ops import pallas_common as pc
+    from koordinator_tpu.ops.pallas_full_chain import (
+        build_pallas_full_chain_step,
+        estimate_vmem_bytes,
+    )
+
+    budget = (pc.vmem_budget_bytes() if vmem_budget_bytes is None
+              else vmem_budget_bytes)
+    pallas_step = build_pallas_full_chain_step(
+        args, num_gangs, num_groups, active_axes=active_axes)
+
+    def step(fc: FullChainInputs):
+        P, R = fc.base.fit_requests.shape
+        N = fc.base.allocatable.shape[0]
+        K = fc.numa_free.shape[1]
+        G = fc.quota_used.shape[0]
+        if estimate_vmem_bytes(N, R, K, G, P) <= budget:
+            step.last_backend = "pallas"
+            return pallas_step(fc)
+        step.last_backend = "xla"
+        return xla_step(fc)
+
+    step.last_backend = None
+    return step

@@ -182,15 +182,36 @@ def build_schedule_step(args: LoadAwareArgs, jit: bool = True):
     return jax.jit(step) if jit else step
 
 
-def build_best_schedule_step(args: LoadAwareArgs):
+def build_best_schedule_step(args: LoadAwareArgs, vmem_budget_bytes=None):
     """Backend-aware selector: the VMEM-resident Pallas kernel on TPU
     (ops/pallas_step.py, ~3x the fori_loop at 10k x 5k), the XLA step
-    elsewhere. Same contract, bit-identical bindings."""
-    if jax.default_backend() == "tpu":
-        from koordinator_tpu.ops.pallas_step import build_pallas_schedule_step
+    elsewhere. Same contract, bit-identical bindings. Past the kernel's
+    VMEM budget the per-call dispatch degrades to the XLA step instead of
+    failing to compile (see build_best_full_chain_step)."""
+    xla_step = build_schedule_step(args)
+    if jax.default_backend() != "tpu":
+        return xla_step
+    from koordinator_tpu.ops import pallas_common as pc
+    from koordinator_tpu.ops.pallas_step import (
+        build_pallas_schedule_step,
+        estimate_vmem_bytes,
+    )
 
-        return build_pallas_schedule_step(args)
-    return build_schedule_step(args)
+    budget = (pc.vmem_budget_bytes() if vmem_budget_bytes is None
+              else vmem_budget_bytes)
+    pallas_step = build_pallas_schedule_step(args)
+
+    def step(inputs):
+        P, R = inputs.fit_requests.shape
+        N = inputs.allocatable.shape[0]
+        if estimate_vmem_bytes(N, R, P) <= budget:
+            step.last_backend = "pallas"
+            return pallas_step(inputs)
+        step.last_backend = "xla"
+        return xla_step(inputs)
+
+    step.last_backend = None
+    return step
 
 
 def build_score_matrix(args: LoadAwareArgs, jit: bool = True):

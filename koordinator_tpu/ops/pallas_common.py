@@ -19,6 +19,22 @@ from jax.experimental.pallas import tpu as pltpu
 
 MAX_NODE_SCORE = 100.0
 
+# Per-core VMEM the sequential kernels may pin (TPU v4/v5e expose ~16 MiB
+# of VMEM per TensorCore; leave headroom for Mosaic's own spills and the
+# grid machinery). The backend selectors fall back to the XLA step past
+# this. Override with KOORD_TPU_VMEM_BUDGET_BYTES for chips with more VMEM.
+DEFAULT_VMEM_BUDGET_BYTES = 14 * 1024 * 1024
+
+
+def vmem_budget_bytes() -> int:
+    import os
+
+    raw = os.environ.get("KOORD_TPU_VMEM_BUDGET_BYTES", "")
+    try:
+        return int(raw) if raw else DEFAULT_VMEM_BUDGET_BYTES
+    except ValueError:
+        return DEFAULT_VMEM_BUDGET_BYTES
+
 
 def weight_consts(weights: np.ndarray) -> List[Tuple[int, float]]:
     """Static (axis, weight) pairs baked into the kernel as Python floats —

@@ -39,6 +39,21 @@ from koordinator_tpu.ops.gang import gang_permit_mask
 from koordinator_tpu.ops.loadaware import LoadAwareArgs
 from koordinator_tpu.ops.numa import POLICY_NONE, POLICY_SINGLE_NUMA_NODE
 
+def estimate_vmem_bytes(N: int, R: int, K: int, G: int, P: int) -> int:
+    """Upper-bound VMEM footprint of one pallas_call of the full-chain
+    kernel, mirroring the in/out/scratch specs below: 3 [R, P_pad] pod
+    columns, 7 [R, N] node buffers, 2 [K*R, N] NUMA buffers, 10 [1, N]
+    rows, quota state (3 [R, G_lane] + [max(G,8), G_lane]) and the chosen
+    output, all f32. Used by models.full_chain.build_best_full_chain_step
+    to fall back to the XLA step when the state would not fit on-chip."""
+    P_pad = -(-P // 8) * 8
+    G_eff = max(G, 1)
+    G_lane = max(128, -(-G_eff // 128) * 128)
+    floats = (3 * R * P_pad + 7 * R * N + 2 * K * R * N + 10 * N
+              + 3 * R * G_lane + max(G_eff, 8) * G_lane + P_pad)
+    return 4 * floats
+
+
 def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
                  K: int, G: int):
     wsum = float(max(weights.sum(), 1.0))

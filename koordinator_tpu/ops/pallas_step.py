@@ -96,6 +96,17 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int):
     return kernel
 
 
+def estimate_vmem_bytes(N: int, R: int, P: int) -> int:
+    """Upper-bound VMEM footprint of one pallas_call of the schedule kernel:
+    2 [R, P_pad] pod-column inputs, 7 [R, N] node buffers (4 in + 1 out +
+    2 scratch), 4 [1, N] rows, and the [P_pad, 1] chosen output, all f32.
+    Used by models.scheduler_model.build_best_schedule_step to fall back to
+    the XLA step when the state would not fit on-chip."""
+    P_pad = -(-P // 8) * 8
+    floats = 2 * R * P_pad + 7 * R * N + 4 * N + P_pad
+    return 4 * floats
+
+
 def build_pallas_schedule_step(args: LoadAwareArgs, interpret: bool = False,
                                jit: bool = True):
     """ScheduleInputs -> (chosen [P] int32, requested [N, R] f32), same

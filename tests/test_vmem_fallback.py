@@ -1,0 +1,110 @@
+"""VMEM-budget fallback: the backend selectors must degrade to the XLA step
+when the Pallas kernel's VMEM-resident state would not fit on-chip, instead
+of failing to compile (the kernels pin all node/NUMA/quota state in VMEM —
+ops/pallas_step.py documents ~20k nodes at R=16 as the reach)."""
+
+import jax
+import numpy as np
+import pytest
+
+import koordinator_tpu.models.full_chain as fc_mod
+import koordinator_tpu.models.scheduler_model as sm_mod
+from koordinator_tpu.ops import pallas_common as pc
+from koordinator_tpu.ops.loadaware import LoadAwareArgs
+from koordinator_tpu.ops.pallas_full_chain import (
+    estimate_vmem_bytes as fc_vmem,
+)
+from koordinator_tpu.ops.pallas_step import estimate_vmem_bytes as step_vmem
+from koordinator_tpu.scheduler.snapshot import build_full_chain_inputs
+from koordinator_tpu.testing import synth_full_cluster
+
+
+class TestEstimates:
+    def test_flagship_shape_fits_default_budget(self):
+        # the headline bench config (10k pods x 5k nodes, R=16, K=2,
+        # G=64) must stay on the Pallas path
+        assert fc_vmem(5_000, 16, 2, 64, 10_000) <= pc.DEFAULT_VMEM_BUDGET_BYTES
+        assert step_vmem(5_000, 16, 10_000) <= pc.DEFAULT_VMEM_BUDGET_BYTES
+
+    def test_50k_nodes_exceeds_default_budget(self):
+        assert fc_vmem(50_000, 16, 2, 64, 10_000) > pc.DEFAULT_VMEM_BUDGET_BYTES
+
+    def test_monotonic_in_every_dim(self):
+        base = fc_vmem(1_000, 16, 2, 64, 2_000)
+        assert fc_vmem(2_000, 16, 2, 64, 2_000) > base
+        assert fc_vmem(1_000, 32, 2, 64, 2_000) > base
+        assert fc_vmem(1_000, 16, 4, 64, 2_000) > base
+        assert fc_vmem(1_000, 16, 2, 300, 2_000) > base
+        assert fc_vmem(1_000, 16, 2, 64, 4_000) > base
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("KOORD_TPU_VMEM_BUDGET_BYTES", "123456")
+        assert pc.vmem_budget_bytes() == 123456
+        monkeypatch.setenv("KOORD_TPU_VMEM_BUDGET_BYTES", "not-a-number")
+        assert pc.vmem_budget_bytes() == pc.DEFAULT_VMEM_BUDGET_BYTES
+
+
+class TestDispatch:
+    """Force the TPU selection path on CPU and check which step runs."""
+
+    def _inputs(self):
+        args = LoadAwareArgs()
+        _, state = synth_full_cluster(16, 24, seed=3)
+        fc, *_, ng, ngroups = build_full_chain_inputs(state, args)
+        return args, fc, ng, ngroups
+
+    def test_over_budget_uses_xla_and_matches(self, monkeypatch):
+        args, fc, ng, ngroups = self._inputs()
+        monkeypatch.setattr(fc_mod.jax, "default_backend", lambda: "tpu")
+        step = fc_mod.build_best_full_chain_step(
+            args, ng, ngroups, vmem_budget_bytes=0)
+        chosen, req, qused = step(fc)
+        assert step.last_backend == "xla"
+        ref_chosen, ref_req, ref_qused = fc_mod.build_full_chain_step(
+            args, ng, ngroups)(fc)
+        np.testing.assert_array_equal(np.asarray(chosen),
+                                      np.asarray(ref_chosen))
+        np.testing.assert_allclose(np.asarray(req), np.asarray(ref_req),
+                                   atol=1e-3)
+
+    def test_under_budget_selects_pallas(self, monkeypatch):
+        args, fc, ng, ngroups = self._inputs()
+        monkeypatch.setattr(fc_mod.jax, "default_backend", lambda: "tpu")
+        import koordinator_tpu.ops.pallas_full_chain as pfc
+
+        calls = []
+        real_build = pfc.build_pallas_full_chain_step
+
+        def fake_build(*a, **kw):
+            real = real_build(*a, interpret=True, **kw)
+            return lambda x: calls.append(1) or real(x)
+
+        monkeypatch.setattr(
+            "koordinator_tpu.ops.pallas_full_chain."
+            "build_pallas_full_chain_step", fake_build)
+        step = fc_mod.build_best_full_chain_step(
+            args, ng, ngroups, vmem_budget_bytes=1 << 40)
+        step(fc)
+        assert step.last_backend == "pallas" and calls
+
+    def test_schedule_step_over_budget_uses_xla(self, monkeypatch):
+        from koordinator_tpu.ops.loadaware import build_loadaware_node_state
+        from koordinator_tpu.ops.packing import pack_nodes, pack_pods
+        from koordinator_tpu.testing import synth_cluster
+
+        args = LoadAwareArgs()
+        cluster = synth_cluster(num_nodes=16, num_pods=24, seed=7)
+        pods = pack_pods(cluster.pods, args.resource_weights,
+                         args.estimated_scaling_factors)
+        nodes = pack_nodes(cluster.nodes)
+        nodes.extras = build_loadaware_node_state(
+            cluster.nodes, cluster.node_metrics, cluster.pods_by_key,
+            cluster.assigned, args, cluster.now, pad_to=nodes.padded_size)
+        inputs = sm_mod.make_inputs(pods, nodes, args)
+        monkeypatch.setattr(sm_mod.jax, "default_backend", lambda: "tpu")
+        step = sm_mod.build_best_schedule_step(args, vmem_budget_bytes=0)
+        chosen, req = step(inputs)
+        assert step.last_backend == "xla"
+        ref_chosen, _ = sm_mod.build_schedule_step(args)(inputs)
+        np.testing.assert_array_equal(np.asarray(chosen),
+                                      np.asarray(ref_chosen))
