@@ -1,0 +1,136 @@
+"""ctypes binding for the compiled serial scheduling floor (libkoordfloor.so).
+
+`serial_schedule_full_native(fc, args)` runs the same full-chain serial loop
+as `scheduler/parity.py::serial_schedule_full` — the scalar transcription of
+the reference's per-pod Go chain — but compiled (g++ -O2, no FMA/fast-math so
+float32 results stay bit-identical to numpy). bench.py times it on the same
+packed trace as the TPU step and reports `vs_compiled_floor`: an honest
+order-of-magnitude proxy for the reference's serial Go scheduler, which is
+not runnable in this environment.
+
+Build with `make -C koordinator_tpu/native` (or `build()` here); if the
+library is missing, `available()` is False and callers fall back to the
+numpy oracle.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_LIB_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_LIB_DIR, "libkoordfloor.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+_F32P = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_I32P = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+
+
+def build(timeout: int = 120) -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", _LIB_DIR, "-s", "libkoordfloor.so"],
+            check=True, capture_output=True, timeout=timeout)
+        return True
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.koord_serial_full_chain.restype = None
+    lib.koord_serial_full_chain.argtypes = (
+        [ctypes.c_int] * 8           # P R N K G A NG prod_mode
+        + [_F32P] * 3                # fit_requests requests estimated
+        + [_I32P] * 7                # is_prod..needs_bind
+        + [_F32P] + [_I32P]          # cores_needed full_pcpus
+        + [_F32P, _F32P] + [_I32P]   # allocatable requested node_ok
+        + [_F32P] + [_I32P]          # filter_usage has_filter_usage
+        + [_F32P] * 5                # filter_thr prod_thr prod_usage term_np term_pr
+        + [_I32P] * 2                # score_valid filter_skip
+        + [_F32P]                    # weights
+        + [_F32P] + [_I32P] * 2      # numa_free numa_policy has_topology
+        + [_F32P] * 2                # bind_free cpus_per_core
+        + [_I32P] + [_F32P] * 2      # ancestors quota_used quota_runtime
+        + [_I32P] + [_F32P] * 2      # gang_valid gang_min gang_assumed
+        + [_I32P, ctypes.c_int]      # gang_group num_groups
+        + [_I32P]                    # chosen (out)
+    )
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _f32(x) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(x), np.float32)
+
+
+def _i32(x) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(x), np.int32)
+
+
+def serial_schedule_full_native(fc, args, num_groups: int = 0) -> np.ndarray:
+    """Native analog of parity.serial_schedule_full: returns chosen[P] int32.
+    Raises RuntimeError if the library is not built."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(
+            "libkoordfloor.so not built (make -C koordinator_tpu/native)")
+    inputs = fc.base
+    fit_requests = _f32(inputs.fit_requests)
+    P, R = fit_requests.shape
+    allocatable = _f32(inputs.allocatable)
+    N = allocatable.shape[0]
+    numa_free = _f32(fc.numa_free).copy()
+    K = numa_free.shape[1]
+    ancestors = _i32(fc.quota_ancestors)
+    if ancestors.ndim != 2:
+        ancestors = ancestors.reshape(0, 1)
+    G, A = ancestors.shape if ancestors.size else (0, 1)
+    gang_min = _f32(fc.gang_min_member)
+    NG = gang_min.shape[0]
+    gang_group = _i32(fc.gang_group_id)
+    n_groups = int(num_groups or (int(gang_group.max()) + 1 if NG else 0))
+
+    chosen = np.full(P, -1, np.int32)
+    lib.koord_serial_full_chain(
+        P, R, N, K, max(G, 0), A, NG,
+        1 if args.score_according_prod_usage else 0,
+        fit_requests, _f32(fc.requests), _f32(inputs.estimated),
+        _i32(inputs.is_prod), _i32(inputs.is_daemonset),
+        _i32(inputs.pod_valid), _i32(fc.gang_id), _i32(fc.quota_id),
+        _i32(fc.needs_numa), _i32(fc.needs_bind),
+        _f32(fc.cores_needed), _i32(fc.full_pcpus),
+        allocatable, _f32(inputs.requested).copy(), _i32(inputs.node_ok),
+        _f32(inputs.la_filter_usage), _i32(inputs.la_has_filter_usage),
+        _f32(inputs.la_filter_thresholds), _f32(inputs.la_prod_thresholds),
+        _f32(inputs.la_prod_pod_usage),
+        _f32(inputs.la_term_nonprod).copy(), _f32(inputs.la_term_prod).copy(),
+        _i32(inputs.la_score_valid), _i32(inputs.la_filter_skip),
+        _f32(inputs.weights),
+        numa_free, _i32(fc.numa_policy), _i32(fc.has_topology),
+        _f32(fc.bind_free).copy(), _f32(fc.cpus_per_core),
+        ancestors if ancestors.size else np.zeros((1, 1), np.int32),
+        _f32(fc.quota_used).copy() if G else np.zeros((1, R), np.float32),
+        _f32(fc.quota_runtime) if G else np.zeros((1, R), np.float32),
+        _i32(fc.gang_valid) if NG else np.zeros(1, np.int32),
+        gang_min if NG else np.zeros(1, np.float32),
+        _f32(fc.gang_assumed) if NG else np.zeros(1, np.float32),
+        gang_group if NG else np.zeros(1, np.int32),
+        n_groups,
+        chosen)
+    return chosen

@@ -1,0 +1,269 @@
+// Compiled serial floor for the full plugin-chain scheduling step.
+//
+// A C++ transcription of scheduler/parity.py::serial_schedule_full (itself a
+// scalar transcription of the reference's per-pod Go chain: kube
+// NodeResourcesFit + load_aware.go:123-335 + NUMA admit + quota admission +
+// gang permit). bench.py times this on the SAME packed trace as the TPU step
+// and reports vs_compiled_floor — an order-of-magnitude-honest stand-in for
+// the reference's serial Go scheduler, which cannot run here (no Go
+// toolchain, no cluster).
+//
+// Float discipline mirrors the numpy oracle exactly so bindings are
+// bit-identical: float32 arithmetic everywhere, except the usage-ratio
+// computation which numpy promotes through float64 before the float32 cast.
+// Build with -ffp-contract=off (see Makefile) so no FMA contraction changes
+// results vs numpy.
+
+#include <cmath>
+#include <cstdint>
+
+namespace {
+
+inline float go_round(float x) { return std::floor(x + 0.5f); }
+
+inline float least_requested(float requested, float capacity) {
+  if (capacity <= 0.0f || requested > capacity) return 0.0f;
+  return std::floor((capacity - requested) * 100.0f / capacity);
+}
+
+}  // namespace
+
+extern "C" {
+
+// All 2-D arrays are row-major contiguous. Mutable state arrays (requested,
+// term_np, term_pr, numa_free, bind_free, quota_used) are scratch copies the
+// caller owns; they are mutated in place, as in the numpy oracle.
+void koord_serial_full_chain(
+    // dims
+    int P, int R, int N, int K, int G, int A, int NG,
+    int prod_mode,
+    // pods
+    const float* fit_requests,   // [P, R]
+    const float* requests,       // [P, R]
+    const float* estimated,      // [P, R]
+    const int32_t* is_prod,      // [P]
+    const int32_t* is_daemonset, // [P]
+    const int32_t* pod_valid,    // [P]
+    const int32_t* gang_id,      // [P]
+    const int32_t* quota_id,     // [P]
+    const int32_t* needs_numa,   // [P]
+    const int32_t* needs_bind,   // [P]
+    const float* cores_needed,   // [P]
+    const int32_t* full_pcpus,   // [P]
+    // nodes
+    const float* allocatable,    // [N, R]
+    float* requested_state,      // [N, R] (mutated)
+    const int32_t* node_ok,      // [N]
+    const float* filter_usage,   // [N, R]
+    const int32_t* has_filter_usage, // [N]
+    const float* filter_thr,     // [N, R]
+    const float* prod_thr,       // [N, R]
+    const float* prod_usage,     // [N, R]
+    float* term_np,              // [N, R] (mutated)
+    float* term_pr,              // [N, R] (mutated)
+    const int32_t* score_valid,  // [N]
+    const int32_t* filter_skip,  // [N]
+    const float* weights,        // [R]
+    // topology
+    float* numa_free,            // [N, K, R] (mutated)
+    const int32_t* numa_policy,  // [N]  0=none, 1=single-numa-node
+    const int32_t* has_topology, // [N]
+    float* bind_free,            // [N] (mutated)
+    const float* cpus_per_core,  // [N]
+    // quota
+    const int32_t* ancestors,    // [G, A] (-1 padded)
+    float* quota_used,           // [G, R] (mutated)
+    const float* quota_runtime,  // [G, R]
+    // gangs
+    const int32_t* gang_valid,   // [NG]
+    const float* gang_min,       // [NG]
+    const float* gang_assumed,   // [NG]
+    const int32_t* gang_group,   // [NG]
+    int num_groups,
+    // out
+    int32_t* chosen)             // [P]
+{
+  float wsum = 0.0f;
+  for (int r = 0; r < R; ++r) wsum += weights[r];
+  const float wdiv = wsum > 1.0f ? wsum : 1.0f;
+
+  for (int p = 0; p < P; ++p) {
+    chosen[p] = -1;
+    if (!pod_valid[p]) continue;
+    // PreFilter: gang validity + quota admission along the ancestor chain
+    if (gang_id[p] >= 0 && !gang_valid[gang_id[p]]) continue;
+    bool admit = true;
+    if (quota_id[p] >= 0) {
+      const int32_t* chain = ancestors + (int64_t)quota_id[p] * A;
+      for (int a = 0; a < A && admit; ++a) {
+        int g = chain[a];
+        if (g < 0) continue;
+        for (int r = 0; r < R; ++r) {
+          float need = requests[(int64_t)p * R + r];
+          if (need > 0.0f &&
+              quota_used[(int64_t)g * R + r] + need >
+                  quota_runtime[(int64_t)g * R + r]) {
+            admit = false;
+            break;
+          }
+        }
+      }
+    }
+    if (!admit) continue;
+
+    int best_n = -1, best_zone = -1;
+    float best_score = -1.0f;
+    const float* fitp = fit_requests + (int64_t)p * R;
+    const float* reqp = requests + (int64_t)p * R;
+    const float* estp = estimated + (int64_t)p * R;
+    const bool use_prod_score = prod_mode && is_prod[p];
+
+    for (int n = 0; n < N; ++n) {
+      if (!node_ok[n]) continue;
+      const float* alloc = allocatable + (int64_t)n * R;
+      const float* reqn = requested_state + (int64_t)n * R;
+      // Filter: Fit
+      bool fit = true;
+      for (int r = 0; r < R; ++r) {
+        if (fitp[r] > 0.0f && reqn[r] + fitp[r] > alloc[r]) { fit = false; break; }
+      }
+      if (!fit) continue;
+      // Filter: LoadAware thresholds (load_aware.go:123-171)
+      if (!is_daemonset[p] && !filter_skip[n]) {
+        bool prod_configured = false;
+        const float* pthr = prod_thr + (int64_t)n * R;
+        for (int r = 0; r < R; ++r)
+          if (pthr[r] > 0.0f) { prod_configured = true; break; }
+        const bool use_prod = is_prod[p] && prod_configured;
+        const float* usage =
+            (use_prod ? prod_usage : filter_usage) + (int64_t)n * R;
+        const float* thr = (use_prod ? prod_thr : filter_thr) + (int64_t)n * R;
+        bool skip = !use_prod && !has_filter_usage[n];
+        if (!skip) {
+          bool ok = true;
+          for (int r = 0; r < R; ++r) {
+            if (thr[r] == 0.0f || alloc[r] == 0.0f) continue;
+            // numpy computes this ratio in float64 then casts to float32
+            float ratio = go_round(
+                (float)((double)usage[r] * 100.0 / (double)alloc[r]));
+            if (ratio >= thr[r]) { ok = false; break; }
+          }
+          if (!ok) continue;
+        }
+      }
+      // Filter: cpuset capacity + SMT alignment
+      if (needs_bind[p]) {
+        if (!has_topology[n]) continue;
+        float cpc = cpus_per_core[n] > 1.0f ? cpus_per_core[n] : 1.0f;
+        if (full_pcpus[p] && std::fmod(cores_needed[p], cpc) != 0.0f) continue;
+        if (cores_needed[p] > bind_free[n]) continue;
+      }
+      // NUMA admit
+      int zone = -1;
+      if (needs_numa[p] && numa_policy[n] != 0) {
+        const float* nf = numa_free + ((int64_t)n * K) * R;
+        if (numa_policy[n] == 1) {  // single-numa-node
+          for (int k = 0; k < K && zone < 0; ++k) {
+            bool fits = true;
+            for (int r = 0; r < R; ++r) {
+              if (reqp[r] > 0.0f && reqp[r] > nf[(int64_t)k * R + r]) {
+                fits = false;
+                break;
+              }
+            }
+            if (fits) zone = k;
+          }
+          if (zone < 0) continue;
+        } else {
+          bool fits = true;
+          for (int r = 0; r < R && fits; ++r) {
+            if (reqp[r] <= 0.0f) continue;
+            float total = 0.0f;
+            for (int k = 0; k < K; ++k) total += nf[(int64_t)k * R + r];
+            if (reqp[r] > total) fits = false;
+          }
+          if (!fits) continue;
+        }
+      }
+      // Score: LoadAware least-requested + NUMA fit score
+      const float* term = (use_prod_score ? term_pr : term_np) + (int64_t)n * R;
+      float acc = 0.0f, acc2 = 0.0f;
+      for (int r = 0; r < R; ++r) {
+        if (weights[r] == 0.0f) continue;
+        acc += weights[r] * least_requested(estp[r] + term[r], alloc[r]);
+        acc2 += weights[r] * least_requested(reqn[r] + reqp[r], alloc[r]);
+      }
+      float la_score = score_valid[n] ? std::floor(acc / wdiv) : 0.0f;
+      float numa_score = std::floor(acc2 / wdiv);
+      float s = la_score + numa_score;
+      if (s > best_score) {  // strict: lowest index wins ties
+        best_n = n;
+        best_score = s;
+        best_zone = zone;
+      }
+    }
+    if (best_n < 0) continue;
+    chosen[p] = best_n;
+    // Reserve: Fit state + assign cache + NUMA/cpuset/quota accounting
+    float* reqn = requested_state + (int64_t)best_n * R;
+    float* tnp = term_np + (int64_t)best_n * R;
+    float* tpr = term_pr + (int64_t)best_n * R;
+    for (int r = 0; r < R; ++r) {
+      reqn[r] += fitp[r];
+      tnp[r] += estp[r];
+      if (prod_mode && is_prod[p]) tpr[r] += estp[r];
+    }
+    if (needs_numa[p]) {
+      float* nf = numa_free + ((int64_t)best_n * K) * R;
+      if (best_zone >= 0) {
+        for (int r = 0; r < R; ++r) nf[(int64_t)best_zone * R + r] -= reqp[r];
+      } else {
+        for (int r = 0; r < R; ++r) {
+          float remaining = reqp[r];
+          for (int k = 0; k < K; ++k) {
+            float avail = nf[(int64_t)k * R + r];
+            float take = avail < remaining ? avail : remaining;
+            nf[(int64_t)k * R + r] -= take;
+            remaining -= take;
+          }
+        }
+      }
+    }
+    if (needs_bind[p]) bind_free[best_n] -= cores_needed[p];
+    if (quota_id[p] >= 0) {
+      const int32_t* chain = ancestors + (int64_t)quota_id[p] * A;
+      for (int a = 0; a < A; ++a) {
+        int g = chain[a];
+        if (g < 0) continue;
+        float* qu = quota_used + (int64_t)g * R;
+        for (int r = 0; r < R; ++r) qu[r] += reqp[r];
+      }
+    }
+  }
+
+  // ---- gang permit barrier (all-or-nothing per gang group)
+  if (NG > 0) {
+    // heap-free small passes: counts fit on the stack only for tiny NG, so
+    // allocate; this is outside the timed per-pod loop's hot path anyway
+    float* per_gang = new float[NG]();
+    for (int p = 0; p < P; ++p)
+      if (gang_id[p] >= 0 && chosen[p] >= 0) per_gang[gang_id[p]] += 1.0f;
+    bool* gang_ok = new bool[NG];
+    int ngrp = num_groups > 0 ? num_groups : 1;
+    int* group_fail = new int[ngrp]();
+    for (int g = 0; g < NG; ++g) {
+      gang_ok[g] = per_gang[g] + gang_assumed[g] >= gang_min[g];
+      if (!gang_ok[g]) group_fail[gang_group[g]] += 1;
+    }
+    for (int p = 0; p < P; ++p) {
+      int g = gang_id[p];
+      if (g >= 0 && (!gang_ok[g] || group_fail[gang_group[g]] > 0))
+        chosen[p] = -1;
+    }
+    delete[] per_gang;
+    delete[] gang_ok;
+    delete[] group_fail;
+  }
+}
+
+}  // extern "C"

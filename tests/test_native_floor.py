@@ -1,0 +1,58 @@
+"""The compiled serial floor (native/serial_floor.cpp) must produce bindings
+bit-identical to the numpy oracle (scheduler/parity.py) — it is the timing
+floor bench.py reports vs_compiled_floor against, so its semantics must be
+beyond dispute."""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.native import floor as native_floor
+from koordinator_tpu.ops.loadaware import LoadAwareArgs
+from koordinator_tpu.scheduler.parity import serial_schedule_full
+from koordinator_tpu.scheduler.snapshot import build_full_chain_inputs
+from koordinator_tpu.testing import synth_full_cluster
+
+pytestmark = pytest.mark.skipif(
+    not (native_floor.available() or native_floor.build()),
+    reason="libkoordfloor.so unavailable and g++ build failed",
+)
+
+
+def _diff(seed, prod=False, **kw):
+    args = LoadAwareArgs(score_according_prod_usage=prod)
+    _, state = synth_full_cluster(28, 56, seed=seed, **kw)
+    fc, _, _, _, _, ng, ngroups = build_full_chain_inputs(state, args)
+    ref = serial_schedule_full(fc, args)
+    nat = native_floor.serial_schedule_full_native(fc, args,
+                                                  num_groups=ngroups)
+    np.testing.assert_array_equal(ref, nat)
+    return ref
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_native_matches_numpy_oracle(seed):
+    chosen = _diff(seed)
+    assert (chosen >= 0).sum() > 0
+
+
+def test_native_prod_mode():
+    _diff(11, prod=True)
+
+
+def test_native_no_quota_no_gang():
+    _diff(12, num_quotas=0, num_gangs=0)
+
+
+def test_native_all_topology():
+    _diff(13, topology_fraction=1.0, lsr_fraction=0.4)
+
+
+def test_native_inputs_not_mutated():
+    args = LoadAwareArgs()
+    _, state = synth_full_cluster(16, 24, seed=5)
+    fc, _, _, _, _, _, ngroups = build_full_chain_inputs(state, args)
+    before = np.asarray(fc.quota_used).copy()
+    numa_before = np.asarray(fc.numa_free).copy()
+    native_floor.serial_schedule_full_native(fc, args, num_groups=ngroups)
+    np.testing.assert_array_equal(np.asarray(fc.quota_used), before)
+    np.testing.assert_array_equal(np.asarray(fc.numa_free), numa_before)
