@@ -10,3 +10,8 @@ the reference's test strategy, SURVEY.md section 4).
 """
 
 from koordinator_tpu.client.store import ObjectStore, EventType, Informer  # noqa: F401
+from koordinator_tpu.client.leaderelection import (  # noqa: F401
+    ElectedRunner,
+    LeaderElector,
+    Lease,
+)

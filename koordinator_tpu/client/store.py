@@ -84,6 +84,27 @@ class ObjectStore:
         self._lock = threading.RLock()
         self._rv = 0
         self._collections: Dict[str, _Collection] = {k: _Collection() for k in ALL_KINDS}
+        # admission interceptors (apiserver -> webhook call path): named so a
+        # standby replica installing the same server is idempotent
+        self._admission: Dict[str, Any] = {}
+
+    def set_admission(self, name: str, fn) -> None:
+        """Install an admission interceptor `fn(kind, obj, old=None,
+        delete=False)` run before every add/update/delete; raising rejects
+        the operation (the store's analog of registering a webhook with the
+        apiserver). Passing None removes it."""
+        with self._lock:
+            if fn is None:
+                self._admission.pop(name, None)
+            else:
+                self._admission[name] = fn
+
+    def _admit(self, kind: str, obj: Any, old: Any = None,
+               delete: bool = False) -> None:
+        with self._lock:
+            interceptors = list(self._admission.values())
+        for fn in interceptors:
+            fn(kind, obj, old=old, delete=delete)
 
     # -- accessors -----------------------------------------------------------
     def get(self, kind: str, key: str) -> Optional[Any]:
@@ -100,6 +121,7 @@ class ObjectStore:
 
     # -- mutators ------------------------------------------------------------
     def add(self, kind: str, obj: Any) -> Any:
+        self._admit(kind, obj)
         with self._lock:
             key = _key_of(obj)
             col = self._collections[kind]
@@ -113,6 +135,7 @@ class ObjectStore:
         return obj
 
     def update(self, kind: str, obj: Any, expect_rv: Optional[int] = None) -> Any:
+        self._admit(kind, obj, old=self.get(kind, _key_of(obj)))
         with self._lock:
             key = _key_of(obj)
             col = self._collections[kind]
@@ -136,6 +159,9 @@ class ObjectStore:
         return self.update(kind, obj) if exists else self.add(kind, obj)
 
     def delete(self, kind: str, key: str) -> Optional[Any]:
+        existing = self.get(kind, key)
+        if existing is not None:
+            self._admit(kind, existing, delete=True)
         with self._lock:
             col = self._collections[kind]
             old = col.objects.pop(key, None)

@@ -29,8 +29,12 @@ class Descheduler:
         store: ObjectStore,
         low_node_load_args: Optional[LowNodeLoadArgs] = None,
         profiles: Optional[List[ProfileConfig]] = None,
+        elector=None,
     ):
         self.store = store
+        # active/standby gating (cmd/koord-descheduler mirrors the scheduler's
+        # leader election): with an elector, run_once acts only on the leader
+        self.elector = elector
         if profiles is None:
             profiles = [DEFAULT_PROFILE]
         if low_node_load_args is not None:
@@ -55,6 +59,9 @@ class Descheduler:
         from koordinator_tpu.client.store import KIND_POD_MIGRATION_JOB
 
         now = time.time() if now is None else now
+        if self.elector is not None and not self.elector.tick(now):
+            return {"skipped_not_leader": True, "jobs_created": 0,
+                    "migration_transitions": 0, "profiles": {}, "evicted": {}}
         statuses: Dict[str, Dict[str, Optional[str]]] = {}
         evicted_before = {
             p.config.name: p.handle.evicted_count for p in self.profiles

@@ -1,0 +1,85 @@
+"""koord-manager: one composed control-plane runner.
+
+Analog of the koord-manager binary (`cmd/koord-manager/main.go` +
+`options/controllers.go:34-39`): a single process that installs the
+nodemetric / noderesource / nodeslo / quota-profile controllers and the
+admission webhook server, with every controller gated behind ONE leader
+lease — standby replicas serve webhooks but run no control loops, exactly
+like controller-runtime managers with LeaderElection enabled.
+
+The webhook installs into the ObjectStore's admission-interceptor seam
+(`store.set_admission`) immediately at construction on every replica:
+admission is load-balanced across replicas in the reference too, so it is
+NOT election-gated.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from koordinator_tpu.client.leaderelection import ElectedRunner, LeaderElector
+from koordinator_tpu.client.store import ObjectStore
+from koordinator_tpu.quotacontroller import QuotaProfileController
+from koordinator_tpu.slocontroller import (
+    NodeMetricController,
+    NodeResourceController,
+    NodeSLOController,
+)
+from koordinator_tpu.utils.sloconfig import ColocationConfig
+from koordinator_tpu.webhook import AdmissionServer
+
+MANAGER_LEASE = "koord-manager"
+
+
+class Manager:
+    """Composed koord-manager replica. `tick(now)` renews/acquires the lease
+    and, while leading, reconciles every installed controller once."""
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        identity: str = "koord-manager-0",
+        config: Optional[ColocationConfig] = None,
+        lease_duration_seconds: float = 15.0,
+    ) -> None:
+        self.store = store
+        self.identity = identity
+        self.webhook = AdmissionServer(store)
+        # webhooks are served by every replica (leader or not)
+        store.set_admission("koord-manager-webhook", self.webhook.admit)
+        self.controllers = {
+            "nodemetric": NodeMetricController(store, config),
+            "noderesource": NodeResourceController(store, config),
+            "nodeslo": NodeSLOController(store),
+            "quotaprofile": QuotaProfileController(store),
+        }
+        self.elector = LeaderElector(
+            store, MANAGER_LEASE, identity,
+            lease_duration_seconds=lease_duration_seconds)
+        self._runner = ElectedRunner(self.elector, self._reconcile_all)
+        self.last_changes: Dict[str, int] = {}
+        self.reconcile_rounds = 0
+
+    @property
+    def is_leader(self) -> bool:
+        return self.elector.is_leader
+
+    def _reconcile_all(self, now: float) -> None:
+        self.last_changes = {
+            "nodemetric": self.controllers["nodemetric"].reconcile(),
+            "noderesource": self.controllers["noderesource"].reconcile(now),
+            "nodeslo": self.controllers["nodeslo"].reconcile(),
+            "quotaprofile": self.controllers["quotaprofile"].reconcile(),
+        }
+        self.reconcile_rounds += 1
+
+    def tick(self, now: Optional[float] = None) -> bool:
+        """One manager round: returns True iff this replica led and ran."""
+        return self._runner.tick(time.time() if now is None else now)
+
+    def stop(self, now: Optional[float] = None) -> None:
+        """Graceful shutdown: release the lease (ReleaseOnCancel) and
+        uninstall this replica's webhook."""
+        self.elector.release(time.time() if now is None else now)
+        self.store.set_admission("koord-manager-webhook", None)

@@ -70,6 +70,7 @@ class Scheduler:
         args: Optional[LoadAwareArgs] = None,
         scheduler_name: str = "koord-scheduler",
         config: Optional["SchedulerConfiguration"] = None,
+        elector=None,
     ):
         from koordinator_tpu.scheduler.config import SchedulerConfiguration
         from koordinator_tpu.scheduler.plugins.reservation import (
@@ -129,6 +130,9 @@ class Scheduler:
         self.preemptor = (
             QuotaPreemptor(store, quota_plugin) if quota_plugin else None
         )
+        # active/standby gating (cmd/koord-scheduler/app/server.go:227-256):
+        # with an elector, a cycle runs only while this replica holds the lease
+        self.elector = elector
         self._step_cache: Dict[Tuple, object] = {}
 
     # ------------------------------------------------------------------
@@ -226,6 +230,8 @@ class Scheduler:
     def run_cycle(self, now: Optional[float] = None) -> CycleResult:
         t_start = time.perf_counter()
         now = time.time() if now is None else now
+        if self.elector is not None and not self.elector.tick(now):
+            return CycleResult(skipped_not_leader=True)
         result = CycleResult()
         res_plugin = self.extender.plugin("Reservation")
         if self.reservation_controller is not None:

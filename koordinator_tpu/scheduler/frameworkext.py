@@ -41,6 +41,7 @@ class CycleResult:
     preempted_victims: List[str] = field(default_factory=list)  # quota PostFilter
     duration_seconds: float = 0.0
     kernel_seconds: float = 0.0
+    skipped_not_leader: bool = False  # election-gated replica in standby
 
 
 class Plugin:

@@ -1,0 +1,170 @@
+"""Leader election wired into the control planes: only leaders act, standbys
+take over after lease expiry mid-workload, and no cycle runs twice (ref
+cmd/koord-scheduler/app/server.go:227-256, cmd/koord-manager)."""
+
+import json
+
+from koordinator_tpu.api.objects import (
+    LABEL_POD_QOS,
+    Node,
+    NodeMetric,
+    NodeMetricInfo,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client import LeaderElector
+from koordinator_tpu.client.store import (
+    KIND_NODE,
+    KIND_NODE_METRIC,
+    KIND_POD,
+    ObjectStore,
+)
+from koordinator_tpu.descheduler.descheduler import Descheduler
+from koordinator_tpu.manager import Manager
+from koordinator_tpu.scheduler.cycle import Scheduler
+
+GIB = 1024**3
+NOW = 1_000_000.0
+LEASE_S = 15.0
+
+
+def _cluster(store, num_nodes=2, num_pods=3):
+    for i in range(num_nodes):
+        store.add(KIND_NODE, Node(
+            meta=ObjectMeta(name=f"node-{i}", namespace=""),
+            allocatable=ResourceList.of(cpu=16_000, memory=64 * GIB, pods=110),
+        ))
+        store.add(KIND_NODE_METRIC, NodeMetric(
+            meta=ObjectMeta(name=f"node-{i}", namespace=""),
+            update_time=NOW - 10,
+            node_metric=NodeMetricInfo(
+                node_usage=ResourceList.of(cpu=1000, memory=2 * GIB)),
+        ))
+    for i in range(num_pods):
+        store.add(KIND_POD, Pod(
+            meta=ObjectMeta(name=f"pod-{i}", labels={LABEL_POD_QOS: "LS"},
+                            creation_timestamp=NOW - 100),
+            spec=PodSpec(priority=9000,
+                         requests=ResourceList.of(cpu=1000, memory=GIB)),
+        ))
+
+
+class TestSchedulerElection:
+    def _make(self, store, ident):
+        elector = LeaderElector(store, "koord-scheduler", ident,
+                                lease_duration_seconds=LEASE_S)
+        return Scheduler(store, elector=elector)
+
+    def test_only_leader_schedules_and_no_double_binding(self):
+        store = ObjectStore()
+        _cluster(store)
+        s1 = self._make(store, "sched-1")
+        s2 = self._make(store, "sched-2")
+        r1 = s1.run_cycle(now=NOW)       # acquires the lease
+        r2 = s2.run_cycle(now=NOW + 1)   # standby: must not act
+        assert not r1.skipped_not_leader and len(r1.bound) == 3
+        assert r2.skipped_not_leader and not r2.bound
+        # every pod bound exactly once
+        assigned = [p for p in store.list(KIND_POD) if p.is_assigned]
+        assert len(assigned) == 3
+
+    def test_standby_takes_over_after_lease_expiry(self):
+        store = ObjectStore()
+        _cluster(store, num_pods=2)
+        s1 = self._make(store, "sched-1")
+        s2 = self._make(store, "sched-2")
+        r1 = s1.run_cycle(now=NOW)
+        assert len(r1.bound) == 2
+        # new work arrives; the leader dies (stops renewing)
+        store.add(KIND_POD, Pod(
+            meta=ObjectMeta(name="late", labels={LABEL_POD_QOS: "LS"},
+                            creation_timestamp=NOW),
+            spec=PodSpec(priority=9000,
+                         requests=ResourceList.of(cpu=1000, memory=GIB)),
+        ))
+        r2 = s2.run_cycle(now=NOW + 5)
+        assert r2.skipped_not_leader  # lease still held
+        r2 = s2.run_cycle(now=NOW + LEASE_S + 6)
+        assert not r2.skipped_not_leader
+        assert [b.pod_key for b in r2.bound] == ["default/late"]
+        # the old leader notices it lost the lease and stands down
+        r1b = s1.run_cycle(now=NOW + LEASE_S + 7)
+        assert r1b.skipped_not_leader
+
+
+class TestDeschedulerElection:
+    def test_only_leader_runs(self):
+        store = ObjectStore()
+        _cluster(store)
+        d1 = Descheduler(store, elector=LeaderElector(
+            store, "koord-descheduler", "d1", lease_duration_seconds=LEASE_S))
+        d2 = Descheduler(store, elector=LeaderElector(
+            store, "koord-descheduler", "d2", lease_duration_seconds=LEASE_S))
+        out1 = d1.run_once(now=NOW)
+        out2 = d2.run_once(now=NOW + 1)
+        assert "skipped_not_leader" not in out1
+        assert out2["skipped_not_leader"]
+        out2 = d2.run_once(now=NOW + LEASE_S + 2)
+        assert "skipped_not_leader" not in out2
+
+
+class TestManagerElection:
+    def test_two_replicas_one_leader_and_failover(self):
+        store = ObjectStore()
+        _cluster(store)
+        m1 = Manager(store, identity="mgr-1",
+                     lease_duration_seconds=LEASE_S)
+        m2 = Manager(store, identity="mgr-2",
+                     lease_duration_seconds=LEASE_S)
+        assert m1.tick(now=NOW) is True
+        assert m2.tick(now=NOW + 1) is False
+        assert m1.is_leader and not m2.is_leader
+        assert m1.reconcile_rounds == 1 and m2.reconcile_rounds == 0
+        # all four controllers ran under the leader
+        assert set(m1.last_changes) == {
+            "nodemetric", "noderesource", "nodeslo", "quotaprofile"}
+        # leader dies mid-workload; standby takes over after expiry
+        assert m2.tick(now=NOW + LEASE_S + 2) is True
+        assert m2.is_leader and m2.reconcile_rounds == 1
+        # the dead leader's replica, revived, stands down
+        assert m1.tick(now=NOW + LEASE_S + 3) is False
+        assert not m1.is_leader
+
+    def test_webhook_served_by_standby_too(self):
+        from koordinator_tpu.utils.features import MANAGER_GATES
+
+        store = ObjectStore()
+        m1 = Manager(store, identity="mgr-1")
+        m2 = Manager(store, identity="mgr-2")
+        m1.tick(now=NOW)
+        assert not m2.is_leader
+        # admission rides the store seam regardless of leadership: a node
+        # with an amplification ratio is mutated on add
+        MANAGER_GATES.set_from_map({"NodeMutatingWebhook": True})
+        try:
+            ann = {AdmissionServerRatio: json.dumps({"cpu": 2.0})}
+            node = Node(meta=ObjectMeta(name="n-adm", namespace="",
+                                        annotations=ann),
+                        allocatable=ResourceList.of(cpu=8_000, memory=GIB))
+            store.add(KIND_NODE, node)
+            from koordinator_tpu.api.resources import ResourceName
+
+            assert node.allocatable.get(ResourceName.CPU) == 16_000
+        finally:
+            MANAGER_GATES.reset()
+
+    def test_stop_releases_lease(self):
+        store = ObjectStore()
+        m1 = Manager(store, identity="mgr-1")
+        m2 = Manager(store, identity="mgr-2")
+        m1.tick(now=NOW)
+        m1.stop(now=NOW + 1)
+        # released lease: the standby acquires on its next tick, no wait
+        assert m2.tick(now=NOW + 2) is True
+
+
+from koordinator_tpu.webhook import AdmissionServer  # noqa: E402
+
+AdmissionServerRatio = AdmissionServer.AMPLIFICATION_RATIO_ANNOTATION
