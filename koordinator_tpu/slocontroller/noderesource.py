@@ -40,6 +40,10 @@ from koordinator_tpu.client.store import (
     KIND_POD,
     ObjectStore,
 )
+from koordinator_tpu.slocontroller.noderesource_plugins import (
+    CPU_NORMALIZATION_CONFIG_KEY,
+    run_plugin_chain,
+)
 from koordinator_tpu.utils.sloconfig import (
     POLICY_MAX_USAGE_REQUEST,
     POLICY_REQUEST,
@@ -219,6 +223,32 @@ class NodeResourceController:
                     changed = True
             if changed:
                 node.allocatable = ResourceList(merged)
+            # post-pass plugin chain: cpunormalization + gpudeviceresource +
+            # resourceamplification (reference plugins_profile.go order);
+            # runs after the batch/mid merge so it sees the final allocatable
+            plugin_changed = run_plugin_chain(
+                node, self.store,
+                cpu_normalization_config=self._cpu_normalization_config())
+            if changed or plugin_changed:
                 self.store.update(KIND_NODE, node)
                 changes += 1
         return changes
+
+    def _cpu_normalization_config(self) -> Optional[dict]:
+        """cpu-normalization-config section of the slo-controller-config
+        ConfigMap (configuration/slo_controller_config.go:34)."""
+        from koordinator_tpu.client.store import KIND_CONFIG_MAP
+        from koordinator_tpu.utils.sloconfig import CONFIG_MAP_NAME
+
+        cm = self.store.get(
+            KIND_CONFIG_MAP, f"koordinator-system/{CONFIG_MAP_NAME}")
+        raw = getattr(cm, "data", {}).get(CPU_NORMALIZATION_CONFIG_KEY) if cm else None
+        if not raw:
+            return None
+        import json
+
+        try:
+            cfg = json.loads(raw)
+        except ValueError:
+            return None
+        return cfg if isinstance(cfg, dict) else None

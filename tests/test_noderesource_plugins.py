@@ -1,0 +1,221 @@
+"""NodeResource controller plugins: cpunormalization, gpudeviceresource,
+resourceamplification (ref pkg/slo-controller/noderesource/plugins/)."""
+
+import json
+
+from koordinator_tpu.api.objects import (
+    ConfigMap,
+    Device,
+    DeviceInfo,
+    Node,
+    NodeMetric,
+    NodeMetricInfo,
+    NodeResourceTopology,
+    ObjectMeta,
+)
+from koordinator_tpu.api.resources import ResourceList, ResourceName
+from koordinator_tpu.client.store import (
+    KIND_CONFIG_MAP,
+    KIND_DEVICE,
+    KIND_NODE,
+    KIND_NODE_METRIC,
+    KIND_NODE_TOPOLOGY,
+    ObjectStore,
+)
+from koordinator_tpu.slocontroller.noderesource import NodeResourceController
+from koordinator_tpu.slocontroller.noderesource_plugins import (
+    ANNOTATION_AMPLIFICATION_RATIO,
+    ANNOTATION_CPU_BASIC_INFO,
+    ANNOTATION_CPU_NORMALIZATION_RATIO,
+    LABEL_CPU_NORMALIZATION_ENABLED,
+    LABEL_GPU_MODEL,
+)
+from koordinator_tpu.utils.sloconfig import CONFIG_MAP_NAME
+
+GIB = 1024**3
+NOW = 1_000_000.0
+
+RATIO_MODEL = {
+    "Intel Xeon 8269CY": {
+        "baseRatio": 1.5,
+        "hyperThreadEnabledRatio": 1.0,
+        "turboEnabledRatio": 1.8,
+        "hyperThreadTurboEnabledRatio": 1.2,
+    },
+}
+
+
+def _store(cpu_norm_cfg=None):
+    store = ObjectStore()
+    store.add(KIND_NODE, Node(
+        meta=ObjectMeta(name="n0", namespace=""),
+        allocatable=ResourceList.of(cpu=16_000, memory=64 * GIB),
+        capacity=ResourceList.of(cpu=16_000, memory=64 * GIB),
+    ))
+    store.add(KIND_NODE_METRIC, NodeMetric(
+        meta=ObjectMeta(name="n0", namespace=""),
+        update_time=NOW - 10,
+        node_metric=NodeMetricInfo(
+            node_usage=ResourceList.of(cpu=1000, memory=2 * GIB)),
+    ))
+    if cpu_norm_cfg is not None:
+        store.add(KIND_CONFIG_MAP, ConfigMap(
+            meta=ObjectMeta(name=CONFIG_MAP_NAME,
+                            namespace="koordinator-system"),
+            data={"cpu-normalization-config": json.dumps(cpu_norm_cfg)},
+        ))
+    return store
+
+
+def _nrt(store, model="Intel Xeon 8269CY", ht=False, turbo=False):
+    store.add(KIND_NODE_TOPOLOGY, NodeResourceTopology(
+        meta=ObjectMeta(name="n0", namespace="", annotations={
+            ANNOTATION_CPU_BASIC_INFO: json.dumps({
+                "cpuModel": model,
+                "hyperThreadEnabled": ht,
+                "turboEnabled": turbo,
+            }),
+        }),
+    ))
+
+
+class TestCPUNormalization:
+    def test_ratio_from_model_by_ht_turbo(self):
+        for ht, turbo, expect in [
+            (False, False, "1.50"), (True, False, "1.00"),
+            (False, True, "1.80"), (True, True, "1.20"),
+        ]:
+            store = _store({"enable": True, "ratioModel": RATIO_MODEL})
+            _nrt(store, ht=ht, turbo=turbo)
+            NodeResourceController(store).reconcile(now=NOW)
+            node = store.get(KIND_NODE, "/n0")
+            assert node.meta.annotations[
+                ANNOTATION_CPU_NORMALIZATION_RATIO] == expect, (ht, turbo)
+
+    def test_disabled_resets_to_default_ratio(self):
+        store = _store({"enable": False, "ratioModel": RATIO_MODEL})
+        _nrt(store)
+        NodeResourceController(store).reconcile(now=NOW)
+        node = store.get(KIND_NODE, "/n0")
+        assert node.meta.annotations[ANNOTATION_CPU_NORMALIZATION_RATIO] == "1.00"
+
+    def test_node_label_overrides_strategy(self):
+        # strategy disabled but node label enables
+        store = _store({"enable": False, "ratioModel": RATIO_MODEL})
+        node = store.get(KIND_NODE, "/n0")
+        node.meta.labels[LABEL_CPU_NORMALIZATION_ENABLED] = "true"
+        _nrt(store)
+        NodeResourceController(store).reconcile(now=NOW)
+        assert node.meta.annotations[ANNOTATION_CPU_NORMALIZATION_RATIO] == "1.50"
+
+    def test_unknown_model_skips_update(self):
+        store = _store({"enable": True, "ratioModel": RATIO_MODEL})
+        _nrt(store, model="Unknown CPU")
+        NodeResourceController(store).reconcile(now=NOW)
+        node = store.get(KIND_NODE, "/n0")
+        assert ANNOTATION_CPU_NORMALIZATION_RATIO not in node.meta.annotations
+
+    def test_missing_nrt_skips_update(self):
+        store = _store({"enable": True, "ratioModel": RATIO_MODEL})
+        NodeResourceController(store).reconcile(now=NOW)
+        node = store.get(KIND_NODE, "/n0")
+        assert ANNOTATION_CPU_NORMALIZATION_RATIO not in node.meta.annotations
+
+    def test_out_of_range_ratio_rejected(self):
+        store = _store({"enable": True, "ratioModel": {
+            "M": {"baseRatio": 9.0}}})
+        _nrt(store, model="M")
+        NodeResourceController(store).reconcile(now=NOW)
+        node = store.get(KIND_NODE, "/n0")
+        assert ANNOTATION_CPU_NORMALIZATION_RATIO not in node.meta.annotations
+
+
+class TestGPUDeviceResource:
+    def test_device_sync_to_node_status(self):
+        store = _store()
+        store.add(KIND_DEVICE, Device(
+            meta=ObjectMeta(name="n0", namespace="",
+                            labels={LABEL_GPU_MODEL: "A100"}),
+            devices=[
+                DeviceInfo(type="gpu", minor=0, health=True,
+                           resources=ResourceList({
+                               ResourceName.GPU_CORE: 100,
+                               ResourceName.GPU_MEMORY: 80 * GIB,
+                               ResourceName.GPU_MEMORY_RATIO: 100})),
+                DeviceInfo(type="gpu", minor=1, health=True,
+                           resources=ResourceList({
+                               ResourceName.GPU_CORE: 100,
+                               ResourceName.GPU_MEMORY: 80 * GIB,
+                               ResourceName.GPU_MEMORY_RATIO: 100})),
+                DeviceInfo(type="gpu", minor=2, health=False,  # skipped
+                           resources=ResourceList({
+                               ResourceName.GPU_CORE: 100})),
+                DeviceInfo(type="rdma", minor=0, health=True,  # not gpu
+                           resources=ResourceList({ResourceName.RDMA: 1})),
+            ],
+        ))
+        NodeResourceController(store).reconcile(now=NOW)
+        node = store.get(KIND_NODE, "/n0")
+        assert node.allocatable.get(ResourceName.GPU_CORE) == 200
+        assert node.allocatable.get(ResourceName.GPU_MEMORY) == 160 * GIB
+        assert node.allocatable.get(ResourceName.GPU) == 200
+        assert node.capacity.get(ResourceName.GPU_CORE) == 200
+        assert node.meta.labels[LABEL_GPU_MODEL] == "A100"
+
+    def test_device_deletion_resets_gpu_resources(self):
+        store = _store()
+        store.add(KIND_DEVICE, Device(
+            meta=ObjectMeta(name="n0", namespace=""),
+            devices=[DeviceInfo(type="gpu", health=True,
+                                resources=ResourceList({
+                                    ResourceName.GPU_CORE: 100}))],
+        ))
+        ctrl = NodeResourceController(store)
+        ctrl.reconcile(now=NOW)
+        node = store.get(KIND_NODE, "/n0")
+        assert node.allocatable.get(ResourceName.GPU_CORE) == 100
+        store.delete(KIND_DEVICE, "/n0")
+        ctrl.reconcile(now=NOW + 1)
+        node = store.get(KIND_NODE, "/n0")
+        assert ResourceName.GPU_CORE not in node.allocatable.quantities
+        assert ResourceName.GPU not in node.allocatable.quantities
+
+
+class TestResourceAmplification:
+    def test_ratio_above_one_produces_annotation(self):
+        store = _store({"enable": True, "ratioModel": RATIO_MODEL})
+        _nrt(store)  # base ratio 1.50
+        NodeResourceController(store).reconcile(now=NOW)
+        node = store.get(KIND_NODE, "/n0")
+        amp = json.loads(node.meta.annotations[ANNOTATION_AMPLIFICATION_RATIO])
+        assert amp == {"cpu": 1.5}
+
+    def test_ratio_of_one_removes_annotation(self):
+        store = _store({"enable": False})
+        node = store.get(KIND_NODE, "/n0")
+        node.meta.annotations[ANNOTATION_AMPLIFICATION_RATIO] = json.dumps(
+            {"cpu": 1.5})
+        NodeResourceController(store).reconcile(now=NOW)
+        assert ANNOTATION_AMPLIFICATION_RATIO not in node.meta.annotations
+
+    def test_round_trip_through_webhook_mutation(self):
+        """Controller writes the amplification annotation; the node mutating
+        webhook (installed on the store seam by the Manager) amplifies
+        allocatable on the very update the controller issues."""
+        from koordinator_tpu.manager import Manager
+        from koordinator_tpu.utils.features import MANAGER_GATES
+
+        store = _store({"enable": True, "ratioModel": RATIO_MODEL})
+        _nrt(store, turbo=True)  # ratio 1.80
+        MANAGER_GATES.set_from_map({"NodeMutatingWebhook": True})
+        try:
+            mgr = Manager(store, identity="m1")
+            assert mgr.tick(now=NOW) is True
+            node = store.get(KIND_NODE, "/n0")
+            amp = json.loads(
+                node.meta.annotations[ANNOTATION_AMPLIFICATION_RATIO])
+            assert amp == {"cpu": 1.8}
+            # raw 16000 cpu * 1.8
+            assert node.allocatable.get(ResourceName.CPU) == 28_800
+        finally:
+            MANAGER_GATES.reset()
