@@ -365,6 +365,22 @@ class Device:
 
 
 # ---------------------------------------------------------------------------
+# PersistentVolumeClaim (core v1 subset consumed by the PVC informer)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PersistentVolumeClaim:
+    """Subset of core v1 PVC: the koordlet pvc informer only needs the
+    namespace/name -> bound volume name mapping (reference
+    pkg/koordlet/statesinformer/impl/states_pvc.go:44-60)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    volume_name: str = ""  # spec.volumeName once bound
+    capacity: ResourceList = field(default_factory=ResourceList)
+
+
+# ---------------------------------------------------------------------------
 # NodeSLO CR (apis/slo/v1alpha1/nodeslo_types.go)
 # ---------------------------------------------------------------------------
 

@@ -50,6 +50,7 @@ KIND_QUOTA_PROFILE = "ElasticQuotaProfile"
 KIND_CONFIG_MAP = "ConfigMap"
 KIND_PDB = "PodDisruptionBudget"
 KIND_LEASE = "Lease"  # coordination.k8s.io leader-election lease
+KIND_PVC = "PersistentVolumeClaim"
 
 ALL_KINDS = (
     KIND_POD,
@@ -67,6 +68,7 @@ ALL_KINDS = (
     KIND_CONFIG_MAP,
     KIND_PDB,
     KIND_LEASE,
+    KIND_PVC,
 )
 
 

@@ -32,7 +32,9 @@ class Daemon:
                  config: Optional[sysutil.SystemConfig] = None,
                  checkpoint_dir: Optional[str] = None,
                  report_interval_seconds: int = 60,
-                 autodetect_cgroups: bool = False):
+                 autodetect_cgroups: bool = False,
+                 kubelet_stub=None,
+                 device_collector=None):
         self.config = config or sysutil.CONFIG
         if autodetect_cgroups:
             # probe the real node layout (koordlet.go does this at startup
@@ -54,9 +56,15 @@ class Daemon:
         self.metric_cache = MetricCache(storage_path=metric_storage)
         self.api_server = KoordletServer(self.auditor,
                                          metrics_registry=REGISTRY)
+        # PLEG feeds the pods informer (cgroup pod-added -> early kubelet
+        # resync), so it is built first (koordlet.go wiring order)
+        self.pleg = Pleg(self.config)
         self.states_informer = StatesInformer(
             store, node_name, self.metric_cache,
             report_interval_seconds=report_interval_seconds,
+            kubelet_stub=kubelet_stub,
+            pleg=self.pleg,
+            device_collector=device_collector,
         )
         self.metrics_advisor = MetricsAdvisor(
             self.states_informer, self.metric_cache, self.config
@@ -72,7 +80,6 @@ class Daemon:
             store, self.states_informer, self.metric_cache, self.executor
         )
         self.runtime_hooks = RuntimeHooks(self.states_informer, self.executor)
-        self.pleg = Pleg(self.config)
 
     def run_once(self, now: Optional[float] = None) -> None:
         now = time.time() if now is None else now
@@ -89,7 +96,7 @@ class Daemon:
                 self.prediction.update(
                     pod.meta.uid or pod.meta.key, cpu or 0.0, mem or 0.0, now
                 )
-        self.states_informer.sync_node_metric(now)
+        self.states_informer.sync(now)
         self.qos_manager.run_once(now)
         self.runtime_hooks.reconcile()
         self.metric_cache.maybe_flush(now)

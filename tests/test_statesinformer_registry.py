@@ -1,0 +1,230 @@
+"""statesinformer plugin-registry tests: kubelet stub over real HTTP, PLEG ->
+pods-informer resync, PVC informer, device informer (the registry surface of
+reference pkg/koordlet/statesinformer/impl/registry.go:21-28)."""
+
+import http.server
+import json
+import threading
+
+import pytest
+
+from koordinator_tpu.api.objects import (
+    DeviceInfo,
+    ObjectMeta,
+    PersistentVolumeClaim,
+    Pod,
+    PodSpec,
+)
+from koordinator_tpu.api.resources import ResourceList, ResourceName
+from koordinator_tpu.client.store import (
+    KIND_DEVICE,
+    KIND_POD,
+    KIND_PVC,
+    ObjectStore,
+)
+from koordinator_tpu.koordlet.kubeletstub import KubeletError, KubeletStub
+from koordinator_tpu.koordlet.metriccache import MetricCache
+from koordinator_tpu.koordlet.pleg import PodLifecycleEvent
+from koordinator_tpu.koordlet.statesinformer import (
+    DEFAULT_PLUGIN_REGISTRY,
+    StatesInformer,
+)
+
+NODE = "node-0"
+
+
+def k8s_pod(name, uid, cpu="500m", memory="1Gi", phase="Running"):
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": "default",
+            "uid": uid,
+            "labels": {"koordinator.sh/qosClass": "LS"},
+        },
+        "spec": {
+            "nodeName": NODE,
+            "priority": 9000,
+            "containers": [
+                {"name": "main",
+                 "resources": {"requests": {"cpu": cpu, "memory": memory},
+                               "limits": {"cpu": cpu, "memory": memory}}},
+                {"name": "sidecar",
+                 "resources": {"requests": {"cpu": "100m"}}},
+            ],
+        },
+        "status": {"phase": phase},
+    }
+
+
+class _KubeletHandler(http.server.BaseHTTPRequestHandler):
+    pods = []
+    configz = {"kubeletconfig": {"cpuManagerPolicy": "static"}}
+
+    def do_GET(self):
+        if self.path.rstrip("/") == "/pods" or self.path == "/pods/":
+            body = json.dumps({"items": type(self).pods})
+        elif self.path == "/configz":
+            body = json.dumps(type(self).configz)
+        else:
+            self.send_error(404)
+            return
+        data = body.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture
+def kubelet():
+    """A real HTTP kubelet fixture serving /pods/ and /configz."""
+
+    class Handler(_KubeletHandler):
+        pods = [k8s_pod("web-0", "uid-web-0"), k8s_pod("db-0", "uid-db-0")]
+
+    server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield Handler, server.server_address[1]
+    server.shutdown()
+    server.server_close()
+    thread.join(timeout=5)
+
+
+def make_informer(**kwargs):
+    store = ObjectStore()
+    informer = StatesInformer(store, NODE, MetricCache(),
+                              report_interval_seconds=60, **kwargs)
+    return store, informer
+
+
+def test_registry_instantiates_all_reference_plugins():
+    _, informer = make_informer()
+    # registry.go:21-28 names + the device reporter
+    assert set(informer.plugins) == {
+        "nodeSLOInformer", "pvcInformer", "nodeTopoInformer", "nodeInformer",
+        "podsInformer", "nodeMetricInformer", "deviceInformer",
+    }
+    assert len(DEFAULT_PLUGIN_REGISTRY) >= 6
+
+
+def test_kubelet_stub_parses_pods_and_configz(kubelet):
+    _, port = kubelet
+    stub = KubeletStub("127.0.0.1", port)
+    pods = stub.get_all_pods()
+    assert {p.meta.name for p in pods} == {"web-0", "db-0"}
+    web = next(p for p in pods if p.meta.name == "web-0")
+    # 500m + 100m sidecar summed, memory 1Gi, priority and labels decoded
+    assert web.spec.requests[ResourceName.CPU] == 600
+    assert web.spec.requests[ResourceName.MEMORY] == 1024**3
+    assert web.spec.limits[ResourceName.CPU] == 500
+    assert web.spec.priority == 9000
+    assert web.spec.node_name == NODE
+    assert web.phase == "Running"
+    assert stub.get_kubelet_configuration()["cpuManagerPolicy"] == "static"
+
+
+def test_kubelet_stub_error_paths(kubelet):
+    _, port = kubelet
+    bad = KubeletStub("127.0.0.1", 1, timeout_seconds=0.2)  # nothing listens
+    with pytest.raises(KubeletError):
+        bad.get_all_pods()
+
+
+def test_pods_informer_pulls_from_kubelet(kubelet):
+    handler, port = kubelet
+    _, informer = make_informer(kubelet_stub=KubeletStub("127.0.0.1", port))
+    assert not informer.has_synced()
+    informer.sync(now=1000.0)
+    assert informer.has_synced()
+    assert {p.meta.name for p in informer.get_all_pods()} == {"web-0", "db-0"}
+    assert informer.get_pod_by_uid("uid-web-0").meta.name == "web-0"
+
+
+def test_pleg_pod_added_triggers_early_resync(kubelet):
+    """The VERDICT-required chain: PLEG event -> pods informer resyncs from
+    the kubelet before the periodic interval elapses (states_pods.go:102-126)."""
+    handler, port = kubelet
+    _, informer = make_informer(
+        kubelet_stub=KubeletStub("127.0.0.1", port), kubelet_sync_interval=30.0
+    )
+    informer.sync(now=1000.0)
+    assert informer.get_pod_by_uid("uid-new") is None
+
+    # a new pod appears on the kubelet; next tick is inside the interval, so
+    # without PLEG nothing would be pulled
+    handler.pods = handler.pods + [k8s_pod("new-0", "uid-new")]
+    informer.sync(now=1005.0)
+    assert informer.get_pod_by_uid("uid-new") is None
+
+    # PLEG notices the pod cgroup dir and fires pod_added
+    pods_informer = informer.plugins["podsInformer"]
+    pods_informer._on_pleg_event(PodLifecycleEvent("pod_added", "pod-uid-new"))
+    informer.sync(now=1006.0)
+    assert informer.get_pod_by_uid("uid-new").meta.name == "new-0"
+
+
+def test_pods_informer_keeps_view_on_kubelet_crash(kubelet):
+    handler, port = kubelet
+    _, informer = make_informer(
+        kubelet_stub=KubeletStub("127.0.0.1", port), kubelet_sync_interval=1.0
+    )
+    informer.sync(now=1000.0)
+    assert len(informer.get_all_pods()) == 2
+    # kubelet recovering from crash returns an empty list: keep last good view
+    handler.pods = []
+    informer.sync(now=1010.0)
+    assert len(informer.get_all_pods()) == 2
+
+
+def test_pods_informer_store_mode_unchanged():
+    store, informer = make_informer()
+    pod = Pod(meta=ObjectMeta(name="p", uid="u1"),
+              spec=PodSpec(node_name=NODE))
+    store.add(KIND_POD, pod)
+    assert informer.get_pod_by_uid("u1") is pod
+    assert [p.meta.name for p in informer.get_all_pods()] == ["p"]
+
+
+def test_pvc_informer_volume_name_map():
+    store, informer = make_informer()
+    pvc = PersistentVolumeClaim(
+        meta=ObjectMeta(name="data", namespace="apps"), volume_name="pv-42"
+    )
+    store.add(KIND_PVC, pvc)
+    assert informer.get_volume_name("apps", "data") == "pv-42"
+    assert informer.get_volume_name("apps", "missing") == ""
+    store.delete(KIND_PVC, "apps/data")
+    assert informer.get_volume_name("apps", "data") == ""
+
+
+def test_device_informer_publishes_device_cr():
+    inventory = [
+        DeviceInfo(type="gpu", uuid="TPU-0", minor=0, health=True,
+                   resources=ResourceList.of(gpu_core=100, gpu_memory=16 * 1024**3,
+                                             gpu_memory_ratio=100)),
+        DeviceInfo(type="gpu", uuid="TPU-1", minor=1, health=True,
+                   resources=ResourceList.of(gpu_core=100, gpu_memory=16 * 1024**3,
+                                             gpu_memory_ratio=100)),
+    ]
+    store, informer = make_informer(device_collector=lambda: list(inventory))
+    informer.sync(now=1000.0)
+    device = store.get(KIND_DEVICE, f"/{NODE}")
+    assert device is not None
+    assert [d.uuid for d in device.devices] == ["TPU-0", "TPU-1"]
+
+    # unchanged inventory: no store churn
+    rv = device.meta.resource_version
+    informer.sync(now=1060.0)
+    assert store.get(KIND_DEVICE, f"/{NODE}").meta.resource_version == rv
+
+    # a chip goes unhealthy: CR updated
+    inventory[1].health = False
+    informer.sync(now=1120.0)
+    device = store.get(KIND_DEVICE, f"/{NODE}")
+    assert [d.health for d in device.devices] == [True, False]
+    assert device.meta.resource_version != rv
