@@ -5,6 +5,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/../koordinator_tpu/runtimeproxy"
 protoc --python_out=. -I. api.proto
+protoc --python_out=. -I. cri.proto
 cd ../scheduler
 protoc --python_out=. -I. sidecar.proto
-echo "generated api_pb2.py + sidecar_pb2.py"
+echo "generated api_pb2.py + cri_pb2.py + sidecar_pb2.py"

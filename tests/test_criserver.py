@@ -1,0 +1,258 @@
+"""CRI proxy e2e over real gRPC/UDS sockets: kubelet-shaped client -> proxy
+socket -> hook server socket -> backend (fake containerd) socket, matching the
+reference koord-runtime-proxy topology (pkg/runtimeproxy/server/cri/)."""
+
+import os
+import tempfile
+
+import grpc
+import pytest
+
+from koordinator_tpu.runtimeproxy import api_pb2, cri_pb2
+from koordinator_tpu.runtimeproxy.criserver import (
+    CRIClient,
+    CRIProxyServer,
+    FakeContainerdServer,
+)
+from koordinator_tpu.runtimeproxy.hookclient import HookClient, serve_hook_service
+from koordinator_tpu.runtimeproxy.server import FailurePolicy
+
+
+class RecordingHookHandler:
+    """Hook server that tags everything it can rewrite, and records requests."""
+
+    def __init__(self):
+        self.calls = []
+
+    def _record(self, method, request):
+        self.calls.append((method, request))
+
+    def PreRunPodSandboxHook(self, request):
+        self._record("PreRunPodSandboxHook", request)
+        res = api_pb2.PodSandboxHookResponse(cgroup_parent="/kubepods/ls")
+        res.annotations["koordinator.sh/hooked"] = "true"
+        return res
+
+    def PostStopPodSandboxHook(self, request):
+        self._record("PostStopPodSandboxHook", request)
+        return api_pb2.PodSandboxHookResponse()
+
+    def PreCreateContainerHook(self, request):
+        self._record("PreCreateContainerHook", request)
+        res = api_pb2.ContainerResourceHookResponse(
+            resources=api_pb2.LinuxContainerResources(
+                cpu_shares=512, cpuset_cpus="0-3", cpu_bvt_warp_ns=2
+            )
+        )
+        res.env["KOORD_QOS"] = "LS"
+        return res
+
+    def PreStartContainerHook(self, request):
+        self._record("PreStartContainerHook", request)
+        return api_pb2.ContainerResourceHookResponse()
+
+    def PostStartContainerHook(self, request):
+        self._record("PostStartContainerHook", request)
+        return api_pb2.ContainerResourceHookResponse()
+
+    def PreUpdateContainerResourcesHook(self, request):
+        self._record("PreUpdateContainerResourcesHook", request)
+        return api_pb2.ContainerResourceHookResponse(
+            resources=api_pb2.LinuxContainerResources(cpu_quota=150000)
+        )
+
+    def PostStopContainerHook(self, request):
+        self._record("PostStopContainerHook", request)
+        return api_pb2.ContainerResourceHookResponse()
+
+
+@pytest.fixture
+def sockets():
+    with tempfile.TemporaryDirectory() as tmp:
+        yield (os.path.join(tmp, "proxy.sock"),
+               os.path.join(tmp, "containerd.sock"),
+               os.path.join(tmp, "hooks.sock"))
+
+
+@pytest.fixture
+def topology(sockets):
+    """hook server + fake containerd + proxy, all on real UDS gRPC."""
+    proxy_sock, backend_sock, hook_sock = sockets
+    handler = RecordingHookHandler()
+    hook_server = serve_hook_service(handler, hook_sock)
+    backend = FakeContainerdServer(backend_sock)
+    backend.start()
+    proxy = CRIProxyServer(proxy_sock, backend_sock,
+                           hook_client=HookClient(hook_sock))
+    proxy.start()
+    kubelet = CRIClient(proxy_sock)
+    yield kubelet, proxy, backend, handler, hook_server, sockets
+    kubelet.close()
+    proxy.stop()
+    backend.stop()
+    hook_server.stop(grace=None)
+
+
+def run_sandbox_request(name="web-0", uid="uid-1"):
+    req = cri_pb2.RunPodSandboxRequest()
+    req.config.metadata.name = name
+    req.config.metadata.namespace = "default"
+    req.config.metadata.uid = uid
+    req.config.labels["app"] = name
+    req.config.linux.cgroup_parent = "/kubepods/burstable"
+    return req
+
+
+def create_container_request(sandbox_id, name="main"):
+    req = cri_pb2.CreateContainerRequest(pod_sandbox_id=sandbox_id)
+    req.config.metadata.name = name
+    req.config.envs.add(key="PATH", value="/bin")
+    req.config.linux.resources.cpu_shares = 1024
+    req.config.linux.resources.memory_limit_in_bytes = 1 << 30
+    return req
+
+
+def test_full_lifecycle_through_real_sockets(topology):
+    kubelet, proxy, backend, handler, _, _ = topology
+
+    sandbox = kubelet.call("RunPodSandbox", run_sandbox_request())
+    assert sandbox.pod_sandbox_id == "sandbox-1"
+    method, forwarded = backend.requests[-1]
+    assert method == "RunPodSandbox"
+    # hook mutations arrived at containerd
+    assert forwarded.config.annotations["koordinator.sh/hooked"] == "true"
+    assert forwarded.config.linux.cgroup_parent == "/kubepods/ls"
+
+    created = kubelet.call(
+        "CreateContainer", create_container_request(sandbox.pod_sandbox_id)
+    )
+    method, forwarded = backend.requests[-1]
+    res = forwarded.config.linux.resources
+    assert res.cpu_shares == 512               # hook override
+    assert res.memory_limit_in_bytes == 1 << 30  # original preserved
+    assert res.cpuset_cpus == "0-3"
+    assert res.unified["cpu.bvt_warp_ns"] == "2"
+    env = {kv.key: kv.value for kv in forwarded.config.envs}
+    assert env == {"PATH": "/bin", "KOORD_QOS": "LS"}
+    # the hook saw the pod context resolved from the proxy's store
+    hook_req = handler.calls[-1][1]
+    assert hook_req.pod_meta.name == "web-0"
+    assert hook_req.pod_meta.cgroup_parent == "/kubepods/ls"
+
+    kubelet.call("StartContainer",
+                 cri_pb2.StartContainerRequest(container_id=created.container_id))
+    assert handler.calls[-1][0] == "PreStartContainerHook"
+
+    kubelet.call(
+        "UpdateContainerResources",
+        cri_pb2.UpdateContainerResourcesRequest(
+            container_id=created.container_id,
+            linux=cri_pb2.LinuxContainerResources(cpu_quota=100000),
+        ),
+    )
+    method, forwarded = backend.requests[-1]
+    assert forwarded.linux.cpu_quota == 150000  # hook override
+
+    kubelet.call("StopContainer",
+                 cri_pb2.StopContainerRequest(container_id=created.container_id))
+    assert handler.calls[-1][0] == "PostStopContainerHook"
+    assert handler.calls[-1][1].container_meta.id == created.container_id
+
+    kubelet.call("StopPodSandbox",
+                 cri_pb2.StopPodSandboxRequest(
+                     pod_sandbox_id=sandbox.pod_sandbox_id))
+    assert handler.calls[-1][0] == "PostStopPodSandboxHook"
+    assert handler.calls[-1][1].pod_meta.name == "web-0"
+
+
+def test_unknown_methods_pass_through_as_raw_bytes(topology):
+    kubelet, _, backend, _, _, _ = topology
+    payload = cri_pb2.VersionRequest(version="v1").SerializeToString()
+    raw = kubelet.call_raw("Version", payload)
+    version = cri_pb2.VersionResponse.FromString(raw)
+    assert version.runtime_name == "fake-containerd"
+    assert backend.raw_calls == [("Version", payload)]
+
+
+def test_hook_server_death_ignore_policy(topology):
+    kubelet, proxy, backend, _, hook_server, _ = topology
+    hook_server.stop(grace=None)
+    sandbox = kubelet.call("RunPodSandbox", run_sandbox_request())
+    created = kubelet.call(
+        "CreateContainer", create_container_request(sandbox.pod_sandbox_id)
+    )
+    assert created.container_id
+    _, forwarded = backend.requests[-1]
+    # no hook: original request forwarded untouched
+    assert forwarded.config.linux.resources.cpu_shares == 1024
+    assert forwarded.config.linux.resources.cpuset_cpus == ""
+
+
+def test_hook_server_death_fail_policy(sockets):
+    proxy_sock, backend_sock, hook_sock = sockets
+    hook_server = serve_hook_service(RecordingHookHandler(), hook_sock)
+    backend = FakeContainerdServer(backend_sock)
+    backend.start()
+    proxy = CRIProxyServer(proxy_sock, backend_sock,
+                           hook_client=HookClient(hook_sock),
+                           failure_policy=FailurePolicy.FAIL)
+    proxy.start()
+    kubelet = CRIClient(proxy_sock)
+    try:
+        hook_server.stop(grace=None)
+        with pytest.raises(grpc.RpcError) as err:
+            kubelet.call("RunPodSandbox", run_sandbox_request())
+        assert err.value.code() == grpc.StatusCode.INTERNAL
+        # nothing beyond the startup failover List* reached containerd
+        assert [m for m, _ in backend.requests] == [
+            "ListPodSandbox", "ListContainers"
+        ]
+    finally:
+        kubelet.close()
+        proxy.stop()
+        backend.stop()
+
+
+def test_failover_rebuilds_store_from_backend(sockets):
+    """Proxy restart: the new instance replays List* from the backend so hook
+    requests keep their pod/container context (criserver.go failOver)."""
+    proxy_sock, backend_sock, hook_sock = sockets
+    handler = RecordingHookHandler()
+    hook_server = serve_hook_service(handler, hook_sock)
+    backend = FakeContainerdServer(backend_sock)
+    backend.start()
+
+    proxy = CRIProxyServer(proxy_sock, backend_sock,
+                           hook_client=HookClient(hook_sock))
+    proxy.start()
+    kubelet = CRIClient(proxy_sock)
+    sandbox = kubelet.call("RunPodSandbox", run_sandbox_request())
+    created = kubelet.call(
+        "CreateContainer", create_container_request(sandbox.pod_sandbox_id)
+    )
+    kubelet.close()
+    proxy.stop()
+
+    proxy2_sock = proxy_sock + "2"
+    proxy2 = CRIProxyServer(proxy2_sock, backend_sock,
+                            hook_client=HookClient(hook_sock))
+    proxy2.start()
+    kubelet2 = CRIClient(proxy2_sock)
+    try:
+        assert sandbox.pod_sandbox_id in proxy2.pod_store
+        kubelet2.call(
+            "UpdateContainerResources",
+            cri_pb2.UpdateContainerResourcesRequest(
+                container_id=created.container_id,
+                linux=cri_pb2.LinuxContainerResources(cpu_quota=50000),
+            ),
+        )
+        method, hook_req = handler.calls[-1]
+        assert method == "PreUpdateContainerResourcesHook"
+        assert hook_req.pod_meta.name == "web-0"  # context survived restart
+        assert hook_req.container_meta.name == "main"
+    finally:
+        kubelet2.close()
+        proxy2.stop()
+        backend.stop()
+        hook_server.stop(grace=None)
