@@ -297,9 +297,13 @@ class CRIProxyServer:
         res = self._call_hook("PreCreateContainerHook", hook_req, context)
         if res is not None:
             _merge_hook_into_cri(request.config.linux.resources, res.resources)
-            existing = {kv.key for kv in request.config.envs}
+            # hook env wins on key collision (same semantics as the
+            # in-process RuntimeProxy merge in server.py)
+            by_key = {kv.key: kv for kv in request.config.envs}
             for k, v in res.env.items():
-                if k not in existing:
+                if k in by_key:
+                    by_key[k].value = v
+                else:
                     request.config.envs.add(key=k, value=v)
         response = self.backend.call("CreateContainer", request)
         container_meta.id = response.container_id

@@ -120,6 +120,14 @@ class Scheduler:
                 self.config.reservation.gc_duration_seconds)
             if res_plugin else None
         )
+        if res_plugin is not None:
+            from koordinator_tpu.scheduler.plugins.reservation import (
+                ReservationRestoreTransformer,
+            )
+
+            self.extender.register_transformer(
+                ReservationRestoreTransformer(store)
+            )
         quota_plugin = self.extender.plugin("ElasticQuota")
         self.quota_revoke_controller = (
             quota_plugin.revoke_controller(store, self.config.elastic_quota)
@@ -167,32 +175,21 @@ class Scheduler:
                 reservations[pseudo.meta.key] = res
         return pods, reservations
 
-    def _assigned_requests(self, now: float) -> Dict[str, np.ndarray]:
-        """Fit state per node: assigned pods + unconsumed reserved resources.
-        Pods allocated FROM a reservation are counted inside the reservation's
-        allocatable (avoid double counting) — but only while that reservation
-        itself is still counted; once it expires/fails, its owner pods must be
-        accounted directly or the node silently overcommits."""
+    def _assigned_requests(self) -> Dict[str, np.ndarray]:
+        """Base fit state per node: every assigned pod's requests. Reservation
+        accounting (reserved capacity + double-count restore) is layered on by
+        ReservationRestoreTransformer via the declared before-Filter extension
+        point — a custom transformer can rewrite the same view."""
         out: Dict[str, np.ndarray] = {}
-
-        def add(node: str, vec: np.ndarray) -> None:
+        for pod in self.store.list(KIND_POD):
+            if not pod.is_assigned or pod.is_terminated:
+                continue
+            vec = with_pod_count(pod.spec.requests.to_vector()[None])[0]
+            node = pod.spec.node_name
             if node in out:
                 out[node] = out[node] + vec
             else:
                 out[node] = vec.astype(np.float32)
-
-        counted_reservations = set()
-        for res in self.store.list(KIND_RESERVATION):
-            if res.is_available and not res.is_expired(now):
-                counted_reservations.add(res.meta.name)
-                add(res.node_name, res.allocatable.to_vector())
-        for pod in self.store.list(KIND_POD):
-            if not pod.is_assigned or pod.is_terminated:
-                continue
-            res_name = pod.meta.annotations.get(ANNOTATION_RESERVATION_ALLOCATED)
-            if res_name and res_name in counted_reservations:
-                continue
-            add(pod.spec.node_name, with_pod_count(pod.spec.requests.to_vector()[None])[0])
         return out
 
     def _cluster_state(self, pending: List[Pod], now: float) -> ClusterState:
@@ -208,7 +205,7 @@ class Scheduler:
             },
             pods_by_key={p.meta.key: p for p in self.store.list(KIND_POD)},
             assigned=la.assigned_view() if la else {},
-            assigned_requests=self._assigned_requests(now),
+            assigned_requests=self._assigned_requests(),
             topologies=dict(numa.topologies) if numa else {},
             cpu_states=dict(numa.cpu_states) if numa else {},
             numa_allocated=dict(numa.numa_allocated) if numa else {},
@@ -307,7 +304,16 @@ class Scheduler:
                 any_victims = True
                 result.preempted_victims.extend(round_.victim_keys)
             if any_victims:
-                retry = rejected_pods + [p for p, _ in failed_pods]
+                # retry from the ORIGINAL queued pods, not the transformed
+                # views _batch_pass returned — re-running the transformer
+                # chain over an already-transformed view would apply
+                # non-idempotent rewrites twice (BeforePreFilter runs per
+                # attempt on the queued pod in the reference too)
+                originals = {p.meta.key: p for p in pending}
+                retry = [
+                    originals.get(p.meta.key, p)
+                    for p in rejected_pods + [p for p, _ in failed_pods]
+                ]
                 rejected_pods, failed_pods = self._batch_pass(
                     retry, now, ctx, result, pending_reservations
                 )
@@ -340,12 +346,18 @@ class Scheduler:
         the caller decides whether to retry them (preemption) or record them."""
         rejected_pods: List[Pod] = []
         failed_pods: List[Tuple[Pod, str]] = []
+        # transformer chain (frameworkext/interface.go:78-97): per-pod view
+        # rewrites, then ClusterState rewrites, then packed-input rewrites
+        pending = self.extender.transform_before_prefilter(pending, ctx)
         state = self._cluster_state(pending, now)
+        self.extender.transform_after_prefilter(state, ctx)
+        self.extender.transform_before_filter(state, ctx)
         if not state.nodes:
             return rejected_pods, [(p, "no schedulable node") for p in pending]
         fc, pods, nodes, tree, gang_index, ng, ngroups = build_full_chain_inputs(
             state, self.args
         )
+        fc = self.extender.transform_before_score(fc, ctx)
         fc, active = reduce_to_active_axes(fc)
         step = self._get_step(
             (pods.padded_size, nodes.padded_size, fc.quota_runtime.shape[0]),

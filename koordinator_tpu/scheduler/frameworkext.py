@@ -75,6 +75,49 @@ class CycleContext:
     data: Dict[str, Any] = field(default_factory=dict)
 
 
+class SchedulingTransformer:
+    """Declared view-transform extension point (frameworkext/interface.go:78-97).
+
+    The reference runs Before/After hooks per (pod, node) inside the Go
+    framework; in the batched architecture the same power lives at the three
+    places a view exists on host:
+
+      * ``PreFilterTransformer.before_prefilter`` — rewrite one pending pod's
+        view before it is packed (BeforePreFilter: return a replacement, never
+        mutate the stored object)
+      * ``PreFilterTransformer.after_prefilter`` / ``FilterTransformer.
+        before_filter`` — rewrite the assembled ClusterState (the batched
+        nodeInfo view) before packing
+      * ``ScoreTransformer.before_score`` — rewrite the packed
+        FullChainInputs before the kernel launches (BeforeScore over all
+        nodes at once).
+    """
+
+    name = "transformer"
+
+
+class PreFilterTransformer(SchedulingTransformer):
+    def before_prefilter(self, pod: Pod, ctx: "CycleContext") -> Optional[Pod]:
+        """Return a replacement pod view for this cycle, or None to keep."""
+        return None
+
+    def after_prefilter(self, state, ctx: "CycleContext") -> None:
+        """Adjust the assembled ClusterState after per-pod transforms ran."""
+        return None
+
+
+class FilterTransformer(SchedulingTransformer):
+    def before_filter(self, state, ctx: "CycleContext") -> None:
+        """Rewrite node-side views (assigned_requests, topologies, ...)."""
+        return None
+
+
+class ScoreTransformer(SchedulingTransformer):
+    def before_score(self, inputs, ctx: "CycleContext"):
+        """Return replacement FullChainInputs, or None to keep."""
+        return None
+
+
 class SchedulerMonitor:
     """Slow/stuck cycle watchdog (frameworkext/scheduler_monitor.go:44-108).
     History is a bounded window; totals are running counters so a long-running
@@ -215,6 +258,7 @@ class FrameworkExtender:
     def __init__(self, store: ObjectStore):
         self.store = store
         self.plugins: List[Plugin] = []
+        self.transformers: List[SchedulingTransformer] = []
         self.monitor = SchedulerMonitor()
         self.error_handlers = ErrorHandlerDispatcher()
         self.services = ServicesEngine(self)
@@ -223,6 +267,44 @@ class FrameworkExtender:
     def register_plugin(self, plugin: Plugin) -> None:
         self.plugins.append(plugin)
         plugin.register(self.store)
+
+    def register_transformer(self, transformer: SchedulingTransformer) -> None:
+        """Transformers run in registration order at each stage
+        (framework_extender.go runTransformers)."""
+        self.transformers.append(transformer)
+
+    # -- transformer dispatch (interface.go:78-97) ---------------------------
+    def transform_before_prefilter(self, pods: List[Pod],
+                                   ctx: CycleContext) -> List[Pod]:
+        if not self.transformers:
+            return pods
+        out = []
+        for pod in pods:
+            for t in self.transformers:
+                if isinstance(t, PreFilterTransformer):
+                    replaced = t.before_prefilter(pod, ctx)
+                    if replaced is not None:
+                        pod = replaced
+            out.append(pod)
+        return out
+
+    def transform_after_prefilter(self, state, ctx: CycleContext) -> None:
+        for t in self.transformers:
+            if isinstance(t, PreFilterTransformer):
+                t.after_prefilter(state, ctx)
+
+    def transform_before_filter(self, state, ctx: CycleContext) -> None:
+        for t in self.transformers:
+            if isinstance(t, FilterTransformer):
+                t.before_filter(state, ctx)
+
+    def transform_before_score(self, inputs, ctx: CycleContext):
+        for t in self.transformers:
+            if isinstance(t, ScoreTransformer):
+                replaced = t.before_score(inputs, ctx)
+                if replaced is not None:
+                    inputs = replaced
+        return inputs
 
     def plugin(self, name: str) -> Optional[Plugin]:
         for p in self.plugins:

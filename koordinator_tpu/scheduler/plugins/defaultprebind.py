@@ -26,10 +26,12 @@ class DefaultPreBindPlugin(Plugin):
 
     def apply_patch(self, pod: Pod, node_name: str,
                     annotations: Dict[str, str]) -> None:
-        # patch a COPY: watch subscribers diff old vs new, and in-place mutation
-        # of the stored object would make them indistinguishable (the reference
-        # patches via the apiserver, which has the same copy semantics)
-        patched = copy.deepcopy(pod)
+        # patch a COPY of the STORED object: watch subscribers diff old vs new,
+        # and `pod` may be a cycle-local transformer view (BeforePreFilter
+        # semantics) whose rewrites must not persist — the reference patches
+        # nodeName/annotations via the apiserver against the server's copy
+        stored = self._store.get(KIND_POD, pod.meta.key)
+        patched = copy.deepcopy(stored if stored is not None else pod)
         patched.meta.annotations.update(annotations)
         patched.spec.node_name = node_name
         self._store.update(KIND_POD, patched)

@@ -31,7 +31,55 @@ from koordinator_tpu.client.store import (
     EventType,
     ObjectStore,
 )
-from koordinator_tpu.scheduler.frameworkext import CycleContext, Plugin
+from koordinator_tpu.scheduler.frameworkext import (
+    CycleContext,
+    FilterTransformer,
+    Plugin,
+)
+
+
+class ReservationRestoreTransformer(FilterTransformer):
+    """Reservation restore through the declared before-Filter extension point
+    (reference plugins/reservation/transformer.go BeforeFilter: expand the
+    nodeInfo view with reserved resources so owner pods fit).
+
+    Batched form: the base snapshot counts every assigned pod; this transform
+    (a) adds each Available reservation's held capacity to its node's
+    assigned_requests, and (b) subtracts pods allocated FROM a counted
+    reservation, since their usage lives inside the reservation's allocatable
+    (double-count restore). Expired/failed reservations are skipped, so their
+    consumers fall back to direct accounting and the node never overcommits."""
+
+    name = "ReservationRestore"
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def before_filter(self, state, ctx: CycleContext) -> None:
+        out = state.assigned_requests
+
+        def add(node: str, vec: np.ndarray) -> None:
+            if node in out:
+                out[node] = out[node] + vec
+            else:
+                out[node] = vec.astype(np.float32)
+
+        counted = set()
+        for res in self.store.list(KIND_RESERVATION):
+            if res.is_available and not res.is_expired(ctx.now):
+                counted.add(res.meta.name)
+                add(res.node_name, res.allocatable.to_vector())
+        if not counted:
+            return
+        from koordinator_tpu.ops.fit import with_pod_count
+
+        for pod in state.pods_by_key.values():
+            if not pod.is_assigned or pod.is_terminated:
+                continue
+            res_name = pod.meta.annotations.get(ANNOTATION_RESERVATION_ALLOCATED)
+            if res_name and res_name in counted:
+                add(pod.spec.node_name,
+                    -with_pod_count(pod.spec.requests.to_vector()[None])[0])
 
 
 class ReservationPlugin(Plugin):

@@ -107,6 +107,7 @@ def create_container_request(sandbox_id, name="main"):
     req = cri_pb2.CreateContainerRequest(pod_sandbox_id=sandbox_id)
     req.config.metadata.name = name
     req.config.envs.add(key="PATH", value="/bin")
+    req.config.envs.add(key="KOORD_QOS", value="BE")  # hook must override
     req.config.linux.resources.cpu_shares = 1024
     req.config.linux.resources.memory_limit_in_bytes = 1 << 30
     return req
@@ -133,7 +134,9 @@ def test_full_lifecycle_through_real_sockets(topology):
     assert res.cpuset_cpus == "0-3"
     assert res.unified["cpu.bvt_warp_ns"] == "2"
     env = {kv.key: kv.value for kv in forwarded.config.envs}
+    # PATH preserved; pre-existing KOORD_QOS=BE overridden by the hook's LS
     assert env == {"PATH": "/bin", "KOORD_QOS": "LS"}
+    assert len(forwarded.config.envs) == 2  # override, not duplicate
     # the hook saw the pod context resolved from the proxy's store
     hook_req = handler.calls[-1][1]
     assert hook_req.pod_meta.name == "web-0"

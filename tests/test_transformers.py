@@ -1,0 +1,232 @@
+"""frameworkext transformer extension point: custom Before/After
+PreFilter/Filter/Score hooks rewrite pod and node views without touching
+snapshot code (reference pkg/scheduler/frameworkext/interface.go:78-97)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.objects import Node, ObjectMeta, Pod, PodSpec
+from koordinator_tpu.api.resources import ResourceList, ResourceName
+from koordinator_tpu.client.store import KIND_NODE, KIND_POD, ObjectStore
+from koordinator_tpu.scheduler.cycle import Scheduler
+from koordinator_tpu.scheduler.frameworkext import (
+    FilterTransformer,
+    PreFilterTransformer,
+    ScoreTransformer,
+)
+
+GIB = 1024**3
+NOW = 1_700_000_000.0
+
+
+def make_cluster(n_nodes=2, cpu=8000):
+    store = ObjectStore()
+    for i in range(n_nodes):
+        store.add(
+            KIND_NODE,
+            Node(meta=ObjectMeta(name=f"node-{i}", namespace=""),
+                 allocatable=ResourceList.of(cpu=cpu, memory=32 * GIB, pods=110)),
+        )
+    return store
+
+
+def make_pod(name="p0", cpu=4000, annotations=None):
+    return Pod(
+        meta=ObjectMeta(name=name, uid=f"uid-{name}",
+                        annotations=dict(annotations or {})),
+        spec=PodSpec(requests=ResourceList.of(cpu=cpu, memory=GIB)),
+    )
+
+
+class HalveOverRequestTransformer(PreFilterTransformer):
+    """Rewrites the pod VIEW: pods annotated half-me schedule with half their
+    cpu request. Never mutates the stored pod."""
+
+    name = "HalveOverRequest"
+
+    def before_prefilter(self, pod, ctx):
+        if pod.meta.annotations.get("example.com/half-me") != "true":
+            return None
+        view = dataclasses.replace(
+            pod,
+            spec=dataclasses.replace(
+                pod.spec,
+                requests=ResourceList.of(
+                    cpu=pod.spec.requests[ResourceName.CPU] // 2,
+                    memory=pod.spec.requests[ResourceName.MEMORY],
+                ),
+            ),
+        )
+        return view
+
+
+def test_prefilter_transformer_rewrites_pod_view_without_snapshot_changes():
+    store = make_cluster(n_nodes=1, cpu=5000)
+    scheduler = Scheduler(store)
+    scheduler.extender.register_transformer(HalveOverRequestTransformer())
+    # requests 8000m > node 5000m: only the transformed (4000m) view can fit
+    pod = make_pod(cpu=8000, annotations={"example.com/half-me": "true"})
+    store.add(KIND_POD, pod)
+    result = scheduler.run_cycle(now=NOW)
+    assert [b.node_name for b in result.bound] == ["node-0"]
+    # the transform was a cycle-local view: the stored pod keeps its request
+    stored = store.get(KIND_POD, pod.meta.key)
+    assert stored.spec.requests[ResourceName.CPU] == 8000
+    assert stored.spec.node_name == "node-0"
+
+
+def test_prefilter_transformer_not_applied_without_annotation():
+    store = make_cluster(n_nodes=1, cpu=5000)
+    scheduler = Scheduler(store)
+    scheduler.extender.register_transformer(HalveOverRequestTransformer())
+    store.add(KIND_POD, make_pod(cpu=8000))
+    result = scheduler.run_cycle(now=NOW)
+    assert result.bound == []
+    assert len(result.failed) == 1
+
+
+class DrainNodeTransformer(FilterTransformer):
+    """Rewrites the node view: marks one node's capacity as fully assigned
+    (a custom drain) without any snapshot/cycle code knowing about it."""
+
+    name = "DrainNode"
+
+    def __init__(self, node_name):
+        self.node_name = node_name
+
+    def before_filter(self, state, ctx):
+        for node in state.nodes:
+            if node.meta.name == self.node_name:
+                state.assigned_requests[self.node_name] = (
+                    node.allocatable.to_vector().astype(np.float32)
+                )
+
+
+def test_filter_transformer_rewrites_node_view():
+    store = make_cluster(n_nodes=2)
+    scheduler = Scheduler(store)
+    scheduler.extender.register_transformer(DrainNodeTransformer("node-0"))
+    for i in range(3):
+        store.add(KIND_POD, make_pod(name=f"p{i}", cpu=1000))
+    result = scheduler.run_cycle(now=NOW)
+    assert len(result.bound) == 3
+    assert {b.node_name for b in result.bound} == {"node-1"}
+
+
+class PinToNodeScoreTransformer(ScoreTransformer):
+    """Rewrites the packed inputs before the kernel: masks node_ok so only
+    the pinned node stays eligible (all candidate-set rewrites ride here)."""
+
+    name = "PinToNode"
+
+    def __init__(self, node_idx):
+        self.node_idx = node_idx
+
+    def before_score(self, inputs, ctx):
+        node_ok = np.asarray(inputs.base.node_ok).copy()
+        node_ok[: self.node_idx] = False
+        node_ok[self.node_idx + 1:] = False
+        return inputs._replace(base=inputs.base._replace(node_ok=node_ok))
+
+
+def test_score_transformer_rewrites_packed_inputs():
+    store = make_cluster(n_nodes=4)
+    scheduler = Scheduler(store)
+    scheduler.extender.register_transformer(PinToNodeScoreTransformer(2))
+    store.add(KIND_POD, make_pod(cpu=500))
+    result = scheduler.run_cycle(now=NOW)
+    assert [b.node_name for b in result.bound] == ["node-2"]
+
+
+def test_transformers_chain_in_registration_order():
+    store = make_cluster(n_nodes=1)
+    scheduler = Scheduler(store)
+    calls = []
+
+    class Recorder(PreFilterTransformer):
+        def __init__(self, tag):
+            self.tag = tag
+
+        def before_prefilter(self, pod, ctx):
+            calls.append((self.tag, "before", pod.meta.name))
+            return None
+
+        def after_prefilter(self, state, ctx):
+            calls.append((self.tag, "after", len(state.pending_pods)))
+
+    scheduler.extender.register_transformer(Recorder("a"))
+    scheduler.extender.register_transformer(Recorder("b"))
+    store.add(KIND_POD, make_pod(cpu=500))
+    scheduler.run_cycle(now=NOW)
+    assert calls == [
+        ("a", "before", "p0"), ("b", "before", "p0"),
+        ("a", "after", 1), ("b", "after", 1),
+    ]
+
+
+def test_preemption_retry_does_not_double_transform():
+    """The quota-preemption retry pass must re-run transformers over the
+    ORIGINAL queued pods, not the first pass's transformed views — a
+    non-idempotent rewrite applied twice would corrupt the view."""
+    from koordinator_tpu.api.objects import ElasticQuota, LABEL_QUOTA_NAME
+    from koordinator_tpu.client.store import KIND_ELASTIC_QUOTA
+
+    store = make_cluster(n_nodes=1, cpu=4000)
+    store.add(KIND_ELASTIC_QUOTA, ElasticQuota(
+        meta=ObjectMeta(name="team", namespace="default"),
+        max=ResourceList.of(cpu=4000, memory=2 * GIB),
+        min=ResourceList.of(cpu=4000, memory=2 * GIB),
+    ))
+    scheduler = Scheduler(store)
+    seen = []
+
+    class TagOnce(PreFilterTransformer):
+        name = "TagOnce"
+
+        def before_prefilter(self, pod, ctx):
+            seen.append(pod.meta.annotations.get("example.com/transformed"))
+            view = dataclasses.replace(
+                pod,
+                meta=dataclasses.replace(
+                    pod.meta,
+                    annotations={**pod.meta.annotations,
+                                 "example.com/transformed": "true"},
+                ),
+            )
+            return view
+
+    scheduler.extender.register_transformer(TagOnce())
+    victim = Pod(
+        meta=ObjectMeta(name="victim", uid="uid-victim",
+                        labels={LABEL_QUOTA_NAME: "team"},
+                        creation_timestamp=NOW - 100),
+        spec=PodSpec(node_name="node-0", priority=6000,
+                     requests=ResourceList.of(cpu=4000, memory=GIB)),
+        phase="Running",
+    )
+    store.add(KIND_POD, victim)
+    contender = Pod(
+        meta=ObjectMeta(name="contender", uid="uid-contender",
+                        labels={LABEL_QUOTA_NAME: "team"},
+                        creation_timestamp=NOW),
+        spec=PodSpec(priority=9500,
+                     requests=ResourceList.of(cpu=4000, memory=GIB)),
+    )
+    store.add(KIND_POD, contender)
+    result = scheduler.run_cycle(now=NOW)
+    assert result.preempted_victims == ["default/victim"]
+    assert [b.pod_key for b in result.bound] == ["default/contender"]
+    # the retry pass saw the original (untagged) pod, never a tagged view
+    assert seen == [None, None]
+
+
+def test_reservation_restore_registered_as_transformer():
+    """The built-in reservation restore now rides the declared extension
+    point instead of being hard-coded in the snapshot builder."""
+    store = make_cluster()
+    scheduler = Scheduler(store)
+    assert any(
+        t.name == "ReservationRestore" for t in scheduler.extender.transformers
+    )
