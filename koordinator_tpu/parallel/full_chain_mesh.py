@@ -35,7 +35,11 @@ from koordinator_tpu.models.full_chain import (
     build_full_chain_step,
 )
 from koordinator_tpu.ops.loadaware import LoadAwareArgs
-from koordinator_tpu.parallel.mesh import _node_axis_spec, shard_inputs_nodewise
+from koordinator_tpu.parallel.mesh import (
+    _node_axis_spec,
+    put_on_mesh,
+    shard_inputs_nodewise,
+)
 
 # FullChainInputs fields indexed [N, ...] (sharded); everything else (pods,
 # quota tree, gangs) is replicated.
@@ -59,7 +63,7 @@ def shard_full_chain_inputs(fc: FullChainInputs, mesh: Mesh) -> FullChainInputs:
 
     def put(name, arr):
         spec = node_spec if name in _FC_NODE_FIELDS else P()
-        return jax.device_put(arr, NamedSharding(mesh, spec))
+        return put_on_mesh(arr, NamedSharding(mesh, spec))
 
     rest = {k: put(k, v) for k, v in fc._asdict().items() if k != "base"}
     return FullChainInputs(base=base, **rest)

@@ -14,7 +14,10 @@ Two shardings cover the framework's compute:
 
 Multi-host: the same code runs under `jax.distributed.initialize()`; mesh axes laid
 out so "nodes" stays within a slice (ICI) and "pods" may span slices (DCN), since
-the pods axis only needs its collectives at the final argmax/top-k.
+the pods axis only needs its collectives at the final argmax/top-k. Exercised by
+`tests/test_multihost.py`: two OS processes x 4 virtual CPU devices federate into
+one 8-device mesh and run the sharded full-chain step with gloo collectives
+crossing the process boundary, bit-identical to single-device.
 """
 
 from __future__ import annotations
@@ -53,6 +56,19 @@ def _node_axis_spec(mesh: Mesh, flat: bool) -> P:
     return P(("pods", "nodes")) if flat else P("nodes")
 
 
+def put_on_mesh(arr, sharding: NamedSharding):
+    """Place host data on a (possibly multi-host) sharding. Single-process
+    meshes take the fast `device_put` path; when the mesh spans processes
+    (`jax.distributed.initialize()`), each process materializes only its
+    addressable shards from the (identically computed) host array."""
+    if sharding.is_fully_addressable:
+        return jax.device_put(arr, sharding)
+    arr = np.asarray(arr)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
+
+
 def shard_inputs_nodewise(inputs: ScheduleInputs, mesh: Mesh) -> ScheduleInputs:
     """Sharding for the serial-parity step: node arrays sharded over all devices,
     pod arrays + weights replicated."""
@@ -68,7 +84,7 @@ def shard_inputs_nodewise(inputs: ScheduleInputs, mesh: Mesh) -> ScheduleInputs:
 
     def put(name, arr):
         spec = P() if name in pod_fields else node_spec
-        return jax.device_put(arr, NamedSharding(mesh, spec))
+        return put_on_mesh(arr, NamedSharding(mesh, spec))
 
     return ScheduleInputs(**{k: put(k, v) for k, v in inputs._asdict().items()})
 
@@ -84,7 +100,7 @@ def shard_inputs_2d(inputs: ScheduleInputs, mesh: Mesh) -> ScheduleInputs:
             spec = P("pods")
         else:
             spec = P("nodes")
-        return jax.device_put(arr, NamedSharding(mesh, spec))
+        return put_on_mesh(arr, NamedSharding(mesh, spec))
 
     return ScheduleInputs(**{k: put(k, v) for k, v in inputs._asdict().items()})
 

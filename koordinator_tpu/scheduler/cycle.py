@@ -257,11 +257,18 @@ class Scheduler:
             self.extender.monitor.record(result)
             return result
 
+        # ---- per-pod view transforms (BeforePreFilter) run before ANY
+        # scheduling decision — the nomination pre-pass must see the same
+        # views the kernel pass packs; originals are kept for the
+        # preemption retry, which re-transforms from scratch
+        ctx = CycleContext(now=now)
+        originals = {p.meta.key: p for p in pending}
+        pending = self.extender.transform_before_prefilter(pending, ctx)
+
         # ---- reservation nomination pre-pass. Gang/quota pods are excluded:
         # their admission barriers live in the batched kernel, and binding them
         # here would bypass min-member and quota checks.
         remaining: List[Pod] = []
-        ctx = CycleContext(now=now)
         for pod in pending:
             if (
                 pod.meta.key in pending_reservations
@@ -304,16 +311,17 @@ class Scheduler:
                 any_victims = True
                 result.preempted_victims.extend(round_.victim_keys)
             if any_victims:
-                # retry from the ORIGINAL queued pods, not the transformed
-                # views _batch_pass returned — re-running the transformer
-                # chain over an already-transformed view would apply
-                # non-idempotent rewrites twice (BeforePreFilter runs per
-                # attempt on the queued pod in the reference too)
-                originals = {p.meta.key: p for p in pending}
-                retry = [
-                    originals.get(p.meta.key, p)
-                    for p in rejected_pods + [p for p, _ in failed_pods]
-                ]
+                # retry transforms from the ORIGINAL queued pods, not the
+                # already-transformed views — a non-idempotent rewrite would
+                # otherwise apply twice (BeforePreFilter runs per attempt on
+                # the queued pod in the reference too)
+                retry = self.extender.transform_before_prefilter(
+                    [
+                        originals.get(p.meta.key, p)
+                        for p in rejected_pods + [p for p, _ in failed_pods]
+                    ],
+                    ctx,
+                )
                 rejected_pods, failed_pods = self._batch_pass(
                     retry, now, ctx, result, pending_reservations
                 )
@@ -346,9 +354,9 @@ class Scheduler:
         the caller decides whether to retry them (preemption) or record them."""
         rejected_pods: List[Pod] = []
         failed_pods: List[Tuple[Pod, str]] = []
-        # transformer chain (frameworkext/interface.go:78-97): per-pod view
-        # rewrites, then ClusterState rewrites, then packed-input rewrites
-        pending = self.extender.transform_before_prefilter(pending, ctx)
+        # pods arrive already view-transformed (run_cycle runs BeforePreFilter
+        # ahead of the nomination pre-pass); here the state-level transformer
+        # chain runs: ClusterState rewrites, then packed-input rewrites
         state = self._cluster_state(pending, now)
         self.extender.transform_after_prefilter(state, ctx)
         self.extender.transform_before_filter(state, ctx)
@@ -401,10 +409,12 @@ class Scheduler:
         """Reserve hooks -> PreBind -> Bind; returns error to leave pod pending."""
         if reservation_cr is not None:
             # binding a Reservation CR itself: no plugin reserve (it only holds
-            # capacity), just set status (reservation plugin Bind, plugin.go:596)
+            # capacity), just set status (reservation plugin Bind, plugin.go:596).
+            # Allocatable comes from the CR's own template, NOT the pseudo-pod,
+            # which may be a cycle-local transformer view that must not persist
             reservation_cr.node_name = node_name
             reservation_cr.phase = "Available"
-            reservation_cr.allocatable = pod.spec.requests.copy()
+            reservation_cr.allocatable = reservation_cr.template.requests.copy()
             self.store.update(KIND_RESERVATION, reservation_cr)
             result.bound.append(
                 BindResult(RESERVATION_POD_PREFIX + reservation_cr.meta.name,

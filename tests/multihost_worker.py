@@ -1,0 +1,75 @@
+"""Worker process for the 2-process jax.distributed multi-host test.
+
+Each process owns 4 virtual CPU devices; `jax.distributed.initialize()`
+federates them into one 8-device global mesh (the DCN analog — process
+boundary == host boundary). Both processes build the identical synthetic
+cluster, run the sharded full-chain step over the GLOBAL mesh (gloo
+collectives across the process boundary), and diff the bindings against a
+locally-computed single-device run. Prints ``MULTIHOST_OK <digest>`` so the
+parent test can also assert both processes agree.
+
+Usage: python multihost_worker.py <process_id> <num_processes> <port>
+"""
+
+import hashlib
+import os
+import re
+import sys
+
+
+def main() -> None:
+    proc_id, num_procs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    # the runtime pre-imports jax with the axon TPU platform baked into
+    # jax.config; flip it back before any backend initializes (conftest.py)
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        f"127.0.0.1:{port}", num_processes=num_procs, process_id=proc_id
+    )
+    assert jax.device_count() == 4 * num_procs, jax.devices()
+    assert jax.local_device_count() == 4
+
+    import numpy as np
+
+    from koordinator_tpu.models.full_chain import build_full_chain_step
+    from koordinator_tpu.ops.loadaware import LoadAwareArgs
+    from koordinator_tpu.parallel import (
+        build_sharded_full_chain_step,
+        make_mesh,
+        shard_full_chain_inputs,
+    )
+    from koordinator_tpu.scheduler.snapshot import build_full_chain_inputs
+    from koordinator_tpu.testing import synth_full_cluster
+
+    args = LoadAwareArgs()
+    _, state = synth_full_cluster(30, 60, seed=0)
+    fc, pods, _, _, _, ng, ngroups = build_full_chain_inputs(state, args)
+
+    # single-device reference on this process's local device
+    chosen_1, requested_1, quota_1 = build_full_chain_step(args, ng, ngroups)(fc)
+    chosen_1 = np.asarray(chosen_1)
+
+    # global mesh spanning both processes
+    mesh = make_mesh(jax.devices())
+    step = build_sharded_full_chain_step(args, ng, ngroups, mesh)
+    chosen_g, requested_g, quota_g = step(shard_full_chain_inputs(fc, mesh))
+    chosen_g = np.asarray(chosen_g)  # replicated -> locally addressable
+
+    np.testing.assert_array_equal(chosen_1, chosen_g)
+    np.testing.assert_array_equal(np.asarray(quota_1), np.asarray(quota_g))
+    assert (chosen_1[: len(pods.keys)] >= 0).sum() > 0, "vacuous schedule"
+
+    digest = hashlib.sha256(chosen_g.tobytes()).hexdigest()[:16]
+    print(f"MULTIHOST_OK {digest}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""2-process `jax.distributed.initialize()` test: the sharded full-chain step
+runs over a global mesh spanning two OS processes (4 virtual CPU devices
+each), with gloo collectives crossing the process boundary — the CI-runnable
+proof of the DCN/multi-host claim in parallel/mesh.py."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_full_chain():
+    port = _free_port()
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(i), "2", str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for proc in procs:
+        try:
+            out, err = proc.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail("multihost worker timed out")
+        assert proc.returncode == 0, f"worker failed:\n{out}\n{err}"
+        outs.append(out)
+    digests = [
+        line.split()[1]
+        for out in outs
+        for line in out.splitlines()
+        if line.startswith("MULTIHOST_OK")
+    ]
+    assert len(digests) == 2, f"missing MULTIHOST_OK lines: {outs}"
+    # both processes computed identical global bindings
+    assert digests[0] == digests[1]
