@@ -35,15 +35,19 @@ def test_two_process_distributed_full_chain():
         for i in range(2)
     ]
     outs = []
-    for proc in procs:
-        try:
-            out, err = proc.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for p in procs:
+    try:
+        for proc in procs:
+            try:
+                out, err = proc.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                pytest.fail("multihost worker timed out")
+            assert proc.returncode == 0, f"worker failed:\n{out}\n{err}"
+            outs.append(out)
+    finally:
+        # a failed worker must not strand its sibling in the gloo handshake
+        for p in procs:
+            if p.poll() is None:
                 p.kill()
-            pytest.fail("multihost worker timed out")
-        assert proc.returncode == 0, f"worker failed:\n{out}\n{err}"
-        outs.append(out)
     digests = [
         line.split()[1]
         for out in outs
