@@ -411,6 +411,10 @@ class ResourceQOSStrategy:
     blkio_enable: bool = False    # per-QoS io weights (blkioQOS)
     ls_blkio_weight: int = 500    # io.weight / blkio.bfq.weight for LS tier
     be_blkio_weight: int = 100    # and for BE tier
+    core_sched_enable: bool = False  # SMT core-sched cookies per QoS group
+    net_qos_policy: str = ""      # "" disabled | "terwayQos" (NETQOSPolicy)
+    net_hw_tx_bps: int = 0        # node NIC egress ceiling, bytes/s (0 = none)
+    net_hw_rx_bps: int = 0        # node NIC ingress ceiling
 
 
 @dataclass

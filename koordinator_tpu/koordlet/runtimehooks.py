@@ -14,11 +14,15 @@ Hooks (feature-gated, config.go:38-100):
   * batchresource : cfs quota + memory limits from batch-cpu/batch-memory
   * gpu           : device env injection (NVIDIA_VISIBLE_DEVICES)
   * cpunormalization: scale cfs quota by the node's cpu-normalization ratio
+  * coresched     : SMT core-scheduling cookies per QoS group (hooks/coresched)
+  * terwayqos     : network-QoS config files for the terway dataplane
+                    (hooks/terwayqos)
 """
 
 from __future__ import annotations
 
 import json
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -160,17 +164,197 @@ class CPUNormalizationHook(Hook):
             ctx.add_write(sysutil.CPU_CFS_QUOTA, str(quota))
 
 
+class CoreSchedHook(Hook):
+    """SMT core-scheduling cookies per QoS trust domain (hooks/coresched/
+    core_sched.go): tasks of LS-tier pods share one "expeller" cookie, each
+    BE pod group gets its own, so BE never co-runs on a hyperthread sibling
+    of an LS task. Gated by NodeSLO resourceQOSStrategy.core_sched_enable and
+    kernel support (util/coresched, prctl PR_SCHED_CORE; degrades to no-op)."""
+
+    name = "CoreSched"
+
+    # QoS tiers sharing the node-wide expeller cookie (ExpellerGroupSuffix)
+    _EXPELLER = (QoSClass.LSE, QoSClass.LSR, QoSClass.LS, QoSClass.SYSTEM)
+
+    def __init__(self, informer: StatesInformer,
+                 executor: ResourceUpdateExecutor, cse=None):
+        from koordinator_tpu.koordlet.util.coresched import default_interface
+
+        self.informer = informer
+        self.executor = executor
+        self.cse = cse if cse is not None else default_interface()
+        # core-sched-group-id -> (leader pid, cookie value) — the cookie value
+        # guards against pid reuse: a recycled leader pid carries a DIFFERENT
+        # cookie, so the entry is discarded instead of leaking a foreign
+        # cookie into the group (cookie_cache.go expiry analog)
+        self.groups: Dict[str, tuple] = {}
+
+    def _group_id(self, pod: Pod) -> str:
+        qos = pod.qos_class
+        if qos in self._EXPELLER:
+            return "ls-expeller"
+        if qos is QoSClass.BE:
+            return f"be/{pod.meta.uid or pod.meta.key}"
+        return ""  # NONE: leave cookies alone
+
+    def _pod_pids(self, relative_dir: str) -> List[int]:
+        """Tasks of the pod: the pod dir's procs plus every child (container)
+        cgroup's — on cgroup v2 the no-internal-process rule keeps all tasks
+        in the leaf container cgroups, so the pod file alone is empty."""
+        chunks = [self.executor.read(relative_dir, sysutil.CGROUP_PROCS) or ""]
+        pod_file = self.executor.config.cgroup_file_path(
+            relative_dir, sysutil.CGROUP_PROCS
+        )
+        pod_dir = os.path.dirname(pod_file)
+        try:
+            children = sorted(os.listdir(pod_dir))
+        except OSError:
+            children = []
+        for child in children:
+            child_procs = os.path.join(pod_dir, child, sysutil.CGROUP_PROCS)
+            if os.path.isfile(child_procs):
+                chunks.append(sysutil.read_file(child_procs) or "")
+        pids: List[int] = []
+        for chunk in chunks:
+            pids.extend(int(p) for p in chunk.split() if p.strip().isdigit())
+        return pids
+
+    def apply(self, ctx: ContainerContext) -> None:
+        if not self.informer.get_node_slo().resource_qos_strategy.core_sched_enable:
+            return
+        if not self.cse.supported():
+            return
+        group = self._group_id(ctx.pod)
+        if not group:
+            return
+        pids = self._pod_pids(ctx.cgroup_parent)
+        if not pids:
+            return
+        entry = self.groups.get(group)
+        if entry is not None and self.cse.get_cookie(entry[0]) != entry[1]:
+            entry = None  # leader died (or its pid was recycled)
+        if entry is None:
+            # first container of the group: mint a cookie on its first task
+            if not self.cse.create_cookie(pids[0]):
+                return
+            cookie = self.cse.get_cookie(pids[0])
+            if not cookie:
+                return
+            entry = (pids[0], cookie)
+            self.groups[group] = entry
+        leader, cookie = entry
+        # idempotent: only tasks whose cookie diverges are re-shared, so a
+        # steady-state reconcile tick issues zero prctls
+        stale = [
+            p for p in pids if p != leader and self.cse.get_cookie(p) != cookie
+        ]
+        if stale:
+            self.cse.share_from(leader, stale)
+
+    def reconcile_node(self) -> None:
+        """Prune cookie-group entries whose pods are gone (bounded cache)."""
+        if not self.groups:
+            return
+        live = {"ls-expeller"}
+        for pod in self.informer.get_all_pods():
+            group = self._group_id(pod)
+            if group:
+                live.add(group)
+        self.groups = {g: v for g, v in self.groups.items() if g in live}
+
+
+ANNOTATION_NET_QOS = "koordinator.sh/networkQOS"  # extension network qos
+
+
+class TerwayQoSHook(Hook):
+    """Network QoS config generator (hooks/terwayqos/terwayqos.go): when the
+    NodeSLO netQoS policy is "terwayQos", render the node bandwidth ceilings
+    to `var/lib/terway/qos/global_bps_config` and every local pod's priority +
+    per-pod limits to `pod.json`; the terway dataplane consumes the files.
+    Per-container apply() is a no-op — this is a node-level reconciler."""
+
+    name = "TerwayQoS"
+
+    # QoS class -> terway priority band (getPodPrio: LS tiers 0, mid 1, BE 2)
+    _PRIO = {QoSClass.LSE: 0, QoSClass.LSR: 0, QoSClass.LS: 0,
+             QoSClass.SYSTEM: 0, QoSClass.NONE: 1, QoSClass.BE: 2}
+
+    def __init__(self, informer: StatesInformer,
+                 executor: ResourceUpdateExecutor):
+        self.informer = informer
+        self.executor = executor
+
+    def _qos_dir(self) -> str:
+        root = self.executor.config.fs_root_dir
+        return os.path.join(root, "var/lib/terway/qos")
+
+    def apply(self, ctx: ContainerContext) -> None:
+        return None
+
+    def reconcile_node(self) -> None:
+        slo = self.informer.get_node_slo().resource_qos_strategy
+        qos_dir = self._qos_dir()
+        node_path = os.path.join(qos_dir, "global_bps_config")
+        pod_path = os.path.join(qos_dir, "pod.json")
+        if slo.net_qos_policy != "terwayQos":
+            for path in (node_path, pod_path):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            return
+        os.makedirs(qos_dir, exist_ok=True)
+        self._write_atomic(node_path, (
+            f"hw_tx_bps_max {slo.net_hw_tx_bps}\n"
+            f"hw_rx_bps_max {slo.net_hw_rx_bps}\n"
+        ))
+        pods = {}
+        for pod in self.informer.get_all_pods():
+            limits = {}
+            raw = pod.meta.annotations.get(ANNOTATION_NET_QOS)
+            if raw:
+                try:
+                    limits = json.loads(raw)
+                except (ValueError, TypeError):
+                    limits = {}
+                if not isinstance(limits, dict):
+                    limits = {}  # valid JSON but not an object
+            pods[pod.meta.uid or pod.meta.key] = {
+                "podName": pod.meta.name,
+                "podNamespace": pod.meta.namespace,
+                "podUID": pod.meta.uid,
+                "prio": self._PRIO.get(pod.qos_class, 1),
+                "ingressLimit": limits.get("ingressLimit", ""),
+                "egressLimit": limits.get("egressLimit", ""),
+            }
+        self._write_atomic(pod_path, json.dumps(pods, sort_keys=True))
+
+    @staticmethod
+    def _write_atomic(path: str, content: str) -> None:
+        # tmp + rename: the dataplane polls these files and must never read
+        # a truncated document
+        tmp = path + ".tmp"
+        if sysutil.write_file(tmp, content):
+            try:
+                os.replace(tmp, path)
+            except OSError:
+                pass
+
+
 DEFAULT_HOOKS = (GroupIdentityHook, CPUSetHook, BatchResourceHook, GPUEnvHook)
 
 
 class RuntimeHooks:
     """Hook runner: proxy-mode entry (run_hooks) + standalone reconciler."""
 
-    def __init__(self, informer: StatesInformer, executor: ResourceUpdateExecutor):
+    def __init__(self, informer: StatesInformer, executor: ResourceUpdateExecutor,
+                 core_sched=None):
         self.informer = informer
         self.executor = executor
         self.hooks: List[Hook] = [cls() for cls in DEFAULT_HOOKS]
         self.hooks.append(CPUNormalizationHook(informer))
+        self.hooks.append(CoreSchedHook(informer, executor, cse=core_sched))
+        self.hooks.append(TerwayQoSHook(informer, executor))
 
     def run_hooks(self, ctx: ContainerContext) -> ContainerContext:
         """Proxy/NRI-mode: mutate the container context; the caller (runtime
@@ -183,6 +367,10 @@ class RuntimeHooks:
         """Standalone reconciler backstop (reconciler.go:144): apply hook output
         directly through the executor for every local pod; returns writes."""
         wrote = 0
+        for hook in self.hooks:
+            node_level = getattr(hook, "reconcile_node", None)
+            if node_level is not None:
+                node_level()
         for pod in self.informer.get_all_pods():
             if not pod.is_assigned:
                 continue

@@ -41,6 +41,7 @@ MEMORY_CURRENT = "memory.current"        # v2
 MEMORY_STAT = "memory.stat"
 CPU_STAT = "cpu.stat"
 CPUACCT_USAGE = "cpuacct.usage"          # v1 ns counter
+CGROUP_PROCS = "cgroup.procs"            # PIDs attached to the cgroup
 CPU_PRESSURE = "cpu.pressure"
 MEMORY_PRESSURE = "memory.pressure"
 IO_PRESSURE = "io.pressure"
@@ -60,6 +61,7 @@ _V1_SUBSYSTEM = {
     CPUACCT_USAGE: "cpuacct",
     CPU_PRESSURE: "cpu", MEMORY_PRESSURE: "memory", IO_PRESSURE: "io",
     BLKIO_WEIGHT: "blkio",
+    CGROUP_PROCS: "cpu",  # v1: any subsystem lists the same tasks; use cpu
 }
 
 # v1 name <-> v2 name translations where they differ

@@ -101,6 +101,13 @@ class NodeSLOController:
                 be_group_identity=qos.get("beGroupIdentity", -1),
                 llc_be_percent=qos.get("llcBEPercent", 100),
                 mba_be_percent=qos.get("mbaBEPercent", 100),
+                blkio_enable=qos.get("blkioEnable", False),
+                ls_blkio_weight=qos.get("lsBlkioWeight", 500),
+                be_blkio_weight=qos.get("beBlkioWeight", 100),
+                core_sched_enable=qos.get("coreSchedEnable", False),
+                net_qos_policy=qos.get("netQOSPolicy", ""),
+                net_hw_tx_bps=qos.get("netHwTxBps", 0),
+                net_hw_rx_bps=qos.get("netHwRxBps", 0),
             )
             burst = self._node_override(burst_cfg, labels)
             slo.cpu_burst_strategy = CPUBurstStrategy(

@@ -1,0 +1,215 @@
+"""coresched + terwayqos runtime hooks (reference hooks/coresched,
+hooks/terwayqos): cookie grouping per QoS trust domain and net-QoS config
+file generation, against the fake cgroup tree."""
+
+import json
+import os
+
+import pytest
+
+from koordinator_tpu.api.objects import (
+    LABEL_POD_QOS,
+    Node,
+    NodeSLO,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client.store import (
+    KIND_NODE,
+    KIND_NODE_SLO,
+    KIND_POD,
+    ObjectStore,
+)
+from koordinator_tpu.koordlet.audit import Auditor
+from koordinator_tpu.koordlet.metriccache import MetricCache
+from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
+from koordinator_tpu.koordlet.runtimehooks import (
+    ANNOTATION_NET_QOS,
+    RuntimeHooks,
+)
+from koordinator_tpu.koordlet.statesinformer import StatesInformer
+from koordinator_tpu.koordlet.util.coresched import FakeCoreSched
+from koordinator_tpu.koordlet.util.system import FakeFS
+
+NODE = "node-0"
+
+
+@pytest.fixture
+def env():
+    fs = FakeFS(use_cgroup_v2=True)
+    store = ObjectStore()
+    store.add(KIND_NODE, Node(meta=ObjectMeta(name=NODE, namespace=""),
+                              allocatable=ResourceList.of(cpu=16000)))
+    informer = StatesInformer(store, NODE, MetricCache())
+    executor = ResourceUpdateExecutor(fs.config, Auditor())
+    cse = FakeCoreSched()
+    hooks = RuntimeHooks(informer, executor, core_sched=cse)
+    yield fs, store, informer, executor, cse, hooks
+    fs.cleanup()
+
+
+def add_pod(store, fs, name, uid, qos, pids, annotations=None):
+    pod = Pod(
+        meta=ObjectMeta(name=name, uid=uid, labels={LABEL_POD_QOS: qos},
+                        annotations=dict(annotations or {})),
+        spec=PodSpec(node_name=NODE, requests=ResourceList.of(cpu=1000)),
+        phase="Running",
+    )
+    store.add(KIND_POD, pod)
+    from koordinator_tpu.koordlet.metricsadvisor import pod_qos_dir
+
+    rel = fs.config.pod_relative_path(pod_qos_dir(pod), uid)
+    fs.set_cgroup(rel, "cgroup.procs", "\n".join(str(p) for p in pids))
+    return pod
+
+
+def enable_coresched(store):
+    slo = NodeSLO(meta=ObjectMeta(name=NODE, namespace=""))
+    slo.resource_qos_strategy.core_sched_enable = True
+    store.add(KIND_NODE_SLO, slo)
+
+
+def test_coresched_ls_pods_share_expeller_cookie(env):
+    fs, store, informer, executor, cse, hooks = env
+    enable_coresched(store)
+    add_pod(store, fs, "ls-0", "uid-ls-0", "LS", [100, 101])
+    add_pod(store, fs, "ls-1", "uid-ls-1", "LSR", [200])
+    hooks.reconcile()
+    # all LS-tier tasks share ONE cookie (the expeller group)
+    cookies = {cse.get_cookie(p) for p in (100, 101, 200)}
+    assert len(cookies) == 1
+    assert 0 not in cookies and None not in cookies
+
+
+def test_coresched_be_pods_get_distinct_cookies(env):
+    fs, store, informer, executor, cse, hooks = env
+    enable_coresched(store)
+    add_pod(store, fs, "be-0", "uid-be-0", "BE", [300, 301])
+    add_pod(store, fs, "be-1", "uid-be-1", "BE", [400])
+    add_pod(store, fs, "ls-0", "uid-ls-0", "LS", [100])
+    hooks.reconcile()
+    be0, be1, ls = cse.get_cookie(300), cse.get_cookie(400), cse.get_cookie(100)
+    assert cse.get_cookie(301) == be0       # same pod -> shared
+    assert len({be0, be1, ls}) == 3         # different trust domains
+    assert None not in (be0, be1, ls)
+
+
+def test_coresched_reads_pids_from_child_container_cgroups(env):
+    """cgroup v2 no-internal-process rule: tasks live in leaf container
+    cgroups, not the pod dir — the hook must walk the children."""
+    fs, store, informer, executor, cse, hooks = env
+    enable_coresched(store)
+    pod = add_pod(store, fs, "ls-0", "uid-ls-0", "LS", [])  # pod dir empty
+    from koordinator_tpu.koordlet.metricsadvisor import pod_qos_dir
+
+    rel = fs.config.pod_relative_path(pod_qos_dir(pod), "uid-ls-0")
+    fs.set_cgroup(rel + "/ctr-a", "cgroup.procs", "500\n501")
+    fs.set_cgroup(rel + "/ctr-b", "cgroup.procs", "502")
+    hooks.reconcile()
+    assert cse.get_cookie(500) == cse.get_cookie(501) == cse.get_cookie(502)
+    assert cse.get_cookie(500) not in (None, 0)
+
+
+def test_coresched_recycled_leader_pid_not_trusted(env):
+    """A dead leader whose pid is reused by another group must not leak its
+    foreign cookie into this group."""
+    fs, store, informer, executor, cse, hooks = env
+    enable_coresched(store)
+    add_pod(store, fs, "ls-0", "uid-ls-0", "LS", [100])
+    hooks.reconcile()
+    ls_cookie = cse.get_cookie(100)
+
+    # leader pid 100 dies and the kernel recycles it into a BE task holding
+    # a different cookie
+    cse.clear_cookie(100)
+    cse.create_cookie(100)
+    foreign = cse.get_cookie(100)
+    assert foreign != ls_cookie
+
+    add_pod(store, fs, "ls-1", "uid-ls-1", "LSR", [600])
+    hooks.reconcile()
+    # a fresh cookie was minted for the group; the foreign one never spread
+    assert cse.get_cookie(600) not in (None, 0, foreign)
+
+
+def test_coresched_group_cache_pruned_on_pod_deletion(env):
+    fs, store, informer, executor, cse, hooks = env
+    enable_coresched(store)
+    add_pod(store, fs, "be-0", "uid-be-0", "BE", [300])
+    hooks.reconcile()
+    coresched = next(h for h in hooks.hooks if h.name == "CoreSched")
+    assert "be/uid-be-0" in coresched.groups
+    store.delete(KIND_POD, "default/be-0")
+    hooks.reconcile()
+    assert "be/uid-be-0" not in coresched.groups
+
+
+def test_coresched_disabled_touches_nothing(env):
+    fs, store, informer, executor, cse, hooks = env
+    add_pod(store, fs, "ls-0", "uid-ls-0", "LS", [100])
+    hooks.reconcile()
+    assert cse.get_cookie(100) in (None, 0)
+
+
+def _qos_paths(fs):
+    base = os.path.join(fs.config.fs_root_dir, "var/lib/terway/qos")
+    return os.path.join(base, "global_bps_config"), os.path.join(base, "pod.json")
+
+
+def test_terwayqos_renders_node_and_pod_config(env):
+    fs, store, informer, executor, cse, hooks = env
+    slo = NodeSLO(meta=ObjectMeta(name=NODE, namespace=""))
+    slo.resource_qos_strategy.net_qos_policy = "terwayQos"
+    slo.resource_qos_strategy.net_hw_tx_bps = 10_000_000_000
+    slo.resource_qos_strategy.net_hw_rx_bps = 10_000_000_000
+    store.add(KIND_NODE_SLO, slo)
+    add_pod(store, fs, "web", "uid-web", "LS", [100],
+            annotations={ANNOTATION_NET_QOS: json.dumps(
+                {"ingressLimit": "50M", "egressLimit": "20M"})})
+    add_pod(store, fs, "batch", "uid-batch", "BE", [200])
+    hooks.reconcile()
+
+    node_path, pod_path = _qos_paths(fs)
+    node_cfg = open(node_path).read()
+    assert "hw_tx_bps_max 10000000000" in node_cfg
+    assert "hw_rx_bps_max 10000000000" in node_cfg
+    pods = json.loads(open(pod_path).read())
+    assert pods["uid-web"]["prio"] == 0
+    assert pods["uid-web"]["ingressLimit"] == "50M"
+    assert pods["uid-batch"]["prio"] == 2
+    assert pods["uid-batch"]["egressLimit"] == ""
+
+
+def test_terwayqos_survives_malformed_annotation(env):
+    """Valid-JSON-but-not-an-object annotations must not kill the agent."""
+    fs, store, informer, executor, cse, hooks = env
+    slo = NodeSLO(meta=ObjectMeta(name=NODE, namespace=""))
+    slo.resource_qos_strategy.net_qos_policy = "terwayQos"
+    store.add(KIND_NODE_SLO, slo)
+    add_pod(store, fs, "bad", "uid-bad", "LS", [100],
+            annotations={ANNOTATION_NET_QOS: "[1, 2]"})
+    add_pod(store, fs, "worse", "uid-worse", "BE", [200],
+            annotations={ANNOTATION_NET_QOS: "not json {"})
+    hooks.reconcile()
+    pods = json.loads(open(_qos_paths(fs)[1]).read())
+    assert pods["uid-bad"]["ingressLimit"] == ""
+    assert pods["uid-worse"]["egressLimit"] == ""
+
+
+def test_terwayqos_disabled_removes_config(env):
+    fs, store, informer, executor, cse, hooks = env
+    slo = NodeSLO(meta=ObjectMeta(name=NODE, namespace=""))
+    slo.resource_qos_strategy.net_qos_policy = "terwayQos"
+    store.add(KIND_NODE_SLO, slo)
+    add_pod(store, fs, "web", "uid-web", "LS", [100])
+    hooks.reconcile()
+    node_path, pod_path = _qos_paths(fs)
+    assert os.path.exists(node_path) and os.path.exists(pod_path)
+
+    slo.resource_qos_strategy.net_qos_policy = ""
+    store.update(KIND_NODE_SLO, slo)
+    hooks.reconcile()
+    assert not os.path.exists(node_path)
+    assert not os.path.exists(pod_path)
