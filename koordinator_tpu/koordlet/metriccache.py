@@ -46,6 +46,8 @@ POD_CPU_THROTTLED_RATIO = "pod_cpu_throttled_ratio"  # nr_throttled/nr_periods
 NODE_FS_USED_BYTES = "node_fs_used_bytes"
 NODE_FS_TOTAL_BYTES = "node_fs_total_bytes"
 NODE_DISK_IO_TICKS = "node_disk_io_ticks"    # per-device busy-ms counter delta
+NODE_GPU_CORE_USAGE = "node_gpu_core_usage"  # per-accelerator compute %
+NODE_GPU_MEM_USAGE = "node_gpu_mem_usage"    # per-accelerator HBM bytes in use
 
 NODE_CPU_INFO_KEY = "node_cpu_info"
 NODE_NUMA_INFO_KEY = "node_numa_info"

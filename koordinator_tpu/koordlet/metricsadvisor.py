@@ -69,7 +69,14 @@ class MetricsAdvisor:
             ("podthrottled", None, self.collect_pod_throttled),
             ("psi", "PSICollector", self.collect_psi),
             ("performance", "CPICollector", self.collect_performance),
+            # gated OFF by default: the default sampler touches jax.devices(),
+            # and initializing the TPU runtime from the node agent would take
+            # exclusive chip ownership away from workload pods
+            ("gpudevice", "TPUDeviceCollector", self.collect_device_usage),
         ]
+        # device sampler seam (reference devices/gpu NVML walk; here the local
+        # TPU chips via JAX): () -> [{minor, uuid, core_pct, mem_bytes}]
+        self.device_sampler = sample_tpu_devices
 
     # -- helpers -------------------------------------------------------------
     def _cpu_rate(self, key: str, now: float, cumulative_ns: Optional[int]) -> Optional[float]:
@@ -273,9 +280,56 @@ class MetricsAdvisor:
         if gc is not None:
             gc(p.meta.key for p in pods)
 
+    def collect_device_usage(self, now: float) -> None:
+        """Per-accelerator utilization series (reference devices/gpu
+        collector_gpu_linux.go:164-201 walks NVML; the TPU-native sampler
+        reads per-chip HBM occupancy through JAX). Pod-level attribution is
+        not collected: a TPU chip is held by one process, so node-level
+        per-chip series carry the same information NVML per-PID walks do."""
+        for dev in self.device_sampler():
+            labels = {"minor": str(dev["minor"]), "uuid": dev["uuid"]}
+            self.cache.add_sample(
+                mc.NODE_GPU_CORE_USAGE, float(dev.get("core_pct", 0.0)), now,
+                **labels,
+            )
+            self.cache.add_sample(
+                mc.NODE_GPU_MEM_USAGE, float(dev.get("mem_bytes", 0)), now,
+                **labels,
+            )
+
     def collect_once(self, now: Optional[float] = None) -> None:
         now = time.time() if now is None else now
         for _name, gate, fn in self.profile:
             if gate is not None and not KOORDLET_GATES.enabled(gate):
                 continue
             fn(now)
+
+
+def sample_tpu_devices() -> List[Dict]:
+    """Default device sampler: local TPU chips' HBM occupancy via JAX
+    memory_stats (bytes_in_use / bytes_limit). Returns [] off-TPU."""
+    try:
+        import jax
+
+        devices = [d for d in jax.devices() if d.platform == "tpu"]
+    except Exception:
+        return []
+    out = []
+    for d in devices:
+        stats = getattr(d, "memory_stats", None)
+        try:
+            stats = stats() if callable(stats) else None
+        except Exception:
+            stats = None
+        if not isinstance(stats, dict):
+            stats = {}
+        in_use = int(stats.get("bytes_in_use", 0))
+        limit = int(stats.get("bytes_limit", 0))
+        out.append({
+            "minor": int(getattr(d, "id", 0)),
+            "uuid": f"TPU-{getattr(d, 'id', 0)}",
+            # unknown capacity -> no occupancy claim, not a nonsense ratio
+            "core_pct": 100.0 * in_use / limit if limit > 0 else 0.0,
+            "mem_bytes": in_use,
+        })
+    return out

@@ -188,6 +188,9 @@ class CoreSchedHook(Hook):
         # cookie, so the entry is discarded instead of leaking a foreign
         # cookie into the group (cookie_cache.go expiry analog)
         self.groups: Dict[str, tuple] = {}
+        # every pid a cookie was put on, for cleanup when the group (or the
+        # whole feature) goes away
+        self.group_pids: Dict[str, set] = {}
 
     def _group_id(self, pod: Pod) -> str:
         qos = pod.qos_class
@@ -250,17 +253,31 @@ class CoreSchedHook(Hook):
         ]
         if stale:
             self.cse.share_from(leader, stale)
+        self.group_pids.setdefault(group, set()).update(pids)
+
+    def _clear_group(self, group: str) -> None:
+        for pid in self.group_pids.pop(group, ()):  # dead pids fail harmlessly
+            self.cse.clear_cookie(pid)
+        self.groups.pop(group, None)
 
     def reconcile_node(self) -> None:
-        """Prune cookie-group entries whose pods are gone (bounded cache)."""
-        if not self.groups:
+        """Prune cookie groups whose pods are gone, and clear every cookie
+        when the feature is switched off (the reference clears on disable —
+        otherwise SMT siblings stay force-idled until every pod restarts)."""
+        if not self.groups and not self.group_pids:
+            return
+        if not self.informer.get_node_slo().resource_qos_strategy.core_sched_enable:
+            for group in list(self.group_pids) + list(self.groups):
+                self._clear_group(group)
             return
         live = {"ls-expeller"}
         for pod in self.informer.get_all_pods():
             group = self._group_id(pod)
             if group:
                 live.add(group)
-        self.groups = {g: v for g, v in self.groups.items() if g in live}
+        for group in list(self.groups):
+            if group not in live:
+                self._clear_group(group)
 
 
 ANNOTATION_NET_QOS = "koordinator.sh/networkQOS"  # extension network qos
@@ -283,6 +300,7 @@ class TerwayQoSHook(Hook):
                  executor: ResourceUpdateExecutor):
         self.informer = informer
         self.executor = executor
+        self._written: Dict[str, str] = {}  # path -> last content on disk
 
     def _qos_dir(self) -> str:
         root = self.executor.config.fs_root_dir
@@ -298,10 +316,11 @@ class TerwayQoSHook(Hook):
         pod_path = os.path.join(qos_dir, "pod.json")
         if slo.net_qos_policy != "terwayQos":
             for path in (node_path, pod_path):
-                try:
-                    os.remove(path)
-                except OSError:
-                    pass
+                if self._written.pop(path, None) is not None or os.path.exists(path):
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
             return
         os.makedirs(qos_dir, exist_ok=True)
         self._write_atomic(node_path, (
@@ -329,16 +348,19 @@ class TerwayQoSHook(Hook):
             }
         self._write_atomic(pod_path, json.dumps(pods, sort_keys=True))
 
-    @staticmethod
-    def _write_atomic(path: str, content: str) -> None:
-        # tmp + rename: the dataplane polls these files and must never read
-        # a truncated document
+    def _write_atomic(self, path: str, content: str) -> None:
+        # tmp + rename (the dataplane polls these files and must never read a
+        # truncated document); unchanged content is not rewritten, so steady
+        # state leaves mtime/inode alone and the poller skips re-parsing
+        if self._written.get(path) == content:
+            return
         tmp = path + ".tmp"
         if sysutil.write_file(tmp, content):
             try:
                 os.replace(tmp, path)
             except OSError:
-                pass
+                return
+            self._written[path] = content
 
 
 DEFAULT_HOOKS = (GroupIdentityHook, CPUSetHook, BatchResourceHook, GPUEnvHook)

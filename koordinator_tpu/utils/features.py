@@ -80,6 +80,9 @@ KOORDLET_GATES = FeatureGate(
         "CoreSched": False,
         "BlkIOReconcile": False,
         "TerwayQoS": False,
+        # off by default: the TPU sampler initializes the JAX runtime, which
+        # takes exclusive chip ownership the workload pods need
+        "TPUDeviceCollector": False,
     }
 )
 

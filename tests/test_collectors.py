@@ -211,3 +211,34 @@ class TestMetricsRegistry:
         evictor = Evictor(store, informer, cache)
         evictor.evict(pod, "test_mem")
         assert km.POD_EVICTION_TOTAL.get(reason="test_mem") == 1.0
+
+
+class TestDeviceCollector:
+    def test_device_usage_series_recorded(self, fs):
+        _, cache, _, advisor = build(fs)
+        advisor.device_sampler = lambda: [
+            {"minor": 0, "uuid": "TPU-0", "core_pct": 37.5,
+             "mem_bytes": 6 * GIB},
+            {"minor": 1, "uuid": "TPU-1", "core_pct": 80.0,
+             "mem_bytes": 12 * GIB},
+        ]
+        KOORDLET_GATES.set_from_map({"TPUDeviceCollector": True})
+        try:
+            advisor.collect_once(now=NOW)
+        finally:
+            KOORDLET_GATES.set_from_map({"TPUDeviceCollector": False})
+        assert cache.query(mc.NODE_GPU_CORE_USAGE, "latest", now=NOW,
+                           minor="0", uuid="TPU-0") == 37.5
+        assert cache.query(mc.NODE_GPU_MEM_USAGE, "latest", now=NOW,
+                           minor="1", uuid="TPU-1") == 12 * GIB
+
+    def test_default_sampler_degrades_off_tpu(self, fs):
+        from koordinator_tpu.koordlet.metricsadvisor import sample_tpu_devices
+
+        # under the CPU test mesh there are no TPU chips: [] and no metrics,
+        # never an exception
+        _, cache, _, advisor = build(fs)
+        assert sample_tpu_devices() == []
+        advisor.collect_once(now=NOW)
+        assert cache.query(mc.NODE_GPU_CORE_USAGE, "latest", now=NOW,
+                           minor="0", uuid="TPU-0") is None

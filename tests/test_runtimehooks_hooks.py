@@ -146,6 +146,40 @@ def test_coresched_group_cache_pruned_on_pod_deletion(env):
     assert "be/uid-be-0" not in coresched.groups
 
 
+def test_coresched_disable_clears_existing_cookies(env):
+    """Flipping coreSchedEnable off must clear kernel cookies, not just the
+    bookkeeping — otherwise SMT siblings stay force-idled until every pod
+    restarts."""
+    fs, store, informer, executor, cse, hooks = env
+    enable_coresched(store)
+    add_pod(store, fs, "ls-0", "uid-ls-0", "LS", [100, 101])
+    add_pod(store, fs, "be-0", "uid-be-0", "BE", [300])
+    hooks.reconcile()
+    assert cse.get_cookie(100) not in (None, 0)
+
+    slo = store.get(KIND_NODE_SLO, f"/{NODE}")
+    slo.resource_qos_strategy.core_sched_enable = False
+    store.update(KIND_NODE_SLO, slo)
+    hooks.reconcile()
+    for pid in (100, 101, 300):
+        assert cse.get_cookie(pid) in (None, 0)
+    coresched = next(h for h in hooks.hooks if h.name == "CoreSched")
+    assert not coresched.groups and not coresched.group_pids
+
+
+def test_terwayqos_steady_state_does_not_rewrite(env):
+    fs, store, informer, executor, cse, hooks = env
+    slo = NodeSLO(meta=ObjectMeta(name=NODE, namespace=""))
+    slo.resource_qos_strategy.net_qos_policy = "terwayQos"
+    store.add(KIND_NODE_SLO, slo)
+    add_pod(store, fs, "web", "uid-web", "LS", [100])
+    hooks.reconcile()
+    node_path, pod_path = _qos_paths(fs)
+    before = [os.stat(p).st_mtime_ns for p in (node_path, pod_path)]
+    hooks.reconcile()  # nothing changed: the poller must see the same inode
+    assert [os.stat(p).st_mtime_ns for p in (node_path, pod_path)] == before
+
+
 def test_coresched_disabled_touches_nothing(env):
     fs, store, informer, executor, cse, hooks = env
     add_pod(store, fs, "ls-0", "uid-ls-0", "LS", [100])
