@@ -70,6 +70,83 @@ class FullChainInputs(NamedTuple):
     gang_group_id: jnp.ndarray    # [NG] int32
 
 
+def resolve_weight_idx(args: LoadAwareArgs, active_axes):
+    """Weight-axis resolution shared by every full-chain kernel, so the serial
+    and wave kernels can never trace different weight sets."""
+    full_weights = args.weight_vector()
+    if active_axes is not None:
+        full_weights = full_weights[list(active_axes)]
+    return tuple(int(i) for i in np.nonzero(full_weights)[0])
+
+
+def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode):
+    """The per-pod PreFilter+Filter+Score+select math, factored so the serial
+    kernel and the wave kernel (models/wave_chain.py) trace the IDENTICAL
+    computation — binding parity between them is by construction.
+
+    Returns evaluate(i, requested, delta_np, delta_pr, numa_free, bind_free,
+    quota_used) -> (found, best, zone_at_best, admit) where admit is the
+    pod-level PreFilter verdict (gang validity AND quota admission);
+    vmap-able over i at frozen state."""
+    inputs = fc.base
+    reject_np, reject_prod = la_ops.loadaware_node_reject(
+        inputs.allocatable,
+        inputs.la_filter_usage,
+        inputs.la_has_filter_usage,
+        inputs.la_filter_thresholds,
+        inputs.la_prod_thresholds,
+        inputs.la_prod_pod_usage,
+        inputs.la_filter_skip,
+    )
+    gang_pod_ok = jnp.where(
+        fc.gang_id >= 0, fc.gang_valid[jnp.maximum(fc.gang_id, 0)], True
+    )
+
+    def evaluate(i, requested, delta_np, delta_pr, numa_free, bind_free,
+                 quota_used):
+        req_fit = inputs.fit_requests[i]
+        req = fc.requests[i]
+        est = inputs.estimated[i]
+        is_prod_i = inputs.is_prod[i]
+
+        # ---- PreFilter: gang validity + quota admission (order-dependent)
+        admit = gang_pod_ok[i] & quota_admit_row(
+            req, fc.quota_id[i], fc.quota_ancestors, quota_used, fc.quota_runtime
+        )
+
+        # ---- Filter chain
+        fit = fit_ok_row(req_fit, inputs.allocatable, requested)
+        la_reject = jnp.where(is_prod_i, reject_prod, reject_np)
+        la_ok = inputs.is_daemonset[i] | ~la_reject
+        cpuset_ok = cpuset_filter_row(
+            fc.needs_bind[i], fc.cores_needed[i], fc.full_pcpus[i],
+            fc.has_topology, bind_free, fc.cpus_per_core,
+        )
+        numa_ok, zone = numa_admit_row(
+            req, fc.needs_numa[i], numa_free, fc.numa_policy
+        )
+        feasible = (
+            inputs.node_ok & fit & la_ok & cpuset_ok & numa_ok & admit
+        )
+
+        # ---- Score chain (equal plugin weights, each already 0..100)
+        la_score = _score_row(
+            est, is_prod_i, inputs, delta_np, delta_pr, weight_idx, prod_mode
+        )
+        numa_score = numa_score_row(
+            req, requested, inputs.allocatable, inputs.weights, weight_idx,
+        )
+        score = la_score + numa_score
+        score = jnp.where(feasible, score, -1.0)
+
+        # ---- select
+        best = jnp.argmax(score)
+        found = (score[best] >= 0.0) & inputs.pod_valid[i]
+        return found, best, zone[best], admit
+
+    return evaluate
+
+
 def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
                           jit: bool = True, active_axes=None):
     """FullChainInputs -> (chosen[P], requested[N, R], quota_used[G, R]).
@@ -79,28 +156,14 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
     (snapshot.reduce_to_active_axes), the original axis ids, so weight indices
     map correctly.
     """
-    full_weights = args.weight_vector()
-    if active_axes is not None:
-        full_weights = full_weights[list(active_axes)]
-    weight_idx = tuple(int(i) for i in np.nonzero(full_weights)[0])
+    weight_idx = resolve_weight_idx(args, active_axes)
     prod_mode = args.score_according_prod_usage
 
     def step(fc: FullChainInputs):
         inputs = fc.base
         P = inputs.fit_requests.shape[0]
         N = inputs.allocatable.shape[0]
-        reject_np, reject_prod = la_ops.loadaware_node_reject(
-            inputs.allocatable,
-            inputs.la_filter_usage,
-            inputs.la_has_filter_usage,
-            inputs.la_filter_thresholds,
-            inputs.la_prod_thresholds,
-            inputs.la_prod_pod_usage,
-            inputs.la_filter_skip,
-        )
-        gang_pod_ok = jnp.where(
-            fc.gang_id >= 0, fc.gang_valid[jnp.maximum(fc.gang_id, 0)], True
-        )
+        evaluate = make_pod_evaluator(fc, weight_idx, prod_mode)
 
         def body(i, state):
             (requested, delta_np, delta_pr, numa_free, bind_free,
@@ -110,40 +173,10 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
             est = inputs.estimated[i]
             is_prod_i = inputs.is_prod[i]
 
-            # ---- PreFilter: gang validity + quota admission (order-dependent)
-            admit = gang_pod_ok[i] & quota_admit_row(
-                req, fc.quota_id[i], fc.quota_ancestors, quota_used, fc.quota_runtime
+            found, best, zone_at_best, _admit = evaluate(
+                i, requested, delta_np, delta_pr, numa_free, bind_free,
+                quota_used,
             )
-
-            # ---- Filter chain
-            fit = fit_ok_row(req_fit, inputs.allocatable, requested)
-            la_reject = jnp.where(is_prod_i, reject_prod, reject_np)
-            la_ok = inputs.is_daemonset[i] | ~la_reject
-            cpuset_ok = cpuset_filter_row(
-                fc.needs_bind[i], fc.cores_needed[i], fc.full_pcpus[i],
-                fc.has_topology, bind_free, fc.cpus_per_core,
-            )
-            numa_ok, zone = numa_admit_row(
-                req, fc.needs_numa[i], numa_free, fc.numa_policy
-            )
-            feasible = (
-                inputs.node_ok & fit & la_ok & cpuset_ok & numa_ok & admit
-            )
-
-            # ---- Score chain (equal plugin weights, each already 0..100)
-            la_score = _score_row(
-                est, is_prod_i, inputs, delta_np, delta_pr, weight_idx, prod_mode
-            )
-            numa_score = numa_score_row(
-                req, requested, inputs.allocatable, inputs.weights, weight_idx,
-            )
-            score = la_score + numa_score
-            score = jnp.where(feasible, score, -1.0)
-
-            # ---- select + Reserve (row-wise state writes: O(K*R) per pod, not
-            # O(N*K*R) — the loop's memory traffic budget)
-            best = jnp.argmax(score)
-            found = (score[best] >= 0.0) & inputs.pod_valid[i]
             fnd = found.astype(jnp.float32)
 
             def upd_row(mat, add_row):
@@ -156,7 +189,7 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
                 delta_pr = upd_row(
                     delta_pr, jnp.where(is_prod_i, 1.0, 0.0) * est
                 )
-            new_zone_free = numa_spread_fill(numa_free[best], req, zone[best])
+            new_zone_free = numa_spread_fill(numa_free[best], req, zone_at_best)
             apply_numa = (found & fc.needs_numa[i]).astype(jnp.float32)
             mixed = apply_numa * new_zone_free + (1.0 - apply_numa) * numa_free[best]
             numa_free = jax.lax.dynamic_update_slice(

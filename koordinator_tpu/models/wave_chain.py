@@ -1,0 +1,206 @@
+"""Wave-parallel full-chain scheduling step: serial bindings, parallel waves.
+
+The serial kernel (models/full_chain.py) walks pods one at a time because each
+binding mutates node/quota state. But the chain's state updates are MONOTONE:
+committing a pod only ever (a) raises a node's requested/estimated usage and
+shrinks its NUMA/bindable-CPU headroom — so that node's feasibility and score
+for later pods can only get WORSE — and (b) raises quota usage along one
+ancestor chain — so quota admission can only flip admit -> reject. Under
+monotone decay, a pod's serial decision is EXACTLY its decision against the
+wave-start state unless something it depends on was touched earlier in the
+wave:
+
+  * its argmax node was also chosen by an earlier wave pod (untouched nodes
+    only decayed elsewhere, so the argmax — lowest-index tie-break included —
+    cannot move), or
+  * in-wave quota usage along its ancestor chain flips its admission (checked
+    EXACTLY via an in-wave exclusive prefix-sum of ancestor-chain additions,
+    not conservatively by chain overlap — sharing the tree root costs
+    nothing while headroom lasts).
+
+So each device step evaluates a WINDOW of W pods in parallel against frozen
+state (vmapping the IDENTICAL per-pod evaluator the serial kernel uses —
+parity is by construction), finds the first conflict, commits the clean
+prefix in one batch of matmul/scatter updates, and advances. Conflict-free
+prefixes average ~sqrt(N) pods, so the 10k x 5k trace collapses from 10k
+serial iterations into ~100 wave iterations of MXU/VPU-friendly [W, N] work.
+
+Same contract and bindings as build_full_chain_step, validated by
+tests/test_wave_chain.py across the parity configs (CPU). State rollups run
+at Precision.HIGHEST; node-side rollups are EXACT (committed pods occupy
+distinct nodes, so each matmul row has a single non-zero term), and the
+quota commit reuses the same cumsum the admission pass saw, so the wave is
+internally consistent. The one theoretical divergence from the serial kernel
+is f32 summation order for a quota group whose packed usage exceeds 2^24
+while sitting within one ULP of its runtime — the full-batch binding diff
+against the serial step (run on-chip when the selector adopts this kernel)
+is the empirical gate.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from koordinator_tpu.models.full_chain import (
+    FullChainInputs,
+    make_pod_evaluator,
+    resolve_weight_idx,
+)
+from koordinator_tpu.ops.gang import gang_permit_mask
+from koordinator_tpu.ops.loadaware import LoadAwareArgs
+from koordinator_tpu.ops.numa import numa_spread_fill
+from koordinator_tpu.ops.quota import quota_admit_row
+
+DEFAULT_WAVE = 256
+
+
+def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
+                               num_groups: int, jit: bool = True,
+                               active_axes=None, wave: int = DEFAULT_WAVE):
+    """FullChainInputs -> (chosen[P], requested[N, R], quota_used[G, R])."""
+    weight_idx = resolve_weight_idx(args, active_axes)
+    prod_mode = args.score_according_prod_usage
+
+    def step(fc: FullChainInputs):
+        inputs = fc.base
+        P, R = inputs.fit_requests.shape
+        N = inputs.allocatable.shape[0]
+        G, D = fc.quota_ancestors.shape
+        W = min(wave, P)
+        evaluate = make_pod_evaluator(fc, weight_idx, prod_mode)
+
+        # [G, G] ancestor membership: anc_mask[g, a] == a is on g's chain
+        anc_valid = fc.quota_ancestors >= 0                      # [G, D]
+        anc_onehot_gd = jax.nn.one_hot(
+            jnp.maximum(fc.quota_ancestors, 0), G, dtype=jnp.float32
+        ) * anc_valid[..., None].astype(jnp.float32)             # [G, D, G]
+        anc_mask = anc_onehot_gd.sum(axis=1)                     # [G, G] 0/1
+
+        warange = jnp.arange(W)
+
+        def cond(state):
+            return state[-1] < P
+
+        def wave_body(state):
+            (requested, delta_np, delta_pr, numa_free, bind_free,
+             quota_used, chosen, pos) = state
+            idx = pos + warange
+            valid_w = idx < P
+            idxc = jnp.minimum(idx, P - 1)
+
+            found_w, best_w, zone_w, admit_w = jax.vmap(
+                lambda i: evaluate(i, requested, delta_np, delta_pr,
+                                   numa_free, bind_free, quota_used)
+            )(idxc)
+            found_w = found_w & valid_w
+
+            req_w = fc.requests[idxc]                 # [W, R]
+            req_fit_w = inputs.fit_requests[idxc]     # [W, R]
+            est_w = inputs.estimated[idxc]            # [W, R]
+            qid_w = fc.quota_id[idxc]                 # [W]
+            has_quota_w = qid_w >= 0
+
+            # ---- exact in-wave quota re-admission: usage each pod would see
+            # serially = wave-start usage + additions of all found pods before
+            # it (exclusive prefix over the window)
+            pod_anc_w = anc_mask[jnp.maximum(qid_w, 0)] * (
+                (found_w & has_quota_w).astype(jnp.float32)[:, None]
+            )                                          # [W, G]
+            adds = pod_anc_w[:, :, None] * req_w[:, None, :]       # [W, G, R]
+            incl = jnp.cumsum(adds, axis=0)                        # inclusive
+            prefix = incl - adds                                   # exclusive
+            admit_prefix_w = jax.vmap(
+                lambda req, qid, pre: quota_admit_row(
+                    req, qid, fc.quota_ancestors, quota_used + pre,
+                    fc.quota_runtime,
+                )
+            )(req_w, qid_w, prefix)
+            quota_flip_w = found_w & admit_w & ~admit_prefix_w
+
+            # ---- node collision: an earlier wave pod already took this argmax
+            sel_w = jax.nn.one_hot(best_w, N, dtype=jnp.float32) * (
+                found_w.astype(jnp.float32)[:, None]
+            )                                          # [W, N]
+            taken_before = jnp.cumsum(sel_w, axis=0) - sel_w       # exclusive
+            node_coll_w = found_w & (
+                jnp.take_along_axis(
+                    taken_before, best_w[:, None], axis=1
+                )[:, 0] > 0.5
+            )
+
+            conflict_w = quota_flip_w | node_coll_w
+            cut = jnp.where(
+                conflict_w.any(), jnp.argmax(conflict_w), W
+            ).astype(jnp.int32)
+
+            commit_w = (warange < cut) & found_w
+            cm = commit_w.astype(jnp.float32)
+            sel_c = sel_w * cm[:, None]                            # [W, N]
+
+            # HIGHEST precision keeps these f32 (TPU matmuls default to bf16
+            # passes); each output row has at most ONE non-zero term — the
+            # node-collision cut guarantees distinct nodes per wave — so the
+            # rollup equals the serial kernel's add exactly
+            hi = jax.lax.Precision.HIGHEST
+            mm = lambda a, b: jnp.matmul(a, b, precision=hi)  # noqa: E731
+            requested = requested + mm(sel_c.T, req_fit_w)
+            delta_np = delta_np + mm(sel_c.T, est_w)
+            if prod_mode:
+                delta_pr = delta_pr + mm(
+                    sel_c.T,
+                    inputs.is_prod[idxc].astype(jnp.float32)[:, None] * est_w,
+                )
+            bind_free = bind_free - mm(
+                sel_c.T,
+                jnp.where(fc.needs_bind[idxc], fc.cores_needed[idxc], 0.0),
+            )
+            # committed pods occupy DISTINCT nodes (node_coll cut), so the
+            # per-pod NUMA fills scatter without aliasing
+            new_rows_w = jax.vmap(numa_spread_fill)(
+                numa_free[best_w], req_w, zone_w
+            )                                          # [W, K, R]
+            numa_idx = jnp.where(
+                commit_w & fc.needs_numa[idxc], best_w, N
+            )
+            numa_free = numa_free.at[numa_idx].set(
+                new_rows_w, mode="drop"
+            )
+            # quota commit from the SAME inclusive cumsum the admission pass
+            # consumed: the committed total is incl[cut-1] (zero when the cut
+            # lands on the first pod), so admission and commit can never see
+            # differently-associated sums
+            committed_total = jnp.where(
+                cut > 0, incl[jnp.maximum(cut - 1, 0)], jnp.zeros_like(incl[0])
+            )
+            quota_used = quota_used + committed_total
+
+            value_w = jnp.where(found_w, best_w.astype(jnp.int32), -1)
+            chosen_idx = jnp.where((warange < cut) & valid_w, idx, P)
+            chosen = chosen.at[chosen_idx].set(value_w, mode="drop")
+            return (requested, delta_np, delta_pr, numa_free, bind_free,
+                    quota_used, chosen, pos + cut)
+
+        init = (
+            inputs.requested,
+            jnp.zeros((N, R), jnp.float32),
+            jnp.zeros((N, R), jnp.float32),
+            fc.numa_free,
+            fc.bind_free,
+            fc.quota_used,
+            jnp.full(P, -1, jnp.int32),
+            jnp.int32(0),
+        )
+        (requested, _, _, _, _, quota_used, chosen, _pos) = jax.lax.while_loop(
+            cond, wave_body, init
+        )
+
+        # ---- Permit barrier (gang group all-or-nothing)
+        keep = gang_permit_mask(
+            chosen, fc.gang_id, fc.gang_min_member, fc.gang_assumed,
+            fc.gang_group_id, num_gangs, num_groups,
+        )
+        chosen = jnp.where(keep, chosen, -1)
+        return chosen, requested, quota_used
+
+    return jax.jit(step) if jit else step
