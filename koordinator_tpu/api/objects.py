@@ -117,6 +117,14 @@ class Pod:
         return self.meta.labels.get(LABEL_POD_GROUP, "")
 
     @property
+    def gang_key(self) -> str:
+        """Namespaced gang identity: the pod-group label names a PodGroup in
+        the POD's namespace (coscheduling core.go GetGangFullName), so two
+        same-named gangs in different namespaces never collide."""
+        name = self.meta.labels.get(LABEL_POD_GROUP, "")
+        return f"{self.meta.namespace}/{name}" if name else ""
+
+    @property
     def quota_name(self) -> str:
         return self.meta.labels.get(LABEL_QUOTA_NAME, "")
 

@@ -92,19 +92,33 @@ def pack_pods(
     gang_ids: Optional[Dict[str, int]] = None,
     quota_ids: Optional[Dict[str, int]] = None,
     pad_to: Optional[int] = None,
+    gang_sort: Optional[Dict[str, Tuple[float, str]]] = None,
 ) -> PodBatch:
-    """Pack pods in scheduling-queue order: priority desc, sub-priority desc,
-    creation time asc, key asc (kube-scheduler PrioritySort + coscheduling Less,
-    coscheduling.go:118)."""
-    order = sorted(
-        range(len(pods)),
-        key=lambda i: (
-            -(pods[i].spec.priority or 0),
-            -pods[i].sub_priority,
-            pods[i].meta.creation_timestamp,
-            pods[i].meta.key,
-        ),
-    )
+    """Pack pods in scheduling-queue order (kube-scheduler PrioritySort +
+    coscheduling Less, coscheduling.go:118): priority desc, sub-priority
+    desc, then the GANG GROUP's identity — members of one gang sort by their
+    gang's creation time and name, so a gang schedules contiguously instead
+    of interleaving with unrelated pods — then pod creation time asc, key
+    asc. ``gang_sort`` maps gang name -> (gang creation time, gang key);
+    gangless pods (and unknown gangs) group as themselves."""
+    gang_sort = gang_sort or {}
+
+    def queue_key(i):
+        pod = pods[i]
+        group_time, group_key = gang_sort.get(
+            pod.gang_key,
+            (pod.meta.creation_timestamp, pod.meta.key),
+        )
+        return (
+            -(pod.spec.priority or 0),
+            -pod.sub_priority,
+            group_time,
+            group_key,
+            pod.meta.creation_timestamp,
+            pod.meta.key,
+        )
+
+    order = sorted(range(len(pods)), key=queue_key)
     pods = [pods[i] for i in order]
     n = len(pods)
     p = pad_to or bucket_size(n)
@@ -130,7 +144,7 @@ def pack_pods(
         prod[i] = cls in (PriorityClass.PROD, PriorityClass.NONE)
         ds[i] = pod.meta.owner_kind == "DaemonSet"
         if gang_ids and pod.gang_name:
-            gang[i] = gang_ids.get(pod.gang_name, -1)
+            gang[i] = gang_ids.get(pod.gang_key, -1)
         if quota_ids and pod.quota_name:
             quota[i] = quota_ids.get(pod.quota_name, -1)
         valid[i] = True

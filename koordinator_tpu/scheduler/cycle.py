@@ -245,7 +245,7 @@ class Scheduler:
             if dead_gangs:
                 kept = []
                 for pod in pending:
-                    if pod.gang_name in dead_gangs:
+                    if pod.gang_key in dead_gangs:
                         result.rejected.append(pod.meta.key)
                         self.extender.error_handlers.dispatch(
                             pod, "gang schedule timeout")

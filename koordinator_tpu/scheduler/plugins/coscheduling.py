@@ -56,16 +56,18 @@ class CoschedulingPlugin(Plugin):
         }
 
     def _on_pod_group(self, ev: EventType, pg: PodGroup, old) -> None:
+        # keyed by the namespaced gang identity (core.go GetGangFullName):
+        # same-named gangs in different namespaces are distinct gangs
         if ev is EventType.DELETED:
-            self.pod_groups.pop(pg.meta.name, None)
+            self.pod_groups.pop(pg.meta.key, None)
             # a recreated gang with the same name is a fresh gang: it must be
             # timeout-eligible again (also bounds the latch set's growth)
-            self._ever_scheduled.discard(pg.meta.name)
+            self._ever_scheduled.discard(pg.meta.key)
         else:
-            self.pod_groups[pg.meta.name] = pg
+            self.pod_groups[pg.meta.key] = pg
 
     def _on_pod(self, ev: EventType, pod: Pod, old) -> None:
-        gang = pod.gang_name
+        gang = pod.gang_key
         if not gang:
             return
         if ev is EventType.ADDED:
@@ -108,7 +110,7 @@ class CoschedulingPlugin(Plugin):
 
         now = _time.time() if now is None else now
         for pg in self.pod_groups.values():
-            name = pg.meta.name
+            name = pg.meta.key
             scheduled = self.assumed.get(name, 0)
             if pg.phase == "Scheduled":  # restart recovery of the latch
                 self._ever_scheduled.add(name)

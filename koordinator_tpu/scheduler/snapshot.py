@@ -180,18 +180,18 @@ def build_full_chain_inputs(
     quota_ids = {name: i for i, name in enumerate(tree.names)}
 
     # ---- gangs
-    gang_index = {pg.meta.name: i for i, pg in enumerate(state.pod_groups)}
+    gang_index = {pg.meta.key: i for i, pg in enumerate(state.pod_groups)}
     ng = max(1, len(state.pod_groups))
     gang_min = np.zeros(ng, np.float32)
     gang_assumed = np.zeros(ng, np.float32)
     gang_total = np.zeros(ng, np.float32)
     for pg in state.pod_groups:
-        i = gang_index[pg.meta.name]
+        i = gang_index[pg.meta.key]
         gang_min[i] = pg.min_member
-        gang_assumed[i] = state.gang_assumed.get(pg.meta.name, 0)
+        gang_assumed[i] = state.gang_assumed.get(pg.meta.key, 0)
         gang_total[i] = gang_assumed[i]
     for pod in state.pending_pods:
-        g = pod.gang_name
+        g = pod.gang_key
         if g in gang_index:
             gang_total[gang_index[g]] += 1
     gang_valid = gang_total >= gang_min
@@ -204,6 +204,10 @@ def build_full_chain_inputs(
         args.estimated_scaling_factors,
         gang_ids=gang_index,
         quota_ids=quota_ids,
+        gang_sort={
+            pg.meta.key: (pg.meta.creation_timestamp, pg.meta.key)
+            for pg in state.pod_groups
+        },
     )
     P = pods.padded_size
     needs_bind = np.zeros(P, bool)

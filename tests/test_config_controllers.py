@@ -163,10 +163,10 @@ class TestPodGroupTimeout:
             min_member=2, schedule_timeout_seconds=600))
         sched = Scheduler(store)
         gang = sched.extender.plugin("Coscheduling")
-        gang.assumed["gang-c"] = 2
+        gang.assumed["default/gang-c"] = 2
         gang.update_pod_group_status(store, NOW)
         assert store.get(KIND_POD_GROUP, "default/gang-c").phase == "Scheduled"
-        gang.assumed["gang-c"] = 1  # member died
+        gang.assumed["default/gang-c"] = 1  # member died
         gang.update_pod_group_status(store, NOW + 100)
         assert store.get(KIND_POD_GROUP, "default/gang-c").phase == "Scheduling"
         assert gang.timed_out_gangs() == []

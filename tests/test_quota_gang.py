@@ -7,9 +7,12 @@ import numpy as np
 import pytest
 
 from koordinator_tpu.api.objects import (
+    LABEL_POD_GROUP,
     LABEL_QUOTA_PARENT,
     ElasticQuota,
     ObjectMeta,
+    Pod,
+    PodSpec,
 )
 from koordinator_tpu.api.resources import RESOURCE_INDEX, ResourceList, ResourceName
 from koordinator_tpu.ops.quota import (
@@ -316,3 +319,86 @@ class TestGangPermit:
             num_groups=1,
         )
         assert bool(keep[0])
+
+
+class TestQueueSortGangGrouping:
+    def test_gang_members_pack_contiguously(self):
+        """coscheduling.go:118 Less: equal-priority gang members group by
+        their GANG's creation/name, not their own creation time, so a gang
+        never interleaves with unrelated pods in the queue."""
+        from koordinator_tpu.ops.loadaware import LoadAwareArgs
+        from koordinator_tpu.ops.packing import pack_pods
+
+        args = LoadAwareArgs()
+        old_gang = (100.0, "default/old-gang")
+        new_gang = (300.0, "default/new-gang")
+        pods = []
+
+        def add(name, ts, gang=None, prio=5000):
+            pod = Pod(
+                meta=ObjectMeta(name=name, creation_timestamp=ts,
+                                labels=({LABEL_POD_GROUP: gang} if gang else {})),
+                spec=PodSpec(priority=prio,
+                             requests=ResourceList.of(cpu=1000)),
+            )
+            pods.append(pod)
+
+        # interleaved creation times across two gangs + loose pods
+        add("o1", 110.0, gang="old-gang")
+        add("loose-early", 50.0)
+        add("n1", 310.0, gang="new-gang")
+        add("o2", 400.0, gang="old-gang")  # created late, still groups early
+        add("n2", 305.0, gang="new-gang")
+        add("loose-late", 500.0)
+        add("vip", 999.0, prio=9000)       # priority still dominates
+
+        packed = pack_pods(
+            pods, args.resource_weights, args.estimated_scaling_factors,
+            gang_sort={"default/old-gang": old_gang, "default/new-gang": new_gang},
+        )
+        names = [k.split("/")[1] for k in packed.keys]
+        assert names == ["vip", "loose-early", "o1", "o2", "n2", "n1",
+                         "loose-late"]
+
+    def test_same_named_gangs_in_different_namespaces_are_distinct(self):
+        """Gang identity is namespace/name (core.go GetGangFullName): a gang
+        'g' in namespace a and a gang 'g' in namespace b must not share
+        min-member accounting or queue grouping."""
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from koordinator_tpu.api.objects import Node, PodGroup
+        from koordinator_tpu.client.store import (
+            KIND_NODE,
+            KIND_POD,
+            KIND_POD_GROUP,
+            ObjectStore,
+        )
+        from koordinator_tpu.scheduler.cycle import Scheduler
+
+        store = ObjectStore()
+        store.add(KIND_NODE, Node(
+            meta=ObjectMeta(name="n0", namespace=""),
+            allocatable=ResourceList.of(cpu=16000, memory=64 << 30, pods=20),
+        ))
+        now = 1_000_000.0
+        # ns-a gang needs 2 and has 2 -> schedules; ns-b gang (same bare
+        # name!) needs 3 and has 1 -> must NOT ride ns-a's count
+        store.add(KIND_POD_GROUP, PodGroup(
+            meta=ObjectMeta(name="g", namespace="a", creation_timestamp=now),
+            min_member=2))
+        store.add(KIND_POD_GROUP, PodGroup(
+            meta=ObjectMeta(name="g", namespace="b", creation_timestamp=now),
+            min_member=3))
+        for ns, name in (("a", "m0"), ("a", "m1"), ("b", "m0")):
+            store.add(KIND_POD, Pod(
+                meta=ObjectMeta(name=name, namespace=ns, uid=f"{ns}-{name}",
+                                creation_timestamp=now,
+                                labels={LABEL_POD_GROUP: "g"}),
+                spec=PodSpec(requests=ResourceList.of(cpu=1000,
+                                                      memory=1 << 30)),
+            ))
+        result = Scheduler(store).run_cycle(now=now)
+        bound = {b.pod_key for b in result.bound}
+        assert bound == {"a/m0", "a/m1"}
+        assert set(result.rejected) == {"b/m0"}
