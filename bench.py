@@ -50,6 +50,12 @@ def main() -> None:
         help="full = Fit+LoadAware+NUMA+quota+gang (BASELINE config 4); "
         "loadaware = config 1 kernel",
     )
+    ap.add_argument(
+        "--kernel",
+        choices=["auto", "serial", "pallas", "wave"],
+        default="auto",
+        help="full-chain kernel selection (auto = backend/VMEM-based)",
+    )
     args_cli = ap.parse_args()
 
     num_pods = args_cli.pods or (100 if args_cli.smoke else 10_000)
@@ -183,7 +189,8 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int) -> None:
         f"{len(active_axes)} active resource axes)"
     )
 
-    step = build_best_full_chain_step(la, ng, ngroups, active_axes=active_axes)
+    step = build_best_full_chain_step(la, ng, ngroups, active_axes=active_axes,
+                                      kernel=args_cli.kernel)
     t0 = time.perf_counter()
     chosen, _, _ = step(fc)
     chosen = np.asarray(jax.block_until_ready(chosen))
@@ -210,11 +217,12 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int) -> None:
         f"p99 {p99_ms:.1f}ms (batch == one scheduling round)"
     )
 
-    # ---- on-chip kernel parity: if the selected step is the Pallas kernel,
-    # run the XLA fori_loop step once at FULL scale and diff the bindings
+    # ---- on-chip kernel parity: whenever the selected step is NOT the XLA
+    # fori_loop itself (pallas or wave), run the serial XLA step once at FULL
+    # scale and diff the bindings
     parity_ok = True
     backend = getattr(step, "last_backend", None)
-    if jax.default_backend() == "tpu" and backend == "pallas":
+    if jax.default_backend() == "tpu" and backend in ("pallas", "wave"):
         from koordinator_tpu.models.full_chain import build_full_chain_step
 
         xla_step = build_full_chain_step(la, ng, ngroups,
@@ -222,7 +230,7 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int) -> None:
         chosen_xla = np.asarray(jax.block_until_ready(xla_step(fc)[0]))
         mism = int((chosen != chosen_xla).sum())
         parity_ok = mism == 0
-        log(f"on-chip Pallas-vs-XLA full-batch parity: "
+        log(f"on-chip {backend}-vs-XLA full-batch parity: "
             f"{'OK' if parity_ok else f'{mism} MISMATCHES'}")
     else:
         log(f"on-chip parity: skipped (backend={backend or 'xla'})")

@@ -232,7 +232,7 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
 
 def build_best_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                                num_groups: int, active_axes=None,
-                               vmem_budget_bytes=None):
+                               vmem_budget_bytes=None, kernel: str = "auto"):
     """Backend-aware selector: the VMEM-resident Pallas kernel on TPU
     (ops/pallas_full_chain.py, ~20x the fori_loop at 10k x 5k), the XLA
     step elsewhere. Same contract, bit-identical bindings.
@@ -241,9 +241,39 @@ def build_best_full_chain_step(args: LoadAwareArgs, num_gangs: int,
     is bounded (~20k nodes at R=16, less with NUMA zones and quota groups);
     past the budget the per-call dispatch degrades to the XLA step instead
     of failing to compile. Shapes are static under jit, so the dispatch
-    happens at trace time and costs nothing per step."""
+    happens at trace time and costs nothing per step.
+
+    ``kernel`` forces an implementation: "serial" (XLA fori_loop), "pallas",
+    or "wave" (models/wave_chain.py); "auto" is the default selection above.
+    """
+    def _forced(step_fn, name):
+        # plain wrapper: jitted callables reject attribute assignment
+        def step(fc):
+            return step_fn(fc)
+
+        step.last_backend = name
+        return step
+
+    if kernel == "serial":
+        return _forced(
+            build_full_chain_step(args, num_gangs, num_groups,
+                                  active_axes=active_axes),
+            "serial",
+        )
+    if kernel == "wave":
+        from koordinator_tpu.models.wave_chain import (
+            build_wave_full_chain_step,
+        )
+
+        return _forced(
+            build_wave_full_chain_step(args, num_gangs, num_groups,
+                                       active_axes=active_axes),
+            "wave",
+        )
     xla_step = build_full_chain_step(args, num_gangs, num_groups,
                                      active_axes=active_axes)
+    if kernel == "pallas" and jax.default_backend() != "tpu":
+        raise ValueError("kernel='pallas' requires the TPU backend")
     if jax.default_backend() != "tpu":
         return xla_step
     from koordinator_tpu.ops import pallas_common as pc
