@@ -91,6 +91,8 @@ class Pod:
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodSpec = field(default_factory=PodSpec)
     phase: str = "Pending"  # Pending/Running/Succeeded/Failed
+    reason: str = ""        # status.reason (e.g. "OutOfCpu", "NodeShutdown")
+    restart_count: int = 0  # sum of container restart counts
 
     @property
     def qos_class(self) -> QoSClass:

@@ -115,6 +115,144 @@ class RemoveDuplicates(BalancePlugin):
         return Status()
 
 
+class PodLifeTime(DeschedulePlugin):
+    """Evict pods older than maxPodLifeTimeSeconds, optionally restricted to
+    pod phases (sigs.k8s.io podlifetime: PodLifeTimeArgs.MaxPodLifeTimeSeconds
+    + States)."""
+
+    name = "PodLifeTime"
+
+    def __init__(self, store: ObjectStore, args: dict = None) -> None:
+        args = args or {}
+        self.store = store
+        self.handle = None
+        self.max_seconds = float(args.get("maxPodLifeTimeSeconds", 86400))
+        self.states = set(args.get("states", []))  # empty = any phase
+
+    def deschedule(self, nodes: List[Node], now: float) -> Status:
+        for pod in _live_assigned(self.store):
+            if self.states and pod.phase not in self.states:
+                continue
+            age = now - pod.meta.creation_timestamp
+            if age > self.max_seconds:
+                self.handle.evict(
+                    pod, self.name,
+                    f"pod lifetime {age:.0f}s exceeds {self.max_seconds:.0f}s",
+                )
+        return Status()
+
+
+class RemoveFailedPods(DeschedulePlugin):
+    """Evict Failed pods so their controllers can recreate them fresh
+    (sigs.k8s.io removefailedpods: reasons filter, minPodLifetimeSeconds,
+    excludeOwnerKinds)."""
+
+    name = "RemoveFailedPods"
+
+    def __init__(self, store: ObjectStore, args: dict = None) -> None:
+        args = args or {}
+        self.store = store
+        self.handle = None
+        self.reasons = set(args.get("reasons", []))  # empty = any reason
+        self.min_lifetime = float(args.get("minPodLifetimeSeconds", 0))
+        self.exclude_owner_kinds = set(args.get("excludeOwnerKinds", []))
+        # upstream defaultevictor EvictFailedBarePods: bare failed pods have
+        # no controller to recreate them, so deleting destroys the failure
+        # record — opt-in only
+        self.evict_failed_bare_pods = bool(args.get("evictFailedBarePods",
+                                                    False))
+
+    def deschedule(self, nodes: List[Node], now: float) -> Status:
+        from koordinator_tpu.descheduler.evictions import ANNOTATION_EVICTABLE
+
+        for pod in self.store.list(KIND_POD):
+            if pod.phase != "Failed" or not pod.is_assigned:
+                continue
+            if self.reasons and pod.reason not in self.reasons:
+                continue
+            if pod.meta.owner_kind in self.exclude_owner_kinds:
+                continue
+            if now - pod.meta.creation_timestamp < self.min_lifetime:
+                continue
+            # a Failed pod is already terminated, so the standard evictor
+            # chain (which refuses terminated pods) does not apply —
+            # upstream's eviction of a failed pod IS deletion. The explicit
+            # opt-out annotation and the bare-pod guard still hold.
+            if pod.meta.annotations.get(ANNOTATION_EVICTABLE) == "false":
+                continue
+            if not pod.meta.owner_kind and not self.evict_failed_bare_pods:
+                continue
+            self.store.delete(KIND_POD, pod.meta.key)
+            if self.handle is not None:
+                self.handle.evicted_count += 1
+        return Status()
+
+
+class RemovePodsHavingTooManyRestarts(DeschedulePlugin):
+    """Evict crash-looping pods past a restart threshold (sigs.k8s.io
+    removepodshavingtoomanyrestarts: PodRestartThreshold)."""
+
+    name = "RemovePodsHavingTooManyRestarts"
+
+    def __init__(self, store: ObjectStore, args: dict = None) -> None:
+        args = args or {}
+        self.store = store
+        self.handle = None
+        self.threshold = int(args.get("podRestartThreshold", 100))
+
+    def deschedule(self, nodes: List[Node], now: float) -> Status:
+        for pod in _live_assigned(self.store):
+            if pod.restart_count >= self.threshold:
+                self.handle.evict(
+                    pod, self.name,
+                    f"{pod.restart_count} restarts >= {self.threshold}",
+                )
+        return Status()
+
+
+class RemovePodsViolatingNodeTaints(DeschedulePlugin):
+    """Evict pods that no longer tolerate their node's taints (sigs.k8s.io
+    removepodsviolatingnodetaints; taints carry NoSchedule semantics in this
+    model)."""
+
+    name = "RemovePodsViolatingNodeTaints"
+
+    def __init__(self, store: ObjectStore, args: dict = None) -> None:
+        self.store = store
+        self.handle = None
+
+    @staticmethod
+    def _tolerates(pod: Pod, node: Node) -> bool:
+        tolerations = set(pod.spec.tolerations)
+        for key, value in node.taints:
+            if (key, value) in tolerations or (key, "") in tolerations:
+                continue  # exact or key-wildcard toleration
+            return False
+        return True
+
+    def deschedule(self, nodes: List[Node], now: float) -> Status:
+        by_name = {n.meta.name: n for n in nodes}
+        for pod in _live_assigned(self.store):
+            node = by_name.get(pod.spec.node_name)
+            if node is None or not node.taints:
+                continue
+            if self._tolerates(pod, node):
+                continue
+            # feasibility pre-check (same guard as the affinity/duplicates
+            # plugins): evict only when some OTHER schedulable node could
+            # host the pod, else the evict/reschedule-back loop churns it
+            if not any(
+                n.meta.name != pod.spec.node_name
+                and not n.unschedulable
+                and self._tolerates(pod, n)
+                and node_matches_pod(n, pod)
+                for n in nodes
+            ):
+                continue
+            self.handle.evict(pod, self.name, "node taints not tolerated")
+        return Status()
+
+
 def register_defaults() -> None:
     """Install the built-in plugin set into the framework registry."""
     from koordinator_tpu.descheduler.framework import DefaultEvictor
@@ -130,6 +268,20 @@ def register_defaults() -> None:
     )
     register_plugin(
         "RemoveDuplicates", lambda store, args: RemoveDuplicates(store, args)
+    )
+    register_plugin(
+        "PodLifeTime", lambda store, args: PodLifeTime(store, args)
+    )
+    register_plugin(
+        "RemoveFailedPods", lambda store, args: RemoveFailedPods(store, args)
+    )
+    register_plugin(
+        "RemovePodsHavingTooManyRestarts",
+        lambda store, args: RemovePodsHavingTooManyRestarts(store, args),
+    )
+    register_plugin(
+        "RemovePodsViolatingNodeTaints",
+        lambda store, args: RemovePodsViolatingNodeTaints(store, args),
     )
     register_plugin(
         "LowNodeLoad",

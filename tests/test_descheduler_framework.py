@@ -188,3 +188,152 @@ class TestTwoProfiles:
         )
         assert survivors == ["r0"]
         assert "affinity" in out["profiles"] and "dedupe" in out["profiles"]
+
+
+class TestPodLifeTime:
+    def test_old_pods_evicted_states_filtered(self):
+        store = ObjectStore()
+        _node(store, "node-a")
+        old = _pod(store, "old", node="node-a", created=NOW - 7200)
+        young = _pod(store, "young", node="node-a", created=NOW - 60)
+        old_pending = Pod(meta=ObjectMeta(name="old-pending",
+                                          creation_timestamp=NOW - 7200),
+                          spec=PodSpec(node_name="node-a"))
+        store.add(KIND_POD, old_pending)  # phase Pending
+        profile = Profile(ProfileConfig(
+            deschedule=["PodLifeTime"],
+            plugin_args={"PodLifeTime": {"maxPodLifeTimeSeconds": 3600,
+                                         "states": ["Running"]}},
+        ), store)
+        profile.run(NOW)
+        assert store.get(KIND_POD, old.meta.key).is_terminated
+        assert not store.get(KIND_POD, young.meta.key).is_terminated
+        assert not store.get(KIND_POD, old_pending.meta.key).is_terminated
+
+
+class TestRemoveFailedPods:
+    def test_failed_pods_evicted_with_filters(self):
+        store = ObjectStore()
+        _node(store, "node-a")
+        failed = _pod(store, "failed", node="node-a", created=NOW - 600)
+        failed.phase, failed.reason = "Failed", "OutOfCpu"
+        store.update(KIND_POD, failed)
+        wrong_reason = _pod(store, "wrong-reason", node="node-a",
+                            created=NOW - 600)
+        wrong_reason.phase, wrong_reason.reason = "Failed", "Evicted"
+        store.update(KIND_POD, wrong_reason)
+        excluded = _pod(store, "excluded", node="node-a", created=NOW - 600,
+                        owner=("DaemonSet", "ds"))
+        excluded.phase, excluded.reason = "Failed", "OutOfCpu"
+        store.update(KIND_POD, excluded)
+        running = _pod(store, "running", node="node-a")
+        recent = _pod(store, "recent", node="node-a", created=NOW - 60)
+        recent.phase, recent.reason = "Failed", "OutOfCpu"
+        store.update(KIND_POD, recent)
+        profile = Profile(ProfileConfig(
+            deschedule=["RemoveFailedPods"],
+            plugin_args={"RemoveFailedPods": {
+                "reasons": ["OutOfCpu"],
+                "minPodLifetimeSeconds": 300,
+                "excludeOwnerKinds": ["DaemonSet"],
+            }},
+        ), store)
+        profile.run(NOW)
+        # the matching failed pod is DELETED (controller recreates it)
+        assert store.get(KIND_POD, failed.meta.key) is None
+        # filtered pods survive: wrong reason, excluded owner, too recent
+        assert store.get(KIND_POD, wrong_reason.meta.key) is not None
+        assert store.get(KIND_POD, excluded.meta.key) is not None
+        assert store.get(KIND_POD, recent.meta.key) is not None
+        assert not store.get(KIND_POD, running.meta.key).is_terminated
+
+
+class TestTooManyRestarts:
+    def test_crashlooping_pod_evicted(self):
+        store = ObjectStore()
+        _node(store, "node-a")
+        looping = _pod(store, "looping", node="node-a")
+        looping.restart_count = 12
+        store.update(KIND_POD, looping)
+        healthy = _pod(store, "healthy", node="node-a")
+        profile = Profile(ProfileConfig(
+            deschedule=["RemovePodsHavingTooManyRestarts"],
+            plugin_args={"RemovePodsHavingTooManyRestarts": {
+                "podRestartThreshold": 10}},
+        ), store)
+        profile.run(NOW)
+        assert store.get(KIND_POD, looping.meta.key).is_terminated
+        assert not store.get(KIND_POD, healthy.meta.key).is_terminated
+
+
+class TestNodeTaints:
+    def test_untolerated_pod_evicted(self):
+        store = ObjectStore()
+        store.add(KIND_NODE, Node(
+            meta=ObjectMeta(name="tainted", namespace=""),
+            allocatable=ResourceList.of(cpu=16000, memory=64 * GIB),
+            taints=[("dedicated", "infra")],
+        ))
+        _node(store, "clean")
+        victim = _pod(store, "victim", node="tainted")
+        tolerant = _pod(store, "tolerant", node="tainted")
+        tolerant.spec.tolerations = [("dedicated", "infra")]
+        store.update(KIND_POD, tolerant)
+        wildcard = _pod(store, "wildcard", node="tainted")
+        wildcard.spec.tolerations = [("dedicated", "")]
+        store.update(KIND_POD, wildcard)
+        elsewhere = _pod(store, "elsewhere", node="clean")
+        profile = Profile(ProfileConfig(
+            deschedule=["RemovePodsViolatingNodeTaints"]), store)
+        profile.run(NOW)
+        assert store.get(KIND_POD, victim.meta.key).is_terminated
+        assert not store.get(KIND_POD, tolerant.meta.key).is_terminated
+        assert not store.get(KIND_POD, wildcard.meta.key).is_terminated
+        assert not store.get(KIND_POD, elsewhere.meta.key).is_terminated
+
+    def test_opt_out_and_bare_pods_protected(self):
+        store = ObjectStore()
+        _node(store, "node-a")
+        opted_out = _pod(store, "opted-out", node="node-a", created=NOW - 600)
+        opted_out.phase = "Failed"
+        opted_out.meta.annotations[
+            "descheduler.koordinator.sh/evictable"] = "false"
+        store.update(KIND_POD, opted_out)
+        bare = _pod(store, "bare", node="node-a", created=NOW - 600,
+                    owner=None)
+        bare.phase = "Failed"
+        store.update(KIND_POD, bare)
+        profile = Profile(ProfileConfig(deschedule=["RemoveFailedPods"]),
+                          store)
+        profile.run(NOW)
+        assert store.get(KIND_POD, opted_out.meta.key) is not None
+        assert store.get(KIND_POD, bare.meta.key) is not None
+        assert profile.handle.evicted_count == 0
+
+        # bare-pod deletion is opt-in (EvictFailedBarePods)
+        profile2 = Profile(ProfileConfig(
+            deschedule=["RemoveFailedPods"],
+            plugin_args={"RemoveFailedPods": {"evictFailedBarePods": True}},
+        ), store)
+        profile2.run(NOW)
+        assert store.get(KIND_POD, bare.meta.key) is None
+        assert profile2.handle.evicted_count == 1
+
+    def test_no_eviction_without_tolerable_alternative(self):
+        store = ObjectStore()
+        store.add(KIND_NODE, Node(
+            meta=ObjectMeta(name="tainted-a", namespace=""),
+            allocatable=ResourceList.of(cpu=16000, memory=64 * GIB),
+            taints=[("dedicated", "infra")],
+        ))
+        store.add(KIND_NODE, Node(
+            meta=ObjectMeta(name="tainted-b", namespace=""),
+            allocatable=ResourceList.of(cpu=16000, memory=64 * GIB),
+            taints=[("dedicated", "gpu")],
+        ))
+        stuck = _pod(store, "stuck", node="tainted-a")
+        profile = Profile(ProfileConfig(
+            deschedule=["RemovePodsViolatingNodeTaints"]), store)
+        profile.run(NOW)
+        # every other node is also intolerable: evicting would churn forever
+        assert not store.get(KIND_POD, stuck.meta.key).is_terminated
