@@ -124,9 +124,13 @@ class PodLifeTime(DeschedulePlugin):
 
     def __init__(self, store: ObjectStore, args: dict = None) -> None:
         args = args or {}
+        if "maxPodLifeTimeSeconds" not in args:
+            # upstream validation treats the parameter as required; a silent
+            # default would start evicting cluster-wide on an empty config
+            raise ValueError("PodLifeTime requires maxPodLifeTimeSeconds")
         self.store = store
         self.handle = None
-        self.max_seconds = float(args.get("maxPodLifeTimeSeconds", 86400))
+        self.max_seconds = float(args["maxPodLifeTimeSeconds"])
         self.states = set(args.get("states", []))  # empty = any phase
 
     def deschedule(self, nodes: List[Node], now: float) -> Status:
@@ -163,8 +167,6 @@ class RemoveFailedPods(DeschedulePlugin):
                                                     False))
 
     def deschedule(self, nodes: List[Node], now: float) -> Status:
-        from koordinator_tpu.descheduler.evictions import ANNOTATION_EVICTABLE
-
         for pod in self.store.list(KIND_POD):
             if pod.phase != "Failed" or not pod.is_assigned:
                 continue
@@ -174,13 +176,26 @@ class RemoveFailedPods(DeschedulePlugin):
                 continue
             if now - pod.meta.creation_timestamp < self.min_lifetime:
                 continue
-            # a Failed pod is already terminated, so the standard evictor
-            # chain (which refuses terminated pods) does not apply —
-            # upstream's eviction of a failed pod IS deletion. The explicit
-            # opt-out annotation and the bare-pod guard still hold.
-            if pod.meta.annotations.get(ANNOTATION_EVICTABLE) == "false":
-                continue
-            if not pod.meta.owner_kind and not self.evict_failed_bare_pods:
+            # a Failed pod is already terminated, which the evictor chain
+            # categorically refuses — but every OTHER evictability guard
+            # (opt-out annotation, DaemonSet, system-critical priority, any
+            # profile-configured FilterPlugins) still applies: run the full
+            # chain on a view with the phase neutralized, then delete
+            # (upstream's eviction of a failed pod IS deletion)
+            import dataclasses
+
+            if not pod.meta.owner_kind:
+                if not self.evict_failed_bare_pods:
+                    continue
+                # EvictFailedBarePods waives ONLY the bare-pod rule: fake an
+                # owner on the view so the rest of the chain still runs
+                view_meta = dataclasses.replace(
+                    pod.meta, owner_kind="__evict-failed-bare__"
+                )
+            else:
+                view_meta = pod.meta
+            view = dataclasses.replace(pod, phase="Running", meta=view_meta)
+            if self.handle is not None and not self.handle.filter(view):
                 continue
             self.store.delete(KIND_POD, pod.meta.key)
             if self.handle is not None:
@@ -223,12 +238,9 @@ class RemovePodsViolatingNodeTaints(DeschedulePlugin):
 
     @staticmethod
     def _tolerates(pod: Pod, node: Node) -> bool:
-        tolerations = set(pod.spec.tolerations)
-        for key, value in node.taints:
-            if (key, value) in tolerations or (key, "") in tolerations:
-                continue  # exact or key-wildcard toleration
-            return False
-        return True
+        from koordinator_tpu.ops.taints import tolerates_taints
+
+        return tolerates_taints(pod.spec.tolerations, node.taints)
 
     def deschedule(self, nodes: List[Node], now: float) -> Status:
         by_name = {n.meta.name: n for n in nodes}

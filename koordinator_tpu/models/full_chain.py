@@ -52,7 +52,9 @@ class FullChainInputs(NamedTuple):
     needs_bind: jnp.ndarray     # [P] bool — requires cpuset binding
     cores_needed: jnp.ndarray   # [P] float — whole cpus for cpuset pods
     full_pcpus: jnp.ndarray     # [P] bool — resolved FullPCPUs policy
+    pod_taint_mask: jnp.ndarray  # [P] f32 bitmask of tolerated taint groups
     # nodes
+    node_taint_group: jnp.ndarray  # [N] int32 taint-set group (ops/taints.py)
     numa_free: jnp.ndarray      # [N, K, R]
     numa_capacity: jnp.ndarray  # [N, K, R]
     numa_policy: jnp.ndarray    # [N] int32
@@ -125,8 +127,17 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode):
         numa_ok, zone = numa_admit_row(
             req, fc.needs_numa[i], numa_free, fc.numa_policy
         )
+        # TaintToleration (vendored default plugin): pod tolerates the node's
+        # taint-set group (ops/taints.py bit test)
+        taint_ok = (
+            jnp.right_shift(
+                fc.pod_taint_mask[i].astype(jnp.int32), fc.node_taint_group
+            )
+            & 1
+        ) == 1
         feasible = (
-            inputs.node_ok & fit & la_ok & cpuset_ok & numa_ok & admit
+            inputs.node_ok & fit & la_ok & cpuset_ok & numa_ok & taint_ok
+            & admit
         )
 
         # ---- Score chain (equal plugin weights, each already 0..100)

@@ -49,12 +49,21 @@ def _load() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:
         return None
+    # reject a stale .so built against an older argument list — calling it
+    # would read every pointer after the insertion shifted
+    try:
+        lib.koord_floor_abi_version.restype = ctypes.c_int
+        if lib.koord_floor_abi_version() != 2:
+            return None
+    except AttributeError:
+        return None
     lib.koord_serial_full_chain.restype = None
     lib.koord_serial_full_chain.argtypes = (
         [ctypes.c_int] * 8           # P R N K G A NG prod_mode
         + [_F32P] * 3                # fit_requests requests estimated
         + [_I32P] * 7                # is_prod..needs_bind
         + [_F32P] + [_I32P]          # cores_needed full_pcpus
+        + [_I32P]                    # pod_taint_mask
         + [_F32P, _F32P] + [_I32P]   # allocatable requested node_ok
         + [_F32P] + [_I32P]          # filter_usage has_filter_usage
         + [_F32P] * 5                # filter_thr prod_thr prod_usage term_np term_pr
@@ -62,6 +71,7 @@ def _load() -> Optional[ctypes.CDLL]:
         + [_F32P]                    # weights
         + [_F32P] + [_I32P] * 2      # numa_free numa_policy has_topology
         + [_F32P] * 2                # bind_free cpus_per_core
+        + [_I32P]                    # node_taint_group
         + [_I32P] + [_F32P] * 2      # ancestors quota_used quota_runtime
         + [_I32P] + [_F32P] * 2      # gang_valid gang_min gang_assumed
         + [_I32P, ctypes.c_int]      # gang_group num_groups
@@ -115,6 +125,7 @@ def serial_schedule_full_native(fc, args, num_groups: int = 0) -> np.ndarray:
         _i32(inputs.pod_valid), _i32(fc.gang_id), _i32(fc.quota_id),
         _i32(fc.needs_numa), _i32(fc.needs_bind),
         _f32(fc.cores_needed), _i32(fc.full_pcpus),
+        _i32(fc.pod_taint_mask),
         allocatable, _f32(inputs.requested).copy(), _i32(inputs.node_ok),
         _f32(inputs.la_filter_usage), _i32(inputs.la_has_filter_usage),
         _f32(inputs.la_filter_thresholds), _f32(inputs.la_prod_thresholds),
@@ -124,6 +135,7 @@ def serial_schedule_full_native(fc, args, num_groups: int = 0) -> np.ndarray:
         _f32(inputs.weights),
         numa_free, _i32(fc.numa_policy), _i32(fc.has_topology),
         _f32(fc.bind_free).copy(), _f32(fc.cpus_per_core),
+        _i32(fc.node_taint_group),
         ancestors if ancestors.size else np.zeros((1, 1), np.int32),
         _f32(fc.quota_used).copy() if G else np.zeros((1, R), np.float32),
         _f32(fc.quota_runtime) if G else np.zeros((1, R), np.float32),

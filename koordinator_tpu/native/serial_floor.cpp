@@ -28,6 +28,10 @@ inline float least_requested(float requested, float capacity) {
 
 }  // namespace
 
+// ABI version: bump when koord_serial_full_chain's signature changes, so a
+// stale .so is rejected instead of mis-reading shifted pointers.
+extern "C" int koord_floor_abi_version() { return 2; }
+
 extern "C" {
 
 // All 2-D arrays are row-major contiguous. Mutable state arrays (requested,
@@ -50,6 +54,7 @@ void koord_serial_full_chain(
     const int32_t* needs_bind,   // [P]
     const float* cores_needed,   // [P]
     const int32_t* full_pcpus,   // [P]
+    const int32_t* pod_taint_mask, // [P] bitmask of tolerated taint groups
     // nodes
     const float* allocatable,    // [N, R]
     float* requested_state,      // [N, R] (mutated)
@@ -70,6 +75,7 @@ void koord_serial_full_chain(
     const int32_t* has_topology, // [N]
     float* bind_free,            // [N] (mutated)
     const float* cpus_per_core,  // [N]
+    const int32_t* node_taint_group, // [N]
     // quota
     const int32_t* ancestors,    // [G, A] (-1 padded)
     float* quota_used,           // [G, R] (mutated)
@@ -120,6 +126,8 @@ void koord_serial_full_chain(
 
     for (int n = 0; n < N; ++n) {
       if (!node_ok[n]) continue;
+      // TaintToleration: group bit test (ops/taints.py)
+      if (!((pod_taint_mask[p] >> node_taint_group[n]) & 1)) continue;
       const float* alloc = allocatable + (int64_t)n * R;
       const float* reqn = requested_state + (int64_t)n * R;
       // Filter: Fit

@@ -49,7 +49,7 @@ def estimate_vmem_bytes(N: int, R: int, K: int, G: int, P: int) -> int:
     P_pad = -(-P // 8) * 8
     G_eff = max(G, 1)
     G_lane = max(128, -(-G_eff // 128) * 128)
-    floats = (3 * R * P_pad + 7 * R * N + 2 * K * R * N + 10 * N
+    floats = (3 * R * P_pad + 7 * R * N + 2 * K * R * N + 11 * N
               + 3 * R * G_lane + max(G_eff, 8) * G_lane + P_pad)
     return 4 * floats
 
@@ -63,6 +63,7 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         # --- SMEM per-pod scalars
         prod_ref, valid_ref, ds_ref, gangok_ref,
         needsnuma_ref, needsbind_ref, fullpcpus_ref, cores_ref,  # f32 [P]
+        taintmask_ref,                                            # f32 [P]
         qid_ref,                                                  # int32 [P]
         # --- VMEM pod columns [R, P]
         fitreq_ref, rawreq_ref, est_ref,
@@ -71,6 +72,7 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         # --- VMEM node rows [1, N]
         lafeas_np_ref, lafeas_pr_ref, node_ok_ref, score_valid_ref,
         has_topo_ref, bindfree0_ref, cpc_ref, policy_ref,
+        taintpow_ref,                                  # [1, N] f32 2^group
         # --- VMEM numa [K*R, N] / quota [G, G] + [R, G]
         numafree0_ref, anc_ref, qused0_ref, qruntime_ref,
         # --- outputs
@@ -152,8 +154,13 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         numa_ok_f = jnp.where(policy == POLICY_NONE, 1.0, numa_ok_f)
         numa_ok = jnp.where(needs_numa, numa_ok_f, 1.0) > 0
 
+        # ---- Filter: TaintToleration — bit test in exact f32 arithmetic
+        # (floor/mod; Mosaic has no shift-by-vector): bit g of mask is
+        # floor(mask / 2^g) mod 2
+        taint_ok = jnp.remainder(
+            jnp.floor(taintmask_ref[i] / taintpow_ref[0, :]), 2.0) >= 1.0
         feasible = ((node_ok_ref[0, :] > 0) & fit & la_ok & cpuset_ok
-                    & numa_ok & admit)
+                    & numa_ok & taint_ok & admit)
 
         # ---- Score: LoadAware + NodeNUMAResource least-allocated
         if prod_mode:
@@ -276,6 +283,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             spad(inputs.is_daemonset), spad(gang_pod_ok),
             spad(fc.needs_numa), spad(fc.needs_bind),
             spad(fc.full_pcpus), spad(fc.cores_needed),
+            jnp.pad(f32(fc.pod_taint_mask), pad_p, constant_values=1.0),
             jnp.pad(qid, pad_p, constant_values=-1),
             pods_t(inputs.fit_requests), pods_t(fc.requests),
             pods_t(inputs.estimated),
@@ -285,6 +293,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             row(inputs.node_ok), row(inputs.la_score_valid),
             row(fc.has_topology), row(fc.bind_free), row(fc.cpus_per_core),
             jnp.asarray(fc.numa_policy, jnp.int32)[None, :],
+            jnp.exp2(f32(fc.node_taint_group))[None, :],
             numa0, jnp.asarray(anc, jnp.float32), qused0, qruntime,
         )
         smem, full = pc.smem_spec, pc.full_spec
@@ -292,10 +301,10 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             kernel,
             grid=(P_pad,),
             in_specs=(
-                [smem()] * 9
+                [smem()] * 10
                 + [full((R, P_pad))] * 3
                 + [full((R, N))] * 4
-                + [full((1, N))] * 8
+                + [full((1, N))] * 9
                 + [full((K * R, N)), full((max(G_eff, 8), G_lane)),
                    full((R, G_lane)), full((R, G_lane))]
             ),

@@ -1,0 +1,67 @@
+"""Taint/toleration admission, batched.
+
+The kube-scheduler's TaintToleration plugin (a vendored default in the
+reference's scheduler binary) rejects nodes whose NoSchedule taints the pod
+does not tolerate. Per-(pod, node) set checks don't batch, so the snapshot
+factorizes them: distinct node taint-SETS get small group ids (real clusters
+have a handful), each node carries its group id [N], and each pod carries a
+bitmask of tolerated groups [P]. The kernel check collapses to one
+elementwise bit test: ``(pod_mask >> node_group) & 1``.
+
+Masks are stored as float32 (exact for < 2^24) so the Pallas kernel can do
+the bit test with floor/mod arithmetic — Mosaic lowers those everywhere,
+unlike shift-by-vector. Group 0 is the empty taint set (always tolerated);
+group ``MAX_TAINT_GROUPS - 1`` is the overflow bucket for clusters with more
+distinct taint sets than bits — no pod ever tolerates it (conservative: the
+scheduler refuses placements it cannot prove, never the reverse).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+MAX_TAINT_GROUPS = 24  # bits must stay exact in float32 (< 2^24)
+
+
+def tolerates_taints(tolerations: Sequence[Tuple[str, str]],
+                     taints: Sequence[Tuple[str, str]]) -> bool:
+    """Exact (key, value) toleration, or (key, "") as a key-wildcard —
+    the same rule the descheduler's NodeTaints plugin applies."""
+    held = set(tolerations)
+    return all(
+        (key, value) in held or (key, "") in held for key, value in taints
+    )
+
+
+def group_node_taints(nodes) -> Tuple[np.ndarray, List[frozenset]]:
+    """(group_id [len(nodes)] int32, group taint-sets). Group 0 is the empty
+    set; sets beyond the bit budget collapse into the overflow group."""
+    sets: List[frozenset] = [frozenset()]
+    ids = {frozenset(): 0}
+    overflow = MAX_TAINT_GROUPS - 1
+    out = np.zeros(len(nodes), np.int32)
+    for i, node in enumerate(nodes):
+        key = frozenset(node.taints)
+        gid = ids.get(key)
+        if gid is None:
+            if len(sets) < overflow:
+                gid = len(sets)
+                ids[key] = gid
+                sets.append(key)
+            else:
+                gid = overflow
+        out[i] = gid
+    return out, sets
+
+
+def toleration_mask(pod, group_sets: List[frozenset]) -> float:
+    """Bitmask (as an exact float32 integer) of the groups this pod's
+    tolerations cover. The overflow group's bit is never set."""
+    mask = 0
+    tolerations = pod.spec.tolerations
+    for gid, taints in enumerate(group_sets):
+        if not taints or tolerates_taints(tolerations, taints):
+            mask |= 1 << gid
+    return float(mask)

@@ -51,6 +51,7 @@ _FC_NODE_FIELDS = frozenset(
         "has_topology",
         "bind_free",
         "cpus_per_core",
+        "node_taint_group",
     }
 )
 

@@ -209,6 +209,8 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
     quota_used = np.array(fc.quota_used, np.float32)
     quota_runtime = np.asarray(fc.quota_runtime, np.float32)
     gang_valid = np.asarray(fc.gang_valid)
+    pod_taint_mask = np.asarray(fc.pod_taint_mask)
+    node_taint_group = np.asarray(fc.node_taint_group)
 
     P, R = fit_requests.shape
     N, K, _ = numa_free.shape
@@ -273,6 +275,9 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
             ):
                 continue
             if not la_filter_ok(p, n):
+                continue
+            # TaintToleration: group bit test (ops/taints.py)
+            if not (int(pod_taint_mask[p]) >> int(node_taint_group[n])) & 1:
                 continue
             # cpuset filter
             if needs_bind[p]:

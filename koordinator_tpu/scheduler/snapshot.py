@@ -31,6 +31,7 @@ from koordinator_tpu.models.scheduler_model import make_inputs
 from koordinator_tpu.ops.loadaware import LoadAwareArgs, build_loadaware_node_state
 from koordinator_tpu.ops.numa import MAX_NUMA, POLICY_BY_NAME, POLICY_NONE
 from koordinator_tpu.ops.packing import NodeBatch, PodBatch, pack_nodes, pack_pods
+from koordinator_tpu.ops.taints import group_node_taints, toleration_mask
 from koordinator_tpu.ops.quota import (
     MAX_QUOTA_DEPTH,
     QuotaTreeArrays,
@@ -214,16 +215,23 @@ def build_full_chain_inputs(
     cores_needed = np.zeros(P, np.float32)
     full_pcpus = np.zeros(P, bool)
     needs_numa = np.zeros(P, bool)
+    pod_taint_mask = np.ones(P, np.float32)  # padding tolerates group 0
+    # taint factorization (ops/taints.py): node taint-sets -> group ids,
+    # pod tolerations -> group bitmasks
+    node_taint_ids, taint_sets = group_node_taints(state.nodes)
     pods_by_key_pending = {p.meta.key: p for p in state.pending_pods}
     for i, key in enumerate(pods.keys):
         pod = pods_by_key_pending[key]
         nb, cn, fp = _pod_cpuset_flags(pod)
         needs_bind[i], cores_needed[i], full_pcpus[i] = nb, cn, fp
         needs_numa[i] = bool(pod.spec.requests)
+        pod_taint_mask[i] = toleration_mask(pod, taint_sets)
 
     # ---- nodes
     nodes = pack_nodes(state.nodes, assigned_requests=state.assigned_requests)
     N = nodes.padded_size
+    node_taint_group = np.zeros(N, np.int32)  # padding: empty set
+    node_taint_group[: len(node_taint_ids)] = node_taint_ids
     nodes.extras = build_loadaware_node_state(
         state.nodes,
         state.node_metrics,
@@ -278,6 +286,8 @@ def build_full_chain_inputs(
         needs_bind=np.asarray(needs_bind),
         cores_needed=np.asarray(cores_needed),
         full_pcpus=np.asarray(full_pcpus),
+        pod_taint_mask=np.asarray(pod_taint_mask),
+        node_taint_group=np.asarray(node_taint_group),
         numa_free=np.asarray(numa_free),
         numa_capacity=np.asarray(numa_capacity),
         numa_policy=np.asarray(numa_policy),

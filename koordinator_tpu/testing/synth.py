@@ -180,6 +180,7 @@ def synth_full_cluster(
     num_gangs: int = 12,
     topology_fraction: float = 0.7,
     lsr_fraction: float = 0.15,
+    taint_fraction: float = 0.0,
     **kwargs,
 ):
     """SynthCluster + ClusterState exercising the full chain: NUMA topologies,
@@ -302,6 +303,20 @@ def synth_full_cluster(
                 cpu=cores * 1000, memory=pod.spec.requests[("memory")] or GIB
             )
             pod.spec.limits = ResourceList()
+
+    # taints: a fraction of nodes dedicated to a pool; a fraction of pods
+    # tolerate each pool (TaintToleration coverage)
+    if taint_fraction > 0:
+        pools = ["infra", "gpu"]
+        for node in cluster.nodes:
+            if rng.random() < taint_fraction:
+                node.taints = [("dedicated", rng.choice(pools))]
+        for pod in cluster.pods:
+            r = rng.random()
+            if r < 0.2:
+                pod.spec.tolerations = [("dedicated", rng.choice(pools))]
+            elif r < 0.25:
+                pod.spec.tolerations = [("dedicated", "")]  # wildcard
 
     state = ClusterState(
         nodes=cluster.nodes,

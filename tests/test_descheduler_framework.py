@@ -337,3 +337,28 @@ class TestNodeTaints:
         profile.run(NOW)
         # every other node is also intolerable: evicting would churn forever
         assert not store.get(KIND_POD, stuck.meta.key).is_terminated
+
+    def test_evictability_guards_apply_to_failed_pods(self):
+        """The full filter chain (minus the terminated check) still guards
+        deletion: DaemonSet and system-critical Failed pods survive."""
+        store = ObjectStore()
+        _node(store, "node-a")
+        ds = _pod(store, "ds-pod", node="node-a", created=NOW - 600,
+                  owner=("DaemonSet", "logger"))
+        ds.phase = "Failed"
+        store.update(KIND_POD, ds)
+        critical = _pod(store, "critical", node="node-a", created=NOW - 600)
+        critical.phase = "Failed"
+        critical.spec.priority = 2_000_001_000
+        store.update(KIND_POD, critical)
+        profile = Profile(ProfileConfig(deschedule=["RemoveFailedPods"]),
+                          store)
+        profile.run(NOW)
+        assert store.get(KIND_POD, ds.meta.key) is not None
+        assert store.get(KIND_POD, critical.meta.key) is not None
+
+
+def test_podlifetime_requires_max_seconds():
+    store = ObjectStore()
+    with pytest.raises(ValueError, match="maxPodLifeTimeSeconds"):
+        Profile(ProfileConfig(deschedule=["PodLifeTime"]), store)

@@ -128,3 +128,49 @@ def test_active_axis_reduction_preserves_bindings():
     # and the serial oracle agrees on the reduced arrays too
     serial = serial_schedule_full(fc_red, args)
     np.testing.assert_array_equal(red[: len(pods.keys)], serial[: len(pods.keys)])
+
+
+def test_full_chain_with_taints():
+    """TaintToleration in the chain: tainted nodes reject intolerant pods in
+    kernel, oracle, and the C++ floor identically."""
+    import numpy as np
+
+    from koordinator_tpu.native import floor as native_floor
+
+    args = LoadAwareArgs()
+    cluster, state = synth_full_cluster(24, 60, seed=21, taint_fraction=0.4)
+    assert any(n.taints for n in state.nodes), "fixture produced no taints"
+    fc, pods, nodes, tree, gang_index, ng, ngroups = build_full_chain_inputs(
+        state, args
+    )
+    step = build_full_chain_step(args, ng, ngroups)
+    chosen_tpu = np.asarray(step(fc)[0])
+    chosen_serial = serial_schedule_full(fc, args)
+    diffs = diff_bindings(
+        chosen_serial[: len(pods.keys)], chosen_tpu[: len(pods.keys)],
+        pods.keys,
+    )
+    assert not diffs, f"{len(diffs)} mismatches: {diffs[:10]}"
+
+    # no pod landed on a node whose taints it does not tolerate
+    from koordinator_tpu.ops.taints import tolerates_taints
+
+    pods_by_key = {p.meta.key: p for p in state.pending_pods}
+    placements = 0
+    tainted_placements = 0
+    for i, key in enumerate(pods.keys):
+        n = chosen_tpu[i]
+        if n < 0:
+            continue
+        placements += 1
+        node = state.nodes[n]
+        if node.taints:
+            tainted_placements += 1
+            assert tolerates_taints(pods_by_key[key].spec.tolerations,
+                                    node.taints), (key, node.meta.name)
+    assert placements > 0
+    assert tainted_placements > 0, "no tolerant pod exercised a tainted node"
+
+    if native_floor.available() or native_floor.build():
+        chosen_native = native_floor.serial_schedule_full_native(fc, args)
+        np.testing.assert_array_equal(chosen_serial, chosen_native)

@@ -57,3 +57,8 @@ def test_pallas_full_chain_with_active_axes_reduction():
         args, ng, ngroups, interpret=True, active_axes=active)(fc)
     np.testing.assert_array_equal(np.asarray(chosen_x), np.asarray(chosen_p))
     np.testing.assert_allclose(np.asarray(req_x), np.asarray(req_p), atol=1e-3)
+
+
+def test_pallas_full_chain_with_taints():
+    chosen = _compare(21, taint_fraction=0.4)
+    assert (chosen >= 0).sum() > 0

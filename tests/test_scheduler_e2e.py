@@ -348,3 +348,51 @@ class TestSchedulerE2E:
         sched.run_cycle(now=NOW)
         assert len(sched.extender.monitor.history) == 1
         assert sched.extender.monitor.slow_cycles == 0
+
+
+def test_taint_toleration_end_to_end():
+    """Dedicated (tainted) nodes accept only tolerant pods, through the whole
+    cycle driver (kube TaintToleration semantics)."""
+    from koordinator_tpu.api.objects import Node, ObjectMeta, Pod, PodSpec
+    from koordinator_tpu.api.resources import ResourceList
+    from koordinator_tpu.client.store import KIND_NODE, KIND_POD, ObjectStore
+    from koordinator_tpu.scheduler.cycle import Scheduler
+
+    GIB = 1024**3
+    store = ObjectStore()
+    store.add(KIND_NODE, Node(
+        meta=ObjectMeta(name="dedicated", namespace=""),
+        allocatable=ResourceList.of(cpu=64000, memory=256 * GIB, pods=100),
+        taints=[("dedicated", "infra")],
+    ))
+    store.add(KIND_NODE, Node(
+        meta=ObjectMeta(name="open", namespace=""),
+        allocatable=ResourceList.of(cpu=2000, memory=8 * GIB, pods=100),
+    ))
+    now = 1_000_000.0
+    # intolerant pods must squeeze onto the small open node even though the
+    # dedicated node is bigger and emptier
+    for i in range(2):
+        store.add(KIND_POD, Pod(
+            meta=ObjectMeta(name=f"plain-{i}", uid=f"plain-{i}",
+                            creation_timestamp=now),
+            spec=PodSpec(requests=ResourceList.of(cpu=500, memory=GIB)),
+        ))
+    tolerant = Pod(
+        meta=ObjectMeta(name="infra", uid="infra", creation_timestamp=now),
+        spec=PodSpec(requests=ResourceList.of(cpu=4000, memory=4 * GIB),
+                     tolerations=[("dedicated", "infra")]),
+    )
+    store.add(KIND_POD, tolerant)
+    # an intolerant pod too big for the open node stays pending
+    store.add(KIND_POD, Pod(
+        meta=ObjectMeta(name="too-big", uid="too-big", creation_timestamp=now),
+        spec=PodSpec(requests=ResourceList.of(cpu=8000, memory=GIB)),
+    ))
+    result = Scheduler(store).run_cycle(now=now)
+    by_pod = {b.pod_key: b.node_name for b in result.bound}
+    assert by_pod["default/plain-0"] == "open"
+    assert by_pod["default/plain-1"] == "open"
+    assert by_pod["default/infra"] == "dedicated"
+    assert "default/too-big" not in by_pod
+    assert "default/too-big" in result.failed

@@ -80,3 +80,9 @@ def test_wave_tight_quota_forces_flips():
     # the squeeze must actually reject some quota pods
     quota_pods = np.asarray(fc.quota_id)[: len(pods.keys)] >= 0
     assert (chosen[: len(pods.keys)][quota_pods] < 0).any()
+
+
+def test_wave_with_taints():
+    args, fc, pods, ng, ngroups = _build(21, num_nodes=24, num_pods=60,
+                                         taint_fraction=0.4)
+    _assert_match(args, fc, ng, ngroups, wave=32)
