@@ -8,6 +8,7 @@ Filter -> PreEvictionFilter -> Evict chain.
 
 from __future__ import annotations
 
+import dataclasses
 from collections import defaultdict
 from typing import Dict, List
 
@@ -182,8 +183,12 @@ class RemoveFailedPods(DeschedulePlugin):
             # profile-configured FilterPlugins) still applies: run the full
             # chain on a view with the phase neutralized, then delete
             # (upstream's eviction of a failed pod IS deletion)
-            import dataclasses
+            from koordinator_tpu.descheduler.evictions import (
+                ANNOTATION_EVICTABLE,
+            )
 
+            if pod.meta.annotations.get(ANNOTATION_EVICTABLE) == "false":
+                continue  # explicit opt-out holds even without a Profile
             if not pod.meta.owner_kind:
                 if not self.evict_failed_bare_pods:
                     continue

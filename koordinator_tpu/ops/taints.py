@@ -18,9 +18,12 @@ scheduler refuses placements it cannot prove, never the reverse).
 
 from __future__ import annotations
 
+import logging
 from typing import List, Sequence, Tuple
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 MAX_TAINT_GROUPS = 24  # bits must stay exact in float32 (< 2^24)
 
@@ -52,6 +55,12 @@ def group_node_taints(nodes) -> Tuple[np.ndarray, List[frozenset]]:
                 sets.append(key)
             else:
                 gid = overflow
+                logger.warning(
+                    "taint-set bit budget exceeded: node %s's taints %s "
+                    "fall into the overflow group and NO pod will schedule "
+                    "there (max %d distinct sets)",
+                    node.meta.name, sorted(key), overflow,
+                )
         out[i] = gid
     return out, sets
 

@@ -1,0 +1,167 @@
+"""The cross-component colocation control loop (SURVEY §3.3), end to end in
+one store: koordlet metrics -> NodeMetric CR -> koord-manager noderesource
+controller -> node batch allocatable -> admission webhook BE mutation ->
+scheduler placement on the batch axes -> koordlet runtimehooks cgroup
+enforcement. Every component is the real one; only the kernel interfaces
+(FakeFS) are synthetic."""
+
+import pytest
+
+from koordinator_tpu.api.objects import (
+    LABEL_POD_QOS,
+    ClusterColocationProfile,
+    Node,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.api.resources import ResourceList, ResourceName
+from koordinator_tpu.client.store import (
+    KIND_COLOCATION_PROFILE,
+    KIND_NODE,
+    KIND_NODE_METRIC,
+    KIND_POD,
+    ObjectStore,
+)
+from koordinator_tpu.koordlet.daemon import Daemon
+from koordinator_tpu.koordlet.util import system as sysutil
+from koordinator_tpu.koordlet.util.system import FakeFS
+from koordinator_tpu.manager import Manager
+from koordinator_tpu.scheduler.cycle import Scheduler
+
+GIB = 1024**3
+NOW = 1_000_000.0
+
+
+@pytest.fixture
+def fs():
+    f = FakeFS(use_cgroup_v2=True)
+    yield f
+    f.cleanup()
+
+
+def test_batch_colocation_loop(fs):
+    store = ObjectStore()
+    store.add(KIND_NODE, Node(
+        meta=ObjectMeta(name="node-0", namespace=""),
+        allocatable=ResourceList.of(cpu=16_000, memory=64 * GIB, pods=110),
+    ))
+    fs.set_proc("stat", "cpu  1000 0 1000 8000 0 0 0 0 0 0\n")
+    fs.set_proc(
+        "meminfo",
+        "MemTotal: %d kB\nMemFree: %d kB\nMemAvailable: %d kB\n"
+        % (64 * GIB // 1024, 48 * GIB // 1024, 56 * GIB // 1024),
+    )
+
+    # one latency-sensitive pod burning ~2 cores
+    ls = Pod(
+        meta=ObjectMeta(name="web", uid="web", labels={LABEL_POD_QOS: "LS"}),
+        spec=PodSpec(node_name="node-0",
+                     requests=ResourceList.of(cpu=4000, memory=8 * GIB),
+                     limits=ResourceList.of(cpu=4000, memory=8 * GIB)),
+        phase="Running",
+    )
+    store.add(KIND_POD, ls)
+    ls_rel = fs.config.pod_relative_path("", "web")
+    fs.set_cgroup(ls_rel, sysutil.CPU_STAT, "usage_usec 10000000\n")
+    fs.set_cgroup(ls_rel, sysutil.MEMORY_USAGE, str(4 * GIB))
+
+    # ---- 1. koordlet reports node metrics over two ticks
+    daemon = Daemon(store, "node-0", fs.config, report_interval_seconds=0)
+    daemon.run_once(now=NOW)
+    fs.set_proc("stat", "cpu  2000 0 2000 12000 0 0 0 0 0 0\n")  # 25% busy
+    fs.set_cgroup(ls_rel, sysutil.CPU_STAT, "usage_usec 30000000\n")
+    daemon.run_once(now=NOW + 10)
+    assert store.get(KIND_NODE_METRIC, "/node-0") is not None
+
+    # ---- 2. koord-manager (leader) computes batch allocatable
+    manager = Manager(store, identity="mgr-0")
+    assert manager.tick(now=NOW + 11)
+    node = store.get(KIND_NODE, "/node-0")
+    batch_cpu = node.allocatable[ResourceName.BATCH_CPU]
+    batch_mem = node.allocatable[ResourceName.BATCH_MEMORY]
+    assert batch_cpu > 0 and batch_mem > 0
+    assert batch_cpu < 16_000  # reclaimed = capacity - reserved - LS usage
+
+    # ---- 3. a colocation profile turns incoming spark pods into BE batch
+    store.add(KIND_COLOCATION_PROFILE, ClusterColocationProfile(
+        meta=ObjectMeta(name="spark"),
+        selector={"app": "spark"},
+        qos_class=QoSClass.BE,
+        priority_class_name="koord-batch",
+        scheduler_name="koord-scheduler",
+    ))
+    spark = Pod(
+        meta=ObjectMeta(name="spark-exec", uid="spark-exec",
+                        labels={"app": "spark"}, creation_timestamp=NOW + 11),
+        spec=PodSpec(requests=ResourceList.of(cpu=2000, memory=4 * GIB),
+                     limits=ResourceList.of(cpu=2000, memory=4 * GIB)),
+    )
+    store.add(KIND_POD, spark)  # admission interceptor mutates on the way in
+    stored = store.get(KIND_POD, "default/spark-exec")
+    assert stored.qos_class is QoSClass.BE
+    assert stored.spec.requests[ResourceName.CPU] == 0
+    assert stored.spec.requests[ResourceName.BATCH_CPU] == 2000
+    assert stored.spec.requests[ResourceName.BATCH_MEMORY] == 4 * GIB
+
+    # ---- 4. the scheduler places it using the batch axes the controller
+    # just published
+    result = Scheduler(store).run_cycle(now=NOW + 12)
+    assert [b.pod_key for b in result.bound] == ["default/spark-exec"]
+    assert result.bound[0].node_name == "node-0"
+
+    # ---- 5. koordlet enforces the batch limits on the pod's cgroup
+    bound = store.get(KIND_POD, "default/spark-exec")
+    bound.phase = "Running"
+    store.update(KIND_POD, bound)
+    be_rel = fs.config.pod_relative_path(sysutil.QOS_BESTEFFORT, "spark-exec")
+    fs.set_cgroup(be_rel, sysutil.CPU_STAT, "usage_usec 0\n")
+    fs.set_cgroup(be_rel, sysutil.MEMORY_USAGE, "0")
+    daemon.run_once(now=NOW + 20)
+    quota = daemon.executor.read(be_rel, sysutil.CPU_CFS_QUOTA)
+    assert quota is not None
+    assert int(quota) == 2000 // 1000 * 100000  # batch-cpu -> cfs quota
+    mem_limit = daemon.executor.read(be_rel, sysutil.MEMORY_LIMIT)
+    assert int(mem_limit) == 4 * GIB
+    # group identity: BE tier bvt
+    bvt = daemon.executor.read(be_rel, sysutil.CPU_BVT_WARP_NS)
+    assert bvt == "-1"
+
+
+def test_batch_capacity_constrains_scheduling(fs):
+    """A BE pod larger than the reclaimed batch capacity must NOT schedule,
+    even though raw node cpu would fit it."""
+    store = ObjectStore()
+    store.add(KIND_NODE, Node(
+        meta=ObjectMeta(name="node-0", namespace=""),
+        allocatable=ResourceList.of(cpu=16_000, memory=64 * GIB, pods=110),
+    ))
+    fs.set_proc("stat", "cpu  1000 0 1000 8000 0 0 0 0 0 0\n")
+    fs.set_proc(
+        "meminfo",
+        "MemTotal: %d kB\nMemFree: %d kB\nMemAvailable: %d kB\n"
+        % (64 * GIB // 1024, 48 * GIB // 1024, 56 * GIB // 1024),
+    )
+    daemon = Daemon(store, "node-0", fs.config, report_interval_seconds=0)
+    daemon.run_once(now=NOW)
+    fs.set_proc("stat", "cpu  3000 0 3000 12000 0 0 0 0 0 0\n")  # 50% busy
+    daemon.run_once(now=NOW + 10)
+    manager = Manager(store, identity="mgr-0")
+    assert manager.tick(now=NOW + 11)
+    node = store.get(KIND_NODE, "/node-0")
+    batch_cpu = node.allocatable[ResourceName.BATCH_CPU]
+    assert 0 < batch_cpu < 8000
+
+    hungry = Pod(
+        meta=ObjectMeta(name="hungry", uid="hungry",
+                        labels={LABEL_POD_QOS: "BE",
+                                "koordinator.sh/priority-class": "koord-batch"},
+                        creation_timestamp=NOW + 11),
+        spec=PodSpec(requests=ResourceList.of(batch_cpu=12_000),
+                     priority=5500),
+    )
+    store.add(KIND_POD, hungry)
+    result = Scheduler(store).run_cycle(now=NOW + 12)
+    assert result.bound == []
+    assert "default/hungry" in result.failed
