@@ -72,6 +72,52 @@ def estimate_pod_used(
     return out.astype(np.float32)
 
 
+def estimate_pods_used_batch(
+    req_packed: np.ndarray,      # [n, R] packed requests (to_vector units)
+    lim_packed: np.ndarray,      # [n, R] packed limits
+    prio_class: np.ndarray,      # [n] int PriorityClass values
+    resource_weights: Dict[str, int],
+    scaling_factors: Dict[str, int],
+) -> np.ndarray:
+    """Vectorized estimate_pod_used over a whole batch: identical math, one
+    set of numpy ops per (priority class, weighted axis) pair instead of a
+    python loop per pod — the host-side packing hot path at 10k pods."""
+    from koordinator_tpu.api.priority import PriorityClass
+
+    n = req_packed.shape[0]
+    req = req_packed.astype(np.float64)
+    lim = lim_packed.astype(np.float64)
+    out = np.zeros((n, NUM_RESOURCES), np.float64)
+    classes = np.unique(prio_class)
+    for native in resource_weights:
+        i_native = RESOURCE_INDEX[native]
+        if native in _CPU_LIKE:
+            default = DEFAULT_MILLI_CPU_REQUEST
+        elif native in _MEMORY_LIKE:
+            default = DEFAULT_MEMORY_REQUEST_MIB
+        else:
+            default = 0.0
+        factor_cfg = float(scaling_factors.get(native, 100))
+        for cls_value in classes:
+            real = translate_resource_by_priority_class(
+                PriorityClass(int(cls_value)), native
+            )
+            if real is None:
+                continue
+            rows = prio_class == cls_value
+            i_real = RESOURCE_INDEX[real]
+            limit_q = lim[rows, i_real]
+            request_q = req[rows, i_real]
+            over = limit_q > request_q
+            quantity = np.where(over, limit_q, request_q)
+            factor = np.where(over, 100.0, factor_cfg)
+            est = np.floor(quantity * factor / 100.0 + 0.5)  # go_round
+            est = np.where(limit_q > 0, np.minimum(est, limit_q), est)
+            est = np.where(quantity == 0, default, est)
+            out[rows, i_native] = est
+    return out.astype(np.float32)
+
+
 def estimate_node_allocatable(node: Node) -> np.ndarray:
     """EstimateNode (default_estimator.go:110+): raw-allocatable annotation wins
     over status.allocatable when present (resource amplification); we model the

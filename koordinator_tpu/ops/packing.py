@@ -22,8 +22,15 @@ import numpy as np
 
 from koordinator_tpu.api.objects import Node, NodeMetric, Pod
 from koordinator_tpu.api.priority import PriorityClass
-from koordinator_tpu.api.resources import NUM_RESOURCES
-from koordinator_tpu.ops.estimator import estimate_node_allocatable, estimate_pod_used
+from koordinator_tpu.api.resources import (
+    NUM_RESOURCES,
+    PACK_SCALE,
+    RESOURCE_INDEX,
+)
+from koordinator_tpu.ops.estimator import (
+    estimate_node_allocatable,
+    estimate_pods_used_batch,
+)
 
 MIN_BUCKET = 16
 
@@ -122,8 +129,10 @@ def pack_pods(
     pods = [pods[i] for i in order]
     n = len(pods)
     p = pad_to or bucket_size(n)
-    req = np.zeros((p, NUM_RESOURCES), np.float32)
-    est = np.zeros((p, NUM_RESOURCES), np.float32)
+    # wire-unit matrices filled in one pass (no per-pod vector allocations),
+    # packed with a single vectorized scale
+    req_wire = np.zeros((p, NUM_RESOURCES), np.float64)
+    lim_wire = np.zeros((p, NUM_RESOURCES), np.float64)
     prio = np.zeros(p, np.int32)
     qos = np.full(p, 5, np.int32)  # QoSClass.NONE
     pcls = np.full(p, int(PriorityClass.NONE), np.int32)
@@ -133,8 +142,14 @@ def pack_pods(
     quota = np.full(p, -1, np.int32)
     valid = np.zeros(p, bool)
     for i, pod in enumerate(pods):
-        req[i] = pod.spec.requests.to_vector()
-        est[i] = estimate_pod_used(pod, resource_weights, scaling_factors)
+        for name, q in pod.spec.requests.quantities.items():
+            idx = RESOURCE_INDEX.get(name)
+            if idx is not None:
+                req_wire[i, idx] = q
+        for name, q in pod.spec.limits.quantities.items():
+            idx = RESOURCE_INDEX.get(name)
+            if idx is not None:
+                lim_wire[i, idx] = q
         prio[i] = pod.spec.priority or 0
         qos[i] = int(pod.qos_class)
         cls = pod.priority_class
@@ -148,6 +163,14 @@ def pack_pods(
         if quota_ids and pod.quota_name:
             quota[i] = quota_ids.get(pod.quota_name, -1)
         valid[i] = True
+    req = (req_wire / PACK_SCALE).astype(np.float32)
+    lim = (lim_wire / PACK_SCALE).astype(np.float32)
+    # estimate only the valid rows: padding must carry zeros, never the
+    # 250-milli/200-MiB defaults the estimator assigns empty requests
+    est = np.zeros((p, NUM_RESOURCES), np.float32)
+    est[:n] = estimate_pods_used_batch(
+        req[:n], lim[:n], pcls[:n], resource_weights, scaling_factors
+    )
     return PodBatch(
         keys=[pd.meta.key for pd in pods],
         requests=req,

@@ -122,6 +122,18 @@ class CPUAllocationState:
             < self.max_ref_count
         )
 
+    def num_available(self) -> int:
+        """len(available_cpus()) without materializing the set: O(allocated)
+        instead of O(all cpus) — the snapshot builder calls this per node per
+        cycle. Only cpu ids actually IN the topology count as saturated, so
+        an inconsistent CR (reserved id outside cr.cpus) cannot undercount."""
+        topo_ids = self.topology.by_id
+        saturated = sum(
+            1 for cpu_id, info in self.allocated.items()
+            if info.ref_count >= self.max_ref_count and cpu_id in topo_ids
+        )
+        return len(self.topology.cpus) - saturated
+
     def add(self, pod_key: str, cpus: CPUSet, exclusive_policy: str) -> None:
         self.by_pod[pod_key] = cpus
         for cpu in cpus:

@@ -149,13 +149,29 @@ def build_full_chain_inputs(
 ) -> Tuple[FullChainInputs, PodBatch, NodeBatch, QuotaTreeArrays, Dict[str, int], int, int]:
     """Returns (inputs, pod_batch, node_batch, quota_tree, gang_index,
     num_gangs, num_groups)."""
-    # ---- quota tree
+    # ---- gangs indexed first so pods pack in one pass; quota ids are filled
+    # into the packed batch after the tree is built (they need the tree)
+    gang_index = {pg.meta.key: i for i, pg in enumerate(state.pod_groups)}
+    pods = pack_pods(
+        state.pending_pods,
+        args.resource_weights,
+        args.estimated_scaling_factors,
+        gang_ids=gang_index,
+        gang_sort={
+            pg.meta.key: (pg.meta.creation_timestamp, pg.meta.key)
+            for pg in state.pod_groups
+        },
+    )
+    pods_by_key_pending = {p.meta.key: p for p in state.pending_pods}
+
+    # ---- quota tree: pending requests accumulate from the PACKED rows (one
+    # to_vector per pod already happened inside pack_pods)
     pod_req_by_quota: Dict[str, np.ndarray] = {}
-    for pod in state.pending_pods:
-        q = pod.quota_name
+    for i, key in enumerate(pods.keys):
+        q = pods_by_key_pending[key].quota_name
         if q:
             pod_req_by_quota.setdefault(q, np.zeros(NUM_RESOURCES, np.float32))
-            pod_req_by_quota[q] += pod.spec.requests.to_vector()
+            pod_req_by_quota[q] += pods.requests[i]
     used_by_quota: Dict[str, np.ndarray] = {}
     for pod in state.pods_by_key.values():
         q = pod.quota_name
@@ -181,7 +197,6 @@ def build_full_chain_inputs(
     quota_ids = {name: i for i, name in enumerate(tree.names)}
 
     # ---- gangs
-    gang_index = {pg.meta.key: i for i, pg in enumerate(state.pod_groups)}
     ng = max(1, len(state.pod_groups))
     gang_min = np.zeros(ng, np.float32)
     gang_assumed = np.zeros(ng, np.float32)
@@ -198,18 +213,7 @@ def build_full_chain_inputs(
     gang_valid = gang_total >= gang_min
     gang_group = np.arange(ng, dtype=np.int32)  # group == gang (annotation later)
 
-    # ---- pods
-    pods = pack_pods(
-        state.pending_pods,
-        args.resource_weights,
-        args.estimated_scaling_factors,
-        gang_ids=gang_index,
-        quota_ids=quota_ids,
-        gang_sort={
-            pg.meta.key: (pg.meta.creation_timestamp, pg.meta.key)
-            for pg in state.pod_groups
-        },
-    )
+    # ---- per-pod flags (single pass over the packed order)
     P = pods.padded_size
     needs_bind = np.zeros(P, bool)
     cores_needed = np.zeros(P, np.float32)
@@ -219,13 +223,15 @@ def build_full_chain_inputs(
     # taint factorization (ops/taints.py): node taint-sets -> group ids,
     # pod tolerations -> group bitmasks
     node_taint_ids, taint_sets = group_node_taints(state.nodes)
-    pods_by_key_pending = {p.meta.key: p for p in state.pending_pods}
     for i, key in enumerate(pods.keys):
         pod = pods_by_key_pending[key]
         nb, cn, fp = _pod_cpuset_flags(pod)
         needs_bind[i], cores_needed[i], full_pcpus[i] = nb, cn, fp
         needs_numa[i] = bool(pod.spec.requests)
         pod_taint_mask[i] = toleration_mask(pod, taint_sets)
+        q = pod.quota_name
+        if q:  # quota ids resolve only after the tree exists
+            pods.quota_id[i] = quota_ids.get(q, -1)
 
     # ---- nodes
     nodes = pack_nodes(state.nodes, assigned_requests=state.assigned_requests)
@@ -263,7 +269,7 @@ def build_full_chain_inputs(
             numa_free[i] = numa_capacity[i] - (alloc if alloc is not None else 0.0)
             cpu_state = state.cpu_states.get(name)
             if cpu_state is not None:
-                bind_free[i] = len(cpu_state.available_cpus())
+                bind_free[i] = cpu_state.num_available()
                 cpus_per_core[i] = cpu_state.topology.cpus_per_core
             else:
                 bind_free[i] = numa_free[i, :, CPU_IDX].sum() / 1000.0

@@ -162,3 +162,19 @@ class TestCPUAccumulator:
         state.remove("pod-a")
         c = take_cpus(state, 16, FULL_PCPUS)
         assert c is not None
+
+
+def test_num_available_matches_set_even_with_foreign_ids():
+    """num_available() == len(available_cpus()) including when allocation
+    book-keeping holds cpu ids absent from the topology (inconsistent CR)."""
+    from koordinator_tpu.scheduler.cpu_topology import (
+        CPUAllocationState,
+        CPUTopology,
+    )
+    from koordinator_tpu.utils.cpuset import CPUSet
+
+    topo = CPUTopology.build(1, 1, 4, 2)  # 8 cpus
+    state = CPUAllocationState(topo)
+    state.add("default/p", CPUSet([0, 1]), "none")
+    state.add("reserved", CPUSet([99]), "none")  # id not in the topology
+    assert state.num_available() == len(state.available_cpus()) == 6
