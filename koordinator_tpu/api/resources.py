@@ -147,13 +147,19 @@ class ResourceList:
     def copy(self) -> "ResourceList":
         return ResourceList(dict(self.quantities))
 
-    def to_vector(self) -> np.ndarray:
-        """Pack into the canonical [R] float32 vector (normalized units)."""
-        vec = np.zeros(NUM_RESOURCES, dtype=np.float64)
+    def fill_wire_row(self, out_row: np.ndarray) -> None:
+        """Write wire-unit quantities into a preallocated [R] row — the
+        allocation-free half of to_vector, shared with the batch packer
+        (callers scale by PACK_SCALE once over the whole matrix)."""
         for name, q in self.quantities.items():
             idx = RESOURCE_INDEX.get(name)
             if idx is not None:
-                vec[idx] = q
+                out_row[idx] = q
+
+    def to_vector(self) -> np.ndarray:
+        """Pack into the canonical [R] float32 vector (normalized units)."""
+        vec = np.zeros(NUM_RESOURCES, dtype=np.float64)
+        self.fill_wire_row(vec)
         return (vec / PACK_SCALE).astype(np.float32)
 
     @staticmethod

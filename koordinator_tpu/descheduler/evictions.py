@@ -74,9 +74,7 @@ def terminate_pod(store: ObjectStore, pod: Pod, annotation: str,
     event's old==new and hide the phase transition from subscribers (quota
     used rollback, assign caches). Single home for that invariant — eviction
     and preemption both route here."""
-    import copy
-
-    updated = copy.deepcopy(pod)
+    updated = pod.patch_copy()
     updated.phase = "Failed"
     updated.meta.annotations[annotation] = reason
     store.update(KIND_POD, updated)

@@ -22,11 +22,7 @@ import numpy as np
 
 from koordinator_tpu.api.objects import Node, NodeMetric, Pod
 from koordinator_tpu.api.priority import PriorityClass
-from koordinator_tpu.api.resources import (
-    NUM_RESOURCES,
-    PACK_SCALE,
-    RESOURCE_INDEX,
-)
+from koordinator_tpu.api.resources import NUM_RESOURCES, PACK_SCALE
 from koordinator_tpu.ops.estimator import (
     estimate_node_allocatable,
     estimate_pods_used_batch,
@@ -142,14 +138,8 @@ def pack_pods(
     quota = np.full(p, -1, np.int32)
     valid = np.zeros(p, bool)
     for i, pod in enumerate(pods):
-        for name, q in pod.spec.requests.quantities.items():
-            idx = RESOURCE_INDEX.get(name)
-            if idx is not None:
-                req_wire[i, idx] = q
-        for name, q in pod.spec.limits.quantities.items():
-            idx = RESOURCE_INDEX.get(name)
-            if idx is not None:
-                lim_wire[i, idx] = q
+        pod.spec.requests.fill_wire_row(req_wire[i])
+        pod.spec.limits.fill_wire_row(lim_wire[i])
         prio[i] = pod.spec.priority or 0
         qos[i] = int(pod.qos_class)
         cls = pod.priority_class

@@ -7,7 +7,7 @@ patch in the reference) together with the binding itself."""
 
 from __future__ import annotations
 
-import copy
+
 from typing import Dict
 
 from koordinator_tpu.api.objects import Pod
@@ -31,7 +31,7 @@ class DefaultPreBindPlugin(Plugin):
         # semantics) whose rewrites must not persist — the reference patches
         # nodeName/annotations via the apiserver against the server's copy
         stored = self._store.get(KIND_POD, pod.meta.key)
-        patched = copy.deepcopy(stored if stored is not None else pod)
+        patched = (stored if stored is not None else pod).patch_copy()
         patched.meta.annotations.update(annotations)
         patched.spec.node_name = node_name
         self._store.update(KIND_POD, patched)

@@ -213,3 +213,30 @@ class TestQuantity:
             DecayingHistogram.from_checkpoint(
                 HistogramOptions.linear(5.0, 1.0), h.to_checkpoint()
             )
+
+
+def test_patch_copy_isolates_mutable_containers():
+    """patch_copy must not alias any container an admission mutator can
+    rewrite in place — otherwise watch subscribers diff old==new."""
+    from koordinator_tpu.api.objects import ObjectMeta, Pod, PodSpec
+    from koordinator_tpu.api.resources import ResourceList, ResourceName
+
+    pod = Pod(
+        meta=ObjectMeta(name="p", labels={"a": "1"}, annotations={"x": "y"}),
+        spec=PodSpec(requests=ResourceList.of(cpu=1000),
+                     limits=ResourceList.of(cpu=1000),
+                     node_selector={"zone": "east"},
+                     tolerations=[("k", "v")]),
+    )
+    clone = pod.patch_copy()
+    clone.meta.labels["a"] = "2"
+    clone.meta.annotations["x"] = "z"
+    del clone.spec.requests.quantities[ResourceName.CPU]
+    clone.spec.requests.quantities["kubernetes.io/batch-cpu"] = 1000
+    clone.spec.node_selector["zone"] = "west"
+    clone.spec.tolerations.append(("k2", "v2"))
+    assert pod.meta.labels["a"] == "1"
+    assert pod.meta.annotations["x"] == "y"
+    assert pod.spec.requests[ResourceName.CPU] == 1000
+    assert pod.spec.node_selector["zone"] == "east"
+    assert pod.spec.tolerations == [("k", "v")]
