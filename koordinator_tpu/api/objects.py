@@ -15,7 +15,7 @@ re-list + rebuild caches.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Any, Dict, List, Optional, Tuple
 
 from koordinator_tpu.api.priority import (
@@ -122,17 +122,15 @@ class Pod:
         alias the old stored object or watch subscribers would see old==new.
         Scalar leaves are shared. A full deepcopy here was the scheduler's
         dominant host cost at 10k bindings per cycle."""
-        import dataclasses
-
         spec = self.spec
-        return dataclasses.replace(
+        return replace(
             self,
-            meta=dataclasses.replace(
+            meta=replace(
                 self.meta,
                 labels=dict(self.meta.labels),
                 annotations=dict(self.meta.annotations),
             ),
-            spec=dataclasses.replace(
+            spec=replace(
                 spec,
                 requests=spec.requests.copy(),
                 limits=spec.limits.copy(),

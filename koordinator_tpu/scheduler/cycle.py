@@ -179,18 +179,32 @@ class Scheduler:
         """Base fit state per node: every assigned pod's requests. Reservation
         accounting (reserved capacity + double-count restore) is layered on by
         ReservationRestoreTransformer via the declared before-Filter extension
-        point — a custom transformer can rewrite the same view."""
-        out: Dict[str, np.ndarray] = {}
-        for pod in self.store.list(KIND_POD):
-            if not pod.is_assigned or pod.is_terminated:
-                continue
-            vec = with_pod_count(pod.spec.requests.to_vector()[None])[0]
-            node = pod.spec.node_name
-            if node in out:
-                out[node] = out[node] + vec
-            else:
-                out[node] = vec.astype(np.float32)
-        return out
+        point — a custom transformer can rewrite the same view.
+
+        Rebuilt per cycle (robust against in-place object mutation), but as
+        ONE wire-matrix fill + scale + segment-sum instead of per-pod vector
+        allocations."""
+        from koordinator_tpu.api.resources import (
+            NUM_RESOURCES,
+            PACK_SCALE,
+        )
+
+        assigned = [
+            p for p in self.store.list(KIND_POD)
+            if p.is_assigned and not p.is_terminated
+        ]
+        if not assigned:
+            return {}
+        node_ids: Dict[str, int] = {}
+        rows = np.zeros(len(assigned), np.int64)
+        wire = np.zeros((len(assigned), NUM_RESOURCES), np.float64)
+        for i, pod in enumerate(assigned):
+            pod.spec.requests.fill_wire_row(wire[i])
+            rows[i] = node_ids.setdefault(pod.spec.node_name, len(node_ids))
+        packed = with_pod_count((wire / PACK_SCALE).astype(np.float32))
+        sums = np.zeros((len(node_ids), NUM_RESOURCES), np.float32)
+        np.add.at(sums, rows, packed)
+        return {node: sums[i] for node, i in node_ids.items()}
 
     def _cluster_state(self, pending: List[Pod], now: float) -> ClusterState:
         la = self.extender.plugin("LoadAwareScheduling")
