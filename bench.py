@@ -36,6 +36,37 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _guard_against_dead_accelerator(timeout_seconds: int) -> None:
+    """Device init blocks in native code when the accelerator tunnel is
+    wedged, which would hang the whole bench (and its caller) forever.
+    Probe `jax.devices()` in a SUBPROCESS first; on timeout/failure, flip
+    this process to the CPU backend and report honestly on stderr + in the
+    JSON (the `platform` field) rather than never finishing."""
+    import os
+    import subprocess
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # explicitly CPU: nothing to probe. An UNSET variable still
+        # auto-detects accelerators, so it must be probed like tpu/axon.
+        return
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_seconds, capture_output=True,
+        )
+        if probe.returncode == 0:
+            return
+        log(f"device probe failed (rc={probe.returncode}); "
+            f"falling back to CPU")
+    except subprocess.TimeoutExpired:
+        log(f"device probe hung >{timeout_seconds}s (accelerator tunnel "
+            f"unresponsive); falling back to CPU")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny shapes, quick check")
@@ -56,7 +87,14 @@ def main() -> None:
         default="auto",
         help="full-chain kernel selection (auto = backend/VMEM-based)",
     )
+    ap.add_argument(
+        "--device-probe-timeout", type=int, default=420,
+        help="seconds to wait for device init in a probe subprocess; a dead "
+        "accelerator tunnel then falls back to CPU instead of hanging forever",
+    )
     args_cli = ap.parse_args()
+
+    _guard_against_dead_accelerator(args_cli.device_probe_timeout)
 
     num_pods = args_cli.pods or (100 if args_cli.smoke else 10_000)
     num_nodes = args_cli.nodes or (50 if args_cli.smoke else 5_000)
@@ -145,6 +183,7 @@ def main() -> None:
                 "value": round(tpu_pps, 1),
                 "unit": "pods/s",
                 "vs_baseline": round(ratio, 2),
+                "platform": jax.default_backend(),
             }
         )
     )
@@ -304,6 +343,7 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int) -> None:
                 "parity_ok": parity_ok,
                 "p50_ms": round(p50_ms, 2),
                 "p99_ms": round(p99_ms, 2),
+                "platform": jax.default_backend(),
             }
         )
     )

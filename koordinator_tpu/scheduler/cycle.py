@@ -35,6 +35,7 @@ from koordinator_tpu.api.objects import (
     PodSpec,
     Reservation,
 )
+from koordinator_tpu.api.resources import NUM_RESOURCES, PACK_SCALE
 from koordinator_tpu.client.store import (
     KIND_NODE,
     KIND_NODE_METRIC,
@@ -184,11 +185,6 @@ class Scheduler:
         Rebuilt per cycle (robust against in-place object mutation), but as
         ONE wire-matrix fill + scale + segment-sum instead of per-pod vector
         allocations."""
-        from koordinator_tpu.api.resources import (
-            NUM_RESOURCES,
-            PACK_SCALE,
-        )
-
         assigned = [
             p for p in self.store.list(KIND_POD)
             if p.is_assigned and not p.is_terminated
