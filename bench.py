@@ -49,16 +49,23 @@ def _guard_against_dead_accelerator(timeout_seconds: int) -> None:
         # explicitly CPU: nothing to probe. An UNSET variable still
         # auto-detects accelerators, so it must be probed like tpu/axon.
         return
+    # Popen + wait(timeout), output to DEVNULL: subprocess.run would drain
+    # captured pipes after the kill, which blocks forever if the child is
+    # wedged uninterruptibly in a device ioctl — the exact failure mode this
+    # guard exists for. With no pipes there is nothing to drain; a D-state
+    # child is abandoned.
+    child = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        start_new_session=True,
+    )
     try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_seconds, capture_output=True,
-        )
-        if probe.returncode == 0:
+        if child.wait(timeout=timeout_seconds) == 0:
             return
-        log(f"device probe failed (rc={probe.returncode}); "
+        log(f"device probe failed (rc={child.returncode}); "
             f"falling back to CPU")
     except subprocess.TimeoutExpired:
+        child.kill()
         log(f"device probe hung >{timeout_seconds}s (accelerator tunnel "
             f"unresponsive); falling back to CPU")
     os.environ["JAX_PLATFORMS"] = "cpu"

@@ -363,17 +363,19 @@ class FakeContainerdServer:
     receives; unknown methods (the passthrough lane) land in ``raw_calls``."""
 
     def __init__(self, socket_path: str):
+        import itertools
+
         self.socket_path = socket_path
         self.requests = []  # (method, request message)
         self.raw_calls = []  # (method, payload bytes)
-        self._counter = 0
+        # atomic under the GIL — handler threads run concurrently
+        self._counter = itertools.count(1)
         self._sandboxes: Dict[str, cri_pb2.PodSandbox] = {}
         self._containers: Dict[str, cri_pb2.Container] = {}
         self._server = None
 
     def _next_id(self, prefix: str) -> str:
-        self._counter += 1
-        return f"{prefix}-{self._counter}"
+        return f"{prefix}-{next(self._counter)}"
 
     def handle(self, method: str, request):
         self.requests.append((method, request))

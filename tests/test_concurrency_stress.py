@@ -205,3 +205,111 @@ def test_resource_executor_concurrent_batches(fakefs):
     events, _ = auditor.query(token=0, limit=100_000)
     assert all(e.operation == "cgroup_write" for e in events)
     assert len(events) >= THREADS * 2
+
+
+def test_cri_proxy_under_parallel_kubelet_calls():
+    """The CRI proxy's pod/container stores are hit by 8 parallel kubelet
+    streams (create/start/update/stop across distinct sandboxes) — state must
+    stay consistent and every forwarded request must carry its own pod's
+    context."""
+    import os
+    import tempfile
+
+    from koordinator_tpu.runtimeproxy import api_pb2, cri_pb2
+    from koordinator_tpu.runtimeproxy.criserver import (
+        CRIClient,
+        CRIProxyServer,
+        FakeContainerdServer,
+    )
+    from koordinator_tpu.runtimeproxy.hookclient import serve_hook_service
+
+    class EchoHooks:
+        """Returns the pod name back as an annotation so forwarded requests
+        prove which pod context the hook saw."""
+
+        def PreRunPodSandboxHook(self, request):
+            res = api_pb2.PodSandboxHookResponse()
+            res.annotations["seen"] = request.pod_meta.name
+            return res
+
+        def __getattr__(self, name):
+            if name.endswith("Hook"):
+                return lambda request: (
+                    api_pb2.PodSandboxHookResponse() if "Sandbox" in name
+                    else api_pb2.ContainerResourceHookResponse()
+                )
+            raise AttributeError(name)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        proxy_sock = os.path.join(tmp, "p.sock")
+        backend_sock = os.path.join(tmp, "b.sock")
+        hook_sock = os.path.join(tmp, "h.sock")
+        from koordinator_tpu.runtimeproxy.hookclient import HookClient
+
+        hooks = serve_hook_service(EchoHooks(), hook_sock)
+        backend = FakeContainerdServer(backend_sock)
+        proxy = None
+        results = {}
+
+        def kubelet_stream(tid):
+            def run():
+                client = CRIClient(proxy_sock)
+                try:
+                    ids = []
+                    for i in range(10):
+                        req = cri_pb2.RunPodSandboxRequest()
+                        req.config.metadata.name = f"pod-{tid}-{i}"
+                        req.config.metadata.uid = f"uid-{tid}-{i}"
+                        sandbox = client.call("RunPodSandbox", req)
+                        creq = cri_pb2.CreateContainerRequest(
+                            pod_sandbox_id=sandbox.pod_sandbox_id)
+                        creq.config.metadata.name = "main"
+                        created = client.call("CreateContainer", creq)
+                        client.call("StartContainer",
+                                    cri_pb2.StartContainerRequest(
+                                        container_id=created.container_id))
+                        ids.append((sandbox.pod_sandbox_id,
+                                    created.container_id))
+                    for sandbox_id, container_id in ids[:5]:
+                        client.call(
+                            "UpdateContainerResources",
+                            cri_pb2.UpdateContainerResourcesRequest(
+                                container_id=container_id,
+                                linux=cri_pb2.LinuxContainerResources(
+                                    cpu_quota=100000),
+                            ),
+                        )
+                        client.call("StopContainer",
+                                    cri_pb2.StopContainerRequest(
+                                        container_id=container_id))
+                        client.call("StopPodSandbox",
+                                    cri_pb2.StopPodSandboxRequest(
+                                        pod_sandbox_id=sandbox_id))
+                    results[tid] = ids
+                finally:
+                    client.close()
+
+            return run
+
+        try:
+            backend.start()
+            proxy = CRIProxyServer(proxy_sock, backend_sock,
+                                   hook_client=HookClient(hook_sock))
+            proxy.start()
+            run_threads([kubelet_stream(t) for t in range(THREADS)])
+        finally:
+            if proxy is not None:
+                proxy.stop()
+            backend.stop()
+            hooks.stop(grace=None)
+
+        # every stream completed its full lifecycle
+        assert len(results) == THREADS
+        # proxy stores: 5 sandboxes/containers alive per stream
+        assert len(proxy.pod_store) == THREADS * 5
+        assert len(proxy.container_store) == THREADS * 5
+        # each forwarded sandbox carried ITS OWN pod's hook annotation
+        for method, request in backend.requests:
+            if method == "RunPodSandbox":
+                assert (request.config.annotations["seen"]
+                        == request.config.metadata.name)
