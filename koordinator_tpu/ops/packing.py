@@ -32,12 +32,21 @@ MIN_BUCKET = 16
 
 
 def bucket_size(n: int, minimum: int = MIN_BUCKET) -> int:
-    """Next power of two >= n (>= minimum); 10k pods and 5k nodes land on 16384/8192
-    so steady-state churn never recompiles."""
+    """Bucketed padding size >= n (>= minimum). Up to 1024 buckets are powers
+    of two; above that the granularity is pow2/8 (e.g. 10k pods -> 10240, 5k
+    nodes -> 5120, not 16384/8192). Padded rows are dead work for every kernel
+    — at the 10k x 5k north-star config pow2 padding would cost 2.56x compute
+    for zero extra recompiles in steady state. Coarse-grained buckets (<= 8
+    per doubling, all multiples of 256, so lane/sublane tiling is preserved)
+    keep churn-driven recompiles amortized while capping dead rows at one
+    granule (< 25% of the padded size, vs up to ~100% for pow2)."""
     b = minimum
     while b < n:
         b *= 2
-    return b
+    if b <= 1024:
+        return b
+    g = b // 8
+    return max(-(-n // g) * g, minimum)
 
 
 @dataclass

@@ -135,7 +135,11 @@ def test_bucketing():
     assert bucket_size(1) == 16
     assert bucket_size(16) == 16
     assert bucket_size(17) == 32
-    assert bucket_size(10000) == 16384
+    assert bucket_size(10000) == 10240
+    assert bucket_size(5000) == 5120
+    assert bucket_size(1024) == 1024
+    assert bucket_size(1025) == 1280
+    assert bucket_size(10240) == 10240
 
 
 def test_estimator_defaults_zero_request():
