@@ -67,6 +67,14 @@ def least_requested(alloc, used) -> jnp.ndarray:
     return jnp.where((alloc > 0) & (used <= alloc), per_r, 0.0)
 
 
+def least_requested_rem(rem, safe_cap, cap_pos) -> jnp.ndarray:
+    """least_requested with the remainder (alloc - used) precomputed and
+    safe_cap/cap_pos hoisted out of the per-pod loop: rem >= 0 is exactly
+    used <= alloc for the packed-integer values the kernels carry."""
+    per_r = jnp.floor(rem * MAX_NODE_SCORE / safe_cap)
+    return jnp.where(cap_pos & (rem >= 0), per_r, 0.0)
+
+
 def weighted_floor_score(per_r, consts, wsum: float) -> jnp.ndarray:
     """[N] floor(sum_r w_r*score_r / wsum) with static weights."""
     acc = jnp.zeros((1, per_r.shape[1]), jnp.float32)
@@ -75,12 +83,23 @@ def weighted_floor_score(per_r, consts, wsum: float) -> jnp.ndarray:
     return jnp.floor(acc[0] / wsum)
 
 
-def lowest_index_max(score, N: int):
+def weighted_floor_score_col(per_r, w_col, wsum: float) -> jnp.ndarray:
+    """weighted_floor_score as one [R, 1]-broadcast multiply + sublane
+    reduce — per-row slicing of an [R, N] array relayouts on Mosaic, so the
+    loop form costs ~3x. Same f32 product/sum values, so the floor parity
+    holds (per-axis products are exact for packed integers * small weights,
+    and the sum order over R is ascending in both forms)."""
+    return jnp.floor(jnp.sum(per_r * w_col, axis=0) / wsum)
+
+
+def lowest_index_max(score, N: int, iota=None):
     """(best, maxv, iota): lowest-index max, computed explicitly — Mosaic's
     argmax does not guarantee first-occurrence on ties, and the binding
-    contract (reference selectHost determinism) hangs on this tie-break."""
+    contract (reference selectHost determinism) hangs on this tie-break.
+    Pass a precomputed [N] iota to hoist it out of a per-pod loop."""
     maxv = jnp.max(score)
-    iota = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)[0]
+    if iota is None:
+        iota = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)[0]
     best = jnp.min(jnp.where(score == maxv, iota, jnp.int32(N))
                    ).astype(jnp.int32)
     return best, maxv, iota
@@ -113,8 +132,9 @@ def row(x) -> jnp.ndarray:
     return f32(x)[None, :]
 
 
-def pad_pods(P: int):
-    """(P_pad, pad_spec): pods padded to a multiple of 8 so the (8, 1)
-    chosen blocks divide the grid; padded entries have pod_valid == 0."""
-    P_pad = -(-P // 8) * 8
+def pad_pods(P: int, multiple: int = 8):
+    """(P_pad, pad_spec): pods padded to a multiple (8 so the (8, 1) chosen
+    blocks divide the grid; the unrolled full-chain kernel asks for its
+    POD_BLOCK). Padded entries have pod_valid == 0."""
+    P_pad = -(-P // multiple) * multiple
     return P_pad, [(0, P_pad - P)]

@@ -39,18 +39,29 @@ from koordinator_tpu.ops.gang import gang_permit_mask
 from koordinator_tpu.ops.loadaware import LoadAwareArgs
 from koordinator_tpu.ops.numa import POLICY_NONE, POLICY_SINGLE_NUMA_NODE
 
+# Pods evaluated per grid step. The serial contract still holds — the 8 pods
+# are walked in order inside the step — but the per-step costs (grid
+# bookkeeping, state ref load/store, read-only row loads) amortize 8x, and
+# the chosen output block (8, 1) is written by exactly one step.
+UNROLL = 8
+# Pod columns stream in as [R, POD_BLOCK] grid blocks instead of whole
+# [R, P_pad] VMEM residents; P_pad is padded to a POD_BLOCK multiple.
+POD_BLOCK = 128
+
+
 def estimate_vmem_bytes(N: int, R: int, K: int, G: int, P: int) -> int:
     """Upper-bound VMEM footprint of one pallas_call of the full-chain
-    kernel, mirroring the in/out/scratch specs below: 3 [R, P_pad] pod
-    columns, 7 [R, N] node buffers, 2 [K*R, N] NUMA buffers, 10 [1, N]
-    rows, quota state (3 [R, G_lane] + [max(G,8), G_lane]) and the chosen
+    kernel, mirroring the in/out/scratch specs below: 3 double-buffered
+    [R, POD_BLOCK] pod column blocks, 8 [R, N] node buffers, 2 [K*R, N]
+    NUMA buffers, 11 [1, N] rows, quota state (4 [R, G_lane] + the
+    double-buffered [UNROLL, G_lane] ancestor blocks) and the chosen
     output, all f32. Used by models.full_chain.build_best_full_chain_step
     to fall back to the XLA step when the state would not fit on-chip."""
-    P_pad = -(-P // 8) * 8
+    P_pad = -(-P // POD_BLOCK) * POD_BLOCK
     G_eff = max(G, 1)
     G_lane = max(128, -(-G_eff // 128) * 128)
-    floats = (3 * R * P_pad + 7 * R * N + 2 * K * R * N + 11 * N
-              + 3 * R * G_lane + max(G_eff, 8) * G_lane + P_pad)
+    floats = (3 * POD_BLOCK * R * 2 + 8 * R * N + 2 * K * R * N + 11 * N
+              + 4 * R * G_lane + 2 * UNROLL * G_lane + P_pad)
     return 4 * floats
 
 
@@ -65,7 +76,7 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         needsnuma_ref, needsbind_ref, fullpcpus_ref, cores_ref,  # f32 [P]
         taintmask_ref,                                            # f32 [P]
         qid_ref,                                                  # int32 [P]
-        # --- VMEM pod columns [R, P]
+        # --- VMEM pod column blocks [R, POD_BLOCK]
         fitreq_ref, rawreq_ref, est_ref,
         # --- VMEM node state [R, N]
         alloc_ref, req0_ref, term_np_ref, term_pr_ref,
@@ -73,140 +84,208 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         lafeas_np_ref, lafeas_pr_ref, node_ok_ref, score_valid_ref,
         has_topo_ref, bindfree0_ref, cpc_ref, policy_ref,
         taintpow_ref,                                  # [1, N] f32 2^group
-        # --- VMEM numa [K*R, N] / quota [G, G] + [R, G]
-        numafree0_ref, anc_ref, qused0_ref, qruntime_ref,
+        # --- VMEM numa [K*R, N] / per-pod ancestor rows [UNROLL, G_lane]
+        #     (pre-gathered host-side: no in-kernel dynamic slice) / quota
+        numafree0_ref, ancpod_ref, qused0_ref, qruntime_ref,
         # --- outputs
-        chosen_ref,                 # (8, 1) int32 blocks over [P_pad, 1]
+        chosen_ref,                 # (UNROLL, 1) int32 block, one per step
         requested_ref,              # [R, N] (carried)
         qused_ref,                  # [R, G] (carried)
         # --- scratch
-        dnp_ref, dpr_ref,           # [R, N]
+        dnp_ref, dpr_ref,           # [R, N] (alloc - LoadAware base)
         numa_ref,                   # [K*R, N]
         bindfree_ref,               # [1, N]
+        headroom_ref,               # [R, N] (alloc - requested)
+        qacc_ref,                   # [R, G] quota-used accumulator
     ):
         i = pl.program_id(0)
+        alloc = alloc_ref[:]
 
+        # Mutable chain state lives in VMEM scratch and is carried in
+        # HEADROOM form — headroom_ref holds alloc - requested, dnp/dpr hold
+        # alloc - (term + delta) — so the per-pod Fit check and
+        # least-requested remainders are single compares/subtracts instead
+        # of add-then-compare. The requested/quota-used OUTPUT buffers are
+        # written only on the last grid step: output blocks round-trip to
+        # HBM, so storing them per step would serialize the pipeline. All
+        # quantities are packed integers < 2^24, so f32 arithmetic is exact
+        # and the re-association preserves bit-parity with the XLA step.
         @pl.when(i == 0)
         def _init():
-            requested_ref[:] = req0_ref[:]
-            dnp_ref[:] = jnp.zeros_like(dnp_ref)
-            dpr_ref[:] = jnp.zeros_like(dpr_ref)
+            headroom_ref[:] = alloc - req0_ref[:]
+            dnp_ref[:] = alloc - term_np_ref[:]
+            if prod_mode:
+                dpr_ref[:] = alloc - term_pr_ref[:]
             numa_ref[:] = numafree0_ref[:]
             bindfree_ref[:] = bindfree0_ref[:]
-            qused_ref[:] = qused0_ref[:]
+            qacc_ref[:] = qused0_ref[:]
 
-        prod = prod_ref[i] > 0
-        needs_numa = needsnuma_ref[i] > 0
-        needs_bind = needsbind_ref[i] > 0
-        full_pcpus = fullpcpus_ref[i] > 0
-        cores = cores_ref[i]
-        gid = qid_ref[i]
-        has_quota = gid >= 0
-
-        pod_mask = pc.make_pod_mask(i, fitreq_ref.shape[1])
-        fit_need = pc.pod_column(fitreq_ref, pod_mask)
-        raw_req = pc.pod_column(rawreq_ref, pod_mask)
-        est = pc.pod_column(est_ref, pod_mask)                        # [R, 1]
-
-        alloc = alloc_ref[:]
-        requested = requested_ref[:]
-
-        # ---- PreFilter: quota admission along the ancestor closure row
-        anc_row = anc_ref[pl.dslice(jnp.maximum(gid, 0), 1), :]      # [1, G]
-        qused = qused_ref[:]                                         # [R, G]
-        # f32 throughout: Mosaic can't truncate narrow bool vectors (G lanes)
-        viol = jnp.max(
-            jnp.where((raw_req > 0) & (qused + raw_req > qruntime_ref[:]),
-                      1.0, 0.0),
-            axis=0, keepdims=True)                                   # [1, G]
-        quota_ok = jnp.sum(anc_row * viol) <= 0.0
-        admit = (gangok_ref[i] > 0) & (quota_ok | ~has_quota)
-
-        # ---- Filter: Fit
-        fit = pc.fit_ok(fit_need, requested, alloc)                  # [N]
-        # ---- Filter: LoadAware thresholds
-        la_feas = jnp.where(prod, lafeas_pr_ref[0, :], lafeas_np_ref[0, :]) > 0
-        la_ok = la_feas | (ds_ref[i] > 0)
-        # ---- Filter: cpuset capacity + SMT alignment
+        # read-only node state: load once per grid step
+        lafeas_np = lafeas_np_ref[0, :]
+        lafeas_pr = lafeas_pr_ref[0, :]
+        node_ok_row = node_ok_ref[0, :] > 0
+        score_valid_row = score_valid_ref[0, :] > 0
+        has_topo_row = has_topo_ref[0, :] > 0
         cpc = jnp.maximum(cpc_ref[0, :], 1.0)
-        smt_ok = (~full_pcpus) | (
-            jnp.abs(jnp.remainder(cores, cpc)) < 0.5)
-        # f32-valued selects throughout the filter chain: Mosaic cannot
-        # truncate/select narrow bool vectors
-        cpuset_ok_f = jnp.where(
-            (has_topo_ref[0, :] > 0) & smt_ok & (cores <= bindfree_ref[0, :]),
-            1.0, 0.0)
-        cpuset_ok = jnp.where(needs_bind, cpuset_ok_f, 1.0) > 0
-        # ---- Filter: NUMA topology admit (ops/numa.numa_admit_row semantics)
-        total_free = jnp.zeros((R, alloc.shape[1]), jnp.float32)
-        zone = jnp.full((alloc.shape[1],), K, jnp.int32)
-        for k in range(K - 1, -1, -1):
-            free_k = numa_ref[k * R:(k + 1) * R, :]                  # [R, N]
-            total_free = total_free + free_k
-            fits_k = jnp.all((raw_req <= 0) | (raw_req <= free_k), axis=0)
-            zone = jnp.where(fits_k, jnp.int32(k), zone)             # lowest k
-        fits_total = jnp.all((raw_req <= 0) | (raw_req <= total_free), axis=0)
         policy = policy_ref[0, :]
-        any_zone_f = jnp.where(zone < K, 1.0, 0.0)
-        fits_total_f = jnp.where(fits_total, 1.0, 0.0)
-        numa_ok_f = jnp.where(policy == POLICY_SINGLE_NUMA_NODE,
-                              any_zone_f, fits_total_f)
-        numa_ok_f = jnp.where(policy == POLICY_NONE, 1.0, numa_ok_f)
-        numa_ok = jnp.where(needs_numa, numa_ok_f, 1.0) > 0
+        taintpow = taintpow_ref[0, :]
+        qruntime = qruntime_ref[:]
+        # [R, 1] weight column built from a sublane iota — Pallas kernels
+        # cannot capture array constants
+        r_iota = jax.lax.broadcasted_iota(jnp.int32, (R, 1), 0)
+        w_col = jnp.zeros((R, 1), jnp.float32)
+        for r, wv in consts:
+            w_col = jnp.where(r_iota == r, jnp.float32(wv), w_col)
+        iota = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)[0]
+        safe_cap = jnp.where(alloc > 0, alloc, 1.0)
+        cap_pos = alloc > 0
+        single_node = policy == POLICY_SINGLE_NUMA_NODE              # [N]
+        fitreq_blk = fitreq_ref[:]
+        rawreq_blk = rawreq_ref[:]
+        est_blk = est_ref[:]
+        NEG = jnp.float32(-3.0e38)
 
-        # ---- Filter: TaintToleration — bit test in exact f32 arithmetic
-        # (floor/mod; Mosaic has no shift-by-vector): bit g of mask is
-        # floor(mask / 2^g) mod 2
-        taint_ok = jnp.remainder(
-            jnp.floor(taintmask_ref[i] / taintpow_ref[0, :]), 2.0) >= 1.0
-        feasible = ((node_ok_ref[0, :] > 0) & fit & la_ok & cpuset_ok
-                    & numa_ok & taint_ok & admit)
+        # mutable chain state: carried in registers across the UNROLL pods,
+        # stored back to the scratch refs once per grid step
+        headroom = headroom_ref[:]                      # alloc - requested
+        headla_np = dnp_ref[:]                          # alloc - np base
+        headla_pr = dpr_ref[:] if prod_mode else headla_np
+        numa = [numa_ref[k * R:(k + 1) * R, :] for k in range(K)]
+        bindfree = bindfree_ref[0, :]
+        qused = qacc_ref[:]                                          # [R, G]
 
-        # ---- Score: LoadAware + NodeNUMAResource least-allocated
+        for j in range(UNROLL):
+            p = i * UNROLL + j
+            prod = prod_ref[p] > 0
+            needs_numa = needsnuma_ref[p] > 0
+            needs_bind = needsbind_ref[p] > 0
+            full_pcpus = fullpcpus_ref[p] > 0
+            cores = cores_ref[p]
+            gid = qid_ref[p]
+            has_quota = gid >= 0
+
+            lane = (i * UNROLL) % POD_BLOCK + j
+            pod_mask = pc.make_pod_mask(lane, POD_BLOCK)
+            fit_need = pc.pod_column(fitreq_blk, pod_mask)
+            raw_req = pc.pod_column(rawreq_blk, pod_mask)
+            est = pc.pod_column(est_blk, pod_mask)                   # [R, 1]
+            # effective requests: rows with no demand compare true against
+            # anything, so (req <= 0) | (req <= free) is one compare
+            fit_eff = jnp.where(fit_need > 0, fit_need, NEG)
+            raw_eff = jnp.where(raw_req > 0, raw_req, NEG)
+
+            # ---- PreFilter: quota admission along the ancestor closure row
+            anc_row = ancpod_ref[j:j + 1, :]                         # [1, G]
+            # f32 throughout: Mosaic can't truncate narrow bool vectors
+            viol = jnp.max(
+                jnp.where((raw_req > 0) & (qused + raw_req > qruntime),
+                          1.0, 0.0),
+                axis=0, keepdims=True)                               # [1, G]
+            quota_ok = jnp.sum(anc_row * viol) <= 0.0
+            admit = (gangok_ref[p] > 0) & (quota_ok | ~has_quota)
+
+            # ---- Filter: Fit
+            fit = jnp.all(headroom >= fit_eff, axis=0)               # [N]
+            # ---- Filter: LoadAware thresholds
+            la_feas = jnp.where(prod, lafeas_pr, lafeas_np) > 0
+            la_ok = la_feas | (ds_ref[p] > 0)
+            # ---- Filter: cpuset capacity + SMT alignment
+            smt_ok = (~full_pcpus) | (
+                jnp.abs(jnp.remainder(cores, cpc)) < 0.5)
+            # f32-valued selects throughout the filter chain: Mosaic cannot
+            # truncate/select narrow bool vectors
+            cpuset_ok_f = jnp.where(
+                has_topo_row & smt_ok & (cores <= bindfree), 1.0, 0.0)
+            cpuset_ok = jnp.where(needs_bind, cpuset_ok_f, 1.0) > 0
+            # ---- Filter: NUMA topology admit (ops/numa.numa_admit_row):
+            # per-zone fits (ascending cumulative free kept for the
+            # waterfall), lowest fitting zone wins
+            fits = []
+            cumfree = []
+            run = jnp.zeros((R, N), jnp.float32) if K == 0 else None
+            for k in range(K):
+                fits.append(jnp.all(numa[k] >= raw_eff, axis=0))
+                run = numa[k] if run is None else run + numa[k]
+                cumfree.append(run)
+            zone = jnp.full((N,), K, jnp.int32)
+            for k in range(K - 1, -1, -1):
+                zone = jnp.where(fits[k], jnp.int32(k), zone)        # lowest k
+            fits_total = jnp.all(run >= raw_eff, axis=0)
+            any_zone_f = jnp.where(zone < K, 1.0, 0.0)
+            fits_total_f = jnp.where(fits_total, 1.0, 0.0)
+            numa_ok_f = jnp.where(single_node, any_zone_f, fits_total_f)
+            numa_ok_f = jnp.where(policy == POLICY_NONE, 1.0, numa_ok_f)
+            numa_ok = jnp.where(needs_numa, numa_ok_f, 1.0) > 0
+
+            # ---- Filter: TaintToleration — bit test in exact f32 arithmetic
+            # (floor/mod; Mosaic has no shift-by-vector): bit g of mask is
+            # floor(mask / 2^g) mod 2
+            taint_ok = jnp.remainder(
+                jnp.floor(taintmask_ref[p] / taintpow), 2.0) >= 1.0
+            feasible = (node_ok_row & fit & la_ok & cpuset_ok
+                        & numa_ok & taint_ok & admit)
+
+            # ---- Score: LoadAware + NodeNUMAResource least-allocated
+            headla = jnp.where(prod, headla_pr, headla_np) if prod_mode \
+                else headla_np
+            la_per_r = pc.least_requested_rem(headla - est, safe_cap, cap_pos)
+            nu_per_r = pc.least_requested_rem(headroom - raw_req, safe_cap,
+                                              cap_pos)
+            la_score = pc.weighted_floor_score_col(la_per_r, w_col, wsum)
+            la_score = jnp.where(score_valid_row, la_score, 0.0)
+            score = la_score + pc.weighted_floor_score_col(nu_per_r, w_col,
+                                                           wsum)
+            score = jnp.where(feasible, score, -1.0)
+
+            best, maxv, _ = pc.lowest_index_max(score, N, iota)
+            found = (maxv >= 0.0) & (valid_ref[p] > 0)
+            sel = ((iota == best) & found).astype(jnp.float32)       # [N]
+
+            # ---- Reserve: state updates
+            headroom = headroom - sel[None, :] * fit_need
+            est_add = sel[None, :] * est
+            headla_np = headla_np - est_add
+            if prod_mode:
+                headla_pr = headla_pr - jnp.where(prod, 1.0, 0.0) * est_add
+            bindfree = bindfree - sel * jnp.where(needs_bind, cores, 0.0)
+            # numa: single-zone subtract + lowest-zones-first waterfall
+            # (disjoint). Only the SingleNUMANode policy pins a zone
+            # (numa_admit_row returns zone = -1 otherwise); every other
+            # policy spread-fills. The waterfall take is the closed form
+            # take_k = clip(D - cumfree_{<k}, 0, free_k): exact for packed
+            # integers, identical to the sequential remaining-carry.
+            apply_numa = sel * jnp.where(needs_numa, 1.0, 0.0)       # [N]
+            single_m = apply_numa * jnp.where(
+                single_node & (zone < K), 1.0, 0.0)
+            spread_m = apply_numa - single_m
+            demand = raw_req * spread_m[None, :]                     # [R, N]
+            for k in range(K):
+                zone_m = (single_m * jnp.where(zone == k, 1.0, 0.0))[None, :]
+                free_k = numa[k] - raw_req * zone_m
+                # cumfree >= 0, so off-demand columns clamp to 0 unmasked
+                rem = demand if k == 0 else \
+                    jnp.maximum(demand - cumfree[k - 1], 0.0)
+                numa[k] = free_k - jnp.minimum(free_k, rem)
+            # quota: add along the ancestor closure
+            q_apply = jnp.where(found & has_quota, 1.0, 0.0)
+            qused = qused + raw_req * anc_row * q_apply
+
+            picked = jnp.where(found, best, jnp.int32(-1))
+            chosen_ref[j:j + 1, :] = picked.reshape(1, 1)
+
+        headroom_ref[:] = headroom
+        dnp_ref[:] = headla_np
         if prod_mode:
-            base = jnp.where(prod, term_pr_ref[:] + dpr_ref[:],
-                             term_np_ref[:] + dnp_ref[:])
-        else:
-            base = term_np_ref[:] + dnp_ref[:]
-        la_per_r = pc.least_requested(alloc, est + base)
-        nu_per_r = pc.least_requested(alloc, requested + raw_req)
-        la_score = pc.weighted_floor_score(la_per_r, consts, wsum)
-        la_score = jnp.where(score_valid_ref[0, :] > 0, la_score, 0.0)
-        score = la_score + pc.weighted_floor_score(nu_per_r, consts, wsum)
-        score = jnp.where(feasible, score, -1.0)
-
-        best, maxv, iota = pc.lowest_index_max(score, alloc.shape[1])
-        found = (maxv >= 0.0) & (valid_ref[i] > 0)
-        sel = ((iota == best) & found).astype(jnp.float32)           # [N]
-
-        # ---- Reserve: state updates
-        requested_ref[:] = requested + sel[None, :] * fit_need
-        est_add = sel[None, :] * est
-        dnp_ref[:] = dnp_ref[:] + est_add
-        if prod_mode:
-            dpr_ref[:] = dpr_ref[:] + jnp.where(prod, 1.0, 0.0) * est_add
-        bindfree_ref[:] = bindfree_ref[:] - (
-            sel * jnp.where(needs_bind, cores, 0.0))[None, :]
-        # numa: single-zone subtract + lowest-zones-first waterfall (disjoint).
-        # Only the SingleNUMANode policy pins a zone (numa_admit_row returns
-        # zone = -1 otherwise); every other policy spread-fills.
-        apply_numa = sel * jnp.where(needs_numa, 1.0, 0.0)           # [N]
-        single_m = apply_numa * jnp.where(
-            (policy == POLICY_SINGLE_NUMA_NODE) & (zone < K), 1.0, 0.0)
-        spread_m = apply_numa - single_m
-        remaining = raw_req * spread_m[None, :]                      # [R, N]
+            dpr_ref[:] = headla_pr
         for k in range(K):
-            free_k = numa_ref[k * R:(k + 1) * R, :]
-            zone_m = (single_m * jnp.where(zone == k, 1.0, 0.0))[None, :]
-            free_k = free_k - raw_req * zone_m
-            take = jnp.minimum(free_k, remaining)
-            numa_ref[k * R:(k + 1) * R, :] = free_k - take
-            remaining = remaining - take
-        # quota: add along the ancestor closure
-        q_apply = jnp.where(found & has_quota, 1.0, 0.0)
-        qused_ref[:] = qused + raw_req * anc_row * q_apply
+            numa_ref[k * R:(k + 1) * R, :] = numa[k]
+        bindfree_ref[:] = bindfree[None, :]
+        qacc_ref[:] = qused
 
-        pc.store_chosen(chosen_ref, i, best, found)
+        @pl.when(i == pl.num_programs(0) - 1)
+        def _emit():
+            requested_ref[:] = alloc - headroom
+            qused_ref[:] = qused
 
     return kernel
 
@@ -253,7 +332,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             anc = jnp.zeros((1, 1), jnp.float32)
 
         f32, row = pc.f32, pc.row
-        P_pad, pad_p = pc.pad_pods(P)
+        P_pad, pad_p = pc.pad_pods(P, POD_BLOCK)
         spad = lambda x: jnp.pad(f32(x), pad_p)  # noqa: E731
 
         def pods_t(x):  # [P, R] -> [R, P_pad]
@@ -275,7 +354,13 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             qused0 = jnp.zeros((R, G_lane), jnp.float32)
             qruntime = jnp.full((R, G_lane), jnp.inf, jnp.float32)
             qid = jnp.full(P, -1, jnp.int32)
-        anc = jnp.pad(anc, [(0, max(8 - G_eff, 0)), (0, G_lane - anc.shape[1])])
+        # pre-gather each pod's ancestor-closure row: [P_pad, G_lane] in HBM,
+        # streamed as [UNROLL, G_lane] blocks (quota-less pods hit row 0 of
+        # an all-zeros closure or carry has_quota == False, so the row is
+        # never applied)
+        qid_pad = jnp.pad(qid, pad_p, constant_values=-1)
+        anc = jnp.pad(anc, [(0, 0), (0, G_lane - anc.shape[1])])
+        anc_pod = jnp.take(anc, jnp.maximum(qid_pad, 0), axis=0)
 
         kernel = _make_kernel(weights, prod_mode, N, R, K, G_eff)
         grid_inputs = (
@@ -284,7 +369,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             spad(fc.needs_numa), spad(fc.needs_bind),
             spad(fc.full_pcpus), spad(fc.cores_needed),
             jnp.pad(f32(fc.pod_taint_mask), pad_p, constant_values=1.0),
-            jnp.pad(qid, pad_p, constant_values=-1),
+            qid_pad,
             pods_t(inputs.fit_requests), pods_t(fc.requests),
             pods_t(inputs.estimated),
             f32(inputs.allocatable).T, f32(inputs.requested).T,
@@ -294,22 +379,27 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             row(fc.has_topology), row(fc.bind_free), row(fc.cpus_per_core),
             jnp.asarray(fc.numa_policy, jnp.int32)[None, :],
             jnp.exp2(f32(fc.node_taint_group))[None, :],
-            numa0, jnp.asarray(anc, jnp.float32), qused0, qruntime,
+            numa0, anc_pod, qused0, qruntime,
         )
         smem, full = pc.smem_spec, pc.full_spec
+        # pod columns stream as [R, POD_BLOCK] blocks; a block serves
+        # POD_BLOCK // UNROLL consecutive grid steps
+        pod_spec = pl.BlockSpec(
+            (R, POD_BLOCK), lambda i: (0, (i * UNROLL) // POD_BLOCK))
         chosen, requested_t, qused_t = pl.pallas_call(
             kernel,
-            grid=(P_pad,),
+            grid=(P_pad // UNROLL,),
             in_specs=(
                 [smem()] * 10
-                + [full((R, P_pad))] * 3
+                + [pod_spec] * 3
                 + [full((R, N))] * 4
                 + [full((1, N))] * 9
-                + [full((K * R, N)), full((max(G_eff, 8), G_lane)),
+                + [full((K * R, N)),
+                   pl.BlockSpec((UNROLL, G_lane), lambda i: (i, 0)),
                    full((R, G_lane)), full((R, G_lane))]
             ),
             out_specs=[
-                pc.chosen_spec(),
+                pl.BlockSpec((UNROLL, 1), lambda i: (i, 0)),
                 full((R, N)),
                 full((R, G_lane)),
             ],
@@ -323,6 +413,8 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                 pltpu.VMEM((R, N), jnp.float32),
                 pltpu.VMEM((K * R, N), jnp.float32),
                 pltpu.VMEM((1, N), jnp.float32),
+                pltpu.VMEM((R, N), jnp.float32),
+                pltpu.VMEM((R, G_lane), jnp.float32),
             ],
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("arbitrary",),

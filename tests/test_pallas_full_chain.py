@@ -37,6 +37,15 @@ def test_pallas_full_chain_no_quota_no_gang():
     _compare(9, num_quotas=0, num_gangs=0)
 
 
+def test_pallas_full_chain_crosses_pod_block():
+    """160 pods > POD_BLOCK=128: at least two pod-column blocks stream
+    through the grid, exercising the block index map and the lane-wrap
+    (`(i * UNROLL) % POD_BLOCK`) math that a single-block case never
+    evaluates past block 0."""
+    chosen = _compare(6, num_nodes=40, num_pods=160)
+    assert (chosen >= 0).sum() > 0
+
+
 def test_pallas_full_chain_all_topology():
     _compare(5, topology_fraction=1.0, lsr_fraction=0.4)
 
