@@ -19,6 +19,17 @@ from jax.experimental.pallas import tpu as pltpu
 
 MAX_NODE_SCORE = 100.0
 
+# Shared unroll/streaming scheme for the sequential kernels: UNROLL pods are
+# walked per grid step (grid bookkeeping and state load/store amortize), pod
+# columns stream in as [R, POD_BLOCK] blocks, and the chosen output block is
+# (UNROLL, 1) — written by exactly one step. UNROLL must divide POD_BLOCK.
+UNROLL = 8
+POD_BLOCK = 128
+
+# Effective-request sentinel: rows with no demand compare true against any
+# headroom, making (req <= 0) | (req <= free) a single compare.
+NEG_F32 = -3.0e38
+
 # Per-core VMEM the sequential kernels may pin (TPU v4/v5e expose ~16 MiB
 # of VMEM per TensorCore; leave headroom for Mosaic's own spills and the
 # grid machinery). The backend selectors fall back to the XLA step past
@@ -55,16 +66,15 @@ def make_pod_mask(i, P_pad: int) -> jnp.ndarray:
             ).astype(jnp.float32)
 
 
-def fit_ok(need, requested, alloc) -> jnp.ndarray:
-    """[N] NodeResourcesFit over [R, N] state (ops/fit.fit_ok_row)."""
-    return jnp.all((need <= 0) | (requested + need <= alloc), axis=0)
-
-
-def least_requested(alloc, used) -> jnp.ndarray:
-    """[R, N] per-resource leastRequestedScore (ops/common semantics)."""
-    safe_cap = jnp.where(alloc > 0, alloc, 1.0)
-    per_r = jnp.floor((alloc - used) * MAX_NODE_SCORE / safe_cap)
-    return jnp.where((alloc > 0) & (used <= alloc), per_r, 0.0)
+def weight_col(consts, R: int) -> jnp.ndarray:
+    """[R, 1] weight column built from a sublane iota — Pallas kernels
+    cannot capture array constants, so the static weights are encoded as a
+    chain of iota selects."""
+    r_iota = jax.lax.broadcasted_iota(jnp.int32, (R, 1), 0)
+    col = jnp.zeros((R, 1), jnp.float32)
+    for r, wv in consts:
+        col = jnp.where(r_iota == r, jnp.float32(wv), col)
+    return col
 
 
 def least_requested_rem(rem, safe_cap, cap_pos) -> jnp.ndarray:
@@ -73,14 +83,6 @@ def least_requested_rem(rem, safe_cap, cap_pos) -> jnp.ndarray:
     used <= alloc for the packed-integer values the kernels carry."""
     per_r = jnp.floor(rem * MAX_NODE_SCORE / safe_cap)
     return jnp.where(cap_pos & (rem >= 0), per_r, 0.0)
-
-
-def weighted_floor_score(per_r, consts, wsum: float) -> jnp.ndarray:
-    """[N] floor(sum_r w_r*score_r / wsum) with static weights."""
-    acc = jnp.zeros((1, per_r.shape[1]), jnp.float32)
-    for r, wv in consts:
-        acc = acc + wv * per_r[r:r + 1, :]
-    return jnp.floor(acc[0] / wsum)
 
 
 def weighted_floor_score_col(per_r, w_col, wsum: float) -> jnp.ndarray:
@@ -105,12 +107,6 @@ def lowest_index_max(score, N: int, iota=None):
     return best, maxv, iota
 
 
-def store_chosen(chosen_ref, i, best, found) -> None:
-    """Write pod i's pick into its (8, 1) output block row."""
-    picked = jnp.where(found, best, jnp.int32(-1))
-    chosen_ref[pl.dslice(i % 8, 1), :] = picked.reshape(1, 1)
-
-
 # ---- wrapper-side packing helpers ----------------------------------------
 
 smem_spec = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
@@ -120,8 +116,15 @@ def full_spec(shape):
     return pl.BlockSpec(shape, lambda i: (0, 0))
 
 
-def chosen_spec():
-    return pl.BlockSpec((8, 1), lambda i: (i // 8, 0))
+def pod_block_spec(R: int):
+    """[R, POD_BLOCK] streaming spec for pod-column arrays: a block serves
+    POD_BLOCK // UNROLL consecutive grid steps."""
+    return pl.BlockSpec((R, POD_BLOCK), lambda i: (0, (i * UNROLL) // POD_BLOCK))
+
+
+def chosen_block_spec():
+    """(UNROLL, 1) chosen-output block, one per grid step."""
+    return pl.BlockSpec((UNROLL, 1), lambda i: (i, 0))
 
 
 def f32(x) -> jnp.ndarray:

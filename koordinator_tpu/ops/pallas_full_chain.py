@@ -39,14 +39,7 @@ from koordinator_tpu.ops.gang import gang_permit_mask
 from koordinator_tpu.ops.loadaware import LoadAwareArgs
 from koordinator_tpu.ops.numa import POLICY_NONE, POLICY_SINGLE_NUMA_NODE
 
-# Pods evaluated per grid step. The serial contract still holds — the 8 pods
-# are walked in order inside the step — but the per-step costs (grid
-# bookkeeping, state ref load/store, read-only row loads) amortize 8x, and
-# the chosen output block (8, 1) is written by exactly one step.
-UNROLL = 8
-# Pod columns stream in as [R, POD_BLOCK] grid blocks instead of whole
-# [R, P_pad] VMEM residents; P_pad is padded to a POD_BLOCK multiple.
-POD_BLOCK = 128
+from koordinator_tpu.ops.pallas_common import POD_BLOCK, UNROLL
 
 
 def estimate_vmem_bytes(N: int, R: int, K: int, G: int, P: int) -> int:
@@ -130,12 +123,7 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         policy = policy_ref[0, :]
         taintpow = taintpow_ref[0, :]
         qruntime = qruntime_ref[:]
-        # [R, 1] weight column built from a sublane iota — Pallas kernels
-        # cannot capture array constants
-        r_iota = jax.lax.broadcasted_iota(jnp.int32, (R, 1), 0)
-        w_col = jnp.zeros((R, 1), jnp.float32)
-        for r, wv in consts:
-            w_col = jnp.where(r_iota == r, jnp.float32(wv), w_col)
+        w_col = pc.weight_col(consts, R)
         iota = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)[0]
         safe_cap = jnp.where(alloc > 0, alloc, 1.0)
         cap_pos = alloc > 0
@@ -143,7 +131,6 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         fitreq_blk = fitreq_ref[:]
         rawreq_blk = rawreq_ref[:]
         est_blk = est_ref[:]
-        NEG = jnp.float32(-3.0e38)
 
         # mutable chain state: carried in registers across the UNROLL pods,
         # stored back to the scratch refs once per grid step
@@ -171,8 +158,8 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
             est = pc.pod_column(est_blk, pod_mask)                   # [R, 1]
             # effective requests: rows with no demand compare true against
             # anything, so (req <= 0) | (req <= free) is one compare
-            fit_eff = jnp.where(fit_need > 0, fit_need, NEG)
-            raw_eff = jnp.where(raw_req > 0, raw_req, NEG)
+            fit_eff = jnp.where(fit_need > 0, fit_need, pc.NEG_F32)
+            raw_eff = jnp.where(raw_req > 0, raw_req, pc.NEG_F32)
 
             # ---- PreFilter: quota admission along the ancestor closure row
             anc_row = ancpod_ref[j:j + 1, :]                         # [1, G]
@@ -382,10 +369,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             numa0, anc_pod, qused0, qruntime,
         )
         smem, full = pc.smem_spec, pc.full_spec
-        # pod columns stream as [R, POD_BLOCK] blocks; a block serves
-        # POD_BLOCK // UNROLL consecutive grid steps
-        pod_spec = pl.BlockSpec(
-            (R, POD_BLOCK), lambda i: (0, (i * UNROLL) // POD_BLOCK))
+        pod_spec = pc.pod_block_spec(R)
         chosen, requested_t, qused_t = pl.pallas_call(
             kernel,
             grid=(P_pad // UNROLL,),
@@ -399,7 +383,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                    full((R, G_lane)), full((R, G_lane))]
             ),
             out_specs=[
-                pl.BlockSpec((UNROLL, 1), lambda i: (i, 0)),
+                pc.chosen_block_spec(),
                 full((R, N)),
                 full((R, G_lane)),
             ],

@@ -5,12 +5,14 @@ sequential pod loop as `lax.fori_loop`; every iteration re-reads the [N, R]
 node state from wherever XLA materialized it. This kernel instead runs the
 WHOLE pod loop inside one `pallas_call` with the node state pinned in VMEM:
 
-  * grid = (P,) — TPU grids are sequential, so scratch buffers carry the
-    running state (requested, LoadAware assign-cache deltas) from pod i to
-    pod i+1 with zero HBM round-trips;
+  * grid = (P_pad / UNROLL,) — TPU grids are sequential, so scratch buffers
+    carry the running state (headroom, LoadAware assign-cache deltas) from
+    step to step with zero HBM round-trips; each step walks UNROLL pods in
+    order with the state held in registers;
   * node arrays are laid out transposed [R, N] so the N axis rides the
     128-wide lanes (R <= 16 sublanes, f32 min tile is (8, 128));
-  * per-pod rows ([1, R] blocks) stream in; per-pod scalars sit in SMEM.
+  * pod columns stream in as [R, POD_BLOCK] blocks; per-pod scalars sit in
+    SMEM.
 
 Semantics are bit-identical to the XLA step (same go_round / floor-division
 helpers, same first-max tie-break); tests/test_pallas_step.py diffs the two
@@ -38,72 +40,107 @@ from koordinator_tpu.ops import pallas_common as pc
 from koordinator_tpu.ops.loadaware import LoadAwareArgs
 
 
-def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int):
+from koordinator_tpu.ops.pallas_common import POD_BLOCK, UNROLL
+
+
+def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int):
     wsum = float(max(weights.sum(), 1.0))
     consts = pc.weight_consts(weights)
 
     def kernel(
         prod_ref, valid_ref, ds_ref,                     # [P] SMEM scalars
-        req_ref, est_ref,                                # [R, P] VMEM (full)
+        req_ref, est_ref,                                # [R, POD_BLOCK] blocks
         alloc_ref, req0_ref, term_np_ref, term_pr_ref,   # [R, N] VMEM
         lafeas_np_ref, lafeas_pr_ref, node_ok_ref, score_valid_ref,  # [1, N]
-        chosen_ref,                                      # [8, 1] int32 out blocks
-        requested_ref,                                   # [R, N] f32 out (carried)
+        chosen_ref,                                      # [UNROLL, 1] out block
+        requested_ref,                                   # [R, N] f32 out
         dnp_ref, dpr_ref,                                # [R, N] scratch
+        headroom_ref,                                    # [R, N] scratch
     ):
         i = pl.program_id(0)
+        alloc = alloc_ref[:]                             # [R, N]
 
+        # state carried in headroom form (alloc - requested, alloc - base):
+        # Fit and least-requested become single compares/subtracts; exact
+        # f32 integer arithmetic keeps bindings bit-identical (see
+        # ops/pallas_full_chain.py)
         @pl.when(i == 0)
         def _init():
-            requested_ref[:] = req0_ref[:]
-            dnp_ref[:] = jnp.zeros_like(dnp_ref)
-            dpr_ref[:] = jnp.zeros_like(dpr_ref)
+            headroom_ref[:] = alloc - req0_ref[:]
+            dnp_ref[:] = alloc - term_np_ref[:]
+            if prod_mode:
+                dpr_ref[:] = alloc - term_pr_ref[:]
 
-        prod = prod_ref[i] > 0
-        pod_mask = pc.make_pod_mask(i, req_ref.shape[1])
-        need = pc.pod_column(req_ref, pod_mask)          # [R, 1]
-        est = pc.pod_column(est_ref, pod_mask)           # [R, 1]
-        alloc = alloc_ref[:]                             # [R, N]
-        requested = requested_ref[:]
-        fit = pc.fit_ok(need, requested, alloc)          # [N]
+        lafeas_np = lafeas_np_ref[0, :]
+        lafeas_pr = lafeas_pr_ref[0, :]
+        node_ok_row = node_ok_ref[0, :] > 0
+        score_valid_row = score_valid_ref[0, :] > 0
+        safe_cap = jnp.where(alloc > 0, alloc, 1.0)
+        cap_pos = alloc > 0
+        iota = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)[0]
+        w_col = pc.weight_col(consts, R)
+        req_blk = req_ref[:]
+        est_blk = est_ref[:]
 
-        # LoadAware least-allocated score with in-batch deltas
+        headroom = headroom_ref[:]
+        headla_np = dnp_ref[:]
+        headla_pr = dpr_ref[:] if prod_mode else headla_np
+
+        for j in range(UNROLL):
+            p = i * UNROLL + j
+            prod = prod_ref[p] > 0
+            lane = (i * UNROLL) % POD_BLOCK + j
+            pod_mask = pc.make_pod_mask(lane, POD_BLOCK)
+            need = pc.pod_column(req_blk, pod_mask)      # [R, 1]
+            est = pc.pod_column(est_blk, pod_mask)       # [R, 1]
+            need_eff = jnp.where(need > 0, need, pc.NEG_F32)
+            fit = jnp.all(headroom >= need_eff, axis=0)  # [N]
+
+            # LoadAware least-allocated score with in-batch deltas
+            headla = jnp.where(prod, headla_pr, headla_np) if prod_mode \
+                else headla_np
+            per_r = pc.least_requested_rem(headla - est, safe_cap, cap_pos)
+            score = pc.weighted_floor_score_col(per_r, w_col, wsum)
+            score = jnp.where(score_valid_row, score, 0.0)
+
+            la_feas = jnp.where(prod, lafeas_pr, lafeas_np) > 0
+            la_ok = la_feas | (ds_ref[p] > 0)
+            feasible = node_ok_row & fit & la_ok
+            score = jnp.where(feasible, score, -1.0)
+
+            best, maxv, _ = pc.lowest_index_max(score, N, iota)
+            found = (maxv >= 0.0) & (valid_ref[p] > 0)
+            sel = ((iota == best) & found).astype(jnp.float32)   # [N]
+
+            headroom = headroom - sel[None, :] * need
+            est_add = sel[None, :] * est
+            headla_np = headla_np - est_add
+            if prod_mode:
+                headla_pr = headla_pr - jnp.where(prod, 1.0, 0.0) * est_add
+            picked = jnp.where(found, best, jnp.int32(-1))
+            chosen_ref[j:j + 1, :] = picked.reshape(1, 1)
+
+        headroom_ref[:] = headroom
+        dnp_ref[:] = headla_np
         if prod_mode:
-            base = jnp.where(prod, term_pr_ref[:] + dpr_ref[:],
-                             term_np_ref[:] + dnp_ref[:])
-        else:
-            base = term_np_ref[:] + dnp_ref[:]
-        per_r = pc.least_requested(alloc, est + base)
-        score = pc.weighted_floor_score(per_r, consts, wsum)
-        score = jnp.where(score_valid_ref[0, :] > 0, score, 0.0)
+            dpr_ref[:] = headla_pr
 
-        la_feas = jnp.where(prod, lafeas_pr_ref[0, :], lafeas_np_ref[0, :]) > 0
-        la_ok = la_feas | (ds_ref[i] > 0)
-        feasible = (node_ok_ref[0, :] > 0) & fit & la_ok
-        score = jnp.where(feasible, score, -1.0)
-
-        best, maxv, iota = pc.lowest_index_max(score, N)
-        found = (maxv >= 0.0) & (valid_ref[i] > 0)
-        sel = ((iota == best) & found).astype(jnp.float32)   # [N]
-
-        requested_ref[:] = requested + sel[None, :] * need
-        est_add = sel[None, :] * est
-        dnp_ref[:] = dnp_ref[:] + est_add
-        if prod_mode:
-            dpr_ref[:] = dpr_ref[:] + jnp.where(prod, 1.0, 0.0) * est_add
-        pc.store_chosen(chosen_ref, i, best, found)
+        @pl.when(i == pl.num_programs(0) - 1)
+        def _emit():
+            requested_ref[:] = alloc - headroom
 
     return kernel
 
 
 def estimate_vmem_bytes(N: int, R: int, P: int) -> int:
     """Upper-bound VMEM footprint of one pallas_call of the schedule kernel:
-    2 [R, P_pad] pod-column inputs, 7 [R, N] node buffers (4 in + 1 out +
-    2 scratch), 4 [1, N] rows, and the [P_pad, 1] chosen output, all f32.
-    Used by models.scheduler_model.build_best_schedule_step to fall back to
-    the XLA step when the state would not fit on-chip."""
-    P_pad = -(-P // 8) * 8
-    floats = 2 * R * P_pad + 7 * R * N + 4 * N + P_pad
+    2 double-buffered [R, POD_BLOCK] pod-column blocks, 8 [R, N] node
+    buffers (4 in + 1 out + 3 scratch), 4 [1, N] rows, and the [P_pad, 1]
+    chosen output, all f32. Used by
+    models.scheduler_model.build_best_schedule_step to fall back to the XLA
+    step when the state would not fit on-chip."""
+    P_pad = -(-P // POD_BLOCK) * POD_BLOCK
+    floats = 2 * R * POD_BLOCK * 2 + 8 * R * N + 4 * N + P_pad
     return 4 * floats
 
 
@@ -129,12 +166,12 @@ def build_pallas_schedule_step(args: LoadAwareArgs, interpret: bool = False,
             inputs.la_filter_skip,
         )
         f32, row = pc.f32, pc.row
-        P_pad, pad_p = pc.pad_pods(P)
+        P_pad, pad_p = pc.pad_pods(P, POD_BLOCK)
 
         def pods_t(x):  # [P, R] -> [R, P_pad]
             return jnp.pad(f32(x), pad_p + [(0, 0)]).T
 
-        kernel = _make_kernel(weights, prod_mode, N)
+        kernel = _make_kernel(weights, prod_mode, N, R)
         grid_inputs = (
             jnp.pad(f32(inputs.is_prod), pad_p),
             jnp.pad(f32(inputs.pod_valid), pad_p),  # padding invalid => -1
@@ -146,17 +183,18 @@ def build_pallas_schedule_step(args: LoadAwareArgs, interpret: bool = False,
             row(inputs.node_ok), row(inputs.la_score_valid),
         )
         smem, full = pc.smem_spec, pc.full_spec
+        pod_spec = pc.pod_block_spec(R)
         chosen, requested_t = pl.pallas_call(
             kernel,
-            grid=(P_pad,),
+            grid=(P_pad // UNROLL,),
             in_specs=[
                 smem(), smem(), smem(),
-                full((R, P_pad)), full((R, P_pad)),
+                pod_spec, pod_spec,
                 full((R, N)), full((R, N)), full((R, N)), full((R, N)),
                 full((1, N)), full((1, N)), full((1, N)), full((1, N)),
             ],
             out_specs=[
-                pc.chosen_spec(),
+                pc.chosen_block_spec(),
                 full((R, N)),
             ],
             out_shape=[
@@ -164,6 +202,7 @@ def build_pallas_schedule_step(args: LoadAwareArgs, interpret: bool = False,
                 jax.ShapeDtypeStruct((R, N), jnp.float32),
             ],
             scratch_shapes=[
+                pltpu.VMEM((R, N), jnp.float32),
                 pltpu.VMEM((R, N), jnp.float32),
                 pltpu.VMEM((R, N), jnp.float32),
             ],

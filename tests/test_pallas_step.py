@@ -41,6 +41,16 @@ def test_pallas_matches_xla_step(seed, prod_mode):
                                rtol=0, atol=1e-4)
 
 
+def test_pallas_crosses_pod_block():
+    """160 pods > POD_BLOCK=128: at least two pod-column blocks stream in,
+    exercising the block index map and lane-wrap math."""
+    args, inputs = _inputs(32, 160, seed=2)
+    chosen_x, _ = build_schedule_step(args)(inputs)
+    chosen_p, _ = build_pallas_schedule_step(args, interpret=True)(inputs)
+    np.testing.assert_array_equal(np.asarray(chosen_x), np.asarray(chosen_p))
+    assert (np.asarray(chosen_x) >= 0).sum() > 0
+
+
 def test_pallas_infeasible_pods_get_minus_one():
     args, inputs = _inputs(4, 6, seed=3)
     # make every node unschedulable
