@@ -43,6 +43,10 @@ ANNOTATION_RESOURCE_STATUS = SCHEDULING_DOMAIN_PREFIX + "/resource-status"
 ANNOTATION_DEVICE_ALLOCATED = SCHEDULING_DOMAIN_PREFIX + "/device-allocated"
 ANNOTATION_RESERVATION_ALLOCATED = SCHEDULING_DOMAIN_PREFIX + "/reservation-allocated"
 ANNOTATION_EXTENDED_RESOURCE_SPEC = NODE_DOMAIN_PREFIX + "/extended-resource-spec"
+# marks the fake pods the scheduler itself creates for Reservation CRs; user
+# pods may never carry it (pkg/util/reservation/reservation.go:44, enforced
+# by webhook pod/validating/verify_annotations.go:60-76)
+ANNOTATION_RESERVE_POD = SCHEDULING_DOMAIN_PREFIX + "/reserve-pod"
 LABEL_QUOTA_NAME = QUOTA_DOMAIN_PREFIX + "/name"
 LABEL_QUOTA_PARENT = QUOTA_DOMAIN_PREFIX + "/parent"
 LABEL_QUOTA_IS_PARENT = QUOTA_DOMAIN_PREFIX + "/is-parent"
@@ -489,6 +493,19 @@ class NodeSLO:
     extensions: Dict[str, Any] = field(default_factory=dict)
 
 
+def host_applications(slo: Optional["NodeSLO"]) -> List[Dict[str, Any]]:
+    """Canonical accessor for the NodeSLO `hostApplications` extension
+    (apis/slo/v1alpha1/nodeslo_types.go:409 HostApplications): a list of
+    {name, cgroupPath, qos} entries describing non-k8s host services.
+    Consumers (metricsadvisor collector, qosmanager suppress accounting,
+    runtimehooks group identity) each require different fields, so this only
+    normalizes the container: non-dict entries are dropped."""
+    if slo is None:
+        return []
+    apps = (slo.extensions or {}).get("hostApplications", [])
+    return [a for a in apps if isinstance(a, dict)]
+
+
 # ---------------------------------------------------------------------------
 # NodeResourceTopology CR (reported by koordlet statesinformer nodeTopo plugin)
 # ---------------------------------------------------------------------------
@@ -562,6 +579,9 @@ class ClusterColocationProfile:
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     namespace_selector: Dict[str, str] = field(default_factory=dict)
     selector: Dict[str, str] = field(default_factory=dict)
+    # percent of matching pods the profile applies to (None == 100;
+    # cluster_colocation_profile.go:147-154 "Probability")
+    probability: Optional[int] = None
     qos_class: Optional[QoSClass] = None
     priority_class_name: str = ""
     koordinator_priority: Optional[int] = None
@@ -574,6 +594,15 @@ class ClusterColocationProfile:
 class ConfigMap:
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     data: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Namespace:
+    """core/v1 Namespace (labels only): the colocation-profile webhook
+    matches its namespaceSelector against these labels
+    (pod/mutating/cluster_colocation_profile.go:113-130)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
 
 
 # ---------------------------------------------------------------------------

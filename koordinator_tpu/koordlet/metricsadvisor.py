@@ -212,9 +212,9 @@ class MetricsAdvisor:
         """Usage of non-k8s host services declared in NodeSLO extensions
         (hostapplication collector): entries {name, cgroupPath} under the
         'hostApplications' extension key."""
-        slo = self.informer.get_node_slo()
-        apps = (slo.extensions or {}).get("hostApplications", []) if slo else []
-        for app in apps:
+        from koordinator_tpu.api.objects import host_applications
+
+        for app in host_applications(self.informer.get_node_slo()):
             name, rel = app.get("name"), app.get("cgroupPath")
             if not name or not rel:
                 continue

@@ -102,10 +102,22 @@ class CPUSuppress:
         )
         if node_usage is None:
             return None
-        # podNonBEUsed + systemUsed = nodeUsage - BE usage
+        # podNonBEUsed + hostAppNonBEUsed + systemUsed = nodeUsage - BE usage.
+        # Host applications declared BE in NodeSLO must not shrink the BE
+        # share either (helpers/calculator.go:30-66 NonBEHostAppFilter +
+        # cpu_suppress.go:139-161): their usage comes out of the non-BE side.
         be_usage = self.ctx.cache.query(
             mc.BE_CPU_USAGE, "latest", self.ctx.metric_collect_interval, now
         ) or 0.0
+        from koordinator_tpu.api.objects import host_applications
+
+        for app in host_applications(slo):
+            if app.get("qos", "") != "BE" or not app.get("name"):
+                continue
+            be_usage += self.ctx.cache.query(
+                mc.HOST_APP_CPU_USAGE, "latest",
+                self.ctx.metric_collect_interval, now, app=app["name"],
+            ) or 0.0
         non_be_used = max(0.0, node_usage - be_usage)
         suppress = capacity * threshold / 100.0 - non_be_used
         return max(suppress, float(self.MIN_SUPPRESS_CPUS))

@@ -366,6 +366,48 @@ class TerwayQoSHook(Hook):
 DEFAULT_HOOKS = (GroupIdentityHook, CPUSetHook, BatchResourceHook, GPUEnvHook)
 
 
+class HostApplicationHook(Hook):
+    """Group identity for non-k8s host services: every NodeSLO
+    `hostApplications` entry gets the bvt of its declared QoS written to its
+    own cgroup dir (hooks/groupidentity/rule.go getHostQOSBvtValue +
+    interceptor.go host-app path). Node-level only — host apps have no
+    container lifecycle, so the standalone reconciler is the only mode."""
+
+    name = "hostapplication"
+
+    def __init__(self, informer: StatesInformer,
+                 executor: ResourceUpdateExecutor):
+        self.informer = informer
+        self.executor = executor
+        self._applied: Dict[str, int] = {}  # cgroup rel -> bvt written
+
+    def apply(self, ctx: ContainerContext) -> None:  # no per-container work
+        return
+
+    def reconcile_node(self) -> None:
+        from koordinator_tpu.api.objects import host_applications
+        from koordinator_tpu.api.qos import qos_class_by_name
+
+        want: Dict[str, int] = {}
+        for app in host_applications(self.informer.get_node_slo()):
+            rel = app.get("cgroupPath")
+            if not rel:
+                continue
+            qos = qos_class_by_name(app.get("qos", ""))
+            want[rel] = BVT_BY_QOS.get(qos, 0)
+        # entries removed from NodeSLO (or whose path changed) get their
+        # bvt reset — otherwise a deleted host app keeps preempting BE
+        for rel in list(self._applied):
+            if rel not in want:
+                self.executor.update(
+                    ResourceUpdater(rel, sysutil.CPU_BVT_WARP_NS, "0"))
+                del self._applied[rel]
+        for rel, bvt in want.items():
+            self.executor.update(
+                ResourceUpdater(rel, sysutil.CPU_BVT_WARP_NS, str(bvt)))
+            self._applied[rel] = bvt
+
+
 class RuntimeHooks:
     """Hook runner: proxy-mode entry (run_hooks) + standalone reconciler."""
 
@@ -377,6 +419,7 @@ class RuntimeHooks:
         self.hooks.append(CPUNormalizationHook(informer))
         self.hooks.append(CoreSchedHook(informer, executor, cse=core_sched))
         self.hooks.append(TerwayQoSHook(informer, executor))
+        self.hooks.append(HostApplicationHook(informer, executor))
 
     def run_hooks(self, ctx: ContainerContext) -> ContainerContext:
         """Proxy/NRI-mode: mutate the container context; the caller (runtime

@@ -28,6 +28,7 @@ from typing import Callable, Dict, List, Optional
 
 from koordinator_tpu.api.objects import (
     ANNOTATION_EXTENDED_RESOURCE_SPEC,
+    ANNOTATION_RESERVE_POD,
     ClusterColocationProfile,
     ConfigMap,
     ElasticQuota,
@@ -65,6 +66,22 @@ class AdmissionError(Exception):
     """Admission denied (apiserver 4xx analog)."""
 
 
+# injectable randomness for profile probability (the reference stubs
+# rand.Intn in tests the same way: cluster_colocation_profile.go:47)
+_rand_intn: Callable[[int], int] = None
+
+
+def _default_rand_intn(n: int) -> int:
+    import random
+
+    return random.randrange(n)
+
+
+# annotations only the scheduler may set; user pods are rejected
+# (pod/validating/verify_annotations.go:60-76)
+FORBIDDEN_POD_ANNOTATIONS = (ANNOTATION_RESERVE_POD,)
+
+
 class AdmissionServer:
     def __init__(self, store: ObjectStore):
         self.store = store
@@ -78,13 +95,41 @@ class AdmissionServer:
         return pod
 
     # -- pod mutating ---------------------------------------------------
+    def _namespace_matches(self, namespace: str,
+                           selector: Dict[str, str]) -> bool:
+        """namespaceSelector matches the Namespace object's labels
+        (cluster_colocation_profile.go:113-130); a missing Namespace object
+        cannot match a non-empty selector."""
+        from koordinator_tpu.client.store import KIND_NAMESPACE
+
+        for ns in self.store.list(KIND_NAMESPACE):
+            if ns.meta.name == namespace:
+                return all(ns.meta.labels.get(k) == v
+                           for k, v in selector.items())
+        return False
+
+    def _probability_skips(self, profile: ClusterColocationProfile) -> bool:
+        """Percent-based sampling (cluster_colocation_profile.go:147-154):
+        skip when percent == 0, apply when 100, else draw."""
+        percent = profile.probability
+        if percent is None:
+            return False
+        rand_intn = _rand_intn or _default_rand_intn
+        return percent == 0 or (percent != 100 and rand_intn(100) > percent)
+
     def _matching_profile(self, pod: Pod) -> Optional[ClusterColocationProfile]:
         for profile in sorted(
             self.store.list(KIND_COLOCATION_PROFILE), key=lambda p: p.meta.name
         ):
+            if profile.namespace_selector and not self._namespace_matches(
+                pod.meta.namespace, profile.namespace_selector
+            ):
+                continue
             if profile.selector and not all(
                 pod.meta.labels.get(k) == v for k, v in profile.selector.items()
             ):
+                continue
+            if self._probability_skips(profile):
                 continue
             return profile
         return None
@@ -164,7 +209,11 @@ class AdmissionServer:
 
     # -- pod validating -------------------------------------------------
     def validate_pod(self, pod: Pod) -> None:
-        """pod/validating: QoS x priority-class consistency rules."""
+        """pod/validating: QoS x priority-class consistency rules +
+        forbidden scheduler-internal annotations."""
+        for ann in FORBIDDEN_POD_ANNOTATIONS:
+            if ann in pod.meta.annotations:
+                raise AdmissionError(f"annotation {ann!r} cannot be set")
         qos = pod.qos_class
         cls = pod.priority_class
         if qos is QoSClass.BE and cls == PriorityClass.PROD:
@@ -192,33 +241,88 @@ class AdmissionServer:
                     f"request[{name}]={req} exceeds limit={limit}")
 
     # -- elasticquota ---------------------------------------------------
+    def _quota_by_name(self, name: str) -> Optional[ElasticQuota]:
+        for q in self.store.list(KIND_ELASTIC_QUOTA):
+            if q.meta.name == name:
+                return q
+        return None
+
+    def _quota_children(self, name: str) -> List[ElasticQuota]:
+        return [q for q in self.store.list(KIND_ELASTIC_QUOTA)
+                if q.parent == name and q.meta.name != name]
+
     def validate_elastic_quota(self, quota: ElasticQuota,
                                old: Optional[ElasticQuota] = None) -> None:
-        """webhook/elasticquota guard rails."""
+        """webhook/elasticquota guard rails (quota_topology_check.go)."""
         for name, mn in quota.min.quantities.items():
             mx = quota.max.get(name, 0)
             if mx and mn > mx:
                 raise AdmissionError(f"min[{name}]={mn} exceeds max={mx}")
         parent_name = quota.parent
         if parent_name:
-            parent = None
-            for q in self.store.list(KIND_ELASTIC_QUOTA):
-                if q.meta.name == parent_name:
-                    parent = q
-                    break
+            parent = self._quota_by_name(parent_name)
             if parent is None:
                 raise AdmissionError(f"parent quota {parent_name!r} does not exist")
             if not parent.is_parent:
                 raise AdmissionError(f"quota {parent_name!r} is not a parent group")
-            for name, mn in quota.min.quantities.items():
-                pmn = parent.min.get(name, 0)
-                if pmn and mn > pmn:
+            # checkSubAndParentGroupMaxQuotaKeySame (:182-213): a child may
+            # only cap resources its parent also caps, else the child's max
+            # is unenforceable against the parent's tree accounting
+            if parent.max.quantities:
+                extra = set(quota.max.quantities) - set(parent.max.quantities)
+                if extra:
                     raise AdmissionError(
-                        f"child min[{name}]={mn} exceeds parent min={pmn}"
-                    )
-        if old is not None and MANAGER_GATES.enabled("ElasticQuotaImmutableAnnotations"):
+                        f"max keys {sorted(extra)} not present in parent "
+                        f"{parent_name!r} max")
+            # checkMinQuotaValidate (:214-255): Σ sibling min (incl. this
+            # quota) must fit inside the parent's min — over the UNION of
+            # the siblings' min keys, a key the parent's min omits counts
+            # as 0 (LessThanOrEqualCompletely semantics), so any child min
+            # in it is rejected
+            siblings = [q for q in self._quota_children(parent_name)
+                        if q.meta.name != quota.meta.name]
+            sibling_keys = set(quota.min.quantities).union(
+                *[set(q.min.quantities) for q in siblings]) if siblings \
+                else set(quota.min.quantities)
+            for name in sibling_keys:
+                pmn = parent.min.get(name, 0)
+                sibling_sum = quota.min.get(name, 0) + sum(
+                    q.min.get(name, 0) for q in siblings)
+                if sibling_sum > pmn:
+                    raise AdmissionError(
+                        f"sibling min[{name}] sum={sibling_sum} exceeds "
+                        f"parent min={pmn}")
+        # Σ children min must fit inside this quota's (possibly shrunken) min
+        children = self._quota_children(quota.meta.name)
+        for name in {n for c in children for n in c.min.quantities}:
+            child_sum = sum(c.min.get(name, 0) for c in children)
+            if child_sum > quota.min.get(name, 0):
+                raise AdmissionError(
+                    f"children min[{name}] sum={child_sum} exceeds new "
+                    f"min={quota.min.get(name, 0)}")
+        if old is not None:
+            self._validate_quota_update(quota, old)
+
+    def _validate_quota_update(self, quota: ElasticQuota,
+                               old: ElasticQuota) -> None:
+        """checkIsParentChange (:142-165) + tree-id immutability."""
+        if MANAGER_GATES.enabled("ElasticQuotaImmutableAnnotations"):
             if old.tree_id and quota.tree_id != old.tree_id:
                 raise AdmissionError("quota tree-id is immutable")
+        if old.is_parent != quota.is_parent:
+            if old.is_parent and self._quota_children(old.meta.name):
+                raise AdmissionError(
+                    "quota has children; isParent cannot become false")
+            from koordinator_tpu.client.store import KIND_POD
+
+            # a pod binds to the quota either by explicit label or by the
+            # namespace-default rule (see mutate_pod_quota_tree_affinity)
+            if quota.is_parent and any(
+                (p.quota_name or p.meta.namespace) == old.meta.name
+                for p in self.store.list(KIND_POD)
+            ):
+                raise AdmissionError(
+                    "quota has bound pods; isParent cannot become true")
 
     def validate_elastic_quota_delete(self, quota: ElasticQuota) -> None:
         """Deletion guard (webhook/elasticquota): a parent group with child

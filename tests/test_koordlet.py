@@ -305,3 +305,40 @@ class TestInfraPieces:
         assert len(daemon.auditor) > 0
         events, _ = daemon.auditor.query()
         assert any(e.operation == "cgroup_write" for e in events)
+
+
+class TestHostApplicationAccounting:
+    def test_be_host_app_usage_not_suppressing(self, fs):
+        """A host application declared BE in NodeSLO must come out of the
+        non-BE side of the suppress formula (helpers/calculator.go
+        NonBEHostAppFilter): with 4 BE host-app cores in use, the BE share
+        grows by ~4 cores over the baseline min."""
+        from koordinator_tpu.koordlet import metriccache as mc
+        from koordinator_tpu.utils.cpuset import CPUSet
+
+        store = ObjectStore()
+        setup_node(store, fs)
+        slo = NodeSLO(
+            meta=ObjectMeta(name="node-0", namespace=""),
+            resource_used_threshold_with_be=ResourceThresholdStrategy(
+                enable=True, cpu_suppress_threshold_percent=65
+            ),
+        )
+        slo.extensions = {"hostApplications": [{"name": "hb", "qos": "BE"}]}
+        store.add(KIND_NODE_SLO, slo)
+        add_pod(store, fs, "ls", qos="LS", cpu_usage_us=0)
+        add_pod(store, fs, "be", qos="BE", cpu_usage_us=0)
+        be_rel = fs.config.qos_relative_path(sysutil.QOS_BESTEFFORT)
+        fs.set_cgroup(be_rel, sysutil.CPU_STAT, "usage_usec 0\n")
+        daemon = Daemon(store, "node-0", fs.config, report_interval_seconds=0)
+        daemon.run_once(now=NOW)
+        fs.set_proc("stat", "cpu  5000 0 5000 8000 0 0 0 0 0 0\n")
+        daemon.metric_cache.add_sample(
+            mc.HOST_APP_CPU_USAGE, 10.0, NOW + 10, app="hb")
+        daemon.run_once(now=NOW + 10)
+        raw = fs.get_cgroup(be_rel, sysutil.CPUSET_CPUS)
+        got = len(CPUSet.parse(raw))
+        # the fixture's node usage saturates (~16 cores busy), so without
+        # the host-app reclassification suppress floors at 2; moving 10
+        # cores of usage to the BE side yields 16*0.65 - (16-10) = 4.4 -> 5
+        assert 4 <= got <= 6

@@ -247,3 +247,48 @@ def test_terwayqos_disabled_removes_config(env):
     hooks.reconcile()
     assert not os.path.exists(node_path)
     assert not os.path.exists(pod_path)
+
+
+def test_hostapplication_bvt_written_per_declared_qos(env):
+    """NodeSLO hostApplications entries get groupidentity bvt on their own
+    cgroup dirs (hooks/groupidentity/rule.go getHostQOSBvtValue)."""
+    from koordinator_tpu.koordlet.util import system as sysutil
+
+    fs, store, informer, executor, cse, hooks = env
+    slo = NodeSLO(meta=ObjectMeta(name=NODE, namespace=""))
+    slo.extensions = {"hostApplications": [
+        {"name": "nginx", "cgroupPath": "host-latency-sensitive/nginx",
+         "qos": "LS"},
+        {"name": "batchd", "cgroupPath": "host-batch/batchd", "qos": "BE"},
+        {"name": "no-dir"},  # missing cgroupPath: skipped
+    ]}
+    store.add(KIND_NODE_SLO, slo)
+    fs.set_cgroup("host-latency-sensitive/nginx", sysutil.CPU_BVT_WARP_NS, "0")
+    fs.set_cgroup("host-batch/batchd", sysutil.CPU_BVT_WARP_NS, "0")
+    hooks.reconcile()
+    assert fs.get_cgroup("host-latency-sensitive/nginx",
+                         sysutil.CPU_BVT_WARP_NS) == "2"
+    assert fs.get_cgroup("host-batch/batchd",
+                         sysutil.CPU_BVT_WARP_NS) == "-1"
+
+
+def test_hostapplication_removed_entry_resets_bvt(env):
+    """Deleting a hostApplications entry must reset its bvt, or the removed
+    host app keeps preempting BE forever."""
+    from koordinator_tpu.koordlet.util import system as sysutil
+
+    fs, store, informer, executor, cse, hooks = env
+    slo = NodeSLO(meta=ObjectMeta(name=NODE, namespace=""))
+    slo.extensions = {"hostApplications": [
+        {"name": "nginx", "cgroupPath": "host-latency-sensitive/nginx",
+         "qos": "LS"}]}
+    store.add(KIND_NODE_SLO, slo)
+    fs.set_cgroup("host-latency-sensitive/nginx", sysutil.CPU_BVT_WARP_NS, "0")
+    hooks.reconcile()
+    assert fs.get_cgroup("host-latency-sensitive/nginx",
+                         sysutil.CPU_BVT_WARP_NS) == "2"
+    slo2 = NodeSLO(meta=ObjectMeta(name=NODE, namespace=""))
+    store.update(KIND_NODE_SLO, slo2)  # extension gone
+    hooks.reconcile()
+    assert fs.get_cgroup("host-latency-sensitive/nginx",
+                         sysutil.CPU_BVT_WARP_NS) == "0"

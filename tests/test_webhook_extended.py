@@ -192,3 +192,179 @@ class TestResourceVerifyAndQuotaDelete:
             assert node.allocatable.get(ResourceName.CPU) == 32_000
         finally:
             MANAGER_GATES.reset()
+
+
+def _quota(name, parent="", is_parent=False, min_rl=None, max_rl=None):
+    labels = {}
+    if parent:
+        labels[LABEL_QUOTA_PARENT] = parent
+    if is_parent:
+        labels[LABEL_QUOTA_IS_PARENT] = "true"
+    return ElasticQuota(meta=ObjectMeta(name=name, namespace="", labels=labels),
+                        min=min_rl or ResourceList(),
+                        max=max_rl or ResourceList())
+
+
+class TestQuotaTopologyChecks:
+    """quota_topology_check.go invariants: sibling/children min sums, max-key
+    subsetting, isParent flips."""
+
+    def _store_with_parent(self, parent_min=None, parent_max=None):
+        store = ObjectStore()
+        store.add(KIND_ELASTIC_QUOTA, _quota(
+            "parent", is_parent=True,
+            min_rl=parent_min or ResourceList.of(cpu=10_000),
+            max_rl=parent_max or ResourceList.of(cpu=20_000)))
+        return store, AdmissionServer(store)
+
+    def test_sibling_min_sum_exceeding_parent_min_rejected(self):
+        store, srv = self._store_with_parent()
+        store.add(KIND_ELASTIC_QUOTA, _quota(
+            "a", parent="parent", min_rl=ResourceList.of(cpu=7_000)))
+        ok = _quota("b", parent="parent", min_rl=ResourceList.of(cpu=3_000))
+        srv.validate_elastic_quota(ok)
+        bad = _quota("c", parent="parent", min_rl=ResourceList.of(cpu=4_000))
+        with pytest.raises(AdmissionError, match="sibling min"):
+            srv.validate_elastic_quota(bad)
+
+    def test_max_key_not_in_parent_rejected(self):
+        store, srv = self._store_with_parent()
+        bad = _quota("a", parent="parent",
+                     max_rl=ResourceList.of(cpu=1_000, memory=GIB))
+        with pytest.raises(AdmissionError, match="max keys"):
+            srv.validate_elastic_quota(bad)
+
+    def test_shrinking_min_below_children_sum_rejected(self):
+        store, srv = self._store_with_parent()
+        store.add(KIND_ELASTIC_QUOTA, _quota(
+            "a", parent="parent", min_rl=ResourceList.of(cpu=6_000)))
+        shrunk = _quota("parent", is_parent=True,
+                        min_rl=ResourceList.of(cpu=5_000),
+                        max_rl=ResourceList.of(cpu=20_000))
+        with pytest.raises(AdmissionError, match="children min"):
+            srv.validate_elastic_quota(shrunk)
+
+    def test_is_parent_flip_with_children_rejected(self):
+        store, srv = self._store_with_parent()
+        store.add(KIND_ELASTIC_QUOTA, _quota("a", parent="parent"))
+        now_leaf = _quota("parent", is_parent=False,
+                          min_rl=ResourceList.of(cpu=10_000))
+        old = _quota("parent", is_parent=True,
+                     min_rl=ResourceList.of(cpu=10_000))
+        with pytest.raises(AdmissionError, match="isParent"):
+            srv.validate_elastic_quota(now_leaf, old=old)
+
+    def test_is_parent_flip_with_bound_pods_rejected(self):
+        from koordinator_tpu.client.store import KIND_POD
+
+        store = ObjectStore()
+        store.add(KIND_ELASTIC_QUOTA, _quota("q"))
+        store.add(KIND_POD, Pod(meta=ObjectMeta(
+            name="p", labels={LABEL_QUOTA_NAME: "q"})))
+        srv = AdmissionServer(store)
+        flip = _quota("q", is_parent=True)
+        with pytest.raises(AdmissionError, match="bound pods"):
+            srv.validate_elastic_quota(flip, old=_quota("q"))
+
+    def test_child_min_key_absent_from_parent_min_rejected(self):
+        store, srv = self._store_with_parent()  # parent min has cpu only
+        bad = _quota("a", parent="parent",
+                     min_rl=ResourceList.of(memory=5 * GIB))
+        with pytest.raises(AdmissionError, match="sibling min"):
+            srv.validate_elastic_quota(bad)
+
+    def test_is_parent_flip_with_namespace_default_pods_rejected(self):
+        from koordinator_tpu.client.store import KIND_POD
+
+        store = ObjectStore()
+        store.add(KIND_ELASTIC_QUOTA, _quota("team-a"))
+        store.add(KIND_POD, Pod(meta=ObjectMeta(
+            name="p", namespace="team-a")))  # no quota label: ns default
+        srv = AdmissionServer(store)
+        with pytest.raises(AdmissionError, match="bound pods"):
+            srv.validate_elastic_quota(_quota("team-a", is_parent=True),
+                                       old=_quota("team-a"))
+
+
+class TestProfileMatching:
+    """cluster_colocation_profile.go namespaceSelector + Probability."""
+
+    def _store(self, probability=None, ns_selector=None, ns_labels=None):
+        from koordinator_tpu.api.objects import (
+            ClusterColocationProfile,
+            Namespace,
+        )
+        from koordinator_tpu.client.store import (
+            KIND_COLOCATION_PROFILE,
+            KIND_NAMESPACE,
+        )
+
+        store = ObjectStore()
+        store.add(KIND_COLOCATION_PROFILE, ClusterColocationProfile(
+            meta=ObjectMeta(name="profile", namespace=""),
+            namespace_selector=ns_selector or {},
+            probability=probability,
+            labels={"injected": "yes"}))
+        if ns_labels is not None:
+            store.add(KIND_NAMESPACE, Namespace(
+                meta=ObjectMeta(name="team-a", namespace="",
+                                labels=ns_labels)))
+        return store, AdmissionServer(store)
+
+    def test_namespace_selector_matches(self):
+        store, srv = self._store(ns_selector={"env": "prod"},
+                                 ns_labels={"env": "prod"})
+        pod = Pod(meta=ObjectMeta(name="p", namespace="team-a"))
+        srv.mutate_pod(pod)
+        assert pod.meta.labels.get("injected") == "yes"
+
+    def test_namespace_selector_mismatch_skips(self):
+        store, srv = self._store(ns_selector={"env": "prod"},
+                                 ns_labels={"env": "dev"})
+        pod = Pod(meta=ObjectMeta(name="p", namespace="team-a"))
+        srv.mutate_pod(pod)
+        assert "injected" not in pod.meta.labels
+
+    def test_missing_namespace_object_skips(self):
+        store, srv = self._store(ns_selector={"env": "prod"})
+        pod = Pod(meta=ObjectMeta(name="p", namespace="team-a"))
+        srv.mutate_pod(pod)
+        assert "injected" not in pod.meta.labels
+
+    def test_probability_zero_always_skips(self):
+        store, srv = self._store(probability=0)
+        pod = Pod(meta=ObjectMeta(name="p"))
+        srv.mutate_pod(pod)
+        assert "injected" not in pod.meta.labels
+
+    def test_probability_hundred_always_applies(self):
+        store, srv = self._store(probability=100)
+        pod = Pod(meta=ObjectMeta(name="p"))
+        srv.mutate_pod(pod)
+        assert pod.meta.labels.get("injected") == "yes"
+
+    def test_probability_draw_uses_injected_rand(self):
+        import koordinator_tpu.webhook.server as websrv
+
+        store, srv = self._store(probability=50)
+        try:
+            websrv._rand_intn = lambda n: 99  # above percent -> skip
+            pod = Pod(meta=ObjectMeta(name="p"))
+            srv.mutate_pod(pod)
+            assert "injected" not in pod.meta.labels
+            websrv._rand_intn = lambda n: 10  # below percent -> apply
+            pod2 = Pod(meta=ObjectMeta(name="p2"))
+            srv.mutate_pod(pod2)
+            assert pod2.meta.labels.get("injected") == "yes"
+        finally:
+            websrv._rand_intn = None
+
+    def test_reserve_pod_annotation_forbidden(self):
+        from koordinator_tpu.api.objects import ANNOTATION_RESERVE_POD
+
+        srv = AdmissionServer(ObjectStore())
+        pod = Pod(meta=ObjectMeta(
+            name="p", labels={LABEL_POD_QOS: "LS"},
+            annotations={ANNOTATION_RESERVE_POD: "true"}))
+        with pytest.raises(AdmissionError, match="cannot be set"):
+            srv.validate_pod(pod)
