@@ -52,9 +52,10 @@ class FullChainInputs(NamedTuple):
     needs_bind: jnp.ndarray     # [P] bool — requires cpuset binding
     cores_needed: jnp.ndarray   # [P] float — whole cpus for cpuset pods
     full_pcpus: jnp.ndarray     # [P] bool — resolved FullPCPUs policy
-    pod_taint_mask: jnp.ndarray  # [P] f32 bitmask of tolerated taint groups
+    pod_taint_mask: jnp.ndarray  # [P] f32 bitmask of admitted node groups
+    #     (taints tolerated AND nodeSelector satisfied — ops/taints.py)
     # nodes
-    node_taint_group: jnp.ndarray  # [N] int32 taint-set group (ops/taints.py)
+    node_taint_group: jnp.ndarray  # [N] int32 admission-signature group
     numa_free: jnp.ndarray      # [N, K, R]
     numa_capacity: jnp.ndarray  # [N, K, R]
     numa_policy: jnp.ndarray    # [N] int32

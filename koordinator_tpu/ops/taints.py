@@ -1,20 +1,23 @@
-"""Taint/toleration admission, batched.
+"""Node-admission (taint/toleration + nodeSelector) factorization, batched.
 
-The kube-scheduler's TaintToleration plugin (a vendored default in the
-reference's scheduler binary) rejects nodes whose NoSchedule taints the pod
-does not tolerate. Per-(pod, node) set checks don't batch, so the snapshot
-factorizes them: distinct node taint-SETS get small group ids (real clusters
-have a handful), each node carries its group id [N], and each pod carries a
-bitmask of tolerated groups [P]. The kernel check collapses to one
-elementwise bit test: ``(pod_mask >> node_group) & 1``.
+The kube-scheduler's TaintToleration and NodeAffinity plugins (vendored
+defaults in the reference's scheduler binary) reject nodes whose NoSchedule
+taints the pod does not tolerate or whose labels don't satisfy the pod's
+nodeSelector. Per-(pod, node) set checks don't batch, so the snapshot
+factorizes them: nodes with the same ADMISSION SIGNATURE — their taint set
+plus their labels projected onto the selector keys the pending batch uses —
+share a small group id (real clusters have a handful of signatures), each
+node carries its group id [N], and each pod carries a bitmask of admitted
+groups [P] (groups whose taints it tolerates AND whose labels satisfy its
+nodeSelector). The kernel check collapses to one elementwise bit test:
+``(pod_mask >> node_group) & 1``.
 
 Masks are stored as float32 (exact for < 2^24) so the Pallas kernel can do
 the bit test with floor/mod arithmetic — Mosaic lowers those everywhere,
-unlike shift-by-vector. Group 0 is the empty taint set (always tolerated);
-group ``MAX_TAINT_GROUPS - 1`` is the overflow bucket for clusters with more
-distinct taint sets than bits — no pod ever tolerates it (conservative: the
-scheduler refuses placements it cannot prove, never the reverse).
-"""
+unlike shift-by-vector. Group ``MAX_TAINT_GROUPS - 1`` is the overflow
+bucket for clusters with more distinct signatures than bits — no pod ever
+admits it (conservative: the scheduler refuses placements it cannot prove,
+never the reverse)."""
 
 from __future__ import annotations
 
@@ -38,39 +41,103 @@ def tolerates_taints(tolerations: Sequence[Tuple[str, str]],
     )
 
 
-def group_node_taints(nodes) -> Tuple[np.ndarray, List[frozenset]]:
-    """(group_id [len(nodes)] int32, group taint-sets). Group 0 is the empty
-    set; sets beyond the bit budget collapse into the overflow group."""
-    sets: List[frozenset] = [frozenset()]
-    ids = {frozenset(): 0}
+def selector_pairs_of(pods) -> frozenset:
+    """The distinct (key, value) nodeSelector PAIRS the pending batch uses.
+    Signatures are built from pair-match booleans, not raw label values, so
+    a high-cardinality key (kubernetes.io/hostname) contributes one bit per
+    PIN, not one signature per node: 5k hostnames with one pinned pod split
+    the cluster into 2 groups (the pinned node, everyone else), where a
+    value-projection signature would fragment all 5k nodes."""
+    pairs = set()
+    for pod in pods:
+        pairs.update(pod.spec.node_selector.items())
+    return frozenset(pairs)
+
+
+_UNKNOWN = object()  # bucket marker: label matches not encoded for this group
+
+
+def group_node_admission(
+    nodes, selector_pairs: frozenset = frozenset()
+) -> Tuple[np.ndarray, List[Tuple[frozenset, object]]]:
+    """(group_id [len(nodes)] int32, group signatures). A signature is
+    (taint set, frozenset of batch selector pairs the node's labels match).
+    When the bit budget runs out, a node degrades to its per-taint-set
+    LABEL-UNKNOWN bucket — still exact for selector-less pods (their
+    admission never depends on labels) and conservative (never admitted)
+    for selector pods. Only if even those buckets exhaust the budget does a
+    node land in the final overflow group, which admits nobody — the same
+    stance the taint-only grouping always had."""
     overflow = MAX_TAINT_GROUPS - 1
     out = np.zeros(len(nodes), np.int32)
+    pairs = sorted(selector_pairs)
+
+    # pass 1: per-node exact signature + frequency
+    node_sigs: List[Tuple[frozenset, frozenset]] = []
+    counts: dict = {}
+    first_seen: dict = {}
+    taint_sets: List[frozenset] = []
     for i, node in enumerate(nodes):
-        key = frozenset(node.taints)
-        gid = ids.get(key)
-        if gid is None:
-            if len(sets) < overflow:
-                gid = len(sets)
-                ids[key] = gid
-                sets.append(key)
-            else:
+        labels = node.meta.labels
+        taints = frozenset(node.taints)
+        matched = frozenset((k, v) for k, v in pairs if labels.get(k) == v)
+        sig = (taints, matched)
+        node_sigs.append(sig)
+        counts[sig] = counts.get(sig, 0) + 1
+        if sig not in first_seen:
+            first_seen[sig] = i
+        if taints not in taint_sets:
+            taint_sets.append(taints)
+
+    # pass 2: exact signatures get the budget minus a reserved slot per
+    # taint set (so a label-unknown bucket can ALWAYS be interned when an
+    # exact signature overflows — without the reservation the unknown
+    # buckets themselves would overflow); most-common signatures first
+    sigs: List[Tuple[frozenset, object]] = []
+    ids: dict = {}
+    exact_budget = max(overflow - min(len(taint_sets), overflow), 0)
+    for sig in sorted(counts, key=lambda s: (-counts[s], first_seen[s])):
+        if len(ids) >= exact_budget:
+            break
+        ids[sig] = len(sigs)
+        sigs.append(sig)
+
+    for i, node in enumerate(nodes):
+        sig = node_sigs[i]
+        gid = ids.get(sig)
+        if gid is None:  # degrade: label-unknown bucket for this taint set
+            key = (sig[0], _UNKNOWN)
+            gid = ids.get(key)
+            if gid is None and len(sigs) < overflow:
+                gid = ids[key] = len(sigs)
+                sigs.append(key)
+            if gid is None:
                 gid = overflow
                 logger.warning(
-                    "taint-set bit budget exceeded: node %s's taints %s "
-                    "fall into the overflow group and NO pod will schedule "
-                    "there (max %d distinct sets)",
-                    node.meta.name, sorted(key), overflow,
+                    "admission-signature bit budget exceeded: node %s "
+                    "(taints %s) falls into the overflow group and NO pod "
+                    "will schedule there (max %d distinct signatures)",
+                    node.meta.name, sorted(sig[0]), overflow,
                 )
         out[i] = gid
-    return out, sets
+    return out, sigs
 
 
-def toleration_mask(pod, group_sets: List[frozenset]) -> float:
-    """Bitmask (as an exact float32 integer) of the groups this pod's
-    tolerations cover. The overflow group's bit is never set."""
+def admission_mask(pod, groups: List[Tuple[frozenset, object]]) -> float:
+    """Bitmask (as an exact float32 integer) of the node groups this pod may
+    land on: taints tolerated AND every nodeSelector pair in the group's
+    matched set. Label-unknown buckets admit only selector-less pods; the
+    overflow group's bit is never set."""
     mask = 0
     tolerations = pod.spec.tolerations
-    for gid, taints in enumerate(group_sets):
-        if not taints or tolerates_taints(tolerations, taints):
-            mask |= 1 << gid
+    selector = frozenset(pod.spec.node_selector.items())
+    for gid, (taints, matched) in enumerate(groups):
+        if taints and not tolerates_taints(tolerations, taints):
+            continue
+        if matched is _UNKNOWN:
+            if selector:
+                continue
+        elif not selector <= matched:
+            continue
+        mask |= 1 << gid
     return float(mask)

@@ -31,7 +31,11 @@ from koordinator_tpu.models.scheduler_model import make_inputs
 from koordinator_tpu.ops.loadaware import LoadAwareArgs, build_loadaware_node_state
 from koordinator_tpu.ops.numa import MAX_NUMA, POLICY_BY_NAME, POLICY_NONE
 from koordinator_tpu.ops.packing import NodeBatch, PodBatch, pack_nodes, pack_pods
-from koordinator_tpu.ops.taints import group_node_taints, toleration_mask
+from koordinator_tpu.ops.taints import (
+    admission_mask,
+    group_node_admission,
+    selector_pairs_of,
+)
 from koordinator_tpu.ops.quota import (
     MAX_QUOTA_DEPTH,
     QuotaTreeArrays,
@@ -219,16 +223,20 @@ def build_full_chain_inputs(
     cores_needed = np.zeros(P, np.float32)
     full_pcpus = np.zeros(P, bool)
     needs_numa = np.zeros(P, bool)
-    pod_taint_mask = np.ones(P, np.float32)  # padding tolerates group 0
-    # taint factorization (ops/taints.py): node taint-sets -> group ids,
-    # pod tolerations -> group bitmasks
-    node_taint_ids, taint_sets = group_node_taints(state.nodes)
+    pod_taint_mask = np.ones(P, np.float32)  # padding admits group 0
+    # admission factorization (ops/taints.py): node (taint set, matched
+    # selector pairs) signatures -> group ids, pod tolerations +
+    # nodeSelector -> group bitmasks. This is how TaintToleration AND
+    # NodeAffinity (nodeSelector) batch into one bit test.
+    sel_pairs = selector_pairs_of(pods_by_key_pending.values())
+    node_taint_ids, admission_groups = group_node_admission(
+        state.nodes, sel_pairs)
     for i, key in enumerate(pods.keys):
         pod = pods_by_key_pending[key]
         nb, cn, fp = _pod_cpuset_flags(pod)
         needs_bind[i], cores_needed[i], full_pcpus[i] = nb, cn, fp
         needs_numa[i] = bool(pod.spec.requests)
-        pod_taint_mask[i] = toleration_mask(pod, taint_sets)
+        pod_taint_mask[i] = admission_mask(pod, admission_groups)
         q = pod.quota_name
         if q:  # quota ids resolve only after the tree exists
             pods.quota_id[i] = quota_ids.get(q, -1)

@@ -102,15 +102,16 @@ class AdmissionServer:
         cannot match a non-empty selector."""
         from koordinator_tpu.client.store import KIND_NAMESPACE
 
-        for ns in self.store.list(KIND_NAMESPACE):
-            if ns.meta.name == namespace:
-                return all(ns.meta.labels.get(k) == v
-                           for k, v in selector.items())
-        return False
+        ns = self.store.get(KIND_NAMESPACE, f"/{namespace}")
+        if ns is None:
+            return False
+        return all(ns.meta.labels.get(k) == v for k, v in selector.items())
 
     def _probability_skips(self, profile: ClusterColocationProfile) -> bool:
         """Percent-based sampling (cluster_colocation_profile.go:147-154):
-        skip when percent == 0, apply when 100, else draw."""
+        skip when percent == 0, apply when 100, else draw. The strict `>`
+        mirrors the reference exactly — including its bias of applying on
+        draws 0..percent, i.e. (percent+1)% of pods for 0 < percent < 100."""
         percent = profile.probability
         if percent is None:
             return False
@@ -316,9 +317,11 @@ class AdmissionServer:
             from koordinator_tpu.client.store import KIND_POD
 
             # a pod binds to the quota either by explicit label or by the
-            # namespace-default rule (see mutate_pod_quota_tree_affinity)
+            # namespace-default rule (see mutate_pod_quota_tree_affinity);
+            # terminated pods no longer hold quota and must not block
             if quota.is_parent and any(
                 (p.quota_name or p.meta.namespace) == old.meta.name
+                and not p.is_terminated
                 for p in self.store.list(KIND_POD)
             ):
                 raise AdmissionError(

@@ -174,3 +174,49 @@ def test_full_chain_with_taints():
     if native_floor.available() or native_floor.build():
         chosen_native = native_floor.serial_schedule_full_native(fc, args)
         np.testing.assert_array_equal(chosen_serial, chosen_native)
+
+
+def test_full_chain_with_node_selector():
+    """NodeAffinity (nodeSelector) rides the admission-group bit test: pods
+    with a selector bind only to label-matching nodes, bit-identically in
+    kernel, oracle, and the C++ floor."""
+    from koordinator_tpu.native import floor as native_floor
+
+    args = LoadAwareArgs()
+    cluster, state = synth_full_cluster(24, 60, seed=33)
+    # carve the cluster into two label pools and pin a third of the pods
+    for j, node in enumerate(state.nodes):
+        node.meta.labels["pool"] = "gold" if j % 3 == 0 else "silver"
+    pending = state.pending_pods
+    for i, pod in enumerate(pending):
+        if i % 3 == 0:
+            pod.spec.node_selector["pool"] = "gold"
+    fc, pods, nodes, tree, gang_index, ng, ngroups = build_full_chain_inputs(
+        state, args
+    )
+    step = build_full_chain_step(args, ng, ngroups)
+    chosen_tpu = np.asarray(step(fc)[0])
+    chosen_serial = serial_schedule_full(fc, args)
+    diffs = diff_bindings(
+        chosen_serial[: len(pods.keys)], chosen_tpu[: len(pods.keys)],
+        pods.keys,
+    )
+    assert not diffs, f"{len(diffs)} mismatches: {diffs[:10]}"
+
+    pods_by_key = {p.meta.key: p for p in pending}
+    selector_placements = 0
+    for i, key in enumerate(pods.keys):
+        n = chosen_tpu[i]
+        if n < 0:
+            continue
+        pod = pods_by_key[key]
+        node = state.nodes[n]
+        for k, v in pod.spec.node_selector.items():
+            assert node.meta.labels.get(k) == v, (key, node.meta.name)
+        if pod.spec.node_selector:
+            selector_placements += 1
+    assert selector_placements > 0, "no selector pod was placed"
+
+    if native_floor.available() or native_floor.build():
+        chosen_native = native_floor.serial_schedule_full_native(fc, args)
+        np.testing.assert_array_equal(chosen_serial, chosen_native)

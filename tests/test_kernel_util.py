@@ -190,3 +190,77 @@ class TestCgroupDriver:
             os.path.join(cfg.cgroup_root_dir, "cgroup.controllers"),
             "cpu io memory")
         assert sysutil.detect_cgroup_version(cfg)
+
+
+class TestAdmissionGrouping:
+    """ops/taints.py pair-based admission signatures: high-cardinality keys
+    must not fragment the cluster, and budget exhaustion must never hurt
+    selector-less pods."""
+
+    def _mk_node(self, name, labels=None, taints=()):
+        from koordinator_tpu.api.objects import Node, ObjectMeta
+
+        n = Node(meta=ObjectMeta(name=name, namespace="",
+                                 labels=dict(labels or {})))
+        n.taints = list(taints)
+        return n
+
+    def _mk_pod(self, name, selector=None):
+        from koordinator_tpu.api.objects import ObjectMeta, Pod, PodSpec
+
+        return Pod(meta=ObjectMeta(name=name),
+                   spec=PodSpec(node_selector=dict(selector or {})))
+
+    def test_hostname_pin_splits_two_groups(self):
+        from koordinator_tpu.ops.taints import (
+            admission_mask,
+            group_node_admission,
+            selector_pairs_of,
+        )
+
+        nodes = [self._mk_node(f"n{i}", {"kubernetes.io/hostname": f"n{i}"})
+                 for i in range(200)]
+        pinned = self._mk_pod("p", {"kubernetes.io/hostname": "n7"})
+        free = self._mk_pod("q")
+        pairs = selector_pairs_of([pinned, free])
+        ids, groups = group_node_admission(nodes, pairs)
+        assert len(groups) == 2  # pinned node vs everyone else — no 200-way
+        pin_mask = int(admission_mask(pinned, groups))
+        free_mask = int(admission_mask(free, groups))
+        for i, node in enumerate(nodes):
+            pin_ok = bool((pin_mask >> ids[i]) & 1)
+            assert pin_ok == (node.meta.name == "n7")
+            assert (free_mask >> ids[i]) & 1  # selector-less: everywhere
+
+    def test_budget_exhaustion_spares_selectorless_pods(self):
+        from koordinator_tpu.ops.taints import (
+            MAX_TAINT_GROUPS,
+            admission_mask,
+            group_node_admission,
+            selector_pairs_of,
+        )
+
+        n = MAX_TAINT_GROUPS + 10
+        nodes = [self._mk_node(f"n{i}", {"host": f"n{i}"}) for i in range(n)]
+        pods = [self._mk_pod(f"p{i}", {"host": f"n{i}"}) for i in range(n)]
+        free = self._mk_pod("free")
+        pairs = selector_pairs_of(pods + [free])
+        ids, groups = group_node_admission(nodes, pairs)
+        assert len(groups) <= MAX_TAINT_GROUPS - 1
+        free_mask = int(admission_mask(free, groups))
+        placeable = unplaceable = 0
+        for i, pod in enumerate(pods):
+            mask = int(admission_mask(pod, groups))
+            ok = bool((mask >> ids[i]) & 1)
+            # a pinned pod is either exactly placeable on its node or
+            # conservatively unschedulable (label-unknown bucket) — never
+            # admitted to a WRONG node
+            for j in range(n):
+                if (mask >> ids[j]) & 1:
+                    assert nodes[j].meta.labels["host"] == f"n{i}"
+            placeable += ok
+            unplaceable += not ok
+        assert placeable > 0 and unplaceable > 0  # degrade path exercised
+        # selector-less pods keep the WHOLE cluster, unknown buckets included
+        for j in range(n):
+            assert (free_mask >> ids[j]) & 1
