@@ -75,6 +75,21 @@ class ObjectMeta:
 
 
 @dataclass
+class PodAffinityTerm:
+    """requiredDuringSchedulingIgnoredDuringExecution inter-pod (anti-)
+    affinity term: pods matching `selector` within the `topology_key`
+    domain of a candidate node (core/v1 PodAffinityTerm, matchLabels
+    form — the form the vendored kube-scheduler InterPodAffinity plugin
+    evaluates in Filter)."""
+
+    selector: Dict[str, str] = field(default_factory=dict)
+    topology_key: str = "kubernetes.io/hostname"
+    # namespaces the selector applies to; empty means the OWNING pod's own
+    # namespace (core/v1 PodAffinityTerm.namespaces default)
+    namespaces: List[str] = field(default_factory=list)
+
+
+@dataclass
 class PodSpec:
     node_name: str = ""
     scheduler_name: str = "koord-scheduler"
@@ -84,6 +99,8 @@ class PodSpec:
     limits: ResourceList = field(default_factory=ResourceList)
     node_selector: Dict[str, str] = field(default_factory=dict)
     affinity_required_node_labels: Dict[str, str] = field(default_factory=dict)
+    pod_affinity: List["PodAffinityTerm"] = field(default_factory=list)
+    pod_anti_affinity: List["PodAffinityTerm"] = field(default_factory=list)
     tolerations: List[Tuple[str, str]] = field(default_factory=list)  # (key, value)
     overhead: ResourceList = field(default_factory=ResourceList)
     restart_policy: str = "Always"
@@ -142,6 +159,8 @@ class Pod:
                 affinity_required_node_labels=dict(
                     spec.affinity_required_node_labels
                 ),
+                pod_affinity=list(spec.pod_affinity),
+                pod_anti_affinity=list(spec.pod_anti_affinity),
                 tolerations=list(spec.tolerations),
                 overhead=spec.overhead.copy(),
             ),

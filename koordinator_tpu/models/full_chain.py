@@ -53,9 +53,17 @@ class FullChainInputs(NamedTuple):
     cores_needed: jnp.ndarray   # [P] float — whole cpus for cpuset pods
     full_pcpus: jnp.ndarray     # [P] bool — resolved FullPCPUs policy
     pod_taint_mask: jnp.ndarray  # [P] f32 bitmask of admitted node groups
-    #     (taints tolerated AND nodeSelector satisfied — ops/taints.py)
+    #     (taints tolerated AND node selector/affinity satisfied —
+    #     ops/taints.py)
+    pod_aff_req: jnp.ndarray    # [P, T] bool — required pod-affinity terms
+    pod_anti_req: jnp.ndarray   # [P, T] bool — required anti-affinity terms
+    pod_aff_match: jnp.ndarray  # [P, T] bool — pod's labels match term
     # nodes
     node_taint_group: jnp.ndarray  # [N] int32 admission-signature group
+    aff_dom: jnp.ndarray        # [N, T] f32 topology domain id (-1 invalid)
+    aff_count: jnp.ndarray      # [N, T] f32 matching pods in n's domain
+    aff_exists: jnp.ndarray     # [T] bool — any matching pod anywhere
+    #     (domain-labeled or not; drives the first-replica bootstrap)
     numa_free: jnp.ndarray      # [N, K, R]
     numa_capacity: jnp.ndarray  # [N, K, R]
     numa_policy: jnp.ndarray    # [N] int32
@@ -105,8 +113,10 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode):
         fc.gang_id >= 0, fc.gang_valid[jnp.maximum(fc.gang_id, 0)], True
     )
 
+    T = fc.aff_dom.shape[1]
+
     def evaluate(i, requested, delta_np, delta_pr, numa_free, bind_free,
-                 quota_used):
+                 quota_used, aff_count, aff_exists):
         req_fit = inputs.fit_requests[i]
         req = fc.requests[i]
         est = inputs.estimated[i]
@@ -136,9 +146,22 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode):
             )
             & 1
         ) == 1
+        # InterPodAffinity (vendored default plugin, ops/podaffinity.py):
+        # every required anti term has a zero count in the node's domain;
+        # every required affinity term has a match in a VALID domain, or
+        # bootstraps (self-match with no matching pod anywhere)
+        affinity_ok = jnp.ones(aff_count.shape[0], bool)
+        for t in range(T):
+            count_t = aff_count[:, t]
+            dom_valid = fc.aff_dom[:, t] >= 0
+            anti_ok = ~fc.pod_anti_req[i, t] | (count_t <= 0)
+            bootstrap = fc.pod_aff_match[i, t] & ~aff_exists[t]
+            aff_ok = ~fc.pod_aff_req[i, t] | (
+                dom_valid & (count_t > 0)) | bootstrap
+            affinity_ok = affinity_ok & anti_ok & aff_ok
         feasible = (
             inputs.node_ok & fit & la_ok & cpuset_ok & numa_ok & taint_ok
-            & admit
+            & affinity_ok & admit
         )
 
         # ---- Score chain (equal plugin weights, each already 0..100)
@@ -177,9 +200,11 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
         N = inputs.allocatable.shape[0]
         evaluate = make_pod_evaluator(fc, weight_idx, prod_mode)
 
+        T = fc.aff_dom.shape[1]
+
         def body(i, state):
             (requested, delta_np, delta_pr, numa_free, bind_free,
-             quota_used, chosen) = state
+             quota_used, aff_count, aff_exists, chosen) = state
             req_fit = inputs.fit_requests[i]
             req = fc.requests[i]
             est = inputs.estimated[i]
@@ -187,7 +212,7 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
 
             found, best, zone_at_best, _admit = evaluate(
                 i, requested, delta_np, delta_pr, numa_free, bind_free,
-                quota_used,
+                quota_used, aff_count, aff_exists,
             )
             fnd = found.astype(jnp.float32)
 
@@ -213,9 +238,19 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
             quota_used = quota_used_add_row(
                 quota_used, req, fc.quota_id[i], fc.quota_ancestors, found
             )
+            # inter-pod affinity: the placed pod raises the count of every
+            # term it matches across the chosen node's whole domain, and
+            # flips the term's exists flag even on an unlabeled node
+            for t in range(T):
+                chosen_dom = fc.aff_dom[best, t]
+                inc = (found & fc.pod_aff_match[i, t] & (chosen_dom >= 0)
+                       & (fc.aff_dom[:, t] == chosen_dom))
+                aff_count = aff_count.at[:, t].add(inc.astype(jnp.float32))
+                aff_exists = aff_exists.at[t].set(
+                    aff_exists[t] | (found & fc.pod_aff_match[i, t]))
             chosen = chosen.at[i].set(jnp.where(found, best.astype(jnp.int32), -1))
             return (requested, delta_np, delta_pr, numa_free, bind_free,
-                    quota_used, chosen)
+                    quota_used, aff_count, aff_exists, chosen)
 
         R = inputs.fit_requests.shape[-1]
         init = (
@@ -225,9 +260,11 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
             fc.numa_free,
             fc.bind_free,
             fc.quota_used,
+            fc.aff_count,
+            jnp.asarray(fc.aff_exists, bool),
             jnp.full(P, -1, jnp.int32),
         )
-        (requested, _, _, _, _, quota_used, chosen) = jax.lax.fori_loop(
+        (requested, _, _, _, _, quota_used, _, _, chosen) = jax.lax.fori_loop(
             0, P, body, init
         )
 
@@ -304,7 +341,8 @@ def build_best_full_chain_step(args: LoadAwareArgs, num_gangs: int,
         N = fc.base.allocatable.shape[0]
         K = fc.numa_free.shape[1]
         G = fc.quota_used.shape[0]
-        if estimate_vmem_bytes(N, R, K, G, P) <= budget:
+        T = fc.aff_dom.shape[1]
+        if estimate_vmem_bytes(N, R, K, G, P, T) <= budget:
             step.last_backend = "pallas"
             return pallas_step(fc)
         step.last_backend = "xla"

@@ -82,16 +82,19 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
         def cond(state):
             return state[-1] < P
 
+        T = fc.aff_dom.shape[1]
+
         def wave_body(state):
             (requested, delta_np, delta_pr, numa_free, bind_free,
-             quota_used, chosen, pos) = state
+             quota_used, aff_count, aff_exists, chosen, pos) = state
             idx = pos + warange
             valid_w = idx < P
             idxc = jnp.minimum(idx, P - 1)
 
             found_w, best_w, zone_w, admit_w = jax.vmap(
                 lambda i: evaluate(i, requested, delta_np, delta_pr,
-                                   numa_free, bind_free, quota_used)
+                                   numa_free, bind_free, quota_used,
+                                   aff_count, aff_exists)
             )(idxc)
             found_w = found_w & valid_w
 
@@ -129,7 +132,26 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                 )[:, 0] > 0.5
             )
 
-            conflict_w = quota_flip_w | node_coll_w
+            # ---- affinity conflict: an earlier in-wave pod MATCHING a term
+            # this pod REQUIRES changes the term's counts, so the frozen
+            # evaluation may diverge from serial. Anti terms only decay
+            # (found -> infeasible), so only found pods conflict; required
+            # affinity can FLIP INFEASIBLE -> FEASIBLE (non-monotone), so
+            # any pod carrying the term conflicts once a match committed.
+            if T:
+                match_w = (fc.pod_aff_match[idxc]
+                           & found_w[:, None])                     # [W, T]
+                matched_before = (jnp.cumsum(
+                    match_w.astype(jnp.float32), axis=0) - match_w) > 0.5
+                anti_conf = found_w & jnp.any(
+                    fc.pod_anti_req[idxc] & matched_before, axis=1)
+                aff_conf = jnp.any(
+                    fc.pod_aff_req[idxc] & matched_before, axis=1) & valid_w
+                affinity_conf_w = anti_conf | aff_conf
+            else:
+                affinity_conf_w = jnp.zeros_like(found_w)
+
+            conflict_w = quota_flip_w | node_coll_w | affinity_conf_w
             cut = jnp.where(
                 conflict_w.any(), jnp.argmax(conflict_w), W
             ).astype(jnp.int32)
@@ -175,11 +197,26 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             )
             quota_used = quota_used + committed_total
 
+            # affinity commit: every committed pod raises its matched terms'
+            # counts over the chosen node's whole domain (exact: 0/1
+            # indicator matmul at HIGHEST precision on small integers)
+            for t in range(T):
+                dom_col = fc.aff_dom[:, t]                         # [N]
+                chosen_dom_w = dom_col[best_w]                     # [W]
+                inc_w = (cm * fc.pod_aff_match[idxc, t]
+                         * (chosen_dom_w >= 0))                    # [W]
+                eq = (dom_col[None, :] == chosen_dom_w[:, None]
+                      ).astype(jnp.float32)                        # [W, N]
+                aff_count = aff_count.at[:, t].add(mm(inc_w[None, :], eq)[0])
+                aff_exists = aff_exists.at[t].set(
+                    aff_exists[t]
+                    | jnp.any(commit_w & fc.pod_aff_match[idxc, t]))
+
             value_w = jnp.where(found_w, best_w.astype(jnp.int32), -1)
             chosen_idx = jnp.where((warange < cut) & valid_w, idx, P)
             chosen = chosen.at[chosen_idx].set(value_w, mode="drop")
             return (requested, delta_np, delta_pr, numa_free, bind_free,
-                    quota_used, chosen, pos + cut)
+                    quota_used, aff_count, aff_exists, chosen, pos + cut)
 
         init = (
             inputs.requested,
@@ -188,12 +225,13 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             fc.numa_free,
             fc.bind_free,
             fc.quota_used,
+            fc.aff_count,
+            jnp.asarray(fc.aff_exists, bool),
             jnp.full(P, -1, jnp.int32),
             jnp.int32(0),
         )
-        (requested, _, _, _, _, quota_used, chosen, _pos) = jax.lax.while_loop(
-            cond, wave_body, init
-        )
+        (requested, _, _, _, _, quota_used, _, _, chosen,
+         _pos) = jax.lax.while_loop(cond, wave_body, init)
 
         # ---- Permit barrier (gang group all-or-nothing)
         keep = gang_permit_mask(

@@ -53,17 +53,18 @@ def _load() -> Optional[ctypes.CDLL]:
     # would read every pointer after the insertion shifted
     try:
         lib.koord_floor_abi_version.restype = ctypes.c_int
-        if lib.koord_floor_abi_version() != 2:
+        if lib.koord_floor_abi_version() != 4:
             return None
     except AttributeError:
         return None
     lib.koord_serial_full_chain.restype = None
     lib.koord_serial_full_chain.argtypes = (
-        [ctypes.c_int] * 8           # P R N K G A NG prod_mode
+        [ctypes.c_int] * 9           # P R N K G A NG T prod_mode
         + [_F32P] * 3                # fit_requests requests estimated
         + [_I32P] * 7                # is_prod..needs_bind
         + [_F32P] + [_I32P]          # cores_needed full_pcpus
         + [_I32P]                    # pod_taint_mask
+        + [_I32P] * 3                # pod_aff_req pod_anti_req pod_aff_match
         + [_F32P, _F32P] + [_I32P]   # allocatable requested node_ok
         + [_F32P] + [_I32P]          # filter_usage has_filter_usage
         + [_F32P] * 5                # filter_thr prod_thr prod_usage term_np term_pr
@@ -72,6 +73,8 @@ def _load() -> Optional[ctypes.CDLL]:
         + [_F32P] + [_I32P] * 2      # numa_free numa_policy has_topology
         + [_F32P] * 2                # bind_free cpus_per_core
         + [_I32P]                    # node_taint_group
+        + [_F32P] * 2                # aff_dom aff_count
+        + [_I32P]                    # aff_exists
         + [_I32P] + [_F32P] * 2      # ancestors quota_used quota_runtime
         + [_I32P] + [_F32P] * 2      # gang_valid gang_min gang_assumed
         + [_I32P, ctypes.c_int]      # gang_group num_groups
@@ -115,10 +118,17 @@ def serial_schedule_full_native(fc, args, num_groups: int = 0) -> np.ndarray:
     NG = gang_min.shape[0]
     gang_group = _i32(fc.gang_group_id)
     n_groups = int(num_groups or (int(gang_group.max()) + 1 if NG else 0))
+    T = int(np.asarray(fc.aff_dom).shape[1])
+    pow_t = (1 << np.arange(max(T, 1), dtype=np.int64))[:T]
+
+    def term_mask(rows) -> np.ndarray:  # [P, T] bool -> [P] int32 bitmask
+        if not T:
+            return np.zeros(P, np.int32)
+        return _i32((np.asarray(rows, bool) * pow_t[None, :]).sum(axis=1))
 
     chosen = np.full(P, -1, np.int32)
     lib.koord_serial_full_chain(
-        P, R, N, K, max(G, 0), A, NG,
+        P, R, N, K, max(G, 0), A, NG, T,
         1 if args.score_according_prod_usage else 0,
         fit_requests, _f32(fc.requests), _f32(inputs.estimated),
         _i32(inputs.is_prod), _i32(inputs.is_daemonset),
@@ -126,6 +136,8 @@ def serial_schedule_full_native(fc, args, num_groups: int = 0) -> np.ndarray:
         _i32(fc.needs_numa), _i32(fc.needs_bind),
         _f32(fc.cores_needed), _i32(fc.full_pcpus),
         _i32(fc.pod_taint_mask),
+        term_mask(fc.pod_aff_req), term_mask(fc.pod_anti_req),
+        term_mask(fc.pod_aff_match),
         allocatable, _f32(inputs.requested).copy(), _i32(inputs.node_ok),
         _f32(inputs.la_filter_usage), _i32(inputs.la_has_filter_usage),
         _f32(inputs.la_filter_thresholds), _f32(inputs.la_prod_thresholds),
@@ -136,6 +148,11 @@ def serial_schedule_full_native(fc, args, num_groups: int = 0) -> np.ndarray:
         numa_free, _i32(fc.numa_policy), _i32(fc.has_topology),
         _f32(fc.bind_free).copy(), _f32(fc.cpus_per_core),
         _i32(fc.node_taint_group),
+        (_f32(fc.aff_dom) if T
+         else np.full((N, 1), -1.0, np.float32)),
+        (_f32(fc.aff_count).copy() if T
+         else np.zeros((N, 1), np.float32)),
+        _i32(fc.aff_exists) if T else np.zeros(1, np.int32),
         ancestors if ancestors.size else np.zeros((1, 1), np.int32),
         _f32(fc.quota_used).copy() if G else np.zeros((1, R), np.float32),
         _f32(fc.quota_runtime) if G else np.zeros((1, R), np.float32),

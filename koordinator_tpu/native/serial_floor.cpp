@@ -30,7 +30,7 @@ inline float least_requested(float requested, float capacity) {
 
 // ABI version: bump when koord_serial_full_chain's signature changes, so a
 // stale .so is rejected instead of mis-reading shifted pointers.
-extern "C" int koord_floor_abi_version() { return 2; }
+extern "C" int koord_floor_abi_version() { return 4; }
 
 extern "C" {
 
@@ -39,7 +39,7 @@ extern "C" {
 // caller owns; they are mutated in place, as in the numpy oracle.
 void koord_serial_full_chain(
     // dims
-    int P, int R, int N, int K, int G, int A, int NG,
+    int P, int R, int N, int K, int G, int A, int NG, int T,
     int prod_mode,
     // pods
     const float* fit_requests,   // [P, R]
@@ -55,6 +55,9 @@ void koord_serial_full_chain(
     const float* cores_needed,   // [P]
     const int32_t* full_pcpus,   // [P]
     const int32_t* pod_taint_mask, // [P] bitmask of tolerated taint groups
+    const int32_t* pod_aff_req,    // [P] bitmask of required affinity terms
+    const int32_t* pod_anti_req,   // [P] bitmask of anti-affinity terms
+    const int32_t* pod_aff_match,  // [P] bitmask of terms the pod matches
     // nodes
     const float* allocatable,    // [N, R]
     float* requested_state,      // [N, R] (mutated)
@@ -76,6 +79,9 @@ void koord_serial_full_chain(
     float* bind_free,            // [N] (mutated)
     const float* cpus_per_core,  // [N]
     const int32_t* node_taint_group, // [N]
+    const float* aff_dom,        // [N, T] topology domain ids (-1 invalid)
+    float* aff_count,            // [N, T] matching pods per domain (mutated)
+    const int32_t* aff_exists0,  // [T] any matching pod anywhere (host seed)
     // quota
     const int32_t* ancestors,    // [G, A] (-1 padded)
     float* quota_used,           // [G, R] (mutated)
@@ -92,6 +98,11 @@ void koord_serial_full_chain(
   float wsum = 0.0f;
   for (int r = 0; r < R; ++r) wsum += weights[r];
   const float wdiv = wsum > 1.0f ? wsum : 1.0f;
+
+  // per-term "any matching pod anywhere" (host-seeded, incl. pods on nodes
+  // without the topology label; flipped on every in-batch match placement)
+  bool* term_has_match = T > 0 ? new bool[T]() : nullptr;
+  for (int t = 0; t < T; ++t) term_has_match[t] = aff_exists0[t] != 0;
 
   for (int p = 0; p < P; ++p) {
     chosen[p] = -1;
@@ -128,6 +139,22 @@ void koord_serial_full_chain(
       if (!node_ok[n]) continue;
       // TaintToleration: group bit test (ops/taints.py)
       if (!((pod_taint_mask[p] >> node_taint_group[n]) & 1)) continue;
+      // InterPodAffinity (ops/podaffinity.py)
+      if (T > 0) {
+        bool affinity_ok = true;
+        const float* cnt = aff_count + (int64_t)n * T;
+        const float* dom = aff_dom + (int64_t)n * T;
+        for (int t = 0; t < T && affinity_ok; ++t) {
+          if (((pod_anti_req[p] >> t) & 1) && cnt[t] > 0.0f)
+            affinity_ok = false;
+          if ((pod_aff_req[p] >> t) & 1) {
+            bool boot = ((pod_aff_match[p] >> t) & 1) && !term_has_match[t];
+            if (!(boot || (dom[t] >= 0.0f && cnt[t] > 0.0f)))
+              affinity_ok = false;
+          }
+        }
+        if (!affinity_ok) continue;
+      }
       const float* alloc = allocatable + (int64_t)n * R;
       const float* reqn = requested_state + (int64_t)n * R;
       // Filter: Fit
@@ -247,7 +274,17 @@ void koord_serial_full_chain(
         for (int r = 0; r < R; ++r) qu[r] += reqp[r];
       }
     }
+    for (int t = 0; t < T; ++t) {
+      if (!((pod_aff_match[p] >> t) & 1)) continue;
+      term_has_match[t] = true;  // even when the node lacks the label
+      float d = aff_dom[(int64_t)best_n * T + t];
+      if (d < 0.0f) continue;
+      for (int n = 0; n < N; ++n)
+        if (aff_dom[(int64_t)n * T + t] == d)
+          aff_count[(int64_t)n * T + t] += 1.0f;
+    }
   }
+  delete[] term_has_match;
 
   // ---- gang permit barrier (all-or-nothing per gang group)
   if (NG > 0) {

@@ -42,7 +42,8 @@ from koordinator_tpu.ops.numa import POLICY_NONE, POLICY_SINGLE_NUMA_NODE
 from koordinator_tpu.ops.pallas_common import POD_BLOCK, UNROLL
 
 
-def estimate_vmem_bytes(N: int, R: int, K: int, G: int, P: int) -> int:
+def estimate_vmem_bytes(N: int, R: int, K: int, G: int, P: int,
+                        T: int = 0) -> int:
     """Upper-bound VMEM footprint of one pallas_call of the full-chain
     kernel, mirroring the in/out/scratch specs below: 3 double-buffered
     [R, POD_BLOCK] pod column blocks, 8 [R, N] node buffers, 2 [K*R, N]
@@ -54,12 +55,13 @@ def estimate_vmem_bytes(N: int, R: int, K: int, G: int, P: int) -> int:
     G_eff = max(G, 1)
     G_lane = max(128, -(-G_eff // 128) * 128)
     floats = (3 * POD_BLOCK * R * 2 + 8 * R * N + 2 * K * R * N + 11 * N
+              + 3 * max(T, 0) * N
               + 4 * R * G_lane + 2 * UNROLL * G_lane + P_pad)
     return 4 * floats
 
 
 def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
-                 K: int, G: int):
+                 K: int, G: int, T: int = 0):
     wsum = float(max(weights.sum(), 1.0))
     consts = pc.weight_consts(weights)
 
@@ -68,6 +70,8 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         prod_ref, valid_ref, ds_ref, gangok_ref,
         needsnuma_ref, needsbind_ref, fullpcpus_ref, cores_ref,  # f32 [P]
         taintmask_ref,                                            # f32 [P]
+        affreq_ref, antireq_ref, affmatch_ref,   # f32 [P] term bitmasks
+        affexists0_ref,                          # f32 [max(T,1)] host seed
         qid_ref,                                                  # int32 [P]
         # --- VMEM pod column blocks [R, POD_BLOCK]
         fitreq_ref, rawreq_ref, est_ref,
@@ -80,6 +84,8 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         # --- VMEM numa [K*R, N] / per-pod ancestor rows [UNROLL, G_lane]
         #     (pre-gathered host-side: no in-kernel dynamic slice) / quota
         numafree0_ref, ancpod_ref, qused0_ref, qruntime_ref,
+        # --- VMEM inter-pod affinity [max(T,1), N]
+        affdom_ref, affcount0_ref,
         # --- outputs
         chosen_ref,                 # (UNROLL, 1) int32 block, one per step
         requested_ref,              # [R, N] (carried)
@@ -90,6 +96,8 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         bindfree_ref,               # [1, N]
         headroom_ref,               # [R, N] (alloc - requested)
         qacc_ref,                   # [R, G] quota-used accumulator
+        affcount_ref,               # [max(T,1), N] carried term counts
+        affexists_ref,              # SMEM [max(T,1)] carried exists flags
     ):
         i = pl.program_id(0)
         alloc = alloc_ref[:]
@@ -112,6 +120,10 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
             numa_ref[:] = numafree0_ref[:]
             bindfree_ref[:] = bindfree0_ref[:]
             qacc_ref[:] = qused0_ref[:]
+            if T:
+                affcount_ref[:] = affcount0_ref[:]
+                for t in range(T):
+                    affexists_ref[t] = affexists0_ref[t]
 
         # read-only node state: load once per grid step
         lafeas_np = lafeas_np_ref[0, :]
@@ -140,6 +152,8 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         numa = [numa_ref[k * R:(k + 1) * R, :] for k in range(K)]
         bindfree = bindfree_ref[0, :]
         qused = qacc_ref[:]                                          # [R, G]
+        aff_dom = [affdom_ref[t:t + 1, :] for t in range(T)]         # [1, N]
+        aff_count = [affcount_ref[t:t + 1, :] for t in range(T)]
 
         for j in range(UNROLL):
             p = i * UNROLL + j
@@ -211,6 +225,23 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
                 jnp.floor(taintmask_ref[p] / taintpow), 2.0) >= 1.0
             feasible = (node_ok_row & fit & la_ok & cpuset_ok
                         & numa_ok & taint_ok & admit)
+            # ---- Filter: InterPodAffinity (ops/podaffinity.py). Term
+            # membership rides per-pod SMEM bitmasks; 2^t is a static
+            # Python constant, so the bit tests are scalar ops.
+            for t in range(T):
+                aff_t = jnp.remainder(
+                    jnp.floor(affreq_ref[p] / float(1 << t)), 2.0) >= 1.0
+                anti_t = jnp.remainder(
+                    jnp.floor(antireq_ref[p] / float(1 << t)), 2.0) >= 1.0
+                match_t = jnp.remainder(
+                    jnp.floor(affmatch_ref[p] / float(1 << t)), 2.0) >= 1.0
+                count_t = aff_count[t][0, :]
+                empty_t = count_t <= 0                              # [N]
+                anti_ok = (~anti_t) | empty_t
+                boot = match_t & (affexists_ref[t] <= 0.0)
+                aff_ok = (~aff_t) | boot | (
+                    (aff_dom[t][0, :] >= 0) & ~empty_t)
+                feasible = feasible & anti_ok & aff_ok
 
             # ---- Score: LoadAware + NodeNUMAResource least-allocated
             headla = jnp.where(prod, headla_pr, headla_np) if prod_mode \
@@ -256,6 +287,19 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
             # quota: add along the ancestor closure
             q_apply = jnp.where(found & has_quota, 1.0, 0.0)
             qused = qused + raw_req * anc_row * q_apply
+            # affinity: raise matched terms' counts over the chosen domain
+            # and latch the exists flag (even on an unlabeled node)
+            for t in range(T):
+                match_t = jnp.remainder(
+                    jnp.floor(affmatch_ref[p] / float(1 << t)), 2.0) >= 1.0
+                dom_row = aff_dom[t][0, :]
+                chosen_dom = jnp.sum(sel * dom_row)
+                inc = jnp.where(
+                    (found & match_t & (chosen_dom >= 0))
+                    & (dom_row == chosen_dom), 1.0, 0.0)
+                aff_count[t] = aff_count[t] + inc[None, :]
+                affexists_ref[t] = jnp.where(
+                    found & match_t, 1.0, affexists_ref[t])
 
             picked = jnp.where(found, best, jnp.int32(-1))
             chosen_ref[j:j + 1, :] = picked.reshape(1, 1)
@@ -268,6 +312,8 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
             numa_ref[k * R:(k + 1) * R, :] = numa[k]
         bindfree_ref[:] = bindfree[None, :]
         qacc_ref[:] = qused
+        for t in range(T):
+            affcount_ref[t:t + 1, :] = aff_count[t]
 
         @pl.when(i == pl.num_programs(0) - 1)
         def _emit():
@@ -349,13 +395,37 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
         anc = jnp.pad(anc, [(0, 0), (0, G_lane - anc.shape[1])])
         anc_pod = jnp.take(anc, jnp.maximum(qid_pad, 0), axis=0)
 
-        kernel = _make_kernel(weights, prod_mode, N, R, K, G_eff)
+        # inter-pod affinity: per-pod term rows become [P] f32 bitmasks
+        # (exact: T <= 24 < 2^24), node state transposes to [T, N]
+        T = fc.aff_dom.shape[1]
+        T_eff = max(T, 1)
+        pow_t = jnp.asarray(
+            [float(1 << t) for t in range(T)], jnp.float32)
+        if T:
+            def bitmask(rows):  # [P, T] bool -> [P_pad] f32
+                return jnp.pad(
+                    jnp.sum(f32(rows) * pow_t[None, :], axis=1), pad_p)
+
+            affreq_m = bitmask(fc.pod_aff_req)
+            antireq_m = bitmask(fc.pod_anti_req)
+            affmatch_m = bitmask(fc.pod_aff_match)
+            affexists0 = f32(fc.aff_exists)
+            affdom0 = f32(fc.aff_dom).T
+            affcount0 = f32(fc.aff_count).T
+        else:
+            affreq_m = antireq_m = affmatch_m = jnp.zeros(P_pad, jnp.float32)
+            affexists0 = jnp.zeros(1, jnp.float32)
+            affdom0 = jnp.full((1, N), -1.0, jnp.float32)
+            affcount0 = jnp.zeros((1, N), jnp.float32)
+
+        kernel = _make_kernel(weights, prod_mode, N, R, K, G_eff, T)
         grid_inputs = (
             spad(inputs.is_prod), spad(inputs.pod_valid),
             spad(inputs.is_daemonset), spad(gang_pod_ok),
             spad(fc.needs_numa), spad(fc.needs_bind),
             spad(fc.full_pcpus), spad(fc.cores_needed),
             jnp.pad(f32(fc.pod_taint_mask), pad_p, constant_values=1.0),
+            affreq_m, antireq_m, affmatch_m, affexists0,
             qid_pad,
             pods_t(inputs.fit_requests), pods_t(fc.requests),
             pods_t(inputs.estimated),
@@ -367,6 +437,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             jnp.asarray(fc.numa_policy, jnp.int32)[None, :],
             jnp.exp2(f32(fc.node_taint_group))[None, :],
             numa0, anc_pod, qused0, qruntime,
+            affdom0, affcount0,
         )
         smem, full = pc.smem_spec, pc.full_spec
         pod_spec = pc.pod_block_spec(R)
@@ -374,13 +445,14 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             kernel,
             grid=(P_pad // UNROLL,),
             in_specs=(
-                [smem()] * 10
+                [smem()] * 14
                 + [pod_spec] * 3
                 + [full((R, N))] * 4
                 + [full((1, N))] * 9
                 + [full((K * R, N)),
                    pl.BlockSpec((UNROLL, G_lane), lambda i: (i, 0)),
                    full((R, G_lane)), full((R, G_lane))]
+                + [full((T_eff, N))] * 2
             ),
             out_specs=[
                 pc.chosen_block_spec(),
@@ -399,6 +471,8 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                 pltpu.VMEM((1, N), jnp.float32),
                 pltpu.VMEM((R, N), jnp.float32),
                 pltpu.VMEM((R, G_lane), jnp.float32),
+                pltpu.VMEM((T_eff, N), jnp.float32),
+                pltpu.SMEM((T_eff,), jnp.float32),
             ],
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("arbitrary",),

@@ -1,0 +1,168 @@
+"""Inter-pod (anti-)affinity, batched (requiredDuringScheduling only).
+
+The vendored kube-scheduler's InterPodAffinity plugin evaluates, per
+candidate node, whether pods matching a term's label selector exist within
+the node's topology domain (core/v1 PodAffinityTerm; the reference binary
+ships the plugin as a vendored default). Per-(pod, node, term) set checks
+don't batch, so the snapshot factorizes:
+
+  * the pending batch's DISTINCT terms (selector matchLabels, topologyKey)
+    become term ids t < T (T is static per batch; real batches carry a
+    handful — replica spreads and co-location pairs);
+  * every node gets a domain id per term ([N, T], -1 when the node lacks
+    the topology label — such nodes are outside every domain, exactly the
+    upstream semantics);
+  * aff_count [N, T] carries how many matching pods (existing assigned
+    pods at snapshot time, plus in-batch placements as the kernel walks)
+    live in node n's domain for term t;
+  * each pod carries three [T] bool rows: which terms it REQUIRES as
+    affinity, which it FORBIDS as anti-affinity, and which its own labels
+    MATCH (driving the in-batch count updates and the first-replica
+    bootstrap: a required affinity term that matches the pod's own labels
+    admits everywhere while no matching pod exists anywhere — the upstream
+    special case that lets the first replica of a self-affine set land).
+
+Feasibility per (pod, node): every anti term has count == 0, every
+affinity term has (domain valid AND count > 0) or its bootstrap; the
+update after a placement increments the chosen node's whole domain row
+for every term the placed pod matches.
+
+MAX_TERMS = 24 keeps the Pallas encoding exact (the three bool rows ride
+one float bitmask each, < 2^24): batches with more distinct terms mark the
+EXCESS pods unschedulable for the round (conservative, loudly logged)
+rather than silently dropping a constraint.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+MAX_TERMS = 24
+
+# (namespace set, selector item set, topology key) — terms are namespace
+# scoped: an empty PodAffinityTerm.namespaces defaults to the owning pod's
+# own namespace, so the same selector in two namespaces is two terms
+Term = Tuple[frozenset, frozenset, str]
+
+
+def _term_key(term, pod) -> Term:
+    ns = frozenset(term.namespaces) if term.namespaces else frozenset(
+        {pod.meta.namespace})
+    return (ns, frozenset(term.selector.items()), term.topology_key)
+
+
+def _pod_matches(term: Term, pod) -> bool:
+    ns, selector, _key = term
+    if pod.meta.namespace not in ns:
+        return False
+    labels = pod.meta.labels
+    return all(labels.get(k) == v for k, v in selector)
+
+
+def _terms_of(pod) -> List[Term]:
+    out = []
+    for term in list(pod.spec.pod_affinity) + list(pod.spec.pod_anti_affinity):
+        out.append(_term_key(term, pod))
+    return out
+
+
+def build_affinity_state(pending_pods, nodes, existing_pods):
+    """-> (terms, aff_dom [N, T] f32, aff_count [N, T] f32,
+           aff_exists [T] bool,
+           aff_req [P_valid, T] bool, anti_req [P_valid, T] bool,
+           match [P_valid, T] bool, overflow_pod_idx: list[int])
+
+    existing_pods: assigned, non-terminated pods (their labels + node names
+    seed the counts). aff_exists[t] is True when ANY existing pod matches
+    term t — regardless of whether its node carries the topology label —
+    driving the first-replica bootstrap exactly as upstream ("no matching
+    pod in the cluster"), where counts alone would miss matches on
+    unlabeled nodes. Row i of the pod arrays corresponds to
+    pending_pods[i]; the caller pads. overflow_pod_idx lists pending pods
+    whose terms did not fit MAX_TERMS — they must be marked unschedulable.
+    """
+    terms: List[Term] = []
+    ids = {}
+    overflow_pods: List[int] = []
+    for i, pod in enumerate(pending_pods):
+        fits = True
+        for term in _terms_of(pod):
+            if term in ids:
+                continue
+            if len(terms) >= MAX_TERMS:
+                fits = False
+                continue
+            ids[term] = len(terms)
+            terms.append(term)
+        if not fits:
+            overflow_pods.append(i)
+            logger.warning(
+                "pod %s exceeds the %d distinct (anti-)affinity terms the "
+                "batch encoding holds; it is unschedulable this round",
+                pod.meta.key, MAX_TERMS,
+            )
+    T = len(terms)
+    N = len(nodes)
+    P = len(pending_pods)
+    aff_dom = np.full((N, T), -1.0, np.float32)
+    aff_count = np.zeros((N, T), np.float32)
+    aff_exists = np.zeros(T, bool)
+    aff_req = np.zeros((P, T), bool)
+    anti_req = np.zeros((P, T), bool)
+    match = np.zeros((P, T), bool)
+    if T == 0:
+        return (terms, aff_dom, aff_count, aff_exists, aff_req, anti_req,
+                match, overflow_pods)
+
+    # domain ids per term: nodes sharing the topology label value
+    node_values: List[dict] = []
+    for t, (_ns, _sel, key) in enumerate(terms):
+        values = {}
+        for n, node in enumerate(nodes):
+            val = node.meta.labels.get(key)
+            if val is not None:
+                aff_dom[n, t] = values.setdefault(val, len(values))
+        node_values.append(values)
+    node_index = {node.meta.name: n for n, node in enumerate(nodes)}
+
+    # seed counts from existing pods: O(E*T) dict accumulation per domain
+    # VALUE, then one O(N*T) write — not a [N] mask per matching pod
+    dom_counts: List[dict] = [dict() for _ in range(T)]
+    for pod in existing_pods:
+        for t, term in enumerate(terms):
+            if not _pod_matches(term, pod):
+                continue
+            aff_exists[t] = True
+            n = node_index.get(pod.spec.node_name)
+            if n is None or aff_dom[n, t] < 0:
+                continue
+            d = aff_dom[n, t]
+            dom_counts[t][d] = dom_counts[t].get(d, 0.0) + 1.0
+    for t in range(T):
+        if dom_counts[t]:
+            col = aff_dom[:, t]
+            aff_count[:, t] = np.where(
+                col >= 0,
+                np.vectorize(lambda d: dom_counts[t].get(d, 0.0))(col),
+                0.0,
+            )
+
+    for i, pod in enumerate(pending_pods):
+        for t, term in enumerate(terms):
+            if _pod_matches(term, pod):
+                match[i, t] = True
+        for term in pod.spec.pod_affinity:
+            t = ids.get(_term_key(term, pod))
+            if t is not None:
+                aff_req[i, t] = True
+        for term in pod.spec.pod_anti_affinity:
+            t = ids.get(_term_key(term, pod))
+            if t is not None:
+                anti_req[i, t] = True
+    return (terms, aff_dom, aff_count, aff_exists, aff_req, anti_req, match,
+            overflow_pods)

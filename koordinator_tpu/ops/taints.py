@@ -51,7 +51,16 @@ def selector_pairs_of(pods) -> frozenset:
     pairs = set()
     for pod in pods:
         pairs.update(pod.spec.node_selector.items())
+        pairs.update(pod.spec.affinity_required_node_labels.items())
     return frozenset(pairs)
+
+
+def required_node_pairs(pod) -> frozenset:
+    """All (key, value) node-label requirements of a pod: nodeSelector AND
+    requiredDuringScheduling node affinity matchLabels — kube-scheduler ANDs
+    the two (NodeAffinity plugin)."""
+    return frozenset(pod.spec.node_selector.items()) | frozenset(
+        pod.spec.affinity_required_node_labels.items())
 
 
 _UNKNOWN = object()  # bucket marker: label matches not encoded for this group
@@ -130,7 +139,7 @@ def admission_mask(pod, groups: List[Tuple[frozenset, object]]) -> float:
     overflow group's bit is never set."""
     mask = 0
     tolerations = pod.spec.tolerations
-    selector = frozenset(pod.spec.node_selector.items())
+    selector = required_node_pairs(pod)
     for gid, (taints, matched) in enumerate(groups):
         if taints and not tolerates_taints(tolerations, taints):
             continue

@@ -52,6 +52,8 @@ _FC_NODE_FIELDS = frozenset(
         "bind_free",
         "cpus_per_core",
         "node_taint_group",
+        "aff_dom",
+        "aff_count",
     }
 )
 

@@ -211,6 +211,13 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
     gang_valid = np.asarray(fc.gang_valid)
     pod_taint_mask = np.asarray(fc.pod_taint_mask)
     node_taint_group = np.asarray(fc.node_taint_group)
+    aff_dom = np.asarray(fc.aff_dom, np.float32)
+    aff_count = np.array(fc.aff_count, np.float32)
+    aff_exists = np.array(fc.aff_exists, bool)
+    pod_aff_req = np.asarray(fc.pod_aff_req)
+    pod_anti_req = np.asarray(fc.pod_anti_req)
+    pod_aff_match = np.asarray(fc.pod_aff_match)
+    T = aff_dom.shape[1]
 
     P, R = fit_requests.shape
     N, K, _ = numa_free.shape
@@ -278,6 +285,20 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
                 continue
             # TaintToleration: group bit test (ops/taints.py)
             if not (int(pod_taint_mask[p]) >> int(node_taint_group[n])) & 1:
+                continue
+            # InterPodAffinity (ops/podaffinity.py)
+            affinity_ok = True
+            for t in range(T):
+                if pod_anti_req[p, t] and aff_count[n, t] > 0:
+                    affinity_ok = False
+                    break
+                if pod_aff_req[p, t]:
+                    bootstrap = pod_aff_match[p, t] and not aff_exists[t]
+                    if not ((aff_dom[n, t] >= 0 and aff_count[n, t] > 0)
+                            or bootstrap):
+                        affinity_ok = False
+                        break
+            if not affinity_ok:
                 continue
             # cpuset filter
             if needs_bind[p]:
@@ -351,6 +372,13 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
             for g in ancestors[quota_id[p]]:
                 if g >= 0:
                     quota_used[g] += requests[p]
+        for t in range(T):
+            if not pod_aff_match[p, t]:
+                continue
+            aff_exists[t] = True
+            if aff_dom[best_n, t] >= 0:
+                dom = aff_dom[:, t] == aff_dom[best_n, t]
+                aff_count[dom, t] += 1.0
     return chosen
 
 

@@ -289,6 +289,33 @@ def build_full_chain_inputs(
             numa_capacity[i, 0] = nodes.allocatable[i]
             numa_free[i, 0] = nodes.allocatable[i] - nodes.requested[i]
 
+    # inter-pod (anti-)affinity factorization (ops/podaffinity.py): the
+    # batch's distinct terms -> per-node domain/count state + per-pod term
+    # rows, in pods.keys order, padded to the bucketed shapes
+    from koordinator_tpu.ops.podaffinity import build_affinity_state
+
+    ordered_pending = [pods_by_key_pending[k] for k in pods.keys]
+    existing = [
+        p for p in state.pods_by_key.values()
+        if p.is_assigned and not p.is_terminated
+    ]
+    (_aff_terms, dom_v, count_v, aff_exists, aff_req_v, anti_req_v, match_v,
+     aff_overflow) = build_affinity_state(ordered_pending, state.nodes,
+                                          existing)
+    T = dom_v.shape[1]
+    aff_dom = np.full((N, T), -1.0, np.float32)
+    aff_dom[: dom_v.shape[0]] = dom_v
+    aff_count = np.zeros((N, T), np.float32)
+    aff_count[: count_v.shape[0]] = count_v
+    pod_aff_req = np.zeros((P, T), bool)
+    pod_aff_req[: aff_req_v.shape[0]] = aff_req_v
+    pod_anti_req = np.zeros((P, T), bool)
+    pod_anti_req[: anti_req_v.shape[0]] = anti_req_v
+    pod_aff_match = np.zeros((P, T), bool)
+    pod_aff_match[: match_v.shape[0]] = match_v
+    for i in aff_overflow:  # conservative: term encoding overflow
+        pods.valid[i] = False
+
     base = make_inputs(pods, nodes, args)
     G = max(1, len(tree.names))
     fc = FullChainInputs(
@@ -301,7 +328,13 @@ def build_full_chain_inputs(
         cores_needed=np.asarray(cores_needed),
         full_pcpus=np.asarray(full_pcpus),
         pod_taint_mask=np.asarray(pod_taint_mask),
+        pod_aff_req=np.asarray(pod_aff_req),
+        pod_anti_req=np.asarray(pod_anti_req),
+        pod_aff_match=np.asarray(pod_aff_match),
         node_taint_group=np.asarray(node_taint_group),
+        aff_dom=np.asarray(aff_dom),
+        aff_count=np.asarray(aff_count),
+        aff_exists=np.asarray(aff_exists),
         numa_free=np.asarray(numa_free),
         numa_capacity=np.asarray(numa_capacity),
         numa_policy=np.asarray(numa_policy),

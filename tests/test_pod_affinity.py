@@ -1,0 +1,222 @@
+"""Inter-pod (anti-)affinity parity and semantics: XLA step vs serial oracle
+vs Pallas (interpret) vs wave kernel vs C++ floor, plus upstream behaviors
+(anti spreads one-per-domain, required affinity co-locates, self-match
+bootstrap admits the first replica)."""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.objects import PodAffinityTerm
+from koordinator_tpu.models.full_chain import build_full_chain_step
+from koordinator_tpu.ops.loadaware import LoadAwareArgs
+from koordinator_tpu.scheduler.parity import diff_bindings, serial_schedule_full
+from koordinator_tpu.scheduler.snapshot import build_full_chain_inputs
+from koordinator_tpu.testing import synth_full_cluster
+
+ZONE_KEY = "topology.kubernetes.io/zone"
+HOST_KEY = "kubernetes.io/hostname"
+
+
+def _fixture(num_nodes=24, num_pods=48, seed=17, anti_every=4, aff_every=7):
+    args = LoadAwareArgs()
+    cluster, state = synth_full_cluster(num_nodes, num_pods, seed=seed)
+    for j, node in enumerate(state.nodes):
+        node.meta.labels[ZONE_KEY] = f"z{j % 5}"
+        node.meta.labels[HOST_KEY] = node.meta.name
+    for i, pod in enumerate(state.pending_pods):
+        if i % anti_every == 0:
+            pod.meta.labels["app"] = "spread-me"
+            pod.spec.pod_anti_affinity.append(PodAffinityTerm(
+                selector={"app": "spread-me"}, topology_key=ZONE_KEY))
+        elif i % aff_every == 0:
+            pod.meta.labels["app"] = "pack-me"
+            pod.spec.pod_affinity.append(PodAffinityTerm(
+                selector={"app": "pack-me"}, topology_key=ZONE_KEY))
+    fc, pods, nodes, tree, gi, ng, ngroups = build_full_chain_inputs(
+        state, args)
+    return args, state, fc, pods, ng, ngroups
+
+
+def test_affinity_bindings_match_oracle():
+    args, state, fc, pods, ng, ngroups = _fixture()
+    assert fc.aff_dom.shape[1] == 2  # anti + affinity terms
+    chosen = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    serial = serial_schedule_full(fc, args)
+    n = len(pods.keys)
+    diffs = diff_bindings(serial[:n], chosen[:n], pods.keys)
+    assert not diffs, f"{len(diffs)} mismatches: {diffs[:10]}"
+
+    # semantics: anti pods land one-per-zone; affinity pods share one zone
+    by_key = {p.meta.key: p for p in state.pending_pods}
+    anti_zones, pack_zones = [], set()
+    placed_anti = placed_aff = 0
+    for i, key in enumerate(pods.keys):
+        if chosen[i] < 0:
+            continue
+        pod = by_key[key]
+        zone = state.nodes[chosen[i]].meta.labels[ZONE_KEY]
+        if pod.spec.pod_anti_affinity:
+            anti_zones.append(zone)
+            placed_anti += 1
+        elif pod.spec.pod_affinity:
+            pack_zones.add(zone)
+            placed_aff += 1
+    assert placed_anti > 1
+    assert len(anti_zones) == len(set(anti_zones)), "anti pods shared a zone"
+    assert placed_aff > 1
+    assert len(pack_zones) == 1, "affinity pods spread across zones"
+
+
+def test_affinity_bootstrap_first_replica():
+    """With no existing match anywhere, a self-matching required-affinity pod
+    must still schedule (upstream first-replica special case) — and later
+    replicas must then co-locate with it."""
+    args, state, fc, pods, ng, ngroups = _fixture(
+        num_pods=30, anti_every=10**9, aff_every=3)
+    chosen = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    by_key = {p.meta.key: p for p in state.pending_pods}
+    zones = [state.nodes[chosen[i]].meta.labels[ZONE_KEY]
+             for i, key in enumerate(pods.keys)
+             if chosen[i] >= 0 and by_key[key].spec.pod_affinity]
+    assert len(zones) > 1          # the first replica bootstrapped
+    assert len(set(zones)) == 1    # the rest co-located with it
+
+
+def test_affinity_counts_seeded_from_existing_pods():
+    """An existing assigned pod matching an anti term blocks its whole
+    domain for incoming anti pods."""
+    args = LoadAwareArgs()
+    cluster, state = synth_full_cluster(12, 8, seed=3)
+    for j, node in enumerate(state.nodes):
+        node.meta.labels[ZONE_KEY] = f"z{j % 2}"
+    # existing running pod with the app label on node 0 (zone z0)
+    existing = next(p for p in state.pods_by_key.values()
+                    if p.is_assigned and not p.is_terminated)
+    existing.meta.labels["app"] = "solo"
+    z_blocked = state.nodes[
+        [n.meta.name for n in state.nodes].index(existing.spec.node_name)
+    ].meta.labels[ZONE_KEY]
+    for pod in state.pending_pods:
+        pod.meta.labels["app"] = "solo"
+        pod.spec.pod_anti_affinity.append(PodAffinityTerm(
+            selector={"app": "solo"}, topology_key=ZONE_KEY))
+    fc, pods, nodes, tree, gi, ng, ngroups = build_full_chain_inputs(
+        state, args)
+    chosen = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    serial = serial_schedule_full(fc, args)
+    np.testing.assert_array_equal(chosen[: len(pods.keys)],
+                                  serial[: len(pods.keys)])
+    placed = [i for i in range(len(pods.keys)) if chosen[i] >= 0]
+    assert len(placed) == 1  # one zone left; one anti pod fits, rest blocked
+    assert state.nodes[chosen[placed[0]]].meta.labels[ZONE_KEY] != z_blocked
+
+
+def test_affinity_pallas_and_wave_and_floor_parity():
+    from koordinator_tpu.models.wave_chain import build_wave_full_chain_step
+    from koordinator_tpu.native import floor as native_floor
+    from koordinator_tpu.ops.pallas_full_chain import (
+        build_pallas_full_chain_step,
+    )
+
+    args, state, fc, pods, ng, ngroups = _fixture(seed=29)
+    chosen_x = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    chosen_p = np.asarray(
+        build_pallas_full_chain_step(args, ng, ngroups, interpret=True)(fc)[0])
+    np.testing.assert_array_equal(chosen_x, chosen_p)
+    chosen_w = np.asarray(
+        build_wave_full_chain_step(args, ng, ngroups, wave=16)(fc)[0])
+    np.testing.assert_array_equal(chosen_x, chosen_w)
+    if native_floor.available() or native_floor.build():
+        chosen_n = native_floor.serial_schedule_full_native(
+            fc, args, num_groups=ngroups)
+        n = len(pods.keys)
+        np.testing.assert_array_equal(chosen_x[:n], chosen_n[:n])
+
+
+def test_term_overflow_marks_pods_unschedulable():
+    from koordinator_tpu.ops.podaffinity import MAX_TERMS
+
+    args = LoadAwareArgs()
+    cluster, state = synth_full_cluster(10, MAX_TERMS + 5, seed=9)
+    for j, node in enumerate(state.nodes):
+        node.meta.labels[HOST_KEY] = node.meta.name
+    for i, pod in enumerate(state.pending_pods):
+        pod.spec.pod_anti_affinity.append(PodAffinityTerm(
+            selector={"uniq": f"u{i}"}, topology_key=HOST_KEY))
+    fc, pods, nodes, tree, gi, ng, ngroups = build_full_chain_inputs(
+        state, args)
+    assert fc.aff_dom.shape[1] == MAX_TERMS
+    chosen = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    serial = serial_schedule_full(fc, args)
+    np.testing.assert_array_equal(chosen[: len(pods.keys)],
+                                  serial[: len(pods.keys)])
+    # pods whose terms overflowed are conservatively unplaced
+    assert (chosen[: len(pods.keys)] < 0).sum() >= 5
+
+
+def test_affinity_terms_are_namespace_scoped():
+    """core/v1 semantics: a term with no explicit namespaces matches only
+    pods in the OWNING pod's namespace — ns-b's pods must not block ns-a's
+    anti-affinity, and each namespace spreads independently."""
+    args = LoadAwareArgs()
+    cluster, state = synth_full_cluster(10, 12, seed=11)
+    for j, node in enumerate(state.nodes):
+        node.meta.labels[ZONE_KEY] = f"z{j % 5}"
+    for i, pod in enumerate(state.pending_pods):
+        pod.meta.namespace = "ns-a" if i % 2 == 0 else "ns-b"
+        pod.meta.labels["app"] = "db"
+        pod.spec.pod_anti_affinity.append(PodAffinityTerm(
+            selector={"app": "db"}, topology_key=ZONE_KEY))
+    fc, pods, nodes, tree, gi, ng, ngroups = build_full_chain_inputs(
+        state, args)
+    assert fc.aff_dom.shape[1] == 2  # one term per namespace
+    chosen = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    serial = serial_schedule_full(fc, args)
+    np.testing.assert_array_equal(chosen[: len(pods.keys)],
+                                  serial[: len(pods.keys)])
+    by_key = {p.meta.key: p for p in state.pending_pods}
+    zones = {"ns-a": [], "ns-b": []}
+    for i, key in enumerate(pods.keys):
+        if chosen[i] >= 0:
+            pod = by_key[key]
+            zones[pod.meta.namespace].append(
+                state.nodes[chosen[i]].meta.labels[ZONE_KEY])
+    # both namespaces independently placed pods into >= 2 zones each: with
+    # cluster-global matching one namespace would have starved
+    for ns, zs in zones.items():
+        assert len(zs) >= 2, (ns, zs)
+        assert len(zs) == len(set(zs)), (ns, zs)  # spread within namespace
+    # the same zone is reused across namespaces somewhere (5 zones, >= 4
+    # placements total of each ns on 10 nodes makes overlap certain)
+    assert set(zones["ns-a"]) & set(zones["ns-b"])
+
+
+def test_bootstrap_sees_match_on_unlabeled_node():
+    """A matching pod on a node WITHOUT the topology label kills the
+    bootstrap (upstream checks 'no matching pod in the cluster', not 'no
+    matching pod in a labeled domain'): later required-affinity replicas
+    must then need a real labeled-domain match."""
+    args = LoadAwareArgs()
+    cluster, state = synth_full_cluster(8, 6, seed=13)
+    for j, node in enumerate(state.nodes):
+        if j != 0:
+            node.meta.labels[ZONE_KEY] = f"z{j % 2}"
+    # existing matching pod sits on node 0 — the UNLABELED node
+    existing = next(p for p in state.pods_by_key.values()
+                    if p.is_assigned and not p.is_terminated
+                    and p.spec.node_name == state.nodes[0].meta.name)
+    existing.meta.labels["app"] = "pack"
+    for pod in state.pending_pods:
+        pod.meta.labels["app"] = "pack"
+        pod.spec.pod_affinity.append(PodAffinityTerm(
+            selector={"app": "pack"}, topology_key=ZONE_KEY))
+    fc, pods, nodes, tree, gi, ng, ngroups = build_full_chain_inputs(
+        state, args)
+    assert bool(np.asarray(fc.aff_exists)[0])
+    chosen = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    serial = serial_schedule_full(fc, args)
+    np.testing.assert_array_equal(chosen[: len(pods.keys)],
+                                  serial[: len(pods.keys)])
+    # a match exists (on the unlabeled node) but no labeled domain has one,
+    # so no bootstrap and no labeled placement: all replicas unschedulable
+    assert (chosen[: len(pods.keys)] < 0).all()
