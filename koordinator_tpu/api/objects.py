@@ -90,6 +90,18 @@ class PodAffinityTerm:
 
 
 @dataclass
+class TopologySpreadConstraint:
+    """whenUnsatisfiable=DoNotSchedule topology spread (core/v1
+    TopologySpreadConstraint, matchLabels form): placing the pod in a
+    domain must keep count(domain) + 1 - min(eligible domain counts)
+    <= max_skew. Evaluated by the vendored PodTopologySpread plugin."""
+
+    max_skew: int = 1
+    topology_key: str = "kubernetes.io/hostname"
+    selector: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
 class PodSpec:
     node_name: str = ""
     scheduler_name: str = "koord-scheduler"
@@ -101,6 +113,8 @@ class PodSpec:
     affinity_required_node_labels: Dict[str, str] = field(default_factory=dict)
     pod_affinity: List["PodAffinityTerm"] = field(default_factory=list)
     pod_anti_affinity: List["PodAffinityTerm"] = field(default_factory=list)
+    topology_spread: List["TopologySpreadConstraint"] = field(
+        default_factory=list)
     tolerations: List[Tuple[str, str]] = field(default_factory=list)  # (key, value)
     overhead: ResourceList = field(default_factory=ResourceList)
     restart_policy: str = "Always"
@@ -159,8 +173,20 @@ class Pod:
                 affinity_required_node_labels=dict(
                     spec.affinity_required_node_labels
                 ),
-                pod_affinity=list(spec.pod_affinity),
-                pod_anti_affinity=list(spec.pod_anti_affinity),
+                pod_affinity=[
+                    replace(t, selector=dict(t.selector),
+                            namespaces=list(t.namespaces))
+                    for t in spec.pod_affinity
+                ],
+                pod_anti_affinity=[
+                    replace(t, selector=dict(t.selector),
+                            namespaces=list(t.namespaces))
+                    for t in spec.pod_anti_affinity
+                ],
+                topology_spread=[
+                    replace(c, selector=dict(c.selector))
+                    for c in spec.topology_spread
+                ],
                 tolerations=list(spec.tolerations),
                 overhead=spec.overhead.copy(),
             ),

@@ -58,6 +58,8 @@ class FullChainInputs(NamedTuple):
     pod_aff_req: jnp.ndarray    # [P, T] bool — required pod-affinity terms
     pod_anti_req: jnp.ndarray   # [P, T] bool — required anti-affinity terms
     pod_aff_match: jnp.ndarray  # [P, T] bool — pod's labels match term
+    pod_spread_skew: jnp.ndarray  # [P, T] f32 — DoNotSchedule topology
+    #     spread maxSkew over term t's domains (0 = no constraint)
     # nodes
     node_taint_group: jnp.ndarray  # [N] int32 admission-signature group
     aff_dom: jnp.ndarray        # [N, T] f32 topology domain id (-1 invalid)
@@ -158,7 +160,20 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode):
             bootstrap = fc.pod_aff_match[i, t] & ~aff_exists[t]
             aff_ok = ~fc.pod_aff_req[i, t] | (
                 dom_valid & (count_t > 0)) | bootstrap
-            affinity_ok = affinity_ok & anti_ok & aff_ok
+            # PodTopologySpread (DoNotSchedule): count + self - min over
+            # ELIGIBLE domains must stay within maxSkew. Eligibility is the
+            # pod's admission bit test (node selector/affinity + taints) —
+            # upstream NodeAffinityPolicy=Honor + NodeTaintsPolicy=Honor —
+            # so an empty domain the pod could never use cannot pin the
+            # minimum at zero. A node without the topology label cannot
+            # host the constrained pod.
+            skew = fc.pod_spread_skew[i, t]
+            self_match = jnp.where(fc.pod_aff_match[i, t], 1.0, 0.0)
+            min_count = jnp.min(
+                jnp.where(dom_valid & taint_ok, count_t, jnp.inf))
+            spread_ok = (skew <= 0) | (
+                dom_valid & (count_t + self_match - min_count <= skew))
+            affinity_ok = affinity_ok & anti_ok & aff_ok & spread_ok
         feasible = (
             inputs.node_ok & fit & la_ok & cpuset_ok & numa_ok & taint_ok
             & affinity_ok & admit

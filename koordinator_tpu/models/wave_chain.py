@@ -145,8 +145,13 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                     match_w.astype(jnp.float32), axis=0) - match_w) > 0.5
                 anti_conf = found_w & jnp.any(
                     fc.pod_anti_req[idxc] & matched_before, axis=1)
+                # required affinity AND topology spread are non-monotone
+                # (a committed match can open previously-infeasible nodes
+                # by raising the domain minimum), so either conflicts
                 aff_conf = jnp.any(
-                    fc.pod_aff_req[idxc] & matched_before, axis=1) & valid_w
+                    (fc.pod_aff_req[idxc]
+                     | (fc.pod_spread_skew[idxc] > 0)) & matched_before,
+                    axis=1) & valid_w
                 affinity_conf_w = anti_conf | aff_conf
             else:
                 affinity_conf_w = jnp.zeros_like(found_w)

@@ -53,7 +53,7 @@ def _load() -> Optional[ctypes.CDLL]:
     # would read every pointer after the insertion shifted
     try:
         lib.koord_floor_abi_version.restype = ctypes.c_int
-        if lib.koord_floor_abi_version() != 4:
+        if lib.koord_floor_abi_version() != 5:
             return None
     except AttributeError:
         return None
@@ -65,6 +65,7 @@ def _load() -> Optional[ctypes.CDLL]:
         + [_F32P] + [_I32P]          # cores_needed full_pcpus
         + [_I32P]                    # pod_taint_mask
         + [_I32P] * 3                # pod_aff_req pod_anti_req pod_aff_match
+        + [_I32P]                    # pod_spread_skew [P, T]
         + [_F32P, _F32P] + [_I32P]   # allocatable requested node_ok
         + [_F32P] + [_I32P]          # filter_usage has_filter_usage
         + [_F32P] * 5                # filter_thr prod_thr prod_usage term_np term_pr
@@ -138,6 +139,8 @@ def serial_schedule_full_native(fc, args, num_groups: int = 0) -> np.ndarray:
         _i32(fc.pod_taint_mask),
         term_mask(fc.pod_aff_req), term_mask(fc.pod_anti_req),
         term_mask(fc.pod_aff_match),
+        (_i32(fc.pod_spread_skew) if T
+         else np.zeros((P, 1), np.int32)),
         allocatable, _f32(inputs.requested).copy(), _i32(inputs.node_ok),
         _f32(inputs.la_filter_usage), _i32(inputs.la_has_filter_usage),
         _f32(inputs.la_filter_thresholds), _f32(inputs.la_prod_thresholds),

@@ -30,7 +30,7 @@ inline float least_requested(float requested, float capacity) {
 
 // ABI version: bump when koord_serial_full_chain's signature changes, so a
 // stale .so is rejected instead of mis-reading shifted pointers.
-extern "C" int koord_floor_abi_version() { return 4; }
+extern "C" int koord_floor_abi_version() { return 5; }
 
 extern "C" {
 
@@ -58,6 +58,7 @@ void koord_serial_full_chain(
     const int32_t* pod_aff_req,    // [P] bitmask of required affinity terms
     const int32_t* pod_anti_req,   // [P] bitmask of anti-affinity terms
     const int32_t* pod_aff_match,  // [P] bitmask of terms the pod matches
+    const int32_t* pod_spread_skew, // [P, T] maxSkew per term (0 = none)
     // nodes
     const float* allocatable,    // [N, R]
     float* requested_state,      // [N, R] (mutated)
@@ -135,6 +136,27 @@ void koord_serial_full_chain(
     const float* estp = estimated + (int64_t)p * R;
     const bool use_prod_score = prod_mode && is_prod[p];
 
+    // spread minimums hoisted per (pod, term): invariant across the node
+    // scan, restricted to domains of nodes the pod is ELIGIBLE for
+    // (admission bit test), matching the batched evaluators
+    float spread_min[32];
+    if (T > 0) {
+      bool any_spread = false;
+      for (int t = 0; t < T; ++t)
+        if (pod_spread_skew[(int64_t)p * T + t] > 0) { any_spread = true; break; }
+      if (any_spread) {
+        for (int t = 0; t < T; ++t) spread_min[t] = 3.4e38f;
+        for (int n = 0; n < N; ++n) {
+          if (!((pod_taint_mask[p] >> node_taint_group[n]) & 1)) continue;
+          for (int t = 0; t < T; ++t) {
+            float d = aff_dom[(int64_t)n * T + t];
+            float c = aff_count[(int64_t)n * T + t];
+            if (d >= 0.0f && c < spread_min[t]) spread_min[t] = c;
+          }
+        }
+      }
+    }
+
     for (int n = 0; n < N; ++n) {
       if (!node_ok[n]) continue;
       // TaintToleration: group bit test (ops/taints.py)
@@ -150,6 +172,14 @@ void koord_serial_full_chain(
           if ((pod_aff_req[p] >> t) & 1) {
             bool boot = ((pod_aff_match[p] >> t) & 1) && !term_has_match[t];
             if (!(boot || (dom[t] >= 0.0f && cnt[t] > 0.0f)))
+              affinity_ok = false;
+          }
+          // PodTopologySpread (DoNotSchedule)
+          int skew = pod_spread_skew[(int64_t)p * T + t];
+          if (affinity_ok && skew > 0) {
+            if (dom[t] < 0.0f) { affinity_ok = false; continue; }
+            float self_m = ((pod_aff_match[p] >> t) & 1) ? 1.0f : 0.0f;
+            if (cnt[t] + self_m - spread_min[t] > (float)skew)
               affinity_ok = false;
           }
         }

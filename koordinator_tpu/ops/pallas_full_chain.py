@@ -71,6 +71,7 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         needsnuma_ref, needsbind_ref, fullpcpus_ref, cores_ref,  # f32 [P]
         taintmask_ref,                                            # f32 [P]
         affreq_ref, antireq_ref, affmatch_ref,   # f32 [P] term bitmasks
+        skew0_ref, skew1_ref, skew2_ref,         # f32 [P] skew bit-planes
         affexists0_ref,                          # f32 [max(T,1)] host seed
         qid_ref,                                                  # int32 [P]
         # --- VMEM pod column blocks [R, POD_BLOCK]
@@ -239,9 +240,21 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
                 empty_t = count_t <= 0                              # [N]
                 anti_ok = (~anti_t) | empty_t
                 boot = match_t & (affexists_ref[t] <= 0.0)
-                aff_ok = (~aff_t) | boot | (
-                    (aff_dom[t][0, :] >= 0) & ~empty_t)
+                dom_valid_t = aff_dom[t][0, :] >= 0
+                aff_ok = (~aff_t) | boot | (dom_valid_t & ~empty_t)
                 feasible = feasible & anti_ok & aff_ok
+                # PodTopologySpread: skew reconstructed from 3 bit-planes
+                bit = lambda ref: jnp.remainder(  # noqa: E731
+                    jnp.floor(ref[p] / float(1 << t)), 2.0)
+                skew = (bit(skew0_ref) + 2.0 * bit(skew1_ref)
+                        + 4.0 * bit(skew2_ref))
+                self_m = jnp.where(match_t, 1.0, 0.0)
+                # min over domains the pod is ELIGIBLE for (admission test)
+                min_count = jnp.min(
+                    jnp.where(dom_valid_t & taint_ok, count_t, jnp.inf))
+                spread_ok = (skew <= 0.0) | (
+                    dom_valid_t & (count_t + self_m - min_count <= skew))
+                feasible = feasible & spread_ok
 
             # ---- Score: LoadAware + NodeNUMAResource least-allocated
             headla = jnp.where(prod, headla_pr, headla_np) if prod_mode \
@@ -409,11 +422,16 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             affreq_m = bitmask(fc.pod_aff_req)
             antireq_m = bitmask(fc.pod_anti_req)
             affmatch_m = bitmask(fc.pod_aff_match)
+            skew_i = jnp.asarray(fc.pod_spread_skew, jnp.int32)
+            skew0_m = bitmask((skew_i & 1) > 0)
+            skew1_m = bitmask((skew_i & 2) > 0)
+            skew2_m = bitmask((skew_i & 4) > 0)
             affexists0 = f32(fc.aff_exists)
             affdom0 = f32(fc.aff_dom).T
             affcount0 = f32(fc.aff_count).T
         else:
             affreq_m = antireq_m = affmatch_m = jnp.zeros(P_pad, jnp.float32)
+            skew0_m = skew1_m = skew2_m = affreq_m
             affexists0 = jnp.zeros(1, jnp.float32)
             affdom0 = jnp.full((1, N), -1.0, jnp.float32)
             affcount0 = jnp.zeros((1, N), jnp.float32)
@@ -425,7 +443,8 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             spad(fc.needs_numa), spad(fc.needs_bind),
             spad(fc.full_pcpus), spad(fc.cores_needed),
             jnp.pad(f32(fc.pod_taint_mask), pad_p, constant_values=1.0),
-            affreq_m, antireq_m, affmatch_m, affexists0,
+            affreq_m, antireq_m, affmatch_m,
+            skew0_m, skew1_m, skew2_m, affexists0,
             qid_pad,
             pods_t(inputs.fit_requests), pods_t(fc.requests),
             pods_t(inputs.estimated),
@@ -445,7 +464,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             kernel,
             grid=(P_pad // UNROLL,),
             in_specs=(
-                [smem()] * 14
+                [smem()] * 17
                 + [pod_spec] * 3
                 + [full((R, N))] * 4
                 + [full((1, N))] * 9

@@ -43,6 +43,11 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 MAX_TERMS = 24
+# maxSkew cap: the Pallas kernel carries per-(pod, term) skews as 3 bit-plane
+# bitmasks, so values clamp to 7 — far beyond practical constraints (the
+# upstream default is 1). Clamping happens HERE so every backend (XLA,
+# Pallas, wave, oracle, C++ floor) sees the same value and bindings match.
+MAX_SKEW = 7
 
 # (namespace set, selector item set, topology key) — terms are namespace
 # scoped: an empty PodAffinityTerm.namespaces defaults to the owning pod's
@@ -64,10 +69,20 @@ def _pod_matches(term: Term, pod) -> bool:
     return all(labels.get(k) == v for k, v in selector)
 
 
+def _spread_key(con, pod) -> Term:
+    """Topology-spread constraints share the affinity term space (identical
+    domain/count state); maxSkew rides per (pod, term), so it is NOT part
+    of the identity. Spread selectors apply to the pod's own namespace."""
+    return (frozenset({pod.meta.namespace}),
+            frozenset(con.selector.items()), con.topology_key)
+
+
 def _terms_of(pod) -> List[Term]:
     out = []
     for term in list(pod.spec.pod_affinity) + list(pod.spec.pod_anti_affinity):
         out.append(_term_key(term, pod))
+    for con in pod.spec.topology_spread:
+        out.append(_spread_key(con, pod))
     return out
 
 
@@ -75,7 +90,11 @@ def build_affinity_state(pending_pods, nodes, existing_pods):
     """-> (terms, aff_dom [N, T] f32, aff_count [N, T] f32,
            aff_exists [T] bool,
            aff_req [P_valid, T] bool, anti_req [P_valid, T] bool,
-           match [P_valid, T] bool, overflow_pod_idx: list[int])
+           match [P_valid, T] bool, spread_skew [P_valid, T] f32,
+           overflow_pod_idx: list[int])
+
+    spread_skew[i, t] > 0 means pod i carries a DoNotSchedule topology
+    spread constraint with that maxSkew over term t's domains.
 
     existing_pods: assigned, non-terminated pods (their labels + node names
     seed the counts). aff_exists[t] is True when ANY existing pod matches
@@ -115,9 +134,10 @@ def build_affinity_state(pending_pods, nodes, existing_pods):
     aff_req = np.zeros((P, T), bool)
     anti_req = np.zeros((P, T), bool)
     match = np.zeros((P, T), bool)
+    spread_skew = np.zeros((P, T), np.float32)
     if T == 0:
         return (terms, aff_dom, aff_count, aff_exists, aff_req, anti_req,
-                match, overflow_pods)
+                match, spread_skew, overflow_pods)
 
     # domain ids per term: nodes sharing the topology label value
     node_values: List[dict] = []
@@ -164,5 +184,9 @@ def build_affinity_state(pending_pods, nodes, existing_pods):
             t = ids.get(_term_key(term, pod))
             if t is not None:
                 anti_req[i, t] = True
+        for con in pod.spec.topology_spread:
+            t = ids.get(_spread_key(con, pod))
+            if t is not None:
+                spread_skew[i, t] = float(min(max(con.max_skew, 1), MAX_SKEW))
     return (terms, aff_dom, aff_count, aff_exists, aff_req, anti_req, match,
-            overflow_pods)
+            spread_skew, overflow_pods)

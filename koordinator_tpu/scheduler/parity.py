@@ -217,6 +217,7 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
     pod_aff_req = np.asarray(fc.pod_aff_req)
     pod_anti_req = np.asarray(fc.pod_anti_req)
     pod_aff_match = np.asarray(fc.pod_aff_match)
+    pod_spread_skew = np.asarray(fc.pod_spread_skew, np.float32)
     T = aff_dom.shape[1]
 
     P, R = fit_requests.shape
@@ -271,6 +272,18 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
             continue
         best_n, best_score = -1, np.float32(-1.0)
         best_zone = -1
+        # spread minimums hoisted per (pod, term): invariant across the node
+        # scan, restricted to domains of nodes the pod is ELIGIBLE for
+        # (admission bit test), matching the batched evaluators
+        spread_min = {}
+        if T:
+            elig = (
+                (int(pod_taint_mask[p]) >> node_taint_group) & 1) > 0  # [N]
+            for t in range(T):
+                if pod_spread_skew[p, t] > 0:
+                    valid = (aff_dom[:, t] >= 0) & elig
+                    spread_min[t] = (aff_count[valid, t].min()
+                                     if valid.any() else np.inf)
         for n in range(N):
             if not node_ok[n]:
                 continue
@@ -296,6 +309,15 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
                     bootstrap = pod_aff_match[p, t] and not aff_exists[t]
                     if not ((aff_dom[n, t] >= 0 and aff_count[n, t] > 0)
                             or bootstrap):
+                        affinity_ok = False
+                        break
+                skew = pod_spread_skew[p, t]
+                if skew > 0:
+                    if aff_dom[n, t] < 0:
+                        affinity_ok = False
+                        break
+                    self_match = 1.0 if pod_aff_match[p, t] else 0.0
+                    if aff_count[n, t] + self_match - spread_min[t] > skew:
                         affinity_ok = False
                         break
             if not affinity_ok:

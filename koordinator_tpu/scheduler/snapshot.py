@@ -300,8 +300,8 @@ def build_full_chain_inputs(
         if p.is_assigned and not p.is_terminated
     ]
     (_aff_terms, dom_v, count_v, aff_exists, aff_req_v, anti_req_v, match_v,
-     aff_overflow) = build_affinity_state(ordered_pending, state.nodes,
-                                          existing)
+     spread_v, aff_overflow) = build_affinity_state(
+        ordered_pending, state.nodes, existing)
     T = dom_v.shape[1]
     aff_dom = np.full((N, T), -1.0, np.float32)
     aff_dom[: dom_v.shape[0]] = dom_v
@@ -313,6 +313,8 @@ def build_full_chain_inputs(
     pod_anti_req[: anti_req_v.shape[0]] = anti_req_v
     pod_aff_match = np.zeros((P, T), bool)
     pod_aff_match[: match_v.shape[0]] = match_v
+    pod_spread_skew = np.zeros((P, T), np.float32)
+    pod_spread_skew[: spread_v.shape[0]] = spread_v
     for i in aff_overflow:  # conservative: term encoding overflow
         pods.valid[i] = False
 
@@ -331,6 +333,7 @@ def build_full_chain_inputs(
         pod_aff_req=np.asarray(pod_aff_req),
         pod_anti_req=np.asarray(pod_anti_req),
         pod_aff_match=np.asarray(pod_aff_match),
+        pod_spread_skew=np.asarray(pod_spread_skew),
         node_taint_group=np.asarray(node_taint_group),
         aff_dom=np.asarray(aff_dom),
         aff_count=np.asarray(aff_count),

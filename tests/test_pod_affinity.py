@@ -220,3 +220,97 @@ def test_bootstrap_sees_match_on_unlabeled_node():
     # a match exists (on the unlabeled node) but no labeled domain has one,
     # so no bootstrap and no labeled placement: all replicas unschedulable
     assert (chosen[: len(pods.keys)] < 0).all()
+
+
+def test_topology_spread_do_not_schedule():
+    """DoNotSchedule maxSkew=1 over zones: replicas fill domains round-robin
+    and never let any domain get 2 ahead of the emptiest; identical across
+    XLA, oracle, Pallas interpret, wave, and the C++ floor."""
+    from koordinator_tpu.api.objects import TopologySpreadConstraint
+    from koordinator_tpu.models.wave_chain import build_wave_full_chain_step
+    from koordinator_tpu.native import floor as native_floor
+    from koordinator_tpu.ops.pallas_full_chain import (
+        build_pallas_full_chain_step,
+    )
+
+    args = LoadAwareArgs()
+    cluster, state = synth_full_cluster(18, 24, seed=23)
+    for j, node in enumerate(state.nodes):
+        node.meta.labels[ZONE_KEY] = f"z{j % 3}"
+    n_spread = 0
+    for i, pod in enumerate(state.pending_pods):
+        if i % 2 == 0:
+            pod.meta.labels["app"] = "web"
+            pod.spec.topology_spread.append(TopologySpreadConstraint(
+                max_skew=1, topology_key=ZONE_KEY,
+                selector={"app": "web"}))
+            n_spread += 1
+    fc, pods, nodes, tree, gi, ng, ngroups = build_full_chain_inputs(
+        state, args)
+    assert (np.asarray(fc.pod_spread_skew) > 0).any()
+    chosen = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    serial = serial_schedule_full(fc, args)
+    n = len(pods.keys)
+    np.testing.assert_array_equal(chosen[:n], serial[:n])
+    chosen_p = np.asarray(
+        build_pallas_full_chain_step(args, ng, ngroups, interpret=True)(fc)[0])
+    np.testing.assert_array_equal(chosen, chosen_p)
+    chosen_w = np.asarray(
+        build_wave_full_chain_step(args, ng, ngroups, wave=8)(fc)[0])
+    np.testing.assert_array_equal(chosen, chosen_w)
+    if native_floor.available() or native_floor.build():
+        chosen_nat = native_floor.serial_schedule_full_native(
+            fc, args, num_groups=ngroups)
+        np.testing.assert_array_equal(chosen[:n], chosen_nat[:n])
+
+    # semantics: per-zone counts of placed spread pods differ by <= 1
+    by_key = {p.meta.key: p for p in state.pending_pods}
+    zone_counts = {}
+    placed_spread = 0
+    for i, key in enumerate(pods.keys):
+        if chosen[i] < 0:
+            continue
+        pod = by_key[key]
+        if pod.spec.topology_spread:
+            z = state.nodes[chosen[i]].meta.labels[ZONE_KEY]
+            zone_counts[z] = zone_counts.get(z, 0) + 1
+            placed_spread += 1
+    assert placed_spread >= 3
+    counts = list(zone_counts.values()) + [0] * (3 - len(zone_counts))
+    assert max(counts) - min(counts) <= 1, zone_counts
+
+
+def test_spread_min_ignores_ineligible_domains():
+    """A zone the pod's nodeSelector excludes must not pin the spread
+    minimum at 0: selector-restricted replicas keep placing into their two
+    allowed zones even while a third (forbidden) zone stays empty."""
+    from koordinator_tpu.api.objects import TopologySpreadConstraint
+
+    args = LoadAwareArgs()
+    cluster, state = synth_full_cluster(18, 12, seed=31)
+    for j, node in enumerate(state.nodes):
+        z = f"z{j % 3}"
+        node.meta.labels[ZONE_KEY] = z
+        node.meta.labels["allowed"] = "yes" if z != "z2" else "no"
+    for pod in state.pending_pods:
+        pod.meta.labels["app"] = "web"
+        pod.spec.node_selector["allowed"] = "yes"
+        pod.spec.topology_spread.append(TopologySpreadConstraint(
+            max_skew=1, topology_key=ZONE_KEY, selector={"app": "web"}))
+    fc, pods, nodes, tree, gi, ng, ngroups = build_full_chain_inputs(
+        state, args)
+    chosen = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    serial = serial_schedule_full(fc, args)
+    n = len(pods.keys)
+    np.testing.assert_array_equal(chosen[:n], serial[:n])
+    placed = (chosen[:n] >= 0).sum()
+    # with the global (buggy) min, only 2 pods could ever place (one per
+    # allowed zone); eligibility-aware min keeps filling both zones evenly
+    assert placed >= 4, f"only {placed} placed"
+    zones = [state.nodes[chosen[i]].meta.labels[ZONE_KEY]
+             for i in range(n) if chosen[i] >= 0]
+    assert "z2" not in zones
+    from collections import Counter
+
+    counts = Counter(zones)
+    assert abs(counts.get("z0", 0) - counts.get("z1", 0)) <= 1
