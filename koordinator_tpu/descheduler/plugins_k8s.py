@@ -270,6 +270,177 @@ class RemovePodsViolatingNodeTaints(DeschedulePlugin):
         return Status()
 
 
+class RemovePodsViolatingInterPodAntiAffinity(DeschedulePlugin):
+    """Evict pods whose required anti-affinity terms are violated by a
+    co-located pod in the same topology domain (sigs.k8s.io
+    removepodsviolatinginterpodantiaffinity). Runtime violations appear when
+    pods were placed before the constraint existed or labels changed."""
+
+    name = "RemovePodsViolatingInterPodAntiAffinity"
+
+    def __init__(self, store: ObjectStore, args: dict = None) -> None:
+        self.store = store
+        self.handle = None
+
+    def deschedule(self, nodes: List[Node], now: float) -> Status:
+        from koordinator_tpu.ops.podaffinity import _pod_matches, _term_key
+
+        by_name = {n.meta.name: n for n in nodes}
+        live = _live_assigned(self.store)
+        evicted: set = set()
+        for pod in live:
+            if not pod.spec.pod_anti_affinity or pod.meta.key in evicted:
+                continue
+            node = by_name.get(pod.spec.node_name)
+            if node is None:
+                continue
+            violated = False
+            for raw in pod.spec.pod_anti_affinity:
+                term = _term_key(raw, pod)
+                dom = node.meta.labels.get(raw.topology_key)
+                if dom is None:
+                    continue
+                for other in live:
+                    # pods evicted earlier in this pass no longer violate —
+                    # evicting ONE of a mutually-violating pair resolves it
+                    if other.meta.key == pod.meta.key or \
+                            other.meta.key in evicted:
+                        continue
+                    other_node = by_name.get(other.spec.node_name)
+                    if other_node is None or \
+                            other_node.meta.labels.get(
+                                raw.topology_key) != dom:
+                        continue
+                    if _pod_matches(term, other):
+                        violated = True
+                        break
+                if violated:
+                    break
+            if violated and self.handle.evict(
+                    pod, self.name, "anti-affinity violated"):
+                evicted.add(pod.meta.key)
+        return Status()
+
+
+class RemovePodsViolatingTopologySpreadConstraint(BalancePlugin):
+    """Evict pods from over-populated topology domains until every
+    DoNotSchedule spread constraint's skew fits maxSkew again
+    (sigs.k8s.io removepodsviolatingtopologyspreadconstraint)."""
+
+    name = "RemovePodsViolatingTopologySpreadConstraint"
+
+    def __init__(self, store: ObjectStore, args: dict = None) -> None:
+        self.store = store
+        self.handle = None
+
+    def balance(self, nodes: List[Node], now: float) -> Status:
+        from koordinator_tpu.ops.podaffinity import _pod_matches, _spread_key
+
+        by_name = {n.meta.name: n for n in nodes}
+        live = _live_assigned(self.store)
+        # group constraints by (term identity, maxSkew): all pods carrying
+        # the same constraint share one skew computation
+        carriers: Dict[tuple, List[Pod]] = defaultdict(list)
+        for pod in live:
+            for con in pod.spec.topology_spread:
+                carriers[(_spread_key(con, pod), int(con.max_skew))].append(
+                    pod)
+        for (term, max_skew), constrained in carriers.items():
+            topology_key = term[2]
+            # a domain counts toward the minimum only if a SCHEDULABLE node
+            # in it could host one of the constrained pods — the same
+            # eligibility stance the scheduler's spread filter takes, so
+            # the two sides can never evict/re-place in a loop (a forbidden
+            # or fully-cordoned zone cannot pin the minimum at 0)
+            domains: Dict[str, List[Pod]] = {}
+            for n in nodes:
+                val = n.meta.labels.get(topology_key)
+                if val is None or n.unschedulable:
+                    continue
+                if any(node_matches_pod(n, p) for p in constrained):
+                    domains.setdefault(val, [])
+            if not domains:
+                continue
+            for other in live:
+                node = by_name.get(other.spec.node_name)
+                if node is None:
+                    continue
+                val = node.meta.labels.get(topology_key)
+                if val in domains and _pod_matches(term, other):
+                    domains[val].append(other)
+            counts = {d: len(ps) for d, ps in domains.items()}
+            min_count = min(counts.values())
+            for dom, pods_in in sorted(domains.items()):
+                excess = counts[dom] - (min_count + max_skew)
+                if excess <= 0:
+                    continue
+                victims = sorted(
+                    pods_in, key=lambda p: p.meta.creation_timestamp,
+                    reverse=True)[:excess]
+                for pod in victims:
+                    self.handle.evict(
+                        pod, self.name,
+                        f"topology skew {counts[dom] - min_count} > "
+                        f"maxSkew {max_skew} in {topology_key}={dom}")
+        return Status()
+
+
+class HighNodeUtilization(BalancePlugin):
+    """Bin-packing consolidation: evict movable pods from UNDER-utilized
+    nodes so the cluster can be compacted (sigs.k8s.io
+    highnodeutilization — the inverse of LowNodeLoad's spreading)."""
+
+    name = "HighNodeUtilization"
+
+    def __init__(self, store: ObjectStore, args: dict = None) -> None:
+        self.store = store
+        self.args = args or {}
+        self.handle = None
+
+    def balance(self, nodes: List[Node], now: float) -> Status:
+        from koordinator_tpu.client.store import KIND_NODE_METRIC
+
+        threshold = float(self.args.get("cpu_threshold_percent", 20))
+        under = []
+        for node in nodes:
+            if node.unschedulable:
+                continue
+            nm = self.store.get(KIND_NODE_METRIC, f"/{node.meta.name}")
+            if nm is None:
+                continue
+            cap = node.allocatable.get("cpu", 0)
+            used = nm.node_metric.node_usage.get("cpu")
+            if cap and used is not None and used * 100.0 / cap < threshold:
+                under.append(node)
+        schedulable = [n for n in nodes if not n.unschedulable]
+        if len(under) < 1 or len(under) == len(schedulable):
+            return Status()  # nothing to consolidate onto
+        under_names = {n.meta.name for n in under}
+        # absorb budget: spare cpu on the nodes pods would consolidate onto
+        # (upstream stops when target capacity runs out — evicting more
+        # than fits would churn: the scheduler puts the rest back)
+        requested_by_node: Dict[str, int] = defaultdict(int)
+        live = _live_assigned(self.store)
+        for pod in live:
+            requested_by_node[pod.spec.node_name] += \
+                pod.spec.requests.get("cpu", 0)
+        spare = sum(
+            max(n.allocatable.get("cpu", 0)
+                - requested_by_node[n.meta.name], 0)
+            for n in schedulable if n.meta.name not in under_names
+        )
+        for pod in live:
+            if pod.spec.node_name not in under_names:
+                continue
+            need = pod.spec.requests.get("cpu", 0)
+            if need > spare:
+                continue
+            if self.handle.evict(
+                    pod, self.name, "under-utilized node consolidation"):
+                spare -= need
+        return Status()
+
+
 def register_defaults() -> None:
     """Install the built-in plugin set into the framework registry."""
     from koordinator_tpu.descheduler.framework import DefaultEvictor
@@ -299,6 +470,20 @@ def register_defaults() -> None:
     register_plugin(
         "RemovePodsViolatingNodeTaints",
         lambda store, args: RemovePodsViolatingNodeTaints(store, args),
+    )
+    register_plugin(
+        "RemovePodsViolatingInterPodAntiAffinity",
+        lambda store, args: RemovePodsViolatingInterPodAntiAffinity(
+            store, args),
+    )
+    register_plugin(
+        "RemovePodsViolatingTopologySpreadConstraint",
+        lambda store, args: RemovePodsViolatingTopologySpreadConstraint(
+            store, args),
+    )
+    register_plugin(
+        "HighNodeUtilization",
+        lambda store, args: HighNodeUtilization(store, args),
     )
     register_plugin(
         "LowNodeLoad",

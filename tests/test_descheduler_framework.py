@@ -362,3 +362,164 @@ def test_podlifetime_requires_max_seconds():
     store = ObjectStore()
     with pytest.raises(ValueError, match="maxPodLifeTimeSeconds"):
         Profile(ProfileConfig(deschedule=["PodLifeTime"]), store)
+
+
+class TestAffinitySpreadPlugins:
+    def test_anti_affinity_violation_evicted(self):
+        from koordinator_tpu.api.objects import PodAffinityTerm
+
+        store = ObjectStore()
+        _node(store, "node-a", labels={"zone": "z0"})
+        _node(store, "node-b", labels={"zone": "z1"})
+        solo = _pod(store, "solo", node="node-a", labels={"app": "db"})
+        solo.spec.pod_anti_affinity.append(PodAffinityTerm(
+            selector={"app": "db"}, topology_key="zone"))
+        store.update(KIND_POD, solo)
+        intruder = _pod(store, "intruder", node="node-a",
+                        labels={"app": "db"})
+        clean = _pod(store, "clean", node="node-b", labels={"app": "db"})
+        profile = Profile(ProfileConfig(
+            deschedule=["RemovePodsViolatingInterPodAntiAffinity"]), store)
+        profile.run(NOW)
+        assert store.get(KIND_POD, solo.meta.key).is_terminated
+        assert not store.get(KIND_POD, intruder.meta.key).is_terminated
+        assert not store.get(KIND_POD, clean.meta.key).is_terminated
+
+    def test_anti_affinity_namespace_scoped(self):
+        from koordinator_tpu.api.objects import PodAffinityTerm
+
+        store = ObjectStore()
+        _node(store, "node-a", labels={"zone": "z0"})
+
+        def ns_pod(name, ns):
+            pod = Pod(meta=ObjectMeta(name=name, namespace=ns,
+                                      labels={"app": "db"},
+                                      creation_timestamp=NOW - 100),
+                      spec=PodSpec(node_name="node-a"), phase="Running")
+            store.add(KIND_POD, pod)
+            return pod
+
+        guarded = ns_pod("guarded", "ns-a")
+        guarded.spec.pod_anti_affinity.append(PodAffinityTerm(
+            selector={"app": "db"}, topology_key="zone"))
+        ns_pod("foreign", "ns-b")
+        profile = Profile(ProfileConfig(
+            deschedule=["RemovePodsViolatingInterPodAntiAffinity"]), store)
+        profile.run(NOW)
+        # the only same-namespace match is itself: no violation
+        assert not store.get(KIND_POD, guarded.meta.key).is_terminated
+
+    def test_topology_spread_violation_evicted(self):
+        from koordinator_tpu.api.objects import TopologySpreadConstraint
+
+        store = ObjectStore()
+        _node(store, "node-a", labels={"zone": "z0"})
+        _node(store, "node-b", labels={"zone": "z1"})
+        crowded = []
+        for i in range(4):
+            p = _pod(store, f"crowd-{i}", node="node-a",
+                     labels={"app": "web"}, created=NOW - 100 + i)
+            p.spec.topology_spread.append(TopologySpreadConstraint(
+                max_skew=1, topology_key="zone", selector={"app": "web"}))
+            store.update(KIND_POD, p)
+            crowded.append(p)
+        lone = _pod(store, "lone", node="node-b", labels={"app": "web"})
+        profile = Profile(ProfileConfig(
+            balance=["RemovePodsViolatingTopologySpreadConstraint"]), store)
+        profile.run(NOW)
+        # z0 has 4, z1 has 1: skew 3 > 1 -> evict 2 newest from z0
+        evicted = [p.meta.name for p in crowded
+                   if store.get(KIND_POD, p.meta.key).is_terminated]
+        assert evicted == ["crowd-2", "crowd-3"]
+        assert not store.get(KIND_POD, lone.meta.key).is_terminated
+
+    def test_high_node_utilization_consolidates(self):
+        from koordinator_tpu.api.objects import NodeMetric, NodeMetricInfo
+        from koordinator_tpu.client.store import KIND_NODE_METRIC
+
+        store = ObjectStore()
+        _node(store, "node-idle")
+        _node(store, "node-busy")
+        for name, cpu in (("node-idle", 800), ("node-busy", 12_000)):
+            store.add(KIND_NODE_METRIC, NodeMetric(
+                meta=ObjectMeta(name=name, namespace=""),
+                node_metric=NodeMetricInfo(
+                    node_usage=ResourceList.of(cpu=cpu)),
+                update_time=NOW))
+        idle_pod = _pod(store, "on-idle", node="node-idle")
+        busy_pod = _pod(store, "on-busy", node="node-busy")
+        profile = Profile(ProfileConfig(
+            balance=["HighNodeUtilization"],
+            plugin_args={"HighNodeUtilization":
+                         {"cpu_threshold_percent": 20}}), store)
+        profile.run(NOW)
+        assert store.get(KIND_POD, idle_pod.meta.key).is_terminated
+        assert not store.get(KIND_POD, busy_pod.meta.key).is_terminated
+
+    def test_anti_affinity_mutual_violation_evicts_only_one(self):
+        from koordinator_tpu.api.objects import PodAffinityTerm
+
+        store = ObjectStore()
+        _node(store, "node-a", labels={"zone": "z0"})
+        pair = []
+        for name in ("a", "b"):
+            p = _pod(store, name, node="node-a", labels={"app": "db"})
+            p.spec.pod_anti_affinity.append(PodAffinityTerm(
+                selector={"app": "db"}, topology_key="zone"))
+            store.update(KIND_POD, p)
+            pair.append(p)
+        profile = Profile(ProfileConfig(
+            deschedule=["RemovePodsViolatingInterPodAntiAffinity"]), store)
+        profile.run(NOW)
+        terminated = [p for p in pair
+                      if store.get(KIND_POD, p.meta.key).is_terminated]
+        assert len(terminated) == 1  # evicting one resolves the violation
+
+    def test_spread_plugin_min_ignores_ineligible_domains(self):
+        from koordinator_tpu.api.objects import TopologySpreadConstraint
+
+        store = ObjectStore()
+        _node(store, "node-a", labels={"zone": "z0", "allowed": "yes"})
+        _node(store, "node-b", labels={"zone": "z1", "allowed": "yes"})
+        _node(store, "node-c", labels={"zone": "z2", "allowed": "no"})
+        for i in range(6):
+            node = "node-a" if i % 2 == 0 else "node-b"
+            p = _pod(store, f"w{i}", node=node, labels={"app": "web"},
+                     selector={"allowed": "yes"})
+            p.spec.topology_spread.append(TopologySpreadConstraint(
+                max_skew=1, topology_key="zone", selector={"app": "web"}))
+            store.update(KIND_POD, p)
+        profile = Profile(ProfileConfig(
+            balance=["RemovePodsViolatingTopologySpreadConstraint"]), store)
+        profile.run(NOW)
+        # 3/3 across the two ELIGIBLE zones is balanced; the empty forbidden
+        # z2 must not pin the minimum at 0 and trigger evictions
+        assert all(not p.is_terminated for p in store.list(KIND_POD))
+
+    def test_high_node_utilization_respects_absorb_capacity(self):
+        from koordinator_tpu.api.objects import NodeMetric, NodeMetricInfo
+        from koordinator_tpu.client.store import KIND_NODE_METRIC
+
+        store = ObjectStore()
+        _node(store, "node-idle")
+        # busy node with almost no spare cpu: 15 pods x 1000m of 16000m
+        _node(store, "node-busy")
+        for i in range(15):
+            _pod(store, f"busy-{i}", node="node-busy")
+        for name, cpu in (("node-idle", 800), ("node-busy", 15_000)):
+            store.add(KIND_NODE_METRIC, NodeMetric(
+                meta=ObjectMeta(name=name, namespace=""),
+                node_metric=NodeMetricInfo(
+                    node_usage=ResourceList.of(cpu=cpu)),
+                update_time=NOW))
+        idle_pods = [_pod(store, f"idle-{i}", node="node-idle")
+                     for i in range(4)]
+        profile = Profile(ProfileConfig(
+            balance=["HighNodeUtilization"],
+            plugin_args={"HighNodeUtilization":
+                         {"cpu_threshold_percent": 20}}), store)
+        profile.run(NOW)
+        evicted = sum(store.get(KIND_POD, p.meta.key).is_terminated
+                      for p in idle_pods)
+        # only 1000m spare on node-busy -> exactly one pod may move
+        assert evicted == 1
