@@ -396,3 +396,81 @@ def test_taint_toleration_end_to_end():
     assert by_pod["default/infra"] == "dedicated"
     assert "default/too-big" not in by_pod
     assert "default/too-big" in result.failed
+
+
+def test_affinity_spread_selector_end_to_end():
+    """The production cycle driver honors nodeSelector, required inter-pod
+    anti-affinity, and DoNotSchedule topology spread together: zone-pinned
+    HA replicas spread one-per-zone inside their pool, web replicas spread
+    evenly, and a co-location pair lands together."""
+    from koordinator_tpu.api.objects import (
+        PodAffinityTerm,
+        TopologySpreadConstraint,
+    )
+
+    store = ObjectStore()
+    for i in range(6):
+        store.add(KIND_NODE, Node(
+            meta=ObjectMeta(name=f"n{i}", namespace="", labels={
+                "zone": f"z{i % 3}",
+                "pool": "gold" if i < 4 else "silver",
+            }),
+            allocatable=ResourceList.of(cpu=32000, memory=128 * GIB,
+                                        pods=100),
+        ))
+    now = NOW
+
+    def add(name, labels=None, **spec_kw):
+        pod = Pod(meta=ObjectMeta(name=name, uid=name, creation_timestamp=now,
+                                  labels=labels or {}),
+                  spec=PodSpec(requests=ResourceList.of(cpu=1000, memory=GIB),
+                               **spec_kw))
+        store.add(KIND_POD, pod)
+        return pod
+
+    # 3 HA replicas: anti-affinity per zone, pinned to the gold pool
+    for i in range(3):
+        p = add(f"ha-{i}", labels={"app": "ha"},
+                node_selector={"pool": "gold"})
+        p.spec.pod_anti_affinity.append(PodAffinityTerm(
+            selector={"app": "ha"}, topology_key="zone"))
+    # 6 web replicas: spread maxSkew=1 over zones
+    for i in range(6):
+        p = add(f"web-{i}", labels={"app": "web"})
+        p.spec.topology_spread.append(TopologySpreadConstraint(
+            max_skew=1, topology_key="zone", selector={"app": "web"}))
+    # co-location pair: follower requires the leader's zone
+    add("leader", labels={"app": "pair"})
+    f = add("follower")
+    f.spec.pod_affinity.append(PodAffinityTerm(
+        selector={"app": "pair"}, topology_key="zone"))
+
+    scheduler = Scheduler(store)
+    result = scheduler.run_cycle(now=now)
+    by_pod = {b.pod_key: b.node_name for b in result.bound}
+    if "default/follower" not in by_pod:
+        # the follower may precede the leader in queue order; like upstream
+        # it stays pending until a match EXISTS — the next cycle binds it
+        result2 = scheduler.run_cycle(now=now + 1)
+        by_pod.update({b.pod_key: b.node_name for b in result2.bound})
+    nodes = {n.meta.name: n for n in store.list(KIND_NODE)}
+
+    ha_zones = [nodes[by_pod[f"default/ha-{i}"]].meta.labels["zone"]
+                for i in range(3)]
+    assert sorted(ha_zones) == ["z0", "z1", "z2"]
+    for i in range(3):
+        assert nodes[by_pod[f"default/ha-{i}"]].meta.labels["pool"] == "gold"
+
+    from collections import Counter
+
+    web_zones = Counter(
+        nodes[by_pod[f"default/web-{i}"]].meta.labels["zone"]
+        for i in range(6))
+    # 6 replicas over 3 zones at maxSkew=1 admit exactly one outcome —
+    # a skew check over only the POPULATED zones would pass a total
+    # spread failure (all six in one zone has skew 0 over itself)
+    assert dict(web_zones) == {"z0": 2, "z1": 2, "z2": 2}
+
+    leader_zone = nodes[by_pod["default/leader"]].meta.labels["zone"]
+    follower_zone = nodes[by_pod["default/follower"]].meta.labels["zone"]
+    assert leader_zone == follower_zone
