@@ -312,29 +312,49 @@ class Scheduler:
         # if any round evicts victims, one kernel rerun retries every pod that
         # is still unbound (the reference's nominate-then-reschedule collapses
         # into an in-cycle retry because victims terminate synchronously here).
+        any_victims = False
         if self.preemptor is not None and rejected_pods:
             quota_rejected = [
                 p for p in rejected_pods if p.quota_name and not p.gang_name
             ]
-            any_victims = False
             for round_ in self.preemptor.post_filter(quota_rejected):
                 any_victims = True
                 result.preempted_victims.extend(round_.victim_keys)
-            if any_victims:
-                # retry transforms from the ORIGINAL queued pods, not the
-                # already-transformed views — a non-idempotent rewrite would
-                # otherwise apply twice (BeforePreFilter runs per attempt on
-                # the queued pod in the reference too)
-                retry = self.extender.transform_before_prefilter(
-                    [
-                        originals.get(p.meta.key, p)
-                        for p in rejected_pods + [p for p, _ in failed_pods]
-                    ],
-                    ctx,
-                )
-                rejected_pods, failed_pods = self._batch_pass(
-                    retry, now, ctx, result, pending_reservations
-                )
+        # ---- PostFilter: DefaultPreemption (the vendored kube fallback) —
+        # pods with no feasible node try priority preemption; victims
+        # terminate synchronously and the kernel rerun is the real gate.
+        # The attempted-latch stops a pod the kernel STILL rejects (e.g.
+        # spread/NUMA constraints the host dry-run cannot see) from
+        # draining a fresh victim set every cycle; it clears when the pod
+        # finally binds or leaves the queue.
+        attempted = getattr(self, "_preempt_attempted", set())
+        self._preempt_attempted = attempted
+        no_fit = [p for p, reason in failed_pods
+                  if reason == "no feasible node" and not p.gang_name
+                  and p.meta.key not in attempted]
+        if no_fit:
+            from koordinator_tpu.scheduler.preempt import DefaultPreemption
+
+            for round_ in DefaultPreemption(self.store).post_filter(no_fit):
+                any_victims = True
+                attempted.add(round_.preemptor_key)
+                result.preempted_victims.extend(round_.victim_keys)
+        if any_victims:
+            # retry transforms from the ORIGINAL queued pods, not the
+            # already-transformed views — a non-idempotent rewrite would
+            # otherwise apply twice (BeforePreFilter runs per attempt on
+            # the queued pod in the reference too)
+            retry = self.extender.transform_before_prefilter(
+                [
+                    originals.get(p.meta.key, p)
+                    for p in rejected_pods + [p for p, _ in failed_pods]
+                ],
+                ctx,
+            )
+            rejected_pods, failed_pods = self._batch_pass(
+                retry, now, ctx, result, pending_reservations
+            )
+        attempted.difference_update(b.pod_key for b in result.bound)
 
         for pod in rejected_pods:
             result.rejected.append(pod.meta.key)

@@ -136,37 +136,10 @@ class QuotaPreemptor:
 
     def _split_by_pdb(self, ordered: List[Pod]):
         """Stable split into (violating, non_violating) with shared
-        DisruptionsAllowed budgets (preempt.go:219-268)."""
-        from koordinator_tpu.client.store import KIND_PDB
-
-        pdbs = list(self.store.list(KIND_PDB))
-        if not pdbs:
-            return [], list(ordered)
-        pods = list(self.store.list(KIND_POD))
-        allowed: Dict[int, int] = {}
-        for i, pdb in enumerate(pdbs):
-            matching = [p for p in pods if pdb.matches(p)]
-            healthy = sum(1 for p in matching if p.is_healthy)
-            if pdb.min_available is not None:
-                allowed[i] = healthy - pdb.min_available
-            elif pdb.max_unavailable is not None:
-                unavailable = len(matching) - healthy
-                allowed[i] = pdb.max_unavailable - unavailable
-            else:
-                allowed[i] = 0
-        violating, non_violating = [], []
-        for pod in ordered:
-            violated = False
-            for i, pdb in enumerate(pdbs):
-                # an unhealthy victim consumes no budget and can never
-                # violate: evicting it leaves the healthy count unchanged
-                if not pdb.matches(pod) or not pod.is_healthy:
-                    continue
-                allowed[i] -= 1
-                if allowed[i] < 0:
-                    violated = True
-            (violating if violated else non_violating).append(pod)
-        return violating, non_violating
+        DisruptionsAllowed budgets (preempt.go:219-268) — the module-level
+        helpers, budgets computed fresh per call."""
+        pdbs, allowed = pdb_disruption_budgets(self.store)
+        return split_by_pdb(pdbs, allowed, ordered)
 
     # -- the PostFilter entry ------------------------------------------
     def post_filter(self, rejected: List[Pod]) -> List[PreemptionRound]:
@@ -215,21 +188,226 @@ class QuotaPreemptor:
             victims = self._select_victims(pod, req, chain, used, runtime)
             if not victims:
                 continue
-            round_ = PreemptionRound(
-                preemptor_key=pod.meta.key, quota_name=pod.quota_name
-            )
-            from koordinator_tpu.descheduler.evictions import terminate_pod
-
-            for v in victims:
-                terminate_pod(
-                    self.store, v, "koordinator.sh/preempted-by", pod.meta.key
-                )
-                round_.victim_keys.append(v.meta.key)
-            rounds.append(round_)
+            rounds.append(evict_round(self.store, pod, victims))
             inflight.append((pod.quota_name, req))
             # evictions changed store-backed used (and group request): rebuild
             snap = self.plugin.tree_snapshot(self.store)
             if snap is None:
                 break
             tree, runtime = snap
+        return rounds
+
+
+def pdb_disruption_budgets(store: ObjectStore):
+    """(pdbs, allowed): each PDB's DisruptionsAllowed computed once —
+    preempt.go:219-268 / upstream filterPodsWithPDBViolation keep a shared
+    counter per PDB, so callers hand split_by_pdb a COPY of `allowed`."""
+    from koordinator_tpu.client.store import KIND_PDB
+
+    pdbs = list(store.list(KIND_PDB))
+    if not pdbs:
+        return [], []
+    pods = list(store.list(KIND_POD))
+    allowed: List[int] = []
+    for pdb in pdbs:
+        matching = [p for p in pods if pdb.matches(p)]
+        healthy = sum(1 for p in matching if p.is_healthy)
+        if pdb.min_available is not None:
+            allowed.append(healthy - pdb.min_available)
+        elif pdb.max_unavailable is not None:
+            unavailable = len(matching) - healthy
+            allowed.append(pdb.max_unavailable - unavailable)
+        else:
+            allowed.append(0)
+    return pdbs, allowed
+
+
+def split_by_pdb(pdbs, allowed: List[int], ordered: List[Pod]):
+    """Stable split of `ordered` into (violating, non_violating), consuming
+    the shared `allowed` budgets in order (the caller passes a copy)."""
+    if not pdbs:
+        return [], list(ordered)
+    violating, non_violating = [], []
+    for pod in ordered:
+        violated = False
+        for i, pdb in enumerate(pdbs):
+            # an unhealthy victim consumes no budget and can never
+            # violate: evicting it leaves the healthy count unchanged
+            if not pdb.matches(pod) or not pod.is_healthy:
+                continue
+            allowed[i] -= 1
+            if allowed[i] < 0:
+                violated = True
+        (violating if violated else non_violating).append(pod)
+    return violating, non_violating
+
+
+def evict_round(store: ObjectStore, preemptor: Pod,
+                victims: List[Pod]) -> PreemptionRound:
+    """Terminate the victims and record the round (shared by the quota and
+    default preemptors)."""
+    from koordinator_tpu.descheduler.evictions import terminate_pod
+
+    round_ = PreemptionRound(preemptor_key=preemptor.meta.key,
+                             quota_name=preemptor.quota_name)
+    for v in victims:
+        terminate_pod(store, v, "koordinator.sh/preempted-by",
+                      preemptor.meta.key)
+        round_.victim_keys.append(v.meta.key)
+    return round_
+
+
+class DefaultPreemption:
+    """Priority (pod-level) preemption — the analog of the vendored
+    kube-scheduler DefaultPreemption PostFilter the reference binary ships.
+
+    For each pod that failed Filter on every node, dry-run removing
+    lower-priority victims per node host-side — static admission (taints +
+    selector/affinity labels), resources (allocatable vs assigned
+    requests), and the pod's required (anti-)affinity terms against the
+    post-eviction state — reprieving candidates from the most important
+    down, PDB-violating ones first, and pick the node upstream's
+    pickOneNodeForPreemption would: fewest PDB violations, then lowest max
+    victim priority, then smallest priority sum, then fewest victims.
+    Earlier preemptors' requests ride a per-node inflight ledger so later
+    ones don't count freed space twice. Victims terminate synchronously
+    and the cycle driver reruns the batched kernel, which is the REAL
+    feasibility gate (NUMA/cpuset/LoadAware/spread re-check there; the
+    cycle's attempted-latch stops a pod that still cannot bind from
+    draining victims every cycle)."""
+
+    def __init__(self, store: ObjectStore) -> None:
+        self.store = store
+
+    @staticmethod
+    def _static_admission(pod: Pod, node) -> bool:
+        from koordinator_tpu.ops.taints import (
+            required_node_pairs,
+            tolerates_taints,
+        )
+
+        if node.unschedulable:
+            return False
+        if not tolerates_taints(pod.spec.tolerations, node.taints):
+            return False
+        labels = node.meta.labels
+        return all(labels.get(k) == v for k, v in required_node_pairs(pod))
+
+    @staticmethod
+    def _affinity_feasible(pod: Pod, node, survivors: List[Pod],
+                           nodes_by_name: Dict[str, object]) -> bool:
+        """Required (anti-)affinity dry-run against the post-eviction pod
+        set: every anti term has no surviving match in the node's domain,
+        every affinity term keeps a match (or bootstraps). Without this, a
+        pod blocked by kernel-only constraints would evict victims in vain
+        every cycle."""
+        from koordinator_tpu.ops.podaffinity import _pod_matches, _term_key
+
+        def domain_match(term, key) -> bool:
+            dom = node.meta.labels.get(key)
+            if dom is None:
+                return False
+            for other in survivors:
+                onode = nodes_by_name.get(other.spec.node_name)
+                if onode is None or onode.meta.labels.get(key) != dom:
+                    continue
+                if _pod_matches(term, other):
+                    return True
+            return False
+
+        for raw in pod.spec.pod_anti_affinity:
+            if node.meta.labels.get(raw.topology_key) is None:
+                continue
+            if domain_match(_term_key(raw, pod), raw.topology_key):
+                return False
+        for raw in pod.spec.pod_affinity:
+            term = _term_key(raw, pod)
+            if any(_pod_matches(term, o) for o in survivors):
+                if not domain_match(term, raw.topology_key):
+                    return False
+            # no match anywhere: feasible only via self-match bootstrap
+            elif not _pod_matches(term, pod):
+                return False
+        return True
+
+    def post_filter(self, failed: List[Pod]) -> List[PreemptionRound]:
+        from koordinator_tpu.client.store import KIND_NODE
+
+        nodes = list(self.store.list(KIND_NODE))
+        nodes_by_name = {n.meta.name: n for n in nodes}
+        live = [p for p in self.store.list(KIND_POD)
+                if p.is_assigned and not p.is_terminated]
+        by_node: Dict[str, List[Pod]] = {}
+        req_of: Dict[str, np.ndarray] = {}
+        for p in live:
+            by_node.setdefault(p.spec.node_name, []).append(p)
+            req_of[p.meta.key] = p.spec.requests.to_vector()
+        pdbs, budgets = pdb_disruption_budgets(self.store)
+        evicted: set = set()
+        inflight: Dict[str, np.ndarray] = {}  # node -> earlier preemptors' req
+
+        rounds: List[PreemptionRound] = []
+        for pod in failed:
+            req = pod.spec.requests.to_vector()
+            prio = pod.spec.priority or 0
+            best = None  # (score tuple, node, victims)
+            for node in nodes:
+                if not self._static_admission(pod, node):
+                    continue
+                assigned = [p for p in by_node.get(node.meta.name, [])
+                            if p.meta.key not in evicted]
+                free = (node.allocatable.to_vector()
+                        - sum((req_of[p.meta.key] for p in assigned),
+                              np.zeros_like(req))
+                        - inflight.get(node.meta.name, 0.0))
+                candidates = [
+                    p for p in assigned
+                    if (p.spec.priority or 0) < prio
+                    and not is_pod_non_preemptible(p)
+                ]
+                gain = sum((req_of[p.meta.key] for p in candidates),
+                           np.zeros_like(req))
+                if ((free + gain - req) < 0).any():
+                    continue
+                # reprieve from the most important down, violating first
+                ordered = sorted(candidates,
+                                 key=QuotaPreemptor._importance_key)
+                violating, non_violating = split_by_pdb(
+                    pdbs, list(budgets), ordered)
+                victims = list(candidates)
+                headroom = free + gain - req
+                for p in violating + non_violating:
+                    vec = req_of[p.meta.key]
+                    if ((headroom - vec) >= 0).all():
+                        headroom = headroom - vec
+                        victims.remove(p)
+                if not victims:
+                    continue
+                victim_keys = {v.meta.key for v in victims}
+                survivors = [
+                    p for p in live
+                    if p.meta.key not in evicted
+                    and p.meta.key not in victim_keys
+                    and p.meta.key != pod.meta.key
+                ]
+                if not self._affinity_feasible(pod, node, survivors,
+                                               nodes_by_name):
+                    continue
+                violating_keys = {v.meta.key for v in violating}
+                score = (
+                    sum(1 for v in victims if v.meta.key in violating_keys),
+                    max((v.spec.priority or 0) for v in victims),
+                    sum((v.spec.priority or 0) for v in victims),
+                    len(victims),
+                    node.meta.name,
+                )
+                if best is None or score < best[0]:
+                    best = (score, node, victims)
+            if best is None:
+                continue
+            _, node, victims = best
+            rounds.append(evict_round(self.store, pod, victims))
+            evicted.update(v.meta.key for v in victims)
+            inflight[node.meta.name] = (
+                inflight.get(node.meta.name, np.zeros_like(req)) + req)
         return rounds

@@ -265,3 +265,157 @@ class TestQuotaPreemption:
         used = quota_plugin.used.get("team-a")
         # only the newly-bound high-prio pod's usage remains
         assert used is not None and used[0] == 2000.0
+
+
+class TestDefaultPreemption:
+    """Priority preemption (vendored kube DefaultPreemption analog): pods
+    with no feasible node evict lower-priority victims and bind in-cycle."""
+
+    def _store(self, nodes=1, cores=4):
+        from koordinator_tpu.api.objects import Node, ObjectMeta
+        from koordinator_tpu.api.resources import ResourceList
+        from koordinator_tpu.client.store import KIND_NODE, ObjectStore
+
+        GIB = 1024**3
+        store = ObjectStore()
+        for i in range(nodes):
+            store.add(KIND_NODE, Node(
+                meta=ObjectMeta(name=f"n{i}", namespace=""),
+                allocatable=ResourceList.of(
+                    cpu=cores * 1000, memory=16 * GIB, pods=10)))
+        return store
+
+    def _pod(self, store, name, cpu, prio, node=None, labels=None):
+        from koordinator_tpu.api.objects import ObjectMeta, Pod, PodSpec
+        from koordinator_tpu.api.resources import ResourceList
+        from koordinator_tpu.client.store import KIND_POD
+
+        pod = Pod(meta=ObjectMeta(name=name, uid=name,
+                                  creation_timestamp=1_000_000.0,
+                                  labels=labels or {}),
+                  spec=PodSpec(priority=prio,
+                               requests=ResourceList.of(
+                                   cpu=cpu, memory=1024**3)))
+        if node:
+            pod.spec.node_name = node
+            pod.phase = "Running"
+        store.add(KIND_POD, pod)
+        return pod
+
+    def test_high_priority_pod_preempts_and_binds(self):
+        from koordinator_tpu.scheduler.cycle import Scheduler
+
+        store = self._store()
+        for i in range(4):
+            self._pod(store, f"low-{i}", cpu=1000, prio=100, node="n0")
+        self._pod(store, "vip", cpu=2000, prio=9000)
+        result = Scheduler(store).run_cycle(now=1_000_000.0)
+        assert len(result.preempted_victims) == 2  # exactly enough freed
+        by_pod = {b.pod_key: b.node_name for b in result.bound}
+        assert by_pod.get("default/vip") == "n0"
+
+    def test_no_lower_priority_victims_stays_pending(self):
+        from koordinator_tpu.scheduler.cycle import Scheduler
+
+        store = self._store()
+        for i in range(4):
+            self._pod(store, f"peer-{i}", cpu=1000, prio=9000, node="n0")
+        self._pod(store, "vip", cpu=2000, prio=9000)  # equal priority
+        result = Scheduler(store).run_cycle(now=1_000_000.0)
+        assert result.preempted_victims == []
+        assert "default/vip" in result.failed
+
+    def test_node_with_fewest_pdb_violations_preferred(self):
+        from koordinator_tpu.api.objects import ObjectMeta, PodDisruptionBudget
+        from koordinator_tpu.client.store import KIND_PDB
+        from koordinator_tpu.scheduler.cycle import Scheduler
+
+        store = self._store(nodes=2)
+        # n0 victims are PDB-guarded, n1 victims are free
+        for i in range(4):
+            self._pod(store, f"guard-{i}", cpu=1000, prio=100, node="n0",
+                      labels={"app": "guarded"})
+            self._pod(store, f"free-{i}", cpu=1000, prio=100, node="n1")
+        store.add(KIND_PDB, PodDisruptionBudget(
+            meta=ObjectMeta(name="pdb", namespace="default"),
+            selector={"app": "guarded"}, min_available=4))
+        self._pod(store, "vip", cpu=2000, prio=9000)
+        result = Scheduler(store).run_cycle(now=1_000_000.0)
+        by_pod = {b.pod_key: b.node_name for b in result.bound}
+        assert by_pod.get("default/vip") == "n1"
+        assert all(k.startswith("default/free") 
+                   for k in result.preempted_victims)
+
+    def test_non_preemptible_victims_skipped(self):
+        from koordinator_tpu.api.objects import QUOTA_DOMAIN_PREFIX
+        from koordinator_tpu.scheduler.cycle import Scheduler
+
+        store = self._store()
+        for i in range(4):
+            self._pod(store, f"pinned-{i}", cpu=1000, prio=100, node="n0",
+                      labels={QUOTA_DOMAIN_PREFIX + "/preemptible": "false"})
+        self._pod(store, "vip", cpu=2000, prio=9000)
+        result = Scheduler(store).run_cycle(now=1_000_000.0)
+        assert result.preempted_victims == []
+        assert "default/vip" in result.failed
+
+    def test_lowest_priority_victims_chosen(self):
+        """Reprieve walks most-important-first, so the surviving victim set
+        is the LEAST important (upstream selectVictimsOnNode)."""
+        from koordinator_tpu.scheduler.cycle import Scheduler
+
+        store = self._store()
+        self._pod(store, "mid", cpu=1000, prio=5000, node="n0")
+        self._pod(store, "low", cpu=1000, prio=100, node="n0")
+        self._pod(store, "mid2", cpu=1000, prio=5000, node="n0")
+        self._pod(store, "low2", cpu=1000, prio=100, node="n0")
+        self._pod(store, "vip", cpu=2000, prio=9000)
+        result = Scheduler(store).run_cycle(now=1_000_000.0)
+        assert sorted(result.preempted_victims) == [
+            "default/low", "default/low2"]
+        by_pod = {b.pod_key: b.node_name for b in result.bound}
+        assert by_pod.get("default/vip") == "n0"
+
+    def test_inflight_ledger_between_preemptors(self):
+        """Two no-fit preemptors must each claim their OWN victims — the
+        second cannot count the first's freed space."""
+        from koordinator_tpu.scheduler.cycle import Scheduler
+
+        store = self._store()  # one 4-core node
+        for i in range(4):
+            self._pod(store, f"low-{i}", cpu=1000, prio=100, node="n0")
+        self._pod(store, "vip-a", cpu=2000, prio=9000)
+        self._pod(store, "vip-b", cpu=2000, prio=9000)
+        result = Scheduler(store).run_cycle(now=1_000_000.0)
+        # both preemptors fit only if all four victims go
+        assert len(result.preempted_victims) == 4
+        by_pod = {b.pod_key: b.node_name for b in result.bound}
+        assert by_pod.get("default/vip-a") == "n0"
+        assert by_pod.get("default/vip-b") == "n0"
+
+    def test_attempted_latch_stops_repeat_drain(self):
+        """A preemptor the kernel still rejects after its victims died must
+        not evict a fresh victim set every cycle."""
+        from koordinator_tpu.api.objects import PodAffinityTerm
+        from koordinator_tpu.client.store import KIND_NODE
+        from koordinator_tpu.scheduler.cycle import Scheduler
+
+        store = self._store(nodes=2)
+        for n in store.list(KIND_NODE):
+            n.meta.labels["zone"] = "z0"  # one domain spans both nodes
+        # a high-priority anti-affinity blocker on n1 the vip cannot evict
+        blocker = self._pod(store, "blocker", cpu=1000, prio=9999, node="n1",
+                            labels={"app": "x"})
+        for i in range(4):
+            self._pod(store, f"low-{i}", cpu=1000, prio=100, node="n0")
+        vip = self._pod(store, "vip", cpu=2000, prio=9000,
+                        labels={"app": "x"})
+        vip.spec.pod_anti_affinity.append(PodAffinityTerm(
+            selector={"app": "x"}, topology_key="zone"))
+        sched = Scheduler(store)
+        r1 = sched.run_cycle(now=1_000_000.0)
+        # the affinity dry-run already refuses every node: no victims die
+        assert r1.preempted_victims == []
+        assert "default/vip" in r1.failed
+        r2 = sched.run_cycle(now=1_000_001.0)
+        assert r2.preempted_victims == []
