@@ -102,6 +102,16 @@ class TopologySpreadConstraint:
 
 
 @dataclass
+class PreferredNodeTerm:
+    """preferredDuringSchedulingIgnoredDuringExecution node affinity term
+    (core/v1 PreferredSchedulingTerm, matchLabels form): nodes matching
+    `labels` gain `weight` in the NodeAffinity score."""
+
+    weight: int = 1
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
 class PodSpec:
     node_name: str = ""
     scheduler_name: str = "koord-scheduler"
@@ -111,6 +121,7 @@ class PodSpec:
     limits: ResourceList = field(default_factory=ResourceList)
     node_selector: Dict[str, str] = field(default_factory=dict)
     affinity_required_node_labels: Dict[str, str] = field(default_factory=dict)
+    affinity_preferred: List["PreferredNodeTerm"] = field(default_factory=list)
     pod_affinity: List["PodAffinityTerm"] = field(default_factory=list)
     pod_anti_affinity: List["PodAffinityTerm"] = field(default_factory=list)
     topology_spread: List["TopologySpreadConstraint"] = field(
@@ -173,6 +184,10 @@ class Pod:
                 affinity_required_node_labels=dict(
                     spec.affinity_required_node_labels
                 ),
+                affinity_preferred=[
+                    replace(t, labels=dict(t.labels))
+                    for t in spec.affinity_preferred
+                ],
                 pod_affinity=[
                     replace(t, selector=dict(t.selector),
                             namespaces=list(t.namespaces))

@@ -60,12 +60,15 @@ class FullChainInputs(NamedTuple):
     pod_aff_match: jnp.ndarray  # [P, T] bool — pod's labels match term
     pod_spread_skew: jnp.ndarray  # [P, T] f32 — DoNotSchedule topology
     #     spread maxSkew over term t's domains (0 = no constraint)
+    pod_pref_id: jnp.ndarray    # [P] int32 preferred-affinity profile (-1)
     # nodes
     node_taint_group: jnp.ndarray  # [N] int32 admission-signature group
     aff_dom: jnp.ndarray        # [N, T] f32 topology domain id (-1 invalid)
     aff_count: jnp.ndarray      # [N, T] f32 matching pods in n's domain
     aff_exists: jnp.ndarray     # [T] bool — any matching pod anywhere
     #     (domain-labeled or not; drives the first-replica bootstrap)
+    pref_scores: jnp.ndarray    # [N, S] f32 preferred-node-affinity score
+    #     rows (0..100 per profile, static — ops/podaffinity.py)
     numa_free: jnp.ndarray      # [N, K, R]
     numa_capacity: jnp.ndarray  # [N, K, R]
     numa_policy: jnp.ndarray    # [N] int32
@@ -186,7 +189,12 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode):
         numa_score = numa_score_row(
             req, requested, inputs.allocatable, inputs.weights, weight_idx,
         )
-        score = la_score + numa_score
+        # preferred node affinity (soft NodeAffinity score): a static,
+        # profile-bucketed 0..100 row — pods without preferences add 0
+        pid = fc.pod_pref_id[i]
+        pref = jnp.where(
+            pid >= 0, fc.pref_scores[:, jnp.maximum(pid, 0)], 0.0)
+        score = la_score + numa_score + pref
         score = jnp.where(feasible, score, -1.0)
 
         # ---- select
@@ -357,7 +365,8 @@ def build_best_full_chain_step(args: LoadAwareArgs, num_gangs: int,
         K = fc.numa_free.shape[1]
         G = fc.quota_used.shape[0]
         T = fc.aff_dom.shape[1]
-        if estimate_vmem_bytes(N, R, K, G, P, T) <= budget:
+        S = fc.pref_scores.shape[1]
+        if estimate_vmem_bytes(N, R, K, G, P, T, S) <= budget:
             step.last_backend = "pallas"
             return pallas_step(fc)
         step.last_backend = "xla"

@@ -53,19 +53,20 @@ def _load() -> Optional[ctypes.CDLL]:
     # would read every pointer after the insertion shifted
     try:
         lib.koord_floor_abi_version.restype = ctypes.c_int
-        if lib.koord_floor_abi_version() != 5:
+        if lib.koord_floor_abi_version() != 6:
             return None
     except AttributeError:
         return None
     lib.koord_serial_full_chain.restype = None
     lib.koord_serial_full_chain.argtypes = (
-        [ctypes.c_int] * 9           # P R N K G A NG T prod_mode
+        [ctypes.c_int] * 10          # P R N K G A NG T S prod_mode
         + [_F32P] * 3                # fit_requests requests estimated
         + [_I32P] * 7                # is_prod..needs_bind
         + [_F32P] + [_I32P]          # cores_needed full_pcpus
         + [_I32P]                    # pod_taint_mask
         + [_I32P] * 3                # pod_aff_req pod_anti_req pod_aff_match
         + [_I32P]                    # pod_spread_skew [P, T]
+        + [_I32P]                    # pod_pref_id [P]
         + [_F32P, _F32P] + [_I32P]   # allocatable requested node_ok
         + [_F32P] + [_I32P]          # filter_usage has_filter_usage
         + [_F32P] * 5                # filter_thr prod_thr prod_usage term_np term_pr
@@ -76,6 +77,7 @@ def _load() -> Optional[ctypes.CDLL]:
         + [_I32P]                    # node_taint_group
         + [_F32P] * 2                # aff_dom aff_count
         + [_I32P]                    # aff_exists
+        + [_F32P]                    # pref_scores [N, S]
         + [_I32P] + [_F32P] * 2      # ancestors quota_used quota_runtime
         + [_I32P] + [_F32P] * 2      # gang_valid gang_min gang_assumed
         + [_I32P, ctypes.c_int]      # gang_group num_groups
@@ -120,6 +122,7 @@ def serial_schedule_full_native(fc, args, num_groups: int = 0) -> np.ndarray:
     gang_group = _i32(fc.gang_group_id)
     n_groups = int(num_groups or (int(gang_group.max()) + 1 if NG else 0))
     T = int(np.asarray(fc.aff_dom).shape[1])
+    S = int(np.asarray(fc.pref_scores).shape[1])
     pow_t = (1 << np.arange(max(T, 1), dtype=np.int64))[:T]
 
     def term_mask(rows) -> np.ndarray:  # [P, T] bool -> [P] int32 bitmask
@@ -129,7 +132,7 @@ def serial_schedule_full_native(fc, args, num_groups: int = 0) -> np.ndarray:
 
     chosen = np.full(P, -1, np.int32)
     lib.koord_serial_full_chain(
-        P, R, N, K, max(G, 0), A, NG, T,
+        P, R, N, K, max(G, 0), A, NG, T, S,
         1 if args.score_according_prod_usage else 0,
         fit_requests, _f32(fc.requests), _f32(inputs.estimated),
         _i32(inputs.is_prod), _i32(inputs.is_daemonset),
@@ -141,6 +144,7 @@ def serial_schedule_full_native(fc, args, num_groups: int = 0) -> np.ndarray:
         term_mask(fc.pod_aff_match),
         (_i32(fc.pod_spread_skew) if T
          else np.zeros((P, 1), np.int32)),
+        _i32(fc.pod_pref_id),
         allocatable, _f32(inputs.requested).copy(), _i32(inputs.node_ok),
         _f32(inputs.la_filter_usage), _i32(inputs.la_has_filter_usage),
         _f32(inputs.la_filter_thresholds), _f32(inputs.la_prod_thresholds),
@@ -156,6 +160,7 @@ def serial_schedule_full_native(fc, args, num_groups: int = 0) -> np.ndarray:
         (_f32(fc.aff_count).copy() if T
          else np.zeros((N, 1), np.float32)),
         _i32(fc.aff_exists) if T else np.zeros(1, np.int32),
+        _f32(fc.pref_scores),
         ancestors if ancestors.size else np.zeros((1, 1), np.int32),
         _f32(fc.quota_used).copy() if G else np.zeros((1, R), np.float32),
         _f32(fc.quota_runtime) if G else np.zeros((1, R), np.float32),

@@ -30,7 +30,7 @@ inline float least_requested(float requested, float capacity) {
 
 // ABI version: bump when koord_serial_full_chain's signature changes, so a
 // stale .so is rejected instead of mis-reading shifted pointers.
-extern "C" int koord_floor_abi_version() { return 5; }
+extern "C" int koord_floor_abi_version() { return 6; }
 
 extern "C" {
 
@@ -39,7 +39,7 @@ extern "C" {
 // caller owns; they are mutated in place, as in the numpy oracle.
 void koord_serial_full_chain(
     // dims
-    int P, int R, int N, int K, int G, int A, int NG, int T,
+    int P, int R, int N, int K, int G, int A, int NG, int T, int S,
     int prod_mode,
     // pods
     const float* fit_requests,   // [P, R]
@@ -59,6 +59,7 @@ void koord_serial_full_chain(
     const int32_t* pod_anti_req,   // [P] bitmask of anti-affinity terms
     const int32_t* pod_aff_match,  // [P] bitmask of terms the pod matches
     const int32_t* pod_spread_skew, // [P, T] maxSkew per term (0 = none)
+    const int32_t* pod_pref_id,    // [P] preferred-affinity profile (-1)
     // nodes
     const float* allocatable,    // [N, R]
     float* requested_state,      // [N, R] (mutated)
@@ -83,6 +84,7 @@ void koord_serial_full_chain(
     const float* aff_dom,        // [N, T] topology domain ids (-1 invalid)
     float* aff_count,            // [N, T] matching pods per domain (mutated)
     const int32_t* aff_exists0,  // [T] any matching pod anywhere (host seed)
+    const float* pref_scores,    // [N, S] preferred-affinity score rows
     // quota
     const int32_t* ancestors,    // [G, A] (-1 padded)
     float* quota_used,           // [G, R] (mutated)
@@ -261,6 +263,9 @@ void koord_serial_full_chain(
       float la_score = score_valid[n] ? std::floor(acc / wdiv) : 0.0f;
       float numa_score = std::floor(acc2 / wdiv);
       float s = la_score + numa_score;
+      // preferred node affinity: static profile score row
+      if (S > 0 && pod_pref_id[p] >= 0)
+        s += pref_scores[(int64_t)n * S + pod_pref_id[p]];
       if (s > best_score) {  // strict: lowest index wins ties
         best_n = n;
         best_score = s;

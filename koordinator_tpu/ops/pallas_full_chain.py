@@ -43,7 +43,7 @@ from koordinator_tpu.ops.pallas_common import POD_BLOCK, UNROLL
 
 
 def estimate_vmem_bytes(N: int, R: int, K: int, G: int, P: int,
-                        T: int = 0) -> int:
+                        T: int = 0, S: int = 0) -> int:
     """Upper-bound VMEM footprint of one pallas_call of the full-chain
     kernel, mirroring the in/out/scratch specs below: 3 double-buffered
     [R, POD_BLOCK] pod column blocks, 8 [R, N] node buffers, 2 [K*R, N]
@@ -55,13 +55,13 @@ def estimate_vmem_bytes(N: int, R: int, K: int, G: int, P: int,
     G_eff = max(G, 1)
     G_lane = max(128, -(-G_eff // 128) * 128)
     floats = (3 * POD_BLOCK * R * 2 + 8 * R * N + 2 * K * R * N + 11 * N
-              + 3 * max(T, 0) * N
+              + 3 * max(T, 0) * N + max(S, 1) * N
               + 4 * R * G_lane + 2 * UNROLL * G_lane + P_pad)
     return 4 * floats
 
 
 def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
-                 K: int, G: int, T: int = 0):
+                 K: int, G: int, T: int = 0, S: int = 0):
     wsum = float(max(weights.sum(), 1.0))
     consts = pc.weight_consts(weights)
 
@@ -73,6 +73,7 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         affreq_ref, antireq_ref, affmatch_ref,   # f32 [P] term bitmasks
         skew0_ref, skew1_ref, skew2_ref,         # f32 [P] skew bit-planes
         affexists0_ref,                          # f32 [max(T,1)] host seed
+        prefid_ref,                              # int32 [P] pref profile
         qid_ref,                                                  # int32 [P]
         # --- VMEM pod column blocks [R, POD_BLOCK]
         fitreq_ref, rawreq_ref, est_ref,
@@ -85,8 +86,9 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         # --- VMEM numa [K*R, N] / per-pod ancestor rows [UNROLL, G_lane]
         #     (pre-gathered host-side: no in-kernel dynamic slice) / quota
         numafree0_ref, ancpod_ref, qused0_ref, qruntime_ref,
-        # --- VMEM inter-pod affinity [max(T,1), N]
-        affdom_ref, affcount0_ref,
+        # --- VMEM inter-pod affinity [max(T,1), N] + preferred-affinity
+        #     profile score rows [max(S,1), N]
+        affdom_ref, affcount0_ref, prefrows_ref,
         # --- outputs
         chosen_ref,                 # (UNROLL, 1) int32 block, one per step
         requested_ref,              # [R, N] (carried)
@@ -266,6 +268,12 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
             la_score = jnp.where(score_valid_row, la_score, 0.0)
             score = la_score + pc.weighted_floor_score_col(nu_per_r, w_col,
                                                            wsum)
+            # preferred node affinity: static profile row one-hot select
+            if S:
+                sid = prefid_ref[p]
+                for s in range(S):
+                    score = score + jnp.where(
+                        sid == s, prefrows_ref[s:s + 1, :][0, :], 0.0)
             score = jnp.where(feasible, score, -1.0)
 
             best, maxv, _ = pc.lowest_index_max(score, N, iota)
@@ -436,7 +444,15 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             affdom0 = jnp.full((1, N), -1.0, jnp.float32)
             affcount0 = jnp.zeros((1, N), jnp.float32)
 
-        kernel = _make_kernel(weights, prod_mode, N, R, K, G_eff, T)
+        # preference-less batches carry one all-zero profile column; padded
+        # pods get pid -1 and match no profile row
+        S = fc.pref_scores.shape[1]
+        S_eff = max(S, 1)
+        prefrows0 = f32(fc.pref_scores).T
+        prefid_pad = jnp.pad(jnp.asarray(fc.pod_pref_id, jnp.int32), pad_p,
+                             constant_values=-1)
+
+        kernel = _make_kernel(weights, prod_mode, N, R, K, G_eff, T, S)
         grid_inputs = (
             spad(inputs.is_prod), spad(inputs.pod_valid),
             spad(inputs.is_daemonset), spad(gang_pod_ok),
@@ -445,6 +461,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             jnp.pad(f32(fc.pod_taint_mask), pad_p, constant_values=1.0),
             affreq_m, antireq_m, affmatch_m,
             skew0_m, skew1_m, skew2_m, affexists0,
+            prefid_pad,
             qid_pad,
             pods_t(inputs.fit_requests), pods_t(fc.requests),
             pods_t(inputs.estimated),
@@ -456,7 +473,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             jnp.asarray(fc.numa_policy, jnp.int32)[None, :],
             jnp.exp2(f32(fc.node_taint_group))[None, :],
             numa0, anc_pod, qused0, qruntime,
-            affdom0, affcount0,
+            affdom0, affcount0, prefrows0,
         )
         smem, full = pc.smem_spec, pc.full_spec
         pod_spec = pc.pod_block_spec(R)
@@ -464,7 +481,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             kernel,
             grid=(P_pad // UNROLL,),
             in_specs=(
-                [smem()] * 17
+                [smem()] * 18
                 + [pod_spec] * 3
                 + [full((R, N))] * 4
                 + [full((1, N))] * 9
@@ -472,6 +489,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                    pl.BlockSpec((UNROLL, G_lane), lambda i: (i, 0)),
                    full((R, G_lane)), full((R, G_lane))]
                 + [full((T_eff, N))] * 2
+                + [full((S_eff, N))]
             ),
             out_specs=[
                 pc.chosen_block_spec(),

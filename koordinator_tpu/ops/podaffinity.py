@@ -190,3 +190,72 @@ def build_affinity_state(pending_pods, nodes, existing_pods):
                 spread_skew[i, t] = float(min(max(con.max_skew, 1), MAX_SKEW))
     return (terms, aff_dom, aff_count, aff_exists, aff_req, anti_req, match,
             spread_skew, overflow_pods)
+
+
+MAX_PREF_PROFILES = 32
+
+
+def build_preferred_scores(pending_pods, nodes):
+    """preferredDuringScheduling node affinity, profile-bucketed:
+
+    -> (pref_rows [max(S, 1), N] f32, pod_pref_id [P_valid] int32)
+
+    Pods sharing an identical preferred-term list share a profile; each
+    profile's row is the upstream NodeAffinity score — sum of matching term
+    weights, normalized to 0..100 over nodes by the framework's
+    defaultNormalizeScore (floor semantics) — a STATIC function of node
+    labels, so it adds to the kernel score without any in-batch state.
+    Batches with more than MAX_PREF_PROFILES distinct profiles drop the
+    excess profiles (their pods score 0 preference — soft scoring degrades
+    gracefully, loudly logged)."""
+    profiles: List[tuple] = []
+    ids: dict = {}
+    P = len(pending_pods)
+    pod_pref_id = np.full(P, -1, np.int32)
+    dropped = 0
+    for i, pod in enumerate(pending_pods):
+        terms = tuple(
+            (int(t.weight), frozenset(t.labels.items()))
+            for t in pod.spec.affinity_preferred if t.labels
+        )
+        if not terms:
+            continue
+        sid = ids.get(terms)
+        if sid is None:
+            if len(profiles) >= MAX_PREF_PROFILES:
+                dropped += 1
+                continue
+            sid = ids[terms] = len(profiles)
+            profiles.append(terms)
+        pod_pref_id[i] = sid
+    if dropped:
+        logger.warning(
+            "preferred-affinity profile budget exceeded: %d pods keep a "
+            "zero preference score this round (max %d distinct profiles)",
+            dropped, MAX_PREF_PROFILES,
+        )
+    S = len(profiles)
+    N = len(nodes)
+    pref_rows = np.zeros((max(S, 1), N), np.float32)
+    if S:
+        # one Python pass over nodes per DISTINCT label pair; profile rows
+        # compose vectorized (term mask = AND of its pair masks, row = Σ w)
+        pair_ids: dict = {}
+        for terms in profiles:
+            for _w, pairs in terms:
+                for kv in pairs:
+                    pair_ids.setdefault(kv, len(pair_ids))
+        pair_masks = np.zeros((len(pair_ids), N), bool)
+        for (k, v), pid in pair_ids.items():
+            for n, node in enumerate(nodes):
+                if node.meta.labels.get(k) == v:
+                    pair_masks[pid, n] = True
+        for s, terms in enumerate(profiles):
+            row = np.zeros(N, np.float32)
+            for w, pairs in terms:
+                idx = [pair_ids[kv] for kv in pairs]
+                row += np.float32(w) * pair_masks[idx].all(axis=0)
+            mx = row.max()
+            pref_rows[s] = np.floor(
+                row * np.float32(100.0) / np.float32(mx)) if mx > 0 else 0.0
+    return pref_rows, pod_pref_id

@@ -325,19 +325,29 @@ class Scheduler:
         # terminate synchronously and the kernel rerun is the real gate.
         # The attempted-latch stops a pod the kernel STILL rejects (e.g.
         # spread/NUMA constraints the host dry-run cannot see) from
-        # draining a fresh victim set every cycle; it clears when the pod
-        # finally binds or leaves the queue.
-        attempted = getattr(self, "_preempt_attempted", set())
+        # draining a fresh victim set EVERY cycle: a latched pod may retry
+        # only every PREEMPT_RETRY_CYCLES (cluster state may have unblocked
+        # it by then — bounded drain instead of either extreme). Keys of
+        # pods that bound or left the queue are dropped each cycle.
+        PREEMPT_RETRY_CYCLES = 5
+        attempted: Dict[str, int] = getattr(self, "_preempt_attempted", {})
         self._preempt_attempted = attempted
-        no_fit = [p for p, reason in failed_pods
-                  if reason == "no feasible node" and not p.gang_name
-                  and p.meta.key not in attempted]
+        self._cycle_seq = getattr(self, "_cycle_seq", 0) + 1
+        still_failed_keys = {p.meta.key for p, _ in failed_pods}
+        for key in [k for k in attempted if k not in still_failed_keys]:
+            del attempted[key]
+        no_fit = [
+            p for p, reason in failed_pods
+            if reason == "no feasible node" and not p.gang_name
+            and self._cycle_seq - attempted.get(p.meta.key, -10**9)
+            >= PREEMPT_RETRY_CYCLES
+        ]
         if no_fit:
             from koordinator_tpu.scheduler.preempt import DefaultPreemption
 
             for round_ in DefaultPreemption(self.store).post_filter(no_fit):
                 any_victims = True
-                attempted.add(round_.preemptor_key)
+                attempted[round_.preemptor_key] = self._cycle_seq
                 result.preempted_victims.extend(round_.victim_keys)
         if any_victims:
             # retry transforms from the ORIGINAL queued pods, not the
@@ -354,7 +364,8 @@ class Scheduler:
             rejected_pods, failed_pods = self._batch_pass(
                 retry, now, ctx, result, pending_reservations
             )
-        attempted.difference_update(b.pod_key for b in result.bound)
+        for b in result.bound:
+            attempted.pop(b.pod_key, None)
 
         for pod in rejected_pods:
             result.rejected.append(pod.meta.key)

@@ -218,6 +218,8 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
     pod_anti_req = np.asarray(fc.pod_anti_req)
     pod_aff_match = np.asarray(fc.pod_aff_match)
     pod_spread_skew = np.asarray(fc.pod_spread_skew, np.float32)
+    pod_pref_id = np.asarray(fc.pod_pref_id)
+    pref_scores = np.asarray(fc.pref_scores, np.float32)
     T = aff_dom.shape[1]
 
     P, R = fit_requests.shape
@@ -370,6 +372,8 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
                 )
             numa_score = np.float32(np.floor(acc2 / max(wsum, np.float32(1.0))))
             s = la_score + numa_score
+            if pod_pref_id[p] >= 0:
+                s = s + pref_scores[n, pod_pref_id[p]]
             if s > best_score:
                 best_n, best_score, best_zone = n, s, zone
         if best_n < 0:

@@ -410,4 +410,7 @@ class DefaultPreemption:
             evicted.update(v.meta.key for v in victims)
             inflight[node.meta.name] = (
                 inflight.get(node.meta.name, np.zeros_like(req)) + req)
+            # evicted victims consumed disruption budget: recompute so a
+            # later preemptor's split/ranking sees the debited PDBs
+            pdbs, budgets = pdb_disruption_budgets(self.store)
         return rounds

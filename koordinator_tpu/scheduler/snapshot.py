@@ -318,6 +318,16 @@ def build_full_chain_inputs(
     for i in aff_overflow:  # conservative: term encoding overflow
         pods.valid[i] = False
 
+    # preferred node affinity (soft scoring), profile-bucketed
+    from koordinator_tpu.ops.podaffinity import build_preferred_scores
+
+    pref_rows_v, pref_id_v = build_preferred_scores(
+        ordered_pending, state.nodes)
+    pref_scores = np.zeros((N, pref_rows_v.shape[0]), np.float32)
+    pref_scores[: pref_rows_v.shape[1]] = pref_rows_v.T
+    pod_pref_id = np.full(P, -1, np.int32)
+    pod_pref_id[: pref_id_v.shape[0]] = pref_id_v
+
     base = make_inputs(pods, nodes, args)
     G = max(1, len(tree.names))
     fc = FullChainInputs(
@@ -334,6 +344,8 @@ def build_full_chain_inputs(
         pod_anti_req=np.asarray(pod_anti_req),
         pod_aff_match=np.asarray(pod_aff_match),
         pod_spread_skew=np.asarray(pod_spread_skew),
+        pod_pref_id=np.asarray(pod_pref_id),
+        pref_scores=np.asarray(pref_scores),
         node_taint_group=np.asarray(node_taint_group),
         aff_dom=np.asarray(aff_dom),
         aff_count=np.asarray(aff_count),

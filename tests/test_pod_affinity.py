@@ -314,3 +314,56 @@ def test_spread_min_ignores_ineligible_domains():
 
     counts = Counter(zones)
     assert abs(counts.get("z0", 0) - counts.get("z1", 0)) <= 1
+
+
+def test_preferred_node_affinity_scoring():
+    """preferredDuringScheduling node affinity steers placement to matching
+    nodes (0..100 normalized profile rows), bit-identically across XLA,
+    oracle, Pallas interpret, wave, and the C++ floor."""
+    from koordinator_tpu.api.objects import PreferredNodeTerm
+    from koordinator_tpu.models.wave_chain import build_wave_full_chain_step
+    from koordinator_tpu.native import floor as native_floor
+    from koordinator_tpu.ops.pallas_full_chain import (
+        build_pallas_full_chain_step,
+    )
+
+    args = LoadAwareArgs()
+    cluster, state = synth_full_cluster(16, 20, seed=37)
+    for j, node in enumerate(state.nodes):
+        node.meta.labels["disk"] = "ssd" if j % 4 == 0 else "hdd"
+    prefer = 0
+    for i, pod in enumerate(state.pending_pods):
+        if i % 2 == 0:
+            pod.spec.affinity_preferred.append(PreferredNodeTerm(
+                weight=10, labels={"disk": "ssd"}))
+            prefer += 1
+    fc, pods, nodes, tree, gi, ng, ngroups = build_full_chain_inputs(
+        state, args)
+    assert (np.asarray(fc.pod_pref_id) >= 0).sum() == prefer
+    chosen = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    serial = serial_schedule_full(fc, args)
+    n = len(pods.keys)
+    np.testing.assert_array_equal(chosen[:n], serial[:n])
+    chosen_p = np.asarray(
+        build_pallas_full_chain_step(args, ng, ngroups, interpret=True)(fc)[0])
+    np.testing.assert_array_equal(chosen, chosen_p)
+    chosen_w = np.asarray(
+        build_wave_full_chain_step(args, ng, ngroups, wave=8)(fc)[0])
+    np.testing.assert_array_equal(chosen, chosen_w)
+    if native_floor.available() or native_floor.build():
+        chosen_nat = native_floor.serial_schedule_full_native(
+            fc, args, num_groups=ngroups)
+        np.testing.assert_array_equal(chosen[:n], chosen_nat[:n])
+
+    # preferring pods overwhelmingly land on ssd nodes (capacity allows)
+    by_key = {p.meta.key: p for p in state.pending_pods}
+    on_ssd = total = 0
+    for i, key in enumerate(pods.keys):
+        if chosen[i] < 0:
+            continue
+        pod = by_key[key]
+        if pod.spec.affinity_preferred:
+            total += 1
+            if state.nodes[chosen[i]].meta.labels["disk"] == "ssd":
+                on_ssd += 1
+    assert total > 0 and on_ssd >= total * 0.7, (on_ssd, total)
