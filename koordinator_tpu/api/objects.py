@@ -90,6 +90,19 @@ class PodAffinityTerm:
 
 
 @dataclass
+class PreferredPodTerm:
+    """preferredDuringSchedulingIgnoredDuringExecution inter-pod affinity
+    (core/v1 WeightedPodAffinityTerm, matchLabels form): candidate nodes
+    gain `weight` per matching pod in their topology domain. Negative
+    weight expresses preferred ANTI-affinity."""
+
+    weight: int = 1
+    selector: Dict[str, str] = field(default_factory=dict)
+    topology_key: str = "kubernetes.io/hostname"
+    namespaces: List[str] = field(default_factory=list)
+
+
+@dataclass
 class TopologySpreadConstraint:
     """whenUnsatisfiable=DoNotSchedule topology spread (core/v1
     TopologySpreadConstraint, matchLabels form): placing the pod in a
@@ -124,6 +137,8 @@ class PodSpec:
     affinity_preferred: List["PreferredNodeTerm"] = field(default_factory=list)
     pod_affinity: List["PodAffinityTerm"] = field(default_factory=list)
     pod_anti_affinity: List["PodAffinityTerm"] = field(default_factory=list)
+    pod_affinity_preferred: List["PreferredPodTerm"] = field(
+        default_factory=list)
     topology_spread: List["TopologySpreadConstraint"] = field(
         default_factory=list)
     tolerations: List[Tuple[str, str]] = field(default_factory=list)  # (key, value)
@@ -197,6 +212,11 @@ class Pod:
                     replace(t, selector=dict(t.selector),
                             namespaces=list(t.namespaces))
                     for t in spec.pod_anti_affinity
+                ],
+                pod_affinity_preferred=[
+                    replace(t, selector=dict(t.selector),
+                            namespaces=list(t.namespaces))
+                    for t in spec.pod_affinity_preferred
                 ],
                 topology_spread=[
                     replace(c, selector=dict(c.selector))

@@ -61,6 +61,9 @@ class FullChainInputs(NamedTuple):
     pod_spread_skew: jnp.ndarray  # [P, T] f32 — DoNotSchedule topology
     #     spread maxSkew over term t's domains (0 = no constraint)
     pod_pref_id: jnp.ndarray    # [P] int32 preferred-affinity profile (-1)
+    pod_ppref_id: jnp.ndarray   # [P] int32 preferred POD-affinity profile
+    pod_ppref_mask: jnp.ndarray  # [P, T] bool — terms the profile weighs
+    #     (the wave kernel's conflict rule)
     # nodes
     node_taint_group: jnp.ndarray  # [N] int32 admission-signature group
     aff_dom: jnp.ndarray        # [N, T] f32 topology domain id (-1 invalid)
@@ -69,6 +72,8 @@ class FullChainInputs(NamedTuple):
     #     (domain-labeled or not; drives the first-replica bootstrap)
     pref_scores: jnp.ndarray    # [N, S] f32 preferred-node-affinity score
     #     rows (0..100 per profile, static — ops/podaffinity.py)
+    ppref_w: jnp.ndarray        # [max(S2,1), max(T,1)] f32 per-profile term
+    #     weights for preferred pod affinity (negative = anti preference)
     numa_free: jnp.ndarray      # [N, K, R]
     numa_capacity: jnp.ndarray  # [N, K, R]
     numa_policy: jnp.ndarray    # [N] int32
@@ -194,6 +199,20 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode):
         pid = fc.pod_pref_id[i]
         pref = jnp.where(
             pid >= 0, fc.pref_scores[:, jnp.maximum(pid, 0)], 0.0)
+        # preferred POD affinity (soft InterPodAffinity score): weighted sum
+        # of matching-pod counts over the shared term space, max-min
+        # normalized to 0..100 per pod (upstream NormalizeScore semantics)
+        sid2 = fc.pod_ppref_id[i]
+        if T and fc.ppref_w.shape[0]:  # zero rows == no profiles: no work
+            w_row = fc.ppref_w[jnp.maximum(sid2, 0), :T]          # [T]
+            # elementwise+reduce, not matmul: TPU matmuls default to bf16
+            # passes and the products must stay exact integers
+            raw = jnp.sum(aff_count * w_row[None, :], axis=1)     # [N]
+            mx, mn = jnp.max(raw), jnp.min(raw)
+            norm = jnp.where(
+                mx > mn,
+                jnp.floor((raw - mn) * 100.0 / (mx - mn)), 0.0)
+            pref = pref + jnp.where(sid2 >= 0, norm, 0.0)
         score = la_score + numa_score + pref
         score = jnp.where(feasible, score, -1.0)
 

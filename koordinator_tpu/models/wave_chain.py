@@ -145,12 +145,14 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                     match_w.astype(jnp.float32), axis=0) - match_w) > 0.5
                 anti_conf = found_w & jnp.any(
                     fc.pod_anti_req[idxc] & matched_before, axis=1)
-                # required affinity AND topology spread are non-monotone
-                # (a committed match can open previously-infeasible nodes
-                # by raising the domain minimum), so either conflicts
+                # required affinity, topology spread, AND weighted
+                # preferences are all count-sensitive (a committed match
+                # changes feasibility or the score), so any referenced
+                # term with an earlier in-wave match conflicts
                 aff_conf = jnp.any(
                     (fc.pod_aff_req[idxc]
-                     | (fc.pod_spread_skew[idxc] > 0)) & matched_before,
+                     | (fc.pod_spread_skew[idxc] > 0)
+                     | fc.pod_ppref_mask[idxc]) & matched_before,
                     axis=1) & valid_w
                 affinity_conf_w = anti_conf | aff_conf
             else:

@@ -53,13 +53,13 @@ def _load() -> Optional[ctypes.CDLL]:
     # would read every pointer after the insertion shifted
     try:
         lib.koord_floor_abi_version.restype = ctypes.c_int
-        if lib.koord_floor_abi_version() != 6:
+        if lib.koord_floor_abi_version() != 7:
             return None
     except AttributeError:
         return None
     lib.koord_serial_full_chain.restype = None
     lib.koord_serial_full_chain.argtypes = (
-        [ctypes.c_int] * 10          # P R N K G A NG T S prod_mode
+        [ctypes.c_int] * 11          # P R N K G A NG T S S2 prod
         + [_F32P] * 3                # fit_requests requests estimated
         + [_I32P] * 7                # is_prod..needs_bind
         + [_F32P] + [_I32P]          # cores_needed full_pcpus
@@ -67,6 +67,8 @@ def _load() -> Optional[ctypes.CDLL]:
         + [_I32P] * 3                # pod_aff_req pod_anti_req pod_aff_match
         + [_I32P]                    # pod_spread_skew [P, T]
         + [_I32P]                    # pod_pref_id [P]
+        + [_I32P]                    # pod_ppref_id [P]
+        + [_F32P]                    # ppref_w [max(S2,1), max(T,1)]
         + [_F32P, _F32P] + [_I32P]   # allocatable requested node_ok
         + [_F32P] + [_I32P]          # filter_usage has_filter_usage
         + [_F32P] * 5                # filter_thr prod_thr prod_usage term_np term_pr
@@ -123,6 +125,7 @@ def serial_schedule_full_native(fc, args, num_groups: int = 0) -> np.ndarray:
     n_groups = int(num_groups or (int(gang_group.max()) + 1 if NG else 0))
     T = int(np.asarray(fc.aff_dom).shape[1])
     S = int(np.asarray(fc.pref_scores).shape[1])
+    S2 = int(np.asarray(fc.ppref_w).shape[0]) if T else 0
     pow_t = (1 << np.arange(max(T, 1), dtype=np.int64))[:T]
 
     def term_mask(rows) -> np.ndarray:  # [P, T] bool -> [P] int32 bitmask
@@ -132,7 +135,7 @@ def serial_schedule_full_native(fc, args, num_groups: int = 0) -> np.ndarray:
 
     chosen = np.full(P, -1, np.int32)
     lib.koord_serial_full_chain(
-        P, R, N, K, max(G, 0), A, NG, T, S,
+        P, R, N, K, max(G, 0), A, NG, T, S, S2,
         1 if args.score_according_prod_usage else 0,
         fit_requests, _f32(fc.requests), _f32(inputs.estimated),
         _i32(inputs.is_prod), _i32(inputs.is_daemonset),
@@ -145,6 +148,9 @@ def serial_schedule_full_native(fc, args, num_groups: int = 0) -> np.ndarray:
         (_i32(fc.pod_spread_skew) if T
          else np.zeros((P, 1), np.int32)),
         _i32(fc.pod_pref_id),
+        _i32(fc.pod_ppref_id),
+        (_f32(fc.ppref_w) if S2
+         else np.zeros((1, max(T, 1)), np.float32)),
         allocatable, _f32(inputs.requested).copy(), _i32(inputs.node_ok),
         _f32(inputs.la_filter_usage), _i32(inputs.la_has_filter_usage),
         _f32(inputs.la_filter_thresholds), _f32(inputs.la_prod_thresholds),

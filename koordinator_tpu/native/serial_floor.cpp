@@ -30,7 +30,7 @@ inline float least_requested(float requested, float capacity) {
 
 // ABI version: bump when koord_serial_full_chain's signature changes, so a
 // stale .so is rejected instead of mis-reading shifted pointers.
-extern "C" int koord_floor_abi_version() { return 6; }
+extern "C" int koord_floor_abi_version() { return 7; }
 
 extern "C" {
 
@@ -40,6 +40,7 @@ extern "C" {
 void koord_serial_full_chain(
     // dims
     int P, int R, int N, int K, int G, int A, int NG, int T, int S,
+    int S2,
     int prod_mode,
     // pods
     const float* fit_requests,   // [P, R]
@@ -60,6 +61,8 @@ void koord_serial_full_chain(
     const int32_t* pod_aff_match,  // [P] bitmask of terms the pod matches
     const int32_t* pod_spread_skew, // [P, T] maxSkew per term (0 = none)
     const int32_t* pod_pref_id,    // [P] preferred-affinity profile (-1)
+    const int32_t* pod_ppref_id,   // [P] preferred POD-affinity profile
+    const float* ppref_w,          // [max(S2,1), max(T,1)] profile weights
     // nodes
     const float* allocatable,    // [N, R]
     float* requested_state,      // [N, R] (mutated)
@@ -138,6 +141,26 @@ void koord_serial_full_chain(
     const float* estp = estimated + (int64_t)p * R;
     const bool use_prod_score = prod_mode && is_prod[p];
 
+    // preferred POD affinity: weighted count row + max-min norm, hoisted
+    // per pod (counts are frozen during one pod's node scan)
+    float* ppref_norm = nullptr;
+    if (T > 0 && S2 > 0 && pod_ppref_id[p] >= 0) {
+      const float* w = ppref_w + (int64_t)pod_ppref_id[p] * (T > 0 ? T : 1);
+      ppref_norm = new float[N];
+      float mx = -3.4e38f, mn = 3.4e38f;
+      for (int n = 0; n < N; ++n) {
+        float raw = 0.0f;
+        for (int t = 0; t < T; ++t)
+          raw += w[t] * aff_count[(int64_t)n * T + t];
+        ppref_norm[n] = raw;
+        if (raw > mx) mx = raw;
+        if (raw < mn) mn = raw;
+      }
+      for (int n = 0; n < N; ++n)
+        ppref_norm[n] = mx > mn
+            ? std::floor((ppref_norm[n] - mn) * 100.0f / (mx - mn))
+            : 0.0f;
+    }
     // spread minimums hoisted per (pod, term): invariant across the node
     // scan, restricted to domains of nodes the pod is ELIGIBLE for
     // (admission bit test), matching the batched evaluators
@@ -266,12 +289,14 @@ void koord_serial_full_chain(
       // preferred node affinity: static profile score row
       if (S > 0 && pod_pref_id[p] >= 0)
         s += pref_scores[(int64_t)n * S + pod_pref_id[p]];
+      if (ppref_norm) s += ppref_norm[n];
       if (s > best_score) {  // strict: lowest index wins ties
         best_n = n;
         best_score = s;
         best_zone = zone;
       }
     }
+    delete[] ppref_norm;
     if (best_n < 0) continue;
     chosen[p] = best_n;
     // Reserve: Fit state + assign cache + NUMA/cpuset/quota accounting

@@ -61,7 +61,7 @@ def estimate_vmem_bytes(N: int, R: int, K: int, G: int, P: int,
 
 
 def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
-                 K: int, G: int, T: int = 0, S: int = 0):
+                 K: int, G: int, T: int = 0, S: int = 0, S2: int = 0):
     wsum = float(max(weights.sum(), 1.0))
     consts = pc.weight_consts(weights)
 
@@ -74,6 +74,8 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         skew0_ref, skew1_ref, skew2_ref,         # f32 [P] skew bit-planes
         affexists0_ref,                          # f32 [max(T,1)] host seed
         prefid_ref,                              # int32 [P] pref profile
+        pprefid_ref,                             # int32 [P] pod-pref profile
+        pprefw_ref,                              # f32 [max(S2,1), max(T,1)]
         qid_ref,                                                  # int32 [P]
         # --- VMEM pod column blocks [R, POD_BLOCK]
         fitreq_ref, rawreq_ref, est_ref,
@@ -274,6 +276,20 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
                 for s in range(S):
                     score = score + jnp.where(
                         sid == s, prefrows_ref[s:s + 1, :][0, :], 0.0)
+            # preferred POD affinity: weighted count sum, max-min normalized
+            # per pod (weights read as SMEM scalars by traced profile id)
+            if T and S2:
+                sid2 = pprefid_ref[p]
+                s2c = jnp.maximum(sid2, 0)
+                raw = jnp.zeros((N,), jnp.float32)
+                for t in range(T):
+                    raw = raw + pprefw_ref[s2c, t] * aff_count[t][0, :]
+                mx = jnp.max(raw)
+                mn = jnp.min(raw)
+                norm = jnp.where(
+                    mx > mn,
+                    jnp.floor((raw - mn) * 100.0 / (mx - mn)), 0.0)
+                score = score + jnp.where(sid2 >= 0, norm, 0.0)
             score = jnp.where(feasible, score, -1.0)
 
             best, maxv, _ = pc.lowest_index_max(score, N, iota)
@@ -451,8 +467,14 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
         prefrows0 = f32(fc.pref_scores).T
         prefid_pad = jnp.pad(jnp.asarray(fc.pod_pref_id, jnp.int32), pad_p,
                              constant_values=-1)
+        S2 = fc.ppref_w.shape[0] if T else 0  # zero rows == no profiles
+        S2_eff = max(S2, 1)
+        pprefid_pad = jnp.pad(jnp.asarray(fc.pod_ppref_id, jnp.int32), pad_p,
+                              constant_values=-1)
+        pprefw0 = (f32(fc.ppref_w) if S2
+                   else jnp.zeros((1, max(T, 1)), jnp.float32))
 
-        kernel = _make_kernel(weights, prod_mode, N, R, K, G_eff, T, S)
+        kernel = _make_kernel(weights, prod_mode, N, R, K, G_eff, T, S, S2)
         grid_inputs = (
             spad(inputs.is_prod), spad(inputs.pod_valid),
             spad(inputs.is_daemonset), spad(gang_pod_ok),
@@ -461,7 +483,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             jnp.pad(f32(fc.pod_taint_mask), pad_p, constant_values=1.0),
             affreq_m, antireq_m, affmatch_m,
             skew0_m, skew1_m, skew2_m, affexists0,
-            prefid_pad,
+            prefid_pad, pprefid_pad, pprefw0,
             qid_pad,
             pods_t(inputs.fit_requests), pods_t(fc.requests),
             pods_t(inputs.estimated),
@@ -481,7 +503,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             kernel,
             grid=(P_pad // UNROLL,),
             in_specs=(
-                [smem()] * 18
+                [smem()] * 20
                 + [pod_spec] * 3
                 + [full((R, N))] * 4
                 + [full((1, N))] * 9

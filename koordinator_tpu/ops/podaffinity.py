@@ -87,7 +87,7 @@ def _terms_of(pod) -> List[Term]:
 
 
 def build_affinity_state(pending_pods, nodes, existing_pods):
-    """-> (terms, aff_dom [N, T] f32, aff_count [N, T] f32,
+    """-> (terms, ids, aff_dom [N, T] f32, aff_count [N, T] f32,
            aff_exists [T] bool,
            aff_req [P_valid, T] bool, anti_req [P_valid, T] bool,
            match [P_valid, T] bool, spread_skew [P_valid, T] f32,
@@ -125,6 +125,24 @@ def build_affinity_state(pending_pods, nodes, existing_pods):
                 "batch encoding holds; it is unschedulable this round",
                 pod.meta.key, MAX_TERMS,
             )
+    # preferred pod-affinity terms join the SHARED space (their weighted
+    # scores read the same domain counts); budget overflow here only drops
+    # the preference — soft scoring degrades, never blocks
+    pref_dropped = 0
+    for pod in pending_pods:
+        for raw in pod.spec.pod_affinity_preferred:
+            key = _term_key(raw, pod)
+            if key in ids:
+                continue
+            if len(terms) >= MAX_TERMS:
+                pref_dropped += 1
+                continue
+            ids[key] = len(terms)
+            terms.append(key)
+    if pref_dropped:
+        logger.warning(
+            "preferred pod-affinity terms beyond the %d-term budget: %d "
+            "dropped to zero weight this round", MAX_TERMS, pref_dropped)
     T = len(terms)
     N = len(nodes)
     P = len(pending_pods)
@@ -136,8 +154,8 @@ def build_affinity_state(pending_pods, nodes, existing_pods):
     match = np.zeros((P, T), bool)
     spread_skew = np.zeros((P, T), np.float32)
     if T == 0:
-        return (terms, aff_dom, aff_count, aff_exists, aff_req, anti_req,
-                match, spread_skew, overflow_pods)
+        return (terms, ids, aff_dom, aff_count, aff_exists, aff_req,
+                anti_req, match, spread_skew, overflow_pods)
 
     # domain ids per term: nodes sharing the topology label value
     node_values: List[dict] = []
@@ -188,8 +206,8 @@ def build_affinity_state(pending_pods, nodes, existing_pods):
             t = ids.get(_spread_key(con, pod))
             if t is not None:
                 spread_skew[i, t] = float(min(max(con.max_skew, 1), MAX_SKEW))
-    return (terms, aff_dom, aff_count, aff_exists, aff_req, anti_req, match,
-            spread_skew, overflow_pods)
+    return (terms, ids, aff_dom, aff_count, aff_exists, aff_req, anti_req,
+            match, spread_skew, overflow_pods)
 
 
 MAX_PREF_PROFILES = 32
@@ -259,3 +277,68 @@ def build_preferred_scores(pending_pods, nodes):
             pref_rows[s] = np.floor(
                 row * np.float32(100.0) / np.float32(mx)) if mx > 0 else 0.0
     return pref_rows, pod_pref_id
+
+
+MAX_PPREF_PROFILES = 16
+
+
+def build_preferred_pod_profiles(pending_pods, term_ids: dict, T: int):
+    """preferredDuringScheduling POD affinity, profile-bucketed over the
+    SHARED term space (the counts the required terms maintain are exactly
+    the weighted sum's inputs; build_affinity_state interned the terms):
+
+    -> (ppref_w [S2, max(T, 1)] f32 (ZERO rows when no profiles — the
+        kernels gate on the shape), pod_ppref_id [P] int32,
+        pod_ppref_mask [P, T] bool)
+
+    ppref_w[s] holds the per-term weights of profile s (negative = anti
+    preference); pod_ppref_mask marks the terms a pod's profile references
+    (the wave kernel's conflict rule). Profiles beyond MAX_PPREF_PROFILES
+    are dropped with a warning: soft scoring degrades, never blocks."""
+    P = len(pending_pods)
+    pod_ppref_id = np.full(P, -1, np.int32)
+    profiles: List[tuple] = []
+    ids: dict = {}
+    dropped = 0
+    per_pod_terms: List[List[tuple]] = []
+    for pod in pending_pods:
+        entries = []
+        for raw in pod.spec.pod_affinity_preferred:
+            t = term_ids.get(_term_key(raw, pod))
+            if t is None:
+                continue  # dropped at intern time (budget), already logged
+            # upstream validates weight into 1..100; clamping (with sign
+            # preserved for anti preference) also keeps every weighted
+            # count sum an exact f32 integer — the bit-parity contract
+            w = int(raw.weight)
+            w = max(-100, min(w, 100)) or 1
+            entries.append((w, t))
+        per_pod_terms.append(entries)
+    for i, entries in enumerate(per_pod_terms):
+        if not entries:
+            continue
+        key = tuple(sorted(entries))
+        sid = ids.get(key)
+        if sid is None:
+            if len(profiles) >= MAX_PPREF_PROFILES:
+                dropped += 1
+                continue
+            sid = ids[key] = len(profiles)
+            profiles.append(key)
+        pod_ppref_id[i] = sid
+    if dropped:
+        logger.warning(
+            "preferred pod-affinity profile budget exceeded: %d profiles "
+            "dropped to zero weight this round", dropped)
+    S2 = len(profiles)
+    ppref_w = np.zeros((max(S2, 1), max(T, 1)), np.float32)
+    pod_ppref_mask = np.zeros((P, max(T, 1)), bool)
+    for s, entries in enumerate(profiles):
+        for w, t in entries:
+            ppref_w[s, t] += float(w)
+    for i, entries in enumerate(per_pod_terms):
+        if pod_ppref_id[i] < 0:
+            continue
+        for _w, t in entries:
+            pod_ppref_mask[i, t] = True
+    return ppref_w, pod_ppref_id, pod_ppref_mask

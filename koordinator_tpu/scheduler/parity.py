@@ -220,6 +220,8 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
     pod_spread_skew = np.asarray(fc.pod_spread_skew, np.float32)
     pod_pref_id = np.asarray(fc.pod_pref_id)
     pref_scores = np.asarray(fc.pref_scores, np.float32)
+    pod_ppref_id = np.asarray(fc.pod_ppref_id)
+    ppref_w = np.asarray(fc.ppref_w, np.float32)
     T = aff_dom.shape[1]
 
     P, R = fit_requests.shape
@@ -274,6 +276,19 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
             continue
         best_n, best_score = -1, np.float32(-1.0)
         best_zone = -1
+        # preferred POD affinity: weighted count row + max-min norm, hoisted
+        # per pod (counts are frozen during one pod's node scan)
+        ppref_norm = None
+        if T and pod_ppref_id[p] >= 0:
+            w_row = ppref_w[pod_ppref_id[p], :T]
+            raw = (aff_count[:, :T] * w_row[None, :]).sum(axis=1,
+                                                          dtype=np.float32)
+            mx, mn = raw.max(), raw.min()
+            if mx > mn:
+                ppref_norm = np.floor(
+                    (raw - mn) * np.float32(100.0) / np.float32(mx - mn))
+            else:
+                ppref_norm = np.zeros_like(raw)
         # spread minimums hoisted per (pod, term): invariant across the node
         # scan, restricted to domains of nodes the pod is ELIGIBLE for
         # (admission bit test), matching the batched evaluators
@@ -374,6 +389,8 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
             s = la_score + numa_score
             if pod_pref_id[p] >= 0:
                 s = s + pref_scores[n, pod_pref_id[p]]
+            if ppref_norm is not None:
+                s = s + ppref_norm[n]
             if s > best_score:
                 best_n, best_score, best_zone = n, s, zone
         if best_n < 0:

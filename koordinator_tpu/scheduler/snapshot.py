@@ -299,8 +299,8 @@ def build_full_chain_inputs(
         p for p in state.pods_by_key.values()
         if p.is_assigned and not p.is_terminated
     ]
-    (_aff_terms, dom_v, count_v, aff_exists, aff_req_v, anti_req_v, match_v,
-     spread_v, aff_overflow) = build_affinity_state(
+    (_aff_terms, term_ids, dom_v, count_v, aff_exists, aff_req_v,
+     anti_req_v, match_v, spread_v, aff_overflow) = build_affinity_state(
         ordered_pending, state.nodes, existing)
     T = dom_v.shape[1]
     aff_dom = np.full((N, T), -1.0, np.float32)
@@ -319,7 +319,10 @@ def build_full_chain_inputs(
         pods.valid[i] = False
 
     # preferred node affinity (soft scoring), profile-bucketed
-    from koordinator_tpu.ops.podaffinity import build_preferred_scores
+    from koordinator_tpu.ops.podaffinity import (
+        build_preferred_pod_profiles,
+        build_preferred_scores,
+    )
 
     pref_rows_v, pref_id_v = build_preferred_scores(
         ordered_pending, state.nodes)
@@ -327,6 +330,14 @@ def build_full_chain_inputs(
     pref_scores[: pref_rows_v.shape[1]] = pref_rows_v.T
     pod_pref_id = np.full(P, -1, np.int32)
     pod_pref_id[: pref_id_v.shape[0]] = pref_id_v
+
+    # preferred POD affinity (weighted, over the shared term space)
+    ppref_w, ppref_id_v, ppref_mask_v = build_preferred_pod_profiles(
+        ordered_pending, term_ids, T)
+    pod_ppref_id = np.full(P, -1, np.int32)
+    pod_ppref_id[: ppref_id_v.shape[0]] = ppref_id_v
+    pod_ppref_mask = np.zeros((P, T), bool)
+    pod_ppref_mask[: ppref_mask_v.shape[0]] = ppref_mask_v[:, :T]
 
     base = make_inputs(pods, nodes, args)
     G = max(1, len(tree.names))
@@ -346,6 +357,9 @@ def build_full_chain_inputs(
         pod_spread_skew=np.asarray(pod_spread_skew),
         pod_pref_id=np.asarray(pod_pref_id),
         pref_scores=np.asarray(pref_scores),
+        pod_ppref_id=np.asarray(pod_ppref_id),
+        pod_ppref_mask=np.asarray(pod_ppref_mask),
+        ppref_w=np.asarray(ppref_w),
         node_taint_group=np.asarray(node_taint_group),
         aff_dom=np.asarray(aff_dom),
         aff_count=np.asarray(aff_count),

@@ -367,3 +367,63 @@ def test_preferred_node_affinity_scoring():
             if state.nodes[chosen[i]].meta.labels["disk"] == "ssd":
                 on_ssd += 1
     assert total > 0 and on_ssd >= total * 0.7, (on_ssd, total)
+
+
+def test_preferred_pod_affinity_scoring():
+    """Weighted (soft) inter-pod affinity: co-location preference pulls
+    replicas toward domains with matches (and negative weights push away),
+    bit-identically across XLA, oracle, Pallas interpret, wave, and the
+    C++ floor."""
+    from koordinator_tpu.api.objects import PreferredPodTerm
+    from koordinator_tpu.models.wave_chain import build_wave_full_chain_step
+    from koordinator_tpu.native import floor as native_floor
+    from koordinator_tpu.ops.pallas_full_chain import (
+        build_pallas_full_chain_step,
+    )
+
+    args = LoadAwareArgs()
+    cluster, state = synth_full_cluster(18, 24, seed=43)
+    for j, node in enumerate(state.nodes):
+        node.meta.labels[ZONE_KEY] = f"z{j % 3}"
+    # seed: one existing cache pod pinned in some zone
+    seed_pod = next(p for p in state.pods_by_key.values()
+                    if p.is_assigned and not p.is_terminated)
+    seed_pod.meta.labels["app"] = "cache"
+    seed_zone = None
+    for n in state.nodes:
+        if n.meta.name == seed_pod.spec.node_name:
+            seed_zone = n.meta.labels[ZONE_KEY]
+    n_soft = 0
+    for i, pod in enumerate(state.pending_pods):
+        if i % 2 == 0:
+            pod.spec.pod_affinity_preferred.append(PreferredPodTerm(
+                weight=80, selector={"app": "cache"}, topology_key=ZONE_KEY))
+            n_soft += 1
+    fc, pods, nodes, tree, gi, ng, ngroups = build_full_chain_inputs(
+        state, args)
+    assert (np.asarray(fc.pod_ppref_id) >= 0).sum() == n_soft
+    chosen = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    serial = serial_schedule_full(fc, args)
+    n = len(pods.keys)
+    np.testing.assert_array_equal(chosen[:n], serial[:n])
+    chosen_p = np.asarray(
+        build_pallas_full_chain_step(args, ng, ngroups, interpret=True)(fc)[0])
+    np.testing.assert_array_equal(chosen, chosen_p)
+    chosen_w = np.asarray(
+        build_wave_full_chain_step(args, ng, ngroups, wave=8)(fc)[0])
+    np.testing.assert_array_equal(chosen, chosen_w)
+    if native_floor.available() or native_floor.build():
+        chosen_nat = native_floor.serial_schedule_full_native(
+            fc, args, num_groups=ngroups)
+        np.testing.assert_array_equal(chosen[:n], chosen_nat[:n])
+
+    # most preferring pods gravitate to the seeded zone
+    by_key = {p.meta.key: p for p in state.pending_pods}
+    near = tot = 0
+    for i, key in enumerate(pods.keys):
+        if chosen[i] < 0:
+            continue
+        if by_key[key].spec.pod_affinity_preferred:
+            tot += 1
+            near += state.nodes[chosen[i]].meta.labels[ZONE_KEY] == seed_zone
+    assert tot > 0 and near >= tot * 0.6, (near, tot, seed_zone)
