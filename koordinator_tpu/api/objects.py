@@ -104,14 +104,17 @@ class PreferredPodTerm:
 
 @dataclass
 class TopologySpreadConstraint:
-    """whenUnsatisfiable=DoNotSchedule topology spread (core/v1
-    TopologySpreadConstraint, matchLabels form): placing the pod in a
-    domain must keep count(domain) + 1 - min(eligible domain counts)
-    <= max_skew. Evaluated by the vendored PodTopologySpread plugin."""
+    """core/v1 TopologySpreadConstraint (matchLabels form), evaluated by
+    the vendored PodTopologySpread plugin. whenUnsatisfiable=DoNotSchedule
+    filters: placing the pod in a domain must keep count(domain) + 1 -
+    min(eligible domain counts) <= max_skew. ScheduleAnyway only scores:
+    emptier domains rank higher (a -1 weight on the constraint's own term
+    in the preferred-affinity machinery)."""
 
     max_skew: int = 1
     topology_key: str = "kubernetes.io/hostname"
     selector: Dict[str, str] = field(default_factory=dict)
+    when_unsatisfiable: str = "DoNotSchedule"
 
 
 @dataclass

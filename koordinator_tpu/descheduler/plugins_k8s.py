@@ -343,6 +343,12 @@ class RemovePodsViolatingTopologySpreadConstraint(BalancePlugin):
         carriers: Dict[tuple, List[Pod]] = defaultdict(list)
         for pod in live:
             for con in pod.spec.topology_spread:
+                # ScheduleAnyway is advisory scoring — the scheduler may
+                # legitimately exceed its skew; enforcing it here would
+                # evict/re-place in a loop (upstream includeSoftConstraints
+                # defaults to false)
+                if con.when_unsatisfiable == "ScheduleAnyway":
+                    continue
                 carriers[(_spread_key(con, pod), int(con.max_skew))].append(
                     pod)
         for (term, max_skew), constrained in carriers.items():

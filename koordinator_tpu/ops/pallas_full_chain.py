@@ -468,7 +468,6 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
         prefid_pad = jnp.pad(jnp.asarray(fc.pod_pref_id, jnp.int32), pad_p,
                              constant_values=-1)
         S2 = fc.ppref_w.shape[0] if T else 0  # zero rows == no profiles
-        S2_eff = max(S2, 1)
         pprefid_pad = jnp.pad(jnp.asarray(fc.pod_ppref_id, jnp.int32), pad_p,
                               constant_values=-1)
         pprefw0 = (f32(fc.ppref_w) if S2

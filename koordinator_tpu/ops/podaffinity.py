@@ -78,11 +78,15 @@ def _spread_key(con, pod) -> Term:
 
 
 def _terms_of(pod) -> List[Term]:
+    """HARD terms only — budget overflow on these marks the pod
+    unschedulable. ScheduleAnyway spread is soft and interns with the
+    preferences (overflow only drops the score)."""
     out = []
     for term in list(pod.spec.pod_affinity) + list(pod.spec.pod_anti_affinity):
         out.append(_term_key(term, pod))
     for con in pod.spec.topology_spread:
-        out.append(_spread_key(con, pod))
+        if con.when_unsatisfiable != "ScheduleAnyway":
+            out.append(_spread_key(con, pod))
     return out
 
 
@@ -130,8 +134,12 @@ def build_affinity_state(pending_pods, nodes, existing_pods):
     # the preference — soft scoring degrades, never blocks
     pref_dropped = 0
     for pod in pending_pods:
-        for raw in pod.spec.pod_affinity_preferred:
-            key = _term_key(raw, pod)
+        soft_keys = [_term_key(raw, pod)
+                     for raw in pod.spec.pod_affinity_preferred]
+        soft_keys += [_spread_key(con, pod)
+                      for con in pod.spec.topology_spread
+                      if con.when_unsatisfiable == "ScheduleAnyway"]
+        for key in soft_keys:
             if key in ids:
                 continue
             if len(terms) >= MAX_TERMS:
@@ -204,7 +212,7 @@ def build_affinity_state(pending_pods, nodes, existing_pods):
                 anti_req[i, t] = True
         for con in pod.spec.topology_spread:
             t = ids.get(_spread_key(con, pod))
-            if t is not None:
+            if t is not None and con.when_unsatisfiable != "ScheduleAnyway":
                 spread_skew[i, t] = float(min(max(con.max_skew, 1), MAX_SKEW))
     return (terms, ids, aff_dom, aff_count, aff_exists, aff_req, anti_req,
             match, spread_skew, overflow_pods)
@@ -313,6 +321,14 @@ def build_preferred_pod_profiles(pending_pods, term_ids: dict, T: int):
             w = int(raw.weight)
             w = max(-100, min(w, 100)) or 1
             entries.append((w, t))
+        # ScheduleAnyway topology spread scores instead of filtering:
+        # emptier domains of the constraint's own term rank higher
+        for con in pod.spec.topology_spread:
+            if con.when_unsatisfiable != "ScheduleAnyway":
+                continue
+            t = term_ids.get(_spread_key(con, pod))
+            if t is not None:
+                entries.append((-1, t))
         per_pod_terms.append(entries)
     for i, entries in enumerate(per_pod_terms):
         if not entries:
@@ -331,7 +347,7 @@ def build_preferred_pod_profiles(pending_pods, term_ids: dict, T: int):
             "preferred pod-affinity profile budget exceeded: %d profiles "
             "dropped to zero weight this round", dropped)
     S2 = len(profiles)
-    ppref_w = np.zeros((max(S2, 1), max(T, 1)), np.float32)
+    ppref_w = np.zeros((S2, max(T, 1)), np.float32)
     pod_ppref_mask = np.zeros((P, max(T, 1)), bool)
     for s, entries in enumerate(profiles):
         for w, t in entries:

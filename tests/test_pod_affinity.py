@@ -427,3 +427,43 @@ def test_preferred_pod_affinity_scoring():
             tot += 1
             near += state.nodes[chosen[i]].meta.labels[ZONE_KEY] == seed_zone
     assert tot > 0 and near >= tot * 0.6, (near, tot, seed_zone)
+
+
+def test_schedule_anyway_spread_scores_but_never_blocks():
+    """ScheduleAnyway spread: replicas prefer emptier zones but a full zone
+    never makes them unschedulable (unlike DoNotSchedule), and bindings
+    stay bit-identical to the serial oracle."""
+    from koordinator_tpu.api.objects import TopologySpreadConstraint
+
+    args = LoadAwareArgs()
+    cluster, state = synth_full_cluster(12, 18, seed=47)
+    for j, node in enumerate(state.nodes):
+        node.meta.labels[ZONE_KEY] = f"z{j % 3}"
+    for pod in state.pending_pods:
+        pod.meta.labels["app"] = "soft"
+        pod.spec.topology_spread.append(TopologySpreadConstraint(
+            max_skew=1, topology_key=ZONE_KEY, selector={"app": "soft"},
+            when_unsatisfiable="ScheduleAnyway"))
+    fc, pods, nodes, tree, gi, ng, ngroups = build_full_chain_inputs(
+        state, args)
+    n = len(pods.keys)
+    assert not (np.asarray(fc.pod_spread_skew) > 0).any()  # no hard filter
+    assert (np.asarray(fc.pod_ppref_id)[:n] >= 0).all()    # soft scoring on
+    chosen = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    serial = serial_schedule_full(fc, args)
+    np.testing.assert_array_equal(chosen[:n], serial[:n])
+    placed = [i for i in range(n) if chosen[i] >= 0]
+    # the soft constraint never blocks: the same cluster WITHOUT any
+    # constraint places exactly as many pods (capacity is the only limit)
+    cluster2, state2 = synth_full_cluster(12, 18, seed=47)
+    for j, node in enumerate(state2.nodes):
+        node.meta.labels[ZONE_KEY] = f"z{j % 3}"
+    fc2, pods2, *_rest2, ng2, ngroups2 = build_full_chain_inputs(
+        state2, args)
+    chosen2 = np.asarray(build_full_chain_step(args, ng2, ngroups2)(fc2)[0])
+    assert len(placed) == int((chosen2[: len(pods2.keys)] >= 0).sum())
+    from collections import Counter
+
+    zones = Counter(state.nodes[chosen[i]].meta.labels[ZONE_KEY]
+                    for i in placed)
+    assert max(zones.values()) - min(zones.values()) <= 2, zones
