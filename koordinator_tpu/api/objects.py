@@ -47,6 +47,10 @@ ANNOTATION_EXTENDED_RESOURCE_SPEC = NODE_DOMAIN_PREFIX + "/extended-resource-spe
 # pods may never carry it (pkg/util/reservation/reservation.go:44, enforced
 # by webhook pod/validating/verify_annotations.go:60-76)
 ANNOTATION_RESERVE_POD = SCHEDULING_DOMAIN_PREFIX + "/reserve-pod"
+# node-level resource reservation for system daemons
+# (apis/extension/node_reservation.go:28-44): {"resources": {...},
+# "reservedCPUs": "1-6", "applyPolicy": "Default"|"ReservedCPUsOnly"}
+ANNOTATION_NODE_RESERVATION = NODE_DOMAIN_PREFIX + "/reservation"
 LABEL_QUOTA_NAME = QUOTA_DOMAIN_PREFIX + "/name"
 LABEL_QUOTA_PARENT = QUOTA_DOMAIN_PREFIX + "/parent"
 LABEL_QUOTA_IS_PARENT = QUOTA_DOMAIN_PREFIX + "/is-parent"
@@ -271,6 +275,43 @@ class Node:
     unschedulable: bool = False
     taints: List[Tuple[str, str]] = field(default_factory=list)  # (key, value)
     ready: bool = True
+
+    def node_reservation(self):
+        """(reserved ResourceList, reserved_cpus str, trims_allocatable) from
+        the node-reservation annotation (apis/extension/node_reservation.go
+        GetNodeReservation + pkg/util/node.go GetNodeReservationResources):
+        reservedCPUs overrides the cpu quantity with the cpuset's core count;
+        applyPolicy Default (or empty) trims schedulable allocatable,
+        ReservedCPUsOnly reserves the cores without trimming. Malformed
+        annotations reserve nothing (the reference logs and returns nil)."""
+        raw = self.meta.annotations.get(ANNOTATION_NODE_RESERVATION)
+        empty = ResourceList()
+        if not raw:
+            return empty, "", False
+        import json
+
+        from koordinator_tpu.api.resources import parse_quantity
+
+        try:
+            data = json.loads(raw)
+            if not isinstance(data, dict):
+                return empty, "", False
+            resources = data.get("resources")
+            if not isinstance(resources, dict):
+                resources = {}
+            reserved = ResourceList()
+            for name, qty in resources.items():
+                reserved.quantities[name] = parse_quantity(
+                    str(qty), cpu=(name == "cpu"))
+            cpus = str(data.get("reservedCPUs") or "")
+            if cpus:
+                from koordinator_tpu.utils.cpuset import CPUSet
+
+                reserved.quantities["cpu"] = len(CPUSet.parse(cpus)) * 1000
+            policy = data.get("applyPolicy") or "Default"
+            return reserved, cpus, policy == "Default"
+        except (ValueError, TypeError):
+            return empty, "", False
 
 
 # ---------------------------------------------------------------------------

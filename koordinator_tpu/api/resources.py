@@ -68,6 +68,10 @@ RESOURCE_AXES = (
 )
 RESOURCE_INDEX: Dict[str, int] = {name: i for i, name in enumerate(RESOURCE_AXES)}
 NUM_RESOURCES = len(RESOURCE_AXES)
+# axes koord-manager computes AFTER applying node reservation — the node
+# transformer must not trim them again (pkg/util/node.go)
+BATCH_AXES = (RESOURCE_INDEX[ResourceName.BATCH_CPU],
+              RESOURCE_INDEX[ResourceName.BATCH_MEMORY])
 
 # Axes whose wire unit is bytes; packed as MiB to stay exact in float32.
 _MEMORY_LIKE = frozenset(

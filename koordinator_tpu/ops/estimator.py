@@ -121,5 +121,16 @@ def estimate_pods_used_batch(
 def estimate_node_allocatable(node: Node) -> np.ndarray:
     """EstimateNode (default_estimator.go:110+): raw-allocatable annotation wins
     over status.allocatable when present (resource amplification); we model the
-    amplified value directly on Node.allocatable."""
-    return node.allocatable.to_vector()
+    amplified value directly on Node.allocatable. The node-reservation
+    annotation (applyPolicy Default) trims schedulable allocatable — except
+    the batch-* axes, which koord-manager already reserved-adjusted
+    (pkg/util/node.go TrimNodeAllocatableByNodeReservation)."""
+    vec = node.allocatable.to_vector()
+    reserved, _cpus, trims = node.node_reservation()
+    if trims and reserved.quantities:
+        from koordinator_tpu.api.resources import BATCH_AXES
+
+        rvec = reserved.to_vector()
+        rvec[list(BATCH_AXES)] = 0.0
+        vec = np.maximum(vec - rvec, 0.0)
+    return vec

@@ -68,6 +68,9 @@ class NodeNUMAResourcePlugin(Plugin):
         self.store = store
         store.subscribe(KIND_NODE_TOPOLOGY, self._on_topology)
         store.subscribe(KIND_POD, self._on_pod)
+        from koordinator_tpu.client.store import KIND_NODE
+
+        store.subscribe(KIND_NODE, self._on_node)
 
     def _on_pod(self, ev: EventType, pod: Pod, old) -> None:
         """Release zone + cpuset accounting when an assigned pod dies (the
@@ -101,6 +104,31 @@ class NodeNUMAResourcePlugin(Plugin):
                     CPUSet(cr.kubelet_reserved_cpus),
                     EXCLUSIVE_NONE,
                 )
+            self._sync_node_reservation(name)
+
+    def _on_node(self, ev: EventType, node, old) -> None:
+        """Re-sync the node-reservation cpuset claim whenever the Node object
+        changes — the annotation may appear, change, or vanish after the
+        topology CR created the allocation state (or arrive before the Node
+        existed at all)."""
+        if ev is not EventType.DELETED and node.meta.name in self.cpu_states:
+            self._sync_node_reservation(node.meta.name)
+
+    def _sync_node_reservation(self, name: str) -> None:
+        """node-reservation annotation reservedCPUs are unavailable to
+        cpuset allocation under BOTH apply policies
+        (nodenumaresource/reservation.go via apis/extension)."""
+        state = self.cpu_states.get(name)
+        if state is None or self.store is None:
+            return
+        from koordinator_tpu.client.store import KIND_NODE
+        from koordinator_tpu.utils.cpuset import CPUSet
+
+        node = self.store.get(KIND_NODE, f"/{name}")
+        cpus = node.node_reservation()[1] if node is not None else ""
+        state.remove("node-reservation")
+        if cpus:
+            state.add("node-reservation", CPUSet.parse(cpus), EXCLUSIVE_NONE)
 
     # -- NUMATopologyHintProvider (topologymanager.py) -----------------
     def node_policy(self, node_name: str) -> str:

@@ -474,3 +474,62 @@ def test_affinity_spread_selector_end_to_end():
     leader_zone = nodes[by_pod["default/leader"]].meta.labels["zone"]
     follower_zone = nodes[by_pod["default/follower"]].meta.labels["zone"]
     assert leader_zone == follower_zone
+
+
+def test_node_reservation_trims_allocatable_end_to_end():
+    """node.koordinator.sh/reservation reserves resources for system daemons
+    (apis/extension/node_reservation.go + pkg/util/node.go trim): the
+    scheduler must not hand reserved capacity to pods, and reservedCPUs
+    never enter cpuset allocations."""
+    import json as _json
+
+    from koordinator_tpu.api.objects import ANNOTATION_NODE_RESERVATION
+
+    store = make_store(num_nodes=1, cores=8, mem_gib=16)
+    node = store.list(KIND_NODE)[0]
+    node.meta.annotations[ANNOTATION_NODE_RESERVATION] = _json.dumps(
+        {"reservedCPUs": "0-3"})  # 4 of 8 cores reserved
+    store.update(KIND_NODE, node)  # fire the reservation re-sync
+    sched = Scheduler(store)
+    # LSR pod first, while capacity is free: it MUST bind and its cpuset
+    # must avoid the reserved cores
+    pend_pod(store, "lsr", cpu=2000, qos="LSR")
+    r1 = sched.run_cycle(now=NOW)
+    assert any(b.pod_key == "default/lsr" for b in r1.bound)
+    lsr = next(p for p in store.list(KIND_POD) if p.meta.name == "lsr")
+    status = json.loads(lsr.meta.annotations[ANNOTATION_RESOURCE_STATUS])
+    from koordinator_tpu.utils.cpuset import CPUSet
+
+    cpus = CPUSet.parse(status["cpuset"])
+    assert len(cpus) == 2
+    assert not (set(cpus) & {0, 1, 2, 3}), status["cpuset"]
+    # capacity trim: 8 cores raw - 4 reserved - 2 (lsr) leaves 2 cores
+    for i in range(2):
+        pend_pod(store, f"p{i}", cpu=2000, mem=GIB)
+    r2 = sched.run_cycle(now=NOW + 1)
+    bound2 = {b.pod_key for b in r2.bound}
+    assert len(bound2) == 1  # only one more 2-core pod fits
+
+
+def test_node_reservation_cpus_only_policy_keeps_allocatable():
+    """applyPolicy=ReservedCPUsOnly reserves the cores for cpuset purposes
+    without trimming schedulable allocatable."""
+    import json as _json
+
+    from koordinator_tpu.api.objects import ANNOTATION_NODE_RESERVATION
+    from koordinator_tpu.ops.estimator import estimate_node_allocatable
+
+    store = make_store(num_nodes=1, cores=8, mem_gib=16)
+    node = store.list(KIND_NODE)[0]
+    node.meta.annotations[ANNOTATION_NODE_RESERVATION] = _json.dumps(
+        {"reservedCPUs": "0-3", "applyPolicy": "ReservedCPUsOnly"})
+    vec = estimate_node_allocatable(node)
+    assert vec[0] == 8000  # untrimmed
+    node.meta.annotations[ANNOTATION_NODE_RESERVATION] = _json.dumps(
+        {"resources": {"cpu": "2", "memory": "4Gi"}})
+    vec2 = estimate_node_allocatable(node)
+    assert vec2[0] == 6000
+    assert vec2[1] == 12 * 1024  # memory packs in MiB wire units
+    # malformed annotation reserves nothing
+    node.meta.annotations[ANNOTATION_NODE_RESERVATION] = "not-json"
+    assert estimate_node_allocatable(node)[0] == 8000
