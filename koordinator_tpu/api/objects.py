@@ -51,6 +51,10 @@ ANNOTATION_RESERVE_POD = SCHEDULING_DOMAIN_PREFIX + "/reserve-pod"
 # (apis/extension/node_reservation.go:28-44): {"resources": {...},
 # "reservedCPUs": "1-6", "applyPolicy": "Default"|"ReservedCPUsOnly"}
 ANNOTATION_NODE_RESERVATION = NODE_DOMAIN_PREFIX + "/reservation"
+# CPU cores dedicated to SYSTEM QoS pods (apis/extension/system_qos.go:24):
+# {"cpuset": "0-1", "cpusetExclusive": true} — exclusive (the default) bars
+# LS/LSR/BE pods from those cores
+ANNOTATION_NODE_SYSTEM_QOS = NODE_DOMAIN_PREFIX + "/system-qos-resource"
 LABEL_QUOTA_NAME = QUOTA_DOMAIN_PREFIX + "/name"
 LABEL_QUOTA_PARENT = QUOTA_DOMAIN_PREFIX + "/parent"
 LABEL_QUOTA_IS_PARENT = QUOTA_DOMAIN_PREFIX + "/is-parent"
@@ -312,6 +316,29 @@ class Node:
             return reserved, cpus, policy == "Default"
         except (ValueError, TypeError):
             return empty, "", False
+
+    def system_qos_resource(self):
+        """(cpuset str, exclusive bool) from the system-qos-resource
+        annotation (apis/extension/system_qos.go GetSystemQOSResource):
+        exclusive defaults to True; malformed annotations yield no cpuset."""
+        raw = self.meta.annotations.get(ANNOTATION_NODE_SYSTEM_QOS)
+        if not raw:
+            return "", True
+        import json
+
+        try:
+            data = json.loads(raw)
+            if not isinstance(data, dict):
+                return "", True
+            cpuset = str(data.get("cpuset") or "")
+            if cpuset:
+                from koordinator_tpu.utils.cpuset import CPUSet
+
+                CPUSet.parse(cpuset)  # malformed -> reserve nothing
+            exclusive = data.get("cpusetExclusive")
+            return cpuset, exclusive is None or bool(exclusive)
+        except (ValueError, TypeError):
+            return "", True
 
 
 # ---------------------------------------------------------------------------

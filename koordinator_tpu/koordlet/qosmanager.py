@@ -144,15 +144,33 @@ class CPUSuppress:
             self.policy_in_use = "cfsQuota"
             koordlet_metrics.BE_SUPPRESS_CPU_CORES.set(quota / period)
         else:
-            # cpuset policy: round up, at least 2, paired HT cores from the top
+            # cpuset policy: round up, at least 2, paired HT cores from the
+            # top — skipping the node's exclusive SYSTEM-QoS cores
+            # (cpu_suppress.go system-qos-resource path)
+            excluded = self._system_qos_excluded(node)
             want = min(max(int(math.ceil(suppress)), self.MIN_SUPPRESS_CPUS),
-                       max(total_cpus, self.MIN_SUPPRESS_CPUS))
-            cpus = CPUSet(range(want))  # cpu ids 0..want-1 (paired cores first)
+                       max(total_cpus - len(excluded),
+                           self.MIN_SUPPRESS_CPUS))
+            # only real cpu ids: running past total_cpus would write a
+            # cpuset the kernel rejects with EINVAL
+            picked = [c for c in range(total_cpus) if c not in excluded]
+            cpus = CPUSet(picked[:want])
             self.ctx.executor.update(
                 ResourceUpdater(be_rel, sysutil.CPUSET_CPUS, cpus.format())
             )
             self.policy_in_use = "cpuset"
-            koordlet_metrics.BE_SUPPRESS_CPU_CORES.set(float(want))
+            koordlet_metrics.BE_SUPPRESS_CPU_CORES.set(float(len(picked)))
+
+    @staticmethod
+    def _system_qos_excluded(node) -> set:
+        """Exclusive SYSTEM-QoS cores are barred to BE under suppression
+        AND recovery (cpu_suppress.go system-qos-resource path)."""
+        if node is None:
+            return set()
+        sys_cpus, sys_exclusive = node.system_qos_resource()
+        if sys_cpus and sys_exclusive:
+            return set(CPUSet.parse(sys_cpus))
+        return set()
 
     def _recover(self, be_rel: str) -> None:
         if self.policy_in_use == "cfsQuota":
@@ -163,11 +181,13 @@ class CPUSuppress:
             node = self.ctx.informer.get_node()
             if node is not None:
                 total = int(node.allocatable.get("cpu", 0) // 1000)
-                if total:
+                excluded = self._system_qos_excluded(node)
+                restore = [c for c in range(total) if c not in excluded]
+                if restore:
                     self.ctx.executor.update(
                         ResourceUpdater(
                             be_rel, sysutil.CPUSET_CPUS,
-                            CPUSet(range(total)).format(),
+                            CPUSet(restore).format(),
                         )
                     )
         self.policy_in_use = None

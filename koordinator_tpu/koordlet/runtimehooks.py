@@ -87,7 +87,19 @@ class GroupIdentityHook(Hook):
 class CPUSetHook(Hook):
     name = "CPUSetAllocator"
 
+    def __init__(self, informer: Optional[StatesInformer] = None):
+        self.informer = informer
+
     def apply(self, ctx: ContainerContext) -> None:
+        # SYSTEM QoS pods run on the node's dedicated system cpuset
+        # (hooks/cpuset/rule.go system-qos-resource path)
+        if ctx.pod.qos_class == QoSClass.SYSTEM and self.informer is not None:
+            node = self.informer.get_node()
+            if node is not None:
+                sys_cpus, _excl = node.system_qos_resource()
+                if sys_cpus:
+                    ctx.add_write(sysutil.CPUSET_CPUS, sys_cpus)
+                    return
         raw = ctx.pod.meta.annotations.get(ANNOTATION_RESOURCE_STATUS)
         if not raw:
             return
@@ -363,7 +375,7 @@ class TerwayQoSHook(Hook):
             self._written[path] = content
 
 
-DEFAULT_HOOKS = (GroupIdentityHook, CPUSetHook, BatchResourceHook, GPUEnvHook)
+DEFAULT_HOOKS = (GroupIdentityHook, BatchResourceHook, GPUEnvHook)
 
 
 class HostApplicationHook(Hook):
@@ -416,6 +428,7 @@ class RuntimeHooks:
         self.informer = informer
         self.executor = executor
         self.hooks: List[Hook] = [cls() for cls in DEFAULT_HOOKS]
+        self.hooks.append(CPUSetHook(informer))
         self.hooks.append(CPUNormalizationHook(informer))
         self.hooks.append(CoreSchedHook(informer, executor, cse=core_sched))
         self.hooks.append(TerwayQoSHook(informer, executor))

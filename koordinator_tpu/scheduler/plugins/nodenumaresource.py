@@ -115,9 +115,10 @@ class NodeNUMAResourcePlugin(Plugin):
             self._sync_node_reservation(node.meta.name)
 
     def _sync_node_reservation(self, name: str) -> None:
-        """node-reservation annotation reservedCPUs are unavailable to
-        cpuset allocation under BOTH apply policies
-        (nodenumaresource/reservation.go via apis/extension)."""
+        """node-reservation reservedCPUs (both apply policies) and EXCLUSIVE
+        system-QoS cores are unavailable to cpuset allocation
+        (nodenumaresource/reservation.go + topology_options.go via
+        apis/extension)."""
         state = self.cpu_states.get(name)
         if state is None or self.store is None:
             return
@@ -129,6 +130,11 @@ class NodeNUMAResourcePlugin(Plugin):
         state.remove("node-reservation")
         if cpus:
             state.add("node-reservation", CPUSet.parse(cpus), EXCLUSIVE_NONE)
+        sys_cpus, exclusive = (node.system_qos_resource()
+                               if node is not None else ("", True))
+        state.remove("system-qos")
+        if sys_cpus and exclusive:
+            state.add("system-qos", CPUSet.parse(sys_cpus), EXCLUSIVE_NONE)
 
     # -- NUMATopologyHintProvider (topologymanager.py) -----------------
     def node_policy(self, node_name: str) -> str:

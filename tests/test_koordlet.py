@@ -342,3 +342,38 @@ class TestHostApplicationAccounting:
         # the host-app reclassification suppress floors at 2; moving 10
         # cores of usage to the BE side yields 16*0.65 - (16-10) = 4.4 -> 5
         assert 4 <= got <= 6
+
+
+class TestSystemQOSSuppress:
+    def test_be_suppress_skips_exclusive_system_cores(self, fs):
+        """BE cpuset suppression must not hand out the node's exclusive
+        SYSTEM-QoS cores (cpu_suppress.go system-qos path)."""
+        import json as _json
+
+        from koordinator_tpu.api.objects import ANNOTATION_NODE_SYSTEM_QOS
+        from koordinator_tpu.client.store import KIND_NODE
+        from koordinator_tpu.utils.cpuset import CPUSet
+
+        store = ObjectStore()
+        setup_node(store, fs)
+        node = store.get(KIND_NODE, "/node-0")
+        node.meta.annotations[ANNOTATION_NODE_SYSTEM_QOS] = _json.dumps(
+            {"cpuset": "0-1"})
+        store.update(KIND_NODE, node)
+        slo = NodeSLO(
+            meta=ObjectMeta(name="node-0", namespace=""),
+            resource_used_threshold_with_be=ResourceThresholdStrategy(
+                enable=True, cpu_suppress_threshold_percent=65
+            ),
+        )
+        store.add(KIND_NODE_SLO, slo)
+        add_pod(store, fs, "be", qos="BE", cpu_usage_us=0)
+        be_rel = fs.config.qos_relative_path(sysutil.QOS_BESTEFFORT)
+        fs.set_cgroup(be_rel, sysutil.CPU_STAT, "usage_usec 0\n")
+        daemon = Daemon(store, "node-0", fs.config, report_interval_seconds=0)
+        daemon.run_once(now=NOW)
+        fs.set_proc("stat", "cpu  5000 0 5000 8000 0 0 0 0 0 0\n")
+        daemon.run_once(now=NOW + 10)
+        got = CPUSet.parse(fs.get_cgroup(be_rel, sysutil.CPUSET_CPUS))
+        assert not (set(got) & {0, 1}), got.format()
+        assert len(got) >= 2

@@ -292,3 +292,25 @@ def test_hostapplication_removed_entry_resets_bvt(env):
     hooks.reconcile()
     assert fs.get_cgroup("host-latency-sensitive/nginx",
                          sysutil.CPU_BVT_WARP_NS) == "0"
+
+
+def test_system_qos_pod_gets_node_system_cpuset(env):
+    """SYSTEM QoS pods run on the node's dedicated system-qos cpuset
+    (hooks/cpuset/rule.go + apis/extension/system_qos.go)."""
+    import json as _json
+
+    from koordinator_tpu.api.objects import ANNOTATION_NODE_SYSTEM_QOS
+    from koordinator_tpu.koordlet.util import system as sysutil
+
+    fs, store, informer, executor, cse, hooks = env
+    node = store.get(KIND_NODE, "/" + NODE)
+    node.meta.annotations[ANNOTATION_NODE_SYSTEM_QOS] = _json.dumps(
+        {"cpuset": "0-1"})
+    store.update(KIND_NODE, node)
+    from koordinator_tpu.koordlet.metricsadvisor import pod_qos_dir
+
+    pod = add_pod(store, fs, "sysd", "u-sys", "SYSTEM", [101])
+    rel = fs.config.pod_relative_path(pod_qos_dir(pod), "u-sys")
+    fs.set_cgroup(rel, sysutil.CPUSET_CPUS, "")
+    hooks.reconcile()
+    assert fs.get_cgroup(rel, sysutil.CPUSET_CPUS) == "0-1"
