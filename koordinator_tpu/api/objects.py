@@ -55,6 +55,14 @@ ANNOTATION_NODE_RESERVATION = NODE_DOMAIN_PREFIX + "/reservation"
 # {"cpuset": "0-1", "cpusetExclusive": true} — exclusive (the default) bars
 # LS/LSR/BE pods from those cores
 ANNOTATION_NODE_SYSTEM_QOS = NODE_DOMAIN_PREFIX + "/system-qos-resource"
+# pod operating mode (apis/extension/operating_pod.go:28-50): a pod labeled
+# "Reservation" schedules normally but then acts as a reservation whose
+# owners (JSON ReservationOwner list annotation) consume its resources
+LABEL_POD_OPERATING_MODE = SCHEDULING_DOMAIN_PREFIX + "/operating-mode"
+ANNOTATION_RESERVATION_OWNERS = (
+    SCHEDULING_DOMAIN_PREFIX + "/reservation-owners")
+ANNOTATION_RESERVATION_CURRENT_OWNER = (
+    SCHEDULING_DOMAIN_PREFIX + "/reservation-current-owner")
 LABEL_QUOTA_NAME = QUOTA_DOMAIN_PREFIX + "/name"
 LABEL_QUOTA_PARENT = QUOTA_DOMAIN_PREFIX + "/parent"
 LABEL_QUOTA_IS_PARENT = QUOTA_DOMAIN_PREFIX + "/is-parent"
@@ -170,6 +178,46 @@ class Pod:
     def qos_class(self) -> QoSClass:
         """QoS from the koordinator.sh/qosClass label (apis/extension/qos.go)."""
         return qos_class_by_name(self.meta.labels.get(LABEL_POD_QOS, ""))
+
+    @property
+    def is_reservation_operating_mode(self) -> bool:
+        """operating_pod.go IsReservationOperatingMode."""
+        return self.meta.labels.get(LABEL_POD_OPERATING_MODE) == "Reservation"
+
+    def reservation_owners(self) -> List["ReservationOwner"]:
+        """Parse the reservation-owners annotation (operating_pod.go
+        SetReservationOwners): a JSON list of ReservationOwner objects; both
+        the full {"labelSelector": {"matchLabels": {...}}} form and a flat
+        {"labelSelector": {...}} shorthand are accepted. Malformed
+        annotations yield no owners (the reservation matches nothing)."""
+        import json
+
+        raw = self.meta.annotations.get(ANNOTATION_RESERVATION_OWNERS)
+        if not raw:
+            return []
+        try:
+            data = json.loads(raw)
+            if not isinstance(data, list):
+                return []
+            owners = []
+            for entry in data:
+                if not isinstance(entry, dict):
+                    continue
+                sel = entry.get("labelSelector") or {}
+                if isinstance(sel, dict) and isinstance(
+                        sel.get("matchLabels"), dict):
+                    sel = sel["matchLabels"]
+                if not isinstance(sel, dict):
+                    continue
+                owners.append(ReservationOwner(
+                    label_selector={str(k): str(v) for k, v in sel.items()},
+                    controller_kind=str(entry.get("controllerKind", "")),
+                    controller_name=str(entry.get("controllerName", "")),
+                    namespace=str(entry.get("namespace", "")),
+                ))
+            return owners
+        except (ValueError, TypeError):
+            return []
 
     @property
     def priority_class(self) -> PriorityClass:
@@ -428,6 +476,10 @@ class Reservation:
     allocatable: ResourceList = field(default_factory=ResourceList)
     allocated: ResourceList = field(default_factory=ResourceList)
     current_owners: List[str] = field(default_factory=list)  # pod keys
+    # set when this entry mirrors an operating-mode POD (operating_pod.go
+    # ReservationPodOperatingMode) instead of a Reservation CR: the pod's
+    # lifecycle governs it and no CR exists in the store
+    from_pod_key: str = ""
 
     @property
     def is_available(self) -> bool:

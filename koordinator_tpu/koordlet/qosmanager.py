@@ -154,6 +154,11 @@ class CPUSuppress:
             # only real cpu ids: running past total_cpus would write a
             # cpuset the kernel rejects with EINVAL
             picked = [c for c in range(total_cpus) if c not in excluded]
+            if len(picked) < self.MIN_SUPPRESS_CPUS:
+                # the exclusion is unsatisfiable (system cores cover nearly
+                # the whole node): a kernel-valid cpuset beats honoring it
+                picked = list(range(total_cpus)) if total_cpus \
+                    else list(range(self.MIN_SUPPRESS_CPUS))
             cpus = CPUSet(picked[:want])
             self.ctx.executor.update(
                 ResourceUpdater(be_rel, sysutil.CPUSET_CPUS, cpus.format())
@@ -183,6 +188,8 @@ class CPUSuppress:
                 total = int(node.allocatable.get("cpu", 0) // 1000)
                 excluded = self._system_qos_excluded(node)
                 restore = [c for c in range(total) if c not in excluded]
+                if not restore:
+                    restore = list(range(total))  # unsatisfiable exclusion
                 if restore:
                     self.ctx.executor.update(
                         ResourceUpdater(

@@ -69,6 +69,13 @@ class ReservationRestoreTransformer(FilterTransformer):
             if res.is_available and not res.is_expired(ctx.now):
                 counted.add(res.meta.name)
                 add(res.node_name, res.allocatable.to_vector())
+        # pod-backed reservations (operating-mode pods) already occupy their
+        # node AS assigned pods — no capacity to add — but their consumers'
+        # usage lives inside that footprint, so they join the subtract pass
+        for pod in state.pods_by_key.values():
+            if (pod.is_reservation_operating_mode and pod.is_assigned
+                    and not pod.is_terminated):
+                counted.add(f"pod:{pod.meta.key}")
         if not counted:
             return
         from koordinator_tpu.ops.fit import with_pod_count
@@ -93,6 +100,66 @@ class ReservationPlugin(Plugin):
     def register(self, store: ObjectStore) -> None:
         self._store = store
         store.subscribe(KIND_RESERVATION, self._on_reservation)
+        from koordinator_tpu.client.store import KIND_POD
+
+        store.subscribe(KIND_POD, self._on_pod)
+
+    def _on_pod(self, ev: EventType, pod: Pod, old) -> None:
+        """Operating-mode pods (operating_pod.go ReservationPodOperatingMode)
+        mirror into the reservation cache once assigned: the pod schedules
+        like any pod, then its resources are reserved for its declared
+        owners. The pod's lifecycle governs the entry — termination or
+        deletion removes it."""
+        if not pod.is_reservation_operating_mode:
+            return
+        key = f"pod:{pod.meta.key}"
+        if (ev is EventType.DELETED or pod.is_terminated
+                or not pod.is_assigned):
+            prev = self.by_name.pop(key, None)
+            if prev and prev.node_name:
+                nodes = self.by_node.get(prev.node_name, [])
+                if key in nodes:
+                    nodes.remove(key)
+            return
+        from dataclasses import replace
+
+        from koordinator_tpu.api.resources import ResourceList
+
+        prev = self.by_name.get(key)
+        if prev is not None:
+            allocated, owners_now = prev.allocated, prev.current_owners
+        else:
+            # cold rebuild (subscriber replay / scheduler restart): the
+            # consumed amount lives on the CONSUMER pods' annotations —
+            # without this, a restarted scheduler would see the full
+            # footprint free and over-consume the reservation
+            allocated, owners_now = ResourceList(), []
+            if self._store is not None:
+                from koordinator_tpu.client.store import KIND_POD
+
+                for other in self._store.list(KIND_POD):
+                    if (other.meta.annotations.get(
+                            ANNOTATION_RESERVATION_ALLOCATED) == key
+                            and other.is_assigned
+                            and not other.is_terminated):
+                        allocated = allocated.add(other.spec.requests)
+                        owners_now.append(other.meta.key)
+        res = Reservation(
+            meta=(prev.meta if prev
+                  else replace(pod.meta, name=key, namespace="")),
+            owners=pod.reservation_owners(),
+            allocate_once=False,
+            phase="Available",
+            node_name=pod.spec.node_name,
+            allocatable=pod.spec.requests.copy(),
+            allocated=allocated,
+            current_owners=owners_now,
+            from_pod_key=pod.meta.key,
+        )
+        self.by_name[key] = res
+        nodes = self.by_node.setdefault(pod.spec.node_name, [])
+        if key not in nodes:
+            nodes.append(key)
 
     def _on_reservation(self, ev: EventType, res: Reservation, old) -> None:
         key = res.meta.name
@@ -140,7 +207,26 @@ class ReservationPlugin(Plugin):
         res.allocated = res.allocated.add(pod.spec.requests)
         res.current_owners.append(pod.meta.key)
         ctx.data.setdefault("reservation_of", {})[pod.meta.key] = res.meta.name
-        if self._store is not None:
+        if self._store is None:
+            return
+        if res.from_pod_key:
+            # pod-backed reservation: record the owner on the BACKING pod
+            # (operating_pod.go AnnotationReservationCurrentOwner); there is
+            # no Reservation CR to update
+            import json
+
+            from koordinator_tpu.api.objects import (
+                ANNOTATION_RESERVATION_CURRENT_OWNER,
+            )
+            from koordinator_tpu.client.store import KIND_POD
+
+            backing = self._store.get(KIND_POD, res.from_pod_key)
+            if backing is not None:
+                backing.meta.annotations[
+                    ANNOTATION_RESERVATION_CURRENT_OWNER
+                ] = json.dumps(res.current_owners)
+                self._store.update(KIND_POD, backing)
+        else:
             self._store.update(KIND_RESERVATION, res)
 
     def unreserve(self, pod: Pod, node_name: str, ctx: CycleContext) -> None:
@@ -150,6 +236,21 @@ class ReservationPlugin(Plugin):
             res.allocated = res.allocated.sub(pod.spec.requests)
             if pod.meta.key in res.current_owners:
                 res.current_owners.remove(pod.meta.key)
+            if res.from_pod_key and self._store is not None:
+                # keep the backing pod's persisted owner list consistent
+                import json
+
+                from koordinator_tpu.api.objects import (
+                    ANNOTATION_RESERVATION_CURRENT_OWNER,
+                )
+                from koordinator_tpu.client.store import KIND_POD
+
+                backing = self._store.get(KIND_POD, res.from_pod_key)
+                if backing is not None:
+                    backing.meta.annotations[
+                        ANNOTATION_RESERVATION_CURRENT_OWNER
+                    ] = json.dumps(res.current_owners)
+                    self._store.update(KIND_POD, backing)
 
     def pre_bind(self, pod: Pod, node_name: str, ctx: CycleContext,
                  annotations: Dict[str, str]) -> None:
@@ -163,6 +264,8 @@ class ReservationPlugin(Plugin):
         now = time.time() if now is None else now
         expired = []
         for res in self.by_name.values():
+            if res.from_pod_key:
+                continue  # the backing pod's lifecycle governs, never a TTL
             if res.phase in ("Pending", "Available") and res.is_expired(now):
                 res.phase = "Failed"
                 expired.append(res.meta.name)

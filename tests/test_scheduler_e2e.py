@@ -533,3 +533,48 @@ def test_node_reservation_cpus_only_policy_keeps_allocatable():
     # malformed annotation reserves nothing
     node.meta.annotations[ANNOTATION_NODE_RESERVATION] = "not-json"
     assert estimate_node_allocatable(node)[0] == 8000
+
+
+def test_operating_mode_pod_acts_as_reservation():
+    """A pod labeled operating-mode=Reservation schedules like a pod, then
+    its resources serve its declared owners: the owner pod lands on the
+    reservation pod's node consuming its footprint (no double count), and
+    non-owners cannot nominate it (operating_pod.go semantics)."""
+    import json as _json
+
+    from koordinator_tpu.api.objects import (
+        ANNOTATION_RESERVATION_ALLOCATED,
+        ANNOTATION_RESERVATION_CURRENT_OWNER,
+        ANNOTATION_RESERVATION_OWNERS,
+        LABEL_POD_OPERATING_MODE,
+    )
+
+    store = make_store(num_nodes=3, cores=8, mem_gib=16)
+    sched = Scheduler(store)
+    placeholder = pend_pod(store, "placeholder", cpu=6000, mem=12 * GIB)
+    placeholder.meta.labels[LABEL_POD_OPERATING_MODE] = "Reservation"
+    placeholder.meta.annotations[ANNOTATION_RESERVATION_OWNERS] = _json.dumps(
+        [{"labelSelector": {"matchLabels": {"app": "web"}}}])
+    store.update(KIND_POD, placeholder)
+    r1 = sched.run_cycle(now=NOW)
+    placeholder = store.get(KIND_POD, "default/placeholder")
+    assert placeholder.is_assigned
+    reserved_node = placeholder.spec.node_name
+
+    # fill the other nodes so the reserved node is the only one with room
+    # for a 6-core pod — which only the owner may use
+    for i in range(2):
+        pend_pod(store, f"filler-{i}", cpu=6000, mem=12 * GIB)
+    sched.run_cycle(now=NOW + 1)
+
+    owner = pend_pod(store, "web-pod", cpu=4000, mem=8 * GIB,
+                     labels={"app": "web"})
+    sched.run_cycle(now=NOW + 2)
+    owner = store.get(KIND_POD, "default/web-pod")
+    assert owner.spec.node_name == reserved_node
+    assert owner.meta.annotations[
+        ANNOTATION_RESERVATION_ALLOCATED] == "pod:default/placeholder"
+    placeholder = store.get(KIND_POD, "default/placeholder")
+    owners = _json.loads(placeholder.meta.annotations[
+        ANNOTATION_RESERVATION_CURRENT_OWNER])
+    assert owners == ["default/web-pod"]
