@@ -86,7 +86,7 @@ class ObjectStore:
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
-        self._rv = 0
+        self._rv = 0  # global mutation counter (see resource_version)
         self._collections: Dict[str, _Collection] = {k: _Collection() for k in ALL_KINDS}
         # admission interceptors (apiserver -> webhook call path): named so a
         # standby replica installing the same server is idempotent
@@ -124,6 +124,12 @@ class ObjectStore:
             return sum(len(c.objects) for c in self._collections.values())
 
     # -- mutators ------------------------------------------------------------
+    @property
+    def resource_version(self) -> int:
+        """Global mutation counter: bumps on every add/update/delete.
+        Cheap cache-invalidation key for derived indexes."""
+        return self._rv
+
     def add(self, kind: str, obj: Any) -> Any:
         self._admit(kind, obj)
         with self._lock:

@@ -156,15 +156,18 @@ class CPUSuppress:
             picked = [c for c in range(total_cpus) if c not in excluded]
             if len(picked) < self.MIN_SUPPRESS_CPUS:
                 # the exclusion is unsatisfiable (system cores cover nearly
-                # the whole node): a kernel-valid cpuset beats honoring it
-                picked = list(range(total_cpus)) if total_cpus \
-                    else list(range(self.MIN_SUPPRESS_CPUS))
+                # the whole node): top up with the least-bad excluded cores
+                # — still only REAL cpu ids, never fabricated ones
+                picked = picked + [c for c in sorted(excluded)
+                                   if c < total_cpus]
+            if not picked:
+                return  # no real cpus known; writing any cpuset would EINVAL
             cpus = CPUSet(picked[:want])
             self.ctx.executor.update(
                 ResourceUpdater(be_rel, sysutil.CPUSET_CPUS, cpus.format())
             )
             self.policy_in_use = "cpuset"
-            koordlet_metrics.BE_SUPPRESS_CPU_CORES.set(float(len(picked)))
+            koordlet_metrics.BE_SUPPRESS_CPU_CORES.set(float(len(cpus)))
 
     @staticmethod
     def _system_qos_excluded(node) -> set:

@@ -96,6 +96,9 @@ class ReservationPlugin(Plugin):
         self.by_name: Dict[str, Reservation] = {}
         self.by_node: Dict[str, List[str]] = {}
         self._store: Optional[ObjectStore] = None
+        # (store rv, {reservation name -> [(owner key, requests)]}) — one
+        # O(P) pass serves every cold rebuild of a subscriber replay
+        self._consumer_index = (-1, {})
 
     def register(self, store: ObjectStore) -> None:
         self._store = store
@@ -134,16 +137,9 @@ class ReservationPlugin(Plugin):
             # without this, a restarted scheduler would see the full
             # footprint free and over-consume the reservation
             allocated, owners_now = ResourceList(), []
-            if self._store is not None:
-                from koordinator_tpu.client.store import KIND_POD
-
-                for other in self._store.list(KIND_POD):
-                    if (other.meta.annotations.get(
-                            ANNOTATION_RESERVATION_ALLOCATED) == key
-                            and other.is_assigned
-                            and not other.is_terminated):
-                        allocated = allocated.add(other.spec.requests)
-                        owners_now.append(other.meta.key)
+            for owner_key, req in self._consumers_of(key):
+                allocated = allocated.add(req)
+                owners_now.append(owner_key)
         res = Reservation(
             meta=(prev.meta if prev
                   else replace(pod.meta, name=key, namespace="")),
@@ -160,6 +156,47 @@ class ReservationPlugin(Plugin):
         nodes = self.by_node.setdefault(pod.spec.node_name, [])
         if key not in nodes:
             nodes.append(key)
+
+    def _consumers_of(self, res_name: str):
+        """Consumers grouped by reservation annotation, indexed once per
+        store state (an O(P) scan per operating-mode pod would make
+        subscriber replay O(N*P))."""
+        if self._store is None:
+            return []
+        rv = self._store.resource_version
+        if self._consumer_index[0] != rv:
+            from koordinator_tpu.client.store import KIND_POD
+
+            index: Dict[str, list] = {}
+            for other in self._store.list(KIND_POD):
+                target = other.meta.annotations.get(
+                    ANNOTATION_RESERVATION_ALLOCATED)
+                if (target and other.is_assigned
+                        and not other.is_terminated):
+                    index.setdefault(target, []).append(
+                        (other.meta.key, other.spec.requests))
+            self._consumer_index = (rv, index)
+        return self._consumer_index[1].get(res_name, [])
+
+    def _persist_pod_backed_owners(self, res: Reservation) -> None:
+        """Write the owner list onto the BACKING pod
+        (operating_pod.go AnnotationReservationCurrentOwner) — the single
+        persistence site consume() and unreserve() share."""
+        if not res.from_pod_key or self._store is None:
+            return
+        import json
+
+        from koordinator_tpu.api.objects import (
+            ANNOTATION_RESERVATION_CURRENT_OWNER,
+        )
+        from koordinator_tpu.client.store import KIND_POD
+
+        backing = self._store.get(KIND_POD, res.from_pod_key)
+        if backing is not None:
+            backing.meta.annotations[
+                ANNOTATION_RESERVATION_CURRENT_OWNER
+            ] = json.dumps(res.current_owners)
+            self._store.update(KIND_POD, backing)
 
     def _on_reservation(self, ev: EventType, res: Reservation, old) -> None:
         key = res.meta.name
@@ -210,22 +247,7 @@ class ReservationPlugin(Plugin):
         if self._store is None:
             return
         if res.from_pod_key:
-            # pod-backed reservation: record the owner on the BACKING pod
-            # (operating_pod.go AnnotationReservationCurrentOwner); there is
-            # no Reservation CR to update
-            import json
-
-            from koordinator_tpu.api.objects import (
-                ANNOTATION_RESERVATION_CURRENT_OWNER,
-            )
-            from koordinator_tpu.client.store import KIND_POD
-
-            backing = self._store.get(KIND_POD, res.from_pod_key)
-            if backing is not None:
-                backing.meta.annotations[
-                    ANNOTATION_RESERVATION_CURRENT_OWNER
-                ] = json.dumps(res.current_owners)
-                self._store.update(KIND_POD, backing)
+            self._persist_pod_backed_owners(res)
         else:
             self._store.update(KIND_RESERVATION, res)
 
@@ -236,21 +258,7 @@ class ReservationPlugin(Plugin):
             res.allocated = res.allocated.sub(pod.spec.requests)
             if pod.meta.key in res.current_owners:
                 res.current_owners.remove(pod.meta.key)
-            if res.from_pod_key and self._store is not None:
-                # keep the backing pod's persisted owner list consistent
-                import json
-
-                from koordinator_tpu.api.objects import (
-                    ANNOTATION_RESERVATION_CURRENT_OWNER,
-                )
-                from koordinator_tpu.client.store import KIND_POD
-
-                backing = self._store.get(KIND_POD, res.from_pod_key)
-                if backing is not None:
-                    backing.meta.annotations[
-                        ANNOTATION_RESERVATION_CURRENT_OWNER
-                    ] = json.dumps(res.current_owners)
-                    self._store.update(KIND_POD, backing)
+            self._persist_pod_backed_owners(res)
 
     def pre_bind(self, pod: Pod, node_name: str, ctx: CycleContext,
                  annotations: Dict[str, str]) -> None:

@@ -1,0 +1,105 @@
+"""Cross-feature parity fuzz: randomized clusters mixing EVERY scheduling
+feature — taints, nodeSelector/affinity, required+preferred pod affinity,
+both spread modes, NUMA, quota, gangs, node reservation — diffed across the
+XLA step, the numpy oracle, the wave kernel, the C++ floor, and (one seed)
+the Pallas interpreter. Single-feature parity suites can miss interactions;
+this is the combinatorial net."""
+
+import json
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.objects import (
+    ANNOTATION_NODE_RESERVATION,
+    PodAffinityTerm,
+    PreferredNodeTerm,
+    PreferredPodTerm,
+    TopologySpreadConstraint,
+)
+from koordinator_tpu.models.full_chain import build_full_chain_step
+from koordinator_tpu.ops.loadaware import LoadAwareArgs
+from koordinator_tpu.scheduler.parity import diff_bindings, serial_schedule_full
+from koordinator_tpu.scheduler.snapshot import build_full_chain_inputs
+from koordinator_tpu.testing import synth_full_cluster
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def _mixed_fixture(seed: int):
+    import random
+
+    rng = random.Random(seed)
+    args = LoadAwareArgs()
+    cluster, state = synth_full_cluster(
+        30, 60, seed=seed, taint_fraction=0.2)
+    for j, node in enumerate(state.nodes):
+        node.meta.labels[ZONE] = f"z{j % 4}"
+        node.meta.labels["pool"] = rng.choice(["gold", "silver"])
+        node.meta.labels["disk"] = rng.choice(["ssd", "hdd"])
+        if rng.random() < 0.1:
+            node.meta.annotations[ANNOTATION_NODE_RESERVATION] = json.dumps(
+                {"resources": {"cpu": "1", "memory": "1Gi"}})
+    apps = ["web", "db", "cache"]
+    for i, pod in enumerate(state.pending_pods):
+        r = rng.random()
+        app = rng.choice(apps)
+        pod.meta.labels["app"] = app
+        if r < 0.15:
+            pod.spec.node_selector["pool"] = rng.choice(["gold", "silver"])
+        elif r < 0.3:
+            pod.spec.pod_anti_affinity.append(PodAffinityTerm(
+                selector={"app": app}, topology_key=ZONE))
+        elif r < 0.45:
+            pod.spec.pod_affinity.append(PodAffinityTerm(
+                selector={"app": rng.choice(apps)}, topology_key=ZONE))
+        elif r < 0.6:
+            pod.spec.topology_spread.append(TopologySpreadConstraint(
+                max_skew=rng.choice([1, 2]), topology_key=ZONE,
+                selector={"app": app},
+                when_unsatisfiable=rng.choice(
+                    ["DoNotSchedule", "ScheduleAnyway"])))
+        elif r < 0.75:
+            pod.spec.affinity_preferred.append(PreferredNodeTerm(
+                weight=rng.randint(1, 100), labels={"disk": "ssd"}))
+        elif r < 0.9:
+            pod.spec.pod_affinity_preferred.append(PreferredPodTerm(
+                weight=rng.choice([-50, 40, 80]),
+                selector={"app": rng.choice(apps)}, topology_key=ZONE))
+    fc, pods, nodes, tree, gi, ng, ngroups = build_full_chain_inputs(
+        state, args)
+    return args, fc, pods, ng, ngroups
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303, 404, 505, 606])
+def test_fuzz_all_backends_agree(seed):
+    from koordinator_tpu.models.wave_chain import build_wave_full_chain_step
+    from koordinator_tpu.native import floor as native_floor
+
+    args, fc, pods, ng, ngroups = _mixed_fixture(seed)
+    chosen = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    serial = serial_schedule_full(fc, args)
+    n = len(pods.keys)
+    diffs = diff_bindings(serial[:n], chosen[:n], pods.keys)
+    assert not diffs, f"seed {seed}: {len(diffs)} mismatches: {diffs[:5]}"
+    chosen_w = np.asarray(build_wave_full_chain_step(
+        args, ng, ngroups, wave=16)(fc)[0])
+    np.testing.assert_array_equal(chosen, chosen_w, err_msg=f"wave seed {seed}")
+    if native_floor.available() or native_floor.build():
+        chosen_nat = native_floor.serial_schedule_full_native(
+            fc, args, num_groups=ngroups)
+        np.testing.assert_array_equal(
+            chosen[:n], chosen_nat[:n], err_msg=f"floor seed {seed}")
+    assert (chosen[:n] >= 0).sum() > n // 3  # the fixture actually schedules
+
+
+def test_fuzz_pallas_interpret_agrees():
+    from koordinator_tpu.ops.pallas_full_chain import (
+        build_pallas_full_chain_step,
+    )
+
+    args, fc, pods, ng, ngroups = _mixed_fixture(707)
+    chosen = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    chosen_p = np.asarray(build_pallas_full_chain_step(
+        args, ng, ngroups, interpret=True)(fc)[0])
+    np.testing.assert_array_equal(chosen, chosen_p)
