@@ -12,6 +12,8 @@ Analog of reference `pkg/descheduler/controllers/migration/`:
 
 from __future__ import annotations
 
+import math
+
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
@@ -66,9 +68,36 @@ class Arbitrator:
             wl = f"{pod.meta.owner_kind}/{pod.meta.owner_name}"
             per_workload[wl] = per_workload.get(wl, 0) + 1
 
+        def eviction_cost(pod: Optional[Pod]) -> int:
+            """scheduling.koordinator.sh/eviction-cost (descheduling.go):
+            cheaper pods migrate first; int32-max opts the pod out entirely
+            (FilterPodWithMaxEvictionCost); malformed values cost 0."""
+            if pod is None:
+                return 0
+            raw = pod.meta.annotations.get(
+                "scheduling.koordinator.sh/eviction-cost")
+            if raw is None:
+                return 0
+            try:
+                value = float(raw)
+                if not math.isfinite(value):
+                    return 0
+                return int(value)
+            except (TypeError, ValueError):
+                return 0
+
+        MAX_INT32 = 2**31 - 1
+        # one (cost, pod) lookup per job: the sort key, the opt-out check,
+        # and the admission loop all read it
+        job_info = {id(j): (eviction_cost(pod_of(j)), pod_of(j))
+                    for j in jobs}
         admitted: List[PodMigrationJob] = []
-        for job in sorted(jobs, key=lambda j: (j.meta.creation_timestamp, j.meta.key)):
-            pod = pod_of(job)
+        for job in sorted(jobs, key=lambda j: (job_info[id(j)][0],
+                                               j.meta.creation_timestamp,
+                                               j.meta.key)):
+            cost, pod = job_info[id(job)]
+            if cost >= MAX_INT32:
+                continue  # opted out of migration
             if pod is None or not pod.is_assigned or pod.is_terminated:
                 continue
             node = pod.spec.node_name

@@ -129,7 +129,8 @@ class NodeResourceController:
                 pods_by_node.setdefault(pod.spec.node_name, []).append(pod)
 
         for i, node in enumerate(nodes):
-            strategy = self.config.strategy_for_node(node.meta.labels)
+            strategy = self.config.strategy_for_node(
+                node.meta.labels, node.meta.annotations)
             capacity[i] = node.capacity.to_vector() if node.capacity else node.allocatable.to_vector()
             reclaim[i, CPU] = strategy.cpu_reclaim_threshold_percent
             reclaim[i, MEM] = strategy.memory_reclaim_threshold_percent

@@ -15,6 +15,12 @@ from typing import Dict, List, Optional, Tuple
 CONFIG_MAP_NAME = "slo-controller-config"
 COLOCATION_CONFIG_KEY = "colocation-config"
 
+# per-node colocation strategy metadata (apis/extension/node_colocation.go)
+ANNOTATION_NODE_COLOCATION_STRATEGY = (
+    "node.koordinator.sh/colocation-strategy")
+LABEL_CPU_RECLAIM_RATIO = "node.koordinator.sh/cpu-reclaim-ratio"
+LABEL_MEMORY_RECLAIM_RATIO = "node.koordinator.sh/memory-reclaim-ratio"
+
 POLICY_USAGE = "usage"
 POLICY_REQUEST = "request"
 POLICY_MAX_USAGE_REQUEST = "maxUsageRequest"
@@ -69,28 +75,66 @@ class ColocationConfig:
     cluster_strategy: ColocationStrategy = field(default_factory=ColocationStrategy)
     node_strategies: List[NodeStrategy] = field(default_factory=list)
 
-    def strategy_for_node(self, node_labels: Dict[str, str]) -> ColocationStrategy:
-        """Cluster strategy patched by the first matching node strategy."""
+    _STRATEGY_KEYS = {
+        "enable": "enable",
+        "cpuReclaimThresholdPercent": "cpu_reclaim_threshold_percent",
+        "memoryReclaimThresholdPercent": "memory_reclaim_threshold_percent",
+        "midCPUThresholdPercent": "mid_cpu_threshold_percent",
+        "midMemoryThresholdPercent": "mid_memory_threshold_percent",
+        "degradeTimeMinutes": "degrade_time_minutes",
+        "updateTimeThresholdSeconds": "update_time_threshold_seconds",
+        "cpuCalculatePolicy": "cpu_calculate_policy",
+        "memoryCalculatePolicy": "memory_calculate_policy",
+    }
+
+    def _merge_keys(self, merged: "ColocationStrategy",
+                    data: Dict) -> "ColocationStrategy":
+        patched = ColocationStrategy.from_dict(data)
+        for k in data:
+            attr = self._STRATEGY_KEYS.get(k)
+            if attr:
+                setattr(merged, attr, getattr(patched, attr))
+        return merged
+
+    def strategy_for_node(
+        self, node_labels: Dict[str, str],
+        node_annotations: Optional[Dict[str, str]] = None,
+    ) -> ColocationStrategy:
+        """Cluster strategy patched by the first matching node-pool
+        strategy, then by per-node METADATA (sloconfig
+        GetNodeColocationStrategy): the node colocation-strategy annotation
+        merges the same keys, and the cpu/memory reclaim-ratio labels
+        (float ratios) override the reclaim threshold percents last."""
         merged = self.cluster_strategy
         for ns in self.node_strategies:
             if all(node_labels.get(k) == v for k, v in ns.node_selector.items()):
                 merged = replace(merged)
-                patched = ColocationStrategy.from_dict(ns.strategy)
-                for k in ns.strategy:
-                    attr = {
-                        "enable": "enable",
-                        "cpuReclaimThresholdPercent": "cpu_reclaim_threshold_percent",
-                        "memoryReclaimThresholdPercent": "memory_reclaim_threshold_percent",
-                        "midCPUThresholdPercent": "mid_cpu_threshold_percent",
-                        "midMemoryThresholdPercent": "mid_memory_threshold_percent",
-                        "degradeTimeMinutes": "degrade_time_minutes",
-                        "updateTimeThresholdSeconds": "update_time_threshold_seconds",
-                        "cpuCalculatePolicy": "cpu_calculate_policy",
-                        "memoryCalculatePolicy": "memory_calculate_policy",
-                    }.get(k)
-                    if attr:
-                        setattr(merged, attr, getattr(patched, attr))
+                merged = self._merge_keys(merged, ns.strategy)
                 break
+        # per-node metadata layer (node_colocation.go):
+        ann = node_annotations or {}
+        raw = ann.get(ANNOTATION_NODE_COLOCATION_STRATEGY)
+        if raw:
+            try:
+                data = json.loads(raw)
+                if isinstance(data, dict):
+                    merged = self._merge_keys(replace(merged), data)
+            except (ValueError, TypeError):
+                pass
+        for label, attr in (
+            (LABEL_CPU_RECLAIM_RATIO, "cpu_reclaim_threshold_percent"),
+            (LABEL_MEMORY_RECLAIM_RATIO, "memory_reclaim_threshold_percent"),
+        ):
+            raw = node_labels.get(label)
+            if raw is None:
+                continue
+            try:
+                ratio = float(raw)
+            except (TypeError, ValueError):
+                continue
+            if 0 <= ratio <= 1:  # getNodeReclaimPercent bounds
+                merged = replace(merged)
+                setattr(merged, attr, ratio * 100.0)
         return merged
 
 

@@ -242,3 +242,44 @@ class TestPodGroupWithinTimeout:
         gang.update_pod_group_status(store, NOW)
         assert store.get(KIND_POD_GROUP, "default/gang-b").phase == "Pending"
         assert gang.timed_out_gangs() == []
+
+
+class TestPerNodeColocationMetadata:
+    """node_colocation.go: reclaim-ratio labels and the colocation-strategy
+    annotation override the merged strategy per node."""
+
+    def test_reclaim_ratio_labels_override(self):
+        from koordinator_tpu.utils.sloconfig import (
+            LABEL_CPU_RECLAIM_RATIO,
+            ColocationConfig,
+        )
+
+        cfg = ColocationConfig()
+        base = cfg.strategy_for_node({})
+        assert base.cpu_reclaim_threshold_percent == 60
+        s = cfg.strategy_for_node({LABEL_CPU_RECLAIM_RATIO: "0.8"})
+        assert s.cpu_reclaim_threshold_percent == 80.0
+        # out-of-bounds / malformed values are ignored
+        s2 = cfg.strategy_for_node({LABEL_CPU_RECLAIM_RATIO: "1.5"})
+        assert s2.cpu_reclaim_threshold_percent == 60
+        s3 = cfg.strategy_for_node({LABEL_CPU_RECLAIM_RATIO: "abc"})
+        assert s3.cpu_reclaim_threshold_percent == 60
+
+    def test_strategy_annotation_merges_then_labels_win(self):
+        import json
+
+        from koordinator_tpu.utils.sloconfig import (
+            ANNOTATION_NODE_COLOCATION_STRATEGY,
+            LABEL_CPU_RECLAIM_RATIO,
+            ColocationConfig,
+        )
+
+        cfg = ColocationConfig()
+        ann = {ANNOTATION_NODE_COLOCATION_STRATEGY: json.dumps(
+            {"cpuReclaimThresholdPercent": 70,
+             "memoryReclaimThresholdPercent": 50})}
+        s = cfg.strategy_for_node({LABEL_CPU_RECLAIM_RATIO: "0.9"}, ann)
+        assert s.cpu_reclaim_threshold_percent == 90.0  # label wins last
+        assert s.memory_reclaim_threshold_percent == 50
+        # the shared cluster strategy object is never mutated
+        assert cfg.cluster_strategy.cpu_reclaim_threshold_percent == 60

@@ -201,3 +201,45 @@ class TestMigrationEndToEnd:
         ctrl.reconcile(now=NOW + 1)   # admitted -> Running
         ctrl.reconcile(now=NOW + 200)  # TTL exceeded
         assert store.list(KIND_POD_MIGRATION_JOB)[0].phase == "Failed"
+
+
+def test_eviction_cost_orders_and_opts_out():
+    """scheduling.koordinator.sh/eviction-cost: cheaper pods migrate first;
+    int32-max opts the pod out of migration entirely."""
+    from koordinator_tpu.api.objects import (
+        Node,
+        ObjectMeta,
+        Pod,
+        PodMigrationJob,
+        PodSpec,
+    )
+    from koordinator_tpu.api.resources import ResourceList
+    from koordinator_tpu.client.store import (
+        KIND_NODE,
+        KIND_POD,
+        ObjectStore,
+    )
+    from koordinator_tpu.descheduler.migration import Arbitrator, ArbitratorArgs
+
+    store = ObjectStore()
+    store.add(KIND_NODE, Node(meta=ObjectMeta(name="n0", namespace=""),
+                              allocatable=ResourceList.of(cpu=64000)))
+    jobs = []
+    for name, cost in (("cheap", "1"), ("pricy", "100"),
+                       ("never", str(2**31 - 1)), ("free", None)):
+        ann = {}
+        if cost is not None:
+            ann["scheduling.koordinator.sh/eviction-cost"] = cost
+        pod = Pod(meta=ObjectMeta(name=name, annotations=ann,
+                                  creation_timestamp=100.0),
+                  spec=PodSpec(node_name="n0",
+                               requests=ResourceList.of(cpu=1000)),
+                  phase="Running")
+        store.add(KIND_POD, pod)
+        job = PodMigrationJob(meta=ObjectMeta(name=f"mj-{name}"),
+                              pod_namespace="default", pod_name=name)
+        jobs.append(job)
+    arb = Arbitrator(store, ArbitratorArgs(max_migrating_per_node=10))
+    admitted = arb.arbitrate(jobs)
+    names = [j.pod_name for j in admitted]
+    assert names == ["free", "cheap", "pricy"]  # cost asc; opted-out absent
