@@ -43,6 +43,10 @@ def pack_request(fc: FullChainInputs, num_gangs: int, num_groups: int,
     )
     if active_axes is not None:
         req.active_axes.extend(int(a) for a in active_axes)
+    # args.resource_weights feed the compiled step's score weights — they
+    # must ride the wire or the server would silently score with defaults
+    req.inputs["args.weights"].CopyFrom(
+        np_to_tensor(np.asarray(args.weight_vector(), np.float32)))
     for name, value in fc.base._asdict().items():
         req.inputs[f"base.{name}"].CopyFrom(np_to_tensor(np.asarray(value)))
     for name, value in fc._asdict().items():
@@ -57,7 +61,11 @@ def unpack_request(req: sidecar_pb2.ScheduleBatchRequest) -> Tuple[FullChainInpu
 
     base_kwargs = {}
     fc_kwargs = {}
+    weights_vec = None
     for name, tensor in req.inputs.items():
+        if name == "args.weights":
+            weights_vec = tensor_to_np(tensor)
+            continue
         arr = jnp.asarray(tensor_to_np(tensor))
         if name.startswith("base."):
             base_kwargs[name[5:]] = arr
@@ -65,6 +73,13 @@ def unpack_request(req: sidecar_pb2.ScheduleBatchRequest) -> Tuple[FullChainInpu
             fc_kwargs[name] = arr
     fc = FullChainInputs(base=ScheduleInputs(**base_kwargs), **fc_kwargs)
     args = LoadAwareArgs(score_according_prod_usage=req.score_according_prod_usage)
+    if weights_vec is not None:
+        from koordinator_tpu.api.resources import RESOURCE_AXES
+
+        args.resource_weights = {
+            RESOURCE_AXES[i]: float(v)
+            for i, v in enumerate(weights_vec) if v
+        }
     return fc, args
 
 

@@ -1,0 +1,100 @@
+"""Scheduler sidecar: the gRPC channel that carries packed pod/node tensors
+to the fused kernel (SURVEY.md section 5.8's Go<->JAX analog, scheduler/
+sidecar.py + sidecar.proto). Bindings over the wire must match the
+in-process step bit-for-bit, and the step cache must key on shapes."""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.models.full_chain import build_full_chain_step
+from koordinator_tpu.ops.loadaware import LoadAwareArgs
+from koordinator_tpu.scheduler.sidecar import (
+    SidecarClient,
+    SidecarServer,
+    pack_request,
+    serve_sidecar,
+    tensor_to_np,
+    unpack_request,
+)
+from koordinator_tpu.scheduler.snapshot import build_full_chain_inputs
+from koordinator_tpu.testing import synth_full_cluster
+
+
+def _fixture(seed=3, nodes=16, pods=24):
+    args = LoadAwareArgs()
+    cluster, state = synth_full_cluster(nodes, pods, seed=seed)
+    fc, pods_b, nb, tree, gi, ng, ngroups = build_full_chain_inputs(
+        state, args)
+    return args, fc, pods_b, ng, ngroups
+
+
+def test_pack_unpack_roundtrip_preserves_every_field():
+    args, fc, pods_b, ng, ngroups = _fixture()
+    req = pack_request(fc, ng, ngroups, args)
+    fc2, args2 = unpack_request(req)
+    for name, value in fc._asdict().items():
+        if name == "base":
+            for bname, bval in fc.base._asdict().items():
+                got = np.asarray(getattr(fc2.base, bname))
+                np.testing.assert_array_equal(np.asarray(bval), got,
+                                              err_msg=f"base.{bname}")
+                assert np.asarray(bval).dtype == got.dtype, f"base.{bname}"
+        else:
+            got = np.asarray(getattr(fc2, name))
+            np.testing.assert_array_equal(np.asarray(value), got,
+                                          err_msg=name)
+            assert np.asarray(value).dtype == got.dtype, name
+
+
+def test_in_process_handler_matches_direct_step():
+    args, fc, pods_b, ng, ngroups = _fixture()
+    direct = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    server = SidecarServer()
+    resp = server.ScheduleBatch(pack_request(fc, ng, ngroups, args))
+    np.testing.assert_array_equal(tensor_to_np(resp.chosen), direct)
+    assert resp.kernel_seconds > 0
+    # second call with the same shapes reuses the cached step
+    server.ScheduleBatch(pack_request(fc, ng, ngroups, args))
+    assert len(server._steps) == 1
+    # a different shape compiles a second entry
+    args3, fc3, pb3, ng3, ngroups3 = _fixture(seed=9, nodes=10, pods=12)
+    server.ScheduleBatch(pack_request(fc3, ng3, ngroups3, args3))
+    assert len(server._steps) == 2
+
+
+def test_custom_resource_weights_survive_the_wire():
+    """args.resource_weights feed the compiled step's scores — the sidecar
+    must transport them, not rebuild defaults server-side."""
+    from koordinator_tpu.api.resources import ResourceName
+
+    args = LoadAwareArgs(resource_weights={ResourceName.CPU: 3,
+                                           ResourceName.MEMORY: 1})
+    cluster, state = synth_full_cluster(16, 24, seed=21)
+    fc, pods_b, nb, tree, gi, ng, ngroups = build_full_chain_inputs(
+        state, args)
+    direct = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    resp = SidecarServer().ScheduleBatch(pack_request(fc, ng, ngroups, args))
+    np.testing.assert_array_equal(tensor_to_np(resp.chosen), direct)
+    # the unpacked args carry the custom weights, not rebuilt defaults
+    fc2, args2 = unpack_request(pack_request(fc, ng, ngroups, args))
+    assert args2.resource_weights == {ResourceName.CPU: 3,
+                                      ResourceName.MEMORY: 1}
+
+
+def test_over_real_grpc_socket(tmp_path):
+    grpc = pytest.importorskip("grpc")
+    args, fc, pods_b, ng, ngroups = _fixture(seed=5)
+    direct = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    address = f"unix://{tmp_path}/sidecar.sock"
+    server = serve_sidecar(address)
+    client = None
+    try:
+        client = SidecarClient(address)
+        resp = client.schedule_batch(
+            pack_request(fc, ng, ngroups, args, snapshot_version=7))
+        np.testing.assert_array_equal(tensor_to_np(resp.chosen), direct)
+        assert resp.snapshot_version == 7
+    finally:
+        if client is not None:
+            client.close()
+        server.stop(0)
