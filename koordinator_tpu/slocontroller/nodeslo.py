@@ -30,6 +30,7 @@ THRESHOLD_CONFIG_KEY = "resource-threshold-config"
 QOS_CONFIG_KEY = "resource-qos-config"
 CPU_BURST_CONFIG_KEY = "cpu-burst-config"
 SYSTEM_CONFIG_KEY = "system-config"
+HOST_APP_CONFIG_KEY = "host-application-config"
 
 
 def _merge_threshold(data: Dict) -> ResourceThresholdStrategy:
@@ -85,6 +86,7 @@ class NodeSLOController:
         qos_cfg = self._config_section(QOS_CONFIG_KEY)
         burst_cfg = self._config_section(CPU_BURST_CONFIG_KEY)
         system_cfg = self._config_section(SYSTEM_CONFIG_KEY)
+        host_app_cfg = self._config_section(HOST_APP_CONFIG_KEY)
         for node in self.store.list(KIND_NODE):
             labels = node.meta.labels
             slo = NodeSLO(
@@ -120,6 +122,21 @@ class NodeSLOController:
                 min_free_kbytes_factor=system.get("minFreeKbytesFactor", 100),
                 watermark_scale_factor=system.get("watermarkScaleFactor", 150),
             )
+            # host applications (HostApplicationConfigKey /
+            # apis/configuration HostApplicationCfg): cluster list, with the
+            # first matching nodeConfigs entry replacing it, rendered into
+            # the NodeSLO extension the koordlet consumes
+            # (nodeslo_controller.go:110 getHostApplicationConfig)
+            host_apps = host_app_cfg.get("applications")
+            for ncfg in host_app_cfg.get("nodeConfigs", []):
+                selector = ncfg.get("nodeSelector", {})
+                if isinstance(selector, dict) and all(
+                        labels.get(k) == v for k, v in selector.items()):
+                    host_apps = ncfg.get("applications", host_apps)
+                    break
+            if host_apps:
+                slo.extensions = dict(slo.extensions or {})
+                slo.extensions["hostApplications"] = host_apps
             existing = self.store.get(KIND_NODE_SLO, f"/{node.meta.name}")
             if existing is None:
                 self.store.add(KIND_NODE_SLO, slo)

@@ -283,3 +283,46 @@ class TestPerNodeColocationMetadata:
         assert s.memory_reclaim_threshold_percent == 50
         # the shared cluster strategy object is never mutated
         assert cfg.cluster_strategy.cpu_reclaim_threshold_percent == 60
+
+
+class TestHostApplicationConfig:
+    """host-application-config renders into NodeSLO.extensions, per-node
+    overridable (nodeslo_controller.go getHostApplicationConfig)."""
+
+    def test_rendered_with_node_override(self):
+        import json
+
+        from koordinator_tpu.api.objects import ConfigMap, Node, ObjectMeta
+        from koordinator_tpu.api.resources import ResourceList
+        from koordinator_tpu.client.store import (
+            KIND_CONFIG_MAP,
+            KIND_NODE,
+            KIND_NODE_SLO,
+            ObjectStore,
+        )
+        from koordinator_tpu.slocontroller.nodeslo import NodeSLOController
+        from koordinator_tpu.utils.sloconfig import CONFIG_MAP_NAME
+
+        store = ObjectStore()
+        for name, labels in (("plain", {}), ("edge", {"tier": "edge"})):
+            store.add(KIND_NODE, Node(
+                meta=ObjectMeta(name=name, namespace="", labels=labels),
+                allocatable=ResourceList.of(cpu=8000)))
+        store.add(KIND_CONFIG_MAP, ConfigMap(
+            meta=ObjectMeta(name=CONFIG_MAP_NAME, namespace="koordinator-system"),
+            data={"host-application-config": json.dumps({
+                "applications": [
+                    {"name": "nginx", "cgroupPath": "host/nginx",
+                     "qos": "LS"}],
+                "nodeConfigs": [{
+                    "nodeSelector": {"tier": "edge"},
+                    "applications": [
+                        {"name": "edge-proxy", "cgroupPath": "host/proxy",
+                         "qos": "BE"}],
+                }],
+            })}))
+        NodeSLOController(store).reconcile()
+        plain = store.get(KIND_NODE_SLO, "/plain")
+        assert plain.extensions["hostApplications"][0]["name"] == "nginx"
+        edge = store.get(KIND_NODE_SLO, "/edge")
+        assert edge.extensions["hostApplications"][0]["name"] == "edge-proxy"
