@@ -36,12 +36,16 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _guard_against_dead_accelerator(timeout_seconds: int) -> None:
+def _guard_against_dead_accelerator(timeout_seconds: int,
+                                    attempts: int = 3) -> None:
     """Device init blocks in native code when the accelerator tunnel is
     wedged, which would hang the whole bench (and its caller) forever.
-    Probe `jax.devices()` in a SUBPROCESS first; on timeout/failure, flip
-    this process to the CPU backend and report honestly on stderr + in the
-    JSON (the `platform` field) rather than never finishing."""
+    Probe `jax.devices()` in a SUBPROCESS first; a transient tunnel outage
+    often recovers within minutes, so retry the probe (with backoff) before
+    giving up — a CPU-fallback bench artifact misrepresents a whole round.
+    Only after every attempt fails, flip this process to the CPU backend and
+    report honestly on stderr + in the JSON (the `platform` field) rather
+    than never finishing."""
     import os
     import subprocess
 
@@ -49,25 +53,33 @@ def _guard_against_dead_accelerator(timeout_seconds: int) -> None:
         # explicitly CPU: nothing to probe. An UNSET variable still
         # auto-detects accelerators, so it must be probed like tpu/axon.
         return
-    # Popen + wait(timeout), output to DEVNULL: subprocess.run would drain
-    # captured pipes after the kill, which blocks forever if the child is
-    # wedged uninterruptibly in a device ioctl — the exact failure mode this
-    # guard exists for. With no pipes there is nothing to drain; a D-state
-    # child is abandoned.
-    child = subprocess.Popen(
-        [sys.executable, "-c", "import jax; jax.devices()"],
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-        start_new_session=True,
-    )
-    try:
-        if child.wait(timeout=timeout_seconds) == 0:
-            return
-        log(f"device probe failed (rc={child.returncode}); "
-            f"falling back to CPU")
-    except subprocess.TimeoutExpired:
-        child.kill()
-        log(f"device probe hung >{timeout_seconds}s (accelerator tunnel "
-            f"unresponsive); falling back to CPU")
+    for attempt in range(1, attempts + 1):
+        # Popen + wait(timeout), output to DEVNULL: subprocess.run would
+        # drain captured pipes after the kill, which blocks forever if the
+        # child is wedged uninterruptibly in a device ioctl — the exact
+        # failure mode this guard exists for. With no pipes there is nothing
+        # to drain; a D-state child is abandoned.
+        child = subprocess.Popen(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+        try:
+            if child.wait(timeout=timeout_seconds) == 0:
+                if attempt > 1:
+                    log(f"device probe recovered on attempt {attempt}")
+                return
+            log(f"device probe attempt {attempt}/{attempts} failed "
+                f"(rc={child.returncode})")
+        except subprocess.TimeoutExpired:
+            child.kill()
+            log(f"device probe attempt {attempt}/{attempts} hung "
+                f">{timeout_seconds}s (accelerator tunnel unresponsive)")
+        if attempt < attempts:
+            backoff = 30 * attempt
+            log(f"retrying device probe in {backoff}s")
+            time.sleep(backoff)
+    log("all device probe attempts failed; falling back to CPU")
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
@@ -96,13 +108,20 @@ def main() -> None:
         help="full-chain kernel selection (auto = backend/VMEM-based)",
     )
     ap.add_argument(
-        "--device-probe-timeout", type=int, default=420,
-        help="seconds to wait for device init in a probe subprocess; a dead "
-        "accelerator tunnel then falls back to CPU instead of hanging forever",
+        "--device-probe-timeout", type=int, default=150,
+        help="seconds per device-init probe attempt (subprocess); after "
+        "--device-probe-attempts failures the bench falls back to CPU "
+        "instead of hanging forever",
+    )
+    ap.add_argument(
+        "--device-probe-attempts", type=int, default=3,
+        help="device probe attempts (with 30s*attempt backoff between) "
+        "before the CPU fallback",
     )
     args_cli = ap.parse_args()
 
-    _guard_against_dead_accelerator(args_cli.device_probe_timeout)
+    _guard_against_dead_accelerator(args_cli.device_probe_timeout,
+                                    args_cli.device_probe_attempts)
 
     num_pods = args_cli.pods or (100 if args_cli.smoke else 10_000)
     num_nodes = args_cli.nodes or (50 if args_cli.smoke else 5_000)
