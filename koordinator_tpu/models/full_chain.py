@@ -68,6 +68,9 @@ class FullChainInputs(NamedTuple):
     node_taint_group: jnp.ndarray  # [N] int32 admission-signature group
     aff_dom: jnp.ndarray        # [N, T] f32 topology domain id (-1 invalid)
     aff_count: jnp.ndarray      # [N, T] f32 matching pods in n's domain
+    anti_cover: jnp.ndarray     # [N, T] f32 pods CARRYING term t as required
+    #     anti-affinity in n's domain (symmetric anti-affinity — upstream
+    #     existingAntiAffinityCounts); blocks incoming pods MATCHING t
     aff_exists: jnp.ndarray     # [T] bool — any matching pod anywhere
     #     (domain-labeled or not; drives the first-replica bootstrap)
     pref_scores: jnp.ndarray    # [N, S] f32 preferred-node-affinity score
@@ -126,7 +129,7 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode):
     T = fc.aff_dom.shape[1]
 
     def evaluate(i, requested, delta_np, delta_pr, numa_free, bind_free,
-                 quota_used, aff_count, aff_exists):
+                 quota_used, aff_count, anti_cover, aff_exists):
         req_fit = inputs.fit_requests[i]
         req = fc.requests[i]
         est = inputs.estimated[i]
@@ -165,6 +168,10 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode):
             count_t = aff_count[:, t]
             dom_valid = fc.aff_dom[:, t] >= 0
             anti_ok = ~fc.pod_anti_req[i, t] | (count_t <= 0)
+            # symmetric anti-affinity: a pod MATCHING term t may not land
+            # where any CARRIER of anti term t lives (anti_cover > 0 only
+            # on domain-labeled nodes, so dom_valid is implied)
+            sym_ok = ~fc.pod_aff_match[i, t] | (anti_cover[:, t] <= 0)
             bootstrap = fc.pod_aff_match[i, t] & ~aff_exists[t]
             aff_ok = ~fc.pod_aff_req[i, t] | (
                 dom_valid & (count_t > 0)) | bootstrap
@@ -181,7 +188,7 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode):
                 jnp.where(dom_valid & taint_ok, count_t, jnp.inf))
             spread_ok = (skew <= 0) | (
                 dom_valid & (count_t + self_match - min_count <= skew))
-            affinity_ok = affinity_ok & anti_ok & aff_ok & spread_ok
+            affinity_ok = affinity_ok & anti_ok & sym_ok & aff_ok & spread_ok
         feasible = (
             inputs.node_ok & fit & la_ok & cpuset_ok & numa_ok & taint_ok
             & affinity_ok & admit
@@ -246,7 +253,7 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
 
         def body(i, state):
             (requested, delta_np, delta_pr, numa_free, bind_free,
-             quota_used, aff_count, aff_exists, chosen) = state
+             quota_used, aff_count, anti_cover, aff_exists, chosen) = state
             req_fit = inputs.fit_requests[i]
             req = fc.requests[i]
             est = inputs.estimated[i]
@@ -254,7 +261,7 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
 
             found, best, zone_at_best, _admit = evaluate(
                 i, requested, delta_np, delta_pr, numa_free, bind_free,
-                quota_used, aff_count, aff_exists,
+                quota_used, aff_count, anti_cover, aff_exists,
             )
             fnd = found.astype(jnp.float32)
 
@@ -281,18 +288,23 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
                 quota_used, req, fc.quota_id[i], fc.quota_ancestors, found
             )
             # inter-pod affinity: the placed pod raises the count of every
-            # term it matches across the chosen node's whole domain, and
-            # flips the term's exists flag even on an unlabeled node
+            # term it matches across the chosen node's whole domain, flips
+            # the term's exists flag even on an unlabeled node, and — for
+            # terms it CARRIES as anti-affinity — raises the domain's
+            # anti_cover (symmetric anti-affinity for later pods)
             for t in range(T):
                 chosen_dom = fc.aff_dom[best, t]
-                inc = (found & fc.pod_aff_match[i, t] & (chosen_dom >= 0)
-                       & (fc.aff_dom[:, t] == chosen_dom))
+                in_dom = (chosen_dom >= 0) & (fc.aff_dom[:, t] == chosen_dom)
+                inc = found & fc.pod_aff_match[i, t] & in_dom
                 aff_count = aff_count.at[:, t].add(inc.astype(jnp.float32))
+                inc_cov = found & fc.pod_anti_req[i, t] & in_dom
+                anti_cover = anti_cover.at[:, t].add(
+                    inc_cov.astype(jnp.float32))
                 aff_exists = aff_exists.at[t].set(
                     aff_exists[t] | (found & fc.pod_aff_match[i, t]))
             chosen = chosen.at[i].set(jnp.where(found, best.astype(jnp.int32), -1))
             return (requested, delta_np, delta_pr, numa_free, bind_free,
-                    quota_used, aff_count, aff_exists, chosen)
+                    quota_used, aff_count, anti_cover, aff_exists, chosen)
 
         R = inputs.fit_requests.shape[-1]
         init = (
@@ -303,12 +315,12 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
             fc.bind_free,
             fc.quota_used,
             fc.aff_count,
+            fc.anti_cover,
             jnp.asarray(fc.aff_exists, bool),
             jnp.full(P, -1, jnp.int32),
         )
-        (requested, _, _, _, _, quota_used, _, _, chosen) = jax.lax.fori_loop(
-            0, P, body, init
-        )
+        (requested, _, _, _, _, quota_used, _, _, _,
+         chosen) = jax.lax.fori_loop(0, P, body, init)
 
         # ---- Permit barrier (gang group all-or-nothing)
         keep = gang_permit_mask(

@@ -86,7 +86,8 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
 
         def wave_body(state):
             (requested, delta_np, delta_pr, numa_free, bind_free,
-             quota_used, aff_count, aff_exists, chosen, pos) = state
+             quota_used, aff_count, anti_cover, aff_exists, chosen,
+             pos) = state
             idx = pos + warange
             valid_w = idx < P
             idxc = jnp.minimum(idx, P - 1)
@@ -94,7 +95,7 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             found_w, best_w, zone_w, admit_w = jax.vmap(
                 lambda i: evaluate(i, requested, delta_np, delta_pr,
                                    numa_free, bind_free, quota_used,
-                                   aff_count, aff_exists)
+                                   aff_count, anti_cover, aff_exists)
             )(idxc)
             found_w = found_w & valid_w
 
@@ -154,7 +155,16 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                      | (fc.pod_spread_skew[idxc] > 0)
                      | fc.pod_ppref_mask[idxc]) & matched_before,
                     axis=1) & valid_w
-                affinity_conf_w = anti_conf | aff_conf
+                # symmetric anti-affinity: an earlier committed CARRIER of
+                # anti term t raises anti_cover, so a later pod MATCHING t
+                # may lose nodes the frozen evaluation still offered
+                carried_w = (fc.pod_anti_req[idxc]
+                             & found_w[:, None])                   # [W, T]
+                carried_before = (jnp.cumsum(
+                    carried_w.astype(jnp.float32), axis=0) - carried_w) > 0.5
+                sym_conf = found_w & jnp.any(
+                    fc.pod_aff_match[idxc] & carried_before, axis=1)
+                affinity_conf_w = anti_conf | aff_conf | sym_conf
             else:
                 affinity_conf_w = jnp.zeros_like(found_w)
 
@@ -215,6 +225,11 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                 eq = (dom_col[None, :] == chosen_dom_w[:, None]
                       ).astype(jnp.float32)                        # [W, N]
                 aff_count = aff_count.at[:, t].add(mm(inc_w[None, :], eq)[0])
+                # committed CARRIERS raise anti_cover over their domain
+                inc_cov_w = (cm * fc.pod_anti_req[idxc, t]
+                             * (chosen_dom_w >= 0))                # [W]
+                anti_cover = anti_cover.at[:, t].add(
+                    mm(inc_cov_w[None, :], eq)[0])
                 aff_exists = aff_exists.at[t].set(
                     aff_exists[t]
                     | jnp.any(commit_w & fc.pod_aff_match[idxc, t]))
@@ -223,7 +238,8 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             chosen_idx = jnp.where((warange < cut) & valid_w, idx, P)
             chosen = chosen.at[chosen_idx].set(value_w, mode="drop")
             return (requested, delta_np, delta_pr, numa_free, bind_free,
-                    quota_used, aff_count, aff_exists, chosen, pos + cut)
+                    quota_used, aff_count, anti_cover, aff_exists, chosen,
+                    pos + cut)
 
         init = (
             inputs.requested,
@@ -233,11 +249,12 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             fc.bind_free,
             fc.quota_used,
             fc.aff_count,
+            fc.anti_cover,
             jnp.asarray(fc.aff_exists, bool),
             jnp.full(P, -1, jnp.int32),
             jnp.int32(0),
         )
-        (requested, _, _, _, _, quota_used, _, _, chosen,
+        (requested, _, _, _, _, quota_used, _, _, _, chosen,
          _pos) = jax.lax.while_loop(cond, wave_body, init)
 
         # ---- Permit barrier (gang group all-or-nothing)

@@ -53,7 +53,7 @@ def _load() -> Optional[ctypes.CDLL]:
     # would read every pointer after the insertion shifted
     try:
         lib.koord_floor_abi_version.restype = ctypes.c_int
-        if lib.koord_floor_abi_version() != 7:
+        if lib.koord_floor_abi_version() != 8:
             return None
     except AttributeError:
         return None
@@ -77,7 +77,7 @@ def _load() -> Optional[ctypes.CDLL]:
         + [_F32P] + [_I32P] * 2      # numa_free numa_policy has_topology
         + [_F32P] * 2                # bind_free cpus_per_core
         + [_I32P]                    # node_taint_group
-        + [_F32P] * 2                # aff_dom aff_count
+        + [_F32P] * 3                # aff_dom aff_count anti_cover
         + [_I32P]                    # aff_exists
         + [_F32P]                    # pref_scores [N, S]
         + [_I32P] + [_F32P] * 2      # ancestors quota_used quota_runtime
@@ -164,6 +164,8 @@ def serial_schedule_full_native(fc, args, num_groups: int = 0) -> np.ndarray:
         (_f32(fc.aff_dom) if T
          else np.full((N, 1), -1.0, np.float32)),
         (_f32(fc.aff_count).copy() if T
+         else np.zeros((N, 1), np.float32)),
+        (_f32(fc.anti_cover).copy() if T
          else np.zeros((N, 1), np.float32)),
         _i32(fc.aff_exists) if T else np.zeros(1, np.int32),
         _f32(fc.pref_scores),

@@ -30,7 +30,7 @@ inline float least_requested(float requested, float capacity) {
 
 // ABI version: bump when koord_serial_full_chain's signature changes, so a
 // stale .so is rejected instead of mis-reading shifted pointers.
-extern "C" int koord_floor_abi_version() { return 7; }
+extern "C" int koord_floor_abi_version() { return 8; }
 
 extern "C" {
 
@@ -86,6 +86,8 @@ void koord_serial_full_chain(
     const int32_t* node_taint_group, // [N]
     const float* aff_dom,        // [N, T] topology domain ids (-1 invalid)
     float* aff_count,            // [N, T] matching pods per domain (mutated)
+    float* anti_cover,           // [N, T] anti-term CARRIERS per domain
+                                 //        (mutated; symmetric anti-affinity)
     const int32_t* aff_exists0,  // [T] any matching pod anywhere (host seed)
     const float* pref_scores,    // [N, S] preferred-affinity score rows
     // quota
@@ -191,8 +193,13 @@ void koord_serial_full_chain(
         bool affinity_ok = true;
         const float* cnt = aff_count + (int64_t)n * T;
         const float* dom = aff_dom + (int64_t)n * T;
+        const float* cov = anti_cover + (int64_t)n * T;
         for (int t = 0; t < T && affinity_ok; ++t) {
           if (((pod_anti_req[p] >> t) & 1) && cnt[t] > 0.0f)
+            affinity_ok = false;
+          // symmetric anti-affinity: a carrier of anti term t in this
+          // node's domain blocks any pod matching t
+          if (((pod_aff_match[p] >> t) & 1) && cov[t] > 0.0f)
             affinity_ok = false;
           if ((pod_aff_req[p] >> t) & 1) {
             bool boot = ((pod_aff_match[p] >> t) & 1) && !term_has_match[t];
@@ -335,13 +342,19 @@ void koord_serial_full_chain(
       }
     }
     for (int t = 0; t < T; ++t) {
-      if (!((pod_aff_match[p] >> t) & 1)) continue;
-      term_has_match[t] = true;  // even when the node lacks the label
       float d = aff_dom[(int64_t)best_n * T + t];
-      if (d < 0.0f) continue;
-      for (int n = 0; n < N; ++n)
-        if (aff_dom[(int64_t)n * T + t] == d)
-          aff_count[(int64_t)n * T + t] += 1.0f;
+      if ((pod_aff_match[p] >> t) & 1) {
+        term_has_match[t] = true;  // even when the node lacks the label
+        if (d >= 0.0f)
+          for (int n = 0; n < N; ++n)
+            if (aff_dom[(int64_t)n * T + t] == d)
+              aff_count[(int64_t)n * T + t] += 1.0f;
+      }
+      // a placed CARRIER of anti term t raises its domain's anti_cover
+      if (((pod_anti_req[p] >> t) & 1) && d >= 0.0f)
+        for (int n = 0; n < N; ++n)
+          if (aff_dom[(int64_t)n * T + t] == d)
+            anti_cover[(int64_t)n * T + t] += 1.0f;
     }
   }
   delete[] term_has_match;

@@ -55,7 +55,7 @@ def estimate_vmem_bytes(N: int, R: int, K: int, G: int, P: int,
     G_eff = max(G, 1)
     G_lane = max(128, -(-G_eff // 128) * 128)
     floats = (3 * POD_BLOCK * R * 2 + 8 * R * N + 2 * K * R * N + 11 * N
-              + 3 * max(T, 0) * N + max(S, 1) * N
+              + 5 * max(T, 0) * N + max(S, 1) * N
               + 4 * R * G_lane + 2 * UNROLL * G_lane + P_pad)
     return 4 * floats
 
@@ -90,7 +90,7 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         numafree0_ref, ancpod_ref, qused0_ref, qruntime_ref,
         # --- VMEM inter-pod affinity [max(T,1), N] + preferred-affinity
         #     profile score rows [max(S,1), N]
-        affdom_ref, affcount0_ref, prefrows_ref,
+        affdom_ref, affcount0_ref, anticover0_ref, prefrows_ref,
         # --- outputs
         chosen_ref,                 # (UNROLL, 1) int32 block, one per step
         requested_ref,              # [R, N] (carried)
@@ -102,6 +102,7 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         headroom_ref,               # [R, N] (alloc - requested)
         qacc_ref,                   # [R, G] quota-used accumulator
         affcount_ref,               # [max(T,1), N] carried term counts
+        anticover_ref,              # [max(T,1), N] carried anti carriers
         affexists_ref,              # SMEM [max(T,1)] carried exists flags
     ):
         i = pl.program_id(0)
@@ -127,6 +128,7 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
             qacc_ref[:] = qused0_ref[:]
             if T:
                 affcount_ref[:] = affcount0_ref[:]
+                anticover_ref[:] = anticover0_ref[:]
                 for t in range(T):
                     affexists_ref[t] = affexists0_ref[t]
 
@@ -159,6 +161,7 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         qused = qacc_ref[:]                                          # [R, G]
         aff_dom = [affdom_ref[t:t + 1, :] for t in range(T)]         # [1, N]
         aff_count = [affcount_ref[t:t + 1, :] for t in range(T)]
+        anti_cover = [anticover_ref[t:t + 1, :] for t in range(T)]
 
         for j in range(UNROLL):
             p = i * UNROLL + j
@@ -243,10 +246,13 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
                 count_t = aff_count[t][0, :]
                 empty_t = count_t <= 0                              # [N]
                 anti_ok = (~anti_t) | empty_t
+                # symmetric anti-affinity: carriers of anti term t in this
+                # node's domain block any pod matching t
+                sym_ok = (~match_t) | (anti_cover[t][0, :] <= 0)
                 boot = match_t & (affexists_ref[t] <= 0.0)
                 dom_valid_t = aff_dom[t][0, :] >= 0
                 aff_ok = (~aff_t) | boot | (dom_valid_t & ~empty_t)
-                feasible = feasible & anti_ok & aff_ok
+                feasible = feasible & anti_ok & sym_ok & aff_ok
                 # PodTopologySpread: skew reconstructed from 3 bit-planes
                 bit = lambda ref: jnp.remainder(  # noqa: E731
                     jnp.floor(ref[p] / float(1 << t)), 2.0)
@@ -329,12 +335,15 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
             for t in range(T):
                 match_t = jnp.remainder(
                     jnp.floor(affmatch_ref[p] / float(1 << t)), 2.0) >= 1.0
+                anti_t = jnp.remainder(
+                    jnp.floor(antireq_ref[p] / float(1 << t)), 2.0) >= 1.0
                 dom_row = aff_dom[t][0, :]
                 chosen_dom = jnp.sum(sel * dom_row)
-                inc = jnp.where(
-                    (found & match_t & (chosen_dom >= 0))
-                    & (dom_row == chosen_dom), 1.0, 0.0)
+                in_dom = (chosen_dom >= 0) & (dom_row == chosen_dom)
+                inc = jnp.where((found & match_t) & in_dom, 1.0, 0.0)
                 aff_count[t] = aff_count[t] + inc[None, :]
+                inc_cov = jnp.where((found & anti_t) & in_dom, 1.0, 0.0)
+                anti_cover[t] = anti_cover[t] + inc_cov[None, :]
                 affexists_ref[t] = jnp.where(
                     found & match_t, 1.0, affexists_ref[t])
 
@@ -351,6 +360,7 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         qacc_ref[:] = qused
         for t in range(T):
             affcount_ref[t:t + 1, :] = aff_count[t]
+            anticover_ref[t:t + 1, :] = anti_cover[t]
 
         @pl.when(i == pl.num_programs(0) - 1)
         def _emit():
@@ -453,12 +463,14 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             affexists0 = f32(fc.aff_exists)
             affdom0 = f32(fc.aff_dom).T
             affcount0 = f32(fc.aff_count).T
+            anticover0 = f32(fc.anti_cover).T
         else:
             affreq_m = antireq_m = affmatch_m = jnp.zeros(P_pad, jnp.float32)
             skew0_m = skew1_m = skew2_m = affreq_m
             affexists0 = jnp.zeros(1, jnp.float32)
             affdom0 = jnp.full((1, N), -1.0, jnp.float32)
             affcount0 = jnp.zeros((1, N), jnp.float32)
+            anticover0 = jnp.zeros((1, N), jnp.float32)
 
         # preference-less batches carry one all-zero profile column; padded
         # pods get pid -1 and match no profile row
@@ -494,7 +506,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             jnp.asarray(fc.numa_policy, jnp.int32)[None, :],
             jnp.exp2(f32(fc.node_taint_group))[None, :],
             numa0, anc_pod, qused0, qruntime,
-            affdom0, affcount0, prefrows0,
+            affdom0, affcount0, anticover0, prefrows0,
         )
         smem, full = pc.smem_spec, pc.full_spec
         pod_spec = pc.pod_block_spec(R)
@@ -509,7 +521,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                 + [full((K * R, N)),
                    pl.BlockSpec((UNROLL, G_lane), lambda i: (i, 0)),
                    full((R, G_lane)), full((R, G_lane))]
-                + [full((T_eff, N))] * 2
+                + [full((T_eff, N))] * 3
                 + [full((S_eff, N))]
             ),
             out_specs=[
@@ -529,6 +541,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                 pltpu.VMEM((1, N), jnp.float32),
                 pltpu.VMEM((R, N), jnp.float32),
                 pltpu.VMEM((R, G_lane), jnp.float32),
+                pltpu.VMEM((T_eff, N), jnp.float32),
                 pltpu.VMEM((T_eff, N), jnp.float32),
                 pltpu.SMEM((T_eff,), jnp.float32),
             ],

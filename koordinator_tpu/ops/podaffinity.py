@@ -27,10 +27,23 @@ affinity term has (domain valid AND count > 0) or its bootstrap; the
 update after a placement increments the chosen node's whole domain row
 for every term the placed pod matches.
 
+Anti-affinity is SYMMETRIC upstream (the vendored InterPodAffinity filter
+keeps existingAntiAffinityCounts): an EXISTING pod's required anti term
+blocks any incoming pod matching that term from the existing pod's whole
+topology domain, even when the incoming pod carries no anti term itself.
+That rides a second [N, T] state array, anti_cover: how many pods
+CARRYING term t as required anti-affinity live in node n's domain.
+Existing assigned pods' anti terms are interned into the shared term
+space to seed it; a placed pending pod carrying an anti term raises its
+domain row as the kernel walks. Feasibility adds: no term the incoming
+pod MATCHES may have anti_cover > 0 on the node.
+
 MAX_TERMS = 24 keeps the Pallas encoding exact (the three bool rows ride
 one float bitmask each, < 2^24): batches with more distinct terms mark the
 EXCESS pods unschedulable for the round (conservative, loudly logged)
-rather than silently dropping a constraint.
+rather than silently dropping a constraint. Existing-pod anti terms
+beyond the budget likewise mark the pending pods MATCHING them
+unschedulable (never admit a co-location upstream would reject).
 """
 
 from __future__ import annotations
@@ -92,7 +105,7 @@ def _terms_of(pod) -> List[Term]:
 
 def build_affinity_state(pending_pods, nodes, existing_pods):
     """-> (terms, ids, aff_dom [N, T] f32, aff_count [N, T] f32,
-           aff_exists [T] bool,
+           anti_cover [N, T] f32, aff_exists [T] bool,
            aff_req [P_valid, T] bool, anti_req [P_valid, T] bool,
            match [P_valid, T] bool, spread_skew [P_valid, T] f32,
            overflow_pod_idx: list[int])
@@ -101,7 +114,9 @@ def build_affinity_state(pending_pods, nodes, existing_pods):
     spread constraint with that maxSkew over term t's domains.
 
     existing_pods: assigned, non-terminated pods (their labels + node names
-    seed the counts). aff_exists[t] is True when ANY existing pod matches
+    seed the counts; their required ANTI terms are interned too and seed
+    anti_cover — the upstream symmetric existingAntiAffinityCounts check).
+    aff_exists[t] is True when ANY existing pod matches
     term t — regardless of whether its node carries the topology label —
     driving the first-replica bootstrap exactly as upstream ("no matching
     pod in the cluster"), where counts alone would miss matches on
@@ -129,6 +144,38 @@ def build_affinity_state(pending_pods, nodes, existing_pods):
                 "batch encoding holds; it is unschedulable this round",
                 pod.meta.key, MAX_TERMS,
             )
+    # existing assigned pods' required anti-affinity terms join the shared
+    # space: their domains must gate incoming pods that MATCH them
+    # (symmetric anti-affinity). On budget overflow the matching pending
+    # pods go unschedulable for the round — conservative, never admitting
+    # a co-location the reference's symmetric check would reject.
+    existing_anti: List[Tuple[Term, object]] = []  # (term, carrier pod)
+    overflow_existing_terms: List[Term] = []
+    for epod in existing_pods:
+        for raw in epod.spec.pod_anti_affinity:
+            key = _term_key(raw, epod)
+            existing_anti.append((key, epod))
+            if key in ids:
+                continue
+            if len(terms) >= MAX_TERMS:
+                if key not in overflow_existing_terms:
+                    overflow_existing_terms.append(key)
+                continue
+            ids[key] = len(terms)
+            terms.append(key)
+    if overflow_existing_terms:
+        hit = set()
+        for i, pod in enumerate(pending_pods):
+            if i in hit or i in overflow_pods:
+                continue
+            if any(_pod_matches(t, pod) for t in overflow_existing_terms):
+                hit.add(i)
+                overflow_pods.append(i)
+        logger.warning(
+            "%d existing-pod anti-affinity terms exceed the %d-term batch "
+            "budget; %d matching pending pods are unschedulable this round",
+            len(overflow_existing_terms), MAX_TERMS, len(hit),
+        )
     # preferred pod-affinity terms join the SHARED space (their weighted
     # scores read the same domain counts); budget overflow here only drops
     # the preference — soft scoring degrades, never blocks
@@ -156,14 +203,15 @@ def build_affinity_state(pending_pods, nodes, existing_pods):
     P = len(pending_pods)
     aff_dom = np.full((N, T), -1.0, np.float32)
     aff_count = np.zeros((N, T), np.float32)
+    anti_cover = np.zeros((N, T), np.float32)
     aff_exists = np.zeros(T, bool)
     aff_req = np.zeros((P, T), bool)
     anti_req = np.zeros((P, T), bool)
     match = np.zeros((P, T), bool)
     spread_skew = np.zeros((P, T), np.float32)
     if T == 0:
-        return (terms, ids, aff_dom, aff_count, aff_exists, aff_req,
-                anti_req, match, spread_skew, overflow_pods)
+        return (terms, ids, aff_dom, aff_count, anti_cover, aff_exists,
+                aff_req, anti_req, match, spread_skew, overflow_pods)
 
     # domain ids per term: nodes sharing the topology label value
     node_values: List[dict] = []
@@ -198,6 +246,28 @@ def build_affinity_state(pending_pods, nodes, existing_pods):
                 0.0,
             )
 
+    # seed anti_cover from existing CARRIERS of interned anti terms: the
+    # carrier's node's domain row rises by one per carrier (same per-value
+    # accumulation as aff_count, keyed on carrying rather than matching)
+    cover_counts: List[dict] = [dict() for _ in range(T)]
+    for key, epod in existing_anti:
+        t = ids.get(key)
+        if t is None:
+            continue
+        n = node_index.get(epod.spec.node_name)
+        if n is None or aff_dom[n, t] < 0:
+            continue
+        d = aff_dom[n, t]
+        cover_counts[t][d] = cover_counts[t].get(d, 0.0) + 1.0
+    for t in range(T):
+        if cover_counts[t]:
+            col = aff_dom[:, t]
+            anti_cover[:, t] = np.where(
+                col >= 0,
+                np.vectorize(lambda d: cover_counts[t].get(d, 0.0))(col),
+                0.0,
+            )
+
     for i, pod in enumerate(pending_pods):
         for t, term in enumerate(terms):
             if _pod_matches(term, pod):
@@ -214,8 +284,8 @@ def build_affinity_state(pending_pods, nodes, existing_pods):
             t = ids.get(_spread_key(con, pod))
             if t is not None and con.when_unsatisfiable != "ScheduleAnyway":
                 spread_skew[i, t] = float(min(max(con.max_skew, 1), MAX_SKEW))
-    return (terms, ids, aff_dom, aff_count, aff_exists, aff_req, anti_req,
-            match, spread_skew, overflow_pods)
+    return (terms, ids, aff_dom, aff_count, anti_cover, aff_exists, aff_req,
+            anti_req, match, spread_skew, overflow_pods)
 
 
 MAX_PREF_PROFILES = 32

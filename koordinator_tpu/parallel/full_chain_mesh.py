@@ -54,6 +54,7 @@ _FC_NODE_FIELDS = frozenset(
         "node_taint_group",
         "aff_dom",
         "aff_count",
+        "anti_cover",
         "pref_scores",
     }
 )

@@ -213,6 +213,7 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
     node_taint_group = np.asarray(fc.node_taint_group)
     aff_dom = np.asarray(fc.aff_dom, np.float32)
     aff_count = np.array(fc.aff_count, np.float32)
+    anti_cover = np.array(fc.anti_cover, np.float32)
     aff_exists = np.array(fc.aff_exists, bool)
     pod_aff_req = np.asarray(fc.pod_aff_req)
     pod_anti_req = np.asarray(fc.pod_anti_req)
@@ -322,6 +323,11 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
                 if pod_anti_req[p, t] and aff_count[n, t] > 0:
                     affinity_ok = False
                     break
+                # symmetric anti-affinity: a carrier of anti term t in this
+                # node's domain blocks any pod matching t
+                if pod_aff_match[p, t] and anti_cover[n, t] > 0:
+                    affinity_ok = False
+                    break
                 if pod_aff_req[p, t]:
                     bootstrap = pod_aff_match[p, t] and not aff_exists[t]
                     if not ((aff_dom[n, t] >= 0 and aff_count[n, t] > 0)
@@ -416,12 +422,14 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
                 if g >= 0:
                     quota_used[g] += requests[p]
         for t in range(T):
-            if not pod_aff_match[p, t]:
-                continue
-            aff_exists[t] = True
-            if aff_dom[best_n, t] >= 0:
+            if pod_aff_match[p, t]:
+                aff_exists[t] = True
+                if aff_dom[best_n, t] >= 0:
+                    dom = aff_dom[:, t] == aff_dom[best_n, t]
+                    aff_count[dom, t] += 1.0
+            if pod_anti_req[p, t] and aff_dom[best_n, t] >= 0:
                 dom = aff_dom[:, t] == aff_dom[best_n, t]
-                aff_count[dom, t] += 1.0
+                anti_cover[dom, t] += 1.0
     return chosen
 
 

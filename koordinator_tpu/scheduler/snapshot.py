@@ -299,7 +299,7 @@ def build_full_chain_inputs(
         p for p in state.pods_by_key.values()
         if p.is_assigned and not p.is_terminated
     ]
-    (_aff_terms, term_ids, dom_v, count_v, aff_exists, aff_req_v,
+    (_aff_terms, term_ids, dom_v, count_v, cover_v, aff_exists, aff_req_v,
      anti_req_v, match_v, spread_v, aff_overflow) = build_affinity_state(
         ordered_pending, state.nodes, existing)
     T = dom_v.shape[1]
@@ -307,6 +307,8 @@ def build_full_chain_inputs(
     aff_dom[: dom_v.shape[0]] = dom_v
     aff_count = np.zeros((N, T), np.float32)
     aff_count[: count_v.shape[0]] = count_v
+    anti_cover = np.zeros((N, T), np.float32)
+    anti_cover[: cover_v.shape[0]] = cover_v
     pod_aff_req = np.zeros((P, T), bool)
     pod_aff_req[: aff_req_v.shape[0]] = aff_req_v
     pod_anti_req = np.zeros((P, T), bool)
@@ -363,6 +365,7 @@ def build_full_chain_inputs(
         node_taint_group=np.asarray(node_taint_group),
         aff_dom=np.asarray(aff_dom),
         aff_count=np.asarray(aff_count),
+        anti_cover=np.asarray(anti_cover),
         aff_exists=np.asarray(aff_exists),
         numa_free=np.asarray(numa_free),
         numa_capacity=np.asarray(numa_capacity),

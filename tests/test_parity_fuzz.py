@@ -41,6 +41,12 @@ def _mixed_fixture(seed: int):
             node.meta.annotations[ANNOTATION_NODE_RESERVATION] = json.dumps(
                 {"resources": {"cpu": "1", "memory": "1Gi"}})
     apps = ["web", "db", "cache"]
+    # existing assigned pods with anti terms exercise SYMMETRIC
+    # anti-affinity (their domains must repel matching incoming pods)
+    for pod in state.pods_by_key.values():
+        if pod.is_assigned and not pod.is_terminated and rng.random() < 0.1:
+            pod.spec.pod_anti_affinity.append(PodAffinityTerm(
+                selector={"app": rng.choice(apps)}, topology_key=ZONE))
     for i, pod in enumerate(state.pending_pods):
         r = rng.random()
         app = rng.choice(apps)

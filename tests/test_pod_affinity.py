@@ -429,6 +429,105 @@ def test_preferred_pod_affinity_scoring():
     assert tot > 0 and near >= tot * 0.6, (near, tot, seed_zone)
 
 
+def test_symmetric_anti_affinity_from_existing_pods():
+    """Upstream's existingAntiAffinityCounts: an EXISTING pod's required
+    anti-affinity blocks incoming pods MATCHING its selector from its whole
+    topology domain, even though the incoming pods carry no anti term
+    themselves — bit-identical across all five backends."""
+    from koordinator_tpu.models.wave_chain import build_wave_full_chain_step
+    from koordinator_tpu.native import floor as native_floor
+    from koordinator_tpu.ops.pallas_full_chain import (
+        build_pallas_full_chain_step,
+    )
+
+    args = LoadAwareArgs()
+    cluster, state = synth_full_cluster(12, 10, seed=53)
+    for j, node in enumerate(state.nodes):
+        node.meta.labels[ZONE_KEY] = f"z{j % 3}"
+    # existing assigned pod demands isolation from app=web in its zone
+    existing = next(p for p in state.pods_by_key.values()
+                    if p.is_assigned and not p.is_terminated)
+    existing.spec.pod_anti_affinity.append(PodAffinityTerm(
+        selector={"app": "web"}, topology_key=ZONE_KEY))
+    blocked_zone = next(
+        n.meta.labels[ZONE_KEY] for n in state.nodes
+        if n.meta.name == existing.spec.node_name)
+    # incoming pods match the selector but carry NO anti term of their own
+    for pod in state.pending_pods:
+        pod.meta.labels["app"] = "web"
+        pod.meta.namespace = existing.meta.namespace
+    fc, pods, nodes, tree, gi, ng, ngroups = build_full_chain_inputs(
+        state, args)
+    assert (np.asarray(fc.anti_cover) > 0).any()
+    chosen = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    serial = serial_schedule_full(fc, args)
+    n = len(pods.keys)
+    np.testing.assert_array_equal(chosen[:n], serial[:n])
+    chosen_p = np.asarray(
+        build_pallas_full_chain_step(args, ng, ngroups, interpret=True)(fc)[0])
+    np.testing.assert_array_equal(chosen, chosen_p)
+    chosen_w = np.asarray(
+        build_wave_full_chain_step(args, ng, ngroups, wave=8)(fc)[0])
+    np.testing.assert_array_equal(chosen, chosen_w)
+    if native_floor.available() or native_floor.build():
+        chosen_nat = native_floor.serial_schedule_full_native(
+            fc, args, num_groups=ngroups)
+        np.testing.assert_array_equal(chosen[:n], chosen_nat[:n])
+    placed = [i for i in range(n) if chosen[i] >= 0]
+    assert placed, "no matching pod placed at all"
+    zones = {state.nodes[chosen[i]].meta.labels[ZONE_KEY] for i in placed}
+    assert blocked_zone not in zones, (blocked_zone, zones)
+
+
+def test_symmetric_anti_affinity_in_batch():
+    """A PENDING pod that carries a self-matching anti term ("run alone")
+    must also repel LATER batch pods that match but carry no anti term —
+    the in-batch half of the symmetric check, exercised across backends
+    (wave=4 forces the carrier and its matches into separate waves)."""
+    from koordinator_tpu.models.wave_chain import build_wave_full_chain_step
+    from koordinator_tpu.native import floor as native_floor
+
+    args = LoadAwareArgs()
+    cluster, state = synth_full_cluster(9, 8, seed=59, num_gangs=0,
+                                        num_quotas=0)
+    for j, node in enumerate(state.nodes):
+        node.meta.labels[ZONE_KEY] = f"z{j % 3}"
+    loner = state.pending_pods[0]
+    loner.meta.labels["app"] = "batch-job"
+    loner.spec.priority = 100000  # packs first (queue sort: priority desc)
+    loner.spec.pod_anti_affinity.append(PodAffinityTerm(
+        selector={"app": "batch-job"}, topology_key=ZONE_KEY))
+    for pod in state.pending_pods[1:]:
+        pod.meta.labels["app"] = "batch-job"
+        pod.meta.namespace = loner.meta.namespace
+    fc, pods, nodes, tree, gi, ng, ngroups = build_full_chain_inputs(
+        state, args)
+    chosen = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    serial = serial_schedule_full(fc, args)
+    n = len(pods.keys)
+    np.testing.assert_array_equal(chosen[:n], serial[:n])
+    chosen_w = np.asarray(
+        build_wave_full_chain_step(args, ng, ngroups, wave=4)(fc)[0])
+    np.testing.assert_array_equal(chosen, chosen_w)
+    if native_floor.available() or native_floor.build():
+        chosen_nat = native_floor.serial_schedule_full_native(
+            fc, args, num_groups=ngroups)
+        np.testing.assert_array_equal(chosen[:n], chosen_nat[:n])
+    by_key = {p.meta.key: p for p in state.pending_pods}
+    loner_zone = follower_zones = None
+    follower_zones = set()
+    for i, key in enumerate(pods.keys):
+        if chosen[i] < 0:
+            continue
+        z = state.nodes[chosen[i]].meta.labels[ZONE_KEY]
+        if by_key[key] is loner:
+            loner_zone = z
+        else:
+            follower_zones.add(z)
+    assert loner_zone is not None
+    assert follower_zones and loner_zone not in follower_zones
+
+
 def test_schedule_anyway_spread_scores_but_never_blocks():
     """ScheduleAnyway spread: replicas prefer emptier zones but a full zone
     never makes them unschedulable (unlike DoNotSchedule), and bindings
