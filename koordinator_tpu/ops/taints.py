@@ -111,15 +111,18 @@ def group_node_admission(
         ids[sig] = len(sigs)
         sigs.append(sig)
 
+    degraded: List[str] = []
     for i, node in enumerate(nodes):
         sig = node_sigs[i]
         gid = ids.get(sig)
         if gid is None:  # degrade: label-unknown bucket for this taint set
             key = (sig[0], _UNKNOWN)
             gid = ids.get(key)
-            if gid is None and len(sigs) < overflow:
-                gid = ids[key] = len(sigs)
-                sigs.append(key)
+            if gid is not None or len(sigs) < overflow:
+                if gid is None:
+                    gid = ids[key] = len(sigs)
+                    sigs.append(key)
+                degraded.append(node.meta.name)
             if gid is None:
                 gid = overflow
                 logger.warning(
@@ -129,6 +132,17 @@ def group_node_admission(
                     node.meta.name, sorted(sig[0]), overflow,
                 )
         out[i] = gid
+    if degraded:
+        # loud by design: selector-carrying pods can NEVER schedule onto a
+        # label-unknown bucket, and host-side dry-runs (preemption) must
+        # consult this grouping or they will evict victims in vain
+        logger.warning(
+            "admission-signature budget exceeded: %d nodes degraded to "
+            "their label-unknown bucket (selector-carrying pods will not "
+            "schedule there this round): %s%s",
+            len(degraded), ", ".join(degraded[:5]),
+            "..." if len(degraded) > 5 else "",
+        )
     return out, sigs
 
 

@@ -345,7 +345,11 @@ class Scheduler:
         if no_fit:
             from koordinator_tpu.scheduler.preempt import DefaultPreemption
 
-            for round_ in DefaultPreemption(self.store).post_filter(no_fit):
+            preempter = DefaultPreemption(
+                self.store,
+                kernel_admission=getattr(self, "_last_admission", None),
+            )
+            for round_ in preempter.post_filter(no_fit):
                 any_victims = True
                 attempted[round_.preemptor_key] = self._cycle_seq
                 result.preempted_victims.extend(round_.victim_keys)
@@ -405,6 +409,18 @@ class Scheduler:
             return rejected_pods, [(p, "no schedulable node") for p in pending]
         fc, pods, nodes, tree, gang_index, ng, ngroups = build_full_chain_inputs(
             state, self.args
+        )
+        # stash the admission grouping this kernel pass used so host-side
+        # dry-runs (DefaultPreemption) consult the SAME encoding — the raw
+        # label check can be more permissive when the signature budget
+        # overflowed, and the dry-run must never accept a node the kernel
+        # cannot bind (it would evict victims in vain)
+        node_group_arr = np.asarray(fc.node_taint_group)
+        pod_mask_arr = np.asarray(fc.pod_taint_mask)
+        self._last_admission = (
+            {n.meta.name: int(node_group_arr[i])
+             for i, n in enumerate(state.nodes)},
+            {key: int(pod_mask_arr[i]) for i, key in enumerate(pods.keys)},
         )
         fc = self.extender.transform_before_score(fc, ctx)
         fc, active = reduce_to_active_axes(fc)

@@ -274,13 +274,21 @@ class DefaultPreemption:
     and the cycle driver reruns the batched kernel, which is the REAL
     feasibility gate (NUMA/cpuset/LoadAware/spread re-check there; the
     cycle's attempted-latch stops a pod that still cannot bind from
-    draining victims every cycle)."""
+    draining victims every cycle).
 
-    def __init__(self, store: ObjectStore) -> None:
+    kernel_admission: the (node_name -> group id, pod key -> group bitmask)
+    view of the LAST kernel pass's admission grouping (ops/taints.py). The
+    raw label/taint dry-run can be more permissive than the kernel when the
+    signature budget overflowed (a node degraded to its label-unknown
+    bucket admits no selector pods there) — without this check the dry-run
+    would accept a node the kernel can never bind and evict victims in
+    vain every retry window."""
+
+    def __init__(self, store: ObjectStore, kernel_admission=None) -> None:
         self.store = store
+        self._node_groups, self._pod_masks = kernel_admission or ({}, {})
 
-    @staticmethod
-    def _static_admission(pod: Pod, node) -> bool:
+    def _static_admission(self, pod: Pod, node) -> bool:
         from koordinator_tpu.ops.taints import (
             required_node_pairs,
             tolerates_taints,
@@ -291,7 +299,15 @@ class DefaultPreemption:
         if not tolerates_taints(pod.spec.tolerations, node.taints):
             return False
         labels = node.meta.labels
-        return all(labels.get(k) == v for k, v in required_node_pairs(pod))
+        if not all(labels.get(k) == v for k, v in required_node_pairs(pod)):
+            return False
+        # consult the kernel's admission grouping: the dry-run must never
+        # accept a node the batched encoding cannot bind
+        gid = self._node_groups.get(node.meta.name)
+        mask = self._pod_masks.get(pod.meta.key)
+        if gid is not None and mask is not None and not ((mask >> gid) & 1):
+            return False
+        return True
 
     @staticmethod
     def _affinity_feasible(pod: Pod, node, survivors: List[Pod],
@@ -320,6 +336,21 @@ class DefaultPreemption:
                 continue
             if domain_match(_term_key(raw, pod), raw.topology_key):
                 return False
+        # SYMMETRIC anti-affinity: a surviving pod CARRYING an anti term the
+        # preemptor matches blocks its whole domain (the kernel enforces
+        # this via anti_cover — the dry-run must not accept what the kernel
+        # will reject, or victims die in vain every retry window)
+        for other in survivors:
+            for raw in other.spec.pod_anti_affinity:
+                dom = node.meta.labels.get(raw.topology_key)
+                if dom is None:
+                    continue
+                onode = nodes_by_name.get(other.spec.node_name)
+                if onode is None or onode.meta.labels.get(
+                        raw.topology_key) != dom:
+                    continue
+                if _pod_matches(_term_key(raw, other), pod):
+                    return False
         for raw in pod.spec.pod_affinity:
             term = _term_key(raw, pod)
             if any(_pod_matches(term, o) for o in survivors):

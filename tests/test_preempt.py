@@ -393,6 +393,46 @@ class TestDefaultPreemption:
         assert by_pod.get("default/vip-a") == "n0"
         assert by_pod.get("default/vip-b") == "n0"
 
+    def test_preemption_consults_kernel_admission_grouping(self):
+        """When the admission-signature budget overflows (>22 usable exact
+        signatures), nodes degrade to their label-unknown bucket and
+        selector-carrying pods become KERNEL-unschedulable there. The
+        DefaultPreemption dry-run must consult that same grouping: raw
+        label checks would accept the node and evict victims in vain,
+        forever (the encoding disagreement is permanent)."""
+        from koordinator_tpu.client.store import KIND_NODE
+        from koordinator_tpu.scheduler.cycle import Scheduler
+
+        n_nodes = 26
+        store = self._store(nodes=n_nodes, cores=2)
+        for i, node in enumerate(store.list(KIND_NODE)):
+            node.meta.labels["kubernetes.io/hostname"] = node.meta.name
+        # every node is full with one low-priority victim
+        for i in range(n_nodes):
+            self._pod(store, f"victim-{i}", cpu=2000, prio=100, node=f"n{i}")
+        # 26 high-priority pods pinned to distinct hostnames -> 26 distinct
+        # signatures; the 22-slot exact budget (24 bits - overflow - one
+        # unknown bucket) interns only the first 22
+        for i in range(n_nodes):
+            vip = self._pod(store, f"vip-{i}", cpu=2000, prio=9000)
+            vip.spec.node_selector["kubernetes.io/hostname"] = f"n{i}"
+        result = Scheduler(store).run_cycle(now=1_000_000.0)
+        # pods whose target node kept an exact signature preempt + bind
+        by_pod = {b.pod_key: b.node_name for b in result.bound}
+        bound_vips = [k for k in by_pod if k.startswith("default/vip")]
+        assert len(bound_vips) >= 20
+        # pods whose node degraded to the label-unknown bucket are
+        # kernel-unschedulable: NO victim on those nodes may die in vain
+        unbound = [f"default/vip-{i}" for i in range(n_nodes)
+                   if f"default/vip-{i}" not in by_pod]
+        assert unbound, "fixture must overflow the signature budget"
+        unbound_nodes = {k.split("vip-")[1] for k in unbound}
+        vain = [v for v in result.preempted_victims
+                if v.split("victim-")[1] in unbound_nodes]
+        assert vain == [], f"victims evicted in vain: {vain}"
+        for k in unbound:
+            assert k in result.failed
+
     def test_attempted_latch_stops_repeat_drain(self):
         """A preemptor the kernel still rejects after its victims died must
         not evict a fresh victim set every cycle."""
