@@ -44,9 +44,18 @@ def pack_request(fc: FullChainInputs, num_gangs: int, num_groups: int,
     if active_axes is not None:
         req.active_axes.extend(int(a) for a in active_axes)
     # args.resource_weights feed the compiled step's score weights — they
-    # must ride the wire or the server would silently score with defaults
+    # must ride the wire or the server would silently score with defaults.
+    # The dense vector alone can't distinguish "axis unset" from "axis set
+    # to 0", and consumers iterate resource_weights keys — so the set-axes
+    # mask rides alongside and the server rebuilds the key set verbatim.
+    from koordinator_tpu.api.resources import NUM_RESOURCES, RESOURCE_INDEX
+
     req.inputs["args.weights"].CopyFrom(
         np_to_tensor(np.asarray(args.weight_vector(), np.float32)))
+    weights_set = np.zeros(NUM_RESOURCES, np.bool_)
+    for name in args.resource_weights:
+        weights_set[RESOURCE_INDEX[name]] = True
+    req.inputs["args.weights_set"].CopyFrom(np_to_tensor(weights_set))
     for name, value in fc.base._asdict().items():
         req.inputs[f"base.{name}"].CopyFrom(np_to_tensor(np.asarray(value)))
     for name, value in fc._asdict().items():
@@ -62,9 +71,13 @@ def unpack_request(req: sidecar_pb2.ScheduleBatchRequest) -> Tuple[FullChainInpu
     base_kwargs = {}
     fc_kwargs = {}
     weights_vec = None
+    weights_set = None
     for name, tensor in req.inputs.items():
         if name == "args.weights":
             weights_vec = tensor_to_np(tensor)
+            continue
+        if name == "args.weights_set":
+            weights_set = tensor_to_np(tensor)
             continue
         arr = jnp.asarray(tensor_to_np(tensor))
         if name.startswith("base."):
@@ -76,10 +89,19 @@ def unpack_request(req: sidecar_pb2.ScheduleBatchRequest) -> Tuple[FullChainInpu
     if weights_vec is not None:
         from koordinator_tpu.api.resources import RESOURCE_AXES
 
-        args.resource_weights = {
-            RESOURCE_AXES[i]: float(v)
-            for i, v in enumerate(weights_vec) if v
-        }
+        # rebuild exactly the key set the client configured: the set-axes
+        # mask keeps explicitly-zero weights (an older client without the
+        # mask falls back to nonzero-only, the previous behavior)
+        if weights_set is not None:
+            args.resource_weights = {
+                RESOURCE_AXES[i]: float(weights_vec[i])
+                for i in range(len(weights_vec)) if weights_set[i]
+            }
+        else:
+            args.resource_weights = {
+                RESOURCE_AXES[i]: float(v)
+                for i, v in enumerate(weights_vec) if v
+            }
     return fc, args
 
 
@@ -146,6 +168,36 @@ def serve_sidecar(address: str, server_impl: Optional[SidecarServer] = None):
     server.add_insecure_port(address)
     server.start()
     return server
+
+
+def schedule_batch_or_fallback(client, fc, num_gangs: int, num_groups: int,
+                               args: LoadAwareArgs, active_axes=None,
+                               local_step=None):
+    """Call the sidecar; on ANY transport failure (dead socket, timeout,
+    server crash) degrade to the in-process step instead of wedging the
+    scheduling cycle — the same stance the reference takes for a missing
+    NodeMetric dependency (load_aware.go:144-147: degrade, don't block).
+
+    Returns (chosen, requested, quota_used, used_fallback). ``local_step``
+    lets the caller inject its cached compiled step; otherwise one is built
+    on first use (and NOT cached here — cycle drivers own step caches)."""
+    import grpc
+
+    # pack OUTSIDE the try: a client-side encoding bug is a programming
+    # error that must surface, not silently degrade every cycle
+    req = pack_request(fc, num_gangs, num_groups, args,
+                       active_axes=active_axes)
+    try:
+        resp = client.schedule_batch(req)
+        return (tensor_to_np(resp.chosen), tensor_to_np(resp.requested),
+                tensor_to_np(resp.quota_used), False)
+    except (grpc.RpcError, ConnectionError, OSError):  # transport only
+        step = local_step or build_full_chain_step(
+            args, num_gangs, num_groups,
+            active_axes=list(active_axes) if active_axes else None)
+        chosen, requested, quota_used = step(fc)
+        return (np.asarray(chosen), np.asarray(requested),
+                np.asarray(quota_used), True)
 
 
 class SidecarClient:

@@ -1,0 +1,155 @@
+"""Compiled-language sidecar client + unavailability fallback.
+
+The sidecar's whole point (SURVEY.md 5.8: keep the reference's Go event loop
+untouched, offload the fused kernel over gRPC — the runtime-proxy proto
+pattern, /root/reference/apis/runtime/v1alpha1/api.proto:148-171) is that a
+NON-Python host consumes ScheduleBatch. native/sidecar_client.cpp is that
+host: a C++ binary speaking raw h2c gRPC framing with protoc-generated C++
+messages. Its bindings must match the in-process step bit-for-bit over a
+real unix socket.
+
+And when the sidecar dies, the cycle must DEGRADE to the in-process path,
+never wedge (load_aware.go:144-147 stance for a missing dependency).
+"""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.models.full_chain import build_full_chain_step
+from koordinator_tpu.ops.loadaware import LoadAwareArgs
+from koordinator_tpu.scheduler.sidecar import (
+    SidecarClient,
+    pack_request,
+    schedule_batch_or_fallback,
+    serve_sidecar,
+    tensor_to_np,
+)
+from koordinator_tpu.scheduler.snapshot import build_full_chain_inputs
+from koordinator_tpu.testing import synth_full_cluster
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "koordinator_tpu", "native")
+CLIENT_BIN = os.path.join(NATIVE_DIR, "koord_sidecar_client")
+
+
+def _fixture(seed=3, nodes=12, pods=16):
+    args = LoadAwareArgs()
+    cluster, state = synth_full_cluster(nodes, pods, seed=seed)
+    fc, pods_b, nb, tree, gi, ng, ngroups = build_full_chain_inputs(
+        state, args)
+    return args, fc, pods_b, ng, ngroups
+
+
+def _build_client() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", NATIVE_DIR, "-s", "koord_sidecar_client"],
+            check=True, capture_output=True, timeout=180)
+        return os.path.exists(CLIENT_BIN)
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def test_cpp_client_end_to_end(tmp_path):
+    """C++ binary -> UDS -> gRPC server -> kernel -> C++ binary: bindings
+    identical to the in-process step."""
+    pytest.importorskip("grpc")
+    if not os.path.exists(CLIENT_BIN) and not _build_client():
+        pytest.skip("C++ toolchain/protobuf unavailable")
+    args, fc, pods_b, ng, ngroups = _fixture(seed=5)
+    direct = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+
+    sock = tmp_path / "sidecar.sock"
+    server = serve_sidecar(f"unix://{sock}")
+    try:
+        from koordinator_tpu.scheduler import sidecar_pb2
+
+        req_file = tmp_path / "request.pb"
+        resp_file = tmp_path / "response.pb"
+        req = pack_request(fc, ng, ngroups, args, snapshot_version=11)
+        req_file.write_bytes(req.SerializeToString())
+        proc = subprocess.run(
+            [CLIENT_BIN, str(sock), str(req_file), str(resp_file), "300"],
+            capture_output=True, timeout=300)
+        assert proc.returncode == 0, proc.stderr.decode()
+        resp = sidecar_pb2.ScheduleBatchResponse()
+        resp.ParseFromString(resp_file.read_bytes())
+        np.testing.assert_array_equal(tensor_to_np(resp.chosen), direct)
+        assert resp.snapshot_version == 11
+        assert resp.kernel_seconds > 0
+    finally:
+        server.stop(0)
+
+
+def test_cpp_client_rejects_garbage_request(tmp_path):
+    if not os.path.exists(CLIENT_BIN) and not _build_client():
+        pytest.skip("C++ toolchain/protobuf unavailable")
+    req_file = tmp_path / "bad.pb"
+    req_file.write_bytes(b"\xff" * 64)
+    proc = subprocess.run(
+        [CLIENT_BIN, "/nonexistent.sock", str(req_file),
+         str(tmp_path / "out.pb"), "5"],
+        capture_output=True, timeout=60)
+    assert proc.returncode != 0
+
+
+def test_unreachable_sidecar_degrades_to_in_process(tmp_path):
+    """A dead/never-started sidecar must not wedge the cycle: the call
+    degrades to the local step and returns identical bindings."""
+    grpc = pytest.importorskip("grpc")
+    args, fc, pods_b, ng, ngroups = _fixture(seed=7)
+    direct = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    client = SidecarClient(f"unix://{tmp_path}/never-started.sock",
+                           timeout_seconds=2.0)
+    try:
+        chosen, requested, quota_used, used_fallback = (
+            schedule_batch_or_fallback(client, fc, ng, ngroups, args))
+    finally:
+        client.close()
+    assert used_fallback
+    np.testing.assert_array_equal(chosen, direct)
+
+
+def test_killed_sidecar_degrades_to_in_process(tmp_path):
+    """The sidecar answering once then DYING mid-operation degrades too —
+    the same client object keeps working through the fallback."""
+    grpc = pytest.importorskip("grpc")
+    args, fc, pods_b, ng, ngroups = _fixture(seed=9)
+    direct = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    address = f"unix://{tmp_path}/sidecar.sock"
+    server = serve_sidecar(address)
+    client = SidecarClient(address, timeout_seconds=30.0)
+    try:
+        chosen, _, _, used_fallback = schedule_batch_or_fallback(
+            client, fc, ng, ngroups, args)
+        assert not used_fallback
+        np.testing.assert_array_equal(chosen, direct)
+        server.stop(0)  # sidecar dies
+        client._timeout = 2.0
+        chosen2, _, _, used_fallback2 = schedule_batch_or_fallback(
+            client, fc, ng, ngroups, args)
+        assert used_fallback2
+        np.testing.assert_array_equal(chosen2, direct)
+    finally:
+        client.close()
+
+
+def test_explicit_zero_weight_survives_the_wire():
+    """A resource axis configured with weight 0 must reach the server as an
+    EXPLICIT key (not vanish into 'unset') — consumers iterate the key
+    set."""
+    from koordinator_tpu.api.resources import ResourceName
+    from koordinator_tpu.scheduler.sidecar import unpack_request
+
+    args = LoadAwareArgs(resource_weights={ResourceName.CPU: 2,
+                                           ResourceName.MEMORY: 0})
+    cluster, state = synth_full_cluster(8, 8, seed=13)
+    fc, pods_b, nb, tree, gi, ng, ngroups = build_full_chain_inputs(
+        state, args)
+    fc2, args2 = unpack_request(pack_request(fc, ng, ngroups, args))
+    assert args2.resource_weights == {ResourceName.CPU: 2.0,
+                                      ResourceName.MEMORY: 0.0}
