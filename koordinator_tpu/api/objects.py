@@ -164,6 +164,16 @@ class PodSpec:
     overhead: ResourceList = field(default_factory=ResourceList)
     restart_policy: str = "Always"
     termination_grace_period_seconds: int = 30
+    # container hostPorts as (protocol, port) — the vendored NodePorts
+    # filter's conflict identity (hostIP treated as the 0.0.0.0 wildcard:
+    # conservative, a conflict on any IP blocks the node)
+    host_ports: List[Tuple[str, int]] = field(default_factory=list)
+    # PVC claim names the pod mounts (volumes[].persistentVolumeClaim) —
+    # drive the CSI volume-limit count and the VolumeZone filter
+    pvc_names: List[str] = field(default_factory=list)
+    # container images — the vendored ImageLocality score reads them
+    # against node.images
+    images: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -327,6 +337,11 @@ class Node:
     unschedulable: bool = False
     taints: List[Tuple[str, str]] = field(default_factory=list)  # (key, value)
     ready: bool = True
+    # node.status.images as image name -> sizeBytes (ImageLocality score)
+    images: Dict[str, int] = field(default_factory=dict)
+    # CSI attachable-volume limit (node.status.allocatable
+    # attachable-volumes-csi-*); 0 = no limit reported
+    attachable_volume_limit: int = 0
 
     def node_reservation(self):
         """(reserved ResourceList, reserved_cpus str, trims_allocatable) from
@@ -624,6 +639,25 @@ class PersistentVolumeClaim:
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     volume_name: str = ""  # spec.volumeName once bound
     capacity: ResourceList = field(default_factory=ResourceList)
+
+
+@dataclass
+class PersistentVolume:
+    """Subset of core v1 PV for the VolumeZone filter: a PV carrying zone/
+    region topology labels restricts pods mounting its claims to matching
+    nodes (the vendored kube-scheduler VolumeZone plugin the reference
+    inherits via cmd/koord-scheduler/main.go:53-62's upstream app)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+
+    ZONE_LABELS = ("topology.kubernetes.io/zone",
+                   "topology.kubernetes.io/region",
+                   "failure-domain.beta.kubernetes.io/zone",
+                   "failure-domain.beta.kubernetes.io/region")
+
+    def zone_pairs(self) -> List[Tuple[str, str]]:
+        return [(k, v) for k, v in self.meta.labels.items()
+                if k in self.ZONE_LABELS]
 
 
 # ---------------------------------------------------------------------------

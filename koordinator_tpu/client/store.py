@@ -51,6 +51,7 @@ KIND_CONFIG_MAP = "ConfigMap"
 KIND_PDB = "PodDisruptionBudget"
 KIND_LEASE = "Lease"  # coordination.k8s.io leader-election lease
 KIND_PVC = "PersistentVolumeClaim"
+KIND_PV = "PersistentVolume"
 KIND_NAMESPACE = "Namespace"
 
 ALL_KINDS = (
@@ -70,6 +71,7 @@ ALL_KINDS = (
     KIND_PDB,
     KIND_LEASE,
     KIND_PVC,
+    KIND_PV,
     KIND_NAMESPACE,
 )
 

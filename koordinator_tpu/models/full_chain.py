@@ -64,6 +64,10 @@ class FullChainInputs(NamedTuple):
     pod_ppref_id: jnp.ndarray   # [P] int32 preferred POD-affinity profile
     pod_ppref_mask: jnp.ndarray  # [P, T] bool — terms the profile weighs
     #     (the wave kernel's conflict rule)
+    pod_port_wants: jnp.ndarray  # [P, PT] bool — hostPort slots requested
+    #     (ops/ports.py NodePorts factorization)
+    vol_needed: jnp.ndarray     # [P] f32 — new PVC volumes the pod mounts
+    pod_img_id: jnp.ndarray     # [P] int32 ImageLocality profile (-1)
     # nodes
     node_taint_group: jnp.ndarray  # [N] int32 admission-signature group
     aff_dom: jnp.ndarray        # [N, T] f32 topology domain id (-1 invalid)
@@ -75,6 +79,10 @@ class FullChainInputs(NamedTuple):
     #     (domain-labeled or not; drives the first-replica bootstrap)
     pref_scores: jnp.ndarray    # [N, S] f32 preferred-node-affinity score
     #     rows (0..100 per profile, static — ops/podaffinity.py)
+    port_used: jnp.ndarray      # [N, PT] f32 — hostPort slot in use on n
+    vol_free: jnp.ndarray       # [N] f32 — attachable CSI volumes left
+    #     (+inf when the node reports no limit)
+    img_scores: jnp.ndarray     # [N, max(SI,1)] f32 ImageLocality rows
     ppref_w: jnp.ndarray        # [max(S2,1), max(T,1)] f32 per-profile term
     #     weights for preferred pod affinity (negative = anti preference)
     numa_free: jnp.ndarray      # [N, K, R]
@@ -127,9 +135,11 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode):
     )
 
     T = fc.aff_dom.shape[1]
+    PT = fc.port_used.shape[1]
 
     def evaluate(i, requested, delta_np, delta_pr, numa_free, bind_free,
-                 quota_used, aff_count, anti_cover, aff_exists):
+                 quota_used, aff_count, anti_cover, aff_exists, port_used,
+                 vol_free):
         req_fit = inputs.fit_requests[i]
         req = fc.requests[i]
         est = inputs.estimated[i]
@@ -189,9 +199,18 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode):
             spread_ok = (skew <= 0) | (
                 dom_valid & (count_t + self_match - min_count <= skew))
             affinity_ok = affinity_ok & anti_ok & sym_ok & aff_ok & spread_ok
+        # NodePorts (vendored default plugin, ops/ports.py): no requested
+        # hostPort slot may already be bound on the node
+        ports_ok = jnp.ones(port_used.shape[0], bool)
+        for s in range(PT):
+            ports_ok = ports_ok & (
+                ~fc.pod_port_wants[i, s] | (port_used[:, s] <= 0))
+        # NodeVolumeLimits (CSI attachable count): nodes without a reported
+        # limit carry vol_free = +inf and always pass
+        vol_ok = (fc.vol_needed[i] <= 0) | (vol_free >= fc.vol_needed[i])
         feasible = (
             inputs.node_ok & fit & la_ok & cpuset_ok & numa_ok & taint_ok
-            & affinity_ok & admit
+            & affinity_ok & ports_ok & vol_ok & admit
         )
 
         # ---- Score chain (equal plugin weights, each already 0..100)
@@ -202,10 +221,15 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode):
             req, requested, inputs.allocatable, inputs.weights, weight_idx,
         )
         # preferred node affinity (soft NodeAffinity score): a static,
-        # profile-bucketed 0..100 row — pods without preferences add 0
-        pid = fc.pod_pref_id[i]
-        pref = jnp.where(
-            pid >= 0, fc.pref_scores[:, jnp.maximum(pid, 0)], 0.0)
+        # profile-bucketed 0..100 row — pods without preferences add 0.
+        # Zero-column tables mean NO pod carries the feature: skip the
+        # gather entirely (snapshot emits true empties)
+        if fc.pref_scores.shape[1]:
+            pid = fc.pod_pref_id[i]
+            pref = jnp.where(
+                pid >= 0, fc.pref_scores[:, jnp.maximum(pid, 0)], 0.0)
+        else:
+            pref = jnp.zeros(aff_count.shape[0], jnp.float32)
         # preferred POD affinity (soft InterPodAffinity score): weighted sum
         # of matching-pod counts over the shared term space, max-min
         # normalized to 0..100 per pod (upstream NormalizeScore semantics)
@@ -215,11 +239,21 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode):
             # elementwise+reduce, not matmul: TPU matmuls default to bf16
             # passes and the products must stay exact integers
             raw = jnp.sum(aff_count * w_row[None, :], axis=1)     # [N]
-            mx, mn = jnp.max(raw), jnp.min(raw)
+            # max-min over node_ok nodes only (upstream NormalizeScore
+            # spans the candidate set — padded/cordoned rows must not
+            # anchor the scale and shift weights across bucket sizes)
+            mx = jnp.max(jnp.where(inputs.node_ok, raw, -jnp.inf))
+            mn = jnp.min(jnp.where(inputs.node_ok, raw, jnp.inf))
             norm = jnp.where(
                 mx > mn,
                 jnp.floor((raw - mn) * 100.0 / (mx - mn)), 0.0)
             pref = pref + jnp.where(sid2 >= 0, norm, 0.0)
+        # ImageLocality (vendored default plugin, ops/ports.py): static
+        # profile-bucketed 0..100 row, like preferred node affinity
+        if fc.img_scores.shape[1]:
+            iid = fc.pod_img_id[i]
+            pref = pref + jnp.where(
+                iid >= 0, fc.img_scores[:, jnp.maximum(iid, 0)], 0.0)
         score = la_score + numa_score + pref
         score = jnp.where(feasible, score, -1.0)
 
@@ -250,10 +284,12 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
         evaluate = make_pod_evaluator(fc, weight_idx, prod_mode)
 
         T = fc.aff_dom.shape[1]
+        PT = fc.port_used.shape[1]
 
         def body(i, state):
             (requested, delta_np, delta_pr, numa_free, bind_free,
-             quota_used, aff_count, anti_cover, aff_exists, chosen) = state
+             quota_used, aff_count, anti_cover, aff_exists, port_used,
+             vol_free, chosen) = state
             req_fit = inputs.fit_requests[i]
             req = fc.requests[i]
             est = inputs.estimated[i]
@@ -261,7 +297,8 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
 
             found, best, zone_at_best, _admit = evaluate(
                 i, requested, delta_np, delta_pr, numa_free, bind_free,
-                quota_used, aff_count, anti_cover, aff_exists,
+                quota_used, aff_count, anti_cover, aff_exists, port_used,
+                vol_free,
             )
             fnd = found.astype(jnp.float32)
 
@@ -284,6 +321,14 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
             bind_free = bind_free.at[best].add(
                 -fnd * jnp.where(fc.needs_bind[i], fc.cores_needed[i], 0.0)
             )
+            # NodePorts: the placed pod binds its wanted slots on the node
+            if PT:
+                port_row = jnp.maximum(
+                    port_used[best],
+                    fnd * fc.pod_port_wants[i].astype(jnp.float32))
+                port_used = jax.lax.dynamic_update_slice(
+                    port_used, port_row[None], (best, 0))
+            vol_free = vol_free.at[best].add(-fnd * fc.vol_needed[i])
             quota_used = quota_used_add_row(
                 quota_used, req, fc.quota_id[i], fc.quota_ancestors, found
             )
@@ -304,7 +349,8 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
                     aff_exists[t] | (found & fc.pod_aff_match[i, t]))
             chosen = chosen.at[i].set(jnp.where(found, best.astype(jnp.int32), -1))
             return (requested, delta_np, delta_pr, numa_free, bind_free,
-                    quota_used, aff_count, anti_cover, aff_exists, chosen)
+                    quota_used, aff_count, anti_cover, aff_exists, port_used,
+                    vol_free, chosen)
 
         R = inputs.fit_requests.shape[-1]
         init = (
@@ -317,9 +363,11 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
             fc.aff_count,
             fc.anti_cover,
             jnp.asarray(fc.aff_exists, bool),
+            fc.port_used,
+            fc.vol_free,
             jnp.full(P, -1, jnp.int32),
         )
-        (requested, _, _, _, _, quota_used, _, _, _,
+        (requested, _, _, _, _, quota_used, _, _, _, _, _,
          chosen) = jax.lax.fori_loop(0, P, body, init)
 
         # ---- Permit barrier (gang group all-or-nothing)
@@ -397,7 +445,9 @@ def build_best_full_chain_step(args: LoadAwareArgs, num_gangs: int,
         G = fc.quota_used.shape[0]
         T = fc.aff_dom.shape[1]
         S = fc.pref_scores.shape[1]
-        if estimate_vmem_bytes(N, R, K, G, P, T, S) <= budget:
+        PT = fc.port_used.shape[1]
+        SI = fc.img_scores.shape[1]
+        if estimate_vmem_bytes(N, R, K, G, P, T, S, PT, SI) <= budget:
             step.last_backend = "pallas"
             return pallas_step(fc)
         step.last_backend = "xla"

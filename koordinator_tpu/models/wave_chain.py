@@ -86,8 +86,8 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
 
         def wave_body(state):
             (requested, delta_np, delta_pr, numa_free, bind_free,
-             quota_used, aff_count, anti_cover, aff_exists, chosen,
-             pos) = state
+             quota_used, aff_count, anti_cover, aff_exists, port_used,
+             vol_free, chosen, pos) = state
             idx = pos + warange
             valid_w = idx < P
             idxc = jnp.minimum(idx, P - 1)
@@ -95,7 +95,8 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             found_w, best_w, zone_w, admit_w = jax.vmap(
                 lambda i: evaluate(i, requested, delta_np, delta_pr,
                                    numa_free, bind_free, quota_used,
-                                   aff_count, anti_cover, aff_exists)
+                                   aff_count, anti_cover, aff_exists,
+                                   port_used, vol_free)
             )(idxc)
             found_w = found_w & valid_w
 
@@ -194,6 +195,15 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                 sel_c.T,
                 jnp.where(fc.needs_bind[idxc], fc.cores_needed[idxc], 0.0),
             )
+            # NodePorts/volumes: same-node conflicts are impossible within a
+            # wave (the node-collision cut commits distinct nodes), so the
+            # frozen evaluation is exact and the rollup scatters cleanly
+            if fc.port_used.shape[1]:
+                port_used = jnp.maximum(
+                    port_used,
+                    mm(sel_c.T,
+                       fc.pod_port_wants[idxc].astype(jnp.float32)))
+            vol_free = vol_free - mm(sel_c.T, fc.vol_needed[idxc])
             # committed pods occupy DISTINCT nodes (node_coll cut), so the
             # per-pod NUMA fills scatter without aliasing
             new_rows_w = jax.vmap(numa_spread_fill)(
@@ -238,8 +248,8 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             chosen_idx = jnp.where((warange < cut) & valid_w, idx, P)
             chosen = chosen.at[chosen_idx].set(value_w, mode="drop")
             return (requested, delta_np, delta_pr, numa_free, bind_free,
-                    quota_used, aff_count, anti_cover, aff_exists, chosen,
-                    pos + cut)
+                    quota_used, aff_count, anti_cover, aff_exists, port_used,
+                    vol_free, chosen, pos + cut)
 
         init = (
             inputs.requested,
@@ -251,10 +261,12 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             fc.aff_count,
             fc.anti_cover,
             jnp.asarray(fc.aff_exists, bool),
+            fc.port_used,
+            fc.vol_free,
             jnp.full(P, -1, jnp.int32),
             jnp.int32(0),
         )
-        (requested, _, _, _, _, quota_used, _, _, _, chosen,
+        (requested, _, _, _, _, quota_used, _, _, _, _, _, chosen,
          _pos) = jax.lax.while_loop(cond, wave_body, init)
 
         # ---- Permit barrier (gang group all-or-nothing)

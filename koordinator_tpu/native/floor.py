@@ -53,13 +53,13 @@ def _load() -> Optional[ctypes.CDLL]:
     # would read every pointer after the insertion shifted
     try:
         lib.koord_floor_abi_version.restype = ctypes.c_int
-        if lib.koord_floor_abi_version() != 8:
+        if lib.koord_floor_abi_version() != 9:
             return None
     except AttributeError:
         return None
     lib.koord_serial_full_chain.restype = None
     lib.koord_serial_full_chain.argtypes = (
-        [ctypes.c_int] * 11          # P R N K G A NG T S S2 prod
+        [ctypes.c_int] * 13          # P R N K G A NG T S S2 PT SI prod
         + [_F32P] * 3                # fit_requests requests estimated
         + [_I32P] * 7                # is_prod..needs_bind
         + [_F32P] + [_I32P]          # cores_needed full_pcpus
@@ -69,6 +69,7 @@ def _load() -> Optional[ctypes.CDLL]:
         + [_I32P]                    # pod_pref_id [P]
         + [_I32P]                    # pod_ppref_id [P]
         + [_F32P]                    # ppref_w [max(S2,1), max(T,1)]
+        + [_I32P] + [_F32P] + [_I32P]  # pod_port_wants vol_needed pod_img_id
         + [_F32P, _F32P] + [_I32P]   # allocatable requested node_ok
         + [_F32P] + [_I32P]          # filter_usage has_filter_usage
         + [_F32P] * 5                # filter_thr prod_thr prod_usage term_np term_pr
@@ -80,6 +81,7 @@ def _load() -> Optional[ctypes.CDLL]:
         + [_F32P] * 3                # aff_dom aff_count anti_cover
         + [_I32P]                    # aff_exists
         + [_F32P]                    # pref_scores [N, S]
+        + [_F32P] * 3                # port_used vol_free img_scores
         + [_I32P] + [_F32P] * 2      # ancestors quota_used quota_runtime
         + [_I32P] + [_F32P] * 2      # gang_valid gang_min gang_assumed
         + [_I32P, ctypes.c_int]      # gang_group num_groups
@@ -91,6 +93,40 @@ def _load() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return _load() is not None
+
+
+def lownodeload_floor_native(alloc, usage_pct, has_metric, low_thr, high_thr,
+                             pod_node, pod_prio, pod_req, movable,
+                             pod_sort_cpu, max_evict_per_node: int):
+    """Compiled serial floor for the LowNodeLoad rebalance pass: returns
+    victim[P] int32 (1 = selected). Same classify/sort/select semantics as
+    descheduler/lownodeload.py, executed per-node/per-pod serially — the
+    honest stand-in for the reference's Go loops (BASELINE config 5)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(
+            "libkoordfloor.so not built (make -C koordinator_tpu/native)")
+    fn = lib.koord_lownodeload_floor
+    if not getattr(fn, "_typed", False):
+        fn.restype = None
+        fn.argtypes = (
+            [ctypes.c_int] * 3
+            + [_F32P] * 2 + [_I32P]      # alloc usage_pct has_metric
+            + [_F32P] * 2                # low_thr high_thr
+            + [_I32P] * 2 + [_F32P]      # pod_node pod_prio pod_req
+            + [_I32P] + [_F32P]          # movable pod_sort_cpu
+            + [ctypes.c_int] + [_I32P]   # max_evict victim(out)
+        )
+        fn._typed = True
+    alloc = _f32(alloc)
+    N, R = alloc.shape
+    pod_node = _i32(pod_node)
+    P = pod_node.shape[0]
+    victim = np.zeros(P, np.int32)
+    fn(N, P, R, alloc, _f32(usage_pct), _i32(has_metric), _f32(low_thr),
+       _f32(high_thr), pod_node, _i32(pod_prio), _f32(pod_req),
+       _i32(movable), _f32(pod_sort_cpu), int(max_evict_per_node), victim)
+    return victim
 
 
 def _f32(x) -> np.ndarray:
@@ -126,6 +162,8 @@ def serial_schedule_full_native(fc, args, num_groups: int = 0) -> np.ndarray:
     T = int(np.asarray(fc.aff_dom).shape[1])
     S = int(np.asarray(fc.pref_scores).shape[1])
     S2 = int(np.asarray(fc.ppref_w).shape[0]) if T else 0
+    PT = int(np.asarray(fc.port_used).shape[1])
+    SI = int(np.asarray(fc.img_scores).shape[1])
     pow_t = (1 << np.arange(max(T, 1), dtype=np.int64))[:T]
 
     def term_mask(rows) -> np.ndarray:  # [P, T] bool -> [P] int32 bitmask
@@ -133,9 +171,15 @@ def serial_schedule_full_native(fc, args, num_groups: int = 0) -> np.ndarray:
             return np.zeros(P, np.int32)
         return _i32((np.asarray(rows, bool) * pow_t[None, :]).sum(axis=1))
 
+    if PT:
+        pow_s = (1 << np.arange(PT, dtype=np.int64))
+        port_mask = _i32(
+            (np.asarray(fc.pod_port_wants, bool) * pow_s[None, :]).sum(axis=1))
+    else:
+        port_mask = np.zeros(P, np.int32)
     chosen = np.full(P, -1, np.int32)
     lib.koord_serial_full_chain(
-        P, R, N, K, max(G, 0), A, NG, T, S, S2,
+        P, R, N, K, max(G, 0), A, NG, T, S, S2, PT, SI,
         1 if args.score_according_prod_usage else 0,
         fit_requests, _f32(fc.requests), _f32(inputs.estimated),
         _i32(inputs.is_prod), _i32(inputs.is_daemonset),
@@ -151,6 +195,7 @@ def serial_schedule_full_native(fc, args, num_groups: int = 0) -> np.ndarray:
         _i32(fc.pod_ppref_id),
         (_f32(fc.ppref_w) if S2
          else np.zeros((1, max(T, 1)), np.float32)),
+        port_mask, _f32(fc.vol_needed), _i32(fc.pod_img_id),
         allocatable, _f32(inputs.requested).copy(), _i32(inputs.node_ok),
         _f32(inputs.la_filter_usage), _i32(inputs.la_has_filter_usage),
         _f32(inputs.la_filter_thresholds), _f32(inputs.la_prod_thresholds),
@@ -168,7 +213,13 @@ def serial_schedule_full_native(fc, args, num_groups: int = 0) -> np.ndarray:
         (_f32(fc.anti_cover).copy() if T
          else np.zeros((N, 1), np.float32)),
         _i32(fc.aff_exists) if T else np.zeros(1, np.int32),
-        _f32(fc.pref_scores),
+        (_f32(fc.pref_scores) if S
+         else np.zeros((N, 1), np.float32)),
+        (_f32(fc.port_used).copy() if PT
+         else np.zeros((N, 1), np.float32)),
+        _f32(fc.vol_free).copy(),
+        (_f32(fc.img_scores) if SI
+         else np.zeros((N, 1), np.float32)),
         ancestors if ancestors.size else np.zeros((1, 1), np.int32),
         _f32(fc.quota_used).copy() if G else np.zeros((1, R), np.float32),
         _f32(fc.quota_runtime) if G else np.zeros((1, R), np.float32),

@@ -14,8 +14,10 @@
 // Build with -ffp-contract=off (see Makefile) so no FMA contraction changes
 // results vs numpy.
 
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
+#include <vector>
 
 namespace {
 
@@ -30,7 +32,7 @@ inline float least_requested(float requested, float capacity) {
 
 // ABI version: bump when koord_serial_full_chain's signature changes, so a
 // stale .so is rejected instead of mis-reading shifted pointers.
-extern "C" int koord_floor_abi_version() { return 8; }
+extern "C" int koord_floor_abi_version() { return 9; }
 
 extern "C" {
 
@@ -40,7 +42,7 @@ extern "C" {
 void koord_serial_full_chain(
     // dims
     int P, int R, int N, int K, int G, int A, int NG, int T, int S,
-    int S2,
+    int S2, int PT, int SI,
     int prod_mode,
     // pods
     const float* fit_requests,   // [P, R]
@@ -63,6 +65,9 @@ void koord_serial_full_chain(
     const int32_t* pod_pref_id,    // [P] preferred-affinity profile (-1)
     const int32_t* pod_ppref_id,   // [P] preferred POD-affinity profile
     const float* ppref_w,          // [max(S2,1), max(T,1)] profile weights
+    const int32_t* pod_port_wants, // [P] bitmask of hostPort slots
+    const float* vol_needed,       // [P] new PVC volume count
+    const int32_t* pod_img_id,     // [P] ImageLocality profile (-1)
     // nodes
     const float* allocatable,    // [N, R]
     float* requested_state,      // [N, R] (mutated)
@@ -90,6 +95,10 @@ void koord_serial_full_chain(
                                  //        (mutated; symmetric anti-affinity)
     const int32_t* aff_exists0,  // [T] any matching pod anywhere (host seed)
     const float* pref_scores,    // [N, S] preferred-affinity score rows
+    float* port_used,            // [N, PT] hostPort slot bound (mutated)
+    float* vol_free,             // [N] CSI attachable headroom (mutated;
+                                 //     +inf when the node reports no limit)
+    const float* img_scores,     // [N, SI] ImageLocality score rows
     // quota
     const int32_t* ancestors,    // [G, A] (-1 padded)
     float* quota_used,           // [G, R] (mutated)
@@ -149,14 +158,18 @@ void koord_serial_full_chain(
     if (T > 0 && S2 > 0 && pod_ppref_id[p] >= 0) {
       const float* w = ppref_w + (int64_t)pod_ppref_id[p] * (T > 0 ? T : 1);
       ppref_norm = new float[N];
+      // max-min over node_ok only (upstream NormalizeScore spans the
+      // candidate set; padded rows must not anchor the scale)
       float mx = -3.4e38f, mn = 3.4e38f;
       for (int n = 0; n < N; ++n) {
         float raw = 0.0f;
         for (int t = 0; t < T; ++t)
           raw += w[t] * aff_count[(int64_t)n * T + t];
         ppref_norm[n] = raw;
-        if (raw > mx) mx = raw;
-        if (raw < mn) mn = raw;
+        if (node_ok[n]) {
+          if (raw > mx) mx = raw;
+          if (raw < mn) mn = raw;
+        }
       }
       for (int n = 0; n < N; ++n)
         ppref_norm[n] = mx > mn
@@ -217,6 +230,17 @@ void koord_serial_full_chain(
         }
         if (!affinity_ok) continue;
       }
+      // NodePorts: no wanted hostPort slot already bound on the node
+      if (PT > 0) {
+        bool port_ok = true;
+        for (int s = 0; s < PT && port_ok; ++s)
+          if (((pod_port_wants[p] >> s) & 1) &&
+              port_used[(int64_t)n * PT + s] > 0.0f)
+            port_ok = false;
+        if (!port_ok) continue;
+      }
+      // CSI volume limit (+inf when the node reports none)
+      if (vol_needed[p] > 0.0f && vol_free[n] < vol_needed[p]) continue;
       const float* alloc = allocatable + (int64_t)n * R;
       const float* reqn = requested_state + (int64_t)n * R;
       // Filter: Fit
@@ -297,6 +321,8 @@ void koord_serial_full_chain(
       if (S > 0 && pod_pref_id[p] >= 0)
         s += pref_scores[(int64_t)n * S + pod_pref_id[p]];
       if (ppref_norm) s += ppref_norm[n];
+      if (SI > 0 && pod_img_id[p] >= 0)
+        s += img_scores[(int64_t)n * SI + pod_img_id[p]];
       if (s > best_score) {  // strict: lowest index wins ties
         best_n = n;
         best_score = s;
@@ -332,6 +358,10 @@ void koord_serial_full_chain(
       }
     }
     if (needs_bind[p]) bind_free[best_n] -= cores_needed[p];
+    for (int s = 0; s < PT; ++s)
+      if ((pod_port_wants[p] >> s) & 1)
+        port_used[(int64_t)best_n * PT + s] = 1.0f;
+    if (vol_needed[p] > 0.0f) vol_free[best_n] -= vol_needed[p];
     if (quota_id[p] >= 0) {
       const int32_t* chain = ancestors + (int64_t)quota_id[p] * A;
       for (int a = 0; a < A; ++a) {
@@ -381,6 +411,90 @@ void koord_serial_full_chain(
     delete[] per_gang;
     delete[] gang_ok;
     delete[] group_fail;
+  }
+}
+
+// Serial floor for the koord-descheduler LowNodeLoad global rebalance
+// (BASELINE config 5): a per-node/per-pod transcription of the classify /
+// sort / select pass (reference pkg/descheduler/framework/plugins/loadaware/
+// utilization_util.go semantics as implemented by descheduler/lownodeload.py:
+// classify nodes by measured utilization, walk each high node's movable
+// pods sorted by (priority asc, cpu desc), select until the node would drop
+// back under the high thresholds or the per-node cap hits). Same float32
+// arithmetic as the python pass so the selected victim set is identical.
+void koord_lownodeload_floor(
+    int N, int P, int R,
+    const float* alloc,          // [N, R]
+    const float* usage_pct,      // [N, R] measured utilization percent
+    const int32_t* has_metric,   // [N]
+    const float* low_thr,        // [R] (0 = unchecked)
+    const float* high_thr,       // [R]
+    const int32_t* pod_node,     // [P] node index (-1 = unassigned)
+    const int32_t* pod_prio,     // [P]
+    const float* pod_req,        // [P, R]
+    const int32_t* movable,      // [P]
+    const float* pod_sort_cpu,   // [P] cpu request (sort key)
+    int max_evict_per_node,
+    int32_t* victim)             // [P] out: 1 = selected for migration
+{
+  for (int p = 0; p < P; ++p) victim[p] = 0;
+  // classification
+  std::vector<bool> is_low(N, false), is_high(N, false);
+  for (int n = 0; n < N; ++n) {
+    if (!has_metric[n]) continue;
+    bool low = true, high = false;
+    for (int r = 0; r < R; ++r) {
+      float u = usage_pct[(int64_t)n * R + r];
+      if (low_thr[r] > 0.0f && !(u < low_thr[r])) low = false;
+      if (high_thr[r] > 0.0f && u > high_thr[r]) high = true;
+    }
+    is_high[n] = high;
+    is_low[n] = low && !high;
+  }
+  bool any_low = false, any_high = false;
+  for (int n = 0; n < N; ++n) {
+    any_low = any_low || is_low[n];
+    any_high = any_high || is_high[n];
+  }
+  if (!any_low || !any_high) return;
+
+  // per-node movable pod lists (single pass, stable order = input order)
+  std::vector<std::vector<int>> node_pods(N);
+  for (int p = 0; p < P; ++p) {
+    int n = pod_node[p];
+    if (n >= 0 && n < N && movable[p]) node_pods[n].push_back(p);
+  }
+  for (int n = 0; n < N; ++n) {
+    if (!is_high[n]) continue;
+    // over-gate mirrors lownodeload.py exactly: NO thr>0 mask here (the
+    // python pass max(usage - thr, 0).any() counts unchecked axes too)
+    bool over = false;
+    for (int r = 0; r < R; ++r)
+      if (usage_pct[(int64_t)n * R + r] - high_thr[r] > 0.0f) over = true;
+    if (!over) continue;
+    std::vector<int>& cand = node_pods[n];
+    std::stable_sort(cand.begin(), cand.end(), [&](int a, int b) {
+      if (pod_prio[a] != pod_prio[b]) return pod_prio[a] < pod_prio[b];
+      return pod_sort_cpu[a] > pod_sort_cpu[b];
+    });
+    std::vector<float> freed(R, 0.0f);
+    int count = 0;
+    for (int pi : cand) {
+      if (count >= max_evict_per_node) break;
+      bool still_over = false;
+      for (int r = 0; r < R; ++r) {
+        if (high_thr[r] <= 0.0f) continue;
+        float a = alloc[(int64_t)n * R + r];
+        float denom = a > 1e-9f ? a : 1e-9f;
+        if (usage_pct[(int64_t)n * R + r] - freed[r] * 100.0f / denom >
+            high_thr[r])
+          still_over = true;
+      }
+      if (!still_over) break;
+      victim[pi] = 1;
+      for (int r = 0; r < R; ++r) freed[r] += pod_req[(int64_t)pi * R + r];
+      ++count;
+    }
   }
 }
 

@@ -66,6 +66,10 @@ class PodBatch:
     gang_id: np.ndarray                  # [P] int32, -1 = no gang
     quota_id: np.ndarray                 # [P] int32, -1 = no quota group
     valid: np.ndarray                    # [P] bool
+    # row -> reason for pods the ENCODING marked unschedulable this round
+    # (term/slot budget overflow) — the cycle driver surfaces these as
+    # first-class failure events instead of a generic "no feasible node"
+    unschedulable_reasons: Dict[int, str] = field(default_factory=dict)
 
     @property
     def num_valid(self) -> int:

@@ -43,7 +43,8 @@ from koordinator_tpu.ops.pallas_common import POD_BLOCK, UNROLL
 
 
 def estimate_vmem_bytes(N: int, R: int, K: int, G: int, P: int,
-                        T: int = 0, S: int = 0) -> int:
+                        T: int = 0, S: int = 0, PT: int = 0,
+                        SI: int = 0) -> int:
     """Upper-bound VMEM footprint of one pallas_call of the full-chain
     kernel, mirroring the in/out/scratch specs below: 3 double-buffered
     [R, POD_BLOCK] pod column blocks, 8 [R, N] node buffers, 2 [K*R, N]
@@ -54,14 +55,16 @@ def estimate_vmem_bytes(N: int, R: int, K: int, G: int, P: int,
     P_pad = -(-P // POD_BLOCK) * POD_BLOCK
     G_eff = max(G, 1)
     G_lane = max(128, -(-G_eff // 128) * 128)
-    floats = (3 * POD_BLOCK * R * 2 + 8 * R * N + 2 * K * R * N + 11 * N
+    floats = (3 * POD_BLOCK * R * 2 + 8 * R * N + 2 * K * R * N + 13 * N
               + 5 * max(T, 0) * N + max(S, 1) * N
+              + 2 * max(PT, 1) * N + max(SI, 1) * N
               + 4 * R * G_lane + 2 * UNROLL * G_lane + P_pad)
     return 4 * floats
 
 
 def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
-                 K: int, G: int, T: int = 0, S: int = 0, S2: int = 0):
+                 K: int, G: int, T: int = 0, S: int = 0, S2: int = 0,
+                 PT: int = 0, SI: int = 0):
     wsum = float(max(weights.sum(), 1.0))
     consts = pc.weight_consts(weights)
 
@@ -76,6 +79,9 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         prefid_ref,                              # int32 [P] pref profile
         pprefid_ref,                             # int32 [P] pod-pref profile
         pprefw_ref,                              # f32 [max(S2,1), max(T,1)]
+        portwants_ref,                           # f32 [P] port-slot bitmask
+        volneeded_ref,                           # f32 [P] new PVC count
+        imgid_ref,                               # int32 [P] image profile
         qid_ref,                                                  # int32 [P]
         # --- VMEM pod column blocks [R, POD_BLOCK]
         fitreq_ref, rawreq_ref, est_ref,
@@ -89,8 +95,10 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         #     (pre-gathered host-side: no in-kernel dynamic slice) / quota
         numafree0_ref, ancpod_ref, qused0_ref, qruntime_ref,
         # --- VMEM inter-pod affinity [max(T,1), N] + preferred-affinity
-        #     profile score rows [max(S,1), N]
+        #     profile score rows [max(S,1), N] + NodePorts slots
+        #     [max(PT,1), N] + volume headroom [1, N] + ImageLocality rows
         affdom_ref, affcount0_ref, anticover0_ref, prefrows_ref,
+        portused0_ref, volfree0_ref, imgrows_ref,
         # --- outputs
         chosen_ref,                 # (UNROLL, 1) int32 block, one per step
         requested_ref,              # [R, N] (carried)
@@ -103,6 +111,8 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         qacc_ref,                   # [R, G] quota-used accumulator
         affcount_ref,               # [max(T,1), N] carried term counts
         anticover_ref,              # [max(T,1), N] carried anti carriers
+        portused_ref,               # [max(PT,1), N] carried port slots
+        volfree_ref,                # [1, N] carried volume headroom
         affexists_ref,              # SMEM [max(T,1)] carried exists flags
     ):
         i = pl.program_id(0)
@@ -131,6 +141,9 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
                 anticover_ref[:] = anticover0_ref[:]
                 for t in range(T):
                     affexists_ref[t] = affexists0_ref[t]
+            if PT:
+                portused_ref[:] = portused0_ref[:]
+            volfree_ref[:] = volfree0_ref[:]
 
         # read-only node state: load once per grid step
         lafeas_np = lafeas_np_ref[0, :]
@@ -162,6 +175,8 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         aff_dom = [affdom_ref[t:t + 1, :] for t in range(T)]         # [1, N]
         aff_count = [affcount_ref[t:t + 1, :] for t in range(T)]
         anti_cover = [anticover_ref[t:t + 1, :] for t in range(T)]
+        port_used = [portused_ref[s:s + 1, :] for s in range(PT)]
+        vol_free = volfree_ref[0, :]
 
         for j in range(UNROLL):
             p = i * UNROLL + j
@@ -231,8 +246,16 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
             # floor(mask / 2^g) mod 2
             taint_ok = jnp.remainder(
                 jnp.floor(taintmask_ref[p] / taintpow), 2.0) >= 1.0
+            # ---- Filter: NodePorts (wanted slot free) + CSI volume limit
+            vol_needed = volneeded_ref[p]
+            vol_ok = (vol_needed <= 0.0) | (vol_free >= vol_needed)
             feasible = (node_ok_row & fit & la_ok & cpuset_ok
-                        & numa_ok & taint_ok & admit)
+                        & numa_ok & taint_ok & vol_ok & admit)
+            for s in range(PT):
+                want_s = jnp.remainder(
+                    jnp.floor(portwants_ref[p] / float(1 << s)), 2.0) >= 1.0
+                feasible = feasible & (
+                    (~want_s) | (port_used[s][0, :] <= 0))
             # ---- Filter: InterPodAffinity (ops/podaffinity.py). Term
             # membership rides per-pod SMEM bitmasks; 2^t is a static
             # Python constant, so the bit tests are scalar ops.
@@ -282,6 +305,12 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
                 for s in range(S):
                     score = score + jnp.where(
                         sid == s, prefrows_ref[s:s + 1, :][0, :], 0.0)
+            # ImageLocality: static profile rows, same select pattern
+            if SI:
+                iid = imgid_ref[p]
+                for s in range(SI):
+                    score = score + jnp.where(
+                        iid == s, imgrows_ref[s:s + 1, :][0, :], 0.0)
             # preferred POD affinity: weighted count sum, max-min normalized
             # per pod (weights read as SMEM scalars by traced profile id)
             if T and S2:
@@ -290,8 +319,10 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
                 raw = jnp.zeros((N,), jnp.float32)
                 for t in range(T):
                     raw = raw + pprefw_ref[s2c, t] * aff_count[t][0, :]
-                mx = jnp.max(raw)
-                mn = jnp.min(raw)
+                # max-min over node_ok only (upstream NormalizeScore spans
+                # the candidate set; padded rows must not anchor the scale)
+                mx = jnp.max(jnp.where(node_ok_row, raw, -jnp.inf))
+                mn = jnp.min(jnp.where(node_ok_row, raw, jnp.inf))
                 norm = jnp.where(
                     mx > mn,
                     jnp.floor((raw - mn) * 100.0 / (mx - mn)), 0.0)
@@ -309,6 +340,14 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
             if prod_mode:
                 headla_pr = headla_pr - jnp.where(prod, 1.0, 0.0) * est_add
             bindfree = bindfree - sel * jnp.where(needs_bind, cores, 0.0)
+            # ports/volumes: bind wanted slots, debit volume headroom
+            for s in range(PT):
+                want_s = jnp.remainder(
+                    jnp.floor(portwants_ref[p] / float(1 << s)), 2.0) >= 1.0
+                port_used[s] = jnp.maximum(
+                    port_used[s],
+                    (sel * jnp.where(want_s, 1.0, 0.0))[None, :])
+            vol_free = vol_free - sel * vol_needed
             # numa: single-zone subtract + lowest-zones-first waterfall
             # (disjoint). Only the SingleNUMANode policy pins a zone
             # (numa_admit_row returns zone = -1 otherwise); every other
@@ -361,6 +400,9 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         for t in range(T):
             affcount_ref[t:t + 1, :] = aff_count[t]
             anticover_ref[t:t + 1, :] = anti_cover[t]
+        for s in range(PT):
+            portused_ref[s:s + 1, :] = port_used[s]
+        volfree_ref[:] = vol_free[None, :]
 
         @pl.when(i == pl.num_programs(0) - 1)
         def _emit():
@@ -472,11 +514,13 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             affcount0 = jnp.zeros((1, N), jnp.float32)
             anticover0 = jnp.zeros((1, N), jnp.float32)
 
-        # preference-less batches carry one all-zero profile column; padded
-        # pods get pid -1 and match no profile row
+        # preference-less batches carry ZERO profile columns (snapshot emits
+        # true empties); the kernel skips the profile loops and the input
+        # slot gets one placeholder row
         S = fc.pref_scores.shape[1]
         S_eff = max(S, 1)
-        prefrows0 = f32(fc.pref_scores).T
+        prefrows0 = (f32(fc.pref_scores).T if S
+                     else jnp.zeros((1, N), jnp.float32))
         prefid_pad = jnp.pad(jnp.asarray(fc.pod_pref_id, jnp.int32), pad_p,
                              constant_values=-1)
         S2 = fc.ppref_w.shape[0] if T else 0  # zero rows == no profiles
@@ -485,7 +529,31 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
         pprefw0 = (f32(fc.ppref_w) if S2
                    else jnp.zeros((1, max(T, 1)), jnp.float32))
 
-        kernel = _make_kernel(weights, prod_mode, N, R, K, G_eff, T, S, S2)
+        # NodePorts slots as per-pod f32 bitmasks (PT <= 16 < 2^24, exact),
+        # node state transposed [PT, N]; volume headroom as one [1, N] row;
+        # ImageLocality rows like the preference profiles
+        PT = fc.port_used.shape[1]
+        PT_eff = max(PT, 1)
+        if PT:
+            pow_s = jnp.asarray(
+                [float(1 << s) for s in range(PT)], jnp.float32)
+            portwants_m = jnp.pad(jnp.sum(
+                f32(fc.pod_port_wants) * pow_s[None, :], axis=1), pad_p)
+            portused0 = f32(fc.port_used).T
+        else:
+            portwants_m = jnp.zeros(P_pad, jnp.float32)
+            portused0 = jnp.zeros((1, N), jnp.float32)
+        volneeded_pad = spad(fc.vol_needed)
+        volfree0 = f32(fc.vol_free)[None, :]
+        SI = fc.img_scores.shape[1]
+        SI_eff = max(SI, 1)
+        imgrows0 = (f32(fc.img_scores).T if SI
+                    else jnp.zeros((1, N), jnp.float32))
+        imgid_pad = jnp.pad(jnp.asarray(fc.pod_img_id, jnp.int32), pad_p,
+                            constant_values=-1)
+
+        kernel = _make_kernel(weights, prod_mode, N, R, K, G_eff, T, S, S2,
+                              PT, SI)
         grid_inputs = (
             spad(inputs.is_prod), spad(inputs.pod_valid),
             spad(inputs.is_daemonset), spad(gang_pod_ok),
@@ -495,6 +563,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             affreq_m, antireq_m, affmatch_m,
             skew0_m, skew1_m, skew2_m, affexists0,
             prefid_pad, pprefid_pad, pprefw0,
+            portwants_m, volneeded_pad, imgid_pad,
             qid_pad,
             pods_t(inputs.fit_requests), pods_t(fc.requests),
             pods_t(inputs.estimated),
@@ -507,6 +576,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             jnp.exp2(f32(fc.node_taint_group))[None, :],
             numa0, anc_pod, qused0, qruntime,
             affdom0, affcount0, anticover0, prefrows0,
+            portused0, volfree0, imgrows0,
         )
         smem, full = pc.smem_spec, pc.full_spec
         pod_spec = pc.pod_block_spec(R)
@@ -514,7 +584,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             kernel,
             grid=(P_pad // UNROLL,),
             in_specs=(
-                [smem()] * 20
+                [smem()] * 23
                 + [pod_spec] * 3
                 + [full((R, N))] * 4
                 + [full((1, N))] * 9
@@ -523,6 +593,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                    full((R, G_lane)), full((R, G_lane))]
                 + [full((T_eff, N))] * 3
                 + [full((S_eff, N))]
+                + [full((PT_eff, N)), full((1, N)), full((SI_eff, N))]
             ),
             out_specs=[
                 pc.chosen_block_spec(),
@@ -543,6 +614,8 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                 pltpu.VMEM((R, G_lane), jnp.float32),
                 pltpu.VMEM((T_eff, N), jnp.float32),
                 pltpu.VMEM((T_eff, N), jnp.float32),
+                pltpu.VMEM((PT_eff, N), jnp.float32),
+                pltpu.VMEM((1, N), jnp.float32),
                 pltpu.SMEM((T_eff,), jnp.float32),
             ],
             compiler_params=pltpu.CompilerParams(

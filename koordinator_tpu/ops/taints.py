@@ -41,17 +41,22 @@ def tolerates_taints(tolerations: Sequence[Tuple[str, str]],
     )
 
 
-def selector_pairs_of(pods) -> frozenset:
+def selector_pairs_of(pods, extra_pairs_by_key=None) -> frozenset:
     """The distinct (key, value) nodeSelector PAIRS the pending batch uses.
     Signatures are built from pair-match booleans, not raw label values, so
     a high-cardinality key (kubernetes.io/hostname) contributes one bit per
     PIN, not one signature per node: 5k hostnames with one pinned pod split
     the cluster into 2 groups (the pinned node, everyone else), where a
-    value-projection signature would fragment all 5k nodes."""
+    value-projection signature would fragment all 5k nodes.
+
+    extra_pairs_by_key: per-pod-key additional required pairs (e.g. the
+    VolumeZone filter's PV topology labels, scheduler/snapshot.py)."""
     pairs = set()
     for pod in pods:
         pairs.update(pod.spec.node_selector.items())
         pairs.update(pod.spec.affinity_required_node_labels.items())
+        if extra_pairs_by_key:
+            pairs.update(extra_pairs_by_key.get(pod.meta.key, ()))
     return frozenset(pairs)
 
 
@@ -146,14 +151,26 @@ def group_node_admission(
     return out, sigs
 
 
-def admission_mask(pod, groups: List[Tuple[frozenset, object]]) -> float:
+def degraded_node_count(group_ids, groups) -> int:
+    """Nodes whose admission signature was NOT exactly encoded: in a
+    label-unknown bucket (selector pods can't schedule there) or the
+    admit-nobody overflow group. Feeds the scheduler's degradation gauge."""
+    return sum(
+        1 for g in group_ids
+        if g >= len(groups) or groups[g][1] is _UNKNOWN
+    )
+
+
+def admission_mask(pod, groups: List[Tuple[frozenset, object]],
+                   extra_pairs: frozenset = frozenset()) -> float:
     """Bitmask (as an exact float32 integer) of the node groups this pod may
     land on: taints tolerated AND every nodeSelector pair in the group's
     matched set. Label-unknown buckets admit only selector-less pods; the
-    overflow group's bit is never set."""
+    overflow group's bit is never set. extra_pairs joins the pod's own
+    required set (VolumeZone)."""
     mask = 0
     tolerations = pod.spec.tolerations
-    selector = required_node_pairs(pod)
+    selector = required_node_pairs(pod) | extra_pairs
     for gid, (taints, matched) in enumerate(groups):
         if taints and not tolerates_taints(tolerations, taints):
             continue

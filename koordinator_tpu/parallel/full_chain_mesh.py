@@ -56,6 +56,9 @@ _FC_NODE_FIELDS = frozenset(
         "aff_count",
         "anti_cover",
         "pref_scores",
+        "port_used",
+        "vol_free",
+        "img_scores",
     }
 )
 

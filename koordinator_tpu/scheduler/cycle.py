@@ -40,6 +40,8 @@ from koordinator_tpu.client.store import (
     KIND_NODE,
     KIND_NODE_METRIC,
     KIND_POD,
+    KIND_PV,
+    KIND_PVC,
     KIND_RESERVATION,
     ObjectStore,
 )
@@ -222,6 +224,8 @@ class Scheduler:
             quotas=quota.quota_list() if quota else [],
             pod_groups=list(gang.pod_groups.values()) if gang else [],
             gang_assumed=dict(gang.assumed) if gang else {},
+            pvcs={c.meta.key: c for c in self.store.list(KIND_PVC)},
+            pvs={v.meta.name: v for v in self.store.list(KIND_PV)},
             now=now,
         )
 
@@ -439,7 +443,14 @@ class Scheduler:
             node_idx = int(chosen[i])
             pod = by_key[key]
             if node_idx < 0:
-                if pod.gang_name or pod.quota_name:
+                # encoding-budget overflows carry their own first-class
+                # reason (surfaced via the error-handler event trail and
+                # the overflow metric) and never enter preemption — no
+                # victim set can fix an encoding cut
+                reason = pods.unschedulable_reasons.get(i)
+                if reason is not None:
+                    failed_pods.append((pod, reason))
+                elif pod.gang_name or pod.quota_name:
                     rejected_pods.append(pod)
                 else:
                     failed_pods.append((pod, "no feasible node"))

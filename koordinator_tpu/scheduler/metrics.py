@@ -1,0 +1,28 @@
+"""Scheduler metrics registry (analog of reference pkg/scheduler/metrics/).
+
+Reuses the shared Prometheus-style Registry (koordlet/metrics.py) the way
+every reference binary reuses client_golang. The encoding-overflow signals
+make conservative batch-encoding cuts (affinity-term / hostPort-slot
+budgets, admission-signature degradation) first-class observables instead
+of log lines: the reference surfaces every filter failure in pod status
+and scheduler metrics, so an operator can see WHY a pod is pending."""
+
+from __future__ import annotations
+
+from koordinator_tpu.koordlet.metrics import Registry
+
+REGISTRY = Registry()
+
+# pods marked unschedulable this round because an encoding budget
+# overflowed; kind = affinity_terms | port_slots
+ENCODING_OVERFLOW_PODS = REGISTRY.counter(
+    "koord_scheduler_encoding_overflow_unschedulable_total",
+    "Pods marked unschedulable by a batch-encoding budget overflow",
+)
+
+# nodes degraded to their label-unknown admission bucket in the last
+# snapshot (selector-carrying pods cannot schedule there)
+ADMISSION_DEGRADED_NODES = REGISTRY.gauge(
+    "koord_scheduler_admission_signature_degraded_nodes",
+    "Nodes in a label-unknown admission bucket in the last snapshot",
+)

@@ -223,7 +223,14 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
     pref_scores = np.asarray(fc.pref_scores, np.float32)
     pod_ppref_id = np.asarray(fc.pod_ppref_id)
     ppref_w = np.asarray(fc.ppref_w, np.float32)
+    pod_port_wants = np.asarray(fc.pod_port_wants)
+    port_used = np.array(fc.port_used, np.float32)
+    vol_needed = np.asarray(fc.vol_needed, np.float32)
+    vol_free = np.array(fc.vol_free, np.float32)
+    pod_img_id = np.asarray(fc.pod_img_id)
+    img_scores = np.asarray(fc.img_scores, np.float32)
     T = aff_dom.shape[1]
+    PT = port_used.shape[1]
 
     P, R = fit_requests.shape
     N, K, _ = numa_free.shape
@@ -284,7 +291,11 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
             w_row = ppref_w[pod_ppref_id[p], :T]
             raw = (aff_count[:, :T] * w_row[None, :]).sum(axis=1,
                                                           dtype=np.float32)
-            mx, mn = raw.max(), raw.min()
+            # max-min over node_ok only (upstream NormalizeScore spans the
+            # candidate set; padded rows must not anchor the scale)
+            ok_raw = raw[node_ok]
+            mx = ok_raw.max() if ok_raw.size else np.float32(0.0)
+            mn = ok_raw.min() if ok_raw.size else np.float32(0.0)
             if mx > mn:
                 ppref_norm = np.floor(
                     (raw - mn) * np.float32(100.0) / np.float32(mx - mn))
@@ -345,6 +356,15 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
                         break
             if not affinity_ok:
                 continue
+            # NodePorts: no wanted hostPort slot already bound on the node
+            if PT and any(
+                pod_port_wants[p, s] and port_used[n, s] > 0
+                for s in range(PT)
+            ):
+                continue
+            # CSI volume limit (+inf when the node reports none)
+            if vol_needed[p] > 0 and vol_free[n] < vol_needed[p]:
+                continue
             # cpuset filter
             if needs_bind[p]:
                 if not has_topology[n]:
@@ -397,6 +417,8 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
                 s = s + pref_scores[n, pod_pref_id[p]]
             if ppref_norm is not None:
                 s = s + ppref_norm[n]
+            if pod_img_id[p] >= 0:
+                s = s + img_scores[n, pod_img_id[p]]
             if s > best_score:
                 best_n, best_score, best_zone = n, s, zone
         if best_n < 0:
@@ -417,6 +439,11 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
                     remaining -= take
         if needs_bind[p]:
             bind_free[best_n] -= cores_needed[p]
+        for s in range(PT):
+            if pod_port_wants[p, s]:
+                port_used[best_n, s] = 1.0
+        if vol_needed[p] > 0:
+            vol_free[best_n] -= vol_needed[p]
         if quota_id[p] >= 0:
             for g in ancestors[quota_id[p]]:
                 if g >= 0:

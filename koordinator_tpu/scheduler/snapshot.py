@@ -33,8 +33,13 @@ from koordinator_tpu.ops.numa import MAX_NUMA, POLICY_BY_NAME, POLICY_NONE
 from koordinator_tpu.ops.packing import NodeBatch, PodBatch, pack_nodes, pack_pods
 from koordinator_tpu.ops.taints import (
     admission_mask,
+    degraded_node_count,
     group_node_admission,
     selector_pairs_of,
+)
+from koordinator_tpu.scheduler.metrics import (
+    ADMISSION_DEGRADED_NODES,
+    ENCODING_OVERFLOW_PODS,
 )
 from koordinator_tpu.ops.quota import (
     MAX_QUOTA_DEPTH,
@@ -120,8 +125,32 @@ class ClusterState:
     quotas: List[ElasticQuota] = field(default_factory=list)
     pod_groups: List[PodGroup] = field(default_factory=list)
     gang_assumed: Dict[str, int] = field(default_factory=dict)
+    # VolumeZone/volume-limit inputs: PVCs by "namespace/name" key, PVs by
+    # volume name (both optional — empty means no volume constraints)
+    pvcs: Dict[str, object] = field(default_factory=dict)
+    pvs: Dict[str, object] = field(default_factory=dict)
     cluster_total: Optional[np.ndarray] = None
     now: float = 0.0
+
+
+def volume_zone_pairs(pod: Pod, pvcs: Dict[str, object],
+                      pvs: Dict[str, object]):
+    """VolumeZone filter folded into the admission-signature machinery: a
+    pod mounting a claim whose bound PV carries zone/region topology labels
+    may only land on nodes carrying the same labels — exactly a
+    nodeSelector pair, so it rides the existing (taints x selector) group
+    bitmask with no new kernel state. Unbound claims contribute nothing
+    (upstream VolumeZone skips them; volume binding is out of scope)."""
+    pairs = []
+    for claim in pod.spec.pvc_names:
+        pvc = pvcs.get(f"{pod.meta.namespace}/{claim}")
+        if pvc is None or not getattr(pvc, "volume_name", ""):
+            continue
+        pv = pvs.get(pvc.volume_name)
+        if pv is None:
+            continue
+        pairs.extend(pv.zone_pairs())
+    return frozenset(pairs)
 
 
 def _pod_cpuset_flags(pod: Pod, default_policy: str = FULL_PCPUS) -> Tuple[bool, float, bool]:
@@ -228,15 +257,30 @@ def build_full_chain_inputs(
     # selector pairs) signatures -> group ids, pod tolerations +
     # nodeSelector -> group bitmasks. This is how TaintToleration AND
     # NodeAffinity (nodeSelector) batch into one bit test.
-    sel_pairs = selector_pairs_of(pods_by_key_pending.values())
+    # VolumeZone: PV topology labels become per-pod required pairs riding
+    # the admission bitmask (no new kernel state)
+    zone_pairs_by_key = {}
+    if state.pvcs:
+        for key, pod in pods_by_key_pending.items():
+            zp = volume_zone_pairs(pod, state.pvcs, state.pvs)
+            if zp:
+                zone_pairs_by_key[key] = zp
+    sel_pairs = selector_pairs_of(pods_by_key_pending.values(),
+                                  zone_pairs_by_key)
     node_taint_ids, admission_groups = group_node_admission(
         state.nodes, sel_pairs)
+    ADMISSION_DEGRADED_NODES.set(
+        float(degraded_node_count(node_taint_ids, admission_groups)))
+    vol_needed = np.zeros(P, np.float32)
     for i, key in enumerate(pods.keys):
         pod = pods_by_key_pending[key]
         nb, cn, fp = _pod_cpuset_flags(pod)
         needs_bind[i], cores_needed[i], full_pcpus[i] = nb, cn, fp
         needs_numa[i] = bool(pod.spec.requests)
-        pod_taint_mask[i] = admission_mask(pod, admission_groups)
+        pod_taint_mask[i] = admission_mask(
+            pod, admission_groups,
+            zone_pairs_by_key.get(key, frozenset()))
+        vol_needed[i] = len(set(pod.spec.pvc_names))
         q = pod.quota_name
         if q:  # quota ids resolve only after the tree exists
             pods.quota_id[i] = quota_ids.get(q, -1)
@@ -319,6 +363,9 @@ def build_full_chain_inputs(
     pod_spread_skew[: spread_v.shape[0]] = spread_v
     for i in aff_overflow:  # conservative: term encoding overflow
         pods.valid[i] = False
+        pods.unschedulable_reasons[i] = (
+            "(anti-)affinity term budget exceeded for this round")
+        ENCODING_OVERFLOW_PODS.inc(kind="affinity_terms")
 
     # preferred node affinity (soft scoring), profile-bucketed
     from koordinator_tpu.ops.podaffinity import (
@@ -328,8 +375,11 @@ def build_full_chain_inputs(
 
     pref_rows_v, pref_id_v = build_preferred_scores(
         ordered_pending, state.nodes)
-    pref_scores = np.zeros((N, pref_rows_v.shape[0]), np.float32)
-    pref_scores[: pref_rows_v.shape[1]] = pref_rows_v.T
+    # TRUE zero columns when no pod carries a preference: the kernels gate
+    # profile work on the column count, so empty batches pay nothing
+    n_pref = pref_rows_v.shape[0] if (pref_id_v >= 0).any() else 0
+    pref_scores = np.zeros((N, n_pref), np.float32)
+    pref_scores[: pref_rows_v.shape[1], :] = pref_rows_v[:n_pref].T
     pod_pref_id = np.full(P, -1, np.int32)
     pod_pref_id[: pref_id_v.shape[0]] = pref_id_v
 
@@ -340,6 +390,39 @@ def build_full_chain_inputs(
     pod_ppref_id[: ppref_id_v.shape[0]] = ppref_id_v
     pod_ppref_mask = np.zeros((P, T), bool)
     pod_ppref_mask[: ppref_mask_v.shape[0]] = ppref_mask_v[:, :T]
+
+    # NodePorts factorization + CSI volume-limit counts + ImageLocality
+    # profiles (ops/ports.py)
+    from koordinator_tpu.ops.ports import build_image_scores, build_port_state
+
+    _slots, used_v, wants_v, port_overflow = build_port_state(
+        ordered_pending, state.nodes, existing)
+    PT = used_v.shape[1]
+    port_used = np.zeros((N, PT), np.float32)
+    port_used[: used_v.shape[0]] = used_v
+    pod_port_wants = np.zeros((P, PT), bool)
+    pod_port_wants[: wants_v.shape[0]] = wants_v
+    for i in port_overflow:  # conservative: slot encoding overflow
+        pods.valid[i] = False
+        pods.unschedulable_reasons[i] = (
+            "hostPort slot budget exceeded for this round")
+        ENCODING_OVERFLOW_PODS.inc(kind="port_slots")
+    vol_free = np.full(N, np.inf, np.float32)
+    attached: Dict[str, set] = {}
+    for pod in existing:
+        if pod.spec.pvc_names:
+            attached.setdefault(pod.spec.node_name, set()).update(
+                f"{pod.meta.namespace}/{c}" for c in pod.spec.pvc_names)
+    for i, node in enumerate(state.nodes):
+        if node.attachable_volume_limit > 0:
+            vol_free[i] = node.attachable_volume_limit - len(
+                attached.get(node.meta.name, ()))
+    img_rows_v, img_id_v = build_image_scores(ordered_pending, state.nodes)
+    n_img = img_rows_v.shape[0] if (img_id_v >= 0).any() else 0
+    img_scores = np.zeros((N, n_img), np.float32)
+    img_scores[: img_rows_v.shape[1], :] = img_rows_v[:n_img].T
+    pod_img_id = np.full(P, -1, np.int32)
+    pod_img_id[: img_id_v.shape[0]] = img_id_v
 
     base = make_inputs(pods, nodes, args)
     G = max(1, len(tree.names))
@@ -362,6 +445,12 @@ def build_full_chain_inputs(
         pod_ppref_id=np.asarray(pod_ppref_id),
         pod_ppref_mask=np.asarray(pod_ppref_mask),
         ppref_w=np.asarray(ppref_w),
+        pod_port_wants=np.asarray(pod_port_wants),
+        vol_needed=np.asarray(vol_needed),
+        pod_img_id=np.asarray(pod_img_id),
+        port_used=np.asarray(port_used),
+        vol_free=np.asarray(vol_free),
+        img_scores=np.asarray(img_scores),
         node_taint_group=np.asarray(node_taint_group),
         aff_dom=np.asarray(aff_dom),
         aff_count=np.asarray(aff_count),

@@ -1,0 +1,84 @@
+"""Encoding-budget overflows are first-class signals: a scheduler metric
+rises and the cycle surfaces a specific failure reason (the analog of the
+reference surfacing filter failures in pod status), instead of a pod
+sitting pending with only a log line to explain it."""
+
+import numpy as np
+
+from koordinator_tpu.api.objects import (
+    Node,
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    PodSpec,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client.store import KIND_NODE, KIND_POD, ObjectStore
+from koordinator_tpu.ops.loadaware import LoadAwareArgs
+from koordinator_tpu.ops.podaffinity import MAX_TERMS
+from koordinator_tpu.scheduler.cycle import Scheduler
+from koordinator_tpu.scheduler.metrics import (
+    ADMISSION_DEGRADED_NODES,
+    ENCODING_OVERFLOW_PODS,
+)
+from koordinator_tpu.scheduler.snapshot import build_full_chain_inputs
+from koordinator_tpu.testing import synth_full_cluster
+
+HOST_KEY = "kubernetes.io/hostname"
+
+
+def test_affinity_overflow_increments_metric_and_reason():
+    args = LoadAwareArgs()
+    cluster, state = synth_full_cluster(10, MAX_TERMS + 5, seed=9)
+    for node in state.nodes:
+        node.meta.labels[HOST_KEY] = node.meta.name
+    for i, pod in enumerate(state.pending_pods):
+        pod.spec.pod_anti_affinity.append(PodAffinityTerm(
+            selector={"uniq": f"u{i}"}, topology_key=HOST_KEY))
+    before = ENCODING_OVERFLOW_PODS.get(kind="affinity_terms") or 0.0
+    fc, pods, nodes, tree, gi, ng, ngroups = build_full_chain_inputs(
+        state, args)
+    after = ENCODING_OVERFLOW_PODS.get(kind="affinity_terms") or 0.0
+    assert after - before >= 5
+    assert len(pods.unschedulable_reasons) >= 5
+    assert all("affinity term budget" in r
+               for r in pods.unschedulable_reasons.values())
+
+
+def test_cycle_reports_overflow_reason_not_no_feasible_node():
+    GIB = 1024**3
+    store = ObjectStore()
+    for i in range(3):
+        node = Node(meta=ObjectMeta(name=f"n{i}", namespace=""),
+                    allocatable=ResourceList.of(cpu=32000, memory=64 * GIB,
+                                                pods=200))
+        node.meta.labels[HOST_KEY] = f"n{i}"
+        store.add(KIND_NODE, node)
+    for i in range(MAX_TERMS + 3):
+        pod = Pod(meta=ObjectMeta(name=f"p{i}", uid=f"p{i}",
+                                  creation_timestamp=float(i)),
+                  spec=PodSpec(requests=ResourceList.of(cpu=100,
+                                                        memory=GIB // 8)))
+        pod.spec.pod_anti_affinity.append(PodAffinityTerm(
+            selector={"uniq": f"u{i}"}, topology_key=HOST_KEY))
+        store.add(KIND_POD, pod)
+    sched = Scheduler(store)
+    result = sched.run_cycle(now=1_000_000.0)
+    # the overflowed pods carry the SPECIFIC reason in the failure trail
+    reasons = [r for _k, r in sched.extender.error_handlers.failures]
+    assert any("affinity term budget" in r for r in reasons)
+    # and no victims were drained for them (encoding cuts skip preemption)
+    assert result.preempted_victims == []
+
+
+def test_admission_degradation_gauge():
+    args = LoadAwareArgs()
+    n_nodes = 30
+    cluster, state = synth_full_cluster(n_nodes, n_nodes, seed=3)
+    for i, node in enumerate(state.nodes):
+        node.meta.labels[HOST_KEY] = node.meta.name
+    for i, pod in enumerate(state.pending_pods):
+        pod.spec.node_selector[HOST_KEY] = state.nodes[
+            i % n_nodes].meta.name
+    build_full_chain_inputs(state, args)
+    assert (ADMISSION_DEGRADED_NODES.get() or 0.0) > 0

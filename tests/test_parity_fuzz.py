@@ -40,17 +40,32 @@ def _mixed_fixture(seed: int):
         if rng.random() < 0.1:
             node.meta.annotations[ANNOTATION_NODE_RESERVATION] = json.dumps(
                 {"resources": {"cpu": "1", "memory": "1Gi"}})
+    MB = 1024 * 1024
+    for j, node in enumerate(state.nodes):
+        if rng.random() < 0.2:
+            node.attachable_volume_limit = rng.choice([2, 4])
+        if rng.random() < 0.4:
+            node.images["registry/web:v2"] = 300 * MB
     apps = ["web", "db", "cache"]
     # existing assigned pods with anti terms exercise SYMMETRIC
-    # anti-affinity (their domains must repel matching incoming pods)
+    # anti-affinity (their domains must repel matching incoming pods);
+    # existing hostPorts seed the NodePorts state
     for pod in state.pods_by_key.values():
         if pod.is_assigned and not pod.is_terminated and rng.random() < 0.1:
             pod.spec.pod_anti_affinity.append(PodAffinityTerm(
                 selector={"app": rng.choice(apps)}, topology_key=ZONE))
+        if pod.is_assigned and not pod.is_terminated and rng.random() < 0.1:
+            pod.spec.host_ports.append(("TCP", rng.choice([80, 443, 8080])))
     for i, pod in enumerate(state.pending_pods):
         r = rng.random()
         app = rng.choice(apps)
         pod.meta.labels["app"] = app
+        if rng.random() < 0.15:
+            pod.spec.host_ports.append(("TCP", rng.choice([80, 443, 8080])))
+        if rng.random() < 0.15:
+            pod.spec.pvc_names = [f"claim-{i}"]
+        if rng.random() < 0.2:
+            pod.spec.images = ["registry/web:v2"]
         if r < 0.15:
             pod.spec.node_selector["pool"] = rng.choice(["gold", "silver"])
         elif r < 0.3:
