@@ -95,10 +95,13 @@ def main() -> None:
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument(
         "--chain",
-        choices=["full", "loadaware", "rebalance"],
+        choices=["full", "loadaware", "numa", "quota-gang", "rebalance"],
         default="full",
         help="full = Fit+LoadAware+NUMA+quota+gang (BASELINE config 4); "
-        "loadaware = config 1 kernel; rebalance = config 5, the "
+        "loadaware = config 1 kernel; numa = config 2 standalone "
+        "(NodeNUMAResource Filter+Score, 1k pods x 500 2-socket nodes); "
+        "quota-gang = config 3 standalone (ElasticQuota+Coscheduling, "
+        "5k pods, 200 PodGroups, 3-level tree); rebalance = config 5, the "
         "koord-descheduler LowNodeLoad 50k-running-pod global rebalance",
     )
     ap.add_argument(
@@ -131,6 +134,22 @@ def main() -> None:
             args_cli,
             args_cli.pods or (500 if args_cli.smoke else 50_000),
             num_nodes,
+        )
+        return
+    if args_cli.chain == "numa":
+        run_full_chain(
+            args_cli,
+            args_cli.pods or (100 if args_cli.smoke else 1_000),
+            args_cli.nodes or (20 if args_cli.smoke else 500),
+            variant="numa",
+        )
+        return
+    if args_cli.chain == "quota-gang":
+        run_full_chain(
+            args_cli,
+            args_cli.pods or (250 if args_cli.smoke else 5_000),
+            args_cli.nodes or (50 if args_cli.smoke else 1_000),
+            variant="quota-gang",
         )
         return
     if args_cli.chain == "full":
@@ -296,6 +315,7 @@ def run_rebalance(args_cli, num_pods: int, num_nodes: int) -> None:
     iters = 2 if args_cli.smoke else max(3, args_cli.iters // 4)
     times = []
     jobs_created = 0
+    jobs = []
     for it in range(iters):
         # fresh job space so every pass does full selection work
         for job in store.list(KIND_POD_MIGRATION_JOB):
@@ -314,13 +334,81 @@ def run_rebalance(args_cli, num_pods: int, num_nodes: int) -> None:
         pps = 0.0
     log(f"rebalance pass: median {t_pass:.3f}s over {iters} iters "
         f"({jobs_created} migration jobs) -> {pps:,.0f} pods considered/s")
+
+    # ---- compiled serial floor: per-node/per-pod C++ transcription of the
+    # same classify/sort/select pass, with victim-set parity
+    from koordinator_tpu.descheduler.lownodeload import _has_pdb_like_guard
+    from koordinator_tpu.native import floor as native_floor
+
+    compiled_pps = 0.0
+    parity_ok = True
+    if not native_floor.available():
+        native_floor.build()
+    if native_floor.available():
+        nodes_l = store.list(KIND_NODE)
+        node_idx = {n.meta.name: i for i, n in enumerate(nodes_l)}
+        N = len(nodes_l)
+        alloc = np.stack([n.allocatable.to_vector() for n in nodes_l])
+        usage_pct = np.zeros_like(alloc, np.float32)
+        has_metric = np.zeros(N, np.int32)
+        for i, node in enumerate(nodes_l):
+            nm = store.get(KIND_NODE_METRIC, f"/{node.meta.name}")
+            if nm is None or nm.update_time <= 0:
+                continue
+            if now - nm.update_time >= plugin.args.node_metric_expiration_seconds:
+                continue
+            a = alloc[i]
+            u = nm.node_metric.node_usage.to_vector()
+            usage_pct[i] = np.where(a > 0, u * 100.0 / np.maximum(a, 1e-9), 0.0)
+            has_metric[i] = 1
+        pods_l = [p for p in store.list(KIND_POD)
+                  if p.is_assigned and not p.is_terminated]
+        pod_node = np.asarray(
+            [node_idx.get(p.spec.node_name, -1) for p in pods_l], np.int32)
+        pod_prio = np.asarray(
+            [p.spec.priority or 0 for p in pods_l], np.int32)
+        pod_req = np.stack([p.spec.requests.to_vector() for p in pods_l])
+        movable = np.asarray(
+            [p.meta.owner_kind != "DaemonSet" and not _has_pdb_like_guard(p)
+             for p in pods_l], np.int32)
+        from koordinator_tpu.api.resources import RESOURCE_INDEX, ResourceName
+
+        pod_sort_cpu = pod_req[:, RESOURCE_INDEX[ResourceName.CPU]]
+        low_thr = plugin._thr_vec(plugin.args.low_thresholds)
+        high_thr = plugin._thr_vec(plugin.args.high_thresholds)
+        floor_times = []
+        victim = None
+        for _ in range(1 if args_cli.smoke else 3):
+            t0 = time.perf_counter()
+            victim = native_floor.lownodeload_floor_native(
+                alloc, usage_pct, has_metric, low_thr, high_thr,
+                pod_node, pod_prio, pod_req, movable, pod_sort_cpu,
+                plugin.args.max_pods_to_evict_per_node)
+            floor_times.append(time.perf_counter() - t0)
+        t_floor = float(np.median(floor_times))
+        compiled_pps = num_pods / t_floor if t_floor > 0 else 0.0
+        floor_victims = {
+            f"{pods_l[i].meta.namespace}/{pods_l[i].meta.name}"
+            for i in np.nonzero(victim)[0]
+        }
+        plugin_victims = {f"{j.pod_namespace}/{j.pod_name}" for j in jobs}
+        parity_ok = floor_victims == plugin_victims
+        log(f"compiled serial floor (C++ -O2): median {t_floor:.3f}s -> "
+            f"{compiled_pps:,.0f} pods/s; victim-set parity "
+            f"{'OK' if parity_ok else 'MISMATCH'} "
+            f"({len(floor_victims)} vs {len(plugin_victims)} victims)")
+    else:
+        log("compiled serial floor: libkoordfloor.so unavailable")
+    ratio = pps / compiled_pps if compiled_pps > 0 else 0.0
     print(
         json.dumps(
             {
                 "metric": f"rebalance_pods_per_sec_{num_pods}x{num_nodes}",
                 "value": round(pps, 1),
                 "unit": "pods/s",
-                "vs_baseline": 0.0,  # no serial floor for config 5
+                "vs_baseline": round(ratio, 2),
+                "vs_compiled_floor": round(ratio, 2),
+                "parity_ok": parity_ok,
                 "migration_jobs": jobs_created,
                 "p50_ms": round(t_pass * 1000, 2),
                 "platform": jax.default_backend(),
@@ -329,7 +417,8 @@ def run_rebalance(args_cli, num_pods: int, num_nodes: int) -> None:
     )
 
 
-def run_full_chain(args_cli, num_pods: int, num_nodes: int) -> None:
+def run_full_chain(args_cli, num_pods: int, num_nodes: int,
+                   variant: str = "full") -> None:
     import jax
 
     from koordinator_tpu.models.full_chain import build_best_full_chain_step
@@ -340,17 +429,32 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int) -> None:
 
     la = LoadAwareArgs()
     log(f"devices: {jax.devices()}")
-    log(
-        f"config: {num_pods} pending pods x {num_nodes} nodes "
-        f"(full chain: Fit+LoadAware+NUMA+quota+gang)"
-    )
+    # BASELINE measurement-plan fixtures: config 2 isolates the
+    # NodeNUMAResource Filter+Score (every node reports a 2-socket
+    # topology, no quotas/gangs, more LSR cpuset pods); config 3 isolates
+    # ElasticQuota+Coscheduling (200 PodGroups, 3-level tree)
+    if variant == "numa":
+        synth_kwargs = dict(num_quotas=0, num_gangs=0,
+                            topology_fraction=1.0, lsr_fraction=0.35)
+        desc = "NodeNUMAResource standalone (BASELINE config 2)"
+    elif variant == "quota-gang":
+        synth_kwargs = dict(
+            num_quotas=max(8, min(30, num_pods // 100)),
+            num_gangs=min(200, max(4, num_pods // 25)),
+            topology_fraction=0.0, lsr_fraction=0.0,
+        )
+        desc = "ElasticQuota+Coscheduling standalone (BASELINE config 3)"
+    else:
+        synth_kwargs = dict(num_quotas=max(8, num_pods // 100),
+                            num_gangs=max(4, num_pods // 50))
+        desc = "full chain: Fit+LoadAware+NUMA+quota+gang"
+    log(f"config: {num_pods} pending pods x {num_nodes} nodes ({desc})")
     t0 = time.perf_counter()
     cluster, state = synth_full_cluster(
         num_nodes,
         num_pods,
         seed=42,
-        num_quotas=max(8, num_pods // 100),
-        num_gangs=max(4, num_pods // 50),
+        **synth_kwargs,
     )
     t_synth = time.perf_counter() - t0
     log(f"synth fixture: {t_synth:.3f}s (not framework cost)")
@@ -479,10 +583,12 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int) -> None:
 
     vs_compiled = tpu_pps / compiled_pps if compiled_pps > 0 else 0.0
     vs_python = tpu_pps / python_pps if python_pps > 0 else 0.0
+    suffix = {"numa": "numa", "quota-gang": "quota_gang"}.get(
+        variant, "full_chain")
     print(
         json.dumps(
             {
-                "metric": f"pods_scheduled_per_sec_{num_pods}x{num_nodes}_full_chain",
+                "metric": f"pods_scheduled_per_sec_{num_pods}x{num_nodes}_{suffix}",
                 "value": round(tpu_pps, 1),
                 "unit": "pods/s",
                 "vs_baseline": round(vs_compiled, 2),

@@ -108,54 +108,91 @@ class LowNodeLoad:
         if not is_high.any() or not is_low.any():
             return []
 
-        low_names = {nodes[i].meta.name for i in np.nonzero(is_low)[0]}
-        jobs: List[PodMigrationJob] = []
-        pods_by_node: Dict[str, List[Pod]] = {}
+        # ---- victim selection, vectorized: one lexsort over (node,
+        # priority asc, cpu desc) + per-segment exclusive cumsum of freed
+        # requests replaces the reference's per-node Go loops. The greedy
+        # serial rule "take sorted candidates while the node stays over any
+        # checked high threshold, capped per node" becomes: candidate k is
+        # selected iff rank < cap AND every earlier candidate in its
+        # segment kept the node over (prefix-AND via a cumsum-of-failures
+        # == 0 test). Identical victim sets to the serial pass
+        # (bench.py --chain rebalance diffs them against the C++ floor).
+        target_pct = self._thr_vec(self.args.high_thresholds)
+        # per-node over-gate (max(usage - thr, 0).any()), hoisted once
+        over_gate = (usage_pct - target_pct[None, :] > 0).any(axis=1)
+        eligible = {
+            nodes[i].meta.name: i
+            for i in np.nonzero(is_high & over_gate)[0]
+        }
+        cand_pods: List[Pod] = []
+        cand_node: List[int] = []
         for pod in self.store.list(KIND_POD):
-            if pod.is_assigned and not pod.is_terminated:
-                pods_by_node.setdefault(pod.spec.node_name, []).append(pod)
-
-        for i in np.nonzero(is_high)[0]:
-            node = nodes[i]
-            target_pct = self._thr_vec(self.args.high_thresholds)
-            over = np.maximum(usage_pct[i] - target_pct, 0.0)
-            if not (over > 0).any():
+            i = eligible.get(pod.spec.node_name)
+            if i is None or not pod.is_assigned or pod.is_terminated:
                 continue
-            movable = [
-                p for p in pods_by_node.get(node.meta.name, [])
-                if p.meta.owner_kind != "DaemonSet" and not _has_pdb_like_guard(p)
-            ]
-            # evict highest-usage BE/low-priority pods first (sorter analog)
-            movable.sort(key=lambda p: (p.spec.priority or 0, -(
-                p.spec.requests[ResourceName.CPU])))
-            alloc = node.allocatable.to_vector()
-            freed = np.zeros(NUM_RESOURCES, np.float32)
-            count = 0
-            for pod in movable:
-                if count >= self.args.max_pods_to_evict_per_node:
-                    break
-                still_over = (
-                    usage_pct[i]
-                    - (freed * 100.0 / np.maximum(alloc, 1e-9))
-                    > target_pct
-                )
-                if not (still_over & (target_pct > 0)).any():
-                    break
-                job = PodMigrationJob(
-                    meta=ObjectMeta(
-                        name=f"migrate-{pod.meta.namespace}-{pod.meta.name}",
-                        namespace="koordinator-system",
-                        creation_timestamp=now,
-                    ),
-                    pod_namespace=pod.meta.namespace,
-                    pod_name=pod.meta.name,
-                    mode="ReservationFirst",
-                )
-                if self.store.get(KIND_POD_MIGRATION_JOB, job.meta.key) is None:
-                    self.store.add(KIND_POD_MIGRATION_JOB, job)
-                    jobs.append(job)
-                freed += pod.spec.requests.to_vector()
-                count += 1
+            if pod.meta.owner_kind == "DaemonSet" or _has_pdb_like_guard(pod):
+                continue
+            cand_pods.append(pod)
+            cand_node.append(i)
+        jobs: List[PodMigrationJob] = []
+        if not cand_pods:
+            return jobs
+        C = len(cand_pods)
+        node_arr = np.asarray(cand_node, np.int64)
+        prio = np.asarray([p.spec.priority or 0 for p in cand_pods], np.int64)
+        cpu = np.asarray(
+            [p.spec.requests[ResourceName.CPU] for p in cand_pods],
+            np.float32)
+        reqs = np.stack([p.spec.requests.to_vector() for p in cand_pods])
+        order = np.lexsort((-cpu, prio, node_arr))  # node, prio asc, cpu desc
+        node_s = node_arr[order]
+        reqs_s = np.asarray(reqs[order], np.float32)
+        seg_start = np.zeros(C, bool)
+        seg_start[0] = True
+        seg_start[1:] = node_s[1:] != node_s[:-1]
+        starts = np.nonzero(seg_start)[0]
+        seg_id = np.cumsum(seg_start) - 1
+        # exclusive freed-requests prefix PER SEGMENT, as sequential f32
+        # adds: a global cumsum minus segment offsets re-associates the
+        # float32 sums and drifts from the serial accumulation right at the
+        # still_over threshold (victim-set parity vs the C++ floor breaks)
+        freed_excl = np.zeros_like(reqs_s)
+        bounds = np.append(starts, C)
+        for j in range(len(starts)):
+            s0, s1 = bounds[j], bounds[j + 1]
+            if s1 - s0 > 1:
+                freed_excl[s0 + 1:s1] = np.cumsum(
+                    reqs_s[s0:s1 - 1], axis=0, dtype=np.float32)
+        # rank within segment
+        rank = np.arange(C) - starts[seg_id]
+        alloc_s = np.stack([nodes[i].allocatable.to_vector()
+                            for i in node_s]).astype(np.float32)
+        checked = target_pct > 0
+        still_over = (
+            (usage_pct[node_s] - freed_excl * 100.0 / np.maximum(alloc_s, 1e-9)
+             > target_pct) & checked
+        ).any(axis=1)
+        # prefix rule: selected while EVERY candidate so far (inclusive)
+        # still saw the node over — cumsum of failures within the segment
+        fails = np.cumsum(~still_over)
+        prefix_ok = (fails - np.asarray(
+            [0, *np.asarray(fails)[starts[1:] - 1]])[seg_id]) == 0
+        selected = prefix_ok & (rank < self.args.max_pods_to_evict_per_node)
+        for k in np.nonzero(selected)[0]:
+            pod = cand_pods[order[k]]
+            job = PodMigrationJob(
+                meta=ObjectMeta(
+                    name=f"migrate-{pod.meta.namespace}-{pod.meta.name}",
+                    namespace="koordinator-system",
+                    creation_timestamp=now,
+                ),
+                pod_namespace=pod.meta.namespace,
+                pod_name=pod.meta.name,
+                mode="ReservationFirst",
+            )
+            if self.store.get(KIND_POD_MIGRATION_JOB, job.meta.key) is None:
+                self.store.add(KIND_POD_MIGRATION_JOB, job)
+                jobs.append(job)
         return jobs
 
 

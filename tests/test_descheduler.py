@@ -203,6 +203,65 @@ class TestMigrationEndToEnd:
         assert store.list(KIND_POD_MIGRATION_JOB)[0].phase == "Failed"
 
 
+def test_balance_victim_set_matches_compiled_floor_non_dyadic():
+    """The vectorized selection must pick the IDENTICAL victim set as the
+    serial C++ floor even with non-power-of-two requests, where a global
+    float32 cumsum would drift at the still_over threshold (per-segment
+    sequential accumulation is the contract)."""
+    import random
+
+    from koordinator_tpu.api.resources import RESOURCE_INDEX
+    from koordinator_tpu.native import floor as native_floor
+
+    if not (native_floor.available() or native_floor.build()):
+        return
+    rng = random.Random(5)
+    store = ObjectStore()
+    for i in range(40):
+        frac = 0.85 if i % 2 == 0 else 0.2
+        _node(store, f"n{i}", cores=32, usage_frac=frac)
+    for p in range(600):
+        _running_pod(
+            store, f"p{p}", f"n{p % 40}",
+            cpu=rng.choice([100, 300, 700, 1100, 1300]),
+            prio=rng.choice([100, 5500, 9000]))
+    plugin = LowNodeLoad(store)
+    jobs = plugin.balance(now=NOW)
+    assert jobs
+
+    nodes_l = store.list(KIND_NODE)
+    node_idx = {n.meta.name: i for i, n in enumerate(nodes_l)}
+    alloc = np.stack([n.allocatable.to_vector() for n in nodes_l])
+    usage_pct = np.zeros_like(alloc, np.float32)
+    has_metric = np.zeros(len(nodes_l), np.int32)
+    for i, node in enumerate(nodes_l):
+        nm = store.get(KIND_NODE_METRIC, f"/{node.meta.name}")
+        if nm is None:
+            continue
+        a = alloc[i]
+        u = nm.node_metric.node_usage.to_vector()
+        usage_pct[i] = np.where(a > 0, u * 100.0 / np.maximum(a, 1e-9), 0.0)
+        has_metric[i] = 1
+    pods_l = [p for p in store.list(KIND_POD)
+              if p.is_assigned and not p.is_terminated]
+    pod_req = np.stack([p.spec.requests.to_vector() for p in pods_l])
+    victim = native_floor.lownodeload_floor_native(
+        alloc, usage_pct, has_metric,
+        plugin._thr_vec(plugin.args.low_thresholds),
+        plugin._thr_vec(plugin.args.high_thresholds),
+        np.asarray([node_idx.get(p.spec.node_name, -1) for p in pods_l],
+                   np.int32),
+        np.asarray([p.spec.priority or 0 for p in pods_l], np.int32),
+        pod_req,
+        np.ones(len(pods_l), np.int32),
+        pod_req[:, RESOURCE_INDEX[ResourceName.CPU]],
+        plugin.args.max_pods_to_evict_per_node)
+    floor_victims = {f"{pods_l[i].meta.namespace}/{pods_l[i].meta.name}"
+                     for i in np.nonzero(victim)[0]}
+    plugin_victims = {f"{j.pod_namespace}/{j.pod_name}" for j in jobs}
+    assert floor_victims == plugin_victims
+
+
 def test_eviction_cost_orders_and_opts_out():
     """scheduling.koordinator.sh/eviction-cost: cheaper pods migrate first;
     int32-max opts the pod out of migration entirely."""
