@@ -1,0 +1,172 @@
+"""Docker backend path of koord-runtime-proxy: kubelet(dockershim)-shaped
+HTTP client -> DockerProxyServer (UDS) -> hook chain -> FakeDockerDaemon,
+mirroring the reference pkg/runtimeproxy/server/docker/ capability the CRI
+path already covers over gRPC."""
+
+import json
+
+import pytest
+
+from koordinator_tpu.runtimeproxy import api_pb2
+from koordinator_tpu.runtimeproxy.dockerserver import (
+    DockerProxyServer,
+    FakeDockerDaemon,
+    _UnixHTTPConnection,
+)
+from koordinator_tpu.runtimeproxy.hookclient import InProcessHookClient
+from koordinator_tpu.runtimeproxy.server import FailurePolicy
+
+
+class _Hooks:
+    """koordlet-side hook handler: pins BE containers to cpuset 0-3 and
+    halves cpu shares on create; bumps memory on update."""
+
+    def __getattr__(self, name):
+        if name.endswith("Hook"):
+            return lambda req: api_pb2.ContainerResourceHookResponse()
+        raise AttributeError(name)
+
+    def PreCreateContainerHook(self, req):
+        assert req.pod_meta.name == "web-0"
+        assert req.container_meta.name == "app"
+        return api_pb2.ContainerResourceHookResponse(
+            resources=api_pb2.LinuxContainerResources(
+                cpu_shares=512, cpuset_cpus="0-3"))
+
+    def PreUpdateContainerResourcesHook(self, req):
+        assert req.container_meta.id
+        return api_pb2.ContainerResourceHookResponse(
+            resources=api_pb2.LinuxContainerResources(
+                memory_limit_bytes=2 * 1024**3))
+
+
+def _post(sock, path, payload):
+    conn = _UnixHTTPConnection(str(sock))
+    body = json.dumps(payload).encode()
+    conn.request("POST", path, body=body,
+                 headers={"Content-Type": "application/json",
+                          "Content-Length": str(len(body))})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, json.loads(data) if data else None
+
+
+def _get(sock, path):
+    conn = _UnixHTTPConnection(str(sock))
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, json.loads(data) if data else None
+
+
+@pytest.fixture
+def stack(tmp_path):
+    backend_sock = tmp_path / "dockerd.sock"
+    proxy_sock = tmp_path / "proxy.sock"
+    daemon = FakeDockerDaemon(str(backend_sock))
+    daemon.start()
+    proxy = DockerProxyServer(str(proxy_sock), str(backend_sock),
+                              hook_client=InProcessHookClient(_Hooks()))
+    proxy.start()
+    yield proxy_sock, daemon, proxy
+    proxy.stop()
+    daemon.stop()
+
+
+CREATE = {
+    "Image": "registry/app:v1",
+    "Labels": {
+        "io.kubernetes.pod.name": "web-0",
+        "io.kubernetes.pod.namespace": "default",
+        "io.kubernetes.pod.uid": "uid-1",
+        "io.kubernetes.container.name": "app",
+    },
+    "HostConfig": {"CpuShares": 1024, "Memory": 1024**3},
+}
+
+
+def test_create_runs_hook_and_mutates_host_config(stack):
+    proxy_sock, daemon, proxy = stack
+    status, resp = _post(proxy_sock,
+                         "/v1.43/containers/create?name=k8s_app_web-0",
+                         CREATE)
+    assert status == 201
+    cid = resp["Id"]
+    ctr = daemon.containers[cid]
+    # the hook's resources overlaid the request before the daemon saw it
+    assert ctr["HostConfig"]["CpuShares"] == 512
+    assert ctr["HostConfig"]["CpusetCpus"] == "0-3"
+    assert ctr["HostConfig"]["Memory"] == 1024**3  # untouched field kept
+    # id -> meta binding for later lifecycle hooks
+    assert cid in proxy.container_store
+
+
+def test_update_intercepted_and_merged(stack):
+    proxy_sock, daemon, proxy = stack
+    _status, resp = _post(proxy_sock, "/v1.43/containers/create", CREATE)
+    cid = resp["Id"]
+    status, _ = _post(proxy_sock, f"/v1.43/containers/{cid}/update",
+                      {"CpuQuota": 50000})
+    assert status == 200
+    hc = daemon.containers[cid]["HostConfig"]
+    assert hc["CpuQuota"] == 50000
+    assert hc["Memory"] == 2 * 1024**3  # hook's bump merged in
+
+
+def test_start_stop_pass_through_with_hooks(stack):
+    proxy_sock, daemon, proxy = stack
+    _status, resp = _post(proxy_sock, "/v1.43/containers/create", CREATE)
+    cid = resp["Id"]
+    status, _ = _post(proxy_sock, f"/v1.43/containers/{cid}/start", {})
+    assert status == 204
+    assert daemon.containers[cid]["State"]["Status"] == "running"
+    status, _ = _post(proxy_sock, f"/v1.43/containers/{cid}/stop", {})
+    assert status == 204
+    assert daemon.containers[cid]["State"]["Status"] == "exited"
+    # post-stop hook ran AFTER the daemon confirmed; meta dropped (no leak)
+    assert cid not in proxy.container_store
+
+
+def test_unintercepted_paths_pass_through(stack):
+    proxy_sock, daemon, proxy = stack
+    status, body = _get(proxy_sock, "/v1.43/_ping")
+    assert status == 200 and body == "OK"
+    _status, resp = _post(proxy_sock, "/v1.43/containers/create", CREATE)
+    status, ctr = _get(proxy_sock, f"/v1.43/containers/{resp['Id']}/json")
+    assert status == 200 and ctr["Id"] == resp["Id"]
+
+
+class _DeadHooks:
+    def call(self, method, request):
+        raise ConnectionError("hook server down")
+
+
+def test_failure_policy_fail_aborts_and_ignore_forwards(tmp_path):
+    backend_sock = tmp_path / "dockerd.sock"
+    daemon = FakeDockerDaemon(str(backend_sock))
+    daemon.start()
+    try:
+        fail_sock = tmp_path / "fail.sock"
+        proxy_fail = DockerProxyServer(
+            str(fail_sock), str(backend_sock), hook_client=_DeadHooks(),
+            failure_policy=FailurePolicy.FAIL)
+        proxy_fail.start()
+        status, _ = _post(fail_sock, "/v1.43/containers/create", CREATE)
+        assert status == 502
+        assert not daemon.containers  # never reached the daemon
+        proxy_fail.stop()
+
+        ign_sock = tmp_path / "ignore.sock"
+        proxy_ign = DockerProxyServer(
+            str(ign_sock), str(backend_sock), hook_client=_DeadHooks(),
+            failure_policy=FailurePolicy.IGNORE)
+        proxy_ign.start()
+        status, resp = _post(ign_sock, "/v1.43/containers/create", CREATE)
+        assert status == 201
+        # degraded: the ORIGINAL request went through unmutated
+        assert daemon.containers[resp["Id"]]["HostConfig"]["CpuShares"] == 1024
+        proxy_ign.stop()
+    finally:
+        daemon.stop()
