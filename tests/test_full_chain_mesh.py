@@ -59,6 +59,27 @@ def test_sharded_full_chain_matches_single_device(cpu_devices, seed, kw):
     assert (np.asarray(chosen_1)[: len(pods.keys)] >= 0).sum() > 0
 
 
+def test_sharded_full_chain_large_shape(cpu_devices):
+    """Bucket/pad/shard interplay at non-toy scale: the full chain at
+    2048 x 1024 under the 8-device mesh must bind identically to the
+    single-device step (shard-boundary bugs the tiny fixtures cannot
+    catch). Axes are reduced to the active set like the cycle driver and
+    the bench do."""
+    from koordinator_tpu.scheduler.snapshot import reduce_to_active_axes
+
+    args, fc, pods, ng, ngroups = _build(1, num_nodes=1024, num_pods=2048)
+    fc, axes = reduce_to_active_axes(fc)
+    chosen_1 = np.asarray(build_full_chain_step(
+        args, ng, ngroups, active_axes=axes)(fc)[0])
+    mesh = make_mesh(cpu_devices)
+    step = build_sharded_full_chain_step(args, ng, ngroups, mesh,
+                                         active_axes=axes)
+    chosen_8 = np.asarray(step(shard_full_chain_inputs(fc, mesh))[0])
+    np.testing.assert_array_equal(chosen_1, chosen_8)
+    assert (chosen_1[: len(pods.keys)] >= 0).sum() >= 1024
+    assert len(pods.keys) >= 2048
+
+
 def test_sharded_full_chain_gang_and_quota_active(cpu_devices):
     """The sharded run must show gang/quota machinery engaged, not vacuously on."""
     args, fc, pods, ng, ngroups = _build(0)
