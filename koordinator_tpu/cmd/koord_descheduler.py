@@ -1,0 +1,47 @@
+"""koord-descheduler binary: profile runner loop.
+
+Analog of reference cmd/koord-descheduler: periodic Deschedule/Balance
+profile execution with leader-election gating and the migration
+controller's arbitration."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from koordinator_tpu.cmd import (
+    add_cluster_flags,
+    add_loop_flags,
+    build_store,
+    run_ticks,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="koord-descheduler")
+    add_cluster_flags(ap)
+    add_loop_flags(ap, default_interval=60.0)
+    ap.add_argument("--leader-elect", action="store_true")
+    ap.add_argument("--identity", default="koord-descheduler-0")
+    args = ap.parse_args(argv)
+
+    from koordinator_tpu.client.leaderelection import LeaderElector
+    from koordinator_tpu.descheduler import Descheduler
+
+    store = build_store(args)
+    elector = (
+        LeaderElector(store, "koord-descheduler", args.identity)
+        if args.leader_elect else None
+    )
+    desched = Descheduler(store, elector=elector)
+
+    def tick():
+        summary = desched.run_once()
+        print(f"koord-descheduler: {summary}", file=sys.stderr)
+
+    run_ticks(tick, args.interval, args.max_ticks, "koord-descheduler")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

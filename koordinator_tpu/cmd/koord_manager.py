@@ -1,0 +1,49 @@
+"""koord-manager binary: slo-controllers + quota profiles + webhooks.
+
+Analog of reference cmd/koord-manager: all controllers behind ONE leader
+election; the admission webhook serves on every replica (store-level
+interceptor here, the apiserver-webhook analog)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from koordinator_tpu.cmd import (
+    add_cluster_flags,
+    add_loop_flags,
+    build_store,
+    parse_feature_gates,
+    run_ticks,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="koord-manager")
+    add_cluster_flags(ap)
+    add_loop_flags(ap, default_interval=15.0)
+    ap.add_argument("--identity", default="koord-manager-0")
+    ap.add_argument("--feature-gates", help="Gate=bool[,Gate=bool...]")
+    args = ap.parse_args(argv)
+
+    from koordinator_tpu.manager import Manager
+    from koordinator_tpu.utils.features import MANAGER_GATES
+
+    parse_feature_gates(MANAGER_GATES, args.feature_gates)
+    store = build_store(args)
+    mgr = Manager(store, identity=args.identity)
+
+    def tick():
+        leading = mgr.tick()
+        if leading:
+            print(
+                f"koord-manager: round={mgr.reconcile_rounds} "
+                f"changes={mgr.last_changes}", file=sys.stderr)
+
+    run_ticks(tick, args.interval, args.max_ticks, "koord-manager")
+    mgr.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -55,6 +55,7 @@ from koordinator_tpu.scheduler.frameworkext import (
     FrameworkExtender,
 )
 from koordinator_tpu.scheduler.plugins import DEFAULT_PLUGINS
+from koordinator_tpu.scheduler.sidecar import SidecarClient
 from koordinator_tpu.scheduler.snapshot import (
     ClusterState,
     build_full_chain_inputs,
@@ -74,6 +75,7 @@ class Scheduler:
         scheduler_name: str = "koord-scheduler",
         config: Optional["SchedulerConfiguration"] = None,
         elector=None,
+        sidecar_address: Optional[str] = None,
     ):
         from koordinator_tpu.scheduler.config import SchedulerConfiguration
         from koordinator_tpu.scheduler.plugins.reservation import (
@@ -145,6 +147,12 @@ class Scheduler:
         # with an elector, a cycle runs only while this replica holds the lease
         self.elector = elector
         self._step_cache: Dict[Tuple, object] = {}
+        # SURVEY 7 step 6: the host event loop may offload the kernel pass
+        # to a gRPC sidecar (the Go<->JAX integration shape); transport
+        # failures degrade to the in-process step, never wedging the cycle
+        self._sidecar_client = (
+            SidecarClient(sidecar_address) if sidecar_address else None)
+        self.sidecar_fallbacks = 0
 
     # ------------------------------------------------------------------
     def _pending_queue(self, now: float) -> Tuple[List[Pod], Dict[str, Reservation]]:
@@ -433,7 +441,19 @@ class Scheduler:
             ng, ngroups, active,
         )
         t_k = time.perf_counter()
-        chosen, _, _ = step(fc)
+        if self._sidecar_client is not None:
+            from koordinator_tpu.scheduler.sidecar import (
+                schedule_batch_or_fallback,
+            )
+
+            chosen, _, _, used_fallback = schedule_batch_or_fallback(
+                self._sidecar_client, fc, ng, ngroups, self.args,
+                active_axes=active, local_step=step,
+            )
+            if used_fallback:
+                self.sidecar_fallbacks += 1
+        else:
+            chosen, _, _ = step(fc)
         chosen = np.asarray(chosen)
         result.kernel_seconds += time.perf_counter() - t_k
 
