@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Micro-benchmarks mirroring the reference's three go-bench harnesses
+(SURVEY.md section 4): reservation snapshot restore
+(transformer_benchmark_test.go), quota-tree update
+(group_quota_manager_test.go), and cpuset accumulator take
+(cpu_accumulator_test.go). The reference records no numbers — these
+harnesses exist so regressions in the host-side hot paths are measurable
+here too. Prints one JSON line per bench on stdout.
+
+Usage: PYTHONPATH=. python hack/microbench.py [--iters N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _bench(name: str, fn, iters: int, unit_count: int, unit: str) -> None:
+    fn()  # warm
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    med = float(np.median(times))
+    print(json.dumps({
+        "bench": name,
+        "median_ms": round(med * 1000, 3),
+        "per_sec": round(unit_count / med, 1),
+        "unit": unit,
+        "iters": iters,
+    }))
+
+
+def bench_reservation_restore(iters: int) -> None:
+    """Reservation snapshot restore: nominate against a cache of available
+    reservations (transformer restore-prep analog)."""
+    from koordinator_tpu.api.objects import (
+        ObjectMeta,
+        Pod,
+        PodSpec,
+        Reservation,
+        ReservationOwner,
+    )
+    from koordinator_tpu.api.resources import ResourceList
+    from koordinator_tpu.client.store import KIND_RESERVATION, ObjectStore
+    from koordinator_tpu.scheduler.plugins.reservation import (
+        ReservationPlugin,
+    )
+
+    GIB = 1024**3
+    store = ObjectStore()
+    plugin = ReservationPlugin()
+    plugin.register(store)
+    n_res = 500
+    for i in range(n_res):
+        res = Reservation(
+            meta=ObjectMeta(name=f"res-{i}", namespace="",
+                            creation_timestamp=1.0),
+            template=PodSpec(requests=ResourceList.of(cpu=2000,
+                                                      memory=4 * GIB)),
+            owners=[ReservationOwner(label_selector={"app": f"a{i % 50}"})],
+            node_name=f"node-{i % 100}",
+            phase="Available",
+        )
+        res.allocatable = res.template.requests.copy()
+        store.add(KIND_RESERVATION, res)
+    pods = [
+        Pod(meta=ObjectMeta(name=f"p-{j}", uid=f"p-{j}",
+                            labels={"app": f"a{j % 50}"}),
+            spec=PodSpec(requests=ResourceList.of(cpu=1000, memory=GIB)))
+        for j in range(200)
+    ]
+
+    def run():
+        hits = 0
+        for pod in pods:
+            if plugin.nominate(pod, now=10.0) is not None:
+                hits += 1
+        assert hits > 0
+
+    _bench("reservation_nominate_200pods_500res", run, iters, 200, "pods")
+
+
+def bench_quota_tree(iters: int) -> None:
+    """Quota-tree rebuild + water-filling runtime computation (the
+    GroupQuotaManager update path)."""
+    from koordinator_tpu.api.objects import (
+        LABEL_QUOTA_PARENT,
+        ElasticQuota,
+        ObjectMeta,
+    )
+    from koordinator_tpu.api.resources import NUM_RESOURCES, ResourceList
+    from koordinator_tpu.ops.quota import (
+        build_quota_tree,
+        compute_runtime_quotas,
+    )
+
+    GIB = 1024**3
+    quotas = []
+    for p in range(10):
+        quotas.append(ElasticQuota(
+            meta=ObjectMeta(name=f"parent-{p}", namespace=""),
+            min=ResourceList.of(cpu=20_000, memory=64 * GIB),
+            max=ResourceList.of(cpu=100_000, memory=256 * GIB)))
+        for c in range(20):
+            q = ElasticQuota(
+                meta=ObjectMeta(name=f"q-{p}-{c}", namespace=""),
+                min=ResourceList.of(cpu=1000, memory=2 * GIB),
+                max=ResourceList.of(cpu=50_000, memory=128 * GIB))
+            q.meta.labels[LABEL_QUOTA_PARENT] = f"parent-{p}"
+            quotas.append(q)
+    rng = np.random.default_rng(3)
+    req = {
+        f"q-{p}-{c}": np.asarray(
+            rng.integers(0, 8000, NUM_RESOURCES), np.float32)
+        for p in range(10) for c in range(20)
+    }
+    total = np.full(NUM_RESOURCES, 1e6, np.float32)
+
+    def run():
+        tree = build_quota_tree(quotas, req, {})
+        runtime = compute_runtime_quotas(tree, total)
+        assert runtime.shape[0] == len(tree.names)
+
+    _bench("quota_tree_update_210groups", run, iters, 210, "groups")
+
+
+def bench_cpu_accumulator(iters: int) -> None:
+    """cpuset accumulator take: sorted free-core allocation on a 2-socket
+    topology (cpu_accumulator.go take semantics)."""
+    from koordinator_tpu.scheduler.cpu_topology import (
+        CPUAllocationState,
+        CPUTopology,
+        FULL_PCPUS,
+        take_cpus,
+    )
+
+    topo = CPUTopology.build(num_sockets=2, nodes_per_socket=1,
+                             cores_per_node=32, threads_per_core=2)
+
+    def run():
+        state = CPUAllocationState(topo)
+        got = 0
+        for _ in range(30):
+            cpus = take_cpus(state, num_cpus=4, bind_policy=FULL_PCPUS)
+            if cpus:
+                got += len(cpus)
+        assert got > 0
+
+    _bench("cpu_accumulator_take_30x4cpus_128cpu_node", run, iters, 30,
+           "takes")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+    for fn in (bench_reservation_restore, bench_quota_tree,
+               bench_cpu_accumulator):
+        try:
+            fn(args.iters)
+        except Exception as e:  # keep the other benches running
+            print(f"{fn.__name__}: FAILED {e!r}", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -391,8 +391,10 @@ def build_best_full_chain_step(args: LoadAwareArgs, num_gangs: int,
     The Pallas kernel pins all node/NUMA/quota state in VMEM, so its reach
     is bounded (~20k nodes at R=16, less with NUMA zones and quota groups);
     past the budget the per-call dispatch degrades to the XLA step instead
-    of failing to compile. Shapes are static under jit, so the dispatch
-    happens at trace time and costs nothing per step.
+    of failing to compile. The dispatch reads shapes plus one host-side
+    numpy flag (any volumes?), so it never syncs the device; under jit the
+    shape checks fold at trace time and the volume variant stays
+    conservative.
 
     ``kernel`` forces an implementation: "serial" (XLA fori_loop), "pallas",
     or "wave" (models/wave_chain.py); "auto" is the default selection above.
@@ -435,8 +437,16 @@ def build_best_full_chain_step(args: LoadAwareArgs, num_gangs: int,
 
     budget = (pc.vmem_budget_bytes() if vmem_budget_bytes is None
               else vmem_budget_bytes)
-    pallas_step = build_pallas_full_chain_step(
-        args, num_gangs, num_groups, active_axes=active_axes)
+    # two lazily-built pallas variants: volume-less batches (the common
+    # case) compile out the CSI volume machinery entirely
+    pallas_steps = {}
+
+    def _pallas(enable_volumes: bool):
+        if enable_volumes not in pallas_steps:
+            pallas_steps[enable_volumes] = build_pallas_full_chain_step(
+                args, num_gangs, num_groups, active_axes=active_axes,
+                enable_volumes=enable_volumes)
+        return pallas_steps[enable_volumes]
 
     def step(fc: FullChainInputs):
         P, R = fc.base.fit_requests.shape
@@ -449,7 +459,12 @@ def build_best_full_chain_step(args: LoadAwareArgs, num_gangs: int,
         SI = fc.img_scores.shape[1]
         if estimate_vmem_bytes(N, R, K, G, P, T, S, PT, SI) <= budget:
             step.last_backend = "pallas"
-            return pallas_step(fc)
+            # the snapshot builder hands HOST (numpy) arrays, so this check
+            # is sync-free; device arrays / tracers conservatively keep the
+            # volume machinery rather than forcing a device->host transfer
+            vn = fc.vol_needed
+            vol = bool((vn > 0).any()) if isinstance(vn, np.ndarray) else True
+            return _pallas(vol)(fc)
         step.last_backend = "xla"
         return xla_step(fc)
 

@@ -64,7 +64,7 @@ def estimate_vmem_bytes(N: int, R: int, K: int, G: int, P: int,
 
 def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
                  K: int, G: int, T: int = 0, S: int = 0, S2: int = 0,
-                 PT: int = 0, SI: int = 0):
+                 PT: int = 0, SI: int = 0, VOL: bool = True):
     wsum = float(max(weights.sum(), 1.0))
     consts = pc.weight_consts(weights)
 
@@ -143,7 +143,8 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
                     affexists_ref[t] = affexists0_ref[t]
             if PT:
                 portused_ref[:] = portused0_ref[:]
-            volfree_ref[:] = volfree0_ref[:]
+            if VOL:
+                volfree_ref[:] = volfree0_ref[:]
 
         # read-only node state: load once per grid step
         lafeas_np = lafeas_np_ref[0, :]
@@ -176,7 +177,7 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         aff_count = [affcount_ref[t:t + 1, :] for t in range(T)]
         anti_cover = [anticover_ref[t:t + 1, :] for t in range(T)]
         port_used = [portused_ref[s:s + 1, :] for s in range(PT)]
-        vol_free = volfree_ref[0, :]
+        vol_free = volfree_ref[0, :] if VOL else None
 
         for j in range(UNROLL):
             p = i * UNROLL + j
@@ -247,10 +248,14 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
             taint_ok = jnp.remainder(
                 jnp.floor(taintmask_ref[p] / taintpow), 2.0) >= 1.0
             # ---- Filter: NodePorts (wanted slot free) + CSI volume limit
-            vol_needed = volneeded_ref[p]
-            vol_ok = (vol_needed <= 0.0) | (vol_free >= vol_needed)
+            # (VOL statically gates the volume machinery: volume-less
+            # batches — the common case — pay nothing per pod)
             feasible = (node_ok_row & fit & la_ok & cpuset_ok
-                        & numa_ok & taint_ok & vol_ok & admit)
+                        & numa_ok & taint_ok & admit)
+            if VOL:
+                vol_needed = volneeded_ref[p]
+                feasible = feasible & (
+                    (vol_needed <= 0.0) | (vol_free >= vol_needed))
             for s in range(PT):
                 want_s = jnp.remainder(
                     jnp.floor(portwants_ref[p] / float(1 << s)), 2.0) >= 1.0
@@ -347,7 +352,8 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
                 port_used[s] = jnp.maximum(
                     port_used[s],
                     (sel * jnp.where(want_s, 1.0, 0.0))[None, :])
-            vol_free = vol_free - sel * vol_needed
+            if VOL:
+                vol_free = vol_free - sel * vol_needed
             # numa: single-zone subtract + lowest-zones-first waterfall
             # (disjoint). Only the SingleNUMANode policy pins a zone
             # (numa_admit_row returns zone = -1 otherwise); every other
@@ -402,7 +408,8 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
             anticover_ref[t:t + 1, :] = anti_cover[t]
         for s in range(PT):
             portused_ref[s:s + 1, :] = port_used[s]
-        volfree_ref[:] = vol_free[None, :]
+        if VOL:
+            volfree_ref[:] = vol_free[None, :]
 
         @pl.when(i == pl.num_programs(0) - 1)
         def _emit():
@@ -414,9 +421,15 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
 
 def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                                  num_groups: int, interpret: bool = False,
-                                 jit: bool = True, active_axes=None):
+                                 jit: bool = True, active_axes=None,
+                                 enable_volumes: bool = True):
     """FullChainInputs -> (chosen[P], requested[N, R], quota_used[G, R]);
-    same contract as models.full_chain.build_full_chain_step."""
+    same contract as models.full_chain.build_full_chain_step.
+
+    enable_volumes=False compiles OUT the CSI volume-limit machinery (the
+    per-pod [N] compare/select/update) — valid only for batches where no
+    pod mounts volumes; the backend selector checks the concrete inputs
+    and picks the variant."""
     full_weights = args.weight_vector()
     if active_axes is not None:
         full_weights = full_weights[list(active_axes)]
@@ -553,7 +566,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                             constant_values=-1)
 
         kernel = _make_kernel(weights, prod_mode, N, R, K, G_eff, T, S, S2,
-                              PT, SI)
+                              PT, SI, VOL=enable_volumes)
         grid_inputs = (
             spad(inputs.is_prod), spad(inputs.pod_valid),
             spad(inputs.is_daemonset), spad(gang_pod_ok),

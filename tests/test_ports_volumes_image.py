@@ -180,6 +180,25 @@ def test_port_slot_overflow_marks_pods_unschedulable():
     assert (chosen[: len(pods.keys)] < 0).sum() >= 4
 
 
+def test_pallas_volume_less_variant_parity():
+    """The selector compiles OUT the volume machinery for volume-less
+    batches (enable_volumes=False); that variant must stay bit-identical
+    to the XLA step — CI coverage for the production common case."""
+    from koordinator_tpu.ops.pallas_full_chain import (
+        build_pallas_full_chain_step,
+    )
+
+    args = LoadAwareArgs()
+    cluster, state = synth_full_cluster(14, 20, seed=23)
+    fc, pods, nodes, tree, gi, ng, ngroups = build_full_chain_inputs(
+        state, args)
+    assert not (np.asarray(fc.vol_needed) > 0).any()
+    ref = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    novol = np.asarray(build_pallas_full_chain_step(
+        args, ng, ngroups, interpret=True, enable_volumes=False)(fc)[0])
+    np.testing.assert_array_equal(novol, ref)
+
+
 def test_cycle_driver_feeds_pvcs_and_pvs():
     """End-to-end through the cycle driver: VolumeZone pins via the store's
     PVC/PV objects."""
