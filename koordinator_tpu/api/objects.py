@@ -174,6 +174,10 @@ class PodSpec:
     # container images — the vendored ImageLocality score reads them
     # against node.images
     images: List[str] = field(default_factory=list)
+    # desired requests of a PENDING in-place resize (KEP-1287 shape; the
+    # frameworkext ResizePod path consumes it when the feature gate is on:
+    # reference frameworkext_factory RunReservePluginsReserve+RunResizePod)
+    resize_requests: Optional[ResourceList] = None
 
 
 @dataclass

@@ -186,6 +186,76 @@ class Scheduler:
                 reservations[pseudo.meta.key] = res
         return pods, reservations
 
+    def _process_resizes(self, now: float, result: CycleResult) -> None:
+        """In-place pod resize (KEP-1287 shape; reference gates it behind
+        the ResizePod feature and runs Reserve + ResizePod instead of a
+        scheduling pass): an assigned pod carrying spec.resize_requests is
+        granted when its node still fits the DELTA against every other
+        assigned pod's requests; otherwise it stays pending and retries
+        next cycle. cpuset-bound (LSE/LSR integer-cpu) pods are refused —
+        their core allocation would need a release/re-take, which in-place
+        resize cannot do safely."""
+        import dataclasses
+
+        from koordinator_tpu.scheduler.snapshot import _pod_cpuset_flags
+
+        candidates = [
+            p for p in self.store.list(KIND_POD)
+            if p.is_assigned and not p.is_terminated
+            and p.spec.resize_requests is not None
+            and p.spec.scheduler_name == self.scheduler_name
+        ]
+        if not candidates:
+            return
+        assigned = self._assigned_requests()
+        # Available reservations HOLD capacity the batch pass counts via
+        # ReservationRestoreTransformer — the resize fit base must count it
+        # too, or a granted resize overcommits against a reservation whose
+        # owner binds later
+        for res in self.store.list(KIND_RESERVATION):
+            if res.is_available and not res.is_expired(now) and res.node_name:
+                vec = res.allocatable.to_vector()
+                assigned[res.node_name] = (
+                    assigned.get(res.node_name, np.zeros_like(vec)) + vec)
+        nodes = {n.meta.name: n for n in self.store.list(KIND_NODE)}
+        for pod in candidates:
+            node = nodes.get(pod.spec.node_name)
+            if node is None:
+                result.resize_pending.append(pod.meta.key)
+                self.extender.error_handlers.dispatch(
+                    pod, "resize target node not found")
+                continue
+            # cpuset guard on BOTH shapes: the old allocation AND what the
+            # pod would become (a resize to integer-cpu LSR must not dodge
+            # the cpuset release/re-take it cannot do in place)
+            needs_bind_old, _c, _f = _pod_cpuset_flags(pod)
+            resized_view = dataclasses.replace(
+                pod, spec=dataclasses.replace(
+                    pod.spec, requests=pod.spec.resize_requests))
+            needs_bind_new, _c, _f = _pod_cpuset_flags(resized_view)
+            if needs_bind_old or needs_bind_new:
+                result.resize_pending.append(pod.meta.key)
+                self.extender.error_handlers.dispatch(
+                    pod, "in-place resize unsupported for cpuset-bound pods")
+                continue
+            new_vec = pod.spec.resize_requests.to_vector()
+            old_vec = pod.spec.requests.to_vector()
+            others = (assigned.get(pod.spec.node_name,
+                                   np.zeros_like(new_vec)) - old_vec)
+            alloc = node.allocatable.to_vector()
+            need = new_vec > 0
+            if np.any(need & (others + new_vec > alloc)):
+                result.resize_pending.append(pod.meta.key)
+                self.extender.error_handlers.dispatch(
+                    pod, "resize does not fit the node")
+                continue
+            pod.spec.requests = pod.spec.resize_requests
+            pod.spec.resize_requests = None
+            self.store.update(KIND_POD, pod)
+            # the node's fit base shifts for later candidates on it
+            assigned[pod.spec.node_name] = others + new_vec
+            result.resized.append(pod.meta.key)
+
     def _assigned_requests(self) -> Dict[str, np.ndarray]:
         """Base fit state per node: every assigned pod's requests. Reservation
         accounting (reserved capacity + double-count restore) is layered on by
@@ -252,6 +322,13 @@ class Scheduler:
         if self.elector is not None and not self.elector.tick(now):
             return CycleResult(skipped_not_leader=True)
         result = CycleResult()
+        # [ResizePod gate] in-place resize of assigned pods, before the
+        # batch pass sees their requests (frameworkext factory
+        # RunReservePluginsReserve + RunResizePod analog)
+        from koordinator_tpu.utils.features import SCHEDULER_GATES
+
+        if SCHEDULER_GATES.enabled("ResizePod"):
+            self._process_resizes(now, result)
         res_plugin = self.extender.plugin("Reservation")
         if self.reservation_controller is not None:
             self.reservation_controller.reconcile(now)

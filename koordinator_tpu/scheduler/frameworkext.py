@@ -39,6 +39,8 @@ class CycleResult:
     failed: List[str] = field(default_factory=list)      # pod keys left pending
     rejected: List[str] = field(default_factory=list)    # struck by permit/quota
     preempted_victims: List[str] = field(default_factory=list)  # quota PostFilter
+    resized: List[str] = field(default_factory=list)     # in-place resizes applied
+    resize_pending: List[str] = field(default_factory=list)  # resize didn't fit
     duration_seconds: float = 0.0
     kernel_seconds: float = 0.0
     skipped_not_leader: bool = False  # election-gated replica in standby

@@ -1,0 +1,173 @@
+"""In-place pod resize behind the ResizePod feature gate (the reference's
+frameworkext factory runs Reserve + ResizePod instead of a scheduling pass
+when the gate is on)."""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.objects import (
+    LABEL_POD_QOS,
+    Node,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from koordinator_tpu.api.resources import ResourceList, ResourceName
+from koordinator_tpu.client.store import KIND_NODE, KIND_POD, ObjectStore
+from koordinator_tpu.scheduler.cycle import Scheduler
+from koordinator_tpu.utils.features import SCHEDULER_GATES
+
+GIB = 1024**3
+
+
+@pytest.fixture(autouse=True)
+def _gate():
+    SCHEDULER_GATES.set_from_map({"ResizePod": True})
+    yield
+    SCHEDULER_GATES.reset()
+
+
+def _store(cores=8):
+    store = ObjectStore()
+    store.add(KIND_NODE, Node(
+        meta=ObjectMeta(name="n0", namespace=""),
+        allocatable=ResourceList.of(cpu=cores * 1000, memory=32 * GIB,
+                                    pods=20)))
+    return store
+
+
+def _running(store, name, cpu, mem_gib=4):
+    pod = Pod(meta=ObjectMeta(name=name, uid=name, creation_timestamp=1.0),
+              spec=PodSpec(node_name="n0",
+                           requests=ResourceList.of(cpu=cpu,
+                                                    memory=mem_gib * GIB)))
+    pod.phase = "Running"
+    store.add(KIND_POD, pod)
+    return pod
+
+
+def test_resize_granted_when_node_fits():
+    store = _store(cores=8)
+    pod = _running(store, "web", cpu=2000)
+    pod.spec.resize_requests = ResourceList.of(cpu=4000, memory=8 * GIB)
+    store.update(KIND_POD, pod)
+    result = Scheduler(store).run_cycle(now=1_000_000.0)
+    assert result.resized == ["default/web"]
+    stored = store.get(KIND_POD, "default/web")
+    assert stored.spec.requests[ResourceName.CPU] == 4000
+    assert stored.spec.resize_requests is None
+
+
+def test_resize_pending_when_node_full():
+    store = _store(cores=8)
+    _running(store, "neighbor", cpu=5000)
+    pod = _running(store, "web", cpu=2000)
+    pod.spec.resize_requests = ResourceList.of(cpu=4000, memory=4 * GIB)
+    store.update(KIND_POD, pod)
+    result = Scheduler(store).run_cycle(now=1_000_000.0)
+    assert result.resized == []
+    assert result.resize_pending == ["default/web"]
+    stored = store.get(KIND_POD, "default/web")
+    assert stored.spec.requests[ResourceName.CPU] == 2000  # unchanged
+    assert stored.spec.resize_requests is not None  # retries next cycle
+    result2 = Scheduler(store).run_cycle(now=1_000_001.0)
+    assert result2.resize_pending == ["default/web"]
+
+
+def test_resize_sequence_respects_earlier_grants():
+    """Two resizes on one node: the second sees the first's grant in the
+    fit base, so they cannot jointly overcommit."""
+    store = _store(cores=8)
+    a = _running(store, "a", cpu=3000)
+    b = _running(store, "b", cpu=3000)
+    a.spec.resize_requests = ResourceList.of(cpu=5000, memory=4 * GIB)
+    b.spec.resize_requests = ResourceList.of(cpu=5000, memory=4 * GIB)
+    store.update(KIND_POD, a)
+    store.update(KIND_POD, b)
+    result = Scheduler(store).run_cycle(now=1_000_000.0)
+    assert len(result.resized) == 1
+    assert len(result.resize_pending) == 1
+
+
+def test_cpuset_bound_pod_refused():
+    store = _store(cores=8)
+    pod = _running(store, "pinned", cpu=2000)
+    pod.meta.labels[LABEL_POD_QOS] = "LSR"  # integer-cpu cpuset pod
+    pod.spec.resize_requests = ResourceList.of(cpu=4000, memory=4 * GIB)
+    store.update(KIND_POD, pod)
+    result = Scheduler(store).run_cycle(now=1_000_000.0)
+    assert result.resized == []
+    assert result.resize_pending == ["default/pinned"]
+
+
+def test_resize_to_integer_cpu_lsr_refused():
+    """A fractional-cpu LSR pod resizing TO integer cpu would become
+    cpuset-bound without a core allocation — refused (guard checks the
+    resized shape, not just the current one)."""
+    store = _store(cores=8)
+    pod = _running(store, "frac", cpu=1500)
+    pod.meta.labels[LABEL_POD_QOS] = "LSR"  # not integer-cpu yet
+    pod.spec.resize_requests = ResourceList.of(cpu=4000, memory=4 * GIB)
+    store.update(KIND_POD, pod)
+    result = Scheduler(store).run_cycle(now=1_000_000.0)
+    assert result.resized == []
+    assert result.resize_pending == ["default/frac"]
+
+
+def test_resize_counts_available_reservations():
+    """An Available reservation's held capacity is part of the fit base: a
+    resize that would eat into it stays pending."""
+    from koordinator_tpu.api.objects import Reservation, ReservationOwner
+    from koordinator_tpu.client.store import KIND_RESERVATION
+
+    store = _store(cores=8)
+    pod = _running(store, "web", cpu=2000)
+    res = Reservation(
+        meta=ObjectMeta(name="hold", namespace="", creation_timestamp=1.0),
+        template=PodSpec(requests=ResourceList.of(cpu=5000, memory=4 * GIB)),
+        owners=[ReservationOwner(label_selector={"app": "later"})],
+        node_name="n0", phase="Available")
+    res.allocatable = res.template.requests.copy()
+    store.add(KIND_RESERVATION, res)
+    pod.spec.resize_requests = ResourceList.of(cpu=4000, memory=4 * GIB)
+    store.update(KIND_POD, pod)
+    result = Scheduler(store).run_cycle(now=1_000_000.0)
+    assert result.resized == []
+    assert result.resize_pending == ["default/web"]
+
+
+def test_resize_ignores_other_schedulers_pods():
+    store = _store(cores=8)
+    pod = _running(store, "foreign", cpu=2000)
+    pod.spec.scheduler_name = "other-scheduler"
+    pod.spec.resize_requests = ResourceList.of(cpu=4000, memory=4 * GIB)
+    store.update(KIND_POD, pod)
+    result = Scheduler(store).run_cycle(now=1_000_000.0)
+    assert result.resized == [] and result.resize_pending == []
+    assert store.get(KIND_POD, "default/foreign").spec.resize_requests \
+        is not None
+
+
+def test_resize_missing_node_surfaces_reason():
+    store = _store(cores=8)
+    pod = _running(store, "orphan", cpu=2000)
+    pod.spec.node_name = "gone-node"
+    pod.spec.resize_requests = ResourceList.of(cpu=4000, memory=4 * GIB)
+    store.update(KIND_POD, pod)
+    sched = Scheduler(store)
+    result = sched.run_cycle(now=1_000_000.0)
+    assert result.resize_pending == ["default/orphan"]
+    assert any("node not found" in r
+               for _k, r in sched.extender.error_handlers.failures)
+
+
+def test_gate_off_ignores_resize():
+    SCHEDULER_GATES.reset()  # default: ResizePod off
+    store = _store()
+    pod = _running(store, "web", cpu=2000)
+    pod.spec.resize_requests = ResourceList.of(cpu=4000, memory=4 * GIB)
+    store.update(KIND_POD, pod)
+    result = Scheduler(store).run_cycle(now=1_000_000.0)
+    assert result.resized == [] and result.resize_pending == []
+    assert store.get(KIND_POD,
+                     "default/web").spec.requests[ResourceName.CPU] == 2000
