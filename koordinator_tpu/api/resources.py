@@ -167,6 +167,17 @@ class ResourceList:
         return (vec / PACK_SCALE).astype(np.float32)
 
     @staticmethod
+    def pack_wire_matrix(resource_lists) -> np.ndarray:
+        """Pack many ResourceLists into one [K, R] float32 matrix: a single
+        fill + scale instead of K to_vector allocations. Rows are
+        bit-identical to to_vector() (same float64 fill, divide, cast)."""
+        rls = list(resource_lists)
+        mat = np.zeros((len(rls), NUM_RESOURCES), np.float64)
+        for j, rl in enumerate(rls):
+            rl.fill_wire_row(mat[j])
+        return (mat / PACK_SCALE).astype(np.float32)
+
+    @staticmethod
     def from_vector(vec: np.ndarray) -> "ResourceList":
         """Inverse of to_vector (rounds back to wire units)."""
         wire = np.asarray(vec, dtype=np.float64) * PACK_SCALE

@@ -25,7 +25,12 @@ from koordinator_tpu.api.objects import (
     PodGroup,
 )
 from koordinator_tpu.api.qos import QoSClass
-from koordinator_tpu.api.resources import NUM_RESOURCES, RESOURCE_INDEX, ResourceName
+from koordinator_tpu.api.resources import (
+    NUM_RESOURCES,
+    RESOURCE_INDEX,
+    ResourceList,
+    ResourceName,
+)
 from koordinator_tpu.models.full_chain import FullChainInputs
 from koordinator_tpu.models.scheduler_model import make_inputs
 from koordinator_tpu.ops.loadaware import LoadAwareArgs, build_loadaware_node_state
@@ -205,21 +210,32 @@ def build_full_chain_inputs(
         if q:
             pod_req_by_quota.setdefault(q, np.zeros(NUM_RESOURCES, np.float32))
             pod_req_by_quota[q] += pods.requests[i]
+    # assigned quota usage: ONE wire-matrix fill + scale + segment-sum
+    # instead of a per-pod to_vector allocation (the 10k-pod store walk's
+    # hot cost)
     used_by_quota: Dict[str, np.ndarray] = {}
+    quota_pods: List[Tuple[str, Pod]] = []
     for pod in state.pods_by_key.values():
         q = pod.quota_name
         if q and pod.is_assigned and not pod.is_terminated:
-            used_by_quota.setdefault(q, np.zeros(NUM_RESOURCES, np.float32))
-            used_by_quota[q] += pod.spec.requests.to_vector()
+            quota_pods.append((q, pod))
+    if quota_pods:
+        mat = ResourceList.pack_wire_matrix(
+            pod.spec.requests for _q, pod in quota_pods)
+        names = sorted({q for q, _p in quota_pods})
+        row_of = {q: j for j, q in enumerate(names)}
+        sums = np.zeros((len(names), NUM_RESOURCES), np.float32)
+        np.add.at(sums, [row_of[q] for q, _p in quota_pods], mat)
+        used_by_quota = {q: sums[j] for q, j in row_of.items()}
     # group request counts EVERY member pod — running AND pending; a
     # pending-only request would understate runtime for groups with running
     # usage and deny admission their min already guarantees
     pod_req_by_quota = merge_group_request(pod_req_by_quota, used_by_quota)
     tree = build_quota_tree(state.quotas, pod_req_by_quota, used_by_quota)
     if state.cluster_total is None:
-        total = np.zeros(NUM_RESOURCES, np.float32)
-        for node in state.nodes:
-            total += node.allocatable.to_vector()
+        # one matrix fill + scale + sum (not 5k per-node to_vector calls)
+        total = ResourceList.pack_wire_matrix(
+            node.allocatable for node in state.nodes).sum(axis=0)
     else:
         total = state.cluster_total
     runtime = (
@@ -305,33 +321,48 @@ def build_full_chain_inputs(
     has_topology = np.zeros(N, bool)
     bind_free = np.zeros(N, np.float32)
     cpus_per_core = np.ones(N, np.float32)
+    # zone capacities via ONE wire-matrix fill + scale + scatter (not a
+    # per-zone to_vector allocation: ~2 zones x every topology node)
+    zone_at: List[Tuple[int, int]] = []
+    zone_lists: List = []
+    topo_nodes: List[int] = []
     for i, node in enumerate(state.nodes):
-        name = node.meta.name
-        topo_cr = state.topologies.get(name)
+        topo_cr = state.topologies.get(node.meta.name)
         if topo_cr is not None and topo_cr.cpus:
+            topo_nodes.append(i)
             has_topology[i] = True
-            policy_name = resolve_numa_policy(
-                node.meta.labels, topo_cr.kubelet_cpu_manager_policy
-            )
-            numa_policy[i] = POLICY_BY_NAME.get(policy_name, POLICY_NONE)
+            numa_policy[i] = POLICY_BY_NAME.get(
+                resolve_numa_policy(node.meta.labels,
+                                    topo_cr.kubelet_cpu_manager_policy),
+                POLICY_NONE)
             for zone in topo_cr.zones:
                 if 0 <= zone.numa_id < MAX_NUMA:
-                    numa_capacity[i, zone.numa_id] = zone.allocatable.to_vector()
-            alloc = state.numa_allocated.get(name)
-            numa_free[i] = numa_capacity[i] - (alloc if alloc is not None else 0.0)
-            cpu_state = state.cpu_states.get(name)
-            if cpu_state is not None:
-                bind_free[i] = cpu_state.num_available()
-                cpus_per_core[i] = cpu_state.topology.cpus_per_core
-            else:
-                bind_free[i] = numa_free[i, :, CPU_IDX].sum() / 1000.0
-                cpus_per_core[i] = 2.0
+                    zone_at.append((i, zone.numa_id))
+                    zone_lists.append(zone.allocatable)
+    if zone_at:
+        zmat = ResourceList.pack_wire_matrix(zone_lists)
+        idx = np.asarray(zone_at)
+        numa_capacity[idx[:, 0], idx[:, 1]] = zmat
+    for i in topo_nodes:
+        node = state.nodes[i]
+        name = node.meta.name
+        alloc = state.numa_allocated.get(name)
+        numa_free[i] = numa_capacity[i] - (alloc if alloc is not None else 0.0)
+        cpu_state = state.cpu_states.get(name)
+        if cpu_state is not None:
+            bind_free[i] = cpu_state.num_available()
+            cpus_per_core[i] = cpu_state.topology.cpus_per_core
         else:
-            # no topology: NUMA admission passes only via POLICY_NONE; spread the
-            # node allocatable into one virtual zone so zero-topology clusters
-            # still quota-fit
-            numa_capacity[i, 0] = nodes.allocatable[i]
-            numa_free[i, 0] = nodes.allocatable[i] - nodes.requested[i]
+            bind_free[i] = numa_free[i, :, CPU_IDX].sum() / 1000.0
+            cpus_per_core[i] = 2.0
+    # no topology: NUMA admission passes only via POLICY_NONE; spread the
+    # node allocatable into one virtual zone so zero-topology clusters
+    # still quota-fit (vectorized over the non-topology rows)
+    no_topo = np.nonzero(~has_topology[: len(state.nodes)])[0]
+    if no_topo.size:
+        numa_capacity[no_topo, 0] = nodes.allocatable[no_topo]
+        numa_free[no_topo, 0] = (nodes.allocatable[no_topo]
+                                 - nodes.requested[no_topo])
 
     # inter-pod (anti-)affinity factorization (ops/podaffinity.py): the
     # batch's distinct terms -> per-node domain/count state + per-pod term
