@@ -534,7 +534,7 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int,
         for _ in range(1 if args_cli.smoke else 3):
             t0 = time.perf_counter()
             chosen_native = native_floor.serial_schedule_full_native(
-                fc, la, num_groups=ngroups)
+                fc, la, num_groups=ngroups, active_axes=active_axes)
             floor_times.append(time.perf_counter() - t0)
         t_native = float(np.median(floor_times))
         compiled_pps = pods.num_valid / t_native
@@ -555,7 +555,8 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int,
     # ---- python serial floor (numpy oracle) on a prefix sample
     if pods.padded_size <= 1024:
         t0 = time.perf_counter()
-        chosen_serial = serial_schedule_full(fc, la)
+        chosen_serial = serial_schedule_full(fc, la,
+                                            active_axes=active_axes)
         t_serial = time.perf_counter() - t0
         python_pps = pods.num_valid / t_serial
         mism = int(
@@ -573,7 +574,7 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int,
         sample = min(args_cli.serial_sample, pods.num_valid)
         fc_slice = slice_full_chain(fc, sample)
         t0 = time.perf_counter()
-        serial_schedule_full_core(fc_slice, la)
+        serial_schedule_full_core(fc_slice, la, active_axes=active_axes)
         t_serial = time.perf_counter() - t0
         python_pps = sample / t_serial
         log(

@@ -111,15 +111,35 @@ def resolve_weight_idx(args: LoadAwareArgs, active_axes):
     return tuple(int(i) for i in np.nonzero(full_weights)[0])
 
 
-def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode):
+def resolve_balance_idx(active_axes):
+    """(cpu_axis, mem_axis) positions after active-axes slicing, for the
+    NodeResourcesBalancedAllocation score; (-1, -1) when either axis was
+    sliced away (score contributes 0 then — upstream needs both)."""
+    from koordinator_tpu.api.resources import RESOURCE_INDEX, ResourceName
+
+    cpu = RESOURCE_INDEX[ResourceName.CPU]
+    mem = RESOURCE_INDEX[ResourceName.MEMORY]
+    if active_axes is None:
+        return cpu, mem
+    axes = [int(a) for a in active_axes]
+    if cpu in axes and mem in axes:
+        return axes.index(cpu), axes.index(mem)
+    return -1, -1
+
+
+def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode,
+                       bal_idx=(-1, -1)):
     """The per-pod PreFilter+Filter+Score+select math, factored so the serial
     kernel and the wave kernel (models/wave_chain.py) trace the IDENTICAL
     computation — binding parity between them is by construction.
 
     Returns evaluate(i, requested, delta_np, delta_pr, numa_free, bind_free,
-    quota_used) -> (found, best, zone_at_best, admit) where admit is the
-    pod-level PreFilter verdict (gang validity AND quota admission);
-    vmap-able over i at frozen state."""
+    quota_used, ...) -> (found, best, zone_at_best, admit, score_row,
+    bal_row, best_score) where admit is the pod-level PreFilter verdict
+    (gang validity AND quota admission); vmap-able over i at frozen
+    state. score_row is the feasibility-masked [N] score vector and
+    bal_row the unmasked balanced-allocation term (both consumed by the
+    wave kernel's conflict bound; the serial loop drops them)."""
     inputs = fc.base
     reject_np, reject_prod = la_ops.loadaware_node_reject(
         inputs.allocatable,
@@ -220,6 +240,23 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode):
         numa_score = numa_score_row(
             req, requested, inputs.allocatable, inputs.weights, weight_idx,
         )
+        # NodeResourcesBalancedAllocation (vendored default scoring): for
+        # the two balanced axes the upstream std reduces to |fc - fm| / 2
+        # (no sqrt — the bit-parity discipline holds); fractions clamp to 1
+        # and a zero-capacity axis contributes fraction 0
+        if bal_idx[0] >= 0:
+            ci, mi = bal_idx
+            def _frac(axis):
+                cap = inputs.allocatable[:, axis]
+                safe = jnp.where(cap > 0, cap, 1.0)
+                f = jnp.where(
+                    cap > 0, (requested[:, axis] + req_fit[axis]) / safe, 0.0)
+                return jnp.minimum(f, 1.0)
+            std = jnp.abs(_frac(ci) - _frac(mi)) * 0.5
+            bal_row = jnp.floor((1.0 - std) * 100.0)
+            numa_score = numa_score + bal_row
+        else:
+            bal_row = jnp.zeros(requested.shape[0], jnp.float32)
         # preferred node affinity (soft NodeAffinity score): a static,
         # profile-bucketed 0..100 row — pods without preferences add 0.
         # Zero-column tables mean NO pod carries the feature: skip the
@@ -260,7 +297,10 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode):
         # ---- select
         best = jnp.argmax(score)
         found = (score[best] >= 0.0) & inputs.pod_valid[i]
-        return found, best, zone[best], admit
+        # score/bal rows + best value ride along for the wave kernel's
+        # balanced-allocation conflict bound; the serial loop ignores them
+        # (XLA dead-code-eliminates the unused outputs)
+        return found, best, zone[best], admit, score, bal_row, score[best]
 
     return evaluate
 
@@ -275,13 +315,14 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
     map correctly.
     """
     weight_idx = resolve_weight_idx(args, active_axes)
+    bal_idx = resolve_balance_idx(active_axes)
     prod_mode = args.score_according_prod_usage
 
     def step(fc: FullChainInputs):
         inputs = fc.base
         P = inputs.fit_requests.shape[0]
         N = inputs.allocatable.shape[0]
-        evaluate = make_pod_evaluator(fc, weight_idx, prod_mode)
+        evaluate = make_pod_evaluator(fc, weight_idx, prod_mode, bal_idx)
 
         T = fc.aff_dom.shape[1]
         PT = fc.port_used.shape[1]
@@ -295,7 +336,7 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
             est = inputs.estimated[i]
             is_prod_i = inputs.is_prod[i]
 
-            found, best, zone_at_best, _admit = evaluate(
+            found, best, zone_at_best, _admit, _s, _b, _mv = evaluate(
                 i, requested, delta_np, delta_pr, numa_free, bind_free,
                 quota_used, aff_count, anti_cover, aff_exists, port_used,
                 vol_free,

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 from koordinator_tpu.models.full_chain import (
     FullChainInputs,
     make_pod_evaluator,
+    resolve_balance_idx,
     resolve_weight_idx,
 )
 from koordinator_tpu.ops.gang import gang_permit_mask
@@ -60,6 +61,7 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                                active_axes=None, wave: int = DEFAULT_WAVE):
     """FullChainInputs -> (chosen[P], requested[N, R], quota_used[G, R])."""
     weight_idx = resolve_weight_idx(args, active_axes)
+    bal_idx = resolve_balance_idx(active_axes)
     prod_mode = args.score_according_prod_usage
 
     def step(fc: FullChainInputs):
@@ -68,7 +70,7 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
         N = inputs.allocatable.shape[0]
         G, D = fc.quota_ancestors.shape
         W = min(wave, P)
-        evaluate = make_pod_evaluator(fc, weight_idx, prod_mode)
+        evaluate = make_pod_evaluator(fc, weight_idx, prod_mode, bal_idx)
 
         # [G, G] ancestor membership: anc_mask[g, a] == a is on g's chain
         anc_valid = fc.quota_ancestors >= 0                      # [G, D]
@@ -92,7 +94,8 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             valid_w = idx < P
             idxc = jnp.minimum(idx, P - 1)
 
-            found_w, best_w, zone_w, admit_w = jax.vmap(
+            (found_w, best_w, zone_w, admit_w, score_w, bal_w,
+             maxv_w) = jax.vmap(
                 lambda i: evaluate(i, requested, delta_np, delta_pr,
                                    numa_free, bind_free, quota_used,
                                    aff_count, anti_cover, aff_exists,
@@ -169,7 +172,48 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             else:
                 affinity_conf_w = jnp.zeros_like(found_w)
 
-            conflict_w = quota_flip_w | node_coll_w | affinity_conf_w
+            # ---- balanced-allocation conflict: the one NON-monotone score
+            # term — committing pod e can make node n_e MORE balanced and so
+            # RAISE its score for a later pod w, moving w's serial argmax to
+            # a node the frozen evaluation under-scored. Sound pairwise
+            # bound: every other term only decays, so w's post-commit score
+            # on n_e is at most frozen_score_w(n_e) - frozen_bal_w(n_e) +
+            # exact_post_bal_w(n_e) (node collisions guarantee at most ONE
+            # in-wave commit per node, so the post state of n_e is frozen +
+            # fit_e). Conflict when that bound could reach w's frozen best.
+            if bal_idx[0] >= 0:
+                ci, mi = bal_idx
+                alloc = inputs.allocatable
+                cap_c = alloc[best_w, ci]                          # [W] (e)
+                cap_m = alloc[best_w, mi]
+                base_c = requested[best_w, ci] + req_fit_w[:, ci]  # n_e + e
+                base_m = requested[best_w, mi] + req_fit_w[:, mi]
+
+                def _pair_frac(base_e, cap_e, waxis):
+                    safe = jnp.where(cap_e > 0, cap_e, 1.0)        # [W]
+                    f = (base_e[None, :] + waxis[:, None]) / safe[None, :]
+                    return jnp.minimum(
+                        jnp.where(cap_e[None, :] > 0, f, 0.0), 1.0)
+
+                fpc = _pair_frac(base_c, cap_c, req_fit_w[:, ci])  # [W, W]
+                fpm = _pair_frac(base_m, cap_m, req_fit_w[:, mi])
+                bal_pair = jnp.floor(
+                    (1.0 - jnp.abs(fpc - fpm) * 0.5) * 100.0)      # w x e
+                score_at_ne = score_w[:, best_w]                   # [W, W]
+                bal_at_ne = bal_w[:, best_w]
+                bound = score_at_ne - bal_at_ne + bal_pair
+                tri_e_before_w = (warange[None, :] < warange[:, None])
+                # found_w gate: the bal term moves scores, never
+                # feasibility, so a not-found pod stays not-found
+                # post-commit and must not cut the wave
+                bal_conf_w = found_w & jnp.any(
+                    tri_e_before_w & found_w[None, :]
+                    & (bound >= maxv_w[:, None]), axis=1)
+            else:
+                bal_conf_w = jnp.zeros_like(found_w)
+
+            conflict_w = (quota_flip_w | node_coll_w | affinity_conf_w
+                          | bal_conf_w)
             cut = jnp.where(
                 conflict_w.any(), jnp.argmax(conflict_w), W
             ).astype(jnp.int32)

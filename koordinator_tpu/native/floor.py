@@ -53,13 +53,13 @@ def _load() -> Optional[ctypes.CDLL]:
     # would read every pointer after the insertion shifted
     try:
         lib.koord_floor_abi_version.restype = ctypes.c_int
-        if lib.koord_floor_abi_version() != 9:
+        if lib.koord_floor_abi_version() != 10:
             return None
     except AttributeError:
         return None
     lib.koord_serial_full_chain.restype = None
     lib.koord_serial_full_chain.argtypes = (
-        [ctypes.c_int] * 13          # P R N K G A NG T S S2 PT SI prod
+        [ctypes.c_int] * 15          # P R N K G A NG T S S2 PT SI CI MI prod
         + [_F32P] * 3                # fit_requests requests estimated
         + [_I32P] * 7                # is_prod..needs_bind
         + [_F32P] + [_I32P]          # cores_needed full_pcpus
@@ -137,9 +137,11 @@ def _i32(x) -> np.ndarray:
     return np.ascontiguousarray(np.asarray(x), np.int32)
 
 
-def serial_schedule_full_native(fc, args, num_groups: int = 0) -> np.ndarray:
+def serial_schedule_full_native(fc, args, num_groups: int = 0,
+                                active_axes=None) -> np.ndarray:
     """Native analog of parity.serial_schedule_full: returns chosen[P] int32.
-    Raises RuntimeError if the library is not built."""
+    Raises RuntimeError if the library is not built. active_axes: original
+    axis ids when fc was sliced (resolves the balanced-allocation axes)."""
     lib = _load()
     if lib is None:
         raise RuntimeError(
@@ -177,9 +179,12 @@ def serial_schedule_full_native(fc, args, num_groups: int = 0) -> np.ndarray:
             (np.asarray(fc.pod_port_wants, bool) * pow_s[None, :]).sum(axis=1))
     else:
         port_mask = np.zeros(P, np.int32)
+    from koordinator_tpu.models.full_chain import resolve_balance_idx
+
+    bal_ci, bal_mi = resolve_balance_idx(active_axes)
     chosen = np.full(P, -1, np.int32)
     lib.koord_serial_full_chain(
-        P, R, N, K, max(G, 0), A, NG, T, S, S2, PT, SI,
+        P, R, N, K, max(G, 0), A, NG, T, S, S2, PT, SI, bal_ci, bal_mi,
         1 if args.score_according_prod_usage else 0,
         fit_requests, _f32(fc.requests), _f32(inputs.estimated),
         _i32(inputs.is_prod), _i32(inputs.is_daemonset),

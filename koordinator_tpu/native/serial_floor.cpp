@@ -32,7 +32,7 @@ inline float least_requested(float requested, float capacity) {
 
 // ABI version: bump when koord_serial_full_chain's signature changes, so a
 // stale .so is rejected instead of mis-reading shifted pointers.
-extern "C" int koord_floor_abi_version() { return 9; }
+extern "C" int koord_floor_abi_version() { return 10; }
 
 extern "C" {
 
@@ -43,6 +43,7 @@ void koord_serial_full_chain(
     // dims
     int P, int R, int N, int K, int G, int A, int NG, int T, int S,
     int S2, int PT, int SI,
+    int bal_ci, int bal_mi,  // balanced-allocation cpu/mem axes (-1 = off)
     int prod_mode,
     // pods
     const float* fit_requests,   // [P, R]
@@ -316,6 +317,22 @@ void koord_serial_full_chain(
       }
       float la_score = score_valid[n] ? std::floor(acc / wdiv) : 0.0f;
       float numa_score = std::floor(acc2 / wdiv);
+      // NodeResourcesBalancedAllocation: 2-axis std == |fc - fm| / 2
+      if (bal_ci >= 0) {
+        float fc_ = 0.0f, fm_ = 0.0f;
+        float capc = alloc[bal_ci];
+        if (capc > 0.0f) {
+          fc_ = (reqn[bal_ci] + fitp[bal_ci]) / capc;
+          if (fc_ > 1.0f) fc_ = 1.0f;
+        }
+        float capm = alloc[bal_mi];
+        if (capm > 0.0f) {
+          fm_ = (reqn[bal_mi] + fitp[bal_mi]) / capm;
+          if (fm_ > 1.0f) fm_ = 1.0f;
+        }
+        float std_ = std::fabs(fc_ - fm_) * 0.5f;
+        numa_score += std::floor((1.0f - std_) * 100.0f);
+      }
       float s = la_score + numa_score;
       // preferred node affinity: static profile score row
       if (S > 0 && pod_pref_id[p] >= 0)

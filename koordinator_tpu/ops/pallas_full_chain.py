@@ -32,7 +32,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from koordinator_tpu.models.full_chain import FullChainInputs
+from koordinator_tpu.models.full_chain import (
+    FullChainInputs,
+    resolve_balance_idx,
+)
 from koordinator_tpu.ops import loadaware as la_ops
 from koordinator_tpu.ops import pallas_common as pc
 from koordinator_tpu.ops.gang import gang_permit_mask
@@ -64,7 +67,8 @@ def estimate_vmem_bytes(N: int, R: int, K: int, G: int, P: int,
 
 def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
                  K: int, G: int, T: int = 0, S: int = 0, S2: int = 0,
-                 PT: int = 0, SI: int = 0, VOL: bool = True):
+                 PT: int = 0, SI: int = 0, VOL: bool = True,
+                 BAL=(-1, -1)):
     wsum = float(max(weights.sum(), 1.0))
     consts = pc.weight_consts(weights)
 
@@ -304,6 +308,23 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
             la_score = jnp.where(score_valid_row, la_score, 0.0)
             score = la_score + pc.weighted_floor_score_col(nu_per_r, w_col,
                                                            wsum)
+            # NodeResourcesBalancedAllocation: 2-axis std == |fc - fm| / 2.
+            # requested = alloc - headroom (exact integers < 2^24, so the
+            # re-association matches the XLA evaluator bit-for-bit)
+            if BAL[0] >= 0:
+                ci, mi = BAL
+
+                def _frac(axis):
+                    cap = alloc[axis:axis + 1, :]
+                    safe = jnp.where(cap > 0, cap, 1.0)
+                    used = (cap - headroom[axis:axis + 1, :]
+                            + fit_need[axis, 0])
+                    return jnp.minimum(
+                        jnp.where(cap > 0, used / safe, 0.0), 1.0)
+
+                bal_std = jnp.abs(_frac(ci) - _frac(mi)) * 0.5
+                score = score + jnp.floor(
+                    (1.0 - bal_std) * 100.0)[0, :]
             # preferred node affinity: static profile row one-hot select
             if S:
                 sid = prefid_ref[p]
@@ -566,7 +587,8 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                             constant_values=-1)
 
         kernel = _make_kernel(weights, prod_mode, N, R, K, G_eff, T, S, S2,
-                              PT, SI, VOL=enable_volumes)
+                              PT, SI, VOL=enable_volumes,
+                              BAL=resolve_balance_idx(active_axes))
         grid_inputs = (
             spad(inputs.is_prod), spad(inputs.pod_valid),
             spad(inputs.is_daemonset), spad(gang_pod_ok),

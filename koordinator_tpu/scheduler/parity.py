@@ -146,11 +146,14 @@ def serial_schedule(inputs: ScheduleInputs, args: LoadAwareArgs) -> np.ndarray:
     return chosen
 
 
-def serial_schedule_full(fc, args: LoadAwareArgs) -> np.ndarray:
+def serial_schedule_full(fc, args: LoadAwareArgs,
+                         active_axes=None) -> np.ndarray:
     """Scalar full-chain oracle: Fit + LoadAware + NUMA/cpuset + quota admission
     in queue order, then the gang Permit barrier. Mirrors
-    models/full_chain.build_full_chain_step exactly (same float32 arithmetic)."""
-    chosen = serial_schedule_full_core(fc, args)
+    models/full_chain.build_full_chain_step exactly (same float32 arithmetic).
+    active_axes: the original axis ids when fc was sliced by
+    reduce_to_active_axes (resolves the balanced-allocation cpu/mem columns)."""
+    chosen = serial_schedule_full_core(fc, args, active_axes=active_axes)
     # ---- gang permit barrier
     gang_id = np.asarray(fc.gang_id)
     gang_min = np.asarray(fc.gang_min_member)
@@ -173,7 +176,11 @@ def serial_schedule_full(fc, args: LoadAwareArgs) -> np.ndarray:
     return chosen
 
 
-def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
+def serial_schedule_full_core(fc, args: LoadAwareArgs,
+                              active_axes=None) -> np.ndarray:
+    from koordinator_tpu.models.full_chain import resolve_balance_idx
+
+    bal_ci, bal_mi = resolve_balance_idx(active_axes)
     inputs = fc.base
     fit_requests = np.asarray(inputs.fit_requests, np.float32)
     requests = np.asarray(fc.requests, np.float32)
@@ -412,6 +419,20 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs) -> np.ndarray:
                     np.float32(requested[n, r] + requests[p, r]), allocatable[n, r]
                 )
             numa_score = np.float32(np.floor(acc2 / max(wsum, np.float32(1.0))))
+            # NodeResourcesBalancedAllocation: std of the 2 balanced axes'
+            # requested fractions == |fc - fm| / 2 (no sqrt)
+            if bal_ci >= 0:
+                def _frac(axis):
+                    cap = allocatable[n, axis]
+                    if cap <= 0:
+                        return np.float32(0.0)
+                    f = np.float32(
+                        (requested[n, axis] + fit_requests[p, axis]) / cap)
+                    return min(f, np.float32(1.0))
+                std = np.float32(
+                    np.abs(_frac(bal_ci) - _frac(bal_mi)) * np.float32(0.5))
+                numa_score = numa_score + np.float32(
+                    np.floor((np.float32(1.0) - std) * np.float32(100.0)))
             s = la_score + numa_score
             if pod_pref_id[p] >= 0:
                 s = s + pref_scores[n, pod_pref_id[p]]

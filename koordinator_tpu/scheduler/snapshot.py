@@ -69,6 +69,11 @@ def reduce_to_active_axes(fc: FullChainInputs):
     base = fc.base
     active = np.zeros(NUM_RESOURCES, bool)
     active[PODS_IDX] = True
+    # cpu/memory always stay: the balanced-allocation score reads their
+    # EXISTING node usage even when no pending pod requests the axis —
+    # slicing one away would silently disable the term in reduced runs
+    active[CPU_IDX] = True
+    active[RESOURCE_INDEX[ResourceName.MEMORY]] = True
     for arr in (
         np.asarray(base.fit_requests),
         np.asarray(base.estimated),

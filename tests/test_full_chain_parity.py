@@ -126,7 +126,7 @@ def test_active_axis_reduction_preserves_bindings():
     )
     np.testing.assert_array_equal(full, red)
     # and the serial oracle agrees on the reduced arrays too
-    serial = serial_schedule_full(fc_red, args)
+    serial = serial_schedule_full(fc_red, args, active_axes=active)
     np.testing.assert_array_equal(red[: len(pods.keys)], serial[: len(pods.keys)])
 
 
