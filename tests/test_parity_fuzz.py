@@ -92,7 +92,7 @@ def _mixed_fixture(seed: int):
     return args, fc, pods, ng, ngroups
 
 
-@pytest.mark.parametrize("seed", [101, 202, 303, 404, 505, 606])
+@pytest.mark.parametrize("seed", [101, 202, 303, 404, 505, 606, 717, 828])
 def test_fuzz_all_backends_agree(seed):
     from koordinator_tpu.models.wave_chain import build_wave_full_chain_step
     from koordinator_tpu.native import floor as native_floor
