@@ -67,7 +67,34 @@ def main() -> None:
     np.testing.assert_array_equal(np.asarray(quota_1), np.asarray(quota_g))
     assert (chosen_1[: len(pods.keys)] >= 0).sum() > 0, "vacuous schedule"
 
-    digest = hashlib.sha256(chosen_g.tobytes()).hexdigest()[:16]
+    # second pass at a bucketed-with-PADDING shape (500 pods x 250 nodes
+    # pad to 512 x 256, so pad rows actually cross the shard boundary):
+    # bucket/pad/shard interplay across the real process boundary, not
+    # just the toy fixture (the single-process dryrun covers 2048x1024;
+    # gloo collectives over CPU bound what is CI-affordable here). Runs
+    # through reduce_to_active_axes like the production cycle, and checks
+    # the quota rollup parity on the reduced axes too.
+    from koordinator_tpu.scheduler.snapshot import reduce_to_active_axes
+
+    _, big_state = synth_full_cluster(250, 500, seed=1)
+    big_fc, big_pods, _, _, _, bng, bngroups = build_full_chain_inputs(
+        big_state, args)
+    big_fc, big_axes = reduce_to_active_axes(big_fc)
+    assert big_fc.base.fit_requests.shape[0] > len(big_pods.keys)  # padded
+    big_ref, _, big_quota_ref = build_full_chain_step(
+        args, bng, bngroups, active_axes=big_axes)(big_fc)
+    big_ref = np.asarray(big_ref)
+    big_step = build_sharded_full_chain_step(
+        args, bng, bngroups, mesh, active_axes=big_axes)
+    big_g, _, big_quota_g = big_step(shard_full_chain_inputs(big_fc, mesh))
+    big_g = np.asarray(big_g)
+    np.testing.assert_array_equal(big_ref, big_g)
+    np.testing.assert_array_equal(
+        np.asarray(big_quota_ref), np.asarray(big_quota_g))
+    assert (big_g[: len(big_pods.keys)] >= 0).sum() > 100
+
+    digest = hashlib.sha256(
+        chosen_g.tobytes() + big_g.tobytes()).hexdigest()[:16]
     print(f"MULTIHOST_OK {digest}", flush=True)
 
 
