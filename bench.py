@@ -337,53 +337,23 @@ def run_rebalance(args_cli, num_pods: int, num_nodes: int) -> None:
 
     # ---- compiled serial floor: per-node/per-pod C++ transcription of the
     # same classify/sort/select pass, with victim-set parity
-    from koordinator_tpu.descheduler.lownodeload import _has_pdb_like_guard
     from koordinator_tpu.native import floor as native_floor
 
     compiled_pps = 0.0
-    parity_ok = True
+    # None (JSON null) until the victim-set diff actually runs: a missing
+    # floor must not report parity it never checked
+    parity_ok = None
     if not native_floor.available():
         native_floor.build()
     if native_floor.available():
-        nodes_l = store.list(KIND_NODE)
-        node_idx = {n.meta.name: i for i, n in enumerate(nodes_l)}
-        N = len(nodes_l)
-        alloc = np.stack([n.allocatable.to_vector() for n in nodes_l])
-        usage_pct = np.zeros_like(alloc, np.float32)
-        has_metric = np.zeros(N, np.int32)
-        for i, node in enumerate(nodes_l):
-            nm = store.get(KIND_NODE_METRIC, f"/{node.meta.name}")
-            if nm is None or nm.update_time <= 0:
-                continue
-            if now - nm.update_time >= plugin.args.node_metric_expiration_seconds:
-                continue
-            a = alloc[i]
-            u = nm.node_metric.node_usage.to_vector()
-            usage_pct[i] = np.where(a > 0, u * 100.0 / np.maximum(a, 1e-9), 0.0)
-            has_metric[i] = 1
-        pods_l = [p for p in store.list(KIND_POD)
-                  if p.is_assigned and not p.is_terminated]
-        pod_node = np.asarray(
-            [node_idx.get(p.spec.node_name, -1) for p in pods_l], np.int32)
-        pod_prio = np.asarray(
-            [p.spec.priority or 0 for p in pods_l], np.int32)
-        pod_req = np.stack([p.spec.requests.to_vector() for p in pods_l])
-        movable = np.asarray(
-            [p.meta.owner_kind != "DaemonSet" and not _has_pdb_like_guard(p)
-             for p in pods_l], np.int32)
-        from koordinator_tpu.api.resources import RESOURCE_INDEX, ResourceName
+        from koordinator_tpu.descheduler.lownodeload import pack_floor_inputs
 
-        pod_sort_cpu = pod_req[:, RESOURCE_INDEX[ResourceName.CPU]]
-        low_thr = plugin._thr_vec(plugin.args.low_thresholds)
-        high_thr = plugin._thr_vec(plugin.args.high_thresholds)
+        pods_l, floor_arrays = pack_floor_inputs(store, plugin, now)
         floor_times = []
         victim = None
         for _ in range(1 if args_cli.smoke else 3):
             t0 = time.perf_counter()
-            victim = native_floor.lownodeload_floor_native(
-                alloc, usage_pct, has_metric, low_thr, high_thr,
-                pod_node, pod_prio, pod_req, movable, pod_sort_cpu,
-                plugin.args.max_pods_to_evict_per_node)
+            victim = native_floor.lownodeload_floor_native(**floor_arrays)
             floor_times.append(time.perf_counter() - t0)
         t_floor = float(np.median(floor_times))
         compiled_pps = num_pods / t_floor if t_floor > 0 else 0.0

@@ -18,13 +18,18 @@ def main(argv=None) -> int:
     ap.add_argument("--mode", choices=["cri", "docker"], default="cri")
     ap.add_argument("--proxy-endpoint",
                     default="/var/run/koord-runtimeproxy.sock")
-    ap.add_argument("--backend-endpoint",
-                    default="/var/run/containerd/containerd.sock")
+    ap.add_argument("--backend-endpoint", default=None,
+                    help="runtime socket (default: containerd's for "
+                    "--mode cri, docker's for --mode docker)")
     ap.add_argument("--hook-server-endpoint",
                     help="koordlet hook server unix socket")
     ap.add_argument("--failure-policy", choices=["Ignore", "Fail"],
                     default="Ignore")
     args = ap.parse_args(argv)
+    if args.backend_endpoint is None:
+        args.backend_endpoint = (
+            "/var/run/docker.sock" if args.mode == "docker"
+            else "/var/run/containerd/containerd.sock")
 
     from koordinator_tpu.runtimeproxy.hookclient import HookClient
     from koordinator_tpu.runtimeproxy.server import FailurePolicy

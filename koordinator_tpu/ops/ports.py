@@ -135,20 +135,25 @@ def build_image_scores(pending_pods, nodes):
     SI = len(profiles)
     img_rows = np.zeros((max(SI, 1), N), np.float32)
     if SI and N:
-        # spread factor per image: fraction of nodes that have it
-        have_count: dict = {}
-        for node in nodes:
-            for name in node.images:
-                have_count[name] = have_count.get(name, 0) + 1
+        # vectorized: ONE [N, I] spread-weighted size matrix over the
+        # distinct referenced images, then each profile row is a column-sum
+        # (no per-(profile, node, image) Python loops — the snapshot's
+        # pack_wire_matrix discipline)
+        img_ids: dict = {}
+        for imgs in profiles:
+            for name in imgs:
+                img_ids.setdefault(name, len(img_ids))
+        size_mat = np.zeros((N, len(img_ids)), np.float64)
+        for n, node in enumerate(nodes):
+            for name, size in node.images.items():
+                j = img_ids.get(name)
+                if j is not None:
+                    size_mat[n, j] = size
+        have_frac = (size_mat > 0).sum(axis=0) / N          # spread factor
+        weighted = size_mat * have_frac[None, :]            # [N, I]
         for s, imgs in enumerate(profiles):
-            row = np.zeros(N, np.float32)
-            for n, node in enumerate(nodes):
-                total = 0.0
-                for name in imgs:
-                    size = node.images.get(name)
-                    if size:
-                        total += size * (have_count.get(name, 0) / N)
-                row[n] = total
+            cols = [img_ids[name] for name in imgs]
+            row = weighted[:, cols].sum(axis=1).astype(np.float32)
             lo, hi = _MIN_IMG, _MAX_IMG * max(len(imgs), 1)
             clipped = np.clip(row, lo, hi)
             img_rows[s] = np.floor(

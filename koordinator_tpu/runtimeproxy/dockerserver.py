@@ -149,16 +149,17 @@ class DockerProxyServer:
         return (qs.get("name") or [""])[0]
 
     def _intercept(self, method: str, path: str, body: bytes,
-                   ) -> Tuple[bytes, Optional[int]]:
-        """Returns (possibly mutated body, error status or None). Stop is
-        NOT handled here: its hook is post-forward (see _after_response)."""
+                   ) -> Tuple[bytes, Optional[int], Optional[str]]:
+        """Returns (possibly mutated body, error status or None, pending-
+        meta key for creates). Stop is NOT handled here: its hook is
+        post-forward (see _after_response)."""
         if method != "POST":
-            return body, None
+            return body, None, None
         if _CREATE_RE.match(path.split("?")[0]):
             try:
                 payload = json.loads(body or b"{}")
             except ValueError:
-                return body, None
+                return body, None, None
             labels = payload.get("Labels") or {}
             pod_meta = api_pb2.PodSandboxMeta(
                 name=labels.get(_LABEL_POD_NAME, ""),
@@ -176,20 +177,23 @@ class DockerProxyServer:
             )
             resp, abort = self._call_hook("PreCreateContainerHook", req)
             if abort:
-                return body, 502
+                return body, 502, None
             if resp is not None and resp.HasField("resources"):
                 _merge_hook_into_host_config(hc, resp.resources)
+            # dockershim always names containers (?name=...); unnamed
+            # creates get a unique token so concurrent ones cannot share
+            # the "" key and cross-bind pod metadata
+            import uuid
+
+            pending_key = self._query_name(path) or f"unnamed-{uuid.uuid4()}"
             with self._lock:
-                # id is assigned by the daemon; remember meta under the
-                # request name query param (dockershim names are unique)
-                self._pending_meta[self._query_name(path)] = (
-                    pod_meta, container_meta)
-            return json.dumps(payload).encode(), None
+                self._pending_meta[pending_key] = (pod_meta, container_meta)
+            return json.dumps(payload).encode(), None, pending_key
         m = _LIFECYCLE_RE.match(path.split("?")[0])
         if m:
             cid, op = m.group("id"), m.group("op")
             if op == "stop":  # post-forward hook: nothing to do pre-flight
-                return body, None
+                return body, None, None
             with self._lock:
                 pod_meta, container_meta = self.container_store.get(
                     cid, (api_pb2.PodSandboxMeta(), api_pb2.ContainerMeta()))
@@ -211,33 +215,38 @@ class DockerProxyServer:
                     req.resources.CopyFrom(_host_config_to_hook(payload))
                 resp, abort = self._call_hook(hook_method, req)
                 if abort:
-                    return body, 502
+                    return body, 502, None
                 if (payload is not None and resp is not None
                         and resp.HasField("resources")):
                     _merge_hook_into_host_config(payload, resp.resources)
-                    return json.dumps(payload).encode(), None
-                return body, None
+                    return json.dumps(payload).encode(), None, None
+                return body, None, None
             _resp, abort = self._call_hook(hook_method, req)
             if abort:
-                return body, 502
-        return body, None
+                return body, 502, None
+        return body, None, None
 
     def _after_response(self, method: str, path: str, status: int,
-                        resp_body: bytes) -> None:
+                        resp_body: bytes,
+                        pending_key: Optional[str] = None) -> None:
         """Post-forward bookkeeping: bind create ids, fire the post-stop
         hook only once the daemon CONFIRMED the stop (CRI-path order), and
         drop meta on stop/delete so the store cannot leak."""
         clean = path.split("?")[0]
         if method == "POST" and _CREATE_RE.match(clean):
-            if status != 201:
+            # pop the pending entry on EVERY create outcome — a rejected
+            # create (409/500) must not leak it
+            with self._lock:
+                meta = (self._pending_meta.pop(pending_key, None)
+                        if pending_key else None)
+            if status != 201 or meta is None:
                 return
             try:
                 cid = json.loads(resp_body).get("Id", "")
             except ValueError:
                 return
-            with self._lock:
-                meta = self._pending_meta.pop(self._query_name(path), None)
-                if cid and meta is not None:
+            if cid:
+                with self._lock:
                     self.container_store[cid] = meta
             return
         m = _LIFECYCLE_RE.match(clean)
@@ -274,13 +283,22 @@ class DockerProxyServer:
             def _relay(self):
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
-                body, err = proxy._intercept(self.command, self.path, body)
+                body, err, pending_key = proxy._intercept(
+                    self.command, self.path, body)
                 if err is not None:
                     self.send_response(err)
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
+                # hijacked/upgraded connections (exec/attach) cannot ride an
+                # http.client relay: refuse loudly instead of wedging
+                if "upgrade" in (self.headers.get("Connection") or "").lower():
+                    self.send_response(501)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
                 conn = _UnixHTTPConnection(proxy.backend_socket)
+                streamed = False
                 try:
                     headers = {
                         k: v for k, v in self.headers.items()
@@ -290,8 +308,31 @@ class DockerProxyServer:
                     conn.request(self.command, self.path, body=body,
                                  headers=headers)
                     resp = conn.getresponse()
+                    if resp.getheader("Content-Length") is None:
+                        # unbounded/streaming response (logs?follow, events,
+                        # stats?stream): forward chunks as they arrive —
+                        # buffering with read() would block forever
+                        streamed = True
+                        if conn.sock is not None:
+                            conn.sock.settimeout(None)  # sporadic stream
+                        self.send_response(resp.status)
+                        ctype = resp.getheader("Content-Type")
+                        if ctype:
+                            self.send_header("Content-Type", ctype)
+                        self.send_header("Connection", "close")
+                        self.end_headers()
+                        self.close_connection = True
+                        while True:
+                            chunk = resp.read(16384)
+                            if not chunk:
+                                break
+                            self.wfile.write(chunk)
+                            self.wfile.flush()
+                        return
                     resp_body = resp.read()
                 except OSError:
+                    if streamed:
+                        return  # headers already sent; peer/daemon gone
                     self.send_response(502)
                     self.send_header("Content-Length", "0")
                     self.end_headers()
@@ -299,7 +340,7 @@ class DockerProxyServer:
                 finally:
                     conn.close()
                 proxy._after_response(self.command, self.path, resp.status,
-                                      resp_body)
+                                      resp_body, pending_key)
                 self.send_response(resp.status)
                 self.send_header("Content-Length", str(len(resp_body)))
                 ctype = resp.getheader("Content-Type")

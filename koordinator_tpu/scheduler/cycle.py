@@ -242,7 +242,14 @@ class Scheduler:
             old_vec = pod.spec.requests.to_vector()
             others = (assigned.get(pod.spec.node_name,
                                    np.zeros_like(new_vec)) - old_vec)
-            alloc = node.allocatable.to_vector()
+            # the SAME trimmed allocatable the batch kernel fits against
+            # (node-reservation annotation trims — ops/estimator.py); raw
+            # status.allocatable would grant resizes into reserved cores
+            from koordinator_tpu.ops.estimator import (
+                estimate_node_allocatable,
+            )
+
+            alloc = estimate_node_allocatable(node)
             need = new_vec > 0
             if np.any(need & (others + new_vec > alloc)):
                 result.resize_pending.append(pod.meta.key)

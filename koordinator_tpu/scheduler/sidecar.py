@@ -191,7 +191,24 @@ def schedule_batch_or_fallback(client, fc, num_gangs: int, num_groups: int,
         resp = client.schedule_batch(req)
         return (tensor_to_np(resp.chosen), tensor_to_np(resp.requested),
                 tensor_to_np(resp.quota_used), False)
-    except (grpc.RpcError, ConnectionError, OSError):  # transport only
+    except grpc.RpcError as e:
+        # TRANSPORT failures degrade; server-side application errors
+        # (INVALID_ARGUMENT/INTERNAL: a schema or kernel bug) must surface,
+        # not silently burn an RPC round-trip every cycle forever
+        transport_codes = (
+            grpc.StatusCode.UNAVAILABLE,
+            grpc.StatusCode.DEADLINE_EXCEEDED,
+            grpc.StatusCode.CANCELLED,
+        )
+        if e.code() not in transport_codes:
+            raise
+        step = local_step or build_full_chain_step(
+            args, num_gangs, num_groups,
+            active_axes=list(active_axes) if active_axes else None)
+        chosen, requested, quota_used = step(fc)
+        return (np.asarray(chosen), np.asarray(requested),
+                np.asarray(quota_used), True)
+    except (ConnectionError, OSError):  # channel-level transport failure
         step = local_step or build_full_chain_step(
             args, num_gangs, num_groups,
             active_axes=list(active_axes) if active_axes else None)

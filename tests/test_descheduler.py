@@ -210,7 +210,7 @@ def test_balance_victim_set_matches_compiled_floor_non_dyadic():
     sequential accumulation is the contract)."""
     import random
 
-    from koordinator_tpu.api.resources import RESOURCE_INDEX
+    from koordinator_tpu.descheduler.lownodeload import pack_floor_inputs
     from koordinator_tpu.native import floor as native_floor
 
     if not (native_floor.available() or native_floor.build()):
@@ -229,33 +229,8 @@ def test_balance_victim_set_matches_compiled_floor_non_dyadic():
     jobs = plugin.balance(now=NOW)
     assert jobs
 
-    nodes_l = store.list(KIND_NODE)
-    node_idx = {n.meta.name: i for i, n in enumerate(nodes_l)}
-    alloc = np.stack([n.allocatable.to_vector() for n in nodes_l])
-    usage_pct = np.zeros_like(alloc, np.float32)
-    has_metric = np.zeros(len(nodes_l), np.int32)
-    for i, node in enumerate(nodes_l):
-        nm = store.get(KIND_NODE_METRIC, f"/{node.meta.name}")
-        if nm is None:
-            continue
-        a = alloc[i]
-        u = nm.node_metric.node_usage.to_vector()
-        usage_pct[i] = np.where(a > 0, u * 100.0 / np.maximum(a, 1e-9), 0.0)
-        has_metric[i] = 1
-    pods_l = [p for p in store.list(KIND_POD)
-              if p.is_assigned and not p.is_terminated]
-    pod_req = np.stack([p.spec.requests.to_vector() for p in pods_l])
-    victim = native_floor.lownodeload_floor_native(
-        alloc, usage_pct, has_metric,
-        plugin._thr_vec(plugin.args.low_thresholds),
-        plugin._thr_vec(plugin.args.high_thresholds),
-        np.asarray([node_idx.get(p.spec.node_name, -1) for p in pods_l],
-                   np.int32),
-        np.asarray([p.spec.priority or 0 for p in pods_l], np.int32),
-        pod_req,
-        np.ones(len(pods_l), np.int32),
-        pod_req[:, RESOURCE_INDEX[ResourceName.CPU]],
-        plugin.args.max_pods_to_evict_per_node)
+    pods_l, floor_arrays = pack_floor_inputs(store, plugin, NOW)
+    victim = native_floor.lownodeload_floor_native(**floor_arrays)
     floor_victims = {f"{pods_l[i].meta.namespace}/{pods_l[i].meta.name}"
                      for i in np.nonzero(victim)[0]}
     plugin_victims = {f"{j.pod_namespace}/{j.pod_name}" for j in jobs}
