@@ -308,7 +308,13 @@ class DockerProxyServer:
                     conn.request(self.command, self.path, body=body,
                                  headers=headers)
                     resp = conn.getresponse()
-                    if resp.getheader("Content-Length") is None:
+                    # 204/304 are BODYLESS — Go's net/http (real dockerd)
+                    # omits Content-Length on them, and stop/delete return
+                    # 204; they must take the buffered path or
+                    # _after_response (post-stop hook, store cleanup)
+                    # would never run
+                    if (resp.getheader("Content-Length") is None
+                            and resp.status not in (204, 304)):
                         # unbounded/streaming response (logs?follow, events,
                         # stats?stream): forward chunks as they arrive —
                         # buffering with read() would block forever
@@ -331,6 +337,9 @@ class DockerProxyServer:
                         return
                     resp_body = resp.read()
                 except OSError:
+                    if pending_key:  # failed create must not leak its meta
+                        with proxy._lock:
+                            proxy._pending_meta.pop(pending_key, None)
                     if streamed:
                         return  # headers already sent; peer/daemon gone
                     self.send_response(502)
@@ -390,6 +399,12 @@ class FakeDockerDaemon:
                 body = (json.dumps(payload).encode()
                         if payload is not None else b"")
                 self.send_response(status)
+                if status == 204:
+                    # Go's net/http omits Content-Length on 204 — mirror
+                    # it so the proxy's streaming detection is tested
+                    # against real-daemon behavior
+                    self.end_headers()
+                    return
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

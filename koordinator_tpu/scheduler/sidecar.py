@@ -187,6 +187,15 @@ def schedule_batch_or_fallback(client, fc, num_gangs: int, num_groups: int,
     # error that must surface, not silently degrade every cycle
     req = pack_request(fc, num_gangs, num_groups, args,
                        active_axes=active_axes)
+
+    def _local_fallback():
+        step = local_step or build_full_chain_step(
+            args, num_gangs, num_groups,
+            active_axes=list(active_axes) if active_axes else None)
+        chosen, requested, quota_used = step(fc)
+        return (np.asarray(chosen), np.asarray(requested),
+                np.asarray(quota_used), True)
+
     try:
         resp = client.schedule_batch(req)
         return (tensor_to_np(resp.chosen), tensor_to_np(resp.requested),
@@ -202,19 +211,9 @@ def schedule_batch_or_fallback(client, fc, num_gangs: int, num_groups: int,
         )
         if e.code() not in transport_codes:
             raise
-        step = local_step or build_full_chain_step(
-            args, num_gangs, num_groups,
-            active_axes=list(active_axes) if active_axes else None)
-        chosen, requested, quota_used = step(fc)
-        return (np.asarray(chosen), np.asarray(requested),
-                np.asarray(quota_used), True)
+        return _local_fallback()
     except (ConnectionError, OSError):  # channel-level transport failure
-        step = local_step or build_full_chain_step(
-            args, num_gangs, num_groups,
-            active_axes=list(active_axes) if active_axes else None)
-        chosen, requested, quota_used = step(fc)
-        return (np.asarray(chosen), np.asarray(requested),
-                np.asarray(quota_used), True)
+        return _local_fallback()
 
 
 class SidecarClient:

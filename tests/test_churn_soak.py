@@ -1,0 +1,161 @@
+"""Multi-cycle churn soak: 25 scheduling cycles with random pod arrivals,
+deletions, and metric updates, checking CLUSTER-LEVEL INVARIANTS from the
+store after every cycle — the integration net single-cycle parity tests
+cannot cast. Invariants mirror what the reference's admission chain
+guarantees: no node overcommitted past (trimmed) allocatable, no hostPort
+double-bind, gang all-or-nothing, CSI volume limits respected."""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.objects import (
+    LABEL_POD_GROUP,
+    Node,
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    PodGroup,
+    PodSpec,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client.store import (
+    KIND_NODE,
+    KIND_POD,
+    KIND_POD_GROUP,
+    ObjectStore,
+)
+from koordinator_tpu.ops.estimator import estimate_node_allocatable
+from koordinator_tpu.scheduler.cycle import Scheduler
+
+GIB = 1024**3
+ZONE = "topology.kubernetes.io/zone"
+
+
+def _check_invariants(store: ObjectStore) -> None:
+    nodes = {n.meta.name: n for n in store.list(KIND_NODE)}
+    pods = [p for p in store.list(KIND_POD)
+            if p.is_assigned and not p.is_terminated]
+    by_node = {}
+    for p in pods:
+        by_node.setdefault(p.spec.node_name, []).append(p)
+    for name, plist in by_node.items():
+        node = nodes.get(name)
+        assert node is not None, f"pod bound to unknown node {name}"
+        # 1. capacity: sum of requests <= trimmed allocatable per axis
+        total = np.zeros_like(estimate_node_allocatable(node))
+        for p in plist:
+            total = total + p.spec.requests.to_vector()
+        alloc = estimate_node_allocatable(node)
+        over = total > alloc + 1e-3
+        assert not over.any(), (
+            f"node {name} overcommitted: {total[over]} > {alloc[over]}")
+        # 2. hostPorts: no (protocol, port) bound twice
+        seen = set()
+        for p in plist:
+            for slot in p.spec.host_ports:
+                assert slot not in seen, (
+                    f"hostPort {slot} double-bound on {name}")
+                seen.add(slot)
+        # 3. volume limit
+        if node.attachable_volume_limit > 0:
+            claims = set()
+            for p in plist:
+                claims.update(
+                    f"{p.meta.namespace}/{c}" for c in p.spec.pvc_names)
+            assert len(claims) <= node.attachable_volume_limit, (
+                f"node {name} exceeds volume limit")
+    # 4. gang all-or-nothing: a gang with any bound member has >= min bound
+    gangs = {g.meta.key: g for g in store.list(KIND_POD_GROUP)}
+    bound_per_gang = {}
+    for p in pods:
+        g = p.gang_key
+        if g:
+            bound_per_gang[g] = bound_per_gang.get(g, 0) + 1
+    for g, count in bound_per_gang.items():
+        pg = gangs.get(g)
+        if pg is not None:
+            assert count >= pg.min_member, (
+                f"gang {g} partially bound: {count} < {pg.min_member}")
+
+
+def test_churn_soak_25_cycles():
+    rng = random.Random(11)
+    store = ObjectStore()
+    for i in range(12):
+        node = Node(
+            meta=ObjectMeta(name=f"n{i}", namespace=""),
+            allocatable=ResourceList.of(cpu=16_000, memory=64 * GIB,
+                                        pods=50))
+        node.meta.labels[ZONE] = f"z{i % 3}"
+        if i % 4 == 0:
+            node.attachable_volume_limit = 3
+        if i % 5 == 0:
+            node.meta.annotations[
+                "node.koordinator.sh/reservation"] = json.dumps(
+                    {"resources": {"cpu": "2", "memory": "4Gi"}})
+        store.add(KIND_NODE, node)
+    sched = Scheduler(store)
+    uid = 0
+    now = 1_000_000.0
+    total_bound = 0
+    for cycle in range(25):
+        now += 5.0
+        # arrivals: 4-10 pods with a random feature mix
+        for _ in range(rng.randint(4, 10)):
+            uid += 1
+            pod = Pod(
+                meta=ObjectMeta(name=f"p{uid}", uid=f"p{uid}",
+                                creation_timestamp=now,
+                                labels={"app": rng.choice("abc")}),
+                spec=PodSpec(requests=ResourceList.of(
+                    cpu=rng.choice([500, 1000, 2000]),
+                    memory=rng.choice([1, 2, 4]) * GIB)))
+            r = rng.random()
+            if r < 0.15:
+                pod.spec.host_ports.append(
+                    ("TCP", rng.choice([80, 443, 9090])))
+            elif r < 0.3:
+                pod.spec.pvc_names = [f"claim-{uid}"]
+            elif r < 0.45:
+                pod.spec.pod_anti_affinity.append(PodAffinityTerm(
+                    selector={"app": pod.meta.labels["app"]},
+                    topology_key=ZONE))
+            store.add(KIND_POD, pod)
+        # a gang every few cycles
+        if cycle % 5 == 1:
+            gname = f"gang-{cycle}"
+            store.add(KIND_POD_GROUP, PodGroup(
+                meta=ObjectMeta(name=gname, namespace="default",
+                                creation_timestamp=now),
+                min_member=3))
+            for j in range(3):
+                uid += 1
+                pod = Pod(
+                    meta=ObjectMeta(
+                        name=f"g{uid}", uid=f"g{uid}",
+                        creation_timestamp=now,
+                        labels={LABEL_POD_GROUP: gname}),
+                    spec=PodSpec(requests=ResourceList.of(
+                        cpu=1000, memory=GIB)))
+                store.add(KIND_POD, pod)
+        # departures: delete a few running pods (gang members excluded —
+        # deleting one leaves its gang legitimately below min_member, which
+        # is lifecycle churn, not a scheduler all-or-nothing violation)
+        running = [p for p in store.list(KIND_POD)
+                   if p.is_assigned and not p.is_terminated
+                   and not p.gang_key]
+        for p in rng.sample(running, min(2, len(running))):
+            store.delete(KIND_POD, p.meta.key)
+
+        result = sched.run_cycle(now=now)
+        total_bound += len(result.bound)
+        for b in result.bound:  # bind -> Running, as the kubelet would
+            pod = store.get(KIND_POD, b.pod_key)
+            if pod is not None:
+                pod.phase = "Running"
+                store.update(KIND_POD, pod)
+        _check_invariants(store)
+    assert total_bound > 100, f"soak bound only {total_bound} pods"
