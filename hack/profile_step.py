@@ -1,0 +1,89 @@
+"""On-chip step profiling: isolate H2D transfer vs kernel math.
+
+Runs the headline 10k x 5k fixture through the pallas full-chain step in
+three modes and prints per-mode medians:
+  numpy   — inputs as numpy arrays (what bench.py timed through round 4):
+            every call pays host->device transfer of the whole snapshot
+  device  — inputs jax.device_put once; calls consume device arrays
+  device+nobal — device-resident AND balanced-allocation score compiled out
+            (semantics change: diagnostic only, not a bench configuration)
+
+Usage: python hack/profile_step.py [--pods P] [--nodes N] [--iters K]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def log(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=10_000)
+    ap.add_argument("--nodes", type=int, default=5_000)
+    ap.add_argument("--iters", type=int, default=20)
+    a = ap.parse_args()
+
+    import jax
+
+    from koordinator_tpu.models.full_chain import build_best_full_chain_step
+    from koordinator_tpu.ops.loadaware import LoadAwareArgs
+    from koordinator_tpu.scheduler.snapshot import (
+        build_full_chain_inputs,
+        reduce_to_active_axes,
+    )
+    from koordinator_tpu.testing import synth_full_cluster
+
+    la = LoadAwareArgs()
+    log(f"devices: {jax.devices()}")
+    cluster, state = synth_full_cluster(
+        a.nodes, a.pods, seed=42,
+        num_quotas=max(8, a.pods // 100), num_gangs=max(4, a.pods // 50))
+    fc, pods, nodes, tree, gang_index, ng, ngroups = build_full_chain_inputs(
+        state, la)
+    fc, active = reduce_to_active_axes(fc)
+
+    def bench(step, inputs, label):
+        out = step(inputs)
+        jax.block_until_ready(out[0])
+        times = []
+        for _ in range(a.iters):
+            t0 = time.perf_counter()
+            out = step(inputs)
+            jax.block_until_ready(out[0])
+            times.append(time.perf_counter() - t0)
+        med = float(np.median(times))
+        log(f"{label:16s} median {med*1000:8.2f} ms  "
+            f"({pods.num_valid/med:,.0f} pods/s)")
+        return np.asarray(out[0]), med
+
+    step = build_best_full_chain_step(la, ng, ngroups, active_axes=active)
+    chosen_np, t_np = bench(step, fc, "numpy-inputs")
+
+    fc_dev = jax.tree.map(jax.device_put, fc)
+    jax.block_until_ready(fc_dev.base.allocatable)
+    chosen_dev, t_dev = bench(step, fc_dev, "device-resident")
+    assert (chosen_np == chosen_dev).all(), "device-resident bindings differ!"
+
+    # diagnostic: balanced-allocation compiled out (forces bal_idx = (-1,-1))
+    import koordinator_tpu.models.full_chain as fcmod
+
+    orig = fcmod.resolve_balance_idx
+    fcmod.resolve_balance_idx = lambda active_axes: (-1, -1)
+    try:
+        step2 = build_best_full_chain_step(la, ng, ngroups,
+                                           active_axes=active)
+        bench(step2, fc_dev, "device+nobal")
+    finally:
+        fcmod.resolve_balance_idx = orig
+    log(f"h2d share of numpy-input step: {(t_np - t_dev)*1000:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""Second-pass on-chip profiling with full distributions.
+
+Prints every iteration time for: numpy-inputs dispatch, device-resident
+(same VOL variant forced), and the bal-less diagnostic, plus a pure
+replay of bench.py's exact timing pattern.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def log(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def dist(times):
+    a = np.asarray(times) * 1000
+    return (f"med {np.median(a):7.2f}  min {a.min():7.2f}  "
+            f"max {a.max():7.2f}  all " +
+            " ".join(f"{x:.0f}" for x in a))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=10_000)
+    ap.add_argument("--nodes", type=int, default=5_000)
+    ap.add_argument("--iters", type=int, default=15)
+    a = ap.parse_args()
+
+    import jax
+
+    from koordinator_tpu.models.full_chain import build_best_full_chain_step
+    from koordinator_tpu.ops.loadaware import LoadAwareArgs
+    from koordinator_tpu.ops.pallas_full_chain import (
+        build_pallas_full_chain_step,
+    )
+    from koordinator_tpu.scheduler.snapshot import (
+        build_full_chain_inputs,
+        reduce_to_active_axes,
+    )
+    from koordinator_tpu.testing import synth_full_cluster
+
+    la = LoadAwareArgs()
+    log(f"devices: {jax.devices()}")
+    cluster, state = synth_full_cluster(
+        a.nodes, a.pods, seed=42,
+        num_quotas=max(8, a.pods // 100), num_gangs=max(4, a.pods // 50))
+    fc, pods, nodes, tree, gang_index, ng, ngroups = build_full_chain_inputs(
+        state, la)
+    fc, active = reduce_to_active_axes(fc)
+
+    def bench(step, inputs, label):
+        out = step(inputs)
+        jax.block_until_ready(out[0])
+        times = []
+        for _ in range(a.iters):
+            t0 = time.perf_counter()
+            out = step(inputs)
+            jax.block_until_ready(out[0])
+            times.append(time.perf_counter() - t0)
+        log(f"{label:18s} {dist(times)}")
+        return np.asarray(out[0])
+
+    # exact pallas variant, volume machinery OFF (the bench headline path)
+    pstep = build_pallas_full_chain_step(la, ng, ngroups, active_axes=active,
+                                         enable_volumes=False)
+    c1 = bench(pstep, fc, "pallas-novol-numpy")
+    fc_dev = jax.tree.map(jax.device_put, fc)
+    jax.block_until_ready(fc_dev.base.allocatable)
+    c2 = bench(pstep, fc_dev, "pallas-novol-dev")
+    assert (c1 == c2).all()
+
+    # dispatch wrapper as bench.py uses it
+    dstep = build_best_full_chain_step(la, ng, ngroups, active_axes=active)
+    bench(dstep, fc, "dispatch-numpy")
+    log(f"dispatch backend: {dstep.last_backend}")
+
+
+if __name__ == "__main__":
+    main()

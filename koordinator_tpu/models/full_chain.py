@@ -157,6 +157,15 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode,
     T = fc.aff_dom.shape[1]
     PT = fc.port_used.shape[1]
 
+    # balanced-allocation reciprocals hoisted out of the pod loop
+    # (ops/pallas_common.safe_reciprocal documents the cross-kernel
+    # bit-parity contract)
+    if bal_idx[0] >= 0:
+        from koordinator_tpu.ops.pallas_common import safe_reciprocal
+
+        bal_inv_c, bal_inv_m = (
+            safe_reciprocal(inputs.allocatable[:, axis]) for axis in bal_idx)
+
     def evaluate(i, requested, delta_np, delta_pr, numa_free, bind_free,
                  quota_used, aff_count, anti_cover, aff_exists, port_used,
                  vol_free):
@@ -246,13 +255,10 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode,
         # and a zero-capacity axis contributes fraction 0
         if bal_idx[0] >= 0:
             ci, mi = bal_idx
-            def _frac(axis):
-                cap = inputs.allocatable[:, axis]
-                safe = jnp.where(cap > 0, cap, 1.0)
-                f = jnp.where(
-                    cap > 0, (requested[:, axis] + req_fit[axis]) / safe, 0.0)
-                return jnp.minimum(f, 1.0)
-            std = jnp.abs(_frac(ci) - _frac(mi)) * 0.5
+            def _frac(axis, inv):
+                return jnp.minimum(
+                    (requested[:, axis] + req_fit[axis]) * inv, 1.0)
+            std = jnp.abs(_frac(ci, bal_inv_c) - _frac(mi, bal_inv_m)) * 0.5
             bal_row = jnp.floor((1.0 - std) * 100.0)
             numa_score = numa_score + bal_row
         else:
@@ -501,13 +507,33 @@ def build_best_full_chain_step(args: LoadAwareArgs, num_gangs: int,
         if estimate_vmem_bytes(N, R, K, G, P, T, S, PT, SI) <= budget:
             step.last_backend = "pallas"
             # the snapshot builder hands HOST (numpy) arrays, so this check
-            # is sync-free; device arrays / tracers conservatively keep the
-            # volume machinery rather than forcing a device->host transfer
+            # is sync-free; CONCRETE device arrays (device-resident snapshot
+            # state) are checked once per buffer and memoized — only tracers
+            # conservatively keep the volume machinery
             vn = fc.vol_needed
-            vol = bool((vn > 0).any()) if isinstance(vn, np.ndarray) else True
+            if isinstance(vn, np.ndarray):
+                vol = bool((vn > 0).any())
+            elif isinstance(vn, jax.Array) and not isinstance(
+                    vn, jax.core.Tracer):
+                import weakref
+
+                # memoized per live array object: the weakref guards
+                # against id() reuse after GC handing back a stale flag
+                cache = step._vol_flags
+                hit = cache.get(id(vn))
+                if hit is not None and hit[0]() is vn:
+                    vol = hit[1]
+                else:
+                    vol = bool((np.asarray(vn) > 0).any())
+                    if len(cache) > 64:
+                        cache.clear()
+                    cache[id(vn)] = (weakref.ref(vn), vol)
+            else:
+                vol = True
             return _pallas(vol)(fc)
         step.last_backend = "xla"
         return xla_step(fc)
 
     step.last_backend = None
+    step._vol_flags = {}
     return step

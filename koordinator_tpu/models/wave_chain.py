@@ -190,10 +190,15 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                 base_m = requested[best_w, mi] + req_fit_w[:, mi]
 
                 def _pair_frac(base_e, cap_e, waxis):
-                    safe = jnp.where(cap_e > 0, cap_e, 1.0)        # [W]
-                    f = (base_e[None, :] + waxis[:, None]) / safe[None, :]
-                    return jnp.minimum(
-                        jnp.where(cap_e[None, :] > 0, f, 0.0), 1.0)
+                    # reciprocal-multiply form, identical to the evaluator's
+                    # _frac so the post-commit bal value is exact
+                    from koordinator_tpu.ops.pallas_common import (
+                        safe_reciprocal,
+                    )
+
+                    inv = safe_reciprocal(cap_e)                       # [W]
+                    f = (base_e[None, :] + waxis[:, None]) * inv[None, :]
+                    return jnp.minimum(f, 1.0)
 
                 fpc = _pair_frac(base_c, cap_c, req_fit_w[:, ci])  # [W, W]
                 fpm = _pair_frac(base_m, cap_m, req_fit_w[:, mi])

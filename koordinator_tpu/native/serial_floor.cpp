@@ -319,15 +319,19 @@ void koord_serial_full_chain(
       float numa_score = std::floor(acc2 / wdiv);
       // NodeResourcesBalancedAllocation: 2-axis std == |fc - fm| / 2
       if (bal_ci >= 0) {
+        // reciprocal-multiply, NOT division: matches the f32 value the
+        // XLA/Pallas/numpy implementations compute (used * f32(1/cap))
         float fc_ = 0.0f, fm_ = 0.0f;
         float capc = alloc[bal_ci];
         if (capc > 0.0f) {
-          fc_ = (reqn[bal_ci] + fitp[bal_ci]) / capc;
+          float invc = 1.0f / capc;
+          fc_ = (reqn[bal_ci] + fitp[bal_ci]) * invc;
           if (fc_ > 1.0f) fc_ = 1.0f;
         }
         float capm = alloc[bal_mi];
         if (capm > 0.0f) {
-          fm_ = (reqn[bal_mi] + fitp[bal_mi]) / capm;
+          float invm = 1.0f / capm;
+          fm_ = (reqn[bal_mi] + fitp[bal_mi]) * invm;
           if (fm_ > 1.0f) fm_ = 1.0f;
         }
         float std_ = std::fabs(fc_ - fm_) * 0.5f;

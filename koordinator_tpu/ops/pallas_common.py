@@ -77,6 +77,16 @@ def weight_col(consts, R: int) -> jnp.ndarray:
     return col
 
 
+def safe_reciprocal(cap) -> jnp.ndarray:
+    """f32 1/cap with 0 for cap <= 0. The balanced-allocation score in every
+    implementation (XLA evaluator, Pallas kernel, wave kernel, numpy oracle,
+    C++ floor) computes f = min(used * safe_reciprocal(cap), 1) — the SAME
+    f32 reciprocal-multiply — so bit-parity across kernels holds while the
+    per-pod division rows disappear. The JAX sites all call this helper; the
+    numpy/C++ forms transcribe it (1.0f/cap guarded by cap > 0)."""
+    return jnp.where(cap > 0, 1.0 / jnp.where(cap > 0, cap, 1.0), 0.0)
+
+
 def least_requested_rem(rem, safe_cap, cap_pos) -> jnp.ndarray:
     """least_requested with the remainder (alloc - used) precomputed and
     safe_cap/cap_pos hoisted out of the per-pod loop: rem >= 0 is exactly

@@ -164,6 +164,12 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         iota = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)[0]
         safe_cap = jnp.where(alloc > 0, alloc, 1.0)
         cap_pos = alloc > 0
+        if BAL[0] >= 0:
+            # balanced-allocation reciprocals once per grid step (8 pods)
+            # instead of two division rows per pod (pc.safe_reciprocal
+            # documents the cross-kernel bit-parity contract)
+            bal_inv_c, bal_inv_m = (
+                pc.safe_reciprocal(alloc[axis:axis + 1, :]) for axis in BAL)
         single_node = policy == POLICY_SINGLE_NUMA_NODE              # [N]
         fitreq_blk = fitreq_ref[:]
         rawreq_blk = rawreq_ref[:]
@@ -314,15 +320,14 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
             if BAL[0] >= 0:
                 ci, mi = BAL
 
-                def _frac(axis):
+                def _frac(axis, inv):
                     cap = alloc[axis:axis + 1, :]
-                    safe = jnp.where(cap > 0, cap, 1.0)
                     used = (cap - headroom[axis:axis + 1, :]
                             + fit_need[axis, 0])
-                    return jnp.minimum(
-                        jnp.where(cap > 0, used / safe, 0.0), 1.0)
+                    return jnp.minimum(used * inv, 1.0)
 
-                bal_std = jnp.abs(_frac(ci) - _frac(mi)) * 0.5
+                bal_std = jnp.abs(
+                    _frac(ci, bal_inv_c) - _frac(mi, bal_inv_m)) * 0.5
                 score = score + jnp.floor(
                     (1.0 - bal_std) * 100.0)[0, :]
             # preferred node affinity: static profile row one-hot select

@@ -426,8 +426,12 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs,
                     cap = allocatable[n, axis]
                     if cap <= 0:
                         return np.float32(0.0)
+                    # reciprocal-multiply, NOT division: every impl
+                    # (XLA/Pallas/C++) uses used * f32(1/cap) so the
+                    # f32 results are bit-identical across the four
+                    inv = np.float32(1.0) / cap
                     f = np.float32(
-                        (requested[n, axis] + fit_requests[p, axis]) / cap)
+                        (requested[n, axis] + fit_requests[p, axis]) * inv)
                     return min(f, np.float32(1.0))
                 std = np.float32(
                     np.abs(_frac(bal_ci) - _frac(bal_mi)) * np.float32(0.5))

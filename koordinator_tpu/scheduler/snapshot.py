@@ -65,7 +65,15 @@ def reduce_to_active_axes(fc: FullChainInputs):
     threshold (zero axes never constrain — k8s semantics), plus the pods axis.
     Cuts per-iteration memory traffic of the serial loop by ~3x at the 10k x 5k
     config; the parity emulator consumes the same sliced arrays, so semantics are
-    unchanged by construction. Returns (sliced_inputs, active_axis_ids)."""
+    unchanged by construction. Returns (sliced_inputs, active_axis_ids).
+
+    The NUMA zone axis is sliced the same way: trailing all-zero zones (the
+    MAX_NUMA padding past the cluster's real socket count) can never fit a
+    pod with any positive request nor contribute to the cross-zone total, so
+    dropping them is exact for every consumer (XLA/Pallas/wave kernels, the
+    numpy oracle and the C++ floor all read K from the array shape). A
+    2-socket fleet pays for 2 zones instead of 8 — the per-pod NUMA fit and
+    waterfall are the serial loop's widest row blocks."""
     base = fc.base
     active = np.zeros(NUM_RESOURCES, bool)
     active[PODS_IDX] = True
@@ -104,6 +112,15 @@ def reduce_to_active_axes(fc: FullChainInputs):
         for k, v in fc._asdict().items()
         if k != "base"
     }
+    # zone-axis slice: keep zones up to the highest with any capacity or
+    # free anywhere in the fleet (>=1 so shapes stay rank-stable)
+    nf = np.asarray(kwargs["numa_free"])
+    nc = np.asarray(kwargs["numa_capacity"])
+    zone_any = (nf != 0).any(axis=(0, 2)) | (nc != 0).any(axis=(0, 2))
+    k_eff = max(1, int(np.nonzero(zone_any)[0].max()) + 1 if zone_any.any() else 1)
+    if k_eff < nf.shape[1]:
+        kwargs["numa_free"] = nf[:, :k_eff]
+        kwargs["numa_capacity"] = nc[:, :k_eff]
     return FullChainInputs(base=new_base, **kwargs), [int(i) for i in idx]
 
 
