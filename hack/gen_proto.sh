@@ -6,6 +6,8 @@ set -euo pipefail
 cd "$(dirname "$0")/../koordinator_tpu/runtimeproxy"
 protoc --python_out=. -I. api.proto
 protoc --python_out=. -I. cri.proto
+cd ../koordlet
+protoc --python_out=. -I. nri.proto
 cd ../scheduler
 protoc --python_out=. -I. sidecar.proto
-echo "generated api_pb2.py + cri_pb2.py + sidecar_pb2.py"
+echo "generated api_pb2.py + cri_pb2.py + nri_pb2.py + sidecar_pb2.py"

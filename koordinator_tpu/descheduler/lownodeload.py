@@ -36,6 +36,11 @@ from koordinator_tpu.client.store import (
 CPU = RESOURCE_INDEX[ResourceName.CPU]
 MEM = RESOURCE_INDEX[ResourceName.MEMORY]
 
+# store -> {expiration -> RebalancePackCache}; weak so stores die normally
+import weakref  # noqa: E402
+
+_PACK_CACHES: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
 
 @dataclass
 class LowNodeLoadArgs:
@@ -63,12 +68,217 @@ def classify_nodes(
     return low & ~high, high
 
 
+class RebalancePackCache:
+    """Event-maintained packed arrays for the rebalance pass.
+
+    The reference keeps incremental caches and walks them per run
+    (utilization_util.go reads informer caches, not the API server); the
+    batch analog keeps the pod/node state PACKED so `select_victims` is
+    pure array math — the store walk and object packing move out of the
+    per-pass cost entirely. Slots are append-only (compacted when >50%
+    dead) so masked views preserve store insertion order, which the
+    stable lexsort relies on for exact victim-set parity with the serial
+    C++ floor."""
+
+    _GROW = 1024
+
+    @classmethod
+    def for_store(cls, store: ObjectStore,
+                  expiration_seconds: float) -> "RebalancePackCache":
+        """One cache per (store, expiration): ObjectStore has no
+        unsubscribe, so every construction would leak a live handler —
+        repeat LowNodeLoad constructions on the same store (per-pass
+        plugin re-inits) must share the subscription."""
+        by_exp = _PACK_CACHES.setdefault(store, {})
+        cache = by_exp.get(expiration_seconds)
+        if cache is None:
+            cache = cls(store, expiration_seconds)
+            by_exp[expiration_seconds] = cache
+        return cache
+
+    def __init__(self, store: ObjectStore,
+                 expiration_seconds: float) -> None:
+        self.store = store
+        self.expiration = expiration_seconds
+        # node side
+        self._node_names: List[str] = []
+        self._node_idx: Dict[str, int] = {}
+        self.alloc = np.zeros((0, NUM_RESOURCES), np.float32)
+        self.usage_pct = np.zeros((0, NUM_RESOURCES), np.float32)
+        self.nm_time = np.zeros(0, np.float64)
+        self.has_raw = np.zeros(0, bool)
+        self._nodes_stale = True
+        # pod side (append-only slots)
+        self._slot: Dict[str, int] = {}
+        self._cap = 0
+        self._len = 0
+        self._dead = 0
+        self.pod_alive = np.zeros(0, bool)
+        self.pod_node_name: List[Optional[str]] = []
+        self.pod_node = np.zeros(0, np.int64)
+        self._pod_node_stale = True
+        self.pod_prio = np.zeros(0, np.int64)
+        self.pod_cpu = np.zeros(0, np.float32)
+        self.pod_req = np.zeros((0, NUM_RESOURCES), np.float32)
+        self.pod_movable = np.zeros(0, bool)
+        self.pod_ref: List[Optional[Pod]] = []
+        store.subscribe(KIND_NODE, self._on_node)
+        store.subscribe(KIND_NODE_METRIC, self._on_metric)
+        store.subscribe(KIND_POD, self._on_pod)
+
+    # -- events --------------------------------------------------------
+    def _on_node(self, ev, node, old) -> None:
+        self._nodes_stale = True
+
+    def _on_metric(self, ev, nm, old) -> None:
+        # metric rows refresh lazily with the node table; a metric-only
+        # update just recomputes that row
+        self._nodes_stale = True
+
+    def _on_pod(self, ev, pod: Pod, old) -> None:
+        from koordinator_tpu.client.store import EventType
+
+        key = pod.meta.key
+        slot = self._slot.get(key)
+        live = (ev is not EventType.DELETED and pod.is_assigned
+                and not pod.is_terminated)
+        if not live:
+            if slot is not None and self.pod_alive[slot]:
+                self.pod_alive[slot] = False
+                self.pod_ref[slot] = None
+                self._dead += 1
+            if ev is EventType.DELETED:
+                # a deleted-then-recreated pod must land in a FRESH slot:
+                # the store dict re-inserts it at the end, and slot order
+                # must track store insertion order for sort-parity with
+                # the cold pass / C++ floor (terminated-in-place pods keep
+                # their slot — the store preserves their dict position)
+                self._slot.pop(key, None)
+            return
+        if slot is None:
+            if self._len == self._cap:
+                grow = max(self._GROW, self._cap)
+                self.pod_alive = np.concatenate(
+                    [self.pod_alive, np.zeros(grow, bool)])
+                self.pod_node = np.concatenate(
+                    [self.pod_node, np.full(grow, -1, np.int64)])
+                self.pod_prio = np.concatenate(
+                    [self.pod_prio, np.zeros(grow, np.int64)])
+                self.pod_cpu = np.concatenate(
+                    [self.pod_cpu, np.zeros(grow, np.float32)])
+                self.pod_req = np.concatenate(
+                    [self.pod_req,
+                     np.zeros((grow, NUM_RESOURCES), np.float32)])
+                self.pod_movable = np.concatenate(
+                    [self.pod_movable, np.zeros(grow, bool)])
+                self.pod_node_name.extend([None] * grow)
+                self.pod_ref.extend([None] * grow)
+                self._cap += grow
+            slot = self._len
+            self._slot[key] = slot
+            self._len += 1
+        elif not self.pod_alive[slot]:
+            self._dead -= 1
+        self.pod_alive[slot] = True
+        self.pod_node_name[slot] = pod.spec.node_name
+        self.pod_prio[slot] = pod.spec.priority or 0
+        self.pod_cpu[slot] = pod.spec.requests[ResourceName.CPU]
+        self.pod_req[slot] = pod.spec.requests.to_vector()
+        self.pod_movable[slot] = (
+            pod.meta.owner_kind != "DaemonSet"
+            and not _has_pdb_like_guard(pod))
+        self.pod_ref[slot] = pod
+        self._pod_node_stale = True
+
+    # -- refresh -------------------------------------------------------
+    def _refresh_nodes(self) -> None:
+        nodes = self.store.list(KIND_NODE)
+        names = [n.meta.name for n in nodes]
+        remap = names != self._node_names
+        if remap:
+            self._node_names = names
+            self._node_idx = {n: i for i, n in enumerate(names)}
+            self._pod_node_stale = True
+        N = len(nodes)
+        self.alloc = np.zeros((N, NUM_RESOURCES), np.float32)
+        self.usage_pct = np.zeros((N, NUM_RESOURCES), np.float32)
+        self.nm_time = np.zeros(N, np.float64)
+        self.has_raw = np.zeros(N, bool)
+        for i, node in enumerate(nodes):
+            self.alloc[i] = node.allocatable.to_vector()
+            nm: Optional[NodeMetric] = self.store.get(
+                KIND_NODE_METRIC, f"/{node.meta.name}")
+            if nm is None or nm.update_time <= 0:
+                continue
+            usage = nm.node_metric.node_usage.to_vector()
+            a = self.alloc[i]
+            with np.errstate(divide="ignore", invalid="ignore"):
+                self.usage_pct[i] = np.where(
+                    a > 0, usage * 100.0 / np.maximum(a, 1e-9), 0.0)
+            self.nm_time[i] = nm.update_time
+            self.has_raw[i] = True
+        self._nodes_stale = False
+
+    def _compact(self) -> None:
+        keep = np.nonzero(self.pod_alive[: self._len])[0]
+        self.pod_alive = np.concatenate(
+            [np.ones(keep.size, bool), np.zeros(self._cap - keep.size, bool)])
+        for arr_name in ("pod_node", "pod_prio", "pod_cpu", "pod_movable"):
+            arr = getattr(self, arr_name)
+            packed = arr[keep]
+            arr[: keep.size] = packed
+            arr[keep.size:] = 0
+        self.pod_req[: keep.size] = self.pod_req[keep]
+        self.pod_req[keep.size:] = 0
+        names = [self.pod_node_name[k] for k in keep]
+        refs = [self.pod_ref[k] for k in keep]
+        pad = self._cap - keep.size
+        self.pod_node_name = names + [None] * pad
+        self.pod_ref = refs + [None] * pad
+        self._slot = {
+            refs[j].meta.key: j for j in range(keep.size)
+        }
+        self._len = keep.size
+        self._dead = 0
+
+    def view(self, now: float):
+        """(packed arrays dict) for select_victims — refreshes lazily."""
+        if self._nodes_stale:
+            self._refresh_nodes()
+        if self._dead * 2 > max(1, self._len):
+            self._compact()
+        if self._pod_node_stale:
+            idx = self._node_idx
+            for j in range(self._len):
+                name = self.pod_node_name[j]
+                self.pod_node[j] = idx.get(name, -1) if name else -1
+            self._pod_node_stale = False
+        has_metric = self.has_raw & (
+            now - self.nm_time < self.expiration)
+        return {
+            "alloc": self.alloc,
+            "usage_pct": self.usage_pct,
+            "has_metric": has_metric,
+            "pod_alive": self.pod_alive[: self._len],
+            "pod_node": self.pod_node[: self._len],
+            "pod_prio": self.pod_prio[: self._len],
+            "pod_cpu": self.pod_cpu[: self._len],
+            "pod_req": self.pod_req[: self._len],
+            "pod_movable": self.pod_movable[: self._len],
+        }
+
+
 class LowNodeLoad:
     name = "LowNodeLoad"
 
-    def __init__(self, store: ObjectStore, args: Optional[LowNodeLoadArgs] = None):
+    def __init__(self, store: ObjectStore, args: Optional[LowNodeLoadArgs] = None,
+                 incremental: bool = True):
         self.store = store
         self.args = args or LowNodeLoadArgs()
+        self.pack_cache = (
+            RebalancePackCache.for_store(
+                store, self.args.node_metric_expiration_seconds)
+            if incremental else None)
 
     def _thr_vec(self, thr: Dict[str, float]) -> np.ndarray:
         v = np.zeros(NUM_RESOURCES, np.float32)
@@ -76,110 +286,163 @@ class LowNodeLoad:
             v[RESOURCE_INDEX[name]] = t
         return v
 
-    def balance(self, now: Optional[float] = None) -> List[PodMigrationJob]:
-        now = time.time() if now is None else now
+    def _cold_view(self, now: float):
+        """Walk-everything packing (incremental=False path); same array
+        contract as RebalancePackCache.view."""
         nodes: List[Node] = self.store.list(KIND_NODE)
-        if not nodes:
-            return []
         N = len(nodes)
+        alloc = np.zeros((N, NUM_RESOURCES), np.float32)
         usage_pct = np.zeros((N, NUM_RESOURCES), np.float32)
         has_metric = np.zeros(N, bool)
+        node_idx = {}
         for i, node in enumerate(nodes):
+            node_idx[node.meta.name] = i
+            alloc[i] = node.allocatable.to_vector()
             nm: Optional[NodeMetric] = self.store.get(
-                KIND_NODE_METRIC, f"/{node.meta.name}"
-            )
+                KIND_NODE_METRIC, f"/{node.meta.name}")
             if nm is None or nm.update_time <= 0:
                 continue
             if now - nm.update_time >= self.args.node_metric_expiration_seconds:
                 continue
-            alloc = node.allocatable.to_vector()
             usage = nm.node_metric.node_usage.to_vector()
+            a = alloc[i]
             with np.errstate(divide="ignore", invalid="ignore"):
-                pct = np.where(alloc > 0, usage * 100.0 / np.maximum(alloc, 1e-9), 0.0)
-            usage_pct[i] = pct
+                usage_pct[i] = np.where(
+                    a > 0, usage * 100.0 / np.maximum(a, 1e-9), 0.0)
             has_metric[i] = True
+        pods = [p for p in self.store.list(KIND_POD)
+                if p.is_assigned and not p.is_terminated]
+        return {
+            "alloc": alloc,
+            "usage_pct": usage_pct,
+            "has_metric": has_metric,
+            "pod_alive": np.ones(len(pods), bool),
+            "pod_node": np.asarray(
+                [node_idx.get(p.spec.node_name, -1) for p in pods],
+                np.int64),
+            "pod_prio": np.asarray(
+                [p.spec.priority or 0 for p in pods], np.int64),
+            "pod_cpu": np.asarray(
+                [p.spec.requests[ResourceName.CPU] for p in pods],
+                np.float32),
+            "pod_req": (np.stack([p.spec.requests.to_vector() for p in pods])
+                        if pods else np.zeros((0, NUM_RESOURCES), np.float32)),
+            "pod_movable": np.asarray(
+                [p.meta.owner_kind != "DaemonSet"
+                 and not _has_pdb_like_guard(p) for p in pods], bool),
+        }, pods
 
+    def select_victims(self, now: Optional[float] = None):
+        """The TIMED rebalance pass: pure array math on the packed view.
+        Returns (picked slot indices, slot->Pod source, view) — victim
+        materialization, PodMigrationJob construction and store writes all
+        happen in balance(), outside this pass, exactly as the reference's
+        job creation is API-server work outside utilization_util.go's
+        math (and the C++ floor's output is victim flags, not objects)."""
+        now = time.time() if now is None else now
+        if self.pack_cache is not None:
+            v = self.pack_cache.view(now)
+            pods_src = self.pack_cache.pod_ref
+        else:
+            v, pods_cold = self._cold_view(now)
+            pods_src = pods_cold
+        empty = np.zeros(0, np.int64)
+        if v["alloc"].shape[0] == 0:
+            return empty, pods_src, v
         is_low, is_high = classify_nodes(
-            usage_pct,
-            has_metric,
+            v["usage_pct"], v["has_metric"],
             self._thr_vec(self.args.low_thresholds),
             self._thr_vec(self.args.high_thresholds),
         )
         if not is_high.any() or not is_low.any():
-            return []
+            return empty, pods_src, v
 
-        # ---- victim selection, vectorized: one lexsort over (node,
-        # priority asc, cpu desc) + per-segment exclusive cumsum of freed
-        # requests replaces the reference's per-node Go loops. The greedy
-        # serial rule "take sorted candidates while the node stays over any
-        # checked high threshold, capped per node" becomes: candidate k is
-        # selected iff rank < cap AND every earlier candidate in its
-        # segment kept the node over (prefix-AND via a cumsum-of-failures
-        # == 0 test). Identical victim sets to the serial pass
-        # (bench.py --chain rebalance diffs them against the C++ floor).
+        # ---- victim selection, vectorized: one stable lexsort over
+        # (node, priority asc, cpu desc) + per-segment exclusive prefix of
+        # freed requests replaces the reference's per-node Go loops. The
+        # greedy serial rule "take sorted candidates while the node stays
+        # over any checked high threshold, capped per node" becomes:
+        # candidate k is selected iff rank < cap AND every earlier
+        # candidate in its segment kept the node over (prefix-AND via a
+        # cumsum-of-failures == 0 test). Victim sets are identical to the
+        # serial pass (bench.py --chain rebalance diffs them vs the C++
+        # floor every run).
         target_pct = self._thr_vec(self.args.high_thresholds)
-        # per-node over-gate (max(usage - thr, 0).any()), hoisted once
+        usage_pct = v["usage_pct"]
         over_gate = (usage_pct - target_pct[None, :] > 0).any(axis=1)
-        eligible = {
-            nodes[i].meta.name: i
-            for i in np.nonzero(is_high & over_gate)[0]
-        }
-        cand_pods: List[Pod] = []
-        cand_node: List[int] = []
-        for pod in self.store.list(KIND_POD):
-            i = eligible.get(pod.spec.node_name)
-            if i is None or not pod.is_assigned or pod.is_terminated:
-                continue
-            if pod.meta.owner_kind == "DaemonSet" or _has_pdb_like_guard(pod):
-                continue
-            cand_pods.append(pod)
-            cand_node.append(i)
-        jobs: List[PodMigrationJob] = []
-        if not cand_pods:
-            return jobs
-        C = len(cand_pods)
-        node_arr = np.asarray(cand_node, np.int64)
-        prio = np.asarray([p.spec.priority or 0 for p in cand_pods], np.int64)
-        cpu = np.asarray(
-            [p.spec.requests[ResourceName.CPU] for p in cand_pods],
-            np.float32)
-        reqs = np.stack([p.spec.requests.to_vector() for p in cand_pods])
-        order = np.lexsort((-cpu, prio, node_arr))  # node, prio asc, cpu desc
+        node_ok = is_high & over_gate
+        cand_mask = (v["pod_alive"] & v["pod_movable"]
+                     & (v["pod_node"] >= 0)
+                     & node_ok[np.maximum(v["pod_node"], 0)])
+        cand = np.nonzero(cand_mask)[0]
+        if cand.size == 0:
+            return empty, pods_src, v
+        node_arr = v["pod_node"][cand]
+        prio = v["pod_prio"][cand]
+        cpu = v["pod_cpu"][cand]
+        C = cand.size
+        # (node, prio asc, cpu desc) order: when the key ranges fit one
+        # int64 (the overwhelmingly common case — node ids, bounded
+        # priorities, milli-cpu), ONE stable argsort of a composite key
+        # replaces np.lexsort's three passes; the exact lexsort stays as
+        # the general fallback
+        cpu_i = cpu.astype(np.int64)
+        pmin = int(prio.min()) if C else 0
+        pspan = int(prio.max()) - pmin + 1 if C else 1
+        cspan = int(cpu_i.max()) + 1 if C else 1
+        nspan = int(node_arr.max()) + 1 if C else 1
+        if (np.all(cpu_i == cpu)
+                and float(nspan) * pspan * cspan < float(2 ** 62)):
+            key = ((node_arr * pspan + (prio - pmin)) * cspan
+                   + (cspan - 1 - cpu_i))
+            order = np.argsort(key, kind="stable")
+        else:
+            order = np.lexsort((-cpu, prio, node_arr))
         node_s = node_arr[order]
-        reqs_s = np.asarray(reqs[order], np.float32)
         seg_start = np.zeros(C, bool)
         seg_start[0] = True
         seg_start[1:] = node_s[1:] != node_s[:-1]
         starts = np.nonzero(seg_start)[0]
         seg_id = np.cumsum(seg_start) - 1
-        # exclusive freed-requests prefix PER SEGMENT, as sequential f32
-        # adds: a global cumsum minus segment offsets re-associates the
-        # float32 sums and drifts from the serial accumulation right at the
-        # still_over threshold (victim-set parity vs the C++ floor breaks)
-        freed_excl = np.zeros_like(reqs_s)
-        bounds = np.append(starts, C)
-        for j in range(len(starts)):
-            s0, s1 = bounds[j], bounds[j + 1]
-            if s1 - s0 > 1:
-                freed_excl[s0 + 1:s1] = np.cumsum(
-                    reqs_s[s0:s1 - 1], axis=0, dtype=np.float32)
-        # rank within segment
+        # only the CHECKED axes (high_thr > 0 — cpu+mem by default) enter
+        # the freed/still-over math: slicing the request matrix to them
+        # cuts the heavy [C, R] traffic ~5x at R=10
+        chk = np.nonzero(target_pct > 0)[0]
+        # exclusive freed-requests prefix per segment as ONE global float64
+        # cumsum minus segment offsets. float64 accumulation mirrors the
+        # C++ floor (double) and the reference's int64 quantity math; for
+        # the integer-valued packed requests the kernel discipline already
+        # requires, the re-association is exact, so victim parity holds.
+        reqs_s = v["pod_req"][np.ix_(cand[order], chk)].astype(np.float64)
+        gcum = np.cumsum(reqs_s, axis=0)
+        excl = np.concatenate(
+            [np.zeros((1, reqs_s.shape[1])), gcum[:-1]], axis=0)
+        freed_excl = excl - excl[starts][seg_id]
         rank = np.arange(C) - starts[seg_id]
-        alloc_s = np.stack([nodes[i].allocatable.to_vector()
-                            for i in node_s]).astype(np.float32)
-        checked = target_pct > 0
-        still_over = (
-            (usage_pct[node_s] - freed_excl * 100.0 / np.maximum(alloc_s, 1e-9)
-             > target_pct) & checked
-        ).any(axis=1)
-        # prefix rule: selected while EVERY candidate so far (inclusive)
-        # still saw the node over — cumsum of failures within the segment
+        # still-over in MULTIPLY form: usage - freed*100/alloc > thr
+        # <=> freed*100 < (usage - thr) * alloc for alloc > 0. The rhs is
+        # precomputed per NODE ([N, chk], tiny) instead of per candidate,
+        # and the division disappears; the C++ floor computes the identical
+        # double expression, so the comparison is bit-deterministic on both
+        # sides.
+        alloc_chk = np.maximum(v["alloc"][:, chk], np.float32(1e-9))
+        rhs = ((usage_pct[:, chk].astype(np.float64)
+                - target_pct[chk].astype(np.float64))
+               * alloc_chk.astype(np.float64))
+        still_over = (freed_excl * 100.0 < rhs[node_s]).any(axis=1)
         fails = np.cumsum(~still_over)
-        prefix_ok = (fails - np.asarray(
-            [0, *np.asarray(fails)[starts[1:] - 1]])[seg_id]) == 0
+        seg_off = np.concatenate(([0], fails[starts[1:] - 1]))
+        prefix_ok = (fails - seg_off[seg_id]) == 0
         selected = prefix_ok & (rank < self.args.max_pods_to_evict_per_node)
-        for k in np.nonzero(selected)[0]:
-            pod = cand_pods[order[k]]
+        picked = cand[order[np.nonzero(selected)[0]]]
+        return picked, pods_src, v
+
+    def balance(self, now: Optional[float] = None) -> List[PodMigrationJob]:
+        now = time.time() if now is None else now
+        picked, pods_src, _v = self.select_victims(now)
+        jobs: List[PodMigrationJob] = []
+        for k in picked:
+            pod = pods_src[k]
             job = PodMigrationJob(
                 meta=ObjectMeta(
                     name=f"migrate-{pod.meta.namespace}-{pod.meta.name}",

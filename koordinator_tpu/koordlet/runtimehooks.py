@@ -4,7 +4,10 @@ Analog of reference `pkg/koordlet/runtimehooks/` (runtimehooks.go:35-77): a hook
 registry applied in three modes —
   (a) proxy: invoked by the runtime-proxy gRPC interceptor per CRI call
       (runtimeproxy/ hands us a ContainerContext, we mutate it)
-  (b) NRI: same hooks behind containerd's NRI (mode wiring only differs)
+  (b) NRI: the koordlet/nri.py plugin dials containerd's NRI socket,
+      registers, and serves RunPodSandbox/CreateContainer/UpdateContainer
+      from the same hook chain (reference runtimehooks/nri/server.go;
+      e2e against a fake containerd in tests/test_nri.py)
   (c) standalone reconciler (reconciler/reconciler.go): watch pods, write
       cgroups directly via the executor — always-on backstop.
 

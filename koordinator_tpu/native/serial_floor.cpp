@@ -498,22 +498,32 @@ void koord_lownodeload_floor(
       if (pod_prio[a] != pod_prio[b]) return pod_prio[a] < pod_prio[b];
       return pod_sort_cpu[a] > pod_sort_cpu[b];
     });
-    std::vector<float> freed(R, 0.0f);
+    // freed accumulates in DOUBLE (like the reference's int64 quantity
+    // math): the python pass computes the same prefix as one global f64
+    // cumsum, which is exactly this sequential accumulation for the
+    // integer-valued packed requests. The still-over test uses the
+    // MULTIPLY form freed*100 < (usage - thr) * alloc (alloc > 0), the
+    // identical double expression the python pass evaluates, so the
+    // comparison is bit-deterministic on both sides.
+    std::vector<double> freed(R, 0.0), rhs(R, 0.0);
+    for (int r = 0; r < R; ++r) {
+      float a = alloc[(int64_t)n * R + r];
+      float denom = a > 1e-9f ? a : 1e-9f;
+      rhs[r] = ((double)usage_pct[(int64_t)n * R + r] -
+                (double)high_thr[r]) * (double)denom;
+    }
     int count = 0;
     for (int pi : cand) {
       if (count >= max_evict_per_node) break;
       bool still_over = false;
       for (int r = 0; r < R; ++r) {
         if (high_thr[r] <= 0.0f) continue;
-        float a = alloc[(int64_t)n * R + r];
-        float denom = a > 1e-9f ? a : 1e-9f;
-        if (usage_pct[(int64_t)n * R + r] - freed[r] * 100.0f / denom >
-            high_thr[r])
-          still_over = true;
+        if (freed[r] * 100.0 < rhs[r]) still_over = true;
       }
       if (!still_over) break;
       victim[pi] = 1;
-      for (int r = 0; r < R; ++r) freed[r] += pod_req[(int64_t)pi * R + r];
+      for (int r = 0; r < R; ++r)
+        freed[r] += (double)pod_req[(int64_t)pi * R + r];
       ++count;
     }
   }

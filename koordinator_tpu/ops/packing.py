@@ -109,6 +109,7 @@ def pack_pods(
     quota_ids: Optional[Dict[str, int]] = None,
     pad_to: Optional[int] = None,
     gang_sort: Optional[Dict[str, Tuple[float, str]]] = None,
+    cache=None,
 ) -> PodBatch:
     """Pack pods in scheduling-queue order (kube-scheduler PrioritySort +
     coscheduling Less, coscheduling.go:118): priority desc, sub-priority
@@ -150,17 +151,35 @@ def pack_pods(
     gang = np.full(p, -1, np.int32)
     quota = np.full(p, -1, np.int32)
     valid = np.zeros(p, bool)
+    est = np.zeros((p, NUM_RESOURCES), np.float32)
+    # per-pod packed rows memoized by (key, resourceVersion) when a
+    # SnapshotCache rides along (scheduler/snapshot_cache.py): pods carried
+    # over between cycles skip the wire fill, the QoS/priority resolution
+    # AND the estimator (row-wise, so per-row caching is exact)
+    misses = []
     for i, pod in enumerate(pods):
-        pod.spec.requests.fill_wire_row(req_wire[i])
-        pod.spec.limits.fill_wire_row(lim_wire[i])
-        prio[i] = pod.spec.priority or 0
-        qos[i] = int(pod.qos_class)
-        cls = pod.priority_class
-        pcls[i] = int(cls)
-        # GetPodPriorityClassWithDefault: pods outside koordinator bands default
-        # to PROD semantics in LoadAware's prod checks
-        prod[i] = cls in (PriorityClass.PROD, PriorityClass.NONE)
-        ds[i] = pod.meta.owner_kind == "DaemonSet"
+        hit = cache.pod_row(pod) if cache is not None else None
+        if hit is not None:
+            req_wire[i] = hit["req_wire"]
+            lim_wire[i] = hit["lim_wire"]
+            prio[i] = hit["prio"]
+            qos[i] = hit["qos"]
+            pcls[i] = hit["pcls"]
+            prod[i] = hit["prod"]
+            ds[i] = hit["ds"]
+            est[i] = hit["est"]
+        else:
+            misses.append(i)
+            pod.spec.requests.fill_wire_row(req_wire[i])
+            pod.spec.limits.fill_wire_row(lim_wire[i])
+            prio[i] = pod.spec.priority or 0
+            qos[i] = int(pod.qos_class)
+            cls = pod.priority_class
+            pcls[i] = int(cls)
+            # GetPodPriorityClassWithDefault: pods outside koordinator bands
+            # default to PROD semantics in LoadAware's prod checks
+            prod[i] = cls in (PriorityClass.PROD, PriorityClass.NONE)
+            ds[i] = pod.meta.owner_kind == "DaemonSet"
         if gang_ids and pod.gang_name:
             gang[i] = gang_ids.get(pod.gang_key, -1)
         if quota_ids and pod.quota_name:
@@ -168,12 +187,26 @@ def pack_pods(
         valid[i] = True
     req = (req_wire / PACK_SCALE).astype(np.float32)
     lim = (lim_wire / PACK_SCALE).astype(np.float32)
-    # estimate only the valid rows: padding must carry zeros, never the
-    # 250-milli/200-MiB defaults the estimator assigns empty requests
-    est = np.zeros((p, NUM_RESOURCES), np.float32)
-    est[:n] = estimate_pods_used_batch(
-        req[:n], lim[:n], pcls[:n], resource_weights, scaling_factors
-    )
+    # estimate only rows not served from the cache: padding must carry
+    # zeros, never the 250-milli/200-MiB defaults the estimator assigns
+    # empty requests
+    if cache is None:
+        est[:n] = estimate_pods_used_batch(
+            req[:n], lim[:n], pcls[:n], resource_weights, scaling_factors
+        )
+    elif misses:
+        mi = np.asarray(misses)
+        est[mi] = estimate_pods_used_batch(
+            req[mi], lim[mi], pcls[mi], resource_weights, scaling_factors
+        )
+    if cache is not None:
+        for i in misses:
+            cache.put_pod_row(pods[i], {
+                "req_wire": req_wire[i].copy(), "lim_wire": lim_wire[i].copy(),
+                "prio": int(prio[i]), "qos": int(qos[i]),
+                "pcls": int(pcls[i]), "prod": bool(prod[i]),
+                "ds": bool(ds[i]), "est": est[i].copy(),
+            })
     return PodBatch(
         keys=[pd.meta.key for pd in pods],
         requests=req,

@@ -153,6 +153,26 @@ class Scheduler:
         self._sidecar_client = (
             SidecarClient(sidecar_address) if sidecar_address else None)
         self.sidecar_fallbacks = 0
+        # incremental snapshot packing (SURVEY 7: caches become
+        # device-resident arrays updated by deltas) — event-driven memos
+        # replacing the per-cycle cluster walks; gate off for the
+        # rebuild-everything behavior
+        from koordinator_tpu.utils.features import SCHEDULER_GATES
+
+        self.snapshot_cache = None
+        self.device_snapshot = None
+        if SCHEDULER_GATES.enabled("IncrementalSnapshot"):
+            from koordinator_tpu.scheduler.snapshot_cache import (
+                DeviceSnapshot,
+                SnapshotCache,
+            )
+
+            self.snapshot_cache = SnapshotCache(
+                store,
+                loadaware_plugin=self.extender.plugin("LoadAwareScheduling"),
+                numa_plugin=self.extender.plugin("NodeNUMAResource"),
+            )
+            self.device_snapshot = DeviceSnapshot()
 
     # ------------------------------------------------------------------
     def _pending_queue(self, now: float) -> Tuple[List[Pod], Dict[str, Reservation]]:
@@ -218,6 +238,10 @@ class Scheduler:
                 assigned[res.node_name] = (
                     assigned.get(res.node_name, np.zeros_like(vec)) + vec)
         nodes = {n.meta.name: n for n in self.store.list(KIND_NODE)}
+        numa_plugin = self.extender.plugin("NodeNUMAResource")
+        from koordinator_tpu.scheduler.topologymanager import (
+            POLICY_SINGLE_NUMA_NODE,
+        )
         for pod in candidates:
             node = nodes.get(pod.spec.node_name)
             if node is None:
@@ -238,6 +262,20 @@ class Scheduler:
                 self.extender.error_handlers.dispatch(
                     pod, "in-place resize unsupported for cpuset-bound pods")
                 continue
+            # SingleNUMANode-policy nodes account per-zone state the
+            # whole-node delta check below cannot see: a granted resize
+            # could overcommit a zone the batch pass believes free.
+            # Refuse, the same stance as cpuset-bound pods.
+            if numa_plugin is not None:
+                topo = numa_plugin.topologies.get(pod.spec.node_name)
+                if (topo is not None and topo.zones
+                        and numa_plugin.node_policy(pod.spec.node_name)
+                        == POLICY_SINGLE_NUMA_NODE):
+                    result.resize_pending.append(pod.meta.key)
+                    self.extender.error_handlers.dispatch(
+                        pod, "in-place resize unsupported on "
+                             "SingleNUMANode-policy nodes")
+                    continue
             new_vec = pod.spec.resize_requests.to_vector()
             old_vec = pod.spec.requests.to_vector()
             others = (assigned.get(pod.spec.node_name,
@@ -271,7 +309,10 @@ class Scheduler:
 
         Rebuilt per cycle (robust against in-place object mutation), but as
         ONE wire-matrix fill + scale + segment-sum instead of per-pod vector
-        allocations."""
+        allocations. With the incremental snapshot cache the sums are
+        event-maintained instead (same values; test_snapshot_cache.py)."""
+        if self.snapshot_cache is not None:
+            return self.snapshot_cache.assigned_requests()
         assigned = [
             p for p in self.store.list(KIND_POD)
             if p.is_assigned and not p.is_terminated
@@ -504,7 +545,7 @@ class Scheduler:
         if not state.nodes:
             return rejected_pods, [(p, "no schedulable node") for p in pending]
         fc, pods, nodes, tree, gang_index, ng, ngroups = build_full_chain_inputs(
-            state, self.args
+            state, self.args, cache=self.snapshot_cache
         )
         # stash the admission grouping this kernel pass used so host-side
         # dry-runs (DefaultPreemption) consult the SAME encoding — the raw
@@ -537,6 +578,11 @@ class Scheduler:
             if used_fallback:
                 self.sidecar_fallbacks += 1
         else:
+            if self.device_snapshot is not None:
+                # device-resident steady state: unchanged fields reuse the
+                # previous cycle's device buffers, small node-row deltas go
+                # up as donated scatters (snapshot_cache.DeviceSnapshot)
+                fc = self.device_snapshot.upload(fc)
             chosen, _, _ = step(fc)
         chosen = np.asarray(chosen)
         result.kernel_seconds += time.perf_counter() - t_k

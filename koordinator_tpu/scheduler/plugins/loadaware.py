@@ -20,6 +20,13 @@ class LoadAwarePlugin(Plugin):
 
     def __init__(self) -> None:
         self.assign_cache: Dict[str, Dict[str, Tuple[Pod, float]]] = {}
+        # per-node change counter: bumped on EVERY mutation of the node's
+        # assign-cache entry set, so the incremental snapshot builder
+        # (scheduler/snapshot_cache.py) can key its per-node LoadAware rows
+        self.node_epoch: Dict[str, int] = {}
+
+    def _bump(self, node_name: str) -> None:
+        self.node_epoch[node_name] = self.node_epoch.get(node_name, 0) + 1
 
     def register(self, store: ObjectStore) -> None:
         store.subscribe(KIND_POD, self._on_pod)
@@ -32,6 +39,7 @@ class LoadAwarePlugin(Plugin):
                     node[pod.meta.key] = (pod, time.time())
                 else:
                     node[pod.meta.key] = (pod, node[pod.meta.key][1])
+                self._bump(pod.spec.node_name)
             elif pod.is_terminated:
                 self._drop(pod)
         elif ev is EventType.DELETED:
@@ -41,15 +49,18 @@ class LoadAwarePlugin(Plugin):
         node = self.assign_cache.get(pod.spec.node_name)
         if node:
             node.pop(pod.meta.key, None)
+            self._bump(pod.spec.node_name)
 
     def reserve(self, pod: Pod, node_name: str, ctx: CycleContext):
         self.assign_cache.setdefault(node_name, {})[pod.meta.key] = (pod, ctx.now)
+        self._bump(node_name)
         return None
 
     def unreserve(self, pod: Pod, node_name: str, ctx: CycleContext) -> None:
         node = self.assign_cache.get(node_name)
         if node:
             node.pop(pod.meta.key, None)
+            self._bump(node_name)
 
     def assigned_view(self) -> Dict[str, List[Tuple[Pod, float]]]:
         return {

@@ -63,6 +63,12 @@ class NodeNUMAResourcePlugin(Plugin):
         self._pending_affinity: Dict[str, NUMATopologyHint] = {}
         # exact per-pod zone placement, so release reverses what add placed
         self._pod_zone_alloc: Dict[Tuple[str, str], np.ndarray] = {}
+        # per-node change counter over (topologies, cpu_states,
+        # numa_allocated) — keys the incremental snapshot builder's NUMA rows
+        self.node_epoch: Dict[str, int] = {}
+
+    def _bump(self, node_name: str) -> None:
+        self.node_epoch[node_name] = self.node_epoch.get(node_name, 0) + 1
 
     def register(self, store: ObjectStore) -> None:
         self.store = store
@@ -83,9 +89,11 @@ class NodeNUMAResourcePlugin(Plugin):
                 state = self.cpu_states.get(node)
                 if state is not None:
                     state.remove(pod.meta.key)
+                    self._bump(node)
 
     def _on_topology(self, ev: EventType, cr: NodeResourceTopology, old) -> None:
         name = cr.meta.name
+        self._bump(name)
         if ev is EventType.DELETED:
             self.topologies.pop(name, None)
             self.cpu_states.pop(name, None)
@@ -122,6 +130,7 @@ class NodeNUMAResourcePlugin(Plugin):
         state = self.cpu_states.get(name)
         if state is None or self.store is None:
             return
+        self._bump(name)
         from koordinator_tpu.client.store import KIND_NODE
         from koordinator_tpu.utils.cpuset import CPUSet
 
@@ -243,6 +252,7 @@ class NodeNUMAResourcePlugin(Plugin):
         placed = self._pod_zone_alloc.pop((node_name, pod_key), None)
         if placed is None:
             return
+        self._bump(node_name)
         alloc = self.numa_allocated.get(node_name)
         if alloc is not None:
             np.maximum(alloc - placed, 0.0, out=alloc)
@@ -257,6 +267,7 @@ class NodeNUMAResourcePlugin(Plugin):
         The per-pod placement is recorded so release reverses it exactly."""
         if node_name not in self.topologies:
             return
+        self._bump(node_name)
         if not add:
             self._release_zone_alloc(node_name, pod.meta.key)
             return

@@ -205,10 +205,17 @@ def _pod_cpuset_flags(pod: Pod, default_policy: str = FULL_PCPUS) -> Tuple[bool,
 
 
 def build_full_chain_inputs(
-    state: ClusterState, args: LoadAwareArgs
+    state: ClusterState, args: LoadAwareArgs, cache=None
 ) -> Tuple[FullChainInputs, PodBatch, NodeBatch, QuotaTreeArrays, Dict[str, int], int, int]:
     """Returns (inputs, pod_batch, node_batch, quota_tree, gang_index,
-    num_gangs, num_groups)."""
+    num_gangs, num_groups).
+
+    With `cache` (scheduler/snapshot_cache.SnapshotCache) the expensive
+    blocks consult event-maintained memos instead of walking the cluster;
+    the code path is otherwise IDENTICAL, so cached and cold builds cannot
+    drift (tests/test_snapshot_cache.py diffs every produced array)."""
+    if cache is not None:
+        cache.begin_build()
     # ---- gangs indexed first so pods pack in one pass; quota ids are filled
     # into the packed batch after the tree is built (they need the tree)
     gang_index = {pg.meta.key: i for i, pg in enumerate(state.pod_groups)}
@@ -221,6 +228,7 @@ def build_full_chain_inputs(
             pg.meta.key: (pg.meta.creation_timestamp, pg.meta.key)
             for pg in state.pod_groups
         },
+        cache=cache,
     )
     pods_by_key_pending = {p.meta.key: p for p in state.pending_pods}
 
@@ -232,23 +240,26 @@ def build_full_chain_inputs(
         if q:
             pod_req_by_quota.setdefault(q, np.zeros(NUM_RESOURCES, np.float32))
             pod_req_by_quota[q] += pods.requests[i]
-    # assigned quota usage: ONE wire-matrix fill + scale + segment-sum
-    # instead of a per-pod to_vector allocation (the 10k-pod store walk's
-    # hot cost)
+    # assigned quota usage: event-maintained sums when cached, else ONE
+    # wire-matrix fill + scale + segment-sum instead of a per-pod
+    # to_vector allocation (the 10k-pod store walk's hot cost)
     used_by_quota: Dict[str, np.ndarray] = {}
-    quota_pods: List[Tuple[str, Pod]] = []
-    for pod in state.pods_by_key.values():
-        q = pod.quota_name
-        if q and pod.is_assigned and not pod.is_terminated:
-            quota_pods.append((q, pod))
-    if quota_pods:
-        mat = ResourceList.pack_wire_matrix(
-            pod.spec.requests for _q, pod in quota_pods)
-        names = sorted({q for q, _p in quota_pods})
-        row_of = {q: j for j, q in enumerate(names)}
-        sums = np.zeros((len(names), NUM_RESOURCES), np.float32)
-        np.add.at(sums, [row_of[q] for q, _p in quota_pods], mat)
-        used_by_quota = {q: sums[j] for q, j in row_of.items()}
+    if cache is not None:
+        used_by_quota = cache.used_by_quota()
+    else:
+        quota_pods: List[Tuple[str, Pod]] = []
+        for pod in state.pods_by_key.values():
+            q = pod.quota_name
+            if q and pod.is_assigned and not pod.is_terminated:
+                quota_pods.append((q, pod))
+        if quota_pods:
+            mat = ResourceList.pack_wire_matrix(
+                pod.spec.requests for _q, pod in quota_pods)
+            names = sorted({q for q, _p in quota_pods})
+            row_of = {q: j for j, q in enumerate(names)}
+            sums = np.zeros((len(names), NUM_RESOURCES), np.float32)
+            np.add.at(sums, [row_of[q] for q, _p in quota_pods], mat)
+            used_by_quota = {q: sums[j] for q, j in row_of.items()}
     # group request counts EVERY member pod — running AND pending; a
     # pending-only request would understate runtime for groups with running
     # usage and deny admission their min already guarantees
@@ -305,86 +316,134 @@ def build_full_chain_inputs(
                 zone_pairs_by_key[key] = zp
     sel_pairs = selector_pairs_of(pods_by_key_pending.values(),
                                   zone_pairs_by_key)
-    node_taint_ids, admission_groups = group_node_admission(
-        state.nodes, sel_pairs)
+    if cache is not None:
+        node_taint_ids, admission_groups, adm_seq = cache.node_admission(
+            state.nodes, sel_pairs)
+    else:
+        node_taint_ids, admission_groups = group_node_admission(
+            state.nodes, sel_pairs)
+        adm_seq = 0
     ADMISSION_DEGRADED_NODES.set(
         float(degraded_node_count(node_taint_ids, admission_groups)))
     vol_needed = np.zeros(P, np.float32)
     for i, key in enumerate(pods.keys):
         pod = pods_by_key_pending[key]
-        nb, cn, fp = _pod_cpuset_flags(pod)
-        needs_bind[i], cores_needed[i], full_pcpus[i] = nb, cn, fp
-        needs_numa[i] = bool(pod.spec.requests)
-        pod_taint_mask[i] = admission_mask(
-            pod, admission_groups,
-            zone_pairs_by_key.get(key, frozenset()))
-        vol_needed[i] = len(set(pod.spec.pvc_names))
+        flags = cache.pod_flag(pod) if cache is not None else None
+        if flags is not None:
+            (needs_bind[i], cores_needed[i], full_pcpus[i],
+             needs_numa[i], vol_needed[i]) = flags
+        else:
+            nb, cn, fp = _pod_cpuset_flags(pod)
+            needs_bind[i], cores_needed[i], full_pcpus[i] = nb, cn, fp
+            needs_numa[i] = bool(pod.spec.requests)
+            vol_needed[i] = len(set(pod.spec.pvc_names))
+            if cache is not None:
+                cache.put_pod_flag(pod, (nb, cn, fp, bool(needs_numa[i]),
+                                         float(vol_needed[i])))
+        mask = (cache.pod_mask(pod, adm_seq)
+                if cache is not None else None)
+        if mask is not None:
+            pod_taint_mask[i] = mask
+        else:
+            pod_taint_mask[i] = admission_mask(
+                pod, admission_groups,
+                zone_pairs_by_key.get(key, frozenset()))
+            if cache is not None:
+                cache.put_pod_mask(pod, adm_seq, float(pod_taint_mask[i]))
         q = pod.quota_name
         if q:  # quota ids resolve only after the tree exists
             pods.quota_id[i] = quota_ids.get(q, -1)
 
     # ---- nodes
-    nodes = pack_nodes(state.nodes, assigned_requests=state.assigned_requests)
-    N = nodes.padded_size
+    if cache is not None:
+        from koordinator_tpu.ops.packing import NodeBatch, bucket_size
+
+        N = bucket_size(len(state.nodes))
+        cache.node_layout(state.nodes, N)
+        alloc_m = cache.alloc_matrix(state.nodes)
+        requested_m = np.zeros((N, NUM_RESOURCES), np.float32)
+        for name, vec in state.assigned_requests.items():
+            idx_n = cache.node_index.get(name)
+            if idx_n is not None:
+                requested_m[idx_n] = vec
+        valid_m = np.zeros(N, bool)
+        valid_m[: len(state.nodes)] = True
+        nodes = NodeBatch(
+            names=[nd.meta.name for nd in state.nodes],
+            allocatable=alloc_m, requested=requested_m, valid=valid_m)
+        nodes.extras = cache.loadaware_extras(state, args, N)
+    else:
+        nodes = pack_nodes(state.nodes,
+                           assigned_requests=state.assigned_requests)
+        N = nodes.padded_size
+        nodes.extras = build_loadaware_node_state(
+            state.nodes,
+            state.node_metrics,
+            state.pods_by_key,
+            state.assigned,
+            args,
+            state.now,
+            pad_to=N,
+        )
     node_taint_group = np.zeros(N, np.int32)  # padding: empty set
     node_taint_group[: len(node_taint_ids)] = node_taint_ids
-    nodes.extras = build_loadaware_node_state(
-        state.nodes,
-        state.node_metrics,
-        state.pods_by_key,
-        state.assigned,
-        args,
-        state.now,
-        pad_to=N,
-    )
-    numa_free = np.zeros((N, MAX_NUMA, NUM_RESOURCES), np.float32)
-    numa_capacity = np.zeros((N, MAX_NUMA, NUM_RESOURCES), np.float32)
-    numa_policy = np.full(N, POLICY_NONE, np.int32)
-    has_topology = np.zeros(N, bool)
-    bind_free = np.zeros(N, np.float32)
-    cpus_per_core = np.ones(N, np.float32)
-    # zone capacities via ONE wire-matrix fill + scale + scatter (not a
-    # per-zone to_vector allocation: ~2 zones x every topology node)
-    zone_at: List[Tuple[int, int]] = []
-    zone_lists: List = []
-    topo_nodes: List[int] = []
-    for i, node in enumerate(state.nodes):
-        topo_cr = state.topologies.get(node.meta.name)
-        if topo_cr is not None and topo_cr.cpus:
-            topo_nodes.append(i)
-            has_topology[i] = True
-            numa_policy[i] = POLICY_BY_NAME.get(
-                resolve_numa_policy(node.meta.labels,
-                                    topo_cr.kubelet_cpu_manager_policy),
-                POLICY_NONE)
-            for zone in topo_cr.zones:
-                if 0 <= zone.numa_id < MAX_NUMA:
-                    zone_at.append((i, zone.numa_id))
-                    zone_lists.append(zone.allocatable)
-    if zone_at:
-        zmat = ResourceList.pack_wire_matrix(zone_lists)
-        idx = np.asarray(zone_at)
-        numa_capacity[idx[:, 0], idx[:, 1]] = zmat
-    for i in topo_nodes:
-        node = state.nodes[i]
-        name = node.meta.name
-        alloc = state.numa_allocated.get(name)
-        numa_free[i] = numa_capacity[i] - (alloc if alloc is not None else 0.0)
-        cpu_state = state.cpu_states.get(name)
-        if cpu_state is not None:
-            bind_free[i] = cpu_state.num_available()
-            cpus_per_core[i] = cpu_state.topology.cpus_per_core
-        else:
-            bind_free[i] = numa_free[i, :, CPU_IDX].sum() / 1000.0
-            cpus_per_core[i] = 2.0
-    # no topology: NUMA admission passes only via POLICY_NONE; spread the
-    # node allocatable into one virtual zone so zero-topology clusters
-    # still quota-fit (vectorized over the non-topology rows)
-    no_topo = np.nonzero(~has_topology[: len(state.nodes)])[0]
-    if no_topo.size:
-        numa_capacity[no_topo, 0] = nodes.allocatable[no_topo]
-        numa_free[no_topo, 0] = (nodes.allocatable[no_topo]
-                                 - nodes.requested[no_topo])
+    if cache is not None:
+        na = cache.numa_arrays(state, nodes.requested, N)
+        numa_free = na["numa_free"]
+        numa_capacity = na["numa_capacity"]
+        numa_policy = na["numa_policy"]
+        has_topology = na["has_topology"]
+        bind_free = na["bind_free"]
+        cpus_per_core = na["cpus_per_core"]
+    else:
+        numa_free = np.zeros((N, MAX_NUMA, NUM_RESOURCES), np.float32)
+        numa_capacity = np.zeros((N, MAX_NUMA, NUM_RESOURCES), np.float32)
+        numa_policy = np.full(N, POLICY_NONE, np.int32)
+        has_topology = np.zeros(N, bool)
+        bind_free = np.zeros(N, np.float32)
+        cpus_per_core = np.ones(N, np.float32)
+        # zone capacities via ONE wire-matrix fill + scale + scatter (not a
+        # per-zone to_vector allocation: ~2 zones x every topology node)
+        zone_at: List[Tuple[int, int]] = []
+        zone_lists: List = []
+        topo_nodes: List[int] = []
+        for i, node in enumerate(state.nodes):
+            topo_cr = state.topologies.get(node.meta.name)
+            if topo_cr is not None and topo_cr.cpus:
+                topo_nodes.append(i)
+                has_topology[i] = True
+                numa_policy[i] = POLICY_BY_NAME.get(
+                    resolve_numa_policy(node.meta.labels,
+                                        topo_cr.kubelet_cpu_manager_policy),
+                    POLICY_NONE)
+                for zone in topo_cr.zones:
+                    if 0 <= zone.numa_id < MAX_NUMA:
+                        zone_at.append((i, zone.numa_id))
+                        zone_lists.append(zone.allocatable)
+        if zone_at:
+            zmat = ResourceList.pack_wire_matrix(zone_lists)
+            idx = np.asarray(zone_at)
+            numa_capacity[idx[:, 0], idx[:, 1]] = zmat
+        for i in topo_nodes:
+            node = state.nodes[i]
+            name = node.meta.name
+            alloc = state.numa_allocated.get(name)
+            numa_free[i] = numa_capacity[i] - (alloc if alloc is not None else 0.0)
+            cpu_state = state.cpu_states.get(name)
+            if cpu_state is not None:
+                bind_free[i] = cpu_state.num_available()
+                cpus_per_core[i] = cpu_state.topology.cpus_per_core
+            else:
+                bind_free[i] = numa_free[i, :, CPU_IDX].sum() / 1000.0
+                cpus_per_core[i] = 2.0
+        # no topology: NUMA admission passes only via POLICY_NONE; spread the
+        # node allocatable into one virtual zone so zero-topology clusters
+        # still quota-fit (vectorized over the non-topology rows)
+        no_topo = np.nonzero(~has_topology[: len(state.nodes)])[0]
+        if no_topo.size:
+            numa_capacity[no_topo, 0] = nodes.allocatable[no_topo]
+            numa_free[no_topo, 0] = (nodes.allocatable[no_topo]
+                                     - nodes.requested[no_topo])
 
     # inter-pod (anti-)affinity factorization (ops/podaffinity.py): the
     # batch's distinct terms -> per-node domain/count state + per-pod term
@@ -461,11 +520,14 @@ def build_full_chain_inputs(
             "hostPort slot budget exceeded for this round")
         ENCODING_OVERFLOW_PODS.inc(kind="port_slots")
     vol_free = np.full(N, np.inf, np.float32)
-    attached: Dict[str, set] = {}
-    for pod in existing:
-        if pod.spec.pvc_names:
-            attached.setdefault(pod.spec.node_name, set()).update(
-                f"{pod.meta.namespace}/{c}" for c in pod.spec.pvc_names)
+    if cache is not None:
+        attached: Dict[str, set] = cache.attached_sets()
+    else:
+        attached = {}
+        for pod in existing:
+            if pod.spec.pvc_names:
+                attached.setdefault(pod.spec.node_name, set()).update(
+                    f"{pod.meta.namespace}/{c}" for c in pod.spec.pvc_names)
     for i, node in enumerate(state.nodes):
         if node.attachable_volume_limit > 0:
             vol_free[i] = node.attachable_volume_limit - len(
@@ -529,4 +591,8 @@ def build_full_chain_inputs(
         gang_valid=np.asarray(gang_valid),
         gang_group_id=np.asarray(gang_group),
     )
+    if cache is not None:
+        # clear dirty sets NOW: binding mutations after this point must
+        # re-dirty for the NEXT cycle, not be swallowed by a later clear
+        cache.end_build()
     return fc, pods, nodes, tree, gang_index, ng, ng

@@ -1,0 +1,580 @@
+"""Incremental snapshot cache: store deltas -> packed arrays, not rebuilds.
+
+SURVEY section 7's design stance — "the caches become device-resident arrays
+updated by deltas" — made concrete. `build_full_chain_inputs` (snapshot.py)
+rebuilds every packed array from the object store each cycle (~0.3-0.7s at
+10k pods x 5k nodes); with a `SnapshotCache` attached it reuses everything
+whose inputs did not change since the previous cycle:
+
+  * per-pod packed rows (requests/limits/estimates/flags) keyed by
+    (pod key, resourceVersion) — reference analog: the scheduling queue
+    caches pod info objects rather than re-parsing specs
+    (pkg/scheduler/ vendored internal queue);
+  * per-node assigned-request sums, per-quota used sums and per-node
+    attached-volume sets maintained from store pod events — reference
+    analogs: pod_assign_cache.go, group_quota_manager.go:184-256;
+  * per-node LoadAware rows recomputed only for nodes whose Node/NodeMetric
+    objects, assign-cache entries, node-local pods, or metric-expiry state
+    changed — reference analog: loadaware keeps NodeMetric-derived state per
+    node and re-reads only on informer events;
+  * per-node NUMA/cpuset rows recomputed only on topology CR or plugin
+    allocation-state changes (plugin `node_epoch` counters);
+  * the node admission grouping (taints x selector pairs) memoized on
+    (node-set epoch, the batch's selector-pair set).
+
+Exactness contract: every cached value is either reused bit-identically
+(per-pod rows, per-node recomputes run the same code on the same inputs) or
+maintained as float64 accumulation of the exact float32 per-pod rows the
+cold path sums — identical for the packed-integer quantities the kernel's
+own f32-exactness discipline already requires. tests/test_snapshot_cache.py
+diffs every array of cached vs cold builds across churn sequences.
+
+The arrays handed out by a cached build are OWNED by the cache and mutated
+in place by later builds; consumers must not hold them across cycles (the
+cycle driver consumes them within the cycle; `DeviceSnapshot` uploads the
+changed fields before the next build).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from koordinator_tpu.api.objects import Node, Pod
+from koordinator_tpu.api.resources import (
+    NUM_RESOURCES,
+    PACK_SCALE,
+    ResourceList,
+)
+from koordinator_tpu.client.store import (
+    KIND_NODE,
+    KIND_NODE_METRIC,
+    KIND_NODE_TOPOLOGY,
+    KIND_POD,
+    KIND_PV,
+    KIND_PVC,
+    EventType,
+    ObjectStore,
+)
+from koordinator_tpu.ops.fit import PODS_AXIS
+
+
+def _packed_row(rl) -> np.ndarray:
+    """The exact f32 row the cold path's pack_wire_matrix/to_vector emits."""
+    wire = np.zeros(NUM_RESOURCES, np.float64)
+    rl.fill_wire_row(wire)
+    return (wire / PACK_SCALE).astype(np.float32)
+
+
+class SnapshotCache:
+    """Event-driven memo for `build_full_chain_inputs` (see module doc)."""
+
+    def __init__(self, store: ObjectStore, loadaware_plugin=None,
+                 numa_plugin=None) -> None:
+        self.store = store
+        self.loadaware = loadaware_plugin
+        self.numa = numa_plugin
+
+        # ---- per-pod caches (keyed key -> (rv, payload)) ----
+        self.pod_rows: Dict[str, Tuple[int, dict]] = {}
+        self.pod_flags: Dict[str, Tuple[int, tuple]] = {}
+        self.pod_masks: Dict[str, Tuple[tuple, float]] = {}
+
+        # ---- incremental aggregates over ASSIGNED pods ----
+        # pod key -> (node, packed f32 row with pods-axis=1) for fit sums
+        self._fit_contrib: Dict[str, Tuple[str, np.ndarray]] = {}
+        self._node_fit: Dict[str, np.ndarray] = {}       # node -> f64 [R]
+        # pod key -> (quota name, packed f32 row) for quota used sums
+        self._quota_contrib: Dict[str, Tuple[str, np.ndarray]] = {}
+        self._quota_used: Dict[str, np.ndarray] = {}     # quota -> f64 [R]
+        # pod key -> (node, frozenset of claim keys); node -> claim -> refs
+        self._vol_contrib: Dict[str, Tuple[str, frozenset]] = {}
+        self._attached: Dict[str, Dict[str, int]] = {}
+
+        # ---- epochs / dirty sets ----
+        self.nodes_epoch = 0          # any Node add/update/delete
+        self.pvcpv_epoch = 0          # any PVC/PV event
+        self._la_dirty: Set[str] = set()   # node names needing LA recompute
+        self._node_dirty: Set[str] = set()  # node rows (alloc/taint) to refresh
+        self._la_keys: Dict[str, tuple] = {}
+        self._numa_keys: Dict[str, tuple] = {}
+
+        # ---- cached node-side arrays (owned; padded to the node bucket) ----
+        self._node_names: List[str] = []
+        self._pad: int = 0
+        self._alloc: Optional[np.ndarray] = None         # [Np, R] f32
+        self._la: Dict[str, np.ndarray] = {}
+        self._numa: Dict[str, np.ndarray] = {}
+        self._adm_cache: Dict[tuple, tuple] = {}
+        self._adm_seq = 0
+
+        # per-build change log: node-side field names the build touched.
+        # Not load-bearing for the device mirror (DeviceSnapshot compares
+        # host values — transformers may rewrite fields post-build); it IS
+        # the recompute-hygiene signal tests assert on (a steady-state
+        # build must touch nothing).
+        self.dirty_fields: Set[str] = set()
+
+        self.stats = {"builds": 0, "pod_row_hits": 0, "pod_row_misses": 0,
+                      "la_recomputed": 0, "numa_recomputed": 0,
+                      "full_rebuilds": 0}
+
+        store.subscribe(KIND_POD, self._on_pod)
+        store.subscribe(KIND_NODE, self._on_node)
+        store.subscribe(KIND_NODE_METRIC, self._on_metric)
+        store.subscribe(KIND_NODE_TOPOLOGY, self._on_topology)
+        store.subscribe(KIND_PVC, self._on_pvcpv)
+        store.subscribe(KIND_PV, self._on_pvcpv)
+
+    # ------------------------------------------------------------------
+    # event handlers
+    # ------------------------------------------------------------------
+    def _on_pod(self, ev: EventType, pod: Pod, old) -> None:
+        key = pod.meta.key
+        self.pod_rows.pop(key, None)
+        self.pod_flags.pop(key, None)
+        self.pod_masks.pop(key, None)
+        counted = (ev is not EventType.DELETED and pod.is_assigned
+                   and not pod.is_terminated)
+        self._retract(key)
+        if counted:
+            node = pod.spec.node_name
+            row = _packed_row(pod.spec.requests)
+            fit_row = row.copy()
+            fit_row[PODS_AXIS] = 1.0
+            self._fit_contrib[key] = (node, fit_row)
+            self._node_fit.setdefault(
+                node, np.zeros(NUM_RESOURCES, np.float64))
+            self._node_fit[node] += fit_row
+            q = pod.quota_name
+            if q:
+                self._quota_contrib[key] = (q, row)
+                self._quota_used.setdefault(
+                    q, np.zeros(NUM_RESOURCES, np.float64))
+                self._quota_used[q] += row
+            claims = frozenset(
+                f"{pod.meta.namespace}/{c}" for c in pod.spec.pvc_names)
+            if claims:
+                self._vol_contrib[key] = (node, claims)
+                refs = self._attached.setdefault(node, {})
+                for c in claims:
+                    refs[c] = refs.get(c, 0) + 1
+        # any pod event on a node invalidates that node's LoadAware rows
+        # (assign-cache entries, metric-map membership, prod-class changes)
+        for p in (pod, old):
+            if p is not None and p.spec.node_name:
+                self._la_dirty.add(p.spec.node_name)
+
+    def _retract(self, key: str) -> None:
+        hit = self._fit_contrib.pop(key, None)
+        if hit is not None:
+            node, row = hit
+            self._node_fit[node] -= row
+        hit = self._quota_contrib.pop(key, None)
+        if hit is not None:
+            q, row = hit
+            self._quota_used[q] -= row
+        hit = self._vol_contrib.pop(key, None)
+        if hit is not None:
+            node, claims = hit
+            refs = self._attached.get(node, {})
+            for c in claims:
+                left = refs.get(c, 0) - 1
+                if left <= 0:
+                    refs.pop(c, None)
+                else:
+                    refs[c] = left
+
+    def _on_node(self, ev: EventType, node, old) -> None:
+        self.nodes_epoch += 1
+        self._node_dirty.add(node.meta.name)
+        self._la_dirty.add(node.meta.name)
+
+    def _on_metric(self, ev: EventType, nm, old) -> None:
+        self._la_dirty.add(nm.meta.name)
+
+    def _on_topology(self, ev: EventType, cr, old) -> None:
+        # numa keys include the plugin epoch; the direct subscription covers
+        # cache use without a NUMA plugin attached
+        self._numa_keys.pop(cr.meta.name, None)
+
+    def _on_pvcpv(self, ev: EventType, obj, old) -> None:
+        self.pvcpv_epoch += 1
+
+    # ------------------------------------------------------------------
+    # aggregates (cycle-facing)
+    # ------------------------------------------------------------------
+    def assigned_requests(self) -> Dict[str, np.ndarray]:
+        """Per-node assigned fit sums — replaces Scheduler._assigned_requests'
+        full store walk. Fresh f32 copies (transformers mutate them)."""
+        return {
+            node: s.astype(np.float32)
+            for node, s in self._node_fit.items() if s.any()
+        }
+
+    def used_by_quota(self) -> Dict[str, np.ndarray]:
+        return {
+            q: s.astype(np.float32)
+            for q, s in self._quota_used.items() if s.any()
+        }
+
+    def attached_sets(self) -> Dict[str, Set[str]]:
+        return {n: set(refs) for n, refs in self._attached.items() if refs}
+
+    # ------------------------------------------------------------------
+    # pod-side caches
+    # ------------------------------------------------------------------
+    def pod_row(self, pod: Pod) -> Optional[dict]:
+        hit = self.pod_rows.get(pod.meta.key)
+        if hit is not None and hit[0] == pod.meta.resource_version:
+            self.stats["pod_row_hits"] += 1
+            return hit[1]
+        self.stats["pod_row_misses"] += 1
+        return None
+
+    def put_pod_row(self, pod: Pod, payload: dict) -> None:
+        self.pod_rows[pod.meta.key] = (pod.meta.resource_version, payload)
+
+    def pod_flag(self, pod: Pod) -> Optional[tuple]:
+        hit = self.pod_flags.get(pod.meta.key)
+        if hit is not None and hit[0] == pod.meta.resource_version:
+            return hit[1]
+        return None
+
+    def put_pod_flag(self, pod: Pod, payload: tuple) -> None:
+        self.pod_flags[pod.meta.key] = (pod.meta.resource_version, payload)
+
+    def pod_mask(self, pod: Pod, adm_seq: int) -> Optional[float]:
+        hit = self.pod_masks.get(pod.meta.key)
+        want = (pod.meta.resource_version, adm_seq, self.pvcpv_epoch)
+        if hit is not None and hit[0] == want:
+            return hit[1]
+        return None
+
+    def put_pod_mask(self, pod: Pod, adm_seq: int, mask: float) -> None:
+        self.pod_masks[pod.meta.key] = (
+            (pod.meta.resource_version, adm_seq, self.pvcpv_epoch), mask)
+
+    # ------------------------------------------------------------------
+    # node admission grouping memo
+    # ------------------------------------------------------------------
+    def node_admission(self, nodes: Sequence[Node], sel_pairs: frozenset):
+        """(group ids, groups, adm_seq) — memoized on (node-set epoch,
+        selector-pair set). adm_seq keys the per-pod mask cache."""
+        from koordinator_tpu.ops.taints import group_node_admission
+
+        key = (self.nodes_epoch, sel_pairs)
+        hit = self._adm_cache.get(key)
+        if hit is None:
+            if len(self._adm_cache) > 16:
+                self._adm_cache.clear()
+            self._adm_seq += 1
+            ids, groups = group_node_admission(nodes, sel_pairs)
+            hit = (ids, groups, self._adm_seq)
+            self._adm_cache[key] = hit
+        return hit
+
+    # ------------------------------------------------------------------
+    # node-side arrays
+    # ------------------------------------------------------------------
+    def _mark(self, field: str) -> None:
+        self.dirty_fields.add(field)
+
+    def node_layout(self, nodes: Sequence[Node], pad_to: int) -> bool:
+        """Realign to the cycle's node list; returns True when the whole
+        node axis must be rebuilt (membership/order/padding changed)."""
+        names = [n.meta.name for n in nodes]
+        if names == self._node_names and pad_to == self._pad:
+            return False
+        self._node_names = names
+        self.node_index = {n: i for i, n in enumerate(names)}
+        self._pad = pad_to
+        self._la_keys.clear()
+        self._numa_keys.clear()
+        self._alloc = None
+        self._la.clear()
+        self._numa.clear()
+        self.stats["full_rebuilds"] += 1
+        return True
+
+    def alloc_matrix(self, nodes: Sequence[Node]) -> np.ndarray:
+        """[pad, R] estimate_node_allocatable rows, refreshed per node rv."""
+        from koordinator_tpu.ops.estimator import estimate_node_allocatable
+
+        if self._alloc is None:
+            self._alloc = np.zeros((self._pad, NUM_RESOURCES), np.float32)
+            dirty = range(len(nodes))
+            self._mark("allocatable")
+        else:
+            dirty = [i for i, n in enumerate(nodes)
+                     if n.meta.name in self._node_dirty]
+            if dirty:
+                self._mark("allocatable")
+        for i in dirty:
+            self._alloc[i] = estimate_node_allocatable(nodes[i])
+        return self._alloc
+
+    def loadaware_extras(self, state, args, pad_to: int) -> Dict[str, np.ndarray]:
+        """Cached per-node LoadAware rows; recomputes only dirty nodes."""
+        from koordinator_tpu.ops.loadaware import build_loadaware_node_state
+
+        nodes = state.nodes
+        plugin_epoch = (self.loadaware.node_epoch
+                        if self.loadaware is not None else {})
+
+        def key_of(node) -> tuple:
+            name = node.meta.name
+            nm = state.node_metrics.get(name)
+            nm_rv = nm.meta.resource_version if nm is not None else -1
+            expired = (
+                nm is None or nm.update_time <= 0
+                or (args.node_metric_expiration_seconds > 0
+                    and state.now - nm.update_time
+                    >= args.node_metric_expiration_seconds))
+            return (node.meta.resource_version, nm_rv,
+                    plugin_epoch.get(name, 0), expired)
+
+        if not self._la:
+            full = build_loadaware_node_state(
+                nodes, state.node_metrics, state.pods_by_key, state.assigned,
+                args, state.now, pad_to=pad_to)
+            self._la = full
+            self._la_keys = {n.meta.name: key_of(n) for n in nodes}
+            self.stats["la_recomputed"] += len(nodes)
+            for f in full:
+                self._mark(f)
+            return self._la
+
+        dirty_idx = [
+            i for i, n in enumerate(nodes)
+            if n.meta.name in self._la_dirty
+            or self._la_keys.get(n.meta.name) != key_of(n)
+        ]
+        if dirty_idx:
+            sub = [nodes[i] for i in dirty_idx]
+            rows = build_loadaware_node_state(
+                sub, state.node_metrics, state.pods_by_key, state.assigned,
+                args, state.now, pad_to=len(sub))
+            idx = np.asarray(dirty_idx)
+            for f, arr in rows.items():
+                self._la[f][idx] = arr[: len(sub)]
+                self._mark(f)
+            for n in sub:
+                self._la_keys[n.meta.name] = key_of(n)
+            self.stats["la_recomputed"] += len(sub)
+        return self._la
+
+    def numa_arrays(self, state, nodes_requested: np.ndarray,
+                    pad_to: int) -> Dict[str, np.ndarray]:
+        """Cached NUMA/cpuset node state. Topology nodes refresh on
+        (node rv, plugin epoch); non-topology nodes' virtual zone-0 free is
+        alloc - requested, recomputed vectorized every build (requested
+        changes with every binding)."""
+        from koordinator_tpu.ops.numa import (
+            MAX_NUMA,
+            POLICY_BY_NAME,
+            POLICY_NONE,
+        )
+        from koordinator_tpu.scheduler.snapshot import resolve_numa_policy
+
+        nodes = state.nodes
+        n_real = len(nodes)
+        plugin_epoch = (self.numa.node_epoch
+                        if self.numa is not None else {})
+        first = not self._numa
+        if first:
+            self._numa = {
+                "numa_free": np.zeros((pad_to, MAX_NUMA, NUM_RESOURCES),
+                                      np.float32),
+                "numa_capacity": np.zeros((pad_to, MAX_NUMA, NUM_RESOURCES),
+                                          np.float32),
+                "numa_policy": np.full(pad_to, POLICY_NONE, np.int32),
+                "has_topology": np.zeros(pad_to, bool),
+                "bind_free": np.zeros(pad_to, np.float32),
+                "cpus_per_core": np.ones(pad_to, np.float32),
+            }
+        a = self._numa
+
+        def key_of(node) -> tuple:
+            name = node.meta.name
+            topo = state.topologies.get(name)
+            topo_rv = topo.meta.resource_version if topo is not None else -1
+            return (node.meta.resource_version, topo_rv,
+                    plugin_epoch.get(name, 0))
+
+        dirty = [
+            i for i, n in enumerate(nodes)
+            if first or self._numa_keys.get(n.meta.name) != key_of(n)
+        ]
+        zone_rows: List[Tuple[int, int]] = []
+        zone_lists: List = []
+        topo_dirty: List[int] = []
+        for i in dirty:
+            node = nodes[i]
+            name = node.meta.name
+            topo_cr = state.topologies.get(name)
+            if topo_cr is not None and topo_cr.cpus:
+                a["has_topology"][i] = True
+                a["numa_policy"][i] = POLICY_BY_NAME.get(
+                    resolve_numa_policy(node.meta.labels,
+                                        topo_cr.kubelet_cpu_manager_policy),
+                    POLICY_NONE)
+                a["numa_capacity"][i] = 0.0
+                for zone in topo_cr.zones:
+                    if 0 <= zone.numa_id < MAX_NUMA:
+                        zone_rows.append((i, zone.numa_id))
+                        zone_lists.append(zone.allocatable)
+                topo_dirty.append(i)
+            else:
+                a["has_topology"][i] = False
+                a["numa_policy"][i] = POLICY_NONE
+                a["numa_capacity"][i] = 0.0
+                a["numa_free"][i] = 0.0
+                a["bind_free"][i] = 0.0
+                a["cpus_per_core"][i] = 1.0
+            self._numa_keys[name] = key_of(node)
+        if zone_rows:
+            zmat = ResourceList.pack_wire_matrix(zone_lists)
+            zi = np.asarray(zone_rows)
+            a["numa_capacity"][zi[:, 0], zi[:, 1]] = zmat
+        from koordinator_tpu.api.resources import RESOURCE_INDEX, ResourceName
+
+        cpu_idx = RESOURCE_INDEX[ResourceName.CPU]
+        for i in topo_dirty:
+            node = nodes[i]
+            name = node.meta.name
+            alloc = state.numa_allocated.get(name)
+            a["numa_free"][i] = a["numa_capacity"][i] - (
+                alloc if alloc is not None else 0.0)
+            cpu_state = state.cpu_states.get(name)
+            if cpu_state is not None:
+                a["bind_free"][i] = cpu_state.num_available()
+                a["cpus_per_core"][i] = cpu_state.topology.cpus_per_core
+            else:
+                a["bind_free"][i] = (
+                    a["numa_free"][i, :, cpu_idx].sum() / 1000.0)
+                a["cpus_per_core"][i] = 2.0
+        self.stats["numa_recomputed"] += len(dirty)
+
+        # non-topology virtual zone 0: alloc - requested, refreshed every
+        # build but marked dirty only where the value actually moved
+        no_topo = np.nonzero(~a["has_topology"][:n_real])[0]
+        changed0 = np.zeros(0, np.int64)
+        if no_topo.size:
+            new_cap = self._alloc[no_topo]
+            new_free = new_cap - nodes_requested[no_topo]
+            moved = ((a["numa_capacity"][no_topo, 0] != new_cap).any(axis=1)
+                     | (a["numa_free"][no_topo, 0] != new_free).any(axis=1))
+            changed0 = no_topo[moved]
+            if changed0.size:
+                a["numa_capacity"][changed0, 0] = self._alloc[changed0]
+                a["numa_free"][changed0, 0] = (
+                    self._alloc[changed0] - nodes_requested[changed0])
+        if dirty or changed0.size:
+            self._mark("numa_free")
+            self._mark("numa_capacity")
+            if dirty:
+                for f in ("numa_policy", "has_topology", "bind_free",
+                          "cpus_per_core"):
+                    self._mark(f)
+        return a
+
+    def begin_build(self) -> None:
+        self.dirty_fields = set()
+        self.stats["builds"] += 1
+
+    def end_build(self) -> None:
+        self._la_dirty.clear()
+        self._node_dirty.clear()
+
+
+# ---------------------------------------------------------------------------
+# device-resident mirror
+# ---------------------------------------------------------------------------
+
+# fraction of node rows above which a scatter update loses to a full put
+_SCATTER_FRACTION = 0.125
+
+
+def _pad_pow2(n: int) -> int:
+    p = 8
+    while p < n:
+        p *= 2
+    return p
+
+
+class DeviceSnapshot:
+    """Per-field device mirror of the (sliced) FullChainInputs.
+
+    upload(fc) returns a FullChainInputs of device arrays where every field
+    whose host value is unchanged since the previous cycle reuses the
+    previous device buffer (zero transfer), small row-deltas of node-axis
+    arrays are applied as DONATED scatter updates (transfer = changed rows
+    only), and everything else is re-put."""
+
+    def __init__(self) -> None:
+        self._fields: Dict[str, Tuple[np.ndarray, object]] = {}
+        self._scatter_cache: Dict[tuple, object] = {}
+        self.stats = {"reused": 0, "scattered": 0, "put": 0,
+                      "bytes_put": 0, "bytes_scattered": 0}
+
+    def _scatter(self, dev, idx: np.ndarray, rows: np.ndarray):
+        import jax
+
+        pad = _pad_pow2(idx.size)
+        idx_p = np.full(pad, idx[-1], np.int32)
+        idx_p[: idx.size] = idx
+        rows_p = np.broadcast_to(
+            rows[-1], (pad,) + rows.shape[1:]).copy()
+        rows_p[: idx.size] = rows
+        key = (dev.shape, str(dev.dtype), pad)
+        fn = self._scatter_cache.get(key)
+        if fn is None:
+            import functools
+
+            fn = jax.jit(lambda a, i, r: a.at[i].set(r),
+                         donate_argnums=(0,))
+            self._scatter_cache[key] = fn
+        return fn(dev, idx_p, rows_p)
+
+    def upload(self, fc):
+        import jax
+
+        def one(name: str, new) -> object:
+            new = np.asarray(new)
+            hit = self._fields.get(name)
+            if (hit is not None and hit[0].shape == new.shape
+                    and hit[0].dtype == new.dtype):
+                prev_np, dev = hit
+                # the host equality compare (~1ms total) is the source of
+                # truth on purpose: score-phase transformers may rewrite
+                # any fc field after the build, so SnapshotCache's
+                # dirty_fields cannot vouch for the final arrays
+                if np.array_equal(prev_np, new):
+                    self.stats["reused"] += 1
+                    return dev
+                if new.ndim >= 1 and new.shape[0] == prev_np.shape[0] > 8:
+                    axes = tuple(range(1, new.ndim))
+                    rows = np.nonzero(
+                        (prev_np != new).any(axis=axes) if axes
+                        else prev_np != new)[0]
+                    if 0 < rows.size <= new.shape[0] * _SCATTER_FRACTION:
+                        dev2 = self._scatter(
+                            dev, rows.astype(np.int32), new[rows])
+                        self._fields[name] = (new.copy(), dev2)
+                        self.stats["scattered"] += 1
+                        self.stats["bytes_scattered"] += int(
+                            new[rows].nbytes)
+                        return dev2
+            dev = jax.device_put(new)
+            self._fields[name] = (new.copy(), dev)
+            self.stats["put"] += 1
+            self.stats["bytes_put"] += int(new.nbytes)
+            return dev
+
+        base = fc.base
+        new_base = type(base)(**{
+            k: one(k, v) for k, v in base._asdict().items()})
+        rest = {k: one(k, v) for k, v in fc._asdict().items() if k != "base"}
+        return type(fc)(base=new_base, **rest)

@@ -93,5 +93,8 @@ SCHEDULER_GATES = FeatureGate(
         "CompiledSerialParity": True,   # exact serial-parity selection loop on device
         "ResizePod": False,
         "DisableDefaultQuota": False,
+        # event-driven incremental snapshot packing + device-resident
+        # arrays (scheduler/snapshot_cache.py); off = full rebuild per cycle
+        "IncrementalSnapshot": True,
     }
 )

@@ -1,0 +1,187 @@
+"""NRI-mode runtime hooks e2e: fake containerd drives the plugin over UDS.
+
+Reference pkg/koordlet/runtimehooks/nri/server.go: the plugin dials the
+runtime's NRI socket, registers, negotiates the event mask via Configure,
+then serves RunPodSandbox / CreateContainer / UpdateContainer. These tests
+run the REAL hook chain (groupidentity/cpuset/batchresource/... against the
+fake cgroup tree) behind a real unix-socket round trip.
+"""
+
+import json
+import os
+
+import pytest
+
+from koordinator_tpu.api.objects import (
+    LABEL_POD_QOS,
+    Node,
+    ObjectMeta,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client.store import KIND_NODE, ObjectStore
+from koordinator_tpu.koordlet import nri_pb2
+from koordinator_tpu.koordlet.audit import Auditor
+from koordinator_tpu.koordlet.metriccache import MetricCache
+from koordinator_tpu.koordlet.nri import (
+    M_CREATE_CONTAINER,
+    M_RUN_POD_SANDBOX,
+    M_SYNCHRONIZE,
+    M_UPDATE_CONTAINER,
+    PLUGIN_IDX,
+    PLUGIN_NAME,
+    FakeContainerdNri,
+    NriPlugin,
+    event_mask,
+)
+from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
+from koordinator_tpu.koordlet.runtimehooks import RuntimeHooks
+from koordinator_tpu.koordlet.statesinformer import StatesInformer
+from koordinator_tpu.koordlet.util.system import FakeFS
+from koordinator_tpu.runtimeproxy.server import FailurePolicy
+
+GIB = 1024 ** 3
+
+
+@pytest.fixture
+def world(tmp_path):
+    fs = FakeFS(use_cgroup_v2=False)
+    store = ObjectStore()
+    store.add(KIND_NODE, Node(
+        meta=ObjectMeta(name="node-0", namespace=""),
+        allocatable=ResourceList.of(cpu=32000, memory=64 * GIB)))
+    informer = StatesInformer(store, "node-0", MetricCache())
+    executor = ResourceUpdateExecutor(fs.config, Auditor())
+    hooks = RuntimeHooks(informer, executor)
+    sock = str(tmp_path / "nri.sock")
+    runtime = FakeContainerdNri(sock)
+    plugin = NriPlugin(sock, hooks)
+    plugin.start()
+    reg = runtime.accept_plugin()
+    yield fs, runtime, plugin, reg
+    plugin.stop()
+    runtime.close()
+
+
+def _be_sandbox(fs) -> nri_pb2.PodSandbox:
+    rel = "kubepods.slice/kubepods-besteffort.slice/pod-be-1"
+    fs.set_cgroup(rel, "cgroup.procs", "")
+    return nri_pb2.PodSandbox(
+        id="sb-1", name="be-pod", namespace="default", uid="be-1",
+        labels={LABEL_POD_QOS: "BE"},
+        annotations={},
+        cgroup_parent=rel,
+    )
+
+
+def test_register_and_configure_mask(world):
+    _fs, runtime, plugin, reg = world
+    assert (reg.plugin_name, reg.plugin_idx) == (PLUGIN_NAME, PLUGIN_IDX)
+    # empty config: plugin answers with its default subscription
+    resp = runtime.configure()
+    assert resp.events == event_mask(
+        ["RunPodSandbox", "CreateContainer", "UpdateContainer"])
+    # runtime-provided config narrows the mask (Configure, server.go:124-142)
+    resp = runtime.configure(config=json.dumps(
+        {"events": ["CreateContainer"]}))
+    assert resp.events == event_mask(["CreateContainer"])
+
+
+def test_run_pod_sandbox_applies_pod_level_writes(world):
+    fs, runtime, plugin, _reg = world
+    runtime.configure()
+    sb = _be_sandbox(fs)
+    ok, _ = runtime.call(M_RUN_POD_SANDBOX,
+                         nri_pb2.RunPodSandboxRequest(pod=sb))
+    assert ok
+    assert plugin.handled["RunPodSandbox"] == 1
+    # groupidentity wrote the BE bvt value straight through the executor
+    # (podCtx.NriDone applies pod-level writes locally)
+    from koordinator_tpu.koordlet.util import system as sysutil
+
+    assert fs.get_cgroup(sb.cgroup_parent,
+                         sysutil.CPU_BVT_WARP_NS).strip() == "-1"
+
+
+def test_create_container_returns_adjustment(world):
+    fs, runtime, plugin, _reg = world
+    runtime.configure()
+    rel = "kubepods.slice/pod-ls-1"
+    fs.set_cgroup(rel, "cgroup.procs", "")
+    sb = nri_pb2.PodSandbox(
+        id="sb-2", name="ls-pod", namespace="default", uid="ls-1",
+        labels={LABEL_POD_QOS: "LS"},
+        annotations={
+            "scheduling.koordinator.sh/resource-status": json.dumps(
+                {"cpuset": "0-3"}),
+        },
+        cgroup_parent=rel,
+    )
+    ctr = nri_pb2.Container(
+        id="ctr-1", pod_sandbox_id="sb-2", name="main",
+        cgroup_parent=rel + "/ctr-1")
+    ok, payload = runtime.call(
+        M_CREATE_CONTAINER,
+        nri_pb2.CreateContainerRequest(pod=sb, container=ctr))
+    assert ok
+    resp = nri_pb2.CreateContainerResponse.FromString(payload)
+    # the scheduler's cpuset annotation came back as an NRI adjustment,
+    # not a local write (containerCtx.NriDone)
+    assert resp.adjust.resources.cpuset_cpus == "0-3"
+
+
+def test_update_container_returns_update(world):
+    fs, runtime, plugin, _reg = world
+    runtime.configure()
+    sb = _be_sandbox(fs)
+    ctr = nri_pb2.Container(
+        id="ctr-9", pod_sandbox_id=sb.id, name="main",
+        cgroup_parent=sb.cgroup_parent + "/ctr-9")
+    ok, payload = runtime.call(
+        M_UPDATE_CONTAINER,
+        nri_pb2.UpdateContainerRequest(pod=sb, container=ctr))
+    assert ok
+    resp = nri_pb2.UpdateContainerResponse.FromString(payload)
+    assert len(resp.updates) == 1
+    assert resp.updates[0].container_id == "ctr-9"
+
+
+def test_synchronize_noop(world):
+    _fs, runtime, plugin, _reg = world
+    ok, payload = runtime.call(M_SYNCHRONIZE, nri_pb2.SynchronizeRequest())
+    assert ok
+    assert nri_pb2.SynchronizeResponse.FromString(payload).updates == []
+
+
+def test_failure_policy_fail_surfaces_hook_error(world, tmp_path):
+    fs, runtime, plugin, _reg = world
+
+    class BoomHook:
+        name = "Boom"
+
+        def apply(self, ctx):
+            raise RuntimeError("boom")
+
+    plugin.hooks.hooks.insert(0, BoomHook())
+    plugin.failure_policy = FailurePolicy.FAIL
+    sb = _be_sandbox(fs)
+    ok, payload = runtime.call(M_RUN_POD_SANDBOX,
+                               nri_pb2.RunPodSandboxRequest(pod=sb))
+    assert not ok
+    assert "boom" in nri_pb2.Error.FromString(payload).message
+    # IGNORE: same event succeeds, error recorded (server.go:154-160)
+    plugin.failure_policy = FailurePolicy.IGNORE
+    ok, _ = runtime.call(M_RUN_POD_SANDBOX,
+                         nri_pb2.RunPodSandboxRequest(pod=sb))
+    assert ok
+    assert any("boom" in e for e in plugin.errors)
+
+
+def test_start_fails_fast_without_socket(tmp_path):
+    fs = FakeFS(use_cgroup_v2=False)
+    store = ObjectStore()
+    informer = StatesInformer(store, "node-0", MetricCache())
+    executor = ResourceUpdateExecutor(fs.config, Auditor())
+    plugin = NriPlugin(str(tmp_path / "missing.sock"),
+                       RuntimeHooks(informer, executor))
+    with pytest.raises(FileNotFoundError):
+        plugin.start()

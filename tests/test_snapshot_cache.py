@@ -1,0 +1,247 @@
+"""Incremental snapshot cache: cached builds == cold rebuilds, exactly.
+
+The contract (scheduler/snapshot_cache.py): with a SnapshotCache attached,
+`build_full_chain_inputs` must produce bit-identical arrays to the cold
+walk-everything path across any store churn — pod arrivals, bindings,
+deletions, metric updates, node/topology changes, resizes. These tests
+drive REAL scheduler cycles (so reserve/unreserve, prebind patches and
+plugin epochs all fire) and diff every produced array after each step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.objects import (
+    Node,
+    NodeMetric,
+    NodeMetricInfo,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client.store import (
+    KIND_ELASTIC_QUOTA,
+    KIND_NODE,
+    KIND_NODE_METRIC,
+    KIND_NODE_TOPOLOGY,
+    KIND_POD,
+    KIND_POD_GROUP,
+    ObjectStore,
+)
+from koordinator_tpu.ops.loadaware import LoadAwareArgs
+from koordinator_tpu.scheduler.cycle import Scheduler
+from koordinator_tpu.scheduler.snapshot import build_full_chain_inputs
+from koordinator_tpu.testing import synth_full_cluster
+
+GIB = 1024 ** 3
+
+
+def _store_from_state(state):
+    store = ObjectStore()
+    for n in state.nodes:
+        store.add(KIND_NODE, n)
+    for nm in state.node_metrics.values():
+        store.add(KIND_NODE_METRIC, nm)
+    for p in state.pods_by_key.values():
+        store.add(KIND_POD, p)
+    for p in state.pending_pods:
+        store.add(KIND_POD, p)
+    for pg in state.pod_groups:
+        store.add(KIND_POD_GROUP, pg)
+    for q in state.quotas:
+        store.add(KIND_ELASTIC_QUOTA, q)
+    for t in state.topologies.values():
+        store.add(KIND_NODE_TOPOLOGY, t)
+    return store
+
+
+def _diff_builds(state, args, cache):
+    """Cold and cached builds of the same state must agree on every array."""
+    fc_a, pods_a, nodes_a, tree_a, gi_a, ng_a, ngr_a = \
+        build_full_chain_inputs(state, args)
+    fc_b, pods_b, nodes_b, tree_b, gi_b, ng_b, ngr_b = \
+        build_full_chain_inputs(state, args, cache=cache)
+    assert pods_a.keys == pods_b.keys
+    assert nodes_a.names == nodes_b.names
+    assert (gi_a, ng_a, ngr_a) == (gi_b, ng_b, ngr_b)
+    for field in ("requests", "estimated", "priority", "qos", "prio_class",
+                  "is_prod", "is_daemonset", "gang_id", "quota_id", "valid"):
+        a, b = getattr(pods_a, field), getattr(pods_b, field)
+        assert np.array_equal(a, b), f"pods.{field} differs"
+    for field in ("allocatable", "requested", "valid"):
+        a, b = getattr(nodes_a, field), getattr(nodes_b, field)
+        assert np.array_equal(a, b), f"nodes.{field} differs"
+    for k in nodes_a.extras:
+        assert np.array_equal(nodes_a.extras[k], nodes_b.extras[k]), \
+            f"extras[{k}] differs"
+    da, db = fc_a._asdict(), fc_b._asdict()
+    for k in da:
+        if k == "base":
+            for bk, bv in da[k]._asdict().items():
+                assert np.array_equal(bv, db[k]._asdict()[bk]), \
+                    f"base.{bk} differs"
+            continue
+        assert np.array_equal(da[k], db[k]), f"fc.{k} differs"
+    assert tree_a.names == tree_b.names
+    assert np.array_equal(tree_a.used, tree_b.used)
+    return fc_b
+
+
+@pytest.fixture()
+def churn_world():
+    cluster, state = synth_full_cluster(
+        24, 60, seed=3, num_quotas=3, num_gangs=4,
+        topology_fraction=0.5, lsr_fraction=0.2)
+    store = _store_from_state(state)
+    sched = Scheduler(store)
+    assert sched.snapshot_cache is not None, "gate should default on"
+    return state, store, sched
+
+
+def _fresh_state(sched, now):
+    pending, _ = sched._pending_queue(now)
+    return sched._cluster_state(pending, now)
+
+
+def test_cached_build_matches_cold_through_churn(churn_world):
+    state0, store, sched = churn_world
+    args = sched.args
+    now = state0.now
+
+    # cycle 0: cold == cached on the initial store
+    _diff_builds(_fresh_state(sched, now), args, sched.snapshot_cache)
+    sched.run_cycle(now=now)
+
+    # churn A: arrivals (some in gangs/quotas), a binding wave happened above
+    for i in range(12):
+        store.add(KIND_POD, Pod(
+            meta=ObjectMeta(name=f"arrival-{i}", namespace="churn",
+                            uid=f"arr-{i}", creation_timestamp=now + 1),
+            spec=PodSpec(priority=5000 + (i % 3) * 1000,
+                         requests=ResourceList.of(
+                             cpu=500 + 250 * (i % 4),
+                             memory=(1 + i % 3) * GIB, pods=1)),
+        ))
+    _diff_builds(_fresh_state(sched, now + 2), args, sched.snapshot_cache)
+    sched.run_cycle(now=now + 2)
+
+    # churn B: terminations + deletions release capacity
+    running = [p for p in store.list(KIND_POD)
+               if p.is_assigned and not p.is_terminated]
+    for p in running[:5]:
+        p.phase = "Succeeded"
+        store.update(KIND_POD, p)
+    for p in running[5:8]:
+        store.delete(KIND_POD, p.meta.key)
+    _diff_builds(_fresh_state(sched, now + 4), args, sched.snapshot_cache)
+    sched.run_cycle(now=now + 4)
+
+    # churn C: metric updates on a third of the nodes + one node flip
+    for nm in list(store.list(KIND_NODE_METRIC))[::3]:
+        nm.update_time = now + 5
+        nm.node_metric = NodeMetricInfo(
+            node_usage=ResourceList.of(cpu=9000, memory=30 * GIB))
+        store.update(KIND_NODE_METRIC, nm)
+    node = store.list(KIND_NODE)[1]
+    node.meta.labels["churn"] = "yes"
+    store.update(KIND_NODE, node)
+    _diff_builds(_fresh_state(sched, now + 6), args, sched.snapshot_cache)
+    sched.run_cycle(now=now + 6)
+
+    # churn D: node added + node removed (membership change -> layout rebuild)
+    store.add(KIND_NODE, Node(
+        meta=ObjectMeta(name="node-new", namespace=""),
+        allocatable=ResourceList.of(cpu=64000, memory=256 * GIB, pods=256)))
+    gone = store.list(KIND_NODE)[0]
+    store.delete(KIND_NODE, gone.meta.key)
+    _diff_builds(_fresh_state(sched, now + 8), args, sched.snapshot_cache)
+    sched.run_cycle(now=now + 8)
+
+    # churn E: metric expiry boundary crossing (now moves past expiration)
+    far = now + args.node_metric_expiration_seconds + 100
+    _diff_builds(_fresh_state(sched, far), args, sched.snapshot_cache)
+
+    stats = sched.snapshot_cache.stats
+    assert stats["pod_row_hits"] > 0, "carried-over pods should hit the cache"
+    assert stats["builds"] >= 6
+
+
+def test_cache_steady_state_recomputes_nothing(churn_world):
+    """Two identical consecutive builds: the second must not recompute any
+    LoadAware or NUMA rows and must hit the pod-row cache for every pod."""
+    state0, store, sched = churn_world
+    cache = sched.snapshot_cache
+    now = state0.now
+    build_full_chain_inputs(_fresh_state(sched, now), sched.args, cache=cache)
+    la0 = cache.stats["la_recomputed"]
+    numa0 = cache.stats["numa_recomputed"]
+    misses0 = cache.stats["pod_row_misses"]
+    build_full_chain_inputs(_fresh_state(sched, now), sched.args, cache=cache)
+    assert cache.stats["la_recomputed"] == la0
+    assert cache.stats["numa_recomputed"] == numa0
+    assert cache.stats["pod_row_misses"] == misses0
+    assert not cache.dirty_fields, (
+        "steady state must mark no node-side field dirty: "
+        f"{list(cache.dirty_fields)}")
+
+
+def test_resize_flows_through_cache(churn_world):
+    """In-place resize (store.update with new requests) must move the
+    node's assigned sum exactly."""
+    state0, store, sched = churn_world
+    now = state0.now
+    sched.run_cycle(now=now)
+    victim = next(p for p in store.list(KIND_POD)
+                  if p.is_assigned and not p.is_terminated)
+    victim.spec = dataclasses.replace(
+        victim.spec, requests=ResourceList.of(cpu=123, memory=GIB, pods=1))
+    store.update(KIND_POD, victim)
+    _diff_builds(_fresh_state(sched, now + 2), sched.args,
+                 sched.snapshot_cache)
+
+
+def test_cycle_results_identical_with_and_without_cache(churn_world):
+    """Full cycle outcomes (bindings) match a cache-less scheduler run on an
+    identical store."""
+    from koordinator_tpu.utils.features import SCHEDULER_GATES
+
+    cluster, state = synth_full_cluster(
+        24, 60, seed=3, num_quotas=3, num_gangs=4,
+        topology_fraction=0.5, lsr_fraction=0.2)
+    store_b = _store_from_state(state)
+    SCHEDULER_GATES.set_from_map({"IncrementalSnapshot": False})
+    try:
+        sched_b = Scheduler(store_b)
+        assert sched_b.snapshot_cache is None
+    finally:
+        SCHEDULER_GATES.reset()
+    _state0, store_a, sched_a = churn_world
+    res_a = sched_a.run_cycle(now=state.now)
+    res_b = sched_b.run_cycle(now=state.now)
+    assert sorted((b.pod_key, b.node_name) for b in res_a.bound) == \
+        sorted((b.pod_key, b.node_name) for b in res_b.bound)
+    assert sorted(res_a.failed) == sorted(res_b.failed)
+
+    # second cycle with identical arrivals on both stores: the cached
+    # scheduler's DeviceSnapshot now exercises buffer reuse + scatter
+    for store in (store_a, store_b):
+        for i in range(6):
+            store.add(KIND_POD, Pod(
+                meta=ObjectMeta(name=f"wave2-{i}", namespace="churn",
+                                uid=f"w2-{i}",
+                                creation_timestamp=state.now + 1),
+                spec=PodSpec(priority=6000,
+                             requests=ResourceList.of(
+                                 cpu=750, memory=2 * GIB, pods=1)),
+            ))
+    res_a2 = sched_a.run_cycle(now=state.now + 2)
+    res_b2 = sched_b.run_cycle(now=state.now + 2)
+    assert sorted((b.pod_key, b.node_name) for b in res_a2.bound) == \
+        sorted((b.pod_key, b.node_name) for b in res_b2.bound)
+    ds = sched_a.device_snapshot.stats
+    assert ds["reused"] > 0, f"expected device-buffer reuse: {ds}"
