@@ -636,23 +636,40 @@ class Device:
 
 @dataclass
 class PersistentVolumeClaim:
-    """Subset of core v1 PVC: the koordlet pvc informer only needs the
+    """Subset of core v1 PVC: the koordlet pvc informer needs the
     namespace/name -> bound volume name mapping (reference
-    pkg/koordlet/statesinformer/impl/states_pvc.go:44-60)."""
+    pkg/koordlet/statesinformer/impl/states_pvc.go:44-60); the scheduler's
+    VolumeBinding analog (scheduler/volumebinding.py) additionally reads
+    the storage class and requested capacity of unbound claims."""
 
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     volume_name: str = ""  # spec.volumeName once bound
+    # for a bound claim this is status.capacity; for an unbound claim it is
+    # spec.resources.requests (what a matching PV must cover)
     capacity: ResourceList = field(default_factory=ResourceList)
+    storage_class_name: str = ""  # spec.storageClassName ("" = classless)
+    phase: str = ""  # "", "Pending", "Bound" — volume_name wins when set
+
+    @property
+    def is_bound(self) -> bool:
+        return bool(self.volume_name)
 
 
 @dataclass
 class PersistentVolume:
-    """Subset of core v1 PV for the VolumeZone filter: a PV carrying zone/
-    region topology labels restricts pods mounting its claims to matching
-    nodes (the vendored kube-scheduler VolumeZone plugin the reference
-    inherits via cmd/koord-scheduler/main.go:53-62's upstream app)."""
+    """Subset of core v1 PV for the VolumeZone filter and the VolumeBinding
+    analog: a PV carrying zone/region topology labels restricts pods
+    mounting its claims to matching nodes (the vendored kube-scheduler
+    VolumeZone plugin the reference inherits via
+    cmd/koord-scheduler/main.go:53-62's upstream app); an Available PV is a
+    static-binding candidate for unbound WaitForFirstConsumer claims
+    (upstream VolumeBinding, same vendoring)."""
 
     meta: ObjectMeta = field(default_factory=ObjectMeta)
+    capacity: ResourceList = field(default_factory=ResourceList)
+    storage_class_name: str = ""
+    claim_ref: str = ""  # "namespace/name" of the bound claim once bound
+    phase: str = "Available"  # Available | Bound | Released
 
     ZONE_LABELS = ("topology.kubernetes.io/zone",
                    "topology.kubernetes.io/region",
@@ -662,6 +679,24 @@ class PersistentVolume:
     def zone_pairs(self) -> List[Tuple[str, str]]:
         return [(k, v) for k, v in self.meta.labels.items()
                 if k in self.ZONE_LABELS]
+
+
+@dataclass
+class StorageClass:
+    """storage.k8s.io/v1 StorageClass subset for volume binding: the
+    volumeBindingMode decides whether an unbound claim blocks scheduling
+    (Immediate — the async PV controller owns it) or binds at schedule time
+    (WaitForFirstConsumer), and allowedTopologies restricts where a dynamic
+    provisioner may create volumes. Cluster-scoped: namespace is ""."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    provisioner: str = ""
+    volume_binding_mode: str = "Immediate"  # or "WaitForFirstConsumer"
+    # allowedTopologies: each term is a tuple of (key, allowed values)
+    # requirements ANDed together; terms are ORed (core v1
+    # TopologySelectorTerm.matchLabelExpressions)
+    allowed_topologies: List[Tuple[Tuple[str, Tuple[str, ...]], ...]] = field(
+        default_factory=list)
 
 
 # ---------------------------------------------------------------------------

@@ -52,6 +52,7 @@ KIND_PDB = "PodDisruptionBudget"
 KIND_LEASE = "Lease"  # coordination.k8s.io leader-election lease
 KIND_PVC = "PersistentVolumeClaim"
 KIND_PV = "PersistentVolume"
+KIND_STORAGECLASS = "StorageClass"
 KIND_NAMESPACE = "Namespace"
 
 ALL_KINDS = (
@@ -72,6 +73,7 @@ ALL_KINDS = (
     KIND_LEASE,
     KIND_PVC,
     KIND_PV,
+    KIND_STORAGECLASS,
     KIND_NAMESPACE,
 )
 

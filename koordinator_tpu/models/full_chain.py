@@ -66,7 +66,11 @@ class FullChainInputs(NamedTuple):
     #     (the wave kernel's conflict rule)
     pod_port_wants: jnp.ndarray  # [P, PT] bool — hostPort slots requested
     #     (ops/ports.py NodePorts factorization)
-    vol_needed: jnp.ndarray     # [P] f32 — new PVC volumes the pod mounts
+    vol_needed: jnp.ndarray     # [P, VG] f32 — NEW PVC attachments the pod
+    #     adds on a node of volume-group g: distinct claims minus claims
+    #     already attached there (upstream NodeVolumeLimits counts only new
+    #     attachments). VG==1 ("no pending claim attached anywhere") is the
+    #     common case and collapses to the plain per-pod count.
     pod_img_id: jnp.ndarray     # [P] int32 ImageLocality profile (-1)
     # nodes
     node_taint_group: jnp.ndarray  # [N] int32 admission-signature group
@@ -82,6 +86,9 @@ class FullChainInputs(NamedTuple):
     port_used: jnp.ndarray      # [N, PT] f32 — hostPort slot in use on n
     vol_free: jnp.ndarray       # [N] f32 — attachable CSI volumes left
     #     (+inf when the node reports no limit)
+    node_vol_group: jnp.ndarray  # [N] int32 — volume-group id: nodes whose
+    #     attached-claim sets intersect the pending batch's claims
+    #     identically share a group (group 0 = empty intersection)
     img_scores: jnp.ndarray     # [N, max(SI,1)] f32 ImageLocality rows
     ppref_w: jnp.ndarray        # [max(S2,1), max(T,1)] f32 per-profile term
     #     weights for preferred pod affinity (negative = anti preference)
@@ -235,8 +242,11 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode,
             ports_ok = ports_ok & (
                 ~fc.pod_port_wants[i, s] | (port_used[:, s] <= 0))
         # NodeVolumeLimits (CSI attachable count): nodes without a reported
-        # limit carry vol_free = +inf and always pass
-        vol_ok = (fc.vol_needed[i] <= 0) | (vol_free >= fc.vol_needed[i])
+        # limit carry vol_free = +inf and always pass; the per-node volume
+        # group resolves "claims already attached here don't count again"
+        # (upstream's already-attached exemption)
+        vn = fc.vol_needed[i][fc.node_vol_group]
+        vol_ok = (vn <= 0) | (vol_free >= vn)
         feasible = (
             inputs.node_ok & fit & la_ok & cpuset_ok & numa_ok & taint_ok
             & affinity_ok & ports_ok & vol_ok & admit
@@ -375,7 +385,8 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
                     fnd * fc.pod_port_wants[i].astype(jnp.float32))
                 port_used = jax.lax.dynamic_update_slice(
                     port_used, port_row[None], (best, 0))
-            vol_free = vol_free.at[best].add(-fnd * fc.vol_needed[i])
+            vol_free = vol_free.at[best].add(
+                -fnd * fc.vol_needed[i][fc.node_vol_group[best]])
             quota_used = quota_used_add_row(
                 quota_used, req, fc.quota_id[i], fc.quota_ancestors, found
             )

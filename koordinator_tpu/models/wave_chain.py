@@ -252,7 +252,13 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                     port_used,
                     mm(sel_c.T,
                        fc.pod_port_wants[idxc].astype(jnp.float32)))
-            vol_free = vol_free - mm(sel_c.T, fc.vol_needed[idxc])
+            # per-pod NEW attachments at the chosen node (volume-group
+            # gather — the already-attached exemption), one nonzero per
+            # output row as above so the rollup equals the serial add
+            vn_at_best = jnp.take_along_axis(
+                fc.vol_needed[idxc],
+                fc.node_vol_group[best_w][:, None], axis=1)[:, 0]  # [W]
+            vol_free = vol_free - mm(sel_c.T, vn_at_best)
             # committed pods occupy DISTINCT nodes (node_coll cut), so the
             # per-pod NUMA fills scatter without aliasing
             new_rows_w = jax.vmap(numa_spread_fill)(

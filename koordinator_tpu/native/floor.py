@@ -53,13 +53,13 @@ def _load() -> Optional[ctypes.CDLL]:
     # would read every pointer after the insertion shifted
     try:
         lib.koord_floor_abi_version.restype = ctypes.c_int
-        if lib.koord_floor_abi_version() != 10:
+        if lib.koord_floor_abi_version() != 11:
             return None
     except AttributeError:
         return None
     lib.koord_serial_full_chain.restype = None
     lib.koord_serial_full_chain.argtypes = (
-        [ctypes.c_int] * 15          # P R N K G A NG T S S2 PT SI CI MI prod
+        [ctypes.c_int] * 16          # P R N K G A NG T S S2 PT SI VG CI MI prod
         + [_F32P] * 3                # fit_requests requests estimated
         + [_I32P] * 7                # is_prod..needs_bind
         + [_F32P] + [_I32P]          # cores_needed full_pcpus
@@ -81,7 +81,8 @@ def _load() -> Optional[ctypes.CDLL]:
         + [_F32P] * 3                # aff_dom aff_count anti_cover
         + [_I32P]                    # aff_exists
         + [_F32P]                    # pref_scores [N, S]
-        + [_F32P] * 3                # port_used vol_free img_scores
+        + [_F32P] * 2 + [_I32P]      # port_used vol_free node_vol_group
+        + [_F32P]                    # img_scores
         + [_I32P] + [_F32P] * 2      # ancestors quota_used quota_runtime
         + [_I32P] + [_F32P] * 2      # gang_valid gang_min gang_assumed
         + [_I32P, ctypes.c_int]      # gang_group num_groups
@@ -183,8 +184,9 @@ def serial_schedule_full_native(fc, args, num_groups: int = 0,
 
     bal_ci, bal_mi = resolve_balance_idx(active_axes)
     chosen = np.full(P, -1, np.int32)
+    VG = int(np.asarray(fc.vol_needed).shape[1])
     lib.koord_serial_full_chain(
-        P, R, N, K, max(G, 0), A, NG, T, S, S2, PT, SI, bal_ci, bal_mi,
+        P, R, N, K, max(G, 0), A, NG, T, S, S2, PT, SI, VG, bal_ci, bal_mi,
         1 if args.score_according_prod_usage else 0,
         fit_requests, _f32(fc.requests), _f32(inputs.estimated),
         _i32(inputs.is_prod), _i32(inputs.is_daemonset),
@@ -222,7 +224,7 @@ def serial_schedule_full_native(fc, args, num_groups: int = 0,
          else np.zeros((N, 1), np.float32)),
         (_f32(fc.port_used).copy() if PT
          else np.zeros((N, 1), np.float32)),
-        _f32(fc.vol_free).copy(),
+        _f32(fc.vol_free).copy(), _i32(fc.node_vol_group),
         (_f32(fc.img_scores) if SI
          else np.zeros((N, 1), np.float32)),
         ancestors if ancestors.size else np.zeros((1, 1), np.int32),

@@ -32,7 +32,7 @@ inline float least_requested(float requested, float capacity) {
 
 // ABI version: bump when koord_serial_full_chain's signature changes, so a
 // stale .so is rejected instead of mis-reading shifted pointers.
-extern "C" int koord_floor_abi_version() { return 10; }
+extern "C" int koord_floor_abi_version() { return 11; }
 
 extern "C" {
 
@@ -42,7 +42,7 @@ extern "C" {
 void koord_serial_full_chain(
     // dims
     int P, int R, int N, int K, int G, int A, int NG, int T, int S,
-    int S2, int PT, int SI,
+    int S2, int PT, int SI, int VG,
     int bal_ci, int bal_mi,  // balanced-allocation cpu/mem axes (-1 = off)
     int prod_mode,
     // pods
@@ -67,7 +67,8 @@ void koord_serial_full_chain(
     const int32_t* pod_ppref_id,   // [P] preferred POD-affinity profile
     const float* ppref_w,          // [max(S2,1), max(T,1)] profile weights
     const int32_t* pod_port_wants, // [P] bitmask of hostPort slots
-    const float* vol_needed,       // [P] new PVC volume count
+    const float* vol_needed,       // [P, VG] new PVC volume count per node
+                                   //         volume group
     const int32_t* pod_img_id,     // [P] ImageLocality profile (-1)
     // nodes
     const float* allocatable,    // [N, R]
@@ -99,6 +100,8 @@ void koord_serial_full_chain(
     float* port_used,            // [N, PT] hostPort slot bound (mutated)
     float* vol_free,             // [N] CSI attachable headroom (mutated;
                                  //     +inf when the node reports no limit)
+    const int32_t* node_vol_group, // [N] volume group selecting the pod's
+                                   //     NEW-attachment count
     const float* img_scores,     // [N, SI] ImageLocality score rows
     // quota
     const int32_t* ancestors,    // [G, A] (-1 padded)
@@ -240,8 +243,12 @@ void koord_serial_full_chain(
             port_ok = false;
         if (!port_ok) continue;
       }
-      // CSI volume limit (+inf when the node reports none)
-      if (vol_needed[p] > 0.0f && vol_free[n] < vol_needed[p]) continue;
+      // CSI volume limit (+inf when the node reports none); the node's
+      // volume group selects NEW attachments only
+      {
+        float vn = vol_needed[(int64_t)p * VG + node_vol_group[n]];
+        if (vn > 0.0f && vol_free[n] < vn) continue;
+      }
       const float* alloc = allocatable + (int64_t)n * R;
       const float* reqn = requested_state + (int64_t)n * R;
       // Filter: Fit
@@ -382,7 +389,10 @@ void koord_serial_full_chain(
     for (int s = 0; s < PT; ++s)
       if ((pod_port_wants[p] >> s) & 1)
         port_used[(int64_t)best_n * PT + s] = 1.0f;
-    if (vol_needed[p] > 0.0f) vol_free[best_n] -= vol_needed[p];
+    {
+      float vnb = vol_needed[(int64_t)p * VG + node_vol_group[best_n]];
+      if (vnb > 0.0f) vol_free[best_n] -= vnb;
+    }
     if (quota_id[p] >= 0) {
       const int32_t* chain = ancestors + (int64_t)quota_id[p] * A;
       for (int a = 0; a < A; ++a) {

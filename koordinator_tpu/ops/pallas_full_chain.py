@@ -58,7 +58,7 @@ def estimate_vmem_bytes(N: int, R: int, K: int, G: int, P: int,
     P_pad = -(-P // POD_BLOCK) * POD_BLOCK
     G_eff = max(G, 1)
     G_lane = max(128, -(-G_eff // 128) * 128)
-    floats = (3 * POD_BLOCK * R * 2 + 8 * R * N + 2 * K * R * N + 13 * N
+    floats = (3 * POD_BLOCK * R * 2 + 8 * R * N + 2 * K * R * N + 14 * N
               + 5 * max(T, 0) * N + max(S, 1) * N
               + 2 * max(PT, 1) * N + max(SI, 1) * N
               + 4 * R * G_lane + 2 * UNROLL * G_lane + P_pad)
@@ -68,7 +68,7 @@ def estimate_vmem_bytes(N: int, R: int, K: int, G: int, P: int,
 def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
                  K: int, G: int, T: int = 0, S: int = 0, S2: int = 0,
                  PT: int = 0, SI: int = 0, VOL: bool = True,
-                 BAL=(-1, -1)):
+                 VG: int = 1, BAL=(-1, -1)):
     wsum = float(max(weights.sum(), 1.0))
     consts = pc.weight_consts(weights)
 
@@ -84,7 +84,8 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         pprefid_ref,                             # int32 [P] pod-pref profile
         pprefw_ref,                              # f32 [max(S2,1), max(T,1)]
         portwants_ref,                           # f32 [P] port-slot bitmask
-        volneeded_ref,                           # f32 [P] new PVC count
+        volneeded_ref,                           # f32 [P, VG] new PVC count
+        #     per node volume-group (already-attached exemption)
         imgid_ref,                               # int32 [P] image profile
         qid_ref,                                                  # int32 [P]
         # --- VMEM pod column blocks [R, POD_BLOCK]
@@ -102,7 +103,7 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         #     profile score rows [max(S,1), N] + NodePorts slots
         #     [max(PT,1), N] + volume headroom [1, N] + ImageLocality rows
         affdom_ref, affcount0_ref, anticover0_ref, prefrows_ref,
-        portused0_ref, volfree0_ref, imgrows_ref,
+        portused0_ref, volfree0_ref, volgrp_ref, imgrows_ref,
         # --- outputs
         chosen_ref,                 # (UNROLL, 1) int32 block, one per step
         requested_ref,              # [R, N] (carried)
@@ -188,6 +189,7 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         anti_cover = [anticover_ref[t:t + 1, :] for t in range(T)]
         port_used = [portused_ref[s:s + 1, :] for s in range(PT)]
         vol_free = volfree_ref[0, :] if VOL else None
+        volgrp = volgrp_ref[0, :] if VOL else None  # [N] f32 group ids
 
         for j in range(UNROLL):
             p = i * UNROLL + j
@@ -263,7 +265,14 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
             feasible = (node_ok_row & fit & la_ok & cpuset_ok
                         & numa_ok & taint_ok & admit)
             if VOL:
-                vol_needed = volneeded_ref[p]
+                # per-node NEW attachments: the pod's [VG] row gathered by
+                # the node's volume group (select over static VG; group ids
+                # are exact small-integer f32)
+                vol_needed = jnp.where(
+                    volgrp == 0.0, volneeded_ref[p, 0], 0.0)
+                for g in range(1, VG):
+                    vol_needed = jnp.where(
+                        volgrp == float(g), volneeded_ref[p, g], vol_needed)
                 feasible = feasible & (
                     (vol_needed <= 0.0) | (vol_free >= vol_needed))
             for s in range(PT):
@@ -582,8 +591,10 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
         else:
             portwants_m = jnp.zeros(P_pad, jnp.float32)
             portused0 = jnp.zeros((1, N), jnp.float32)
-        volneeded_pad = spad(fc.vol_needed)
+        VG = fc.vol_needed.shape[1]
+        volneeded_pad = jnp.pad(f32(fc.vol_needed), pad_p + [(0, 0)])
         volfree0 = f32(fc.vol_free)[None, :]
+        volgrp0 = f32(fc.node_vol_group)[None, :]
         SI = fc.img_scores.shape[1]
         SI_eff = max(SI, 1)
         imgrows0 = (f32(fc.img_scores).T if SI
@@ -592,7 +603,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                             constant_values=-1)
 
         kernel = _make_kernel(weights, prod_mode, N, R, K, G_eff, T, S, S2,
-                              PT, SI, VOL=enable_volumes,
+                              PT, SI, VOL=enable_volumes, VG=VG,
                               BAL=resolve_balance_idx(active_axes))
         grid_inputs = (
             spad(inputs.is_prod), spad(inputs.pod_valid),
@@ -616,7 +627,7 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             jnp.exp2(f32(fc.node_taint_group))[None, :],
             numa0, anc_pod, qused0, qruntime,
             affdom0, affcount0, anticover0, prefrows0,
-            portused0, volfree0, imgrows0,
+            portused0, volfree0, volgrp0, imgrows0,
         )
         smem, full = pc.smem_spec, pc.full_spec
         pod_spec = pc.pod_block_spec(R)
@@ -633,7 +644,8 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                    full((R, G_lane)), full((R, G_lane))]
                 + [full((T_eff, N))] * 3
                 + [full((S_eff, N))]
-                + [full((PT_eff, N)), full((1, N)), full((SI_eff, N))]
+                + [full((PT_eff, N)), full((1, N)), full((1, N)),
+                   full((SI_eff, N))]
             ),
             out_specs=[
                 pc.chosen_block_spec(),

@@ -162,12 +162,20 @@ def degraded_node_count(group_ids, groups) -> int:
 
 
 def admission_mask(pod, groups: List[Tuple[frozenset, object]],
-                   extra_pairs: frozenset = frozenset()) -> float:
+                   extra_pairs: frozenset = frozenset(),
+                   any_of_sets: Sequence = ()) -> float:
     """Bitmask (as an exact float32 integer) of the node groups this pod may
     land on: taints tolerated AND every nodeSelector pair in the group's
-    matched set. Label-unknown buckets admit only selector-less pods; the
+    matched set. Label-unknown buckets admit only unconstrained pods; the
     overflow group's bit is never set. extra_pairs joins the pod's own
-    required set (VolumeZone)."""
+    required set (VolumeZone).
+
+    any_of_sets carries OR-of-AND requirements (the VolumeBinding analog,
+    scheduler/volumebinding.py): each element is a collection of
+    ALTERNATIVES for one unbound claim — the group must fully match at
+    least one alternative's pair set per element (some candidate PV's
+    topology, or some provisioner-allowed topology term). An element with
+    no satisfiable alternative zeroes the mask: the claim fits nowhere."""
     mask = 0
     tolerations = pod.spec.tolerations
     selector = required_node_pairs(pod) | extra_pairs
@@ -175,9 +183,13 @@ def admission_mask(pod, groups: List[Tuple[frozenset, object]],
         if taints and not tolerates_taints(tolerations, taints):
             continue
         if matched is _UNKNOWN:
-            if selector:
+            if selector or any_of_sets:
                 continue
-        elif not selector <= matched:
-            continue
+        else:
+            if not selector <= matched:
+                continue
+            if any(not any(alt <= matched for alt in alts)
+                   for alts in any_of_sets):
+                continue
         mask |= 1 << gid
     return float(mask)

@@ -58,6 +58,7 @@ _FC_NODE_FIELDS = frozenset(
         "pref_scores",
         "port_used",
         "vol_free",
+        "node_vol_group",
         "img_scores",
     }
 )

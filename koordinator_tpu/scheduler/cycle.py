@@ -43,6 +43,7 @@ from koordinator_tpu.client.store import (
     KIND_PV,
     KIND_PVC,
     KIND_RESERVATION,
+    KIND_STORAGECLASS,
     ObjectStore,
 )
 from koordinator_tpu.models.full_chain import build_best_full_chain_step
@@ -352,6 +353,10 @@ class Scheduler:
             gang_assumed=dict(gang.assumed) if gang else {},
             pvcs={c.meta.key: c for c in self.store.list(KIND_PVC)},
             pvs={v.meta.name: v for v in self.store.list(KIND_PV)},
+            storage_classes={
+                s.meta.name: s
+                for s in self.store.list(KIND_STORAGECLASS)
+            },
             now=now,
         )
 

@@ -26,3 +26,10 @@ ADMISSION_DEGRADED_NODES = REGISTRY.gauge(
     "koord_scheduler_admission_signature_degraded_nodes",
     "Nodes in a label-unknown admission bucket in the last snapshot",
 )
+
+# nodes whose attached-claim volume group overflowed MAX_VOL_GROUPS in the
+# last snapshot: pods pay the full (unexempted) attachment count there
+VOL_GROUP_DEGRADED_NODES = REGISTRY.gauge(
+    "koord_scheduler_volume_group_degraded_nodes",
+    "Nodes degraded to the conservative volume group in the last snapshot",
+)

@@ -232,8 +232,9 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs,
     ppref_w = np.asarray(fc.ppref_w, np.float32)
     pod_port_wants = np.asarray(fc.pod_port_wants)
     port_used = np.array(fc.port_used, np.float32)
-    vol_needed = np.asarray(fc.vol_needed, np.float32)
+    vol_needed = np.asarray(fc.vol_needed, np.float32)  # [P, VG]
     vol_free = np.array(fc.vol_free, np.float32)
+    node_vol_group = np.asarray(fc.node_vol_group, np.int64)
     pod_img_id = np.asarray(fc.pod_img_id)
     img_scores = np.asarray(fc.img_scores, np.float32)
     T = aff_dom.shape[1]
@@ -369,8 +370,11 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs,
                 for s in range(PT)
             ):
                 continue
-            # CSI volume limit (+inf when the node reports none)
-            if vol_needed[p] > 0 and vol_free[n] < vol_needed[p]:
+            # CSI volume limit (+inf when the node reports none); the node's
+            # volume group selects NEW attachments only (already-attached
+            # exemption)
+            vn = vol_needed[p, node_vol_group[n]]
+            if vn > 0 and vol_free[n] < vn:
                 continue
             # cpuset filter
             if needs_bind[p]:
@@ -467,8 +471,9 @@ def serial_schedule_full_core(fc, args: LoadAwareArgs,
         for s in range(PT):
             if pod_port_wants[p, s]:
                 port_used[best_n, s] = 1.0
-        if vol_needed[p] > 0:
-            vol_free[best_n] -= vol_needed[p]
+        vn_best = vol_needed[p, node_vol_group[best_n]]
+        if vn_best > 0:
+            vol_free[best_n] -= vn_best
         if quota_id[p] >= 0:
             for g in ancestors[quota_id[p]]:
                 if g >= 0:

@@ -15,6 +15,7 @@ from koordinator_tpu.scheduler.plugins.coscheduling import CoschedulingPlugin  #
 from koordinator_tpu.scheduler.plugins.elasticquota import ElasticQuotaPlugin  # noqa: F401
 from koordinator_tpu.scheduler.plugins.deviceshare import DeviceSharePlugin  # noqa: F401
 from koordinator_tpu.scheduler.plugins.defaultprebind import DefaultPreBindPlugin  # noqa: F401
+from koordinator_tpu.scheduler.volumebinding import VolumeBindingPlugin  # noqa: F401
 
 DEFAULT_PLUGINS = (
     LoadAwarePlugin,
@@ -23,5 +24,6 @@ DEFAULT_PLUGINS = (
     CoschedulingPlugin,
     ElasticQuotaPlugin,
     DeviceSharePlugin,
+    VolumeBindingPlugin,
     DefaultPreBindPlugin,
 )

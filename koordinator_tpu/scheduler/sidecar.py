@@ -84,6 +84,15 @@ def unpack_request(req: sidecar_pb2.ScheduleBatchRequest) -> Tuple[FullChainInpu
             base_kwargs[name[5:]] = arr
         else:
             fc_kwargs[name] = arr
+    # wire compat: clients predating the volume-group encoding send a 1-D
+    # vol_needed and no node_vol_group — normalize to the VG == 1 form
+    # (identical semantics)
+    vn = fc_kwargs.get("vol_needed")
+    if vn is not None and vn.ndim == 1:
+        fc_kwargs["vol_needed"] = vn[:, None]
+    if "node_vol_group" not in fc_kwargs and "vol_free" in fc_kwargs:
+        fc_kwargs["node_vol_group"] = jnp.zeros(
+            fc_kwargs["vol_free"].shape[0], jnp.int32)
     fc = FullChainInputs(base=ScheduleInputs(**base_kwargs), **fc_kwargs)
     args = LoadAwareArgs(score_according_prod_usage=req.score_according_prod_usage)
     if weights_vec is not None:

@@ -10,6 +10,7 @@ relative to the win.
 from __future__ import annotations
 
 import json
+import logging
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -45,6 +46,7 @@ from koordinator_tpu.ops.taints import (
 from koordinator_tpu.scheduler.metrics import (
     ADMISSION_DEGRADED_NODES,
     ENCODING_OVERFLOW_PODS,
+    VOL_GROUP_DEGRADED_NODES,
 )
 from koordinator_tpu.ops.quota import (
     MAX_QUOTA_DEPTH,
@@ -54,6 +56,13 @@ from koordinator_tpu.ops.quota import (
     merge_group_request,
 )
 from koordinator_tpu.scheduler.cpu_topology import CPUAllocationState, FULL_PCPUS
+
+logger = logging.getLogger(__name__)
+
+# volume-group budget: more distinct attached-set intersections than this
+# degrade to the conservative full count (group 0) — the same stance as the
+# admission-signature overflow (ops/taints.py)
+MAX_VOL_GROUPS = 16
 
 CPU_IDX = RESOURCE_INDEX[ResourceName.CPU]
 PODS_IDX = RESOURCE_INDEX[ResourceName.PODS]
@@ -152,10 +161,12 @@ class ClusterState:
     quotas: List[ElasticQuota] = field(default_factory=list)
     pod_groups: List[PodGroup] = field(default_factory=list)
     gang_assumed: Dict[str, int] = field(default_factory=dict)
-    # VolumeZone/volume-limit inputs: PVCs by "namespace/name" key, PVs by
-    # volume name (both optional — empty means no volume constraints)
+    # VolumeZone/volume-limit/VolumeBinding inputs: PVCs by "namespace/name"
+    # key, PVs by volume name, StorageClasses by name (all optional — empty
+    # means no volume constraints)
     pvcs: Dict[str, object] = field(default_factory=dict)
     pvs: Dict[str, object] = field(default_factory=dict)
+    storage_classes: Dict[str, object] = field(default_factory=dict)
     cluster_total: Optional[np.ndarray] = None
     now: float = 0.0
 
@@ -307,15 +318,48 @@ def build_full_chain_inputs(
     # nodeSelector -> group bitmasks. This is how TaintToleration AND
     # NodeAffinity (nodeSelector) batch into one bit test.
     # VolumeZone: PV topology labels become per-pod required pairs riding
-    # the admission bitmask (no new kernel state)
+    # the admission bitmask (no new kernel state). VolumeBinding (unbound
+    # WaitForFirstConsumer claims) rides the same bitmask as OR-of-AND
+    # alternatives — scheduler/volumebinding.py — so the kernel's one bit
+    # test covers schedule-time volume feasibility too, in every backend.
     zone_pairs_by_key = {}
-    if state.pvcs:
+    vb_any_of_by_key: Dict[str, tuple] = {}
+    vb_reason_by_key: Dict[str, str] = {}
+    # volume-aware mode: any PVC/PV/StorageClass object in the store turns
+    # classification on (a cluster that has ever used storage keeps its
+    # StorageClasses even when all claims are deleted, so a pod referencing
+    # a vanished claim is still PreFilter-rejected). A store with NONE of
+    # the three is the informal harness mode where pvc_names are opaque
+    # CSI-count tokens (synth clusters, kernel-level benches).
+    if state.pvcs or state.pvs or state.storage_classes:
+        from koordinator_tpu.scheduler.volumebinding import (
+            any_of_pair_universe,
+            classify_pod_volumes,
+        )
+
         for key, pod in pods_by_key_pending.items():
+            if not pod.spec.pvc_names:
+                continue
             zp = volume_zone_pairs(pod, state.pvcs, state.pvs)
             if zp:
                 zone_pairs_by_key[key] = zp
+            vb = (cache.pod_vb(pod) if cache is not None else None)
+            if vb is None:
+                vb = classify_pod_volumes(
+                    pod, state.pvcs, state.pvs, state.storage_classes)
+                if cache is not None:
+                    cache.put_pod_vb(pod, vb)
+            if vb.reason is not None:
+                vb_reason_by_key[key] = vb.reason
+            elif vb.any_of_sets:
+                vb_any_of_by_key[key] = vb.any_of_sets
     sel_pairs = selector_pairs_of(pods_by_key_pending.values(),
                                   zone_pairs_by_key)
+    if vb_any_of_by_key:
+        sel_pairs = frozenset(
+            sel_pairs
+            | {p for sets in vb_any_of_by_key.values()
+               for p in any_of_pair_universe(sets)})
     if cache is not None:
         node_taint_ids, admission_groups, adm_seq = cache.node_admission(
             state.nodes, sel_pairs)
@@ -340,16 +384,26 @@ def build_full_chain_inputs(
             if cache is not None:
                 cache.put_pod_flag(pod, (nb, cn, fp, bool(needs_numa[i]),
                                          float(vol_needed[i])))
-        mask = (cache.pod_mask(pod, adm_seq)
-                if cache is not None else None)
-        if mask is not None:
-            pod_taint_mask[i] = mask
+        if key in vb_reason_by_key:
+            # VolumeBinding PreFilter rejection (missing claim/class,
+            # unbound immediate claim, claim satisfiable nowhere): no
+            # group admits the pod, and the cycle surfaces the reason on
+            # the pod's condition (upstream PreFilter unschedulable status)
+            pod_taint_mask[i] = 0.0
+            pods.unschedulable_reasons[i] = vb_reason_by_key[key]
         else:
-            pod_taint_mask[i] = admission_mask(
-                pod, admission_groups,
-                zone_pairs_by_key.get(key, frozenset()))
-            if cache is not None:
-                cache.put_pod_mask(pod, adm_seq, float(pod_taint_mask[i]))
+            mask = (cache.pod_mask(pod, adm_seq)
+                    if cache is not None else None)
+            if mask is not None:
+                pod_taint_mask[i] = mask
+            else:
+                pod_taint_mask[i] = admission_mask(
+                    pod, admission_groups,
+                    zone_pairs_by_key.get(key, frozenset()),
+                    any_of_sets=vb_any_of_by_key.get(key, ()))
+                if cache is not None:
+                    cache.put_pod_mask(pod, adm_seq,
+                                       float(pod_taint_mask[i]))
         q = pod.quota_name
         if q:  # quota ids resolve only after the tree exists
             pods.quota_id[i] = quota_ids.get(q, -1)
@@ -532,6 +586,55 @@ def build_full_chain_inputs(
         if node.attachable_volume_limit > 0:
             vol_free[i] = node.attachable_volume_limit - len(
                 attached.get(node.meta.name, ()))
+    # volume-group factorization (upstream NodeVolumeLimits' already-
+    # attached exemption): nodes whose attached-claim sets intersect the
+    # PENDING batch's claims identically share a group, and vol_needed
+    # expands to [P, VG] rows counting only NEW attachments per group.
+    # Group 0 is the empty intersection (the common case: VG == 1 and the
+    # column equals the plain per-pod count). Budget overflow degrades a
+    # node to group 0 — the conservative full count, the pre-exemption
+    # behavior. Known divergence: TWO PENDING pods sharing a claim in the
+    # same batch each count it (the groups are frozen at pack time, while
+    # upstream's assume cache sees the first binding); conservative, and
+    # self-corrects next cycle when the binding reaches the attached sets.
+    node_vol_group = np.zeros(N, np.int32)
+    group_sets: List[frozenset] = [frozenset()]
+    pending_claims: Dict[str, frozenset] = {}
+    for key, pod in pods_by_key_pending.items():
+        if pod.spec.pvc_names:
+            pending_claims[key] = frozenset(
+                f"{pod.meta.namespace}/{c}" for c in pod.spec.pvc_names)
+    vol_degraded = 0
+    if pending_claims and attached:
+        claim_universe = frozenset().union(*pending_claims.values())
+        gid_of = {frozenset(): 0}
+        for i, node in enumerate(state.nodes):
+            s = frozenset(attached.get(node.meta.name, ())) & claim_universe
+            gid = gid_of.get(s)
+            if gid is None:
+                if len(group_sets) >= MAX_VOL_GROUPS:
+                    # overflow: the node loses its exemption (full count) —
+                    # surfaced like the admission-signature degradation
+                    gid = 0
+                    vol_degraded += 1
+                    logger.warning(
+                        "node %s exceeds the volume-group budget (%d): "
+                        "pods pay the full attachment count there",
+                        node.meta.name, MAX_VOL_GROUPS)
+                else:
+                    gid = gid_of[s] = len(group_sets)
+                    group_sets.append(s)
+            node_vol_group[i] = gid
+    VOL_GROUP_DEGRADED_NODES.set(float(vol_degraded))
+    VG = len(group_sets)
+    vol_needed_g = np.zeros((P, VG), np.float32)
+    vol_needed_g[:, 0] = vol_needed
+    if VG > 1:
+        for i, key in enumerate(pods.keys):
+            claims = pending_claims.get(key)
+            for g in range(1, VG):
+                vol_needed_g[i, g] = (len(claims - group_sets[g])
+                                      if claims else 0.0)
     img_rows_v, img_id_v = build_image_scores(ordered_pending, state.nodes)
     n_img = img_rows_v.shape[0] if (img_id_v >= 0).any() else 0
     img_scores = np.zeros((N, n_img), np.float32)
@@ -561,10 +664,11 @@ def build_full_chain_inputs(
         pod_ppref_mask=np.asarray(pod_ppref_mask),
         ppref_w=np.asarray(ppref_w),
         pod_port_wants=np.asarray(pod_port_wants),
-        vol_needed=np.asarray(vol_needed),
+        vol_needed=np.asarray(vol_needed_g),
         pod_img_id=np.asarray(pod_img_id),
         port_used=np.asarray(port_used),
         vol_free=np.asarray(vol_free),
+        node_vol_group=np.asarray(node_vol_group),
         img_scores=np.asarray(img_scores),
         node_taint_group=np.asarray(node_taint_group),
         aff_dom=np.asarray(aff_dom),

@@ -54,6 +54,7 @@ from koordinator_tpu.client.store import (
     KIND_POD,
     KIND_PV,
     KIND_PVC,
+    KIND_STORAGECLASS,
     EventType,
     ObjectStore,
 )
@@ -80,6 +81,9 @@ class SnapshotCache:
         self.pod_rows: Dict[str, Tuple[int, dict]] = {}
         self.pod_flags: Dict[str, Tuple[int, tuple]] = {}
         self.pod_masks: Dict[str, Tuple[tuple, float]] = {}
+        # VolumeBinding classification (scheduler/volumebinding.py): the
+        # PV-scan feeding the admission mask, keyed like the mask itself
+        self.pod_vbs: Dict[str, Tuple[tuple, object]] = {}
 
         # ---- incremental aggregates over ASSIGNED pods ----
         # pod key -> (node, packed f32 row with pods-axis=1) for fit sums
@@ -126,6 +130,10 @@ class SnapshotCache:
         store.subscribe(KIND_NODE_TOPOLOGY, self._on_topology)
         store.subscribe(KIND_PVC, self._on_pvcpv)
         store.subscribe(KIND_PV, self._on_pvcpv)
+        # StorageClass changes feed the VolumeBinding classification that
+        # shapes the admission mask (scheduler/volumebinding.py), so they
+        # share the PVC/PV epoch the mask cache is keyed on
+        store.subscribe(KIND_STORAGECLASS, self._on_pvcpv)
 
     # ------------------------------------------------------------------
     # event handlers
@@ -135,6 +143,7 @@ class SnapshotCache:
         self.pod_rows.pop(key, None)
         self.pod_flags.pop(key, None)
         self.pod_masks.pop(key, None)
+        self.pod_vbs.pop(key, None)
         counted = (ev is not EventType.DELETED and pod.is_assigned
                    and not pod.is_terminated)
         self._retract(key)
@@ -255,6 +264,19 @@ class SnapshotCache:
     def put_pod_mask(self, pod: Pod, adm_seq: int, mask: float) -> None:
         self.pod_masks[pod.meta.key] = (
             (pod.meta.resource_version, adm_seq, self.pvcpv_epoch), mask)
+
+    def pod_vb(self, pod: Pod):
+        """Memoized VolumeBinding classification — valid while neither the
+        pod spec nor any PVC/PV/StorageClass changed."""
+        hit = self.pod_vbs.get(pod.meta.key)
+        want = (pod.meta.resource_version, self.pvcpv_epoch)
+        if hit is not None and hit[0] == want:
+            return hit[1]
+        return None
+
+    def put_pod_vb(self, pod: Pod, vb) -> None:
+        self.pod_vbs[pod.meta.key] = (
+            (pod.meta.resource_version, self.pvcpv_epoch), vb)
 
     # ------------------------------------------------------------------
     # node admission grouping memo

@@ -242,3 +242,38 @@ def test_cycle_driver_feeds_pvcs_and_pvs():
     result = Scheduler(store).run_cycle(now=1_000_000.0)
     by_pod = {b.pod_key: b.node_name for b in result.bound}
     assert by_pod.get("default/db") in ("n0", "n2")  # the z0 nodes
+
+
+def test_csi_already_attached_claims_exempt():
+    """Upstream NodeVolumeLimits counts only NEW attachments: a node at its
+    CSI limit still admits a pod whose claims are already attached there
+    (shared RWX volume / pod replacement), while a node without the claim
+    rejects — the volume-group encoding, bit-identical in every backend."""
+    args = LoadAwareArgs()
+    cluster, state = synth_full_cluster(2, 4, seed=13, num_gangs=0,
+                                        num_quotas=0)
+    # both nodes fully at their volume limit via existing pods
+    existing = [p for p in state.pods_by_key.values()
+                if p.is_assigned and not p.is_terminated]
+    node0, node1 = (n.meta.name for n in state.nodes[:2])
+    for node_name in (node0, node1):
+        ex = next(p for p in existing if p.spec.node_name == node_name)
+        ex.spec.pvc_names = [f"vol-{node_name}"]
+    for node in state.nodes[:2]:
+        node.attachable_volume_limit = 1
+    # pending pod 0 mounts node0's already-attached claim; pod 1 mounts a
+    # fresh claim (no headroom anywhere -> stays pending)
+    p0, p1 = state.pending_pods[0], state.pending_pods[1]
+    p0.spec.pvc_names = [f"vol-{node0}"]
+    p0.meta.namespace = next(p for p in existing
+                             if p.spec.node_name == node0).meta.namespace
+    p1.spec.pvc_names = ["brand-new-claim"]
+    for pod in state.pending_pods[2:]:
+        pod.spec.pvc_names = []
+    fc, pods, nodes, tree, gi, ng, ngroups = build_full_chain_inputs(
+        state, args)
+    assert fc.vol_needed.shape[1] > 1  # the exemption groups materialized
+    chosen = _all_backends_agree(args, fc, pods, ng, ngroups)
+    placed = {pods.keys[i]: int(chosen[i]) for i in range(len(pods.keys))}
+    assert placed[p0.meta.key] == 0  # admitted where its claim lives
+    assert placed[p1.meta.key] == -1  # no node has a free attachment slot

@@ -1,0 +1,343 @@
+"""VolumeBinding analog: schedule-time PVC->PV matching, WFFC deferred
+binding, dynamic-provisioning handoff, and the admission-mask encoding
+(upstream VolumeBinding vendored via the reference's
+cmd/koord-scheduler/main.go:43-62 registration into the stock app)."""
+
+import numpy as np
+
+from koordinator_tpu.api.objects import (
+    Node,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Pod,
+    PodSpec,
+    StorageClass,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client.store import (
+    KIND_NODE,
+    KIND_POD,
+    KIND_PV,
+    KIND_PVC,
+    KIND_STORAGECLASS,
+    ObjectStore,
+)
+from koordinator_tpu.scheduler.cycle import Scheduler
+from koordinator_tpu.scheduler.volumebinding import (
+    REASON_NO_MATCHING_PV,
+    REASON_PVC_NOT_FOUND,
+    REASON_SC_NOT_FOUND,
+    REASON_UNBOUND_IMMEDIATE,
+    SELECTED_NODE_ANNOTATION,
+    WAIT_FOR_FIRST_CONSUMER,
+)
+
+ZONE = "topology.kubernetes.io/zone"
+GIB = 1024**3
+NOW = 1_000_000.0
+
+
+def make_store(num_nodes=4, zones=2):
+    store = ObjectStore()
+    for i in range(num_nodes):
+        node = Node(meta=ObjectMeta(name=f"n{i}", namespace=""),
+                    allocatable=ResourceList.of(cpu=8000, memory=32 * GIB,
+                                                pods=20))
+        node.meta.labels[ZONE] = f"z{i % zones}"
+        store.add(KIND_NODE, node)
+    return store
+
+
+def wffc_class(name="local", provisioner="kubernetes.io/no-provisioner",
+               allowed=()):
+    return StorageClass(
+        meta=ObjectMeta(name=name, namespace=""),
+        provisioner=provisioner,
+        volume_binding_mode=WAIT_FOR_FIRST_CONSUMER,
+        allowed_topologies=list(allowed),
+    )
+
+
+def make_pv(name, zone=None, gib=100, sc="local"):
+    pv = PersistentVolume(
+        meta=ObjectMeta(name=name, namespace=""),
+        capacity=ResourceList({"storage": gib * GIB}),
+        storage_class_name=sc,
+    )
+    if zone is not None:
+        pv.meta.labels[ZONE] = zone
+    return pv
+
+
+def make_pvc(name, sc="local", gib=10):
+    return PersistentVolumeClaim(
+        meta=ObjectMeta(name=name, namespace="default"),
+        capacity=ResourceList({"storage": gib * GIB}),
+        storage_class_name=sc,
+    )
+
+
+def make_pod(name, claims):
+    pod = Pod(meta=ObjectMeta(name=name, uid=name, creation_timestamp=1.0),
+              spec=PodSpec(requests=ResourceList.of(cpu=1000, memory=GIB)))
+    pod.spec.pvc_names = list(claims)
+    return pod
+
+
+def run(store, now=NOW):
+    sched = Scheduler(store)
+    result = sched.run_cycle(now=now)
+    return sched, result
+
+
+def failure_reasons(sched):
+    return dict(sched.extender.error_handlers.failures)
+
+
+def test_wffc_pod_lands_in_pv_zone_and_binds():
+    """A WFFC claim with its only candidate PV in z1 pins the pod to the z1
+    nodes; after the cycle the PVC and PV are bound to each other."""
+    store = make_store(4, zones=2)
+    store.add(KIND_STORAGECLASS, wffc_class())
+    store.add(KIND_PV, make_pv("pv-1", zone="z1"))
+    store.add(KIND_PVC, make_pvc("data"))
+    store.add(KIND_POD, make_pod("db", ["data"]))
+    _sched, result = run(store)
+    by_pod = {b.pod_key: b.node_name for b in result.bound}
+    assert by_pod.get("default/db") in ("n1", "n3")  # the z1 nodes
+    pvc = store.get(KIND_PVC, "default/data")
+    assert pvc.volume_name == "pv-1" and pvc.phase == "Bound"
+    pv = next(v for v in store.list(KIND_PV) if v.meta.name == "pv-1")
+    assert pv.claim_ref == "default/data" and pv.phase == "Bound"
+
+
+def test_unbound_immediate_pvc_rejects_pod_with_reason():
+    store = make_store(2)
+    store.add(KIND_STORAGECLASS, StorageClass(
+        meta=ObjectMeta(name="std", namespace=""),
+        provisioner="ebs.csi.aws.com"))  # Immediate mode default
+    store.add(KIND_PVC, make_pvc("data", sc="std"))
+    store.add(KIND_POD, make_pod("db", ["data"]))
+    sched, result = run(store)
+    assert not result.bound
+    assert failure_reasons(sched)["default/db"] == REASON_UNBOUND_IMMEDIATE
+
+
+def test_classless_unbound_pvc_is_immediate():
+    store = make_store(2)
+    store.add(KIND_PVC, make_pvc("data", sc=""))
+    store.add(KIND_POD, make_pod("db", ["data"]))
+    sched, result = run(store)
+    assert not result.bound
+    assert failure_reasons(sched)["default/db"] == REASON_UNBOUND_IMMEDIATE
+
+
+def test_missing_pvc_and_missing_class_reasons():
+    store = make_store(2)
+    store.add(KIND_POD, make_pod("a", ["ghost"]))
+    store.add(KIND_PVC, make_pvc("data", sc="no-such-class"))
+    store.add(KIND_POD, make_pod("b", ["data"]))
+    sched, result = run(store)
+    assert not result.bound
+    reasons = failure_reasons(sched)
+    assert reasons["default/a"] == REASON_PVC_NOT_FOUND
+    assert reasons["default/b"] == REASON_SC_NOT_FOUND
+
+
+def test_claim_satisfiable_nowhere_reason():
+    """WFFC, no provisioner, no PV anywhere: the mask zeroes out and the
+    specific upstream message reaches the failure trail."""
+    store = make_store(3)
+    store.add(KIND_STORAGECLASS, wffc_class())
+    store.add(KIND_PVC, make_pvc("data"))
+    store.add(KIND_POD, make_pod("db", ["data"]))
+    sched, result = run(store)
+    assert not result.bound
+    assert failure_reasons(sched)["default/db"] == REASON_NO_MATCHING_PV
+
+
+def test_dynamic_provisioning_annotates_then_binds_when_pv_appears():
+    """No PV yet but the class provisions dynamically: cycle 1 picks a node,
+    annotates the claim with it, and retries; once the provisioner (the
+    test) creates the PV there, cycle 2 binds pod and volume."""
+    store = make_store(4, zones=2)
+    store.add(KIND_STORAGECLASS, wffc_class(
+        name="csi", provisioner="pd.csi.storage.gke.io"))
+    store.add(KIND_PVC, make_pvc("data", sc="csi"))
+    store.add(KIND_POD, make_pod("db", ["data"]))
+    sched, result = run(store)
+    assert not result.bound
+    pvc = store.get(KIND_PVC, "default/data")
+    selected = pvc.meta.annotations.get(SELECTED_NODE_ANNOTATION)
+    assert selected in ("n0", "n1", "n2", "n3")
+    # Reserve vetoes carry the vetoing plugin's name (cycle driver)
+    assert failure_reasons(sched)["default/db"] == \
+        "VolumeBinding: waiting for volume provisioning"
+    # the provisioner creates the volume in the selected node's zone
+    zone = store.get(KIND_NODE, f"/{selected}").meta.labels[ZONE]
+    store.add(KIND_PV, make_pv("pv-dyn", zone=zone, sc="csi"))
+    result2 = sched.run_cycle(now=NOW + 10)
+    by_pod = {b.pod_key: b.node_name for b in result2.bound}
+    bound_node = by_pod["default/db"]
+    assert store.get(KIND_NODE, f"/{bound_node}").meta.labels[ZONE] == zone
+    assert store.get(KIND_PVC, "default/data").volume_name == "pv-dyn"
+
+
+def test_allowed_topologies_restrict_dynamic_provisioning():
+    store = make_store(4, zones=2)
+    store.add(KIND_STORAGECLASS, wffc_class(
+        name="csi", provisioner="pd.csi.storage.gke.io",
+        allowed=[((ZONE, ("z0",)),)]))
+    store.add(KIND_PVC, make_pvc("data", sc="csi"))
+    store.add(KIND_POD, make_pod("db", ["data"]))
+    sched, result = run(store)
+    pvc = store.get(KIND_PVC, "default/data")
+    selected = pvc.meta.annotations.get(SELECTED_NODE_ANNOTATION)
+    assert selected in ("n0", "n2")  # only the z0 nodes are feasible
+
+
+def test_smallest_matching_pv_wins():
+    store = make_store(2, zones=1)
+    store.add(KIND_STORAGECLASS, wffc_class())
+    store.add(KIND_PV, make_pv("pv-big", zone="z0", gib=500))
+    store.add(KIND_PV, make_pv("pv-small", zone="z0", gib=20))
+    store.add(KIND_PV, make_pv("pv-too-small", zone="z0", gib=5))
+    store.add(KIND_PVC, make_pvc("data", gib=10))
+    store.add(KIND_POD, make_pod("db", ["data"]))
+    _sched, result = run(store)
+    assert store.get(KIND_PVC, "default/data").volume_name == "pv-small"
+
+
+def test_two_pods_race_one_pv():
+    """Two pods, one PV: the in-cycle assume set prevents a double bind;
+    the loser retries and binds once a second PV exists."""
+    store = make_store(3, zones=1)
+    store.add(KIND_STORAGECLASS, wffc_class())
+    store.add(KIND_PV, make_pv("pv-1", zone="z0"))
+    store.add(KIND_PVC, make_pvc("c1"))
+    store.add(KIND_PVC, make_pvc("c2"))
+    store.add(KIND_POD, make_pod("p1", ["c1"]))
+    store.add(KIND_POD, make_pod("p2", ["c2"]))
+    sched, result = run(store)
+    bound_claims = [c for c in ("default/c1", "default/c2")
+                    if store.get(KIND_PVC, c).volume_name]
+    assert len(bound_claims) == 1
+    assert len(result.bound) == 1
+    store.add(KIND_PV, make_pv("pv-2", zone="z0"))
+    result2 = sched.run_cycle(now=NOW + 10)
+    assert len(result2.bound) == 1
+    assert store.get(KIND_PVC, "default/c1").volume_name
+    assert store.get(KIND_PVC, "default/c2").volume_name
+
+
+def test_zoneless_pv_is_unconstrained():
+    store = make_store(4, zones=4)
+    store.add(KIND_STORAGECLASS, wffc_class())
+    store.add(KIND_PV, make_pv("pv-any"))  # no topology labels
+    store.add(KIND_PVC, make_pvc("data"))
+    store.add(KIND_POD, make_pod("db", ["data"]))
+    _sched, result = run(store)
+    assert len(result.bound) == 1
+    assert store.get(KIND_PVC, "default/data").volume_name == "pv-any"
+
+
+def test_prebound_claim_ref_pv_reserved_for_its_claim():
+    """A PV pre-bound via claimRef is only a candidate for that claim
+    (upstream honors claimRef pre-binding)."""
+    store = make_store(2, zones=1)
+    store.add(KIND_STORAGECLASS, wffc_class())
+    pv = make_pv("pv-owned", zone="z0")
+    pv.claim_ref = "default/other"
+    store.add(KIND_PV, pv)
+    store.add(KIND_PVC, make_pvc("data"))
+    store.add(KIND_POD, make_pod("db", ["data"]))
+    sched, result = run(store)
+    assert not result.bound
+    assert failure_reasons(sched)["default/db"] == REASON_NO_MATCHING_PV
+
+
+def test_wffc_parity_across_backends():
+    """Unbound WFFC claims ride the admission bitmask, so every backend
+    (XLA, oracle, Pallas interpret, wave, C++ floor) inherits the filter
+    from the same packed arrays — assert the bindings agree and respect
+    the PV topology on a fuzzed cluster."""
+    from koordinator_tpu.models.full_chain import build_full_chain_step
+    from koordinator_tpu.models.wave_chain import build_wave_full_chain_step
+    from koordinator_tpu.native import floor as native_floor
+    from koordinator_tpu.ops.loadaware import LoadAwareArgs
+    from koordinator_tpu.ops.pallas_full_chain import (
+        build_pallas_full_chain_step,
+    )
+    from koordinator_tpu.scheduler.parity import serial_schedule_full
+    from koordinator_tpu.scheduler.snapshot import build_full_chain_inputs
+    from koordinator_tpu.testing import synth_full_cluster
+
+    args = LoadAwareArgs()
+    _cluster, state = synth_full_cluster(6, 12, seed=11, num_gangs=0,
+                                         num_quotas=0)
+    rng = np.random.default_rng(11)
+    for i, node in enumerate(state.nodes):
+        node.meta.labels[ZONE] = f"z{i % 3}"
+    state.storage_classes = {"local": wffc_class()}
+    # PVs only in z0 and z2
+    for j, zone in enumerate(["z0", "z0", "z2"]):
+        pv = make_pv(f"pv-{j}", zone=zone)
+        state.pvs[pv.meta.name] = pv
+    claimed = []
+    for pod in state.pending_pods[::3]:
+        name = f"claim-{pod.meta.name}"
+        pvc = make_pvc(name)
+        pvc.meta.namespace = pod.meta.namespace
+        state.pvcs[pvc.meta.key] = pvc
+        pod.spec.pvc_names = [name]
+        claimed.append(pod.meta.key)
+    fc, pods, nodes, tree, gi, ng, ngroups = build_full_chain_inputs(
+        state, args)
+    chosen = np.asarray(build_full_chain_step(args, ng, ngroups)(fc)[0])
+    serial = serial_schedule_full(fc, args)
+    n = len(pods.keys)
+    np.testing.assert_array_equal(chosen[:n], serial[:n])
+    chosen_p = np.asarray(build_pallas_full_chain_step(
+        args, ng, ngroups, interpret=True)(fc)[0])
+    np.testing.assert_array_equal(chosen, chosen_p)
+    chosen_w = np.asarray(build_wave_full_chain_step(
+        args, ng, ngroups, wave=8)(fc)[0])
+    np.testing.assert_array_equal(chosen, chosen_w)
+    if native_floor.available() or native_floor.build():
+        chosen_nat = native_floor.serial_schedule_full_native(
+            fc, args, num_groups=ngroups)
+        np.testing.assert_array_equal(chosen[:n], chosen_nat[:n])
+    # every placed claimed pod sits in a PV zone
+    zone_of = {i: state.nodes[i].meta.labels[ZONE]
+               for i in range(len(state.nodes))}
+    for i, key in enumerate(pods.keys):
+        if key in claimed and chosen[i] >= 0:
+            assert zone_of[int(chosen[i])] in ("z0", "z2")
+
+
+def test_classification_pure():
+    from koordinator_tpu.scheduler.volumebinding import classify_pod_volumes
+
+    pod = make_pod("p", ["a", "b"])
+    pvcs = {"default/a": make_pvc("a"), "default/b": make_pvc("b")}
+    pvs = {"pv-0": make_pv("pv-0", zone="z0")}
+    classes = {"local": wffc_class()}
+    vb = classify_pod_volumes(pod, pvcs, pvs, classes)
+    assert vb.reason is None
+    assert vb.wffc_claims == ("a", "b")
+    assert len(vb.any_of_sets) == 2
+    assert all(frozenset({(ZONE, "z0")}) in alts for alts in vb.any_of_sets)
+
+
+def test_ghost_claim_rejected_even_with_zero_pvcs_in_store():
+    """A cluster that has storage machinery (a StorageClass) but currently
+    zero PVC objects still PreFilter-rejects a pod referencing a vanished
+    claim — it must not be assumed by the kernel and vetoed at Reserve
+    every cycle."""
+    store = make_store(2)
+    store.add(KIND_STORAGECLASS, wffc_class())
+    store.add(KIND_POD, make_pod("orphan", ["ghost"]))
+    sched, result = run(store)
+    assert not result.bound
+    assert failure_reasons(sched)["default/orphan"] == REASON_PVC_NOT_FOUND
