@@ -181,12 +181,52 @@ class PodSpec:
 
 
 @dataclass
+class PodCondition:
+    """core v1 PodCondition subset: the scheduler writes PodScheduled
+    (status False / reason Unschedulable / message with the per-stage
+    breakdown) when a pod ends a cycle unbound, and flips it True at bind —
+    the same status surface the scheduler framework propagates upstream."""
+
+    type: str = "PodScheduled"
+    status: str = "False"  # "True" | "False"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = 0.0
+
+
+@dataclass
 class Pod:
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodSpec = field(default_factory=PodSpec)
     phase: str = "Pending"  # Pending/Running/Succeeded/Failed
     reason: str = ""        # status.reason (e.g. "OutOfCpu", "NodeShutdown")
     restart_count: int = 0  # sum of container restart counts
+    conditions: List[PodCondition] = field(default_factory=list)
+
+    def get_condition(self, ctype: str) -> Optional[PodCondition]:
+        for c in self.conditions:
+            if c.type == ctype:
+                return c
+        return None
+
+    def set_condition(self, ctype: str, status: str, reason: str,
+                      message: str, now: float) -> bool:
+        """Upsert a condition; returns True when anything changed.
+        last_transition_time bumps only on a STATUS flip (upstream
+        semantics), so repeated identical writes are no-ops the caller can
+        skip persisting."""
+        cur = self.get_condition(ctype)
+        if cur is None:
+            self.conditions.append(PodCondition(
+                type=ctype, status=status, reason=reason, message=message,
+                last_transition_time=now))
+            return True
+        if (cur.status, cur.reason, cur.message) == (status, reason, message):
+            return False
+        if cur.status != status:
+            cur.last_transition_time = now
+        cur.status, cur.reason, cur.message = status, reason, message
+        return True
 
     @property
     def qos_class(self) -> QoSClass:
@@ -298,6 +338,7 @@ class Pod:
                 tolerations=list(spec.tolerations),
                 overhead=spec.overhead.copy(),
             ),
+            conditions=[replace(c) for c in self.conditions],
         )
 
     @property

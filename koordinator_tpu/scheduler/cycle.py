@@ -23,10 +23,13 @@ steady-state cluster never recompiles.
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from koordinator_tpu.api.objects import (
     ANNOTATION_RESERVATION_ALLOCATED,
@@ -519,12 +522,64 @@ class Scheduler:
         for pod, reason in failed_pods:
             result.failed.append(pod.meta.key)
             self.extender.error_handlers.dispatch(pod, reason)
+        # pod-status propagation (upstream PodScheduled=False/Unschedulable
+        # with the per-stage message): the reason becomes store-visible on
+        # the pod object, not just the failure trail
+        self._write_unschedulable_conditions(
+            rejected_pods, failed_pods, now)
+        # the packed batch is only needed within this cycle; don't pin
+        # tens of MB of host arrays across idle periods
+        self._last_batch = None
 
         if gang_plugin is not None:
             gang_plugin.update_pod_group_status(self.store, now)
         result.duration_seconds = time.perf_counter() - t_start
         self.extender.monitor.record(result)
         return result
+
+    # ------------------------------------------------------------------
+    def _write_unschedulable_conditions(
+        self,
+        rejected_pods: List[Pod],
+        failed_pods: List[Tuple[Pod, str]],
+        now: float,
+    ) -> None:
+        """PodScheduled=False/Unschedulable on every pod ending the cycle
+        unbound. Specific reasons (encoding overflow, volume PreFilter,
+        Reserve vetoes) pass through verbatim; generic kernel rejections
+        get the per-stage breakdown from scheduler/diagnose.py. Idempotent:
+        an unchanged condition writes nothing (no store churn, no snapshot
+        cache invalidation for permanently-pending pods)."""
+        last = getattr(self, "_last_batch", None)
+        items = list(failed_pods) + [
+            (p, "admission rejected") for p in rejected_pods]
+        for pod, reason in items:
+            msg = reason
+            if last is not None and reason in (
+                    "no feasible node", "admission rejected"):
+                fc, index, n_nodes = last
+                j = index.get(pod.meta.key)
+                if j is not None:
+                    from koordinator_tpu.scheduler.diagnose import (
+                        diagnose_unbound,
+                    )
+
+                    try:
+                        msg = diagnose_unbound(fc, j, n_nodes)
+                    except Exception:  # diagnosis must never wedge a cycle
+                        logger.exception(
+                            "unschedulability diagnosis failed for %s",
+                            pod.meta.key)
+            stored = self.store.get(KIND_POD, pod.meta.key)
+            if stored is None:  # reservation pseudo-pods, raced deletions
+                continue
+            cur = stored.get_condition("PodScheduled")
+            if cur is not None and (cur.status, cur.message) == ("False", msg):
+                continue
+            patched = stored.patch_copy()
+            patched.set_condition(
+                "PodScheduled", "False", "Unschedulable", msg, now)
+            self.store.update(KIND_POD, patched)
 
     # ------------------------------------------------------------------
     def _batch_pass(
@@ -566,6 +621,12 @@ class Scheduler:
         )
         fc = self.extender.transform_before_score(fc, ctx)
         fc, active = reduce_to_active_axes(fc)
+        # keep the packed batch for end-of-cycle unschedulability diagnosis
+        # (scheduler/diagnose.py reads the same arrays the kernel consumed);
+        # a retry pass overwrites this with the final batch
+        self._last_batch = (
+            fc, {key: j for j, key in enumerate(pods.keys)},
+            len(state.nodes))
         step = self._get_step(
             (pods.padded_size, nodes.padded_size, fc.quota_runtime.shape[0]),
             ng, ngroups, active,
@@ -661,6 +722,6 @@ class Scheduler:
         for plugin in self.extender.plugins:
             plugin.pre_bind(pod, node_name, ctx, annotations)
         prebind = self.extender.plugin("DefaultPreBind")
-        prebind.apply_patch(pod, node_name, annotations)
+        prebind.apply_patch(pod, node_name, annotations, now=ctx.now)
         result.bound.append(BindResult(pod.meta.key, node_name, annotations))
         return None

@@ -25,7 +25,7 @@ class DefaultPreBindPlugin(Plugin):
         self._store = store
 
     def apply_patch(self, pod: Pod, node_name: str,
-                    annotations: Dict[str, str]) -> None:
+                    annotations: Dict[str, str], now: float = 0.0) -> None:
         # patch a COPY of the STORED object: watch subscribers diff old vs new,
         # and `pod` may be a cycle-local transformer view (BeforePreFilter
         # semantics) whose rewrites must not persist — the reference patches
@@ -34,6 +34,9 @@ class DefaultPreBindPlugin(Plugin):
         patched = (stored if stored is not None else pod).patch_copy()
         patched.meta.annotations.update(annotations)
         patched.spec.node_name = node_name
+        # PodScheduled=True rides the same single patch (upstream sets the
+        # condition through the bind API call)
+        patched.set_condition("PodScheduled", "True", "", "", now)
         self._store.update(KIND_POD, patched)
         # keep the caller's object coherent for later hooks in this cycle
         pod.meta.annotations.update(annotations)

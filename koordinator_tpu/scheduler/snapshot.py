@@ -335,8 +335,10 @@ def build_full_chain_inputs(
         from koordinator_tpu.scheduler.volumebinding import (
             any_of_pair_universe,
             classify_pod_volumes,
+            index_pvs_by_class,
         )
 
+        pvs_by_class = None  # built once, on the first cache miss
         for key, pod in pods_by_key_pending.items():
             if not pod.spec.pvc_names:
                 continue
@@ -345,8 +347,11 @@ def build_full_chain_inputs(
                 zone_pairs_by_key[key] = zp
             vb = (cache.pod_vb(pod) if cache is not None else None)
             if vb is None:
+                if pvs_by_class is None:
+                    pvs_by_class = index_pvs_by_class(state.pvs)
                 vb = classify_pod_volumes(
-                    pod, state.pvcs, state.pvs, state.storage_classes)
+                    pod, state.pvcs, state.pvs, state.storage_classes,
+                    pvs_by_class=pvs_by_class)
                 if cache is not None:
                     cache.put_pod_vb(pod, vb)
             if vb.reason is not None:
@@ -617,14 +622,18 @@ def build_full_chain_inputs(
                     # surfaced like the admission-signature degradation
                     gid = 0
                     vol_degraded += 1
-                    logger.warning(
-                        "node %s exceeds the volume-group budget (%d): "
-                        "pods pay the full attachment count there",
+                    logger.debug(
+                        "node %s exceeds the volume-group budget (%d)",
                         node.meta.name, MAX_VOL_GROUPS)
                 else:
                     gid = gid_of[s] = len(group_sets)
                     group_sets.append(s)
             node_vol_group[i] = gid
+    if vol_degraded:
+        # one aggregate line per build, not one per node per cycle
+        logger.warning(
+            "%d nodes exceed the volume-group budget (%d): pods pay the "
+            "full attachment count there", vol_degraded, MAX_VOL_GROUPS)
     VOL_GROUP_DEGRADED_NODES.set(float(vol_degraded))
     VG = len(group_sets)
     vol_needed_g = np.zeros((P, VG), np.float32)

@@ -103,17 +103,31 @@ class PodVolumeClassification:
     reason: Optional[str] = None
 
 
+def index_pvs_by_class(
+    pvs: Dict[str, PersistentVolume],
+) -> Dict[str, List[PersistentVolume]]:
+    """Per-storage-class candidate index, built once per snapshot so each
+    classification scans only its class's volumes instead of every PV."""
+    by_class: Dict[str, List[PersistentVolume]] = {}
+    for pv in pvs.values():
+        by_class.setdefault(pv.storage_class_name, []).append(pv)
+    return by_class
+
+
 def classify_pod_volumes(
     pod: Pod,
     pvcs: Dict[str, PersistentVolumeClaim],
     pvs: Dict[str, PersistentVolume],
     storage_classes: Dict[str, StorageClass],
+    pvs_by_class: Optional[Dict[str, List[PersistentVolume]]] = None,
 ) -> PodVolumeClassification:
     """Classify the pod's claims the way upstream PreFilter does.
 
     Bound claims are out of scope here — their PV topology already rides
     the admission bitmask as required pairs (snapshot.volume_zone_pairs).
     """
+    if pvs_by_class is None:
+        pvs_by_class = index_pvs_by_class(pvs)
     wffc: List[str] = []
     any_of: List[frozenset] = []
     for claim in pod.spec.pvc_names:
@@ -137,7 +151,7 @@ def classify_pod_volumes(
         unconstrained = False
         # static candidates: any matching Available PV's full topology
         # pair set is one alternative; a label-less PV fits every node
-        for pv in pvs.values():
+        for pv in pvs_by_class.get(pvc.storage_class_name, ()):
             if not pv_matches_claim(pv, pvc):
                 continue
             zp = pv.zone_pairs()

@@ -1,0 +1,148 @@
+"""Pod-status unschedulability propagation: a pod ending a cycle unbound
+carries a store-visible PodScheduled=False/Unschedulable condition with the
+specific reason class (quota exhausted / gang not satisfied / encoding
+overflow / volume PreFilter / per-stage filter breakdown), and the
+condition flips True at bind — the status surface kube-scheduler writes
+through the framework and frameworkext's debug plumbing
+(/root/reference/pkg/scheduler/frameworkext/debug.go:31-46)."""
+
+from koordinator_tpu.api.objects import (
+    LABEL_POD_GROUP,
+    LABEL_POD_QOS,
+    LABEL_QUOTA_NAME,
+    ElasticQuota,
+    Node,
+    ObjectMeta,
+    PersistentVolumeClaim,
+    Pod,
+    PodGroup,
+    PodSpec,
+    StorageClass,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client.store import (
+    KIND_ELASTIC_QUOTA,
+    KIND_NODE,
+    KIND_POD,
+    KIND_POD_GROUP,
+    KIND_PVC,
+    KIND_STORAGECLASS,
+    ObjectStore,
+)
+from koordinator_tpu.scheduler.cycle import Scheduler
+
+GIB = 1024**3
+NOW = 1_000_000.0
+
+
+def make_store(num_nodes=3, cpu=8000):
+    store = ObjectStore()
+    for i in range(num_nodes):
+        store.add(KIND_NODE, Node(
+            meta=ObjectMeta(name=f"n{i}", namespace=""),
+            allocatable=ResourceList.of(cpu=cpu, memory=32 * GIB, pods=20)))
+    return store
+
+
+def pend_pod(store, name, cpu=1000, labels=None):
+    pod = Pod(
+        meta=ObjectMeta(name=name, uid=name, creation_timestamp=NOW,
+                        labels={LABEL_POD_QOS: "LS", **(labels or {})}),
+        spec=PodSpec(requests=ResourceList.of(cpu=cpu, memory=GIB)))
+    store.add(KIND_POD, pod)
+    return pod
+
+
+def scheduled_cond(store, key):
+    return store.get(KIND_POD, key).get_condition("PodScheduled")
+
+
+def test_insufficient_resources_breakdown():
+    store = make_store(3, cpu=4000)
+    pend_pod(store, "huge", cpu=64000)
+    Scheduler(store).run_cycle(now=NOW)
+    cond = scheduled_cond(store, "default/huge")
+    assert cond.status == "False" and cond.reason == "Unschedulable"
+    assert "0/3 nodes are available" in cond.message
+    assert "3 insufficient resources" in cond.message
+
+
+def test_selector_mismatch_breakdown():
+    store = make_store(4)
+    pod = pend_pod(store, "pinned")
+    pod.spec.node_selector["disk"] = "nvme"  # no node carries the label
+    Scheduler(store).run_cycle(now=NOW)
+    cond = scheduled_cond(store, "default/pinned")
+    assert cond.status == "False"
+    assert "4 taint/selector/volume-topology mismatch" in cond.message
+
+
+def test_quota_exhausted_reason():
+    store = make_store(3)
+    store.add(KIND_ELASTIC_QUOTA, ElasticQuota(
+        meta=ObjectMeta(name="tiny", namespace="default"),
+        min=ResourceList.of(cpu=0),
+        max=ResourceList.of(cpu=500, memory=GIB)))
+    pend_pod(store, "q-pod", cpu=2000, labels={LABEL_QUOTA_NAME: "tiny"})
+    Scheduler(store).run_cycle(now=NOW)
+    cond = scheduled_cond(store, "default/q-pod")
+    assert cond.status == "False"
+    assert "quota group exhausted" in cond.message
+
+
+def test_gang_min_member_reason():
+    store = make_store(3)
+    store.add(KIND_POD_GROUP, PodGroup(
+        meta=ObjectMeta(name="g1", namespace="default"), min_member=3))
+    pend_pod(store, "lonely", labels={LABEL_POD_GROUP: "g1"})
+    Scheduler(store).run_cycle(now=NOW)
+    cond = scheduled_cond(store, "default/lonely")
+    assert cond.status == "False"
+    assert "gang minMember not satisfied" in cond.message
+
+
+def test_volume_prefilter_reason_passthrough():
+    store = make_store(2)
+    store.add(KIND_STORAGECLASS, StorageClass(
+        meta=ObjectMeta(name="std", namespace=""), provisioner="x"))
+    store.add(KIND_PVC, PersistentVolumeClaim(
+        meta=ObjectMeta(name="c", namespace="default"),
+        capacity=ResourceList({"storage": GIB}), storage_class_name="std"))
+    pod = pend_pod(store, "vol-pod")
+    pod.spec.pvc_names = ["c"]
+    Scheduler(store).run_cycle(now=NOW)
+    cond = scheduled_cond(store, "default/vol-pod")
+    assert cond.message == "pod has unbound immediate PersistentVolumeClaims"
+
+
+def test_condition_flips_true_on_bind():
+    store = make_store(2)
+    store.add(KIND_POD_GROUP, PodGroup(
+        meta=ObjectMeta(name="g2", namespace="default"), min_member=2))
+    pend_pod(store, "m1", labels={LABEL_POD_GROUP: "g2"})
+    sched = Scheduler(store)
+    sched.run_cycle(now=NOW)
+    assert scheduled_cond(store, "default/m1").status == "False"
+    pend_pod(store, "m2", labels={LABEL_POD_GROUP: "g2"})
+    result = sched.run_cycle(now=NOW + 10)
+    assert len(result.bound) == 2
+    for key in ("default/m1", "default/m2"):
+        cond = scheduled_cond(store, key)
+        assert cond.status == "True"
+        assert cond.last_transition_time == NOW + 10
+
+
+def test_condition_write_is_idempotent():
+    """A permanently-pending pod's condition is written once; later cycles
+    with the same message leave the stored object untouched (no churn, no
+    snapshot-cache invalidation)."""
+    store = make_store(2, cpu=4000)
+    pend_pod(store, "huge", cpu=64000)
+    sched = Scheduler(store)
+    sched.run_cycle(now=NOW)
+    rv1 = store.get(KIND_POD, "default/huge").meta.resource_version
+    sched.run_cycle(now=NOW + 30)
+    sched.run_cycle(now=NOW + 60)
+    assert store.get(KIND_POD, "default/huge").meta.resource_version == rv1
+    cond = scheduled_cond(store, "default/huge")
+    assert cond.last_transition_time == NOW  # first write's flip time
