@@ -1,14 +1,18 @@
-"""Worker process for the 2-process jax.distributed multi-host test.
+"""Worker process for the jax.distributed multi-host tests (2 and 4
+processes).
 
-Each process owns 4 virtual CPU devices; `jax.distributed.initialize()`
-federates them into one 8-device global mesh (the DCN analog — process
-boundary == host boundary). Both processes build the identical synthetic
-cluster, run the sharded full-chain step over the GLOBAL mesh (gloo
-collectives across the process boundary), and diff the bindings against a
-locally-computed single-device run. Prints ``MULTIHOST_OK <digest>`` so the
-parent test can also assert both processes agree.
+Each process owns `local_devices` virtual CPU devices;
+`jax.distributed.initialize()` federates them into one global mesh (the
+DCN analog — process boundary == host boundary). Every process builds the
+identical synthetic cluster, runs the sharded full-chain step over the
+GLOBAL mesh (gloo collectives across the process boundary), and diffs the
+bindings against a locally-computed single-device run. In the 4-process
+shape the mesh is 2-D (pods x nodes), so the one-shot score matrix shards
+BOTH batch axes across the process boundary. Prints ``MULTIHOST_OK
+<digest>`` so the parent test can also assert all processes agree.
 
 Usage: python multihost_worker.py <process_id> <num_processes> <port>
+       [local_devices=4]
 """
 
 import hashlib
@@ -19,10 +23,11 @@ import sys
 
 def main() -> None:
     proc_id, num_procs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    local_devices = int(sys.argv[4]) if len(sys.argv) > 4 else 4
     flags = os.environ.get("XLA_FLAGS", "")
     flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
     os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=4"
+        flags + f" --xla_force_host_platform_device_count={local_devices}"
     ).strip()
     os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -34,8 +39,8 @@ def main() -> None:
     jax.distributed.initialize(
         f"127.0.0.1:{port}", num_processes=num_procs, process_id=proc_id
     )
-    assert jax.device_count() == 4 * num_procs, jax.devices()
-    assert jax.local_device_count() == 4
+    assert jax.device_count() == local_devices * num_procs, jax.devices()
+    assert jax.local_device_count() == local_devices
 
     import numpy as np
 
@@ -93,9 +98,37 @@ def main() -> None:
         np.asarray(big_quota_ref), np.asarray(big_quota_g))
     assert (big_g[: len(big_pods.keys)] >= 0).sum() > 100
 
+    # third pass: the one-shot [P, N] score matrix sharded over BOTH mesh
+    # axes (pods x nodes) at the same padded 512 x 256 shape — with >= 2
+    # processes per axis (the 4-process shape), every shard boundary of
+    # both batch axes crosses a process boundary. Feasibility and score
+    # must match the local single-device matrix bit-for-bit.
+    from koordinator_tpu.models.scheduler_model import build_score_matrix
+    from koordinator_tpu.parallel import (
+        build_sharded_score_matrix,
+        shard_inputs_2d,
+    )
+
+    matrix = build_sharded_score_matrix(args, mesh)
+    feas_g, score_g = matrix(shard_inputs_2d(big_fc.base, mesh))
+    # the matrix outputs stay sharded across processes (unlike the
+    # replicated chosen vector): assemble the global arrays via the DCN
+    # allgather before host comparison
+    from jax.experimental import multihost_utils
+
+    feas_g = np.asarray(multihost_utils.process_allgather(feas_g, tiled=True))
+    score_g = np.asarray(
+        multihost_utils.process_allgather(score_g, tiled=True))
+    feas_1, score_1 = build_score_matrix(args)(big_fc.base)
+    np.testing.assert_array_equal(np.asarray(feas_1), feas_g)
+    np.testing.assert_array_equal(np.asarray(score_1), score_g)
+    assert feas_g.shape[0] > len(big_pods.keys)  # padding crossed shards
+
     digest = hashlib.sha256(
-        chosen_g.tobytes() + big_g.tobytes()).hexdigest()[:16]
-    print(f"MULTIHOST_OK {digest}", flush=True)
+        chosen_g.tobytes() + big_g.tobytes() + feas_g.tobytes()
+        + score_g.tobytes()).hexdigest()[:16]
+    mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    print(f"MULTIHOST_OK {digest} mesh={mesh_shape}", flush=True)
 
 
 if __name__ == "__main__":

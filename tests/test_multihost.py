@@ -19,7 +19,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distributed_full_chain():
+def _run_workers(num_procs: int, local_devices: int, timeout: int = 420):
     port = _free_port()
     env = {
         k: v for k, v in os.environ.items()
@@ -29,22 +29,23 @@ def test_two_process_distributed_full_chain():
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, str(i), "2", str(port)],
+            [sys.executable, WORKER, str(i), str(num_procs), str(port),
+             str(local_devices)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
         )
-        for i in range(2)
+        for i in range(num_procs)
     ]
     outs = []
     try:
         for proc in procs:
             try:
-                out, err = proc.communicate(timeout=300)
+                out, err = proc.communicate(timeout=timeout)
             except subprocess.TimeoutExpired:
                 pytest.fail("multihost worker timed out")
             assert proc.returncode == 0, f"worker failed:\n{out}\n{err}"
             outs.append(out)
     finally:
-        # a failed worker must not strand its sibling in the gloo handshake
+        # a failed worker must not strand its siblings in the gloo handshake
         for p in procs:
             if p.poll() is None:
                 p.kill()
@@ -54,6 +55,22 @@ def test_two_process_distributed_full_chain():
         for line in out.splitlines()
         if line.startswith("MULTIHOST_OK")
     ]
-    assert len(digests) == 2, f"missing MULTIHOST_OK lines: {outs}"
-    # both processes computed identical global bindings
-    assert digests[0] == digests[1]
+    assert len(digests) == num_procs, f"missing MULTIHOST_OK lines: {outs}"
+    # every process computed identical global results
+    assert len(set(digests)) == 1
+    return outs
+
+
+def test_two_process_distributed_full_chain():
+    _run_workers(num_procs=2, local_devices=4)
+
+
+def test_four_process_distributed_2d():
+    """4 OS processes x 2 virtual devices = an 8-device (pods=2, nodes=4)
+    global mesh where BOTH batch axes shard across process boundaries: the
+    full chain's flat node sharding AND the one-shot score matrix's 2-D
+    pods x nodes sharding run over gloo, padded 512 x 256 shapes crossing
+    every shard boundary, bindings + quota rollups + matrix diffed against
+    local single-device runs in each process."""
+    outs = _run_workers(num_procs=4, local_devices=2, timeout=600)
+    assert any("mesh={'pods': 2, 'nodes': 4}" in o for o in outs), outs
