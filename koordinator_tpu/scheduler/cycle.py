@@ -399,14 +399,20 @@ class Scheduler:
             dead_gangs = set(gang_plugin.timed_out_gangs())
             if dead_gangs:
                 kept = []
+                timed_out: List[Tuple[Pod, str]] = []
                 for pod in pending:
                     if pod.gang_key in dead_gangs:
                         result.rejected.append(pod.meta.key)
                         self.extender.error_handlers.dispatch(
                             pod, "gang schedule timeout")
+                        timed_out.append((pod, "gang schedule timeout"))
                     else:
                         kept.append(pod)
                 pending = kept
+                # these pods never reach the batch pass, so the terminal
+                # reason must land on their status here (the end-of-cycle
+                # writer only sees batch-pass failures)
+                self._write_unschedulable_conditions([], timed_out, now)
         if not pending:
             result.duration_seconds = time.perf_counter() - t_start
             self.extender.monitor.record(result)

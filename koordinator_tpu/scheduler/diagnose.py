@@ -120,7 +120,8 @@ def diagnose_unbound(fc, i: int, num_nodes: int) -> str:
             | (raw_req[None, :] <= numa_free.sum(axis=1))).all(axis=1)
         reasons["NUMA topology cannot fit"] = np.where(
             policy == 1, ~per_zone_fit, (policy != 0) & ~total_fit)
-    # inter-pod affinity / anti-affinity / spread (aggregate)
+    # inter-pod affinity / anti-affinity / spread (aggregate), mirroring
+    # the kernel predicates in models/full_chain.py make_pod_evaluator
     T = fc.aff_dom.shape[1]
     if T:
         aff_bad = np.zeros(n, bool)
@@ -128,13 +129,30 @@ def diagnose_unbound(fc, i: int, num_nodes: int) -> str:
         count = np.asarray(fc.aff_count, np.float32)[:n]
         cover = np.asarray(fc.anti_cover, np.float32)[:n]
         exists = np.asarray(fc.aff_exists, bool)
+        taint_ok = ~reasons["taint/selector/volume-topology mismatch"]
+        skew_row = np.asarray(fc.pod_spread_skew, np.float32)[i]
         for t in range(T):
+            match_t = bool(np.asarray(fc.pod_aff_match)[i, t])
             if bool(np.asarray(fc.pod_anti_req)[i, t]):
                 aff_bad |= count[:, t] > 0
-            if bool(np.asarray(fc.pod_aff_match)[i, t]):
+            if match_t:
                 aff_bad |= cover[:, t] > 0
-            if bool(np.asarray(fc.pod_aff_req)[i, t]) and exists[t]:
-                aff_bad |= ~((dom[:, t] >= 0) & (count[:, t] > 0))
+            if bool(np.asarray(fc.pod_aff_req)[i, t]):
+                # bootstrap admits a self-matching first replica only when
+                # NO matching pod exists anywhere; otherwise the node needs
+                # a matching pod in a valid domain
+                bootstrap = match_t and not exists[t]
+                if not bootstrap:
+                    aff_bad |= ~((dom[:, t] >= 0) & (count[:, t] > 0))
+            skew = float(skew_row[t])
+            if skew > 0:
+                dom_valid = dom[:, t] >= 0
+                eligible = dom_valid & taint_ok
+                min_count = (count[eligible, t].min()
+                             if eligible.any() else np.inf)
+                self_m = 1.0 if match_t else 0.0
+                aff_bad |= ~(dom_valid
+                             & (count[:, t] + self_m - min_count <= skew))
         reasons["affinity/anti-affinity/spread mismatch"] = aff_bad
 
     parts: List[str] = []

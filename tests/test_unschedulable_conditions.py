@@ -146,3 +146,57 @@ def test_condition_write_is_idempotent():
     assert store.get(KIND_POD, "default/huge").meta.resource_version == rv1
     cond = scheduled_cond(store, "default/huge")
     assert cond.last_transition_time == NOW  # first write's flip time
+
+
+def test_spread_blocked_pod_reports_mismatch_not_capacity():
+    """A DoNotSchedule spread constraint over a topology key no node
+    carries rejects every node in-kernel; the condition must name the
+    spread/affinity stage, not the in-batch-contention fallback."""
+    from koordinator_tpu.api.objects import TopologySpreadConstraint
+
+    store = make_store(3)
+    pod = pend_pod(store, "spread-pod")
+    pod.meta.labels["app"] = "web"
+    pod.spec.topology_spread.append(TopologySpreadConstraint(
+        max_skew=1, topology_key="topology.kubernetes.io/zone",
+        selector={"app": "web"}))
+    Scheduler(store).run_cycle(now=NOW)
+    cond = scheduled_cond(store, "default/spread-pod")
+    assert cond.status == "False"
+    assert "affinity/anti-affinity/spread mismatch" in cond.message
+
+
+def test_required_affinity_without_match_reports_mismatch():
+    """requiredDuringScheduling podAffinity whose selector matches nothing
+    (and not the pod itself) fails every node; the condition names the
+    affinity stage even though no matching pod exists anywhere."""
+    from koordinator_tpu.api.objects import PodAffinityTerm
+
+    store = make_store(3)
+    pod = pend_pod(store, "needs-db")
+    pod.spec.pod_affinity.append(PodAffinityTerm(
+        selector={"app": "db"}, topology_key="kubernetes.io/hostname"))
+    Scheduler(store).run_cycle(now=NOW)
+    cond = scheduled_cond(store, "default/needs-db")
+    assert cond.status == "False"
+    assert "affinity/anti-affinity/spread mismatch" in cond.message
+
+
+def test_gang_timeout_writes_terminal_condition():
+    """Pods of a terminally-failed gang never reach the batch pass; the
+    'gang schedule timeout' reason must still land on their status."""
+    from koordinator_tpu.api.objects import PodGroup
+
+    store = make_store(3)
+    store.add(KIND_POD_GROUP, PodGroup(
+        meta=ObjectMeta(name="g-slow", namespace="default",
+                        creation_timestamp=NOW),
+        min_member=3, schedule_timeout_seconds=60))
+    pend_pod(store, "gm1", labels={LABEL_POD_GROUP: "g-slow"})
+    sched = Scheduler(store)
+    sched.run_cycle(now=NOW)  # pending: minMember unmet
+    assert "gang minMember" in scheduled_cond(store, "default/gm1").message
+    sched.run_cycle(now=NOW + 120)  # past the schedule timeout -> Failed
+    cond = scheduled_cond(store, "default/gm1")
+    assert cond.status == "False"
+    assert cond.message == "gang schedule timeout"
