@@ -19,9 +19,13 @@ request's HostConfig JSON (CpuPeriod/CpuQuota/CpuShares/Memory/CpusetCpus/
 CpusetMems — the docker-API spellings of resexecutor's update semantics),
 and forwards the mutated request to the real docker daemon's socket. Every
 other path/method passes through untouched (the docker analog of the CRI
-TransparentHandler). FailurePolicy matches the CRI path: Ignore forwards
-the original request when the hook server is down, Fail returns 502 so
-kubelet retries.
+TransparentHandler), including Connection-Upgrade hijacks (exec/attach):
+after relaying the request raw, the proxy pumps bytes both ways until
+either side closes, so `kubectl exec` / `attach` / `logs -f` work through
+the docker path exactly as through the reference's server
+(pkg/runtimeproxy/server/docker/server.go proxies all endpoints).
+FailurePolicy matches the CRI path: Ignore forwards the original request
+when the hook server is down, Fail returns 502 so kubelet retries.
 
 The pod/sandbox linkage rides docker labels the way dockershim writes them
 (`io.kubernetes.pod.*`, `io.kubernetes.container.name`): create requests
@@ -32,9 +36,11 @@ store.
 from __future__ import annotations
 
 import json
+import os
 import re
 import socket
 import socketserver
+import stat
 import threading
 from http.client import HTTPConnection
 from http.server import BaseHTTPRequestHandler
@@ -105,6 +111,32 @@ class _UnixHTTPServer(socketserver.ThreadingUnixStreamServer):
 
     def handle_error(self, request, client_address):
         # keep-alive peers closing mid-read are routine, not reportable
+        pass
+
+
+def _unlink_stale_socket(path: str) -> None:
+    """allow_reuse_address is a no-op for AF_UNIX: a socket file left by an
+    unclean shutdown raises 'Address already in use' on rebind, so remove
+    it first — but only if it IS a socket (never a regular file) and
+    nobody answers on it (a live server's endpoint must not be destroyed
+    by a double start; its bind error surfaces instead)."""
+    try:
+        if not stat.S_ISSOCK(os.stat(path).st_mode):
+            return
+    except OSError:
+        return  # nothing there
+    probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        probe.settimeout(0.2)
+        probe.connect(path)
+        return  # something is serving: leave it alone
+    except OSError:
+        pass  # stale: refused / dead peer
+    finally:
+        probe.close()
+    try:
+        os.unlink(path)
+    except OSError:
         pass
 
 
@@ -251,7 +283,13 @@ class DockerProxyServer:
             return
         m = _LIFECYCLE_RE.match(clean)
         if method == "POST" and m and m.group("op") == "stop":
-            if status >= 300:
+            # 404 == the daemon no longer knows the container (AutoRemove,
+            # out-of-band rm, daemon restart): treat it as a confirmed
+            # teardown — fire the post-stop hook so koordlet releases its
+            # per-container state, then drop the meta (no DELETE may ever
+            # come). Other non-2xx are transient: keep the entry for the
+            # kubelet retry.
+            if status >= 300 and status != 404:
                 return
             cid = m.group("id")
             with self._lock:
@@ -290,12 +328,13 @@ class DockerProxyServer:
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
-                # hijacked/upgraded connections (exec/attach) cannot ride an
-                # http.client relay: refuse loudly instead of wedging
+                # hijacked/upgraded connections (exec/attach/logs over the
+                # hijack protocol) cannot ride an http.client relay: tunnel
+                # the raw bytes instead — request verbatim to the daemon,
+                # then a bidirectional pump until either side closes (the
+                # reference's docker server proxies these transparently)
                 if "upgrade" in (self.headers.get("Connection") or "").lower():
-                    self.send_response(501)
-                    self.send_header("Content-Length", "0")
-                    self.end_headers()
+                    self._tunnel(body)
                     return
                 conn = _UnixHTTPConnection(proxy.backend_socket)
                 streamed = False
@@ -358,8 +397,71 @@ class DockerProxyServer:
                 self.end_headers()
                 self.wfile.write(resp_body)
 
+            def _tunnel(self, body: bytes) -> None:
+                """Byte-for-byte Connection-Upgrade relay. The daemon's
+                response (101 UPGRADED + raw stream) flows back verbatim;
+                after it, the connection is a plain duplex pipe."""
+                back = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                try:
+                    back.settimeout(10.0)
+                    back.connect(proxy.backend_socket)
+                except OSError:
+                    back.close()
+                    self.send_response(502)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.close_connection = True
+                lines = [f"{self.command} {self.path} HTTP/1.1"]
+                lines.extend(
+                    f"{k}: {v}" for k, v in self.headers.items()
+                    if k.lower() != "host")
+                lines.append("Host: docker")
+                raw = ("\r\n".join(lines) + "\r\n\r\n").encode(
+                    "latin-1") + body
+                try:
+                    back.sendall(raw)
+                    back.settimeout(None)  # interactive stream: no deadline
+                    client = self.connection
+                    client.settimeout(None)
+
+                    def client_to_back():
+                        try:
+                            while True:
+                                # read1 drains rfile's buffer before hitting
+                                # the socket — bytes the client pipelined
+                                # behind the request must not be lost
+                                data = self.rfile.read1(65536)
+                                if not data:
+                                    break
+                                back.sendall(data)
+                            back.shutdown(socket.SHUT_WR)  # half-close
+                        except OSError:
+                            pass
+
+                    t = threading.Thread(target=client_to_back, daemon=True)
+                    t.start()
+                    while True:
+                        data = back.recv(65536)
+                        if not data:
+                            break
+                        client.sendall(data)
+                except OSError:
+                    pass
+                finally:
+                    try:
+                        back.close()
+                    except OSError:
+                        pass
+                    try:
+                        # unblocks the pump thread's rfile read
+                        self.connection.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+
             do_GET = do_POST = do_DELETE = do_PUT = do_HEAD = _relay
 
+        _unlink_stale_socket(self.proxy_socket)
         self._server = _UnixHTTPServer(self.proxy_socket, Handler)
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)
@@ -427,6 +529,28 @@ class FakeDockerDaemon:
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 path = self.path.split("?")[0]
+                # attach/exec hijack: answer 101 and become an echo pipe
+                # (each chunk comes back prefixed "echo:"), like dockerd's
+                # raw-stream hijack — exercises the proxy's upgrade tunnel
+                am = re.match(r"^/v[\d.]+/containers/([^/]+)/attach$", path)
+                if am and "upgrade" in (
+                        self.headers.get("Connection") or "").lower():
+                    self.close_connection = True
+                    self.wfile.write(
+                        b"HTTP/1.1 101 UPGRADED\r\n"
+                        b"Content-Type: application/vnd.docker.raw-stream\r\n"
+                        b"Connection: Upgrade\r\nUpgrade: tcp\r\n\r\n")
+                    self.wfile.flush()
+                    while True:
+                        try:
+                            data = self.rfile.read1(65536)
+                        except OSError:
+                            break
+                        if not data:
+                            break
+                        self.wfile.write(b"echo:" + data)
+                        self.wfile.flush()
+                    return
                 payload = json.loads(body) if body else {}
                 if _CREATE_RE.match(path):
                     with daemon._lock:
@@ -457,6 +581,7 @@ class FakeDockerDaemon:
                         {"Warnings": []} if op == "update" else None)
                 return self._reply(404, {"message": "unknown path"})
 
+        _unlink_stale_socket(self.socket_path)
         self._server = _UnixHTTPServer(self.socket_path, Handler)
         threading.Thread(
             target=self._server.serve_forever, daemon=True).start()

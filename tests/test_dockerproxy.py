@@ -170,3 +170,115 @@ def test_failure_policy_fail_aborts_and_ignore_forwards(tmp_path):
         proxy_ign.stop()
     finally:
         daemon.stop()
+
+
+def test_attach_upgrade_streams_bytes_bidirectionally(stack):
+    """kubectl exec/attach shape: a Connection-Upgrade request tunnels
+    through the proxy byte-for-byte — 101 from the daemon, then multiple
+    echo round-trips on the hijacked duplex stream."""
+    import socket
+
+    proxy_sock, daemon, proxy = stack
+    _post(proxy_sock, "/v1.41/containers/create?name=k8s_app", CREATE)
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(10.0)
+    s.connect(str(proxy_sock))
+    s.sendall(b"POST /v1.41/containers/ctr-1/attach?stream=1 HTTP/1.1\r\n"
+              b"Host: docker\r\nConnection: Upgrade\r\nUpgrade: tcp\r\n"
+              b"Content-Length: 0\r\n\r\n")
+    head = b""
+    while b"\r\n\r\n" not in head:
+        head += s.recv(4096)
+    assert head.startswith(b"HTTP/1.1 101"), head
+    assert b"application/vnd.docker.raw-stream" in head
+    stream_tail = head.split(b"\r\n\r\n", 1)[1]
+    for payload in (b"hello", b"stdin-bytes-2", b"\x00\x01binary\xff"):
+        s.sendall(payload)
+        want = b"echo:" + payload
+        buf = stream_tail
+        stream_tail = b""
+        while len(buf) < len(want):
+            chunk = s.recv(4096)
+            assert chunk, f"stream closed early, got {buf!r}"
+            buf += chunk
+        assert buf == want
+    s.close()
+
+
+def test_attach_upgrade_backend_down_returns_502(tmp_path):
+    import socket
+
+    proxy_sock = tmp_path / "proxy.sock"
+    proxy = DockerProxyServer(str(proxy_sock), str(tmp_path / "nope.sock"))
+    proxy.start()
+    try:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(10.0)
+        s.connect(str(proxy_sock))
+        s.sendall(b"POST /v1.41/containers/x/attach HTTP/1.1\r\n"
+                  b"Host: d\r\nConnection: Upgrade\r\nUpgrade: tcp\r\n"
+                  b"Content-Length: 0\r\n\r\n")
+        head = b""
+        while b"\r\n\r\n" not in head:
+            head += s.recv(4096)
+        assert b"502" in head.split(b"\r\n", 1)[0]
+        s.close()
+    finally:
+        proxy.stop()
+
+
+def test_restart_after_unclean_shutdown_rebinds_stale_socket(tmp_path):
+    """allow_reuse_address is a no-op for unix sockets: a stale socket file
+    from an unclean shutdown must be unlinked on start, not crash it."""
+    import socket
+
+    backend_sock = tmp_path / "dockerd.sock"
+    proxy_sock = tmp_path / "proxy.sock"
+    # plant a stale bound-then-abandoned socket file at the proxy path
+    stale = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    stale.bind(str(proxy_sock))
+    stale.close()  # file remains on disk
+    daemon = FakeDockerDaemon(str(backend_sock))
+    daemon.start()
+    proxy = DockerProxyServer(str(proxy_sock), str(backend_sock))
+    proxy.start()  # must not raise 'Address already in use'
+    try:
+        status, _ = _get(proxy_sock, "/v1.41/_ping")
+        assert status == 200
+    finally:
+        proxy.stop()
+        daemon.stop()
+
+
+def test_stop_404_drops_container_store_entry(stack):
+    """A stop answered 404 (container already gone daemon-side) must clean
+    the proxy's meta entry — no later DELETE is guaranteed to come."""
+    proxy_sock, daemon, proxy = stack
+    _post(proxy_sock, "/v1.41/containers/create?name=k8s_app", CREATE)
+    assert "ctr-1" in proxy.container_store
+    with daemon._lock:
+        del daemon.containers["ctr-1"]  # daemon-side disappearance
+    status, _ = _post(proxy_sock, "/v1.41/containers/ctr-1/stop", {})
+    assert status == 404
+    assert "ctr-1" not in proxy.container_store
+
+
+def test_double_start_does_not_destroy_live_proxy(tmp_path):
+    """The stale-socket unlink probes for liveness first: a second instance
+    must fail its bind, not silently unlink a live proxy's endpoint."""
+    backend_sock = tmp_path / "dockerd.sock"
+    proxy_sock = tmp_path / "proxy.sock"
+    daemon = FakeDockerDaemon(str(backend_sock))
+    daemon.start()
+    proxy_a = DockerProxyServer(str(proxy_sock), str(backend_sock))
+    proxy_a.start()
+    proxy_b = DockerProxyServer(str(proxy_sock), str(backend_sock))
+    try:
+        with pytest.raises(OSError):
+            proxy_b.start()
+        status, _ = _get(proxy_sock, "/v1.41/_ping")  # A still serves
+        assert status == 200
+    finally:
+        proxy_b.stop()
+        proxy_a.stop()
+        daemon.stop()
