@@ -130,8 +130,13 @@ def _unlink_stale_socket(path: str) -> None:
         probe.settimeout(0.2)
         probe.connect(path)
         return  # something is serving: leave it alone
+    except ConnectionRefusedError:
+        pass  # definitively stale: bound-then-abandoned file
     except OSError:
-        pass  # stale: refused / dead peer
+        # timeout / EAGAIN (full backlog) / anything ambiguous: the server
+        # may be alive but busy — never destroy its endpoint; our own bind
+        # error will surface instead
+        return
     finally:
         probe.close()
     try:
@@ -293,8 +298,13 @@ class DockerProxyServer:
                 return
             cid = m.group("id")
             with self._lock:
-                pod_meta, container_meta = self.container_store.pop(
-                    cid, (api_pb2.PodSandboxMeta(), api_pb2.ContainerMeta()))
+                entry = self.container_store.pop(cid, None)
+            if entry is None:
+                # never tracked (non-k8s container) or already handled (a
+                # stop retry after an earlier 404): no blank-meta hook and
+                # no duplicate teardown event for koordlet
+                return
+            pod_meta, container_meta = entry
             meta = api_pb2.ContainerMeta()
             meta.CopyFrom(container_meta)
             meta.id = cid
@@ -321,20 +331,24 @@ class DockerProxyServer:
             def _relay(self):
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
+                # hijacked/upgraded connections (exec/attach/logs over the
+                # hijack protocol) cannot ride an http.client relay: tunnel
+                # the raw bytes instead — request verbatim to the daemon,
+                # then a bidirectional pump until either side closes (the
+                # reference's docker server proxies these transparently).
+                # Decided BEFORE _intercept: upgrade endpoints are not
+                # lifecycle hooks, and the tunnel forwards the ORIGINAL
+                # headers, so a hook-mutated body (new length) or a pending
+                # create entry must never reach this path
+                if "upgrade" in (self.headers.get("Connection") or "").lower():
+                    self._tunnel(body)
+                    return
                 body, err, pending_key = proxy._intercept(
                     self.command, self.path, body)
                 if err is not None:
                     self.send_response(err)
                     self.send_header("Content-Length", "0")
                     self.end_headers()
-                    return
-                # hijacked/upgraded connections (exec/attach/logs over the
-                # hijack protocol) cannot ride an http.client relay: tunnel
-                # the raw bytes instead — request verbatim to the daemon,
-                # then a bidirectional pump until either side closes (the
-                # reference's docker server proxies these transparently)
-                if "upgrade" in (self.headers.get("Connection") or "").lower():
-                    self._tunnel(body)
                     return
                 conn = _UnixHTTPConnection(proxy.backend_socket)
                 streamed = False

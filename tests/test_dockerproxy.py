@@ -282,3 +282,26 @@ def test_double_start_does_not_destroy_live_proxy(tmp_path):
         proxy_b.stop()
         proxy_a.stop()
         daemon.stop()
+
+
+def test_stop_retry_after_404_fires_no_blank_hook(stack):
+    """A stop retried after an earlier 404 (entry already popped) must not
+    deliver a second PostStop hook with blank metadata."""
+    proxy_sock, daemon, proxy = stack
+    fired = []
+    orig = proxy._call_hook
+
+    def spy(method, request):
+        if method == "PostStopContainerHook":
+            fired.append(request)
+        return orig(method, request)
+
+    proxy._call_hook = spy
+    _post(proxy_sock, "/v1.41/containers/create?name=k8s_app", CREATE)
+    with daemon._lock:
+        del daemon.containers["ctr-1"]
+    _post(proxy_sock, "/v1.41/containers/ctr-1/stop", {})  # 404: hook fires
+    _post(proxy_sock, "/v1.41/containers/ctr-1/stop", {})  # retry: no hook
+    _post(proxy_sock, "/v1.41/containers/never-tracked/stop", {})
+    assert len(fired) == 1
+    assert fired[0].pod_meta.name == "web-0"  # real meta, never blank
