@@ -1,0 +1,183 @@
+"""Versioned componentconfig round-trip: v1beta2 external form with pointer
+defaulting, strict decoding, and lossless encode/decode (reference
+pkg/scheduler/apis/config/v1beta2/ register+defaults+conversion)."""
+
+import pytest
+
+from koordinator_tpu.ops.loadaware import LoadAwareArgs
+from koordinator_tpu.scheduler.config import (
+    ConfigValidationError,
+    SchedulerConfiguration,
+)
+from koordinator_tpu.scheduler.config_v1beta2 import (
+    API_VERSION,
+    decode_args,
+    decode_component_config,
+    encode_args,
+    encode_component_config,
+)
+
+
+def test_roundtrip_defaults():
+    cfg = SchedulerConfiguration()
+    assert decode_component_config(encode_component_config(cfg)) == cfg
+
+
+def test_roundtrip_non_defaults():
+    cfg = SchedulerConfiguration()
+    cfg.load_aware = LoadAwareArgs(
+        node_metric_expiration_seconds=60.0,
+        resource_weights={"cpu": 2, "memory": 1},
+        score_according_prod_usage=True,
+        agg_usage_thresholds={"cpu": 70},
+        agg_usage_aggregation_type="p95",
+    )
+    cfg.coscheduling.default_timeout_seconds = 120.0
+    cfg.device_share.scoring_strategy = "LeastAllocated"
+    ext = encode_component_config(cfg)
+    assert decode_component_config(ext) == cfg
+    # double round-trip is stable (normalized form)
+    assert encode_component_config(decode_component_config(ext)) == ext
+
+
+def test_pointer_defaulting_absent_vs_explicit():
+    """Absent/null fields take the v1beta2 default; an explicitly present
+    falsy value is kept (the Go nil-pointer vs zero-value distinction)."""
+    plugin, args = decode_args({
+        "apiVersion": API_VERSION, "kind": "LoadAwareSchedulingArgs",
+        "nodeMetricExpirationSeconds": None,       # null -> default
+        "filterExpiredNodeMetrics": False,         # explicit falsy kept
+        "resourceWeights": {},                     # explicit empty kept
+    })
+    assert plugin == "LoadAwareScheduling"
+    assert args.node_metric_expiration_seconds == 180.0
+    assert args.filter_expired_node_metrics is False
+    assert args.resource_weights == {}
+    # untouched fields keep their defaults
+    assert args.usage_thresholds == {"cpu": 65, "memory": 95}
+
+
+def test_aggregated_nesting():
+    ext = encode_args(LoadAwareArgs(agg_usage_aggregation_type="p90",
+                                    agg_usage_thresholds={"cpu": 60}))
+    assert ext["aggregated"]["usageAggregationType"] == "p90"
+    assert "aggUsageAggregationType" not in ext
+    _plugin, back = decode_args(ext)
+    assert back.agg_usage_aggregation_type == "p90"
+    assert back.agg_usage_thresholds == {"cpu": 60}
+
+
+def test_camel_case_acronyms():
+    ext = encode_args(SchedulerConfiguration().node_numa_resource)
+    assert ext["kind"] == "NodeNUMAResourceArgs"
+    assert "defaultCPUBindPolicy" in ext
+    assert "numaAllocateStrategy" in ext
+
+
+def test_strict_unknown_field_and_kind():
+    with pytest.raises(ConfigValidationError, match="unknown field"):
+        decode_args({"apiVersion": API_VERSION, "kind": "ReservationArgs",
+                     "gcDurationSeconds": 10, "bogus": 1})
+    with pytest.raises(ConfigValidationError, match="unknown kind"):
+        decode_args({"apiVersion": API_VERSION, "kind": "NopeArgs"})
+    with pytest.raises(ConfigValidationError, match="unknown apiVersion"):
+        decode_args({"apiVersion": "v9", "kind": "ReservationArgs"})
+
+
+def test_component_config_guards():
+    base = encode_component_config(SchedulerConfiguration())
+    dup = dict(base)
+    entry = base["profiles"][0]["pluginConfig"][0]
+    dup["profiles"] = [{
+        "schedulerName": "koord-scheduler",
+        "pluginConfig": [entry, entry],
+    }]
+    with pytest.raises(ConfigValidationError, match="duplicate"):
+        decode_component_config(dup)
+    mismatch = {
+        "apiVersion": API_VERSION, "kind": "KubeSchedulerConfiguration",
+        "profiles": [{"schedulerName": "koord-scheduler", "pluginConfig": [
+            {"name": "Coscheduling",
+             "args": {"apiVersion": API_VERSION, "kind": "ReservationArgs"}},
+        ]}],
+    }
+    with pytest.raises(ConfigValidationError, match="does not match"):
+        decode_component_config(mismatch)
+
+
+def test_other_profiles_ignored():
+    raw = encode_component_config(SchedulerConfiguration())
+    raw["profiles"].insert(0, {
+        "schedulerName": "default-scheduler",
+        "pluginConfig": [{"name": "Coscheduling", "args": {
+            "apiVersion": API_VERSION, "kind": "CoschedulingArgs",
+            "defaultTimeoutSeconds": 5.0}}],
+    })
+    cfg = decode_component_config(raw)
+    assert cfg.coscheduling.default_timeout_seconds == 600.0  # untouched
+
+
+def test_validation_runs_after_decode():
+    raw = {
+        "apiVersion": API_VERSION, "kind": "KubeSchedulerConfiguration",
+        "profiles": [{"schedulerName": "koord-scheduler", "pluginConfig": [
+            {"name": "DeviceShare", "args": {
+                "apiVersion": API_VERSION, "kind": "DeviceShareArgs",
+                "scoringStrategy": "Bogus"}},
+        ]}],
+    }
+    with pytest.raises(ConfigValidationError, match="scoringStrategy"):
+        decode_component_config(raw)
+
+
+def test_decoded_config_drives_scheduler():
+    """The versioned form plugs into the Scheduler constructor end-to-end."""
+    from koordinator_tpu.client.store import ObjectStore
+    from koordinator_tpu.scheduler.cycle import Scheduler
+
+    raw = encode_component_config(SchedulerConfiguration())
+    for entry in raw["profiles"][0]["pluginConfig"]:
+        if entry["name"] == "Coscheduling":
+            entry["args"]["defaultTimeoutSeconds"] = 42.0
+    cfg = decode_component_config(raw)
+    sched = Scheduler(ObjectStore(), config=cfg)
+    gang = sched.extender.plugin("Coscheduling")
+    assert gang.default_timeout_seconds == 42.0
+
+
+def test_upstream_and_argless_entries_pass_through():
+    """A profile can carry upstream kube-scheduler args (not koordinator
+    kinds) and args-less entries; both are passed over, not rejected."""
+    raw = {
+        "apiVersion": API_VERSION, "kind": "KubeSchedulerConfiguration",
+        "profiles": [{"schedulerName": "koord-scheduler", "pluginConfig": [
+            {"name": "NodeResourcesFit", "args": {
+                "apiVersion": API_VERSION, "kind": "NodeResourcesFitArgs",
+                "scoringStrategy": {"type": "LeastAllocated"}}},
+            {"name": "Coscheduling"},  # args-less == defaults
+            {"name": "Reservation", "args": {
+                "apiVersion": API_VERSION, "kind": "ReservationArgs",
+                "gcDurationSeconds": 3600}},
+        ]}],
+    }
+    cfg = decode_component_config(raw)
+    assert cfg.reservation.gc_duration_seconds == 3600
+    assert cfg.coscheduling.default_timeout_seconds == 600.0
+
+
+def test_wrong_wire_types_are_validation_errors():
+    with pytest.raises(ConfigValidationError, match="expected float"):
+        decode_args({"apiVersion": API_VERSION, "kind": "ReservationArgs",
+                     "gcDurationSeconds": "ten"})
+    with pytest.raises(ConfigValidationError, match="expected dict"):
+        decode_args({"apiVersion": API_VERSION,
+                     "kind": "LoadAwareSchedulingArgs",
+                     "resourceWeights": ["cpu"]})
+    with pytest.raises(ConfigValidationError, match="expected bool"):
+        decode_args({"apiVersion": API_VERSION,
+                     "kind": "LoadAwareSchedulingArgs",
+                     "filterExpiredNodeMetrics": 1})
+    with pytest.raises(ConfigValidationError, match="expected object"):
+        decode_args({"apiVersion": API_VERSION,
+                     "kind": "LoadAwareSchedulingArgs",
+                     "aggregated": [1]})
