@@ -116,16 +116,23 @@ class DeviceShareArgs:
         return []
 
 
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
 def _validate_loadaware(args: LoadAwareArgs) -> List[str]:
     errs = []
     if args.node_metric_expiration_seconds <= 0:
         errs.append("nodeMetricExpirationSeconds: must be > 0")
+    for name, w in args.resource_weights.items():
+        if not _num(w) or w < 0:
+            errs.append(f"resourceWeights[{name}]: must be a number >= 0")
     for name, pct in {**args.usage_thresholds,
                       **args.prod_usage_thresholds}.items():
-        if not (0 <= pct <= 100):
+        if not _num(pct) or not (0 <= pct <= 100):
             errs.append(f"usageThresholds[{name}]: must be in [0,100]")
     for name, pct in args.estimated_scaling_factors.items():
-        if not (0 < pct <= 100):
+        if not _num(pct) or not (0 < pct <= 100):
             errs.append(f"estimatedScalingFactors[{name}]: must be in (0,100]")
     if args.agg_usage_aggregation_type not in (
             "", "avg", "p50", "p90", "p95", "p99"):

@@ -181,3 +181,17 @@ def test_wrong_wire_types_are_validation_errors():
         decode_args({"apiVersion": API_VERSION,
                      "kind": "LoadAwareSchedulingArgs",
                      "aggregated": [1]})
+
+
+def test_dict_element_types_are_validation_errors():
+    raw = {
+        "apiVersion": API_VERSION, "kind": "KubeSchedulerConfiguration",
+        "profiles": [{"schedulerName": "koord-scheduler", "pluginConfig": [
+            {"name": "LoadAwareScheduling", "args": {
+                "apiVersion": API_VERSION,
+                "kind": "LoadAwareSchedulingArgs",
+                "resourceWeights": {"cpu": "high"}}},
+        ]}],
+    }
+    with pytest.raises(ConfigValidationError, match="resourceWeights"):
+        decode_component_config(raw)
