@@ -84,8 +84,11 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
         pprefid_ref,                             # int32 [P] pod-pref profile
         pprefw_ref,                              # f32 [max(S2,1), max(T,1)]
         portwants_ref,                           # f32 [P] port-slot bitmask
-        volneeded_ref,                           # f32 [P, VG] new PVC count
-        #     per node volume-group (already-attached exemption)
+        volneeded_ref,                           # f32 [P * VG] new-PVC
+        #     counts per node volume-group, FLATTENED row-major (pod p,
+        #     group g at [p * VG + g]): a 2-D SMEM window lane-pads each
+        #     row to 128 floats — 5 MB at 10k pods, over the 1 MB SMEM
+        #     budget — so the per-pod rows stay 1-D
         imgid_ref,                               # int32 [P] image profile
         qid_ref,                                                  # int32 [P]
         # --- VMEM pod column blocks [R, POD_BLOCK]
@@ -267,12 +270,13 @@ def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
             if VOL:
                 # per-node NEW attachments: the pod's [VG] row gathered by
                 # the node's volume group (select over static VG; group ids
-                # are exact small-integer f32)
+                # are exact small-integer f32; flattened SMEM indexing)
                 vol_needed = jnp.where(
-                    volgrp == 0.0, volneeded_ref[p, 0], 0.0)
+                    volgrp == 0.0, volneeded_ref[p * VG], 0.0)
                 for g in range(1, VG):
                     vol_needed = jnp.where(
-                        volgrp == float(g), volneeded_ref[p, g], vol_needed)
+                        volgrp == float(g), volneeded_ref[p * VG + g],
+                        vol_needed)
                 feasible = feasible & (
                     (vol_needed <= 0.0) | (vol_free >= vol_needed))
             for s in range(PT):
@@ -592,7 +596,8 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             portwants_m = jnp.zeros(P_pad, jnp.float32)
             portused0 = jnp.zeros((1, N), jnp.float32)
         VG = fc.vol_needed.shape[1]
-        volneeded_pad = jnp.pad(f32(fc.vol_needed), pad_p + [(0, 0)])
+        volneeded_pad = jnp.pad(
+            f32(fc.vol_needed), pad_p + [(0, 0)]).reshape(-1)
         volfree0 = f32(fc.vol_free)[None, :]
         volgrp0 = f32(fc.node_vol_group)[None, :]
         SI = fc.img_scores.shape[1]
