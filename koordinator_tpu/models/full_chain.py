@@ -489,7 +489,9 @@ def build_best_full_chain_step(args: LoadAwareArgs, num_gangs: int,
         return xla_step
     from koordinator_tpu.ops import pallas_common as pc
     from koordinator_tpu.ops.pallas_full_chain import (
+        SMEM_BUDGET_BYTES,
         build_pallas_full_chain_step,
+        estimate_smem_bytes,
         estimate_vmem_bytes,
     )
 
@@ -515,7 +517,11 @@ def build_best_full_chain_step(args: LoadAwareArgs, num_gangs: int,
         S = fc.pref_scores.shape[1]
         PT = fc.port_used.shape[1]
         SI = fc.img_scores.shape[1]
-        if estimate_vmem_bytes(N, R, K, G, P, T, S, PT, SI) <= budget:
+        VG = fc.vol_needed.shape[1]
+        S2 = fc.ppref_w.shape[0] if T else 0
+        if (estimate_vmem_bytes(N, R, K, G, P, T, S, PT, SI) <= budget
+                and estimate_smem_bytes(P, VG, T, S2)
+                <= SMEM_BUDGET_BYTES):
             step.last_backend = "pallas"
             # the snapshot builder hands HOST (numpy) arrays, so this check
             # is sync-free; CONCRETE device arrays (device-resident snapshot

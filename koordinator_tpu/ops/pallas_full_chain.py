@@ -65,6 +65,25 @@ def estimate_vmem_bytes(N: int, R: int, K: int, G: int, P: int,
     return 4 * floats
 
 
+# measured Mosaic SMEM allocation limit on v5e (the compile error reports
+# "would exceed memory (size=1048576)")
+SMEM_BUDGET_BYTES = 1 << 20
+
+
+def estimate_smem_bytes(P: int, VG: int = 1, T: int = 0,
+                        S2: int = 0) -> int:
+    """Upper-bound SMEM footprint: 20 per-pod [P_pad] f32 scalar arrays,
+    the flattened [P_pad * VG] volume-group rows, the [max(T,1)] exists
+    seed + scratch, and the [max(S2,1), max(T,1)] pod-pref weights. Used
+    alongside estimate_vmem_bytes to degrade to the XLA step before Mosaic
+    rejects the allocation (a high-VG batch is the only way past the
+    budget at the shapes the VMEM check admits)."""
+    P_pad = -(-P // POD_BLOCK) * POD_BLOCK
+    floats = ((20 + VG) * P_pad + 2 * max(T, 1)
+              + max(S2, 1) * max(T, 1))
+    return 4 * floats
+
+
 def _make_kernel(weights: np.ndarray, prod_mode: bool, N: int, R: int,
                  K: int, G: int, T: int = 0, S: int = 0, S2: int = 0,
                  PT: int = 0, SI: int = 0, VOL: bool = True,

@@ -108,3 +108,19 @@ class TestDispatch:
         ref_chosen, _ = sm_mod.build_schedule_step(args)(inputs)
         np.testing.assert_array_equal(np.asarray(chosen),
                                       np.asarray(ref_chosen))
+
+
+def test_smem_estimate_guards_high_vg_batches():
+    """The flattened volume-group SMEM rows grow with VG; the estimator
+    must admit the measured-good shapes (10k pods, VG=1) and reject the
+    combination that would blow the 1 MB Mosaic budget (10k pods, VG=16)
+    so the dispatch degrades to XLA instead of failing to compile."""
+    from koordinator_tpu.ops.pallas_full_chain import (
+        SMEM_BUDGET_BYTES,
+        estimate_smem_bytes,
+    )
+
+    assert estimate_smem_bytes(10_000, VG=1, T=8) <= SMEM_BUDGET_BYTES
+    assert estimate_smem_bytes(10_000, VG=16, T=8) > SMEM_BUDGET_BYTES
+    # small batches afford the full group budget
+    assert estimate_smem_bytes(1_000, VG=16, T=8) <= SMEM_BUDGET_BYTES
