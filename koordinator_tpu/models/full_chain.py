@@ -519,34 +519,37 @@ def build_best_full_chain_step(args: LoadAwareArgs, num_gangs: int,
         SI = fc.img_scores.shape[1]
         VG = fc.vol_needed.shape[1]
         S2 = fc.ppref_w.shape[0] if T else 0
+        # the snapshot builder hands HOST (numpy) arrays, so this check
+        # is sync-free; CONCRETE device arrays (device-resident snapshot
+        # state) are checked once per buffer and memoized — only tracers
+        # conservatively keep the volume machinery. Resolved BEFORE the
+        # SMEM guard: a volume-less batch compiles the machinery out (a
+        # 1-float placeholder rides the input slot), so high-VG batches
+        # with no new PVCs still fit the Pallas budget.
+        vn = fc.vol_needed
+        if isinstance(vn, np.ndarray):
+            vol = bool((vn > 0).any())
+        elif isinstance(vn, jax.Array) and not isinstance(
+                vn, jax.core.Tracer):
+            import weakref
+
+            # memoized per live array object: the weakref guards
+            # against id() reuse after GC handing back a stale flag
+            cache = step._vol_flags
+            hit = cache.get(id(vn))
+            if hit is not None and hit[0]() is vn:
+                vol = hit[1]
+            else:
+                vol = bool((np.asarray(vn) > 0).any())
+                if len(cache) > 64:
+                    cache.clear()
+                cache[id(vn)] = (weakref.ref(vn), vol)
+        else:
+            vol = True
         if (estimate_vmem_bytes(N, R, K, G, P, T, S, PT, SI) <= budget
-                and estimate_smem_bytes(P, VG, T, S2)
+                and estimate_smem_bytes(P, VG if vol else 0, T, S2)
                 <= SMEM_BUDGET_BYTES):
             step.last_backend = "pallas"
-            # the snapshot builder hands HOST (numpy) arrays, so this check
-            # is sync-free; CONCRETE device arrays (device-resident snapshot
-            # state) are checked once per buffer and memoized — only tracers
-            # conservatively keep the volume machinery
-            vn = fc.vol_needed
-            if isinstance(vn, np.ndarray):
-                vol = bool((vn > 0).any())
-            elif isinstance(vn, jax.Array) and not isinstance(
-                    vn, jax.core.Tracer):
-                import weakref
-
-                # memoized per live array object: the weakref guards
-                # against id() reuse after GC handing back a stale flag
-                cache = step._vol_flags
-                hit = cache.get(id(vn))
-                if hit is not None and hit[0]() is vn:
-                    vol = hit[1]
-                else:
-                    vol = bool((np.asarray(vn) > 0).any())
-                    if len(cache) > 64:
-                        cache.clear()
-                    cache[id(vn)] = (weakref.ref(vn), vol)
-            else:
-                vol = True
             return _pallas(vol)(fc)
         step.last_backend = "xla"
         return xla_step(fc)

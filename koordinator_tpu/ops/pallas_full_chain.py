@@ -73,13 +73,16 @@ SMEM_BUDGET_BYTES = 1 << 20
 def estimate_smem_bytes(P: int, VG: int = 1, T: int = 0,
                         S2: int = 0) -> int:
     """Upper-bound SMEM footprint: 20 per-pod [P_pad] f32 scalar arrays,
-    the flattened [P_pad * VG] volume-group rows, the [max(T,1)] exists
-    seed + scratch, and the [max(S2,1), max(T,1)] pod-pref weights. Used
-    alongside estimate_vmem_bytes to degrade to the XLA step before Mosaic
-    rejects the allocation (a high-VG batch is the only way past the
-    budget at the shapes the VMEM check admits)."""
+    the flattened [P_pad * VG] volume-group rows (VG == 0 means the
+    volume machinery is compiled out — a 1-float placeholder rides the
+    input slot), the [max(T,1)] exists seed + scratch, and the
+    [max(S2,1), max(T,1)] pod-pref weights. Used alongside
+    estimate_vmem_bytes to degrade to the XLA step before Mosaic rejects
+    the allocation (a high-VG batch is the only way past the budget at
+    the shapes the VMEM check admits)."""
     P_pad = -(-P // POD_BLOCK) * POD_BLOCK
-    floats = ((20 + VG) * P_pad + 2 * max(T, 1)
+    vol_floats = VG * P_pad if VG else 1
+    floats = (20 * P_pad + vol_floats + 2 * max(T, 1)
               + max(S2, 1) * max(T, 1))
     return 4 * floats
 
@@ -615,8 +618,14 @@ def build_pallas_full_chain_step(args: LoadAwareArgs, num_gangs: int,
             portwants_m = jnp.zeros(P_pad, jnp.float32)
             portused0 = jnp.zeros((1, N), jnp.float32)
         VG = fc.vol_needed.shape[1]
-        volneeded_pad = jnp.pad(
-            f32(fc.vol_needed), pad_p + [(0, 0)]).reshape(-1)
+        if enable_volumes:
+            volneeded_pad = jnp.pad(
+                f32(fc.vol_needed), pad_p + [(0, 0)]).reshape(-1)
+        else:
+            # volume machinery compiled out: the kernel never reads the
+            # ref, so a 1-float placeholder keeps high-VG volume-less
+            # batches inside the SMEM budget
+            volneeded_pad = jnp.zeros(1, jnp.float32)
         volfree0 = f32(fc.vol_free)[None, :]
         volgrp0 = f32(fc.node_vol_group)[None, :]
         SI = fc.img_scores.shape[1]

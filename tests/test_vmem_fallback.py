@@ -124,3 +124,16 @@ def test_smem_estimate_guards_high_vg_batches():
     assert estimate_smem_bytes(10_000, VG=16, T=8) > SMEM_BUDGET_BYTES
     # small batches afford the full group budget
     assert estimate_smem_bytes(1_000, VG=16, T=8) <= SMEM_BUDGET_BYTES
+
+
+def test_volume_less_high_vg_batch_stays_on_pallas_budget():
+    """A high-VG batch whose pods mount no new PVCs compiles the volume
+    machinery out (1-float placeholder), so the SMEM estimate must admit
+    it where the volume-carrying shape would not."""
+    from koordinator_tpu.ops.pallas_full_chain import (
+        SMEM_BUDGET_BYTES,
+        estimate_smem_bytes,
+    )
+
+    assert estimate_smem_bytes(10_000, VG=0, T=8) <= SMEM_BUDGET_BYTES
+    assert estimate_smem_bytes(10_000, VG=16, T=8) > SMEM_BUDGET_BYTES
