@@ -519,6 +519,12 @@ def build_best_full_chain_step(args: LoadAwareArgs, num_gangs: int,
         SI = fc.img_scores.shape[1]
         VG = fc.vol_needed.shape[1]
         S2 = fc.ppref_w.shape[0] if T else 0
+        # VMEM budget first: a batch bound for the XLA step anyway must
+        # not pay the vol-flag resolution (which can cost a D2H readback
+        # for fresh device-resident arrays)
+        if estimate_vmem_bytes(N, R, K, G, P, T, S, PT, SI) > budget:
+            step.last_backend = "xla"
+            return xla_step(fc)
         # the snapshot builder hands HOST (numpy) arrays, so this check
         # is sync-free; CONCRETE device arrays (device-resident snapshot
         # state) are checked once per buffer and memoized — only tracers
@@ -546,8 +552,7 @@ def build_best_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                 cache[id(vn)] = (weakref.ref(vn), vol)
         else:
             vol = True
-        if (estimate_vmem_bytes(N, R, K, G, P, T, S, PT, SI) <= budget
-                and estimate_smem_bytes(P, VG if vol else 0, T, S2)
+        if (estimate_smem_bytes(P, VG if vol else 0, T, S2)
                 <= SMEM_BUDGET_BYTES):
             step.last_backend = "pallas"
             return _pallas(vol)(fc)

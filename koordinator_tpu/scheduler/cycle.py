@@ -499,6 +499,7 @@ class Scheduler:
             preempter = DefaultPreemption(
                 self.store,
                 kernel_admission=getattr(self, "_last_admission", None),
+                attempt_seed=self._cycle_seq,
             )
             for round_ in preempter.post_filter(no_fit):
                 any_victims = True
@@ -559,6 +560,7 @@ class Scheduler:
         last = getattr(self, "_last_batch", None)
         items = list(failed_pods) + [
             (p, "admission rejected") for p in rejected_pods]
+        shared = None  # node-level diagnosis state, built once per cycle
         for pod, reason in items:
             msg = reason
             if last is not None and reason in (
@@ -568,10 +570,14 @@ class Scheduler:
                 if j is not None:
                     from koordinator_tpu.scheduler.diagnose import (
                         diagnose_unbound,
+                        shared_state,
                     )
 
                     try:
-                        msg = diagnose_unbound(fc, j, n_nodes)
+                        if shared is None:
+                            shared = shared_state(fc, n_nodes)
+                        msg = diagnose_unbound(fc, j, n_nodes,
+                                               shared=shared)
                     except Exception:  # diagnosis must never wedge a cycle
                         logger.exception(
                             "unschedulability diagnosis failed for %s",

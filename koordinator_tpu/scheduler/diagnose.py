@@ -30,16 +30,41 @@ def _count(mask) -> int:
     return int(np.asarray(mask).sum())
 
 
-def diagnose_unbound(fc, i: int, num_nodes: int) -> str:
-    """Upstream-style message for pod row ``i`` of FullChainInputs ``fc``:
-    per-stage counts over the first ``num_nodes`` real (unpadded) nodes."""
+def shared_state(fc, num_nodes: int) -> dict:
+    """Node-level inputs every diagnosis of this batch shares, pulled to
+    host ONCE: the LoadAware reject rows are a compiled-op call whose
+    result readback costs a full device round-trip — paying it per unbound
+    pod made a many-unbound cycle quadratically expensive."""
     from koordinator_tpu.ops import loadaware as la_ops
 
     inputs = fc.base
     n = num_nodes
-    alloc = np.asarray(inputs.allocatable, np.float32)[:n]
-    requested = np.asarray(inputs.requested, np.float32)[:n]
-    node_ok = np.asarray(inputs.node_ok, bool)[:n]
+    rej_np, rej_pr = la_ops.loadaware_node_reject(
+        inputs.allocatable, inputs.la_filter_usage,
+        inputs.la_has_filter_usage, inputs.la_filter_thresholds,
+        inputs.la_prod_thresholds, inputs.la_prod_pod_usage,
+        inputs.la_filter_skip)
+    return {
+        "alloc": np.asarray(inputs.allocatable, np.float32)[:n],
+        "requested": np.asarray(inputs.requested, np.float32)[:n],
+        "node_ok": np.asarray(inputs.node_ok, bool)[:n],
+        "rej_np": np.asarray(rej_np, bool)[:n],
+        "rej_pr": np.asarray(rej_pr, bool)[:n],
+    }
+
+
+def diagnose_unbound(fc, i: int, num_nodes: int,
+                     shared: dict = None) -> str:
+    """Upstream-style message for pod row ``i`` of FullChainInputs ``fc``:
+    per-stage counts over the first ``num_nodes`` real (unpadded) nodes.
+    Pass ``shared`` (shared_state) when diagnosing many pods of one batch."""
+    inputs = fc.base
+    n = num_nodes
+    if shared is None:
+        shared = shared_state(fc, n)
+    alloc = shared["alloc"]
+    requested = shared["requested"]
+    node_ok = shared["node_ok"]
     fit_req = np.asarray(inputs.fit_requests, np.float32)[i]
     raw_req = np.asarray(fc.requests, np.float32)[i]
 
@@ -74,15 +99,10 @@ def diagnose_unbound(fc, i: int, num_nodes: int) -> str:
     reasons["insufficient resources"] = (
         (fit_req[None, :] > 0) & (requested + fit_req[None, :] > alloc)
     ).any(axis=1)
-    # LoadAware thresholds
-    rej_np, rej_pr = la_ops.loadaware_node_reject(
-        inputs.allocatable, inputs.la_filter_usage,
-        inputs.la_has_filter_usage, inputs.la_filter_thresholds,
-        inputs.la_prod_thresholds, inputs.la_prod_pod_usage,
-        inputs.la_filter_skip)
+    # LoadAware thresholds (node rows precomputed in shared_state)
     is_prod = bool(np.asarray(inputs.is_prod)[i])
     is_ds = bool(np.asarray(inputs.is_daemonset)[i])
-    la_rej = np.asarray(rej_pr if is_prod else rej_np, bool)[:n]
+    la_rej = shared["rej_pr"] if is_prod else shared["rej_np"]
     reasons["node load over threshold"] = (
         la_rej if not is_ds else np.zeros(n, bool))
     # NodePorts
