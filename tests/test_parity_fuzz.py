@@ -64,6 +64,19 @@ def _mixed_fixture(seed: int):
             pod.spec.host_ports.append(("TCP", rng.choice([80, 443, 8080])))
         if rng.random() < 0.15:
             pod.spec.pvc_names = [f"claim-{i}"]
+        elif rng.random() < 0.1:
+            # mount a claim an ASSIGNED pod already attached somewhere:
+            # the node's attached set intersects the pending batch's
+            # claims -> VG > 1 volume groups (the already-attached
+            # exemption encoding) flow through every backend
+            donors = [p for p in state.pods_by_key.values()
+                      if p.is_assigned and not p.is_terminated]
+            if donors:
+                donor = rng.choice(donors)
+                if not donor.spec.pvc_names:
+                    donor.spec.pvc_names = [f"shared-{i}"]
+                pod.spec.pvc_names = list(donor.spec.pvc_names)
+                pod.meta.namespace = donor.meta.namespace
         if rng.random() < 0.2:
             pod.spec.images = ["registry/web:v2"]
         if r < 0.15:
