@@ -441,18 +441,37 @@ class DefaultPreemption:
 
         for j in range(N):
             pack_node(j)
-        kmax = max((p.shape[0] for p in node_prios), default=0)
-        prio_mat = np.full((N, max(kmax, 1)), np.iinfo(np.int64).max,
-                           np.int64)
-        for j in range(N):
-            k = node_prios[j].shape[0]
-            if k:
-                prio_mat[j, :k] = node_prios[j]
+
+        # ragged-but-flat gather tables (no N x kmax padding — a single
+        # hot node with many preemptible pods must not inflate a dense
+        # tensor): per-node candidate priorities and prefix sums
+        # concatenated with offsets. Rebuilt wholesale after an eviction
+        # repacks a node (one concatenate over ~|live| rows, rare).
+        gather: Dict[str, np.ndarray] = {}
+
+        def build_gather() -> None:
+            offsets = np.zeros(N + 1, np.int64)
+            for j in range(N):
+                offsets[j + 1] = offsets[j] + node_prios[j].shape[0]
+            gather["offsets"] = offsets
+            gather["flat_prios"] = (
+                np.concatenate(node_prios) if N and offsets[-1]
+                else np.zeros(0, np.int64))
+            gather["flat_prefix"] = (
+                np.concatenate(node_prefix) if N
+                else np.zeros((0, R)))
+            # prefix rows: node j owns rows [offsets[j] + j,
+            # offsets[j+1] + j + 1) — each node contributes k_j + 1 rows
+            gather["prefix_base"] = offsets[:-1] + np.arange(N)
+
+        build_gather()
 
         def feasible_nodes(pod: Pod, req: np.ndarray, prio: int):
-            counts = (prio_mat < prio).sum(axis=1)           # [N]
-            gain = np.stack([node_prefix[j][counts[j]] for j in range(N)]) \
-                if N else np.zeros((0, R))
+            offsets = gather["offsets"]
+            below = np.concatenate(
+                [[0], np.cumsum(gather["flat_prios"] < prio)])
+            counts = below[offsets[1:]] - below[offsets[:-1]]   # [N]
+            gain = gather["flat_prefix"][gather["prefix_base"] + counts]
             free = alloc_arr - assigned_sum
             for name, vec in inflight.items():
                 free[node_idx[name]] = free[node_idx[name]] - vec
@@ -580,12 +599,8 @@ class DefaultPreemption:
             # repack the touched node's pre-filter row (its assigned set
             # shrank; pods-per-node only ever decreases here, so the
             # padded priority matrix row is refilled in place)
-            j = node_idx[node.meta.name]
-            pack_node(j)
-            prio_mat[j, :] = np.iinfo(np.int64).max
-            k = node_prios[j].shape[0]
-            if k:
-                prio_mat[j, :k] = node_prios[j]
+            pack_node(node_idx[node.meta.name])
+            build_gather()
             # evicted victims consumed disruption budget: recompute so a
             # later preemptor's split/ranking sees the debited PDBs
             pdbs, budgets = pdb_disruption_budgets(self.store)
