@@ -530,8 +530,13 @@ class DefaultPreemption:
             evaluated = 0
             relevant = relevant_for(pod)
             if len(feasible):
-                start = (hash(pod.meta.key) + self.attempt_seed) % len(
-                    feasible)
+                # stable hash: Python's builtin str hash is salted per
+                # process, which would make replayed cycles preempt
+                # different victims than production
+                import zlib
+
+                start = (zlib.crc32(pod.meta.key.encode())
+                         + self.attempt_seed) % len(feasible)
                 feasible = np.roll(feasible, -start)
             for j in feasible:
                 if evaluated >= max_candidates:
@@ -596,9 +601,9 @@ class DefaultPreemption:
             evicted.update(v.meta.key for v in victims)
             inflight[node.meta.name] = (
                 inflight.get(node.meta.name, np.zeros_like(req)) + req)
-            # repack the touched node's pre-filter row (its assigned set
-            # shrank; pods-per-node only ever decreases here, so the
-            # padded priority matrix row is refilled in place)
+            # the victim node's assigned set shrank: repack its per-node
+            # entries, then rebuild the flat gather tables (O(N + sum k)
+            # concatenate — evictions are rare)
             pack_node(node_idx[node.meta.name])
             build_gather()
             # evicted victims consumed disruption budget: recompute so a

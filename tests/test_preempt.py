@@ -459,3 +459,90 @@ class TestDefaultPreemption:
         assert "default/vip" in r1.failed
         r2 = sched.run_cycle(now=1_000_001.0)
         assert r2.preempted_victims == []
+
+
+class TestCandidateSampling:
+    """The DefaultPreemption candidate cap follows upstream's sampling
+    semantics; the window must ROTATE across attempts so a blocked window
+    cannot starve a preemptor forever."""
+
+    def _blocked_fleet(self):
+        """150 nodes: every node carries a low-prio victim (resource
+        feasibility needs eviction everywhere); the FIRST 120 also carry a
+        non-preemptible anti-affinity carrier that repels the preemptor
+        (symmetric anti-affinity), so only the CONTIGUOUS last 30 nodes can
+        host it — a fixed-order 100-candidate window starting at node 0
+        would fail forever; rotation must reach the tail."""
+        from koordinator_tpu.api.objects import (
+            Node,
+            ObjectMeta,
+            Pod,
+            PodAffinityTerm,
+            PodSpec,
+        )
+        from koordinator_tpu.client.store import (
+            KIND_NODE,
+            KIND_POD,
+            ObjectStore,
+        )
+
+        GIB = 1024**3
+        store = ObjectStore()
+        good = set()
+        for i in range(150):
+            node = Node(meta=ObjectMeta(name=f"n{i:03d}", namespace=""),
+                        allocatable=ResourceList.of(cpu=2000, memory=8 * GIB,
+                                                    pods=10))
+            node.meta.labels["kubernetes.io/hostname"] = node.meta.name
+            store.add(KIND_NODE, node)
+            victim = Pod(
+                meta=ObjectMeta(name=f"victim-{i}", uid=f"victim-{i}",
+                                creation_timestamp=1.0),
+                spec=PodSpec(priority=100,
+                             requests=ResourceList.of(cpu=1500, memory=GIB)))
+            victim.spec.node_name = node.meta.name
+            victim.phase = "Running"
+            store.add(KIND_POD, victim)
+            if i < 120:  # contiguous blocked prefix
+                carrier = Pod(
+                    meta=ObjectMeta(name=f"carrier-{i}", uid=f"carrier-{i}",
+                                    creation_timestamp=1.0,
+                                    labels={"app": "guard"}),
+                    spec=PodSpec(priority=10_000,  # never a victim
+                                 requests=ResourceList.of(cpu=100,
+                                                          memory=GIB // 4)))
+                carrier.spec.pod_anti_affinity.append(PodAffinityTerm(
+                    selector={"app": "hot"},
+                    topology_key="kubernetes.io/hostname"))
+                carrier.spec.node_name = node.meta.name
+                carrier.phase = "Running"
+                store.add(KIND_POD, carrier)
+            else:
+                good.add(node.meta.name)
+        hot = Pod(meta=ObjectMeta(name="hot", uid="hot",
+                                  creation_timestamp=2.0,
+                                  labels={"app": "hot"}),
+                  spec=PodSpec(priority=5000,
+                               requests=ResourceList.of(cpu=1500,
+                                                        memory=GIB)))
+        return store, hot, good
+
+    def test_rotating_window_reaches_unblocked_nodes(self):
+        from koordinator_tpu.scheduler.preempt import DefaultPreemption
+
+        store, hot, good = self._blocked_fleet()
+        outcomes = {}
+        for seed in range(8):
+            preempter = DefaultPreemption(store, attempt_seed=seed)
+            rounds = preempter.post_filter([hot])
+            outcomes[seed] = bool(rounds)
+            if rounds:
+                # the victim must come from an UNBLOCKED node
+                victim_key = rounds[0].victim_keys[0]
+                node = store.get(
+                    "Pod", victim_key).spec.node_name
+                assert node in good
+        # with only 20% of nodes unblocked and a 100-candidate window over
+        # 150 nodes, some seeds may sample a blocked-heavy window — but
+        # rotation must find an unblocked window within a few attempts
+        assert any(outcomes.values()), outcomes
