@@ -761,6 +761,7 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int,
     kernel_ms_marginal = 0.0
     fixed_overhead_ms = 0.0
     marginal_pps = 0.0
+    marginal_walls_ms: dict = {}  # str(S) -> measured wall ms (auditable)
     if (jax.default_backend() == "tpu" and not args_cli.smoke
             and backend in ("pallas", "xla", None)):
         try:
@@ -797,9 +798,15 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int,
                 return jax.lax.fori_loop(
                     0, S, body, jnp.full(P_pad, -1, jnp.int32))
 
-            reps = (1, 9)
+            # 3+ S values so the slope is a least-squares fit, not a
+            # noise-amplifying 2-point difference (a single outlier median
+            # at S=1 used to swing the headline silently)
+            reps = (1, 5, 9)
             walls = {}
             for S in reps:
+                # per-S compile is the measurement itself (each S is a
+                # distinct unrolled program)
+                # koordlint: disable=jax-jit-in-loop
                 fn = jax.jit(lambda f, S=S: many(f, S))
                 np.asarray(fn(fc_dev))  # compile + warm
                 ws = []
@@ -808,17 +815,22 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int,
                     np.asarray(fn(fc_dev))
                     ws.append(time.perf_counter() - t0)
                 walls[S] = float(np.median(ws)) * 1000.0
-            kernel_ms_marginal = max(
-                (walls[reps[1]] - walls[reps[0]]) / (reps[1] - reps[0]), 0.0)
-            fixed_overhead_ms = max(walls[reps[0]] - kernel_ms_marginal, 0.0)
+            slope, intercept = np.polyfit(
+                list(reps), [walls[S] for S in reps], 1)
+            kernel_ms_marginal = max(float(slope), 0.0)
+            fixed_overhead_ms = max(float(intercept), 0.0)
             if kernel_ms_marginal > 0:
                 marginal_pps = pods.num_valid / (kernel_ms_marginal / 1000.0)
             log(
-                f"marginal kernel (S=1 vs S=9 chained in-jit, fixed "
-                f"readback cancelled): {kernel_ms_marginal:.2f}ms/batch "
+                f"marginal kernel (least-squares over S={reps} chained "
+                f"in-jit, fixed readback cancelled): "
+                f"{kernel_ms_marginal:.2f}ms/batch "
                 f"-> {marginal_pps:,.0f} pods/s; fixed per-call overhead "
-                f"{fixed_overhead_ms:.1f}ms (axon tunnel readback)"
+                f"{fixed_overhead_ms:.1f}ms (axon tunnel readback); raw "
+                f"walls "
+                + ", ".join(f"S={S}: {walls[S]:.2f}ms" for S in reps)
             )
+            marginal_walls_ms = {str(S): round(walls[S], 3) for S in reps}
         except Exception as e:  # measurement is advisory, never fatal
             log(f"marginal kernel measurement skipped: {e}")
 
@@ -852,6 +864,7 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int,
                 "floor_s_min": round(floor_s_min, 3),
                 "floor_runs": floor_runs,
                 "kernel_ms_marginal": round(kernel_ms_marginal, 2),
+                "marginal_walls_ms": marginal_walls_ms,
                 "fixed_overhead_ms": round(fixed_overhead_ms, 1),
                 "pods_per_sec_marginal": round(marginal_pps, 1),
                 "vs_compiled_floor_marginal": round(

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Repo lint gate: koordlint (AST static analysis, see README "Static
+# analysis") + a bytecode-compile sweep. Mirrors what tier-1 enforces via
+# tests/test_static_analysis.py so it can run pre-push without pytest.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+echo "== koordlint =="
+python -m koordinator_tpu.analysis koordinator_tpu bench.py
+
+echo "== compileall =="
+python -m compileall -q koordinator_tpu bench.py tests hack/microbench.py
+
+echo "lint OK"

@@ -1,0 +1,117 @@
+"""koordlint CLI.
+
+    python -m koordinator_tpu.analysis [paths...]
+        [--baseline FILE] [--write-baseline] [--json] [--list-rules]
+
+Exit codes (the CI contract tests/test_static_analysis.py pins):
+    0  no non-baselined, non-suppressed findings
+    1  findings reported
+    2  usage error / unreadable baseline
+
+Default paths: ``koordinator_tpu bench.py`` (the shipped tree). Default
+baseline: ``koordlint_baseline.json`` next to the first scanned tree's
+repo root (CWD), used only when it exists; pass ``--baseline ''`` to
+force a no-baseline run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from koordinator_tpu.analysis.core import (
+    all_rules,
+    analyze_paths,
+    load_baseline,
+    write_baseline,
+)
+
+DEFAULT_BASELINE = "koordlint_baseline.json"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m koordinator_tpu.analysis",
+        description="koordlint: static analysis for JAX-tracing, "
+                    "wire-decode and concurrency invariants")
+    ap.add_argument("paths", nargs="*",
+                    default=["koordinator_tpu", "bench.py"],
+                    help="files/directories to scan "
+                         "(default: koordinator_tpu bench.py)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline JSON (default: ./{DEFAULT_BASELINE} "
+                         f"if present; '' disables)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write all current findings to the baseline "
+                         "file and exit 0")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as a JSON array")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for name in sorted(rules):
+            r = rules[name]
+            print(f"{name} [{r.severity}]\n    {r.description}")
+        return 0
+
+    missing = [p for p in args.paths if not Path(p).exists()]
+    if missing:
+        print(f"koordlint: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    # a path that exists but matches no .py files (a typo'd extensionless
+    # file, an empty dir) must not produce a false-clean exit 0
+    from koordinator_tpu.analysis.core import iter_python_files
+
+    empty = [p for p in args.paths
+             if not any(True for _ in iter_python_files([p]))]
+    if empty:
+        print(f"koordlint: no Python files under: {', '.join(empty)}",
+              file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline
+    if baseline_path is None:
+        baseline_path = (DEFAULT_BASELINE
+                         if Path(DEFAULT_BASELINE).exists() else "")
+    baseline = set()
+    if baseline_path and not args.write_baseline:
+        try:
+            baseline = load_baseline(baseline_path)
+        except (ValueError, OSError, KeyError, json.JSONDecodeError) as e:
+            print(f"koordlint: bad baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    findings = analyze_paths(args.paths, baseline=baseline)
+
+    if args.write_baseline:
+        target = baseline_path or DEFAULT_BASELINE
+        write_baseline(target, findings)
+        print(f"koordlint: wrote {len(findings)} finding(s) to {target}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps([
+            {"rule": f.rule, "severity": f.severity, "path": f.path,
+             "line": f.line, "message": f.message}
+            for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        n_err = sum(1 for f in findings if f.severity == "error")
+        n_warn = len(findings) - n_err
+        print(f"koordlint: {len(findings)} finding(s) "
+              f"({n_err} error, {n_warn} warning) across "
+              f"{len(rules)} rules"
+              + (f", {len(baseline)} baselined" if baseline else ""))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,271 @@
+"""koordlint core: findings, rule registry, suppressions, baseline, engine.
+
+The analyzer is a plain-AST framework (no imports of the analyzed code, no
+jax dependency): each rule receives a parsed module plus shared lexical
+context and yields findings. The engine layers three noise controls on top:
+
+  * inline suppressions — ``# koordlint: disable=<rule>[,<rule>...]`` on the
+    offending line (or alone on the line above) silences those rules there;
+    ``disable=all`` silences every rule for that line;
+  * a JSON baseline of grandfathered findings (keyed path:rule:line) so a
+    new rule can land strict for NEW code while existing debt is burned
+    down incrementally (ROADMAP tracks the burn-down);
+  * per-rule severity (error/warning) — informational only; the exit-code
+    contract fails on ANY non-baselined, non-suppressed finding so CI
+    stays binary.
+
+Rules register themselves via the ``@register`` decorator at import time of
+``koordinator_tpu.analysis.rules``; the registry is the single source the
+CLI, the tests, and the README rule catalog all enumerate.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+import tokenize
+from io import StringIO
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+SEVERITIES = ("error", "warning")
+
+# generated protobuf modules are not hand-maintained; linting them is noise
+_SKIP_FILE_RE = re.compile(r"(_pb2\.py|_pb2_grpc\.py)$")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*koordlint:\s*disable=([A-Za-z0-9_,\-\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    severity: str
+    path: str          # as given to the engine (posix-normalized)
+    line: int          # 1-based
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Stable identity used by baseline matching (message excluded so
+        rewording a diagnostic does not churn the baseline)."""
+        return f"{self.path}:{self.rule}:{self.line}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.severity}: "
+                f"[{self.rule}] {self.message}")
+
+
+class ModuleContext:
+    """Everything a rule may consult about one source file."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module) -> None:
+        self.path = path.replace("\\", "/")
+        self.source = source
+        self.tree = tree
+        self.lines = source.splitlines()
+        self._traced: Optional[Set[ast.AST]] = None
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+
+    # ---- shared lexical helpers ------------------------------------
+    def parent_map(self) -> Dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            self._parents = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    self._parents[child] = node
+        return self._parents
+
+    def traced_functions(self) -> Set[ast.AST]:
+        """Function defs reachable from a jax tracing entry point — see
+        rules/jaxtrace.py for the discovery algorithm."""
+        if self._traced is None:
+            from koordinator_tpu.analysis.rules.jaxtrace import (
+                find_traced_functions,
+            )
+            self._traced = find_traced_functions(self.tree)
+        return self._traced
+
+
+class Rule:
+    """Base class; subclasses set name/severity/description and implement
+    check()."""
+
+    name: str = ""
+    severity: str = "error"
+    description: str = ""
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: ModuleContext, node: ast.AST,
+                message: str) -> Finding:
+        return Finding(rule=self.name, severity=self.severity,
+                       path=ctx.path, line=getattr(node, "lineno", 1),
+                       message=message)
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and add to the global registry."""
+    rule = cls()
+    if not rule.name:
+        raise ValueError(f"rule {cls.__name__} has no name")
+    if rule.severity not in SEVERITIES:
+        raise ValueError(f"rule {rule.name}: bad severity {rule.severity!r}")
+    if rule.name in _REGISTRY:
+        raise ValueError(f"duplicate rule name {rule.name!r}")
+    _REGISTRY[rule.name] = rule
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    """name -> rule, importing the rule modules on first use."""
+    import koordinator_tpu.analysis.rules  # noqa: F401  (registration)
+
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+def suppressed_lines(source: str) -> Dict[int, Set[str]]:
+    """line number -> set of rule names disabled there ('all' wildcard).
+
+    A ``# koordlint: disable=...`` trailing a statement applies to its own
+    line; a comment ALONE on a line applies to the next line (so long
+    statements can carry the pragma above themselves).
+    """
+    out: Dict[int, Set[str]] = {}
+    try:
+        tokens = list(tokenize.generate_tokens(StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        tokens = []
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        line = tok.start[0]
+        standalone = tok.string.strip() == tok.line.strip()
+        target = line + 1 if standalone else line
+        out.setdefault(target, set()).update(rules)
+    return out
+
+
+def is_suppressed(finding: Finding,
+                  suppress: Dict[int, Set[str]]) -> bool:
+    rules = suppress.get(finding.line)
+    if not rules:
+        return False
+    return "all" in rules or finding.rule in rules
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path) -> Set[str]:
+    """Baseline file -> set of finding keys. Missing file == empty."""
+    p = Path(path)
+    if not p.exists():
+        return set()
+    data = json.loads(p.read_text())
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {p}: unsupported version {data.get('version')!r}")
+    return {
+        f"{e['path']}:{e['rule']}:{e['line']}" for e in data["findings"]
+    }
+
+
+def write_baseline(path, findings: Sequence[Finding]) -> None:
+    entries = [
+        {"path": f.path, "rule": f.rule, "line": f.line,
+         "message": f.message}
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+    ]
+    Path(path).write_text(json.dumps(
+        {"version": BASELINE_VERSION, "findings": entries}, indent=2)
+        + "\n")
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[Path]:
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if not _SKIP_FILE_RE.search(f.name):
+                    yield f
+        elif p.suffix == ".py":
+            yield p
+
+
+def analyze_source(source: str, path: str = "<memory>",
+                   rules: Optional[Dict[str, Rule]] = None) -> List[Finding]:
+    """Run the rule set over one source text (suppressions applied,
+    baseline NOT applied — that is the caller's policy layer)."""
+    rules = all_rules() if rules is None else rules
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(rule="parse-error", severity="error",
+                        path=path.replace("\\", "/"),
+                        line=e.lineno or 1,
+                        message=f"could not parse: {e.msg}")]
+    ctx = ModuleContext(path, source, tree)
+    suppress = suppressed_lines(source)
+    out: List[Finding] = []
+    seen: Set[Finding] = set()
+    for rule in rules.values():
+        for f in rule.check(ctx):
+            # dedup identical reports (e.g. a jit call inside two nested
+            # loops is one site, not two findings)
+            if not is_suppressed(f, suppress) and f not in seen:
+                seen.add(f)
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def _canonical_path(p: Path) -> str:
+    """CWD-relative posix path when the file lives under CWD, else the
+    path as given. Baseline keys embed this string, so `koordinator_tpu/
+    foo.py`, `./koordinator_tpu/foo.py` and the absolute spelling must
+    all produce the same key or grandfathered findings resurface."""
+    try:
+        rel = p.resolve().relative_to(Path.cwd())
+        return rel.as_posix()
+    except (ValueError, OSError):
+        return p.as_posix()
+
+
+def analyze_paths(paths: Iterable[str],
+                  baseline: Optional[Set[str]] = None) -> List[Finding]:
+    """Analyze files/directories; findings present in `baseline` are
+    dropped."""
+    rules = all_rules()
+    baseline = baseline or set()
+    out: List[Finding] = []
+    for f in iter_python_files(paths):
+        source = f.read_text()
+        for finding in analyze_source(source, _canonical_path(f), rules):
+            if finding.key not in baseline:
+                out.append(finding)
+    return out

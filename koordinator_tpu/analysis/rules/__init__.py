@@ -1,0 +1,8 @@
+"""Rule modules; importing this package registers every rule."""
+
+from koordinator_tpu.analysis.rules import (  # noqa: F401
+    concurrency,
+    jaxtrace,
+    loops,
+    wire,
+)

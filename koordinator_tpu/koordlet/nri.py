@@ -138,8 +138,15 @@ class NriPlugin:
         self.mask = event_mask(events)
         self._sock: Optional[socket.socket] = None
         self._thread: Optional[threading.Thread] = None
+        # handled/errors are written by the serve thread and read by the
+        # owner (tests, daemon status) — guard both behind one lock
+        self._state_lock = threading.Lock()
         self.handled: Dict[str, int] = {}
         self.errors: List[str] = []
+
+    def _count(self, method: str) -> None:
+        with self._state_lock:
+            self.handled[method] = self.handled.get(method, 0) + 1
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
@@ -218,7 +225,7 @@ class NriPlugin:
         raise ValueError(f"unknown NRI method {method}")
 
     def _configure(self, req: nri_pb2.ConfigureRequest):
-        self.handled["Configure"] = self.handled.get("Configure", 0) + 1
+        self._count("Configure")
         if req.config:
             cfg = json.loads(req.config)
             self.mask = event_mask(cfg.get("events") or [])
@@ -228,14 +235,14 @@ class NriPlugin:
         try:
             self.hooks.run_hooks(ctx)
         except Exception as exc:  # noqa: BLE001
-            self.errors.append(f"{stage}: {exc}")
+            with self._state_lock:
+                self.errors.append(f"{stage}: {exc}")
             if self.failure_policy is FailurePolicy.FAIL:
                 raise
             # IGNORE: the runtime proceeds unmodified
 
     def _run_pod_sandbox(self, req: nri_pb2.RunPodSandboxRequest):
-        self.handled["RunPodSandbox"] = (
-            self.handled.get("RunPodSandbox", 0) + 1)
+        self._count("RunPodSandbox")
         pod = pod_from_sandbox(req.pod)
         ctx = ContainerContext(
             pod=pod, cgroup_parent=req.pod.cgroup_parent)
@@ -267,8 +274,7 @@ class NriPlugin:
         return adjust
 
     def _create_container(self, req: nri_pb2.CreateContainerRequest):
-        self.handled["CreateContainer"] = (
-            self.handled.get("CreateContainer", 0) + 1)
+        self._count("CreateContainer")
         pod = pod_from_sandbox(req.pod)
         ctx = ContainerContext(
             pod=pod,
@@ -280,8 +286,7 @@ class NriPlugin:
         return nri_pb2.CreateContainerResponse(adjust=self._adjustment(ctx))
 
     def _update_container(self, req: nri_pb2.UpdateContainerRequest):
-        self.handled["UpdateContainer"] = (
-            self.handled.get("UpdateContainer", 0) + 1)
+        self._count("UpdateContainer")
         pod = pod_from_sandbox(req.pod)
         ctx = ContainerContext(
             pod=pod,

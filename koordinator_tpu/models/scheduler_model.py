@@ -160,7 +160,7 @@ def build_schedule_step(args: LoadAwareArgs, jit: bool = True):
             score = jnp.where(feasible, score, -1.0)
             best = jnp.argmax(score)  # first occurrence -> lowest index tie-break
             found = (score[best] >= 0.0) & inputs.pod_valid[i]
-            sel = (jnp.arange(N) == best) & found
+            sel = (jnp.arange(N, dtype=jnp.int32) == best) & found
             requested = requested + sel[:, None] * req[None, :]
             est_add = sel[:, None] * est[None, :]
             delta_np = delta_np + est_add

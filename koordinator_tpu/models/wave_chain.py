@@ -79,7 +79,7 @@ def build_wave_full_chain_step(args: LoadAwareArgs, num_gangs: int,
         ) * anc_valid[..., None].astype(jnp.float32)             # [G, D, G]
         anc_mask = anc_onehot_gd.sum(axis=1)                     # [G, G] 0/1
 
-        warange = jnp.arange(W)
+        warange = jnp.arange(W, dtype=jnp.int32)
 
         def cond(state):
             return state[-1] < P

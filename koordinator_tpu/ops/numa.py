@@ -102,7 +102,8 @@ def numa_spread_fill(
     K = numa_free_n.shape[0]
 
     def single_case():
-        onehot = (jnp.arange(K) == zone).astype(numa_free_n.dtype)
+        onehot = (jnp.arange(K, dtype=jnp.int32) == zone).astype(
+            numa_free_n.dtype)
         return numa_free_n - onehot[:, None] * request[None, :]
 
     def spread_case():

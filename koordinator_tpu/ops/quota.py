@@ -261,7 +261,8 @@ def quota_used_add_row(
         g = chain[d]
         onehot = onehot + jnp.where(
             (g >= 0) & (quota_id >= 0) & apply,
-            (jnp.arange(G) == jnp.maximum(g, 0)).astype(jnp.float32),
+            (jnp.arange(G, dtype=jnp.int32)
+             == jnp.maximum(g, 0)).astype(jnp.float32),
             0.0,
         )
     return used + onehot[:, None] * request[None, :]

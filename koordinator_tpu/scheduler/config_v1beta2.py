@@ -189,14 +189,44 @@ def decode_component_config(
     cfg = SchedulerConfiguration()
     seen: set = set()
     errs: List[str] = []
-    for profile in raw.get("profiles") or []:
+    # every nested wire layer is isinstance-guarded before container/dict
+    # access: malformed YAML (profiles: 17, a string profile, pluginConfig:
+    # "oops", args: "foo") must surface as ConfigValidationError, never
+    # TypeError/AttributeError — and a string container must be rejected
+    # whole, not iterated per character
+    profiles = raw.get("profiles") or []
+    if not isinstance(profiles, list):
+        raise ConfigValidationError(
+            [f"profiles: expected list, got {type(profiles).__name__}"])
+    for pi, profile in enumerate(profiles):
+        if not isinstance(profile, dict):
+            errs.append(f"profiles[{pi}]: expected object, got "
+                        f"{type(profile).__name__}")
+            continue
         if profile.get("schedulerName", scheduler_name) != scheduler_name:
             continue
-        for entry in profile.get("pluginConfig") or []:
+        plugin_config = profile.get("pluginConfig") or []
+        if not isinstance(plugin_config, list):
+            errs.append(
+                f"profiles[{pi}].pluginConfig: expected list, got "
+                f"{type(plugin_config).__name__}")
+            continue
+        for ei, entry in enumerate(plugin_config):
+            if not isinstance(entry, dict):
+                errs.append(
+                    f"profiles[{pi}].pluginConfig[{ei}]: expected object, "
+                    f"got {type(entry).__name__}")
+                continue
             name = entry.get("name", "")
             args_obj = entry.get("args")
             if not args_obj:
                 continue  # args-less entry == use defaults (legal upstream)
+            if not isinstance(args_obj, dict):
+                errs.append(
+                    f"profiles[{pi}].pluginConfig[{ei}]"
+                    f"{f' ({name})' if name else ''}: args must be an "
+                    f"object, got {type(args_obj).__name__}")
+                continue
             if args_obj.get("kind") not in KINDS:
                 # not a koordinator kind: upstream kube-scheduler plugin
                 # args (NodeResourcesFitArgs, ...) ride the same profile —

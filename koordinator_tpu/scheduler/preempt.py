@@ -526,21 +526,43 @@ class DefaultPreemption:
             # pod whose first window is blocked by affinity/victim checks
             # reaches different nodes on later cycles instead of replaying
             # the same failures forever.
-            max_candidates = max(100, len(feasible) // 10)
+            # upstream bases the percentage on the WHOLE fleet
+            # (minCandidateNodesPercentage of numNodes, floor 100), not on
+            # the prefiltered subset — an aggressive prefilter must not
+            # shrink the dry-run window below upstream's
+            max_candidates = max(100, len(nodes) // 10)
             evaluated = 0
+            # every VISITED node counts toward a hard scan bound —
+            # admission/recheck failures included. Without it, a fleet
+            # where most nodes fail _static_admission still walks every
+            # prefiltered node per failed pod, each paying per-node Python
+            # sums (the round-5 advisor's unbounded-scan finding); 2x the
+            # candidate budget bounds total per-pod work while the
+            # rotating window still reaches fresh nodes on later attempts
+            visited = 0
+            scan_cap = 2 * max_candidates
             relevant = relevant_for(pod)
             if len(feasible):
                 # stable hash: Python's builtin str hash is salted per
                 # process, which would make replayed cycles preempt
-                # different victims than production
+                # different victims than production. The seed advances the
+                # window by MAX_CANDIDATES per attempt: a +1 stride would
+                # leave a pod behind an admission-failing window waiting
+                # ~scan_cap cycles to reach fresh nodes, while any stride
+                # LARGER than the minimum consumed window (the evaluated
+                # cap can fire after max_candidates nodes) would tile the
+                # ring with permanent gaps — stride == min window width
+                # guarantees full coverage across attempts for every
+                # feasible-set size
                 import zlib
 
                 start = (zlib.crc32(pod.meta.key.encode())
-                         + self.attempt_seed) % len(feasible)
+                         + self.attempt_seed * max_candidates) % len(feasible)
                 feasible = np.roll(feasible, -start)
             for j in feasible:
-                if evaluated >= max_candidates:
+                if evaluated >= max_candidates or visited >= scan_cap:
                     break
+                visited += 1
                 node = nodes[j]
                 if not self._static_admission(pod, node):
                     continue

@@ -148,7 +148,16 @@ def parse_colocation_config(config_map_data: Dict[str, str]) -> Tuple[Colocation
     except (ValueError, TypeError) as e:
         return ColocationConfig(), f"invalid colocation-config json: {e}"
     cfg = ColocationConfig(cluster_strategy=ColocationStrategy.from_dict(data))
-    for ns in data.get("nodeConfigs", []):
+    node_cfgs = data.get("nodeConfigs", [])
+    if not isinstance(node_cfgs, list):
+        return ColocationConfig(), (
+            f"invalid colocation-config json: nodeConfigs must be a list, "
+            f"got {type(node_cfgs).__name__}")
+    for ns in node_cfgs:
+        if not isinstance(ns, dict):
+            return ColocationConfig(), (
+                f"invalid colocation-config json: nodeConfigs entry must "
+                f"be an object, got {type(ns).__name__}")
         cfg.node_strategies.append(
             NodeStrategy(
                 node_selector=ns.get("nodeSelector", {}),

@@ -326,3 +326,42 @@ class TestHostApplicationConfig:
         assert plain.extensions["hostApplications"][0]["name"] == "nginx"
         edge = store.get(KIND_NODE_SLO, "/edge")
         assert edge.extensions["hostApplications"][0]["name"] == "edge-proxy"
+
+
+class TestColocationWireSafety:
+    """Malformed configmap payloads surface as (default config, error),
+    never AttributeError (koordlint wire-unguarded-access class)."""
+
+    def test_non_dict_node_configs_entries(self):
+        import json
+
+        from koordinator_tpu.utils.sloconfig import (
+            COLOCATION_CONFIG_KEY,
+            parse_colocation_config,
+        )
+
+        cfg, err = parse_colocation_config({COLOCATION_CONFIG_KEY: json.dumps(
+            {"nodeConfigs": ["not-an-object"]})})
+        assert err is not None and "nodeConfigs entry" in err
+        assert cfg.node_strategies == []
+
+        cfg, err = parse_colocation_config({COLOCATION_CONFIG_KEY: json.dumps(
+            {"nodeConfigs": "nope"})})
+        assert err is not None and "must be a list" in err
+        assert cfg.node_strategies == []
+
+    def test_well_formed_still_parses(self):
+        import json
+
+        from koordinator_tpu.utils.sloconfig import (
+            COLOCATION_CONFIG_KEY,
+            parse_colocation_config,
+        )
+
+        cfg, err = parse_colocation_config({COLOCATION_CONFIG_KEY: json.dumps(
+            {"nodeConfigs": [
+                {"nodeSelector": {"pool": "batch"},
+                 "cpuReclaimThresholdPercent": 70}]})})
+        assert err is None
+        assert len(cfg.node_strategies) == 1
+        assert cfg.node_strategies[0].node_selector == {"pool": "batch"}

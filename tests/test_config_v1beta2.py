@@ -195,3 +195,45 @@ def test_dict_element_types_are_validation_errors():
     }
     with pytest.raises(ConfigValidationError, match="resourceWeights"):
         decode_component_config(raw)
+
+
+def test_malformed_wire_containers_are_validation_errors():
+    """Non-dict profiles, pluginConfig entries, and args values must
+    surface as ConfigValidationError, never AttributeError (the
+    config_v1beta2.py:200 bug class koordlint's wire-unguarded-access
+    rule now guards)."""
+    base = {"apiVersion": API_VERSION, "kind": "KubeSchedulerConfiguration"}
+    with pytest.raises(ConfigValidationError, match="profiles\\[0\\]"):
+        decode_component_config({**base, "profiles": ["not-an-object"]})
+    with pytest.raises(ConfigValidationError,
+                       match="pluginConfig\\[0\\]: expected object"):
+        decode_component_config({**base, "profiles": [
+            {"schedulerName": "koord-scheduler",
+             "pluginConfig": ["oops"]}]})
+    with pytest.raises(ConfigValidationError, match="args must be an"):
+        decode_component_config({**base, "profiles": [
+            {"schedulerName": "koord-scheduler",
+             "pluginConfig": [{"name": "Reservation", "args": "foo"}]}]})
+    # several malformed layers accumulate into one error list
+    with pytest.raises(ConfigValidationError) as ei:
+        decode_component_config({**base, "profiles": [
+            17,
+            {"schedulerName": "koord-scheduler",
+             "pluginConfig": [{"args": [1, 2]}, "bad-entry"]},
+        ]})
+    assert len(ei.value.errors) == 3
+
+
+def test_non_list_wire_containers_are_validation_errors():
+    """profiles/pluginConfig that are not lists (or are strings, which
+    would otherwise iterate per character) fail as one validation error."""
+    base = {"apiVersion": API_VERSION, "kind": "KubeSchedulerConfiguration"}
+    with pytest.raises(ConfigValidationError, match="profiles: expected"):
+        decode_component_config({**base, "profiles": 17})
+    with pytest.raises(ConfigValidationError, match="profiles: expected"):
+        decode_component_config({**base, "profiles": "text"})
+    with pytest.raises(ConfigValidationError) as ei:
+        decode_component_config({**base, "profiles": [
+            {"schedulerName": "koord-scheduler", "pluginConfig": "oops"}]})
+    assert ei.value.errors == [
+        "profiles[0].pluginConfig: expected list, got str"]

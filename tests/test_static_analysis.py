@@ -1,0 +1,640 @@
+"""koordlint: engine mechanics, per-rule fixtures, and the tier-1 gate.
+
+Three layers:
+  * engine — suppressions, baseline round-trip, dedup/ordering, parse
+    errors, CLI exit codes;
+  * rules — every registered rule has at least one positive (fires) and
+    one negative (stays silent) fixture, run through the real
+    analyze_source path so suppression/severity plumbing is covered too;
+  * gate — the shipped tree (koordinator_tpu/ + bench.py) is clean modulo
+    the checked-in baseline, which is exactly the CI contract
+    `python -m koordinator_tpu.analysis` enforces.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from koordinator_tpu.analysis import (
+    all_rules,
+    analyze_paths,
+    analyze_source,
+    load_baseline,
+    suppressed_lines,
+    write_baseline,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def findings_for(src: str, rule: str, path: str = "pkg/mod.py"):
+    """Run ONE rule over a dedented snippet; returns its findings."""
+    out = analyze_source(textwrap.dedent(src), path=path,
+                        rules={rule: all_rules()[rule]})
+    return [f for f in out if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_registry_has_at_least_eight_rules():
+    rules = all_rules()
+    assert len(rules) >= 8, sorted(rules)
+    for name, rule in rules.items():
+        assert rule.name == name
+        assert rule.severity in ("error", "warning")
+        assert rule.description
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures: one positive + one negative each
+# ---------------------------------------------------------------------------
+
+class TestJaxHostSync:
+    RULE = "jax-host-sync"
+
+    def test_positive_float_on_jnp_value(self):
+        src = """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                y = jnp.sum(x)
+                return float(y)
+        """
+        assert findings_for(src, self.RULE)
+
+    def test_positive_item_and_np_asarray(self):
+        src = """
+            import jax
+            import numpy as np
+
+            def step(fc):
+                a = np.asarray(fc)
+                return fc.item()
+
+            g = jax.jit(step)
+        """
+        found = findings_for(src, self.RULE)
+        assert len(found) == 2
+
+    def test_negative_static_float_and_untraced(self):
+        src = """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x, s):
+                w = float(1 << 3)       # static Python math
+                n = float(x.shape[0])   # shape access is static
+                return x * w * n
+
+            def host(x):
+                return float(x)         # not traced at all
+        """
+        assert not findings_for(src, self.RULE)
+
+    def test_negative_isinstance_guarded_dispatch(self):
+        src = """
+            import jax
+            import numpy as np
+
+            def step(fc):
+                if isinstance(fc, np.ndarray):
+                    flag = bool((np.asarray(fc) > 0).any())
+                return fc
+
+            g = jax.jit(step)
+        """
+        assert not findings_for(src, self.RULE)
+
+
+class TestJaxTracedBranch:
+    RULE = "jax-traced-branch"
+
+    def test_positive_if_on_jnp_value(self):
+        src = """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                y = jnp.sum(x)
+                if y > 0:
+                    return y
+                return -y
+        """
+        assert findings_for(src, self.RULE)
+
+    def test_negative_static_branch(self):
+        src = """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                acc = x
+                for k in range(4):
+                    acc = acc + jnp.maximum(x, 0.0) if k == 0 else acc
+                return acc
+        """
+        assert not findings_for(src, self.RULE)
+
+    def test_negative_subscript_store_does_not_taint_index(self):
+        src = """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                rows = [x, x]
+                for k in range(2):
+                    rows[k] = jnp.abs(rows[k])
+                    if k == 0:
+                        pass
+                return rows[0]
+        """
+        assert not findings_for(src, self.RULE)
+
+
+class TestImplicitDtype:
+    RULE = "jax-implicit-dtype"
+
+    def test_positive_bare_arange(self):
+        assert findings_for(
+            "import jax.numpy as jnp\nx = jnp.arange(5)\n", self.RULE)
+
+    def test_negative_pinned_and_positional(self):
+        src = """
+            import jax.numpy as jnp
+            a = jnp.arange(5, dtype=jnp.int32)
+            b = jnp.zeros((2, 3), jnp.float32)
+            c = jnp.asarray([1.0])          # not a shape constructor
+        """
+        assert not findings_for(src, self.RULE)
+
+
+class TestJitInLoop:
+    RULE = "jax-jit-in-loop"
+
+    def test_positive_jit_in_for(self):
+        src = """
+            import jax
+            fns = []
+            for i in range(3):
+                fns.append(jax.jit(lambda x: x + i))
+        """
+        assert findings_for(src, self.RULE)
+
+    def test_nested_loops_report_once(self):
+        src = """
+            import jax
+            for i in range(2):
+                for j in range(2):
+                    fn = jax.jit(lambda x: x)
+        """
+        assert len(findings_for(src, self.RULE)) == 1
+
+    def test_negative_hoisted_and_def_in_loop(self):
+        src = """
+            import jax
+            g = jax.jit(lambda x: x)
+            for i in range(3):
+                def helper(x):
+                    return jax.jit(lambda y: y)(x)  # def only, not called
+        """
+        assert not findings_for(src, self.RULE)
+
+
+class TestPrintInJit:
+    RULE = "jax-print-in-jit"
+
+    def test_positive(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def f(x):
+                print("tracing", x)
+                return x
+        """
+        assert findings_for(src, self.RULE)
+
+    def test_negative_outside_trace(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x
+
+            def report(x):
+                print("done", x)
+        """
+        assert not findings_for(src, self.RULE)
+
+
+class TestWireUnguardedAccess:
+    RULE = "wire-unguarded-access"
+
+    # the exemplar regression: config_v1beta2.decode_component_config
+    # PRE-fix — .get() on pluginConfig entries and their args without
+    # isinstance guards. The rule must flag this shape (so reverting the
+    # fix turns the tree red) and accept the guarded post-fix shape.
+    PRE_FIX = """
+        def decode_component_config(raw):
+            for profile in raw.get("profiles") or []:
+                for entry in profile.get("pluginConfig") or []:
+                    args_obj = entry.get("args")
+                    if not args_obj:
+                        continue
+                    if args_obj.get("kind") not in ("A", "B"):
+                        continue
+    """
+
+    POST_FIX = """
+        def decode_component_config(raw):
+            errs = []
+            for profile in raw.get("profiles") or []:
+                if not isinstance(profile, dict):
+                    errs.append("bad profile")
+                    continue
+                for entry in profile.get("pluginConfig") or []:
+                    if not isinstance(entry, dict):
+                        errs.append("bad entry")
+                        continue
+                    args_obj = entry.get("args")
+                    if not isinstance(args_obj, dict):
+                        errs.append("bad args")
+                        continue
+                    if args_obj.get("kind") not in ("A", "B"):
+                        continue
+    """
+
+    def test_positive_pre_fix_shape(self):
+        found = findings_for(self.PRE_FIX, self.RULE)
+        flagged = {f.message.split("'")[1] for f in found}
+        assert {"entry", "args_obj"} <= flagged
+
+    def test_negative_post_fix_shape(self):
+        assert not findings_for(self.POST_FIX, self.RULE)
+
+    def test_positive_wrong_type_guard_does_not_license(self):
+        """isinstance against a NON-mapping type must not silence the
+        rule — a partial revert guarding with str would otherwise pass."""
+        src = """
+            def decode_component_config(raw):
+                for entry in raw.get("pluginConfig") or []:
+                    if isinstance(entry, str):
+                        continue
+                    entry.get("kind")
+        """
+        assert findings_for(src, self.RULE)
+
+    def test_negative_mapping_abc_guard(self):
+        src = """
+            from collections.abc import Mapping
+
+            def decode_component_config(raw):
+                for entry in raw.get("pluginConfig") or []:
+                    if not isinstance(entry, Mapping):
+                        continue
+                    entry.get("kind")
+        """
+        assert not findings_for(src, self.RULE)
+
+    def test_negative_params_are_callers_contract(self):
+        src = """
+            def decode_args(obj):
+                return obj.get("kind")
+        """
+        assert not findings_for(src, self.RULE)
+
+    def test_negative_non_decode_function(self):
+        src = """
+            def lookup(table):
+                for row in table.get("rows") or []:
+                    row.get("x")
+        """
+        assert not findings_for(src, self.RULE)
+
+
+class TestExceptSwallow:
+    RULE = "except-swallow"
+
+    def test_positive_bare_and_silent(self):
+        src = """
+            def f():
+                try:
+                    work()
+                except:
+                    pass
+
+            def g():
+                try:
+                    work()
+                except Exception:
+                    pass
+        """
+        assert len(findings_for(src, self.RULE)) == 2
+
+    def test_negative_logged_or_narrow(self):
+        src = """
+            def f(log):
+                try:
+                    work()
+                except Exception as e:
+                    log(e)
+                try:
+                    work()
+                except KeyError:
+                    pass
+        """
+        assert not findings_for(src, self.RULE)
+
+
+class TestSharedMutableGlobal:
+    RULE = "shared-mutable-global"
+    PATH = "koordinator_tpu/koordlet/fake.py"
+
+    def test_positive_unlocked_global_write(self):
+        src = """
+            _cache = {}
+
+            def put(k, v):
+                _cache[k] = v
+        """
+        assert findings_for(src, self.RULE, path=self.PATH)
+
+    def test_negative_locked_write(self):
+        src = """
+            import threading
+            _lock = threading.Lock()
+            _cache = {}
+
+            def put(k, v):
+                with _lock:
+                    _cache[k] = v
+        """
+        assert not findings_for(src, self.RULE, path=self.PATH)
+
+    def test_negative_local_shadow_is_not_the_global(self):
+        src = """
+            _cache = {}
+
+            def build():
+                _cache = {}
+                _cache["a"] = 1     # a local, not the module global
+                return _cache
+
+            def iterate(rows):
+                for _cache in rows:
+                    _cache["b"] = 2  # loop-local rebinding shadows too
+        """
+        assert not findings_for(src, self.RULE, path=self.PATH)
+
+    def test_positive_global_declaration_unshadows(self):
+        src = """
+            _cache = {}
+
+            def reset():
+                global _cache
+                _cache["x"] = 1
+        """
+        assert findings_for(src, self.RULE, path=self.PATH)
+
+    def test_negative_outside_concurrent_paths(self):
+        src = """
+            REGISTRY = {}
+
+            def register(cls):
+                REGISTRY[cls.__name__] = cls
+                return cls
+        """
+        assert not findings_for(
+            src, self.RULE, path="koordinator_tpu/ops/registry.py")
+
+
+class TestUnlockedSharedMutation:
+    RULE = "unlocked-shared-mutation"
+    PATH = "koordinator_tpu/runtimeproxy/fake.py"
+
+    SRC = """
+        import threading
+
+        class Server:
+            def __init__(self):
+                self.requests = []
+                self._lock = threading.Lock()
+                self._t = threading.Thread(target=self.serve)
+
+            def handle(self, req):
+                self.requests.append(req)
+
+            def handle_locked(self, req):
+                with self._lock:
+                    self.requests.append(req)
+    """
+
+    def test_positive_unlocked_append(self):
+        found = findings_for(self.SRC, self.RULE, path=self.PATH)
+        assert len(found) == 1
+        assert "handle" in found[0].message
+
+    def test_negative_locked_and_init(self):
+        # the same source's __init__ assignment and locked append are clean
+        found = findings_for(self.SRC, self.RULE, path=self.PATH)
+        assert all("handle_locked" not in f.message
+                   and "__init__" not in f.message for f in found)
+
+    def test_negative_threadless_class(self):
+        src = """
+            class Plain:
+                def __init__(self):
+                    self.items = []
+
+                def add(self, x):
+                    self.items.append(x)
+        """
+        assert not findings_for(src, self.RULE, path=self.PATH)
+
+
+class TestUnboundedScan:
+    RULE = "unbounded-scan"
+    PATH = "koordinator_tpu/scheduler/fake.py"
+
+    def test_positive_uncapped_cross_product(self):
+        src = """
+            def dry_run(pods, nodes):
+                out = []
+                for pod in pods:
+                    for node in nodes:
+                        out.append((pod, node))
+                return out
+        """
+        assert findings_for(src, self.RULE, path=self.PATH)
+
+    def test_negative_capped_with_break(self):
+        src = """
+            def dry_run(pods, nodes, cap):
+                out = []
+                for pod in pods:
+                    if len(out) >= cap:
+                        break
+                    for node in nodes:
+                        out.append((pod, node))
+                return out
+        """
+        assert not findings_for(src, self.RULE, path=self.PATH)
+
+    def test_negative_outside_scheduler(self):
+        src = """
+            def pair(pods, nodes):
+                return [(p, n) for p in pods for n in nodes]
+
+            def walk(pods, nodes):
+                for pod in pods:
+                    for node in nodes:
+                        pass
+        """
+        assert not findings_for(
+            src, self.RULE, path="koordinator_tpu/koordlet/fake.py")
+
+
+# ---------------------------------------------------------------------------
+# engine mechanics
+# ---------------------------------------------------------------------------
+
+class TestSuppressions:
+    SRC = "import jax.numpy as jnp\nx = jnp.arange(5)%s\n"
+
+    def test_trailing_comment_suppresses(self):
+        src = self.SRC % "  # koordlint: disable=jax-implicit-dtype"
+        assert not analyze_source(src, path="m.py")
+
+    def test_standalone_comment_suppresses_next_line(self):
+        src = ("import jax.numpy as jnp\n"
+               "# koordlint: disable=jax-implicit-dtype\n"
+               "x = jnp.arange(5)\n")
+        assert not analyze_source(src, path="m.py")
+
+    def test_disable_all_and_wrong_rule(self):
+        assert not analyze_source(
+            self.SRC % "  # koordlint: disable=all", path="m.py")
+        assert analyze_source(
+            self.SRC % "  # koordlint: disable=other-rule", path="m.py")
+
+    def test_suppressed_lines_parsing(self):
+        lines = suppressed_lines(
+            "x = 1  # koordlint: disable=a,b\n"
+            "# koordlint: disable=c\n"
+            "y = 2\n")
+        assert lines[1] == {"a", "b"}
+        assert lines[3] == {"c"}
+
+
+class TestBaseline:
+    def test_round_trip(self, tmp_path):
+        src = "import jax.numpy as jnp\nx = jnp.arange(5)\n"
+        mod = tmp_path / "mod.py"
+        mod.write_text(src)
+        first = analyze_paths([str(mod)])
+        assert first, "fixture must produce a finding"
+        bl = tmp_path / "baseline.json"
+        write_baseline(bl, first)
+        # the same findings are now grandfathered...
+        assert analyze_paths([str(mod)],
+                             baseline=load_baseline(bl)) == []
+        # ...but a NEW finding still surfaces
+        mod.write_text(src + "y = jnp.arange(9)\n")
+        fresh = analyze_paths([str(mod)], baseline=load_baseline(bl))
+        assert [f.line for f in fresh] == [3]
+
+    def test_path_spelling_is_canonicalized(self, tmp_path, monkeypatch):
+        """Baseline keys must match whether the tree is scanned as
+        'pkg', './pkg' or an absolute path (CI vs editor invocations)."""
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(
+            "import jax.numpy as jnp\nx = jnp.arange(4)\n")
+        monkeypatch.chdir(tmp_path)
+        bl = tmp_path / "bl.json"
+        write_baseline(bl, analyze_paths(["pkg"]))
+        for spelling in ("pkg", "./pkg", str(pkg)):
+            assert analyze_paths(
+                [spelling], baseline=load_baseline(bl)) == [], spelling
+
+    def test_missing_baseline_is_empty(self, tmp_path):
+        assert load_baseline(tmp_path / "nope.json") == set()
+
+    def test_version_check(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"version": 99, "findings": []}))
+        with pytest.raises(ValueError):
+            load_baseline(bad)
+
+
+def test_parse_error_is_a_finding():
+    out = analyze_source("def broken(:\n", path="m.py")
+    assert [f.rule for f in out] == ["parse-error"]
+
+
+def test_generated_pb2_files_are_skipped(tmp_path):
+    (tmp_path / "x_pb2.py").write_text(
+        "import jax.numpy as jnp\nx = jnp.arange(5)\n")
+    assert analyze_paths([str(tmp_path)]) == []
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate + CLI exit-code contract
+# ---------------------------------------------------------------------------
+
+def _run_cli(*args, cwd=REPO_ROOT):
+    return subprocess.run(
+        [sys.executable, "-m", "koordinator_tpu.analysis", *args],
+        cwd=cwd, capture_output=True, text=True, timeout=120)
+
+
+def test_shipped_tree_is_clean_modulo_baseline():
+    """THE gate: koordlint over the package + bench.py exits 0. Any new
+    finding must be fixed, suppressed with rationale, or consciously
+    baselined — this test is what makes every rule a standing invariant."""
+    proc = _run_cli("koordinator_tpu", "bench.py")
+    assert proc.returncode == 0, (
+        "koordlint found new violations:\n" + proc.stdout + proc.stderr)
+
+
+def test_cli_exit_codes(tmp_path):
+    assert _run_cli("no/such/path.py").returncode == 2
+    assert _run_cli("--list-rules").returncode == 0
+    # an existing path with no .py files must not exit 0 (false-clean)
+    (tmp_path / "notpython").write_text("x")
+    assert _run_cli(str(tmp_path / "notpython")).returncode == 2
+    empty_dir = tmp_path / "empty"
+    empty_dir.mkdir()
+    assert _run_cli(str(empty_dir)).returncode == 2
+
+
+def test_cli_reports_findings_nonzero(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax.numpy as jnp\nx = jnp.arange(5)\n")
+    proc = _run_cli(str(bad), "--baseline", "")
+    assert proc.returncode == 1
+    assert "jax-implicit-dtype" in proc.stdout
+
+
+def test_checked_in_baseline_matches_format():
+    data = json.loads((REPO_ROOT / "koordlint_baseline.json").read_text())
+    assert data["version"] == 1
+    for entry in data["findings"]:
+        assert {"path", "rule", "line", "message"} <= set(entry)
+        # the wire-decode regression guard must never be grandfathered:
+        # reverting the config_v1beta2 fix has to turn the tree red
+        assert not (entry["rule"] == "wire-unguarded-access"
+                    and "config_v1beta2" in entry["path"])
