@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # Repo lint gate: koordlint (AST static analysis, see README "Static
-# analysis") + a bytecode-compile sweep. Mirrors what tier-1 enforces via
-# tests/test_static_analysis.py so it can run pre-push without pytest.
+# analysis") + a bytecode-compile sweep + the koordtrace JSONL schema pin.
+# Mirrors what tier-1 enforces via tests/test_static_analysis.py and
+# tests/test_obs.py so it can run pre-push without pytest.
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
@@ -11,5 +12,11 @@ python -m koordinator_tpu.analysis koordinator_tpu bench.py
 
 echo "== compileall =="
 python -m compileall -q koordinator_tpu bench.py tests hack/microbench.py
+
+echo "== obs trace schema (golden fixture) =="
+# the CLI exits non-zero on any schema drift against the checked-in trace;
+# a deliberate format change must regenerate the fixture AND bump
+# TRACE_SCHEMA_VERSION in koordinator_tpu/obs/__init__.py
+python -m koordinator_tpu.obs tests/fixtures/trace_golden.jsonl > /dev/null
 
 echo "lint OK"

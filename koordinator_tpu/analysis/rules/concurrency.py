@@ -3,10 +3,11 @@
 Scope: the modules that actually face more than one thread — the scheduler
 cycle driver and its caches (cycle.py, snapshot_cache.py, frameworkext.py),
 the event-sourced object store, the koordlet daemon tree (metrics
-collectors, hook server, states informer all run threads), and the
-runtimeproxy servers. Everywhere else a module-level dict is usually an
-import-time registry and flagging it would be noise, so the rules gate on
-the module path.
+collectors, hook server, states informer all run threads), the
+runtimeproxy servers, and the obs/ tracing layer (its finished-root ring
+is shared across every traced thread). Everywhere else a module-level
+dict is usually an import-time registry and flagging it would be noise,
+so the rules gate on the module path.
 
 Rules:
 
@@ -39,7 +40,8 @@ from koordinator_tpu.analysis.core import (
 
 # path fragments that mark a module as concurrency-sensitive
 _CONCURRENT_PATH_RE = re.compile(
-    r"(koordlet/|runtimeproxy/|client/store\.py|scheduler/cycle\.py"
+    r"(koordlet/|runtimeproxy/|(^|/)obs/|client/store\.py"
+    r"|scheduler/cycle\.py"
     r"|scheduler/snapshot_cache\.py|scheduler/frameworkext\.py)")
 
 _LOCKISH_RE = re.compile(r"(lock|mutex|cond|sem|rlock)", re.IGNORECASE)

@@ -48,6 +48,22 @@ def add_loop_flags(ap, default_interval: float) -> None:
                     help="stop after this many ticks (0 = run until signal)")
 
 
+def serve_obs(port: int, metrics_registry, name: str, tracer=None):
+    """`--obs-port` wiring shared by the binaries: serve /metrics (and
+    /traces when a tracer is given) via obs.server.ObsServer and announce
+    the bound address. Returns the live server, or None when port is 0;
+    the caller shuts it down after its tick loop ends."""
+    if not port:
+        return None
+    from koordinator_tpu.obs.server import ObsServer
+
+    server, _thread = ObsServer(metrics_registry, tracer).serve(port)
+    routes = "/metrics + /traces" if tracer is not None else "/metrics"
+    print(f"{name}: {routes} on 127.0.0.1:{server.server_address[1]}",
+          file=sys.stderr)
+    return server
+
+
 def parse_feature_gates(gate_obj, spec: Optional[str]) -> None:
     """--feature-gates Gate1=true,Gate2=false (component main.go flag)."""
     if not spec:

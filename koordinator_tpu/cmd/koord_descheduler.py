@@ -14,6 +14,7 @@ from koordinator_tpu.cmd import (
     add_loop_flags,
     build_store,
     run_ticks,
+    serve_obs,
 )
 
 
@@ -23,6 +24,8 @@ def main(argv=None) -> int:
     add_loop_flags(ap, default_interval=60.0)
     ap.add_argument("--leader-elect", action="store_true")
     ap.add_argument("--identity", default="koord-descheduler-0")
+    ap.add_argument("--obs-port", type=int, default=0,
+                    help="serve /metrics (0 = off)")
     args = ap.parse_args(argv)
 
     from koordinator_tpu.client.leaderelection import LeaderElector
@@ -34,12 +37,18 @@ def main(argv=None) -> int:
         if args.leader_elect else None
     )
     desched = Descheduler(store, elector=elector)
+    from koordinator_tpu.descheduler import metrics as descheduler_metrics
+
+    obs_server = serve_obs(args.obs_port, descheduler_metrics.REGISTRY,
+                           "koord-descheduler")
 
     def tick():
         summary = desched.run_once()
         print(f"koord-descheduler: {summary}", file=sys.stderr)
 
     run_ticks(tick, args.interval, args.max_ticks, "koord-descheduler")
+    if obs_server is not None:
+        obs_server.shutdown()
     return 0
 
 

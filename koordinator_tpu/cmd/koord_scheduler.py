@@ -18,6 +18,7 @@ from koordinator_tpu.cmd import (
     build_store,
     parse_feature_gates,
     run_ticks,
+    serve_obs,
 )
 
 
@@ -31,6 +32,8 @@ def main(argv=None) -> int:
                     help="gRPC address of the TPU scheduling sidecar")
     ap.add_argument("--services-port", type=int, default=0,
                     help="serve /apis/v1/... debug endpoints (0 = off)")
+    ap.add_argument("--obs-port", type=int, default=0,
+                    help="serve /metrics + /traces (0 = off)")
     ap.add_argument("--feature-gates", help="Gate=bool[,Gate=bool...]")
     args = ap.parse_args(argv)
 
@@ -51,6 +54,10 @@ def main(argv=None) -> int:
         server, _thread = sched.extender.services.serve(args.services_port)
         print(f"koord-scheduler: services on "
               f"127.0.0.1:{server.server_address[1]}", file=sys.stderr)
+    from koordinator_tpu.scheduler import metrics as scheduler_metrics
+
+    obs_server = serve_obs(args.obs_port, scheduler_metrics.REGISTRY,
+                           "koord-scheduler", tracer=sched.tracer)
 
     def tick():
         result = sched.run_cycle()
@@ -68,6 +75,8 @@ def main(argv=None) -> int:
     run_ticks(tick, args.interval, args.max_ticks, "koord-scheduler")
     if server is not None:
         server.shutdown()
+    if obs_server is not None:
+        obs_server.shutdown()
     return 0
 
 

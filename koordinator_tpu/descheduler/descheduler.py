@@ -13,6 +13,7 @@ from typing import Dict, List, Optional
 
 import koordinator_tpu.descheduler.plugins_k8s  # noqa: F401  (registers plugins)
 from koordinator_tpu.client.store import ObjectStore
+from koordinator_tpu.descheduler import metrics as descheduler_metrics
 from koordinator_tpu.descheduler.framework import Profile, ProfileConfig
 from koordinator_tpu.descheduler.lownodeload import LowNodeLoadArgs
 from koordinator_tpu.descheduler.migration import MigrationController
@@ -62,6 +63,7 @@ class Descheduler:
         if self.elector is not None and not self.elector.tick(now):
             return {"skipped_not_leader": True, "jobs_created": 0,
                     "migration_transitions": 0, "profiles": {}, "evicted": {}}
+        t_start = time.perf_counter()
         statuses: Dict[str, Dict[str, Optional[str]]] = {}
         evicted_before = {
             p.config.name: p.handle.evicted_count for p in self.profiles
@@ -73,12 +75,23 @@ class Descheduler:
             }
         jobs_created = len(self.store.list(KIND_POD_MIGRATION_JOB)) - jobs_before
         transitions = self.migration.reconcile(now)
+        evicted = {
+            p.config.name: p.handle.evicted_count - evicted_before[p.config.name]
+            for p in self.profiles
+        }
+        descheduler_metrics.CYCLE_SECONDS.observe(
+            time.perf_counter() - t_start)
+        if jobs_created:
+            descheduler_metrics.MIGRATION_JOBS_CREATED_TOTAL.inc(jobs_created)
+        if transitions:
+            descheduler_metrics.MIGRATION_TRANSITIONS_TOTAL.inc(transitions)
+        for profile_name, delta in evicted.items():
+            if delta:
+                descheduler_metrics.PODS_EVICTED_TOTAL.inc(
+                    delta, profile=profile_name)
         return {
             "jobs_created": jobs_created,
             "migration_transitions": transitions,
             "profiles": statuses,
-            "evicted": {
-                p.config.name: p.handle.evicted_count - evicted_before[p.config.name]
-                for p in self.profiles
-            },
+            "evicted": evicted,
         }

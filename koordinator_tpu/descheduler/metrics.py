@@ -1,0 +1,28 @@
+"""Descheduler metrics registry (analog of reference pkg/descheduler/metrics/).
+
+Same shared Registry class as the koordlet and scheduler registries, so all
+three binaries expose the identical Prometheus text format through
+`obs.server.ObsServer` and one scrape config covers the deployment."""
+
+from __future__ import annotations
+
+from koordinator_tpu.koordlet.metrics import Registry
+
+REGISTRY = Registry()
+
+CYCLE_SECONDS = REGISTRY.histogram(
+    "koord_descheduler_cycle_seconds",
+    "End-to-end descheduling round latency (profiles + migration)",
+)
+MIGRATION_JOBS_CREATED_TOTAL = REGISTRY.counter(
+    "koord_descheduler_migration_jobs_created_total",
+    "PodMigrationJob CRs created by profile plugins",
+)
+MIGRATION_TRANSITIONS_TOTAL = REGISTRY.counter(
+    "koord_descheduler_migration_transitions_total",
+    "PodMigrationJob state transitions executed by the controller",
+)
+PODS_EVICTED_TOTAL = REGISTRY.counter(
+    "koord_descheduler_pods_evicted_total",
+    "Pods evicted by descheduling, labeled by profile",
+)

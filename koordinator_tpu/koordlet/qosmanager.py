@@ -461,5 +461,10 @@ class QoSManager:
         ]
 
     def run_once(self, now: Optional[float] = None) -> None:
+        t_start = time.perf_counter()
         for strategy in self.strategies:
             strategy.run(now)
+            koordlet_metrics.QOS_STRATEGY_RUN_TOTAL.inc(
+                strategy=type(strategy).__name__)
+        koordlet_metrics.QOS_CYCLE_SECONDS.observe(
+            time.perf_counter() - t_start)

@@ -10,15 +10,19 @@ from __future__ import annotations
 
 import json
 from typing import Any, Dict, Optional, Tuple
-from urllib.parse import parse_qs, urlparse
 
 from koordinator_tpu.koordlet.audit import Auditor
+from koordinator_tpu.obs.server import ObsServer
 
 
 class KoordletServer:
-    def __init__(self, auditor: Auditor, metrics_registry=None):
+    def __init__(self, auditor: Auditor, metrics_registry=None, tracer=None):
         self.auditor = auditor
-        self.metrics_registry = metrics_registry
+        # /metrics and /traces live on the shared observability routing
+        # core (single copy of the registry/tracer state — it already
+        # 404s routes whose backend is absent), so all binaries expose
+        # the identical formats
+        self.obs = ObsServer(metrics_registry, tracer)
 
     # -- routing core ---------------------------------------------------
     def handle(self, path: str, query: Optional[Dict[str, str]] = None
@@ -30,8 +34,8 @@ class KoordletServer:
             return 200, "text/plain", "ok"
         if parts == ["apis", "v1", "audit"]:
             return self._audit(query)
-        if parts == ["metrics"] and self.metrics_registry is not None:
-            return 200, "text/plain; version=0.0.4", self.metrics_registry.expose()
+        if parts == ["metrics"] or parts == ["traces"]:
+            return self.obs.handle(path, query)
         return 404, "text/plain", f"unknown path {path!r}"
 
     def _audit(self, query: Dict[str, str]) -> Tuple[int, str, str]:
@@ -63,27 +67,6 @@ class KoordletServer:
     # -- live server ----------------------------------------------------
     def serve(self, port: int = 0):
         """Start the HTTP server; returns (server, thread)."""
-        import threading
-        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        from koordinator_tpu.obs.server import serve_handler
 
-        outer = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802
-                url = urlparse(self.path)
-                q = {k: v[0] for k, v in parse_qs(url.query).items()}
-                status, ctype, body = outer.handle(url.path, q)
-                payload = body.encode()
-                self.send_response(status)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
-
-            def log_message(self, fmt, *args):  # silence
-                pass
-
-        server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
-        thread = threading.Thread(target=server.serve_forever, daemon=True)
-        thread.start()
-        return server, thread
+        return serve_handler(self.handle, port)

@@ -1,0 +1,160 @@
+"""Trace replay CLI: pretty-print a koordtrace JSONL dump as a waterfall.
+
+    python -m koordinator_tpu.obs trace.jsonl
+    curl -s localhost:9090/traces | python -m koordinator_tpu.obs -
+
+Each trace renders as an indented latency waterfall — bar offset is the
+span's monotonic start relative to its root, bar length its share of the
+root's duration — so "where did the cycle spend its time" is answerable
+from a terminal with no tooling.
+
+Exit codes (the `hack/lint.sh` golden-fixture contract):
+  0  every record parsed and validated
+  1  schema drift: bad JSON, missing/mistyped fields, dangling parent ids
+  2  usage error (unreadable input)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from koordinator_tpu.obs import validate_record
+
+
+def load_records(lines) -> Tuple[List[dict], List[str]]:
+    records: List[dict] = []
+    errors: List[str] = []
+    for lineno, line in enumerate(lines, start=1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as exc:
+            errors.append(f"line {lineno}: invalid JSON ({exc})")
+            continue
+        errs = validate_record(obj)
+        if errs:
+            errors.extend(f"line {lineno}: {e}" for e in errs)
+            continue
+        records.append(obj)
+    return records, errors
+
+
+def build_traces(records: List[dict]
+                 ) -> Tuple[List[Tuple[dict, Dict[int, List[dict]]]], List[str]]:
+    """Group records into (root, children_by_parent) per trace id."""
+    errors: List[str] = []
+    by_trace: Dict[int, List[dict]] = {}
+    for rec in records:
+        by_trace.setdefault(rec["trace"], []).append(rec)
+    traces = []
+    for trace_id, spans in sorted(by_trace.items()):
+        ids = {s["span"] for s in spans}
+        roots = [s for s in spans if s["parent"] is None]
+        for s in spans:
+            if s["parent"] is not None and s["parent"] not in ids:
+                errors.append(
+                    f"trace {trace_id}: span {s['span']} ({s['name']!r}) "
+                    f"has dangling parent {s['parent']}")
+        if len(roots) != 1:
+            errors.append(
+                f"trace {trace_id}: expected exactly 1 root span, "
+                f"got {len(roots)}")
+            continue
+        children: Dict[int, List[dict]] = {}
+        for s in spans:
+            if s["parent"] is not None:
+                children.setdefault(s["parent"], []).append(s)
+        for sibs in children.values():
+            sibs.sort(key=lambda s: s["start_mono"])
+        traces.append((roots[0], children))
+    return traces, errors
+
+
+def _bar(offset_ms: float, dur_ms: float, total_ms: float, width: int) -> str:
+    if total_ms <= 0:
+        return " " * width
+    scale = width / total_ms
+    lead = min(width - 1, int(round(offset_ms * scale)))
+    length = max(1, int(round(dur_ms * scale)))
+    length = min(length, width - lead)
+    return " " * lead + "█" * length + " " * (width - lead - length)
+
+
+def render_trace(root: dict, children: Dict[int, List[dict]],
+                 width: int = 40, out=sys.stdout) -> None:
+    total_ms = root["duration_ms"]
+    n_spans = 1 + sum(len(v) for v in children.values())
+    out.write(f"trace {root['trace']} · {root['name']} · "
+              f"{total_ms:.2f}ms · {n_spans} spans\n")
+    name_width = max(
+        (len(s["name"]) + 2 * depth
+         for s, depth in _walk(root, children)), default=0)
+
+    for span, depth in _walk(root, children):
+        offset_ms = (span["start_mono"] - root["start_mono"]) * 1000.0
+        label = "  " * depth + span["name"]
+        attrs = "".join(
+            f" {k}={v}" for k, v in sorted(span["attrs"].items()))
+        out.write(
+            f"  {label:<{name_width}} "
+            f"|{_bar(offset_ms, span['duration_ms'], total_ms, width)}| "
+            f"{span['duration_ms']:8.2f}ms{attrs}\n")
+
+
+def _walk(root: dict, children: Dict[int, List[dict]], depth: int = 0):
+    yield root, depth
+    for child in children.get(root["span"], []):
+        yield from _walk(child, children, depth + 1)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m koordinator_tpu.obs",
+        description="replay a koordtrace JSONL dump as a latency waterfall")
+    ap.add_argument("trace", help="JSONL trace file, or '-' for stdin")
+    ap.add_argument("--width", type=int, default=40,
+                    help="waterfall bar width in characters")
+    args = ap.parse_args(argv)
+
+    if args.trace == "-":
+        lines = sys.stdin.readlines()
+    else:
+        try:
+            with open(args.trace) as f:
+                lines = f.readlines()
+        except OSError as exc:
+            print(f"cannot read {args.trace!r}: {exc}", file=sys.stderr)
+            return 2
+
+    records, errors = load_records(lines)
+    traces, tree_errors = build_traces(records)
+    errors.extend(tree_errors)
+    if errors:
+        for err in errors:
+            print(f"schema error: {err}", file=sys.stderr)
+        return 1
+    if not records:
+        print("no spans in input", file=sys.stderr)
+        return 0
+    try:
+        for i, (root, children) in enumerate(traces):
+            if i:
+                print()
+            render_trace(root, children, width=max(10, args.width))
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe mid-waterfall: normal CLI
+        # usage, not an error; hand stdout a sink so interpreter shutdown
+        # doesn't print a second traceback flushing the dead pipe
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

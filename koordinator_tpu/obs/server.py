@@ -1,0 +1,87 @@
+"""Shared /metrics + /traces + /healthz surface for every binary.
+
+The koordlet API server (`koordlet/server.py`) established the pattern:
+a socket-free routing core `handle(path, query) -> (status, content_type,
+body)` that tests drive directly, wrapped by `serve()` in a
+ThreadingHTTPServer for live use. This module extracts that pattern so the
+scheduler and descheduler expose the exact same Prometheus exposition
+format (and JSONL trace dumps) as the node agent — one scrape config for
+the whole deployment.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+
+def serve_handler(handle, port: int = 0):
+    """Wrap a `(path, query) -> (status, content_type, body)` routing core
+    in a ThreadingHTTPServer on 127.0.0.1; returns (server, thread). The
+    one HTTP wrapper every handler-pattern server shares (ObsServer,
+    KoordletServer) — fix transport behavior here, not per server."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib API)
+            url = urlparse(self.path)
+            q = {k: v[0] for k, v in parse_qs(url.query).items()}
+            status, ctype, body = handle(url.path, q)
+            payload = body.encode()
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, fmt, *args):  # silence
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, thread
+
+
+class ObsServer:
+    """Routing core for the observability endpoints.
+
+    * ``/healthz`` — liveness
+    * ``/metrics`` — Prometheus text exposition from the given Registry
+    * ``/traces``  — the tracer ring as JSONL (``?limit=N`` newest roots),
+      replayable with ``python -m koordinator_tpu.obs``
+    """
+
+    def __init__(self, metrics_registry=None, tracer=None):
+        self.metrics_registry = metrics_registry
+        self.tracer = tracer
+
+    def handle(self, path: str, query: Optional[Dict[str, str]] = None
+               ) -> Tuple[int, str, str]:
+        """(status, content_type, body)."""
+        query = query or {}
+        parts = [p for p in path.split("/") if p]
+        if parts == ["healthz"]:
+            return 200, "text/plain", "ok"
+        if parts == ["metrics"] and self.metrics_registry is not None:
+            return (200, "text/plain; version=0.0.4",
+                    self.metrics_registry.expose())
+        if parts == ["traces"] and self.tracer is not None:
+            raw = query.get("limit")
+            if raw is None or raw == "":
+                limit = None  # absent: the whole ring
+            else:
+                try:
+                    limit = int(raw)
+                except ValueError:
+                    return 400, "text/plain", "limit must be an integer"
+                if limit < 0:
+                    return 400, "text/plain", "limit must be non-negative"
+            body = self.tracer.export_jsonl(limit=limit)
+            return 200, "application/x-ndjson", body
+        return 404, "text/plain", f"unknown path {path!r}"
+
+    def serve(self, port: int = 0):
+        """Start the HTTP server; returns (server, thread)."""
+        return serve_handler(self.handle, port)

@@ -368,51 +368,56 @@ class FakeContainerdServer:
         self.socket_path = socket_path
         self.requests = []  # (method, request message)
         self.raw_calls = []  # (method, payload bytes)
-        # atomic under the GIL — handler threads run concurrently
         self._counter = itertools.count(1)
         self._sandboxes: Dict[str, cri_pb2.PodSandbox] = {}
         self._containers: Dict[str, cri_pb2.Container] = {}
+        # gRPC handler threads run concurrently; every request-log and
+        # sandbox/container map access goes through this lock
+        self._lock = threading.Lock()
         self._server = None
 
     def _next_id(self, prefix: str) -> str:
         return f"{prefix}-{next(self._counter)}"
 
     def handle(self, method: str, request):
-        self.requests.append((method, request))
-        if method == "RunPodSandbox":
-            sandbox_id = self._next_id("sandbox")
-            self._sandboxes[sandbox_id] = cri_pb2.PodSandbox(
-                id=sandbox_id, metadata=request.config.metadata,
-                labels=request.config.labels,
-                annotations=request.config.annotations,
-            )
-            return cri_pb2.RunPodSandboxResponse(pod_sandbox_id=sandbox_id)
-        if method == "StopPodSandbox":
-            self._sandboxes.pop(request.pod_sandbox_id, None)
-            return cri_pb2.StopPodSandboxResponse()
-        if method == "CreateContainer":
-            container_id = self._next_id("container")
-            self._containers[container_id] = cri_pb2.Container(
-                id=container_id, pod_sandbox_id=request.pod_sandbox_id,
-                metadata=request.config.metadata, labels=request.config.labels,
-                annotations=request.config.annotations,
-            )
-            return cri_pb2.CreateContainerResponse(container_id=container_id)
-        if method == "StartContainer":
-            return cri_pb2.StartContainerResponse()
-        if method == "StopContainer":
-            self._containers.pop(request.container_id, None)
-            return cri_pb2.StopContainerResponse()
-        if method == "UpdateContainerResources":
-            return cri_pb2.UpdateContainerResourcesResponse()
-        if method == "ListPodSandbox":
-            return cri_pb2.ListPodSandboxResponse(
-                items=list(self._sandboxes.values())
-            )
-        if method == "ListContainers":
-            return cri_pb2.ListContainersResponse(
-                containers=list(self._containers.values())
-            )
+        with self._lock:
+            self.requests.append((method, request))
+            if method == "RunPodSandbox":
+                sandbox_id = self._next_id("sandbox")
+                self._sandboxes[sandbox_id] = cri_pb2.PodSandbox(
+                    id=sandbox_id, metadata=request.config.metadata,
+                    labels=request.config.labels,
+                    annotations=request.config.annotations,
+                )
+                return cri_pb2.RunPodSandboxResponse(pod_sandbox_id=sandbox_id)
+            if method == "StopPodSandbox":
+                self._sandboxes.pop(request.pod_sandbox_id, None)
+                return cri_pb2.StopPodSandboxResponse()
+            if method == "CreateContainer":
+                container_id = self._next_id("container")
+                self._containers[container_id] = cri_pb2.Container(
+                    id=container_id, pod_sandbox_id=request.pod_sandbox_id,
+                    metadata=request.config.metadata,
+                    labels=request.config.labels,
+                    annotations=request.config.annotations,
+                )
+                return cri_pb2.CreateContainerResponse(
+                    container_id=container_id)
+            if method == "StartContainer":
+                return cri_pb2.StartContainerResponse()
+            if method == "StopContainer":
+                self._containers.pop(request.container_id, None)
+                return cri_pb2.StopContainerResponse()
+            if method == "UpdateContainerResources":
+                return cri_pb2.UpdateContainerResourcesResponse()
+            if method == "ListPodSandbox":
+                return cri_pb2.ListPodSandboxResponse(
+                    items=list(self._sandboxes.values())
+                )
+            if method == "ListContainers":
+                return cri_pb2.ListContainersResponse(
+                    containers=list(self._containers.values())
+                )
         raise KeyError(method)
 
     def start(self) -> None:
@@ -435,7 +440,8 @@ class FakeContainerdServer:
                     )
 
                 def raw(payload, context, m=method):
-                    outer.raw_calls.append((m, payload))
+                    with outer._lock:
+                        outer.raw_calls.append((m, payload))
                     if m == "Version":
                         return cri_pb2.VersionResponse(
                             version="0.1.0", runtime_name="fake-containerd",

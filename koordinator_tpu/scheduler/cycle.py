@@ -50,6 +50,7 @@ from koordinator_tpu.client.store import (
     ObjectStore,
 )
 from koordinator_tpu.models.full_chain import build_best_full_chain_step
+from koordinator_tpu.obs import Tracer
 from koordinator_tpu.ops.fit import with_pod_count
 from koordinator_tpu.ops.loadaware import LoadAwareArgs
 from koordinator_tpu.scheduler.frameworkext import (
@@ -58,6 +59,7 @@ from koordinator_tpu.scheduler.frameworkext import (
     CycleResult,
     FrameworkExtender,
 )
+from koordinator_tpu.scheduler import metrics as scheduler_metrics
 from koordinator_tpu.scheduler.plugins import DEFAULT_PLUGINS
 from koordinator_tpu.scheduler.sidecar import SidecarClient
 from koordinator_tpu.scheduler.snapshot import (
@@ -150,7 +152,12 @@ class Scheduler:
         # active/standby gating (cmd/koord-scheduler/app/server.go:227-256):
         # with an elector, a cycle runs only while this replica holds the lease
         self.elector = elector
+        # koordtrace: every cycle emits a root span with the per-stage
+        # split (snapshot/encode/kernel/bind); dump via /traces or
+        # `python -m koordinator_tpu.obs`
+        self.tracer = Tracer()
         self._step_cache: Dict[Tuple, object] = {}
+        self._last_step_compiled = False
         # SURVEY 7 step 6: the host event loop may offload the kernel pass
         # to a gRPC sidecar (the Go<->JAX integration shape); transport
         # failures degrade to the in-process step, never wedging the cycle
@@ -365,19 +372,47 @@ class Scheduler:
 
     def _get_step(self, signature: Tuple, ng: int, ngroups: int, active) -> object:
         key = (signature, ng, ngroups, tuple(active))
-        if key not in self._step_cache:
-            self._step_cache[key] = build_best_full_chain_step(
+        step = self._step_cache.get(key)
+        if step is not None:
+            self._last_step_compiled = False
+            scheduler_metrics.COMPILE_CACHE_HITS.inc()
+            return step
+        # shape-signature miss: this span times host-side step
+        # construction only — jit is lazy, so the multi-second XLA build
+        # itself lands in the NEXT kernel launch, which is why the kernel
+        # span carries compiled="1" on that cycle. Together with the
+        # hit/miss counters that makes the recompile pathology visible
+        # (a steady-state cluster should be all hits)
+        self._last_step_compiled = True
+        scheduler_metrics.COMPILE_CACHE_MISSES.inc()
+        with self.tracer.span("compile", signature=str(key)):
+            step = build_best_full_chain_step(
                 self.args, ng, ngroups, active_axes=active
             )
-        return self._step_cache[key]
+        self._step_cache[key] = step
+        return step
 
     # ------------------------------------------------------------------
     def run_cycle(self, now: Optional[float] = None) -> CycleResult:
-        t_start = time.perf_counter()
         now = time.time() if now is None else now
         if self.elector is not None and not self.elector.tick(now):
             return CycleResult(skipped_not_leader=True)
         result = CycleResult()
+        # root span: the ONE place the cycle duration is stamped. Every
+        # early-return path inside the traced body (empty queue, pre-pass
+        # binds everything, full pass) exits through the span's finally,
+        # so no return path can ship a zero duration — the old three-site
+        # assignment pattern broke exactly that way.
+        with self.tracer.span("cycle") as root:
+            self._run_cycle_traced(now, result)
+        result.duration_seconds = root.duration_seconds
+        scheduler_metrics.CYCLE_SECONDS.observe(result.duration_seconds)
+        if result.bound:
+            scheduler_metrics.PODS_BOUND_TOTAL.inc(len(result.bound))
+        self.extender.monitor.record(result)
+        return result
+
+    def _run_cycle_traced(self, now: float, result: CycleResult) -> None:
         # [ResizePod gate] in-place resize of assigned pods, before the
         # batch pass sees their requests (frameworkext factory
         # RunReservePluginsReserve + RunResizePod analog)
@@ -414,9 +449,7 @@ class Scheduler:
                 # writer only sees batch-pass failures)
                 self._write_unschedulable_conditions([], timed_out, now)
         if not pending:
-            result.duration_seconds = time.perf_counter() - t_start
-            self.extender.monitor.record(result)
-            return result
+            return
 
         # ---- per-pod view transforms (BeforePreFilter) run before ANY
         # scheduling decision — the nomination pre-pass must see the same
@@ -429,29 +462,32 @@ class Scheduler:
         # ---- reservation nomination pre-pass. Gang/quota pods are excluded:
         # their admission barriers live in the batched kernel, and binding them
         # here would bypass min-member and quota checks.
-        remaining: List[Pod] = []
-        for pod in pending:
-            if (
-                pod.meta.key in pending_reservations
-                or res_plugin is None
-                or pod.gang_name
-                or pod.quota_name
-            ):
-                remaining.append(pod)
-                continue
-            res = res_plugin.nominate(pod, now)
-            if res is None:
-                remaining.append(pod)
-                continue
-            err = self._reserve_and_bind(pod, res.node_name, ctx, result,
-                                         via_reservation=res)
-            if err:
-                remaining.append(pod)
+        with self.tracer.span("reservation_prepass") as presp:
+            remaining: List[Pod] = []
+            nominated = 0
+            for pod in pending:
+                if (
+                    pod.meta.key in pending_reservations
+                    or res_plugin is None
+                    or pod.gang_name
+                    or pod.quota_name
+                ):
+                    remaining.append(pod)
+                    continue
+                res = res_plugin.nominate(pod, now)
+                if res is None:
+                    remaining.append(pod)
+                    continue
+                err = self._reserve_and_bind(pod, res.node_name, ctx, result,
+                                             via_reservation=res)
+                if err:
+                    remaining.append(pod)
+                else:
+                    nominated += 1
+            presp.attributes["nominated"] = str(nominated)
         pending = remaining
         if not pending:
-            result.duration_seconds = time.perf_counter() - t_start
-            self.extender.monitor.record(result)
-            return result
+            return
 
         # ---- batched kernel pass
         rejected_pods, failed_pods = self._batch_pass(
@@ -540,9 +576,6 @@ class Scheduler:
 
         if gang_plugin is not None:
             gang_plugin.update_pod_group_status(self.store, now)
-        result.duration_seconds = time.perf_counter() - t_start
-        self.extender.monitor.record(result)
-        return result
 
     # ------------------------------------------------------------------
     def _write_unschedulable_conditions(
@@ -560,6 +593,12 @@ class Scheduler:
         last = getattr(self, "_last_batch", None)
         items = list(failed_pods) + [
             (p, "admission rejected") for p in rejected_pods]
+        if not items:
+            return
+        with self.tracer.span("diagnose", pods=str(len(items))):
+            self._diagnose_and_write(items, last, now)
+
+    def _diagnose_and_write(self, items, last, now: float) -> None:
         shared = None  # node-level diagnosis state, built once per cycle
         for pod, reason in items:
             msg = reason
@@ -611,85 +650,99 @@ class Scheduler:
         # pods arrive already view-transformed (run_cycle runs BeforePreFilter
         # ahead of the nomination pre-pass); here the state-level transformer
         # chain runs: ClusterState rewrites, then packed-input rewrites
-        state = self._cluster_state(pending, now)
-        self.extender.transform_after_prefilter(state, ctx)
-        self.extender.transform_before_filter(state, ctx)
+        with self.tracer.span("snapshot") as ssp:
+            state = self._cluster_state(pending, now)
+            self.extender.transform_after_prefilter(state, ctx)
+            self.extender.transform_before_filter(state, ctx)
+            ssp.attributes["nodes"] = str(len(state.nodes))
+            ssp.attributes["pods"] = str(len(pending))
         if not state.nodes:
             return rejected_pods, [(p, "no schedulable node") for p in pending]
-        fc, pods, nodes, tree, gang_index, ng, ngroups = build_full_chain_inputs(
-            state, self.args, cache=self.snapshot_cache
-        )
-        # stash the admission grouping this kernel pass used so host-side
-        # dry-runs (DefaultPreemption) consult the SAME encoding — the raw
-        # label check can be more permissive when the signature budget
-        # overflowed, and the dry-run must never accept a node the kernel
-        # cannot bind (it would evict victims in vain)
-        node_group_arr = np.asarray(fc.node_taint_group)
-        pod_mask_arr = np.asarray(fc.pod_taint_mask)
-        self._last_admission = (
-            {n.meta.name: int(node_group_arr[i])
-             for i, n in enumerate(state.nodes)},
-            {key: int(pod_mask_arr[i]) for i, key in enumerate(pods.keys)},
-        )
-        fc = self.extender.transform_before_score(fc, ctx)
-        fc, active = reduce_to_active_axes(fc)
-        # keep the packed batch for end-of-cycle unschedulability diagnosis
-        # (scheduler/diagnose.py reads the same arrays the kernel consumed);
-        # a retry pass overwrites this with the final batch
-        self._last_batch = (
-            fc, {key: j for j, key in enumerate(pods.keys)},
-            len(state.nodes))
+        with self.tracer.span("encode"):
+            fc, pods, nodes, tree, gang_index, ng, ngroups = (
+                build_full_chain_inputs(
+                    state, self.args, cache=self.snapshot_cache
+                ))
+            # stash the admission grouping this kernel pass used so
+            # host-side dry-runs (DefaultPreemption) consult the SAME
+            # encoding — the raw label check can be more permissive when
+            # the signature budget overflowed, and the dry-run must never
+            # accept a node the kernel cannot bind (it would evict victims
+            # in vain)
+            node_group_arr = np.asarray(fc.node_taint_group)
+            pod_mask_arr = np.asarray(fc.pod_taint_mask)
+            self._last_admission = (
+                {n.meta.name: int(node_group_arr[i])
+                 for i, n in enumerate(state.nodes)},
+                {key: int(pod_mask_arr[i]) for i, key in enumerate(pods.keys)},
+            )
+            fc = self.extender.transform_before_score(fc, ctx)
+            fc, active = reduce_to_active_axes(fc)
+            # keep the packed batch for end-of-cycle unschedulability
+            # diagnosis (scheduler/diagnose.py reads the same arrays the
+            # kernel consumed); a retry pass overwrites this with the
+            # final batch
+            self._last_batch = (
+                fc, {key: j for j, key in enumerate(pods.keys)},
+                len(state.nodes))
         step = self._get_step(
             (pods.padded_size, nodes.padded_size, fc.quota_runtime.shape[0]),
             ng, ngroups, active,
         )
-        t_k = time.perf_counter()
-        if self._sidecar_client is not None:
-            from koordinator_tpu.scheduler.sidecar import (
-                schedule_batch_or_fallback,
-            )
+        with self.tracer.span(
+                "kernel",
+                compiled="1" if self._last_step_compiled else "0") as ksp:
+            if self._sidecar_client is not None:
+                from koordinator_tpu.scheduler.sidecar import (
+                    schedule_batch_or_fallback,
+                )
 
-            chosen, _, _, used_fallback = schedule_batch_or_fallback(
-                self._sidecar_client, fc, ng, ngroups, self.args,
-                active_axes=active, local_step=step,
-            )
-            if used_fallback:
-                self.sidecar_fallbacks += 1
-        else:
-            if self.device_snapshot is not None:
-                # device-resident steady state: unchanged fields reuse the
-                # previous cycle's device buffers, small node-row deltas go
-                # up as donated scatters (snapshot_cache.DeviceSnapshot)
-                fc = self.device_snapshot.upload(fc)
-            chosen, _, _ = step(fc)
-        chosen = np.asarray(chosen)
-        result.kernel_seconds += time.perf_counter() - t_k
+                chosen, _, _, used_fallback = schedule_batch_or_fallback(
+                    self._sidecar_client, fc, ng, ngroups, self.args,
+                    active_axes=active, local_step=step,
+                )
+                if used_fallback:
+                    self.sidecar_fallbacks += 1
+            else:
+                if self.device_snapshot is not None:
+                    # device-resident steady state: unchanged fields reuse
+                    # the previous cycle's device buffers, small node-row
+                    # deltas go up as donated scatters
+                    # (snapshot_cache.DeviceSnapshot)
+                    fc = self.device_snapshot.upload(fc)
+                chosen, _, _ = step(fc)
+            chosen = np.asarray(chosen)
+        result.kernel_seconds += ksp.duration_seconds
+        scheduler_metrics.KERNEL_SECONDS.observe(ksp.duration_seconds)
 
         # apply bindings in queue order
-        by_key = {p.meta.key: p for p in pending}
-        for i, key in enumerate(pods.keys):
-            node_idx = int(chosen[i])
-            pod = by_key[key]
-            if node_idx < 0:
-                # encoding-budget overflows carry their own first-class
-                # reason (surfaced via the error-handler event trail and
-                # the overflow metric) and never enter preemption — no
-                # victim set can fix an encoding cut
-                reason = pods.unschedulable_reasons.get(i)
-                if reason is not None:
-                    failed_pods.append((pod, reason))
-                elif pod.gang_name or pod.quota_name:
-                    rejected_pods.append(pod)
-                else:
-                    failed_pods.append((pod, "no feasible node"))
-                continue
-            node_name = nodes.names[node_idx]
-            reservation = pending_reservations.get(key)
-            err = self._reserve_and_bind(
-                pod, node_name, ctx, result, reservation_cr=reservation
-            )
-            if err:
-                failed_pods.append((pod, err))
+        with self.tracer.span("bind") as bsp:
+            bound_before = len(result.bound)
+            by_key = {p.meta.key: p for p in pending}
+            for i, key in enumerate(pods.keys):
+                node_idx = int(chosen[i])
+                pod = by_key[key]
+                if node_idx < 0:
+                    # encoding-budget overflows carry their own first-class
+                    # reason (surfaced via the error-handler event trail
+                    # and the overflow metric) and never enter preemption —
+                    # no victim set can fix an encoding cut
+                    reason = pods.unschedulable_reasons.get(i)
+                    if reason is not None:
+                        failed_pods.append((pod, reason))
+                    elif pod.gang_name or pod.quota_name:
+                        rejected_pods.append(pod)
+                    else:
+                        failed_pods.append((pod, "no feasible node"))
+                    continue
+                node_name = nodes.names[node_idx]
+                reservation = pending_reservations.get(key)
+                err = self._reserve_and_bind(
+                    pod, node_name, ctx, result, reservation_cr=reservation
+                )
+                if err:
+                    failed_pods.append((pod, err))
+            bsp.attributes["bound"] = str(len(result.bound) - bound_before)
         return rejected_pods, failed_pods
 
     # ------------------------------------------------------------------
@@ -718,22 +771,27 @@ class Scheduler:
             )
             return None
 
-        done: List = []
-        for plugin in self.extender.plugins:
-            err = plugin.reserve(pod, node_name, ctx)
-            if err:
-                for p in reversed(done):
-                    p.unreserve(pod, node_name, ctx)
-                return f"{plugin.name}: {err}"
-            done.append(plugin)
-        if via_reservation is not None:
-            res_plugin = self.extender.plugin("Reservation")
-            res_plugin.consume(pod, via_reservation, ctx)
+        with self.tracer.span("bind_pod", pod=pod.meta.key,
+                              node=node_name) as psp:
+            with self.tracer.span("reserve"):
+                done: List = []
+                for plugin in self.extender.plugins:
+                    err = plugin.reserve(pod, node_name, ctx)
+                    if err:
+                        for p in reversed(done):
+                            p.unreserve(pod, node_name, ctx)
+                        psp.attributes["veto"] = plugin.name
+                        return f"{plugin.name}: {err}"
+                    done.append(plugin)
+                if via_reservation is not None:
+                    res_plugin = self.extender.plugin("Reservation")
+                    res_plugin.consume(pod, via_reservation, ctx)
 
-        annotations: Dict[str, str] = {}
-        for plugin in self.extender.plugins:
-            plugin.pre_bind(pod, node_name, ctx, annotations)
-        prebind = self.extender.plugin("DefaultPreBind")
-        prebind.apply_patch(pod, node_name, annotations, now=ctx.now)
+            with self.tracer.span("prebind"):
+                annotations: Dict[str, str] = {}
+                for plugin in self.extender.plugins:
+                    plugin.pre_bind(pod, node_name, ctx, annotations)
+                prebind = self.extender.plugin("DefaultPreBind")
+                prebind.apply_patch(pod, node_name, annotations, now=ctx.now)
         result.bound.append(BindResult(pod.meta.key, node_name, annotations))
         return None

@@ -33,3 +33,31 @@ VOL_GROUP_DEGRADED_NODES = REGISTRY.gauge(
     "koord_scheduler_volume_group_degraded_nodes",
     "Nodes degraded to the conservative volume group in the last snapshot",
 )
+
+# cycle-latency histograms (koordtrace spans carry the per-stage split;
+# these carry the distribution a scraper can alert on)
+CYCLE_SECONDS = REGISTRY.histogram(
+    "koord_scheduler_cycle_seconds",
+    "End-to-end scheduling cycle latency",
+)
+KERNEL_SECONDS = REGISTRY.histogram(
+    "koord_scheduler_kernel_seconds",
+    "Batched kernel pass latency (compile+execute on a cache miss)",
+)
+
+# shape-signature step-cache traffic: a steady-state cluster should be
+# all hits; misses are the XLA-recompile pathology the batched-tensor
+# design introduces over the reference, and each one costs seconds
+COMPILE_CACHE_HITS = REGISTRY.counter(
+    "koord_scheduler_compile_cache_hits_total",
+    "Kernel launches served by the shape-signature step cache",
+)
+COMPILE_CACHE_MISSES = REGISTRY.counter(
+    "koord_scheduler_compile_cache_misses_total",
+    "Kernel step builds forced by a shape-signature cache miss",
+)
+
+PODS_BOUND_TOTAL = REGISTRY.counter(
+    "koord_scheduler_pods_bound_total",
+    "Pods bound across all cycles",
+)
