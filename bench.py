@@ -562,6 +562,132 @@ def run_rebalance(args_cli, num_pods: int, num_nodes: int) -> None:
     )
 
 
+def run_steady_state(args_cli, num_pods: int, num_nodes: int) -> dict:
+    """Warm steady-state loop: the honest answer to "what does a CYCLE cost
+    once the cluster is warm?".
+
+    Builds a store world, runs one cold cycle (full pack + compile + full
+    upload), then applies a synthetic ~2% store delta per round (fresh
+    pending arrivals + node-metric touches) and runs pipelined cycles
+    (scheduler/cycle.CyclePipeline: incremental pack, delta upload,
+    non-blocking dispatch, deferred diagnose). A serial twin scheduler
+    replays the identical delta stream on an identical store; bindings are
+    diffed every round and PodScheduled conditions at the end — the
+    pipeline must be byte-for-byte the serial path.
+
+    Returns the JSON fields: steady_state_pods_per_sec, pack_seconds_warm
+    / pack_seconds_cold (the pack_incremental span), pipeline_occupancy
+    (fraction of wall where the device has work) and pipeline_parity_ok."""
+    from koordinator_tpu.scheduler.cycle import CyclePipeline, Scheduler
+    from koordinator_tpu.scheduler.pipeline_parity import (
+        _conditions,
+        apply_round_delta,
+        build_store_from_state,
+    )
+    from koordinator_tpu.testing import synth_full_cluster
+
+    arrivals = max(4, num_pods // 100)      # ~1% new pods...
+    metric_touches = max(2, num_nodes // 100)  # ...+ ~1% metric updates
+    warmup = 1 if args_cli.smoke else 2     # delta cycles paying one-time
+    rounds = 2 if args_cli.smoke else 3     # scatter/step compiles
+    log(f"steady-state loop: {arrivals} arrivals + {metric_touches} metric "
+        f"touches per round (~2% delta), {warmup} warmup + {rounds} "
+        f"measured rounds, serial twin for parity")
+
+    def make_store():
+        _cluster, state = synth_full_cluster(
+            num_nodes, num_pods, seed=42,
+            num_quotas=max(8, num_pods // 100),
+            num_gangs=max(4, num_pods // 50))
+        return build_store_from_state(state), state
+
+    t0 = time.perf_counter()
+    store_p, state = make_store()
+    store_s, _state2 = make_store()
+    sched_p = Scheduler(store_p)
+    pipeline = CyclePipeline(sched_p)  # KOORD_TPU_PIPELINE gates
+    sched_s = Scheduler(store_s)
+    assert sched_s.pipeline_mode is False
+    log(f"steady-state fixture + twin stores: {time.perf_counter() - t0:.2f}s "
+        "(not framework cost)")
+
+    def pack_span_seconds(sched) -> float:
+        root = sched.tracer.roots(limit=1)[0]
+        sp = root.find("pack_incremental")
+        return sp.duration_seconds if sp is not None else 0.0
+
+    def bound_list(res):
+        return [(b.pod_key, b.node_name) for b in res.bound]
+
+    def apply_delta(store, r: int, now: float) -> None:
+        # the SAME delta generator the lint parity gate uses, scaled to
+        # this fixture's arrival/metric-touch budget
+        apply_round_delta(store, r, now, arrivals,
+                          metric_touches=metric_touches,
+                          prefix="ss", namespace="steady")
+
+    now = state.now
+    parity_ok = True
+    t0 = time.perf_counter()
+    res0 = pipeline.run_cycle(now=now)
+    t_cycle0 = time.perf_counter() - t0
+    pack_cold = pack_span_seconds(sched_p)
+    res0_s = sched_s.run_cycle(now=now)
+    if bound_list(res0) != bound_list(res0_s):
+        parity_ok = False
+        log("steady-state cycle 0: bindings MISMATCH vs serial twin!")
+    log(f"steady-state cycle 0 (cold): {t_cycle0:.3f}s, pack "
+        f"{pack_cold:.3f}s, {len(res0.bound)} bound")
+
+    walls, packs, busys, bound_counts = [], [], [], []
+    for r in range(1, warmup + rounds + 1):
+        apply_delta(store_p, r, now)
+        apply_delta(store_s, r, now)
+        t = now + 2 * r
+        t0 = time.perf_counter()
+        res_p = pipeline.run_cycle(now=t)
+        wall = time.perf_counter() - t0
+        res_s = sched_s.run_cycle(now=t)
+        if (bound_list(res_p) != bound_list(res_s)
+                or sorted(res_p.failed) != sorted(res_s.failed)):
+            parity_ok = False
+            log(f"steady-state round {r}: MISMATCH vs serial twin")
+        if r > warmup:
+            walls.append(wall)
+            packs.append(pack_span_seconds(sched_p))
+            busys.append(res_p.device_busy_seconds)
+            bound_counts.append(len(res_p.bound))
+    pipeline.flush()
+    if _conditions(store_p) != _conditions(store_s):
+        parity_ok = False
+        log("steady-state: PodScheduled conditions MISMATCH vs serial twin")
+
+    pack_warm = float(np.median(packs))
+    wall_sum = float(np.sum(walls))
+    occupancy = float(np.sum(busys)) / wall_sum if wall_sum > 0 else 0.0
+    steady_pps = float(np.sum(bound_counts)) / wall_sum if wall_sum else 0.0
+    speedup = pack_cold / pack_warm if pack_warm > 0 else 0.0
+    log(f"steady state: {steady_pps:,.0f} pods/s end-to-end over {rounds} "
+        f"rounds (median cycle {float(np.median(walls))*1000:.1f}ms); pack "
+        f"warm {pack_warm*1000:.1f}ms vs cold {pack_cold*1000:.1f}ms -> "
+        f"{speedup:.1f}x; device occupancy {occupancy:.0%}; serial parity "
+        f"{'OK' if parity_ok else 'MISMATCH'}")
+    cs = sched_p.snapshot_cache.stats if sched_p.snapshot_cache else {}
+    if cs:
+        log(f"steady-state snapshot cache: {cs}")
+    return {
+        "steady_state_pods_per_sec": round(steady_pps, 1),
+        "pack_seconds_warm": round(pack_warm, 4),
+        "pack_seconds_cold": round(pack_cold, 4),
+        "pack_warm_speedup": round(speedup, 2),
+        "pipeline_occupancy": round(occupancy, 3),
+        "pipeline_parity_ok": parity_ok,
+        "pipeline_enabled": pipeline.enabled,
+        "steady_rows_reused": int(cs.get("pod_row_hits", 0)),
+        "steady_rows_repacked": int(cs.get("pod_row_misses", 0)),
+    }
+
+
 def run_full_chain(args_cli, num_pods: int, num_nodes: int,
                    variant: str = "full") -> None:
     import jax
@@ -837,12 +963,19 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int,
     vs_compiled = tpu_pps / compiled_pps if compiled_pps > 0 else 0.0
     vs_python = tpu_pps / python_pps if python_pps > 0 else 0.0
     # end-to-end scheduler time: host pack + full snapshot upload + step.
-    # This is the cold-path bound; the steady-state cycle applies store
-    # deltas instead of a full rebuild (snapshot_cache), so the true cycle
-    # sits between end_to_end and the kernel-only headline.
+    # This is the COLD-path bound; the warm steady-state loop below runs
+    # real pipelined cycles against store deltas and reports what a cycle
+    # costs once the cluster is warm.
     e2e_pps = pods.num_valid / (t_pack + t_upload + t_batch)
     log(f"end-to-end (pack {t_pack:.3f}s + upload {t_upload:.3f}s + step "
         f"{t_batch:.3f}s): {e2e_pps:,.0f} pods/s")
+    steady = {}
+    if variant == "full":
+        try:
+            steady = run_steady_state(args_cli, num_pods, num_nodes)
+        except Exception as e:  # the cold numbers must still ship
+            log(f"steady-state loop failed: {e!r}")
+            steady = {"steady_state_error": repr(e)[:200]}
     suffix = {"numa": "numa", "quota-gang": "quota_gang"}.get(
         variant, "full_chain")
     print(
@@ -870,6 +1003,7 @@ def run_full_chain(args_cli, num_pods: int, num_nodes: int,
                 "vs_compiled_floor_marginal": round(
                     marginal_pps / compiled_pps if compiled_pps else 0.0, 2),
                 "platform": jax.default_backend(),
+                **steady,
             }
         )
     )

@@ -13,6 +13,12 @@ python -m koordinator_tpu.analysis koordinator_tpu bench.py
 echo "== compileall =="
 python -m compileall -q koordinator_tpu bench.py tests hack/microbench.py
 
+echo "== serial-vs-pipelined cycle parity =="
+# same store fixture through the strictly serial path and the CyclePipeline:
+# bindings, failure sets and PodScheduled conditions must be byte-identical
+# (tier-1 runs the same fixture via tests/test_cycle_pipeline.py)
+JAX_PLATFORMS=cpu python -m koordinator_tpu.scheduler.pipeline_parity
+
 echo "== obs trace schema (golden fixture) =="
 # the CLI exits non-zero on any schema drift against the checked-in trace;
 # a deliberate format change must regenerate the fixture AND bump

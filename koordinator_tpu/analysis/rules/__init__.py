@@ -4,5 +4,6 @@ from koordinator_tpu.analysis.rules import (  # noqa: F401
     concurrency,
     jaxtrace,
     loops,
+    pipeline,
     wire,
 )

@@ -1,0 +1,87 @@
+"""Pipelined-cycle readback discipline.
+
+The cycle pipeline (scheduler/cycle.py CyclePipeline) exists because
+``np.asarray`` on a device value is a host-blocking sync: the serial path
+used to block the host for the whole kernel duration doing nothing. The
+overlap only survives if the pipelined region keeps a SINGLE designated
+sync point. This rule flags ``np.asarray`` / ``block_until_ready`` calls
+lexically inside scheduler/cycle.py's pipelined region — the bodies of
+``tracer.span("kernel")`` / ``tracer.span("overlap_wait")`` blocks —
+unless the line carries a ``# koordlint: disable`` pragma documenting why
+that sync is intended. A drive-by readback added "for debugging" would
+silently serialize the pipeline again; with this rule it cannot land
+without a visible pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from koordinator_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    Rule,
+    register,
+)
+
+# the pipelined region lives in the cycle driver only
+_CYCLE_PATH_RE = re.compile(r"scheduler/cycle\.py$")
+
+# span names whose with-bodies form the pipelined region (dispatch ..
+# readback): host code here runs while the device executes
+_REGION_SPANS = {"kernel", "overlap_wait"}
+
+_BLOCKING_TAILS = {"asarray", "block_until_ready"}
+
+
+def _dotted_tail(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _is_region_item(item: ast.withitem) -> bool:
+    call = item.context_expr
+    return (isinstance(call, ast.Call)
+            and _dotted_tail(call.func) == "span"
+            and bool(call.args)
+            and isinstance(call.args[0], ast.Constant)
+            and call.args[0].value in _REGION_SPANS)
+
+
+@register
+class BlockingReadbackInPipeline(Rule):
+    name = "blocking-readback-in-pipeline"
+    severity = "error"
+    description = (
+        "np.asarray / block_until_ready inside scheduler/cycle.py's "
+        "pipelined region (the span(\"kernel\")/span(\"overlap_wait\") "
+        "bodies) without a pragma: every readback is a host-blocking "
+        "device sync, and an undeclared one silently re-serializes the "
+        "cycle pipeline; keep the single designated sync point or mark "
+        "the new one with # koordlint: disable")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not _CYCLE_PATH_RE.search(ctx.path):
+            return
+        seen = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.With):
+                continue
+            if not any(_is_region_item(item) for item in node.items):
+                continue
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Call)
+                        and _dotted_tail(sub.func) in _BLOCKING_TAILS
+                        and id(sub) not in seen):
+                    seen.add(id(sub))
+                    yield self.finding(
+                        ctx, sub,
+                        f"{_dotted_tail(sub.func)} blocks the host inside "
+                        "the pipelined kernel region — the overlap dies "
+                        "silently; move it past the designated sync point "
+                        "or annotate the intent with a pragma")

@@ -87,7 +87,16 @@ class ObjectMeta:
 
     @property
     def key(self) -> str:
-        return f"{self.namespace}/{self.name}"
+        # memoized: the packed-snapshot path reads keys tens of thousands
+        # of times per cycle. Identity-checked against name/namespace so a
+        # rebound field (tests mutate metas in place) recomputes.
+        cached = self.__dict__.get("_key_memo")
+        if (cached is not None and cached[0] is self.name
+                and cached[1] is self.namespace):
+            return cached[2]
+        k = f"{self.namespace}/{self.name}"
+        self.__dict__["_key_memo"] = (self.name, self.namespace, k)
+        return k
 
 
 @dataclass

@@ -70,6 +70,17 @@ class PodBatch:
     # (term/slot budget overflow) — the cycle driver surfaces these as
     # first-class failure events instead of a generic "no feasible node"
     unschedulable_reasons: Dict[int, str] = field(default_factory=dict)
+    # incremental-pack bookkeeping (cache builds only): row i was gathered
+    # from row reused_src[i] of the previous build's memo (-1 = repacked
+    # from the object). Downstream per-pod loops (snapshot.py flags/masks)
+    # use the same mapping to gather THEIR cached columns.
+    reused_src: Optional[np.ndarray] = None          # [num_valid] int64
+    gang_keys: Optional[np.ndarray] = None           # [num_valid] object, "" = none
+    quota_names: Optional[np.ndarray] = None         # [num_valid] object, "" = none
+    # the pod objects in packed (queue) order — lets the snapshot builder
+    # index pods without re-walking key properties; NOT retained across
+    # cycles (the batch itself is cycle-local)
+    objs: Optional[List[Pod]] = None
 
     @property
     def num_valid(self) -> int:
@@ -117,11 +128,23 @@ def pack_pods(
     gang's creation time and name, so a gang schedules contiguously instead
     of interleaving with unrelated pods — then pod creation time asc, key
     asc. ``gang_sort`` maps gang name -> (gang creation time, gang key);
-    gangless pods (and unknown gangs) group as themselves."""
-    gang_sort = gang_sort or {}
+    gangless pods (and unknown gangs) group as themselves.
 
-    def queue_key(i):
-        pod = pods[i]
+    With a SnapshotCache attached, packing is INCREMENTAL: the previous
+    build's packed rows (and queue-key tuples) live in ``cache.pack_memo``
+    keyed by (pod key, resourceVersion); rows whose source object did not
+    change are gathered with batched fancy indexing — one numpy op per
+    field — and only dirty rows pay the per-object Python fill. The cached
+    path produces bit-identical arrays to the cold path (the memo stores
+    exactly the rows the cold fill writes)."""
+    gang_sort = gang_sort or {}
+    n_in = len(pods)
+    prev = cache.pack_memo if cache is not None else None
+    # cached queue-key tuples are only valid if the gang grouping map they
+    # were built with is unchanged (gang creation/identity feeds the order)
+    same_gs = prev is not None and prev["gang_sort"] == gang_sort
+
+    def queue_key_of(pod):
         group_time, group_key = gang_sort.get(
             pod.gang_key,
             (pod.meta.creation_timestamp, pod.meta.key),
@@ -135,10 +158,44 @@ def pack_pods(
             pod.meta.key,
         )
 
-    order = sorted(range(len(pods)), key=queue_key)
+    # one pass: key/rv lookup against the memo + queue-key tuples (cached
+    # tuples reused; this loop is the only O(P) Python the warm path pays).
+    # rv/qk live as plain Python lists — per-element numpy scalar reads
+    # would triple the loop's cost.
+    keys_in: List[str] = [None] * n_in
+    rvs_in: List[int] = [0] * n_in
+    src_in = np.full(n_in, -1, np.int64)
+    qk_in: List[tuple] = [None] * n_in
+    if prev is not None:
+        row_of_get = prev["row_of"].get
+        prev_rv = prev["rv"]
+        prev_qk = prev["qk"]
+        for i, pod in enumerate(pods):
+            meta = pod.meta
+            k = meta.key
+            rv = meta.resource_version
+            keys_in[i] = k
+            rvs_in[i] = rv
+            j = row_of_get(k)
+            if j is not None and prev_rv[j] == rv:
+                src_in[i] = j
+                if same_gs:
+                    qk_in[i] = prev_qk[j]
+                    continue
+            qk_in[i] = queue_key_of(pod)
+    else:
+        for i, pod in enumerate(pods):
+            meta = pod.meta
+            keys_in[i] = meta.key
+            rvs_in[i] = meta.resource_version
+            qk_in[i] = queue_key_of(pod)
+    order = sorted(range(n_in), key=qk_in.__getitem__)
     pods = [pods[i] for i in order]
-    n = len(pods)
+    n = n_in
     p = pad_to or bucket_size(n)
+    order_np = np.asarray(order, np.int64) if n else np.zeros(0, np.int64)
+    src = src_in[order_np]
+    keys_arr = [keys_in[i] for i in order]
     # wire-unit matrices filled in one pass (no per-pod vector allocations),
     # packed with a single vectorized scale
     req_wire = np.zeros((p, NUM_RESOURCES), np.float64)
@@ -152,63 +209,79 @@ def pack_pods(
     quota = np.full(p, -1, np.int32)
     valid = np.zeros(p, bool)
     est = np.zeros((p, NUM_RESOURCES), np.float32)
-    # per-pod packed rows memoized by (key, resourceVersion) when a
-    # SnapshotCache rides along (scheduler/snapshot_cache.py): pods carried
-    # over between cycles skip the wire fill, the QoS/priority resolution
-    # AND the estimator (row-wise, so per-row caching is exact)
-    misses = []
-    for i, pod in enumerate(pods):
-        hit = cache.pod_row(pod) if cache is not None else None
-        if hit is not None:
-            req_wire[i] = hit["req_wire"]
-            lim_wire[i] = hit["lim_wire"]
-            prio[i] = hit["prio"]
-            qos[i] = hit["qos"]
-            pcls[i] = hit["pcls"]
-            prod[i] = hit["prod"]
-            ds[i] = hit["ds"]
-            est[i] = hit["est"]
-        else:
-            misses.append(i)
-            pod.spec.requests.fill_wire_row(req_wire[i])
-            pod.spec.limits.fill_wire_row(lim_wire[i])
-            prio[i] = pod.spec.priority or 0
-            qos[i] = int(pod.qos_class)
-            cls = pod.priority_class
-            pcls[i] = int(cls)
-            # GetPodPriorityClassWithDefault: pods outside koordinator bands
-            # default to PROD semantics in LoadAware's prod checks
-            prod[i] = cls in (PriorityClass.PROD, PriorityClass.NONE)
-            ds[i] = pod.meta.owner_kind == "DaemonSet"
-        if gang_ids and pod.gang_name:
-            gang[i] = gang_ids.get(pod.gang_key, -1)
-        if quota_ids and pod.quota_name:
-            quota[i] = quota_ids.get(pod.quota_name, -1)
-        valid[i] = True
+    gang_col = np.full(n, "", object)
+    quota_col = np.full(n, "", object)
+    hit = np.nonzero(src >= 0)[0]
+    if hit.size:
+        hsrc = src[hit]
+        req_wire[hit] = prev["req_wire"][hsrc]
+        lim_wire[hit] = prev["lim_wire"][hsrc]
+        prio[hit] = prev["prio"][hsrc]
+        qos[hit] = prev["qos"][hsrc]
+        pcls[hit] = prev["pcls"][hsrc]
+        prod[hit] = prev["prod"][hsrc]
+        ds[hit] = prev["ds"][hsrc]
+        est[hit] = prev["est"][hsrc]
+        gang_col[hit] = prev["gang_key"][hsrc]
+        quota_col[hit] = prev["quota_name"][hsrc]
+    misses = np.nonzero(src < 0)[0]
+    for i in misses:
+        pod = pods[i]
+        pod.spec.requests.fill_wire_row(req_wire[i])
+        pod.spec.limits.fill_wire_row(lim_wire[i])
+        prio[i] = pod.spec.priority or 0
+        qos[i] = int(pod.qos_class)
+        cls = pod.priority_class
+        pcls[i] = int(cls)
+        # GetPodPriorityClassWithDefault: pods outside koordinator bands
+        # default to PROD semantics in LoadAware's prod checks
+        prod[i] = cls in (PriorityClass.PROD, PriorityClass.NONE)
+        ds[i] = pod.meta.owner_kind == "DaemonSet"
+        gang_col[i] = pod.gang_key
+        quota_col[i] = pod.quota_name
+    valid[:n] = True
+    # gang/quota id resolution: unique-name factorization instead of a
+    # per-pod dict lookup (the id maps are small; the columns are cached)
+    if gang_ids is not None:
+        fill_ids_from_names(gang, gang_col, gang_ids)
+    if quota_ids is not None:
+        fill_ids_from_names(quota, quota_col, quota_ids)
     req = (req_wire / PACK_SCALE).astype(np.float32)
     lim = (lim_wire / PACK_SCALE).astype(np.float32)
     # estimate only rows not served from the cache: padding must carry
     # zeros, never the 250-milli/200-MiB defaults the estimator assigns
     # empty requests
     if cache is None:
-        est[:n] = estimate_pods_used_batch(
-            req[:n], lim[:n], pcls[:n], resource_weights, scaling_factors
-        )
-    elif misses:
-        mi = np.asarray(misses)
-        est[mi] = estimate_pods_used_batch(
-            req[mi], lim[mi], pcls[mi], resource_weights, scaling_factors
+        if n:
+            est[:n] = estimate_pods_used_batch(
+                req[:n], lim[:n], pcls[:n], resource_weights, scaling_factors
+            )
+    elif misses.size:
+        est[misses] = estimate_pods_used_batch(
+            req[misses], lim[misses], pcls[misses],
+            resource_weights, scaling_factors
         )
     if cache is not None:
-        for i in misses:
-            cache.put_pod_row(pods[i], {
-                "req_wire": req_wire[i].copy(), "lim_wire": lim_wire[i].copy(),
-                "prio": int(prio[i]), "qos": int(qos[i]),
-                "pcls": int(pcls[i]), "prod": bool(prod[i]),
-                "ds": bool(ds[i]), "est": est[i].copy(),
-            })
+        cache.stats["pod_row_hits"] += int(hit.size)
+        cache.stats["pod_row_misses"] += int(misses.size)
+        # rotate the memo: the OLD one stays visible (pack_memo_prev) so
+        # build_full_chain_inputs can gather its flag/mask columns with the
+        # same reused_src mapping before storing the new columns
+        cache.pack_memo_prev = prev
+        cache.pack_memo = {
+            "gang_sort": dict(gang_sort),
+            "row_of": {k: i for i, k in enumerate(keys_arr)},
+            "rv": [rvs_in[i] for i in order],
+            "qk": [qk_in[i] for i in order],
+            "req_wire": req_wire[:n].copy(),
+            "lim_wire": lim_wire[:n].copy(),
+            "prio": prio[:n].copy(), "qos": qos[:n].copy(),
+            "pcls": pcls[:n].copy(), "prod": prod[:n].copy(),
+            "ds": ds[:n].copy(), "est": est[:n].copy(),
+            "gang_key": gang_col.copy(), "quota_name": quota_col.copy(),
+        }
     return PodBatch(
-        keys=[pd.meta.key for pd in pods],
+        keys=keys_arr,
         requests=req,
         estimated=est,
         priority=prio,
@@ -219,7 +292,25 @@ def pack_pods(
         gang_id=gang,
         quota_id=quota,
         valid=valid,
+        reused_src=src if cache is not None else None,
+        gang_keys=gang_col,
+        quota_names=quota_col,
+        objs=pods,
     )
+
+
+def fill_ids_from_names(out: np.ndarray, names: np.ndarray,
+                         id_map: Dict[str, int]) -> None:
+    """out[i] = id_map.get(names[i], -1) for named rows, vectorized through
+    a unique-name factorization ("" rows keep -1)."""
+    if not names.size or not id_map:
+        return
+    named = np.nonzero(names != "")[0]
+    if not named.size:
+        return
+    uniq, inv = np.unique(names[named].astype(str), return_inverse=True)
+    ids = np.asarray([id_map.get(u, -1) for u in uniq], np.int32)
+    out[named] = ids[inv]
 
 
 def pack_nodes(

@@ -103,7 +103,7 @@ def _terms_of(pod) -> List[Term]:
     return out
 
 
-def build_affinity_state(pending_pods, nodes, existing_pods):
+def build_affinity_state(pending_pods, nodes, existing_pods, rows=None):
     """-> (terms, ids, aff_dom [N, T] f32, aff_count [N, T] f32,
            anti_cover [N, T] f32, aff_exists [T] bool,
            aff_req [P_valid, T] bool, anti_req [P_valid, T] bool,
@@ -123,11 +123,19 @@ def build_affinity_state(pending_pods, nodes, existing_pods):
     unlabeled nodes. Row i of the pod arrays corresponds to
     pending_pods[i]; the caller pads. overflow_pod_idx lists pending pods
     whose terms did not fit MAX_TERMS — they must be marked unschedulable.
+
+    rows: optional indices of pending pods that carry ANY (anti-)affinity /
+    spread / preferred-pod-affinity spec — term extraction loops restrict
+    to them (a spec-less pod can contribute no term, so the restriction is
+    exact); matching against interned terms still scans every pod.
     """
+    if rows is None:
+        rows = range(len(pending_pods))
     terms: List[Term] = []
     ids = {}
     overflow_pods: List[int] = []
-    for i, pod in enumerate(pending_pods):
+    for i in rows:
+        pod = pending_pods[i]
         fits = True
         for term in _terms_of(pod):
             if term in ids:
@@ -180,7 +188,8 @@ def build_affinity_state(pending_pods, nodes, existing_pods):
     # scores read the same domain counts); budget overflow here only drops
     # the preference — soft scoring degrades, never blocks
     pref_dropped = 0
-    for pod in pending_pods:
+    for i in rows:
+        pod = pending_pods[i]
         soft_keys = [_term_key(raw, pod)
                      for raw in pod.spec.pod_affinity_preferred]
         soft_keys += [_spread_key(con, pod)
@@ -291,7 +300,7 @@ def build_affinity_state(pending_pods, nodes, existing_pods):
 MAX_PREF_PROFILES = 32
 
 
-def build_preferred_scores(pending_pods, nodes):
+def build_preferred_scores(pending_pods, nodes, rows=None):
     """preferredDuringScheduling node affinity, profile-bucketed:
 
     -> (pref_rows [max(S, 1), N] f32, pod_pref_id [P_valid] int32)
@@ -309,7 +318,8 @@ def build_preferred_scores(pending_pods, nodes):
     P = len(pending_pods)
     pod_pref_id = np.full(P, -1, np.int32)
     dropped = 0
-    for i, pod in enumerate(pending_pods):
+    for i in (rows if rows is not None else range(P)):
+        pod = pending_pods[i]
         terms = tuple(
             (int(t.weight), frozenset(t.labels.items()))
             for t in pod.spec.affinity_preferred if t.labels
@@ -360,7 +370,8 @@ def build_preferred_scores(pending_pods, nodes):
 MAX_PPREF_PROFILES = 16
 
 
-def build_preferred_pod_profiles(pending_pods, term_ids: dict, T: int):
+def build_preferred_pod_profiles(pending_pods, term_ids: dict, T: int,
+                                 rows=None):
     """preferredDuringScheduling POD affinity, profile-bucketed over the
     SHARED term space (the counts the required terms maintain are exactly
     the weighted sum's inputs; build_affinity_state interned the terms):
@@ -378,8 +389,11 @@ def build_preferred_pod_profiles(pending_pods, term_ids: dict, T: int):
     profiles: List[tuple] = []
     ids: dict = {}
     dropped = 0
-    per_pod_terms: List[List[tuple]] = []
-    for pod in pending_pods:
+    # spec-less pods contribute no entries; with `rows` (indices of pods
+    # carrying any affinity/spread spec) only those rows pay the extraction
+    per_pod_terms: List[List[tuple]] = [[] for _ in range(P)]
+    for i in (rows if rows is not None else range(P)):
+        pod = pending_pods[i]
         entries = []
         for raw in pod.spec.pod_affinity_preferred:
             t = term_ids.get(_term_key(raw, pod))
@@ -399,7 +413,7 @@ def build_preferred_pod_profiles(pending_pods, term_ids: dict, T: int):
             t = term_ids.get(_spread_key(con, pod))
             if t is not None:
                 entries.append((-1, t))
-        per_pod_terms.append(entries)
+        per_pod_terms[i] = entries
     for i, entries in enumerate(per_pod_terms):
         if not entries:
             continue

@@ -39,17 +39,24 @@ def _slots_of(pod) -> List[Slot]:
     return [(proto or "TCP", int(port)) for proto, port in pod.spec.host_ports]
 
 
-def build_port_state(pending_pods, nodes, existing_pods):
+def build_port_state(pending_pods, nodes, existing_pods, rows=None):
     """-> (slots, port_used [N, PT] f32, wants [P, PT] bool,
            overflow_pod_idx list[int])
 
     existing_pods: assigned non-terminated pods; their hostPorts seed
     port_used on their nodes (only for slots the pending batch requests —
-    other ports can never conflict with this batch)."""
+    other ports can never conflict with this batch).
+
+    rows: optional indices of pending pods that declare hostPorts — the
+    extraction loops restrict to them (portless pods contribute no slot
+    and want nothing, so the restriction is exact)."""
+    if rows is None:
+        rows = range(len(pending_pods))
     slots: List[Slot] = []
     ids = {}
     overflow: List[int] = []
-    for i, pod in enumerate(pending_pods):
+    for i in rows:
+        pod = pending_pods[i]
         fits = True
         for slot in _slots_of(pod):
             if slot in ids:
@@ -81,7 +88,8 @@ def build_port_state(pending_pods, nodes, existing_pods):
             s = ids.get(slot)
             if s is not None:
                 port_used[n, s] = 1.0
-    for i, pod in enumerate(pending_pods):
+    for i in rows:
+        pod = pending_pods[i]
         for slot in _slots_of(pod):
             s = ids.get(slot)
             if s is not None:
@@ -96,7 +104,7 @@ _MIN_IMG = 23 * 1024 * 1024      # minThreshold: 23 MiB
 _MAX_IMG = 1000 * 1024 * 1024    # maxContainerThreshold: 1000 MiB
 
 
-def build_image_scores(pending_pods, nodes):
+def build_image_scores(pending_pods, nodes, rows=None):
     """ImageLocality score rows, profile-bucketed like preferred affinity:
 
     -> (img_rows [max(SI, 1), N] f32, pod_img_id [P] int32)
@@ -115,7 +123,8 @@ def build_image_scores(pending_pods, nodes):
     N = len(nodes)
     pod_img_id = np.full(P, -1, np.int32)
     dropped = 0
-    for i, pod in enumerate(pending_pods):
+    for i in (rows if rows is not None else range(P)):
+        pod = pending_pods[i]
         imgs = tuple(sorted(set(pod.spec.images)))
         if not imgs:
             continue

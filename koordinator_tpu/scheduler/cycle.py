@@ -70,6 +70,11 @@ from koordinator_tpu.scheduler.snapshot import (
 
 RESERVATION_POD_PREFIX = "__reservation__/"
 
+# failure reasons whose condition message is recomputed from the packed
+# batch (scheduler/diagnose.py); the deferral path keeps the batch alive
+# only when one of these is present — the two sites must stay in sync
+DIAGNOSED_REASONS = ("no feasible node", "admission rejected")
+
 
 class Scheduler:
     """koord-scheduler analog: batched cycles against the object store."""
@@ -164,6 +169,16 @@ class Scheduler:
         self._sidecar_client = (
             SidecarClient(sidecar_address) if sidecar_address else None)
         self.sidecar_fallbacks = 0
+        # pipelined-cycle mode (CyclePipeline): the kernel dispatch is
+        # non-blocking and diagnose/condition writes for unbound pods are
+        # deferred into the NEXT cycle's kernel window so host work
+        # overlaps device execution. Off by default — plain run_cycle
+        # callers keep the strictly serial path.
+        self.pipeline_mode = False
+        self._deferred_diagnose: List[Tuple[list, object, float]] = []
+        self._flushed_this_cycle = False
+        # last DeviceSnapshot stats snapshot, for counter deltas
+        self._upload_stats_last: Dict[str, int] = {}
         # incremental snapshot packing (SURVEY 7: caches become
         # device-resident arrays updated by deltas) — event-driven memos
         # replacing the per-cycle cluster walks; gate off for the
@@ -398,6 +413,8 @@ class Scheduler:
         if self.elector is not None and not self.elector.tick(now):
             return CycleResult(skipped_not_leader=True)
         result = CycleResult()
+        carried_deferred = bool(self._deferred_diagnose)
+        self._flushed_this_cycle = False
         # root span: the ONE place the cycle duration is stamped. Every
         # early-return path inside the traced body (empty queue, pre-pass
         # binds everything, full pass) exits through the span's finally,
@@ -405,6 +422,15 @@ class Scheduler:
         # assignment pattern broke exactly that way.
         with self.tracer.span("cycle") as root:
             self._run_cycle_traced(now, result)
+            # a cycle with no local kernel window (empty queue, sidecar
+            # path) never reached the overlap flush: drain carried-over
+            # deferred writes here so they cannot linger unboundedly —
+            # without device work to overlap, flushing now IS the serial
+            # timing
+            if (self.pipeline_mode and carried_deferred
+                    and not self._flushed_this_cycle
+                    and self._deferred_diagnose):
+                self.flush_deferred()
         result.duration_seconds = root.duration_seconds
         scheduler_metrics.CYCLE_SECONDS.observe(result.duration_seconds)
         if result.bound:
@@ -595,15 +621,43 @@ class Scheduler:
             (p, "admission rejected") for p in rejected_pods]
         if not items:
             return
+        if self.pipeline_mode:
+            # pipelined cycle: the writes run inside the NEXT cycle's
+            # kernel window (flush_deferred), overlapping device work.
+            # `now` and the packed batch are captured here, so the
+            # diagnosis content is byte-identical to the serial path.
+            # Only generic kernel rejections consult the packed batch —
+            # drop it when no item needs it, so a deferred entry does not
+            # pin the fc arrays the `_last_batch = None` release below
+            # exists to free. (Streaming use bounds the pinning to one
+            # cycle anyway: the next kernel window or a kernel-less cycle
+            # drains the queue; idle drivers must call flush().)
+            if not any(r in DIAGNOSED_REASONS for _p, r in items):
+                last = None
+            self._deferred_diagnose.append((items, last, now))
+            return
         with self.tracer.span("diagnose", pods=str(len(items))):
             self._diagnose_and_write(items, last, now)
 
-    def _diagnose_and_write(self, items, last, now: float) -> None:
+    def flush_deferred(self) -> None:
+        """Drain deferred diagnose/condition work (pipeline mode). Runs in
+        the next cycle's kernel window — host work the device never waits
+        on — and from CyclePipeline.flush() at end of stream. FIFO order
+        preserves the serial path's write sequence when a pod accumulates
+        verdicts across cycles."""
+        self._flushed_this_cycle = True
+        while self._deferred_diagnose:
+            items, last, now = self._deferred_diagnose.pop(0)
+            with self.tracer.span("diagnose", pods=str(len(items)),
+                                  deferred="1"):
+                self._diagnose_and_write(items, last, now, deferred=True)
+
+    def _diagnose_and_write(self, items, last, now: float,
+                            deferred: bool = False) -> None:
         shared = None  # node-level diagnosis state, built once per cycle
         for pod, reason in items:
             msg = reason
-            if last is not None and reason in (
-                    "no feasible node", "admission rejected"):
+            if last is not None and reason in DIAGNOSED_REASONS:
                 fc, index, n_nodes = last
                 j = index.get(pod.meta.key)
                 if j is not None:
@@ -624,6 +678,29 @@ class Scheduler:
             stored = self.store.get(KIND_POD, pod.meta.key)
             if stored is None:  # reservation pseudo-pods, raced deletions
                 continue
+            if deferred:
+                # the flush runs after later store activity; two ways the
+                # verdict can be superseded, both of which the serial path
+                # resolved by writing BEFORE that activity:
+                #  * the pod was bound (next cycle's nomination pre-pass):
+                #    serial's transient False was overwritten by the
+                #    bind's PodScheduled=True — skipping converges;
+                #  * the pod was deleted and RECREATED under the same key
+                #    (stable StatefulSet-style names, fresh uid): serial
+                #    stamped the old incarnation; the new pod must wait
+                #    for its own verdict.
+                if stored.is_assigned:
+                    continue
+                if (stored.meta.uid and pod.meta.uid
+                        and stored.meta.uid != pod.meta.uid):
+                    continue
+                # uid-less objects (bare test fixtures): creation time is
+                # the remaining identity signal — a recreated incarnation
+                # carries a fresh timestamp, the same incarnation never
+                # changes its own
+                if (stored.meta.creation_timestamp
+                        != pod.meta.creation_timestamp):
+                    continue
             cur = stored.get_condition("PodScheduled")
             if cur is not None and (cur.status, cur.message) == ("False", msg):
                 continue
@@ -659,10 +736,22 @@ class Scheduler:
         if not state.nodes:
             return rejected_pods, [(p, "no schedulable node") for p in pending]
         with self.tracer.span("encode"):
-            fc, pods, nodes, tree, gang_index, ng, ngroups = (
-                build_full_chain_inputs(
-                    state, self.args, cache=self.snapshot_cache
-                ))
+            cs = (self.snapshot_cache.stats
+                  if self.snapshot_cache is not None else None)
+            hits0 = cs["pod_row_hits"] if cs is not None else 0
+            miss0 = cs["pod_row_misses"] if cs is not None else 0
+            with self.tracer.span("pack_incremental") as pis:
+                fc, pods, nodes, tree, gang_index, ng, ngroups = (
+                    build_full_chain_inputs(
+                        state, self.args, cache=self.snapshot_cache
+                    ))
+            if cs is not None:
+                reused = cs["pod_row_hits"] - hits0
+                repacked = cs["pod_row_misses"] - miss0
+                pis.attributes["rows_reused"] = str(reused)
+                pis.attributes["rows_repacked"] = str(repacked)
+                scheduler_metrics.PACK_ROWS_REUSED.inc(reused)
+                scheduler_metrics.PACK_ROWS_REPACKED.inc(repacked)
             # stash the admission grouping this kernel pass used so
             # host-side dry-runs (DefaultPreemption) consult the SAME
             # encoding — the raw label check can be more permissive when
@@ -703,6 +792,10 @@ class Scheduler:
                 )
                 if used_fallback:
                     self.sidecar_fallbacks += 1
+                # remote RPC: the call blocked already; asarray is a no-op
+                # copy of host data, not a device sync
+                # koordlint: disable=blocking-readback-in-pipeline
+                chosen = np.asarray(chosen)
             else:
                 if self.device_snapshot is not None:
                     # device-resident steady state: unchanged fields reuse
@@ -710,8 +803,39 @@ class Scheduler:
                     # deltas go up as donated scatters
                     # (snapshot_cache.DeviceSnapshot)
                     fc = self.device_snapshot.upload(fc)
-                chosen, _, _ = step(fc)
-            chosen = np.asarray(chosen)
+                    # counter deltas against the cumulative snapshot stats
+                    ds = self.device_snapshot.stats
+                    prev_ds = self._upload_stats_last
+                    for key, counter in (
+                        ("reused", scheduler_metrics.UPLOAD_FIELDS_REUSED),
+                        ("scattered",
+                         scheduler_metrics.UPLOAD_FIELDS_SCATTERED),
+                        ("put", scheduler_metrics.UPLOAD_FIELDS_PUT),
+                        ("bytes_scattered",
+                         scheduler_metrics.UPLOAD_BYTES_SCATTERED),
+                        ("bytes_put", scheduler_metrics.UPLOAD_BYTES_PUT),
+                    ):
+                        counter.inc(ds[key] - prev_ds.get(key, 0))
+                    self._upload_stats_last = dict(ds)
+                t_dispatch = time.perf_counter()
+                chosen, _, _ = step(fc)  # async dispatch — no host sync yet
+                if self.pipeline_mode:
+                    # overlap window: the previous cycle's deferred host
+                    # work (unschedulability diagnosis + condition writes)
+                    # runs while the device executes this cycle's kernel
+                    self.flush_deferred()
+                    with self.tracer.span("overlap_wait"):
+                        # the pipeline's single designated sync point:
+                        # bind needs the chosen vector, nothing before does
+                        # koordlint: disable=blocking-readback-in-pipeline
+                        chosen = np.asarray(chosen)
+                else:
+                    # serial path: block immediately (the pre-pipeline
+                    # behavior, and the KOORD_TPU_PIPELINE=0 fallback)
+                    # koordlint: disable=blocking-readback-in-pipeline
+                    chosen = np.asarray(chosen)
+                result.device_busy_seconds += (
+                    time.perf_counter() - t_dispatch)
         result.kernel_seconds += ksp.duration_seconds
         scheduler_metrics.KERNEL_SECONDS.observe(ksp.duration_seconds)
 
@@ -795,3 +919,57 @@ class Scheduler:
                 prebind.apply_patch(pod, node_name, annotations, now=ctx.now)
         result.bound.append(BindResult(pod.meta.key, node_name, annotations))
         return None
+
+
+# ---------------------------------------------------------------------------
+# pipelined cycle driver
+# ---------------------------------------------------------------------------
+
+def pipeline_enabled_from_env() -> bool:
+    """KOORD_TPU_PIPELINE=0 restores the strictly serial cycle."""
+    import os
+
+    return os.environ.get("KOORD_TPU_PIPELINE", "1") != "0"
+
+
+class CyclePipeline:
+    """Pipelined cycle driver: overlap host work with device execution.
+
+    Wraps a Scheduler for a STREAM of cycles (the input-pipeline shape from
+    the training world — keep the accelerator fed). Three hand-off points
+    change relative to the serial path, none of which changes results:
+
+      1. the kernel dispatch is non-blocking — no ``np.asarray`` readback
+         until the bind loop actually needs the chosen vector;
+      2. unschedulability diagnosis + PodScheduled condition writes for
+         cycle N run inside cycle N+1's kernel window (``flush_deferred``),
+         while the device executes — content is captured at cycle N (same
+         packed batch, same ``now``), so the writes are byte-identical;
+      3. ``flush()`` drains whatever the last cycle deferred.
+
+    Bind order, CRD writes and unschedulable conditions end up byte-for-
+    byte what the serial path produces (tests/test_cycle_pipeline.py runs
+    both paths over the same fixture and diffs the store).
+    ``KOORD_TPU_PIPELINE=0`` (or ``enabled=False``) falls back to the
+    serial single-threaded path exactly.
+    """
+
+    def __init__(self, scheduler: Scheduler,
+                 enabled: Optional[bool] = None) -> None:
+        self.scheduler = scheduler
+        self.enabled = (pipeline_enabled_from_env()
+                        if enabled is None else bool(enabled))
+        scheduler.pipeline_mode = self.enabled
+
+    def run_cycle(self, now: Optional[float] = None) -> CycleResult:
+        return self.scheduler.run_cycle(now=now)
+
+    def flush(self) -> None:
+        """Drain deferred condition writes (call at end of stream)."""
+        self.scheduler.flush_deferred()
+
+    def __enter__(self) -> "CyclePipeline":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.flush()

@@ -61,3 +61,40 @@ PODS_BOUND_TOTAL = REGISTRY.counter(
     "koord_scheduler_pods_bound_total",
     "Pods bound across all cycles",
 )
+
+# incremental-pack row traffic: steady state should be nearly all reused;
+# a repack surge means the store is churning (or a cache regression)
+PACK_ROWS_REUSED = REGISTRY.counter(
+    "koord_scheduler_pack_rows_reused_total",
+    "Packed pod rows gathered unchanged from the previous build",
+)
+PACK_ROWS_REPACKED = REGISTRY.counter(
+    "koord_scheduler_pack_rows_repacked_total",
+    "Packed pod rows rebuilt from the object (new/changed pods)",
+)
+
+# DeviceSnapshot upload traffic (scheduler/snapshot_cache.DeviceSnapshot
+# stats, fed as per-cycle counter deltas by the cycle driver): an upload
+# regression — reuse collapsing into full puts — shows up in /metrics,
+# not just bench runs. Counters, so rate()/increase() behave across
+# process restarts.
+UPLOAD_FIELDS_REUSED = REGISTRY.counter(
+    "koord_scheduler_upload_fields_reused_total",
+    "Device-snapshot fields reused without any transfer",
+)
+UPLOAD_FIELDS_SCATTERED = REGISTRY.counter(
+    "koord_scheduler_upload_fields_scattered_total",
+    "Device-snapshot fields updated by donated row scatters",
+)
+UPLOAD_FIELDS_PUT = REGISTRY.counter(
+    "koord_scheduler_upload_fields_put_total",
+    "Device-snapshot fields re-uploaded in full",
+)
+UPLOAD_BYTES_SCATTERED = REGISTRY.counter(
+    "koord_scheduler_upload_bytes_scattered_total",
+    "Bytes shipped by device-snapshot row scatters",
+)
+UPLOAD_BYTES_PUT = REGISTRY.counter(
+    "koord_scheduler_upload_bytes_put_total",
+    "Bytes shipped by full device-snapshot puts",
+)

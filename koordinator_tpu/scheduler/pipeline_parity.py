@@ -1,0 +1,186 @@
+"""Serial-vs-pipelined cycle parity: same fixture, byte-identical outcomes.
+
+The CyclePipeline (scheduler/cycle.py) reorders WHEN host work runs — the
+kernel readback is deferred until bind needs it, and unschedulability
+condition writes for cycle N run inside cycle N+1's kernel window. None of
+that may change WHAT the scheduler produces: bind order, CRD writes, and
+PodScheduled conditions must be byte-for-byte what the strictly serial
+path produces. This module drives one store fixture through both paths
+with identical arrival/metric churn and diffs everything observable.
+
+Run as a gate (hack/lint.sh and tier-1 via tests/test_cycle_pipeline.py):
+
+    JAX_PLATFORMS=cpu python -m koordinator_tpu.scheduler.pipeline_parity
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Optional, Tuple
+
+GIB = 1024 ** 3
+
+
+def build_store_from_state(state):
+    from koordinator_tpu.client.store import (
+        KIND_ELASTIC_QUOTA,
+        KIND_NODE,
+        KIND_NODE_METRIC,
+        KIND_NODE_TOPOLOGY,
+        KIND_POD,
+        KIND_POD_GROUP,
+        ObjectStore,
+    )
+
+    store = ObjectStore()
+    for n in state.nodes:
+        store.add(KIND_NODE, n)
+    for nm in state.node_metrics.values():
+        store.add(KIND_NODE_METRIC, nm)
+    for p in state.pods_by_key.values():
+        store.add(KIND_POD, p)
+    for p in state.pending_pods:
+        store.add(KIND_POD, p)
+    for pg in state.pod_groups:
+        store.add(KIND_POD_GROUP, pg)
+    for q in state.quotas:
+        store.add(KIND_ELASTIC_QUOTA, q)
+    for t in state.topologies.values():
+        store.add(KIND_NODE_TOPOLOGY, t)
+    return store
+
+
+def apply_round_delta(store, round_idx: int, now: float, arrivals: int,
+                      metric_touches: Optional[int] = None,
+                      prefix: str = "pp", namespace: str = "parity") -> None:
+    """Deterministic per-round churn: fresh pending pods + metric touches.
+    Twin worlds receive byte-identical objects (independent instances).
+    Shared by the parity gate and bench.run_steady_state so both exercise
+    the same delta shape; ``metric_touches`` defaults to ~1/7 of the
+    metrics (the parity fixture's historical cadence)."""
+    from koordinator_tpu.api.objects import (
+        NodeMetricInfo,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+    )
+    from koordinator_tpu.api.resources import ResourceList
+    from koordinator_tpu.client.store import KIND_NODE_METRIC, KIND_POD
+
+    for i in range(arrivals):
+        store.add(KIND_POD, Pod(
+            meta=ObjectMeta(name=f"{prefix}-{round_idx}-{i}",
+                            namespace=namespace,
+                            uid=f"{prefix}-{round_idx}-{i}",
+                            creation_timestamp=now + round_idx),
+            spec=PodSpec(priority=5000 + (i % 4) * 1000,
+                         requests=ResourceList.of(
+                             cpu=250 * (1 + i % 6),
+                             memory=(1 + i % 3) * GIB, pods=1)),
+        ))
+    metrics = store.list(KIND_NODE_METRIC)
+    stride = (7 if metric_touches is None
+              else max(1, len(metrics) // metric_touches))
+    for nm in metrics[round_idx % min(3, stride)::stride]:
+        nm.update_time = now + round_idx
+        nm.node_metric = NodeMetricInfo(
+            node_usage=ResourceList.of(
+                cpu=4000 + 500 * round_idx, memory=(8 + round_idx) * GIB))
+        store.update(KIND_NODE_METRIC, nm)
+
+
+def _conditions(store) -> Dict[str, tuple]:
+    """Every pod's PodScheduled condition, keyed by pod key."""
+    from koordinator_tpu.client.store import KIND_POD
+
+    out = {}
+    for pod in store.list(KIND_POD):
+        cond = pod.get_condition("PodScheduled")
+        if cond is not None:
+            out[pod.meta.key] = (cond.status, cond.reason, cond.message,
+                                 cond.last_transition_time)
+    return out
+
+
+def run_pipeline_parity(num_nodes: int = 24, num_pods: int = 70,
+                        rounds: int = 4, seed: int = 11,
+                        arrivals: int = 9) -> dict:
+    """Drive identical twin stores through the serial and pipelined paths.
+
+    Returns a report dict; report["ok"] is the gate. Diffs per round:
+    bound (pod, node) sequences in order, failed/rejected/victim sets —
+    and at end of stream (after flush): every pod's PodScheduled
+    condition tuple and node assignment."""
+    from koordinator_tpu.client.store import KIND_POD
+    from koordinator_tpu.scheduler.cycle import CyclePipeline, Scheduler
+    from koordinator_tpu.testing import synth_full_cluster
+
+    def make_world():
+        _cluster, state = synth_full_cluster(
+            num_nodes, num_pods, seed=seed, num_quotas=3, num_gangs=4,
+            topology_fraction=0.5, lsr_fraction=0.2)
+        return state, build_store_from_state(state)
+
+    state_s, store_serial = make_world()
+    _state_p, store_pipe = make_world()
+    sched_serial = Scheduler(store_serial)
+    sched_pipe = Scheduler(store_pipe)
+    pipeline = CyclePipeline(sched_pipe, enabled=True)
+    assert sched_serial.pipeline_mode is False
+
+    now = state_s.now
+    mismatches: List[str] = []
+    for r in range(rounds + 1):
+        if r > 0:
+            apply_round_delta(store_serial, r, now, arrivals)
+            apply_round_delta(store_pipe, r, now, arrivals)
+        t = now + 2 * r
+        res_s = sched_serial.run_cycle(now=t)
+        res_p = pipeline.run_cycle(now=t)
+        if ([(b.pod_key, b.node_name, b.annotations) for b in res_s.bound]
+                != [(b.pod_key, b.node_name, b.annotations)
+                    for b in res_p.bound]):
+            mismatches.append(f"round {r}: bound sequence differs")
+        for field in ("failed", "rejected", "preempted_victims",
+                      "resized", "resize_pending"):
+            if sorted(getattr(res_s, field)) != sorted(getattr(res_p, field)):
+                mismatches.append(f"round {r}: {field} differs")
+    pipeline.flush()
+
+    cond_s, cond_p = _conditions(store_serial), _conditions(store_pipe)
+    if cond_s != cond_p:
+        keys = {k for k in set(cond_s) | set(cond_p)
+                if cond_s.get(k) != cond_p.get(k)}
+        mismatches.append(
+            f"PodScheduled conditions differ for {len(keys)} pods "
+            f"(e.g. {sorted(keys)[:3]})")
+    assign_s = {p.meta.key: p.spec.node_name
+                for p in store_serial.list(KIND_POD)}
+    assign_p = {p.meta.key: p.spec.node_name
+                for p in store_pipe.list(KIND_POD)}
+    if assign_s != assign_p:
+        mismatches.append("final pod->node assignments differ")
+
+    return {
+        "ok": not mismatches,
+        "mismatches": mismatches,
+        "rounds": rounds + 1,
+        "pods": len(assign_s),
+        "conditions_checked": len(cond_s),
+    }
+
+
+def main(argv: List[str]) -> int:
+    report = run_pipeline_parity()
+    line = (f"pipeline parity: rounds={report['rounds']} "
+            f"pods={report['pods']} "
+            f"conditions={report['conditions_checked']} -> "
+            f"{'OK' if report['ok'] else 'MISMATCH'}")
+    print(line, file=sys.stderr)
+    for m in report["mismatches"]:
+        print(f"  {m}", file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -24,9 +24,13 @@ class LoadAwarePlugin(Plugin):
         # assign-cache entry set, so the incremental snapshot builder
         # (scheduler/snapshot_cache.py) can key its per-node LoadAware rows
         self.node_epoch: Dict[str, int] = {}
+        # names bumped since the snapshot cache last drained: lets the
+        # cache find changed nodes without scanning every epoch per build
+        self.epoch_dirty: set = set()
 
     def _bump(self, node_name: str) -> None:
         self.node_epoch[node_name] = self.node_epoch.get(node_name, 0) + 1
+        self.epoch_dirty.add(node_name)
 
     def register(self, store: ObjectStore) -> None:
         store.subscribe(KIND_POD, self._on_pod)

@@ -66,9 +66,13 @@ class NodeNUMAResourcePlugin(Plugin):
         # per-node change counter over (topologies, cpu_states,
         # numa_allocated) — keys the incremental snapshot builder's NUMA rows
         self.node_epoch: Dict[str, int] = {}
+        # names bumped since the snapshot cache last drained (see
+        # scheduler/snapshot_cache.py numa_arrays)
+        self.epoch_dirty: set = set()
 
     def _bump(self, node_name: str) -> None:
         self.node_epoch[node_name] = self.node_epoch.get(node_name, 0) + 1
+        self.epoch_dirty.add(node_name)
 
     def register(self, store: ObjectStore) -> None:
         self.store = store

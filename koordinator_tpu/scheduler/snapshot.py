@@ -2,9 +2,13 @@
 
 The analog of the scheduler's cache/snapshot layer plus every plugin's PreFilter
 precompute (SURVEY.md section 3.1): one pass over nodes/pods/CRs produces the
-packed device arrays for the fused full-chain step. Incremental delta updates
-(donate-buffer) come later; v1 rebuilds per cycle, which the bench shows is cheap
-relative to the win.
+packed device arrays for the fused full-chain step. With a SnapshotCache
+attached the pass is INCREMENTAL — O(changed objects), not O(cluster):
+packed pod rows, flags, masks and selector sets gather from the previous
+build's pack memo with batched fancy indexing, node-side LoadAware/NUMA
+rows refresh only where store events or plugin epochs dirtied them, and
+the cold code path is otherwise identical so cached and cold builds
+cannot drift (tests/test_snapshot_cache.py diffs every array).
 """
 
 from __future__ import annotations
@@ -36,7 +40,13 @@ from koordinator_tpu.models.full_chain import FullChainInputs
 from koordinator_tpu.models.scheduler_model import make_inputs
 from koordinator_tpu.ops.loadaware import LoadAwareArgs, build_loadaware_node_state
 from koordinator_tpu.ops.numa import MAX_NUMA, POLICY_BY_NAME, POLICY_NONE
-from koordinator_tpu.ops.packing import NodeBatch, PodBatch, pack_nodes, pack_pods
+from koordinator_tpu.ops.packing import (
+    NodeBatch,
+    PodBatch,
+    fill_ids_from_names,
+    pack_nodes,
+    pack_pods,
+)
 from koordinator_tpu.ops.taints import (
     admission_mask,
     degraded_node_count,
@@ -241,16 +251,24 @@ def build_full_chain_inputs(
         },
         cache=cache,
     )
-    pods_by_key_pending = {p.meta.key: p for p in state.pending_pods}
+    # keyed off the packed batch (keys computed once inside pack_pods)
+    pods_by_key_pending = dict(zip(pods.keys, pods.objs))
 
     # ---- quota tree: pending requests accumulate from the PACKED rows (one
-    # to_vector per pod already happened inside pack_pods)
+    # to_vector per pod already happened inside pack_pods). Grouped by the
+    # quota-name column with one segment-sum; np.add.at processes rows in
+    # ascending packed order, the same float32 accumulation sequence the
+    # per-pod loop produced.
     pod_req_by_quota: Dict[str, np.ndarray] = {}
-    for i, key in enumerate(pods.keys):
-        q = pods_by_key_pending[key].quota_name
-        if q:
-            pod_req_by_quota.setdefault(q, np.zeros(NUM_RESOURCES, np.float32))
-            pod_req_by_quota[q] += pods.requests[i]
+    n_valid = pods.num_valid
+    qn_col = pods.quota_names[:n_valid]
+    q_rows = np.nonzero(qn_col != "")[0]
+    if q_rows.size:
+        q_uniq, q_inv = np.unique(qn_col[q_rows].astype(str),
+                                  return_inverse=True)
+        q_sums = np.zeros((len(q_uniq), NUM_RESOURCES), np.float32)
+        np.add.at(q_sums, q_inv, pods.requests[q_rows])
+        pod_req_by_quota = {str(q): q_sums[j] for j, q in enumerate(q_uniq)}
     # assigned quota usage: event-maintained sums when cached, else ONE
     # wire-matrix fill + scale + segment-sum instead of a per-pod
     # to_vector allocation (the 10k-pod store walk's hot cost)
@@ -277,9 +295,14 @@ def build_full_chain_inputs(
     pod_req_by_quota = merge_group_request(pod_req_by_quota, used_by_quota)
     tree = build_quota_tree(state.quotas, pod_req_by_quota, used_by_quota)
     if state.cluster_total is None:
-        # one matrix fill + scale + sum (not 5k per-node to_vector calls)
-        total = ResourceList.pack_wire_matrix(
-            node.allocatable for node in state.nodes).sum(axis=0)
+        if cache is not None:
+            # memoized on the node epoch: any Node add/update/delete
+            # invalidates, so the warm path skips the O(N) matrix fill
+            total = cache.cluster_total(state.nodes)
+        else:
+            # one matrix fill + scale + sum (not 5k per-node to_vector calls)
+            total = ResourceList.pack_wire_matrix(
+                node.allocatable for node in state.nodes).sum(axis=0)
     else:
         total = state.cluster_total
     runtime = (
@@ -299,10 +322,17 @@ def build_full_chain_inputs(
         gang_min[i] = pg.min_member
         gang_assumed[i] = state.gang_assumed.get(pg.meta.key, 0)
         gang_total[i] = gang_assumed[i]
-    for pod in state.pending_pods:
-        g = pod.gang_key
-        if g in gang_index:
-            gang_total[gang_index[g]] += 1
+    # pending members per gang: unique-count over the packed gang column
+    # (integer counts — accumulation order free)
+    gk_col = pods.gang_keys[:n_valid]
+    gk_rows = np.nonzero(gk_col != "")[0]
+    if gk_rows.size:
+        gk_uniq, gk_counts = np.unique(gk_col[gk_rows].astype(str),
+                                       return_counts=True)
+        for g, c in zip(gk_uniq, gk_counts):
+            gi = gang_index.get(str(g))
+            if gi is not None:
+                gang_total[gi] += c
     gang_valid = gang_total >= gang_min
     gang_group = np.arange(ng, dtype=np.int32)  # group == gang (annotation later)
 
@@ -358,8 +388,33 @@ def build_full_chain_inputs(
                 vb_reason_by_key[key] = vb.reason
             elif vb.any_of_sets:
                 vb_any_of_by_key[key] = vb.any_of_sets
-    sel_pairs = selector_pairs_of(pods_by_key_pending.values(),
-                                  zone_pairs_by_key)
+    # distinct nodeSelector/affinity pair universe: per-pod pair sets are
+    # cached in the pack memo (frozensets hash-cache themselves), so the
+    # warm path unions a handful of DISTINCT sets instead of walking every
+    # pod's label dicts
+    if cache is not None and pods.reused_src is not None:
+        sel_col = np.empty(n_valid, object)
+        sel_done = np.zeros(n_valid, bool)
+        prevm_sel = cache.pack_memo_prev
+        if prevm_sel is not None and "sel" in prevm_sel:
+            sel_hit = np.nonzero(pods.reused_src >= 0)[0]
+            if sel_hit.size:
+                sel_col[sel_hit] = prevm_sel["sel"][pods.reused_src[sel_hit]]
+                sel_done[sel_hit] = True
+        for i in np.nonzero(~sel_done)[0]:
+            pod = pods_by_key_pending[pods.keys[i]]
+            sel_col[i] = frozenset(
+                pod.spec.node_selector.items()) | frozenset(
+                pod.spec.affinity_required_node_labels.items())
+        cache.pack_memo["sel"] = sel_col
+        pair_union = (set().union(*set(sel_col.tolist()))
+                      if n_valid else set())
+        for zp in zone_pairs_by_key.values():
+            pair_union |= zp
+        sel_pairs = frozenset(pair_union)
+    else:
+        sel_pairs = selector_pairs_of(pods_by_key_pending.values(),
+                                      zone_pairs_by_key)
     if vb_any_of_by_key:
         sel_pairs = frozenset(
             sel_pairs
@@ -375,20 +430,74 @@ def build_full_chain_inputs(
     ADMISSION_DEGRADED_NODES.set(
         float(degraded_node_count(node_taint_ids, admission_groups)))
     vol_needed = np.zeros(P, np.float32)
-    for i, key in enumerate(pods.keys):
+    # per-row feature presence (affinity/spread specs, hostPorts, images,
+    # preferred node affinity): the candidate-row sets the batch encoders
+    # below restrict their extraction loops to
+    has_aff = np.zeros(P, bool)
+    has_ports = np.zeros(P, bool)
+    has_img = np.zeros(P, bool)
+    has_npref = np.zeros(P, bool)
+    # dirty-row flags/masks: rows carried over from the previous build
+    # gather their cached columns with batched fancy indexing (the same
+    # reused_src mapping pack_pods used); only changed rows pay the
+    # per-object Python below. Masks are position-independent (pure pod ->
+    # group bitmask), so gathering across reordered rows is exact.
+    src = pods.reused_src
+    prevm = cache.pack_memo_prev if cache is not None else None
+    flag_done = np.zeros(n_valid, bool)
+    mask_done = np.zeros(n_valid, bool)
+    if prevm is not None and src is not None and "f_needs_bind" in prevm:
+        f_hit = np.nonzero(src >= 0)[0]
+        if f_hit.size:
+            hsrc = src[f_hit]
+            needs_bind[f_hit] = prevm["f_needs_bind"][hsrc]
+            cores_needed[f_hit] = prevm["f_cores"][hsrc]
+            full_pcpus[f_hit] = prevm["f_fullp"][hsrc]
+            needs_numa[f_hit] = prevm["f_needs_numa"][hsrc]
+            vol_needed[f_hit] = prevm["f_vol"][hsrc]
+            has_aff[f_hit] = prevm["f_aff"][hsrc]
+            has_ports[f_hit] = prevm["f_ports"][hsrc]
+            has_img[f_hit] = prevm["f_img"][hsrc]
+            has_npref[f_hit] = prevm["f_npref"][hsrc]
+            flag_done[f_hit] = True
+            # cached masks are valid only under the SAME admission grouping
+            # and PVC/PV/StorageClass epoch, and only for volume-less pods
+            # (pvc carriers fold VolumeZone/VolumeBinding state into theirs)
+            if prevm.get("mask_epoch") == (adm_seq, cache.pvcpv_epoch):
+                m_hit = f_hit[prevm["f_vol"][hsrc] == 0.0]
+                if m_hit.size:
+                    pod_taint_mask[m_hit] = prevm["mask"][src[m_hit]]
+                    mask_done[m_hit] = True
+    for i in np.nonzero(~(flag_done & mask_done))[0]:
+        key = pods.keys[i]
         pod = pods_by_key_pending[key]
-        flags = cache.pod_flag(pod) if cache is not None else None
-        if flags is not None:
-            (needs_bind[i], cores_needed[i], full_pcpus[i],
-             needs_numa[i], vol_needed[i]) = flags
-        else:
-            nb, cn, fp = _pod_cpuset_flags(pod)
-            needs_bind[i], cores_needed[i], full_pcpus[i] = nb, cn, fp
-            needs_numa[i] = bool(pod.spec.requests)
-            vol_needed[i] = len(set(pod.spec.pvc_names))
-            if cache is not None:
-                cache.put_pod_flag(pod, (nb, cn, fp, bool(needs_numa[i]),
-                                         float(vol_needed[i])))
+        if not flag_done[i]:
+            flags = cache.pod_flag(pod) if cache is not None else None
+            if flags is not None:
+                (needs_bind[i], cores_needed[i], full_pcpus[i],
+                 needs_numa[i], vol_needed[i], has_aff[i], has_ports[i],
+                 has_img[i], has_npref[i]) = flags
+            else:
+                spec = pod.spec
+                nb, cn, fp = _pod_cpuset_flags(pod)
+                needs_bind[i], cores_needed[i], full_pcpus[i] = nb, cn, fp
+                needs_numa[i] = bool(spec.requests)
+                vol_needed[i] = len(set(spec.pvc_names))
+                has_aff[i] = bool(spec.pod_affinity or spec.pod_anti_affinity
+                                  or spec.topology_spread
+                                  or spec.pod_affinity_preferred)
+                has_ports[i] = bool(spec.host_ports)
+                has_img[i] = bool(spec.images)
+                has_npref[i] = bool(spec.affinity_preferred)
+                if cache is not None:
+                    cache.put_pod_flag(pod, (nb, cn, fp, bool(needs_numa[i]),
+                                             float(vol_needed[i]),
+                                             bool(has_aff[i]),
+                                             bool(has_ports[i]),
+                                             bool(has_img[i]),
+                                             bool(has_npref[i])))
+        if mask_done[i]:
+            continue
         if key in vb_reason_by_key:
             # VolumeBinding PreFilter rejection (missing claim/class,
             # unbound immediate claim, claim satisfiable nowhere): no
@@ -409,9 +518,22 @@ def build_full_chain_inputs(
                 if cache is not None:
                     cache.put_pod_mask(pod, adm_seq,
                                        float(pod_taint_mask[i]))
-        q = pod.quota_name
-        if q:  # quota ids resolve only after the tree exists
-            pods.quota_id[i] = quota_ids.get(q, -1)
+    # quota ids resolve only after the tree exists — one vectorized
+    # unique-name map over the packed quota column
+    fill_ids_from_names(pods.quota_id, pods.quota_names[:n_valid], quota_ids)
+    if cache is not None and cache.pack_memo is not None:
+        memo = cache.pack_memo
+        memo["f_needs_bind"] = needs_bind[:n_valid].copy()
+        memo["f_cores"] = cores_needed[:n_valid].copy()
+        memo["f_fullp"] = full_pcpus[:n_valid].copy()
+        memo["f_needs_numa"] = needs_numa[:n_valid].copy()
+        memo["f_vol"] = vol_needed[:n_valid].copy()
+        memo["f_aff"] = has_aff[:n_valid].copy()
+        memo["f_ports"] = has_ports[:n_valid].copy()
+        memo["f_img"] = has_img[:n_valid].copy()
+        memo["f_npref"] = has_npref[:n_valid].copy()
+        memo["mask"] = pod_taint_mask[:n_valid].copy()
+        memo["mask_epoch"] = (adm_seq, cache.pvcpv_epoch)
 
     # ---- nodes
     if cache is not None:
@@ -509,14 +631,15 @@ def build_full_chain_inputs(
     # rows, in pods.keys order, padded to the bucketed shapes
     from koordinator_tpu.ops.podaffinity import build_affinity_state
 
-    ordered_pending = [pods_by_key_pending[k] for k in pods.keys]
+    ordered_pending = pods.objs
     existing = [
         p for p in state.pods_by_key.values()
         if p.is_assigned and not p.is_terminated
     ]
     (_aff_terms, term_ids, dom_v, count_v, cover_v, aff_exists, aff_req_v,
      anti_req_v, match_v, spread_v, aff_overflow) = build_affinity_state(
-        ordered_pending, state.nodes, existing)
+        ordered_pending, state.nodes, existing,
+        rows=np.nonzero(has_aff[:n_valid])[0])
     T = dom_v.shape[1]
     aff_dom = np.full((N, T), -1.0, np.float32)
     aff_dom[: dom_v.shape[0]] = dom_v
@@ -545,7 +668,7 @@ def build_full_chain_inputs(
     )
 
     pref_rows_v, pref_id_v = build_preferred_scores(
-        ordered_pending, state.nodes)
+        ordered_pending, state.nodes, rows=np.nonzero(has_npref[:n_valid])[0])
     # TRUE zero columns when no pod carries a preference: the kernels gate
     # profile work on the column count, so empty batches pay nothing
     n_pref = pref_rows_v.shape[0] if (pref_id_v >= 0).any() else 0
@@ -556,7 +679,7 @@ def build_full_chain_inputs(
 
     # preferred POD affinity (weighted, over the shared term space)
     ppref_w, ppref_id_v, ppref_mask_v = build_preferred_pod_profiles(
-        ordered_pending, term_ids, T)
+        ordered_pending, term_ids, T, rows=np.nonzero(has_aff[:n_valid])[0])
     pod_ppref_id = np.full(P, -1, np.int32)
     pod_ppref_id[: ppref_id_v.shape[0]] = ppref_id_v
     pod_ppref_mask = np.zeros((P, T), bool)
@@ -567,7 +690,8 @@ def build_full_chain_inputs(
     from koordinator_tpu.ops.ports import build_image_scores, build_port_state
 
     _slots, used_v, wants_v, port_overflow = build_port_state(
-        ordered_pending, state.nodes, existing)
+        ordered_pending, state.nodes, existing,
+        rows=np.nonzero(has_ports[:n_valid])[0])
     PT = used_v.shape[1]
     port_used = np.zeros((N, PT), np.float32)
     port_used[: used_v.shape[0]] = used_v
@@ -644,7 +768,8 @@ def build_full_chain_inputs(
             for g in range(1, VG):
                 vol_needed_g[i, g] = (len(claims - group_sets[g])
                                       if claims else 0.0)
-    img_rows_v, img_id_v = build_image_scores(ordered_pending, state.nodes)
+    img_rows_v, img_id_v = build_image_scores(
+        ordered_pending, state.nodes, rows=np.nonzero(has_img[:n_valid])[0])
     n_img = img_rows_v.shape[0] if (img_id_v >= 0).any() else 0
     img_scores = np.zeros((N, n_img), np.float32)
     img_scores[: img_rows_v.shape[1], :] = img_rows_v[:n_img].T

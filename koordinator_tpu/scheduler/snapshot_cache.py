@@ -6,10 +6,13 @@ rebuilds every packed array from the object store each cycle (~0.3-0.7s at
 10k pods x 5k nodes); with a `SnapshotCache` attached it reuses everything
 whose inputs did not change since the previous cycle:
 
-  * per-pod packed rows (requests/limits/estimates/flags) keyed by
-    (pod key, resourceVersion) — reference analog: the scheduling queue
-    caches pod info objects rather than re-parsing specs
-    (pkg/scheduler/ vendored internal queue);
+  * per-pod packed rows (requests/limits/estimates/flags/masks, queue-key
+    tuples, selector-pair sets) keyed by (pod key, resourceVersion) in the
+    VECTORIZED pack memo (`pack_memo`): the previous build's column
+    matrices are gathered into the next build with batched fancy indexing
+    (ops/packing.pack_pods), so only changed rows pay per-object Python —
+    reference analog: the scheduling queue caches pod info objects rather
+    than re-parsing specs (pkg/scheduler/ vendored internal queue);
   * per-node assigned-request sums, per-quota used sums and per-node
     attached-volume sets maintained from store pod events — reference
     analogs: pod_assign_cache.go, group_quota_manager.go:184-256;
@@ -78,7 +81,6 @@ class SnapshotCache:
         self.numa = numa_plugin
 
         # ---- per-pod caches (keyed key -> (rv, payload)) ----
-        self.pod_rows: Dict[str, Tuple[int, dict]] = {}
         self.pod_flags: Dict[str, Tuple[int, tuple]] = {}
         self.pod_masks: Dict[str, Tuple[tuple, float]] = {}
         # VolumeBinding classification (scheduler/volumebinding.py): the
@@ -96,13 +98,31 @@ class SnapshotCache:
         self._vol_contrib: Dict[str, Tuple[str, frozenset]] = {}
         self._attached: Dict[str, Dict[str, int]] = {}
 
+        # ---- vectorized pack memo (ops/packing.pack_pods): the previous
+        # build's packed pod rows + the flag/mask columns snapshot.py adds,
+        # gathered into the next build with batched fancy indexing. The
+        # `_prev` handle keeps the outgoing memo readable during the build
+        # that replaces it (pack rotates first; the flags block still needs
+        # the old columns under the same reused_src mapping).
+        self.pack_memo: Optional[dict] = None
+        self.pack_memo_prev: Optional[dict] = None
+        self._cluster_total: Optional[Tuple[int, np.ndarray]] = None
+
         # ---- epochs / dirty sets ----
         self.nodes_epoch = 0          # any Node add/update/delete
         self.pvcpv_epoch = 0          # any PVC/PV event
         self._la_dirty: Set[str] = set()   # node names needing LA recompute
         self._node_dirty: Set[str] = set()  # node rows (alloc/taint) to refresh
+        self._numa_dirty: Set[str] = set()  # node/topology NUMA rows to refresh
         self._la_keys: Dict[str, tuple] = {}
         self._numa_keys: Dict[str, tuple] = {}
+        # per-node NodeMetric update times aligned to the layout (0.0 =
+        # missing), plus the last build's expiry bits: metric EXPIRY is the
+        # one LA input that changes with pure time passage, so the warm
+        # path detects boundary crossings with one vectorized compare
+        # instead of a per-node key scan
+        self._nm_ut: Optional[np.ndarray] = None
+        self._la_expired: Optional[np.ndarray] = None
 
         # ---- cached node-side arrays (owned; padded to the node bucket) ----
         self._node_names: List[str] = []
@@ -140,7 +160,6 @@ class SnapshotCache:
     # ------------------------------------------------------------------
     def _on_pod(self, ev: EventType, pod: Pod, old) -> None:
         key = pod.meta.key
-        self.pod_rows.pop(key, None)
         self.pod_flags.pop(key, None)
         self.pod_masks.pop(key, None)
         self.pod_vbs.pop(key, None)
@@ -199,14 +218,23 @@ class SnapshotCache:
         self.nodes_epoch += 1
         self._node_dirty.add(node.meta.name)
         self._la_dirty.add(node.meta.name)
+        self._numa_dirty.add(node.meta.name)
 
     def _on_metric(self, ev: EventType, nm, old) -> None:
         self._la_dirty.add(nm.meta.name)
+        # keep the layout-aligned update-time vector current so the expiry
+        # compare in loadaware_extras never consults a stale timestamp
+        if self._nm_ut is not None:
+            idx = self.node_index.get(nm.meta.name)
+            if idx is not None:
+                self._nm_ut[idx] = (
+                    0.0 if ev is EventType.DELETED else nm.update_time)
 
     def _on_topology(self, ev: EventType, cr, old) -> None:
         # numa keys include the plugin epoch; the direct subscription covers
         # cache use without a NUMA plugin attached
         self._numa_keys.pop(cr.meta.name, None)
+        self._numa_dirty.add(cr.meta.name)
 
     def _on_pvcpv(self, ev: EventType, obj, old) -> None:
         self.pvcpv_epoch += 1
@@ -214,6 +242,17 @@ class SnapshotCache:
     # ------------------------------------------------------------------
     # aggregates (cycle-facing)
     # ------------------------------------------------------------------
+    def cluster_total(self, nodes: Sequence[Node]) -> np.ndarray:
+        """Sum of node allocatable wire rows, memoized on the node epoch
+        (any Node add/update/delete recomputes)."""
+        hit = self._cluster_total
+        if hit is not None and hit[0] == self.nodes_epoch:
+            return hit[1]
+        total = ResourceList.pack_wire_matrix(
+            node.allocatable for node in nodes).sum(axis=0)
+        self._cluster_total = (self.nodes_epoch, total)
+        return total
+
     def assigned_requests(self) -> Dict[str, np.ndarray]:
         """Per-node assigned fit sums — replaces Scheduler._assigned_requests'
         full store walk. Fresh f32 copies (transformers mutate them)."""
@@ -234,17 +273,6 @@ class SnapshotCache:
     # ------------------------------------------------------------------
     # pod-side caches
     # ------------------------------------------------------------------
-    def pod_row(self, pod: Pod) -> Optional[dict]:
-        hit = self.pod_rows.get(pod.meta.key)
-        if hit is not None and hit[0] == pod.meta.resource_version:
-            self.stats["pod_row_hits"] += 1
-            return hit[1]
-        self.stats["pod_row_misses"] += 1
-        return None
-
-    def put_pod_row(self, pod: Pod, payload: dict) -> None:
-        self.pod_rows[pod.meta.key] = (pod.meta.resource_version, payload)
-
     def pod_flag(self, pod: Pod) -> Optional[tuple]:
         hit = self.pod_flags.get(pod.meta.key)
         if hit is not None and hit[0] == pod.meta.resource_version:
@@ -317,8 +345,19 @@ class SnapshotCache:
         self._alloc = None
         self._la.clear()
         self._numa.clear()
+        self._nm_ut = None
+        self._la_expired = None
         self.stats["full_rebuilds"] += 1
         return True
+
+    def _dirty_indices(self, names: Set[str]) -> List[int]:
+        """Layout row indices of a dirty-name set (names outside the
+        current layout — deleted/unschedulable nodes — are dropped)."""
+        if not names:
+            return []
+        idx = self.node_index
+        return sorted(i for i in (idx.get(n) for n in names)
+                      if i is not None)
 
     def alloc_matrix(self, nodes: Sequence[Node]) -> np.ndarray:
         """[pad, R] estimate_node_allocatable rows, refreshed per node rv."""
@@ -329,16 +368,36 @@ class SnapshotCache:
             dirty = range(len(nodes))
             self._mark("allocatable")
         else:
-            dirty = [i for i, n in enumerate(nodes)
-                     if n.meta.name in self._node_dirty]
+            dirty = self._dirty_indices(self._node_dirty)
             if dirty:
                 self._mark("allocatable")
         for i in dirty:
             self._alloc[i] = estimate_node_allocatable(nodes[i])
         return self._alloc
 
+    def _metric_expiry_flips(self, state, args, n_real: int) -> List[int]:
+        """Rows whose metric-expiry bit flipped since the previous build.
+        Expiry is the one LoadAware input that changes with pure time
+        passage (no store event), so the warm path detects boundary
+        crossings with one vectorized compare over the layout-aligned
+        update-time vector instead of a per-node Python key scan."""
+        ut = self._nm_ut
+        expired = ut <= 0.0
+        T = args.node_metric_expiration_seconds
+        if T > 0:
+            expired = expired | (state.now - ut >= T)
+        prev = self._la_expired
+        self._la_expired = expired
+        if prev is None:
+            return []
+        return np.nonzero(expired[:n_real] != prev[:n_real])[0].tolist()
+
     def loadaware_extras(self, state, args, pad_to: int) -> Dict[str, np.ndarray]:
-        """Cached per-node LoadAware rows; recomputes only dirty nodes."""
+        """Cached per-node LoadAware rows; recomputes only dirty nodes.
+        Dirtiness is event-driven: store events land in `_la_dirty`, plugin
+        assign-cache mutations drain from the plugin's `epoch_dirty` set,
+        and metric expiry flips come from the vectorized compare above — a
+        steady-state build touches no per-node Python at all."""
         from koordinator_tpu.ops.loadaware import build_loadaware_node_state
 
         nodes = state.nodes
@@ -364,15 +423,37 @@ class SnapshotCache:
             self._la = full
             self._la_keys = {n.meta.name: key_of(n) for n in nodes}
             self.stats["la_recomputed"] += len(nodes)
+            self._nm_ut = np.zeros(pad_to, np.float64)
+            for i, n in enumerate(nodes):
+                nm = state.node_metrics.get(n.meta.name)
+                if nm is not None:
+                    self._nm_ut[i] = nm.update_time
+            self._la_expired = None
+            self._metric_expiry_flips(state, args, len(nodes))
+            ed = getattr(self.loadaware, "epoch_dirty", None)
+            if ed:
+                ed.clear()  # the full build covered every node
             for f in full:
                 self._mark(f)
             return self._la
 
-        dirty_idx = [
-            i for i, n in enumerate(nodes)
-            if n.meta.name in self._la_dirty
-            or self._la_keys.get(n.meta.name) != key_of(n)
-        ]
+        ed = (getattr(self.loadaware, "epoch_dirty", None)
+              if self.loadaware is not None else set())
+        if self.loadaware is not None and ed is None:
+            # plugin without change-reporting (custom subclass): fall back
+            # to the conservative per-node key scan
+            dirty_idx = [
+                i for i, n in enumerate(nodes)
+                if n.meta.name in self._la_dirty
+                or self._la_keys.get(n.meta.name) != key_of(n)
+            ]
+        else:
+            if ed:
+                self._la_dirty |= ed
+                ed.clear()
+            flips = self._metric_expiry_flips(state, args, len(nodes))
+            dirty_idx = sorted(
+                set(self._dirty_indices(self._la_dirty)) | set(flips))
         if dirty_idx:
             sub = [nodes[i] for i in dirty_idx]
             rows = build_loadaware_node_state(
@@ -425,10 +506,23 @@ class SnapshotCache:
             return (node.meta.resource_version, topo_rv,
                     plugin_epoch.get(name, 0))
 
-        dirty = [
-            i for i, n in enumerate(nodes)
-            if first or self._numa_keys.get(n.meta.name) != key_of(n)
-        ]
+        ed = (getattr(self.numa, "epoch_dirty", None)
+              if self.numa is not None else set())
+        if first:
+            dirty = list(range(len(nodes)))
+            if ed:
+                ed.clear()  # the full pass covers every node
+        elif self.numa is not None and ed is None:
+            # plugin without change-reporting: conservative key scan
+            dirty = [
+                i for i, n in enumerate(nodes)
+                if self._numa_keys.get(n.meta.name) != key_of(n)
+            ]
+        else:
+            if ed:
+                self._numa_dirty |= ed
+                ed.clear()
+            dirty = self._dirty_indices(self._numa_dirty)
         zone_rows: List[Tuple[int, int]] = []
         zone_lists: List = []
         topo_dirty: List[int] = []
@@ -509,6 +603,11 @@ class SnapshotCache:
     def end_build(self) -> None:
         self._la_dirty.clear()
         self._node_dirty.clear()
+        self._numa_dirty.clear()
+        # the outgoing memo's last consumer is the build that just ended
+        # (flags/mask/sel gathers) — release it now instead of carrying a
+        # second full copy of the packed columns across the idle period
+        self.pack_memo_prev = None
 
 
 # ---------------------------------------------------------------------------
@@ -544,6 +643,11 @@ class DeviceSnapshot:
     def _scatter(self, dev, idx: np.ndarray, rows: np.ndarray):
         import jax
 
+        if idx.size == 0:
+            # guard the empty dirty-row set: idx[-1] below indexes a
+            # zero-length array (IndexError), and a zero-row scatter is a
+            # pointless device launch — the unchanged buffer IS the result
+            return dev
         pad = _pad_pow2(idx.size)
         idx_p = np.full(pad, idx[-1], np.int32)
         idx_p[: idx.size] = idx

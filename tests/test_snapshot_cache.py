@@ -245,3 +245,105 @@ def test_cycle_results_identical_with_and_without_cache(churn_world):
         sorted((b.pod_key, b.node_name) for b in res_b2.bound)
     ds = sched_a.device_snapshot.stats
     assert ds["reused"] > 0, f"expected device-buffer reuse: {ds}"
+
+
+# ---------------------------------------------------------------------------
+# DeviceSnapshot: scatter-vs-put crossover, donation, guard rails
+# ---------------------------------------------------------------------------
+
+def _mini_fc(arr, extra=None):
+    """Minimal FullChainInputs-shaped pair of namedtuples for upload()."""
+    from collections import namedtuple
+
+    Base = namedtuple("MiniBase", ["core"])
+    FC = namedtuple("MiniFC", ["base", "aux"])
+    return FC(base=Base(core=arr),
+              aux=extra if extra is not None else np.arange(4, dtype=np.int32))
+
+
+def test_scatter_empty_index_set_is_guarded():
+    """Regression: an empty dirty-row set reaching _scatter used to index
+    idx[-1] on a zero-length array (IndexError); it must hand back the
+    unchanged device buffer."""
+    import jax
+
+    from koordinator_tpu.scheduler.snapshot_cache import DeviceSnapshot
+
+    ds = DeviceSnapshot()
+    dev = jax.device_put(np.zeros((16, 4), np.float32))
+    out = ds._scatter(dev, np.zeros(0, np.int32),
+                      np.zeros((0, 4), np.float32))
+    assert out is dev
+
+
+def test_scatter_vs_put_crossover_boundary():
+    """Rows at exactly _SCATTER_FRACTION take the scatter; one row more
+    falls back to a full put."""
+    from koordinator_tpu.scheduler.snapshot_cache import (
+        _SCATTER_FRACTION,
+        DeviceSnapshot,
+    )
+
+    n = 64
+    at_fraction = int(n * _SCATTER_FRACTION)      # 8 rows: scatter path
+    ds = DeviceSnapshot()
+    base = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+    ds.upload(_mini_fc(base))
+    assert ds.stats["put"] == 2  # core + aux cold puts
+
+    under = base.copy()
+    under[:at_fraction] += 1.0
+    fc2 = ds.upload(_mini_fc(under))
+    assert ds.stats["scattered"] == 1
+    assert np.array_equal(np.asarray(fc2.base.core), under)
+
+    over = under.copy()
+    over[: at_fraction + 1] += 1.0               # 9 rows: put path
+    fc3 = ds.upload(_mini_fc(over))
+    assert ds.stats["scattered"] == 1, "crossover must fall back to put"
+    assert ds.stats["put"] == 3
+    assert np.array_equal(np.asarray(fc3.base.core), over)
+    # bytes accounting moved on both paths
+    assert ds.stats["bytes_scattered"] == at_fraction * 4 * 4
+    assert ds.stats["bytes_put"] >= base.nbytes * 2
+
+
+def test_donated_buffer_not_reused_after_donation():
+    """The scatter donates the previous device buffer; the mirror must
+    track the POST-scatter buffer so later cycles reuse that, never the
+    donated one."""
+    from koordinator_tpu.scheduler.snapshot_cache import DeviceSnapshot
+
+    n = 32
+    ds = DeviceSnapshot()
+    base = np.zeros((n, 4), np.float32)
+    ds.upload(_mini_fc(base))
+    changed = base.copy()
+    changed[3] = 7.0
+    fc2 = ds.upload(_mini_fc(changed))
+    assert ds.stats["scattered"] == 1
+    dev_after = ds._fields["core"][1]
+    assert dev_after is fc2.base.core
+    # an identical re-upload must reuse the post-scatter buffer (and the
+    # values must be the scattered ones, not the donated original's)
+    fc3 = ds.upload(_mini_fc(changed.copy()))
+    assert fc3.base.core is dev_after
+    assert np.array_equal(np.asarray(fc3.base.core), changed)
+
+
+def test_dtype_or_shape_change_forces_full_put():
+    from koordinator_tpu.scheduler.snapshot_cache import DeviceSnapshot
+
+    n = 32
+    ds = DeviceSnapshot()
+    base = np.zeros((n, 4), np.float32)
+    ds.upload(_mini_fc(base))
+    puts0 = ds.stats["put"]
+    # same shape, different dtype: no scatter, full put
+    ds.upload(_mini_fc(base.astype(np.float64)))
+    assert ds.stats["put"] == puts0 + 1
+    assert ds.stats["scattered"] == 0
+    # different leading shape: full put as well
+    ds.upload(_mini_fc(np.zeros((n * 2, 4), np.float64)))
+    assert ds.stats["put"] == puts0 + 2
+    assert ds.stats["scattered"] == 0

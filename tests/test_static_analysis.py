@@ -640,3 +640,75 @@ def test_checked_in_baseline_is_empty():
     assert data["findings"] == [], (
         "koordlint_baseline.json must stay empty; fix or suppress new "
         "findings inline with a rationale instead of baselining them")
+
+
+class TestBlockingReadbackInPipeline:
+    RULE = "blocking-readback-in-pipeline"
+    PATH = "koordinator_tpu/scheduler/cycle.py"
+
+    def test_positive_readback_in_kernel_span(self):
+        src = """
+            import numpy as np
+
+            def _batch_pass(self, fc, step):
+                with self.tracer.span("kernel") as ksp:
+                    chosen, _, _ = step(fc)
+                    chosen = np.asarray(chosen)
+                return chosen
+        """
+        out = findings_for(src, self.RULE, path=self.PATH)
+        assert len(out) == 1 and "sync" in out[0].message
+
+    def test_positive_block_until_ready_in_overlap_wait(self):
+        src = """
+            import jax
+
+            def wait(self, chosen):
+                with self.tracer.span("overlap_wait"):
+                    jax.block_until_ready(chosen)
+        """
+        assert len(findings_for(src, self.RULE, path=self.PATH)) == 1
+
+    def test_negative_pragma_licenses_designated_sync(self):
+        src = """
+            import numpy as np
+
+            def _batch_pass(self, fc, step):
+                with self.tracer.span("kernel"):
+                    chosen, _, _ = step(fc)
+                    with self.tracer.span("overlap_wait"):
+                        # koordlint: disable=blocking-readback-in-pipeline
+                        chosen = np.asarray(chosen)
+                return chosen
+        """
+        assert findings_for(src, self.RULE, path=self.PATH) == []
+
+    def test_negative_outside_region_and_outside_cycle(self):
+        # a readback outside the pipelined spans is host-side bookkeeping
+        src = """
+            import numpy as np
+
+            def encode(self, fc):
+                with self.tracer.span("encode"):
+                    arr = np.asarray(fc.node_taint_group)
+                return arr
+        """
+        assert findings_for(src, self.RULE, path=self.PATH) == []
+        # other modules may read back freely — the region is cycle.py's
+        src2 = """
+            import numpy as np
+
+            def f(step, fc):
+                with tracer.span("kernel"):
+                    return np.asarray(step(fc))
+        """
+        assert findings_for(src2, self.RULE, path="pkg/other.py") == []
+
+    def test_shipped_cycle_module_is_clean(self):
+        source = (REPO_ROOT / "koordinator_tpu" / "scheduler"
+                  / "cycle.py").read_text()
+        out = analyze_source(source,
+                             path="koordinator_tpu/scheduler/cycle.py",
+                             rules={self.RULE: all_rules()[self.RULE]})
+        assert [f for f in out if f.rule == self.RULE] == [], (
+            "every sync in the pipelined region must carry its pragma")
