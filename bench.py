@@ -112,6 +112,13 @@ def main() -> None:
         help="full-chain kernel selection (auto = backend/VMEM-based)",
     )
     ap.add_argument(
+        "--waves", default=None,
+        help="comma list of fused-wave depths for the steady-state sweep "
+        "(default 1,2,4,8; smoke runs 1,2; empty string disables). Each K "
+        "runs the steady loop with KOORD_TPU_WAVES=K semantics and the "
+        "JSON gains pods_per_sec_at_k + fixed_overhead_ms_amortized",
+    )
+    ap.add_argument(
         "--device-probe-timeout", type=int, default=150,
         help="seconds per device-init probe attempt (subprocess); after "
         "--device-probe-attempts failures the bench falls back to CPU "
@@ -314,13 +321,15 @@ def run_churn(args_cli, num_pods: int, num_nodes: int) -> None:
             store.add(KIND_NODE_TOPOLOGY, t)
         return store, state
 
+    # waves=1 keeps the churn numbers comparable across rounds: this
+    # bench isolates the snapshot-cache delta path, not wave fusion
     store_inc, state = make_store()
-    sched_inc = Scheduler(store_inc)
+    sched_inc = Scheduler(store_inc, waves=1)
     assert sched_inc.snapshot_cache is not None
     store_cold, _state2 = make_store()
     SCHEDULER_GATES.set_from_map({"IncrementalSnapshot": False})
     try:
-        sched_cold = Scheduler(store_cold)
+        sched_cold = Scheduler(store_cold, waves=1)
     finally:
         SCHEDULER_GATES.reset()
     log(f"fixture + stores: {time.perf_counter() - t0:.2f}s "
@@ -604,9 +613,13 @@ def run_steady_state(args_cli, num_pods: int, num_nodes: int) -> dict:
     t0 = time.perf_counter()
     store_p, state = make_store()
     store_s, _state2 = make_store()
-    sched_p = Scheduler(store_p)
+    # waves pinned to 1: this loop is the PR-3-comparable pipeline-vs-
+    # serial measurement (auto-K would fuse the deep cold queue and
+    # change what steady_state_pods_per_sec/pack_seconds_cold mean);
+    # the sweep below covers K > 1 explicitly
+    sched_p = Scheduler(store_p, waves=1)
     pipeline = CyclePipeline(sched_p)  # KOORD_TPU_PIPELINE gates
-    sched_s = Scheduler(store_s)
+    sched_s = Scheduler(store_s, waves=1)
     assert sched_s.pipeline_mode is False
     log(f"steady-state fixture + twin stores: {time.perf_counter() - t0:.2f}s "
         "(not framework cost)")
@@ -675,7 +688,7 @@ def run_steady_state(args_cli, num_pods: int, num_nodes: int) -> dict:
     cs = sched_p.snapshot_cache.stats if sched_p.snapshot_cache else {}
     if cs:
         log(f"steady-state snapshot cache: {cs}")
-    return {
+    out = {
         "steady_state_pods_per_sec": round(steady_pps, 1),
         "pack_seconds_warm": round(pack_warm, 4),
         "pack_seconds_cold": round(pack_cold, 4),
@@ -686,6 +699,66 @@ def run_steady_state(args_cli, num_pods: int, num_nodes: int) -> dict:
         "steady_rows_reused": int(cs.get("pod_row_hits", 0)),
         "steady_rows_repacked": int(cs.get("pod_row_misses", 0)),
     }
+
+    # ---- fused-wave sweep: the same steady loop pinned to each K
+    # (models/fused_waves.py), plus the per-dispatch fixed-overhead probe.
+    # The probe times an already-compiled no-op jit with the fused step's
+    # readback footprint: every dispatch pays it regardless of program
+    # (the ~66ms axon-tunnel RTT on chip, sub-ms on local CPU), and a
+    # fused dispatch amortizes it over K dependent rounds — that quotient
+    # is fixed_overhead_ms_amortized[K].
+    raw_sweep = args_cli.waves
+    if raw_sweep is None:
+        raw_sweep = "1,2" if args_cli.smoke else "1,2,4,8"
+    sweep = [int(x) for x in raw_sweep.split(",") if x.strip()]
+    if not sweep:
+        return out
+    import jax
+
+    probe_buf = np.zeros(max(256, num_pods), np.int32)
+    probe = jax.jit(lambda x: x + 1)
+    np.asarray(probe(probe_buf))  # compile + warm
+    probe_walls = []
+    for _ in range(15):
+        t0 = time.perf_counter()
+        np.asarray(probe(probe_buf))
+        probe_walls.append(time.perf_counter() - t0)
+    fixed_ms = float(np.median(probe_walls)) * 1000.0
+    pps_at_k = {}
+    waves_seen = {}
+    for k in sweep:
+        store_k, _state_k = make_store()
+        sched_k = Scheduler(store_k, waves=k)
+        pl_k = CyclePipeline(sched_k)
+        pl_k.run_cycle(now=now)  # cold build + compile
+        walls_k, bound_k, waves_k = [], [], []
+        for r in range(1, warmup + rounds + 1):
+            apply_delta(store_k, r, now)
+            t = now + 2 * r
+            t0 = time.perf_counter()
+            res_k = pl_k.run_cycle(now=t)
+            wall = time.perf_counter() - t0
+            if r > warmup:
+                walls_k.append(wall)
+                bound_k.append(len(res_k.bound))
+                waves_k.append(res_k.waves)
+        pl_k.flush()
+        wsum = float(np.sum(walls_k))
+        pps_at_k[str(k)] = round(
+            float(np.sum(bound_k)) / wsum if wsum else 0.0, 1)
+        waves_seen[str(k)] = int(max(waves_k)) if waves_k else 0
+        log(f"wave sweep K={k}: {pps_at_k[str(k)]:,.1f} pods/s steady "
+            f"(median cycle {float(np.median(walls_k))*1000:.1f}ms, "
+            f"max logical cycles/dispatch {waves_seen[str(k)]}, "
+            f"amortized fixed overhead {fixed_ms / k:.2f}ms/round)")
+    out.update({
+        "dispatch_fixed_overhead_ms": round(fixed_ms, 3),
+        "fixed_overhead_ms_amortized": {
+            str(k): round(fixed_ms / k, 3) for k in sweep},
+        "pods_per_sec_at_k": pps_at_k,
+        "waves_consumed_at_k": waves_seen,
+    })
+    return out
 
 
 def run_full_chain(args_cli, num_pods: int, num_nodes: int,

@@ -13,10 +13,13 @@ python -m koordinator_tpu.analysis koordinator_tpu bench.py
 echo "== compileall =="
 python -m compileall -q koordinator_tpu bench.py tests hack/microbench.py
 
-echo "== serial-vs-pipelined cycle parity =="
-# same store fixture through the strictly serial path and the CyclePipeline:
-# bindings, failure sets and PodScheduled conditions must be byte-identical
-# (tier-1 runs the same fixture via tests/test_cycle_pipeline.py)
+echo "== serial-vs-pipelined + fused-wave cycle parity =="
+# same store fixture through the strictly serial path, the CyclePipeline,
+# AND the fused multi-wave path at K in {1,2,4,8}: bindings, failure sets
+# and PodScheduled conditions must be byte-identical — a fused-K cycle is
+# K sequential single-round cycles (tier-1 runs the same fixtures via
+# tests/test_cycle_pipeline.py and tests/test_fused_waves.py; the
+# readback-in-wave-body rule above keeps the wave kernels device-pure)
 JAX_PLATFORMS=cpu python -m koordinator_tpu.scheduler.pipeline_parity
 
 echo "== obs trace schema (golden fixture) =="

@@ -53,6 +53,59 @@ def _is_region_item(item: ast.withitem) -> bool:
             and call.args[0].value in _REGION_SPANS)
 
 
+# the wave-kernel modules: pure device code end to end. A host transfer
+# anywhere inside them runs INSIDE the fused dispatch's trace (or worse,
+# per wave), destroying exactly the dispatch amortization the fused
+# multi-wave design exists to buy.
+_WAVE_PATH_RE = re.compile(r"models/(fused_waves|wave_chain)\.py$")
+
+_WAVE_TRANSFER_TAILS = {"asarray", "item", "device_get",
+                        "block_until_ready"}
+
+
+def _is_device_asarray(func: ast.AST) -> bool:
+    """jnp.asarray is a device-side dtype coercion, not a host transfer —
+    only numpy's asarray (np./numpy./bare) pulls the value to host.
+    Covers the spellings jnp.asarray and jax.numpy.asarray."""
+    if not (isinstance(func, ast.Attribute) and func.attr == "asarray"):
+        return False
+    value = func.value
+    if isinstance(value, ast.Name):
+        return value.id == "jnp"
+    return (isinstance(value, ast.Attribute) and value.attr == "numpy"
+            and isinstance(value.value, ast.Name)
+            and value.value.id == "jax")
+
+
+@register
+class ReadbackInWaveBody(Rule):
+    name = "readback-in-wave-body"
+    severity = "error"
+    description = (
+        "host transfer (np.asarray / .item() / jax.device_get / "
+        "block_until_ready) inside a wave-kernel module "
+        "(models/fused_waves.py, models/wave_chain.py): the wave body is "
+        "traced into ONE fused device program precisely to amortize "
+        "dispatch/readback overhead over K rounds — a host transfer "
+        "inside it either breaks tracing or silently re-serializes every "
+        "wave; keep all readback in the cycle driver's designated sync "
+        "point or mark a deliberate exception with # koordlint: disable")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not _WAVE_PATH_RE.search(ctx.path):
+            return
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and _dotted_tail(node.func) in _WAVE_TRANSFER_TAILS
+                    and not _is_device_asarray(node.func)):
+                yield self.finding(
+                    ctx, node,
+                    f"{_dotted_tail(node.func)} transfers to host inside "
+                    "a wave-kernel module — the fused dispatch must stay "
+                    "a single device program; read back in the cycle "
+                    "driver instead")
+
+
 @register
 class BlockingReadbackInPipeline(Rule):
     name = "blocking-readback-in-pipeline"

@@ -321,6 +321,79 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode,
     return evaluate
 
 
+def commit_pod_state(fc: FullChainInputs, prod_mode: bool, state, i, found,
+                     best, zone_at_best):
+    """Apply pod ``i``'s tentative binding to the in-round device state.
+
+    ``state`` is the 11-tuple (requested, delta_np, delta_pr, numa_free,
+    bind_free, quota_used, aff_count, anti_cover, aff_exists, port_used,
+    vol_free) every full-chain kernel carries. Factored out of the serial
+    loop so the fused wave kernel (models/fused_waves.py) traces the
+    IDENTICAL update sequence — both its in-wave pass and its kept-only
+    replay pass call this function, so carried state can never drift from
+    what the serial kernel would have produced."""
+    inputs = fc.base
+    (requested, delta_np, delta_pr, numa_free, bind_free, quota_used,
+     aff_count, anti_cover, aff_exists, port_used, vol_free) = state
+    T = fc.aff_dom.shape[1]
+    PT = fc.port_used.shape[1]
+    req_fit = inputs.fit_requests[i]
+    req = fc.requests[i]
+    est = inputs.estimated[i]
+    is_prod_i = inputs.is_prod[i]
+    fnd = found.astype(jnp.float32)
+
+    def upd_row(mat, add_row):
+        new_row = mat[best] + fnd * add_row
+        return jax.lax.dynamic_update_slice(mat, new_row[None], (best, 0))
+
+    requested = upd_row(requested, req_fit)
+    delta_np = upd_row(delta_np, est)
+    if prod_mode:
+        delta_pr = upd_row(
+            delta_pr, jnp.where(is_prod_i, 1.0, 0.0) * est
+        )
+    new_zone_free = numa_spread_fill(numa_free[best], req, zone_at_best)
+    apply_numa = (found & fc.needs_numa[i]).astype(jnp.float32)
+    mixed = apply_numa * new_zone_free + (1.0 - apply_numa) * numa_free[best]
+    numa_free = jax.lax.dynamic_update_slice(
+        numa_free, mixed[None], (best, 0, 0)
+    )
+    bind_free = bind_free.at[best].add(
+        -fnd * jnp.where(fc.needs_bind[i], fc.cores_needed[i], 0.0)
+    )
+    # NodePorts: the placed pod binds its wanted slots on the node
+    if PT:
+        port_row = jnp.maximum(
+            port_used[best],
+            fnd * fc.pod_port_wants[i].astype(jnp.float32))
+        port_used = jax.lax.dynamic_update_slice(
+            port_used, port_row[None], (best, 0))
+    vol_free = vol_free.at[best].add(
+        -fnd * fc.vol_needed[i][fc.node_vol_group[best]])
+    quota_used = quota_used_add_row(
+        quota_used, req, fc.quota_id[i], fc.quota_ancestors, found
+    )
+    # inter-pod affinity: the placed pod raises the count of every
+    # term it matches across the chosen node's whole domain, flips
+    # the term's exists flag even on an unlabeled node, and — for
+    # terms it CARRIES as anti-affinity — raises the domain's
+    # anti_cover (symmetric anti-affinity for later pods)
+    for t in range(T):
+        chosen_dom = fc.aff_dom[best, t]
+        in_dom = (chosen_dom >= 0) & (fc.aff_dom[:, t] == chosen_dom)
+        inc = found & fc.pod_aff_match[i, t] & in_dom
+        aff_count = aff_count.at[:, t].add(inc.astype(jnp.float32))
+        inc_cov = found & fc.pod_anti_req[i, t] & in_dom
+        anti_cover = anti_cover.at[:, t].add(
+            inc_cov.astype(jnp.float32))
+        aff_exists = aff_exists.at[t].set(
+            aff_exists[t] | (found & fc.pod_aff_match[i, t]))
+    return (requested, delta_np, delta_pr, numa_free, bind_free,
+            quota_used, aff_count, anti_cover, aff_exists, port_used,
+            vol_free)
+
+
 def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
                           jit: bool = True, active_axes=None):
     """FullChainInputs -> (chosen[P], requested[N, R], quota_used[G, R]).
@@ -344,71 +417,15 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
         PT = fc.port_used.shape[1]
 
         def body(i, state):
-            (requested, delta_np, delta_pr, numa_free, bind_free,
-             quota_used, aff_count, anti_cover, aff_exists, port_used,
-             vol_free, chosen) = state
-            req_fit = inputs.fit_requests[i]
-            req = fc.requests[i]
-            est = inputs.estimated[i]
-            is_prod_i = inputs.is_prod[i]
+            chain_state, chosen = state[:-1], state[-1]
 
             found, best, zone_at_best, _admit, _s, _b, _mv = evaluate(
-                i, requested, delta_np, delta_pr, numa_free, bind_free,
-                quota_used, aff_count, anti_cover, aff_exists, port_used,
-                vol_free,
+                i, *chain_state,
             )
-            fnd = found.astype(jnp.float32)
-
-            def upd_row(mat, add_row):
-                new_row = mat[best] + fnd * add_row
-                return jax.lax.dynamic_update_slice(mat, new_row[None], (best, 0))
-
-            requested = upd_row(requested, req_fit)
-            delta_np = upd_row(delta_np, est)
-            if prod_mode:
-                delta_pr = upd_row(
-                    delta_pr, jnp.where(is_prod_i, 1.0, 0.0) * est
-                )
-            new_zone_free = numa_spread_fill(numa_free[best], req, zone_at_best)
-            apply_numa = (found & fc.needs_numa[i]).astype(jnp.float32)
-            mixed = apply_numa * new_zone_free + (1.0 - apply_numa) * numa_free[best]
-            numa_free = jax.lax.dynamic_update_slice(
-                numa_free, mixed[None], (best, 0, 0)
-            )
-            bind_free = bind_free.at[best].add(
-                -fnd * jnp.where(fc.needs_bind[i], fc.cores_needed[i], 0.0)
-            )
-            # NodePorts: the placed pod binds its wanted slots on the node
-            if PT:
-                port_row = jnp.maximum(
-                    port_used[best],
-                    fnd * fc.pod_port_wants[i].astype(jnp.float32))
-                port_used = jax.lax.dynamic_update_slice(
-                    port_used, port_row[None], (best, 0))
-            vol_free = vol_free.at[best].add(
-                -fnd * fc.vol_needed[i][fc.node_vol_group[best]])
-            quota_used = quota_used_add_row(
-                quota_used, req, fc.quota_id[i], fc.quota_ancestors, found
-            )
-            # inter-pod affinity: the placed pod raises the count of every
-            # term it matches across the chosen node's whole domain, flips
-            # the term's exists flag even on an unlabeled node, and — for
-            # terms it CARRIES as anti-affinity — raises the domain's
-            # anti_cover (symmetric anti-affinity for later pods)
-            for t in range(T):
-                chosen_dom = fc.aff_dom[best, t]
-                in_dom = (chosen_dom >= 0) & (fc.aff_dom[:, t] == chosen_dom)
-                inc = found & fc.pod_aff_match[i, t] & in_dom
-                aff_count = aff_count.at[:, t].add(inc.astype(jnp.float32))
-                inc_cov = found & fc.pod_anti_req[i, t] & in_dom
-                anti_cover = anti_cover.at[:, t].add(
-                    inc_cov.astype(jnp.float32))
-                aff_exists = aff_exists.at[t].set(
-                    aff_exists[t] | (found & fc.pod_aff_match[i, t]))
+            chain_state = commit_pod_state(
+                fc, prod_mode, chain_state, i, found, best, zone_at_best)
             chosen = chosen.at[i].set(jnp.where(found, best.astype(jnp.int32), -1))
-            return (requested, delta_np, delta_pr, numa_free, bind_free,
-                    quota_used, aff_count, anti_cover, aff_exists, port_used,
-                    vol_free, chosen)
+            return chain_state + (chosen,)
 
         R = inputs.fit_requests.shape[-1]
         init = (

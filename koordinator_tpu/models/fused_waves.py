@@ -1,0 +1,253 @@
+"""Fused multi-wave scheduling: K dependent rounds in ONE device dispatch.
+
+BENCH_r05 measured the production cycle's shape: a 10k x 5k full-chain
+round costs ~17ms of marginal kernel but every dispatch pays ~66ms of
+fixed overhead (dispatch + result-readback RTT through the axon tunnel),
+so realized throughput sits at ~1/5 of the marginal ceiling. The on-chip
+chain bench (BENCH_ONCHIP_CHAINS_*) already proved that chaining rounds
+inside one jit cancels the fixed cost; this module gives the production
+cycle that capability.
+
+One fused dispatch runs up to K WAVES, where wave w is exactly the
+scheduling round serial cycle w would run:
+
+  wave body =
+    1. evaluation pass — the serial full-chain round (the same
+       ``make_pod_evaluator`` + ``commit_pod_state`` the single-round
+       kernel traces, models/full_chain.py) over the still-pending pods,
+       producing tentative bindings with in-round state feedback;
+    2. gang Permit against the CARRIED assumed counters;
+    3. kept-only replay pass — the next wave's state is rebuilt from the
+       wave-start state by committing ONLY the pods that survived Permit,
+       in bind order. This mirrors what the host does between serial
+       cycles: reverted gang members never reach the store, so their
+       in-round reservations must not leak into the next round's state
+       (and NUMA zone choices are re-picked under the kept-only state,
+       the same way the host plugin allocates at Reserve).
+
+Carried device state: node requested/NUMA-free/bindable-cpu/port/volume
+state, quota used along the ancestor chains, gang assumed counters, the
+pod assigned-mask, and the LoadAware assigned-estimate sum ``est_sum``.
+The LoadAware score term is recomputed per wave as ``est_sum + adjusted``
+— the SAME two-operand association a next-cycle host rebuild produces
+(ops/loadaware.py exports the split), so carried state is bit-identical
+to what serial cycle w's snapshot would contain. A pod rejected in wave i
+because a node filled up (or a gang's quota was transiently held) retries
+in wave i+1 on-device, with no host round-trip.
+
+Readback is COMPACTED: a (pod_idx, node_idx, zone) binding buffer plus
+per-wave bound counts — not K full assignment vectors and none of the
+score/state matrices. The driver (scheduler/cycle.py) replays the waves
+host-side as logical cycles; scheduler/pipeline_parity.py gates that a
+fused-K cycle is byte-identical to K sequential single-round cycles.
+
+Waves run under ``lax.while_loop`` with early exit: a wave that commits
+nothing proves the fixpoint (the next wave would see identical state), so
+the remaining waves cost nothing on device.
+
+Known demotions (the driver falls back to K=1, the exact serial path):
+pending Reservation CRs (a CR bound in wave 1 changes the next cycle's
+nomination pre-pass), pending pods carrying PVCs (volume-group
+factorization regroups between cycles), ``score_according_prod_usage``
+(the prod score term is not carried in split form), and the gRPC sidecar
+path (the remote protocol is single-round).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from koordinator_tpu.models.full_chain import (
+    FullChainInputs,
+    commit_pod_state,
+    make_pod_evaluator,
+    resolve_balance_idx,
+    resolve_weight_idx,
+)
+from koordinator_tpu.ops.gang import gang_permit_mask
+from koordinator_tpu.ops.loadaware import LoadAwareArgs
+from koordinator_tpu.ops.numa import numa_zone_for_node
+
+MAX_WAVES = 8  # bounds the compile-cache key space; auto-K never exceeds it
+
+
+class FusedWaveOut(NamedTuple):
+    """Compacted readback of one fused dispatch."""
+
+    bind_pods: jnp.ndarray    # [P] int32 pod row indices in bind order, -1 pad
+    bind_nodes: jnp.ndarray   # [P] int32 node index per binding
+    bind_zones: jnp.ndarray   # [P] int32 replay-state NUMA zone (-1 = spread)
+    wave_counts: jnp.ndarray  # [K] int32 bindings committed per wave
+    waves_run: jnp.ndarray    # scalar int32 wave bodies actually executed
+
+
+def build_fused_wave_step(args: LoadAwareArgs, num_gangs: int,
+                          num_groups: int, waves: int, jit: bool = True,
+                          active_axes=None):
+    """(FullChainInputs, la_est[N, R], la_adj[N, R]) -> FusedWaveOut.
+
+    ``la_est``/``la_adj`` are the LoadAware nonprod score-term split
+    (build_loadaware_node_state's ``la_est_nonprod``/``la_adj_nonprod``),
+    sliced to the same active axes as the rest of the batch.
+    """
+    if not 1 <= waves <= MAX_WAVES:
+        raise ValueError(f"waves must be in [1, {MAX_WAVES}], got {waves}")
+    if args.score_according_prod_usage:
+        # the prod-branch term is not carried in split form; the driver
+        # demotes to the serial path before ever building this step
+        raise ValueError("fused waves do not support "
+                         "score_according_prod_usage — use the serial step")
+    weight_idx = resolve_weight_idx(args, active_axes)
+    bal_idx = resolve_balance_idx(active_axes)
+    prod_mode = False
+
+    def step(fc: FullChainInputs, la_est, la_adj):
+        inputs = fc.base
+        P, R = inputs.fit_requests.shape
+        N = inputs.allocatable.shape[0]
+
+        def wave_body(carry):
+            (assigned, requested, est_sum, numa_free, bind_free, quota_used,
+             aff_count, anti_cover, aff_exists, port_used, vol_free,
+             gang_assumed, out_pods, out_nodes, out_zones, n_out,
+             wave_counts, w, done) = carry
+
+            # the round's LoadAware base term, rebuilt-association exact:
+            # est_sum folds committed estimates in bind order onto the
+            # host's initial sum, then ONE add of the adjusted usage
+            term = est_sum + la_adj
+            active = inputs.pod_valid & ~assigned
+            fc_w = fc._replace(base=inputs._replace(
+                la_term_nonprod=term, pod_valid=active))
+            evaluate = make_pod_evaluator(fc_w, weight_idx, prod_mode,
+                                          bal_idx)
+
+            # ---- pass 1: the serial round (identical tracing to
+            # build_full_chain_step's body — decisions are by construction
+            # what serial cycle w's kernel would decide)
+            def body(i, state):
+                chain_state, chosen = state[:-1], state[-1]
+                found, best, zone_at_best, _adm, _s, _b, _mv = evaluate(
+                    i, *chain_state)
+                chain_state = commit_pod_state(
+                    fc_w, prod_mode, chain_state, i, found, best,
+                    zone_at_best)
+                chosen = chosen.at[i].set(
+                    jnp.where(found, best.astype(jnp.int32), -1))
+                return chain_state + (chosen,)
+
+            init = (
+                requested,
+                jnp.zeros((N, R), jnp.float32),
+                jnp.zeros((N, R), jnp.float32),
+                numa_free,
+                bind_free,
+                quota_used,
+                aff_count,
+                anti_cover,
+                aff_exists,
+                port_used,
+                vol_free,
+                jnp.full(P, -1, jnp.int32),
+            )
+            chosen = jax.lax.fori_loop(0, P, body, init)[-1]
+
+            # ---- Permit barrier against the CARRIED assumed counters
+            keep = gang_permit_mask(
+                chosen, fc.gang_id, fc.gang_min_member, gang_assumed,
+                fc.gang_group_id, num_gangs, num_groups,
+            )
+            kept = (chosen >= 0) & keep
+            kept_count = jnp.sum(kept.astype(jnp.int32))
+
+            # ---- pass 2: kept-only replay from the WAVE-START state.
+            # Reverted gang reservations never persisted host-side, so the
+            # next wave's base state commits only survivors, in bind
+            # order; est_sum rides the delta_np slot so the fold order
+            # matches the assign-cache append order, and the NUMA zone is
+            # re-picked under replay state (= what the host plugin's
+            # Reserve sees).
+            def rbody(i, st):
+                chain_state = st[:11]
+                out_p, out_n, out_z, cnt = st[11:]
+                k = kept[i]
+                best = jnp.maximum(chosen[i], 0)
+                zone = numa_zone_for_node(
+                    fc.requests[i], fc.needs_numa[i],
+                    chain_state[3][best], fc.numa_policy[best])
+                chain_state = commit_pod_state(
+                    fc_w, prod_mode, chain_state, i, k, best, zone)
+                slot = jnp.where(k, cnt, P)
+                out_p = out_p.at[slot].set(i, mode="drop")
+                out_n = out_n.at[slot].set(chosen[i], mode="drop")
+                out_z = out_z.at[slot].set(zone, mode="drop")
+                return chain_state + (out_p, out_n, out_z,
+                                      cnt + k.astype(jnp.int32))
+
+            rinit = (
+                requested,
+                est_sum,                       # delta_np slot: the carry
+                jnp.zeros((N, R), jnp.float32),  # delta_pr: dead (prod off)
+                numa_free,
+                bind_free,
+                quota_used,
+                aff_count,
+                anti_cover,
+                aff_exists,
+                port_used,
+                vol_free,
+                out_pods, out_nodes, out_zones, n_out,
+            )
+            rout = jax.lax.fori_loop(0, P, rbody, rinit)
+            (requested, est_sum, _dpr, numa_free, bind_free, quota_used,
+             aff_count, anti_cover, aff_exists, port_used, vol_free,
+             out_pods, out_nodes, out_zones, n_out) = rout
+
+            in_gang = fc.gang_id >= 0
+            gang_assumed = gang_assumed + jax.ops.segment_sum(
+                (kept & in_gang).astype(jnp.float32),
+                jnp.maximum(fc.gang_id, 0), num_segments=num_gangs)
+            assigned = assigned | kept
+            wave_counts = wave_counts.at[w].set(kept_count)
+            # a zero-commit wave is a fixpoint: the next wave would see
+            # identical state and commit nothing again
+            done = kept_count == 0
+            return (assigned, requested, est_sum, numa_free, bind_free,
+                    quota_used, aff_count, anti_cover, aff_exists,
+                    port_used, vol_free, gang_assumed, out_pods, out_nodes,
+                    out_zones, n_out, wave_counts, w + 1, done)
+
+        def cond(carry):
+            w, done = carry[-2], carry[-1]
+            return (w < waves) & ~done
+
+        init = (
+            jnp.zeros(P, bool),
+            inputs.requested,
+            la_est,
+            fc.numa_free,
+            fc.bind_free,
+            fc.quota_used,
+            fc.aff_count,
+            fc.anti_cover,
+            jnp.asarray(fc.aff_exists, bool),
+            fc.port_used,
+            fc.vol_free,
+            fc.gang_assumed,
+            jnp.full(P, -1, jnp.int32),
+            jnp.full(P, -1, jnp.int32),
+            jnp.full(P, -1, jnp.int32),
+            jnp.int32(0),
+            jnp.zeros(waves, jnp.int32),
+            jnp.int32(0),
+            jnp.bool_(False),
+        )
+        out = jax.lax.while_loop(cond, wave_body, init)
+        return FusedWaveOut(
+            bind_pods=out[12], bind_nodes=out[13], bind_zones=out[14],
+            wave_counts=out[16], waves_run=out[17])
+
+    return jax.jit(step) if jit else step

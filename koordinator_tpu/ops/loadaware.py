@@ -172,6 +172,14 @@ def build_loadaware_node_state(
     term_pr = np.zeros((n_pad, R), np.float32)
     score_valid = np.zeros(n_pad, bool)
     filter_skip = np.zeros(n_pad, bool)
+    # the non-prod score term split into its two components, so the fused
+    # wave kernel (models/fused_waves.py) can carry the assigned-estimate
+    # sum on device and recompute term = est_sum + adjusted per wave with
+    # the SAME association a next-cycle host rebuild would produce
+    # (term_np == est_np_arr + adj_np_arr holds bit-exactly: the host adds
+    # the identical two operands below)
+    est_np_arr = np.zeros((n_pad, R), np.float32)
+    adj_np_arr = np.zeros((n_pad, R), np.float32)
 
     for i, node in enumerate(nodes):
         nm = node_metrics.get(node.meta.name)
@@ -274,6 +282,8 @@ def build_loadaware_node_state(
             # decided per-resource on the whole vector
             adjusted = np.where(score_src >= est_actual, score_src - est_actual, score_src)
             term += adjusted
+            adj_np_arr[i] = adjusted
+        est_np_arr[i] = est_np
         term_np[i] = term
 
         # prod branch (scoreAccordingProdUsage): prod pod metrics only
@@ -295,6 +305,9 @@ def build_loadaware_node_state(
         "la_term_prod": term_pr,
         "la_score_valid": score_valid,
         "la_filter_skip": filter_skip,
+        # consumed only by the fused wave path (not part of ScheduleInputs)
+        "la_est_nonprod": est_np_arr,
+        "la_adj_nonprod": adj_np_arr,
     }
 
 

@@ -75,6 +75,26 @@ def numa_admit_row(
     return ok, zone
 
 
+def numa_zone_for_node(
+    request: jnp.ndarray,      # [R] pod request (packed units)
+    needs_numa: jnp.ndarray,   # scalar bool
+    numa_free_n: jnp.ndarray,  # [K, R] free of ONE node
+    policy_n: jnp.ndarray,     # scalar int32
+) -> jnp.ndarray:
+    """Scalar zone choice for a single node: the single-node restriction of
+    ``numa_admit_row``'s zone output (-1 when not single-numa). Used by the
+    fused wave kernel's kept-only replay pass, where the zone must be
+    re-picked under the replay state — the same first-fitting-zone rule the
+    host plugin's width-1 hint uses at Reserve."""
+    fits_zone = jnp.all(
+        (request[None, :] <= 0) | (request[None, :] <= numa_free_n), axis=-1)
+    any_zone = jnp.any(fits_zone)
+    first_zone = jnp.argmax(fits_zone).astype(jnp.int32)
+    single = policy_n == POLICY_SINGLE_NUMA_NODE
+    return jnp.where(single & any_zone & needs_numa, first_zone,
+                     jnp.int32(-1))
+
+
 def cpuset_filter_row(
     needs_bind: jnp.ndarray,    # scalar bool: pod requires cpuset binding
     cores_needed: jnp.ndarray,  # scalar float: whole cpus requested

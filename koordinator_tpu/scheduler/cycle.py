@@ -76,6 +76,134 @@ RESERVATION_POD_PREFIX = "__reservation__/"
 DIAGNOSED_REASONS = ("no feasible node", "admission rejected")
 
 
+def waves_from_env():
+    """KOORD_TPU_WAVES=K pins the fused multi-wave depth (K rounds per
+    device dispatch, models/fused_waves.py); "auto" (the default) picks K
+    from the pending-queue depth, K=1 being the exact serial path."""
+    import os
+
+    from koordinator_tpu.models.fused_waves import MAX_WAVES
+
+    raw = os.environ.get("KOORD_TPU_WAVES", "auto").strip().lower()
+    if raw in ("", "auto"):
+        return "auto"
+    try:
+        return max(1, min(int(raw), MAX_WAVES))
+    except ValueError:
+        logger.warning("KOORD_TPU_WAVES=%r not an int; using auto", raw)
+        return "auto"
+
+
+def _auto_waves(queue_depth: int) -> int:
+    """Depth-based auto-K: the fused dispatch amortizes the fixed
+    dispatch+readback overhead over K dependent rounds, but each extra
+    wave costs real device work, so shallow queues (where one round
+    drains everything bindable and the fixed overhead is small relative
+    to host work anyway) stay serial. Powers of two only, so the
+    compile cache sees at most 4 distinct K values."""
+    if queue_depth >= 4096:
+        return 8
+    if queue_depth >= 1024:
+        return 4
+    if queue_depth >= 256:
+        return 2
+    return 1
+
+
+def _np_spread_fill(row: np.ndarray, req: np.ndarray, zone: int) -> None:
+    """In-place numpy replica of ops/numa.numa_spread_fill on one node's
+    [K, R] free block: all from ``zone`` when single-numa, else the
+    lowest-zones-first waterfall. Same float32 operations in the same
+    order as the kernel, so the mirror cannot drift by a ULP."""
+    if zone >= 0:
+        row[zone] -= req
+        return
+    remaining = req.astype(np.float32, copy=True)
+    for k in range(row.shape[0]):
+        take = np.minimum(row[k], remaining)
+        row[k] = row[k] - take
+        remaining = remaining - take
+
+
+class _WaveStateMirror:
+    """Host numpy replica of the fused kernel's carried node/quota state
+    (models/fused_waves.py), advanced wave by wave with the read-back
+    bindings. Feeds per-wave unschedulability diagnosis
+    (scheduler/diagnose.py) the SAME wave-start state serial cycle w's
+    packed batch would contain — a pod that stays unbound across waves
+    must report cycle-w's per-stage counts, not cycle-1's."""
+
+    def __init__(self, fc) -> None:
+        self._fc = fc
+        self.requested = np.array(fc.base.requested, np.float32, copy=True)
+        self.quota_used = np.array(fc.quota_used, np.float32, copy=True)
+        self.numa_free = np.array(fc.numa_free, np.float32, copy=True)
+        self.bind_free = np.array(fc.bind_free, np.float32, copy=True)
+        self.port_used = np.array(fc.port_used, np.float32, copy=True)
+        self.vol_free = np.array(fc.vol_free, np.float32, copy=True)
+        self.aff_count = np.array(fc.aff_count, np.float32, copy=True)
+        self.anti_cover = np.array(fc.anti_cover, np.float32, copy=True)
+        self.aff_exists = np.array(fc.aff_exists, bool, copy=True)
+        # static per-pod gathers
+        self._fit_requests = np.asarray(fc.base.fit_requests, np.float32)
+        self._requests = np.asarray(fc.requests, np.float32)
+        self._needs_numa = np.asarray(fc.needs_numa, bool)
+        self._needs_bind = np.asarray(fc.needs_bind, bool)
+        self._cores = np.asarray(fc.cores_needed, np.float32)
+        self._wants = np.asarray(fc.pod_port_wants, bool)
+        self._vol_needed = np.asarray(fc.vol_needed, np.float32)
+        self._vol_group = np.asarray(fc.node_vol_group)
+        self._quota_id = np.asarray(fc.quota_id)
+        self._ancestors = np.asarray(fc.quota_ancestors)
+        self._aff_dom = np.asarray(fc.aff_dom, np.float32)
+        self._aff_match = np.asarray(fc.pod_aff_match, bool)
+        self._anti_req = np.asarray(fc.pod_anti_req, bool)
+
+    def commit(self, i: int, node: int, zone: int) -> None:
+        """Apply one committed binding, mirroring commit_pod_state."""
+        self.requested[node] += self._fit_requests[i]
+        req = self._requests[i]
+        if self._needs_numa[i]:
+            _np_spread_fill(self.numa_free[node], req, zone)
+        if self._needs_bind[i]:
+            self.bind_free[node] -= self._cores[i]
+        if self._wants.shape[1]:
+            self.port_used[node] = np.maximum(
+                self.port_used[node],
+                self._wants[i].astype(np.float32))
+        self.vol_free[node] -= self._vol_needed[i][self._vol_group[node]]
+        qid = int(self._quota_id[i])
+        if qid >= 0:
+            for g in self._ancestors[qid]:
+                if g >= 0:
+                    self.quota_used[g] += req
+        for t in range(self._aff_dom.shape[1]):
+            dom = self._aff_dom[node, t]
+            if self._aff_match[i, t]:
+                self.aff_exists[t] = True
+                if dom >= 0:
+                    self.aff_count[self._aff_dom[:, t] == dom, t] += 1.0
+            if self._anti_req[i, t] and dom >= 0:
+                self.anti_cover[self._aff_dom[:, t] == dom, t] += 1.0
+
+    def patched_fc(self):
+        """A FullChainInputs view with the mirror's CURRENT state frozen
+        in (copies: the deferred-diagnosis queue may hold it while later
+        waves advance the mirror)."""
+        fc = self._fc
+        return fc._replace(
+            base=fc.base._replace(requested=self.requested.copy()),
+            quota_used=self.quota_used.copy(),
+            numa_free=self.numa_free.copy(),
+            bind_free=self.bind_free.copy(),
+            port_used=self.port_used.copy(),
+            vol_free=self.vol_free.copy(),
+            aff_count=self.aff_count.copy(),
+            anti_cover=self.anti_cover.copy(),
+            aff_exists=self.aff_exists.copy(),
+        )
+
+
 class Scheduler:
     """koord-scheduler analog: batched cycles against the object store."""
 
@@ -87,6 +215,7 @@ class Scheduler:
         config: Optional["SchedulerConfiguration"] = None,
         elector=None,
         sidecar_address: Optional[str] = None,
+        waves=None,
     ):
         from koordinator_tpu.scheduler.config import SchedulerConfiguration
         from koordinator_tpu.scheduler.plugins.reservation import (
@@ -163,6 +292,10 @@ class Scheduler:
         self.tracer = Tracer()
         self._step_cache: Dict[Tuple, object] = {}
         self._last_step_compiled = False
+        # fused multi-wave depth: K rounds per device dispatch
+        # (models/fused_waves.py). "auto" picks from queue depth per
+        # cycle; an int pins it. K=1 always takes the exact serial path.
+        self.waves_spec = waves_from_env() if waves is None else waves
         # SURVEY 7 step 6: the host event loop may offload the kernel pass
         # to a gRPC sidecar (the Go<->JAX integration shape); transport
         # failures degrade to the in-process step, never wedging the cycle
@@ -179,6 +312,10 @@ class Scheduler:
         self._flushed_this_cycle = False
         # last DeviceSnapshot stats snapshot, for counter deltas
         self._upload_stats_last: Dict[str, int] = {}
+        # admission grouping of the last encode: raw arrays, with the
+        # dict view materialized lazily on the preemption path
+        self._last_admission_raw = None
+        self._last_admission = None
         # incremental snapshot packing (SURVEY 7: caches become
         # device-resident arrays updated by deltas) — event-driven memos
         # replacing the per-cycle cluster walks; gate off for the
@@ -407,8 +544,65 @@ class Scheduler:
         self._step_cache[key] = step
         return step
 
+    def _get_fused_step(self, signature: Tuple, ng: int, ngroups: int,
+                        active, waves: int) -> object:
+        from koordinator_tpu.models.fused_waves import build_fused_wave_step
+
+        key = ("fused", waves, signature, ng, ngroups, tuple(active))
+        step = self._step_cache.get(key)
+        if step is not None:
+            self._last_step_compiled = False
+            scheduler_metrics.COMPILE_CACHE_HITS.inc()
+            return step
+        self._last_step_compiled = True
+        scheduler_metrics.COMPILE_CACHE_MISSES.inc()
+        with self.tracer.span("compile", signature=str(key)):
+            step = build_fused_wave_step(
+                self.args, ng, ngroups, waves=waves, active_axes=active)
+        self._step_cache[key] = step
+        return step
+
+    def _effective_waves(self, pending: List[Pod],
+                         pending_reservations: Dict[str, Reservation],
+                         override=None) -> int:
+        """Resolve this cycle's fused-wave depth. Demotions to K=1 keep
+        the fused path exactly equivalent to K serial cycles (see
+        models/fused_waves.py module doc for why each case cannot be
+        carried on-device)."""
+        from koordinator_tpu.models.fused_waves import MAX_WAVES
+
+        spec = self.waves_spec if override is None else override
+        k = _auto_waves(len(pending)) if spec == "auto" else int(spec)
+        k = max(1, min(k, MAX_WAVES))
+        if k == 1:
+            return 1
+        if self._sidecar_client is not None:
+            return 1  # the sidecar RPC protocol is single-round
+        if pending_reservations:
+            # a Reservation CR bound in wave 1 turns Available and feeds
+            # the NEXT cycle's nomination pre-pass — not expressible as
+            # carried kernel state
+            return 1
+        if self.args.score_according_prod_usage:
+            return 1  # prod score term is not carried in split form
+        if any(p.spec.pvc_names for p in pending):
+            # the volume-group factorization regroups nodes between
+            # cycles once a claim-carrying pod binds
+            return 1
+        from koordinator_tpu.scheduler.frameworkext import ScoreTransformer
+
+        if any(isinstance(t, ScoreTransformer)
+               for t in self.extender.transformers):
+            # a ScoreTransformer may rewrite la_term_nonprod (or any fc
+            # field) AFTER the build; the fused waves recompute the term
+            # from the pre-transform est/adj split every wave, which
+            # would silently discard the rewrite
+            return 1
+        return k
+
     # ------------------------------------------------------------------
-    def run_cycle(self, now: Optional[float] = None) -> CycleResult:
+    def run_cycle(self, now: Optional[float] = None,
+                  waves=None) -> CycleResult:
         now = time.time() if now is None else now
         if self.elector is not None and not self.elector.tick(now):
             return CycleResult(skipped_not_leader=True)
@@ -421,7 +615,7 @@ class Scheduler:
         # so no return path can ship a zero duration — the old three-site
         # assignment pattern broke exactly that way.
         with self.tracer.span("cycle") as root:
-            self._run_cycle_traced(now, result)
+            self._run_cycle_traced(now, result, waves_override=waves)
             # a cycle with no local kernel window (empty queue, sidecar
             # path) never reached the overlap flush: drain carried-over
             # deferred writes here so they cannot linger unboundedly —
@@ -438,7 +632,8 @@ class Scheduler:
         self.extender.monitor.record(result)
         return result
 
-    def _run_cycle_traced(self, now: float, result: CycleResult) -> None:
+    def _run_cycle_traced(self, now: float, result: CycleResult,
+                          waves_override=None) -> None:
         # [ResizePod gate] in-place resize of assigned pods, before the
         # batch pass sees their requests (frameworkext factory
         # RunReservePluginsReserve + RunResizePod analog)
@@ -515,58 +710,28 @@ class Scheduler:
         if not pending:
             return
 
+        # ---- fused multi-wave path: K dependent rounds in one device
+        # dispatch, replayed host-side as logical cycles (byte-identical
+        # to K sequential single-round cycles — pipeline_parity gates it)
+        k_waves = self._effective_waves(pending, pending_reservations,
+                                        waves_override)
+        if k_waves > 1:
+            # _fused_wave_cycles refreshes pod-group status at the end of
+            # every logical cycle — no trailing refresh here, or a fused
+            # K-cycle would walk the groups K+1 times where K serial
+            # cycles walk them K times
+            self._fused_wave_cycles(pending, now, ctx, result,
+                                    pending_reservations, originals,
+                                    k_waves)
+            return
+
         # ---- batched kernel pass
         rejected_pods, failed_pods = self._batch_pass(
             pending, now, ctx, result, pending_reservations
         )
 
-        # ---- PostFilter: ElasticQuota preemption (preempt.go). Quota-rejected
-        # non-gang pods try to reclaim from lower-priority same-group members;
-        # if any round evicts victims, one kernel rerun retries every pod that
-        # is still unbound (the reference's nominate-then-reschedule collapses
-        # into an in-cycle retry because victims terminate synchronously here).
-        any_victims = False
-        if self.preemptor is not None and rejected_pods:
-            quota_rejected = [
-                p for p in rejected_pods if p.quota_name and not p.gang_name
-            ]
-            for round_ in self.preemptor.post_filter(quota_rejected):
-                any_victims = True
-                result.preempted_victims.extend(round_.victim_keys)
-        # ---- PostFilter: DefaultPreemption (the vendored kube fallback) —
-        # pods with no feasible node try priority preemption; victims
-        # terminate synchronously and the kernel rerun is the real gate.
-        # The attempted-latch stops a pod the kernel STILL rejects (e.g.
-        # spread/NUMA constraints the host dry-run cannot see) from
-        # draining a fresh victim set EVERY cycle: a latched pod may retry
-        # only every PREEMPT_RETRY_CYCLES (cluster state may have unblocked
-        # it by then — bounded drain instead of either extreme). Keys of
-        # pods that bound or left the queue are dropped each cycle.
-        PREEMPT_RETRY_CYCLES = 5
-        attempted: Dict[str, int] = getattr(self, "_preempt_attempted", {})
-        self._preempt_attempted = attempted
-        self._cycle_seq = getattr(self, "_cycle_seq", 0) + 1
-        still_failed_keys = {p.meta.key for p, _ in failed_pods}
-        for key in [k for k in attempted if k not in still_failed_keys]:
-            del attempted[key]
-        no_fit = [
-            p for p, reason in failed_pods
-            if reason == "no feasible node" and not p.gang_name
-            and self._cycle_seq - attempted.get(p.meta.key, -10**9)
-            >= PREEMPT_RETRY_CYCLES
-        ]
-        if no_fit:
-            from koordinator_tpu.scheduler.preempt import DefaultPreemption
-
-            preempter = DefaultPreemption(
-                self.store,
-                kernel_admission=getattr(self, "_last_admission", None),
-                attempt_seed=self._cycle_seq,
-            )
-            for round_ in preempter.post_filter(no_fit):
-                any_victims = True
-                attempted[round_.preemptor_key] = self._cycle_seq
-                result.preempted_victims.extend(round_.victim_keys)
+        any_victims = self._post_filter_preempt(
+            rejected_pods, failed_pods, result)
         if any_victims:
             # retry transforms from the ORIGINAL queued pods, not the
             # already-transformed views — a non-idempotent rewrite would
@@ -583,7 +748,7 @@ class Scheduler:
                 retry, now, ctx, result, pending_reservations
             )
         for b in result.bound:
-            attempted.pop(b.pod_key, None)
+            self._preempt_attempted.pop(b.pod_key, None)
 
         for pod in rejected_pods:
             result.rejected.append(pod.meta.key)
@@ -602,6 +767,63 @@ class Scheduler:
 
         if gang_plugin is not None:
             gang_plugin.update_pod_group_status(self.store, now)
+
+    # ------------------------------------------------------------------
+    def _post_filter_preempt(self, rejected_pods: List[Pod],
+                             failed_pods: List[Tuple[Pod, str]],
+                             result: CycleResult) -> bool:
+        """PostFilter preemption for ONE logical scheduling cycle: the
+        shared block behind both the serial flow and every fused-wave
+        logical cycle, so their preemption cadence can never drift.
+        Advances the cycle sequence (the rotation/latch clock) and returns
+        whether any victims were evicted (the caller reruns the kernel
+        then, exactly as the reference's nominate-then-reschedule).
+
+        ElasticQuota preemption (preempt.go): quota-rejected non-gang pods
+        try to reclaim from lower-priority same-group members.
+        DefaultPreemption (the vendored kube fallback): pods with no
+        feasible node try priority preemption; victims terminate
+        synchronously and the kernel rerun is the real gate. The
+        attempted-latch stops a pod the kernel STILL rejects (e.g.
+        spread/NUMA constraints the host dry-run cannot see) from
+        draining a fresh victim set EVERY cycle: a latched pod may retry
+        only every PREEMPT_RETRY_CYCLES (cluster state may have unblocked
+        it by then — bounded drain instead of either extreme). Keys of
+        pods that bound or left the queue are dropped each cycle."""
+        any_victims = False
+        if self.preemptor is not None and rejected_pods:
+            quota_rejected = [
+                p for p in rejected_pods if p.quota_name and not p.gang_name
+            ]
+            for round_ in self.preemptor.post_filter(quota_rejected):
+                any_victims = True
+                result.preempted_victims.extend(round_.victim_keys)
+        PREEMPT_RETRY_CYCLES = 5
+        attempted: Dict[str, int] = getattr(self, "_preempt_attempted", {})
+        self._preempt_attempted = attempted
+        self._cycle_seq = getattr(self, "_cycle_seq", 0) + 1
+        still_failed_keys = {p.meta.key for p, _ in failed_pods}
+        for key in [k for k in attempted if k not in still_failed_keys]:
+            del attempted[key]
+        no_fit = [
+            p for p, reason in failed_pods
+            if reason == "no feasible node" and not p.gang_name
+            and self._cycle_seq - attempted.get(p.meta.key, -10**9)
+            >= PREEMPT_RETRY_CYCLES
+        ]
+        if no_fit:
+            from koordinator_tpu.scheduler.preempt import DefaultPreemption
+
+            preempter = DefaultPreemption(
+                self.store,
+                kernel_admission=self._resolve_admission(),
+                attempt_seed=self._cycle_seq,
+            )
+            for round_ in preempter.post_filter(no_fit):
+                any_victims = True
+                attempted[round_.preemptor_key] = self._cycle_seq
+                result.preempted_victims.extend(round_.victim_keys)
+        return any_victims
 
     # ------------------------------------------------------------------
     def _write_unschedulable_conditions(
@@ -710,20 +932,28 @@ class Scheduler:
             self.store.update(KIND_POD, patched)
 
     # ------------------------------------------------------------------
-    def _batch_pass(
-        self,
-        pending: List[Pod],
-        now: float,
-        ctx: CycleContext,
-        result: CycleResult,
-        pending_reservations: Dict[str, Reservation],
-    ) -> Tuple[List[Pod], List[Tuple[Pod, str]]]:
-        """One snapshot -> kernel -> bind pass. Appends bindings to `result`
-        and returns (rejected_pods, failed) still unbound — `failed` carries
-        (pod, reason) so Reserve/PreBind veto reasons survive to dispatch —
-        the caller decides whether to retry them (preemption) or record them."""
-        rejected_pods: List[Pod] = []
-        failed_pods: List[Tuple[Pod, str]] = []
+    def _resolve_admission(self):
+        """The (node -> group, pod key -> mask) dicts host-side dry-runs
+        consult. Built lazily from the raw arrays the last encode stashed:
+        materializing 10k-entry dicts on every cycle charged the hot path
+        for a mapping only the (rare) preemption path reads."""
+        raw = getattr(self, "_last_admission_raw", None)
+        if raw is None:
+            return None
+        if self._last_admission is None:
+            node_group_arr, node_names, pod_mask_arr, pod_keys = raw
+            self._last_admission = (
+                {n: int(node_group_arr[i]) for i, n in enumerate(node_names)},
+                {key: int(pod_mask_arr[i])
+                 for i, key in enumerate(pod_keys)},
+            )
+        return self._last_admission
+
+    def _encode_batch(self, pending: List[Pod], now: float,
+                      ctx: CycleContext):
+        """Snapshot + encode: store objects -> packed FullChainInputs.
+        Returns (fc, pods, nodes, ng, ngroups, active) or None when no
+        schedulable node exists. Shared by the serial and fused paths."""
         # pods arrive already view-transformed (run_cycle runs BeforePreFilter
         # ahead of the nomination pre-pass); here the state-level transformer
         # chain runs: ClusterState rewrites, then packed-input rewrites
@@ -734,7 +964,7 @@ class Scheduler:
             ssp.attributes["nodes"] = str(len(state.nodes))
             ssp.attributes["pods"] = str(len(pending))
         if not state.nodes:
-            return rejected_pods, [(p, "no schedulable node") for p in pending]
+            return None
         with self.tracer.span("encode"):
             cs = (self.snapshot_cache.stats
                   if self.snapshot_cache is not None else None)
@@ -757,14 +987,16 @@ class Scheduler:
             # encoding — the raw label check can be more permissive when
             # the signature budget overflowed, and the dry-run must never
             # accept a node the kernel cannot bind (it would evict victims
-            # in vain)
-            node_group_arr = np.asarray(fc.node_taint_group)
-            pod_mask_arr = np.asarray(fc.pod_taint_mask)
-            self._last_admission = (
-                {n.meta.name: int(node_group_arr[i])
-                 for i, n in enumerate(state.nodes)},
-                {key: int(pod_mask_arr[i]) for i, key in enumerate(pods.keys)},
+            # in vain). Raw arrays only: _resolve_admission materializes
+            # the dicts on the (rare) preemption path instead of charging
+            # every cycle for them.
+            self._last_admission_raw = (
+                np.asarray(fc.node_taint_group),
+                [n.meta.name for n in state.nodes],
+                np.asarray(fc.pod_taint_mask),
+                list(pods.keys),
             )
+            self._last_admission = None
             fc = self.extender.transform_before_score(fc, ctx)
             fc, active = reduce_to_active_axes(fc)
             # keep the packed batch for end-of-cycle unschedulability
@@ -774,6 +1006,40 @@ class Scheduler:
             self._last_batch = (
                 fc, {key: j for j, key in enumerate(pods.keys)},
                 len(state.nodes))
+        return fc, pods, nodes, ng, ngroups, active
+
+    def _record_upload_deltas(self) -> None:
+        """DeviceSnapshot stats -> per-cycle counter deltas."""
+        ds = self.device_snapshot.stats
+        prev_ds = self._upload_stats_last
+        for key, counter in (
+            ("reused", scheduler_metrics.UPLOAD_FIELDS_REUSED),
+            ("scattered", scheduler_metrics.UPLOAD_FIELDS_SCATTERED),
+            ("put", scheduler_metrics.UPLOAD_FIELDS_PUT),
+            ("bytes_scattered", scheduler_metrics.UPLOAD_BYTES_SCATTERED),
+            ("bytes_put", scheduler_metrics.UPLOAD_BYTES_PUT),
+        ):
+            counter.inc(ds[key] - prev_ds.get(key, 0))
+        self._upload_stats_last = dict(ds)
+
+    def _batch_pass(
+        self,
+        pending: List[Pod],
+        now: float,
+        ctx: CycleContext,
+        result: CycleResult,
+        pending_reservations: Dict[str, Reservation],
+    ) -> Tuple[List[Pod], List[Tuple[Pod, str]]]:
+        """One snapshot -> kernel -> bind pass. Appends bindings to `result`
+        and returns (rejected_pods, failed) still unbound — `failed` carries
+        (pod, reason) so Reserve/PreBind veto reasons survive to dispatch —
+        the caller decides whether to retry them (preemption) or record them."""
+        rejected_pods: List[Pod] = []
+        failed_pods: List[Tuple[Pod, str]] = []
+        enc = self._encode_batch(pending, now, ctx)
+        if enc is None:
+            return rejected_pods, [(p, "no schedulable node") for p in pending]
+        fc, pods, nodes, ng, ngroups, active = enc
         step = self._get_step(
             (pods.padded_size, nodes.padded_size, fc.quota_runtime.shape[0]),
             ng, ngroups, active,
@@ -803,39 +1069,38 @@ class Scheduler:
                     # deltas go up as donated scatters
                     # (snapshot_cache.DeviceSnapshot)
                     fc = self.device_snapshot.upload(fc)
-                    # counter deltas against the cumulative snapshot stats
-                    ds = self.device_snapshot.stats
-                    prev_ds = self._upload_stats_last
-                    for key, counter in (
-                        ("reused", scheduler_metrics.UPLOAD_FIELDS_REUSED),
-                        ("scattered",
-                         scheduler_metrics.UPLOAD_FIELDS_SCATTERED),
-                        ("put", scheduler_metrics.UPLOAD_FIELDS_PUT),
-                        ("bytes_scattered",
-                         scheduler_metrics.UPLOAD_BYTES_SCATTERED),
-                        ("bytes_put", scheduler_metrics.UPLOAD_BYTES_PUT),
-                    ):
-                        counter.inc(ds[key] - prev_ds.get(key, 0))
-                    self._upload_stats_last = dict(ds)
+                    self._record_upload_deltas()
+                    self.device_snapshot.begin_dispatch()
                 t_dispatch = time.perf_counter()
-                chosen, _, _ = step(fc)  # async dispatch — no host sync yet
-                if self.pipeline_mode:
-                    # overlap window: the previous cycle's deferred host
-                    # work (unschedulability diagnosis + condition writes)
-                    # runs while the device executes this cycle's kernel
-                    self.flush_deferred()
-                    with self.tracer.span("overlap_wait"):
-                        # the pipeline's single designated sync point:
-                        # bind needs the chosen vector, nothing before does
+                try:
+                    chosen, _, _ = step(fc)  # async dispatch — no sync yet
+                    if self.pipeline_mode:
+                        # overlap window: the previous cycle's deferred
+                        # host work (unschedulability diagnosis +
+                        # condition writes) runs while the device
+                        # executes this cycle's kernel
+                        self.flush_deferred()
+                        with self.tracer.span("overlap_wait"):
+                            # the pipeline's single designated sync point:
+                            # bind needs the chosen vector, nothing
+                            # before does
+                            # koordlint: disable=blocking-readback-in-pipeline
+                            chosen = np.asarray(chosen)
+                    else:
+                        # serial path: block immediately (the pre-pipeline
+                        # behavior, and the KOORD_TPU_PIPELINE=0 fallback)
                         # koordlint: disable=blocking-readback-in-pipeline
                         chosen = np.asarray(chosen)
-                else:
-                    # serial path: block immediately (the pre-pipeline
-                    # behavior, and the KOORD_TPU_PIPELINE=0 fallback)
-                    # koordlint: disable=blocking-readback-in-pipeline
-                    chosen = np.asarray(chosen)
+                finally:
+                    if self.device_snapshot is not None:
+                        self.device_snapshot.end_dispatch()
                 result.device_busy_seconds += (
                     time.perf_counter() - t_dispatch)
+                # local dispatch only: a sidecar-served batch arrived
+                # over RPC — counting it as device readback would poison
+                # the readback-regression signal
+                scheduler_metrics.WAVES_PER_DISPATCH.observe(1.0)
+                scheduler_metrics.READBACK_BYTES.inc(int(chosen.nbytes))
         result.kernel_seconds += ksp.duration_seconds
         scheduler_metrics.KERNEL_SECONDS.observe(ksp.duration_seconds)
 
@@ -868,6 +1133,227 @@ class Scheduler:
                     failed_pods.append((pod, err))
             bsp.attributes["bound"] = str(len(result.bound) - bound_before)
         return rejected_pods, failed_pods
+
+    # ------------------------------------------------------------------
+    def _fused_wave_cycles(
+        self,
+        pending: List[Pod],
+        now: float,
+        ctx: CycleContext,
+        result: CycleResult,
+        pending_reservations: Dict[str, Reservation],
+        originals: Dict[str, Pod],
+        k_waves: int,
+    ) -> None:
+        """K scheduling rounds in ONE device dispatch, replayed host-side
+        as logical cycles (models/fused_waves.py module doc has the kernel
+        contract). Each logical cycle w binds wave w's pods, runs the SAME
+        preemption block serial cycle w would (_post_filter_preempt —
+        including its per-cycle rotation clock), and writes conditions
+        diagnosed against wave-w-start state (a host numpy mirror advanced
+        with the read-back bindings). A Reserve veto or a preemption
+        retry truncates: the device state beyond that wave assumed a world
+        that didn't happen, so the remaining rounds fall to the next
+        cycle. result.waves reports the logical cycles completed."""
+        assert not pending_reservations, (
+            "_effective_waves demotes to K=1 when reservation CRs pend")
+        result.waves = 0
+        enc = self._encode_batch(pending, now, ctx)
+        if enc is None:
+            # the serial early-return, repeated K times: every logical
+            # cycle re-dispatches the same verdicts (idempotent condition
+            # writes, per-cycle failure-trail events — exactly what K
+            # no-node serial cycles produce)
+            failed = [(p, "no schedulable node") for p in pending]
+            gang_plugin = self.extender.plugin("Coscheduling")
+            for _w in range(k_waves):
+                self._post_filter_preempt([], failed, result)
+                for pod, reason in failed:
+                    result.failed.append(pod.meta.key)
+                    self.extender.error_handlers.dispatch(pod, reason)
+                self._write_unschedulable_conditions([], failed, now)
+                result.waves += 1
+                if gang_plugin is not None:
+                    gang_plugin.update_pod_group_status(self.store, now)
+            return
+        fc, pods, nodes, ng, ngroups, active = enc
+        fc_host = fc  # the pre-upload host arrays feed the wave mirror
+        ex = nodes.extras
+        axis_idx = np.asarray(active)
+        la_est = np.ascontiguousarray(
+            np.take(ex["la_est_nonprod"], axis_idx, axis=-1))
+        la_adj = np.ascontiguousarray(
+            np.take(ex["la_adj_nonprod"], axis_idx, axis=-1))
+        step = self._get_fused_step(
+            (pods.padded_size, nodes.padded_size,
+             fc.quota_runtime.shape[0]),
+            ng, ngroups, active, k_waves,
+        )
+        with self.tracer.span(
+                "kernel",
+                compiled="1" if self._last_step_compiled else "0",
+                waves=str(k_waves)) as ksp:
+            if self.device_snapshot is not None:
+                fc = self.device_snapshot.upload(fc)
+                sides = self.device_snapshot.upload_fields(
+                    {"la_est_nonprod": la_est, "la_adj_nonprod": la_adj})
+                la_est = sides["la_est_nonprod"]
+                la_adj = sides["la_adj_nonprod"]
+                self._record_upload_deltas()
+                self.device_snapshot.begin_dispatch()
+            t_dispatch = time.perf_counter()
+            try:
+                out = step(fc, la_est, la_adj)  # async dispatch
+                if self.pipeline_mode:
+                    self.flush_deferred()
+                    with self.tracer.span("overlap_wait"):
+                        # the single designated sync point: the first
+                        # readback blocks until the whole fused program
+                        # (all K waves) finished
+                        # koordlint: disable=blocking-readback-in-pipeline
+                        bind_pods = np.asarray(out.bind_pods)
+                else:
+                    # koordlint: disable=blocking-readback-in-pipeline
+                    bind_pods = np.asarray(out.bind_pods)
+                # the remaining outputs are already materialized — the
+                # program completed at the first sync above
+                # koordlint: disable=blocking-readback-in-pipeline
+                bind_nodes = np.asarray(out.bind_nodes)
+                # koordlint: disable=blocking-readback-in-pipeline
+                bind_zones = np.asarray(out.bind_zones)
+                # koordlint: disable=blocking-readback-in-pipeline
+                wave_counts = np.asarray(out.wave_counts)
+                waves_run = int(out.waves_run)
+            finally:
+                if self.device_snapshot is not None:
+                    self.device_snapshot.end_dispatch()
+            result.device_busy_seconds += time.perf_counter() - t_dispatch
+            scheduler_metrics.WAVES_PER_DISPATCH.observe(float(waves_run))
+            scheduler_metrics.READBACK_BYTES.inc(
+                int(bind_pods.nbytes + bind_nodes.nbytes
+                    + bind_zones.nbytes + wave_counts.nbytes + 4))
+            for w in range(waves_run):
+                # retrospective per-wave markers under the kernel span:
+                # how the dispatch's work split across the fused rounds
+                with self.tracer.span("wave", index=str(w),
+                                      bound=str(int(wave_counts[w]))):
+                    pass
+        result.kernel_seconds += ksp.duration_seconds
+        scheduler_metrics.KERNEL_SECONDS.observe(ksp.duration_seconds)
+
+        # ---- replay the waves as logical cycles. The state mirror is
+        # LAZY: it only exists to diagnose unbound pods against wave-w
+        # state, so the happy path (every wave binds cleanly) never pays
+        # the array copies or the per-binding numpy replay — committed
+        # bindings accumulate in a backlog that the first diagnosable
+        # wave replays in order.
+        mirror: Optional[_WaveStateMirror] = None
+        mirror_backlog: List[Tuple[int, int, int]] = []
+
+        def mirror_state() -> _WaveStateMirror:
+            nonlocal mirror
+            if mirror is None:
+                mirror = _WaveStateMirror(fc_host)
+                for commit in mirror_backlog:
+                    mirror.commit(*commit)
+                mirror_backlog.clear()
+            return mirror
+
+        index = {key: j for j, key in enumerate(pods.keys)}
+        by_key = {p.meta.key: p for p in pending}
+        keys = pods.keys
+        bound_mask = np.zeros(len(keys), bool)
+        gang_plugin = self.extender.plugin("Coscheduling")
+        pos = 0
+        for w in range(k_waves):
+            n_w = int(wave_counts[w]) if w < waves_run else 0
+            seg = range(pos, pos + n_w)
+            pos += n_w
+            bind_of = {int(bind_pods[b]): int(bind_nodes[b]) for b in seg}
+            rejected_pods: List[Pod] = []
+            failed_pods: List[Tuple[Pod, str]] = []
+            veto = False
+            with self.tracer.span("bind", wave=str(w)) as bsp:
+                bound_before = len(result.bound)
+                # one walk in packed (queue) order, the serial bind-loop
+                # contract: bind-or-classify each still-pending pod
+                for i, key in enumerate(keys):
+                    if bound_mask[i]:
+                        continue  # bound in an earlier wave: not pending
+                    pod = by_key[key]
+                    node_idx = bind_of.get(i)
+                    if node_idx is not None:
+                        err = self._reserve_and_bind(
+                            pod, nodes.names[node_idx], ctx, result)
+                        if err:
+                            failed_pods.append((pod, err))
+                            veto = True
+                        else:
+                            bound_mask[i] = True
+                        continue
+                    reason = pods.unschedulable_reasons.get(i)
+                    if reason is not None:
+                        failed_pods.append((pod, reason))
+                    elif pod.gang_name or pod.quota_name:
+                        rejected_pods.append(pod)
+                    else:
+                        failed_pods.append((pod, "no feasible node"))
+                bsp.attributes["bound"] = str(
+                    len(result.bound) - bound_before)
+            # diagnosis for THIS logical cycle reads wave-w-START state
+            # (serial cycle w packed its batch before its kernel ran);
+            # the mirror still holds it — advance happens below
+            if any(r in DIAGNOSED_REASONS for _p, r in failed_pods) or (
+                    rejected_pods):
+                self._last_batch = (
+                    mirror_state().patched_fc(), index, len(nodes.names))
+            truncate = veto
+            any_victims = self._post_filter_preempt(
+                rejected_pods, failed_pods, result)
+            if any_victims:
+                # serial cycle w's in-cycle kernel rerun after evictions:
+                # a fresh SINGLE-round pass over the still-unbound pods
+                # (the device's later waves assumed no evictions — drop
+                # them and let the next cycle continue the budget)
+                retry = self.extender.transform_before_prefilter(
+                    [
+                        originals.get(p.meta.key, p)
+                        for p in rejected_pods
+                        + [p for p, _ in failed_pods]
+                    ],
+                    ctx,
+                )
+                rejected_pods, failed_pods = self._batch_pass(
+                    retry, now, ctx, result, pending_reservations
+                )
+                truncate = True
+            for b in result.bound:
+                self._preempt_attempted.pop(b.pod_key, None)
+            for pod in rejected_pods:
+                result.rejected.append(pod.meta.key)
+                self.extender.error_handlers.dispatch(
+                    pod, "admission rejected")
+            for pod, reason in failed_pods:
+                result.failed.append(pod.meta.key)
+                self.extender.error_handlers.dispatch(pod, reason)
+            self._write_unschedulable_conditions(
+                rejected_pods, failed_pods, now)
+            result.waves += 1
+            if gang_plugin is not None:
+                gang_plugin.update_pod_group_status(self.store, now)
+            if truncate:
+                break
+            # advance the mirror with the device's view of this wave's
+            # commits, so the next logical cycle diagnoses against the
+            # state serial cycle w+1 would have packed
+            for b in seg:
+                commit = (int(bind_pods[b]), int(bind_nodes[b]),
+                          int(bind_zones[b]))
+                if mirror is not None:
+                    mirror.commit(*commit)
+                else:
+                    mirror_backlog.append(commit)
+        self._last_batch = None
 
     # ------------------------------------------------------------------
     def _reserve_and_bind(
@@ -961,8 +1447,9 @@ class CyclePipeline:
                         if enabled is None else bool(enabled))
         scheduler.pipeline_mode = self.enabled
 
-    def run_cycle(self, now: Optional[float] = None) -> CycleResult:
-        return self.scheduler.run_cycle(now=now)
+    def run_cycle(self, now: Optional[float] = None,
+                  waves=None) -> CycleResult:
+        return self.scheduler.run_cycle(now=now, waves=waves)
 
     def flush(self) -> None:
         """Drain deferred condition writes (call at end of stream)."""

@@ -48,6 +48,12 @@ class CycleResult:
     # host work outlasts the kernel) — feeds the pipeline occupancy number
     device_busy_seconds: float = 0.0
     skipped_not_leader: bool = False  # election-gated replica in standby
+    # logical scheduling rounds this cycle consumed: 1 on the serial path,
+    # up to K on a fused multi-wave dispatch (models/fused_waves.py). A
+    # fused cycle truncated by a Reserve veto or a preemption retry
+    # reports the rounds it actually completed, so a driver replaying a
+    # K-round budget knows how much remains.
+    waves: int = 1
 
 
 class Plugin:

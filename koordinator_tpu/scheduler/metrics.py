@@ -62,6 +62,21 @@ PODS_BOUND_TOTAL = REGISTRY.counter(
     "Pods bound across all cycles",
 )
 
+# fused multi-wave dispatch (models/fused_waves.py): how many dependent
+# scheduling rounds each device dispatch actually executed (early exit
+# stops at the first zero-commit wave), and how many bytes every kernel
+# readback shipped — the compacted binding buffer is the whole point, so
+# a regression back to full-matrix readbacks must be visible
+WAVES_PER_DISPATCH = REGISTRY.histogram(
+    "koord_scheduler_waves_per_dispatch",
+    "Scheduling waves executed per device dispatch",
+    buckets=(1.0, 2.0, 4.0, 8.0),
+)
+READBACK_BYTES = REGISTRY.counter(
+    "koord_scheduler_readback_bytes_total",
+    "Bytes read back from the device across all kernel dispatches",
+)
+
 # incremental-pack row traffic: steady state should be nearly all reused;
 # a repack surge means the store is churning (or a cache regression)
 PACK_ROWS_REUSED = REGISTRY.counter(

@@ -123,8 +123,10 @@ def run_pipeline_parity(num_nodes: int = 24, num_pods: int = 70,
 
     state_s, store_serial = make_world()
     _state_p, store_pipe = make_world()
-    sched_serial = Scheduler(store_serial)
-    sched_pipe = Scheduler(store_pipe)
+    # waves pinned to 1: this gate isolates pipelining; the fused-wave
+    # gate (run_fused_wave_parity) owns the K > 1 dimension
+    sched_serial = Scheduler(store_serial, waves=1)
+    sched_pipe = Scheduler(store_pipe, waves=1)
     pipeline = CyclePipeline(sched_pipe, enabled=True)
     assert sched_serial.pipeline_mode is False
 
@@ -170,6 +172,127 @@ def run_pipeline_parity(num_nodes: int = 24, num_pods: int = 70,
     }
 
 
+def run_fused_wave_parity(k_waves: int, num_nodes: int = 24,
+                          num_pods: int = 70, rounds: int = 2,
+                          seed: int = 11, arrivals: int = 9) -> dict:
+    """Fused-K vs K sequential single-round cycles: byte-identical state.
+
+    The fused wave kernel (models/fused_waves.py) runs K dependent
+    scheduling rounds in one dispatch; the driver replays them as logical
+    cycles. This harness drives twin stores through identical churn: the
+    serial world runs K plain single-round cycles per round, the fused
+    world runs pipelined fused cycles until K logical cycles are consumed
+    (``CycleResult.waves`` — a veto/preemption truncation hands the
+    remaining budget to the next dispatch). Diffed per round: the
+    CONCATENATED bound sequences and failed/rejected/victim lists across
+    the K logical cycles; at end of stream: every pod's PodScheduled
+    condition tuple and node assignment."""
+    from koordinator_tpu.client.store import KIND_POD
+    from koordinator_tpu.scheduler.cycle import CyclePipeline, Scheduler
+    from koordinator_tpu.testing import synth_full_cluster
+
+    def make_world():
+        _cluster, state = synth_full_cluster(
+            num_nodes, num_pods, seed=seed, num_quotas=3, num_gangs=4,
+            topology_fraction=0.5, lsr_fraction=0.2)
+        return state, build_store_from_state(state)
+
+    state_s, store_serial = make_world()
+    _state_f, store_fused = make_world()
+    sched_serial = Scheduler(store_serial, waves=1)
+    sched_fused = Scheduler(store_fused, waves=k_waves)
+    pipeline = CyclePipeline(sched_fused, enabled=True)
+    assert sched_serial.pipeline_mode is False
+
+    now = state_s.now
+    mismatches: List[str] = []
+    fields = ("failed", "rejected", "preempted_victims", "resized",
+              "resize_pending")
+    for r in range(rounds + 1):
+        if r > 0:
+            apply_round_delta(store_serial, r, now, arrivals)
+            apply_round_delta(store_fused, r, now, arrivals)
+        t = now + 2 * r
+        ser_bound: List[tuple] = []
+        ser_lists = {f: [] for f in fields}
+        for _c in range(k_waves):
+            res = sched_serial.run_cycle(now=t)
+            ser_bound.extend(
+                (b.pod_key, b.node_name, b.annotations) for b in res.bound)
+            for f in fields:
+                ser_lists[f].extend(getattr(res, f))
+        fused_bound: List[tuple] = []
+        fused_lists = {f: [] for f in fields}
+        consumed = 0
+        while consumed < k_waves:
+            res = pipeline.run_cycle(now=t, waves=k_waves - consumed)
+            if res.waves <= 0:  # defensive: a cycle must consume >= 1
+                mismatches.append(f"round {r}: fused cycle consumed 0")
+                break
+            consumed += res.waves
+            fused_bound.extend(
+                (b.pod_key, b.node_name, b.annotations) for b in res.bound)
+            for f in fields:
+                fused_lists[f].extend(getattr(res, f))
+        if ser_bound != fused_bound:
+            mismatches.append(
+                f"round {r}: bound sequence differs "
+                f"(serial {len(ser_bound)} vs fused {len(fused_bound)})")
+        for f in fields:
+            if ser_lists[f] != fused_lists[f]:
+                mismatches.append(f"round {r}: {f} differs")
+    pipeline.flush()
+
+    cond_s, cond_f = _conditions(store_serial), _conditions(store_fused)
+    if cond_s != cond_f:
+        keys = {k for k in set(cond_s) | set(cond_f)
+                if cond_s.get(k) != cond_f.get(k)}
+        mismatches.append(
+            f"PodScheduled conditions differ for {len(keys)} pods "
+            f"(e.g. {sorted(keys)[:3]})")
+    # plugin-side counters: the fused path increments gang assumed and
+    # quota used via carried device state + per-wave binds — the host
+    # plugin caches must land exactly where K serial cycles put them
+    import numpy as np
+
+    def plugin_counters(sched):
+        gang = sched.extender.plugin("Coscheduling")
+        quota = sched.extender.plugin("ElasticQuota")
+        return (
+            {g: n for g, n in (gang.assumed if gang else {}).items() if n},
+            {q: tuple(np.asarray(v).tolist())
+             for q, v in (quota.used if quota else {}).items()
+             if np.asarray(v).any()},
+        )
+
+    gang_s, quota_s = plugin_counters(sched_serial)
+    gang_f, quota_f = plugin_counters(sched_fused)
+    if gang_s != gang_f:
+        mismatches.append(f"gang assumed counters differ: "
+                          f"{gang_s} vs {gang_f}")
+    if quota_s != quota_f:
+        mismatches.append("quota used counters differ")
+    assign_s = {p.meta.key: p.spec.node_name
+                for p in store_serial.list(KIND_POD)}
+    assign_f = {p.meta.key: p.spec.node_name
+                for p in store_fused.list(KIND_POD)}
+    if assign_s != assign_f:
+        diff = sorted(k for k in set(assign_s) | set(assign_f)
+                      if assign_s.get(k) != assign_f.get(k))
+        mismatches.append(
+            f"final pod->node assignments differ for {len(diff)} pods "
+            f"(e.g. {diff[:3]})")
+
+    return {
+        "ok": not mismatches,
+        "mismatches": mismatches,
+        "waves": k_waves,
+        "rounds": rounds + 1,
+        "pods": len(assign_s),
+        "conditions_checked": len(cond_s),
+    }
+
+
 def main(argv: List[str]) -> int:
     report = run_pipeline_parity()
     line = (f"pipeline parity: rounds={report['rounds']} "
@@ -179,7 +302,18 @@ def main(argv: List[str]) -> int:
     print(line, file=sys.stderr)
     for m in report["mismatches"]:
         print(f"  {m}", file=sys.stderr)
-    return 0 if report["ok"] else 1
+    ok = report["ok"]
+    for k in (1, 2, 4, 8):
+        rep = run_fused_wave_parity(k)
+        line = (f"fused-wave parity K={k}: rounds={rep['rounds']} "
+                f"pods={rep['pods']} "
+                f"conditions={rep['conditions_checked']} -> "
+                f"{'OK' if rep['ok'] else 'MISMATCH'}")
+        print(line, file=sys.stderr)
+        for m in rep["mismatches"]:
+            print(f"  {m}", file=sys.stderr)
+        ok = ok and rep["ok"]
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

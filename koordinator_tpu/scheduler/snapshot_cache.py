@@ -618,10 +618,17 @@ class SnapshotCache:
 _SCATTER_FRACTION = 0.125
 
 
-def _pad_pow2(n: int) -> int:
+def _pad_bucket(n: int) -> int:
+    """Scatter-row pad bucket: 8, 64, 512, 4096, ... (x8 steps, not x2).
+    Each distinct pad is a distinct jitted scatter program; with x2
+    buckets the churn-driven dirty-row count hopped buckets nearly every
+    warm cycle and re-paid a ~40ms XLA compile — the dominant cost of the
+    steady-state cycle. Coarse buckets over-pad by at most 8x with
+    DUPLICATE rows (the scatter is an idempotent .at[].set, and the padded
+    transfer is still tiny), and the program set stays <= 4 in practice."""
     p = 8
     while p < n:
-        p *= 2
+        p *= 8
     return p
 
 
@@ -637,8 +644,26 @@ class DeviceSnapshot:
     def __init__(self) -> None:
         self._fields: Dict[str, Tuple[np.ndarray, object]] = {}
         self._scatter_cache: Dict[tuple, object] = {}
-        self.stats = {"reused": 0, "scattered": 0, "put": 0,
-                      "bytes_put": 0, "bytes_scattered": 0}
+        # dispatches whose consumers may still be in flight on device. A
+        # DONATED scatter source reachable by an un-synced dispatch is the
+        # double-buffering hazard: donation aliases the input buffer into
+        # the output, so the in-flight program could read memory the
+        # scatter just overwrote. While any dispatch is outstanding the
+        # scatter runs WITHOUT donation (the old buffer stays live as the
+        # second buffer until the dispatch syncs) — the cycle driver
+        # brackets every async kernel window with begin/end_dispatch.
+        self._in_flight = 0
+        self.stats = {"reused": 0, "scattered": 0, "scattered_safe": 0,
+                      "put": 0, "bytes_put": 0, "bytes_scattered": 0}
+
+    def begin_dispatch(self) -> None:
+        """A kernel consuming this snapshot's buffers was dispatched and
+        not yet synced: donation of those buffers is unsafe until
+        ``end_dispatch``."""
+        self._in_flight += 1
+
+    def end_dispatch(self) -> None:
+        self._in_flight = max(0, self._in_flight - 1)
 
     def _scatter(self, dev, idx: np.ndarray, rows: np.ndarray):
         import jax
@@ -648,59 +673,67 @@ class DeviceSnapshot:
             # zero-length array (IndexError), and a zero-row scatter is a
             # pointless device launch — the unchanged buffer IS the result
             return dev
-        pad = _pad_pow2(idx.size)
+        pad = _pad_bucket(idx.size)
         idx_p = np.full(pad, idx[-1], np.int32)
         idx_p[: idx.size] = idx
         rows_p = np.broadcast_to(
             rows[-1], (pad,) + rows.shape[1:]).copy()
         rows_p[: idx.size] = rows
-        key = (dev.shape, str(dev.dtype), pad)
+        donate = self._in_flight == 0
+        key = (dev.shape, str(dev.dtype), pad, donate)
         fn = self._scatter_cache.get(key)
         if fn is None:
-            import functools
-
             fn = jax.jit(lambda a, i, r: a.at[i].set(r),
-                         donate_argnums=(0,))
+                         donate_argnums=(0,) if donate else ())
             self._scatter_cache[key] = fn
+        if not donate:
+            self.stats["scattered_safe"] += 1
         return fn(dev, idx_p, rows_p)
 
-    def upload(self, fc):
+    def _one(self, name: str, new) -> object:
         import jax
 
-        def one(name: str, new) -> object:
-            new = np.asarray(new)
-            hit = self._fields.get(name)
-            if (hit is not None and hit[0].shape == new.shape
-                    and hit[0].dtype == new.dtype):
-                prev_np, dev = hit
-                # the host equality compare (~1ms total) is the source of
-                # truth on purpose: score-phase transformers may rewrite
-                # any fc field after the build, so SnapshotCache's
-                # dirty_fields cannot vouch for the final arrays
-                if np.array_equal(prev_np, new):
-                    self.stats["reused"] += 1
-                    return dev
-                if new.ndim >= 1 and new.shape[0] == prev_np.shape[0] > 8:
-                    axes = tuple(range(1, new.ndim))
-                    rows = np.nonzero(
-                        (prev_np != new).any(axis=axes) if axes
-                        else prev_np != new)[0]
-                    if 0 < rows.size <= new.shape[0] * _SCATTER_FRACTION:
-                        dev2 = self._scatter(
-                            dev, rows.astype(np.int32), new[rows])
-                        self._fields[name] = (new.copy(), dev2)
-                        self.stats["scattered"] += 1
-                        self.stats["bytes_scattered"] += int(
-                            new[rows].nbytes)
-                        return dev2
-            dev = jax.device_put(new)
-            self._fields[name] = (new.copy(), dev)
-            self.stats["put"] += 1
-            self.stats["bytes_put"] += int(new.nbytes)
-            return dev
+        new = np.asarray(new)
+        hit = self._fields.get(name)
+        if (hit is not None and hit[0].shape == new.shape
+                and hit[0].dtype == new.dtype):
+            prev_np, dev = hit
+            # the host equality compare (~1ms total) is the source of
+            # truth on purpose: score-phase transformers may rewrite
+            # any fc field after the build, so SnapshotCache's
+            # dirty_fields cannot vouch for the final arrays
+            if np.array_equal(prev_np, new):
+                self.stats["reused"] += 1
+                return dev
+            if new.ndim >= 1 and new.shape[0] == prev_np.shape[0] > 8:
+                axes = tuple(range(1, new.ndim))
+                rows = np.nonzero(
+                    (prev_np != new).any(axis=axes) if axes
+                    else prev_np != new)[0]
+                if 0 < rows.size <= new.shape[0] * _SCATTER_FRACTION:
+                    dev2 = self._scatter(
+                        dev, rows.astype(np.int32), new[rows])
+                    self._fields[name] = (new.copy(), dev2)
+                    self.stats["scattered"] += 1
+                    self.stats["bytes_scattered"] += int(
+                        new[rows].nbytes)
+                    return dev2
+        dev = jax.device_put(new)
+        self._fields[name] = (new.copy(), dev)
+        self.stats["put"] += 1
+        self.stats["bytes_put"] += int(new.nbytes)
+        return dev
 
+    def upload(self, fc):
         base = fc.base
         new_base = type(base)(**{
-            k: one(k, v) for k, v in base._asdict().items()})
-        rest = {k: one(k, v) for k, v in fc._asdict().items() if k != "base"}
+            k: self._one(k, v) for k, v in base._asdict().items()})
+        rest = {k: self._one(k, v)
+                for k, v in fc._asdict().items() if k != "base"}
         return type(fc)(base=new_base, **rest)
+
+    def upload_fields(self, fields: Dict[str, np.ndarray]) -> Dict[str, object]:
+        """Upload side arrays outside FullChainInputs (the fused wave
+        step's LoadAware term split) through the same reuse/scatter/put
+        machinery, keyed by the given names."""
+        return {k: self._one(k, v) for k, v in fields.items()}

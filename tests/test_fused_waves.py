@@ -1,0 +1,341 @@
+"""Fused multi-wave scheduling (models/fused_waves.py + the cycle
+driver's per-wave replay): K rounds per device dispatch must be
+byte-identical to K sequential single-round cycles, with compacted
+readback and carried on-device state.
+
+The kernel-level contract (wave 1 == the serial step, bit-exact) plus
+the driver-level contract (fuzz parity through churn, the genuine
+multi-wave retry channel, truncation semantics, auto-K policy and its
+demotions, metrics/spans)."""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.objects import (
+    Node,
+    ObjectMeta,
+    Pod,
+    PodGroup,
+    PodSpec,
+    Reservation,
+    TopologySpreadConstraint,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client.store import (
+    KIND_NODE,
+    KIND_POD,
+    KIND_POD_GROUP,
+    KIND_RESERVATION,
+    ObjectStore,
+)
+from koordinator_tpu.ops.loadaware import LoadAwareArgs
+from koordinator_tpu.scheduler.cycle import CyclePipeline, Scheduler
+from koordinator_tpu.scheduler.pipeline_parity import run_fused_wave_parity
+
+GIB = 1024 ** 3
+NOW = 1_000_000.0
+GANG_LABEL = "pod-group.scheduling.sigs.k8s.io"
+
+
+# ---------------------------------------------------------------------------
+# kernel level
+# ---------------------------------------------------------------------------
+
+def _packed_fixture(num_nodes=24, num_pods=70, seed=11):
+    from koordinator_tpu.scheduler.snapshot import (
+        build_full_chain_inputs,
+        reduce_to_active_axes,
+    )
+    from koordinator_tpu.testing import synth_full_cluster
+
+    la = LoadAwareArgs()
+    _cluster, state = synth_full_cluster(
+        num_nodes, num_pods, seed=seed, num_quotas=3, num_gangs=4,
+        topology_fraction=0.5, lsr_fraction=0.2)
+    fc, pods, nodes, _tree, _gi, ng, ngroups = build_full_chain_inputs(
+        state, la)
+    ex = nodes.extras
+    fc, active = reduce_to_active_axes(fc)
+    idx = np.asarray(active)
+    est = np.take(ex["la_est_nonprod"], idx, axis=-1)
+    adj = np.take(ex["la_adj_nonprod"], idx, axis=-1)
+    return la, fc, pods, ng, ngroups, active, est, adj
+
+
+def test_la_term_split_is_exact():
+    """la_term_nonprod == la_est_nonprod + la_adj_nonprod bit-for-bit —
+    the invariant the fused kernel's carried est_sum rests on."""
+    from koordinator_tpu.testing import synth_full_cluster
+    from koordinator_tpu.ops.loadaware import build_loadaware_node_state
+
+    _cluster, state = synth_full_cluster(16, 40, seed=3)
+    ex = build_loadaware_node_state(
+        state.nodes, state.node_metrics, state.pods_by_key, state.assigned,
+        LoadAwareArgs(), state.now, pad_to=16)
+    assert np.array_equal(
+        ex["la_term_nonprod"],
+        ex["la_est_nonprod"] + ex["la_adj_nonprod"])
+
+
+def test_fused_wave1_matches_serial_step_bitwise():
+    """K=1 fused bindings == the serial single-round step, row for row
+    (the evaluator and commit path are shared code — this pins it)."""
+    from koordinator_tpu.models.full_chain import build_full_chain_step
+    from koordinator_tpu.models.fused_waves import build_fused_wave_step
+
+    la, fc, pods, ng, ngroups, active, est, adj = _packed_fixture()
+    chosen = np.asarray(
+        build_full_chain_step(la, ng, ngroups, active_axes=active)(fc)[0])
+    out = build_fused_wave_step(la, ng, ngroups, waves=1,
+                                active_axes=active)(fc, est, adj)
+    n = int(np.asarray(out.wave_counts)[0])
+    fused = np.full_like(chosen, -1)
+    fused[np.asarray(out.bind_pods)[:n]] = np.asarray(out.bind_nodes)[:n]
+    assert int(out.waves_run) == 1
+    assert np.array_equal(fused, chosen)
+
+
+def test_fused_kernel_early_exits_on_fixpoint():
+    """A wave that commits nothing proves the fixpoint: waves_run stops
+    there instead of burning the full K on device."""
+    from koordinator_tpu.models.fused_waves import build_fused_wave_step
+
+    la, fc, pods, ng, ngroups, active, est, adj = _packed_fixture()
+    out = build_fused_wave_step(la, ng, ngroups, waves=8,
+                                active_axes=active)(fc, est, adj)
+    counts = np.asarray(out.wave_counts)
+    waves_run = int(out.waves_run)
+    assert waves_run < 8
+    assert counts[waves_run - 1] == 0  # the exit wave committed nothing
+    assert (counts[waves_run:] == 0).all()
+
+
+def test_fused_step_rejects_bad_waves_and_prod_mode():
+    from koordinator_tpu.models.fused_waves import build_fused_wave_step
+
+    with pytest.raises(ValueError):
+        build_fused_wave_step(LoadAwareArgs(), 1, 1, waves=0)
+    with pytest.raises(ValueError):
+        build_fused_wave_step(LoadAwareArgs(), 1, 1, waves=9)
+    with pytest.raises(ValueError):
+        build_fused_wave_step(
+            LoadAwareArgs(score_according_prod_usage=True), 1, 1, waves=2)
+
+
+# ---------------------------------------------------------------------------
+# driver level: parity through churn
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("k", [2, 8])
+def test_fused_k_equals_k_serial_cycles_through_churn(k):
+    """The pipeline_parity gate fixture (quotas, gangs, NUMA topology,
+    cpuset pods, per-round arrival/metric churn): fused-K bound
+    sequences, failure/rejection lists, PodScheduled conditions and final
+    assignments must be byte-identical to K sequential single-round
+    cycles. hack/lint.sh runs all of K in {1,2,4,8}."""
+    report = run_fused_wave_parity(k)
+    assert report["ok"], report["mismatches"]
+    assert report["conditions_checked"] > 0
+
+
+# ---------------------------------------------------------------------------
+# driver level: the genuine multi-wave retry channel
+# ---------------------------------------------------------------------------
+
+def _spread_retry_store():
+    """Two zones; gang member b1 (Permit always fails -> reverts every
+    round) holds n0 in wave 1 and shadows p; kept pod c raises zone za's
+    spread count, so wave 2's re-evaluation pushes b1 to zone zb and p
+    binds n0 — the topology-spread channel is non-additive, which is what
+    makes a LATER round differ from re-running the first."""
+    store = ObjectStore()
+    for name, zone in (("n0", "za"), ("n1", "zb")):
+        store.add(KIND_NODE, Node(
+            meta=ObjectMeta(name=name, namespace="", labels={"zone": zone}),
+            allocatable=ResourceList.of(cpu=6000, memory=32 * GIB, pods=20)))
+    store.add(KIND_POD_GROUP, PodGroup(
+        meta=ObjectMeta(name="gb", namespace="default"), min_member=2))
+    store.add(KIND_POD, Pod(
+        meta=ObjectMeta(name="b1", uid="b1", creation_timestamp=NOW,
+                        labels={GANG_LABEL: "gb", "app": "red"}),
+        spec=PodSpec(priority=9000,
+                     requests=ResourceList.of(cpu=3000, memory=GIB, pods=1),
+                     topology_spread=[TopologySpreadConstraint(
+                         max_skew=1, topology_key="zone",
+                         selector={"app": "red"})])))
+    store.add(KIND_POD, Pod(
+        meta=ObjectMeta(name="b2", uid="b2", creation_timestamp=NOW,
+                        labels={GANG_LABEL: "gb"}),
+        spec=PodSpec(priority=9000,
+                     requests=ResourceList.of(cpu=900_000, memory=GIB,
+                                              pods=1))))
+    store.add(KIND_POD, Pod(
+        meta=ObjectMeta(name="c", uid="c", creation_timestamp=NOW + 1,
+                        labels={"app": "red"}),
+        spec=PodSpec(priority=5000, node_selector={"zone": "za"},
+                     requests=ResourceList.of(cpu=1000, memory=GIB,
+                                              pods=1))))
+    store.add(KIND_POD, Pod(
+        meta=ObjectMeta(name="p", uid="p", creation_timestamp=NOW + 2),
+        spec=PodSpec(priority=1000, node_selector={"zone": "za"},
+                     requests=ResourceList.of(cpu=3000, memory=GIB,
+                                              pods=1))))
+    return store
+
+
+def test_wave2_binds_pod_rejected_in_wave1():
+    """One fused dispatch does what took two serial cycles: p fails the
+    first round (capacity held by the reverting gang member), binds in
+    the second (the kept commit moved the gang member's choice)."""
+    sched = Scheduler(_spread_retry_store(), waves=4)
+    res = sched.run_cycle(now=NOW)
+    bound = [(b.pod_key, b.node_name) for b in res.bound]
+    assert bound == [("default/c", "n0"), ("default/p", "n0")]
+    # logical cycle 1 recorded p's transient failure, like serial c1 did
+    assert "default/p" in res.failed
+    assert res.waves >= 2
+
+
+def test_fused_spread_scenario_matches_serial_exactly():
+    """The same store through 3 serial cycles vs one fused K=3 cycle:
+    concatenated bound/failed/rejected and final store state identical."""
+    s_ser = Scheduler(_spread_retry_store(), waves=1)
+    ser_bound, ser_failed, ser_rejected = [], [], []
+    for _ in range(3):
+        r = s_ser.run_cycle(now=NOW)
+        ser_bound += [(b.pod_key, b.node_name) for b in r.bound]
+        ser_failed += r.failed
+        ser_rejected += r.rejected
+    s_f = Scheduler(_spread_retry_store(), waves=3)
+    rf = s_f.run_cycle(now=NOW)
+    assert [(b.pod_key, b.node_name) for b in rf.bound] == ser_bound
+    assert rf.failed == ser_failed
+    assert rf.rejected == ser_rejected
+    assert rf.waves == 3
+    for key in ("default/c", "default/p", "default/b1"):
+        a = s_ser.store.get(KIND_POD, key)
+        b = s_f.store.get(KIND_POD, key)
+        assert a.spec.node_name == b.spec.node_name
+        ca, cb = (x.get_condition("PodScheduled") for x in (a, b))
+        assert (ca is None) == (cb is None)
+        if ca is not None:
+            assert (ca.status, ca.reason, ca.message) == (
+                cb.status, cb.reason, cb.message)
+
+
+# ---------------------------------------------------------------------------
+# driver level: waves policy
+# ---------------------------------------------------------------------------
+
+def _plain_store(num_nodes=2):
+    store = ObjectStore()
+    for i in range(num_nodes):
+        store.add(KIND_NODE, Node(
+            meta=ObjectMeta(name=f"n{i}", namespace=""),
+            allocatable=ResourceList.of(cpu=64000, memory=64 * GIB,
+                                        pods=500)))
+    return store
+
+
+def _pend(store, name, cpu=500, pvcs=()):
+    pod = Pod(
+        meta=ObjectMeta(name=name, uid=name, creation_timestamp=NOW),
+        spec=PodSpec(requests=ResourceList.of(cpu=cpu, memory=GIB, pods=1),
+                     pvc_names=list(pvcs)))
+    store.add(KIND_POD, pod)
+    return pod
+
+
+def test_auto_waves_policy_scales_with_queue_depth():
+    from koordinator_tpu.scheduler.cycle import _auto_waves
+
+    assert _auto_waves(10) == 1
+    assert _auto_waves(256) == 2
+    assert _auto_waves(1024) == 4
+    assert _auto_waves(4096) == 8
+
+
+def test_effective_waves_demotions():
+    store = _plain_store()
+    sched = Scheduler(store, waves=8)
+    pods = [_pend(store, f"p{i}") for i in range(4)]
+    assert sched._effective_waves(pods, {}) == 8
+    # pending Reservation CRs: wave-1 CR binds feed the NEXT cycle's
+    # nomination pre-pass — not carryable
+    res = Reservation(meta=ObjectMeta(name="r", namespace="__reservation__"))
+    assert sched._effective_waves(pods, {"__reservation__/r": res}) == 1
+    # claim-carrying pods: volume groups refactor between cycles
+    pvc_pod = _pend(store, "with-claim", pvcs=["claim-a"])
+    assert sched._effective_waves(pods + [pvc_pod], {}) == 1
+    # prod-usage scoring: the prod term is not carried in split form
+    prod_sched = Scheduler(
+        _plain_store(), args=LoadAwareArgs(score_according_prod_usage=True),
+        waves=8)
+    assert prod_sched._effective_waves(pods, {}) == 1
+    # explicit K=1 and env-auto shallow queues stay serial
+    assert Scheduler(_plain_store(), waves=1)._effective_waves(
+        pods, {}) == 1
+    assert Scheduler(_plain_store(), waves="auto")._effective_waves(
+        pods, {}) == 1
+
+
+def test_waves_env_spec(monkeypatch):
+    from koordinator_tpu.scheduler.cycle import waves_from_env
+
+    monkeypatch.setenv("KOORD_TPU_WAVES", "4")
+    assert waves_from_env() == 4
+    monkeypatch.setenv("KOORD_TPU_WAVES", "99")
+    assert waves_from_env() == 8  # clamped to MAX_WAVES
+    monkeypatch.setenv("KOORD_TPU_WAVES", "auto")
+    assert waves_from_env() == "auto"
+    monkeypatch.setenv("KOORD_TPU_WAVES", "bogus")
+    assert waves_from_env() == "auto"
+    monkeypatch.delenv("KOORD_TPU_WAVES")
+    assert waves_from_env() == "auto"
+
+
+# ---------------------------------------------------------------------------
+# driver level: observability
+# ---------------------------------------------------------------------------
+
+def test_fused_cycle_metrics_and_wave_spans():
+    from koordinator_tpu.scheduler import metrics as m
+
+    store = _spread_retry_store()
+    sched = Scheduler(store, waves=4)
+    res = sched.run_cycle(now=NOW)
+    assert res.waves >= 2
+    text = m.REGISTRY.expose()
+    assert "koord_scheduler_waves_per_dispatch_bucket" in text
+    assert "koord_scheduler_readback_bytes_total" in text
+    root = sched.tracer.roots(limit=1)[0]
+    kernel = root.find("kernel")
+    assert kernel is not None
+    assert kernel.attributes.get("waves") == "4"
+    waves = [s for s in kernel.children if s.name == "wave"]
+    assert len(waves) >= 2
+    assert waves[0].attributes.get("index") == "0"
+    assert "bound" in waves[0].attributes
+
+
+def test_serial_path_reports_one_wave():
+    store = _plain_store()
+    _pend(store, "a")
+    sched = Scheduler(store)  # auto -> shallow queue -> serial
+    res = sched.run_cycle(now=NOW)
+    assert res.waves == 1
+    assert [b.pod_key for b in res.bound] == ["default/a"]
+
+
+def test_pipeline_defers_conditions_across_fused_cycle():
+    """Fused cycles compose with the CyclePipeline: a transient wave-1
+    failure that a later wave resolves must end PodScheduled=True after
+    flush (the deferred False verdict is superseded by the bind)."""
+    store = _spread_retry_store()
+    pipeline = CyclePipeline(Scheduler(store, waves=4), enabled=True)
+    res = pipeline.run_cycle(now=NOW)
+    assert ("default/p", "n0") in [
+        (b.pod_key, b.node_name) for b in res.bound]
+    pipeline.flush()
+    cond = store.get(KIND_POD, "default/p").get_condition("PodScheduled")
+    assert cond is not None and cond.status == "True"

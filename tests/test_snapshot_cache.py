@@ -331,6 +331,67 @@ def test_donated_buffer_not_reused_after_donation():
     assert np.array_equal(np.asarray(fc3.base.core), changed)
 
 
+def test_scatter_under_in_flight_dispatch_does_not_donate():
+    """Regression (fused-wave double buffering): a donated scatter source
+    must never be a buffer a still-pending dispatch reads. While the
+    cycle driver holds an un-synced dispatch (begin_dispatch), the
+    scatter must run WITHOUT donation — the pre-scatter buffer stays
+    live as the second buffer and keeps its original values until the
+    dispatch syncs."""
+    import jax
+
+    from koordinator_tpu.scheduler.snapshot_cache import DeviceSnapshot
+
+    n = 32
+    ds = DeviceSnapshot()
+    base = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+    fc1 = ds.upload(_mini_fc(base))
+    old_dev = fc1.base.core
+    # simulate a dispatch consuming fc1's buffers that has NOT been
+    # synced yet (the pipelined/fused overlap window)
+    ds.begin_dispatch()
+    consumer = jax.jit(lambda a: a * 2.0)(old_dev)  # in-flight reader
+    changed = base.copy()
+    changed[5] = -1.0
+    fc2 = ds.upload(_mini_fc(changed))
+    assert ds.stats["scattered"] == 1
+    assert ds.stats["scattered_safe"] == 1, (
+        "scatter under an in-flight dispatch must take the non-donating "
+        "path")
+    # the OLD buffer is intact (second buffer) and the new one is updated
+    assert np.array_equal(np.asarray(old_dev), base)
+    assert np.array_equal(np.asarray(fc2.base.core), changed)
+    assert np.array_equal(np.asarray(consumer), base * 2.0)
+    ds.end_dispatch()
+    # with no dispatch outstanding, donation resumes
+    changed2 = changed.copy()
+    changed2[7] = -2.0
+    fc3 = ds.upload(_mini_fc(changed2))
+    assert ds.stats["scattered"] == 2
+    assert ds.stats["scattered_safe"] == 1
+    assert np.array_equal(np.asarray(fc3.base.core), changed2)
+
+
+def test_upload_fields_side_arrays_share_reuse_machinery():
+    """upload_fields (the fused step's LoadAware term split) reuses,
+    scatters and puts exactly like fc fields."""
+    from koordinator_tpu.scheduler.snapshot_cache import DeviceSnapshot
+
+    n = 32
+    ds = DeviceSnapshot()
+    est = np.zeros((n, 4), np.float32)
+    out1 = ds.upload_fields({"la_est": est})
+    assert ds.stats["put"] == 1
+    out2 = ds.upload_fields({"la_est": est.copy()})
+    assert ds.stats["reused"] == 1
+    assert out2["la_est"] is out1["la_est"]
+    changed = est.copy()
+    changed[2] = 5.0
+    out3 = ds.upload_fields({"la_est": changed})
+    assert ds.stats["scattered"] == 1
+    assert np.array_equal(np.asarray(out3["la_est"]), changed)
+
+
 def test_dtype_or_shape_change_forces_full_put():
     from koordinator_tpu.scheduler.snapshot_cache import DeviceSnapshot
 

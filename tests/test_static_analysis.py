@@ -712,3 +712,67 @@ class TestBlockingReadbackInPipeline:
                              rules={self.RULE: all_rules()[self.RULE]})
         assert [f for f in out if f.rule == self.RULE] == [], (
             "every sync in the pipelined region must carry its pragma")
+
+
+class TestReadbackInWaveBody:
+    RULE = "readback-in-wave-body"
+    PATH = "koordinator_tpu/models/fused_waves.py"
+
+    def test_positive_host_transfers_in_wave_module(self):
+        src = """
+            import numpy as np
+            import jax
+
+            def wave_body(carry):
+                chosen = np.asarray(carry[0])
+                n = carry[1].item()
+                jax.device_get(carry[2])
+                jax.block_until_ready(carry[3])
+                return chosen, n
+        """
+        out = findings_for(src, self.RULE, path=self.PATH)
+        assert len(out) == 4
+        assert all("device program" in f.message for f in out)
+
+    def test_negative_jnp_asarray_is_device_side(self):
+        # jnp.asarray is a dtype coercion that stays on device — the
+        # wave kernels use it on bool inputs; it must not be flagged,
+        # in either spelling
+        src = """
+            import jax
+            import jax.numpy as jnp
+
+            def step(fc):
+                a = jnp.asarray(fc.aff_exists, bool)
+                return jax.numpy.asarray(a, bool)
+        """
+        assert findings_for(src, self.RULE, path=self.PATH) == []
+
+    def test_negative_other_modules_unaffected(self):
+        src = """
+            import numpy as np
+
+            def readback(x):
+                return np.asarray(x)
+        """
+        assert findings_for(src, self.RULE,
+                            path="koordinator_tpu/scheduler/cycle.py") == []
+
+    def test_pragma_licenses_deliberate_exception(self):
+        src = """
+            import numpy as np
+
+            def debug_dump(carry):
+                # koordlint: disable=readback-in-wave-body
+                return np.asarray(carry[0])
+        """
+        assert findings_for(src, self.RULE, path=self.PATH) == []
+
+    def test_shipped_wave_modules_are_clean(self):
+        for mod in ("fused_waves.py", "wave_chain.py"):
+            source = (REPO_ROOT / "koordinator_tpu" / "models"
+                      / mod).read_text()
+            out = analyze_source(source,
+                                 path=f"koordinator_tpu/models/{mod}",
+                                 rules={self.RULE: all_rules()[self.RULE]})
+            assert [f for f in out if f.rule == self.RULE] == [], mod
