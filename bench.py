@@ -700,6 +700,40 @@ def run_steady_state(args_cli, num_pods: int, num_nodes: int) -> dict:
         "steady_rows_repacked": int(cs.get("pod_row_misses", 0)),
     }
 
+    # ---- koordexplain overhead: the same steady loop at
+    # KOORD_TPU_EXPLAIN=counts vs off, as a back-to-back A/B pair inside
+    # ONE process (BENCH_NOTES convention: this box's noise makes numbers
+    # from different runs incomparable — only the pair ratio is real)
+    def steady_pps_at(explain_level: str) -> float:
+        store_e, _state_e = make_store()
+        sched_e = Scheduler(store_e, waves=1, explain=explain_level)
+        pl_e = CyclePipeline(sched_e)
+        pl_e.run_cycle(now=now)  # cold build + compile
+        walls_e, bound_e = [], []
+        for r in range(1, warmup + rounds + 1):
+            apply_delta(store_e, r, now)
+            t = now + 2 * r
+            t0 = time.perf_counter()
+            res_e = pl_e.run_cycle(now=t)
+            wall = time.perf_counter() - t0
+            if r > warmup:
+                walls_e.append(wall)
+                bound_e.append(len(res_e.bound))
+        pl_e.flush()
+        wsum = float(np.sum(walls_e))
+        return float(np.sum(bound_e)) / wsum if wsum else 0.0
+
+    pps_counts = steady_pps_at("counts")
+    pps_off = steady_pps_at("off")
+    overhead = (100.0 * (1.0 - pps_counts / pps_off)) if pps_off > 0 else 0.0
+    log(f"explain overhead (A/B pair): counts {pps_counts:,.1f} vs off "
+        f"{pps_off:,.1f} pods/s -> {overhead:+.1f}%")
+    out.update({
+        "explain_overhead_pct": round(overhead, 1),
+        "steady_pods_per_sec_explain_counts": round(pps_counts, 1),
+        "steady_pods_per_sec_explain_off": round(pps_off, 1),
+    })
+
     # ---- fused-wave sweep: the same steady loop pinned to each K
     # (models/fused_waves.py), plus the per-dispatch fixed-overhead probe.
     # The probe times an already-compiled no-op jit with the fused step's

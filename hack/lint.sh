@@ -13,13 +13,16 @@ python -m koordinator_tpu.analysis koordinator_tpu bench.py
 echo "== compileall =="
 python -m compileall -q koordinator_tpu bench.py tests hack/microbench.py
 
-echo "== serial-vs-pipelined + fused-wave cycle parity =="
+echo "== serial-vs-pipelined + fused-wave + explain cycle parity =="
 # same store fixture through the strictly serial path, the CyclePipeline,
 # AND the fused multi-wave path at K in {1,2,4,8}: bindings, failure sets
 # and PodScheduled conditions must be byte-identical — a fused-K cycle is
 # K sequential single-round cycles (tier-1 runs the same fixtures via
 # tests/test_cycle_pipeline.py and tests/test_fused_waves.py; the
-# readback-in-wave-body rule above keeps the wave kernels device-pure)
+# readback-in-wave-body rule above keeps the wave kernels device-pure).
+# Also gates koordexplain: the kernel-counts formatter must reproduce the
+# legacy diagnose messages string-for-string, and the pipeline/fused
+# parity properties must hold with KOORD_TPU_EXPLAIN=counts enabled.
 JAX_PLATFORMS=cpu python -m koordinator_tpu.scheduler.pipeline_parity
 
 echo "== obs trace schema (golden fixture) =="
@@ -27,5 +30,11 @@ echo "== obs trace schema (golden fixture) =="
 # a deliberate format change must regenerate the fixture AND bump
 # TRACE_SCHEMA_VERSION in koordinator_tpu/obs/__init__.py
 python -m koordinator_tpu.obs tests/fixtures/trace_golden.jsonl > /dev/null
+
+echo "== flight-recorder bundle schema (golden fixture) =="
+# same pin for the koordexplain flight recorder (obs/flight.py): schema
+# drift against the checked-in bundle must be a conscious
+# FLIGHT_SCHEMA_VERSION bump + fixture regeneration
+python -m koordinator_tpu.obs flight tests/fixtures/flight_golden.jsonl > /dev/null
 
 echo "lint OK"

@@ -48,17 +48,25 @@ def add_loop_flags(ap, default_interval: float) -> None:
                     help="stop after this many ticks (0 = run until signal)")
 
 
-def serve_obs(port: int, metrics_registry, name: str, tracer=None):
+def serve_obs(port: int, metrics_registry, name: str, tracer=None,
+              health_provider=None, explain_provider=None, flight=None):
     """`--obs-port` wiring shared by the binaries: serve /metrics (and
-    /traces when a tracer is given) via obs.server.ObsServer and announce
-    the bound address. Returns the live server, or None when port is 0;
-    the caller shuts it down after its tick loop ends."""
+    /traces when a tracer is given, plus the koordexplain surfaces when
+    providers are given) via obs.server.ObsServer and announce the bound
+    address. Returns the live server, or None when port is 0; the caller
+    shuts it down after its tick loop ends."""
     if not port:
         return None
     from koordinator_tpu.obs.server import ObsServer
 
-    server, _thread = ObsServer(metrics_registry, tracer).serve(port)
+    server, _thread = ObsServer(
+        metrics_registry, tracer, health_provider=health_provider,
+        explain_provider=explain_provider, flight=flight).serve(port)
     routes = "/metrics + /traces" if tracer is not None else "/metrics"
+    if explain_provider is not None:
+        routes += " + /explain"
+    if flight is not None:
+        routes += " + /debug/flightrecorder"
     print(f"{name}: {routes} on 127.0.0.1:{server.server_address[1]}",
           file=sys.stderr)
     return server

@@ -57,7 +57,10 @@ def main(argv=None) -> int:
     from koordinator_tpu.scheduler import metrics as scheduler_metrics
 
     obs_server = serve_obs(args.obs_port, scheduler_metrics.REGISTRY,
-                           "koord-scheduler", tracer=sched.tracer)
+                           "koord-scheduler", tracer=sched.tracer,
+                           health_provider=sched.health_snapshot,
+                           explain_provider=sched.explain_record,
+                           flight=sched.flight)
 
     def tick():
         result = sched.run_cycle()

@@ -41,6 +41,63 @@ from koordinator_tpu.ops.numa import (
 )
 from koordinator_tpu.ops.quota import quota_admit_row, quota_used_add_row
 
+# ---------------------------------------------------------------------------
+# koordexplain: the per-filter-stage reject taxonomy shared by the on-device
+# attribution pass (explain_stage_counts), the host-numpy oracle
+# (scheduler/diagnose.py host_stage_counts) and the /explain surfaces.
+# ORDER IS LOAD-BEARING: it is the insertion order of diagnose.py's legacy
+# reasons dict, and format_stage_counts relies on a stable sort over it to
+# reproduce the legacy message tie-break byte-for-byte.
+# ---------------------------------------------------------------------------
+EXPLAIN_STAGES = (
+    "node not schedulable",
+    "taint/selector/volume-topology mismatch",
+    "insufficient resources",
+    "node load over threshold",
+    "hostPort in use",
+    "CSI volume limit exceeded",
+    "insufficient bindable CPUs",
+    "NUMA topology cannot fit",
+    "affinity/anti-affinity/spread mismatch",
+)
+# prometheus-safe stage keys for koord_scheduler_filter_rejections_total
+EXPLAIN_STAGE_KEYS = (
+    "node_not_schedulable",
+    "taint_selector_volume_topology",
+    "insufficient_resources",
+    "node_load_over_threshold",
+    "host_port_in_use",
+    "csi_volume_limit",
+    "insufficient_bindable_cpus",
+    "numa_topology",
+    "affinity_spread",
+    "gang_not_satisfied",
+    "quota_exhausted",
+)
+# pod-level PreFilter verdict slots appended after the per-node stages
+# (0/1 flags, not node counts — they reproduce diagnose.py's early returns)
+EXPLAIN_STAGE_GANG = len(EXPLAIN_STAGES)
+EXPLAIN_STAGE_QUOTA = len(EXPLAIN_STAGES) + 1
+NUM_EXPLAIN_STAGES = len(EXPLAIN_STAGES) + 2
+
+# per-plugin score-term slots of ExplainOut.terms rows (the "full" level)
+EXPLAIN_TERMS = ("LoadAware", "NodeNUMAResource", "Preferred",
+                 "best_score", "runner_up")
+
+
+class ExplainOut(NamedTuple):
+    """Attribution readback riding the scheduling dispatch.
+
+    ``stage_counts``: [P, NUM_EXPLAIN_STAGES] uint32 per-pod rejected-node
+    counts over the REAL (unpadded) nodes, evaluated at cycle-start state —
+    the same state scheduler/diagnose.py reads — plus the two pod-level
+    PreFilter verdict slots. The fused wave step emits [K, P, ...], one row
+    per wave at wave-start state. ``terms``: [P, len(EXPLAIN_TERMS)] f32
+    decision-time score attribution (None below the "full" level)."""
+
+    stage_counts: jnp.ndarray
+    terms: jnp.ndarray  # or None
+
 
 class FullChainInputs(NamedTuple):
     base: ScheduleInputs
@@ -135,7 +192,7 @@ def resolve_balance_idx(active_axes):
 
 
 def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode,
-                       bal_idx=(-1, -1)):
+                       bal_idx=(-1, -1), explain_terms=False):
     """The per-pod PreFilter+Filter+Score+select math, factored so the serial
     kernel and the wave kernel (models/wave_chain.py) trace the IDENTICAL
     computation — binding parity between them is by construction.
@@ -146,7 +203,18 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode,
     (gang validity AND quota admission); vmap-able over i at frozen
     state. score_row is the feasibility-masked [N] score vector and
     bal_row the unmasked balanced-allocation term (both consumed by the
-    wave kernel's conflict bound; the serial loop drops them)."""
+    wave kernel's conflict bound; the serial loop drops them).
+
+    With ``explain_terms`` (the KOORD_TPU_EXPLAIN=full kernels) evaluate
+    appends the per-plugin score-term rows (la_score, numa_score, pref) so
+    the loop body can record the winning node's attribution.
+
+    The returned callable carries ``evaluate.filter_chain`` — the
+    PreFilter+Filter verdicts alone, at any frozen state — which the
+    attribution pass (explain_stage_counts) vmaps to produce the per-stage
+    reject counts diagnose.py formats. It is the SAME closure evaluate
+    itself combines into ``feasible``, so counts can never drift from the
+    decisions."""
     inputs = fc.base
     reject_np, reject_prod = la_ops.loadaware_node_reject(
         inputs.allocatable,
@@ -173,16 +241,19 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode,
         bal_inv_c, bal_inv_m = (
             safe_reciprocal(inputs.allocatable[:, axis]) for axis in bal_idx)
 
-    def evaluate(i, requested, delta_np, delta_pr, numa_free, bind_free,
-                 quota_used, aff_count, anti_cover, aff_exists, port_used,
-                 vol_free):
+    def filter_chain(i, requested, numa_free, bind_free, quota_used,
+                     aff_count, anti_cover, aff_exists, port_used, vol_free):
+        """PreFilter + Filter verdicts for pod ``i`` at the given frozen
+        state: (gang_ok, quota_ok, fit, la_ok, cpuset_ok, numa_ok, zone,
+        taint_ok, affinity_ok, ports_ok, vol_ok). The single home of every
+        filter predicate — evaluate combines these into ``feasible`` and
+        the attribution pass counts their complements."""
         req_fit = inputs.fit_requests[i]
         req = fc.requests[i]
-        est = inputs.estimated[i]
         is_prod_i = inputs.is_prod[i]
 
         # ---- PreFilter: gang validity + quota admission (order-dependent)
-        admit = gang_pod_ok[i] & quota_admit_row(
+        quota_ok = quota_admit_row(
             req, fc.quota_id[i], fc.quota_ancestors, quota_used, fc.quota_runtime
         )
 
@@ -247,6 +318,22 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode,
         # (upstream's already-attached exemption)
         vn = fc.vol_needed[i][fc.node_vol_group]
         vol_ok = (vn <= 0) | (vol_free >= vn)
+        return (gang_pod_ok[i], quota_ok, fit, la_ok, cpuset_ok, numa_ok,
+                zone, taint_ok, affinity_ok, ports_ok, vol_ok)
+
+    def evaluate(i, requested, delta_np, delta_pr, numa_free, bind_free,
+                 quota_used, aff_count, anti_cover, aff_exists, port_used,
+                 vol_free):
+        req_fit = inputs.fit_requests[i]
+        req = fc.requests[i]
+        est = inputs.estimated[i]
+        is_prod_i = inputs.is_prod[i]
+
+        (gang_ok, quota_ok, fit, la_ok, cpuset_ok, numa_ok, zone, taint_ok,
+         affinity_ok, ports_ok, vol_ok) = filter_chain(
+            i, requested, numa_free, bind_free, quota_used, aff_count,
+            anti_cover, aff_exists, port_used, vol_free)
+        admit = gang_ok & quota_ok
         feasible = (
             inputs.node_ok & fit & la_ok & cpuset_ok & numa_ok & taint_ok
             & affinity_ok & ports_ok & vol_ok & admit
@@ -316,9 +403,43 @@ def make_pod_evaluator(fc: FullChainInputs, weight_idx, prod_mode,
         # score/bal rows + best value ride along for the wave kernel's
         # balanced-allocation conflict bound; the serial loop ignores them
         # (XLA dead-code-eliminates the unused outputs)
+        if explain_terms:
+            return (found, best, zone[best], admit, score, bal_row,
+                    score[best], la_score, numa_score, pref)
         return found, best, zone[best], admit, score, bal_row, score[best]
 
+    evaluate.filter_chain = filter_chain
     return evaluate
+
+
+def explain_stage_counts(fc: FullChainInputs, evaluate, filter_state,
+                         n_real):
+    """[P, NUM_EXPLAIN_STAGES] uint32: per-pod rejected-node counts at the
+    frozen ``filter_state`` — the (requested, numa_free, bind_free,
+    quota_used, aff_count, anti_cover, aff_exists, port_used, vol_free)
+    9-tuple ``evaluate.filter_chain`` takes — over the first ``n_real``
+    (unpadded) nodes, plus the two pod-level PreFilter verdict flags.
+    Vmapped reuse of the SAME filter_chain the decisions ran through, so a
+    count here is exactly "nodes this stage rejected for this pod", in the
+    state scheduler/diagnose.py diagnoses against."""
+    inputs = fc.base
+    N = inputs.allocatable.shape[0]
+    P = inputs.fit_requests.shape[0]
+    valid = jnp.arange(N, dtype=jnp.int32) < n_real
+
+    def row(i):
+        (gang_ok, quota_ok, fit, la_ok, cpuset_ok, numa_ok, _zone, taint_ok,
+         affinity_ok, ports_ok, vol_ok) = evaluate.filter_chain(
+            i, *filter_state)
+        # EXPLAIN_STAGES order (diagnose.py's legacy insertion order)
+        bads = (~inputs.node_ok, ~taint_ok, ~fit, ~la_ok, ~ports_ok,
+                ~vol_ok, ~cpuset_ok, ~numa_ok, ~affinity_ok)
+        counts = [jnp.sum(b & valid).astype(jnp.uint32) for b in bads]
+        counts.append(jnp.where(gang_ok, 0, 1).astype(jnp.uint32))
+        counts.append(jnp.where(quota_ok, 0, 1).astype(jnp.uint32))
+        return jnp.stack(counts)
+
+    return jax.vmap(row)(jnp.arange(P, dtype=jnp.int32))
 
 
 def commit_pod_state(fc: FullChainInputs, prod_mode: bool, state, i, found,
@@ -395,36 +516,61 @@ def commit_pod_state(fc: FullChainInputs, prod_mode: bool, state, i, found,
 
 
 def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
-                          jit: bool = True, active_axes=None):
+                          jit: bool = True, active_axes=None, explain=None):
     """FullChainInputs -> (chosen[P], requested[N, R], quota_used[G, R]).
 
     num_gangs/num_groups are static (gang arrays are padded to them).
     active_axes: when the inputs were sliced to the active resource axes
     (snapshot.reduce_to_active_axes), the original axis ids, so weight indices
     map correctly.
+
+    explain: None (the default, the exact historical step), "counts", or
+    "full" (koordexplain attribution). An explain step takes an extra
+    ``n_real`` int32 scalar (real node count — padding must not inflate
+    counts) and returns a 4th output, ExplainOut. The decision computation
+    is untouched: attribution is extra outputs only, so bindings stay
+    byte-identical to the explain=None step.
     """
     weight_idx = resolve_weight_idx(args, active_axes)
     bal_idx = resolve_balance_idx(active_axes)
     prod_mode = args.score_according_prod_usage
+    explain_full = explain == "full"
 
-    def step(fc: FullChainInputs):
+    def _step_impl(fc: FullChainInputs, n_real):
         inputs = fc.base
         P = inputs.fit_requests.shape[0]
         N = inputs.allocatable.shape[0]
-        evaluate = make_pod_evaluator(fc, weight_idx, prod_mode, bal_idx)
+        evaluate = make_pod_evaluator(fc, weight_idx, prod_mode, bal_idx,
+                                      explain_terms=explain_full)
 
         T = fc.aff_dom.shape[1]
         PT = fc.port_used.shape[1]
 
         def body(i, state):
-            chain_state, chosen = state[:-1], state[-1]
-
-            found, best, zone_at_best, _admit, _s, _b, _mv = evaluate(
-                i, *chain_state,
-            )
+            if explain_full:
+                chain_state, terms, chosen = state[:-2], state[-2], state[-1]
+                (found, best, zone_at_best, _admit, score, _b, best_v,
+                 la_row, numa_row, pref_row) = evaluate(i, *chain_state)
+                # decision-time attribution: the winning node's per-plugin
+                # terms + the runner-up score (margin = best - runner_up);
+                # -1 marks "no feasible runner-up", matching the score
+                # vector's infeasible sentinel
+                runner = jnp.maximum(jnp.max(jnp.where(
+                    jnp.arange(N, dtype=jnp.int32) == best,
+                    -jnp.inf, score)), -1.0)
+                terms = terms.at[i].set(jnp.stack([
+                    la_row[best], numa_row[best], pref_row[best],
+                    best_v, runner]))
+            else:
+                chain_state, chosen = state[:-1], state[-1]
+                found, best, zone_at_best, _admit, _s, _b, _mv = evaluate(
+                    i, *chain_state,
+                )
             chain_state = commit_pod_state(
                 fc, prod_mode, chain_state, i, found, best, zone_at_best)
             chosen = chosen.at[i].set(jnp.where(found, best.astype(jnp.int32), -1))
+            if explain_full:
+                return chain_state + (terms, chosen)
             return chain_state + (chosen,)
 
         R = inputs.fit_requests.shape[-1]
@@ -440,10 +586,13 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
             jnp.asarray(fc.aff_exists, bool),
             fc.port_used,
             fc.vol_free,
-            jnp.full(P, -1, jnp.int32),
         )
-        (requested, _, _, _, _, quota_used, _, _, _, _, _,
-         chosen) = jax.lax.fori_loop(0, P, body, init)
+        if explain_full:
+            init = init + (jnp.zeros((P, len(EXPLAIN_TERMS)), jnp.float32),)
+        init = init + (jnp.full(P, -1, jnp.int32),)
+        out = jax.lax.fori_loop(0, P, body, init)
+        requested, quota_used, chosen = out[0], out[5], out[-1]
+        terms = out[-2] if explain_full else None
 
         # ---- Permit barrier (gang group all-or-nothing)
         keep = gang_permit_mask(
@@ -451,14 +600,30 @@ def build_full_chain_step(args: LoadAwareArgs, num_gangs: int, num_groups: int,
             fc.gang_group_id, num_gangs, num_groups,
         )
         chosen = jnp.where(keep, chosen, -1)
-        return chosen, requested, quota_used
+        if explain is None:
+            return chosen, requested, quota_used
+        # attribution counts at CYCLE-START state — diagnose.py's contract
+        # (its legacy messages are computed against the packed batch before
+        # in-batch placements)
+        filter_state = (init[0], init[3], init[4], init[5], init[6],
+                        init[7], init[8], init[9], init[10])
+        counts = explain_stage_counts(fc, evaluate, filter_state, n_real)
+        return chosen, requested, quota_used, ExplainOut(counts, terms)
+
+    if explain is None:
+        def step(fc: FullChainInputs):
+            return _step_impl(fc, None)
+    else:
+        def step(fc: FullChainInputs, n_real):
+            return _step_impl(fc, n_real)
 
     return jax.jit(step) if jit else step
 
 
 def build_best_full_chain_step(args: LoadAwareArgs, num_gangs: int,
                                num_groups: int, active_axes=None,
-                               vmem_budget_bytes=None, kernel: str = "auto"):
+                               vmem_budget_bytes=None, kernel: str = "auto",
+                               explain=None):
     """Backend-aware selector: the VMEM-resident Pallas kernel on TPU
     (ops/pallas_full_chain.py, ~20x the fori_loop at 10k x 5k), the XLA
     step elsewhere. Same contract, bit-identical bindings.
@@ -473,15 +638,26 @@ def build_best_full_chain_step(args: LoadAwareArgs, num_gangs: int,
 
     ``kernel`` forces an implementation: "serial" (XLA fori_loop), "pallas",
     or "wave" (models/wave_chain.py); "auto" is the default selection above.
+
+    ``explain`` (koordexplain attribution) pins the XLA serial step — the
+    Pallas/wave kernels do not emit attribution; the cycle driver documents
+    the demotion via ``last_backend``.
     """
     def _forced(step_fn, name):
         # plain wrapper: jitted callables reject attribute assignment
-        def step(fc):
-            return step_fn(fc)
+        # (varargs: explain steps take an extra n_real operand)
+        def step(*fc_args):
+            return step_fn(*fc_args)
 
         step.last_backend = name
         return step
 
+    if explain is not None:
+        return _forced(
+            build_full_chain_step(args, num_gangs, num_groups,
+                                  active_axes=active_axes, explain=explain),
+            "xla",
+        )
     if kernel == "serial":
         return _forced(
             build_full_chain_step(args, num_gangs, num_groups,

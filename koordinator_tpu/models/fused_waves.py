@@ -61,8 +61,12 @@ import jax
 import jax.numpy as jnp
 
 from koordinator_tpu.models.full_chain import (
+    EXPLAIN_TERMS,
+    NUM_EXPLAIN_STAGES,
+    ExplainOut,
     FullChainInputs,
     commit_pod_state,
+    explain_stage_counts,
     make_pod_evaluator,
     resolve_balance_idx,
     resolve_weight_idx,
@@ -86,12 +90,22 @@ class FusedWaveOut(NamedTuple):
 
 def build_fused_wave_step(args: LoadAwareArgs, num_gangs: int,
                           num_groups: int, waves: int, jit: bool = True,
-                          active_axes=None):
+                          active_axes=None, explain=None):
     """(FullChainInputs, la_est[N, R], la_adj[N, R]) -> FusedWaveOut.
 
     ``la_est``/``la_adj`` are the LoadAware nonprod score-term split
     (build_loadaware_node_state's ``la_est_nonprod``/``la_adj_nonprod``),
     sliced to the same active axes as the rest of the batch.
+
+    ``explain`` (None | "counts" | "full", koordexplain): the step takes an
+    extra ``n_real`` int32 operand and returns (FusedWaveOut, ExplainOut)
+    with per-WAVE stage counts [waves, P, NUM_EXPLAIN_STAGES], each wave's
+    row computed at wave-START state — exactly the state the driver's
+    legacy host mirror (_WaveStateMirror) would hand diagnose.py for that
+    logical cycle. "full" additionally carries the winning node's score
+    terms for each pod across waves (the wave that finally kept the pod
+    wins the row). Decisions are untouched: attribution is extra carried
+    outputs only.
     """
     if not 1 <= waves <= MAX_WAVES:
         raise ValueError(f"waves must be in [1, {MAX_WAVES}], got {waves}")
@@ -103,8 +117,9 @@ def build_fused_wave_step(args: LoadAwareArgs, num_gangs: int,
     weight_idx = resolve_weight_idx(args, active_axes)
     bal_idx = resolve_balance_idx(active_axes)
     prod_mode = False
+    explain_full = explain == "full"
 
-    def step(fc: FullChainInputs, la_est, la_adj):
+    def _step_impl(fc: FullChainInputs, la_est, la_adj, n_real):
         inputs = fc.base
         P, R = inputs.fit_requests.shape
         N = inputs.allocatable.shape[0]
@@ -113,7 +128,11 @@ def build_fused_wave_step(args: LoadAwareArgs, num_gangs: int,
             (assigned, requested, est_sum, numa_free, bind_free, quota_used,
              aff_count, anti_cover, aff_exists, port_used, vol_free,
              gang_assumed, out_pods, out_nodes, out_zones, n_out,
-             wave_counts, w, done) = carry
+             wave_counts) = carry[:17]
+            w, done = carry[-2], carry[-1]
+            if explain is not None:
+                ex_counts = carry[17]
+                ex_terms = carry[18] if explain_full else None
 
             # the round's LoadAware base term, rebuilt-association exact:
             # est_sum folds committed estimates in bind order onto the
@@ -123,20 +142,48 @@ def build_fused_wave_step(args: LoadAwareArgs, num_gangs: int,
             fc_w = fc._replace(base=inputs._replace(
                 la_term_nonprod=term, pod_valid=active))
             evaluate = make_pod_evaluator(fc_w, weight_idx, prod_mode,
-                                          bal_idx)
+                                          bal_idx,
+                                          explain_terms=explain_full)
+
+            if explain is not None:
+                # per-wave attribution at wave-START state: the counts the
+                # driver's logical cycle w formats for pods it leaves
+                # unbound (diagnose.py reads wave-start state, see
+                # _WaveStateMirror)
+                filter_state = (requested, numa_free, bind_free, quota_used,
+                                aff_count, anti_cover, aff_exists,
+                                port_used, vol_free)
+                counts_w = explain_stage_counts(fc_w, evaluate, filter_state,
+                                                n_real)
+                ex_counts = jax.lax.dynamic_update_slice(
+                    ex_counts, counts_w[None], (w, 0, 0))
 
             # ---- pass 1: the serial round (identical tracing to
             # build_full_chain_step's body — decisions are by construction
             # what serial cycle w's kernel would decide)
             def body(i, state):
-                chain_state, chosen = state[:-1], state[-1]
-                found, best, zone_at_best, _adm, _s, _b, _mv = evaluate(
-                    i, *chain_state)
+                if explain_full:
+                    chain_state, wterms, chosen = (state[:-2], state[-2],
+                                                   state[-1])
+                    (found, best, zone_at_best, _adm, score, _b, best_v,
+                     la_row, numa_row, pref_row) = evaluate(i, *chain_state)
+                    runner = jnp.maximum(jnp.max(jnp.where(
+                        jnp.arange(N, dtype=jnp.int32) == best,
+                        -jnp.inf, score)), -1.0)
+                    wterms = wterms.at[i].set(jnp.stack([
+                        la_row[best], numa_row[best], pref_row[best],
+                        best_v, runner]))
+                else:
+                    chain_state, chosen = state[:-1], state[-1]
+                    found, best, zone_at_best, _adm, _s, _b, _mv = evaluate(
+                        i, *chain_state)
                 chain_state = commit_pod_state(
                     fc_w, prod_mode, chain_state, i, found, best,
                     zone_at_best)
                 chosen = chosen.at[i].set(
                     jnp.where(found, best.astype(jnp.int32), -1))
+                if explain_full:
+                    return chain_state + (wterms, chosen)
                 return chain_state + (chosen,)
 
             init = (
@@ -151,9 +198,14 @@ def build_fused_wave_step(args: LoadAwareArgs, num_gangs: int,
                 aff_exists,
                 port_used,
                 vol_free,
-                jnp.full(P, -1, jnp.int32),
             )
-            chosen = jax.lax.fori_loop(0, P, body, init)[-1]
+            if explain_full:
+                init = init + (
+                    jnp.zeros((P, len(EXPLAIN_TERMS)), jnp.float32),)
+            init = init + (jnp.full(P, -1, jnp.int32),)
+            pass1 = jax.lax.fori_loop(0, P, body, init)
+            chosen = pass1[-1]
+            wave_terms = pass1[-2] if explain_full else None
 
             # ---- Permit barrier against the CARRIED assumed counters
             keep = gang_permit_mask(
@@ -162,6 +214,10 @@ def build_fused_wave_step(args: LoadAwareArgs, num_gangs: int,
             )
             kept = (chosen >= 0) & keep
             kept_count = jnp.sum(kept.astype(jnp.int32))
+            if explain_full:
+                # the wave that finally KEEPS a pod owns its attribution
+                # row (a Permit-reverted choice never persisted host-side)
+                ex_terms = jnp.where(kept[:, None], wave_terms, ex_terms)
 
             # ---- pass 2: kept-only replay from the WAVE-START state.
             # Reverted gang reservations never persisted host-side, so the
@@ -215,10 +271,15 @@ def build_fused_wave_step(args: LoadAwareArgs, num_gangs: int,
             # a zero-commit wave is a fixpoint: the next wave would see
             # identical state and commit nothing again
             done = kept_count == 0
-            return (assigned, requested, est_sum, numa_free, bind_free,
-                    quota_used, aff_count, anti_cover, aff_exists,
-                    port_used, vol_free, gang_assumed, out_pods, out_nodes,
-                    out_zones, n_out, wave_counts, w + 1, done)
+            new_carry = (assigned, requested, est_sum, numa_free, bind_free,
+                         quota_used, aff_count, anti_cover, aff_exists,
+                         port_used, vol_free, gang_assumed, out_pods,
+                         out_nodes, out_zones, n_out, wave_counts)
+            if explain is not None:
+                new_carry = new_carry + (ex_counts,)
+                if explain_full:
+                    new_carry = new_carry + (ex_terms,)
+            return new_carry + (w + 1, done)
 
         def cond(carry):
             w, done = carry[-2], carry[-1]
@@ -242,12 +303,27 @@ def build_fused_wave_step(args: LoadAwareArgs, num_gangs: int,
             jnp.full(P, -1, jnp.int32),
             jnp.int32(0),
             jnp.zeros(waves, jnp.int32),
-            jnp.int32(0),
-            jnp.bool_(False),
         )
+        if explain is not None:
+            init = init + (
+                jnp.zeros((waves, P, NUM_EXPLAIN_STAGES), jnp.uint32),)
+            if explain_full:
+                init = init + (
+                    jnp.zeros((P, len(EXPLAIN_TERMS)), jnp.float32),)
+        init = init + (jnp.int32(0), jnp.bool_(False))
         out = jax.lax.while_loop(cond, wave_body, init)
-        return FusedWaveOut(
+        fw = FusedWaveOut(
             bind_pods=out[12], bind_nodes=out[13], bind_zones=out[14],
-            wave_counts=out[16], waves_run=out[17])
+            wave_counts=out[16], waves_run=out[-2])
+        if explain is None:
+            return fw
+        return fw, ExplainOut(out[17], out[18] if explain_full else None)
+
+    if explain is None:
+        def step(fc: FullChainInputs, la_est, la_adj):
+            return _step_impl(fc, la_est, la_adj, None)
+    else:
+        def step(fc: FullChainInputs, la_est, la_adj, n_real):
+            return _step_impl(fc, la_est, la_adj, n_real)
 
     return jax.jit(step) if jit else step

@@ -1,4 +1,4 @@
-"""Shared /metrics + /traces + /healthz surface for every binary.
+"""Shared /metrics + /traces + /healthz (+ koordexplain) surface.
 
 The koordlet API server (`koordlet/server.py`) established the pattern:
 a socket-free routing core `handle(path, query) -> (status, content_type,
@@ -7,33 +7,58 @@ ThreadingHTTPServer for live use. This module extracts that pattern so the
 scheduler and descheduler expose the exact same Prometheus exposition
 format (and JSONL trace dumps) as the node agent — one scrape config for
 the whole deployment.
+
+koordexplain (PR 5) adds the decision surfaces: ``/explain?pod=<key>``
+answers "why this node / why not at all" from the scheduler's latest
+attribution, and ``/debug/flightrecorder`` serves the cycle flight
+recorder (GET = status, POST = dump the ring as a JSONL bundle).
 """
 
 from __future__ import annotations
 
+import json
 from typing import Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 
 def serve_handler(handle, port: int = 0):
-    """Wrap a `(path, query) -> (status, content_type, body)` routing core
-    in a ThreadingHTTPServer on 127.0.0.1; returns (server, thread). The
-    one HTTP wrapper every handler-pattern server shares (ObsServer,
-    KoordletServer) — fix transport behavior here, not per server."""
+    """Wrap a `(path, query[, method]) -> (status, content_type, body)`
+    routing core in a ThreadingHTTPServer on 127.0.0.1; returns
+    (server, thread). The one HTTP wrapper every handler-pattern server
+    shares (ObsServer, KoordletServer) — fix transport behavior here, not
+    per server. Handlers that accept a ``method`` parameter also receive
+    POSTs; two-argument handlers stay GET-only (POST returns 405)."""
+    import inspect
     import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+    try:
+        accepts_method = "method" in inspect.signature(handle).parameters
+    except (TypeError, ValueError):
+        accepts_method = False
+
     class Handler(BaseHTTPRequestHandler):
-        def do_GET(self):  # noqa: N802 (stdlib API)
+        def _route(self, method: str):
             url = urlparse(self.path)
             q = {k: v[0] for k, v in parse_qs(url.query).items()}
-            status, ctype, body = handle(url.path, q)
+            if accepts_method:
+                status, ctype, body = handle(url.path, q, method)
+            elif method == "GET":
+                status, ctype, body = handle(url.path, q)
+            else:
+                status, ctype, body = 405, "text/plain", "method not allowed"
             payload = body.encode()
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
             self.wfile.write(payload)
+
+        def do_GET(self):  # noqa: N802 (stdlib API)
+            self._route("GET")
+
+        def do_POST(self):  # noqa: N802 (stdlib API)
+            self._route("POST")
 
         def log_message(self, fmt, *args):  # silence
             pass
@@ -47,22 +72,35 @@ def serve_handler(handle, port: int = 0):
 class ObsServer:
     """Routing core for the observability endpoints.
 
-    * ``/healthz`` — liveness
+    * ``/healthz`` — liveness; with a ``health_provider`` the body is its
+      JSON payload (the scheduler reports last-completed-cycle age + wave
+      count — a stale-cycle signal instead of a bare 200), else "ok"
     * ``/metrics`` — Prometheus text exposition from the given Registry
     * ``/traces``  — the tracer ring as JSONL (``?limit=N`` newest roots),
       replayable with ``python -m koordinator_tpu.obs``
+    * ``/explain?pod=<key>`` — the pod's latest decision attribution
+      (``explain_provider``: pod key -> record dict or None)
+    * ``/debug/flightrecorder`` — GET: ring status; POST: dump the ring as
+      a JSONL bundle (``flight``: an obs.flight.FlightRecorder)
     """
 
-    def __init__(self, metrics_registry=None, tracer=None):
+    def __init__(self, metrics_registry=None, tracer=None,
+                 health_provider=None, explain_provider=None, flight=None):
         self.metrics_registry = metrics_registry
         self.tracer = tracer
+        self.health_provider = health_provider
+        self.explain_provider = explain_provider
+        self.flight = flight
 
-    def handle(self, path: str, query: Optional[Dict[str, str]] = None
-               ) -> Tuple[int, str, str]:
+    def handle(self, path: str, query: Optional[Dict[str, str]] = None,
+               method: str = "GET") -> Tuple[int, str, str]:
         """(status, content_type, body)."""
         query = query or {}
         parts = [p for p in path.split("/") if p]
         if parts == ["healthz"]:
+            if self.health_provider is not None:
+                return (200, "application/json",
+                        json.dumps(self.health_provider(), sort_keys=True))
             return 200, "text/plain", "ok"
         if parts == ["metrics"] and self.metrics_registry is not None:
             return (200, "text/plain; version=0.0.4",
@@ -80,6 +118,27 @@ class ObsServer:
                     return 400, "text/plain", "limit must be non-negative"
             body = self.tracer.export_jsonl(limit=limit)
             return 200, "application/x-ndjson", body
+        if parts == ["explain"] and self.explain_provider is not None:
+            pod = query.get("pod")
+            if not pod:
+                return (400, "text/plain",
+                        "missing ?pod=<namespace/name> parameter")
+            record = self.explain_provider(pod)
+            if record is None:
+                return (404, "application/json", json.dumps({
+                    "pod": pod,
+                    "error": "no decision recorded for this pod (not "
+                             "scheduled since explain was enabled, or "
+                             "KOORD_TPU_EXPLAIN is off)",
+                }, sort_keys=True))
+            return (200, "application/json",
+                    json.dumps({"pod": pod, **record}, sort_keys=True))
+        if parts == ["debug", "flightrecorder"] and self.flight is not None:
+            if method == "POST":
+                return (200, "application/x-ndjson",
+                        self.flight.dump("http"))
+            return (200, "application/json",
+                    json.dumps(self.flight.status(), sort_keys=True))
         return 404, "text/plain", f"unknown path {path!r}"
 
     def serve(self, port: int = 0):

@@ -94,6 +94,44 @@ def waves_from_env():
         return "auto"
 
 
+def explain_from_env():
+    """KOORD_TPU_EXPLAIN=off|counts|full gates koordexplain decision
+    attribution (models/full_chain.explain_stage_counts): "counts" emits
+    the per-pod per-stage rejected-node counts in the scheduling dispatch
+    (diagnose becomes a pure formatter over them), "full" adds the winning
+    node's per-plugin score terms + runner-up for bound pods. Returns
+    None (off), "counts" or "full"."""
+    import os
+
+    raw = os.environ.get("KOORD_TPU_EXPLAIN", "off").strip().lower()
+    if raw in ("", "off", "0", "false", "no"):
+        return None
+    if raw in ("counts", "on", "1", "true"):
+        return "counts"
+    if raw == "full":
+        return "full"
+    logger.warning("KOORD_TPU_EXPLAIN=%r unknown; explain stays off", raw)
+    return None
+
+
+def cycle_deadline_from_env():
+    """KOORD_TPU_CYCLE_DEADLINE_MS=N arms the flight recorder's
+    deadline-overrun trigger: a cycle slower than N ms dumps the ring.
+    Unset/0 disables (the default)."""
+    import os
+
+    raw = os.environ.get("KOORD_TPU_CYCLE_DEADLINE_MS", "").strip()
+    if not raw:
+        return None
+    try:
+        ms = float(raw)
+    except ValueError:
+        logger.warning("KOORD_TPU_CYCLE_DEADLINE_MS=%r not a number; "
+                       "deadline trigger off", raw)
+        return None
+    return ms / 1000.0 if ms > 0 else None
+
+
 def _auto_waves(queue_depth: int) -> int:
     """Depth-based auto-K: the fused dispatch amortizes the fixed
     dispatch+readback overhead over K dependent rounds, but each extra
@@ -216,6 +254,7 @@ class Scheduler:
         elector=None,
         sidecar_address: Optional[str] = None,
         waves=None,
+        explain=None,
     ):
         from koordinator_tpu.scheduler.config import SchedulerConfiguration
         from koordinator_tpu.scheduler.plugins.reservation import (
@@ -296,6 +335,35 @@ class Scheduler:
         # (models/fused_waves.py). "auto" picks from queue depth per
         # cycle; an int pins it. K=1 always takes the exact serial path.
         self.waves_spec = waves_from_env() if waves is None else waves
+        # koordexplain (KOORD_TPU_EXPLAIN): None=off, "counts", "full".
+        # An explicit "off" argument pins it off regardless of env (the
+        # bench A/B pairs and parity twins need that determinism). Unknown
+        # strings fail loudly — a typo like "Full" would otherwise build
+        # the counts kernel and silently drop the score terms.
+        if explain not in (None, "off", "counts", "full"):
+            raise ValueError(
+                f"explain must be None, 'off', 'counts' or 'full'; "
+                f"got {explain!r}")
+        self.explain_spec = (explain_from_env() if explain is None
+                             else (None if explain == "off" else explain))
+        # cycle flight recorder (obs/flight.py): decision records for the
+        # last N cycles, dumped on deadline overrun / unhandled cycle
+        # exception / parity mismatch / HTTP demand
+        import threading
+
+        from koordinator_tpu.obs.flight import FlightRecorder
+
+        self.flight = FlightRecorder(
+            dump_counter=scheduler_metrics.FLIGHT_DUMPS)
+        self.cycle_deadline_seconds = cycle_deadline_from_env()
+        # /explain surface state: written by the cycle thread, read by the
+        # ObsServer thread — lock-guarded (koordlint concurrency gate)
+        self._explain_lock = threading.Lock()
+        self.explain_index: Dict[str, dict] = {}
+        self._cycle_attrib: Dict[str, dict] = {}
+        self._cycle_terms: Dict[str, dict] = {}
+        self._cycle_counter = 0
+        self._last_cycle_end: Optional[Tuple[float, int]] = None
         # SURVEY 7 step 6: the host event loop may offload the kernel pass
         # to a gRPC sidecar (the Go<->JAX integration shape); transport
         # failures degrade to the in-process step, never wedging the cycle
@@ -308,7 +376,9 @@ class Scheduler:
         # overlaps device execution. Off by default — plain run_cycle
         # callers keep the strictly serial path.
         self.pipeline_mode = False
-        self._deferred_diagnose: List[Tuple[list, object, float]] = []
+        # (items, last-batch tuple, now, precomputed messages) per deferral
+        self._deferred_diagnose: List[Tuple[list, object, float,
+                                            Optional[Dict[str, str]]]] = []
         self._flushed_this_cycle = False
         # last DeviceSnapshot stats snapshot, for counter deltas
         self._upload_stats_last: Dict[str, int] = {}
@@ -522,8 +592,9 @@ class Scheduler:
             now=now,
         )
 
-    def _get_step(self, signature: Tuple, ng: int, ngroups: int, active) -> object:
-        key = (signature, ng, ngroups, tuple(active))
+    def _get_step(self, signature: Tuple, ng: int, ngroups: int, active,
+                  explain=None) -> object:
+        key = (signature, ng, ngroups, tuple(active), explain)
         step = self._step_cache.get(key)
         if step is not None:
             self._last_step_compiled = False
@@ -539,16 +610,16 @@ class Scheduler:
         scheduler_metrics.COMPILE_CACHE_MISSES.inc()
         with self.tracer.span("compile", signature=str(key)):
             step = build_best_full_chain_step(
-                self.args, ng, ngroups, active_axes=active
+                self.args, ng, ngroups, active_axes=active, explain=explain
             )
         self._step_cache[key] = step
         return step
 
     def _get_fused_step(self, signature: Tuple, ng: int, ngroups: int,
-                        active, waves: int) -> object:
+                        active, waves: int, explain=None) -> object:
         from koordinator_tpu.models.fused_waves import build_fused_wave_step
 
-        key = ("fused", waves, signature, ng, ngroups, tuple(active))
+        key = ("fused", waves, signature, ng, ngroups, tuple(active), explain)
         step = self._step_cache.get(key)
         if step is not None:
             self._last_step_compiled = False
@@ -558,9 +629,18 @@ class Scheduler:
         scheduler_metrics.COMPILE_CACHE_MISSES.inc()
         with self.tracer.span("compile", signature=str(key)):
             step = build_fused_wave_step(
-                self.args, ng, ngroups, waves=waves, active_axes=active)
+                self.args, ng, ngroups, waves=waves, active_axes=active,
+                explain=explain)
         self._step_cache[key] = step
         return step
+
+    def _effective_explain(self):
+        """This cycle's koordexplain level. The sidecar path demotes to
+        off: the RPC protocol ships only the chosen vector, so attribution
+        falls back to the legacy host recompute."""
+        if self._sidecar_client is not None:
+            return None
+        return self.explain_spec
 
     def _effective_waves(self, pending: List[Pod],
                          pending_reservations: Dict[str, Reservation],
@@ -609,28 +689,167 @@ class Scheduler:
         result = CycleResult()
         carried_deferred = bool(self._deferred_diagnose)
         self._flushed_this_cycle = False
+        self._cycle_attrib = {}
+        self._cycle_terms = {}
+        self._cycle_counter += 1
+        flight_base = self._flight_metric_base()
+        root = None
         # root span: the ONE place the cycle duration is stamped. Every
         # early-return path inside the traced body (empty queue, pre-pass
         # binds everything, full pass) exits through the span's finally,
         # so no return path can ship a zero duration — the old three-site
         # assignment pattern broke exactly that way.
-        with self.tracer.span("cycle") as root:
-            self._run_cycle_traced(now, result, waves_override=waves)
-            # a cycle with no local kernel window (empty queue, sidecar
-            # path) never reached the overlap flush: drain carried-over
-            # deferred writes here so they cannot linger unboundedly —
-            # without device work to overlap, flushing now IS the serial
-            # timing
-            if (self.pipeline_mode and carried_deferred
-                    and not self._flushed_this_cycle
-                    and self._deferred_diagnose):
-                self.flush_deferred()
+        try:
+            with self.tracer.span("cycle") as root:
+                self._run_cycle_traced(now, result, waves_override=waves)
+                # a cycle with no local kernel window (empty queue, sidecar
+                # path) never reached the overlap flush: drain carried-over
+                # deferred writes here so they cannot linger unboundedly —
+                # without device work to overlap, flushing now IS the serial
+                # timing
+                if (self.pipeline_mode and carried_deferred
+                        and not self._flushed_this_cycle
+                        and self._deferred_diagnose):
+                    self.flush_deferred()
+        except Exception as exc:
+            # flight-recorder trigger: an unhandled cycle exception leaves
+            # the wreck behind — the partial result, the span tree (the
+            # span's finally already committed the root with an error
+            # attribute) and the preceding cycles in the ring — then
+            # re-raises unchanged
+            result.duration_seconds = (root.duration_seconds
+                                       if root is not None else 0.0)
+            self.flight.record_cycle(self._flight_record(
+                result, now, root, flight_base,
+                error=f"{type(exc).__name__}: {exc}"))
+            self.flight.dump("cycle_exception")
+            raise
         result.duration_seconds = root.duration_seconds
         scheduler_metrics.CYCLE_SECONDS.observe(result.duration_seconds)
         if result.bound:
             scheduler_metrics.PODS_BOUND_TOTAL.inc(len(result.bound))
         self.extender.monitor.record(result)
+        self._finish_cycle_obs(result, now, root, flight_base)
         return result
+
+    # ------------------------------------------------------------------
+    def _flight_metric_base(self) -> Dict[str, float]:
+        """Cycle-start counter values, so the flight record carries per-
+        cycle METRIC DELTAS instead of meaningless cumulative totals."""
+        return {
+            "pods_bound": scheduler_metrics.PODS_BOUND_TOTAL.get() or 0.0,
+            "compile_cache_misses":
+                scheduler_metrics.COMPILE_CACHE_MISSES.get() or 0.0,
+            "readback_bytes": scheduler_metrics.READBACK_BYTES.get() or 0.0,
+            "explain_readback_bytes":
+                scheduler_metrics.EXPLAIN_READBACK_BYTES.get() or 0.0,
+        }
+
+    def _flight_record(self, result: CycleResult, now: float, root,
+                       base: Dict[str, float], error=None) -> dict:
+        """One flight-recorder cycle record (obs/flight.py schema)."""
+        from koordinator_tpu.obs.flight import FLIGHT_SCHEMA_VERSION
+
+        end = self._flight_metric_base()
+        bound = []
+        for b in result.bound:
+            entry: Dict[str, object] = {"pod": b.pod_key, "node": b.node_name}
+            terms = self._cycle_terms.get(b.pod_key)
+            if terms is not None:
+                entry["terms"] = terms
+            bound.append(entry)
+
+        def unbound(keys: List[str]) -> List[dict]:
+            out = []
+            for key in keys:
+                entry: Dict[str, object] = {"pod": key}
+                attrib = self._cycle_attrib.get(key)
+                if attrib:
+                    for field in ("reason", "stages", "message"):
+                        if field in attrib:
+                            entry[field] = attrib[field]
+                out.append(entry)
+            return out
+
+        record = {
+            "v": FLIGHT_SCHEMA_VERSION,
+            "kind": "cycle",
+            "seq": self._cycle_counter,
+            "ts": float(now),
+            "duration_ms": result.duration_seconds * 1000.0,
+            "waves": int(result.waves),
+            "bound": bound,
+            "failed": unbound(result.failed),
+            "rejected": unbound(result.rejected),
+            "preempted": list(result.preempted_victims),
+            "metrics": {k: end[k] - base.get(k, 0.0) for k in end},
+            "spans": ([s.to_record() for s in root.walk()]
+                      if root is not None else []),
+        }
+        if error is not None:
+            record["error"] = str(error)
+        return record
+
+    def _finish_cycle_obs(self, result: CycleResult, now: float, root,
+                          flight_base: Dict[str, float]) -> None:
+        """Post-cycle koordexplain bookkeeping: bound-pod attribution, the
+        /explain index, the flight ring, liveness state and the deadline
+        trigger."""
+        if self.explain_spec is not None:
+            for b in result.bound:
+                rec: Dict[str, object] = {"verdict": "bound",
+                                          "node": b.node_name}
+                terms = self._cycle_terms.get(b.pod_key)
+                if terms is not None:
+                    rec["terms"] = terms
+                    # margin vs the runner-up node; meaningful only when a
+                    # feasible runner-up existed (runner_up >= 0)
+                    rec["margin"] = terms["best_score"] - terms["runner_up"]
+                self._cycle_attrib[b.pod_key] = rec
+            with self._explain_lock:
+                for key, rec in self._cycle_attrib.items():
+                    rec = dict(rec)
+                    rec["cycle"] = self._cycle_counter
+                    rec["ts"] = float(now)
+                    # pop-then-insert keeps dict order = recency, so the
+                    # cap below evicts the genuinely oldest records
+                    self.explain_index.pop(key, None)
+                    self.explain_index[key] = rec
+                overflow = len(self.explain_index) - 4096
+                if overflow > 0:
+                    # dict preserves insertion order: drop the oldest
+                    for key in list(self.explain_index)[:overflow]:
+                        del self.explain_index[key]
+        self.flight.record_cycle(
+            self._flight_record(result, now, root, flight_base))
+        with self._explain_lock:
+            self._last_cycle_end = (time.time(), int(result.waves))
+        if (self.cycle_deadline_seconds is not None
+                and result.duration_seconds > self.cycle_deadline_seconds):
+            self.flight.dump("deadline_overrun")
+
+    def health_snapshot(self) -> Dict[str, object]:
+        """The ObsServer /healthz payload: last-completed-cycle age + wave
+        count — a stale-cycle liveness signal instead of a bare 200."""
+        with self._explain_lock:
+            last = self._last_cycle_end
+            cycles = self._cycle_counter
+        if last is None:
+            return {"status": "ok", "cycles": 0}
+        end_wall, waves = last
+        return {
+            "status": "ok",
+            "cycles": cycles,
+            "last_cycle_age_seconds": max(0.0, time.time() - end_wall),
+            "last_cycle_waves": waves,
+        }
+
+    def explain_record(self, pod_key: str) -> Optional[dict]:
+        """The /explain?pod= payload: the pod's most recent decision
+        attribution, or None."""
+        with self._explain_lock:
+            rec = self.explain_index.get(pod_key)
+            return dict(rec) if rec is not None else None
 
     def _run_cycle_traced(self, now: float, result: CycleResult,
                           waves_override=None) -> None:
@@ -843,6 +1062,7 @@ class Scheduler:
             (p, "admission rejected") for p in rejected_pods]
         if not items:
             return
+        messages = self._capture_attribution(items, last)
         if self.pipeline_mode:
             # pipelined cycle: the writes run inside the NEXT cycle's
             # kernel window (flush_deferred), overlapping device work.
@@ -856,10 +1076,70 @@ class Scheduler:
             # drains the queue; idle drivers must call flush().)
             if not any(r in DIAGNOSED_REASONS for _p, r in items):
                 last = None
-            self._deferred_diagnose.append((items, last, now))
+            elif last is not None and last[3] is not None:
+                # kernel-emitted counts captured: the deferred formatter
+                # needs only (index, n_nodes, counts) — never pin the
+                # packed fc arrays across the deferral
+                last = (None, last[1], last[2], last[3])
+            self._deferred_diagnose.append((items, last, now, messages))
+            scheduler_metrics.DIAGNOSE_DEFERRED_TOTAL.inc(len(items))
+            scheduler_metrics.DIAGNOSE_DEFERRED_DEPTH.set(
+                float(len(self._deferred_diagnose)))
             return
         with self.tracer.span("diagnose", pods=str(len(items))):
-            self._diagnose_and_write(items, last, now)
+            self._diagnose_and_write(items, last, now, messages=messages)
+
+    def _stash_terms(self, keys, chosen_mask, terms_np) -> None:
+        """KOORD_TPU_EXPLAIN=full: per-pod decision-time score attribution
+        rows for pods the kernel chose a node for. Only pods that finish
+        the cycle BOUND are surfaced (a Reserve veto leaves the row
+        unread)."""
+        from koordinator_tpu.models.full_chain import EXPLAIN_TERMS
+
+        for i, key in enumerate(keys):
+            if bool(chosen_mask[i]):
+                row = terms_np[i]
+                self._cycle_terms[key] = {
+                    name: float(row[j])
+                    for j, name in enumerate(EXPLAIN_TERMS)
+                }
+
+    def _capture_attribution(self, items, last) -> Optional[Dict[str, str]]:
+        """koordexplain capture, at verdict time (NOT at deferred-flush
+        time, so pipeline mode cannot skew metrics or the flight record):
+        per-stage rejection counters + the /explain and flight-recorder
+        attribution entries for pods ending this logical cycle unbound.
+        Returns the formatted message per pod key so the condition writer
+        never formats the same counts twice. No-op (None) when kernel
+        counts were not emitted (explain off, sidecar)."""
+        if self.explain_spec is None:
+            return None
+        counts = last[3] if last is not None else None
+        if counts is not None:
+            from koordinator_tpu.models.full_chain import EXPLAIN_STAGE_KEYS
+            from koordinator_tpu.scheduler.diagnose import (
+                format_stage_counts,
+            )
+        messages: Dict[str, str] = {}
+        for pod, reason in items:
+            entry: Dict[str, object] = {"verdict": "unschedulable",
+                                        "reason": reason}
+            if counts is not None and reason in DIAGNOSED_REASONS:
+                j = last[1].get(pod.meta.key)
+                if j is not None:
+                    row = counts[j]
+                    stages = {}
+                    for stage_key, c in zip(EXPLAIN_STAGE_KEYS, row):
+                        if int(c):
+                            stages[stage_key] = int(c)
+                            scheduler_metrics.FILTER_REJECTIONS.inc(
+                                int(c), stage=stage_key)
+                    entry["stages"] = stages
+                    msg = format_stage_counts(row, last[2])
+                    entry["message"] = msg
+                    messages[pod.meta.key] = msg
+            self._cycle_attrib[pod.meta.key] = entry
+        return messages or None
 
     def flush_deferred(self) -> None:
         """Drain deferred diagnose/condition work (pipeline mode). Runs in
@@ -869,30 +1149,51 @@ class Scheduler:
         verdicts across cycles."""
         self._flushed_this_cycle = True
         while self._deferred_diagnose:
-            items, last, now = self._deferred_diagnose.pop(0)
+            items, last, now, messages = self._deferred_diagnose.pop(0)
             with self.tracer.span("diagnose", pods=str(len(items)),
                                   deferred="1"):
-                self._diagnose_and_write(items, last, now, deferred=True)
+                self._diagnose_and_write(items, last, now, deferred=True,
+                                         messages=messages)
+        scheduler_metrics.DIAGNOSE_DEFERRED_DEPTH.set(
+            float(len(self._deferred_diagnose)))
 
     def _diagnose_and_write(self, items, last, now: float,
-                            deferred: bool = False) -> None:
+                            deferred: bool = False, messages=None) -> None:
         shared = None  # node-level diagnosis state, built once per cycle
         for pod, reason in items:
             msg = reason
-            if last is not None and reason in DIAGNOSED_REASONS:
-                fc, index, n_nodes = last
+            if messages is not None and pod.meta.key in messages:
+                # koordexplain: _capture_attribution already formatted the
+                # kernel-emitted counts at verdict time — reuse, don't
+                # recompute
+                msg = messages[pod.meta.key]
+            elif last is not None and reason in DIAGNOSED_REASONS:
+                fc, index, n_nodes, counts = last
                 j = index.get(pod.meta.key)
                 if j is not None:
-                    from koordinator_tpu.scheduler.diagnose import (
-                        diagnose_unbound,
-                        shared_state,
-                    )
-
                     try:
-                        if shared is None:
-                            shared = shared_state(fc, n_nodes)
-                        msg = diagnose_unbound(fc, j, n_nodes,
-                                               shared=shared)
+                        if counts is not None:
+                            # koordexplain: pure formatter over the
+                            # KERNEL-emitted stage counts — no host
+                            # recompute (tier-1 pins this string-for-
+                            # string against the legacy path below)
+                            from koordinator_tpu.scheduler.diagnose import (
+                                format_stage_counts,
+                            )
+
+                            msg = format_stage_counts(counts[j], n_nodes)
+                        elif fc is not None:
+                            # legacy host-numpy recompute: the parity
+                            # oracle, and the path explain-off keeps
+                            from koordinator_tpu.scheduler.diagnose import (
+                                diagnose_unbound,
+                                shared_state,
+                            )
+
+                            if shared is None:
+                                shared = shared_state(fc, n_nodes)
+                            msg = diagnose_unbound(fc, j, n_nodes,
+                                                   shared=shared)
                     except Exception:  # diagnosis must never wedge a cycle
                         logger.exception(
                             "unschedulability diagnosis failed for %s",
@@ -1002,10 +1303,11 @@ class Scheduler:
             # keep the packed batch for end-of-cycle unschedulability
             # diagnosis (scheduler/diagnose.py reads the same arrays the
             # kernel consumed); a retry pass overwrites this with the
-            # final batch
+            # final batch. 4th slot: kernel-emitted explain counts, filled
+            # after the dispatch when KOORD_TPU_EXPLAIN is on.
             self._last_batch = (
                 fc, {key: j for j, key in enumerate(pods.keys)},
-                len(state.nodes))
+                len(state.nodes), None)
         return fc, pods, nodes, ng, ngroups, active
 
     def _record_upload_deltas(self) -> None:
@@ -1040,10 +1342,12 @@ class Scheduler:
         if enc is None:
             return rejected_pods, [(p, "no schedulable node") for p in pending]
         fc, pods, nodes, ng, ngroups, active = enc
+        explain = self._effective_explain()
         step = self._get_step(
             (pods.padded_size, nodes.padded_size, fc.quota_runtime.shape[0]),
-            ng, ngroups, active,
+            ng, ngroups, active, explain=explain,
         )
+        ex_out = None
         with self.tracer.span(
                 "kernel",
                 compiled="1" if self._last_step_compiled else "0") as ksp:
@@ -1073,7 +1377,13 @@ class Scheduler:
                     self.device_snapshot.begin_dispatch()
                 t_dispatch = time.perf_counter()
                 try:
-                    chosen, _, _ = step(fc)  # async dispatch — no sync yet
+                    if explain is not None:
+                        # same dispatch, extra attribution outputs; n_real
+                        # masks padded node rows out of the stage counts
+                        chosen, _, _, ex_out = step(
+                            fc, np.int32(len(nodes.names)))
+                    else:
+                        chosen, _, _ = step(fc)  # async dispatch — no sync
                     if self.pipeline_mode:
                         # overlap window: the previous cycle's deferred
                         # host work (unschedulability diagnosis +
@@ -1101,6 +1411,22 @@ class Scheduler:
                 # the readback-regression signal
                 scheduler_metrics.WAVES_PER_DISPATCH.observe(1.0)
                 scheduler_metrics.READBACK_BYTES.inc(int(chosen.nbytes))
+                if ex_out is not None:
+                    # the program completed at the chosen sync above;
+                    # these are materialized outputs, not fresh syncs
+                    # koordlint: disable=blocking-readback-in-pipeline
+                    explain_counts = np.asarray(ex_out.stage_counts)
+                    ex_bytes = explain_counts.nbytes
+                    if ex_out.terms is not None:
+                        # koordlint: disable=blocking-readback-in-pipeline
+                        terms_np = np.asarray(ex_out.terms)
+                        ex_bytes += terms_np.nbytes
+                        # chosen is already host-side (synced above)
+                        self._stash_terms(pods.keys, chosen >= 0, terms_np)
+                    scheduler_metrics.EXPLAIN_READBACK_BYTES.inc(
+                        int(ex_bytes))
+                    fc_lb, idx_lb, n_lb, _ = self._last_batch
+                    self._last_batch = (fc_lb, idx_lb, n_lb, explain_counts)
         result.kernel_seconds += ksp.duration_seconds
         scheduler_metrics.KERNEL_SECONDS.observe(ksp.duration_seconds)
 
@@ -1184,11 +1510,13 @@ class Scheduler:
             np.take(ex["la_est_nonprod"], axis_idx, axis=-1))
         la_adj = np.ascontiguousarray(
             np.take(ex["la_adj_nonprod"], axis_idx, axis=-1))
+        explain = self._effective_explain()
         step = self._get_fused_step(
             (pods.padded_size, nodes.padded_size,
              fc.quota_runtime.shape[0]),
-            ng, ngroups, active, k_waves,
+            ng, ngroups, active, k_waves, explain=explain,
         )
+        ex_out = None
         with self.tracer.span(
                 "kernel",
                 compiled="1" if self._last_step_compiled else "0",
@@ -1203,7 +1531,11 @@ class Scheduler:
                 self.device_snapshot.begin_dispatch()
             t_dispatch = time.perf_counter()
             try:
-                out = step(fc, la_est, la_adj)  # async dispatch
+                if explain is not None:
+                    out, ex_out = step(fc, la_est, la_adj,
+                                       np.int32(len(nodes.names)))
+                else:
+                    out = step(fc, la_est, la_adj)  # async dispatch
                 if self.pipeline_mode:
                     self.flush_deferred()
                     with self.tracer.span("overlap_wait"):
@@ -1232,6 +1564,21 @@ class Scheduler:
             scheduler_metrics.READBACK_BYTES.inc(
                 int(bind_pods.nbytes + bind_nodes.nbytes
                     + bind_zones.nbytes + wave_counts.nbytes + 4))
+            explain_counts = None
+            if ex_out is not None:
+                # program complete at the bind_pods sync: materialized
+                # outputs, not fresh syncs
+                # koordlint: disable=blocking-readback-in-pipeline
+                explain_counts = np.asarray(ex_out.stage_counts)
+                ex_bytes = explain_counts.nbytes
+                if ex_out.terms is not None:
+                    # koordlint: disable=blocking-readback-in-pipeline
+                    terms_np = np.asarray(ex_out.terms)
+                    ex_bytes += terms_np.nbytes
+                    kept_mask = np.zeros(len(pods.keys), bool)
+                    kept_mask[bind_pods[bind_pods >= 0]] = True
+                    self._stash_terms(pods.keys, kept_mask, terms_np)
+                scheduler_metrics.EXPLAIN_READBACK_BYTES.inc(int(ex_bytes))
             for w in range(waves_run):
                 # retrospective per-wave markers under the kernel span:
                 # how the dispatch's work split across the fused rounds
@@ -1302,11 +1649,22 @@ class Scheduler:
                     len(result.bound) - bound_before)
             # diagnosis for THIS logical cycle reads wave-w-START state
             # (serial cycle w packed its batch before its kernel ran);
-            # the mirror still holds it — advance happens below
+            # the mirror still holds it — advance happens below. With
+            # kernel counts the mirror is bypassed entirely: the dispatch
+            # already attributed every wave at wave-start state.
             if any(r in DIAGNOSED_REASONS for _p, r in failed_pods) or (
                     rejected_pods):
-                self._last_batch = (
-                    mirror_state().patched_fc(), index, len(nodes.names))
+                if explain_counts is not None:
+                    # waves >= waves_run reuse the last EXECUTED wave's
+                    # row: a zero-commit early exit proves the state (and
+                    # hence the counts) is a fixpoint
+                    counts_w = explain_counts[min(w, waves_run - 1)]
+                    self._last_batch = (
+                        None, index, len(nodes.names), counts_w)
+                else:
+                    self._last_batch = (
+                        mirror_state().patched_fc(), index,
+                        len(nodes.names), None)
             truncate = veto
             any_victims = self._post_filter_preempt(
                 rejected_pods, failed_pods, result)
@@ -1345,14 +1703,17 @@ class Scheduler:
                 break
             # advance the mirror with the device's view of this wave's
             # commits, so the next logical cycle diagnoses against the
-            # state serial cycle w+1 would have packed
-            for b in seg:
-                commit = (int(bind_pods[b]), int(bind_nodes[b]),
-                          int(bind_zones[b]))
-                if mirror is not None:
-                    mirror.commit(*commit)
-                else:
-                    mirror_backlog.append(commit)
+            # state serial cycle w+1 would have packed (kernel counts
+            # make the whole mirror unnecessary — each wave carries its
+            # own attribution)
+            if explain_counts is None:
+                for b in seg:
+                    commit = (int(bind_pods[b]), int(bind_nodes[b]),
+                              int(bind_zones[b]))
+                    if mirror is not None:
+                        mirror.commit(*commit)
+                    else:
+                        mirror_backlog.append(commit)
         self._last_batch = None
 
     # ------------------------------------------------------------------

@@ -17,6 +17,13 @@ state (before in-batch placements), so a pod starved by earlier pods in
 the same batch reports the stage that failed at batch start — the same
 approximation upstream makes when it diagnoses against the informer
 snapshot rather than the in-flight assume cache.
+
+koordexplain split (PR 5): the module is now counts + formatter. The
+kernel emits the same per-stage counts on device in the scheduling
+dispatch (models/full_chain.explain_stage_counts, KOORD_TPU_EXPLAIN);
+``format_stage_counts`` renders EITHER source into the identical message,
+and ``host_stage_counts`` (this module's numpy recompute) stays as the
+parity oracle tier-1 diffs the kernel counts against.
 """
 
 from __future__ import annotations
@@ -25,9 +32,51 @@ from typing import Dict, List
 
 import numpy as np
 
+from koordinator_tpu.models.full_chain import (
+    EXPLAIN_STAGE_GANG,
+    EXPLAIN_STAGE_QUOTA,
+    EXPLAIN_STAGES,
+    NUM_EXPLAIN_STAGES,
+)
+
+GANG_MESSAGE = ("gang minMember not satisfied: sibling pods missing or the "
+                "gang timed out (Coscheduling PreFilter)")
+QUOTA_MESSAGE = ("quota group exhausted: request exceeds runtime "
+                 "quota along the ancestor chain (ElasticQuota "
+                 "PreFilter)")
+
 
 def _count(mask) -> int:
     return int(np.asarray(mask).sum())
+
+
+def format_stage_counts(counts, num_nodes: int) -> str:
+    """The upstream-style message for one pod's stage-count vector
+    (NUM_EXPLAIN_STAGES long, kernel- or host-computed — the SAME formatter
+    renders both, so parity between them reduces to count equality).
+    Reproduces the legacy diagnose_unbound byte-for-byte: PreFilter
+    verdicts short-circuit (gang before quota, the legacy early returns),
+    then non-zero per-node stages sort by descending count with the
+    taxonomy order breaking ties (Python's stable sort + EXPLAIN_STAGES
+    insertion order)."""
+    counts = np.asarray(counts)
+    if int(counts[EXPLAIN_STAGE_GANG]):
+        return GANG_MESSAGE
+    if int(counts[EXPLAIN_STAGE_QUOTA]):
+        return QUOTA_MESSAGE
+    parts: List[str] = [
+        f"{int(c)} {label}"
+        for label, c in zip(EXPLAIN_STAGES, counts)
+        if int(c)
+    ]
+    parts.sort(key=lambda s: -int(s.split(" ", 1)[0]))
+    if not parts:
+        # every stage we model passes on some node at cycle-start state:
+        # the pod lost to in-batch contention (capacity taken by earlier
+        # queue positions this cycle)
+        return (f"0/{num_nodes} nodes available after in-batch placements: "
+                "capacity consumed by earlier pods this cycle")
+    return f"0/{num_nodes} nodes are available: " + ", ".join(parts) + "."
 
 
 def shared_state(fc, num_nodes: int) -> dict:
@@ -53,11 +102,14 @@ def shared_state(fc, num_nodes: int) -> dict:
     }
 
 
-def diagnose_unbound(fc, i: int, num_nodes: int,
-                     shared: dict = None) -> str:
-    """Upstream-style message for pod row ``i`` of FullChainInputs ``fc``:
-    per-stage counts over the first ``num_nodes`` real (unpadded) nodes.
-    Pass ``shared`` (shared_state) when diagnosing many pods of one batch."""
+def host_stage_counts(fc, i: int, num_nodes: int,
+                      shared: dict = None) -> np.ndarray:
+    """[NUM_EXPLAIN_STAGES] uint32 for pod row ``i`` of FullChainInputs
+    ``fc``: per-stage rejected-node counts over the first ``num_nodes``
+    real (unpadded) nodes plus the gang/quota PreFilter verdict flags —
+    the host-numpy oracle the kernel's on-device attribution is diffed
+    against. Pass ``shared`` (shared_state) when diagnosing many pods of
+    one batch."""
     inputs = fc.base
     n = num_nodes
     if shared is None:
@@ -67,12 +119,12 @@ def diagnose_unbound(fc, i: int, num_nodes: int,
     node_ok = shared["node_ok"]
     fit_req = np.asarray(inputs.fit_requests, np.float32)[i]
     raw_req = np.asarray(fc.requests, np.float32)[i]
+    counts = np.zeros(NUM_EXPLAIN_STAGES, np.uint32)
 
-    # ---- PreFilter stage (pod-level; no node breakdown)
+    # ---- PreFilter stage (pod-level verdict flags; no node breakdown)
     gang_id = int(np.asarray(fc.gang_id)[i])
     if gang_id >= 0 and not bool(np.asarray(fc.gang_valid)[gang_id]):
-        return ("gang minMember not satisfied: sibling pods missing or the "
-                "gang timed out (Coscheduling PreFilter)")
+        counts[EXPLAIN_STAGE_GANG] = 1
     qid = int(np.asarray(fc.quota_id)[i])
     if qid >= 0:
         used = np.asarray(fc.quota_used, np.float32)
@@ -83,9 +135,8 @@ def diagnose_unbound(fc, i: int, num_nodes: int,
                 continue
             bad = (raw_req > 0) & (used[g] + raw_req > runtime[g])
             if bad.any():
-                return ("quota group exhausted: request exceeds runtime "
-                        "quota along the ancestor chain (ElasticQuota "
-                        "PreFilter)")
+                counts[EXPLAIN_STAGE_QUOTA] = 1
+                break
 
     # ---- Filter stages, counted per node
     reasons: Dict[str, np.ndarray] = {}
@@ -175,16 +226,18 @@ def diagnose_unbound(fc, i: int, num_nodes: int,
                              & (count[:, t] + self_m - min_count <= skew))
         reasons["affinity/anti-affinity/spread mismatch"] = aff_bad
 
-    parts: List[str] = []
-    for label, bad in reasons.items():
-        c = _count(bad)
-        if c:
-            parts.append(f"{c} {label}")
-    parts.sort(key=lambda s: -int(s.split(" ", 1)[0]))
-    if not parts:
-        # every stage we model passes on some node at cycle-start state:
-        # the pod lost to in-batch contention (capacity taken by earlier
-        # queue positions this cycle)
-        return (f"0/{n} nodes available after in-batch placements: "
-                "capacity consumed by earlier pods this cycle")
-    return f"0/{n} nodes are available: " + ", ".join(parts) + "."
+    for s, label in enumerate(EXPLAIN_STAGES):
+        bad = reasons.get(label)
+        if bad is not None:
+            counts[s] = _count(bad)
+    return counts
+
+
+def diagnose_unbound(fc, i: int, num_nodes: int,
+                     shared: dict = None) -> str:
+    """Upstream-style message for pod row ``i`` of FullChainInputs ``fc``:
+    the legacy host-numpy recompute path — host counts through the shared
+    formatter. The explain-enabled cycle driver formats KERNEL counts with
+    the same formatter instead; tier-1 pins the two string-for-string."""
+    return format_stage_counts(
+        host_stage_counts(fc, i, num_nodes, shared=shared), num_nodes)

@@ -113,3 +113,40 @@ UPLOAD_BYTES_PUT = REGISTRY.counter(
     "koord_scheduler_upload_bytes_put_total",
     "Bytes shipped by full device-snapshot puts",
 )
+
+# koordexplain (PR 5): per-stage filter rejections, attributed on device by
+# the scheduling dispatch itself (models/full_chain.explain_stage_counts).
+# Labeled by stage key (EXPLAIN_STAGE_KEYS); counted once per pod ending a
+# logical cycle unbound, over the nodes each stage rejected — the
+# aggregate view of what /explain answers per pod. Only populated when
+# KOORD_TPU_EXPLAIN is on (the legacy host recompute does not feed it).
+FILTER_REJECTIONS = REGISTRY.counter(
+    "koord_scheduler_filter_rejections_total",
+    "Node rejections per filter stage for pods left unbound, "
+    "labeled by stage",
+)
+# explain attribution rides the kernel readback; its extra bytes must be
+# visible so the counts-level overhead stays an explicit trade
+EXPLAIN_READBACK_BYTES = REGISTRY.counter(
+    "koord_scheduler_explain_readback_bytes_total",
+    "Bytes of koordexplain attribution read back from the device",
+)
+# cycle flight recorder (obs/flight.py): every bundle dump, labeled by the
+# trigger (deadline_overrun | cycle_exception | parity_mismatch | http)
+FLIGHT_DUMPS = REGISTRY.counter(
+    "koord_flight_recorder_dumps_total",
+    "Flight-recorder bundle dumps, labeled by trigger reason",
+)
+
+# pipeline deferred-diagnose backlog: depth of the queue carrying cycle
+# N's unschedulability writes into cycle N+1's kernel window, plus the
+# total items ever deferred — a growing depth means kernel windows (or
+# flush()) are not draining the backlog
+DIAGNOSE_DEFERRED_TOTAL = REGISTRY.counter(
+    "koord_scheduler_diagnose_deferred_total",
+    "Unschedulability diagnose/condition writes deferred by the pipeline",
+)
+DIAGNOSE_DEFERRED_DEPTH = REGISTRY.gauge(
+    "koord_scheduler_diagnose_deferred_depth",
+    "Deferred diagnose entries currently queued",
+)

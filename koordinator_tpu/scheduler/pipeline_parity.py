@@ -102,15 +102,27 @@ def _conditions(store) -> Dict[str, tuple]:
     return out
 
 
+def _dump_on_mismatch(mismatches, *scheds) -> None:
+    """Flight-recorder trigger: a parity mismatch dumps each world's ring
+    (files land in KOORD_TPU_FLIGHT_DIR when set; the dump counter always
+    increments so the trigger is observable either way)."""
+    if not mismatches:
+        return
+    for sched in scheds:
+        sched.flight.dump("parity_mismatch")
+
+
 def run_pipeline_parity(num_nodes: int = 24, num_pods: int = 70,
                         rounds: int = 4, seed: int = 11,
-                        arrivals: int = 9) -> dict:
+                        arrivals: int = 9, explain: str = "off") -> dict:
     """Drive identical twin stores through the serial and pipelined paths.
 
     Returns a report dict; report["ok"] is the gate. Diffs per round:
     bound (pod, node) sequences in order, failed/rejected/victim sets —
     and at end of stream (after flush): every pod's PodScheduled
-    condition tuple and node assignment."""
+    condition tuple and node assignment. ``explain`` runs BOTH worlds at
+    that koordexplain level (the PR 5 acceptance gate: the pipeline stays
+    byte-identical with attribution enabled)."""
     from koordinator_tpu.client.store import KIND_POD
     from koordinator_tpu.scheduler.cycle import CyclePipeline, Scheduler
     from koordinator_tpu.testing import synth_full_cluster
@@ -125,8 +137,8 @@ def run_pipeline_parity(num_nodes: int = 24, num_pods: int = 70,
     _state_p, store_pipe = make_world()
     # waves pinned to 1: this gate isolates pipelining; the fused-wave
     # gate (run_fused_wave_parity) owns the K > 1 dimension
-    sched_serial = Scheduler(store_serial, waves=1)
-    sched_pipe = Scheduler(store_pipe, waves=1)
+    sched_serial = Scheduler(store_serial, waves=1, explain=explain)
+    sched_pipe = Scheduler(store_pipe, waves=1, explain=explain)
     pipeline = CyclePipeline(sched_pipe, enabled=True)
     assert sched_serial.pipeline_mode is False
 
@@ -162,6 +174,7 @@ def run_pipeline_parity(num_nodes: int = 24, num_pods: int = 70,
                 for p in store_pipe.list(KIND_POD)}
     if assign_s != assign_p:
         mismatches.append("final pod->node assignments differ")
+    _dump_on_mismatch(mismatches, sched_serial, sched_pipe)
 
     return {
         "ok": not mismatches,
@@ -169,12 +182,84 @@ def run_pipeline_parity(num_nodes: int = 24, num_pods: int = 70,
         "rounds": rounds + 1,
         "pods": len(assign_s),
         "conditions_checked": len(cond_s),
+        "explain": explain,
+    }
+
+
+def run_explain_parity(num_nodes: int = 24, num_pods: int = 70,
+                       rounds: int = 4, seed: int = 11,
+                       arrivals: int = 9, waves: int = 1) -> dict:
+    """Formatter-over-kernel-counts vs the legacy host-numpy diagnosis:
+    byte-identical stores on a churn workload.
+
+    Twin worlds run the SAME cycle cadence, one with KOORD_TPU_EXPLAIN
+    semantics pinned to "counts" (PodScheduled messages formatted from the
+    kernel-emitted per-stage counts) and one pinned off (the legacy
+    diagnose_unbound recompute). Every observable — bound sequences,
+    failure sets, every PodScheduled condition tuple string-for-string,
+    final assignments — must match, proving BOTH that attribution does not
+    perturb decisions and that the kernel counts format to the exact
+    legacy messages."""
+    from koordinator_tpu.client.store import KIND_POD
+    from koordinator_tpu.scheduler.cycle import Scheduler
+    from koordinator_tpu.testing import synth_full_cluster
+
+    def make_world():
+        _cluster, state = synth_full_cluster(
+            num_nodes, num_pods, seed=seed, num_quotas=3, num_gangs=4,
+            topology_fraction=0.5, lsr_fraction=0.2)
+        return state, build_store_from_state(state)
+
+    state_l, store_legacy = make_world()
+    _state_e, store_explain = make_world()
+    sched_legacy = Scheduler(store_legacy, waves=waves, explain="off")
+    sched_explain = Scheduler(store_explain, waves=waves, explain="counts")
+
+    now = state_l.now
+    mismatches: List[str] = []
+    for r in range(rounds + 1):
+        if r > 0:
+            apply_round_delta(store_legacy, r, now, arrivals)
+            apply_round_delta(store_explain, r, now, arrivals)
+        t = now + 2 * r
+        res_l = sched_legacy.run_cycle(now=t)
+        res_e = sched_explain.run_cycle(now=t)
+        if ([(b.pod_key, b.node_name) for b in res_l.bound]
+                != [(b.pod_key, b.node_name) for b in res_e.bound]):
+            mismatches.append(f"round {r}: bound sequence differs")
+        for field in ("failed", "rejected", "preempted_victims"):
+            if sorted(getattr(res_l, field)) != sorted(getattr(res_e, field)):
+                mismatches.append(f"round {r}: {field} differs")
+
+    cond_l, cond_e = _conditions(store_legacy), _conditions(store_explain)
+    if cond_l != cond_e:
+        keys = {k for k in set(cond_l) | set(cond_e)
+                if cond_l.get(k) != cond_e.get(k)}
+        mismatches.append(
+            f"PodScheduled conditions differ for {len(keys)} pods "
+            f"(e.g. {sorted(keys)[:3]})")
+    assign_l = {p.meta.key: p.spec.node_name
+                for p in store_legacy.list(KIND_POD)}
+    assign_e = {p.meta.key: p.spec.node_name
+                for p in store_explain.list(KIND_POD)}
+    if assign_l != assign_e:
+        mismatches.append("final pod->node assignments differ")
+    _dump_on_mismatch(mismatches, sched_legacy, sched_explain)
+
+    return {
+        "ok": not mismatches,
+        "mismatches": mismatches,
+        "rounds": rounds + 1,
+        "waves": waves,
+        "pods": len(assign_l),
+        "conditions_checked": len(cond_l),
     }
 
 
 def run_fused_wave_parity(k_waves: int, num_nodes: int = 24,
                           num_pods: int = 70, rounds: int = 2,
-                          seed: int = 11, arrivals: int = 9) -> dict:
+                          seed: int = 11, arrivals: int = 9,
+                          explain: str = "off") -> dict:
     """Fused-K vs K sequential single-round cycles: byte-identical state.
 
     The fused wave kernel (models/fused_waves.py) runs K dependent
@@ -199,8 +284,8 @@ def run_fused_wave_parity(k_waves: int, num_nodes: int = 24,
 
     state_s, store_serial = make_world()
     _state_f, store_fused = make_world()
-    sched_serial = Scheduler(store_serial, waves=1)
-    sched_fused = Scheduler(store_fused, waves=k_waves)
+    sched_serial = Scheduler(store_serial, waves=1, explain=explain)
+    sched_fused = Scheduler(store_fused, waves=k_waves, explain=explain)
     pipeline = CyclePipeline(sched_fused, enabled=True)
     assert sched_serial.pipeline_mode is False
 
@@ -282,6 +367,7 @@ def run_fused_wave_parity(k_waves: int, num_nodes: int = 24,
         mismatches.append(
             f"final pod->node assignments differ for {len(diff)} pods "
             f"(e.g. {diff[:3]})")
+    _dump_on_mismatch(mismatches, sched_serial, sched_fused)
 
     return {
         "ok": not mismatches,
@@ -290,29 +376,34 @@ def run_fused_wave_parity(k_waves: int, num_nodes: int = 24,
         "rounds": rounds + 1,
         "pods": len(assign_s),
         "conditions_checked": len(cond_s),
+        "explain": explain,
     }
 
 
 def main(argv: List[str]) -> int:
-    report = run_pipeline_parity()
-    line = (f"pipeline parity: rounds={report['rounds']} "
-            f"pods={report['pods']} "
-            f"conditions={report['conditions_checked']} -> "
-            f"{'OK' if report['ok'] else 'MISMATCH'}")
-    print(line, file=sys.stderr)
-    for m in report["mismatches"]:
-        print(f"  {m}", file=sys.stderr)
-    ok = report["ok"]
-    for k in (1, 2, 4, 8):
-        rep = run_fused_wave_parity(k)
-        line = (f"fused-wave parity K={k}: rounds={rep['rounds']} "
-                f"pods={rep['pods']} "
+    def show(name: str, rep: dict) -> bool:
+        line = (f"{name}: rounds={rep['rounds']} pods={rep['pods']} "
                 f"conditions={rep['conditions_checked']} -> "
                 f"{'OK' if rep['ok'] else 'MISMATCH'}")
         print(line, file=sys.stderr)
         for m in rep["mismatches"]:
             print(f"  {m}", file=sys.stderr)
-        ok = ok and rep["ok"]
+        return rep["ok"]
+
+    ok = show("pipeline parity", run_pipeline_parity())
+    for k in (1, 2, 4, 8):
+        ok = show(f"fused-wave parity K={k}", run_fused_wave_parity(k)) and ok
+    # koordexplain gates (PR 5): kernel-counts formatter vs the legacy
+    # host diagnosis must be string-for-string on churn, and the PR 3/4
+    # parity properties must survive with attribution enabled
+    ok = show("explain parity (counts vs legacy, serial)",
+              run_explain_parity()) and ok
+    ok = show("explain parity (counts vs legacy, fused K=4)",
+              run_explain_parity(waves=4, rounds=2)) and ok
+    ok = show("pipeline parity (explain=counts)",
+              run_pipeline_parity(explain="counts")) and ok
+    ok = show("fused-wave parity K=4 (explain=counts)",
+              run_fused_wave_parity(4, explain="counts")) and ok
     return 0 if ok else 1
 
 

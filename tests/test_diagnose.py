@@ -1,0 +1,243 @@
+"""Direct coverage for scheduler/diagnose.py: golden messages per reason
+branch, the counts/formatter split, and the kernel attribution pass
+(models/full_chain.explain_stage_counts) against the host oracle on each
+crafted branch — previously this module was only exercised indirectly
+through cycle tests."""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.models.full_chain import (
+    EXPLAIN_STAGE_GANG,
+    EXPLAIN_STAGE_QUOTA,
+    EXPLAIN_STAGES,
+    NUM_EXPLAIN_STAGES,
+    FullChainInputs,
+    explain_stage_counts,
+    make_pod_evaluator,
+    resolve_weight_idx,
+)
+from koordinator_tpu.models.scheduler_model import ScheduleInputs
+from koordinator_tpu.ops.loadaware import LoadAwareArgs
+from koordinator_tpu.scheduler.diagnose import (
+    GANG_MESSAGE,
+    QUOTA_MESSAGE,
+    diagnose_unbound,
+    format_stage_counts,
+    host_stage_counts,
+)
+
+N, P, R, T, K, PT = 3, 1, 2, 1, 2, 1
+
+
+def make_fc(**over) -> FullChainInputs:
+    """A minimal 1-pod x 3-node batch where EVERY stage passes; each test
+    flips exactly the arrays that trigger its reason branch."""
+    f32, i32 = np.float32, np.int32
+
+    base = ScheduleInputs(
+        fit_requests=np.ones((P, R), f32),
+        estimated=np.ones((P, R), f32),
+        is_prod=np.zeros(P, bool),
+        is_daemonset=np.zeros(P, bool),
+        pod_valid=np.ones(P, bool),
+        allocatable=np.full((N, R), 10.0, f32),
+        requested=np.zeros((N, R), f32),
+        node_ok=np.ones(N, bool),
+        la_filter_usage=np.zeros((N, R), f32),
+        la_has_filter_usage=np.zeros(N, bool),
+        la_filter_thresholds=np.zeros((N, R), f32),
+        la_prod_thresholds=np.zeros((N, R), f32),
+        la_prod_pod_usage=np.zeros((N, R), f32),
+        la_term_nonprod=np.zeros((N, R), f32),
+        la_term_prod=np.zeros((N, R), f32),
+        la_score_valid=np.ones(N, bool),
+        la_filter_skip=np.zeros(N, bool),
+        weights=np.asarray(LoadAwareArgs().weight_vector()[:R], f32),
+    )
+    base = base._replace(**{k: np.asarray(v)
+                            for k, v in over.items()
+                            if k in base._fields})
+    fc_over = {k: np.asarray(v) for k, v in over.items()
+               if k not in base._fields}
+    fc = FullChainInputs(
+        base=base,
+        requests=np.ones((P, R), f32),
+        gang_id=np.full(P, -1, i32),
+        quota_id=np.full(P, -1, i32),
+        needs_numa=np.zeros(P, bool),
+        needs_bind=np.zeros(P, bool),
+        cores_needed=np.zeros(P, f32),
+        full_pcpus=np.zeros(P, bool),
+        pod_taint_mask=np.ones(P, f32),       # bit 0 set
+        pod_aff_req=np.zeros((P, T), bool),
+        pod_anti_req=np.zeros((P, T), bool),
+        pod_aff_match=np.zeros((P, T), bool),
+        pod_spread_skew=np.zeros((P, T), f32),
+        pod_pref_id=np.full(P, -1, i32),
+        pod_ppref_id=np.full(P, -1, i32),
+        pod_ppref_mask=np.zeros((P, T), bool),
+        pod_port_wants=np.zeros((P, PT), bool),
+        vol_needed=np.zeros((P, 1), f32),
+        pod_img_id=np.full(P, -1, i32),
+        node_taint_group=np.zeros(N, i32),    # group 0 -> bit 0
+        aff_dom=np.zeros((N, T), f32),        # all nodes in domain 0
+        aff_count=np.zeros((N, T), f32),
+        anti_cover=np.zeros((N, T), f32),
+        aff_exists=np.zeros(T, bool),
+        pref_scores=np.zeros((N, 0), f32),
+        port_used=np.zeros((N, PT), f32),
+        vol_free=np.full(N, np.inf, f32),
+        node_vol_group=np.zeros(N, i32),
+        img_scores=np.zeros((N, 1), f32),
+        ppref_w=np.zeros((1, T), f32),
+        numa_free=np.full((N, K, R), 10.0, f32),
+        numa_capacity=np.full((N, K, R), 10.0, f32),
+        numa_policy=np.zeros(N, i32),
+        has_topology=np.ones(N, bool),
+        bind_free=np.full(N, 8.0, f32),
+        cpus_per_core=np.ones(N, f32),
+        quota_ancestors=np.asarray([[0, -1]], i32),
+        quota_used=np.zeros((1, R), f32),
+        quota_runtime=np.full((1, R), 100.0, f32),
+        gang_min_member=np.ones(1, f32),
+        gang_assumed=np.zeros(1, f32),
+        gang_valid=np.ones(1, bool),
+        gang_group_id=np.zeros(1, i32),
+    )
+    return fc._replace(**fc_over)
+
+
+def kernel_counts(fc: FullChainInputs) -> np.ndarray:
+    """The on-device attribution pass at cycle-start state, unjitted."""
+    import jax
+    import jax.numpy as jnp
+
+    # vmap indexes pod rows with tracers: inputs must be device arrays
+    # (inside the jitted production step they already are)
+    fc = jax.tree_util.tree_map(jnp.asarray, fc)
+    evaluate = make_pod_evaluator(
+        fc, resolve_weight_idx(LoadAwareArgs(), list(range(R))), False)
+    state = (fc.base.requested, fc.numa_free, fc.bind_free, fc.quota_used,
+             fc.aff_count, fc.anti_cover, jnp.asarray(fc.aff_exists, bool),
+             fc.port_used, fc.vol_free)
+    return np.asarray(explain_stage_counts(fc, evaluate, state,
+                                           np.int32(N)))
+
+
+# every reason branch: (name, fc overrides, expected exact message)
+BRANCHES = [
+    ("gang", dict(gang_id=[0], gang_valid=[False]), GANG_MESSAGE),
+    ("quota", dict(quota_id=[0], quota_runtime=[[1.0, 1.0]],
+                   requests=[[2.0, 2.0]]), QUOTA_MESSAGE),
+    ("unschedulable_node", dict(node_ok=[False] * 3),
+     "0/3 nodes are available: 3 node not schedulable."),
+    ("taint_selector", dict(pod_taint_mask=[0.0]),
+     "0/3 nodes are available: "
+     "3 taint/selector/volume-topology mismatch."),
+    ("insufficient_resources", dict(fit_requests=[[100.0, 1.0]]),
+     "0/3 nodes are available: 3 insufficient resources."),
+    ("load_threshold", dict(la_has_filter_usage=[True] * 3,
+                            la_filter_usage=[[9.0, 9.0]] * 3,
+                            la_filter_thresholds=[[50.0, 50.0]] * 3),
+     "0/3 nodes are available: 3 node load over threshold."),
+    ("host_port", dict(pod_port_wants=[[True]],
+                       port_used=[[1.0]] * 3),
+     "0/3 nodes are available: 3 hostPort in use."),
+    ("csi_limit", dict(vol_needed=[[2.0]], vol_free=[1.0] * 3),
+     "0/3 nodes are available: 3 CSI volume limit exceeded."),
+    ("bindable_cpus", dict(needs_bind=[True], cores_needed=[4.0],
+                           bind_free=[2.0] * 3),
+     "0/3 nodes are available: 3 insufficient bindable CPUs."),
+    ("numa_topology", dict(needs_numa=[True], numa_policy=[1] * 3,
+                           requests=[[5.0, 5.0]],
+                           numa_free=[[[2.0, 2.0]] * K] * 3),
+     "0/3 nodes are available: 3 NUMA topology cannot fit."),
+    ("affinity", dict(pod_aff_req=[[True]], aff_exists=[True]),
+     "0/3 nodes are available: "
+     "3 affinity/anti-affinity/spread mismatch."),
+]
+
+
+@pytest.mark.parametrize("name,over,expected",
+                         BRANCHES, ids=[b[0] for b in BRANCHES])
+def test_golden_message_per_branch(name, over, expected):
+    fc = make_fc(**over)
+    assert diagnose_unbound(fc, 0, N) == expected
+
+
+@pytest.mark.parametrize("name,over,expected",
+                         BRANCHES, ids=[b[0] for b in BRANCHES])
+def test_kernel_counts_match_host_per_branch(name, over, expected):
+    """The on-device attribution must agree with the host oracle on every
+    crafted branch — and format to the same golden message."""
+    fc = make_fc(**over)
+    host = host_stage_counts(fc, 0, N)
+    kern = kernel_counts(fc)[0]
+    assert np.array_equal(host, kern), (host, kern)
+    assert format_stage_counts(kern, N) == expected
+
+
+def test_in_batch_contention_fallback():
+    """All stages pass at cycle-start state -> the contention message."""
+    fc = make_fc()
+    assert diagnose_unbound(fc, 0, N) == (
+        "0/3 nodes available after in-batch placements: "
+        "capacity consumed by earlier pods this cycle")
+    assert not host_stage_counts(fc, 0, N).any()
+
+
+def test_gang_short_circuits_quota_and_filters():
+    """The legacy early-return order: gang wins over quota and over any
+    filter-stage counts riding the same vector."""
+    fc = make_fc(gang_id=[0], gang_valid=[False], quota_id=[0],
+                 quota_runtime=[[1.0, 1.0]], requests=[[2.0, 2.0]],
+                 node_ok=[False] * 3)
+    counts = host_stage_counts(fc, 0, N)
+    assert counts[EXPLAIN_STAGE_GANG] == 1
+    assert counts[EXPLAIN_STAGE_QUOTA] == 1
+    assert counts[0] == 3  # node not schedulable still counted
+    assert diagnose_unbound(fc, 0, N) == GANG_MESSAGE
+    fc2 = make_fc(quota_id=[0], quota_runtime=[[1.0, 1.0]],
+                  requests=[[2.0, 2.0]], node_ok=[False] * 3)
+    assert diagnose_unbound(fc2, 0, N) == QUOTA_MESSAGE
+
+
+def test_multi_reason_sorted_by_count_then_taxonomy():
+    """Counts sort descending; equal counts keep taxonomy order (the
+    legacy dict-insertion tie-break via stable sort)."""
+    # 3 taint mismatches everywhere, 1 node cordoned -> taint first
+    fc = make_fc(pod_taint_mask=[0.0], node_ok=[False, True, True])
+    assert diagnose_unbound(fc, 0, N) == (
+        "0/3 nodes are available: "
+        "3 taint/selector/volume-topology mismatch, "
+        "1 node not schedulable.")
+    # tie at 3: taxonomy order (node not schedulable before taint)
+    fc = make_fc(pod_taint_mask=[0.0], node_ok=[False] * 3)
+    assert diagnose_unbound(fc, 0, N) == (
+        "0/3 nodes are available: 3 node not schedulable, "
+        "3 taint/selector/volume-topology mismatch.")
+
+
+def test_format_stage_counts_vector_contract():
+    counts = np.zeros(NUM_EXPLAIN_STAGES, np.uint32)
+    counts[2] = 5  # insufficient resources
+    assert format_stage_counts(counts, 7) == (
+        "0/7 nodes are available: 5 insufficient resources.")
+    assert len(EXPLAIN_STAGES) + 2 == NUM_EXPLAIN_STAGES
+
+
+def test_stage_taxonomy_matches_legacy_labels():
+    """The kernel/host shared taxonomy IS the legacy message vocabulary;
+    renaming a stage is a message-format change and must be deliberate."""
+    assert EXPLAIN_STAGES == (
+        "node not schedulable",
+        "taint/selector/volume-topology mismatch",
+        "insufficient resources",
+        "node load over threshold",
+        "hostPort in use",
+        "CSI volume limit exceeded",
+        "insufficient bindable CPUs",
+        "NUMA topology cannot fit",
+        "affinity/anti-affinity/spread mismatch",
+    )

@@ -776,3 +776,22 @@ class TestReadbackInWaveBody:
                                  path=f"koordinator_tpu/models/{mod}",
                                  rules={self.RULE: all_rules()[self.RULE]})
             assert [f for f in out if f.rule == self.RULE] == [], mod
+
+
+class TestConcurrencyGatedPaths:
+    """The concurrency rules must keep covering the modules that share
+    state across threads — a path-regex refactor that silently drops one
+    is a real gate regression (PR 5 satellite: obs/flight.py is read by
+    the ObsServer thread while the cycle thread records)."""
+
+    def test_flight_recorder_stays_concurrency_gated(self):
+        from koordinator_tpu.analysis.rules.concurrency import (
+            is_concurrent_path,
+        )
+
+        for path in (
+            "koordinator_tpu/obs/flight.py",
+            "koordinator_tpu/obs/__init__.py",
+            "koordinator_tpu/scheduler/cycle.py",
+        ):
+            assert is_concurrent_path(path), path
