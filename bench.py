@@ -119,6 +119,27 @@ def main() -> None:
         "JSON gains pods_per_sec_at_k + fixed_overhead_ms_amortized",
     )
     ap.add_argument(
+        "--mesh", action="store_true",
+        help="mesh-backed dispatch sweep: the steady-state loop through "
+        "the production Scheduler with KOORD_TPU_MESH pinned to each "
+        "device count in --mesh-devices, each emitted as a back-to-back "
+        "A/B stash pair against the single-device path (BENCH_NOTES "
+        "convention: only pair ratios are real on this box). On the CPU "
+        "backend the process is forced onto 8 virtual host devices",
+    )
+    ap.add_argument(
+        "--mesh-devices", default=None,
+        help="comma list of mesh device counts for --mesh "
+        "(default 1,2,4,8; capped at the visible device count)",
+    )
+    ap.add_argument(
+        "--mesh-scale", type=int, default=None, choices=(0, 1),
+        help="include the 100k pods x 50k nodes cluster config in the "
+        "--mesh sweep (the 'millions of users' shape: ~100k pods via the "
+        "incremental pack memo, 2048-pod pending queue, 8-device mesh). "
+        "SLOW — several minutes on CPU. Default: on unless --smoke",
+    )
+    ap.add_argument(
         "--device-probe-timeout", type=int, default=150,
         help="seconds per device-init probe attempt (subprocess); after "
         "--device-probe-attempts failures the bench falls back to CPU "
@@ -131,8 +152,26 @@ def main() -> None:
     )
     args_cli = ap.parse_args()
 
+    if args_cli.mesh:
+        # the CPU backend exposes ONE device unless the 8-way virtual
+        # split is forced before the first jax import (same shape
+        # tests/conftest.py pins); real accelerators keep their topology
+        import os
+
+        if (os.environ.get("JAX_PLATFORMS", "") == "cpu"
+                and "jax" not in sys.modules):
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
+
     _guard_against_dead_accelerator(args_cli.device_probe_timeout,
                                     args_cli.device_probe_attempts)
+
+    if args_cli.mesh:
+        run_mesh_sweep(args_cli)
+        return
 
     num_pods = args_cli.pods or (100 if args_cli.smoke else 10_000)
     num_nodes = args_cli.nodes or (50 if args_cli.smoke else 5_000)
@@ -793,6 +832,185 @@ def run_steady_state(args_cli, num_pods: int, num_nodes: int) -> dict:
         "waves_consumed_at_k": waves_seen,
     })
     return out
+
+
+def run_mesh_sweep(args_cli) -> None:
+    """Mesh-backed dispatch sweep (KOORD_TPU_MESH, scheduler/cycle.py +
+    parallel/mesh.py): the warm steady-state loop through the PRODUCTION
+    Scheduler — sharded DeviceSnapshot upload, sharded kernel, per-shard
+    readback merge — at each mesh size, emitted as back-to-back A/B stash
+    pairs against the single-device path in the SAME process (BENCH_NOTES
+    convention: this box's noise makes numbers from different runs
+    incomparable; only the pair ratio is real). Bindings are diffed
+    against the single-device twin every round (mesh parity inside the
+    bench, not just the lint gate).
+
+    Unless --smoke (or --mesh-scale 0), a final SLOW row runs the
+    100k pods x 50k nodes cluster — ~100k total pods flowing through the
+    incremental pack memo with a 2048-pod pending queue — end to end at
+    the maximum mesh size; this is the "millions of users" config no
+    single chip can hold whose host side only stays feasible because of
+    the PR 3 pack memo.
+
+    JSON: pods_per_sec_at_devices{d}, pods_per_sec_single_pair{d},
+    mesh_parity_ok, and mesh_scale{...} for the large config."""
+    import jax
+
+    from koordinator_tpu.scheduler.cycle import CyclePipeline, Scheduler
+    from koordinator_tpu.scheduler.pipeline_parity import (
+        apply_round_delta,
+        build_store_from_state,
+    )
+    from koordinator_tpu.testing import synth_full_cluster
+
+    num_pods = args_cli.pods or (96 if args_cli.smoke else 2048)
+    num_nodes = args_cli.nodes or (48 if args_cli.smoke else 1024)
+    visible = len(jax.devices())
+    raw_devs = args_cli.mesh_devices or "1,2,4,8"
+    devices = [int(x) for x in raw_devs.split(",") if x.strip()]
+    skipped = [d for d in devices if d > visible]
+    if skipped:
+        log(f"mesh sweep: skipping device counts {skipped} "
+            f"(only {visible} visible)")
+    devices = [d for d in devices if 1 <= d <= visible]
+    warmup = 1 if args_cli.smoke else 2
+    rounds = 2 if args_cli.smoke else 3
+    log(f"mesh sweep: {num_pods} pods x {num_nodes} nodes, device counts "
+        f"{devices}, {warmup} warmup + {rounds} measured rounds, "
+        f"single-device twin per count (A/B pair)")
+
+    def make_store(nn, np_, seed=42):
+        _cluster, state = synth_full_cluster(
+            nn, np_, seed=seed,
+            num_quotas=max(8, np_ // 100), num_gangs=max(4, np_ // 50))
+        return build_store_from_state(state), state
+
+    def bound_list(res):
+        return [(b.pod_key, b.node_name) for b in res.bound]
+
+    def steady(sched, store, now, nn, np_):
+        # waves pinned to 1 by the caller: the sweep isolates the MESH
+        # dimension (pipeline on, the production default); composition
+        # with K-fusion is gated byte-identical by run_mesh_parity
+        pipeline = CyclePipeline(sched)
+        rounds_out = []
+        t0 = time.perf_counter()
+        res0 = pipeline.run_cycle(now=now)
+        cold = time.perf_counter() - t0
+        rounds_out.append(bound_list(res0))
+        walls, bound = [], []
+        for r in range(1, warmup + rounds + 1):
+            apply_round_delta(store, r, now, max(4, np_ // 100),
+                              metric_touches=max(2, nn // 100),
+                              prefix="mesh", namespace="meshbench")
+            t = now + 2 * r
+            t0 = time.perf_counter()
+            res = pipeline.run_cycle(now=t)
+            wall = time.perf_counter() - t0
+            rounds_out.append(bound_list(res))
+            if r > warmup:
+                walls.append(wall)
+                bound.append(len(res.bound))
+        pipeline.flush()
+        wsum = float(np.sum(walls))
+        pps = float(np.sum(bound)) / wsum if wsum else 0.0
+        return pps, cold, rounds_out
+
+    pps_at_dev = {}
+    pair_single = {}
+    parity_ok = True
+    for d in devices:
+        store_m, state_m = make_store(num_nodes, num_pods)
+        sched_m = Scheduler(store_m, mesh=d, waves=1)
+        assert (sched_m.mesh is not None
+                and sched_m.mesh.devices.size == d), (
+            f"mesh={d} did not resolve to a {d}-device mesh — the A/B "
+            "pair would fabricate a mesh datapoint")
+        pps_m, cold_m, rounds_m = steady(
+            sched_m, store_m, state_m.now, num_nodes, num_pods)
+        # the back-to-back single-device half of the stash pair
+        store_s, state_s = make_store(num_nodes, num_pods)
+        sched_s = Scheduler(store_s, mesh="off", waves=1)
+        pps_s, cold_s, rounds_s = steady(
+            sched_s, store_s, state_s.now, num_nodes, num_pods)
+        if rounds_m != rounds_s:
+            parity_ok = False
+            log(f"mesh sweep d={d}: bindings MISMATCH vs single-device twin")
+        pps_at_dev[str(d)] = round(pps_m, 1)
+        pair_single[str(d)] = round(pps_s, 1)
+        ratio = pps_m / pps_s if pps_s > 0 else 0.0
+        log(f"mesh sweep d={d}: {pps_m:,.1f} pods/s (mesh) vs "
+            f"{pps_s:,.1f} (single, same process) -> pair ratio "
+            f"{ratio:.2f}; cold {cold_m:.2f}s/{cold_s:.2f}s")
+
+    out = {
+        "metric": f"mesh_pods_per_sec_{num_pods}x{num_nodes}",
+        "value": pps_at_dev.get(str(max(devices))) if devices else 0.0,
+        "unit": "pods/s",
+        "pods_per_sec_at_devices": pps_at_dev,
+        "pods_per_sec_single_pair": pair_single,
+        "mesh_parity_ok": parity_ok,
+        "rounds": rounds,
+        "platform": jax.default_backend(),
+        "devices_visible": visible,
+    }
+
+    scale_on = (args_cli.mesh_scale if args_cli.mesh_scale is not None
+                else (0 if args_cli.smoke else 1))
+    if scale_on and devices:
+        from koordinator_tpu.api.objects import ObjectMeta, Pod, PodSpec
+        from koordinator_tpu.api.resources import ResourceList
+        from koordinator_tpu.client.store import KIND_POD
+
+        d = max(devices)
+        nn, np_, target = 50_000, 2_048, 100_000
+
+        def top_up_assigned(store):
+            # deterministic assigned filler up to the target pod count:
+            # running pods only shape the per-node requested sums, which
+            # the incremental pack memo aggregates — exactly the host-side
+            # scale story this config exists to prove
+            have = len(store.list(KIND_POD))
+            for i in range(max(0, target - have)):
+                store.add(KIND_POD, Pod(
+                    meta=ObjectMeta(name=f"filler-{i}",
+                                    namespace="meshscale",
+                                    uid=f"filler-{i}"),
+                    spec=PodSpec(
+                        node_name=f"node-{i % nn}",
+                        requests=ResourceList.of(
+                            cpu=50, memory=64 * 1024 * 1024, pods=1)),
+                    phase="Running"))
+
+        log(f"mesh scale config (SLOW): {np_} pending x {nn} nodes, "
+            f"topped up to {target} pods total, mesh d={d}")
+        t0 = time.perf_counter()
+        store_l, state_l = make_store(nn, np_, seed=7)
+        top_up_assigned(store_l)
+        t_fixture = time.perf_counter() - t0
+        sched_l = Scheduler(store_l, mesh=d, waves=1)
+        pps_l, cold_l, _ = steady(sched_l, store_l, state_l.now, nn, np_)
+        # back-to-back single-device pair (one fewer round would save
+        # minutes but break the pair convention — keep it symmetric)
+        store_1, state_1 = make_store(nn, np_, seed=7)
+        top_up_assigned(store_1)
+        sched_1 = Scheduler(store_1, mesh="off", waves=1)
+        pps_1, cold_1, _ = steady(sched_1, store_1, state_1.now, nn, np_)
+        total_pods = len(store_l.list(KIND_POD))
+        cs = sched_l.snapshot_cache.stats if sched_l.snapshot_cache else {}
+        log(f"mesh scale: {pps_l:,.1f} pods/s (mesh d={d}) vs "
+            f"{pps_1:,.1f} (single pair); cold cycle {cold_l:.1f}s, "
+            f"fixture {t_fixture:.1f}s, {total_pods} pods in store")
+        out["mesh_scale"] = {
+            "config": f"{total_pods}x{nn}",
+            "pending_per_cycle": np_,
+            "pods_per_sec_at_devices": {str(d): round(pps_l, 1)},
+            "pods_per_sec_single_pair": round(pps_1, 1),
+            "cold_cycle_seconds": round(cold_l, 2),
+            "pack_rows_reused": int(cs.get("pod_row_hits", 0)),
+        }
+
+    print(json.dumps(out))
 
 
 def run_full_chain(args_cli, num_pods: int, num_nodes: int,

@@ -13,7 +13,7 @@ python -m koordinator_tpu.analysis koordinator_tpu bench.py
 echo "== compileall =="
 python -m compileall -q koordinator_tpu bench.py tests hack/microbench.py
 
-echo "== serial-vs-pipelined + fused-wave + explain cycle parity =="
+echo "== serial-vs-pipelined + fused-wave + explain + mesh cycle parity =="
 # same store fixture through the strictly serial path, the CyclePipeline,
 # AND the fused multi-wave path at K in {1,2,4,8}: bindings, failure sets
 # and PodScheduled conditions must be byte-identical — a fused-K cycle is
@@ -23,6 +23,10 @@ echo "== serial-vs-pipelined + fused-wave + explain cycle parity =="
 # Also gates koordexplain: the kernel-counts formatter must reproduce the
 # legacy diagnose messages string-for-string, and the pipeline/fused
 # parity properties must hold with KOORD_TPU_EXPLAIN=counts enabled.
+# Also gates the mesh-backed dispatch (KOORD_TPU_MESH): the production
+# cycle sharded over 1/2/4/8-device meshes — serial, fused K=4, and with
+# explain=counts on top — must be byte-identical to single-device (the
+# harness forces the 8-way virtual CPU device split itself).
 JAX_PLATFORMS=cpu python -m koordinator_tpu.scheduler.pipeline_parity
 
 echo "== obs trace schema (golden fixture) =="

@@ -106,6 +106,66 @@ class ReadbackInWaveBody(Rule):
                     "driver instead")
 
 
+# the mesh dispatch path: every host<->device transfer must route through
+# the sharding-aware helpers (put_on_mesh pads + places per the mesh
+# layout; merge_readback merges the compacted buffers from the per-shard
+# copies with byte accounting). A bare device_put silently commits to ONE
+# device — the first sharded consumer then pays a full reshard — and a
+# bare asarray readback bypasses the per-shard observability.
+_MESH_PATH_RE = re.compile(r"parallel/[^/]+\.py$")
+_MESH_WRAPPERS = {"put_on_mesh", "merge_readback", "pad_for_sharding"}
+_MESH_TRANSFER_TAILS = {"asarray", "device_put"}
+
+
+@register
+class UnshardedTransferInMeshPath(Rule):
+    name = "unsharded-transfer-in-mesh-path"
+    severity = "error"
+    description = (
+        "bare jax.device_put / np.asarray inside parallel/ or a mesh-path "
+        "function of scheduler/cycle.py: mesh-dispatch transfers must go "
+        "through put_on_mesh (pads non-divisible axes and places per the "
+        "mesh sharding — a bare device_put commits to one device and "
+        "forces a full reshard) and readbacks through merge_readback (the "
+        "compacted per-shard merge with byte accounting); mark a "
+        "deliberate exception with # koordlint: disable")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        in_parallel = bool(_MESH_PATH_RE.search(ctx.path))
+        in_cycle = bool(_CYCLE_PATH_RE.search(ctx.path))
+        if not (in_parallel or in_cycle):
+            return
+        # function scope map: parallel/ is mesh path everywhere except
+        # inside the blessed wrapper definitions themselves; cycle.py's
+        # mesh branch is its mesh-named functions
+        wrapper_nodes = set()
+        scopes = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if in_parallel and node.name in _MESH_WRAPPERS:
+                    wrapper_nodes.add(node)
+                elif in_cycle and "mesh" in node.name:
+                    scopes.append(node)
+        if in_parallel:
+            exempt = set()
+            for w in wrapper_nodes:
+                exempt.update(id(n) for n in ast.walk(w))
+            roots = [n for n in ast.walk(ctx.tree)
+                     if id(n) not in exempt]
+        else:
+            roots = [n for s in scopes for n in ast.walk(s)]
+        for node in roots:
+            if (isinstance(node, ast.Call)
+                    and _dotted_tail(node.func) in _MESH_TRANSFER_TAILS
+                    and not _is_device_asarray(node.func)):
+                yield self.finding(
+                    ctx, node,
+                    f"{_dotted_tail(node.func)} bypasses the mesh "
+                    "sharding helpers — use put_on_mesh for uploads and "
+                    "merge_readback for the compacted readback, or "
+                    "annotate the intent with a pragma")
+
+
 @register
 class BlockingReadbackInPipeline(Rule):
     name = "blocking-readback-in-pipeline"

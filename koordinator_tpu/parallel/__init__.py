@@ -11,12 +11,18 @@ NCCL/MPI analog needed.
 
 from koordinator_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
+    merge_readback,
+    mesh_from_env,
+    mesh_row_layout,
+    pad_for_sharding,
+    put_on_mesh,
     shard_inputs_nodewise,
     shard_inputs_2d,
     build_sharded_schedule_step,
     build_sharded_score_matrix,
 )
 from koordinator_tpu.parallel.full_chain_mesh import (  # noqa: F401
+    build_sharded_fused_wave_step,
     build_sharded_full_chain_step,
     shard_full_chain_inputs,
 )

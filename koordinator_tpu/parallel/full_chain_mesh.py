@@ -31,6 +31,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from koordinator_tpu.models.full_chain import (
+    ExplainOut,
     FullChainInputs,
     build_full_chain_step,
 )
@@ -84,19 +85,70 @@ def build_sharded_full_chain_step(
     num_groups: int,
     mesh: Mesh,
     active_axes=None,
+    explain=None,
 ):
     """Full-chain step jitted with node-sharded in/out shardings.
 
     Same contract as build_full_chain_step:
-    FullChainInputs -> (chosen[P], requested[N, R], quota_used[G, R]).
+    FullChainInputs -> (chosen[P], requested[N, R], quota_used[G, R]),
+    plus the ExplainOut 4th output (and the extra ``n_real`` operand) when
+    ``explain`` is "counts"/"full" — attribution arrays are pod-axis and
+    come back replicated, so the readback merge sees one packed buffer.
     """
     raw = build_full_chain_step(
-        args, num_gangs, num_groups, jit=False, active_axes=active_axes
+        args, num_gangs, num_groups, jit=False, active_axes=active_axes,
+        explain=explain,
     )
     node_spec = _node_axis_spec(mesh, flat=True)
+    rep = NamedSharding(mesh, P())
     out_shardings = (
-        NamedSharding(mesh, P()),          # chosen [P] replicated
+        rep,                               # chosen [P] replicated
         NamedSharding(mesh, node_spec),    # requested [N, R] node-sharded
-        NamedSharding(mesh, P()),          # quota_used [G, R] replicated
+        rep,                               # quota_used [G, R] replicated
     )
+    if explain is not None:
+        # ExplainOut(stage_counts[P, S], terms[P, T] | None): pod-axis,
+        # replicated. terms is None below "full" — a pytree NON-leaf, so
+        # its sharding slot must be None too or the structures mismatch.
+        out_shardings = out_shardings + (
+            ExplainOut(rep, rep if explain == "full" else None),)
+    return jax.jit(raw, out_shardings=out_shardings)
+
+
+def build_sharded_fused_wave_step(
+    args: LoadAwareArgs,
+    num_gangs: int,
+    num_groups: int,
+    waves: int,
+    mesh: Mesh,
+    active_axes=None,
+    explain=None,
+):
+    """Fused multi-wave step (models/fused_waves.py) jitted over the mesh.
+
+    Same contract as build_fused_wave_step — (FullChainInputs, la_est[N, R],
+    la_adj[N, R]) -> FusedWaveOut (+ ExplainOut under koordexplain) — with
+    the node-axis carried state sharded exactly like the serial mesh step:
+    each wave's filter/score rows compute shard-locally, the argmax reduces
+    over ICI, and `commit_pod_state`'s node-row updates stay on the owning
+    shard. The compacted (pod, node, zone) readback buffers are pod-axis
+    and pinned REPLICATED, so the host merge reads the same packed order
+    the serial driver replays (parallel/mesh.merge_readback).
+    """
+    from koordinator_tpu.models.fused_waves import (
+        FusedWaveOut,
+        build_fused_wave_step,
+    )
+
+    raw = build_fused_wave_step(
+        args, num_gangs, num_groups, waves=waves, jit=False,
+        active_axes=active_axes, explain=explain,
+    )
+    rep = NamedSharding(mesh, P())
+    fw_out = FusedWaveOut(rep, rep, rep, rep, rep)
+    if explain is None:
+        out_shardings = fw_out
+    else:
+        out_shardings = (
+            fw_out, ExplainOut(rep, rep if explain == "full" else None))
     return jax.jit(raw, out_shardings=out_shardings)

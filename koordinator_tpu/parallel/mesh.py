@@ -22,7 +22,8 @@ crossing the process boundary, bit-identical to single-device.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -34,6 +35,8 @@ from koordinator_tpu.models.scheduler_model import (
     build_score_matrix,
 )
 from koordinator_tpu.ops.loadaware import LoadAwareArgs
+
+logger = logging.getLogger(__name__)
 
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
@@ -51,22 +54,135 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return Mesh(dev_array, axis_names=("pods", "nodes"))
 
 
+def mesh_from_env(env_value: Optional[str] = None) -> Optional[Mesh]:
+    """KOORD_TPU_MESH=<ndev>|auto selects the production mesh-backed
+    dispatch path (scheduler/cycle.py): "auto" takes every visible device,
+    an integer takes a prefix of `jax.devices()`. Unset/0/1-device-visible
+    "auto"/"off" return None — the single-device path. A request for more
+    devices than exist fails loudly (a silently-smaller mesh would make
+    capacity planning lie)."""
+    import os
+
+    raw = (os.environ.get("KOORD_TPU_MESH", "") if env_value is None
+           else str(env_value)).strip().lower()
+    if raw in ("", "0", "off", "false", "no"):
+        return None
+    devices = jax.devices()
+    if raw == "auto":
+        if len(devices) < 2:
+            return None
+        return make_mesh(devices)
+    try:
+        n = int(raw)
+    except ValueError:
+        logger.warning("KOORD_TPU_MESH=%r not an int or 'auto'; "
+                       "mesh dispatch stays off", raw)
+        return None
+    if n <= 1:
+        # a 1-device mesh is still a valid mesh (the parity gates use it);
+        # pin it explicitly with KOORD_TPU_MESH=1
+        if raw == "1":
+            return make_mesh(devices[:1])
+        return None
+    if n > len(devices):
+        raise ValueError(
+            f"KOORD_TPU_MESH={n} but only {len(devices)} devices visible")
+    return make_mesh(devices[:n])
+
+
 def _node_axis_spec(mesh: Mesh, flat: bool) -> P:
     # serial mode shards nodes over every device (both mesh axes)
     return P(("pods", "nodes")) if flat else P("nodes")
 
 
+def _shard_counts(sharding: NamedSharding, ndim: int) -> Tuple[int, ...]:
+    """Shards per dimension a NamedSharding splits an ndim-array into."""
+    spec = sharding.spec
+    sizes = dict(sharding.mesh.shape)
+    counts = []
+    for d in range(ndim):
+        entry = spec[d] if d < len(spec) else None
+        if entry is None:
+            counts.append(1)
+        elif isinstance(entry, (tuple, list)):
+            f = 1
+            for ax in entry:
+                f *= sizes[ax]
+            counts.append(f)
+        else:
+            counts.append(sizes[entry])
+    return tuple(counts)
+
+
+def pad_for_sharding(arr: np.ndarray, sharding: NamedSharding) -> np.ndarray:
+    """Zero-pad each sharded dimension up to the next multiple of its shard
+    count, so callers never pre-quantize axis sizes to the mesh factor.
+
+    Zero rows reproduce the snapshot build's own bucket-pad semantics
+    exactly (node_ok/allocatable/pod_valid all zero -> the row is
+    infeasible for every kernel), which is why padding here cannot perturb
+    bindings — the regression gate is test_parallel's 1023-node fixture.
+    Divisible shapes pass through untouched (no copy)."""
+    arr = np.asarray(arr)
+    counts = _shard_counts(sharding, arr.ndim)
+    widths = []
+    needs = False
+    for size, c in zip(arr.shape, counts):
+        pad = (-size) % c
+        widths.append((0, pad))
+        needs = needs or pad > 0
+    if not needs:
+        return arr
+    return np.pad(arr, widths)
+
+
 def put_on_mesh(arr, sharding: NamedSharding):
-    """Place host data on a (possibly multi-host) sharding. Single-process
-    meshes take the fast `device_put` path; when the mesh spans processes
+    """Place host data on a (possibly multi-host) sharding, zero-padding
+    non-divisible sharded axes (`pad_for_sharding`). Single-process meshes
+    take the fast `device_put` path; when the mesh spans processes
     (`jax.distributed.initialize()`), each process materializes only its
     addressable shards from the (identically computed) host array."""
+    arr = pad_for_sharding(np.asarray(arr), sharding)
     if sharding.is_fully_addressable:
         return jax.device_put(arr, sharding)
-    arr = np.asarray(arr)
     return jax.make_array_from_callback(
         arr.shape, sharding, lambda idx: arr[idx]
     )
+
+
+def merge_readback(*arrays) -> Tuple[List[np.ndarray], Dict[int, int]]:
+    """Materialize kernel outputs to host numpy, merging from the per-shard
+    device buffers, and account the bytes each mesh device actually holds
+    for them.
+
+    The sharded steps pin their compacted readback outputs (chosen /
+    bind_pods / bind_nodes / bind_zones / wave_counts) to a REPLICATED
+    sharding, so every shard holds the full buffer in the same packed order
+    the serial driver replays; the merge reads one addressable copy and the
+    per-shard byte map feeds the `koord_scheduler_mesh_readback_bytes`
+    gauges (shard-imbalance regressions must be visible, not inferred).
+    Blocking is intended: this IS the mesh path's designated sync point."""
+    out: List[np.ndarray] = []
+    per_shard: Dict[int, int] = {}
+    for arr in arrays:
+        shards = getattr(arr, "addressable_shards", None)
+        if shards:
+            for sh in shards:
+                per_shard[sh.device.id] = (
+                    per_shard.get(sh.device.id, 0) + int(sh.data.nbytes))
+        # koordlint: disable=unsharded-transfer-in-mesh-path
+        out.append(np.asarray(arr))
+    return out, per_shard
+
+
+def mesh_row_layout(mesh: Mesh, n_real: int, n_padded: int) -> List[int]:
+    """REAL (unpadded) node rows owned by each shard of the flat node
+    sharding, in device order — the shard-imbalance observability input.
+    With the node axis padded to `n_padded` over D devices each shard owns
+    `n_padded // D` rows; trailing shards may hold only pad rows."""
+    ndev = mesh.devices.size
+    per = n_padded // ndev if ndev else 0
+    return [max(0, min(per, n_real - i * per)) for i in range(ndev)]
 
 
 def shard_inputs_nodewise(inputs: ScheduleInputs, mesh: Mesh) -> ScheduleInputs:

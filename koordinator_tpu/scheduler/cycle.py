@@ -255,6 +255,7 @@ class Scheduler:
         sidecar_address: Optional[str] = None,
         waves=None,
         explain=None,
+        mesh=None,
     ):
         from koordinator_tpu.scheduler.config import SchedulerConfiguration
         from koordinator_tpu.scheduler.plugins.reservation import (
@@ -370,6 +371,27 @@ class Scheduler:
         self._sidecar_client = (
             SidecarClient(sidecar_address) if sidecar_address else None)
         self.sidecar_fallbacks = 0
+        # mesh-backed dispatch (KOORD_TPU_MESH=<ndev>|auto): node-state
+        # tensors shard over the device mesh (parallel/mesh.py), the
+        # filter/score rows compute shard-locally and the argmax reduces
+        # over ICI — the cluster sizes one chip cannot hold. An explicit
+        # argument pins it (int/"auto"/"off"/a jax Mesh); None reads the
+        # env. The gRPC sidecar protocol is single-device, so a sidecar
+        # demotes the mesh off.
+        from koordinator_tpu.parallel.mesh import mesh_from_env
+
+        if mesh is None:
+            self.mesh = mesh_from_env()
+        elif isinstance(mesh, (int, str)):
+            self.mesh = mesh_from_env(env_value=mesh)
+        else:
+            self.mesh = mesh
+        if self.mesh is not None and self._sidecar_client is not None:
+            logger.warning("KOORD_TPU_MESH ignored: the sidecar RPC "
+                           "protocol is single-device")
+            self.mesh = None
+        scheduler_metrics.MESH_DEVICES.set(
+            float(self.mesh.devices.size) if self.mesh is not None else 0.0)
         # pipelined-cycle mode (CyclePipeline): the kernel dispatch is
         # non-blocking and diagnose/condition writes for unbound pods are
         # deferred into the NEXT cycle's kernel window so host work
@@ -380,6 +402,12 @@ class Scheduler:
         self._deferred_diagnose: List[Tuple[list, object, float,
                                             Optional[Dict[str, str]]]] = []
         self._flushed_this_cycle = False
+        # fused-dispatch condition-write batching: while a multi-wave
+        # replay is in progress every logical cycle's PodScheduled writes
+        # queue on the SAME deferred machinery the pipeline uses, and the
+        # dispatch drains them in ONE flush instead of K store-write
+        # batches serializing against the next dispatch
+        self._defer_condition_writes = False
         # last DeviceSnapshot stats snapshot, for counter deltas
         self._upload_stats_last: Dict[str, int] = {}
         # admission grouping of the last encode: raw arrays, with the
@@ -405,7 +433,17 @@ class Scheduler:
                 loadaware_plugin=self.extender.plugin("LoadAwareScheduling"),
                 numa_plugin=self.extender.plugin("NodeNUMAResource"),
             )
-            self.device_snapshot = DeviceSnapshot()
+            self.device_snapshot = DeviceSnapshot(mesh=self.mesh)
+        elif self.mesh is not None:
+            # the mesh path REQUIRES the device mirror: it owns the
+            # sharded upload (put_on_mesh) and the shard-aware scatter.
+            # Without the incremental-snapshot gate it still dedups on
+            # host equality, it just sees full rebuilds each cycle.
+            from koordinator_tpu.scheduler.snapshot_cache import (
+                DeviceSnapshot,
+            )
+
+            self.device_snapshot = DeviceSnapshot(mesh=self.mesh)
 
     # ------------------------------------------------------------------
     def _pending_queue(self, now: float) -> Tuple[List[Pod], Dict[str, Reservation]]:
@@ -594,7 +632,8 @@ class Scheduler:
 
     def _get_step(self, signature: Tuple, ng: int, ngroups: int, active,
                   explain=None) -> object:
-        key = (signature, ng, ngroups, tuple(active), explain)
+        mesh_tag = self.mesh.devices.size if self.mesh is not None else 0
+        key = (signature, ng, ngroups, tuple(active), explain, mesh_tag)
         step = self._step_cache.get(key)
         if step is not None:
             self._last_step_compiled = False
@@ -609,9 +648,18 @@ class Scheduler:
         self._last_step_compiled = True
         scheduler_metrics.COMPILE_CACHE_MISSES.inc()
         with self.tracer.span("compile", signature=str(key)):
-            step = build_best_full_chain_step(
-                self.args, ng, ngroups, active_axes=active, explain=explain
-            )
+            if self.mesh is not None:
+                from koordinator_tpu.parallel import (
+                    build_sharded_full_chain_step,
+                )
+
+                step = build_sharded_full_chain_step(
+                    self.args, ng, ngroups, self.mesh, active_axes=active,
+                    explain=explain)
+            else:
+                step = build_best_full_chain_step(
+                    self.args, ng, ngroups, active_axes=active,
+                    explain=explain)
         self._step_cache[key] = step
         return step
 
@@ -619,7 +667,9 @@ class Scheduler:
                         active, waves: int, explain=None) -> object:
         from koordinator_tpu.models.fused_waves import build_fused_wave_step
 
-        key = ("fused", waves, signature, ng, ngroups, tuple(active), explain)
+        mesh_tag = self.mesh.devices.size if self.mesh is not None else 0
+        key = ("fused", waves, signature, ng, ngroups, tuple(active),
+               explain, mesh_tag)
         step = self._step_cache.get(key)
         if step is not None:
             self._last_step_compiled = False
@@ -628,9 +678,18 @@ class Scheduler:
         self._last_step_compiled = True
         scheduler_metrics.COMPILE_CACHE_MISSES.inc()
         with self.tracer.span("compile", signature=str(key)):
-            step = build_fused_wave_step(
-                self.args, ng, ngroups, waves=waves, active_axes=active,
-                explain=explain)
+            if self.mesh is not None:
+                from koordinator_tpu.parallel import (
+                    build_sharded_fused_wave_step,
+                )
+
+                step = build_sharded_fused_wave_step(
+                    self.args, ng, ngroups, waves=waves, mesh=self.mesh,
+                    active_axes=active, explain=explain)
+            else:
+                step = build_fused_wave_step(
+                    self.args, ng, ngroups, waves=waves, active_axes=active,
+                    explain=explain)
         self._step_cache[key] = step
         return step
 
@@ -1063,7 +1122,7 @@ class Scheduler:
         if not items:
             return
         messages = self._capture_attribution(items, last)
-        if self.pipeline_mode:
+        if self.pipeline_mode or self._defer_condition_writes:
             # pipelined cycle: the writes run inside the NEXT cycle's
             # kernel window (flush_deferred), overlapping device work.
             # `now` and the packed batch are captured here, so the
@@ -1324,6 +1383,43 @@ class Scheduler:
             counter.inc(ds[key] - prev_ds.get(key, 0))
         self._upload_stats_last = dict(ds)
 
+    def _readback_sync(self, n_shape: Tuple[int, int], *arrays):
+        """The designated host sync point: materialize kernel outputs.
+        Mesh mode routes through the per-shard merge (compacted packed
+        order + shard observability); single-device is a plain blocking
+        asarray. ``n_shape`` is (real nodes, padded node axis) for the
+        shard-imbalance gauge."""
+        if self.mesh is not None:
+            return self._mesh_merge_readback(n_shape, *arrays)
+        # the single intended host-blocking sync of the dispatch window
+        # koordlint: disable=blocking-readback-in-pipeline
+        return [np.asarray(a) for a in arrays]
+
+    def _mesh_merge_readback(self, n_shape: Tuple[int, int], *arrays):
+        """Mesh-branch readback: merge the (replicated) compacted output
+        buffers from the per-shard device copies (parallel/mesh.py
+        merge_readback — the packed order is identical to what the serial
+        driver replays), then surface how the dispatch split across the
+        mesh: per-shard readback bytes + real-row imbalance gauges and a
+        `shard[i]` marker span per device under the kernel span."""
+        from koordinator_tpu.parallel import merge_readback, mesh_row_layout
+
+        out, per_shard = merge_readback(*arrays)
+        n_real, n_padded = n_shape
+        rows = mesh_row_layout(self.mesh, n_real, n_padded)
+        mean_rows = float(np.mean(rows)) if rows else 0.0
+        scheduler_metrics.MESH_SHARD_IMBALANCE.set(
+            float(max(rows)) / mean_rows if mean_rows > 0 else 0.0)
+        for i, dev in enumerate(self.mesh.devices.flat):
+            nbytes = per_shard.get(dev.id, 0)
+            scheduler_metrics.MESH_SHARD_READBACK_BYTES.set(
+                float(nbytes), shard=str(i))
+            with self.tracer.span("shard", index=str(i),
+                                  rows=str(rows[i]),
+                                  readback_bytes=str(nbytes)):
+                pass
+        return out
+
     def _batch_pass(
         self,
         pending: List[Pod],
@@ -1376,6 +1472,8 @@ class Scheduler:
                     self._record_upload_deltas()
                     self.device_snapshot.begin_dispatch()
                 t_dispatch = time.perf_counter()
+                n_shape = (len(nodes.names),
+                           int(np.shape(fc.base.allocatable)[0]))
                 try:
                     if explain is not None:
                         # same dispatch, extra attribution outputs; n_real
@@ -1394,13 +1492,11 @@ class Scheduler:
                             # the pipeline's single designated sync point:
                             # bind needs the chosen vector, nothing
                             # before does
-                            # koordlint: disable=blocking-readback-in-pipeline
-                            chosen = np.asarray(chosen)
+                            chosen, = self._readback_sync(n_shape, chosen)
                     else:
                         # serial path: block immediately (the pre-pipeline
                         # behavior, and the KOORD_TPU_PIPELINE=0 fallback)
-                        # koordlint: disable=blocking-readback-in-pipeline
-                        chosen = np.asarray(chosen)
+                        chosen, = self._readback_sync(n_shape, chosen)
                 finally:
                     if self.device_snapshot is not None:
                         self.device_snapshot.end_dispatch()
@@ -1480,7 +1576,40 @@ class Scheduler:
         with the read-back bindings). A Reserve veto or a preemption
         retry truncates: the device state beyond that wave assumed a world
         that didn't happen, so the remaining rounds fall to the next
-        cycle. result.waves reports the logical cycles completed."""
+        cycle. result.waves reports the logical cycles completed.
+
+        Condition writes are BATCHED per dispatch: each logical cycle's
+        PodScheduled/condition verdicts are captured at verdict time
+        (content byte-identical — same packed state, same ``now``) but
+        queue on the pipeline's deferred machinery; the dispatch drains
+        them in one flush at the end (pipeline mode keeps deferring into
+        the next kernel window as before). The supersede guards in
+        ``_diagnose_and_write`` make the late writes converge to exactly
+        the serial end state: a pod bound by a later wave skips its stale
+        False verdict the same way the next cycle's bind would have
+        overwritten it."""
+        self._defer_condition_writes = True
+        try:
+            self._fused_wave_dispatch(pending, now, ctx, result,
+                                      pending_reservations, originals,
+                                      k_waves)
+        finally:
+            self._defer_condition_writes = False
+            if not self.pipeline_mode and self._deferred_diagnose:
+                # ONE store-write flush for the whole dispatch (pipeline
+                # mode leaves the queue for the next kernel window)
+                self.flush_deferred()
+
+    def _fused_wave_dispatch(
+        self,
+        pending: List[Pod],
+        now: float,
+        ctx: CycleContext,
+        result: CycleResult,
+        pending_reservations: Dict[str, Reservation],
+        originals: Dict[str, Pod],
+        k_waves: int,
+    ) -> None:
         assert not pending_reservations, (
             "_effective_waves demotes to K=1 when reservation CRs pend")
         result.waves = 0
@@ -1530,31 +1659,29 @@ class Scheduler:
                 self._record_upload_deltas()
                 self.device_snapshot.begin_dispatch()
             t_dispatch = time.perf_counter()
+            n_shape = (len(nodes.names),
+                       int(np.shape(fc.base.allocatable)[0]))
             try:
                 if explain is not None:
                     out, ex_out = step(fc, la_est, la_adj,
                                        np.int32(len(nodes.names)))
                 else:
                     out = step(fc, la_est, la_adj)  # async dispatch
+                compacted = (out.bind_pods, out.bind_nodes, out.bind_zones,
+                             out.wave_counts)
                 if self.pipeline_mode:
                     self.flush_deferred()
                     with self.tracer.span("overlap_wait"):
                         # the single designated sync point: the first
                         # readback blocks until the whole fused program
-                        # (all K waves) finished
-                        # koordlint: disable=blocking-readback-in-pipeline
-                        bind_pods = np.asarray(out.bind_pods)
+                        # (all K waves) finished; the compacted buffers
+                        # merge together (mesh mode reads them from the
+                        # per-shard replicas in one pass)
+                        bind_pods, bind_nodes, bind_zones, wave_counts = (
+                            self._readback_sync(n_shape, *compacted))
                 else:
-                    # koordlint: disable=blocking-readback-in-pipeline
-                    bind_pods = np.asarray(out.bind_pods)
-                # the remaining outputs are already materialized — the
-                # program completed at the first sync above
-                # koordlint: disable=blocking-readback-in-pipeline
-                bind_nodes = np.asarray(out.bind_nodes)
-                # koordlint: disable=blocking-readback-in-pipeline
-                bind_zones = np.asarray(out.bind_zones)
-                # koordlint: disable=blocking-readback-in-pipeline
-                wave_counts = np.asarray(out.wave_counts)
+                    bind_pods, bind_nodes, bind_zones, wave_counts = (
+                        self._readback_sync(n_shape, *compacted))
                 waves_run = int(out.waves_run)
             finally:
                 if self.device_snapshot is not None:

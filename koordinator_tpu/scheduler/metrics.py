@@ -138,6 +138,26 @@ FLIGHT_DUMPS = REGISTRY.counter(
     "Flight-recorder bundle dumps, labeled by trigger reason",
 )
 
+# mesh-backed dispatch (KOORD_TPU_MESH, parallel/mesh.py): how many
+# devices the production cycle shards over (0 = single-device path), how
+# the node rows and the compacted readback split across shards. The
+# imbalance gauge is max/mean REAL (unpadded) rows per shard — 1.0 is a
+# perfectly level mesh; trailing shards holding only pad rows push it up
+# and that capacity is simply wasted.
+MESH_DEVICES = REGISTRY.gauge(
+    "koord_scheduler_mesh_devices",
+    "Devices in the production dispatch mesh (0 = single-device)",
+)
+MESH_SHARD_READBACK_BYTES = REGISTRY.gauge(
+    "koord_scheduler_mesh_readback_bytes",
+    "Bytes of the last kernel readback held per mesh shard, "
+    "labeled by shard",
+)
+MESH_SHARD_IMBALANCE = REGISTRY.gauge(
+    "koord_scheduler_mesh_shard_imbalance",
+    "Max/mean real node rows per mesh shard in the last dispatch",
+)
+
 # pipeline deferred-diagnose backlog: depth of the queue carrying cycle
 # N's unschedulability writes into cycle N+1's kernel window, plus the
 # total items ever deferred — a growing depth means kernel windows (or

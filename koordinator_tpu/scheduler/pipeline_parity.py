@@ -380,6 +380,130 @@ def run_fused_wave_parity(k_waves: int, num_nodes: int = 24,
     }
 
 
+def run_mesh_parity(ndev: int, waves: int = 1, num_nodes: int = 24,
+                    num_pods: int = 70, rounds: int = 2, seed: int = 11,
+                    arrivals: int = 9, explain: str = "off") -> dict:
+    """Mesh-backed dispatch vs the single-device path: byte-identical.
+
+    The mesh world runs the production cycle with KOORD_TPU_MESH=ndev
+    semantics pinned (node-state tensors sharded over an ndev-device mesh,
+    sharded upload + shard-aware scatter, per-shard readback merge —
+    scheduler/cycle.py + parallel/mesh.py); the twin runs the exact
+    single-device path. Both worlds use the SAME wave depth and explain
+    level, so this gate isolates the mesh dimension; composition with
+    pipelining and K-fusion is covered transitively by the PR 3/4 gates.
+    Diffed per round: bound (pod, node, annotations) sequences in order
+    and the failure/victim/resize lists; at end of stream: every
+    PodScheduled condition tuple, gang/quota plugin counters, and final
+    assignments."""
+    import numpy as np
+
+    from koordinator_tpu.client.store import KIND_POD
+    from koordinator_tpu.scheduler.cycle import Scheduler
+    from koordinator_tpu.testing import synth_full_cluster
+
+    def make_world():
+        _cluster, state = synth_full_cluster(
+            num_nodes, num_pods, seed=seed, num_quotas=3, num_gangs=4,
+            topology_fraction=0.5, lsr_fraction=0.2)
+        return state, build_store_from_state(state)
+
+    state_s, store_single = make_world()
+    _state_m, store_mesh = make_world()
+    sched_single = Scheduler(store_single, waves=waves, explain=explain,
+                             mesh="off")
+    sched_mesh = Scheduler(store_mesh, waves=waves, explain=explain,
+                           mesh=ndev)
+    assert sched_mesh.mesh is not None and (
+        sched_mesh.mesh.devices.size == ndev)
+
+    now = state_s.now
+    mismatches: List[str] = []
+    fields = ("failed", "rejected", "preempted_victims", "resized",
+              "resize_pending")
+    for r in range(rounds + 1):
+        if r > 0:
+            apply_round_delta(store_single, r, now, arrivals)
+            apply_round_delta(store_mesh, r, now, arrivals)
+        t = now + 2 * r
+        res_s = sched_single.run_cycle(now=t)
+        res_m = sched_mesh.run_cycle(now=t)
+        if ([(b.pod_key, b.node_name, b.annotations) for b in res_s.bound]
+                != [(b.pod_key, b.node_name, b.annotations)
+                    for b in res_m.bound]):
+            mismatches.append(f"round {r}: bound sequence differs")
+        if res_s.waves != res_m.waves:
+            mismatches.append(f"round {r}: waves consumed differ "
+                              f"({res_s.waves} vs {res_m.waves})")
+        for f in fields:
+            if sorted(getattr(res_s, f)) != sorted(getattr(res_m, f)):
+                mismatches.append(f"round {r}: {f} differs")
+
+    cond_s, cond_m = _conditions(store_single), _conditions(store_mesh)
+    if cond_s != cond_m:
+        keys = {k for k in set(cond_s) | set(cond_m)
+                if cond_s.get(k) != cond_m.get(k)}
+        mismatches.append(
+            f"PodScheduled conditions differ for {len(keys)} pods "
+            f"(e.g. {sorted(keys)[:3]})")
+
+    def plugin_counters(sched):
+        gang = sched.extender.plugin("Coscheduling")
+        quota = sched.extender.plugin("ElasticQuota")
+        return (
+            {g: n for g, n in (gang.assumed if gang else {}).items() if n},
+            {q: tuple(np.asarray(v).tolist())
+             for q, v in (quota.used if quota else {}).items()
+             if np.asarray(v).any()},
+        )
+
+    gang_s, quota_s = plugin_counters(sched_single)
+    gang_m, quota_m = plugin_counters(sched_mesh)
+    if gang_s != gang_m:
+        mismatches.append(f"gang assumed counters differ: "
+                          f"{gang_s} vs {gang_m}")
+    if quota_s != quota_m:
+        mismatches.append("quota used counters differ")
+    assign_s = {p.meta.key: p.spec.node_name
+                for p in store_single.list(KIND_POD)}
+    assign_m = {p.meta.key: p.spec.node_name
+                for p in store_mesh.list(KIND_POD)}
+    if assign_s != assign_m:
+        diff = sorted(k for k in set(assign_s) | set(assign_m)
+                      if assign_s.get(k) != assign_m.get(k))
+        mismatches.append(
+            f"final pod->node assignments differ for {len(diff)} pods "
+            f"(e.g. {diff[:3]})")
+    _dump_on_mismatch(mismatches, sched_single, sched_mesh)
+
+    return {
+        "ok": not mismatches,
+        "mismatches": mismatches,
+        "ndev": ndev,
+        "waves": waves,
+        "rounds": rounds + 1,
+        "pods": len(assign_s),
+        "conditions_checked": len(cond_s),
+        "explain": explain,
+    }
+
+
+def _force_virtual_devices() -> None:
+    """The mesh parity gates need >= 8 devices; on the CPU backend force
+    the 8-way virtual split (same shape tests/conftest.py pins) BEFORE the
+    first jax import of this process."""
+    import os
+    import sys
+
+    if "jax" in sys.modules:
+        return  # too late to change the platform flags; use what exists
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+
 def main(argv: List[str]) -> int:
     def show(name: str, rep: dict) -> bool:
         line = (f"{name}: rounds={rep['rounds']} pods={rep['pods']} "
@@ -390,9 +514,30 @@ def main(argv: List[str]) -> int:
             print(f"  {m}", file=sys.stderr)
         return rep["ok"]
 
+    _force_virtual_devices()
     ok = show("pipeline parity", run_pipeline_parity())
     for k in (1, 2, 4, 8):
         ok = show(f"fused-wave parity K={k}", run_fused_wave_parity(k)) and ok
+    # mesh-backed dispatch (KOORD_TPU_MESH): the production sharded path
+    # must be byte-identical to single-device at every mesh size, serial
+    # and fused, and with koordexplain attribution enabled on top
+    import jax
+
+    max_dev = len(jax.devices())
+    for nd in (1, 2, 4, 8):
+        if nd > max_dev:
+            print(f"mesh parity ndev={nd}: SKIPPED "
+                  f"(only {max_dev} devices)", file=sys.stderr)
+            continue
+        ok = show(f"mesh parity ndev={nd} (serial)",
+                  run_mesh_parity(nd)) and ok
+        ok = show(f"mesh parity ndev={nd} (fused K=4)",
+                  run_mesh_parity(nd, waves=4)) and ok
+    if max_dev >= 8:
+        ok = show("mesh parity ndev=8 (serial, explain=counts)",
+                  run_mesh_parity(8, explain="counts")) and ok
+        ok = show("mesh parity ndev=8 (fused K=4, explain=counts)",
+                  run_mesh_parity(8, waves=4, explain="counts")) and ok
     # koordexplain gates (PR 5): kernel-counts formatter vs the legacy
     # host diagnosis must be string-for-string on churn, and the PR 3/4
     # parity properties must survive with attribution enabled

@@ -632,6 +632,23 @@ def _pad_bucket(n: int) -> int:
     return p
 
 
+def _mesh_node_fields() -> Set[str]:
+    """Field names (FullChainInputs + ScheduleInputs + the fused side
+    arrays) whose leading axis is the node axis — the set the mesh-backed
+    DeviceSnapshot shards over all devices; everything else is replicated.
+    Derived from the SAME sets the dry-run sharders use
+    (parallel/mesh.py, parallel/full_chain_mesh.py) so the production
+    upload can never disagree with the proven parity layout."""
+    from koordinator_tpu.models.scheduler_model import ScheduleInputs
+    from koordinator_tpu.parallel.full_chain_mesh import _FC_NODE_FIELDS
+
+    pod_fields = {"fit_requests", "estimated", "is_prod", "is_daemonset",
+                  "pod_valid", "weights"}
+    base_node = set(ScheduleInputs._fields) - pod_fields
+    return base_node | set(_FC_NODE_FIELDS) | {
+        "la_est_nonprod", "la_adj_nonprod"}
+
+
 class DeviceSnapshot:
     """Per-field device mirror of the (sliced) FullChainInputs.
 
@@ -639,9 +656,23 @@ class DeviceSnapshot:
     whose host value is unchanged since the previous cycle reuses the
     previous device buffer (zero transfer), small row-deltas of node-axis
     arrays are applied as DONATED scatter updates (transfer = changed rows
-    only), and everything else is re-put."""
+    only), and everything else is re-put.
 
-    def __init__(self) -> None:
+    With ``mesh`` (KOORD_TPU_MESH, parallel/mesh.py) every buffer lives
+    under a NamedSharding: node-axis fields shard over all mesh devices
+    (zero-padded to the mesh factor by ``put_on_mesh``), pod/quota/gang
+    fields replicate, and the incremental scatter routes dirty rows to
+    their owning shard — the jitted update pins the node sharding on its
+    output and XLA lowers the replicated-index scatter shard-locally, so
+    a row delta never reshards (or re-ships) the whole array. The
+    donation/double-buffer guard (begin/end_dispatch) is sharding-agnostic
+    and applies unchanged."""
+
+    def __init__(self, mesh=None) -> None:
+        self.mesh = mesh
+        self._node_fields: Optional[Set[str]] = (
+            _mesh_node_fields() if mesh is not None else None)
+        self._shardings: Dict[bool, object] = {}
         self._fields: Dict[str, Tuple[np.ndarray, object]] = {}
         self._scatter_cache: Dict[tuple, object] = {}
         # dispatches whose consumers may still be in flight on device. A
@@ -665,7 +696,23 @@ class DeviceSnapshot:
     def end_dispatch(self) -> None:
         self._in_flight = max(0, self._in_flight - 1)
 
-    def _scatter(self, dev, idx: np.ndarray, rows: np.ndarray):
+    def _sharding(self, node_axis: bool):
+        """The field's NamedSharding under the mesh: node-axis fields flat
+        over every device, the rest replicated. Cached per kind."""
+        hit = self._shardings.get(node_axis)
+        if hit is None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from koordinator_tpu.parallel.mesh import _node_axis_spec
+
+            spec = (_node_axis_spec(self.mesh, flat=True) if node_axis
+                    else P())
+            hit = NamedSharding(self.mesh, spec)
+            self._shardings[node_axis] = hit
+        return hit
+
+    def _scatter(self, dev, idx: np.ndarray, rows: np.ndarray,
+                 sharding=None):
         import jax
 
         if idx.size == 0:
@@ -680,20 +727,47 @@ class DeviceSnapshot:
             rows[-1], (pad,) + rows.shape[1:]).copy()
         rows_p[: idx.size] = rows
         donate = self._in_flight == 0
-        key = (dev.shape, str(dev.dtype), pad, donate)
+        # the sharding itself (hashable) keys the cache: node-sharded and
+        # replicated fields of equal shape/dtype must NOT share a jitted
+        # fn, or the pinned out_shardings of whichever compiled first
+        # would silently reshard the other
+        key = (dev.shape, str(dev.dtype), pad, donate, sharding)
         fn = self._scatter_cache.get(key)
         if fn is None:
+            # under a mesh the output sharding is pinned to the input's
+            # node sharding: the dirty rows (replicated operands) land on
+            # their owning shard via XLA's shard-local scatter lowering —
+            # no reshard, no cross-shard traffic beyond the tiny operands
             fn = jax.jit(lambda a, i, r: a.at[i].set(r),
-                         donate_argnums=(0,) if donate else ())
+                         donate_argnums=(0,) if donate else (),
+                         out_shardings=sharding)
             self._scatter_cache[key] = fn
         if not donate:
             self.stats["scattered_safe"] += 1
+        if sharding is not None:
+            from koordinator_tpu.parallel.mesh import put_on_mesh
+
+            rep = self._sharding(False)
+            idx_p = put_on_mesh(idx_p, rep)
+            rows_p = put_on_mesh(rows_p, rep)
         return fn(dev, idx_p, rows_p)
 
     def _one(self, name: str, new) -> object:
         import jax
 
         new = np.asarray(new)
+        sharding = None
+        if self.mesh is not None:
+            from koordinator_tpu.parallel.mesh import (
+                pad_for_sharding,
+                put_on_mesh,
+            )
+
+            sharding = self._sharding(name in self._node_fields)
+            # the host mirror is kept in PADDED coordinates so the change
+            # compare and the dirty-row indices line up with the device
+            # layout; pad rows are constant zero and never show up dirty
+            new = pad_for_sharding(new, sharding)
         hit = self._fields.get(name)
         if (hit is not None and hit[0].shape == new.shape
                 and hit[0].dtype == new.dtype):
@@ -712,13 +786,19 @@ class DeviceSnapshot:
                     else prev_np != new)[0]
                 if 0 < rows.size <= new.shape[0] * _SCATTER_FRACTION:
                     dev2 = self._scatter(
-                        dev, rows.astype(np.int32), new[rows])
+                        dev, rows.astype(np.int32), new[rows],
+                        sharding=sharding)
                     self._fields[name] = (new.copy(), dev2)
                     self.stats["scattered"] += 1
                     self.stats["bytes_scattered"] += int(
                         new[rows].nbytes)
                     return dev2
-        dev = jax.device_put(new)
+        if sharding is not None:
+            from koordinator_tpu.parallel.mesh import put_on_mesh
+
+            dev = put_on_mesh(new, sharding)
+        else:
+            dev = jax.device_put(new)
         self._fields[name] = (new.copy(), dev)
         self.stats["put"] += 1
         self.stats["bytes_put"] += int(new.nbytes)

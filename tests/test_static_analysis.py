@@ -795,3 +795,89 @@ class TestConcurrencyGatedPaths:
             "koordinator_tpu/scheduler/cycle.py",
         ):
             assert is_concurrent_path(path), path
+
+
+class TestUnshardedTransferInMeshPath:
+    RULE = "unsharded-transfer-in-mesh-path"
+
+    def test_positive_device_put_in_parallel(self):
+        src = """
+            import jax
+            import numpy as np
+
+            def shard_side_arrays(arr, sharding):
+                return jax.device_put(arr, sharding)
+        """
+        out = findings_for(src, self.RULE,
+                           path="koordinator_tpu/parallel/mesh.py")
+        assert len(out) == 1 and "put_on_mesh" in out[0].message
+
+    def test_positive_asarray_readback_in_mesh_branch_of_cycle(self):
+        src = """
+            import numpy as np
+
+            def _mesh_merge_readback(self, arrays):
+                return [np.asarray(a) for a in arrays]
+        """
+        out = findings_for(src, self.RULE,
+                           path="koordinator_tpu/scheduler/cycle.py")
+        assert len(out) == 1
+
+    def test_negative_wrappers_and_jnp_are_exempt(self):
+        # put_on_mesh / merge_readback / pad_for_sharding ARE the blessed
+        # helpers; jnp.asarray is a device-side coercion, not a transfer
+        src = """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            def put_on_mesh(arr, sharding):
+                arr = np.asarray(arr)
+                return jax.device_put(arr, sharding)
+
+            def pad_for_sharding(arr, sharding):
+                return np.asarray(arr)
+
+            def coerce(x):
+                return jnp.asarray(x)
+        """
+        assert findings_for(
+            src, self.RULE,
+            path="koordinator_tpu/parallel/mesh.py") == []
+
+    def test_negative_non_mesh_cycle_function_and_other_modules(self):
+        src = """
+            import numpy as np
+
+            def _batch_pass(self, chosen):
+                return np.asarray(chosen)
+        """
+        assert findings_for(
+            src, self.RULE,
+            path="koordinator_tpu/scheduler/cycle.py") == []
+        assert findings_for(
+            src, self.RULE,
+            path="koordinator_tpu/models/full_chain.py") == []
+
+    def test_negative_pragma(self):
+        src = """
+            import numpy as np
+
+            def merge_helper_for_mesh(arrays):
+                # koordlint: disable=unsharded-transfer-in-mesh-path
+                return [np.asarray(a) for a in arrays]
+        """
+        assert findings_for(
+            src, self.RULE,
+            path="koordinator_tpu/parallel/full_chain_mesh.py") == []
+
+    def test_shipped_mesh_modules_are_clean(self):
+        for rel in (
+            "koordinator_tpu/parallel/mesh.py",
+            "koordinator_tpu/parallel/full_chain_mesh.py",
+            "koordinator_tpu/scheduler/cycle.py",
+        ):
+            source = (REPO_ROOT / rel).read_text()
+            out = analyze_source(source, path=rel,
+                                 rules={self.RULE: all_rules()[self.RULE]})
+            assert [f for f in out if f.rule == self.RULE] == [], rel
