@@ -140,6 +140,21 @@ def main() -> None:
         "SLOW — several minutes on CPU. Default: on unless --smoke",
     )
     ap.add_argument(
+        "--churn", default=None, metavar="SCENARIO",
+        help="run a named koordsim churn scenario (python -m "
+        "koordinator_tpu.sim --list) TWICE back-to-back in this process "
+        "and emit the SLO report as an A/B stash pair (BENCH_NOTES "
+        "convention: same-process pairs are the only comparable numbers "
+        "on a noisy box). The JSON carries bound-pods/sec for both runs, "
+        "time-to-bind p50/p99, invariant breaches and the binding-log "
+        "hashes (pair determinism)",
+    )
+    ap.add_argument(
+        "--churn-cycles", type=int, default=None,
+        help="override the --churn scenario's cycle count "
+        "(--smoke caps it at 30)",
+    )
+    ap.add_argument(
         "--device-probe-timeout", type=int, default=150,
         help="seconds per device-init probe attempt (subprocess); after "
         "--device-probe-attempts failures the bench falls back to CPU "
@@ -152,7 +167,19 @@ def main() -> None:
     )
     args_cli = ap.parse_args()
 
-    if args_cli.mesh:
+    churn_scenario = None
+    if args_cli.churn is not None:
+        # resolve the scenario BEFORE jax imports: a mesh scenario needs
+        # the virtual device split forced first (see below)
+        from koordinator_tpu.sim.scenarios import SCENARIOS
+
+        churn_scenario = SCENARIOS.get(args_cli.churn)
+        if churn_scenario is None:
+            ap.error(f"unknown churn scenario {args_cli.churn!r}; "
+                     f"catalog: {', '.join(sorted(SCENARIOS))}")
+
+    if args_cli.mesh or (churn_scenario is not None
+                         and churn_scenario.mesh is not None):
         # the CPU backend exposes ONE device unless the 8-way virtual
         # split is forced before the first jax import (same shape
         # tests/conftest.py pins); real accelerators keep their topology
@@ -168,6 +195,10 @@ def main() -> None:
 
     _guard_against_dead_accelerator(args_cli.device_probe_timeout,
                                     args_cli.device_probe_attempts)
+
+    if churn_scenario is not None:
+        run_sim_churn(args_cli, churn_scenario)
+        return
 
     if args_cli.mesh:
         run_mesh_sweep(args_cli)
@@ -294,6 +325,71 @@ def main() -> None:
             }
         )
     )
+
+
+def run_sim_churn(args_cli, scenario) -> None:
+    """koordsim scenario as a back-to-back A/B stash pair.
+
+    Runs the named scenario TWICE in this process with the same seed and
+    reports both runs: bound-pods-per-wall-second is the throughput
+    number (pair ratio ~1 is this box's noise floor — BENCH_NOTES
+    convention), the binding-log hashes pin determinism (they MUST be
+    equal: same seed, same code), and time-to-bind p50/p99 plus
+    invariant breaches are the SLO report (the structural deliverable;
+    wall-clock throughput is backend-bound, correctness is not)."""
+    import dataclasses
+
+    import jax
+
+    from koordinator_tpu.sim.harness import run_scenario
+
+    sc = scenario
+    if args_cli.churn_cycles is not None:
+        sc = dataclasses.replace(sc, cycles=args_cli.churn_cycles)
+    elif args_cli.smoke:
+        sc = dataclasses.replace(sc, cycles=min(sc.cycles, 30))
+    log(f"devices: {jax.devices()}")
+    log(f"config: churn scenario {sc.name!r} — {sc.cycles} cycles, "
+        f"{sc.nodes} nodes, seed {sc.seed}, {len(sc.faults)} scheduled "
+        "faults; two back-to-back runs (A/B pair)")
+    reports = []
+    for label in ("A", "B"):
+        rep = run_scenario(sc)
+        reports.append(rep)
+        log(f"run {label}: bound {rep.pods_bound}/{rep.pods_created} in "
+            f"{rep.wall_seconds:.1f}s "
+            f"({rep.pods_bound / max(rep.wall_seconds, 1e-9):.1f} "
+            f"bound/s), ttb p50/p99 {rep.percentile(50):.1f}/"
+            f"{rep.percentile(99):.1f}s, "
+            f"{len(rep.invariant_breaches)} breaches, final ladder "
+            f"level {rep.final_level}")
+    a, b = reports
+    pair = [round(r.pods_bound / max(r.wall_seconds, 1e-9), 1)
+            for r in reports]
+    deterministic = a.binding_log == b.binding_log
+    log(f"binding logs {'IDENTICAL' if deterministic else 'DIVERGED'} "
+        f"across the pair (sha256 {a.binding_log_sha256[:16]})")
+    print(json.dumps({
+        "metric": f"churn_bound_pods_per_sec_{sc.name}",
+        "value": pair[0],
+        "unit": "pods/s",
+        "pair": pair,
+        "pair_ratio": round(pair[1] / pair[0], 3) if pair[0] else 0.0,
+        "scenario": sc.name,
+        "seed": sc.seed,
+        "cycles": sc.cycles,
+        "ttb_p50_seconds": round(a.percentile(50), 3),
+        "ttb_p99_seconds": round(a.percentile(99), 3),
+        "ttb_slo_seconds": sc.ttb_slo_seconds,
+        "slo_met": a.percentile(99) <= sc.ttb_slo_seconds,
+        "invariant_breaches": len(a.invariant_breaches)
+        + len(b.invariant_breaches),
+        "cycle_exceptions": len(a.cycle_exceptions),
+        "degradation_transitions": len(a.ladder_transitions),
+        "pair_deterministic": deterministic,
+        "binding_log_sha256": a.binding_log_sha256,
+        "platform": jax.default_backend(),
+    }))
 
 
 def run_churn(args_cli, num_pods: int, num_nodes: int) -> None:

@@ -41,4 +41,15 @@ echo "== flight-recorder bundle schema (golden fixture) =="
 # FLIGHT_SCHEMA_VERSION bump + fixture regeneration
 python -m koordinator_tpu.obs flight tests/fixtures/flight_golden.jsonl > /dev/null
 
+echo "== koordsim seeded smoke scenario (determinism + invariants) =="
+# the fixed-seed smoke scenario through the REAL Scheduler (~50 cycles:
+# Poisson churn, a gang storm cadence, a node drain, metric flips, and a
+# dispatch-fault burst that demotes the degradation ladder to the host
+# fallback and back). --check-determinism runs it TWICE and requires a
+# byte-identical binding log; --max-breaches 0 fails the gate on ANY
+# store-level invariant breach (koordinator_tpu/sim/invariants.py). This
+# keeps the gate structural — wall-clock numbers stay in bench.py.
+JAX_PLATFORMS=cpu python -m koordinator_tpu.sim smoke \
+    --check-determinism --max-breaches 0 --quiet > /dev/null
+
 echo "lint OK"

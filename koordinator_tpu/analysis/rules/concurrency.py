@@ -23,6 +23,14 @@ Rules:
     leans on loud failure (parity tests, exactness contracts); silently
     eating BaseException-adjacent errors hides the exact bugs the rest of
     this linter exists to surface.
+  * silent-exception-swallow — the ERROR-severity version for the
+    dispatch-critical paths (scheduler/, obs/, parallel/, sim/): a broad
+    handler whose body only pass/continue/returns-a-constant, with no
+    raise, no log, no metric. The degradation ladder turned "dispatch
+    failure" into control flow there, so an unobserved swallow doesn't
+    just hide a bug — it can mask the exact signal the ladder, the
+    flight recorder, and the sim's fault plan exist to surface (the
+    koordlet device probe swallowed exactly this way for six PRs).
 """
 
 from __future__ import annotations
@@ -291,3 +299,71 @@ class ExceptSwallow(Rule):
                     ctx, node,
                     "except Exception with an empty body silently "
                     "swallows every error; log or narrow it")
+
+
+# the dispatch-critical packages where an unobserved swallow can mask the
+# very failure signal the degradation ladder / flight recorder / sim
+# fault plan are built around
+_SWALLOW_GATED_RE = re.compile(r"(^|/)(scheduler|obs|parallel|sim)/")
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad_handler(node: ast.ExceptHandler) -> bool:
+    if node.type is None:
+        return True
+    types = (node.type.elts if isinstance(node.type, ast.Tuple)
+             else [node.type])
+    for t in types:
+        name = (t.id if isinstance(t, ast.Name)
+                else t.attr if isinstance(t, ast.Attribute) else "")
+        if name in _BROAD:
+            return True
+    return False
+
+
+def _is_trivial_swallow_stmt(s: ast.stmt) -> bool:
+    """pass / continue / a bare docstring-style constant / `return` of a
+    constant or empty literal — shapes that discard the error without a
+    trace. Anything else (a call, an assignment, a raise) counts as
+    handling and is left to human review."""
+    if isinstance(s, (ast.Pass, ast.Continue)):
+        return True
+    if isinstance(s, ast.Expr) and isinstance(s.value, ast.Constant):
+        return True
+    if isinstance(s, ast.Return):
+        v = s.value
+        if v is None or isinstance(v, ast.Constant):
+            return True
+        if isinstance(v, (ast.List, ast.Dict, ast.Tuple, ast.Set)):
+            return not (getattr(v, "elts", None)
+                        or getattr(v, "keys", None))
+    return False
+
+
+@register
+class SilentExceptionSwallow(Rule):
+    name = "silent-exception-swallow"
+    severity = "error"
+    description = (
+        "in a dispatch-critical package (scheduler/, obs/, parallel/, "
+        "sim/), a bare 'except:' / 'except Exception' whose whole body "
+        "is pass/continue/return-constant — no raise, no log, no "
+        "metric: the degradation ladder, flight recorder and sim fault "
+        "plan all depend on failures being observable there; swallow "
+        "deliberately only with a pragma explaining why")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not _SWALLOW_GATED_RE.search(ctx.path):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad_handler(node):
+                continue
+            if all(_is_trivial_swallow_stmt(s) for s in node.body):
+                yield self.finding(
+                    ctx, node,
+                    "broad except handler discards the error without a "
+                    "trace in a dispatch-critical path; log it, count "
+                    "it, re-raise, or pragma the intent")

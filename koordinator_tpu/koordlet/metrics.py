@@ -307,3 +307,8 @@ QOS_CYCLE_SECONDS = REGISTRY.histogram(
 QOS_STRATEGY_RUN_TOTAL = REGISTRY.counter(
     "koordlet_qos_strategy_run_total",
     "QoS strategy executions, labeled by strategy")
+INFORMER_ERRORS_TOTAL = REGISTRY.counter(
+    "koord_koordlet_informer_errors_total",
+    "Errors swallowed inside statesinformer plugins (device probe, "
+    "kubelet pulls), labeled by informer and stage — a rising rate "
+    "means an informer is silently degraded, not healthy")

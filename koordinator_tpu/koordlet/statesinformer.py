@@ -27,6 +27,8 @@ koordlet module keeps working unchanged.
 
 from __future__ import annotations
 
+import logging
+import threading
 import time
 from dataclasses import dataclass, replace
 from typing import Callable, Dict, List, Optional
@@ -255,17 +257,42 @@ class PVCInformer(InformerPlugin):
             self._volume_name[pvc.meta.key] = pvc.volume_name
 
 
+_DEVICE_PROBE_LOGGED = set()  # log each failure stage once, count always
+_DEVICE_PROBE_LOCK = threading.Lock()  # probes run from informer threads
+
+
+def _device_probe_error(stage: str, exc: Exception) -> None:
+    """An accelerator-probe failure is an EXPECTED degradation off-TPU
+    but must never be invisible: count every occurrence
+    (koord_koordlet_informer_errors_total) and log the first per stage —
+    a silent `except Exception` here once hid real breakage behind an
+    empty device inventory."""
+    from koordinator_tpu.koordlet import metrics as koordlet_metrics
+
+    koordlet_metrics.INFORMER_ERRORS_TOTAL.inc(
+        informer="deviceInformer", stage=stage)
+    with _DEVICE_PROBE_LOCK:
+        first = stage not in _DEVICE_PROBE_LOGGED
+        _DEVICE_PROBE_LOGGED.add(stage)
+    if first:
+        logging.getLogger(__name__).warning(
+            "device probe %s failed (%s: %s); reporting no accelerators "
+            "— counted in koord_koordlet_informer_errors_total",
+            stage, type(exc).__name__, exc)
+
+
 def collect_tpu_devices() -> List[DeviceInfo]:
     """Default device collector: probe local TPU chips through JAX (the
     tpu-native stand-in for the reference's NVML walk in
     states_device_linux.go buildGPUDevice). Reported under the generic
     accelerator resource axes so DeviceShare/gpudeviceresource consume them
-    unchanged. Returns [] off-TPU."""
+    unchanged. Returns [] off-TPU (logged once + counted, never silent)."""
     try:
         import jax
 
         devices = [d for d in jax.devices() if d.platform == "tpu"]
-    except Exception:
+    except Exception as exc:
+        _device_probe_error("jax_devices", exc)
         return []
     out = []
     for d in devices:
@@ -274,7 +301,8 @@ def collect_tpu_devices() -> List[DeviceInfo]:
         if callable(stats):
             try:
                 mem = int(stats().get("bytes_limit", 0))
-            except Exception:
+            except Exception as exc:
+                _device_probe_error("memory_stats", exc)
                 mem = 0
         out.append(
             DeviceInfo(

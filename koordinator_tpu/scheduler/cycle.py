@@ -51,6 +51,15 @@ from koordinator_tpu.client.store import (
 )
 from koordinator_tpu.models.full_chain import build_best_full_chain_step
 from koordinator_tpu.obs import Tracer
+from koordinator_tpu.scheduler.degrade import (
+    LEVEL_HOST_FALLBACK,
+    LEVEL_NO_EXPLAIN,
+    LEVEL_NO_MESH,
+    LEVEL_SERIAL_WAVES,
+    DegradationLadder,
+    FusedDispatchDemoted,
+    host_fallback_schedule,
+)
 from koordinator_tpu.ops.fit import with_pod_count
 from koordinator_tpu.ops.loadaware import LoadAwareArgs
 from koordinator_tpu.scheduler.frameworkext import (
@@ -163,6 +172,17 @@ def _np_spread_fill(row: np.ndarray, req: np.ndarray, zone: int) -> None:
         remaining = remaining - take
 
 
+class _HostWriteFailure(Exception):
+    """Control flow: the deferred host work (unschedulability diagnosis +
+    condition store writes) failed INSIDE a device-dispatch window. That
+    is a store/host-side fault, not a device fault — the degradation
+    ladder must not absorb it (shedding device capability cannot fix a
+    store, and a retry would silently drop the popped deferred entries).
+    The dispatch wrappers unwrap and re-raise the original error, which
+    then propagates as an unhandled cycle exception (flight recorder
+    ``cycle_exception`` trigger), exactly as it did pre-ladder."""
+
+
 class _WaveStateMirror:
     """Host numpy replica of the fused kernel's carried node/quota state
     (models/fused_waves.py), advanced wave by wave with the read-back
@@ -256,6 +276,7 @@ class Scheduler:
         waves=None,
         explain=None,
         mesh=None,
+        ladder=None,
     ):
         from koordinator_tpu.scheduler.config import SchedulerConfiguration
         from koordinator_tpu.scheduler.plugins.reservation import (
@@ -392,6 +413,20 @@ class Scheduler:
             self.mesh = None
         scheduler_metrics.MESH_DEVICES.set(
             float(self.mesh.devices.size) if self.mesh is not None else 0.0)
+        # graceful-degradation ladder (scheduler/degrade.py): dispatch
+        # failures demote mesh -> single-device -> serial waves -> no
+        # explain -> pure-host fallback instead of killing the scheduler;
+        # clean cycles probe back up. The configured mesh is remembered
+        # so a re-promotion can restore it.
+        self._configured_mesh = self.mesh
+        self.ladder = ladder if ladder is not None else DegradationLadder()
+        self.ladder.observer = self._on_ladder_transition
+        scheduler_metrics.DEGRADED_LEVEL.set(float(self.ladder.level))
+        # sim/test failure-injection hook: a callable(stage) invoked at
+        # the top of every device-dispatch window ("serial"/"fused");
+        # raising from it exercises the ladder exactly like a real
+        # XLA/mesh fault (koordinator_tpu/sim FaultPlan arms it)
+        self.fault_injector = None
         # pipelined-cycle mode (CyclePipeline): the kernel dispatch is
         # non-blocking and diagnose/condition writes for unbound pods are
         # deferred into the NEXT cycle's kernel window so host work
@@ -693,11 +728,87 @@ class Scheduler:
         self._step_cache[key] = step
         return step
 
+    # ------------------------------------------------------------------
+    # degradation ladder (scheduler/degrade.py)
+    # ------------------------------------------------------------------
+    def _ladder_features(self) -> Dict[str, bool]:
+        """Which ladder rungs actually change behavior for this
+        scheduler's configuration — demotion and re-promotion both skip
+        rungs whose feature was never on."""
+        waves_capable = (self.waves_spec == "auto"
+                         or (isinstance(self.waves_spec, int)
+                             and self.waves_spec > 1))
+        return {
+            "mesh": self._configured_mesh is not None,
+            "waves": waves_capable and self._sidecar_client is None,
+            "explain": (self.explain_spec is not None
+                        and self._sidecar_client is None),
+        }
+
+    def _on_ladder_transition(self, record: dict) -> None:
+        """Every ladder transition is observable: gauge, loud log, the
+        effective settings re-applied, and a flight-recorder dump (the
+        preceding cycles' decision records ARE the incident context)."""
+        scheduler_metrics.DEGRADED_LEVEL.set(float(record["to_level"]))
+        log = (logger.warning if record["to_level"] > record["from_level"]
+               else logger.info)
+        log("dispatch degradation ladder: %s -> %s (%s)",
+            record["from"], record["to"], record["reason"])
+        self._apply_degraded_level()
+        self.flight.dump("degradation")
+
+    def _apply_degraded_level(self) -> None:
+        """Reconcile the mesh with the ladder level (the waves/explain
+        rungs are consulted per cycle by _effective_waves/_effective_
+        explain; the mesh owns device buffers, so it reconfigures here).
+        Idempotent and cheap when nothing changed."""
+        want_mesh = (self._configured_mesh
+                     if self.ladder.level < LEVEL_NO_MESH else None)
+        if want_mesh is self.mesh:
+            return
+        self.mesh = want_mesh
+        scheduler_metrics.MESH_DEVICES.set(
+            float(want_mesh.devices.size) if want_mesh is not None else 0.0)
+        # rebuild the device mirror for the new placement: the next
+        # upload repopulates it (one cycle of full puts, then steady-
+        # state reuse). Stats baseline resets with it so the per-cycle
+        # counter deltas never go negative.
+        if self.snapshot_cache is not None or want_mesh is not None:
+            from koordinator_tpu.scheduler.snapshot_cache import (
+                DeviceSnapshot,
+            )
+
+            self.device_snapshot = DeviceSnapshot(mesh=want_mesh)
+        else:
+            self.device_snapshot = None
+        self._upload_stats_last = {}
+
+    def _on_dispatch_failure(self, stage: str, exc: Exception) -> None:
+        """A device-dispatch attempt failed before any binding was
+        applied. Count it, consult the ladder; returns normally when a
+        retry or demotion was arranged (the caller re-runs its dispatch
+        window), re-raises when the ladder is exhausted."""
+        scheduler_metrics.DISPATCH_RETRIES.inc(stage=stage)
+        action = self.ladder.on_failure(
+            self._ladder_features(),
+            error=f"{type(exc).__name__}: {exc}")
+        if action == "exhausted":
+            raise exc
+        if action == "retry":
+            logger.warning(
+                "%s dispatch failed (%s: %s); retrying once at ladder "
+                "level %s", stage, type(exc).__name__, exc,
+                self.ladder.level_name)
+        # "demoted": the transition observer already re-applied settings
+
     def _effective_explain(self):
         """This cycle's koordexplain level. The sidecar path demotes to
         off: the RPC protocol ships only the chosen vector, so attribution
-        falls back to the legacy host recompute."""
+        falls back to the legacy host recompute. The degradation ladder's
+        no-explain rung (and below) pins it off too."""
         if self._sidecar_client is not None:
+            return None
+        if self.ladder.level >= LEVEL_NO_EXPLAIN:
             return None
         return self.explain_spec
 
@@ -715,6 +826,8 @@ class Scheduler:
         k = max(1, min(k, MAX_WAVES))
         if k == 1:
             return 1
+        if self.ladder.level >= LEVEL_SERIAL_WAVES:
+            return 1  # degradation ladder: fused dispatch demoted off
         if self._sidecar_client is not None:
             return 1  # the sidecar RPC protocol is single-round
         if pending_reservations:
@@ -745,6 +858,13 @@ class Scheduler:
         now = time.time() if now is None else now
         if self.elector is not None and not self.elector.tick(now):
             return CycleResult(skipped_not_leader=True)
+        # degradation ladder: make sure the effective settings match the
+        # current rung (a promotion at the end of the previous cycle
+        # reconfigures here). The retry budget is armed per dispatch
+        # window, not per cycle — a cycle can open several (initial pass,
+        # preemption retry, the serial re-run after a fused demotion) and
+        # each is promised its own retry-once before demoting.
+        self._apply_degraded_level()
         result = CycleResult()
         carried_deferred = bool(self._deferred_diagnose)
         self._flushed_this_cycle = False
@@ -789,6 +909,10 @@ class Scheduler:
             scheduler_metrics.PODS_BOUND_TOTAL.inc(len(result.bound))
         self.extender.monitor.record(result)
         self._finish_cycle_obs(result, now, root, flight_base)
+        # a completed cycle feeds the ladder's clean-cycle counter (a
+        # cycle that needed retries/demotions does not count as clean);
+        # enough clean cycles probe one rung back up
+        self.ladder.note_cycle()
         return result
 
     # ------------------------------------------------------------------
@@ -889,18 +1013,23 @@ class Scheduler:
 
     def health_snapshot(self) -> Dict[str, object]:
         """The ObsServer /healthz payload: last-completed-cycle age + wave
-        count — a stale-cycle liveness signal instead of a bare 200."""
+        count — a stale-cycle liveness signal instead of a bare 200 —
+        plus the degradation-ladder state: a scheduler surviving at a
+        demoted rung must not look identical to a healthy one on its
+        liveness probe."""
         with self._explain_lock:
             last = self._last_cycle_end
             cycles = self._cycle_counter
+        degraded = self.ladder.snapshot()
         if last is None:
-            return {"status": "ok", "cycles": 0}
+            return {"status": "ok", "cycles": 0, "degraded": degraded}
         end_wall, waves = last
         return {
             "status": "ok",
             "cycles": cycles,
             "last_cycle_age_seconds": max(0.0, time.time() - end_wall),
             "last_cycle_waves": waves,
+            "degraded": degraded,
         }
 
     def explain_record(self, pod_key: str) -> Optional[dict]:
@@ -994,14 +1123,21 @@ class Scheduler:
         k_waves = self._effective_waves(pending, pending_reservations,
                                         waves_override)
         if k_waves > 1:
-            # _fused_wave_cycles refreshes pod-group status at the end of
-            # every logical cycle — no trailing refresh here, or a fused
-            # K-cycle would walk the groups K+1 times where K serial
-            # cycles walk them K times
-            self._fused_wave_cycles(pending, now, ctx, result,
-                                    pending_reservations, originals,
-                                    k_waves)
-            return
+            try:
+                # _fused_wave_cycles refreshes pod-group status at the end
+                # of every logical cycle — no trailing refresh here, or a
+                # fused K-cycle would walk the groups K+1 times where K
+                # serial cycles walk them K times
+                self._fused_wave_cycles(pending, now, ctx, result,
+                                        pending_reservations, originals,
+                                        k_waves)
+                return
+            except FusedDispatchDemoted:
+                # the fused dispatch window failed before ANY binding was
+                # applied and the ladder demoted below fused waves: fall
+                # through and run this same pass through the serial path
+                # at the demoted settings
+                pass
 
         # ---- batched kernel pass
         rejected_pods, failed_pods = self._batch_pass(
@@ -1199,6 +1335,15 @@ class Scheduler:
                     messages[pod.meta.key] = msg
             self._cycle_attrib[pod.meta.key] = entry
         return messages or None
+
+    def _flush_deferred_in_window(self) -> None:
+        """flush_deferred from inside a ladder-wrapped dispatch window:
+        tag host/store-side failures so the ladder's except does not
+        mistake them for device failures (see _HostWriteFailure)."""
+        try:
+            self.flush_deferred()
+        except Exception as exc:
+            raise _HostWriteFailure() from exc
 
     def flush_deferred(self) -> None:
         """Drain deferred diagnose/condition work (pipeline mode). Runs in
@@ -1438,93 +1583,12 @@ class Scheduler:
         if enc is None:
             return rejected_pods, [(p, "no schedulable node") for p in pending]
         fc, pods, nodes, ng, ngroups, active = enc
-        explain = self._effective_explain()
-        step = self._get_step(
-            (pods.padded_size, nodes.padded_size, fc.quota_runtime.shape[0]),
-            ng, ngroups, active, explain=explain,
-        )
-        ex_out = None
-        with self.tracer.span(
-                "kernel",
-                compiled="1" if self._last_step_compiled else "0") as ksp:
-            if self._sidecar_client is not None:
-                from koordinator_tpu.scheduler.sidecar import (
-                    schedule_batch_or_fallback,
-                )
-
-                chosen, _, _, used_fallback = schedule_batch_or_fallback(
-                    self._sidecar_client, fc, ng, ngroups, self.args,
-                    active_axes=active, local_step=step,
-                )
-                if used_fallback:
-                    self.sidecar_fallbacks += 1
-                # remote RPC: the call blocked already; asarray is a no-op
-                # copy of host data, not a device sync
-                # koordlint: disable=blocking-readback-in-pipeline
-                chosen = np.asarray(chosen)
-            else:
-                if self.device_snapshot is not None:
-                    # device-resident steady state: unchanged fields reuse
-                    # the previous cycle's device buffers, small node-row
-                    # deltas go up as donated scatters
-                    # (snapshot_cache.DeviceSnapshot)
-                    fc = self.device_snapshot.upload(fc)
-                    self._record_upload_deltas()
-                    self.device_snapshot.begin_dispatch()
-                t_dispatch = time.perf_counter()
-                n_shape = (len(nodes.names),
-                           int(np.shape(fc.base.allocatable)[0]))
-                try:
-                    if explain is not None:
-                        # same dispatch, extra attribution outputs; n_real
-                        # masks padded node rows out of the stage counts
-                        chosen, _, _, ex_out = step(
-                            fc, np.int32(len(nodes.names)))
-                    else:
-                        chosen, _, _ = step(fc)  # async dispatch — no sync
-                    if self.pipeline_mode:
-                        # overlap window: the previous cycle's deferred
-                        # host work (unschedulability diagnosis +
-                        # condition writes) runs while the device
-                        # executes this cycle's kernel
-                        self.flush_deferred()
-                        with self.tracer.span("overlap_wait"):
-                            # the pipeline's single designated sync point:
-                            # bind needs the chosen vector, nothing
-                            # before does
-                            chosen, = self._readback_sync(n_shape, chosen)
-                    else:
-                        # serial path: block immediately (the pre-pipeline
-                        # behavior, and the KOORD_TPU_PIPELINE=0 fallback)
-                        chosen, = self._readback_sync(n_shape, chosen)
-                finally:
-                    if self.device_snapshot is not None:
-                        self.device_snapshot.end_dispatch()
-                result.device_busy_seconds += (
-                    time.perf_counter() - t_dispatch)
-                # local dispatch only: a sidecar-served batch arrived
-                # over RPC — counting it as device readback would poison
-                # the readback-regression signal
-                scheduler_metrics.WAVES_PER_DISPATCH.observe(1.0)
-                scheduler_metrics.READBACK_BYTES.inc(int(chosen.nbytes))
-                if ex_out is not None:
-                    # the program completed at the chosen sync above;
-                    # these are materialized outputs, not fresh syncs
-                    # koordlint: disable=blocking-readback-in-pipeline
-                    explain_counts = np.asarray(ex_out.stage_counts)
-                    ex_bytes = explain_counts.nbytes
-                    if ex_out.terms is not None:
-                        # koordlint: disable=blocking-readback-in-pipeline
-                        terms_np = np.asarray(ex_out.terms)
-                        ex_bytes += terms_np.nbytes
-                        # chosen is already host-side (synced above)
-                        self._stash_terms(pods.keys, chosen >= 0, terms_np)
-                    scheduler_metrics.EXPLAIN_READBACK_BYTES.inc(
-                        int(ex_bytes))
-                    fc_lb, idx_lb, n_lb, _ = self._last_batch
-                    self._last_batch = (fc_lb, idx_lb, n_lb, explain_counts)
-        result.kernel_seconds += ksp.duration_seconds
-        scheduler_metrics.KERNEL_SECONDS.observe(ksp.duration_seconds)
+        if self._sidecar_client is not None:
+            chosen = self._dispatch_sidecar(fc, pods, nodes, ng, ngroups,
+                                            active, result)
+        else:
+            chosen = self._dispatch_serial(fc, pods, nodes, ng, ngroups,
+                                           active, result)
 
         # apply bindings in queue order
         with self.tracer.span("bind") as bsp:
@@ -1555,6 +1619,161 @@ class Scheduler:
                     failed_pods.append((pod, err))
             bsp.attributes["bound"] = str(len(result.bound) - bound_before)
         return rejected_pods, failed_pods
+
+    # ------------------------------------------------------------------
+    def _dispatch_sidecar(self, fc, pods, nodes, ng, ngroups, active,
+                          result: CycleResult) -> np.ndarray:
+        """Sidecar-served batch pass: the RPC layer owns its own
+        degradation (transport failure falls back to the in-process
+        step), so the ladder does not wrap this path."""
+        step = self._get_step(
+            (pods.padded_size, nodes.padded_size, fc.quota_runtime.shape[0]),
+            ng, ngroups, active, explain=None,
+        )
+        with self.tracer.span(
+                "kernel",
+                compiled="1" if self._last_step_compiled else "0") as ksp:
+            from koordinator_tpu.scheduler.sidecar import (
+                schedule_batch_or_fallback,
+            )
+
+            chosen, _, _, used_fallback = schedule_batch_or_fallback(
+                self._sidecar_client, fc, ng, ngroups, self.args,
+                active_axes=active, local_step=step,
+            )
+            if used_fallback:
+                self.sidecar_fallbacks += 1
+            # remote RPC: the call blocked already; asarray is a no-op
+            # copy of host data, not a device sync
+            # koordlint: disable=blocking-readback-in-pipeline
+            chosen = np.asarray(chosen)
+        result.kernel_seconds += ksp.duration_seconds
+        scheduler_metrics.KERNEL_SECONDS.observe(ksp.duration_seconds)
+        return chosen
+
+    def _dispatch_serial(self, fc_host, pods, nodes, ng, ngroups, active,
+                         result: CycleResult) -> np.ndarray:
+        """The single-round device-dispatch window, wrapped in the
+        degradation ladder: a failure anywhere between step construction
+        and readback (strictly before any binding) retries once, then
+        demotes — mesh off, explain off, finally the pure-host pass —
+        instead of killing the scheduler. ``fc_host`` keeps the pre-
+        upload host arrays so every retry re-uploads from scratch
+        against the (possibly rebuilt) device snapshot."""
+        self.ladder.begin_pass()
+        while True:
+            if self.ladder.level >= LEVEL_HOST_FALLBACK:
+                return self._dispatch_host_fallback(fc_host, pods, nodes,
+                                                    result)
+            explain = self._effective_explain()
+            ex_out = None
+            try:
+                step = self._get_step(
+                    (pods.padded_size, nodes.padded_size,
+                     fc_host.quota_runtime.shape[0]),
+                    ng, ngroups, active, explain=explain,
+                )
+                with self.tracer.span(
+                        "kernel",
+                        compiled="1" if self._last_step_compiled
+                        else "0") as ksp:
+                    fc = fc_host
+                    if self.device_snapshot is not None:
+                        # device-resident steady state: unchanged fields
+                        # reuse the previous cycle's device buffers, small
+                        # node-row deltas go up as donated scatters
+                        # (snapshot_cache.DeviceSnapshot)
+                        fc = self.device_snapshot.upload(fc)
+                        self._record_upload_deltas()
+                        self.device_snapshot.begin_dispatch()
+                    t_dispatch = time.perf_counter()
+                    n_shape = (len(nodes.names),
+                               int(np.shape(fc.base.allocatable)[0]))
+                    try:
+                        if self.fault_injector is not None:
+                            self.fault_injector("serial")
+                        if explain is not None:
+                            # same dispatch, extra attribution outputs;
+                            # n_real masks padded node rows out of the
+                            # stage counts
+                            chosen, _, _, ex_out = step(
+                                fc, np.int32(len(nodes.names)))
+                        else:
+                            chosen, _, _ = step(fc)  # async — no sync
+                        if self.pipeline_mode:
+                            # overlap window: the previous cycle's
+                            # deferred host work (unschedulability
+                            # diagnosis + condition writes) runs while
+                            # the device executes this cycle's kernel
+                            self._flush_deferred_in_window()
+                            with self.tracer.span("overlap_wait"):
+                                # the pipeline's single designated sync
+                                # point: bind needs the chosen vector,
+                                # nothing before does
+                                chosen, = self._readback_sync(
+                                    n_shape, chosen)
+                        else:
+                            # serial path: block immediately (the pre-
+                            # pipeline behavior, and the
+                            # KOORD_TPU_PIPELINE=0 fallback)
+                            chosen, = self._readback_sync(n_shape, chosen)
+                    finally:
+                        if self.device_snapshot is not None:
+                            self.device_snapshot.end_dispatch()
+                    result.device_busy_seconds += (
+                        time.perf_counter() - t_dispatch)
+                    # local dispatch only: a sidecar-served batch arrived
+                    # over RPC — counting it as device readback would
+                    # poison the readback-regression signal
+                    scheduler_metrics.WAVES_PER_DISPATCH.observe(1.0)
+                    scheduler_metrics.READBACK_BYTES.inc(int(chosen.nbytes))
+                    if ex_out is not None:
+                        # the program completed at the chosen sync above;
+                        # these are materialized outputs, not fresh syncs
+                        # koordlint: disable=blocking-readback-in-pipeline
+                        explain_counts = np.asarray(ex_out.stage_counts)
+                        ex_bytes = explain_counts.nbytes
+                        if ex_out.terms is not None:
+                            # koordlint: disable=blocking-readback-in-pipeline
+                            terms_np = np.asarray(ex_out.terms)
+                            ex_bytes += terms_np.nbytes
+                            # chosen is already host-side (synced above)
+                            self._stash_terms(pods.keys, chosen >= 0,
+                                              terms_np)
+                        scheduler_metrics.EXPLAIN_READBACK_BYTES.inc(
+                            int(ex_bytes))
+                        fc_lb, idx_lb, n_lb, _ = self._last_batch
+                        self._last_batch = (fc_lb, idx_lb, n_lb,
+                                            explain_counts)
+                result.kernel_seconds += ksp.duration_seconds
+                scheduler_metrics.KERNEL_SECONDS.observe(
+                    ksp.duration_seconds)
+                return chosen
+            except _HostWriteFailure as hw:
+                # deferred store writes died, not the device: the ladder
+                # must not absorb this — re-raise the original error as
+                # an unhandled cycle exception
+                raise hw.__cause__
+            except Exception as exc:
+                # retry or demote (settings re-applied by the transition
+                # observer); re-raises when the ladder is exhausted
+                self._on_dispatch_failure("serial", exc)
+
+    def _dispatch_host_fallback(self, fc_host, pods, nodes,
+                                result: CycleResult) -> np.ndarray:
+        """The ladder's bottom rung: no device dispatch at all — a
+        pure-host numpy scheduling pass over the diagnose oracle
+        (scheduler/degrade.host_fallback_schedule). A failure here has
+        no deeper rung to absorb it and propagates as an unhandled cycle
+        exception (flight recorder ``cycle_exception`` trigger).
+        ``_last_batch`` keeps the host arrays, so unschedulability
+        diagnosis runs through the legacy host recompute unchanged."""
+        with self.tracer.span("kernel", host_fallback="1") as ksp:
+            chosen = host_fallback_schedule(fc_host, pods,
+                                            len(nodes.names))
+        result.kernel_seconds += ksp.duration_seconds
+        scheduler_metrics.KERNEL_SECONDS.observe(ksp.duration_seconds)
+        return chosen
 
     # ------------------------------------------------------------------
     def _fused_wave_cycles(
@@ -1639,79 +1858,116 @@ class Scheduler:
             np.take(ex["la_est_nonprod"], axis_idx, axis=-1))
         la_adj = np.ascontiguousarray(
             np.take(ex["la_adj_nonprod"], axis_idx, axis=-1))
-        explain = self._effective_explain()
-        step = self._get_fused_step(
-            (pods.padded_size, nodes.padded_size,
-             fc.quota_runtime.shape[0]),
-            ng, ngroups, active, k_waves, explain=explain,
-        )
-        ex_out = None
-        with self.tracer.span(
-                "kernel",
-                compiled="1" if self._last_step_compiled else "0",
-                waves=str(k_waves)) as ksp:
-            if self.device_snapshot is not None:
-                fc = self.device_snapshot.upload(fc)
-                sides = self.device_snapshot.upload_fields(
-                    {"la_est_nonprod": la_est, "la_adj_nonprod": la_adj})
-                la_est = sides["la_est_nonprod"]
-                la_adj = sides["la_adj_nonprod"]
-                self._record_upload_deltas()
-                self.device_snapshot.begin_dispatch()
-            t_dispatch = time.perf_counter()
-            n_shape = (len(nodes.names),
-                       int(np.shape(fc.base.allocatable)[0]))
+        # ---- the fused dispatch window, wrapped in the degradation
+        # ladder: a failure between step construction and readback
+        # (strictly before any binding is replayed) retries once, then
+        # demotes — a demotion below fused waves raises
+        # FusedDispatchDemoted and the cycle driver re-runs this pass
+        # through the serial path. `fc_host`/`la_est`/`la_adj` hold the
+        # host arrays, so a retry after a mesh demotion re-uploads from
+        # scratch against the rebuilt device snapshot.
+        self.ladder.begin_pass()
+        while True:
+            explain = self._effective_explain()
+            ex_out = None
             try:
-                if explain is not None:
-                    out, ex_out = step(fc, la_est, la_adj,
-                                       np.int32(len(nodes.names)))
-                else:
-                    out = step(fc, la_est, la_adj)  # async dispatch
-                compacted = (out.bind_pods, out.bind_nodes, out.bind_zones,
-                             out.wave_counts)
-                if self.pipeline_mode:
-                    self.flush_deferred()
-                    with self.tracer.span("overlap_wait"):
-                        # the single designated sync point: the first
-                        # readback blocks until the whole fused program
-                        # (all K waves) finished; the compacted buffers
-                        # merge together (mesh mode reads them from the
-                        # per-shard replicas in one pass)
-                        bind_pods, bind_nodes, bind_zones, wave_counts = (
-                            self._readback_sync(n_shape, *compacted))
-                else:
-                    bind_pods, bind_nodes, bind_zones, wave_counts = (
-                        self._readback_sync(n_shape, *compacted))
-                waves_run = int(out.waves_run)
-            finally:
-                if self.device_snapshot is not None:
-                    self.device_snapshot.end_dispatch()
-            result.device_busy_seconds += time.perf_counter() - t_dispatch
-            scheduler_metrics.WAVES_PER_DISPATCH.observe(float(waves_run))
-            scheduler_metrics.READBACK_BYTES.inc(
-                int(bind_pods.nbytes + bind_nodes.nbytes
-                    + bind_zones.nbytes + wave_counts.nbytes + 4))
-            explain_counts = None
-            if ex_out is not None:
-                # program complete at the bind_pods sync: materialized
-                # outputs, not fresh syncs
-                # koordlint: disable=blocking-readback-in-pipeline
-                explain_counts = np.asarray(ex_out.stage_counts)
-                ex_bytes = explain_counts.nbytes
-                if ex_out.terms is not None:
-                    # koordlint: disable=blocking-readback-in-pipeline
-                    terms_np = np.asarray(ex_out.terms)
-                    ex_bytes += terms_np.nbytes
-                    kept_mask = np.zeros(len(pods.keys), bool)
-                    kept_mask[bind_pods[bind_pods >= 0]] = True
-                    self._stash_terms(pods.keys, kept_mask, terms_np)
-                scheduler_metrics.EXPLAIN_READBACK_BYTES.inc(int(ex_bytes))
-            for w in range(waves_run):
-                # retrospective per-wave markers under the kernel span:
-                # how the dispatch's work split across the fused rounds
-                with self.tracer.span("wave", index=str(w),
-                                      bound=str(int(wave_counts[w]))):
-                    pass
+                step = self._get_fused_step(
+                    (pods.padded_size, nodes.padded_size,
+                     fc_host.quota_runtime.shape[0]),
+                    ng, ngroups, active, k_waves, explain=explain,
+                )
+                with self.tracer.span(
+                        "kernel",
+                        compiled="1" if self._last_step_compiled else "0",
+                        waves=str(k_waves)) as ksp:
+                    fc = fc_host
+                    la_est_d, la_adj_d = la_est, la_adj
+                    if self.device_snapshot is not None:
+                        fc = self.device_snapshot.upload(fc)
+                        sides = self.device_snapshot.upload_fields(
+                            {"la_est_nonprod": la_est,
+                             "la_adj_nonprod": la_adj})
+                        la_est_d = sides["la_est_nonprod"]
+                        la_adj_d = sides["la_adj_nonprod"]
+                        self._record_upload_deltas()
+                        self.device_snapshot.begin_dispatch()
+                    t_dispatch = time.perf_counter()
+                    n_shape = (len(nodes.names),
+                               int(np.shape(fc.base.allocatable)[0]))
+                    try:
+                        if self.fault_injector is not None:
+                            self.fault_injector("fused")
+                        if explain is not None:
+                            out, ex_out = step(fc, la_est_d, la_adj_d,
+                                               np.int32(len(nodes.names)))
+                        else:
+                            out = step(fc, la_est_d, la_adj_d)  # async
+                        compacted = (out.bind_pods, out.bind_nodes,
+                                     out.bind_zones, out.wave_counts)
+                        if self.pipeline_mode:
+                            self._flush_deferred_in_window()
+                            with self.tracer.span("overlap_wait"):
+                                # the single designated sync point: the
+                                # first readback blocks until the whole
+                                # fused program (all K waves) finished;
+                                # the compacted buffers merge together
+                                # (mesh mode reads them from the
+                                # per-shard replicas in one pass)
+                                (bind_pods, bind_nodes, bind_zones,
+                                 wave_counts) = self._readback_sync(
+                                     n_shape, *compacted)
+                        else:
+                            (bind_pods, bind_nodes, bind_zones,
+                             wave_counts) = self._readback_sync(
+                                 n_shape, *compacted)
+                        waves_run = int(out.waves_run)
+                    finally:
+                        if self.device_snapshot is not None:
+                            self.device_snapshot.end_dispatch()
+                    result.device_busy_seconds += (
+                        time.perf_counter() - t_dispatch)
+                    scheduler_metrics.WAVES_PER_DISPATCH.observe(
+                        float(waves_run))
+                    scheduler_metrics.READBACK_BYTES.inc(
+                        int(bind_pods.nbytes + bind_nodes.nbytes
+                            + bind_zones.nbytes + wave_counts.nbytes + 4))
+                    explain_counts = None
+                    if ex_out is not None:
+                        # program complete at the bind_pods sync:
+                        # materialized outputs, not fresh syncs
+                        # koordlint: disable=blocking-readback-in-pipeline
+                        explain_counts = np.asarray(ex_out.stage_counts)
+                        ex_bytes = explain_counts.nbytes
+                        if ex_out.terms is not None:
+                            # koordlint: disable=blocking-readback-in-pipeline
+                            terms_np = np.asarray(ex_out.terms)
+                            ex_bytes += terms_np.nbytes
+                            kept_mask = np.zeros(len(pods.keys), bool)
+                            kept_mask[bind_pods[bind_pods >= 0]] = True
+                            self._stash_terms(pods.keys, kept_mask,
+                                              terms_np)
+                        scheduler_metrics.EXPLAIN_READBACK_BYTES.inc(
+                            int(ex_bytes))
+                    for w in range(waves_run):
+                        # retrospective per-wave markers under the kernel
+                        # span: how the dispatch's work split across the
+                        # fused rounds
+                        with self.tracer.span(
+                                "wave", index=str(w),
+                                bound=str(int(wave_counts[w]))):
+                            pass
+                break
+            except _HostWriteFailure as hw:
+                # deferred store writes died, not the device: the ladder
+                # must not absorb this — re-raise the original error as
+                # an unhandled cycle exception
+                raise hw.__cause__
+            except Exception as exc:
+                self._on_dispatch_failure("fused", exc)
+                if self.ladder.level >= LEVEL_SERIAL_WAVES:
+                    # demoted below fused waves: no binding was applied,
+                    # the cycle driver re-runs this pass serially
+                    raise FusedDispatchDemoted() from exc
         result.kernel_seconds += ksp.duration_seconds
         scheduler_metrics.KERNEL_SECONDS.observe(ksp.duration_seconds)
 

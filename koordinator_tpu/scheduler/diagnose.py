@@ -102,14 +102,12 @@ def shared_state(fc, num_nodes: int) -> dict:
     }
 
 
-def host_stage_counts(fc, i: int, num_nodes: int,
-                      shared: dict = None) -> np.ndarray:
-    """[NUM_EXPLAIN_STAGES] uint32 for pod row ``i`` of FullChainInputs
-    ``fc``: per-stage rejected-node counts over the first ``num_nodes``
-    real (unpadded) nodes plus the gang/quota PreFilter verdict flags —
-    the host-numpy oracle the kernel's on-device attribution is diffed
-    against. Pass ``shared`` (shared_state) when diagnosing many pods of
-    one batch."""
+def _stage_verdicts(fc, i: int, num_nodes: int, shared: dict = None):
+    """The per-stage verdicts behind one pod's diagnosis: a pod-level
+    PreFilter flag pair (gang invalid, quota exhausted) plus the per-node
+    reject masks keyed by EXPLAIN_STAGES label. Shared by the counts
+    oracle (host_stage_counts) and the feasibility view
+    (host_feasible_mask) so the two can never drift."""
     inputs = fc.base
     n = num_nodes
     if shared is None:
@@ -119,12 +117,13 @@ def host_stage_counts(fc, i: int, num_nodes: int,
     node_ok = shared["node_ok"]
     fit_req = np.asarray(inputs.fit_requests, np.float32)[i]
     raw_req = np.asarray(fc.requests, np.float32)[i]
-    counts = np.zeros(NUM_EXPLAIN_STAGES, np.uint32)
 
     # ---- PreFilter stage (pod-level verdict flags; no node breakdown)
+    gang_bad = False
+    quota_bad = False
     gang_id = int(np.asarray(fc.gang_id)[i])
     if gang_id >= 0 and not bool(np.asarray(fc.gang_valid)[gang_id]):
-        counts[EXPLAIN_STAGE_GANG] = 1
+        gang_bad = True
     qid = int(np.asarray(fc.quota_id)[i])
     if qid >= 0:
         used = np.asarray(fc.quota_used, np.float32)
@@ -135,7 +134,7 @@ def host_stage_counts(fc, i: int, num_nodes: int,
                 continue
             bad = (raw_req > 0) & (used[g] + raw_req > runtime[g])
             if bad.any():
-                counts[EXPLAIN_STAGE_QUOTA] = 1
+                quota_bad = True
                 break
 
     # ---- Filter stages, counted per node
@@ -226,11 +225,47 @@ def host_stage_counts(fc, i: int, num_nodes: int,
                              & (count[:, t] + self_m - min_count <= skew))
         reasons["affinity/anti-affinity/spread mismatch"] = aff_bad
 
+    return gang_bad, quota_bad, reasons
+
+
+def host_stage_counts(fc, i: int, num_nodes: int,
+                      shared: dict = None) -> np.ndarray:
+    """[NUM_EXPLAIN_STAGES] uint32 for pod row ``i`` of FullChainInputs
+    ``fc``: per-stage rejected-node counts over the first ``num_nodes``
+    real (unpadded) nodes plus the gang/quota PreFilter verdict flags —
+    the host-numpy oracle the kernel's on-device attribution is diffed
+    against. Pass ``shared`` (shared_state) when diagnosing many pods of
+    one batch."""
+    gang_bad, quota_bad, reasons = _stage_verdicts(fc, i, num_nodes,
+                                                  shared=shared)
+    counts = np.zeros(NUM_EXPLAIN_STAGES, np.uint32)
+    if gang_bad:
+        counts[EXPLAIN_STAGE_GANG] = 1
+    if quota_bad:
+        counts[EXPLAIN_STAGE_QUOTA] = 1
     for s, label in enumerate(EXPLAIN_STAGES):
         bad = reasons.get(label)
         if bad is not None:
             counts[s] = _count(bad)
     return counts
+
+
+def host_feasible_mask(fc, i: int, num_nodes: int,
+                       shared: dict = None) -> np.ndarray:
+    """bool[num_nodes]: the nodes on which pod row ``i`` passes every
+    modeled PreFilter/Filter predicate at ``fc``'s state — the
+    complement union of the same per-stage verdicts the counts oracle
+    reports. The degradation ladder's host-fallback pass
+    (scheduler/degrade.host_fallback_schedule) schedules against this
+    view when the device dispatch is down."""
+    gang_bad, quota_bad, reasons = _stage_verdicts(fc, i, num_nodes,
+                                                  shared=shared)
+    if gang_bad or quota_bad:
+        return np.zeros(num_nodes, bool)
+    feasible = np.ones(num_nodes, bool)
+    for bad in reasons.values():
+        feasible &= ~np.asarray(bad, bool)
+    return feasible
 
 
 def diagnose_unbound(fc, i: int, num_nodes: int,

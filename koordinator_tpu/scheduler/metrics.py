@@ -132,10 +132,26 @@ EXPLAIN_READBACK_BYTES = REGISTRY.counter(
     "Bytes of koordexplain attribution read back from the device",
 )
 # cycle flight recorder (obs/flight.py): every bundle dump, labeled by the
-# trigger (deadline_overrun | cycle_exception | parity_mismatch | http)
+# trigger (deadline_overrun | cycle_exception | parity_mismatch |
+# degradation | invariant_breach | slo_overrun | http)
 FLIGHT_DUMPS = REGISTRY.counter(
     "koord_flight_recorder_dumps_total",
     "Flight-recorder bundle dumps, labeled by trigger reason",
+)
+
+# dispatch degradation ladder (scheduler/degrade.py): the current rung
+# (0=full, 1=no-mesh, 2=serial-waves, 3=no-explain, 4=host-fallback) and
+# every failed dispatch attempt the ladder absorbed instead of letting it
+# kill the scheduler, labeled by the dispatch stage that failed
+DEGRADED_LEVEL = REGISTRY.gauge(
+    "koord_scheduler_degraded_level",
+    "Dispatch degradation-ladder level "
+    "(0=full 1=no-mesh 2=serial-waves 3=no-explain 4=host-fallback)",
+)
+DISPATCH_RETRIES = REGISTRY.counter(
+    "koord_scheduler_dispatch_retries_total",
+    "Failed device-dispatch attempts absorbed by the degradation "
+    "ladder, labeled by stage",
 )
 
 # mesh-backed dispatch (KOORD_TPU_MESH, parallel/mesh.py): how many

@@ -47,6 +47,66 @@ def is_pod_non_preemptible(pod: Pod) -> bool:
     return pod.meta.labels.get(LABEL_PREEMPTIBLE, "") == "false"
 
 
+class GangVictimGuard:
+    """Gang all-or-nothing vs preemption.
+
+    Evicting a bound gang member below its PodGroup's min_member
+    silently breaks the barrier the admission kernel enforced at bind
+    time — the koordsim churn soak caught DefaultPreemption doing
+    exactly this to priority-less gang pods (the upstream vendored
+    DefaultPreemption has the same hole; the coscheduling plugin only
+    protects gangs BEFORE they bind). One guard instance spans a whole
+    post_filter call, so victim sets chosen for different preemptors
+    share one spare-member ledger:
+
+      * ``protected(pod)`` — the pod's gang has no spare bound members:
+        never a candidate;
+      * ``admissible(victims)`` — would this victim set overdraw any
+        gang's spare count? (two same-gang victims can each look fine
+        alone);
+      * ``commit(victims)`` — debit the ledger once a round is taken.
+
+    Gangs whose bound count already sits below min_member (external
+    lifecycle churn) have no spare either — preemption never makes a
+    broken gang worse."""
+
+    def __init__(self, store: ObjectStore, live=None) -> None:
+        """``live``: an already-built list of assigned, non-terminated
+        pods — callers that just walked the store (post_filter) pass it
+        to avoid a second full O(|pods|) scan on the hot path."""
+        from koordinator_tpu.client.store import KIND_POD_GROUP
+
+        mins = {g.meta.key: g.min_member
+                for g in store.list(KIND_POD_GROUP)}
+        if live is None:
+            live = (p for p in store.list(KIND_POD)
+                    if p.is_assigned and not p.is_terminated)
+        bound: dict = {}
+        for p in live:
+            g = p.gang_key
+            if g and g in mins:
+                bound[g] = bound.get(g, 0) + 1
+        self._spare = {g: bound[g] - mins[g] for g in bound}
+
+    def protected(self, pod: Pod) -> bool:
+        g = pod.gang_key
+        return g in self._spare and self._spare[g] <= 0
+
+    def admissible(self, victims) -> bool:
+        taken: dict = {}
+        for v in victims:
+            g = v.gang_key
+            if g in self._spare:
+                taken[g] = taken.get(g, 0) + 1
+        return all(self._spare[g] >= n for g, n in taken.items())
+
+    def commit(self, victims) -> None:
+        for v in victims:
+            g = v.gang_key
+            if g in self._spare:
+                self._spare[g] -= 1
+
+
 @dataclass
 class PreemptionRound:
     """Outcome of one preemptor's PostFilter attempt."""
@@ -76,9 +136,12 @@ class QuotaPreemptor:
                 index.setdefault(q, []).append(p)
         return index
 
-    def _candidates(self, preemptor: Pod, quota_index: dict) -> List[Pod]:
+    def _candidates(self, preemptor: Pod, quota_index: dict,
+                    gang_guard: Optional["GangVictimGuard"] = None,
+                    ) -> List[Pod]:
         """canPreempt filter: live assigned members of the preemptor's quota
-        group with strictly lower priority, not marked non-preemptible."""
+        group with strictly lower priority, not marked non-preemptible, and
+        not protected by their gang's min_member (GangVictimGuard)."""
         pri = preemptor.spec.priority or 0
         return [
             p
@@ -86,6 +149,7 @@ class QuotaPreemptor:
             if not p.is_terminated
             and (p.spec.priority or 0) < pri
             and not is_pod_non_preemptible(p)
+            and not (gang_guard is not None and gang_guard.protected(p))
         ]
 
     @staticmethod
@@ -115,13 +179,15 @@ class QuotaPreemptor:
         used: np.ndarray,     # [G, R] incl. inflight nominations
         runtime: np.ndarray,  # [G, R]
         quota_index: Optional[dict] = None,
+        gang_guard: Optional["GangVictimGuard"] = None,
     ) -> Optional[List[Pod]]:
         """Minimal victim set freeing enough quota, or None if preemption
         cannot help (no candidates / still over limit with all of them gone —
         preempt.go:149-163)."""
         candidates = self._candidates(
             preemptor,
-            quota_index if quota_index is not None else self._quota_index())
+            quota_index if quota_index is not None else self._quota_index(),
+            gang_guard=gang_guard)
         if not candidates:
             return None
         freed_all = np.zeros(req.shape, np.float32)
@@ -145,6 +211,12 @@ class QuotaPreemptor:
                 freed = without
             else:
                 victims.append(c)
+        if victims and gang_guard is not None and (
+                not gang_guard.admissible(victims)):
+            # the minimal set needs more same-gang victims than the gang
+            # has spare bound members: preemption cannot help without
+            # breaking all-or-nothing — leave the gang whole
+            return None
         return victims or None
 
     def _split_by_pdb(self, ordered: List[Pod]):
@@ -183,6 +255,7 @@ class QuotaPreemptor:
             return extra
 
         quota_index = self._quota_index()
+        gang_guard = GangVictimGuard(self.store)
         for pod in rejected:
             gid = tree.index.get(pod.quota_name)
             if gid is None:
@@ -200,10 +273,12 @@ class QuotaPreemptor:
                     inflight.append((pod.quota_name, req))
                 continue
             victims = self._select_victims(pod, req, chain, used, runtime,
-                                           quota_index=quota_index)
+                                           quota_index=quota_index,
+                                           gang_guard=gang_guard)
             if not victims:
                 continue
             rounds.append(evict_round(self.store, pod, victims))
+            gang_guard.commit(victims)
             inflight.append((pod.quota_name, req))
             # evictions changed store-backed used (and group request):
             # rebuild the snapshot AND the candidate index
@@ -395,6 +470,7 @@ class DefaultPreemption:
             by_node.setdefault(p.spec.node_name, []).append(p)
             req_of[p.meta.key] = p.spec.requests.to_vector()
         pdbs, budgets = pdb_disruption_budgets(self.store)
+        gang_guard = GangVictimGuard(self.store, live=live)
         evicted: set = set()
         inflight: Dict[str, np.ndarray] = {}  # node -> earlier preemptors' req
 
@@ -430,7 +506,8 @@ class DefaultPreemption:
                 np.sum([req_of[p.meta.key] for p in assigned], axis=0)
                 if assigned else 0.0)
             cands = sorted(
-                (p for p in assigned if not is_pod_non_preemptible(p)),
+                (p for p in assigned if not is_pod_non_preemptible(p)
+                 and not gang_guard.protected(p)),
                 key=lambda p: p.spec.priority or 0)
             node_prios[j] = np.asarray(
                 [p.spec.priority or 0 for p in cands], np.int64)
@@ -576,6 +653,7 @@ class DefaultPreemption:
                     p for p in assigned
                     if (p.spec.priority or 0) < prio
                     and not is_pod_non_preemptible(p)
+                    and not gang_guard.protected(p)
                 ]
                 gain = sum((req_of[p.meta.key] for p in candidates),
                            np.zeros_like(req))
@@ -595,6 +673,11 @@ class DefaultPreemption:
                         headroom = headroom - vec
                         victims.remove(p)
                 if not victims:
+                    continue
+                if not gang_guard.admissible(victims):
+                    # two same-gang victims can each look fine alone but
+                    # jointly overdraw the gang's spare members — skip
+                    # the node rather than break all-or-nothing
                     continue
                 victim_keys = {v.meta.key for v in victims}
                 survivors = [
@@ -621,6 +704,7 @@ class DefaultPreemption:
             _, node, victims = best
             rounds.append(evict_round(self.store, pod, victims))
             evicted.update(v.meta.key for v in victims)
+            gang_guard.commit(victims)
             inflight[node.meta.name] = (
                 inflight.get(node.meta.name, np.zeros_like(req)) + req)
             # the victim node's assigned set shrank: repack its per-node

@@ -1,0 +1,25 @@
+"""koordsim: a fault-injecting churn simulator for the real scheduler.
+
+The cluster simulator ROADMAP calls the scenario-diversity engine and
+the regression harness: seeded arrival/departure processes (Poisson
+arrivals, gang storms, burst queues), cluster events (node drain/delete,
+spot reclamation, metric-expiry flips, quota rebalances) and an
+injectable :class:`FaultPlan` drive the REAL :class:`Scheduler` (and
+optionally the descheduler) for thousands of cycles, checking the
+store-level invariants (:mod:`koordinator_tpu.sim.invariants`) after
+every cycle and tracking time-to-bind p50/p99 SLOs with pending-queue
+backpressure.
+
+Run named scenarios with ``python -m koordinator_tpu.sim <scenario>``;
+the catalog lives in :mod:`koordinator_tpu.sim.scenarios`.
+"""
+
+from koordinator_tpu.sim.faults import (  # noqa: F401
+    Fault,
+    FaultPlan,
+    FaultyStore,
+    InjectedFault,
+)
+from koordinator_tpu.sim.harness import ChurnSimulator, SimReport  # noqa: F401
+from koordinator_tpu.sim.invariants import check_invariants  # noqa: F401
+from koordinator_tpu.sim.scenarios import SCENARIOS, Scenario  # noqa: F401

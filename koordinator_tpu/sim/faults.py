@@ -1,0 +1,151 @@
+"""Fault injection: the plan, the dispatch hook, and the faulty store.
+
+A :class:`FaultPlan` is a schedule of :class:`Fault` entries, armed by
+the simulator at the start of each sim cycle:
+
+  * ``kind="dispatch"`` — the scheduler's ``fault_injector`` hook raises
+    :class:`InjectedFault` from inside the device-dispatch window for
+    the next ``count`` attempts, exercising the degradation ladder
+    (scheduler/degrade.py) exactly like a real XLA/mesh fault. Two
+    failing attempts demote one rung (retry-once policy), so ``count``
+    is the demotion depth dial: 2 = one rung, 8 = all the way to the
+    pure-host fallback.
+  * ``kind="store_write"`` — the next ``count`` store writes issued by
+    the SCHEDULER (the simulator wraps only the scheduler's store view
+    in :class:`FaultyStore`; its own churn mutations never fail) raise.
+    This lands mid-bind or in the condition writer — paths the ladder
+    deliberately does not absorb — so it pins that an unhandled cycle
+    exception flight-dumps, re-raises, and the next cycle carries on.
+  * ``kind="sidecar"`` — installs a dead in-process sidecar client stub
+    (every RPC raises) for ``count`` cycles, exercising the sidecar's
+    own local-step fallback path.
+
+Everything is deterministic: faults fire at fixed cycles with fixed
+budgets, no randomness.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence, Tuple
+
+
+class InjectedFault(RuntimeError):
+    """The exception every injected fault raises — distinguishable from
+    real bugs in sim reports."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One scheduled fault: at sim cycle ``cycle``, arm ``count`` units
+    of ``kind`` failure."""
+
+    cycle: int
+    kind: str              # "dispatch" | "store_write" | "sidecar"
+    count: int = 1
+    message: str = "injected fault"
+
+    def __post_init__(self):
+        if self.kind not in ("dispatch", "store_write", "sidecar"):
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.cycle < 0 or self.count < 1:
+            raise ValueError("fault cycle must be >= 0 and count >= 1")
+
+
+class FaultPlan:
+    """Armed budgets per fault kind, advanced cycle by cycle. The
+    simulator owns the lifecycle: ``begin_cycle`` arms the entries
+    scheduled for that cycle, the hooks consume budget as they fire."""
+
+    def __init__(self, faults: Sequence[Fault] = ()) -> None:
+        self.faults: Tuple[Fault, ...] = tuple(faults)
+        self._budget: Dict[str, int] = {
+            "dispatch": 0, "store_write": 0, "sidecar": 0}
+        self._message: Dict[str, str] = {}
+        self.injected: List[dict] = []  # what actually fired, per kind
+        self._cycle = -1
+
+    def begin_cycle(self, cycle: int) -> None:
+        self._cycle = cycle
+        for f in self.faults:
+            if f.cycle == cycle:
+                self._budget[f.kind] += f.count
+                self._message[f.kind] = f.message
+
+    def budget(self, kind: str) -> int:
+        return self._budget[kind]
+
+    def _fire(self, kind: str, detail: str) -> None:
+        self._budget[kind] -= 1
+        self.injected.append(
+            {"cycle": self._cycle, "kind": kind, "detail": detail})
+        raise InjectedFault(
+            f"{self._message.get(kind, 'injected fault')} "
+            f"({kind}: {detail})")
+
+    # ---- scheduler.fault_injector hook --------------------------------
+    def dispatch_hook(self, stage: str) -> None:
+        """Installed as ``Scheduler.fault_injector``; raises while the
+        dispatch budget lasts."""
+        if self._budget["dispatch"] > 0:
+            self._fire("dispatch", stage)
+
+    # ---- store-write hook ---------------------------------------------
+    def store_write_hook(self, kind: str, key: str) -> None:
+        if self._budget["store_write"] > 0:
+            self._fire("store_write", f"{kind} {key}")
+
+    # ---- sidecar ------------------------------------------------------
+    def sidecar_armed(self) -> bool:
+        """True while a sidecar fault cycle is active; the simulator
+        swaps a dead client stub in/out of the scheduler. Consumes one
+        budget unit per armed cycle."""
+        if self._budget["sidecar"] > 0:
+            self._budget["sidecar"] -= 1
+            self.injected.append(
+                {"cycle": self._cycle, "kind": "sidecar", "detail": "stub"})
+            return True
+        return False
+
+
+class DeadSidecarClient:
+    """A sidecar client whose every RPC raises a channel-level transport
+    failure: what a timed-out / crashed gRPC peer looks like to
+    schedule_batch_or_fallback, which must degrade to the local step
+    (scheduler/sidecar.py catches ConnectionError/OSError)."""
+
+    def schedule_batch(self, request):
+        raise ConnectionError("sidecar timeout (injected)")
+
+    def close(self) -> None:
+        pass
+
+
+class FaultyStore:
+    """The scheduler's store view with write faults: forwards everything
+    to the real store, but ``update``/``add``/``delete`` consult the
+    plan first. Only the scheduler holds this wrapper — the simulator's
+    own churn mutations go to the inner store directly."""
+
+    def __init__(self, inner, plan: FaultPlan) -> None:
+        # bypass __setattr__-free plain attributes; no locking needed,
+        # the sim drives a single cycle thread
+        self._inner = inner
+        self._plan = plan
+
+    def update(self, kind: str, obj):
+        self._plan.store_write_hook(kind, getattr(
+            getattr(obj, "meta", None), "key", "?"))
+        return self._inner.update(kind, obj)
+
+    def add(self, kind: str, obj):
+        self._plan.store_write_hook(kind, getattr(
+            getattr(obj, "meta", None), "key", "?"))
+        return self._inner.add(kind, obj)
+
+    def delete(self, kind: str, key: str):
+        self._plan.store_write_hook(kind, key)
+        return self._inner.delete(kind, key)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)

@@ -1,0 +1,665 @@
+"""The churn simulator: seeded hostile traffic against the real Scheduler.
+
+One :class:`ChurnSimulator` owns a synthetic cluster store, drives the
+production :class:`~koordinator_tpu.scheduler.cycle.Scheduler` (and
+optionally the descheduler) cycle by cycle on a synthetic clock, and
+layers on everything a shared cluster throws at a scheduler:
+
+  * seeded arrival/departure processes — Poisson pod arrivals with a
+    prod/BE/quota/feature mix, gang storms, burst queues, Poisson
+    departures of running pods;
+  * cluster events — node drain (cordon + evict + uncordon-or-delete),
+    spot reclamation of bound BE pods (re-queued as fresh arrivals),
+    NodeMetric expiry flips, elastic-quota rebalances;
+  * fault injection — a :class:`FaultPlan` arming dispatch exceptions
+    (exercising the degradation ladder), scheduler store-write failures
+    and dead-sidecar cycles at chosen cycles;
+  * pending-queue backpressure — a bounded admitted queue with a
+    waiting room: arrivals beyond ``queue_cap`` wait (requeue) and
+    beyond ``overflow_cap`` are shed;
+  * per-cycle invariant checks (:mod:`koordinator_tpu.sim.invariants`)
+    and time-to-bind SLO tracking, flight-recorder dumps on any breach
+    or overrun.
+
+Everything is deterministic for a (scenario, seed) pair — the binding
+log is byte-stable and ``hack/lint.sh`` pins that.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import logging
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+from koordinator_tpu.api.objects import (
+    LABEL_POD_GROUP,
+    LABEL_QUOTA_NAME,
+    ElasticQuota,
+    Node,
+    NodeMetric,
+    NodeMetricInfo,
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    PodGroup,
+    PodSpec,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client.store import (
+    KIND_ELASTIC_QUOTA,
+    KIND_NODE,
+    KIND_NODE_METRIC,
+    KIND_POD,
+    KIND_POD_GROUP,
+    ObjectStore,
+)
+from koordinator_tpu.sim.faults import (
+    DeadSidecarClient,
+    FaultPlan,
+    FaultyStore,
+)
+from koordinator_tpu.sim.invariants import check_invariants
+from koordinator_tpu.sim.scenarios import Scenario
+
+GIB = 1024 ** 3
+ZONE = "topology.kubernetes.io/zone"
+PRIORITY_PROD = 9500
+PRIORITY_BE = 5500
+MAX_EVENT_DUMPS = 3  # flight dumps per trigger kind, so a pathological
+#                      run cannot turn the recorder into the bottleneck
+
+
+@dataclasses.dataclass
+class SimReport:
+    """Everything a scenario run produced, JSON-ready via to_dict()."""
+
+    scenario: str
+    seed: int
+    cycles: int
+    pods_created: int = 0
+    pods_bound: int = 0
+    pods_departed: int = 0
+    pods_reclaimed: int = 0
+    pods_drained: int = 0
+    pods_shed: int = 0
+    pods_requeued: int = 0
+    max_pending: int = 0
+    max_overflow: int = 0
+    final_pending: int = 0
+    ttb_seconds: List[float] = dataclasses.field(default_factory=list)
+    slo_target_seconds: float = 0.0
+    slo_overruns: int = 0
+    invariant_breaches: List[str] = dataclasses.field(default_factory=list)
+    cycle_exceptions: List[str] = dataclasses.field(default_factory=list)
+    faults_injected: int = 0
+    sidecar_fallbacks: int = 0
+    ladder_transitions: List[dict] = dataclasses.field(default_factory=list)
+    cycles_at_level: Dict[str, int] = dataclasses.field(default_factory=dict)
+    final_level: str = "full"
+    flight_dumps: int = 0
+    descheduler_runs: int = 0
+    binding_log: List[str] = dataclasses.field(default_factory=list)
+    wall_seconds: float = 0.0
+
+    def percentile(self, q: float) -> float:
+        if not self.ttb_seconds:
+            return 0.0
+        return float(np.percentile(np.asarray(self.ttb_seconds), q))
+
+    @property
+    def binding_log_sha256(self) -> str:
+        h = hashlib.sha256()
+        for line in self.binding_log:
+            h.update(line.encode())
+            h.update(b"\n")
+        return h.hexdigest()
+
+    def to_dict(self, include_log: bool = False) -> dict:
+        ttb = {
+            "count": len(self.ttb_seconds),
+            "p50": round(self.percentile(50), 3),
+            "p90": round(self.percentile(90), 3),
+            "p99": round(self.percentile(99), 3),
+            "max": round(max(self.ttb_seconds), 3) if self.ttb_seconds
+            else 0.0,
+            "mean": round(float(np.mean(self.ttb_seconds)), 3)
+            if self.ttb_seconds else 0.0,
+        }
+        out = {
+            "scenario": self.scenario,
+            "seed": self.seed,
+            "cycles": self.cycles,
+            "pods": {
+                "created": self.pods_created,
+                "bound": self.pods_bound,
+                "departed": self.pods_departed,
+                "reclaimed": self.pods_reclaimed,
+                "drained": self.pods_drained,
+                "shed": self.pods_shed,
+                "requeued": self.pods_requeued,
+                "final_pending": self.final_pending,
+            },
+            "time_to_bind_seconds": ttb,
+            "slo": {
+                "ttb_p99_target_seconds": self.slo_target_seconds,
+                "met": (ttb["p99"] <= self.slo_target_seconds
+                        if self.ttb_seconds else True),
+                "overruns": self.slo_overruns,
+            },
+            "queue": {
+                "max_pending": self.max_pending,
+                "max_overflow": self.max_overflow,
+            },
+            "invariant_breaches": len(self.invariant_breaches),
+            "invariant_breach_samples": self.invariant_breaches[:5],
+            "cycle_exceptions": len(self.cycle_exceptions),
+            "cycle_exception_samples": self.cycle_exceptions[:5],
+            "faults_injected": self.faults_injected,
+            "sidecar_fallbacks": self.sidecar_fallbacks,
+            "degradation": {
+                "transitions": self.ladder_transitions,
+                "cycles_at_level": self.cycles_at_level,
+                "final_level": self.final_level,
+            },
+            "flight_dumps": self.flight_dumps,
+            "descheduler_runs": self.descheduler_runs,
+            "binding_log_sha256": self.binding_log_sha256,
+            "bindings": len(self.binding_log),
+            "wall_seconds": round(self.wall_seconds, 2),
+        }
+        if include_log:
+            out["binding_log"] = list(self.binding_log)
+        return out
+
+
+class ChurnSimulator:
+    """Drive one scenario. ``run()`` returns the :class:`SimReport`."""
+
+    def __init__(self, scenario: Scenario,
+                 flight_dir: Optional[str] = None) -> None:
+        import random
+
+        self.sc = scenario
+        self.rng = random.Random(scenario.seed)
+        self.store = ObjectStore()  # the simulator's own (never-failing) view
+        self.plan = FaultPlan(scenario.faults)
+        self.now = 1_000_000.0
+        self.report = SimReport(scenario=scenario.name,
+                                seed=scenario.seed,
+                                cycles=scenario.cycles,
+                                slo_target_seconds=scenario.ttb_slo_seconds)
+        self._uid = 0
+        self._arrival_time: Dict[str, float] = {}   # pod key -> sim arrival
+        self._overflow: List[Pod] = []              # waiting room (FIFO)
+        self._draining: List[Tuple[str, int]] = []  # (node, cycles left)
+        self._gangs: List[Tuple[int, str, List[str]]] = (
+            [])  # (finish cycle, PodGroup key, member pod keys)
+        self._metric_flip_state = False
+        self._dump_budget = {"invariant_breach": MAX_EVENT_DUMPS,
+                             "slo_overrun": MAX_EVENT_DUMPS}
+        self._build_world()
+        self._build_scheduler(flight_dir)
+
+    # ------------------------------------------------------------------
+    # world + scheduler construction
+    # ------------------------------------------------------------------
+    def _build_world(self) -> None:
+        import json
+
+        for i in range(self.sc.nodes):
+            node = Node(
+                meta=ObjectMeta(name=f"n{i}", namespace=""),
+                allocatable=ResourceList.of(cpu=16_000, memory=64 * GIB,
+                                            pods=50))
+            node.meta.labels[ZONE] = f"z{i % 3}"
+            if i % 4 == 0:
+                node.attachable_volume_limit = 3
+            if i % 5 == 0:
+                node.meta.annotations[
+                    "node.koordinator.sh/reservation"] = json.dumps(
+                        {"resources": {"cpu": "2", "memory": "4Gi"}})
+            self.store.add(KIND_NODE, node)
+            nm = NodeMetric(
+                meta=ObjectMeta(name=f"n{i}", namespace=""),
+                update_time=self.now,
+                node_metric=NodeMetricInfo(
+                    node_usage=ResourceList.of(
+                        cpu=1_000 + 500 * (i % 3), memory=4 * GIB)))
+            self.store.add(KIND_NODE_METRIC, nm)
+        # two sibling elastic quotas; the rebalance event shifts max
+        # capacity between them
+        total_cpu = self.sc.nodes * 16_000
+        for qname in ("q-a", "q-b"):
+            self.store.add(KIND_ELASTIC_QUOTA, ElasticQuota(
+                meta=ObjectMeta(name=qname, namespace="sim"),
+                min=ResourceList.of(cpu=2_000, memory=8 * GIB),
+                max=ResourceList.of(cpu=total_cpu // 2,
+                                    memory=self.sc.nodes * 32 * GIB)))
+
+    def _build_scheduler(self, flight_dir: Optional[str]) -> None:
+        from koordinator_tpu.obs.flight import FlightRecorder
+        from koordinator_tpu.scheduler import metrics as scheduler_metrics
+        from koordinator_tpu.scheduler.cycle import CyclePipeline, Scheduler
+        from koordinator_tpu.scheduler.degrade import DegradationLadder
+
+        sc = self.sc
+        self.sched = Scheduler(
+            FaultyStore(self.store, self.plan),
+            waves=sc.waves,
+            explain=sc.explain if sc.explain is not None else "off",
+            mesh=sc.mesh if sc.mesh is not None else "off",
+            ladder=DegradationLadder(promote_after=sc.promote_after),
+        )
+        self.sched.fault_injector = self.plan.dispatch_hook
+        if flight_dir:
+            self.sched.flight = FlightRecorder(
+                dump_dir=flight_dir,
+                dump_counter=scheduler_metrics.FLIGHT_DUMPS)
+        self.pipeline = (CyclePipeline(self.sched, enabled=True)
+                         if sc.pipeline else None)
+        self.desch = None
+        if sc.descheduler_every > 0:
+            from koordinator_tpu.descheduler.descheduler import Descheduler
+
+            # the descheduler shares the simulator's store view directly:
+            # injected store faults target the scheduler's bind path
+            self.desch = Descheduler(self.store)
+
+    # ------------------------------------------------------------------
+    # workload generation
+    # ------------------------------------------------------------------
+    def _next_uid(self) -> int:
+        self._uid += 1
+        return self._uid
+
+    def _make_pod(self, prefix: str = "p") -> Pod:
+        rng = self.rng
+        uid = self._next_uid()
+        name = f"{prefix}{uid}"
+        labels = {"app": rng.choice("abc")}
+        is_be = rng.random() < self.sc.be_fraction
+        spec = PodSpec(
+            priority=PRIORITY_BE if is_be else PRIORITY_PROD,
+            requests=ResourceList.of(
+                cpu=rng.choice([250, 500, 1000, 2000]),
+                memory=rng.choice([1, 2, 4]) * GIB))
+        pod = Pod(meta=ObjectMeta(name=name, namespace="sim", uid=name,
+                                  creation_timestamp=self.now,
+                                  labels=labels),
+                  spec=spec)
+        r = rng.random()
+        if r < 0.10:
+            pod.spec.host_ports.append(
+                ("TCP", rng.choice([80, 443, 9090])))
+        elif r < 0.20:
+            pod.spec.pvc_names = [f"claim-{uid}"]
+        elif r < 0.30:
+            pod.spec.pod_anti_affinity.append(PodAffinityTerm(
+                selector={"app": labels["app"]}, topology_key=ZONE))
+        elif r < 0.40 and not is_be:
+            pod.meta.labels[LABEL_QUOTA_NAME] = rng.choice(["q-a", "q-b"])
+        return pod
+
+    def _make_gang(self, storm_idx: int, cycle: int) -> List[Pod]:
+        gname = f"gang-{storm_idx}-{self._next_uid()}"
+        pg = PodGroup(
+            meta=ObjectMeta(name=gname, namespace="sim",
+                            creation_timestamp=self.now),
+            min_member=self.sc.gang_size)
+        self.store.add(KIND_POD_GROUP, pg)
+        members = []
+        for _ in range(self.sc.gang_size):
+            uid = self._next_uid()
+            members.append(Pod(
+                meta=ObjectMeta(name=f"g{uid}", namespace="sim",
+                                uid=f"g{uid}",
+                                creation_timestamp=self.now,
+                                labels={LABEL_POD_GROUP: gname}),
+                spec=PodSpec(requests=ResourceList.of(
+                    cpu=1000, memory=GIB))))
+        if self.sc.gang_lifetime > 0:
+            self._gangs.append((cycle + self.sc.gang_lifetime, pg.meta.key,
+                                [m.meta.key for m in members]))
+        return members
+
+    def _finish_gangs(self, cycle: int) -> None:
+        """Whole gangs complete as one unit (a training job finishing):
+        every member and the PodGroup leave together — all-or-nothing in
+        death as in life, so the invariant checker never sees a partial
+        gang from lifecycle churn. Without this, immortal gangs slowly
+        clog the cluster and strangle plain-pod throughput."""
+        due = [g for g in self._gangs if g[0] <= cycle]
+        if not due:
+            return
+        self._gangs = [g for g in self._gangs if g[0] > cycle]
+        for _at, pg_key, member_keys in due:
+            for key in member_keys:
+                if self.store.get(KIND_POD, key) is not None:
+                    self.store.delete(KIND_POD, key)
+                self._arrival_time.pop(key, None)
+                self.report.pods_departed += 1
+            self.store.delete(KIND_POD_GROUP, pg_key)
+
+    def _poisson(self, lam: float) -> int:
+        """Knuth's seeded Poisson draw — numpy's generator would need a
+        second seed stream; random.Random keeps ONE deterministic
+        sequence for the whole scenario."""
+        import math
+
+        if lam <= 0:
+            return 0
+        L = math.exp(-lam)
+        k, p = 0, 1.0
+        while True:
+            p *= self.rng.random()
+            if p <= L:
+                return k
+            k += 1
+
+    # ------------------------------------------------------------------
+    # queue admission (backpressure)
+    # ------------------------------------------------------------------
+    def _pending_count(self) -> int:
+        return sum(1 for p in self.store.list(KIND_POD)
+                   if not p.is_assigned and not p.is_terminated)
+
+    def _admit(self, fresh: List[Pod]) -> None:
+        """Bounded-queue admission: the waiting room drains FIFO first
+        (requeue), fresh arrivals line up behind it, and anything beyond
+        the waiting room's own bound is shed (dropped, counted). Gang
+        members bypass the cap as one unit — admitting half a gang would
+        deadlock its barrier forever."""
+        for pod in fresh:
+            self._arrival_time.setdefault(pod.meta.key, self.now)
+        gangs, plain = [], []
+        for pod in fresh:
+            (gangs if pod.gang_name else plain).append(pod)
+        for pod in gangs:
+            self.store.add(KIND_POD, pod)
+        queue = self._overflow + plain
+        budget = max(0, self.sc.queue_cap - self._pending_count())
+        admit, wait = queue[:budget], queue[budget:]
+        fresh_ids = {id(p) for p in plain}
+        self.report.pods_requeued += sum(
+            1 for p in admit if id(p) not in fresh_ids)
+        for pod in admit:
+            self.store.add(KIND_POD, pod)
+        if len(wait) > self.sc.overflow_cap:
+            shed = wait[self.sc.overflow_cap:]
+            wait = wait[:self.sc.overflow_cap]
+            self.report.pods_shed += len(shed)
+            for pod in shed:
+                self._arrival_time.pop(pod.meta.key, None)
+        self._overflow = wait
+        self.report.max_overflow = max(self.report.max_overflow,
+                                       len(self._overflow))
+
+    # ------------------------------------------------------------------
+    # cluster events
+    # ------------------------------------------------------------------
+    def _running_pods(self, include_gang: bool = False) -> List[Pod]:
+        return [p for p in self.store.list(KIND_POD)
+                if p.is_assigned and not p.is_terminated
+                and (include_gang or not p.gang_key)]
+
+    def _departures(self) -> None:
+        n = self._poisson(self.sc.departure_rate)
+        if n <= 0:
+            return
+        running = self._running_pods()
+        for pod in self.rng.sample(running, min(n, len(running))):
+            self.store.delete(KIND_POD, pod.meta.key)
+            self._arrival_time.pop(pod.meta.key, None)
+            self.report.pods_departed += 1
+
+    def _drain_step(self, cycle: int) -> None:
+        sc = self.sc
+        # advance in-flight drains
+        by_name = {n.meta.name: n for n in self.store.list(KIND_NODE)}
+        still = []
+        for name, left in self._draining:
+            node = by_name.get(name)
+            if node is None:
+                continue
+            if left > 1:
+                still.append((name, left - 1))
+                continue
+            # drain complete: delete the node only when nothing is bound
+            # to it anymore (gang pods are not drained — see below — and
+            # deleting a node under them would orphan bound pods)
+            bound = [p for p in self.store.list(KIND_POD)
+                     if p.spec.node_name == name and p.is_assigned
+                     and not p.is_terminated]
+            if sc.drain_delete and not bound:
+                self.store.delete(KIND_NODE, node.meta.key)
+            else:
+                node.unschedulable = False
+                self.store.update(KIND_NODE, node)
+        self._draining = still
+        if sc.drain_every <= 0 or cycle == 0 or cycle % sc.drain_every:
+            return
+        draining_names = {n for n, _ in self._draining}
+        candidates = [n for n in self.store.list(KIND_NODE)
+                      if not n.unschedulable
+                      and n.meta.name not in draining_names]
+        if len(candidates) <= 2:
+            return  # never drain the cluster below a working floor
+        node = self.rng.choice(candidates)
+        node.unschedulable = True
+        self.store.update(KIND_NODE, node)
+        self._draining.append((node.meta.name, sc.drain_uncordon_after))
+        # evict (and requeue) the node's non-gang pods — the reference
+        # drains via eviction + reschedule; gang members stay (evicting
+        # one would legitimately break all-or-nothing, which is gang
+        # lifecycle churn, not a scheduler violation)
+        evicted = []
+        for pod in self.store.list(KIND_POD):
+            if (pod.spec.node_name == node.meta.name and pod.is_assigned
+                    and not pod.is_terminated and not pod.gang_key):
+                self.store.delete(KIND_POD, pod.meta.key)
+                self._arrival_time.pop(pod.meta.key, None)
+                evicted.append(pod)
+        self.report.pods_drained += len(evicted)
+        self._admit([self._make_pod(prefix="re") for _ in evicted])
+
+    def _spot_reclaim(self, cycle: int) -> None:
+        sc = self.sc
+        if sc.spot_reclaim_every <= 0 or cycle == 0 or (
+                cycle % sc.spot_reclaim_every):
+            return
+        be = [p for p in self._running_pods()
+              if (p.spec.priority or 0) < 9000]
+        victims = self.rng.sample(be, min(sc.spot_reclaim_count, len(be)))
+        for pod in victims:
+            self.store.delete(KIND_POD, pod.meta.key)
+            self._arrival_time.pop(pod.meta.key, None)
+            self.report.pods_reclaimed += 1
+        # the reclaimed workload comes straight back as fresh arrivals —
+        # spot churn, not capacity loss
+        self._admit([self._make_pod(prefix="sp") for _ in victims])
+
+    def _metric_flip(self, cycle: int) -> None:
+        sc = self.sc
+        if sc.metric_flip_every <= 0 or cycle == 0 or (
+                cycle % sc.metric_flip_every):
+            return
+        self._metric_flip_state = not self._metric_flip_state
+        for i, nm in enumerate(self.store.list(KIND_NODE_METRIC)):
+            if i % 2 == (0 if self._metric_flip_state else 1):
+                nm.update_time = self.now  # fresh
+                nm.node_metric.node_usage = ResourceList.of(
+                    cpu=1_000 + 250 * (i % 5), memory=4 * GIB)
+            else:
+                nm.update_time = self.now - 10_000.0  # expired
+            self.store.update(KIND_NODE_METRIC, nm)
+
+    def _quota_rebalance(self, cycle: int) -> None:
+        sc = self.sc
+        if sc.quota_rebalance_every <= 0 or cycle == 0 or (
+                cycle % sc.quota_rebalance_every):
+            return
+        total_cpu = max(1, len(self.store.list(KIND_NODE))) * 16_000
+        quotas = sorted(self.store.list(KIND_ELASTIC_QUOTA),
+                        key=lambda q: q.meta.name)
+        if len(quotas) < 2:
+            return
+        # shift capacity: one quota tight, the other generous, alternating
+        tight, wide = ((quotas[0], quotas[1])
+                       if (cycle // sc.quota_rebalance_every) % 2
+                       else (quotas[1], quotas[0]))
+        tight.max = ResourceList.of(cpu=total_cpu // 8,
+                                    memory=len(quotas) * 16 * GIB)
+        wide.max = ResourceList.of(cpu=total_cpu,
+                                   memory=len(quotas) * 64 * GIB)
+        self.store.update(KIND_ELASTIC_QUOTA, tight)
+        self.store.update(KIND_ELASTIC_QUOTA, wide)
+
+    # ------------------------------------------------------------------
+    # the loop
+    # ------------------------------------------------------------------
+    def _dump(self, reason: str) -> None:
+        if self._dump_budget.get(reason, 0) > 0:
+            self._dump_budget[reason] -= 1
+            self.sched.flight.dump(reason)
+
+    def _account_bind(self, cycle: int, pod_key: str,
+                      node_name: str) -> None:
+        """One committed binding into the report: phase bookkeeping is
+        the caller's; this records ttb (+ SLO overrun), the bound
+        counter, and the binding-log line."""
+        arrived = self._arrival_time.pop(pod_key, None)
+        if arrived is not None:
+            ttb = self.now - arrived
+            self.report.ttb_seconds.append(ttb)
+            if ttb > self.sc.ttb_slo_seconds:
+                self.report.slo_overruns += 1
+                self._dump("slo_overrun")
+        self.report.pods_bound += 1
+        self.report.binding_log.append(
+            f"{cycle}\t{pod_key}\t{node_name}")
+
+    def _reconcile_store_binds(self, cycle: int) -> None:
+        """After a mid-cycle exception: bindings the cycle applied before
+        the wreck are already store-visible (a store-write fault raises
+        mid-bind-loop), but never reached ``result.bound``. Sweep the
+        tracked pending pods and account any the store now shows
+        assigned, exactly as the normal path would — arrival order, the
+        seeded run's deterministic iteration order."""
+        for key in list(self._arrival_time):
+            pod = self.store.get(KIND_POD, key)
+            if pod is None or not pod.is_assigned:
+                continue
+            if pod.phase != "Running":
+                pod.phase = "Running"
+                self.store.update(KIND_POD, pod)
+            self._account_bind(cycle, key, pod.spec.node_name)
+
+    def _check_invariants(self, cycle: int) -> None:
+        breaches = check_invariants(self.store)
+        if breaches:
+            self.report.invariant_breaches.extend(
+                f"cycle {cycle}: {b}" for b in breaches)
+            self._dump("invariant_breach")
+
+    def _run_one_cycle(self, cycle: int) -> None:
+        sc = self.sc
+        self.now += sc.dt_seconds
+        self.plan.begin_cycle(cycle)
+        # sidecar fault window: swap a dead client in (the sidecar layer
+        # must degrade to the local step, never wedge the cycle)
+        self.sched._sidecar_client = (DeadSidecarClient()
+                                      if self.plan.sidecar_armed() else None)
+        # cluster events before arrivals, arrivals before the cycle —
+        # a fixed order is what makes the run reproducible
+        self._finish_gangs(cycle)
+        self._drain_step(cycle)
+        self._spot_reclaim(cycle)
+        self._metric_flip(cycle)
+        self._quota_rebalance(cycle)
+        self._departures()
+        fresh = [self._make_pod() for _ in range(
+            self._poisson(sc.arrival_rate))]
+        if sc.burst_every > 0 and cycle > 0 and cycle % sc.burst_every == 0:
+            fresh.extend(self._make_pod(prefix="b")
+                         for _ in range(sc.burst_size))
+        if sc.gang_every > 0 and cycle > 0 and cycle % sc.gang_every == 0:
+            for s in range(sc.gangs_per_storm):
+                fresh.extend(self._make_gang(cycle * 10 + s, cycle))
+        self.report.pods_created += len(fresh)
+        self._admit(fresh)
+        self.report.max_pending = max(self.report.max_pending,
+                                      self._pending_count())
+
+        driver = self.pipeline if self.pipeline is not None else self.sched
+        try:
+            result = driver.run_cycle(now=self.now)
+        except Exception as exc:  # the flight recorder already dumped
+            self.report.cycle_exceptions.append(
+                f"cycle {cycle}: {type(exc).__name__}: {exc}")
+            logger.warning("sim cycle %d raised: %s", cycle, exc)
+            # bindings applied before the wreck are already store-visible
+            # (e.g. a store-write fault mid-bind-loop): reconcile them
+            # into the report so binding_log/ttb/pods_bound match the
+            # store, then still run the invariant check — a partially
+            # applied cycle is exactly when it matters
+            self._reconcile_store_binds(cycle)
+            self._check_invariants(cycle)
+            return
+        for b in result.bound:
+            pod = self.store.get(KIND_POD, b.pod_key)
+            if pod is None:
+                continue
+            pod.phase = "Running"  # bind -> Running, as the kubelet would
+            self.store.update(KIND_POD, pod)
+            self._account_bind(cycle, b.pod_key, b.node_name)
+        self._check_invariants(cycle)
+        if (self.desch is not None and cycle > 0
+                and cycle % sc.descheduler_every == 0):
+            try:
+                self.desch.run_once(now=self.now)
+                self.report.descheduler_runs += 1
+            except Exception as exc:
+                self.report.cycle_exceptions.append(
+                    f"cycle {cycle} descheduler: "
+                    f"{type(exc).__name__}: {exc}")
+
+    def run(self) -> SimReport:
+        t0 = time.perf_counter()
+        for cycle in range(self.sc.cycles):
+            self._run_one_cycle(cycle)
+        if self.pipeline is not None:
+            self.pipeline.flush()
+        self.report.wall_seconds = time.perf_counter() - t0
+        self.report.final_pending = self._pending_count()
+        self.report.faults_injected = len(self.plan.injected)
+        self.report.sidecar_fallbacks = self.sched.sidecar_fallbacks
+        self.report.ladder_transitions = list(self.sched.ladder.transitions)
+        self.report.final_level = self.sched.ladder.level_name
+        self.report.flight_dumps = self.sched.flight.dumps
+        return self.report
+
+
+def run_scenario(scenario: Scenario,
+                 flight_dir: Optional[str] = None) -> SimReport:
+    """Build + run in one call; the harness tracks the per-cycle ladder
+    residency histogram here so every caller gets it."""
+    sim = ChurnSimulator(scenario, flight_dir=flight_dir)
+    # per-cycle level residency: wrap the cycle runner
+    counts: Dict[str, int] = {}
+    orig = sim._run_one_cycle
+
+    def counted(cycle: int) -> None:
+        orig(cycle)
+        name = sim.sched.ladder.level_name
+        counts[name] = counts.get(name, 0) + 1
+
+    sim._run_one_cycle = counted
+    report = sim.run()
+    report.cycles_at_level = counts
+    return report

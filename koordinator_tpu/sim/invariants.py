@@ -1,0 +1,80 @@
+"""Store-level cluster invariants: what must hold after EVERY cycle.
+
+The single source both the churn soak test (tests/test_churn_soak.py)
+and the simulator's per-cycle net assert. Mirrors what the reference's
+admission chain guarantees: no node overcommitted past its (trimmed)
+allocatable, no hostPort double-bind, CSI volume limits respected, gang
+all-or-nothing. Returns breach DESCRIPTIONS instead of asserting so the
+simulator can count, flight-dump, and keep churning — the test layer
+asserts the list is empty.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from koordinator_tpu.client.store import (
+    KIND_NODE,
+    KIND_POD,
+    KIND_POD_GROUP,
+    ObjectStore,
+)
+from koordinator_tpu.ops.estimator import estimate_node_allocatable
+
+
+def check_invariants(store: ObjectStore) -> List[str]:
+    """Check the invariant set against the store; [] == clean."""
+    breaches: List[str] = []
+    nodes = {n.meta.name: n for n in store.list(KIND_NODE)}
+    pods = [p for p in store.list(KIND_POD)
+            if p.is_assigned and not p.is_terminated]
+    by_node = {}
+    for p in pods:
+        by_node.setdefault(p.spec.node_name, []).append(p)
+    for name, plist in by_node.items():
+        node = nodes.get(name)
+        if node is None:
+            breaches.append(f"pod bound to unknown node {name}")
+            continue
+        # 1. capacity: sum of requests <= trimmed allocatable per axis
+        alloc = estimate_node_allocatable(node)
+        total = np.zeros_like(alloc)
+        for p in plist:
+            total = total + p.spec.requests.to_vector()
+        over = total > alloc + 1e-3
+        if over.any():
+            breaches.append(
+                f"node {name} overcommitted: {total[over]} > {alloc[over]}")
+        # 2. hostPorts: no (protocol, port) bound twice
+        seen = set()
+        for p in plist:
+            for slot in p.spec.host_ports:
+                if slot in seen:
+                    breaches.append(
+                        f"hostPort {slot} double-bound on {name}")
+                seen.add(slot)
+        # 3. volume limit
+        if node.attachable_volume_limit > 0:
+            claims = set()
+            for p in plist:
+                claims.update(
+                    f"{p.meta.namespace}/{c}" for c in p.spec.pvc_names)
+            if len(claims) > node.attachable_volume_limit:
+                breaches.append(
+                    f"node {name} exceeds volume limit: "
+                    f"{len(claims)} > {node.attachable_volume_limit}")
+    # 4. gang all-or-nothing: a gang with any bound member has >= min bound
+    gangs = {g.meta.key: g for g in store.list(KIND_POD_GROUP)}
+    bound_per_gang = {}
+    for p in pods:
+        g = p.gang_key
+        if g:
+            bound_per_gang[g] = bound_per_gang.get(g, 0) + 1
+    for g, count in bound_per_gang.items():
+        pg = gangs.get(g)
+        if pg is not None and count < pg.min_member:
+            breaches.append(
+                f"gang {g} partially bound: {count} < {pg.min_member}")
+    return breaches

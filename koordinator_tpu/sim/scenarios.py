@@ -1,0 +1,187 @@
+"""Named simulation scenarios: the workload catalog.
+
+Every scenario is a fully-seeded :class:`Scenario` — same name + same
+seed means the same arrival stream, the same cluster events, the same
+fault schedule, and (because the scheduler itself is deterministic on a
+fixed backend) a byte-stable binding log. ``hack/lint.sh`` pins exactly
+that for ``smoke``; ``bench.py --churn`` runs any scenario as a
+back-to-back A/B pair per the BENCH_NOTES noise protocol.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+from koordinator_tpu.sim.faults import Fault
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """One reproducible churn workload. Times are sim-clock seconds
+    (the simulator advances ``dt_seconds`` per cycle); every `_every`
+    knob is in cycles, 0 = event disabled."""
+
+    name: str
+    description: str = ""
+    seed: int = 11
+    cycles: int = 200
+    nodes: int = 12
+    dt_seconds: float = 5.0
+    # arrivals / departures
+    arrival_rate: float = 6.0     # Poisson mean pods per cycle
+    be_fraction: float = 0.35     # arrivals that are best-effort (spot prey)
+    departure_rate: float = 2.0   # Poisson mean running-pod deletions/cycle
+    burst_every: int = 0          # burst queue: +burst_size pods at once
+    burst_size: int = 40
+    gang_every: int = 0           # gang storm cadence
+    gang_size: int = 3
+    gangs_per_storm: int = 1
+    gang_lifetime: int = 0        # cycles until a whole gang finishes
+    #                               (0 = gangs run forever)
+    # cluster events
+    drain_every: int = 0          # cordon a node, evict its pods, then
+    drain_delete: bool = False    # ... delete it (True) or uncordon later
+    drain_uncordon_after: int = 6
+    spot_reclaim_every: int = 0   # evict bound BE pods (re-queued as new)
+    spot_reclaim_count: int = 3
+    metric_flip_every: int = 0    # alternate NodeMetric fresh <-> expired
+    quota_rebalance_every: int = 0  # shrink/grow quota max
+    # backpressure
+    queue_cap: int = 512          # max pending pods admitted to the store
+    overflow_cap: int = 2048      # waiting-room bound; beyond it -> shed
+    # SLOs
+    ttb_slo_seconds: float = 120.0  # time-to-bind p99 target
+    # scheduler configuration under test
+    waves: object = 1             # Scheduler(waves=...): int or "auto"
+    explain: Optional[str] = None  # None keeps explain off ("off" pin)
+    mesh: Optional[int] = None    # KOORD_TPU_MESH-style device count
+    pipeline: bool = False        # drive through CyclePipeline
+    descheduler_every: int = 0    # run the real descheduler every N cycles
+    promote_after: int = 8        # ladder clean-cycle re-promotion probe
+    # fault schedule
+    faults: Tuple[Fault, ...] = ()
+
+    def resolved(self, cycles: Optional[int] = None,
+                 seed: Optional[int] = None) -> "Scenario":
+        """CLI overrides without losing the catalog definition."""
+        changes = {}
+        if cycles is not None:
+            changes["cycles"] = cycles
+        if seed is not None:
+            changes["seed"] = seed
+        return dataclasses.replace(self, **changes) if changes else self
+
+
+SCENARIOS: Dict[str, Scenario] = {}
+
+
+def _register(sc: Scenario) -> Scenario:
+    if sc.name in SCENARIOS:
+        raise ValueError(f"duplicate scenario {sc.name!r}")
+    SCENARIOS[sc.name] = sc
+    return sc
+
+
+_register(Scenario(
+    name="smoke",
+    description=(
+        "tier-1 / lint gate: ~50 cycles of light churn with one gang "
+        "storm cadence, a node drain, metric flips, and a dispatch-fault "
+        "burst that demotes the ladder to the host fallback and back — "
+        "fixed seed, byte-stable binding log, zero invariant breaches"),
+    seed=11, cycles=50, nodes=10,
+    arrival_rate=5.0, departure_rate=1.5,
+    gang_every=9, gang_size=3,
+    drain_every=17, drain_uncordon_after=5,
+    metric_flip_every=13,
+    queue_cap=128,
+    ttb_slo_seconds=180.0,
+    promote_after=6,
+    faults=(Fault(cycle=20, kind="dispatch", count=3,
+                  message="smoke dispatch fault"),),
+))
+
+_register(Scenario(
+    name="soak",
+    description=(
+        "the 1000-cycle acceptance soak (slow): sustained Poisson "
+        "traffic with gang storms, bursts, drains, spot reclamation, "
+        "metric flips, quota rebalances, and dispatch/store-write "
+        "faults mid-soak; emits the CHURN SLO report"),
+    seed=7, cycles=1000, nodes=16,
+    # near-capacity but sustainable: ~16x16 cores hold ~270 of these
+    # pods; steady arrivals (+ gang storms and bursts on top) roughly
+    # match departures + reclamation so the queue breathes instead of
+    # diverging — the bursts are the stress, not a monotone backlog
+    arrival_rate=3.0, departure_rate=4.0, be_fraction=0.4,
+    burst_every=97, burst_size=60,
+    gang_every=23, gang_size=4, gangs_per_storm=2, gang_lifetime=40,
+    drain_every=61, drain_uncordon_after=8,
+    spot_reclaim_every=43, spot_reclaim_count=4,
+    metric_flip_every=29,
+    quota_rebalance_every=53,
+    queue_cap=384, overflow_cap=1536,
+    ttb_slo_seconds=300.0,
+    waves="auto",
+    descheduler_every=50,
+    promote_after=16,
+    faults=(
+        Fault(cycle=300, kind="dispatch", count=2,
+              message="soak transient dispatch fault"),
+        Fault(cycle=450, kind="store_write", count=1,
+              message="soak store-write fault"),
+        Fault(cycle=600, kind="dispatch", count=8,
+              message="soak dispatch fault storm"),
+        Fault(cycle=750, kind="sidecar", count=3,
+              message="soak sidecar outage"),
+    ),
+))
+
+_register(Scenario(
+    name="gang-storm",
+    description=(
+        "gang-dominated arrivals: storms of multi-member PodGroups every "
+        "few cycles plus burst queues — the all-or-nothing admission "
+        "path under sustained pressure"),
+    seed=3, cycles=300, nodes=14,
+    arrival_rate=3.0, departure_rate=2.0,
+    burst_every=31, burst_size=30,
+    gang_every=3, gang_size=5, gangs_per_storm=2, gang_lifetime=12,
+    queue_cap=256,
+    ttb_slo_seconds=240.0,
+    waves="auto",
+))
+
+_register(Scenario(
+    name="spot-churn",
+    description=(
+        "spot-heavy cluster: most arrivals are best-effort and "
+        "reclamation keeps evicting bound BE pods (re-queued as fresh "
+        "arrivals) while drains rotate nodes out and back"),
+    seed=5, cycles=300, nodes=12,
+    arrival_rate=7.0, be_fraction=0.7, departure_rate=1.0,
+    spot_reclaim_every=5, spot_reclaim_count=4,
+    drain_every=41, drain_uncordon_after=6,
+    metric_flip_every=19,
+    queue_cap=256,
+    ttb_slo_seconds=240.0,
+))
+
+_register(Scenario(
+    name="fault-ladder",
+    description=(
+        "robustness proof: mesh + fused waves + explain all on, a "
+        "dispatch-fault storm deep enough to walk the full ladder "
+        "(mesh -> single-device -> serial -> no-explain -> host "
+        "fallback), then clean cycles to re-promote — the deterministic "
+        "seeded scenario the acceptance test pins"),
+    seed=13, cycles=60, nodes=8,
+    arrival_rate=4.0, departure_rate=1.0,
+    queue_cap=128,
+    ttb_slo_seconds=300.0,
+    waves=4, explain="counts", mesh=2,
+    promote_after=5,
+    faults=(Fault(cycle=10, kind="dispatch", count=8,
+                  message="ladder walk fault storm"),),
+))

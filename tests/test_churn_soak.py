@@ -1,14 +1,14 @@
 """Multi-cycle churn soak: 25 scheduling cycles with random pod arrivals,
 deletions, and metric updates, checking CLUSTER-LEVEL INVARIANTS from the
 store after every cycle — the integration net single-cycle parity tests
-cannot cast. Invariants mirror what the reference's admission chain
-guarantees: no node overcommitted past (trimmed) allocatable, no hostPort
-double-bind, gang all-or-nothing, CSI volume limits respected."""
+cannot cast. The invariant set itself lives in
+koordinator_tpu/sim/invariants.py (one source shared with the koordsim
+churn simulator, which runs the same checks for thousands of cycles
+under fault injection)."""
 
 import json
 import random
 
-import numpy as np
 import pytest
 
 from koordinator_tpu.api.objects import (
@@ -27,58 +27,16 @@ from koordinator_tpu.client.store import (
     KIND_POD_GROUP,
     ObjectStore,
 )
-from koordinator_tpu.ops.estimator import estimate_node_allocatable
 from koordinator_tpu.scheduler.cycle import Scheduler
+from koordinator_tpu.sim.invariants import check_invariants
 
 GIB = 1024**3
 ZONE = "topology.kubernetes.io/zone"
 
 
 def _check_invariants(store: ObjectStore) -> None:
-    nodes = {n.meta.name: n for n in store.list(KIND_NODE)}
-    pods = [p for p in store.list(KIND_POD)
-            if p.is_assigned and not p.is_terminated]
-    by_node = {}
-    for p in pods:
-        by_node.setdefault(p.spec.node_name, []).append(p)
-    for name, plist in by_node.items():
-        node = nodes.get(name)
-        assert node is not None, f"pod bound to unknown node {name}"
-        # 1. capacity: sum of requests <= trimmed allocatable per axis
-        total = np.zeros_like(estimate_node_allocatable(node))
-        for p in plist:
-            total = total + p.spec.requests.to_vector()
-        alloc = estimate_node_allocatable(node)
-        over = total > alloc + 1e-3
-        assert not over.any(), (
-            f"node {name} overcommitted: {total[over]} > {alloc[over]}")
-        # 2. hostPorts: no (protocol, port) bound twice
-        seen = set()
-        for p in plist:
-            for slot in p.spec.host_ports:
-                assert slot not in seen, (
-                    f"hostPort {slot} double-bound on {name}")
-                seen.add(slot)
-        # 3. volume limit
-        if node.attachable_volume_limit > 0:
-            claims = set()
-            for p in plist:
-                claims.update(
-                    f"{p.meta.namespace}/{c}" for c in p.spec.pvc_names)
-            assert len(claims) <= node.attachable_volume_limit, (
-                f"node {name} exceeds volume limit")
-    # 4. gang all-or-nothing: a gang with any bound member has >= min bound
-    gangs = {g.meta.key: g for g in store.list(KIND_POD_GROUP)}
-    bound_per_gang = {}
-    for p in pods:
-        g = p.gang_key
-        if g:
-            bound_per_gang[g] = bound_per_gang.get(g, 0) + 1
-    for g, count in bound_per_gang.items():
-        pg = gangs.get(g)
-        if pg is not None:
-            assert count >= pg.min_member, (
-                f"gang {g} partially bound: {count} < {pg.min_member}")
+    breaches = check_invariants(store)
+    assert not breaches, breaches
 
 
 def test_churn_soak_25_cycles():

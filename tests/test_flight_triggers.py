@@ -1,0 +1,199 @@
+"""Flight-recorder trigger coverage beyond the serial path.
+
+PR 5 pinned the ``cycle_exception`` trigger only through the serial
+driver (`test_explain.test_cycle_exception_triggers_dump` patches
+`_run_cycle_traced` wholesale). The fused-wave and mesh drivers have
+their own failure surfaces — the wave replay after a successful
+dispatch, and the ladder-exhausted path where even the host fallback
+dies — and both must leave a schema-valid wreck behind and re-raise.
+This file pins them, plus the ladder's own ``degradation`` dump reason.
+"""
+
+import pytest
+
+from koordinator_tpu.obs.flight import load_bundle
+from koordinator_tpu.scheduler import metrics as scheduler_metrics
+from koordinator_tpu.scheduler.cycle import Scheduler
+from koordinator_tpu.scheduler.degrade import (
+    LEVEL_HOST_FALLBACK,
+    DegradationLadder,
+)
+from koordinator_tpu.scheduler.pipeline_parity import build_store_from_state
+from koordinator_tpu.testing import synth_full_cluster
+
+NOW = 1_000_000.0
+
+
+def make_world(nodes=8, pods=24, seed=9):
+    _cluster, state = synth_full_cluster(
+        nodes, pods, seed=seed, num_quotas=0, num_gangs=0)
+    return state, build_store_from_state(state)
+
+
+def _dump_reason_count(reason: str) -> float:
+    return scheduler_metrics.FLIGHT_DUMPS.get(reason=reason) or 0.0
+
+
+def test_cycle_exception_dump_under_fused_waves(monkeypatch):
+    """An exception in the WAVE REPLAY (after a clean fused dispatch —
+    not a dispatch failure, so the ladder must NOT absorb it) dumps the
+    flight ring with the wreck record and re-raises."""
+    from koordinator_tpu.api.objects import ObjectMeta, Pod, PodSpec
+    from koordinator_tpu.api.resources import ResourceList
+    from koordinator_tpu.client.store import KIND_POD
+
+    state, store = make_world()
+    sched = Scheduler(store, waves=4, explain="off")
+    sched.run_cycle(now=state.now)  # a healthy cycle in the ring first
+    for i in range(6):  # fresh pending pods so the second cycle binds
+        store.add(KIND_POD, Pod(
+            meta=ObjectMeta(name=f"fresh-{i}", namespace="t",
+                            uid=f"fresh-{i}",
+                            creation_timestamp=state.now + 1),
+            spec=PodSpec(requests=ResourceList.of(cpu=200,
+                                                  memory=1 << 28))))
+    before = sched.flight.dumps
+    metric_before = _dump_reason_count("cycle_exception")
+
+    def boom(*a, **k):
+        raise RuntimeError("bind exploded mid-replay")
+
+    monkeypatch.setattr(sched, "_reserve_and_bind", boom)
+    with pytest.raises(RuntimeError, match="mid-replay"):
+        sched.run_cycle(now=state.now + 5)
+    assert sched.flight.dumps == before + 1
+    assert _dump_reason_count("cycle_exception") == metric_before + 1
+    records = sched.flight.snapshot()
+    assert records[-1]["error"].startswith("RuntimeError")
+    # the wreck came from the fused driver: its kernel span ran with waves
+    kernel = [s for s in records[-1]["spans"] if s["name"] == "kernel"]
+    assert kernel and kernel[0]["attrs"].get("waves") == "4"
+    # the ladder saw no DISPATCH failure: no demotion happened
+    assert sched.ladder.level == 0
+    _h, _r, errors = load_bundle(sched.flight.dump("post").splitlines())
+    assert not errors, errors
+
+
+def test_cycle_exception_dump_when_ladder_exhausted_on_mesh(
+        monkeypatch, cpu_devices):
+    """The mesh path's worst case: every device dispatch fails AND the
+    host fallback itself dies. The ladder walks mesh -> ... -> host
+    fallback (degradation dumps along the way), the bottom rung raises,
+    and the cycle driver dumps cycle_exception + re-raises — the ladder
+    never turns a genuinely unservable cycle into silence."""
+    state, store = make_world()
+    sched = Scheduler(store, waves=1, explain="off", mesh=2,
+                      ladder=DegradationLadder(promote_after=4))
+    sched.fault_injector = lambda stage: (_ for _ in ()).throw(
+        RuntimeError(f"device dead ({stage})"))
+    import koordinator_tpu.scheduler.cycle as cycle_mod
+
+    def host_dead(fc, pods, n_real):
+        raise RuntimeError("host fallback dead too")
+
+    monkeypatch.setattr(cycle_mod, "host_fallback_schedule", host_dead)
+    degr_before = _dump_reason_count("degradation")
+    exc_before = _dump_reason_count("cycle_exception")
+    with pytest.raises(RuntimeError, match="host fallback dead"):
+        sched.run_cycle(now=state.now)
+    assert sched.ladder.level == LEVEL_HOST_FALLBACK
+    # one degradation dump per demotion: full -> no-mesh, then (waves and
+    # explain were never on, so those rungs are skipped) -> host-fallback
+    assert _dump_reason_count("degradation") == degr_before + 2
+    assert _dump_reason_count("cycle_exception") == exc_before + 1
+    records = sched.flight.snapshot()
+    assert "host fallback dead" in records[-1]["error"]
+    _h, _r, errors = load_bundle(sched.flight.dump("post").splitlines())
+    assert not errors, errors
+
+
+def test_degradation_dump_carries_prior_cycles(cpu_devices):
+    """A ladder transition dumps the ring: the bundle holds the healthy
+    cycles BEFORE the incident — the incident context — and validates."""
+    from koordinator_tpu.api.objects import ObjectMeta, Pod, PodSpec
+    from koordinator_tpu.api.resources import ResourceList
+    from koordinator_tpu.client.store import KIND_POD
+
+    state, store = make_world()
+    sched = Scheduler(store, waves=1, explain="off", mesh=2,
+                      ladder=DegradationLadder(promote_after=4))
+    sched.run_cycle(now=state.now)
+    sched.run_cycle(now=state.now + 5)
+    for i in range(4):  # fresh pending pods so the next cycle dispatches
+        store.add(KIND_POD, Pod(
+            meta=ObjectMeta(name=f"fresh-{i}", namespace="t",
+                            uid=f"fresh-{i}",
+                            creation_timestamp=state.now + 6),
+            spec=PodSpec(requests=ResourceList.of(cpu=200,
+                                                  memory=1 << 28))))
+    budget = {"n": 2}
+
+    def flaky(stage):
+        if budget["n"] > 0:
+            budget["n"] -= 1
+            raise RuntimeError("transient mesh fault")
+
+    sched.fault_injector = flaky
+    before = sched.flight.dumps
+    res = sched.run_cycle(now=state.now + 10)  # retry fails -> demote, succeeds
+    assert res.duration_seconds > 0
+    assert sched.ladder.level == 1  # no-mesh
+    assert sched.flight.dumps == before + 1
+    body = sched.flight.dump("post")
+    header, records, errors = load_bundle(body.splitlines())
+    assert not errors, errors
+    assert len(records) >= 2  # the pre-incident cycles are in the bundle
+
+
+def test_deferred_store_write_failure_bypasses_the_ladder(monkeypatch):
+    """A store-write failure in the deferred condition flush runs INSIDE
+    the dispatch window (pipeline overlap), but it is a host/store
+    fault, not a device fault: the ladder must not absorb it — no
+    retry, no demotion (shedding device capability cannot fix a store)
+    — it re-raises as a cycle exception and dumps the wreck."""
+    from koordinator_tpu.api.objects import Node, ObjectMeta, Pod, PodSpec
+    from koordinator_tpu.api.resources import ResourceList
+    from koordinator_tpu.client.store import KIND_NODE, KIND_POD, ObjectStore
+    from koordinator_tpu.scheduler.cycle import CyclePipeline
+
+    store = ObjectStore()
+    store.add(KIND_NODE, Node(
+        meta=ObjectMeta(name="n0", namespace=""),
+        allocatable=ResourceList.of(cpu=2000, memory=8 << 30, pods=20)))
+
+    def pend(name, cpu):
+        store.add(KIND_POD, Pod(
+            meta=ObjectMeta(name=name, uid=name, creation_timestamp=NOW),
+            spec=PodSpec(requests=ResourceList.of(cpu=cpu,
+                                                  memory=1 << 28))))
+
+    pend("too-big", 64000)  # unschedulable: its condition write defers
+    sched = Scheduler(store)
+    pipeline = CyclePipeline(sched, enabled=True)
+    pipeline.run_cycle(now=NOW)
+    assert len(sched._deferred_diagnose) == 1
+
+    metric_before = _dump_reason_count("cycle_exception")
+    retries_before = (scheduler_metrics.DISPATCH_RETRIES.get(stage="serial")
+                      or 0.0)
+    orig_update = store.update
+
+    def faulty_update(kind, obj, **kw):
+        if getattr(getattr(obj, "meta", None), "key", "") == (
+                "default/too-big"):
+            raise RuntimeError("injected store-write fault")
+        return orig_update(kind, obj, **kw)
+
+    monkeypatch.setattr(store, "update", faulty_update)
+    pend("late", 500)  # next cycle has a kernel window -> in-window flush
+    with pytest.raises(RuntimeError, match="store-write fault"):
+        pipeline.run_cycle(now=NOW + 2)
+    # the ladder saw nothing: still full, no transition, no retry counted
+    assert sched.ladder.level == 0
+    assert sched.ladder.transitions == []
+    assert (scheduler_metrics.DISPATCH_RETRIES.get(stage="serial")
+            or 0.0) == retries_before
+    # but the flight recorder kept the wreck
+    assert _dump_reason_count("cycle_exception") == metric_before + 1
+    records = sched.flight.snapshot()
+    assert records[-1]["error"].startswith("RuntimeError")

@@ -648,3 +648,117 @@ class TestScanBound:
         # admission is consulted for all of them (evaluation stops at the
         # best-scoring search's natural end, not at a 100-node cap)
         assert calls["n"] == n_feasible, calls
+
+
+class TestGangVictimGuard:
+    """Preemption must never break a bound gang below its min_member —
+    the all-or-nothing barrier the admission kernel enforced at bind
+    time (found by the koordsim churn soak: priority-less gang members
+    were DefaultPreemption's favorite victims)."""
+
+    def _world(self, spare_members=0):
+        """One full node: a bound gang (min_member=3) with
+        3 + spare_members members, the rest filled by a non-gang low-prio
+        pod, plus a high-priority preemptor that needs one slot."""
+        from koordinator_tpu.api.objects import (
+            LABEL_POD_GROUP,
+            ObjectMeta,
+            PodGroup,
+        )
+        from koordinator_tpu.client.store import KIND_POD_GROUP
+
+        helper = TestDefaultPreemption()
+        members = 3 + spare_members
+        store = helper._store(nodes=1, cores=members + 1)
+        store.add(KIND_POD_GROUP, PodGroup(
+            meta=ObjectMeta(name="g", namespace="default",
+                            creation_timestamp=1_000_000.0),
+            min_member=3))
+        for i in range(members):
+            helper._pod(store, f"gm-{i}", cpu=1000, prio=100, node="n0",
+                        labels={LABEL_POD_GROUP: "g"})
+        helper._pod(store, "plain-low", cpu=1000, prio=50, node="n0")
+        helper._pod(store, "vip", cpu=1000, prio=9000)
+        return helper, store
+
+    def test_gang_at_min_member_is_never_a_victim(self):
+        from koordinator_tpu.scheduler.cycle import Scheduler
+
+        _helper, store = self._world(spare_members=0)
+        result = Scheduler(store).run_cycle(now=1_000_000.0)
+        # the non-gang pod is the only admissible victim — the gang
+        # stays whole even though its members are lower-priority-ordered
+        # AFTER plain-low in the candidate sort
+        assert result.preempted_victims == ["default/plain-low"]
+        assert any(b.pod_key == "default/vip" for b in result.bound)
+        from koordinator_tpu.sim.invariants import check_invariants
+
+        assert check_invariants(store) == []
+
+    def test_spare_gang_members_stay_preemptible(self):
+        from koordinator_tpu.scheduler.preempt import (
+            DefaultPreemption,
+            GangVictimGuard,
+        )
+
+        helper, store = self._world(spare_members=2)
+        guard = GangVictimGuard(store)
+        pods = {f"gm-{i}" for i in range(5)}
+        from koordinator_tpu.client.store import KIND_POD
+
+        members = [p for p in store.list(KIND_POD)
+                   if p.meta.name in pods]
+        # 5 bound, min 3: two spares — individually unprotected
+        assert all(not guard.protected(p) for p in members)
+        # but a victim SET overdrawing the spare count is inadmissible
+        assert guard.admissible(members[:2])
+        assert not guard.admissible(members[:3])
+        guard.commit(members[:2])
+        assert all(guard.protected(p) for p in members)
+
+    def test_quota_preemption_respects_gang_min_member(self):
+        """The ElasticQuota reclaim path shares the guard, driven through
+        the REAL cycle: a quota-starved high-priority pod whose only
+        victims are gang members at min_member reclaims nothing (the
+        gang stays whole and the pod stays pending); give the gang
+        spares and the same cycle evicts exactly the spare count."""
+        from koordinator_tpu.api.objects import LABEL_POD_GROUP, PodGroup
+        from koordinator_tpu.client.store import KIND_POD_GROUP
+        from koordinator_tpu.scheduler.cycle import Scheduler
+        from koordinator_tpu.sim.invariants import check_invariants
+
+        def world(min_member):
+            store = _store(num_nodes=1, cores=4)
+            _quota(store, cpu=4000)
+            store.add(KIND_POD_GROUP, PodGroup(
+                meta=ObjectMeta(name="g", namespace="default",
+                                creation_timestamp=NOW - 500.0),
+                min_member=min_member))
+            for i in range(4):
+                _pod(store, f"gm-{i}", cpu=1000, prio=6000, node="node-0",
+                     labels={LABEL_POD_GROUP: "g"})
+            high = _pod(store, "high", cpu=2000, prio=9500)
+            return store, high
+
+        # all 4 bound members needed for min_member: no victim set can
+        # help without breaking all-or-nothing — refuse outright
+        store, high = world(min_member=4)
+        result = Scheduler(store).run_cycle(now=NOW)
+        assert not result.preempted_victims
+        assert not any(b.pod_key == high.meta.key for b in result.bound)
+        gang_bound = [p for p in store.list(KIND_POD)
+                      if p.gang_key and p.is_assigned
+                      and not p.is_terminated]
+        assert len(gang_bound) == 4
+        assert check_invariants(store) == []
+
+        # two spares: reclaim takes exactly the spares, never below min
+        store, high = world(min_member=2)
+        result = Scheduler(store).run_cycle(now=NOW)
+        assert len(result.preempted_victims) == 2
+        assert any(b.pod_key == high.meta.key for b in result.bound)
+        gang_bound = [p for p in store.list(KIND_POD)
+                      if p.gang_key and p.is_assigned
+                      and not p.is_terminated]
+        assert len(gang_bound) == 2
+        assert check_invariants(store) == []

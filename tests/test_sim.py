@@ -1,0 +1,365 @@
+"""koordsim + the degradation ladder: the robustness tentpole's gates.
+
+Three layers:
+
+  * DegradationLadder unit mechanics (no jax): retry-once policy, rung
+    skipping, exponential re-promotion backoff.
+  * Seeded scenarios through the REAL Scheduler: the smoke scenario is
+    clean and deterministic; the fault-ladder scenario walks mesh ->
+    single-device -> serial -> no-explain -> host-fallback and back
+    while binding pods with ZERO invariant breaches (the acceptance
+    pin); store-write and sidecar faults degrade without wedging.
+  * The 1000-cycle soak rides the `slow` marker (hack/lint.sh runs the
+    smoke determinism gate; bench.py --churn runs any scenario as an
+    A/B pair).
+"""
+
+import dataclasses
+
+import pytest
+
+from koordinator_tpu.scheduler.degrade import (
+    LEVEL_FULL,
+    LEVEL_HOST_FALLBACK,
+    LEVEL_NO_EXPLAIN,
+    LEVEL_NO_MESH,
+    LEVEL_SERIAL_WAVES,
+    DegradationLadder,
+)
+from koordinator_tpu.sim import (
+    Fault,
+    FaultPlan,
+    InjectedFault,
+    Scenario,
+    SCENARIOS,
+    check_invariants,
+)
+from koordinator_tpu.sim.harness import run_scenario
+
+ALL_FEATURES = {"mesh": True, "waves": True, "explain": True}
+NO_FEATURES = {"mesh": False, "waves": False, "explain": False}
+
+
+# ---------------------------------------------------------------------------
+# ladder unit mechanics
+# ---------------------------------------------------------------------------
+
+
+class TestDegradationLadder:
+    def test_retry_once_then_demote_walks_every_rung(self):
+        ladder = DegradationLadder(promote_after=4)
+        ladder.begin_pass()
+        seen = []
+        for _ in range(8):  # 2 failures per rung: retry, then demote
+            seen.append(ladder.on_failure(ALL_FEATURES, error="boom"))
+        assert seen == ["retry", "demoted"] * 4
+        assert ladder.level == LEVEL_HOST_FALLBACK
+        assert [t["to_level"] for t in ladder.transitions] == [
+            LEVEL_NO_MESH, LEVEL_SERIAL_WAVES, LEVEL_NO_EXPLAIN,
+            LEVEL_HOST_FALLBACK]
+        # the bottom rung has nothing below it
+        assert ladder.on_failure(ALL_FEATURES) == "retry"
+        assert ladder.on_failure(ALL_FEATURES) == "exhausted"
+
+    def test_meaningless_rungs_are_skipped(self):
+        ladder = DegradationLadder(promote_after=4)
+        ladder.begin_pass()
+        ladder.on_failure(NO_FEATURES)
+        assert ladder.on_failure(NO_FEATURES) == "demoted"
+        # nothing is configured: the only rung that changes anything is
+        # the host fallback
+        assert ladder.level == LEVEL_HOST_FALLBACK
+        # and the promotion mirror jumps straight back to full (the
+        # failing cycle itself does not count clean: 1 + promote_after)
+        for _ in range(5):
+            ladder.note_cycle()
+        assert ladder.level == LEVEL_FULL
+
+    def test_promotion_probes_one_rung_per_window(self):
+        ladder = DegradationLadder(promote_after=3)
+        ladder.begin_pass()
+        for _ in range(8):
+            ladder.on_failure(ALL_FEATURES)
+        assert ladder.level == LEVEL_HOST_FALLBACK
+        levels = []
+        for _ in range(13):
+            ladder.note_cycle()
+            levels.append(ladder.level)
+        # note 1 retires the failed cycle (not clean), then every 3 clean
+        # cycles climb one rung
+        assert levels == [4, 4, 4, 3, 3, 3, 2, 2, 2, 1, 1, 1, 0]
+
+    def test_failed_probe_doubles_the_backoff(self):
+        ladder = DegradationLadder(promote_after=2, max_promote_after=8)
+        ladder.begin_pass()
+        for _ in range(8):
+            ladder.on_failure(ALL_FEATURES)
+        for _ in range(3):  # failed cycle + 2 clean
+            ladder.note_cycle()
+        assert ladder.level == LEVEL_NO_EXPLAIN  # promoted: probation on
+        # the probe fails inside the probation window
+        ladder.begin_pass()
+        ladder.on_failure(ALL_FEATURES)
+        ladder.on_failure(ALL_FEATURES)
+        assert ladder.level == LEVEL_HOST_FALLBACK
+        assert ladder.promote_after == 4  # doubled
+        for _ in range(5):  # failed cycle + 4 clean
+            ladder.note_cycle()
+        assert ladder.level == LEVEL_NO_EXPLAIN
+        # fail the next probe too -> doubled again, capped at 8
+        ladder.begin_pass()
+        ladder.on_failure(ALL_FEATURES)
+        ladder.on_failure(ALL_FEATURES)
+        assert ladder.promote_after == 8
+
+    def test_surviving_probation_resets_the_backoff(self):
+        ladder = DegradationLadder(promote_after=2, max_promote_after=64)
+        ladder.begin_pass()
+        for _ in range(4):
+            ladder.on_failure(NO_FEATURES)  # -> host fallback
+        for _ in range(3):  # failed cycle + 2 clean -> promote to full
+            ladder.note_cycle()
+        assert ladder.level == LEVEL_FULL
+        ladder.begin_pass()
+        ladder.on_failure(NO_FEATURES)
+        ladder.on_failure(NO_FEATURES)  # probe failed -> backoff doubles
+        assert ladder.promote_after == 4
+        for _ in range(5):  # failed cycle + 4 clean -> promote to full
+            ladder.note_cycle()
+        assert ladder.level == LEVEL_FULL
+        # probation = base (2) clean cycles, then the backoff resets
+        ladder.note_cycle()
+        ladder.note_cycle()
+        assert ladder.promote_after == 2
+
+    def test_failed_cycle_does_not_count_clean(self):
+        ladder = DegradationLadder(promote_after=2)
+        ladder.begin_pass()
+        ladder.on_failure(NO_FEATURES)
+        ladder.on_failure(NO_FEATURES)
+        assert ladder.level == LEVEL_HOST_FALLBACK
+        ladder.note_cycle()  # the cycle that failed: not clean
+        ladder.note_cycle()
+        assert ladder.level == LEVEL_HOST_FALLBACK  # only 1 clean so far
+        ladder.note_cycle()
+        assert ladder.level == LEVEL_FULL
+
+
+# ---------------------------------------------------------------------------
+# fault plan mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_budgets_fire_at_their_cycle():
+    plan = FaultPlan([Fault(cycle=2, kind="dispatch", count=2)])
+    plan.begin_cycle(0)
+    plan.dispatch_hook("serial")  # no budget: no raise
+    plan.begin_cycle(2)
+    with pytest.raises(InjectedFault):
+        plan.dispatch_hook("serial")
+    with pytest.raises(InjectedFault):
+        plan.dispatch_hook("fused")
+    plan.dispatch_hook("serial")  # budget exhausted
+    assert [f["kind"] for f in plan.injected] == ["dispatch", "dispatch"]
+
+
+def test_invariant_checker_catches_seeded_breaches():
+    from koordinator_tpu.api.objects import Node, ObjectMeta, Pod, PodSpec
+    from koordinator_tpu.api.resources import ResourceList
+    from koordinator_tpu.client.store import KIND_NODE, KIND_POD, ObjectStore
+
+    GIB = 1024 ** 3
+    store = ObjectStore()
+    store.add(KIND_NODE, Node(meta=ObjectMeta(name="n0", namespace=""),
+                              allocatable=ResourceList.of(
+                                  cpu=1000, memory=GIB, pods=10)))
+    for i in range(2):
+        pod = Pod(meta=ObjectMeta(name=f"p{i}", namespace="sim",
+                                  uid=f"p{i}"),
+                  spec=PodSpec(requests=ResourceList.of(cpu=800,
+                                                        memory=GIB // 2)))
+        pod.spec.node_name = "n0"
+        pod.spec.host_ports.append(("TCP", 80))
+        store.add(KIND_POD, pod)
+    breaches = check_invariants(store)
+    assert any("overcommitted" in b for b in breaches)
+    assert any("double-bound" in b for b in breaches)
+
+
+# ---------------------------------------------------------------------------
+# seeded scenarios through the real Scheduler
+# ---------------------------------------------------------------------------
+
+
+def _mini(name, **kw):
+    base = dict(name=name, seed=23, cycles=8, nodes=6, arrival_rate=4.0,
+                departure_rate=1.0, be_fraction=0.3, queue_cap=64,
+                ttb_slo_seconds=600.0, promote_after=3)
+    base.update(kw)
+    return Scenario(**base)
+
+
+def test_smoke_scenario_zero_breaches_and_ladder_round_trip():
+    sc = dataclasses.replace(SCENARIOS["smoke"], cycles=35)
+    report = run_scenario(sc)
+    assert report.invariant_breaches == []
+    assert report.cycle_exceptions == []
+    assert report.pods_bound > 50
+    # the cycle-20 dispatch-fault burst demoted (no mesh/waves/explain
+    # configured, so straight to the host fallback) and promoted back
+    walked = [(t["from"], t["to"]) for t in report.ladder_transitions]
+    assert ("full", "host-fallback") in walked
+    assert report.final_level == "full"
+    assert report.cycles_at_level.get("host-fallback", 0) > 0
+    # the degraded window kept binding (the whole point of the ladder)
+    degraded_cycles = {c for c in range(20, 27)}
+    assert any(int(line.split("\t")[0]) in degraded_cycles
+               for line in report.binding_log)
+    assert report.flight_dumps >= 2  # one per transition at least
+    # SLO surface is populated
+    assert report.ttb_seconds and report.percentile(99) >= 0.0
+
+
+def test_smoke_scenario_is_deterministic():
+    sc = dataclasses.replace(SCENARIOS["smoke"], cycles=12)
+    a = run_scenario(sc)
+    b = run_scenario(sc)
+    assert a.binding_log == b.binding_log
+    assert a.binding_log_sha256 == b.binding_log_sha256
+    assert a.pods_created == b.pods_created
+
+
+def test_fault_ladder_walks_mesh_to_host_and_repromotes(cpu_devices):
+    """The acceptance pin: with mesh + fused waves + explain all on and
+    a dispatch-fault storm mid-soak, the scheduler demotes mesh ->
+    single-device -> serial -> no-explain -> host fallback, KEEPS
+    binding pods with zero invariant breaches, records every transition
+    (flight recorder + gauge), and re-promotes to full after N clean
+    cycles."""
+    from koordinator_tpu.scheduler import metrics as scheduler_metrics
+
+    sc = dataclasses.replace(SCENARIOS["fault-ladder"], cycles=35)
+    report = run_scenario(sc)
+    assert report.invariant_breaches == []
+    assert report.cycle_exceptions == []
+    walk = [(t["from"], t["to"]) for t in report.ladder_transitions]
+    assert walk[:4] == [
+        ("full", "no-mesh"),
+        ("no-mesh", "serial-waves"),
+        ("serial-waves", "no-explain"),
+        ("no-explain", "host-fallback"),
+    ]
+    # re-promotion probes climb back rung by rung to full
+    assert walk[4:] == [
+        ("host-fallback", "no-explain"),
+        ("no-explain", "serial-waves"),
+        ("serial-waves", "no-mesh"),
+        ("no-mesh", "full"),
+    ]
+    assert report.final_level == "full"
+    assert scheduler_metrics.DEGRADED_LEVEL.get() == 0.0
+    # every rung was lived in AND pods bound while degraded
+    for level in ("no-mesh", "serial-waves", "no-explain", "host-fallback"):
+        assert report.cycles_at_level.get(level, 0) > 0, level
+    degraded = {c for c in range(10, 30)}
+    assert any(int(line.split("\t")[0]) in degraded
+               for line in report.binding_log)
+    # one flight dump per transition, and the retry counters moved
+    assert report.flight_dumps >= len(report.ladder_transitions)
+    retries = dict(
+        (labels["stage"], v)
+        for labels, v in scheduler_metrics.DISPATCH_RETRIES.samples())
+    assert retries.get("fused", 0) + retries.get("serial", 0) >= 8
+
+
+def test_store_write_fault_dumps_and_recovers():
+    sc = _mini("store-fault", faults=(
+        Fault(cycle=3, kind="store_write", count=1),))
+    report = run_scenario(sc)
+    # the ladder deliberately does NOT absorb store-write failures: the
+    # cycle raised, flight-dumped, and the next cycle carried on
+    assert len(report.cycle_exceptions) == 1
+    assert "InjectedFault" in report.cycle_exceptions[0]
+    assert report.invariant_breaches == []
+    assert report.flight_dumps >= 1
+    assert any(int(line.split("\t")[0]) > 3 for line in report.binding_log)
+
+
+def test_sidecar_fault_degrades_to_local_step():
+    sc = _mini("sidecar-fault", faults=(
+        Fault(cycle=2, kind="sidecar", count=2),))
+    report = run_scenario(sc)
+    assert report.sidecar_fallbacks == 2
+    assert report.invariant_breaches == []
+    assert report.cycle_exceptions == []
+    assert report.pods_bound > 0
+
+
+def test_backpressure_sheds_and_requeues():
+    sc = _mini("backpressure", cycles=10, arrival_rate=2.0,
+               queue_cap=8, overflow_cap=10,
+               burst_every=2, burst_size=40)
+    report = run_scenario(sc)
+    assert report.max_pending <= 8
+    assert report.pods_shed > 0
+    assert report.pods_requeued > 0
+    assert report.max_overflow <= 10
+    assert report.invariant_breaches == []
+
+
+def test_drain_and_spot_reclaim_keep_invariants():
+    sc = _mini("churny", cycles=14, nodes=8, arrival_rate=5.0,
+               drain_every=4, drain_uncordon_after=3,
+               spot_reclaim_every=3, spot_reclaim_count=3,
+               metric_flip_every=5, quota_rebalance_every=6,
+               gang_every=5, gang_size=3, descheduler_every=4)
+    report = run_scenario(sc)
+    assert report.invariant_breaches == []
+    assert report.pods_drained > 0
+    assert report.pods_reclaimed > 0
+    assert report.pods_bound > 0
+    assert report.descheduler_runs > 0  # the REAL descheduler rode along
+
+
+def test_host_fallback_holds_invariants_under_permanent_device_loss():
+    """Device never comes back: every cycle runs the pure-host pass.
+    Capacity/hostPort invariants must hold through sustained churn."""
+    sc = _mini("dead-device", cycles=12, arrival_rate=6.0,
+               faults=(Fault(cycle=0, kind="dispatch", count=10**6),))
+    report = run_scenario(sc)
+    assert report.invariant_breaches == []
+    assert report.final_level == "host-fallback"
+    assert report.pods_bound > 20  # the fallback really binds
+    assert report.cycle_exceptions == []
+
+
+@pytest.mark.slow
+def test_soak_1000_cycles_clean():
+    """The acceptance soak: 1000 cycles of sustained churn with gang
+    storms, drains, spot reclamation, metric flips, quota rebalances and
+    dispatch/store-write/sidecar faults mid-soak. Zero invariant
+    breaches; the SLO report (p99 time-to-bind) is the CHURN_r01.json
+    deliverable (python -m koordinator_tpu.sim soak --out CHURN_r01.json).
+    """
+    report = run_scenario(SCENARIOS["soak"])
+    assert report.invariant_breaches == []
+    # the store-write fault is the ONLY expected cycle exception
+    assert len(report.cycle_exceptions) <= 1
+    assert report.pods_bound > 2000
+    assert report.final_level == "full"
+    assert report.descheduler_runs > 0
+    # the p99 time-to-bind SLO verdict is REPORTED (CHURN_r01.json);
+    # pass/fail against the target is load- and backend-dependent data,
+    # not a structural gate
+    assert report.ttb_seconds and report.percentile(99) > 0.0
+
+
+def test_cli_list_and_usage_contract(capsys):
+    from koordinator_tpu.sim.__main__ import main
+
+    assert main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for name in SCENARIOS:
+        assert name in out
+    assert main(["no-such-scenario"]) == 4
+    assert main([]) == 4  # no scenario given: usage error after catalog

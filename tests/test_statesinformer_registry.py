@@ -228,3 +228,35 @@ def test_device_informer_publishes_device_cr():
     device = store.get(KIND_DEVICE, f"/{NODE}")
     assert [d.health for d in device.devices] == [True, False]
     assert device.meta.resource_version != rv
+
+
+def test_device_probe_failure_is_counted_and_logged_once(monkeypatch, caplog):
+    """A failing accelerator probe must never be silent: every failure
+    increments koord_koordlet_informer_errors_total and the first one
+    per stage logs a warning (the old bare `except Exception` swallowed
+    both — the koordlint silent-exception-swallow rule now guards the
+    gated paths against the same shape)."""
+    import logging
+
+    import jax
+
+    from koordinator_tpu.koordlet import metrics as koordlet_metrics
+    from koordinator_tpu.koordlet import statesinformer
+
+    def boom():
+        raise RuntimeError("device backend exploded")
+
+    monkeypatch.setattr(jax, "devices", boom)
+    monkeypatch.setattr(statesinformer, "_DEVICE_PROBE_LOGGED", set())
+    before = koordlet_metrics.INFORMER_ERRORS_TOTAL.get(
+        informer="deviceInformer", stage="jax_devices") or 0.0
+    with caplog.at_level(logging.WARNING,
+                         logger="koordinator_tpu.koordlet.statesinformer"):
+        assert statesinformer.collect_tpu_devices() == []
+        assert statesinformer.collect_tpu_devices() == []
+    after = koordlet_metrics.INFORMER_ERRORS_TOTAL.get(
+        informer="deviceInformer", stage="jax_devices")
+    assert after == before + 2.0  # counted EVERY time
+    probe_logs = [r for r in caplog.records
+                  if "device probe jax_devices failed" in r.message]
+    assert len(probe_logs) == 1  # logged once, not per poll

@@ -359,6 +359,115 @@ class TestExceptSwallow:
         assert not findings_for(src, self.RULE)
 
 
+class TestSilentExceptionSwallow:
+    """The error-severity swallow gate for the dispatch-critical paths
+    (scheduler/, obs/, parallel/, sim/): pass/continue AND the
+    return-a-constant shape (the koordlet device-probe bug) are errors
+    there; handled/logged/re-raised bodies and ungated modules stay
+    legal."""
+
+    RULE = "silent-exception-swallow"
+    GATED = "koordinator_tpu/scheduler/mod.py"
+
+    def test_positive_pass_continue_and_constant_return(self):
+        src = """
+            def f():
+                try:
+                    work()
+                except Exception:
+                    pass
+
+            def g(items):
+                for i in items:
+                    try:
+                        work(i)
+                    except:
+                        continue
+
+            def probe():
+                try:
+                    return expensive()
+                except Exception:
+                    return []
+
+            def flag():
+                try:
+                    return expensive()
+                except BaseException:
+                    return None
+        """
+        assert len(findings_for(src, self.RULE, path=self.GATED)) == 4
+
+    def test_positive_in_every_gated_package(self):
+        src = """
+            def f():
+                try:
+                    work()
+                except Exception:
+                    pass
+        """
+        for path in ("koordinator_tpu/scheduler/cycle.py",
+                     "koordinator_tpu/obs/flight.py",
+                     "koordinator_tpu/parallel/mesh.py",
+                     "koordinator_tpu/sim/harness.py"):
+            assert findings_for(src, self.RULE, path=path), path
+
+    def test_negative_handled_logged_or_reraised(self):
+        src = """
+            import logging
+            logger = logging.getLogger(__name__)
+
+            def f():
+                try:
+                    work()
+                except Exception:
+                    logger.exception("work failed")
+
+            def g(counter):
+                try:
+                    work()
+                except Exception as e:
+                    counter.inc(stage="work")
+                    raise
+
+            def h(report):
+                try:
+                    work()
+                except Exception as e:
+                    report.append(str(e))
+                    return None
+
+            def narrow():
+                try:
+                    return expensive()
+                except KeyError:
+                    return []
+        """
+        assert not findings_for(src, self.RULE, path=self.GATED)
+
+    def test_negative_outside_gated_paths(self):
+        src = """
+            def f():
+                try:
+                    work()
+                except Exception:
+                    return []
+        """
+        assert not findings_for(src, self.RULE,
+                                path="koordinator_tpu/koordlet/mod.py")
+
+    def test_pragma_suppresses(self):
+        src = """
+            def f():
+                try:
+                    work()
+                # koordlint: disable=silent-exception-swallow
+                except Exception:
+                    pass
+        """
+        assert not findings_for(src, self.RULE, path=self.GATED)
+
+
 class TestSharedMutableGlobal:
     RULE = "shared-mutable-global"
     PATH = "koordinator_tpu/koordlet/fake.py"
