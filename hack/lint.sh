@@ -27,7 +27,13 @@ echo "== serial-vs-pipelined + fused-wave + explain + mesh cycle parity =="
 # cycle sharded over 1/2/4/8-device meshes — serial, fused K=4, and with
 # explain=counts on top — must be byte-identical to single-device (the
 # harness forces the 8-way virtual CPU device split itself).
-JAX_PLATFORMS=cpu python -m koordinator_tpu.scheduler.pipeline_parity
+# Also gates the overlapped wave replay (KOORD_TPU_REPLAY_OVERLAP):
+# run_replay_overlap_parity diffs the chained in-flight replay against
+# the serial-replay twin at K in {1,2,4,8}; the env pin below makes the
+# fused-wave + mesh gates run WITH overlap enabled (both worlds), so
+# every parity property above holds under the overlap architecture too.
+KOORD_TPU_REPLAY_OVERLAP=1 JAX_PLATFORMS=cpu \
+    python -m koordinator_tpu.scheduler.pipeline_parity
 
 echo "== obs trace schema (golden fixture) =="
 # the CLI exits non-zero on any schema drift against the checked-in trace;
@@ -49,7 +55,10 @@ echo "== koordsim seeded smoke scenario (determinism + invariants) =="
 # byte-identical binding log; --max-breaches 0 fails the gate on ANY
 # store-level invariant breach (koordinator_tpu/sim/invariants.py). This
 # keeps the gate structural — wall-clock numbers stay in bench.py.
-JAX_PLATFORMS=cpu python -m koordinator_tpu.sim smoke \
+# overlap pinned on: the byte-stability of the seeded scenario must hold
+# under the overlapped-replay architecture (decisions are parity-gated
+# identical, so the binding log cannot move)
+KOORD_TPU_REPLAY_OVERLAP=1 JAX_PLATFORMS=cpu python -m koordinator_tpu.sim smoke \
     --check-determinism --max-breaches 0 --quiet > /dev/null
 
 echo "lint OK"

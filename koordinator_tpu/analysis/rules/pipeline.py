@@ -166,6 +166,66 @@ class UnshardedTransferInMeshPath(Rule):
                     "annotate the intent with a pragma")
 
 
+# the fused wave-replay loop (scheduler/cycle.py _replay_*/_fused_wave_*
+# functions): per-pod store writes inside it are exactly what the
+# overlapped-replay architecture batches away — a bind patch or condition
+# write issued per pod re-serializes the replay against the store (lock +
+# event fan-out per object) while the next wave executes. All writes must
+# route through the designated batched flush sites (store.update_many
+# bind transactions, the deferred-condition flush), which carry pragmas.
+_SCHED_PATH_RE = re.compile(r"scheduler/[^/]+\.py$")
+_REPLAY_FUNC_RE = re.compile(r"(replay|fused_wave|fused_no_node)")
+_STORE_WRITE_TAILS = {"update", "add", "upsert", "delete", "update_many"}
+
+
+def _is_store_receiver(node: ast.AST) -> bool:
+    """self.store.update(...) / store.update(...) / self._store.add(...)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("store", "_store")
+    if isinstance(node, ast.Name):
+        return node.id in ("store", "_store")
+    return False
+
+
+@register
+class StoreWriteInWaveReplayLoop(Rule):
+    name = "store-write-in-wave-replay-loop"
+    severity = "error"
+    description = (
+        "per-pod store write inside the fused wave-replay loop "
+        "(scheduler/ functions named *replay*/*fused_wave*): the "
+        "overlapped replay drains host work while the device executes "
+        "the next wave, and per-object store calls re-serialize it "
+        "against the store's lock and event fan-out — route bind patches "
+        "and condition writes through the batched flush "
+        "(store.update_many / the deferred-condition flush) or mark a "
+        "designated flush site with # koordlint: disable")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not _SCHED_PATH_RE.search(ctx.path):
+            return
+        seen = set()
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _REPLAY_FUNC_RE.search(fn.name):
+                continue
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _STORE_WRITE_TAILS
+                        and _is_store_receiver(node.func.value)
+                        and id(node) not in seen):
+                    seen.add(id(node))
+                    yield self.finding(
+                        ctx, node,
+                        f"store.{node.func.attr} inside the wave-replay "
+                        "loop — per-pod writes re-serialize the "
+                        "overlapped replay; batch through update_many or "
+                        "the deferred-condition flush (pragma the "
+                        "designated flush site)")
+
+
 @register
 class BlockingReadbackInPipeline(Rule):
     name = "blocking-readback-in-pipeline"

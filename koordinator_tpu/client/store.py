@@ -167,6 +167,57 @@ class ObjectStore:
         self._notify(handlers, EventType.MODIFIED, obj, old)
         return obj
 
+    def update_many(self, kind: str, objs: List[Any]) -> List[Any]:
+        """Vectorized update transaction: N updates of one kind under
+        TWO lock acquisitions (admission pre-read + apply) instead of
+        2N+. Each object still gets its own resourceVersion bump and its
+        own MODIFIED event (handlers receive the identical (obj, old)
+        pairs, in order, that N sequential ``update`` calls would
+        deliver — only the lock round-trips are amortized; a mid-batch
+        failure (missing key, admission rejection) applies and notifies
+        the prefix, then raises, exactly like the sequential loop. One
+        batching departure: admission interceptors see the pre-batch
+        ``old`` side, not the just-applied prefix). The
+        scheduler's wave-replay batches (bind patches per wave, the
+        deferred condition flush) route through this so a K-wave dispatch
+        pays one store transaction per batch instead of one per pod."""
+        if not objs:
+            return objs
+        with self._lock:  # one locked pre-read for the admission olds
+            col = self._collections[kind]
+            olds = [col.objects.get(_key_of(obj)) for obj in objs]
+        admitted: List[Any] = []
+        failure: Optional[Exception] = None
+        for obj, old in zip(objs, olds):
+            try:
+                self._admit(kind, obj, old=old)
+            except Exception as exc:  # admission rejection: stop where
+                failure = exc         # the sequential loop would
+                break
+            admitted.append(obj)
+        events: List[tuple] = []
+        with self._lock:
+            col = self._collections[kind]
+            for obj in admitted:
+                key = _key_of(obj)
+                old = col.objects.get(key)
+                if old is None:
+                    # stop exactly where N sequential updates would: the
+                    # applied prefix keeps its rv bumps AND (below) its
+                    # MODIFIED events before the KeyError surfaces
+                    failure = KeyError(f"{kind} {key} not found")
+                    break
+                self._rv += 1
+                obj.meta.resource_version = self._rv
+                col.objects[key] = obj
+                events.append((obj, old))
+            handlers = list(col.handlers)
+        for obj, old in events:
+            self._notify(handlers, EventType.MODIFIED, obj, old)
+        if failure is not None:
+            raise failure
+        return objs
+
     def upsert(self, kind: str, obj: Any) -> Any:
         with self._lock:
             exists = _key_of(obj) in self._collections[kind].objects

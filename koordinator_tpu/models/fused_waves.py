@@ -35,15 +35,28 @@ to what serial cycle w's snapshot would contain. A pod rejected in wave i
 because a node filled up (or a gang's quota was transiently held) retries
 in wave i+1 on-device, with no host round-trip.
 
+The ONE wave body (``_make_wave_body``) backs two dispatch shapes:
+
+  * ``build_fused_wave_step`` — all K waves under ``lax.while_loop`` in
+    one program, compacted (pod_idx, node_idx, zone) readback at the
+    end. Early exit: a wave that commits nothing proves the fixpoint.
+    This is the ``KOORD_TPU_REPLAY_OVERLAP=0`` path: the host replay of
+    every wave runs serially after the single readback.
+  * ``build_chained_wave_step`` — ONE wave per dispatch with the carried
+    state staying on device between dispatches. The cycle driver
+    (scheduler/cycle.py) dispatches wave w+1 asynchronously BEFORE
+    syncing wave w's rows, so the host-side replay of wave w overlaps
+    device execution of wave w+1 — the replay queue architecture. The
+    step is K-independent, so every wave depth shares one compiled
+    program. Tracing the SAME wave body keeps the chain bit-identical
+    to the fused while_loop (pipeline_parity.run_replay_overlap_parity
+    gates it).
+
 Readback is COMPACTED: a (pod_idx, node_idx, zone) binding buffer plus
 per-wave bound counts — not K full assignment vectors and none of the
 score/state matrices. The driver (scheduler/cycle.py) replays the waves
 host-side as logical cycles; scheduler/pipeline_parity.py gates that a
 fused-K cycle is byte-identical to K sequential single-round cycles.
-
-Waves run under ``lax.while_loop`` with early exit: a wave that commits
-nothing proves the fixpoint (the next wave would see identical state), so
-the remaining waves cost nothing on device.
 
 Known demotions (the driver falls back to K=1, the exact serial path):
 pending Reservation CRs (a CR bound in wave 1 changes the next cycle's
@@ -77,6 +90,21 @@ from koordinator_tpu.ops.numa import numa_zone_for_node
 
 MAX_WAVES = 8  # bounds the compile-cache key space; auto-K never exceeds it
 
+# carried wave state (the chain step's explicit carry): index layout of
+# the first 12 slots of the while_loop carry — scheduler/cycle.py builds
+# the initial tuple via initial_wave_carry and threads the chain's output
+# carry back in unchanged
+WAVE_STATE_FIELDS = (
+    "assigned", "requested", "est_sum", "numa_free", "bind_free",
+    "quota_used", "aff_count", "anti_cover", "aff_exists", "port_used",
+    "vol_free", "gang_assumed",
+)
+NUM_WAVE_STATE = len(WAVE_STATE_FIELDS)
+# wave-state slots indexed [N, ...] (node axis): sharded over the mesh in
+# the sharded chain step; everything else (pod/quota/gang/term axes)
+# replicated. est_sum (slot 2) is the node-axis LoadAware estimate sum.
+WAVE_STATE_NODE_SLOTS = frozenset({1, 2, 3, 4, 6, 7, 9, 10})
+
 
 class FusedWaveOut(NamedTuple):
     """Compacted readback of one fused dispatch."""
@@ -86,6 +114,228 @@ class FusedWaveOut(NamedTuple):
     bind_zones: jnp.ndarray   # [P] int32 replay-state NUMA zone (-1 = spread)
     wave_counts: jnp.ndarray  # [K] int32 bindings committed per wave
     waves_run: jnp.ndarray    # scalar int32 wave bodies actually executed
+
+
+class WaveChainOut(NamedTuple):
+    """Compacted readback of ONE chained wave dispatch."""
+
+    bind_pods: jnp.ndarray   # [P] int32 this wave's pod rows in bind order
+    bind_nodes: jnp.ndarray  # [P] int32 node index per binding
+    bind_zones: jnp.ndarray  # [P] int32 replay-state NUMA zone (-1 = spread)
+    count: jnp.ndarray       # scalar int32 bindings this wave (0 = fixpoint)
+
+
+def _check_wave_args(args: LoadAwareArgs) -> None:
+    if args.score_according_prod_usage:
+        # the prod-branch term is not carried in split form; the driver
+        # demotes to the serial path before ever building this step
+        raise ValueError("fused waves do not support "
+                         "score_according_prod_usage — use the serial step")
+
+
+def _make_wave_body(fc: FullChainInputs, la_adj, n_real, weight_idx,
+                    bal_idx, num_gangs: int, num_groups: int, explain):
+    """The ONE wave body both dispatch shapes trace.
+
+    ``carry`` layout: WAVE_STATE_FIELDS (12 slots), then out_pods /
+    out_nodes / out_zones / n_out / wave_counts, then [ex_counts]
+    [ex_terms] under koordexplain, then (w, done). Returns the same
+    layout with w+1 and the fixpoint flag. Extracted verbatim from the
+    original while_loop body so the fused step and the chained step
+    cannot drift — byte parity between them is by construction of the
+    trace, and pipeline_parity gates it empirically.
+    """
+    inputs = fc.base
+    P, R = inputs.fit_requests.shape
+    N = inputs.allocatable.shape[0]
+    prod_mode = False
+    explain_full = explain == "full"
+
+    def wave_body(carry):
+        (assigned, requested, est_sum, numa_free, bind_free, quota_used,
+         aff_count, anti_cover, aff_exists, port_used, vol_free,
+         gang_assumed, out_pods, out_nodes, out_zones, n_out,
+         wave_counts) = carry[:17]
+        w, done = carry[-2], carry[-1]
+        if explain is not None:
+            ex_counts = carry[17]
+            ex_terms = carry[18] if explain_full else None
+
+        # the round's LoadAware base term, rebuilt-association exact:
+        # est_sum folds committed estimates in bind order onto the
+        # host's initial sum, then ONE add of the adjusted usage
+        term = est_sum + la_adj
+        active = inputs.pod_valid & ~assigned
+        fc_w = fc._replace(base=inputs._replace(
+            la_term_nonprod=term, pod_valid=active))
+        evaluate = make_pod_evaluator(fc_w, weight_idx, prod_mode,
+                                      bal_idx,
+                                      explain_terms=explain_full)
+
+        if explain is not None:
+            # per-wave attribution at wave-START state: the counts the
+            # driver's logical cycle w formats for pods it leaves
+            # unbound (diagnose.py reads wave-start state, see
+            # _WaveStateMirror)
+            filter_state = (requested, numa_free, bind_free, quota_used,
+                            aff_count, anti_cover, aff_exists,
+                            port_used, vol_free)
+            counts_w = explain_stage_counts(fc_w, evaluate, filter_state,
+                                            n_real)
+            ex_counts = jax.lax.dynamic_update_slice(
+                ex_counts, counts_w[None], (w, 0, 0))
+
+        # ---- pass 1: the serial round (identical tracing to
+        # build_full_chain_step's body — decisions are by construction
+        # what serial cycle w's kernel would decide)
+        def body(i, state):
+            if explain_full:
+                chain_state, wterms, chosen = (state[:-2], state[-2],
+                                               state[-1])
+                (found, best, zone_at_best, _adm, score, _b, best_v,
+                 la_row, numa_row, pref_row) = evaluate(i, *chain_state)
+                runner = jnp.maximum(jnp.max(jnp.where(
+                    jnp.arange(N, dtype=jnp.int32) == best,
+                    -jnp.inf, score)), -1.0)
+                wterms = wterms.at[i].set(jnp.stack([
+                    la_row[best], numa_row[best], pref_row[best],
+                    best_v, runner]))
+            else:
+                chain_state, chosen = state[:-1], state[-1]
+                found, best, zone_at_best, _adm, _s, _b, _mv = evaluate(
+                    i, *chain_state)
+            chain_state = commit_pod_state(
+                fc_w, prod_mode, chain_state, i, found, best,
+                zone_at_best)
+            chosen = chosen.at[i].set(
+                jnp.where(found, best.astype(jnp.int32), -1))
+            if explain_full:
+                return chain_state + (wterms, chosen)
+            return chain_state + (chosen,)
+
+        init = (
+            requested,
+            jnp.zeros((N, R), jnp.float32),
+            jnp.zeros((N, R), jnp.float32),
+            numa_free,
+            bind_free,
+            quota_used,
+            aff_count,
+            anti_cover,
+            aff_exists,
+            port_used,
+            vol_free,
+        )
+        if explain_full:
+            init = init + (
+                jnp.zeros((P, len(EXPLAIN_TERMS)), jnp.float32),)
+        init = init + (jnp.full(P, -1, jnp.int32),)
+        pass1 = jax.lax.fori_loop(0, P, body, init)
+        chosen = pass1[-1]
+        wave_terms = pass1[-2] if explain_full else None
+
+        # ---- Permit barrier against the CARRIED assumed counters
+        keep = gang_permit_mask(
+            chosen, fc.gang_id, fc.gang_min_member, gang_assumed,
+            fc.gang_group_id, num_gangs, num_groups,
+        )
+        kept = (chosen >= 0) & keep
+        kept_count = jnp.sum(kept.astype(jnp.int32))
+        if explain_full:
+            # the wave that finally KEEPS a pod owns its attribution
+            # row (a Permit-reverted choice never persisted host-side)
+            ex_terms = jnp.where(kept[:, None], wave_terms, ex_terms)
+
+        # ---- pass 2: kept-only replay from the WAVE-START state.
+        # Reverted gang reservations never persisted host-side, so the
+        # next wave's base state commits only survivors, in bind
+        # order; est_sum rides the delta_np slot so the fold order
+        # matches the assign-cache append order, and the NUMA zone is
+        # re-picked under replay state (= what the host plugin's
+        # Reserve sees).
+        def rbody(i, st):
+            chain_state = st[:11]
+            out_p, out_n, out_z, cnt = st[11:]
+            k = kept[i]
+            best = jnp.maximum(chosen[i], 0)
+            zone = numa_zone_for_node(
+                fc.requests[i], fc.needs_numa[i],
+                chain_state[3][best], fc.numa_policy[best])
+            chain_state = commit_pod_state(
+                fc_w, prod_mode, chain_state, i, k, best, zone)
+            slot = jnp.where(k, cnt, P)
+            out_p = out_p.at[slot].set(i, mode="drop")
+            out_n = out_n.at[slot].set(chosen[i], mode="drop")
+            out_z = out_z.at[slot].set(zone, mode="drop")
+            return chain_state + (out_p, out_n, out_z,
+                                  cnt + k.astype(jnp.int32))
+
+        rinit = (
+            requested,
+            est_sum,                       # delta_np slot: the carry
+            jnp.zeros((N, R), jnp.float32),  # delta_pr: dead (prod off)
+            numa_free,
+            bind_free,
+            quota_used,
+            aff_count,
+            anti_cover,
+            aff_exists,
+            port_used,
+            vol_free,
+            out_pods, out_nodes, out_zones, n_out,
+        )
+        rout = jax.lax.fori_loop(0, P, rbody, rinit)
+        (requested, est_sum, _dpr, numa_free, bind_free, quota_used,
+         aff_count, anti_cover, aff_exists, port_used, vol_free,
+         out_pods, out_nodes, out_zones, n_out) = rout
+
+        in_gang = fc.gang_id >= 0
+        gang_assumed = gang_assumed + jax.ops.segment_sum(
+            (kept & in_gang).astype(jnp.float32),
+            jnp.maximum(fc.gang_id, 0), num_segments=num_gangs)
+        assigned = assigned | kept
+        wave_counts = wave_counts.at[w].set(kept_count)
+        # a zero-commit wave is a fixpoint: the next wave would see
+        # identical state and commit nothing again
+        done = kept_count == 0
+        new_carry = (assigned, requested, est_sum, numa_free, bind_free,
+                     quota_used, aff_count, anti_cover, aff_exists,
+                     port_used, vol_free, gang_assumed, out_pods,
+                     out_nodes, out_zones, n_out, wave_counts)
+        if explain is not None:
+            new_carry = new_carry + (ex_counts,)
+            if explain_full:
+                new_carry = new_carry + (ex_terms,)
+        return new_carry + (w + 1, done)
+
+    return wave_body
+
+
+def initial_wave_carry(fc: FullChainInputs, la_est, explain=None):
+    """The chain step's wave-0 carry (WAVE_STATE_FIELDS layout), built
+    from the same (possibly device-resident/sharded) arrays the fused
+    init consumes. ``la_est`` is the LoadAware ``la_est_nonprod`` side
+    array. Under koordexplain "full" the carry also holds the per-pod
+    score-term rows (kept-wave-wins across the chain)."""
+    P = fc.base.fit_requests.shape[0]
+    carry = (
+        jnp.zeros(P, bool),
+        fc.base.requested,
+        la_est,
+        fc.numa_free,
+        fc.bind_free,
+        fc.quota_used,
+        fc.aff_count,
+        fc.anti_cover,
+        jnp.asarray(fc.aff_exists, bool),
+        fc.port_used,
+        fc.vol_free,
+        fc.gang_assumed,
+    )
+    if explain == "full":
+        carry = carry + (
+            jnp.zeros((P, len(EXPLAIN_TERMS)), jnp.float32),)
+    return carry
 
 
 def build_fused_wave_step(args: LoadAwareArgs, num_gangs: int,
@@ -109,195 +359,27 @@ def build_fused_wave_step(args: LoadAwareArgs, num_gangs: int,
     """
     if not 1 <= waves <= MAX_WAVES:
         raise ValueError(f"waves must be in [1, {MAX_WAVES}], got {waves}")
-    if args.score_according_prod_usage:
-        # the prod-branch term is not carried in split form; the driver
-        # demotes to the serial path before ever building this step
-        raise ValueError("fused waves do not support "
-                         "score_according_prod_usage — use the serial step")
+    _check_wave_args(args)
     weight_idx = resolve_weight_idx(args, active_axes)
     bal_idx = resolve_balance_idx(active_axes)
-    prod_mode = False
     explain_full = explain == "full"
 
     def _step_impl(fc: FullChainInputs, la_est, la_adj, n_real):
         inputs = fc.base
-        P, R = inputs.fit_requests.shape
-        N = inputs.allocatable.shape[0]
+        P, _R = inputs.fit_requests.shape
 
-        def wave_body(carry):
-            (assigned, requested, est_sum, numa_free, bind_free, quota_used,
-             aff_count, anti_cover, aff_exists, port_used, vol_free,
-             gang_assumed, out_pods, out_nodes, out_zones, n_out,
-             wave_counts) = carry[:17]
-            w, done = carry[-2], carry[-1]
-            if explain is not None:
-                ex_counts = carry[17]
-                ex_terms = carry[18] if explain_full else None
-
-            # the round's LoadAware base term, rebuilt-association exact:
-            # est_sum folds committed estimates in bind order onto the
-            # host's initial sum, then ONE add of the adjusted usage
-            term = est_sum + la_adj
-            active = inputs.pod_valid & ~assigned
-            fc_w = fc._replace(base=inputs._replace(
-                la_term_nonprod=term, pod_valid=active))
-            evaluate = make_pod_evaluator(fc_w, weight_idx, prod_mode,
-                                          bal_idx,
-                                          explain_terms=explain_full)
-
-            if explain is not None:
-                # per-wave attribution at wave-START state: the counts the
-                # driver's logical cycle w formats for pods it leaves
-                # unbound (diagnose.py reads wave-start state, see
-                # _WaveStateMirror)
-                filter_state = (requested, numa_free, bind_free, quota_used,
-                                aff_count, anti_cover, aff_exists,
-                                port_used, vol_free)
-                counts_w = explain_stage_counts(fc_w, evaluate, filter_state,
-                                                n_real)
-                ex_counts = jax.lax.dynamic_update_slice(
-                    ex_counts, counts_w[None], (w, 0, 0))
-
-            # ---- pass 1: the serial round (identical tracing to
-            # build_full_chain_step's body — decisions are by construction
-            # what serial cycle w's kernel would decide)
-            def body(i, state):
-                if explain_full:
-                    chain_state, wterms, chosen = (state[:-2], state[-2],
-                                                   state[-1])
-                    (found, best, zone_at_best, _adm, score, _b, best_v,
-                     la_row, numa_row, pref_row) = evaluate(i, *chain_state)
-                    runner = jnp.maximum(jnp.max(jnp.where(
-                        jnp.arange(N, dtype=jnp.int32) == best,
-                        -jnp.inf, score)), -1.0)
-                    wterms = wterms.at[i].set(jnp.stack([
-                        la_row[best], numa_row[best], pref_row[best],
-                        best_v, runner]))
-                else:
-                    chain_state, chosen = state[:-1], state[-1]
-                    found, best, zone_at_best, _adm, _s, _b, _mv = evaluate(
-                        i, *chain_state)
-                chain_state = commit_pod_state(
-                    fc_w, prod_mode, chain_state, i, found, best,
-                    zone_at_best)
-                chosen = chosen.at[i].set(
-                    jnp.where(found, best.astype(jnp.int32), -1))
-                if explain_full:
-                    return chain_state + (wterms, chosen)
-                return chain_state + (chosen,)
-
-            init = (
-                requested,
-                jnp.zeros((N, R), jnp.float32),
-                jnp.zeros((N, R), jnp.float32),
-                numa_free,
-                bind_free,
-                quota_used,
-                aff_count,
-                anti_cover,
-                aff_exists,
-                port_used,
-                vol_free,
-            )
-            if explain_full:
-                init = init + (
-                    jnp.zeros((P, len(EXPLAIN_TERMS)), jnp.float32),)
-            init = init + (jnp.full(P, -1, jnp.int32),)
-            pass1 = jax.lax.fori_loop(0, P, body, init)
-            chosen = pass1[-1]
-            wave_terms = pass1[-2] if explain_full else None
-
-            # ---- Permit barrier against the CARRIED assumed counters
-            keep = gang_permit_mask(
-                chosen, fc.gang_id, fc.gang_min_member, gang_assumed,
-                fc.gang_group_id, num_gangs, num_groups,
-            )
-            kept = (chosen >= 0) & keep
-            kept_count = jnp.sum(kept.astype(jnp.int32))
-            if explain_full:
-                # the wave that finally KEEPS a pod owns its attribution
-                # row (a Permit-reverted choice never persisted host-side)
-                ex_terms = jnp.where(kept[:, None], wave_terms, ex_terms)
-
-            # ---- pass 2: kept-only replay from the WAVE-START state.
-            # Reverted gang reservations never persisted host-side, so the
-            # next wave's base state commits only survivors, in bind
-            # order; est_sum rides the delta_np slot so the fold order
-            # matches the assign-cache append order, and the NUMA zone is
-            # re-picked under replay state (= what the host plugin's
-            # Reserve sees).
-            def rbody(i, st):
-                chain_state = st[:11]
-                out_p, out_n, out_z, cnt = st[11:]
-                k = kept[i]
-                best = jnp.maximum(chosen[i], 0)
-                zone = numa_zone_for_node(
-                    fc.requests[i], fc.needs_numa[i],
-                    chain_state[3][best], fc.numa_policy[best])
-                chain_state = commit_pod_state(
-                    fc_w, prod_mode, chain_state, i, k, best, zone)
-                slot = jnp.where(k, cnt, P)
-                out_p = out_p.at[slot].set(i, mode="drop")
-                out_n = out_n.at[slot].set(chosen[i], mode="drop")
-                out_z = out_z.at[slot].set(zone, mode="drop")
-                return chain_state + (out_p, out_n, out_z,
-                                      cnt + k.astype(jnp.int32))
-
-            rinit = (
-                requested,
-                est_sum,                       # delta_np slot: the carry
-                jnp.zeros((N, R), jnp.float32),  # delta_pr: dead (prod off)
-                numa_free,
-                bind_free,
-                quota_used,
-                aff_count,
-                anti_cover,
-                aff_exists,
-                port_used,
-                vol_free,
-                out_pods, out_nodes, out_zones, n_out,
-            )
-            rout = jax.lax.fori_loop(0, P, rbody, rinit)
-            (requested, est_sum, _dpr, numa_free, bind_free, quota_used,
-             aff_count, anti_cover, aff_exists, port_used, vol_free,
-             out_pods, out_nodes, out_zones, n_out) = rout
-
-            in_gang = fc.gang_id >= 0
-            gang_assumed = gang_assumed + jax.ops.segment_sum(
-                (kept & in_gang).astype(jnp.float32),
-                jnp.maximum(fc.gang_id, 0), num_segments=num_gangs)
-            assigned = assigned | kept
-            wave_counts = wave_counts.at[w].set(kept_count)
-            # a zero-commit wave is a fixpoint: the next wave would see
-            # identical state and commit nothing again
-            done = kept_count == 0
-            new_carry = (assigned, requested, est_sum, numa_free, bind_free,
-                         quota_used, aff_count, anti_cover, aff_exists,
-                         port_used, vol_free, gang_assumed, out_pods,
-                         out_nodes, out_zones, n_out, wave_counts)
-            if explain is not None:
-                new_carry = new_carry + (ex_counts,)
-                if explain_full:
-                    new_carry = new_carry + (ex_terms,)
-            return new_carry + (w + 1, done)
+        wave_body = _make_wave_body(fc, la_adj, n_real, weight_idx,
+                                    bal_idx, num_gangs, num_groups,
+                                    explain)
 
         def cond(carry):
             w, done = carry[-2], carry[-1]
             return (w < waves) & ~done
 
-        init = (
-            jnp.zeros(P, bool),
-            inputs.requested,
-            la_est,
-            fc.numa_free,
-            fc.bind_free,
-            fc.quota_used,
-            fc.aff_count,
-            fc.anti_cover,
-            jnp.asarray(fc.aff_exists, bool),
-            fc.port_used,
-            fc.vol_free,
-            fc.gang_assumed,
+        # the 12 parity-critical wave-state slots come from the SAME
+        # builder the chain's wave-0 carry uses — the two dispatch
+        # shapes cannot desynchronize their initial state
+        init = initial_wave_carry(fc, la_est) + (
             jnp.full(P, -1, jnp.int32),
             jnp.full(P, -1, jnp.int32),
             jnp.full(P, -1, jnp.int32),
@@ -325,5 +407,69 @@ def build_fused_wave_step(args: LoadAwareArgs, num_gangs: int,
     else:
         def step(fc: FullChainInputs, la_est, la_adj, n_real):
             return _step_impl(fc, la_est, la_adj, n_real)
+
+    return jax.jit(step) if jit else step
+
+
+def build_chained_wave_step(args: LoadAwareArgs, num_gangs: int,
+                            num_groups: int, jit: bool = True,
+                            active_axes=None, explain=None):
+    """ONE wave per dispatch, carried state on device between dispatches.
+
+    (FullChainInputs, carry, la_adj[N, R]) -> (carry', WaveChainOut),
+    where ``carry`` is the initial_wave_carry tuple (or a previous
+    dispatch's output carry — the arrays never leave the device between
+    waves). Under koordexplain the step takes the extra ``n_real``
+    operand and returns (carry', WaveChainOut, counts_row[P, S]) — this
+    wave's attribution at wave-START state, the exact row the fused
+    step's [K, P, S] buffer holds at index w.
+
+    K-independent by construction: the cycle driver chains as many
+    dispatches as the wave budget needs, so every K shares one compiled
+    program, and — the point of the chain — wave w+1 can be dispatched
+    BEFORE wave w's rows are read back, overlapping the host replay of
+    wave w with device execution of wave w+1. A zero ``count`` readback
+    is the fixpoint signal (the fused while_loop's early exit); the
+    driver stops consuming there.
+    """
+    _check_wave_args(args)
+    weight_idx = resolve_weight_idx(args, active_axes)
+    bal_idx = resolve_balance_idx(active_axes)
+    explain_full = explain == "full"
+
+    def _step_impl(fc: FullChainInputs, carry, la_adj, n_real):
+        P = fc.base.fit_requests.shape[0]
+        wave_body = _make_wave_body(fc, la_adj, n_real, weight_idx,
+                                    bal_idx, num_gangs, num_groups,
+                                    explain)
+        full = tuple(carry[:NUM_WAVE_STATE]) + (
+            jnp.full(P, -1, jnp.int32),
+            jnp.full(P, -1, jnp.int32),
+            jnp.full(P, -1, jnp.int32),
+            jnp.int32(0),
+            jnp.zeros(1, jnp.int32),
+        )
+        if explain is not None:
+            full = full + (
+                jnp.zeros((1, P, NUM_EXPLAIN_STAGES), jnp.uint32),)
+            if explain_full:
+                full = full + (carry[NUM_WAVE_STATE],)
+        full = full + (jnp.int32(0), jnp.bool_(False))
+        out = wave_body(full)
+        new_carry = tuple(out[:NUM_WAVE_STATE])
+        if explain_full:
+            new_carry = new_carry + (out[18],)
+        rows = WaveChainOut(bind_pods=out[12], bind_nodes=out[13],
+                            bind_zones=out[14], count=out[15])
+        if explain is None:
+            return new_carry, rows
+        return new_carry, rows, out[17][0]
+
+    if explain is None:
+        def step(fc: FullChainInputs, carry, la_adj):
+            return _step_impl(fc, carry, la_adj, None)
+    else:
+        def step(fc: FullChainInputs, carry, la_adj, n_real):
+            return _step_impl(fc, carry, la_adj, n_real)
 
     return jax.jit(step) if jit else step

@@ -22,7 +22,9 @@ from koordinator_tpu.parallel.mesh import (  # noqa: F401
     build_sharded_score_matrix,
 )
 from koordinator_tpu.parallel.full_chain_mesh import (  # noqa: F401
+    build_sharded_chained_wave_step,
     build_sharded_fused_wave_step,
     build_sharded_full_chain_step,
     shard_full_chain_inputs,
+    wave_carry_shardings,
 )

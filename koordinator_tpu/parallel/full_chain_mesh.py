@@ -152,3 +152,58 @@ def build_sharded_fused_wave_step(
         out_shardings = (
             fw_out, ExplainOut(rep, rep if explain == "full" else None))
     return jax.jit(raw, out_shardings=out_shardings)
+
+
+def wave_carry_shardings(mesh: Mesh, explain=None):
+    """Shardings for the chained wave step's carry tuple: node-axis state
+    slots sharded flat over the mesh (the same layout the fused carry has
+    inside the sharded while_loop), pod/quota/gang/term slots replicated.
+    Used both for the step's out_shardings (so the carried state never
+    leaves its shard between wave dispatches) and by the driver to place
+    the few host-created wave-0 slots (put_on_mesh)."""
+    from koordinator_tpu.models.fused_waves import (
+        NUM_WAVE_STATE,
+        WAVE_STATE_NODE_SLOTS,
+    )
+
+    node = NamedSharding(mesh, _node_axis_spec(mesh, flat=True))
+    rep = NamedSharding(mesh, P())
+    carry = tuple(node if i in WAVE_STATE_NODE_SLOTS else rep
+                  for i in range(NUM_WAVE_STATE))
+    if explain == "full":
+        carry = carry + (rep,)  # per-pod score-term rows
+    return carry
+
+
+def build_sharded_chained_wave_step(
+    args: LoadAwareArgs,
+    num_gangs: int,
+    num_groups: int,
+    mesh: Mesh,
+    active_axes=None,
+    explain=None,
+):
+    """One chained wave (models/fused_waves.build_chained_wave_step)
+    jitted over the mesh: the overlapped-replay dispatch unit.
+
+    The carry's node-axis slots are pinned to the flat node sharding on
+    OUTPUT, so chaining dispatches keeps every wave's filter/score rows
+    shard-local with no resharding between waves; the per-wave compacted
+    (pod, node, zone) rows come back replicated for the host merge
+    (parallel/mesh.merge_readback), exactly like the fused step's
+    buffers."""
+    from koordinator_tpu.models.fused_waves import (
+        WaveChainOut,
+        build_chained_wave_step,
+    )
+
+    raw = build_chained_wave_step(
+        args, num_gangs, num_groups, jit=False,
+        active_axes=active_axes, explain=explain,
+    )
+    rep = NamedSharding(mesh, P())
+    rows = WaveChainOut(rep, rep, rep, rep)
+    out_shardings = (wave_carry_shardings(mesh, explain=explain), rows)
+    if explain is not None:
+        out_shardings = out_shardings + (rep,)  # this wave's counts row
+    return jax.jit(raw, out_shardings=out_shardings)

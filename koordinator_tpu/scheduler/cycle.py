@@ -123,6 +123,18 @@ def explain_from_env():
     return None
 
 
+def replay_overlap_from_env() -> bool:
+    """KOORD_TPU_REPLAY_OVERLAP=0 restores the single-program fused
+    dispatch whose host replay runs strictly serially after the one
+    readback — the byte-exact parity twin. Default on: the fused
+    dispatch runs as a CHAIN of per-wave device programs
+    (models/fused_waves.build_chained_wave_step) and the host replays
+    logical cycle w while the device executes wave w+1."""
+    import os
+
+    return os.environ.get("KOORD_TPU_REPLAY_OVERLAP", "1") != "0"
+
+
 def cycle_deadline_from_env():
     """KOORD_TPU_CYCLE_DEADLINE_MS=N arms the flight recorder's
     deadline-overrun trigger: a cycle slower than N ms dumps the ring.
@@ -181,6 +193,32 @@ class _HostWriteFailure(Exception):
     The dispatch wrappers unwrap and re-raise the original error, which
     then propagates as an unhandled cycle exception (flight recorder
     ``cycle_exception`` trigger), exactly as it did pre-ladder."""
+
+
+class _DeferredFlushTxn:
+    """Read-your-writes view for one deferred-condition flush: patches
+    accumulate here and land as ONE ``store.update_many`` transaction
+    (the vectorized store write the wave-replay batching promises),
+    while later entries in the same flush still see earlier entries'
+    patches — the sequential supersede/idempotence semantics of per-pod
+    writes are preserved exactly; only the per-pod lock round-trips and
+    duplicate MODIFIED events for re-verdicted pods are gone."""
+
+    def __init__(self, store: ObjectStore) -> None:
+        self._store = store
+        self.pending: Dict[str, Pod] = {}
+
+    def get(self, key: str) -> Optional[Pod]:
+        obj = self.pending.get(key)
+        return obj if obj is not None else self._store.get(KIND_POD, key)
+
+    def put(self, obj: Pod) -> None:
+        self.pending[obj.meta.key] = obj
+
+    def flush(self) -> None:
+        if self.pending:
+            self._store.update_many(KIND_POD, list(self.pending.values()))
+            self.pending.clear()
 
 
 class _WaveStateMirror:
@@ -277,6 +315,7 @@ class Scheduler:
         explain=None,
         mesh=None,
         ladder=None,
+        replay_overlap=None,
     ):
         from koordinator_tpu.scheduler.config import SchedulerConfiguration
         from koordinator_tpu.scheduler.plugins.reservation import (
@@ -357,6 +396,13 @@ class Scheduler:
         # (models/fused_waves.py). "auto" picks from queue depth per
         # cycle; an int pins it. K=1 always takes the exact serial path.
         self.waves_spec = waves_from_env() if waves is None else waves
+        # overlapped wave replay (KOORD_TPU_REPLAY_OVERLAP): the fused
+        # dispatch becomes a chain of per-wave programs and the host
+        # drains the replay queue while the device runs the next wave.
+        # An explicit argument pins it (the parity twins need that).
+        self.replay_overlap = (replay_overlap_from_env()
+                               if replay_overlap is None
+                               else bool(replay_overlap))
         # koordexplain (KOORD_TPU_EXPLAIN): None=off, "counts", "full".
         # An explicit "off" argument pins it off regardless of env (the
         # bench A/B pairs and parity twins need that determinism). Unknown
@@ -728,6 +774,41 @@ class Scheduler:
         self._step_cache[key] = step
         return step
 
+    def _get_chain_step(self, signature: Tuple, ng: int, ngroups: int,
+                        active, explain=None) -> object:
+        """The chained per-wave step (overlapped replay). NOTE: no wave
+        depth in the cache key — one compiled program serves every K,
+        which also collapses the fused path's per-K compile fan-out."""
+        from koordinator_tpu.models.fused_waves import (
+            build_chained_wave_step,
+        )
+
+        mesh_tag = self.mesh.devices.size if self.mesh is not None else 0
+        key = ("chain", signature, ng, ngroups, tuple(active), explain,
+               mesh_tag)
+        step = self._step_cache.get(key)
+        if step is not None:
+            self._last_step_compiled = False
+            scheduler_metrics.COMPILE_CACHE_HITS.inc()
+            return step
+        self._last_step_compiled = True
+        scheduler_metrics.COMPILE_CACHE_MISSES.inc()
+        with self.tracer.span("compile", signature=str(key)):
+            if self.mesh is not None:
+                from koordinator_tpu.parallel import (
+                    build_sharded_chained_wave_step,
+                )
+
+                step = build_sharded_chained_wave_step(
+                    self.args, ng, ngroups, mesh=self.mesh,
+                    active_axes=active, explain=explain)
+            else:
+                step = build_chained_wave_step(
+                    self.args, ng, ngroups, active_axes=active,
+                    explain=explain)
+        self._step_cache[key] = step
+        return step
+
     # ------------------------------------------------------------------
     # degradation ladder (scheduler/degrade.py)
     # ------------------------------------------------------------------
@@ -905,6 +986,13 @@ class Scheduler:
             raise
         result.duration_seconds = root.duration_seconds
         scheduler_metrics.CYCLE_SECONDS.observe(result.duration_seconds)
+        if result.duration_seconds > 0:
+            # device-busy fraction of this cycle: the "is the device the
+            # bottleneck yet" gauge (bench's pipeline_occupancy, now on
+            # /metrics). Clamped — the busy window is wall-clock around
+            # dispatch..last-readback and timer skew must not read >1.
+            scheduler_metrics.PIPELINE_OCCUPANCY.set(min(
+                1.0, result.device_busy_seconds / result.duration_seconds))
         if result.bound:
             scheduler_metrics.PODS_BOUND_TOTAL.inc(len(result.bound))
         self.extender.monitor.record(result)
@@ -1352,17 +1440,27 @@ class Scheduler:
         preserves the serial path's write sequence when a pod accumulates
         verdicts across cycles."""
         self._flushed_this_cycle = True
+        # overlapped-replay mode batches the whole flush into one store
+        # transaction; overlap=0 keeps the per-pod writes of the parity
+        # twin byte-for-byte (event granularity included)
+        txn = (_DeferredFlushTxn(self.store)
+               if self.replay_overlap and self._deferred_diagnose else None)
         while self._deferred_diagnose:
             items, last, now, messages = self._deferred_diagnose.pop(0)
             with self.tracer.span("diagnose", pods=str(len(items)),
                                   deferred="1"):
                 self._diagnose_and_write(items, last, now, deferred=True,
-                                         messages=messages)
+                                         messages=messages, txn=txn)
+        if txn is not None and txn.pending:
+            with self.tracer.span("store_flush",
+                                  writes=str(len(txn.pending))):
+                txn.flush()
         scheduler_metrics.DIAGNOSE_DEFERRED_DEPTH.set(
             float(len(self._deferred_diagnose)))
 
     def _diagnose_and_write(self, items, last, now: float,
-                            deferred: bool = False, messages=None) -> None:
+                            deferred: bool = False, messages=None,
+                            txn: Optional[_DeferredFlushTxn] = None) -> None:
         shared = None  # node-level diagnosis state, built once per cycle
         for pod, reason in items:
             msg = reason
@@ -1402,7 +1500,8 @@ class Scheduler:
                         logger.exception(
                             "unschedulability diagnosis failed for %s",
                             pod.meta.key)
-            stored = self.store.get(KIND_POD, pod.meta.key)
+            stored = (txn.get(pod.meta.key) if txn is not None
+                      else self.store.get(KIND_POD, pod.meta.key))
             if stored is None:  # reservation pseudo-pods, raced deletions
                 continue
             if deferred:
@@ -1434,7 +1533,10 @@ class Scheduler:
             patched = stored.patch_copy()
             patched.set_condition(
                 "PodScheduled", "False", "Unschedulable", msg, now)
-            self.store.update(KIND_POD, patched)
+            if txn is not None:
+                txn.put(patched)
+            else:
+                self.store.update(KIND_POD, patched)
 
     # ------------------------------------------------------------------
     def _resolve_admission(self):
@@ -1809,15 +1911,36 @@ class Scheduler:
         overwritten it."""
         self._defer_condition_writes = True
         try:
-            self._fused_wave_dispatch(pending, now, ctx, result,
-                                      pending_reservations, originals,
-                                      k_waves)
+            dispatch = (self._fused_wave_dispatch_overlap
+                        if self.replay_overlap
+                        else self._fused_wave_dispatch)
+            dispatch(pending, now, ctx, result,
+                     pending_reservations, originals, k_waves)
         finally:
             self._defer_condition_writes = False
             if not self.pipeline_mode and self._deferred_diagnose:
                 # ONE store-write flush for the whole dispatch (pipeline
                 # mode leaves the queue for the next kernel window)
                 self.flush_deferred()
+
+    def _fused_no_node_cycles(self, pending: List[Pod], now: float,
+                              result: CycleResult, k_waves: int) -> None:
+        """The serial early-return (no schedulable node), repeated K
+        times: every logical cycle re-dispatches the same verdicts
+        (idempotent condition writes, per-cycle failure-trail events —
+        exactly what K no-node serial cycles produce). Shared by the
+        fused and overlapped-replay dispatch paths."""
+        failed = [(p, "no schedulable node") for p in pending]
+        gang_plugin = self.extender.plugin("Coscheduling")
+        for _w in range(k_waves):
+            self._post_filter_preempt([], failed, result)
+            for pod, reason in failed:
+                result.failed.append(pod.meta.key)
+                self.extender.error_handlers.dispatch(pod, reason)
+            self._write_unschedulable_conditions([], failed, now)
+            result.waves += 1
+            if gang_plugin is not None:
+                gang_plugin.update_pod_group_status(self.store, now)
 
     def _fused_wave_dispatch(
         self,
@@ -1834,21 +1957,7 @@ class Scheduler:
         result.waves = 0
         enc = self._encode_batch(pending, now, ctx)
         if enc is None:
-            # the serial early-return, repeated K times: every logical
-            # cycle re-dispatches the same verdicts (idempotent condition
-            # writes, per-cycle failure-trail events — exactly what K
-            # no-node serial cycles produce)
-            failed = [(p, "no schedulable node") for p in pending]
-            gang_plugin = self.extender.plugin("Coscheduling")
-            for _w in range(k_waves):
-                self._post_filter_preempt([], failed, result)
-                for pod, reason in failed:
-                    result.failed.append(pod.meta.key)
-                    self.extender.error_handlers.dispatch(pod, reason)
-                self._write_unschedulable_conditions([], failed, now)
-                result.waves += 1
-                if gang_plugin is not None:
-                    gang_plugin.update_pod_group_status(self.store, now)
+            self._fused_no_node_cycles(pending, now, result, k_waves)
             return
         fc, pods, nodes, ng, ngroups, active = enc
         fc_host = fc  # the pre-upload host arrays feed the wave mirror
@@ -2100,6 +2209,556 @@ class Scheduler:
         self._last_batch = None
 
     # ------------------------------------------------------------------
+    # overlapped wave replay (KOORD_TPU_REPLAY_OVERLAP, the default)
+    # ------------------------------------------------------------------
+    def _initial_chain_carry(self, fc, la_est, explain):
+        """Wave-0 carried state for the chained dispatch, from the same
+        (device-resident when uploaded) arrays the fused init reads."""
+        from koordinator_tpu.models.fused_waves import initial_wave_carry
+
+        carry = initial_wave_carry(fc, la_est, explain=explain)
+        if self.mesh is not None:
+            carry = self._place_chain_carry_on_mesh(carry, explain)
+        return carry
+
+    def _place_chain_carry_on_mesh(self, carry, explain):
+        """Wave-0 carry placement for the mesh chain: node-axis slots
+        arrived sharded through the DeviceSnapshot upload and pass
+        through untouched; the host-created slots (the assigned mask,
+        the aff_exists coercion, quota/gang state, koordexplain term
+        rows) are placed REPLICATED via put_on_mesh so the first chain
+        dispatch never pays an implicit reshard."""
+        from koordinator_tpu.models.fused_waves import (
+            WAVE_STATE_NODE_SLOTS,
+        )
+        from koordinator_tpu.parallel import (
+            put_on_mesh,
+            wave_carry_shardings,
+        )
+
+        shardings = wave_carry_shardings(self.mesh, explain=explain)
+        return tuple(
+            arr if i in WAVE_STATE_NODE_SLOTS else put_on_mesh(arr, sh)
+            for i, (arr, sh) in enumerate(zip(carry, shardings)))
+
+    def _dispatch_chain_wave(self, step, fc, carry, la_adj_d, n_real: int,
+                             explain):
+        """Dispatch ONE chained wave asynchronously. Returns (next
+        carry, WaveChainOut, counts_row-or-None) — all device values,
+        nothing synced: the caller decides when to block."""
+        if explain is not None:
+            return step(fc, carry, la_adj_d, np.int32(n_real))
+        carry, rows = step(fc, carry, la_adj_d)
+        return carry, rows, None
+
+    def _sync_wave_rows(self, n_shape, rows, counts_row):
+        """Materialize one wave's compacted readback — the per-wave
+        designated sync point of the overlapped replay. Returns host
+        arrays (pods, nodes, zones, count[, counts_row])."""
+        arrays = (rows.bind_pods, rows.bind_nodes, rows.bind_zones,
+                  rows.count)
+        if counts_row is not None:
+            arrays = arrays + (counts_row,)
+        synced = self._readback_sync(n_shape, *arrays)
+        scheduler_metrics.READBACK_BYTES.inc(
+            int(sum(a.nbytes for a in synced[:4])))
+        if counts_row is not None:
+            scheduler_metrics.EXPLAIN_READBACK_BYTES.inc(
+                int(synced[4].nbytes))
+        return synced
+
+    def _drain_abandoned_wave(self, rows) -> None:
+        """A truncation (Reserve veto, preemption retry) dropped a
+        dispatched-but-unconsumed wave: block until it completes before
+        the dispatch window closes, so the DeviceSnapshot donation guard
+        can never re-arm while the wave still holds the buffers. A
+        deliberate sync of a result we discard."""
+        import jax
+
+        jax.block_until_ready(rows.count)
+
+    def _abort_chain_window(self, rows, window_open: bool) -> None:
+        """Tear down the chain dispatch window on a host-side failure
+        (store-write fault in the in-window flush, ladder retry): wave 1
+        may still be executing, and end_dispatch must not re-arm the
+        DeviceSnapshot donation guard while the program holds the
+        buffers — the next upload (a ladder retry, or the next cycle
+        after the re-raise) would donate them out from under it."""
+        if rows is not None:
+            try:
+                self._drain_abandoned_wave(rows)
+            except Exception:
+                # the wave itself wrecked: it no longer holds buffers,
+                # and the ORIGINAL failure is the evidence being raised
+                logger.exception("abandoned chain wave failed while "
+                                 "draining")
+        if window_open:
+            self.device_snapshot.end_dispatch()
+
+    def _fused_wave_dispatch_overlap(
+        self,
+        pending: List[Pod],
+        now: float,
+        ctx: CycleContext,
+        result: CycleResult,
+        pending_reservations: Dict[str, Reservation],
+        originals: Dict[str, Pod],
+        k_waves: int,
+    ) -> None:
+        """The overlapped-replay fused dispatch: K waves as a CHAIN of
+        per-wave device programs (models/fused_waves.py
+        build_chained_wave_step — one compiled step serves every K),
+        with wave w+1 dispatched BEFORE wave w's rows are read back, so
+        the host-side replay of logical cycle w — bind/classify over the
+        still-pending slice, PostFilter preemption, condition-write
+        capture — drains while the device executes wave w+1.
+
+        The degradation ladder's window closes at the FIRST wave's
+        readback: beyond that point bindings are being applied, and a
+        failure is evidence for the flight recorder (an unhandled
+        cycle_exception), never a reason to shed device capability.
+
+        Byte parity: the chain traces the SAME wave body as the fused
+        while_loop and the replay applies the same logical-cycle
+        sequence, so outcomes are byte-identical to
+        KOORD_TPU_REPLAY_OVERLAP=0 and, transitively, to K sequential
+        serial cycles (run_replay_overlap_parity + run_fused_wave_parity
+        gate both)."""
+        assert not pending_reservations, (
+            "_effective_waves demotes to K=1 when reservation CRs pend")
+        result.waves = 0
+        enc = self._encode_batch(pending, now, ctx)
+        if enc is None:
+            self._fused_no_node_cycles(pending, now, result, k_waves)
+            return
+        fc, pods, nodes, ng, ngroups, active = enc
+        fc_host = fc  # the pre-upload host arrays feed the wave mirror
+        ex = nodes.extras
+        axis_idx = np.asarray(active)
+        la_est = np.ascontiguousarray(
+            np.take(ex["la_est_nonprod"], axis_idx, axis=-1))
+        la_adj = np.ascontiguousarray(
+            np.take(ex["la_adj_nonprod"], axis_idx, axis=-1))
+
+        # ---- ladder-wrapped dispatch window: step build, upload, the
+        # wave-1 dispatch and its readback — strictly before any binding.
+        self.ladder.begin_pass()
+        window_open = False
+        rows0 = None  # wave 1 in flight: must drain before the window closes
+        while True:
+            explain = self._effective_explain()
+            try:
+                step = self._get_chain_step(
+                    (pods.padded_size, nodes.padded_size,
+                     fc_host.quota_runtime.shape[0]),
+                    ng, ngroups, active, explain=explain,
+                )
+                with self.tracer.span(
+                        "kernel",
+                        compiled="1" if self._last_step_compiled else "0",
+                        waves=str(k_waves), overlap="1"):
+                    fc = fc_host
+                    la_est_d, la_adj_d = la_est, la_adj
+                    if self.device_snapshot is not None:
+                        fc = self.device_snapshot.upload(fc)
+                        sides = self.device_snapshot.upload_fields(
+                            {"la_est_nonprod": la_est,
+                             "la_adj_nonprod": la_adj})
+                        la_est_d = sides["la_est_nonprod"]
+                        la_adj_d = sides["la_adj_nonprod"]
+                        self._record_upload_deltas()
+                        self.device_snapshot.begin_dispatch()
+                        window_open = True
+                    t_dispatch = time.perf_counter()
+                    n_real = len(nodes.names)
+                    n_shape = (n_real,
+                               int(np.shape(fc.base.allocatable)[0]))
+                    if self.fault_injector is not None:
+                        self.fault_injector("fused")
+                    carry = self._initial_chain_carry(fc, la_est_d,
+                                                      explain)
+                    carry, rows0, crow0 = self._dispatch_chain_wave(
+                        step, fc, carry, la_adj_d, n_real, explain)
+                    if self.pipeline_mode:
+                        # the previous cycle's deferred host work drains
+                        # while the device runs wave 1
+                        self._flush_deferred_in_window()
+                    with self.tracer.span("overlap_wait"):
+                        synced = self._sync_wave_rows(n_shape, rows0,
+                                                      crow0)
+                break
+            except _HostWriteFailure as hw:
+                self._abort_chain_window(rows0, window_open)
+                rows0, window_open = None, False
+                raise hw.__cause__
+            except Exception as exc:
+                self._abort_chain_window(rows0, window_open)
+                rows0, window_open = None, False
+                self._on_dispatch_failure("fused", exc)
+                if self.ladder.level >= LEVEL_SERIAL_WAVES:
+                    raise FusedDispatchDemoted() from exc
+        try:
+            executed, t_last_sync = self._replay_wave_chain(
+                step, fc, fc_host, carry, la_adj_d, synced, n_shape,
+                n_real, pods, nodes, pending, now, ctx, result,
+                pending_reservations, originals, k_waves, explain)
+        finally:
+            if window_open:
+                self.device_snapshot.end_dispatch()
+        # kernel time = the CHAIN's dispatch->last-sync window, the same
+        # quantity the serial twin's single-program kernel span measures
+        # — NOT just wave 1's window, or the metric would silently
+        # shrink ~(K-1)/K when the overlap default flips on and every
+        # KERNEL_SECONDS dashboard would read a phantom speedup
+        window_seconds = t_last_sync - t_dispatch
+        result.kernel_seconds += window_seconds
+        scheduler_metrics.KERNEL_SECONDS.observe(window_seconds)
+        result.device_busy_seconds += window_seconds
+        scheduler_metrics.WAVES_PER_DISPATCH.observe(float(executed))
+        self._last_batch = None
+
+    def _replay_wave_chain(
+        self,
+        step,
+        fc,
+        fc_host,
+        carry,
+        la_adj_d,
+        synced,
+        n_shape,
+        n_real: int,
+        pods,
+        nodes,
+        pending: List[Pod],
+        now: float,
+        ctx: CycleContext,
+        result: CycleResult,
+        pending_reservations: Dict[str, Reservation],
+        originals: Dict[str, Pod],
+        k_waves: int,
+        explain,
+    ) -> Tuple[int, float]:
+        """Consume the wave chain: one logical cycle per wave, the
+        replay of wave w overlapping device execution of wave w+1.
+        Returns (wave bodies consumed device-side, wall clock of the
+        last device sync) — the device-busy window closes at the last
+        sync; host replay past it is not device time.
+
+        Packed-order work is amortized per DISPATCH: the classification
+        of every pod (encoding-overflow reason, gang/quota membership,
+        plain no-fit) is static for the dispatch, so each wave walks
+        only the still-pending slice, and a fixpoint repeat — a wave
+        the device early-exited, re-verdicting the same pods at the
+        same wave-start state — reuses the previous wave's lists and
+        attribution wholesale instead of re-deriving them. Store
+        writes: the wave's bind patches land as ONE update_many
+        transaction before preemption or gang status reads the store
+        (span ``store_flush``); condition writes queue on the deferred
+        machinery with byte-identical repeats deduped (their flush was
+        already a proven no-op)."""
+        keys = pods.keys
+        by_key = {p.meta.key: p for p in pending}
+        index = {key: j for j, key in enumerate(keys)}
+        # per-dispatch precompute: the static (pod, verdict) partition
+        _REJECT = object()
+        pending_rows: List[Tuple[int, Pod, object]] = []
+        for i, key in enumerate(keys):
+            pod = by_key[key]
+            reason = pods.unschedulable_reasons.get(i)
+            if reason is None and (pod.gang_name or pod.quota_name):
+                pending_rows.append((i, pod, _REJECT))
+            else:
+                pending_rows.append(
+                    (i, pod, reason or "no feasible node"))
+        gang_plugin = self.extender.plugin("Coscheduling")
+
+        mirror: Optional[_WaveStateMirror] = None
+        mirror_backlog: List[Tuple[int, int, int]] = []
+
+        def mirror_state() -> _WaveStateMirror:
+            nonlocal mirror
+            if mirror is None:
+                mirror = _WaveStateMirror(fc_host)
+                for commit in mirror_backlog:
+                    mirror.commit(*commit)
+                mirror_backlog.clear()
+            return mirror
+
+        executed = 1           # wave bodies whose readback we consumed
+        t_last_sync = time.perf_counter()
+        # fixpoint-repeat caches: valid while no wave commits/vetoes and
+        # preemption stays victim-less (any of those invalidates)
+        reuse_lists = None     # (rejected_pods, failed_pods)
+        reuse_attrib = None    # [(pod key, /explain attribution entry)]
+        in_flight = None       # (rows, counts_row) of wave w+1
+        # explain=full bookkeeping mirroring the serial twin's masking:
+        # term rows belong to DEVICE-kept pods (the chain's bind rows),
+        # and a preemption-retry pass stashes its own kernel's rows for
+        # the pods it re-ran — the end-of-chain stash must not clobber
+        # those (the serial twin stashes chain rows BEFORE the replay,
+        # so its retry stash wins by order)
+        device_kept = (np.zeros(len(keys), bool)
+                       if explain == "full" else None)
+        retried_keys: set = set()
+        try:
+            with self.tracer.span("replay_drain",
+                                  waves=str(k_waves)) as dsp:
+                for w in range(k_waves):
+                    if synced is not None:
+                        pods_w, nodes_w, zones_w = (synced[0], synced[1],
+                                                    synced[2])
+                        cnt_w = int(synced[3])
+                        crow_w = synced[4] if explain is not None else None
+                    else:
+                        pods_w = nodes_w = zones_w = None
+                        cnt_w = 0
+                        crow_w = None
+                    # one-ahead: launch wave w+1 BEFORE replaying wave w
+                    # — the device works through it while the host
+                    # replays (a known fixpoint dispatches nothing: the
+                    # fused while_loop's early exit, saved host-side)
+                    if (synced is not None and cnt_w > 0
+                            and w + 1 < k_waves):
+                        carry, rows_n, crow_n = self._dispatch_chain_wave(
+                            step, fc, carry, la_adj_d, n_real, explain)
+                        in_flight = (rows_n, crow_n)
+                    else:
+                        in_flight = None
+
+                    if device_kept is not None and cnt_w:
+                        device_kept[
+                            np.asarray(pods_w[:cnt_w], np.int64)] = True
+                    replay_out: Dict[str, object] = {}
+                    truncate = self._replay_logical_cycle(
+                        w, pods_w, nodes_w, cnt_w, crow_w, pending_rows,
+                        mirror_state, index, n_real, nodes, now, ctx,
+                        result, pending_reservations, originals, explain,
+                        reuse_lists, reuse_attrib, replay_out)
+                    pending_rows = replay_out["pending_rows"]
+                    reuse_lists = replay_out["reuse_lists"]
+                    reuse_attrib = replay_out["reuse_attrib"]
+                    retried_keys.update(replay_out.get("retried_keys",
+                                                       ()))
+                    result.waves += 1
+                    if gang_plugin is not None:
+                        gang_plugin.update_pod_group_status(self.store,
+                                                            now)
+                    if truncate:
+                        break
+                    # advance the mirror with the device's committed rows
+                    # so the next logical cycle diagnoses at
+                    # wave-(w+1)-start state (kernel counts make the
+                    # mirror unnecessary)
+                    if explain is None and cnt_w:
+                        for b in range(cnt_w):
+                            commit = (int(pods_w[b]), int(nodes_w[b]),
+                                      int(zones_w[b]))
+                            if mirror is not None:
+                                mirror.commit(*commit)
+                            else:
+                                mirror_backlog.append(commit)
+                    if in_flight is not None:
+                        rows_n, crow_n = in_flight
+                        in_flight = None
+                        with self.tracer.span("overlap_wait",
+                                              wave=str(w + 1)):
+                            synced = self._sync_wave_rows(n_shape, rows_n,
+                                                          crow_n)
+                        t_last_sync = time.perf_counter()
+                        executed += 1
+                    else:
+                        synced = None
+                dsp.attributes["cycles"] = str(result.waves)
+        finally:
+            if in_flight is not None:
+                # a truncation (or a replay wreck mid-flight) left wave
+                # w+1 dispatched and unread: block it before the
+                # dispatch window can close behind us. Guarded — a
+                # device fault in the DISCARDED wave must not replace
+                # the in-flight replay exception (or wreck a truncated
+                # cycle whose binds already applied) during unwind.
+                try:
+                    self._drain_abandoned_wave(in_flight[0])
+                    # the discarded wave DID execute on device — count
+                    # it. (On truncation the overlap world still
+                    # executes FEWER device waves than the serial
+                    # twin's run-to-fixpoint program: that gap in the
+                    # waves-per-dispatch histogram is the overlap's
+                    # saved device work, not an accounting artifact.)
+                    executed += 1
+                except Exception:
+                    logger.exception("abandoned chain wave failed "
+                                     "while draining")
+                t_last_sync = time.perf_counter()
+        if explain == "full":
+            # decision-time score terms ride the carried state; the last
+            # dispatched wave's carry holds the kept-wave-wins rows. The
+            # chain completed at the syncs/drain above — this transfer
+            # materializes a finished output. The mask is the serial
+            # twin's: DEVICE-kept rows (not result.bound — a preemption
+            # retry's host rebind has no chain row), minus the pods a
+            # retry pass re-ran (its kernel already stashed their rows;
+            # in the serial twin that stash comes after the chain's and
+            # wins by order).
+            terms_np = np.asarray(carry[-1])
+            scheduler_metrics.EXPLAIN_READBACK_BYTES.inc(
+                int(terms_np.nbytes))
+            kept_mask = device_kept
+            for key in retried_keys:
+                j = index.get(key)
+                if j is not None:
+                    kept_mask[j] = False
+            self._stash_terms(keys, kept_mask, terms_np)
+        return executed, t_last_sync
+
+    def _replay_logical_cycle(
+        self,
+        w: int,
+        pods_w,
+        nodes_w,
+        cnt_w: int,
+        crow_w,
+        pending_rows,
+        mirror_state,
+        index,
+        n_real: int,
+        nodes,
+        now: float,
+        ctx: CycleContext,
+        result: CycleResult,
+        pending_reservations: Dict[str, Reservation],
+        originals: Dict[str, Pod],
+        explain,
+        reuse_lists,
+        reuse_attrib,
+        out: dict,
+    ) -> bool:
+        """Replay ONE logical cycle of the overlapped chain (bind and
+        classify in packed order, PostFilter preemption, failure records,
+        condition capture). Returns whether the dispatch truncates; the
+        updated pending slice and fixpoint-reuse caches ride ``out``.
+        A pending row's verdict is a string (the static failure reason)
+        or the chain's reject sentinel (any non-string: gang/quota
+        admission rejection)."""
+        rejected_pods: List[Pod] = []
+        failed_pods: List[Tuple[Pod, str]] = []
+        veto = False
+        fresh = True
+        txn: List[tuple] = []  # (patched, live pod, annotations, node)
+        with self.tracer.span("wave_replay", index=str(w)) as wsp:
+            bound_before = len(result.bound)
+            if cnt_w == 0 and reuse_lists is not None:
+                # fixpoint repeat: same pending slice, same wave-start
+                # state — the previous wave's partition IS this wave's
+                rejected_pods, failed_pods = reuse_lists
+                fresh = False
+            else:
+                bind_of = ({int(pods_w[b]): int(nodes_w[b])
+                            for b in range(cnt_w)} if cnt_w else {})
+                still: List[Tuple[int, Pod, object]] = []
+                for ent in pending_rows:
+                    i, pod, verdict = ent
+                    node_idx = bind_of.get(i) if cnt_w else None
+                    if node_idx is not None:
+                        err = self._reserve_and_bind(
+                            pod, nodes.names[node_idx], ctx, result,
+                            txn=txn)
+                        if err:
+                            failed_pods.append((pod, err))
+                            veto = True
+                            still.append(ent)
+                        continue
+                    still.append(ent)
+                    if isinstance(verdict, str):
+                        failed_pods.append((pod, verdict))
+                    else:
+                        rejected_pods.append(pod)
+                pending_rows = still
+            if txn:
+                with self.tracer.span("store_flush",
+                                      writes=str(len(txn))):
+                    # the wave's bind patches: ONE store transaction,
+                    # applied before preemption/gang status reads; the
+                    # live queue objects turn coherent right after, as
+                    # the serial per-pod write would have left them.
+                    # THE designated batched flush site of the replay
+                    # koordlint: disable=store-write-in-wave-replay-loop
+                    self.store.update_many(KIND_POD,
+                                           [t[0] for t in txn])
+                for _patched, live, annotations, node_name in txn:
+                    live.meta.annotations.update(annotations)
+                    live.spec.node_name = node_name
+
+            if fresh and (rejected_pods or any(
+                    r in DIAGNOSED_REASONS for _p, r in failed_pods)):
+                if explain is not None:
+                    self._last_batch = (None, index, n_real, crow_w)
+                else:
+                    self._last_batch = (
+                        mirror_state().patched_fc(), index, n_real, None)
+            truncate = veto
+            any_victims = self._post_filter_preempt(
+                rejected_pods, failed_pods, result)
+            if any_victims:
+                retry = self.extender.transform_before_prefilter(
+                    [
+                        originals.get(p.meta.key, p)
+                        for p in rejected_pods
+                        + [p for p, _ in failed_pods]
+                    ],
+                    ctx,
+                )
+                rejected_pods, failed_pods = self._batch_pass(
+                    retry, now, ctx, result, pending_reservations
+                )
+                out["retried_keys"] = [p.meta.key for p in retry]
+                truncate = True
+                fresh = True
+            for b in result.bound[bound_before:]:
+                self._preempt_attempted.pop(b.pod_key, None)
+            for pod in rejected_pods:
+                result.rejected.append(pod.meta.key)
+                self.extender.error_handlers.dispatch(
+                    pod, "admission rejected")
+            for pod, reason in failed_pods:
+                result.failed.append(pod.meta.key)
+                self.extender.error_handlers.dispatch(pod, reason)
+            if fresh:
+                self._write_unschedulable_conditions(
+                    rejected_pods, failed_pods, now)
+            elif reuse_attrib:
+                # the repeat's attribution is per logical cycle, exactly
+                # like K serial cycles — re-apply the cached entries and
+                # stage counters; the byte-identical deferred store
+                # write is deduped (its flush was a proven no-op)
+                for key, entry in reuse_attrib:
+                    self._cycle_attrib[key] = entry
+                    for stage_key, c in entry.get("stages", {}).items():
+                        scheduler_metrics.FILTER_REJECTIONS.inc(
+                            c, stage=stage_key)
+            # set LAST so a preemption-retry pass's rebinds count toward
+            # this logical cycle's replay span, as they are bound in it
+            wsp.attributes["bound"] = str(len(result.bound) - bound_before)
+        reuse_ok = cnt_w == 0 and not veto and not any_victims
+        if reuse_ok and fresh:
+            reuse_lists = (rejected_pods, failed_pods)
+            if self.explain_spec is not None:
+                reuse_attrib = [
+                    (p.meta.key, self._cycle_attrib[p.meta.key])
+                    for p in rejected_pods + [fp for fp, _r in failed_pods]
+                    if p.meta.key in self._cycle_attrib
+                ]
+            else:
+                reuse_attrib = None
+        elif not reuse_ok:
+            reuse_lists = None
+            reuse_attrib = None
+        out["pending_rows"] = pending_rows
+        out["reuse_lists"] = reuse_lists
+        out["reuse_attrib"] = reuse_attrib
+        return truncate
+
+    # ------------------------------------------------------------------
     def _reserve_and_bind(
         self,
         pod: Pod,
@@ -2108,8 +2767,13 @@ class Scheduler:
         result: CycleResult,
         via_reservation: Optional[Reservation] = None,
         reservation_cr: Optional[Reservation] = None,
+        txn: Optional[list] = None,
     ) -> Optional[str]:
-        """Reserve hooks -> PreBind -> Bind; returns error to leave pod pending."""
+        """Reserve hooks -> PreBind -> Bind; returns error to leave pod
+        pending. ``txn`` (overlapped wave replay) collects the bind's
+        store patch instead of writing it immediately — the wave flushes
+        the whole batch as one store transaction before anything
+        (preemption dry-runs, gang status) reads the store."""
         if reservation_cr is not None:
             # binding a Reservation CR itself: no plugin reserve (it only holds
             # capacity), just set status (reservation plugin Bind, plugin.go:596).
@@ -2146,7 +2810,8 @@ class Scheduler:
                 for plugin in self.extender.plugins:
                     plugin.pre_bind(pod, node_name, ctx, annotations)
                 prebind = self.extender.plugin("DefaultPreBind")
-                prebind.apply_patch(pod, node_name, annotations, now=ctx.now)
+                prebind.apply_patch(pod, node_name, annotations, now=ctx.now,
+                                    txn=txn)
         result.bound.append(BindResult(pod.meta.key, node_name, annotations))
         return None
 

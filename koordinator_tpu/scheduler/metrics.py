@@ -77,6 +77,18 @@ READBACK_BYTES = REGISTRY.counter(
     "Bytes read back from the device across all kernel dispatches",
 )
 
+# pipeline occupancy: fraction of the last cycle's wall time the device
+# had work in flight (device_busy_seconds / cycle duration). The whole
+# point of the batched-tensor re-expression is that the DEVICE sets the
+# cycle rate — this gauge makes the next host-side bottleneck visible in
+# /metrics instead of only in bench JSON. Overlapped wave replay
+# (KOORD_TPU_REPLAY_OVERLAP) raises it by draining the replay queue
+# while later waves execute.
+PIPELINE_OCCUPANCY = REGISTRY.gauge(
+    "koord_scheduler_pipeline_occupancy",
+    "Device-busy fraction of the last scheduling cycle's wall time",
+)
+
 # incremental-pack row traffic: steady state should be nearly all reused;
 # a repack surge means the store is churning (or a cache regression)
 PACK_ROWS_REUSED = REGISTRY.counter(

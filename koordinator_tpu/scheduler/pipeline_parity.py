@@ -380,6 +380,130 @@ def run_fused_wave_parity(k_waves: int, num_nodes: int = 24,
     }
 
 
+def run_replay_overlap_parity(k_waves: int, num_nodes: int = 24,
+                              num_pods: int = 70, rounds: int = 2,
+                              seed: int = 11, arrivals: int = 9,
+                              explain: str = "off") -> dict:
+    """Overlapped wave replay vs the serial-replay fused dispatch:
+    byte-identical state.
+
+    The overlap world (KOORD_TPU_REPLAY_OVERLAP=1 semantics pinned) runs
+    the fused dispatch as a chain of per-wave device programs with the
+    host replay of wave w draining while wave w+1 executes, batched bind
+    transactions and deduped condition repeats; the twin pins overlap
+    OFF — the single fused program with strictly serial post-readback
+    replay, i.e. today's exact path. Both drive identical churn at the
+    same wave depth through the pipeline. Diffed per round: bound
+    (pod, node, annotations) sequences, the failure/victim/resize lists;
+    at end of stream: every PodScheduled condition tuple, gang/quota
+    plugin counters, and final assignments."""
+    import numpy as np
+
+    from koordinator_tpu.client.store import KIND_POD
+    from koordinator_tpu.scheduler.cycle import CyclePipeline, Scheduler
+    from koordinator_tpu.testing import synth_full_cluster
+
+    def make_world():
+        _cluster, state = synth_full_cluster(
+            num_nodes, num_pods, seed=seed, num_quotas=3, num_gangs=4,
+            topology_fraction=0.5, lsr_fraction=0.2)
+        return state, build_store_from_state(state)
+
+    state_s, store_serial = make_world()
+    _state_o, store_overlap = make_world()
+    sched_serial = Scheduler(store_serial, waves=k_waves, explain=explain,
+                             replay_overlap=False)
+    sched_overlap = Scheduler(store_overlap, waves=k_waves,
+                              explain=explain, replay_overlap=True)
+    pipe_serial = CyclePipeline(sched_serial, enabled=True)
+    pipe_overlap = CyclePipeline(sched_overlap, enabled=True)
+
+    now = state_s.now
+    mismatches: List[str] = []
+    fields = ("failed", "rejected", "preempted_victims", "resized",
+              "resize_pending")
+    for r in range(rounds + 1):
+        if r > 0:
+            apply_round_delta(store_serial, r, now, arrivals)
+            apply_round_delta(store_overlap, r, now, arrivals)
+        t = now + 2 * r
+        res_s = pipe_serial.run_cycle(now=t)
+        res_o = pipe_overlap.run_cycle(now=t)
+        if ([(b.pod_key, b.node_name, b.annotations) for b in res_s.bound]
+                != [(b.pod_key, b.node_name, b.annotations)
+                    for b in res_o.bound]):
+            mismatches.append(f"round {r}: bound sequence differs")
+        if res_s.waves != res_o.waves:
+            mismatches.append(f"round {r}: waves consumed differ "
+                              f"({res_s.waves} vs {res_o.waves})")
+        for f in fields:
+            if sorted(getattr(res_s, f)) != sorted(getattr(res_o, f)):
+                mismatches.append(f"round {r}: {f} differs")
+    pipe_serial.flush()
+    pipe_overlap.flush()
+
+    cond_s, cond_o = _conditions(store_serial), _conditions(store_overlap)
+    if cond_s != cond_o:
+        keys = {k for k in set(cond_s) | set(cond_o)
+                if cond_s.get(k) != cond_o.get(k)}
+        mismatches.append(
+            f"PodScheduled conditions differ for {len(keys)} pods "
+            f"(e.g. {sorted(keys)[:3]})")
+
+    def plugin_counters(sched):
+        gang = sched.extender.plugin("Coscheduling")
+        quota = sched.extender.plugin("ElasticQuota")
+        return (
+            {g: n for g, n in (gang.assumed if gang else {}).items() if n},
+            {q: tuple(np.asarray(v).tolist())
+             for q, v in (quota.used if quota else {}).items()
+             if np.asarray(v).any()},
+        )
+
+    gang_s, quota_s = plugin_counters(sched_serial)
+    gang_o, quota_o = plugin_counters(sched_overlap)
+    if gang_s != gang_o:
+        mismatches.append(f"gang assumed counters differ: "
+                          f"{gang_s} vs {gang_o}")
+    if quota_s != quota_o:
+        mismatches.append("quota used counters differ")
+    assign_s = {p.meta.key: p.spec.node_name
+                for p in store_serial.list(KIND_POD)}
+    assign_o = {p.meta.key: p.spec.node_name
+                for p in store_overlap.list(KIND_POD)}
+    if assign_s != assign_o:
+        diff = sorted(k for k in set(assign_s) | set(assign_o)
+                      if assign_s.get(k) != assign_o.get(k))
+        mismatches.append(
+            f"final pod->node assignments differ for {len(diff)} pods "
+            f"(e.g. {diff[:3]})")
+    if explain == "full":
+        # the per-pod score-term rows ride the chain's carried state —
+        # the one koordexplain mode with NEW state threading in the
+        # overlap world. The /explain surface (verdict, node, terms,
+        # margin for bound pods; stages/message for unbound) must be
+        # identical record-for-record.
+        rec_s = {k: sched_serial.explain_record(k) for k in assign_s}
+        rec_o = {k: sched_overlap.explain_record(k) for k in assign_o}
+        if rec_s != rec_o:
+            keys = sorted(k for k in set(rec_s) | set(rec_o)
+                          if rec_s.get(k) != rec_o.get(k))
+            mismatches.append(
+                f"explain=full records differ for {len(keys)} pods "
+                f"(e.g. {keys[:3]})")
+    _dump_on_mismatch(mismatches, sched_serial, sched_overlap)
+
+    return {
+        "ok": not mismatches,
+        "mismatches": mismatches,
+        "waves": k_waves,
+        "rounds": rounds + 1,
+        "pods": len(assign_s),
+        "conditions_checked": len(cond_s),
+        "explain": explain,
+    }
+
+
 def run_mesh_parity(ndev: int, waves: int = 1, num_nodes: int = 24,
                     num_pods: int = 70, rounds: int = 2, seed: int = 11,
                     arrivals: int = 9, explain: str = "off") -> dict:
@@ -518,6 +642,18 @@ def main(argv: List[str]) -> int:
     ok = show("pipeline parity", run_pipeline_parity())
     for k in (1, 2, 4, 8):
         ok = show(f"fused-wave parity K={k}", run_fused_wave_parity(k)) and ok
+    # overlapped wave replay (KOORD_TPU_REPLAY_OVERLAP): the chain-of-
+    # per-wave-programs dispatch with in-flight replay must be byte-
+    # identical to the single-program serial-replay twin at every depth
+    for k in (1, 2, 4, 8):
+        ok = show(f"replay-overlap parity K={k}",
+                  run_replay_overlap_parity(k)) and ok
+    ok = show("replay-overlap parity K=4 (explain=counts)",
+              run_replay_overlap_parity(4, explain="counts")) and ok
+    # "full" is the one explain mode whose kept-wave-wins term rows ride
+    # the NEW chain carry (slot 12) — gate its surface record-for-record
+    ok = show("replay-overlap parity K=4 (explain=full)",
+              run_replay_overlap_parity(4, explain="full")) and ok
     # mesh-backed dispatch (KOORD_TPU_MESH): the production sharded path
     # must be byte-identical to single-device at every mesh size, serial
     # and fused, and with koordexplain attribution enabled on top

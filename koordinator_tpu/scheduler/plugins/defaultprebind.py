@@ -25,7 +25,8 @@ class DefaultPreBindPlugin(Plugin):
         self._store = store
 
     def apply_patch(self, pod: Pod, node_name: str,
-                    annotations: Dict[str, str], now: float = 0.0) -> None:
+                    annotations: Dict[str, str], now: float = 0.0,
+                    txn=None) -> None:
         # patch a COPY of the STORED object: watch subscribers diff old vs new,
         # and `pod` may be a cycle-local transformer view (BeforePreFilter
         # semantics) whose rewrites must not persist — the reference patches
@@ -37,6 +38,16 @@ class DefaultPreBindPlugin(Plugin):
         # PodScheduled=True rides the same single patch (upstream sets the
         # condition through the bind API call)
         patched.set_condition("PodScheduled", "True", "", "", now)
+        if txn is not None:
+            # overlapped wave replay: the cycle driver lands the whole
+            # wave's patches as ONE store.update_many transaction. The
+            # live-object mutation is deferred with it — `pod` may BE the
+            # stored object, and mutating it before the batched event
+            # fires would make the MODIFIED old-side already assigned,
+            # hiding the bind transition from the gang/quota event
+            # handlers the plugin counters hang off.
+            txn.append((patched, pod, annotations, node_name))
+            return
         self._store.update(KIND_POD, patched)
         # keep the caller's object coherent for later hooks in this cycle
         pod.meta.annotations.update(annotations)

@@ -147,5 +147,12 @@ class FaultyStore:
         self._plan.store_write_hook(kind, key)
         return self._inner.delete(kind, key)
 
+    def update_many(self, kind: str, objs):
+        """Batched writes keep PER-OBJECT fault semantics: a store-write
+        fault armed mid-batch leaves the earlier objects applied, exactly
+        like N sequential updates — the reconcile-after-wreck path in the
+        harness depends on partially-applied batches being visible."""
+        return [self.update(kind, obj) for obj in objs]
+
     def __getattr__(self, name):
         return getattr(self._inner, name)

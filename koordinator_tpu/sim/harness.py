@@ -106,6 +106,14 @@ class SimReport:
     descheduler_runs: int = 0
     binding_log: List[str] = dataclasses.field(default_factory=list)
     wall_seconds: float = 0.0
+    # pipeline-occupancy accounting under realistic arrivals: per-cycle
+    # wall and device-busy sums, plus bound/wall bucketed by the logical
+    # cycles each dispatch consumed (CycleResult.waves) — the churn-side
+    # pods_per_sec_at_k / pipeline_occupancy the bench report cites
+    cycle_wall_seconds: float = 0.0
+    device_busy_seconds: float = 0.0
+    wall_by_waves: Dict[int, float] = dataclasses.field(default_factory=dict)
+    bound_by_waves: Dict[int, int] = dataclasses.field(default_factory=dict)
 
     def percentile(self, q: float) -> float:
         if not self.ttb_seconds:
@@ -172,6 +180,19 @@ class SimReport:
             "binding_log_sha256": self.binding_log_sha256,
             "bindings": len(self.binding_log),
             "wall_seconds": round(self.wall_seconds, 2),
+            "pipeline": {
+                "occupancy": (
+                    round(self.device_busy_seconds
+                          / self.cycle_wall_seconds, 3)
+                    if self.cycle_wall_seconds > 0 else 0.0),
+                "pods_per_sec_at_k": {
+                    str(k): round(self.bound_by_waves.get(k, 0)
+                                  / self.wall_by_waves[k], 1)
+                    for k in sorted(self.wall_by_waves)
+                    if self.wall_by_waves[k] > 0},
+                "cycle_wall_seconds": round(self.cycle_wall_seconds, 2),
+                "device_busy_seconds": round(self.device_busy_seconds, 2),
+            },
         }
         if include_log:
             out["binding_log"] = list(self.binding_log)
@@ -597,9 +618,13 @@ class ChurnSimulator:
                                       self._pending_count())
 
         driver = self.pipeline if self.pipeline is not None else self.sched
+        t_cycle = time.perf_counter()
         try:
             result = driver.run_cycle(now=self.now)
         except Exception as exc:  # the flight recorder already dumped
+            # the wrecked cycle's wall still counts (device idle in it)
+            self.report.cycle_wall_seconds += (
+                time.perf_counter() - t_cycle)
             self.report.cycle_exceptions.append(
                 f"cycle {cycle}: {type(exc).__name__}: {exc}")
             logger.warning("sim cycle %d raised: %s", cycle, exc)
@@ -611,6 +636,14 @@ class ChurnSimulator:
             self._reconcile_store_binds(cycle)
             self._check_invariants(cycle)
             return
+        wall = time.perf_counter() - t_cycle
+        self.report.cycle_wall_seconds += wall
+        self.report.device_busy_seconds += result.device_busy_seconds
+        k = max(1, int(result.waves))
+        self.report.wall_by_waves[k] = (
+            self.report.wall_by_waves.get(k, 0.0) + wall)
+        self.report.bound_by_waves[k] = (
+            self.report.bound_by_waves.get(k, 0) + len(result.bound))
         for b in result.bound:
             pod = self.store.get(KIND_POD, b.pod_key)
             if pod is None:

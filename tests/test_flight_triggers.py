@@ -177,14 +177,26 @@ def test_deferred_store_write_failure_bypasses_the_ladder(monkeypatch):
     retries_before = (scheduler_metrics.DISPATCH_RETRIES.get(stage="serial")
                       or 0.0)
     orig_update = store.update
+    orig_update_many = store.update_many
+
+    def _is_target(obj):
+        return getattr(getattr(obj, "meta", None), "key", "") == (
+            "default/too-big")
 
     def faulty_update(kind, obj, **kw):
-        if getattr(getattr(obj, "meta", None), "key", "") == (
-                "default/too-big"):
+        if _is_target(obj):
             raise RuntimeError("injected store-write fault")
         return orig_update(kind, obj, **kw)
 
+    def faulty_update_many(kind, objs):
+        # overlapped replay batches the deferred flush into ONE
+        # update_many transaction — the fault must hit that path too
+        if any(_is_target(o) for o in objs):
+            raise RuntimeError("injected store-write fault")
+        return orig_update_many(kind, objs)
+
     monkeypatch.setattr(store, "update", faulty_update)
+    monkeypatch.setattr(store, "update_many", faulty_update_many)
     pend("late", 500)  # next cycle has a kernel window -> in-window flush
     with pytest.raises(RuntimeError, match="store-write fault"):
         pipeline.run_cycle(now=NOW + 2)

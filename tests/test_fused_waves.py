@@ -299,19 +299,46 @@ def test_waves_env_spec(monkeypatch):
 # ---------------------------------------------------------------------------
 
 def test_fused_cycle_metrics_and_wave_spans():
+    """Default (overlapped-replay) trace layout: the kernel span carries
+    the wave budget + overlap marker, and the per-wave host replay rides
+    wave_replay[i] spans under replay_drain."""
     from koordinator_tpu.scheduler import metrics as m
 
     store = _spread_retry_store()
     sched = Scheduler(store, waves=4)
+    assert sched.replay_overlap  # the default
     res = sched.run_cycle(now=NOW)
     assert res.waves >= 2
     text = m.REGISTRY.expose()
     assert "koord_scheduler_waves_per_dispatch_bucket" in text
     assert "koord_scheduler_readback_bytes_total" in text
+    assert "koord_scheduler_pipeline_occupancy" in text
     root = sched.tracer.roots(limit=1)[0]
     kernel = root.find("kernel")
     assert kernel is not None
     assert kernel.attributes.get("waves") == "4"
+    assert kernel.attributes.get("overlap") == "1"
+    drain = root.find("replay_drain")
+    assert drain is not None
+    waves = [s for s in drain.children if s.name == "wave_replay"]
+    assert len(waves) >= 2
+    assert waves[0].attributes.get("index") == "0"
+    assert "bound" in waves[0].attributes
+
+
+def test_fused_cycle_wave_spans_serial_replay_twin():
+    """KOORD_TPU_REPLAY_OVERLAP=0: the single-program fused dispatch
+    keeps the original retrospective wave markers under the kernel span
+    — the parity twin's trace shape is part of 'today's exact path'."""
+    store = _spread_retry_store()
+    sched = Scheduler(store, waves=4, replay_overlap=False)
+    res = sched.run_cycle(now=NOW)
+    assert res.waves >= 2
+    root = sched.tracer.roots(limit=1)[0]
+    kernel = root.find("kernel")
+    assert kernel is not None
+    assert kernel.attributes.get("waves") == "4"
+    assert kernel.attributes.get("overlap") is None
     waves = [s for s in kernel.children if s.name == "wave"]
     assert len(waves) >= 2
     assert waves[0].attributes.get("index") == "0"

@@ -1,0 +1,325 @@
+"""Overlapped wave replay (KOORD_TPU_REPLAY_OVERLAP, PR 8).
+
+The fused dispatch runs as a chain of per-wave device programs and the
+host replays logical cycle w while the device executes wave w+1
+(scheduler/cycle.py _fused_wave_dispatch_overlap). Pinned here:
+
+  * byte parity against the serial-replay twin at K in {1,2,4,8}
+    (run_replay_overlap_parity — the same harness hack/lint.sh gates);
+  * the store-write discipline: ZERO store writes inside the pure
+    device window (first dispatch -> first readback), the wave's bind
+    patches as one update_many batch, and exactly one deduped
+    PodScheduled write per unbound pod per dispatch, after the last
+    bind;
+  * a replay failure re-raises as an unhandled cycle exception with a
+    flight dump — evidence, never a ladder demotion;
+  * the chained step is K-independent in the compile cache;
+  * ObjectStore.update_many event/rv semantics.
+"""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.objects import Node, ObjectMeta, Pod, PodSpec
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client.store import (
+    KIND_NODE,
+    KIND_POD,
+    EventType,
+    ObjectStore,
+)
+from koordinator_tpu.scheduler import metrics as scheduler_metrics
+from koordinator_tpu.scheduler.cycle import Scheduler
+from koordinator_tpu.scheduler.pipeline_parity import (
+    run_replay_overlap_parity,
+)
+
+GIB = 1024 ** 3
+NOW = 1_000_000.0
+
+
+def _world(bindable=6, unbindable=2):
+    """One node, a few bindable pods and a few that can never fit —
+    deep enough for auto/pinned multi-wave, with a fixpoint tail."""
+    store = ObjectStore()
+    store.add(KIND_NODE, Node(
+        meta=ObjectMeta(name="n0", namespace=""),
+        allocatable=ResourceList.of(cpu=16_000, memory=64 * GIB,
+                                    pods=50)))
+    for i in range(bindable):
+        store.add(KIND_POD, Pod(
+            meta=ObjectMeta(name=f"ok-{i}", uid=f"ok-{i}",
+                            creation_timestamp=NOW),
+            spec=PodSpec(requests=ResourceList.of(cpu=500,
+                                                  memory=GIB))))
+    for i in range(unbindable):
+        store.add(KIND_POD, Pod(
+            meta=ObjectMeta(name=f"big-{i}", uid=f"big-{i}",
+                            creation_timestamp=NOW),
+            spec=PodSpec(requests=ResourceList.of(cpu=900_000,
+                                                  memory=GIB))))
+    return store
+
+
+# ---------------------------------------------------------------------------
+# parity: overlap vs the serial-replay twin
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("k", [1, 2, 4, 8])
+def test_overlap_byte_identical_to_serial_replay(k):
+    """The lint-gate fixture (quotas, gangs, NUMA, cpuset, churn):
+    bound sequences, failure lists, PodScheduled conditions, gang/quota
+    plugin counters and final assignments must be byte-identical
+    between KOORD_TPU_REPLAY_OVERLAP=1 and =0 at every wave depth."""
+    report = run_replay_overlap_parity(k, rounds=1)
+    assert report["ok"], report["mismatches"]
+    assert report["conditions_checked"] > 0
+
+
+def test_overlap_parity_with_explain_counts():
+    report = run_replay_overlap_parity(4, rounds=1, explain="counts")
+    assert report["ok"], report["mismatches"]
+
+
+def test_overlap_parity_with_explain_full_records():
+    """explain=full is the one mode whose per-pod score-term rows ride
+    the chain's carried state — the /explain surface (terms + margin for
+    bound pods included) must match the serial twin record-for-record."""
+    report = run_replay_overlap_parity(4, rounds=1, explain="full")
+    assert report["ok"], report["mismatches"]
+
+
+# ---------------------------------------------------------------------------
+# store-write discipline
+# ---------------------------------------------------------------------------
+
+def test_zero_store_writes_inside_device_window_and_one_cond_batch(
+        monkeypatch):
+    """Phase-tagged store events across one fused overlap dispatch:
+    nothing may write between the first wave's dispatch and its
+    readback (the device-only window), the wave's bind patches land as
+    one update_many batch, and every unbound pod gets exactly ONE
+    PodScheduled write — after the dispatch's last bind — despite K
+    logical cycles re-verdicting it (the fixpoint dedupe)."""
+    store = _world()
+    sched = Scheduler(store, waves=4)
+    assert sched.replay_overlap
+
+    phase = {"cur": "pre"}
+    events = []
+
+    def on_pod(ev, obj, old):
+        if ev is EventType.MODIFIED:
+            cond = obj.get_condition("PodScheduled")
+            kind = cond.status if cond is not None else "other"
+            events.append((phase["cur"], obj.meta.key, kind))
+
+    store.subscribe(KIND_POD, on_pod, replay=False)
+
+    orig_dispatch = sched._dispatch_chain_wave
+    orig_sync = sched._sync_wave_rows
+
+    def marked_dispatch(*a, **kw):
+        if phase["cur"] == "pre":
+            phase["cur"] = "device-window"
+        return orig_dispatch(*a, **kw)
+
+    def marked_sync(*a, **kw):
+        out = orig_sync(*a, **kw)
+        phase["cur"] = "replay"
+        return out
+
+    monkeypatch.setattr(sched, "_dispatch_chain_wave", marked_dispatch)
+    monkeypatch.setattr(sched, "_sync_wave_rows", marked_sync)
+
+    res = sched.run_cycle(now=NOW)
+    assert len(res.bound) == 6
+    assert res.waves == 4
+    # 1. the device-only window saw zero store writes
+    assert [e for e in events if e[0] == "device-window"] == []
+    # 2. every condition write is AFTER every bind write
+    bind_idx = [i for i, e in enumerate(events) if e[2] == "True"]
+    cond_idx = [i for i, e in enumerate(events) if e[2] == "False"]
+    assert bind_idx and cond_idx
+    assert max(bind_idx) < min(cond_idx)
+    # 3. one batched write per unbound pod for the whole dispatch, even
+    # though 4 logical cycles re-verdicted it (dedupe + update_many)
+    from collections import Counter
+
+    per_pod = Counter(e[1] for e in events if e[2] == "False")
+    assert per_pod == {"default/big-0": 1, "default/big-1": 1}
+    # the verdicts themselves repeat per logical cycle, like K serial
+    # cycles would report them
+    assert res.failed.count("default/big-0") == 4
+
+
+def test_update_many_event_pairs_and_rv_bumps():
+    """update_many == N sequential updates to every observer: one
+    MODIFIED per object with the correct old-side, in order, and one
+    resourceVersion bump each."""
+    store = ObjectStore()
+    pods = []
+    for i in range(3):
+        pods.append(store.add(KIND_POD, Pod(
+            meta=ObjectMeta(name=f"p{i}", uid=f"p{i}",
+                            creation_timestamp=NOW),
+            spec=PodSpec(requests=ResourceList.of(cpu=100,
+                                                  memory=GIB)))))
+    seen = []
+    store.subscribe(KIND_POD, lambda ev, obj, old: seen.append(
+        (ev, obj.meta.key, obj.spec.node_name,
+         old.spec.node_name if old is not None else None)),
+        replay=False)
+    rv0 = store.resource_version
+    patched = []
+    for p in pods:
+        cp = p.patch_copy()
+        cp.spec.node_name = "n0"
+        patched.append(cp)
+    store.update_many(KIND_POD, patched)
+    assert store.resource_version == rv0 + 3
+    assert [p.meta.resource_version for p in patched] == [
+        rv0 + 1, rv0 + 2, rv0 + 3]
+    assert seen == [
+        (EventType.MODIFIED, "default/p0", "n0", ""),
+        (EventType.MODIFIED, "default/p1", "n0", ""),
+        (EventType.MODIFIED, "default/p2", "n0", ""),
+    ]
+    assert store.update_many(KIND_POD, []) == []
+    with pytest.raises(KeyError):
+        store.update_many(KIND_POD, [Pod(
+            meta=ObjectMeta(name="ghost", uid="g",
+                            creation_timestamp=NOW),
+            spec=PodSpec())])
+
+
+def test_update_many_mid_batch_missing_key_applies_prefix():
+    """A raced deletion mid-batch stops exactly where N sequential
+    updates would: the prefix keeps its store mutations AND its MODIFIED
+    events (watch-fed plugin counters must not diverge from
+    store-visible state), then the KeyError surfaces."""
+    store = ObjectStore()
+    pods = []
+    for i in range(3):
+        pods.append(store.add(KIND_POD, Pod(
+            meta=ObjectMeta(name=f"p{i}", uid=f"p{i}",
+                            creation_timestamp=NOW),
+            spec=PodSpec(requests=ResourceList.of(cpu=100,
+                                                  memory=GIB)))))
+    patched = []
+    for p in pods:
+        cp = p.patch_copy()
+        cp.spec.node_name = "n0"
+        patched.append(cp)
+    store.delete(KIND_POD, pods[1].meta.key)
+    seen = []
+    store.subscribe(KIND_POD, lambda ev, obj, old: seen.append(
+        (ev, obj.meta.key)), replay=False)
+    with pytest.raises(KeyError, match="p1"):
+        store.update_many(KIND_POD, patched)
+    assert seen == [(EventType.MODIFIED, "default/p0")]
+    assert store.get(KIND_POD, "default/p0").spec.node_name == "n0"
+    assert store.get(KIND_POD, "default/p2").spec.node_name == ""
+
+
+def test_update_many_admission_rejection_applies_prefix():
+    """An admission-webhook rejection mid-batch behaves like the
+    sequential loop too: the admitted prefix lands (mutations + events),
+    the rejected object and everything after it do not."""
+    store = ObjectStore()
+    pods = []
+    for i in range(3):
+        pods.append(store.add(KIND_POD, Pod(
+            meta=ObjectMeta(name=f"p{i}", uid=f"p{i}",
+                            creation_timestamp=NOW),
+            spec=PodSpec(requests=ResourceList.of(cpu=100,
+                                                  memory=GIB)))))
+
+    def webhook(kind, obj, old=None, delete=False):
+        if obj.meta.name == "p1":
+            raise ValueError("p1 rejected by policy")
+
+    store.set_admission("policy", webhook)
+    seen = []
+    store.subscribe(KIND_POD, lambda ev, obj, old: seen.append(
+        (ev, obj.meta.key)), replay=False)
+    patched = []
+    for p in pods:
+        cp = p.patch_copy()
+        cp.spec.node_name = "n0"
+        patched.append(cp)
+    with pytest.raises(ValueError, match="rejected by policy"):
+        store.update_many(KIND_POD, patched)
+    assert seen == [(EventType.MODIFIED, "default/p0")]
+    assert store.get(KIND_POD, "default/p0").spec.node_name == "n0"
+    assert store.get(KIND_POD, "default/p1").spec.node_name == ""
+    assert store.get(KIND_POD, "default/p2").spec.node_name == ""
+
+
+# ---------------------------------------------------------------------------
+# failure semantics
+# ---------------------------------------------------------------------------
+
+def test_replay_failure_is_cycle_exception_not_demotion(monkeypatch):
+    """A failure in the overlapped replay — after the first wave's
+    readback, with the next wave possibly in flight — is evidence: the
+    flight recorder dumps cycle_exception, the error re-raises, and the
+    ladder never moves (no retry, no demotion: bindings were already
+    being applied)."""
+    store = _world()
+    sched = Scheduler(store, waves=4)
+    retries_before = (scheduler_metrics.DISPATCH_RETRIES.get(stage="fused")
+                      or 0.0)
+    dumps_before = (scheduler_metrics.FLIGHT_DUMPS.get(
+        reason="cycle_exception") or 0.0)
+
+    def boom(*a, **kw):
+        raise RuntimeError("replay exploded")
+
+    monkeypatch.setattr(sched, "_reserve_and_bind", boom)
+    with pytest.raises(RuntimeError, match="replay exploded"):
+        sched.run_cycle(now=NOW)
+    assert sched.ladder.level == 0
+    assert sched.ladder.transitions == []
+    assert (scheduler_metrics.DISPATCH_RETRIES.get(stage="fused")
+            or 0.0) == retries_before
+    assert (scheduler_metrics.FLIGHT_DUMPS.get(reason="cycle_exception")
+            or 0.0) == dumps_before + 1
+    records = sched.flight.snapshot()
+    assert records[-1]["error"].startswith("RuntimeError")
+
+
+def test_dispatch_window_failure_still_walks_the_ladder():
+    """The ladder's territory is unchanged: a failure BEFORE the first
+    wave's readback (the fault injector fires at the top of the fused
+    window) retries once, then demotes — the overlap moves the window's
+    end, not its meaning."""
+    store = _world()
+    sched = Scheduler(store, waves=4)
+    budget = {"n": 2}
+
+    def flaky(stage):
+        if budget["n"] > 0:
+            budget["n"] -= 1
+            raise RuntimeError(f"transient device fault ({stage})")
+
+    sched.fault_injector = flaky
+    res = sched.run_cycle(now=NOW)
+    # retry failed too -> demoted to serial waves, pass re-ran serially
+    assert sched.ladder.level >= 2
+    assert len(res.bound) == 6
+
+
+# ---------------------------------------------------------------------------
+# compile-cache shape
+# ---------------------------------------------------------------------------
+
+def test_chain_step_is_k_independent_in_the_compile_cache():
+    """One chained program serves every wave depth: driving the same
+    batch shape at K=2 then K=4 must build exactly ONE chain step."""
+    store = _world(bindable=2, unbindable=2)
+    sched = Scheduler(store, waves=2)
+    sched.run_cycle(now=NOW, waves=2)
+    sched.run_cycle(now=NOW + 2, waves=4)
+    chain_keys = [k for k in sched._step_cache if k[0] == "chain"]
+    assert len(chain_keys) == 1

@@ -887,6 +887,66 @@ class TestReadbackInWaveBody:
             assert [f for f in out if f.rule == self.RULE] == [], mod
 
 
+class TestStoreWriteInWaveReplayLoop:
+    RULE = "store-write-in-wave-replay-loop"
+    PATH = "koordinator_tpu/scheduler/cycle.py"
+
+    def test_positive_per_pod_write_in_replay(self):
+        src = """
+            def _replay_logical_cycle(self, pods, now):
+                for pod in pods:
+                    patched = pod.patch_copy()
+                    self.store.update(KIND_POD, patched)
+        """
+        out = findings_for(src, self.RULE, path=self.PATH)
+        assert len(out) == 1 and "batch" in out[0].message
+
+    def test_positive_all_write_tails_in_fused_wave_scope(self):
+        src = """
+            def _fused_wave_dispatch_overlap(self, store, pod):
+                store.add("Pod", pod)
+                store.delete("Pod", pod.meta.key)
+                self._store.upsert("Pod", pod)
+        """
+        assert len(findings_for(src, self.RULE, path=self.PATH)) == 3
+
+    def test_negative_pragma_licenses_designated_flush(self):
+        src = """
+            def _replay_logical_cycle(self, txn):
+                # koordlint: disable=store-write-in-wave-replay-loop
+                self.store.update_many(KIND_POD, [t[0] for t in txn])
+        """
+        assert findings_for(src, self.RULE, path=self.PATH) == []
+
+    def test_negative_outside_replay_scope_and_outside_scheduler(self):
+        # the designated flush helpers (flush_deferred, diagnose) and any
+        # non-replay function write freely
+        src = """
+            def flush_deferred(self, patched):
+                self.store.update(KIND_POD, patched)
+
+            def _diagnose_and_write(self, patched):
+                self.store.update(KIND_POD, patched)
+        """
+        assert findings_for(src, self.RULE, path=self.PATH) == []
+        src2 = """
+            def _replay_wave_chain(self, store, pod):
+                store.update("Pod", pod)
+        """
+        assert findings_for(src2, self.RULE,
+                            path="koordinator_tpu/sim/harness.py") == []
+
+    def test_shipped_cycle_module_is_clean(self):
+        source = (REPO_ROOT / "koordinator_tpu" / "scheduler"
+                  / "cycle.py").read_text()
+        out = analyze_source(source,
+                             path="koordinator_tpu/scheduler/cycle.py",
+                             rules={self.RULE: all_rules()[self.RULE]})
+        assert [f for f in out if f.rule == self.RULE] == [], (
+            "wave-replay store writes must route through the batched "
+            "flush sites (pragma'd update_many / deferred flush)")
+
+
 class TestConcurrencyGatedPaths:
     """The concurrency rules must keep covering the modules that share
     state across threads — a path-regex refactor that silently drops one
