@@ -140,6 +140,14 @@ def main() -> None:
         "SLOW — several minutes on CPU. Default: on unless --smoke",
     )
     ap.add_argument(
+        "--rebalance", action="store_true",
+        help="koordbalance A/B: the device rebalance pass vs the host "
+        "LowNodeLoad oracle back-to-back at 10k pods x 5k nodes "
+        "(rebalance_pass_ms pair + victim parity), then the drain-storm "
+        "and hotspot churn pairs (time-to-dissipate p50/p99 in the "
+        "rebalance block, BENCH_NOTES convention)",
+    )
+    ap.add_argument(
         "--churn", default=None, metavar="SCENARIO",
         help="run a named koordsim churn scenario (python -m "
         "koordinator_tpu.sim --list) TWICE back-to-back in this process "
@@ -202,6 +210,14 @@ def main() -> None:
 
     if args_cli.mesh:
         run_mesh_sweep(args_cli)
+        return
+
+    if args_cli.rebalance:
+        run_rebalance_ab(
+            args_cli,
+            args_cli.pods or (500 if args_cli.smoke else 10_000),
+            args_cli.nodes or (50 if args_cli.smoke else 5_000),
+        )
         return
 
     num_pods = args_cli.pods or (100 if args_cli.smoke else 10_000)
@@ -398,6 +414,9 @@ def run_sim_churn(args_cli, scenario) -> None:
         "degradation_transitions": len(a.ladder_transitions),
         "pair_deterministic": deterministic,
         "binding_log_sha256": a.binding_log_sha256,
+        # koordbalance: migration-job/eviction activity + the hotspot
+        # time-to-dissipate SLO (cycles), straight from the SimReport
+        "rebalance": a.to_dict()["rebalance"],
         "platform": jax.default_backend(),
     }))
 
@@ -561,15 +580,13 @@ def run_churn(args_cli, num_pods: int, num_nodes: int) -> None:
     }))
 
 
-def run_rebalance(args_cli, num_pods: int, num_nodes: int) -> None:
-    """BASELINE config 5: koord-descheduler LowNodeLoad over num_pods RUNNING
-    pods on num_nodes nodes (30% overloaded, 40% underloaded). Measures one
-    full global rebalance pass: classification, victim selection, and
-    PodMigrationJob creation — the reference walks this with per-node Go
-    loops; here classification is one [N, R] compare."""
+def _build_rebalance_fixture(num_pods: int, num_nodes: int, now: float):
+    """The BASELINE config 5 store: num_pods RUNNING pods on num_nodes
+    nodes, 30% overloaded (85% cpu), 40% underloaded (20%), 30% in-band
+    (60%). ONE home for the shape — `run_rebalance` (host pass vs C++
+    floor) and `run_rebalance_ab` (device vs host pair) must measure the
+    identical fixture or their reports stop being comparable."""
     import random
-
-    import jax
 
     from koordinator_tpu.api.objects import (
         Node,
@@ -584,19 +601,12 @@ def run_rebalance(args_cli, num_pods: int, num_nodes: int) -> None:
         KIND_NODE,
         KIND_NODE_METRIC,
         KIND_POD,
-        KIND_POD_MIGRATION_JOB,
         ObjectStore,
     )
-    from koordinator_tpu.descheduler.lownodeload import LowNodeLoad
 
-    GIB = 1024**3
-    now = 1_000_000.0
+    GIB = 1024 ** 3
     rng = random.Random(7)
-    log(f"config: {num_pods} running pods x {num_nodes} nodes "
-        f"(LowNodeLoad global rebalance, BASELINE config 5)")
-    t0 = time.perf_counter()
     store = ObjectStore()
-    # 30% overloaded (85% cpu), 40% underloaded (20%), 30% in-band (60%)
     for i in range(num_nodes):
         cores = 32
         band = 85.0 if i % 10 < 3 else (20.0 if i % 10 < 7 else 60.0)
@@ -628,6 +638,24 @@ def run_rebalance(args_cli, num_pods: int, num_nodes: int) -> None:
                              memory=rng.choice([1, 2, 4]) * GIB)),
             phase="Running",
         ))
+    return store
+
+
+def run_rebalance(args_cli, num_pods: int, num_nodes: int) -> None:
+    """BASELINE config 5: koord-descheduler LowNodeLoad over num_pods RUNNING
+    pods on num_nodes nodes (30% overloaded, 40% underloaded). Measures one
+    full global rebalance pass: classification, victim selection, and
+    PodMigrationJob creation — the reference walks this with per-node Go
+    loops; here classification is one [N, R] compare."""
+    import jax
+
+    from koordinator_tpu.descheduler.lownodeload import LowNodeLoad
+
+    now = 1_000_000.0
+    log(f"config: {num_pods} running pods x {num_nodes} nodes "
+        f"(LowNodeLoad global rebalance, BASELINE config 5)")
+    t0 = time.perf_counter()
+    store = _build_rebalance_fixture(num_pods, num_nodes, now)
     log(f"fixture: {time.perf_counter() - t0:.2f}s (not framework cost)")
 
     plugin = LowNodeLoad(store)
@@ -714,6 +742,85 @@ def run_rebalance(args_cli, num_pods: int, num_nodes: int) -> None:
             }
         )
     )
+
+
+def run_rebalance_ab(args_cli, num_pods: int, num_nodes: int) -> None:
+    """koordbalance A/B: the device rebalance pass vs the host
+    LowNodeLoad oracle, back-to-back in one process (BENCH_NOTES
+    convention — only the pair ratio is real on a noisy box), plus the
+    drain-storm and hotspot churn pairs the subsystem opens.
+
+    The selection pair runs BOTH engines over the SAME packed view of
+    the 10k x 5k rebalance fixture (`_build_rebalance_fixture` — the
+    identical BASELINE config 5 store `run_rebalance` measures): N
+    timed host passes, then N timed device passes (upload + dispatch +
+    readback — the warm steady state reuses unchanged device buffers
+    through the shared DeviceSnapshot machinery), with victim-set
+    parity asserted every iteration. The churn legs ride run_sim_churn
+    and report time-to-dissipate p50/p99 from the hotspot scenario."""
+    import jax
+
+    from koordinator_tpu.balance.rebalancer import DeviceRebalancer
+    from koordinator_tpu.descheduler.lownodeload import LowNodeLoad
+    from koordinator_tpu.sim.scenarios import SCENARIOS
+
+    now = 1_000_000.0
+    log(f"config: {num_pods} running pods x {num_nodes} nodes "
+        f"(device rebalance pass vs host LowNodeLoad, A/B pair)")
+    t0 = time.perf_counter()
+    store = _build_rebalance_fixture(num_pods, num_nodes, now)
+    log(f"fixture: {time.perf_counter() - t0:.2f}s (not framework cost)")
+
+    plugin = LowNodeLoad(store)
+    plugin.select_victims(now=now)  # warm the pack (subscription replay)
+    view, _src = plugin._view(now)
+    iters = 2 if args_cli.smoke else max(5, args_cli.iters // 4)
+
+    host_times = []
+    host_picked = None
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        host_picked = plugin.select_victims_host(view)
+        host_times.append(time.perf_counter() - t0)
+    host_ms = float(np.median(host_times)) * 1000.0
+
+    reb = DeviceRebalancer()
+    plugin.attach_device(reb)
+    dev_times = []
+    parity_ok = True
+    dev_picked = None
+    plugin.select_victims(now=now)  # compile + first upload outside loop
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        dev_picked, _s, view = plugin.select_victims(now=now)
+        dev_times.append(time.perf_counter() - t0)
+        parity_ok = parity_ok and (
+            plugin.last_pass_stats.get("engine") == "device"
+            and list(dev_picked) == list(host_picked))
+    dev_ms = float(np.median(dev_times)) * 1000.0
+    log(f"host oracle: median {host_ms:.2f}ms; device pass: median "
+        f"{dev_ms:.2f}ms over {iters} iters each "
+        f"({len(host_picked)} victims) -> pair ratio "
+        f"{host_ms / dev_ms if dev_ms else 0.0:.2f}x, victim parity "
+        f"{'OK' if parity_ok else 'MISMATCH'}")
+    print(json.dumps({
+        "metric": f"rebalance_pass_ms_{num_pods}x{num_nodes}",
+        "value": round(dev_ms, 3),
+        "unit": "ms",
+        "rebalance_pass_ms_device": round(dev_ms, 3),
+        "rebalance_pass_ms_host": round(host_ms, 3),
+        "pair_ratio_host_over_device": round(
+            host_ms / dev_ms, 3) if dev_ms else 0.0,
+        "victims": int(len(host_picked)),
+        "parity_ok": bool(parity_ok),
+        "platform": jax.default_backend(),
+    }))
+
+    # ---- the scenario pairs the subsystem opens: drain-storm (mass
+    # cordon + migration) and hotspot (time-to-dissipate p50/p99 rides
+    # the churn JSON's "rebalance" block)
+    for name in ("drain-storm", "hotspot"):
+        run_sim_churn(args_cli, SCENARIOS[name])
 
 
 def run_steady_state(args_cli, num_pods: int, num_nodes: int) -> dict:

@@ -1,6 +1,7 @@
 """Rule modules; importing this package registers every rule."""
 
 from koordinator_tpu.analysis.rules import (  # noqa: F401
+    balance,
     concurrency,
     jaxtrace,
     loops,

@@ -1,0 +1,49 @@
+"""koordbalance: device-resident rebalancing.
+
+The descheduler's LowNodeLoad pass re-expressed as a batched node x pod
+tensor pass sharing the scheduler's device mirror — one upload, two
+consumers (PAPER.md layer map: koord-descheduler peers with the
+scheduler only through Reservation/migration CRDs; ROADMAP "Batch the
+descheduler onto the device snapshot").
+
+Three pieces:
+
+  * :mod:`koordinator_tpu.balance.pack` — ``RebalancePack``, the
+    event-maintained packed arrays (node usage/metric columns + assigned
+    pod rows). One pack per store; when a scheduler shares the process
+    its :class:`~koordinator_tpu.scheduler.snapshot_cache.SnapshotCache`
+    FORWARDS its store events into the pack, so the cluster is encoded
+    once for both consumers (the old ``RebalancePackCache``'s duplicate
+    subscription chain is gone).
+  * :mod:`koordinator_tpu.balance.step` — ``build_rebalance_step``, the
+    jitted tensor pass: node classification against the high/low
+    thresholds, per-node overload margins, and the victim-candidate
+    selection (sorted-by-usage victim order, movability masks, the
+    per-segment freed-prefix greedy) in ONE batched device program with
+    compacted (node_idx, pod_idx, score) readback.
+  * :mod:`koordinator_tpu.balance.rebalancer` — ``DeviceRebalancer``,
+    the driver: pad-bucketed upload through the (shared)
+    ``DeviceSnapshot``, the ``rebalance`` span tree, rebalance metrics,
+    and the PR 7 degradation ladder (device pass -> host ``LowNodeLoad``
+    fallback) so a rebalance fault never kills either component.
+
+``KOORD_TPU_REBALANCE=on|off|host`` selects the engine (see
+``rebalance_from_env``); decision parity against the host oracle is
+gated by ``pipeline_parity.run_rebalance_parity`` at mesh 1/2/4/8.
+"""
+
+from koordinator_tpu.balance.pack import RebalancePack, has_pdb_like_guard
+from koordinator_tpu.balance.rebalancer import (
+    DeviceRebalancer,
+    rebalance_from_env,
+)
+from koordinator_tpu.balance.step import RebalanceOut, build_rebalance_step
+
+__all__ = [
+    "RebalancePack",
+    "DeviceRebalancer",
+    "RebalanceOut",
+    "build_rebalance_step",
+    "has_pdb_like_guard",
+    "rebalance_from_env",
+]

@@ -1,0 +1,356 @@
+"""DeviceRebalancer: drive the rebalance tensor pass against the shared
+device mirror, with the PR 7 degradation ladder underneath.
+
+The rebalancer is the descheduler-side consumer of the scheduler's
+``DeviceSnapshot``: its arrays upload through the SAME reuse/scatter/put
+machinery (``upload_fields``) under ``rb_*`` names, so a steady-state
+cluster ships only row deltas and the two consumers share one device
+mirror — the "one upload, two consumers" closing of the ROADMAP item.
+Under ``KOORD_TPU_MESH`` the node-axis fields shard over the mesh via
+the existing ``put_on_mesh``/NamedSharding helpers
+(parallel/rebalance_mesh.py) and the compacted readback replicates.
+
+Resilience reuses the scheduler's ladder machine
+(scheduler/degrade.DegradationLadder) with only the rungs that change
+behavior here: ``full`` (sharded device pass) -> ``no-mesh`` (single-
+device pass, skipped when no mesh is configured) -> ``host-fallback``
+(the host ``LowNodeLoad`` oracle). A rebalance fault therefore never
+kills the descheduler — it sheds the device, keeps the decisions (the
+host oracle is decision-identical by the parity gate), and re-promotes
+after clean passes exactly like the dispatch ladder.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from koordinator_tpu.obs import Tracer
+from koordinator_tpu.obs.flight import FLIGHT_SCHEMA_VERSION, FlightRecorder
+from koordinator_tpu.scheduler.degrade import (
+    LEVEL_HOST_FALLBACK,
+    LEVEL_NO_MESH,
+    DegradationLadder,
+)
+
+logger = logging.getLogger(__name__)
+
+# names of the node-axis upload fields — shared with
+# snapshot_cache._mesh_node_fields so the mesh-backed DeviceSnapshot
+# shards them exactly like the scheduler's own node arrays
+RB_NODE_FIELDS = ("rb_usage_pct", "rb_has_metric", "rb_rhs_hi",
+                  "rb_rhs_lo")
+
+
+def rebalance_from_env():
+    """KOORD_TPU_REBALANCE=on|off|host selects the LowNodeLoad engine:
+    "on" (default) runs the device tensor pass (with the host fallback
+    ladder underneath), "host" pins the host numpy oracle, "off"
+    disables the rebalance pass entirely (the incident kill switch —
+    the descheduler's other plugins keep running)."""
+    import os
+
+    raw = os.environ.get("KOORD_TPU_REBALANCE", "on").strip().lower()
+    if raw in ("", "on", "1", "true", "device"):
+        return "on"
+    if raw in ("off", "0", "false", "no"):
+        return "off"
+    if raw == "host":
+        return "host"
+    logger.warning("KOORD_TPU_REBALANCE=%r unknown; using 'on'", raw)
+    return "on"
+
+
+def _bucket(n: int, lo: int) -> int:
+    """Power-of-two pad bucket (>= lo): each distinct padded shape is a
+    distinct compiled program, so shapes quantize."""
+    p = lo
+    while p < n:
+        p *= 2
+    return p
+
+
+class DeviceRebalancer:
+    """Owns the compiled rebalance steps, the (possibly shared) device
+    mirror, the rebalance ladder, span tree, metrics and flight ring.
+
+    ``snapshot_getter`` returns the scheduler's live ``DeviceSnapshot``
+    (it is rebuilt on scheduler ladder transitions, so the reference
+    must be read per pass); without one the rebalancer owns a private
+    mirror. ``mesh`` is the configured mesh (parallel/mesh.py) — the
+    ladder's no-mesh rung drops to a private single-device mirror."""
+
+    def __init__(self, mesh=None,
+                 snapshot_getter: Optional[Callable[[], object]] = None,
+                 ladder: Optional[DegradationLadder] = None,
+                 promote_after: int = 16,
+                 tracer: Optional[Tracer] = None,
+                 flight: Optional[FlightRecorder] = None) -> None:
+        self.mesh = mesh
+        self.snapshot_getter = snapshot_getter
+        self.ladder = ladder if ladder is not None else DegradationLadder(
+            promote_after=promote_after)
+        self.tracer = tracer if tracer is not None else Tracer()
+        self.flight = flight if flight is not None else FlightRecorder()
+        self._step_cache: Dict[Tuple, object] = {}
+        self._own_snapshots: Dict[bool, object] = {}  # mesh_on -> mirror
+        self._seq = 0
+        self._warned_host_only = False
+        # sim/test failure-injection hook: a callable() invoked at the
+        # top of every device-pass window; raising from it exercises the
+        # rebalance ladder exactly like a real XLA/mesh fault
+        self.fault_injector = None
+        self.stats = {"device_passes": 0, "host_passes": 0,
+                      "candidates": 0, "victims": 0}
+
+    # ------------------------------------------------------------------
+    def _features(self) -> Dict[str, bool]:
+        return {"mesh": self.mesh is not None,
+                "waves": False, "explain": False}
+
+    def _active_mesh(self):
+        return self.mesh if self.ladder.level < LEVEL_NO_MESH else None
+
+    def _snapshot(self, mesh):
+        """The device mirror for this pass. The scheduler's shared
+        mirror is used only while its mesh placement matches ours —
+        otherwise (scheduler demoted independently, or we did) the
+        rebalancer falls back to a private mirror so the upload
+        placement always matches the compiled step."""
+        if self.snapshot_getter is not None:
+            shared = self.snapshot_getter()
+            if shared is not None and getattr(shared, "mesh", None) is mesh:
+                return shared
+        key = mesh is not None
+        snap = self._own_snapshots.get(key)
+        if snap is None:
+            from koordinator_tpu.scheduler.snapshot_cache import (
+                DeviceSnapshot,
+            )
+
+            snap = DeviceSnapshot(mesh=mesh)
+            self._own_snapshots[key] = snap
+        return snap
+
+    def _get_step(self, p_pad: int, n_pad: int, cap: int, mesh):
+        mesh_tag = mesh.devices.size if mesh is not None else 0
+        key = (p_pad, n_pad, cap, mesh_tag)
+        step = self._step_cache.get(key)
+        if step is None:
+            with self.tracer.span("compile", signature=str(key)):
+                if mesh is not None:
+                    from koordinator_tpu.parallel import (
+                        build_sharded_rebalance_step,
+                    )
+
+                    step = build_sharded_rebalance_step(cap, mesh)
+                else:
+                    from koordinator_tpu.balance.step import (
+                        build_rebalance_step,
+                    )
+
+                    step = build_rebalance_step(cap)
+            self._step_cache[key] = step
+        return step
+
+    # ------------------------------------------------------------------
+    # a per-SEGMENT freed total above this bound could make the f32
+    # product X = freed * 100 inexact and flip the limb compare near the
+    # threshold (balance/step.py module doc): f32 is integer-exact to
+    # 2^24, so freed*100 is unconditionally exact below 2^24/100. The
+    # per-node sum of ALL movable pod requests upper-bounds any
+    # segment's freed prefix.
+    _X_EXACT_BOUND = (2 ** 24) // 100
+
+    @staticmethod
+    def _device_eligible(view) -> Optional[str]:
+        """The device pass's exactness preconditions (module doc of
+        balance/step.py). A view outside them is not a fault — it is a
+        per-pass demotion to the host oracle, like the fused-wave
+        feature demotions."""
+        req = view["pod_req"]
+        if not req.size:
+            return None
+        if not np.all(np.floor(req) == req):
+            return "non-integer packed request rows"
+        n = view["alloc"].shape[0]
+        live = view["pod_alive"] & view["pod_movable"] & (
+            view["pod_node"] >= 0)
+        per_node = np.zeros((n, req.shape[1]), np.float64)
+        np.add.at(per_node, view["pod_node"][live],
+                  np.abs(req[live], dtype=np.float64))
+        if np.any(per_node > DeviceRebalancer._X_EXACT_BOUND):
+            return ("per-node request totals exceed the f32 "
+                    "freed*100 exactness bound")
+        return None
+
+    def _prep(self, view, low_thr: np.ndarray, high_thr: np.ndarray):
+        """Pad-bucketed host arrays + the float64 rhs limb split."""
+        from koordinator_tpu.balance.step import split_rhs_limbs
+
+        n = view["alloc"].shape[0]
+        p = view["pod_node"].shape[0]
+        n_pad = _bucket(n, 8)
+        p_pad = _bucket(p, 64)
+        usage = np.zeros((n_pad, view["usage_pct"].shape[1]), np.float32)
+        usage[:n] = view["usage_pct"]
+        has_metric = np.zeros(n_pad, bool)
+        has_metric[:n] = view["has_metric"]
+        rhs_hi, rhs_lo = split_rhs_limbs(
+            view["usage_pct"], view["alloc"], high_thr)
+        hi = np.zeros_like(usage)
+        hi[:n] = rhs_hi
+        lo = np.zeros_like(usage)
+        lo[:n] = rhs_lo
+        pod_node = np.full(p_pad, -1, np.int32)
+        pod_node[:p] = view["pod_node"].astype(np.int32)
+        pod_prio = np.zeros(p_pad, np.int32)
+        pod_prio[:p] = view["pod_prio"].astype(np.int32)
+        pod_cpu = np.zeros(p_pad, np.float32)
+        pod_cpu[:p] = view["pod_cpu"]
+        pod_req = np.zeros((p_pad, view["pod_req"].shape[1]), np.int32)
+        pod_req[:p] = view["pod_req"].astype(np.int32)
+        pod_ok = np.zeros(p_pad, bool)
+        pod_ok[:p] = view["pod_alive"] & view["pod_movable"]
+        return {
+            "rb_usage_pct": usage, "rb_has_metric": has_metric,
+            "rb_rhs_hi": hi, "rb_rhs_lo": lo,
+            "rb_low_thr": low_thr, "rb_high_thr": high_thr,
+            "rb_pod_node": pod_node, "rb_pod_prio": pod_prio,
+            "rb_pod_cpu": pod_cpu, "rb_pod_req": pod_req,
+            "rb_pod_ok": pod_ok,
+        }, p_pad, n_pad
+
+    # ------------------------------------------------------------------
+    def select_victims(self, plugin, view, now: float):
+        """One rebalance pass over the packed view. Returns
+        (picked slot indices, stats dict) — decision-identical to the
+        host oracle ``plugin.select_victims_host`` (the parity gate
+        pins it); the ladder demotes to that oracle on faults."""
+        t0 = time.perf_counter()
+        self._seq += 1
+        self.ladder.begin_pass()
+        reason = self._device_eligible(view)
+        if reason is not None:
+            if not self._warned_host_only:
+                logger.warning("rebalance device pass ineligible (%s); "
+                               "using the host oracle", reason)
+                self._warned_host_only = True
+            return self._host_pass(plugin, view, now, t0,
+                                   engine="host-ineligible")
+        while True:
+            if self.ladder.level >= LEVEL_HOST_FALLBACK:
+                return self._host_pass(plugin, view, now, t0)
+            mesh = self._active_mesh()
+            try:
+                picked, stats = self._device_pass(plugin, view, mesh)
+                self._record(now, t0, stats)
+                self.ladder.note_cycle()
+                return picked, stats
+            except Exception as exc:
+                action = self.ladder.on_failure(
+                    self._features(),
+                    error=f"{type(exc).__name__}: {exc}")
+                if action == "exhausted":
+                    # cannot happen above the host rung (it always
+                    # changes behavior); defensive parity with the
+                    # scheduler's wrapper
+                    raise
+                logger.warning(
+                    "rebalance device pass failed (%s: %s); %s at "
+                    "ladder level %s", type(exc).__name__, exc, action,
+                    self.ladder.level_name)
+
+    def _host_pass(self, plugin, view, now: float, t0: float,
+                   engine: str = "host"):
+        with self.tracer.span("score", host="1"):
+            picked = plugin.select_victims_host(view)
+        stats = {"engine": engine,
+                 "candidates": int(plugin.last_pass_stats.get(
+                     "candidates", 0)),
+                 "victims": int(picked.size),
+                 "ladder_level": self.ladder.level_name}
+        self.stats["host_passes"] += 1
+        self.stats["candidates"] += stats["candidates"]
+        self.stats["victims"] += stats["victims"]
+        self._record(now, t0, stats)
+        self.ladder.note_cycle()
+        return picked, stats
+
+    def _device_pass(self, plugin, view, mesh):
+        if self.fault_injector is not None:
+            self.fault_injector()
+        with self.tracer.span("classify") as csp:
+            low_thr = plugin._thr_vec(plugin.args.low_thresholds)
+            high_thr = plugin._thr_vec(plugin.args.high_thresholds)
+            fields, p_pad, n_pad = self._prep(view, low_thr, high_thr)
+            csp.attributes["nodes"] = str(view["alloc"].shape[0])
+            csp.attributes["pods"] = str(view["pod_node"].shape[0])
+        step = self._get_step(p_pad, n_pad,
+                              plugin.args.max_pods_to_evict_per_node, mesh)
+        snap = self._snapshot(mesh)
+        snap.begin_dispatch()
+        try:
+            with self.tracer.span("score", mesh=str(
+                    mesh.devices.size if mesh is not None else 0)):
+                dev = snap.upload_fields(fields)
+                out = step(dev["rb_usage_pct"], dev["rb_has_metric"],
+                           dev["rb_low_thr"], dev["rb_high_thr"],
+                           dev["rb_rhs_hi"], dev["rb_rhs_lo"],
+                           dev["rb_pod_node"], dev["rb_pod_prio"],
+                           dev["rb_pod_cpu"], dev["rb_pod_req"],
+                           dev["rb_pod_ok"])
+            with self.tracer.span("readback"):
+                # the rebalance pass's designated sync point
+                sel_count = int(out.sel_count)
+                cand_count = int(out.cand_count)
+                sel_pod = np.asarray(out.sel_pod)[:sel_count]
+                sel_node = np.asarray(out.sel_node)[:sel_count]
+                sel_score = np.asarray(out.sel_score)[:sel_count]
+                n = view["alloc"].shape[0]
+                is_low = np.asarray(out.is_low)[:n]
+                is_high = np.asarray(out.is_high)[:n]
+                margin = np.asarray(out.margin)[:n]
+        finally:
+            snap.end_dispatch()
+        picked = sel_pod.astype(np.int64)
+        stats = {"engine": "device", "candidates": cand_count,
+                 "victims": sel_count,
+                 "is_low": is_low, "is_high": is_high, "margin": margin,
+                 "victim_nodes": sel_node, "victim_scores": sel_score,
+                 "ladder_level": self.ladder.level_name}
+        self.stats["device_passes"] += 1
+        self.stats["candidates"] += cand_count
+        self.stats["victims"] += sel_count
+        return picked, stats
+
+    def _record(self, now: float, t0: float, stats: dict) -> None:
+        """One pass record into the flight ring (valid ``cycle`` record
+        per obs/flight.py's schema, so rebalance dumps replay through
+        the same tooling) + the pass metrics."""
+        from koordinator_tpu.descheduler import metrics as dm
+
+        duration = time.perf_counter() - t0
+        dm.REBALANCE_PASS_SECONDS.observe(duration)
+        if stats.get("candidates"):
+            dm.REBALANCE_CANDIDATES.inc(stats["candidates"])
+        if stats.get("victims"):
+            dm.REBALANCE_VICTIMS.inc(stats["victims"])
+        self.flight.record_cycle({
+            "v": FLIGHT_SCHEMA_VERSION,
+            "kind": "cycle",
+            "seq": self._seq,
+            "ts": float(now),
+            "duration_ms": duration * 1000.0,
+            "waves": 0,
+            "bound": [], "failed": [], "rejected": [], "preempted": [],
+            "metrics": {
+                "rebalance_candidates": float(stats.get("candidates", 0)),
+                "rebalance_victims": float(stats.get("victims", 0)),
+                "rebalance_device": float(stats.get("engine") == "device"),
+            },
+            "spans": [],
+        })

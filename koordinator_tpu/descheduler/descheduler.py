@@ -31,6 +31,8 @@ class Descheduler:
         low_node_load_args: Optional[LowNodeLoadArgs] = None,
         profiles: Optional[List[ProfileConfig]] = None,
         elector=None,
+        scheduler=None,
+        rebalance: Optional[str] = None,
     ):
         self.store = store
         # active/standby gating (cmd/koord-descheduler mirrors the scheduler's
@@ -55,6 +57,57 @@ class Descheduler:
             ]
         self.profiles = [Profile(cfg, store) for cfg in profiles]
         self.migration = MigrationController(store)
+        # ---- koordbalance wiring (balance/): the descheduler as the
+        # SECOND consumer of the scheduler's snapshot. With a co-located
+        # `scheduler`, LowNodeLoad's packed view comes from the
+        # scheduler's SnapshotCache subscription chain (one encode) and
+        # the device pass uploads through the scheduler's DeviceSnapshot
+        # (one mirror). KOORD_TPU_REBALANCE=on|off|host picks the
+        # engine; "on" (default) attaches the DeviceRebalancer with the
+        # host-oracle fallback ladder underneath.
+        from koordinator_tpu.balance.rebalancer import rebalance_from_env
+
+        self.scheduler = scheduler
+        self.rebalance_mode = (rebalance_from_env() if rebalance is None
+                               else rebalance)
+        if self.rebalance_mode not in ("on", "off", "host"):
+            raise ValueError(
+                f"rebalance must be 'on', 'off' or 'host'; "
+                f"got {self.rebalance_mode!r}")
+        self.rebalancer = None
+        self._wire_rebalance()
+
+    def _wire_rebalance(self) -> None:
+        from koordinator_tpu.balance.rebalancer import DeviceRebalancer
+
+        snapshot_cache = (getattr(self.scheduler, "snapshot_cache", None)
+                          if self.scheduler is not None else None)
+        for profile in self.profiles:
+            for plugin in profile.balance_plugins:
+                if plugin.name != "LowNodeLoad":
+                    continue
+                plugin.enabled = self.rebalance_mode != "off"
+                inner = plugin.inner
+                if snapshot_cache is not None:
+                    inner.pack_cache = snapshot_cache.rebalance_pack(
+                        inner.args.node_metric_expiration_seconds)
+                if self.rebalance_mode != "on":
+                    continue
+                if self.rebalancer is None:
+                    if self.scheduler is not None:
+                        mesh = getattr(self.scheduler,
+                                       "_configured_mesh", None)
+                        getter = lambda: self.scheduler.device_snapshot  # noqa: E731
+                    else:
+                        from koordinator_tpu.parallel.mesh import (
+                            mesh_from_env,
+                        )
+
+                        mesh = mesh_from_env()
+                        getter = None
+                    self.rebalancer = DeviceRebalancer(
+                        mesh=mesh, snapshot_getter=getter)
+                inner.attach_device(self.rebalancer)
 
     def run_once(self, now: Optional[float] = None) -> dict:
         from koordinator_tpu.client.store import KIND_POD_MIGRATION_JOB

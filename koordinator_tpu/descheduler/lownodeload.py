@@ -5,11 +5,27 @@ Analog of reference `pkg/descheduler/framework/plugins/loadaware/low_node_load.g
 into low (below lowThresholds on every resource) and high (above highThresholds
 on any); evict movable pods from high nodes while capacity remains on low nodes.
 
-Batched formulation: classification is one [N, R] compare; victim-fit against
-low nodes reuses the scheduler's one-shot score-matrix kernel
-(models/scheduler_model.build_score_matrix) in "all candidate pods x low nodes"
-mode — BASELINE config 5's 50k-pod global rebalance runs as a single device
-pass instead of per-pod Go loops."""
+Two engines over one packed view (balance/pack.RebalancePack — the
+event-maintained arrays, shared with the scheduler's SnapshotCache when
+both run in one process):
+
+  * ``select_victims_host`` — the host numpy oracle: one stable lexsort
+    + per-segment freed-prefix math, victim-set-identical to the serial
+    C++ floor (bench.py --chain rebalance diffs them every run). This
+    is the diagnose-style REFERENCE the device pass is gated against,
+    the way ``host_stage_counts`` is for koordexplain.
+  * the device tensor pass (balance/step.py via an attached
+    :class:`~koordinator_tpu.balance.rebalancer.DeviceRebalancer`) —
+    the same classification + selection as one jitted batched program
+    on the (mesh-shardable) device mirror, decision-parity gated by
+    ``pipeline_parity.run_rebalance_parity`` at mesh 1/2/4/8, with the
+    PR 7 degradation ladder falling back to the host oracle on faults.
+
+`KOORD_TPU_REBALANCE=on|off|host` picks the engine at the Descheduler
+level (descheduler/descheduler.py wires the rebalancer in); a bare
+``LowNodeLoad(store)`` stays pure host, so standalone descheduler
+deployments and unit fixtures never touch jax.
+"""
 
 from __future__ import annotations
 
@@ -25,6 +41,7 @@ from koordinator_tpu.api.resources import (
     RESOURCE_INDEX,
     ResourceName,
 )
+from koordinator_tpu.balance.pack import RebalancePack, has_pdb_like_guard
 from koordinator_tpu.client.store import (
     KIND_NODE,
     KIND_NODE_METRIC,
@@ -32,14 +49,10 @@ from koordinator_tpu.client.store import (
     KIND_POD_MIGRATION_JOB,
     ObjectStore,
 )
+from koordinator_tpu.obs import Tracer
 
 CPU = RESOURCE_INDEX[ResourceName.CPU]
 MEM = RESOURCE_INDEX[ResourceName.MEMORY]
-
-# store -> {expiration -> RebalancePackCache}; weak so stores die normally
-import weakref  # noqa: E402
-
-_PACK_CACHES: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 @dataclass
@@ -68,217 +81,34 @@ def classify_nodes(
     return low & ~high, high
 
 
-class RebalancePackCache:
-    """Event-maintained packed arrays for the rebalance pass.
-
-    The reference keeps incremental caches and walks them per run
-    (utilization_util.go reads informer caches, not the API server); the
-    batch analog keeps the pod/node state PACKED so `select_victims` is
-    pure array math — the store walk and object packing move out of the
-    per-pass cost entirely. Slots are append-only (compacted when >50%
-    dead) so masked views preserve store insertion order, which the
-    stable lexsort relies on for exact victim-set parity with the serial
-    C++ floor."""
-
-    _GROW = 1024
-
-    @classmethod
-    def for_store(cls, store: ObjectStore,
-                  expiration_seconds: float) -> "RebalancePackCache":
-        """One cache per (store, expiration): ObjectStore has no
-        unsubscribe, so every construction would leak a live handler —
-        repeat LowNodeLoad constructions on the same store (per-pass
-        plugin re-inits) must share the subscription."""
-        by_exp = _PACK_CACHES.setdefault(store, {})
-        cache = by_exp.get(expiration_seconds)
-        if cache is None:
-            cache = cls(store, expiration_seconds)
-            by_exp[expiration_seconds] = cache
-        return cache
-
-    def __init__(self, store: ObjectStore,
-                 expiration_seconds: float) -> None:
-        self.store = store
-        self.expiration = expiration_seconds
-        # node side
-        self._node_names: List[str] = []
-        self._node_idx: Dict[str, int] = {}
-        self.alloc = np.zeros((0, NUM_RESOURCES), np.float32)
-        self.usage_pct = np.zeros((0, NUM_RESOURCES), np.float32)
-        self.nm_time = np.zeros(0, np.float64)
-        self.has_raw = np.zeros(0, bool)
-        self._nodes_stale = True
-        # pod side (append-only slots)
-        self._slot: Dict[str, int] = {}
-        self._cap = 0
-        self._len = 0
-        self._dead = 0
-        self.pod_alive = np.zeros(0, bool)
-        self.pod_node_name: List[Optional[str]] = []
-        self.pod_node = np.zeros(0, np.int64)
-        self._pod_node_stale = True
-        self.pod_prio = np.zeros(0, np.int64)
-        self.pod_cpu = np.zeros(0, np.float32)
-        self.pod_req = np.zeros((0, NUM_RESOURCES), np.float32)
-        self.pod_movable = np.zeros(0, bool)
-        self.pod_ref: List[Optional[Pod]] = []
-        store.subscribe(KIND_NODE, self._on_node)
-        store.subscribe(KIND_NODE_METRIC, self._on_metric)
-        store.subscribe(KIND_POD, self._on_pod)
-
-    # -- events --------------------------------------------------------
-    def _on_node(self, ev, node, old) -> None:
-        self._nodes_stale = True
-
-    def _on_metric(self, ev, nm, old) -> None:
-        # metric rows refresh lazily with the node table; a metric-only
-        # update just recomputes that row
-        self._nodes_stale = True
-
-    def _on_pod(self, ev, pod: Pod, old) -> None:
-        from koordinator_tpu.client.store import EventType
-
-        key = pod.meta.key
-        slot = self._slot.get(key)
-        live = (ev is not EventType.DELETED and pod.is_assigned
-                and not pod.is_terminated)
-        if not live:
-            if slot is not None and self.pod_alive[slot]:
-                self.pod_alive[slot] = False
-                self.pod_ref[slot] = None
-                self._dead += 1
-            if ev is EventType.DELETED:
-                # a deleted-then-recreated pod must land in a FRESH slot:
-                # the store dict re-inserts it at the end, and slot order
-                # must track store insertion order for sort-parity with
-                # the cold pass / C++ floor (terminated-in-place pods keep
-                # their slot — the store preserves their dict position)
-                self._slot.pop(key, None)
-            return
-        if slot is None:
-            if self._len == self._cap:
-                grow = max(self._GROW, self._cap)
-                self.pod_alive = np.concatenate(
-                    [self.pod_alive, np.zeros(grow, bool)])
-                self.pod_node = np.concatenate(
-                    [self.pod_node, np.full(grow, -1, np.int64)])
-                self.pod_prio = np.concatenate(
-                    [self.pod_prio, np.zeros(grow, np.int64)])
-                self.pod_cpu = np.concatenate(
-                    [self.pod_cpu, np.zeros(grow, np.float32)])
-                self.pod_req = np.concatenate(
-                    [self.pod_req,
-                     np.zeros((grow, NUM_RESOURCES), np.float32)])
-                self.pod_movable = np.concatenate(
-                    [self.pod_movable, np.zeros(grow, bool)])
-                self.pod_node_name.extend([None] * grow)
-                self.pod_ref.extend([None] * grow)
-                self._cap += grow
-            slot = self._len
-            self._slot[key] = slot
-            self._len += 1
-        elif not self.pod_alive[slot]:
-            self._dead -= 1
-        self.pod_alive[slot] = True
-        self.pod_node_name[slot] = pod.spec.node_name
-        self.pod_prio[slot] = pod.spec.priority or 0
-        self.pod_cpu[slot] = pod.spec.requests[ResourceName.CPU]
-        self.pod_req[slot] = pod.spec.requests.to_vector()
-        self.pod_movable[slot] = (
-            pod.meta.owner_kind != "DaemonSet"
-            and not _has_pdb_like_guard(pod))
-        self.pod_ref[slot] = pod
-        self._pod_node_stale = True
-
-    # -- refresh -------------------------------------------------------
-    def _refresh_nodes(self) -> None:
-        nodes = self.store.list(KIND_NODE)
-        names = [n.meta.name for n in nodes]
-        remap = names != self._node_names
-        if remap:
-            self._node_names = names
-            self._node_idx = {n: i for i, n in enumerate(names)}
-            self._pod_node_stale = True
-        N = len(nodes)
-        self.alloc = np.zeros((N, NUM_RESOURCES), np.float32)
-        self.usage_pct = np.zeros((N, NUM_RESOURCES), np.float32)
-        self.nm_time = np.zeros(N, np.float64)
-        self.has_raw = np.zeros(N, bool)
-        for i, node in enumerate(nodes):
-            self.alloc[i] = node.allocatable.to_vector()
-            nm: Optional[NodeMetric] = self.store.get(
-                KIND_NODE_METRIC, f"/{node.meta.name}")
-            if nm is None or nm.update_time <= 0:
-                continue
-            usage = nm.node_metric.node_usage.to_vector()
-            a = self.alloc[i]
-            with np.errstate(divide="ignore", invalid="ignore"):
-                self.usage_pct[i] = np.where(
-                    a > 0, usage * 100.0 / np.maximum(a, 1e-9), 0.0)
-            self.nm_time[i] = nm.update_time
-            self.has_raw[i] = True
-        self._nodes_stale = False
-
-    def _compact(self) -> None:
-        keep = np.nonzero(self.pod_alive[: self._len])[0]
-        self.pod_alive = np.concatenate(
-            [np.ones(keep.size, bool), np.zeros(self._cap - keep.size, bool)])
-        for arr_name in ("pod_node", "pod_prio", "pod_cpu", "pod_movable"):
-            arr = getattr(self, arr_name)
-            packed = arr[keep]
-            arr[: keep.size] = packed
-            arr[keep.size:] = 0
-        self.pod_req[: keep.size] = self.pod_req[keep]
-        self.pod_req[keep.size:] = 0
-        names = [self.pod_node_name[k] for k in keep]
-        refs = [self.pod_ref[k] for k in keep]
-        pad = self._cap - keep.size
-        self.pod_node_name = names + [None] * pad
-        self.pod_ref = refs + [None] * pad
-        self._slot = {
-            refs[j].meta.key: j for j in range(keep.size)
-        }
-        self._len = keep.size
-        self._dead = 0
-
-    def view(self, now: float):
-        """(packed arrays dict) for select_victims — refreshes lazily."""
-        if self._nodes_stale:
-            self._refresh_nodes()
-        if self._dead * 2 > max(1, self._len):
-            self._compact()
-        if self._pod_node_stale:
-            idx = self._node_idx
-            for j in range(self._len):
-                name = self.pod_node_name[j]
-                self.pod_node[j] = idx.get(name, -1) if name else -1
-            self._pod_node_stale = False
-        has_metric = self.has_raw & (
-            now - self.nm_time < self.expiration)
-        return {
-            "alloc": self.alloc,
-            "usage_pct": self.usage_pct,
-            "has_metric": has_metric,
-            "pod_alive": self.pod_alive[: self._len],
-            "pod_node": self.pod_node[: self._len],
-            "pod_prio": self.pod_prio[: self._len],
-            "pod_cpu": self.pod_cpu[: self._len],
-            "pod_req": self.pod_req[: self._len],
-            "pod_movable": self.pod_movable[: self._len],
-        }
-
-
 class LowNodeLoad:
     name = "LowNodeLoad"
 
     def __init__(self, store: ObjectStore, args: Optional[LowNodeLoadArgs] = None,
-                 incremental: bool = True):
+                 incremental: bool = True, pack: Optional[RebalancePack] = None,
+                 device=None):
         self.store = store
         self.args = args or LowNodeLoadArgs()
-        self.pack_cache = (
-            RebalancePackCache.for_store(
-                store, self.args.node_metric_expiration_seconds)
-            if incremental else None)
+        # the packed view: an explicitly shared pack (SnapshotCache
+        # deployments — one encode, two consumers) wins; otherwise the
+        # per-store singleton, created LAZILY on the first view so the
+        # Descheduler can swap the shared pack in post-construction
+        # without having orphaned a store-subscribed singleton;
+        # incremental=False keeps the cold walk
+        self.pack_cache: Optional[RebalancePack] = pack
+        self._lazy_pack = incremental and pack is None
+        # DeviceRebalancer (balance/rebalancer.py): None = host oracle
+        self.device = None
+        self.tracer = Tracer()
+        self.last_pass_stats: Dict[str, object] = {}
+        if device is not None:
+            self.attach_device(device)
+
+    def attach_device(self, device) -> None:
+        """Wire a DeviceRebalancer in; its tracer becomes the plugin's
+        so classify/score/readback land under the ``rebalance`` root."""
+        self.device = device
+        self.tracer = device.tracer
 
     def _thr_vec(self, thr: Dict[str, float]) -> np.ndarray:
         v = np.zeros(NUM_RESOURCES, np.float32)
@@ -288,7 +118,7 @@ class LowNodeLoad:
 
     def _cold_view(self, now: float):
         """Walk-everything packing (incremental=False path); same array
-        contract as RebalancePackCache.view."""
+        contract as RebalancePack.view."""
         nodes: List[Node] = self.store.list(KIND_NODE)
         N = len(nodes)
         alloc = np.zeros((N, NUM_RESOURCES), np.float32)
@@ -329,8 +159,17 @@ class LowNodeLoad:
                         if pods else np.zeros((0, NUM_RESOURCES), np.float32)),
             "pod_movable": np.asarray(
                 [p.meta.owner_kind != "DaemonSet"
-                 and not _has_pdb_like_guard(p) for p in pods], bool),
+                 and not has_pdb_like_guard(p) for p in pods], bool),
         }, pods
+
+    def _view(self, now: float):
+        if self.pack_cache is None and self._lazy_pack:
+            self.pack_cache = RebalancePack.for_store(
+                self.store, self.args.node_metric_expiration_seconds)
+        if self.pack_cache is not None:
+            return self.pack_cache.view(now), self.pack_cache.pod_ref
+        v, pods_cold = self._cold_view(now)
+        return v, pods_cold
 
     def select_victims(self, now: Optional[float] = None):
         """The TIMED rebalance pass: pure array math on the packed view.
@@ -338,24 +177,49 @@ class LowNodeLoad:
         materialization, PodMigrationJob construction and store writes all
         happen in balance(), outside this pass, exactly as the reference's
         job creation is API-server work outside utilization_util.go's
-        math (and the C++ floor's output is victim flags, not objects)."""
+        math (and the C++ floor's output is victim flags, not objects).
+        With a DeviceRebalancer attached the pass runs on device
+        (decision-identical; ladder falls back to the host oracle)."""
         now = time.time() if now is None else now
-        if self.pack_cache is not None:
-            v = self.pack_cache.view(now)
-            pods_src = self.pack_cache.pod_ref
-        else:
-            v, pods_cold = self._cold_view(now)
-            pods_src = pods_cold
-        empty = np.zeros(0, np.int64)
+        v, pods_src = self._view(now)
         if v["alloc"].shape[0] == 0:
-            return empty, pods_src, v
+            self.last_pass_stats = {"engine": "host", "candidates": 0,
+                                    "victims": 0}
+            return np.zeros(0, np.int64), pods_src, v
+        if self.device is not None:
+            picked, stats = self.device.select_victims(self, v, now)
+            self.last_pass_stats = stats
+            return picked, pods_src, v
+        t0 = time.perf_counter()
+        with self.tracer.span("score", host="1"):
+            picked = self.select_victims_host(v)
+        from koordinator_tpu.descheduler import metrics as dm
+
+        dm.REBALANCE_PASS_SECONDS.observe(time.perf_counter() - t0)
+        cands = int(self.last_pass_stats.get("candidates", 0))
+        if cands:
+            dm.REBALANCE_CANDIDATES.inc(cands)
+        if picked.size:
+            dm.REBALANCE_VICTIMS.inc(int(picked.size))
+        return picked, pods_src, v
+
+    def select_victims_host(self, v: dict) -> np.ndarray:
+        """The host numpy oracle over a packed view: classification +
+        the vectorized greedy victim selection. The device pass's
+        decision reference (see module doc); also sets
+        ``last_pass_stats``."""
+        empty = np.zeros(0, np.int64)
+        self.last_pass_stats = {"engine": "host", "candidates": 0,
+                                "victims": 0}
+        if v["alloc"].shape[0] == 0:
+            return empty
         is_low, is_high = classify_nodes(
             v["usage_pct"], v["has_metric"],
             self._thr_vec(self.args.low_thresholds),
             self._thr_vec(self.args.high_thresholds),
         )
         if not is_high.any() or not is_low.any():
-            return empty, pods_src, v
+            return empty
 
         # ---- victim selection, vectorized: one stable lexsort over
         # (node, priority asc, cpu desc) + per-segment exclusive prefix of
@@ -375,8 +239,9 @@ class LowNodeLoad:
                      & (v["pod_node"] >= 0)
                      & node_ok[np.maximum(v["pod_node"], 0)])
         cand = np.nonzero(cand_mask)[0]
+        self.last_pass_stats["candidates"] = int(cand.size)
         if cand.size == 0:
-            return empty, pods_src, v
+            return empty
         node_arr = v["pod_node"][cand]
         prio = v["pod_prio"][cand]
         cpu = v["pod_cpu"][cand]
@@ -424,7 +289,8 @@ class LowNodeLoad:
         # precomputed per NODE ([N, chk], tiny) instead of per candidate,
         # and the division disappears; the C++ floor computes the identical
         # double expression, so the comparison is bit-deterministic on both
-        # sides.
+        # sides. The device pass (balance/step.py) ships the same rhs as
+        # two float32 limbs and decides the identical comparison.
         alloc_chk = np.maximum(v["alloc"][:, chk], np.float32(1e-9))
         rhs = ((usage_pct[:, chk].astype(np.float64)
                 - target_pct[chk].astype(np.float64))
@@ -435,32 +301,39 @@ class LowNodeLoad:
         prefix_ok = (fails - seg_off[seg_id]) == 0
         selected = prefix_ok & (rank < self.args.max_pods_to_evict_per_node)
         picked = cand[order[np.nonzero(selected)[0]]]
-        return picked, pods_src, v
+        self.last_pass_stats["victims"] = int(picked.size)
+        return picked
 
     def balance(self, now: Optional[float] = None) -> List[PodMigrationJob]:
         now = time.time() if now is None else now
-        picked, pods_src, _v = self.select_victims(now)
-        jobs: List[PodMigrationJob] = []
-        for k in picked:
-            pod = pods_src[k]
-            job = PodMigrationJob(
-                meta=ObjectMeta(
-                    name=f"migrate-{pod.meta.namespace}-{pod.meta.name}",
-                    namespace="koordinator-system",
-                    creation_timestamp=now,
-                ),
-                pod_namespace=pod.meta.namespace,
-                pod_name=pod.meta.name,
-                mode="ReservationFirst",
-            )
-            if self.store.get(KIND_POD_MIGRATION_JOB, job.meta.key) is None:
-                self.store.add(KIND_POD_MIGRATION_JOB, job)
-                jobs.append(job)
+        with self.tracer.span("rebalance"):
+            picked, pods_src, _v = self.select_victims(now)
+            jobs: List[PodMigrationJob] = []
+            with self.tracer.span("migrate",
+                                  victims=str(int(len(picked)))):
+                for k in picked:
+                    pod = pods_src[k]
+                    job = PodMigrationJob(
+                        meta=ObjectMeta(
+                            name=f"migrate-{pod.meta.namespace}-{pod.meta.name}",
+                            namespace="koordinator-system",
+                            creation_timestamp=now,
+                        ),
+                        pod_namespace=pod.meta.namespace,
+                        pod_name=pod.meta.name,
+                        mode="ReservationFirst",
+                    )
+                    if self.store.get(KIND_POD_MIGRATION_JOB,
+                                      job.meta.key) is None:
+                        self.store.add(KIND_POD_MIGRATION_JOB, job)
+                        jobs.append(job)
         return jobs
 
 
 def _has_pdb_like_guard(pod: Pod) -> bool:
-    return pod.meta.annotations.get("descheduler.alpha.kubernetes.io/evict") == "false"
+    # back-compat alias; the predicate moved to balance/pack.py with the
+    # shared pack
+    return has_pdb_like_guard(pod)
 
 
 def pack_floor_inputs(store: ObjectStore, plugin: LowNodeLoad,
@@ -502,7 +375,7 @@ def pack_floor_inputs(store: ObjectStore, plugin: LowNodeLoad,
         pod_prio=np.asarray([p.spec.priority or 0 for p in pods], np.int32),
         pod_req=pod_req,
         movable=np.asarray(
-            [p.meta.owner_kind != "DaemonSet" and not _has_pdb_like_guard(p)
+            [p.meta.owner_kind != "DaemonSet" and not has_pdb_like_guard(p)
              for p in pods], np.int32),
         pod_sort_cpu=np.asarray(
             [p.spec.requests[ResourceName.CPU] for p in pods], np.float32),

@@ -26,3 +26,16 @@ PODS_EVICTED_TOTAL = REGISTRY.counter(
     "koord_descheduler_pods_evicted_total",
     "Pods evicted by descheduling, labeled by profile",
 )
+# koordbalance (balance/): the device-resident rebalance pass
+REBALANCE_CANDIDATES = REGISTRY.counter(
+    "koord_descheduler_rebalance_candidates_total",
+    "Movable pods on overloaded nodes considered by rebalance passes",
+)
+REBALANCE_VICTIMS = REGISTRY.counter(
+    "koord_descheduler_rebalance_victims_total",
+    "Victims selected by rebalance passes (migration-job candidates)",
+)
+REBALANCE_PASS_SECONDS = REGISTRY.histogram(
+    "koord_descheduler_rebalance_pass_seconds",
+    "Rebalance victim-selection pass latency (device or host engine)",
+)

@@ -225,6 +225,16 @@ class MigrationController:
         res = self.store.get(KIND_RESERVATION, f"/{job.reservation_name}")
         if res is None or res.phase == "Failed":
             return self._fail(job, "reservation failed or lost")
+        if res.phase == "Succeeded" and res.node_name:
+            # the allocate-once reservation was already consumed by an
+            # owner-matched replica (another pod of the same workload
+            # took the reserved spot first): the workload holds the
+            # replacement capacity, so the migration completes with the
+            # eviction — waiting would only wedge the job until its TTL
+            if res.node_name == pod.spec.node_name:
+                return self._fail(job,
+                                  "reservation landed on the source node")
+            return self._finish_with_eviction(job, pod)
         if not res.is_available:
             return 0  # wait for the scheduler to bind the reservation
         # replacement capacity secured away from the source -> evict

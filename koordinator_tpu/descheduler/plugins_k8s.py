@@ -501,7 +501,9 @@ def register_defaults() -> None:
 
 class _LowNodeLoadAdapter(BalancePlugin):
     """BalancePlugin facade over the batched LowNodeLoad classifier (it
-    creates PodMigrationJob CRs; the migration controller evicts)."""
+    creates PodMigrationJob CRs; the migration controller evicts).
+    ``enabled`` is the KOORD_TPU_REBALANCE=off kill switch (the
+    Descheduler wires it); the other plugins keep running."""
 
     name = "LowNodeLoad"
 
@@ -510,8 +512,11 @@ class _LowNodeLoadAdapter(BalancePlugin):
 
         self.inner = LowNodeLoad(store, args)
         self.handle = None
+        self.enabled = True
 
     def balance(self, nodes, now: float) -> Status:
+        if not self.enabled:
+            return Status()
         self.inner.balance(now)
         return Status()
 

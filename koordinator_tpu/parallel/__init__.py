@@ -28,3 +28,6 @@ from koordinator_tpu.parallel.full_chain_mesh import (  # noqa: F401
     shard_full_chain_inputs,
     wave_carry_shardings,
 )
+from koordinator_tpu.parallel.rebalance_mesh import (  # noqa: F401
+    build_sharded_rebalance_step,
+)

@@ -1177,7 +1177,16 @@ class Scheduler:
 
         # ---- reservation nomination pre-pass. Gang/quota pods are excluded:
         # their admission barriers live in the batched kernel, and binding them
-        # here would bypass min-member and quota checks.
+        # here would bypass min-member and quota checks. So are pods whose
+        # placement the kernel Filter chain must vet — hostPorts, CSI
+        # volume claims, inter-pod (anti-)affinity, topology spread: the
+        # nominator checks only the reservation's resource fit, and with
+        # descheduler-issued migration reservations (owner-matched to a
+        # whole workload) a port-carrying replica nominated onto the
+        # reserved node could double-bind a hostPort the kernel would
+        # have rejected (the koordbalance drain-storm scenario caught
+        # exactly that). Such pods schedule through the kernel, which
+        # still counts reserved capacity via the restore transformer.
         with self.tracer.span("reservation_prepass") as presp:
             remaining: List[Pod] = []
             nominated = 0
@@ -1187,6 +1196,11 @@ class Scheduler:
                     or res_plugin is None
                     or pod.gang_name
                     or pod.quota_name
+                    or pod.spec.host_ports
+                    or pod.spec.pvc_names
+                    or pod.spec.pod_affinity
+                    or pod.spec.pod_anti_affinity
+                    or pod.spec.topology_spread
                 ):
                     remaining.append(pod)
                     continue

@@ -144,6 +144,12 @@ class SnapshotCache:
                       "la_recomputed": 0, "numa_recomputed": 0,
                       "full_rebuilds": 0}
 
+        # koordbalance (balance/pack.py): rebalance packs fed from THIS
+        # cache's subscription chain — the descheduler's second encode of
+        # the same cluster is gone (one event stream, two consumers).
+        # Keyed by metric-expiration like the standalone per-store packs.
+        self._rebalance_packs: Dict[float, object] = {}
+
         store.subscribe(KIND_POD, self._on_pod)
         store.subscribe(KIND_NODE, self._on_node)
         store.subscribe(KIND_NODE_METRIC, self._on_metric)
@@ -159,6 +165,8 @@ class SnapshotCache:
     # event handlers
     # ------------------------------------------------------------------
     def _on_pod(self, ev: EventType, pod: Pod, old) -> None:
+        for pack in self._rebalance_packs.values():
+            pack.on_pod(ev, pod, old)
         key = pod.meta.key
         self.pod_flags.pop(key, None)
         self.pod_masks.pop(key, None)
@@ -215,12 +223,16 @@ class SnapshotCache:
                     refs[c] = left
 
     def _on_node(self, ev: EventType, node, old) -> None:
+        for pack in self._rebalance_packs.values():
+            pack.on_node(ev, node, old)
         self.nodes_epoch += 1
         self._node_dirty.add(node.meta.name)
         self._la_dirty.add(node.meta.name)
         self._numa_dirty.add(node.meta.name)
 
     def _on_metric(self, ev: EventType, nm, old) -> None:
+        for pack in self._rebalance_packs.values():
+            pack.on_metric(ev, nm, old)
         self._la_dirty.add(nm.meta.name)
         # keep the layout-aligned update-time vector current so the expiry
         # compare in loadaware_extras never consults a stale timestamp
@@ -238,6 +250,26 @@ class SnapshotCache:
 
     def _on_pvcpv(self, ev: EventType, obj, old) -> None:
         self.pvcpv_epoch += 1
+
+    # ------------------------------------------------------------------
+    # koordbalance: the shared rebalance pack
+    # ------------------------------------------------------------------
+    def rebalance_pack(self, expiration_seconds: float):
+        """The rebalance pack maintained from THIS cache's store
+        subscriptions (no second subscription chain, no duplicate
+        encode): the descheduler's LowNodeLoad consumes it as its view
+        source when scheduler and descheduler share a process. Existing
+        pods replay list-then-watch style at first attach."""
+        pack = self._rebalance_packs.get(expiration_seconds)
+        if pack is None:
+            from koordinator_tpu.balance.pack import RebalancePack
+
+            pack = RebalancePack(self.store, expiration_seconds,
+                                 subscribe=False)
+            for pod in self.store.list(KIND_POD):
+                pack.on_pod(EventType.ADDED, pod, None)
+            self._rebalance_packs[expiration_seconds] = pack
+        return pack
 
     # ------------------------------------------------------------------
     # aggregates (cycle-facing)
@@ -642,11 +674,13 @@ def _mesh_node_fields() -> Set[str]:
     from koordinator_tpu.models.scheduler_model import ScheduleInputs
     from koordinator_tpu.parallel.full_chain_mesh import _FC_NODE_FIELDS
 
+    from koordinator_tpu.balance.rebalancer import RB_NODE_FIELDS
+
     pod_fields = {"fit_requests", "estimated", "is_prod", "is_daemonset",
                   "pod_valid", "weights"}
     base_node = set(ScheduleInputs._fields) - pod_fields
     return base_node | set(_FC_NODE_FIELDS) | {
-        "la_est_nonprod", "la_adj_nonprod"}
+        "la_est_nonprod", "la_adj_nonprod"} | set(RB_NODE_FIELDS)
 
 
 class DeviceSnapshot:

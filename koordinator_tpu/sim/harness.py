@@ -104,6 +104,13 @@ class SimReport:
     final_level: str = "full"
     flight_dumps: int = 0
     descheduler_runs: int = 0
+    # koordbalance: the rebalance closed loop's activity + SLO
+    migration_jobs_created: int = 0
+    pods_migrated: int = 0
+    hotspot_events: int = 0
+    hotspots_open: int = 0        # flagged node sets still hot at end
+    dissipate_cycles: List[int] = dataclasses.field(default_factory=list)
+    dissipate_slo_cycles: int = 0
     binding_log: List[str] = dataclasses.field(default_factory=list)
     wall_seconds: float = 0.0
     # pipeline-occupancy accounting under realistic arrivals: per-cycle
@@ -177,6 +184,30 @@ class SimReport:
             },
             "flight_dumps": self.flight_dumps,
             "descheduler_runs": self.descheduler_runs,
+            "rebalance": {
+                "migration_jobs": self.migration_jobs_created,
+                "pods_migrated": self.pods_migrated,
+                "hotspot_events": self.hotspot_events,
+                "hotspots_undissipated": self.hotspots_open,
+                "time_to_dissipate_cycles": {
+                    "count": len(self.dissipate_cycles),
+                    "p50": (float(np.percentile(
+                        np.asarray(self.dissipate_cycles), 50))
+                        if self.dissipate_cycles else 0.0),
+                    "p99": (float(np.percentile(
+                        np.asarray(self.dissipate_cycles), 99))
+                        if self.dissipate_cycles else 0.0),
+                    "max": (max(self.dissipate_cycles)
+                            if self.dissipate_cycles else 0),
+                },
+                "dissipate_slo_cycles": self.dissipate_slo_cycles,
+                "dissipate_slo_met": (
+                    self.dissipate_slo_cycles <= 0
+                    or (self.hotspots_open == 0
+                        and (not self.dissipate_cycles
+                             or max(self.dissipate_cycles)
+                             <= self.dissipate_slo_cycles))),
+            },
             "binding_log_sha256": self.binding_log_sha256,
             "bindings": len(self.binding_log),
             "wall_seconds": round(self.wall_seconds, 2),
@@ -211,10 +242,12 @@ class ChurnSimulator:
         self.store = ObjectStore()  # the simulator's own (never-failing) view
         self.plan = FaultPlan(scenario.faults)
         self.now = 1_000_000.0
-        self.report = SimReport(scenario=scenario.name,
-                                seed=scenario.seed,
-                                cycles=scenario.cycles,
-                                slo_target_seconds=scenario.ttb_slo_seconds)
+        self.report = SimReport(
+            scenario=scenario.name,
+            seed=scenario.seed,
+            cycles=scenario.cycles,
+            slo_target_seconds=scenario.ttb_slo_seconds,
+            dissipate_slo_cycles=scenario.hotspot_dissipate_slo_cycles)
         self._uid = 0
         self._arrival_time: Dict[str, float] = {}   # pod key -> sim arrival
         self._overflow: List[Pod] = []              # waiting room (FIFO)
@@ -222,6 +255,12 @@ class ChurnSimulator:
         self._gangs: List[Tuple[int, str, List[str]]] = (
             [])  # (finish cycle, PodGroup key, member pod keys)
         self._metric_flip_state = False
+        # koordbalance: per-pod usage multipliers (hotspot-marked pods
+        # run HOT; migration replacements inherit — the workload is hot
+        # wherever it runs, so hotspots dissipate by SPREADING) and the
+        # open hotspot events awaiting dissipation
+        self._pod_mult: Dict[str, float] = {}
+        self._hotspots: List[Tuple[int, set]] = []
         self._dump_budget = {"invariant_breach": MAX_EVENT_DUMPS,
                              "slo_overrun": MAX_EVENT_DUMPS}
         self._build_world()
@@ -253,6 +292,29 @@ class ChurnSimulator:
                     node_usage=ResourceList.of(
                         cpu=1_000 + 500 * (i % 3), memory=4 * GIB)))
             self.store.add(KIND_NODE_METRIC, nm)
+        # pre-bound initial workload (plain pods, round-robin): load
+        # events (hotspots, drain storms) have real mass from cycle 0
+        # instead of waiting for arrivals to fill the cluster
+        rng = self.rng
+        for i in range(self.sc.initial_pods):
+            uid = self._next_uid()
+            pod = Pod(
+                meta=ObjectMeta(name=f"w{uid}", namespace="sim",
+                                uid=f"w{uid}",
+                                creation_timestamp=self.now,
+                                labels={"app": rng.choice("abc")},
+                                owner_kind="ReplicaSet",
+                                owner_name=f"rs-{uid % 13}"),
+                spec=PodSpec(
+                    node_name=f"n{i % self.sc.nodes}",
+                    priority=(PRIORITY_BE
+                              if rng.random() < self.sc.be_fraction
+                              else PRIORITY_PROD),
+                    requests=ResourceList.of(
+                        cpu=rng.choice([250, 500, 1000, 2000]),
+                        memory=rng.choice([1, 2, 4]) * GIB)),
+                phase="Running")
+            self.store.add(KIND_POD, pod)
         # two sibling elastic quotas; the rebalance event shifts max
         # capacity between them
         total_cpu = self.sc.nodes * 16_000
@@ -288,9 +350,15 @@ class ChurnSimulator:
         if sc.descheduler_every > 0:
             from koordinator_tpu.descheduler.descheduler import Descheduler
 
-            # the descheduler shares the simulator's store view directly:
-            # injected store faults target the scheduler's bind path
-            self.desch = Descheduler(self.store)
+            # the descheduler shares the simulator's store view directly
+            # (injected store faults target the scheduler's bind path)
+            # and the SCHEDULER's snapshot: its LowNodeLoad view rides
+            # the SnapshotCache subscription chain and the device
+            # rebalance pass uploads through the scheduler's
+            # DeviceSnapshot — the one-upload-two-consumers production
+            # wiring (koordbalance)
+            self.desch = Descheduler(self.store, scheduler=self.sched,
+                                     rebalance=sc.rebalance)
 
     # ------------------------------------------------------------------
     # workload generation
@@ -310,9 +378,15 @@ class ChurnSimulator:
             requests=ResourceList.of(
                 cpu=rng.choice([250, 500, 1000, 2000]),
                 memory=rng.choice([1, 2, 4]) * GIB))
+        # controller-owned (ReplicaSet analog): the eviction chain
+        # categorically refuses bare pods, so ownerless sim pods would
+        # make every migration vacuous. Deterministic owner from uid —
+        # no extra rng draws, the arrival stream is unchanged.
         pod = Pod(meta=ObjectMeta(name=name, namespace="sim", uid=name,
                                   creation_timestamp=self.now,
-                                  labels=labels),
+                                  labels=labels,
+                                  owner_kind="ReplicaSet",
+                                  owner_name=f"rs-{uid % 13}"),
                   spec=spec)
         r = rng.random()
         if r < 0.10:
@@ -341,7 +415,15 @@ class ChurnSimulator:
                 meta=ObjectMeta(name=f"g{uid}", namespace="sim",
                                 uid=f"g{uid}",
                                 creation_timestamp=self.now,
-                                labels={LABEL_POD_GROUP: gname}),
+                                labels={LABEL_POD_GROUP: gname},
+                                # training jobs protect their members:
+                                # the PDB-like guard keeps the
+                                # descheduler's migration pass off gang
+                                # pods (evicting one would break the
+                                # all-or-nothing invariant mid-life)
+                                annotations={
+                                    "descheduler.alpha.kubernetes.io/"
+                                    "evict": "false"}),
                 spec=PodSpec(requests=ResourceList.of(
                     cpu=1000, memory=GIB))))
         if self.sc.gang_lifetime > 0:
@@ -437,6 +519,7 @@ class ChurnSimulator:
         for pod in self.rng.sample(running, min(n, len(running))):
             self.store.delete(KIND_POD, pod.meta.key)
             self._arrival_time.pop(pod.meta.key, None)
+            self._pod_mult.pop(pod.meta.key, None)
             self.report.pods_departed += 1
 
     def _drain_step(self, cycle: int) -> None:
@@ -465,29 +548,37 @@ class ChurnSimulator:
         self._draining = still
         if sc.drain_every <= 0 or cycle == 0 or cycle % sc.drain_every:
             return
-        draining_names = {n for n, _ in self._draining}
-        candidates = [n for n in self.store.list(KIND_NODE)
-                      if not n.unschedulable
-                      and n.meta.name not in draining_names]
-        if len(candidates) <= 2:
-            return  # never drain the cluster below a working floor
-        node = self.rng.choice(candidates)
-        node.unschedulable = True
-        self.store.update(KIND_NODE, node)
-        self._draining.append((node.meta.name, sc.drain_uncordon_after))
-        # evict (and requeue) the node's non-gang pods — the reference
-        # drains via eviction + reschedule; gang members stay (evicting
-        # one would legitimately break all-or-nothing, which is gang
-        # lifecycle churn, not a scheduler violation)
-        evicted = []
-        for pod in self.store.list(KIND_POD):
-            if (pod.spec.node_name == node.meta.name and pod.is_assigned
-                    and not pod.is_terminated and not pod.gang_key):
-                self.store.delete(KIND_POD, pod.meta.key)
-                self._arrival_time.pop(pod.meta.key, None)
-                evicted.append(pod)
-        self.report.pods_drained += len(evicted)
-        self._admit([self._make_pod(prefix="re") for _ in evicted])
+        # drains_per_event > 1 is the drain-storm shape: several nodes
+        # cordoned in one event, their load concentrating on the
+        # survivors (which the descheduler then has to rebalance)
+        for _ in range(max(1, sc.drains_per_event)):
+            draining_names = {n for n, _ in self._draining}
+            candidates = [n for n in self.store.list(KIND_NODE)
+                          if not n.unschedulable
+                          and n.meta.name not in draining_names]
+            if len(candidates) <= 2:
+                return  # never drain the cluster below a working floor
+            node = self.rng.choice(candidates)
+            node.unschedulable = True
+            self.store.update(KIND_NODE, node)
+            self._draining.append((node.meta.name,
+                                   sc.drain_uncordon_after))
+            # evict (and requeue) the node's non-gang pods — the
+            # reference drains via eviction + reschedule; gang members
+            # stay (evicting one would legitimately break
+            # all-or-nothing, which is gang lifecycle churn, not a
+            # scheduler violation)
+            evicted = []
+            for pod in self.store.list(KIND_POD):
+                if (pod.spec.node_name == node.meta.name
+                        and pod.is_assigned
+                        and not pod.is_terminated and not pod.gang_key):
+                    self.store.delete(KIND_POD, pod.meta.key)
+                    self._arrival_time.pop(pod.meta.key, None)
+                    self._pod_mult.pop(pod.meta.key, None)
+                    evicted.append(pod)
+            self.report.pods_drained += len(evicted)
+            self._admit([self._make_pod(prefix="re") for _ in evicted])
 
     def _spot_reclaim(self, cycle: int) -> None:
         sc = self.sc
@@ -500,6 +591,7 @@ class ChurnSimulator:
         for pod in victims:
             self.store.delete(KIND_POD, pod.meta.key)
             self._arrival_time.pop(pod.meta.key, None)
+            self._pod_mult.pop(pod.meta.key, None)
             self.report.pods_reclaimed += 1
         # the reclaimed workload comes straight back as fresh arrivals —
         # spot churn, not capacity loss
@@ -519,6 +611,132 @@ class ChurnSimulator:
             else:
                 nm.update_time = self.now - 10_000.0  # expired
             self.store.update(KIND_NODE_METRIC, nm)
+
+    # ------------------------------------------------------------------
+    # rebalance-under-load events (koordbalance)
+    # ------------------------------------------------------------------
+    def _hotspot_step(self, cycle: int) -> None:
+        """Every hotspot_every cycles: the pods on a few seeded nodes
+        turn HOT (usage multiplier) — real overload from mis-estimated
+        workloads, which only migration can dissipate. Gang pods are
+        skipped (their guard makes them unevictable, so their heat could
+        never dissipate)."""
+        sc = self.sc
+        if sc.hotspot_every <= 0 or cycle == 0 or cycle % sc.hotspot_every:
+            return
+        nodes = [n for n in self.store.list(KIND_NODE)
+                 if not n.unschedulable]
+        if not nodes:
+            return
+        # the MOST-LOADED nodes flip hot (deterministic: count desc,
+        # name): a hotspot on an empty node is not a hotspot
+        counts: Dict[str, int] = {}
+        for pod in self.store.list(KIND_POD):
+            if pod.is_assigned and not pod.is_terminated and not pod.gang_key:
+                counts[pod.spec.node_name] = counts.get(
+                    pod.spec.node_name, 0) + 1
+        nodes.sort(key=lambda n: (-counts.get(n.meta.name, 0),
+                                  n.meta.name))
+        chosen = nodes[: sc.hotspot_nodes]
+        names = {n.meta.name for n in chosen}
+        marked = 0
+        for pod in self.store.list(KIND_POD):
+            if (pod.is_assigned and not pod.is_terminated
+                    and pod.spec.node_name in names and not pod.gang_key):
+                self._pod_mult[pod.meta.key] = sc.hotspot_multiplier
+                marked += 1
+        if marked:
+            self._hotspots.append((cycle, names))
+            self.report.hotspot_events += 1
+
+    def _refresh_usage_metrics(self) -> None:
+        """metrics_follow_usage: NodeMetric usage derives from the pods
+        actually bound to each node (x their hot multipliers), so
+        migrating load away genuinely lowers the source node's reading.
+        Metrics the flip event deliberately expired stay expired."""
+        sc = self.sc
+        if not sc.metrics_follow_usage:
+            return
+        cpu_by: Dict[str, float] = {}
+        mem_by: Dict[str, float] = {}
+        for pod in self.store.list(KIND_POD):
+            if not pod.is_assigned or pod.is_terminated:
+                continue
+            mult = self._pod_mult.get(pod.meta.key, 1.0)
+            node = pod.spec.node_name
+            cpu_by[node] = cpu_by.get(node, 0.0) + (
+                pod.spec.requests.get("cpu", 0) or 0) * mult
+            mem_by[node] = mem_by.get(node, 0.0) + (
+                pod.spec.requests.get("memory", 0) or 0) * mult
+        for nm in self.store.list(KIND_NODE_METRIC):
+            expired = nm.update_time <= self.now - 9_000.0
+            nm.node_metric = NodeMetricInfo(node_usage=ResourceList.of(
+                cpu=sc.usage_idle_cpu + int(
+                    cpu_by.get(nm.meta.name, 0.0) * sc.usage_fraction),
+                memory=2 * GIB + int(
+                    mem_by.get(nm.meta.name, 0.0) * sc.usage_fraction)))
+            if not expired:
+                nm.update_time = self.now
+            self.store.update(KIND_NODE_METRIC, nm)
+
+    def _node_is_hot(self, name: str) -> bool:
+        """LowNodeLoad's default high thresholds (70% cpu / 80% mem)
+        against the current metric — the dissipation probe."""
+        node = self.store.get(KIND_NODE, f"/{name}")
+        nm = self.store.get(KIND_NODE_METRIC, f"/{name}")
+        if node is None or nm is None:
+            return False
+        alloc = node.allocatable
+        usage = nm.node_metric.node_usage
+        cpu_pct = (usage.get("cpu", 0) or 0) * 100.0 / max(
+            alloc.get("cpu", 0) or 1, 1)
+        mem_pct = (usage.get("memory", 0) or 0) * 100.0 / max(
+            alloc.get("memory", 0) or 1, 1)
+        return cpu_pct > 70.0 or mem_pct > 80.0
+
+    def _note_hotspot_dissipation(self, cycle: int) -> None:
+        still: List[Tuple[int, set]] = []
+        for event_cycle, names in self._hotspots:
+            if (cycle > event_cycle
+                    and not any(self._node_is_hot(n) for n in names)):
+                self.report.dissipate_cycles.append(cycle - event_cycle)
+            else:
+                still.append((event_cycle, names))
+        self._hotspots = still
+
+    def _sweep_migrated(self) -> None:
+        """The workload-controller analog for migration evictions: a pod
+        the migration controller evicted (Failed + the evicted
+        annotation) is replaced by a fresh replica with the same labels
+        and requests — which the scheduler's nomination pre-pass matches
+        to the migration's replacement Reservation. The replacement
+        inherits the hot multiplier: the workload is hot wherever it
+        runs, so hotspots dissipate by SPREADING, not by vanishing."""
+        evicted = [p for p in self.store.list(KIND_POD)
+                   if p.phase == "Failed"
+                   and "koordinator.sh/evicted" in p.meta.annotations]
+        if not evicted:
+            return
+        fresh: List[Pod] = []
+        for pod in evicted:
+            self.store.delete(KIND_POD, pod.meta.key)
+            mult = self._pod_mult.pop(pod.meta.key, 1.0)
+            self._arrival_time.pop(pod.meta.key, None)
+            self.report.pods_migrated += 1
+            uid = self._next_uid()
+            repl = Pod(
+                meta=ObjectMeta(name=f"mg{uid}", namespace="sim",
+                                uid=f"mg{uid}",
+                                creation_timestamp=self.now,
+                                labels=dict(pod.meta.labels),
+                                owner_kind=pod.meta.owner_kind,
+                                owner_name=pod.meta.owner_name),
+                spec=PodSpec(priority=pod.spec.priority,
+                             requests=pod.spec.requests.copy()))
+            if mult != 1.0:
+                self._pod_mult[repl.meta.key] = mult
+            fresh.append(repl)
+        self._admit(fresh)
 
     def _quota_rebalance(self, cycle: int) -> None:
         sc = self.sc
@@ -574,7 +792,7 @@ class ChurnSimulator:
         seeded run's deterministic iteration order."""
         for key in list(self._arrival_time):
             pod = self.store.get(KIND_POD, key)
-            if pod is None or not pod.is_assigned:
+            if pod is None or not pod.is_assigned or pod.is_terminated:
                 continue
             if pod.phase != "Running":
                 pod.phase = "Running"
@@ -582,7 +800,7 @@ class ChurnSimulator:
             self._account_bind(cycle, key, pod.spec.node_name)
 
     def _check_invariants(self, cycle: int) -> None:
-        breaches = check_invariants(self.store)
+        breaches = check_invariants(self.store, now=self.now)
         if breaches:
             self.report.invariant_breaches.extend(
                 f"cycle {cycle}: {b}" for b in breaches)
@@ -604,6 +822,9 @@ class ChurnSimulator:
         self._metric_flip(cycle)
         self._quota_rebalance(cycle)
         self._departures()
+        self._hotspot_step(cycle)
+        self._refresh_usage_metrics()
+        self._note_hotspot_dissipation(cycle)
         fresh = [self._make_pod() for _ in range(
             self._poisson(sc.arrival_rate))]
         if sc.burst_every > 0 and cycle > 0 and cycle % sc.burst_every == 0:
@@ -646,21 +867,33 @@ class ChurnSimulator:
             self.report.bound_by_waves.get(k, 0) + len(result.bound))
         for b in result.bound:
             pod = self.store.get(KIND_POD, b.pod_key)
-            if pod is None:
+            if pod is None or pod.is_terminated:
+                # bound and then preempted/evicted within the SAME cycle
+                # (a later wave's preemption chose it as a victim):
+                # flipping it back to Running would resurrect a
+                # terminated pod in place and overcommit its node
                 continue
             pod.phase = "Running"  # bind -> Running, as the kubelet would
             self.store.update(KIND_POD, pod)
             self._account_bind(cycle, b.pod_key, b.node_name)
-        self._check_invariants(cycle)
         if (self.desch is not None and cycle > 0
                 and cycle % sc.descheduler_every == 0):
             try:
-                self.desch.run_once(now=self.now)
+                out = self.desch.run_once(now=self.now)
                 self.report.descheduler_runs += 1
+                self.report.migration_jobs_created += out.get(
+                    "jobs_created", 0)
             except Exception as exc:
                 self.report.cycle_exceptions.append(
                     f"cycle {cycle} descheduler: "
                     f"{type(exc).__name__}: {exc}")
+            # the workload-controller analog replaces migration-evicted
+            # pods (they re-enter the queue and consume the replacement
+            # reservations via the nomination pre-pass)
+            self._sweep_migrated()
+        # invariants run AFTER the descheduler so the migration-job and
+        # reservation double-booking checks see its writes every cycle
+        self._check_invariants(cycle)
 
     def run(self) -> SimReport:
         t0 = time.perf_counter()
@@ -670,6 +903,7 @@ class ChurnSimulator:
             self.pipeline.flush()
         self.report.wall_seconds = time.perf_counter() - t0
         self.report.final_pending = self._pending_count()
+        self.report.hotspots_open = len(self._hotspots)
         self.report.faults_injected = len(self.plan.injected)
         self.report.sidecar_fallbacks = self.sched.sidecar_fallbacks
         self.report.ladder_transitions = list(self.sched.ladder.transitions)

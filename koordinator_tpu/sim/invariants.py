@@ -11,7 +11,8 @@ asserts the list is empty.
 
 from __future__ import annotations
 
-from typing import List
+import time
+from typing import List, Optional
 
 import numpy as np
 
@@ -19,13 +20,18 @@ from koordinator_tpu.client.store import (
     KIND_NODE,
     KIND_POD,
     KIND_POD_GROUP,
+    KIND_POD_MIGRATION_JOB,
+    KIND_RESERVATION,
     ObjectStore,
 )
 from koordinator_tpu.ops.estimator import estimate_node_allocatable
 
 
-def check_invariants(store: ObjectStore) -> List[str]:
-    """Check the invariant set against the store; [] == clean."""
+def check_invariants(store: ObjectStore,
+                     now: Optional[float] = None) -> List[str]:
+    """Check the invariant set against the store; [] == clean.
+    ``now`` governs reservation expiry (sim clock); defaults to wall."""
+    now = time.time() if now is None else now
     breaches: List[str] = []
     nodes = {n.meta.name: n for n in store.list(KIND_NODE)}
     pods = [p for p in store.list(KIND_POD)
@@ -77,4 +83,51 @@ def check_invariants(store: ObjectStore) -> List[str]:
         if pg is not None and count < pg.min_member:
             breaches.append(
                 f"gang {g} partially bound: {count} < {pg.min_member}")
+    # 5. rebalance discipline: an active migration job must target a
+    # MOVABLE pod — never a DaemonSet replica or a pod carrying the
+    # PDB-like opt-out guard (a missing/terminated pod is a lifecycle
+    # race the controller resolves, not a breach)
+    from koordinator_tpu.balance.pack import has_pdb_like_guard
+
+    for job in store.list(KIND_POD_MIGRATION_JOB):
+        if job.phase not in ("Pending", "Running"):
+            continue
+        pod = store.get(KIND_POD, f"{job.pod_namespace}/{job.pod_name}")
+        if pod is None or pod.is_terminated:
+            continue
+        if has_pdb_like_guard(pod):
+            breaches.append(
+                f"migration job {job.meta.key} targets PDB-guarded pod "
+                f"{pod.meta.key}")
+        if pod.meta.owner_kind == "DaemonSet":
+            breaches.append(
+                f"migration job {job.meta.key} targets DaemonSet pod "
+                f"{pod.meta.key}")
+    # 6. reserved capacity is not double-booked: per node, assigned pod
+    # requests PLUS the unconsumed remainder of Available unexpired
+    # reservations must fit the trimmed allocatable (the scheduler
+    # counts held reservation capacity via ReservationRestoreTransformer
+    # — this pins that the rebalance closed loop cannot overcommit a
+    # node through its replacement reservations)
+    reserved = {}
+    for res in store.list(KIND_RESERVATION):
+        if not res.is_available or res.is_expired(now):
+            continue
+        free = np.maximum(
+            res.allocatable.to_vector() - res.allocated.to_vector(), 0.0)
+        reserved[res.node_name] = reserved.get(res.node_name, 0.0) + free
+    for name, held in reserved.items():
+        node = nodes.get(name)
+        if node is None:
+            breaches.append(f"reservation held on unknown node {name}")
+            continue
+        alloc = estimate_node_allocatable(node)
+        total = np.asarray(held, np.float64).copy()
+        for p in by_node.get(name, []):
+            total = total + p.spec.requests.to_vector()
+        over = total > alloc + 1e-3
+        if over.any():
+            breaches.append(
+                f"node {name} double-booked by reservations: "
+                f"{total[over]} > {alloc[over]}")
     return breaches

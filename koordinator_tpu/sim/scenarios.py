@@ -27,6 +27,8 @@ class Scenario:
     seed: int = 11
     cycles: int = 200
     nodes: int = 12
+    initial_pods: int = 0         # pre-bound plain pods (round-robin)
+    #                               so load events bite from cycle 0
     dt_seconds: float = 5.0
     # arrivals / departures
     arrival_rate: float = 6.0     # Poisson mean pods per cycle
@@ -43,10 +45,22 @@ class Scenario:
     drain_every: int = 0          # cordon a node, evict its pods, then
     drain_delete: bool = False    # ... delete it (True) or uncordon later
     drain_uncordon_after: int = 6
+    drains_per_event: int = 1     # nodes cordoned per drain event
+    #                               (>1 = the drain-storm shape)
     spot_reclaim_every: int = 0   # evict bound BE pods (re-queued as new)
     spot_reclaim_count: int = 3
     metric_flip_every: int = 0    # alternate NodeMetric fresh <-> expired
     quota_rebalance_every: int = 0  # shrink/grow quota max
+    # rebalance-under-load (koordbalance): NodeMetric usage derived from
+    # the pods actually bound to each node, so migrating load away
+    # genuinely lowers the source node's reading
+    metrics_follow_usage: bool = False
+    usage_fraction: float = 0.6   # measured usage per unit of request
+    usage_idle_cpu: int = 500     # per-node idle floor (milli-cores)
+    hotspot_every: int = 0        # skew event: pods on chosen nodes run HOT
+    hotspot_nodes: int = 2        # nodes skewed per event
+    hotspot_multiplier: float = 2.5  # hot pods' usage multiplier
+    hotspot_dissipate_slo_cycles: int = 0  # 0 = report-only
     # backpressure
     queue_cap: int = 512          # max pending pods admitted to the store
     overflow_cap: int = 2048      # waiting-room bound; beyond it -> shed
@@ -58,6 +72,8 @@ class Scenario:
     mesh: Optional[int] = None    # KOORD_TPU_MESH-style device count
     pipeline: bool = False        # drive through CyclePipeline
     descheduler_every: int = 0    # run the real descheduler every N cycles
+    rebalance: Optional[str] = None  # KOORD_TPU_REBALANCE pin for the
+    #                                  descheduler (None = env default)
     promote_after: int = 8        # ladder clean-cycle re-promotion probe
     # fault schedule
     faults: Tuple[Fault, ...] = ()
@@ -109,7 +125,7 @@ _register(Scenario(
         "traffic with gang storms, bursts, drains, spot reclamation, "
         "metric flips, quota rebalances, and dispatch/store-write "
         "faults mid-soak; emits the CHURN SLO report"),
-    seed=7, cycles=1000, nodes=16,
+    seed=7, cycles=1000, nodes=16, initial_pods=120,
     # near-capacity but sustainable: ~16x16 cores hold ~270 of these
     # pods; steady arrivals (+ gang storms and bursts on top) roughly
     # match departures + reclamation so the queue breathes instead of
@@ -129,7 +145,13 @@ _register(Scenario(
     # window and the fused dispatches replay overlapped — decisions (and
     # the binding log) are parity-gated identical either way
     pipeline=True,
-    descheduler_every=50,
+    # rebalance-under-load (koordbalance): usage-derived metrics +
+    # periodic hotspots give the descheduler REAL work every soak —
+    # tests assert nonzero migration activity (binding-log change vs
+    # pre-koordbalance soaks declared in BENCH_NOTES_r11)
+    metrics_follow_usage=True, usage_fraction=0.8,
+    hotspot_every=60, hotspot_nodes=2, hotspot_multiplier=4.0,
+    descheduler_every=25,
     promote_after=16,
     faults=(
         Fault(cycle=300, kind="dispatch", count=2,
@@ -171,6 +193,49 @@ _register(Scenario(
     metric_flip_every=19,
     queue_cap=256,
     ttb_slo_seconds=240.0,
+))
+
+_register(Scenario(
+    name="drain-storm",
+    description=(
+        "mass cordon + migration under arrival pressure: every drain "
+        "event cordons several nodes at once, their load concentrates "
+        "on the survivors (usage-derived metrics), and the descheduler "
+        "must keep rebalancing through its reservation closed loop "
+        "while arrivals keep coming"),
+    seed=17, cycles=200, nodes=16, initial_pods=96,
+    arrival_rate=5.0, departure_rate=3.0, be_fraction=0.3,
+    drain_every=23, drains_per_event=3, drain_uncordon_after=7,
+    # near-1.0 usage-per-request: a survivor node that fills up with
+    # drained load genuinely reads above the 70% high threshold, so the
+    # storm's concentration is what the descheduler must dissipate
+    metrics_follow_usage=True, usage_fraction=0.85,
+    descheduler_every=5,
+    queue_cap=384,
+    ttb_slo_seconds=240.0,
+    waves="auto",
+))
+
+_register(Scenario(
+    name="hotspot",
+    description=(
+        "skewed usage flips that must dissipate: every event marks the "
+        "pods on a few nodes HOT (usage multiplier), LowNodeLoad "
+        "classifies them high, and the migration closed loop "
+        "(reservation -> next dispatch -> evict -> respread) must bring "
+        "every flagged node back under the high thresholds within the "
+        "dissipation SLO"),
+    seed=23, cycles=160, nodes=16, initial_pods=128,
+    arrival_rate=3.5, departure_rate=3.0, be_fraction=0.3,
+    metrics_follow_usage=True, usage_fraction=0.5,
+    hotspot_every=40, hotspot_nodes=2, hotspot_multiplier=3.5,
+    hotspot_dissipate_slo_cycles=30,
+    descheduler_every=3,
+    queue_cap=256,
+    # time-to-dissipate is this scenario's tight deliverable; the ttb
+    # target stays loose enough that feature-stuck stragglers (hostPort
+    # collisions under load) do not mask a dissipation regression
+    ttb_slo_seconds=360.0,
 ))
 
 _register(Scenario(

@@ -348,10 +348,46 @@ def test_soak_1000_cycles_clean():
     assert report.pods_bound > 2000
     assert report.final_level == "full"
     assert report.descheduler_runs > 0
+    # koordbalance: the descheduler's rebalance work is ASSERTED, not
+    # just wired — hotspot events fire, migration jobs get created, and
+    # every flagged node dissipates by soak end
+    assert report.hotspot_events > 0
+    assert report.migration_jobs_created > 0
+    assert report.hotspots_open == 0
     # the p99 time-to-bind SLO verdict is REPORTED (CHURN_r01.json);
     # pass/fail against the target is load- and backend-dependent data,
     # not a structural gate
     assert report.ttb_seconds and report.percentile(99) > 0.0
+
+
+def test_hotspot_scenario_dissipates_within_slo():
+    """The koordbalance scenario family: a hotspot event marks the
+    most-loaded nodes' pods HOT; the migration closed loop (job ->
+    reservation -> next dispatch -> evict -> respread) must bring every
+    flagged node back under the high thresholds within the SLO, with
+    zero invariant breaches (incl. the new migration-job and
+    reservation double-booking checks)."""
+    sc = SCENARIOS["hotspot"].resolved(cycles=55)
+    report = run_scenario(sc)
+    assert report.invariant_breaches == []
+    assert report.hotspot_events >= 1
+    assert report.migration_jobs_created > 0
+    assert report.pods_migrated > 0
+    assert report.hotspots_open == 0
+    assert report.dissipate_cycles
+    assert max(report.dissipate_cycles) <= sc.hotspot_dissipate_slo_cycles
+
+
+def test_drain_storm_scenario_rebalances_clean():
+    """Mass cordon + migration under arrival pressure: several nodes
+    cordoned per drain event, their load concentrating on the
+    survivors; the descheduler keeps creating migration work and no
+    store-level invariant (capacity, hostPort, reservation
+    double-booking) breaks."""
+    report = run_scenario(SCENARIOS["drain-storm"].resolved(cycles=55))
+    assert report.invariant_breaches == []
+    assert report.pods_drained > 0
+    assert report.migration_jobs_created > 0
 
 
 def test_cli_list_and_usage_contract(capsys):

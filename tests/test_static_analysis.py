@@ -947,6 +947,65 @@ class TestStoreWriteInWaveReplayLoop:
             "flush sites (pragma'd update_many / deferred flush)")
 
 
+class TestHostLoopInRebalancePath:
+    RULE = "host-loop-in-rebalance-path"
+    PATH = "koordinator_tpu/balance/victims.py"
+
+    def test_positive_for_loop_and_pod_walk(self):
+        src = """
+            def select(view, store):
+                total = 0
+                for i in range(len(view)):
+                    total += view[i]
+                pods = store.list(KIND_POD)
+                return total, pods
+        """
+        out = findings_for(src, self.RULE, path=self.PATH)
+        assert len(out) == 2
+        assert any("for-loop" in f.message for f in out)
+        assert any("second pod encode" in f.message for f in out)
+
+    def test_negative_outside_balance_and_non_pod_walks(self):
+        src = """
+            def select(view, store):
+                for i in range(len(view)):
+                    pass
+                store.list(KIND_POD)
+        """
+        assert findings_for(src, self.RULE,
+                            path="koordinator_tpu/descheduler/"
+                                 "lownodeload.py") == []
+        # node walks and comprehensions are not the pod re-encode
+        src2 = """
+            def refresh(self, store):
+                nodes = store.list(KIND_NODE)
+                names = [n.meta.name for n in nodes]
+                return names
+        """
+        assert findings_for(src2, self.RULE, path=self.PATH) == []
+
+    def test_pragma_licenses_event_maintenance(self):
+        src = """
+            def remap(self):
+                # koordlint: disable=host-loop-in-rebalance-path
+                for j in range(self._len):
+                    self.pod_node[j] = self._node_idx.get(
+                        self.pod_node_name[j], -1)
+        """
+        assert findings_for(src, self.RULE, path=self.PATH) == []
+
+    def test_shipped_balance_package_is_clean(self):
+        for mod in ("pack", "step", "rebalancer", "__init__"):
+            path = REPO_ROOT / "koordinator_tpu" / "balance" / f"{mod}.py"
+            out = analyze_source(
+                path.read_text(),
+                path=f"koordinator_tpu/balance/{mod}.py",
+                rules={self.RULE: all_rules()[self.RULE]})
+            assert [f for f in out if f.rule == self.RULE] == [], (
+                f"balance/{mod}.py must stay a tensor pass "
+                f"(pragma event-maintenance loops)")
+
+
 class TestConcurrencyGatedPaths:
     """The concurrency rules must keep covering the modules that share
     state across threads — a path-regex refactor that silently drops one
