@@ -412,6 +412,11 @@ def run_sim_churn(args_cli, scenario) -> None:
         + len(b.invariant_breaches),
         "cycle_exceptions": len(a.cycle_exceptions),
         "degradation_transitions": len(a.ladder_transitions),
+        # koordguard: deadline-overrun counts, ladder residency per
+        # level (incl. partial-mesh) and the restart-to-first-bind SLO
+        "deadline_overruns": a.deadline_overruns,
+        "cycles_at_level": a.cycles_at_level,
+        "restart": a.to_dict()["restart"],
         "pair_deterministic": deterministic,
         "binding_log_sha256": a.binding_log_sha256,
         # koordbalance: migration-job/eviction activity + the hotspot

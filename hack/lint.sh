@@ -61,4 +61,16 @@ echo "== koordsim seeded smoke scenario (determinism + invariants) =="
 KOORD_TPU_REPLAY_OVERLAP=1 JAX_PLATFORMS=cpu python -m koordinator_tpu.sim smoke \
     --check-determinism --max-breaches 0 --quiet > /dev/null
 
+echo "== koordsim crash-restart scenario (recovery determinism + invariants) =="
+# koordguard's crash-restart gate: the scheduler is torn down mid-run
+# (device state, step caches, pack memo dropped; its store watches
+# severed) and rebuilt against the surviving store. Run TWICE with
+# --check-determinism: the binding logs must be byte-identical across
+# the restart boundary, with zero invariant breaches (the double-booking
+# and gang checks see both sides of the boundary every cycle). The
+# restart-to-first-bind SLO verdict rides the report JSON; bench.py
+# --churn fault-ladder is the citable wall-clock pair.
+KOORD_TPU_REPLAY_OVERLAP=1 JAX_PLATFORMS=cpu python -m koordinator_tpu.sim crash-restart \
+    --check-determinism --max-breaches 0 --quiet > /dev/null
+
 echo "lint OK"

@@ -226,6 +226,75 @@ class StoreWriteInWaveReplayLoop(Rule):
                         "designated flush site)")
 
 
+# koordguard dispatch deadlines (scheduler/deadline.py): every blocking
+# device sync in the dispatch paths must route through the deadline
+# watchdog (Scheduler._readback_sync / DeviceRebalancer's monitored
+# sync_readback), or a slow-not-dead device wedges the cycle with the
+# watchdog none the wiser. Two shapes are flagged: bare
+# ``block_until_ready`` anywhere in scheduler/, parallel/ or balance/
+# (the unambiguous device sync), and ``np.asarray`` readbacks lexically
+# inside a ``span("readback")`` body (the rebalance pass's sync site) —
+# the designated drain/merge sites carry pragmas.
+_DEADLINE_DIR_RE = re.compile(r"(^|/)(scheduler|parallel|balance)/[^/]+\.py$")
+_READBACK_SPANS = {"readback"}
+
+
+def _is_readback_span_item(item: ast.withitem) -> bool:
+    call = item.context_expr
+    return (isinstance(call, ast.Call)
+            and _dotted_tail(call.func) == "span"
+            and bool(call.args)
+            and isinstance(call.args[0], ast.Constant)
+            and call.args[0].value in _READBACK_SPANS)
+
+
+@register
+class NakedDeviceSyncWithoutDeadline(Rule):
+    name = "naked-device-sync-without-deadline"
+    severity = "error"
+    description = (
+        "bare device sync (block_until_ready, or np.asarray inside a "
+        "span(\"readback\") body) in a scheduler/, parallel/ or "
+        "balance/ dispatch path: blocking syncs must route through the "
+        "dispatch-deadline watchdog (Scheduler._readback_sync / the "
+        "rebalancer's monitored sync closure) so a slow-not-dead device "
+        "demotes the ladder instead of wedging the cycle "
+        "(KOORD_TPU_DISPATCH_DEADLINE_MS); mark a designated "
+        "drain/merge site with # koordlint: disable")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not _DEADLINE_DIR_RE.search(ctx.path):
+            return
+        seen = set()
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and _dotted_tail(node.func) == "block_until_ready"):
+                seen.add(id(node))
+                yield self.finding(
+                    ctx, node,
+                    "block_until_ready outside the deadline watchdog — "
+                    "a slow-not-dead device blocks here forever; route "
+                    "the sync through the monitored readback or pragma "
+                    "the designated drain site")
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.With):
+                continue
+            if not any(_is_readback_span_item(i) for i in node.items):
+                continue
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Call)
+                        and _dotted_tail(sub.func) == "asarray"
+                        and not _is_device_asarray(sub.func)
+                        and id(sub) not in seen):
+                    seen.add(id(sub))
+                    yield self.finding(
+                        ctx, sub,
+                        "np.asarray readback inline in a "
+                        "span(\"readback\") body — run the sync through "
+                        "the deadline watchdog (a monitored closure) or "
+                        "pragma the designated site")
+
+
 @register
 class BlockingReadbackInPipeline(Rule):
     name = "blocking-readback-in-pipeline"

@@ -30,6 +30,11 @@ import numpy as np
 
 from koordinator_tpu.obs import Tracer
 from koordinator_tpu.obs.flight import FLIGHT_SCHEMA_VERSION, FlightRecorder
+from koordinator_tpu.scheduler.deadline import (
+    DeadlineWatchdog,
+    DispatchDeadlineExceeded,
+    deadline_seconds_from,
+)
 from koordinator_tpu.scheduler.degrade import (
     LEVEL_HOST_FALLBACK,
     LEVEL_NO_MESH,
@@ -88,7 +93,8 @@ class DeviceRebalancer:
                  ladder: Optional[DegradationLadder] = None,
                  promote_after: int = 16,
                  tracer: Optional[Tracer] = None,
-                 flight: Optional[FlightRecorder] = None) -> None:
+                 flight: Optional[FlightRecorder] = None,
+                 dispatch_deadline_ms=None) -> None:
         self.mesh = mesh
         self.snapshot_getter = snapshot_getter
         self.ladder = ladder if ladder is not None else DegradationLadder(
@@ -103,8 +109,26 @@ class DeviceRebalancer:
         # top of every device-pass window; raising from it exercises the
         # rebalance ladder exactly like a real XLA/mesh fault
         self.fault_injector = None
+        # koordguard dispatch deadline: the rebalance pass shares the
+        # scheduler's KOORD_TPU_DISPATCH_DEADLINE_MS knob and watchdog
+        # discipline — an overrun abandons the pass (the shared mirror's
+        # dispatch window stays open so donation never re-arms under the
+        # slow program) and walks THIS ladder toward the host oracle.
+        self.dispatch_deadline_seconds = deadline_seconds_from(
+            dispatch_deadline_ms)
+        self.dispatch_watchdog = DeadlineWatchdog(
+            self.dispatch_deadline_seconds,
+            on_overrun=self._on_deadline_overrun)
+        # sim/test latency hook: invoked inside the monitored readback
+        self.sync_delay_injector = None
         self.stats = {"device_passes": 0, "host_passes": 0,
                       "candidates": 0, "victims": 0}
+
+    def _on_deadline_overrun(self, path: str) -> None:
+        from koordinator_tpu.scheduler import metrics as scheduler_metrics
+
+        scheduler_metrics.DISPATCH_DEADLINE_OVERRUNS.inc(path=path)
+        self.flight.dump("dispatch_deadline")
 
     # ------------------------------------------------------------------
     def _features(self) -> Dict[str, bool]:
@@ -136,7 +160,12 @@ class DeviceRebalancer:
         return snap
 
     def _get_step(self, p_pad: int, n_pad: int, cap: int, mesh):
-        mesh_tag = mesh.devices.size if mesh is not None else 0
+        # device IDS, not just the count: the scheduler's partial-mesh
+        # rung can hand this pass two same-size submeshes over different
+        # survivors, and a step compiled against the old Mesh must never
+        # serve the new one
+        mesh_tag = (tuple(d.id for d in mesh.devices.flat)
+                    if mesh is not None else ())
         key = (p_pad, n_pad, cap, mesh_tag)
         step = self._step_cache.get(key)
         if step is None:
@@ -292,7 +321,25 @@ class DeviceRebalancer:
         step = self._get_step(p_pad, n_pad,
                               plugin.args.max_pods_to_evict_per_node, mesh)
         snap = self._snapshot(mesh)
+
+        def sync_readback():
+            # the rebalance pass's designated sync point, run under the
+            # dispatch-deadline watchdog — route new syncs through here
+            # (koordlint naked-device-sync-without-deadline)
+            if self.sync_delay_injector is not None:
+                self.sync_delay_injector()
+            n = view["alloc"].shape[0]
+            sel_count = int(out.sel_count)
+            return (sel_count, int(out.cand_count),
+                    np.asarray(out.sel_pod)[:sel_count],
+                    np.asarray(out.sel_node)[:sel_count],
+                    np.asarray(out.sel_score)[:sel_count],
+                    np.asarray(out.is_low)[:n],
+                    np.asarray(out.is_high)[:n],
+                    np.asarray(out.margin)[:n])
+
         snap.begin_dispatch()
+        abandoned = False
         try:
             with self.tracer.span("score", mesh=str(
                     mesh.devices.size if mesh is not None else 0)):
@@ -304,18 +351,26 @@ class DeviceRebalancer:
                            dev["rb_pod_cpu"], dev["rb_pod_req"],
                            dev["rb_pod_ok"])
             with self.tracer.span("readback"):
-                # the rebalance pass's designated sync point
-                sel_count = int(out.sel_count)
-                cand_count = int(out.cand_count)
-                sel_pod = np.asarray(out.sel_pod)[:sel_count]
-                sel_node = np.asarray(out.sel_node)[:sel_count]
-                sel_score = np.asarray(out.sel_score)[:sel_count]
-                n = view["alloc"].shape[0]
-                is_low = np.asarray(out.is_low)[:n]
-                is_high = np.asarray(out.is_high)[:n]
-                margin = np.asarray(out.margin)[:n]
+                try:
+                    (sel_count, cand_count, sel_pod, sel_node, sel_score,
+                     is_low, is_high, margin) = self.dispatch_watchdog.run(
+                        sync_readback, "rebalance")
+                except DispatchDeadlineExceeded:
+                    # slow-not-dead device: abandon the pass. The
+                    # dispatch window stays OPEN on this mirror —
+                    # donation can never re-arm under the still-running
+                    # program (the scheduler's shared mirror simply runs
+                    # non-donating until its own next rebuild) — and a
+                    # privately-owned mirror is dropped so the next pass
+                    # re-uploads through a fresh one.
+                    abandoned = True
+                    self._own_snapshots = {
+                        k: s for k, s in self._own_snapshots.items()
+                        if s is not snap}
+                    raise
         finally:
-            snap.end_dispatch()
+            if not abandoned:
+                snap.end_dispatch()
         picked = sel_pod.astype(np.int64)
         stats = {"engine": "device", "candidates": cand_count,
                  "victims": sel_count,

@@ -248,6 +248,16 @@ class ObjectStore:
             for obj in existing:
                 handler(EventType.ADDED, obj, None)
 
+    def unsubscribe(self, kind: str, handler: Handler) -> None:
+        """Drop a watch handler registered by subscribe (no-op when it
+        was never registered). The apiserver analog of a client's watch
+        connection closing — crash-restart teardown severs a dead
+        consumer's handlers so they stop receiving events."""
+        with self._lock:
+            handlers = self._collections[kind].handlers
+            if handler in handlers:
+                handlers.remove(handler)
+
     @staticmethod
     def _notify(handlers: Iterable[Handler], ev: EventType, obj: Any, old: Any) -> None:
         for h in handlers:

@@ -98,15 +98,24 @@ class Descheduler:
                         mesh = getattr(self.scheduler,
                                        "_configured_mesh", None)
                         getter = lambda: self.scheduler.device_snapshot  # noqa: E731
+                        # a co-located rebalancer shares the scheduler's
+                        # RESOLVED deadline (koordguard): a sim that
+                        # pins the scheduler's deadline off must not
+                        # have the rebalance pass re-read the env and
+                        # demote non-deterministically
+                        dl = getattr(self.scheduler,
+                                     "dispatch_deadline_seconds", None)
+                        deadline_ms = dl * 1000.0 if dl else 0
+                        self.rebalancer = DeviceRebalancer(
+                            mesh=mesh, snapshot_getter=getter,
+                            dispatch_deadline_ms=deadline_ms)
                     else:
                         from koordinator_tpu.parallel.mesh import (
                             mesh_from_env,
                         )
 
-                        mesh = mesh_from_env()
-                        getter = None
-                    self.rebalancer = DeviceRebalancer(
-                        mesh=mesh, snapshot_getter=getter)
+                        self.rebalancer = DeviceRebalancer(
+                            mesh=mesh_from_env(), snapshot_getter=None)
                 inner.attach_device(self.rebalancer)
 
     def run_once(self, now: Optional[float] = None) -> dict:

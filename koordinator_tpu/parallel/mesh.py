@@ -90,6 +90,22 @@ def mesh_from_env(env_value: Optional[str] = None) -> Optional[Mesh]:
     return make_mesh(devices[:n])
 
 
+def surviving_submesh(mesh: Mesh, lost_device_ids) -> Optional[Mesh]:
+    """The partial-mesh rung's submesh (koordguard): the configured mesh
+    minus the devices a dispatch fault was attributed to, re-factored
+    2-D by ``make_mesh``. Non-divisible node axes re-pad through the
+    existing ``pad_for_sharding`` on upload, so any survivor count is a
+    valid mesh. The scheduler records losses only while survivors
+    remain, so its calls never see the defensive None (returned when
+    nothing survives) — a caller that can reach it must drop to its
+    single-device rung itself."""
+    lost = {int(i) for i in lost_device_ids}
+    survivors = [d for d in mesh.devices.flat if d.id not in lost]
+    if not survivors:
+        return None
+    return make_mesh(survivors)
+
+
 def _node_axis_spec(mesh: Mesh, flat: bool) -> P:
     # serial mode shards nodes over every device (both mesh axes)
     return P(("pods", "nodes")) if flat else P("nodes")

@@ -51,13 +51,21 @@ from koordinator_tpu.client.store import (
 )
 from koordinator_tpu.models.full_chain import build_best_full_chain_step
 from koordinator_tpu.obs import Tracer
+from koordinator_tpu.scheduler.deadline import (
+    DeadlineWatchdog,
+    DispatchDeadlineExceeded,
+    deadline_seconds_from,
+)
 from koordinator_tpu.scheduler.degrade import (
+    LEVEL_FULL,
     LEVEL_HOST_FALLBACK,
     LEVEL_NO_EXPLAIN,
     LEVEL_NO_MESH,
+    LEVEL_PARTIAL_MESH,
     LEVEL_SERIAL_WAVES,
     DegradationLadder,
     FusedDispatchDemoted,
+    attributable_device_ids,
     host_fallback_schedule,
 )
 from koordinator_tpu.ops.fit import with_pod_count
@@ -316,6 +324,7 @@ class Scheduler:
         mesh=None,
         ladder=None,
         replay_overlap=None,
+        dispatch_deadline_ms=None,
     ):
         from koordinator_tpu.scheduler.config import SchedulerConfiguration
         from koordinator_tpu.scheduler.plugins.reservation import (
@@ -460,19 +469,42 @@ class Scheduler:
         scheduler_metrics.MESH_DEVICES.set(
             float(self.mesh.devices.size) if self.mesh is not None else 0.0)
         # graceful-degradation ladder (scheduler/degrade.py): dispatch
-        # failures demote mesh -> single-device -> serial waves -> no
-        # explain -> pure-host fallback instead of killing the scheduler;
-        # clean cycles probe back up. The configured mesh is remembered
-        # so a re-promotion can restore it.
+        # failures demote mesh -> partial mesh (koordguard, when the
+        # fault names its dead devices) -> single-device -> serial waves
+        # -> no explain -> pure-host fallback instead of killing the
+        # scheduler; clean cycles probe back up. The configured mesh is
+        # remembered so a re-promotion can restore it; device ids shed
+        # by attributable faults accumulate in _lost_device_ids until a
+        # promotion to full probes the whole mesh back.
         self._configured_mesh = self.mesh
+        self._lost_device_ids: set = set()
+        self._submesh_cache: Dict[frozenset, object] = {}
         self.ladder = ladder if ladder is not None else DegradationLadder()
         self.ladder.observer = self._on_ladder_transition
         scheduler_metrics.DEGRADED_LEVEL.set(float(self.ladder.level))
+        # koordguard dispatch deadline (scheduler/deadline.py,
+        # KOORD_TPU_DISPATCH_DEADLINE_MS): every designated device sync
+        # runs under the watchdog; an overrun counts, flight-dumps
+        # (reason dispatch_deadline) and feeds the ladder exactly like a
+        # raised fault, so a slow-not-dead device demotes instead of
+        # wedging the cycle. None/0 (the default) keeps syncs inline.
+        self.dispatch_deadline_seconds = deadline_seconds_from(
+            dispatch_deadline_ms)
+        self.dispatch_watchdog = DeadlineWatchdog(
+            self.dispatch_deadline_seconds,
+            on_overrun=self._on_deadline_overrun)
         # sim/test failure-injection hook: a callable(stage) invoked at
         # the top of every device-dispatch window ("serial"/"fused");
         # raising from it exercises the ladder exactly like a real
         # XLA/mesh fault (koordinator_tpu/sim FaultPlan arms it)
         self.fault_injector = None
+        # sim/test latency hook: a callable() invoked inside every
+        # monitored readback sync — sleeping in it is a slow-not-dead
+        # device, the dispatch-deadline fault model
+        self.sync_delay_injector = None
+        # sim/test upload-failure hook, propagated onto every
+        # DeviceSnapshot this scheduler builds (see the property below)
+        self._upload_fault_injector = None
         # pipelined-cycle mode (CyclePipeline): the kernel dispatch is
         # non-blocking and diagnose/condition writes for unbound pods are
         # deferred into the NEXT cycle's kernel window so host work
@@ -505,7 +537,6 @@ class Scheduler:
         self.device_snapshot = None
         if SCHEDULER_GATES.enabled("IncrementalSnapshot"):
             from koordinator_tpu.scheduler.snapshot_cache import (
-                DeviceSnapshot,
                 SnapshotCache,
             )
 
@@ -514,17 +545,35 @@ class Scheduler:
                 loadaware_plugin=self.extender.plugin("LoadAwareScheduling"),
                 numa_plugin=self.extender.plugin("NodeNUMAResource"),
             )
-            self.device_snapshot = DeviceSnapshot(mesh=self.mesh)
-        elif self.mesh is not None:
-            # the mesh path REQUIRES the device mirror: it owns the
-            # sharded upload (put_on_mesh) and the shard-aware scatter.
-            # Without the incremental-snapshot gate it still dedups on
-            # host equality, it just sees full rebuilds each cycle.
-            from koordinator_tpu.scheduler.snapshot_cache import (
-                DeviceSnapshot,
-            )
+        if self.snapshot_cache is not None or self.mesh is not None:
+            # the mesh path REQUIRES the device mirror even without the
+            # incremental-snapshot gate: it owns the sharded upload
+            # (put_on_mesh) and the shard-aware scatter — gate off it
+            # still dedups on host equality, it just sees full rebuilds
+            # each cycle. Same condition _apply_degraded_level re-applies
+            # on every ladder transition.
+            self.device_snapshot = self._new_device_snapshot(self.mesh)
 
-            self.device_snapshot = DeviceSnapshot(mesh=self.mesh)
+    # ------------------------------------------------------------------
+    def _new_device_snapshot(self, mesh):
+        """Build a DeviceSnapshot with the sim's upload-failure hook
+        propagated — every rebuild site (ladder transitions, deadline
+        abandons) must keep the hook armed or fault tests go blind."""
+        from koordinator_tpu.scheduler.snapshot_cache import DeviceSnapshot
+
+        snap = DeviceSnapshot(mesh=mesh)
+        snap.fault_injector = self._upload_fault_injector
+        return snap
+
+    @property
+    def upload_fault_injector(self):
+        return self._upload_fault_injector
+
+    @upload_fault_injector.setter
+    def upload_fault_injector(self, fn) -> None:
+        self._upload_fault_injector = fn
+        if self.device_snapshot is not None:
+            self.device_snapshot.fault_injector = fn
 
     # ------------------------------------------------------------------
     def _pending_queue(self, now: float) -> Tuple[List[Pod], Dict[str, Reservation]]:
@@ -711,10 +760,20 @@ class Scheduler:
             now=now,
         )
 
+    def _mesh_tag(self) -> Tuple:
+        """Step-cache key component for the mesh placement. Device IDS,
+        not just the count: the partial-mesh rung can produce two
+        same-size submeshes over different survivors across one
+        scheduler lifetime, and a step compiled against the old Mesh
+        must never serve the new one."""
+        if self.mesh is None:
+            return ()
+        return tuple(d.id for d in self.mesh.devices.flat)
+
     def _get_step(self, signature: Tuple, ng: int, ngroups: int, active,
                   explain=None) -> object:
-        mesh_tag = self.mesh.devices.size if self.mesh is not None else 0
-        key = (signature, ng, ngroups, tuple(active), explain, mesh_tag)
+        key = (signature, ng, ngroups, tuple(active), explain,
+               self._mesh_tag())
         step = self._step_cache.get(key)
         if step is not None:
             self._last_step_compiled = False
@@ -748,9 +807,8 @@ class Scheduler:
                         active, waves: int, explain=None) -> object:
         from koordinator_tpu.models.fused_waves import build_fused_wave_step
 
-        mesh_tag = self.mesh.devices.size if self.mesh is not None else 0
         key = ("fused", waves, signature, ng, ngroups, tuple(active),
-               explain, mesh_tag)
+               explain, self._mesh_tag())
         step = self._step_cache.get(key)
         if step is not None:
             self._last_step_compiled = False
@@ -783,9 +841,8 @@ class Scheduler:
             build_chained_wave_step,
         )
 
-        mesh_tag = self.mesh.devices.size if self.mesh is not None else 0
         key = ("chain", signature, ng, ngroups, tuple(active), explain,
-               mesh_tag)
+               self._mesh_tag())
         step = self._step_cache.get(key)
         if step is not None:
             self._last_step_compiled = False
@@ -835,16 +892,45 @@ class Scheduler:
                else logger.info)
         log("dispatch degradation ladder: %s -> %s (%s)",
             record["from"], record["to"], record["reason"])
+        if record["to_level"] == LEVEL_FULL:
+            # re-promotion probes the FULL configured mesh back: the
+            # lost-device set resets, and a still-dead device re-records
+            # itself when the probe's dispatch fails attributably
+            self._lost_device_ids = set()
         self._apply_degraded_level()
         self.flight.dump("degradation")
+
+    def _partial_mesh(self):
+        """The surviving submesh for the partial-mesh rung: the
+        configured mesh minus every device id shed so far. Cached per
+        lost-set so `_apply_degraded_level`'s identity compare sees a
+        stable Mesh while the set is unchanged."""
+        from koordinator_tpu.parallel.mesh import surviving_submesh
+
+        key = frozenset(self._lost_device_ids)
+        hit = self._submesh_cache.get(key)
+        if hit is None:
+            # never None-valued: _on_dispatch_failure records losses
+            # only while survivors remain, so the submesh is non-empty
+            hit = surviving_submesh(self._configured_mesh, key)
+            self._submesh_cache[key] = hit
+        return hit
 
     def _apply_degraded_level(self) -> None:
         """Reconcile the mesh with the ladder level (the waves/explain
         rungs are consulted per cycle by _effective_waves/_effective_
         explain; the mesh owns device buffers, so it reconfigures here).
-        Idempotent and cheap when nothing changed."""
-        want_mesh = (self._configured_mesh
-                     if self.ladder.level < LEVEL_NO_MESH else None)
+        The partial-mesh rung runs the surviving submesh — snapshot and
+        step cache rebuild against it, re-padding through the normal
+        pad_for_sharding/put path. Idempotent and cheap when nothing
+        changed."""
+        if self.ladder.level >= LEVEL_NO_MESH:
+            want_mesh = None
+        elif (self.ladder.level == LEVEL_PARTIAL_MESH
+                and self._configured_mesh is not None):
+            want_mesh = self._partial_mesh()
+        else:
+            want_mesh = self._configured_mesh
         if want_mesh is self.mesh:
             return
         self.mesh = want_mesh
@@ -855,13 +941,31 @@ class Scheduler:
         # state reuse). Stats baseline resets with it so the per-cycle
         # counter deltas never go negative.
         if self.snapshot_cache is not None or want_mesh is not None:
-            from koordinator_tpu.scheduler.snapshot_cache import (
-                DeviceSnapshot,
-            )
-
-            self.device_snapshot = DeviceSnapshot(mesh=want_mesh)
+            self.device_snapshot = self._new_device_snapshot(want_mesh)
         else:
             self.device_snapshot = None
+        self._upload_stats_last = {}
+
+    def _on_deadline_overrun(self, path: str) -> None:
+        """The dispatch watchdog abandoned a monitored sync: count it
+        and dump the flight ring (reason dispatch_deadline) — the
+        DispatchDeadlineExceeded it raises right after lands in the
+        dispatch window's failure handler, which abandons the device
+        state and feeds the ladder like any raised fault."""
+        scheduler_metrics.DISPATCH_DEADLINE_OVERRUNS.inc(path=path)
+        self.flight.dump("dispatch_deadline")
+
+    def _abandon_device_state(self) -> None:
+        """A deadline overrun left a device program running against the
+        mirror's buffers. Never block on it (that IS the wedge being
+        escaped) and never donate those buffers out from under it: the
+        mirror is replaced wholesale — the next upload repopulates the
+        fresh one through the normal put/scatter path, and the slow
+        program keeps the old buffers alive until its background sync
+        drains."""
+        if self.device_snapshot is None:
+            return
+        self.device_snapshot = self._new_device_snapshot(self.mesh)
         self._upload_stats_last = {}
 
     def _on_dispatch_failure(self, stage: str, exc: Exception) -> None:
@@ -870,9 +974,47 @@ class Scheduler:
         retry or demotion was arranged (the caller re-runs its dispatch
         window), re-raises when the ladder is exhausted."""
         scheduler_metrics.DISPATCH_RETRIES.inc(stage=stage)
+        if isinstance(exc, DispatchDeadlineExceeded):
+            # slow-not-dead device: the in-flight window was abandoned,
+            # so the retry/demoted re-run must upload into a fresh
+            # mirror whose donation guard the slow program cannot bite
+            self._abandon_device_state()
+        features = self._ladder_features()
+        # koordguard partial-mesh: a failure that NAMES dead mesh
+        # devices engages the partial-mesh rung — record the loss first
+        # (the transition observer rebuilds the submesh), then let the
+        # ladder pick the rung. A repeat loss while already at
+        # partial-mesh shrinks the submesh in place.
+        ids = attributable_device_ids(exc)
+        if ids and self._configured_mesh is not None:
+            all_ids = {d.id for d in self._configured_mesh.devices.flat}
+            named = ids & all_ids
+            fresh = named - self._lost_device_ids
+            survivors = all_ids - self._lost_device_ids - named
+            if named and survivors:
+                # the rung is engaged whenever the failure NAMES devices
+                # with survivors left — including the second attempt of
+                # the same fault, whose ids the retry already recorded
+                self._lost_device_ids |= fresh
+                features["partial_mesh"] = True
+                if (self.ladder.level == LEVEL_PARTIAL_MESH
+                        and self.mesh is not None
+                        and named & {d.id
+                                     for d in self.mesh.devices.flat}):
+                    # the loss names a device still in the ACTIVE
+                    # submesh: shrink in place. Keyed off the current
+                    # mesh, not the fresh set — the retry attempt
+                    # already recorded the id, but the submesh only
+                    # rebuilds on the ladder transition, so both
+                    # attempts must see the shrink flag.
+                    features["partial_mesh_shrink"] = True
+                if fresh:
+                    logger.warning(
+                        "%s dispatch failure attributed to device(s) %s; "
+                        "%d of %d mesh devices survive", stage,
+                        sorted(fresh), len(survivors), len(all_ids))
         action = self.ladder.on_failure(
-            self._ladder_features(),
-            error=f"{type(exc).__name__}: {exc}")
+            features, error=f"{type(exc).__name__}: {exc}")
         if action == "exhausted":
             raise exc
         if action == "retry":
@@ -880,7 +1022,8 @@ class Scheduler:
                 "%s dispatch failed (%s: %s); retrying once at ladder "
                 "level %s", stage, type(exc).__name__, exc,
                 self.ladder.level_name)
-        # "demoted": the transition observer already re-applied settings
+        # "demoted" (including a partial-mesh shrink in place): the
+        # transition observer already re-applied settings
 
     def _effective_explain(self):
         """This cycle's koordexplain level. The sidecar path demotes to
@@ -1644,12 +1787,30 @@ class Scheduler:
             counter.inc(ds[key] - prev_ds.get(key, 0))
         self._upload_stats_last = dict(ds)
 
-    def _readback_sync(self, n_shape: Tuple[int, int], *arrays):
-        """The designated host sync point: materialize kernel outputs.
-        Mesh mode routes through the per-shard merge (compacted packed
-        order + shard observability); single-device is a plain blocking
-        asarray. ``n_shape`` is (real nodes, padded node axis) for the
-        shard-imbalance gauge."""
+    def _readback_sync(self, n_shape: Tuple[int, int], *arrays,
+                       path: str = "serial"):
+        """The designated host sync point: materialize kernel outputs,
+        MONITORED by the dispatch-deadline watchdog (koordguard). With a
+        deadline armed the blocking body runs on a watchdog worker; an
+        overrun abandons the window (DispatchDeadlineExceeded into the
+        dispatch's failure handler) instead of wedging the cycle behind
+        a slow-not-dead device. ``path`` labels the overrun counter.
+        Note: under a mesh the per-shard marker spans then land as
+        detached roots in the tracer ring (the worker thread has no
+        cycle root); the default no-deadline path is inline and
+        byte-identical to the pre-koordguard behavior."""
+        return self.dispatch_watchdog.run(
+            lambda: self._readback_sync_now(n_shape, *arrays), path)
+
+    def _readback_sync_now(self, n_shape: Tuple[int, int], *arrays):
+        """The blocking readback body. Mesh mode routes through the
+        per-shard merge (compacted packed order + shard observability);
+        single-device is a plain blocking asarray. ``n_shape`` is (real
+        nodes, padded node axis) for the shard-imbalance gauge."""
+        if self.sync_delay_injector is not None:
+            # sim latency injection: a slow-not-dead device is a sync
+            # that takes too long, exactly where the watchdog watches
+            self.sync_delay_injector()
         if self.mesh is not None:
             return self._mesh_merge_readback(n_shape, *arrays)
         # the single intended host-blocking sync of the dispatch window
@@ -2038,11 +2199,11 @@ class Scheduler:
                                 # per-shard replicas in one pass)
                                 (bind_pods, bind_nodes, bind_zones,
                                  wave_counts) = self._readback_sync(
-                                     n_shape, *compacted)
+                                     n_shape, *compacted, path="fused")
                         else:
                             (bind_pods, bind_nodes, bind_zones,
                              wave_counts) = self._readback_sync(
-                                 n_shape, *compacted)
+                                 n_shape, *compacted, path="fused")
                         waves_run = int(out.waves_run)
                     finally:
                         if self.device_snapshot is not None:
@@ -2265,15 +2426,27 @@ class Scheduler:
         carry, rows = step(fc, carry, la_adj_d)
         return carry, rows, None
 
-    def _sync_wave_rows(self, n_shape, rows, counts_row):
+    def _sync_wave_rows(self, n_shape, rows, counts_row,
+                        monitored: bool = True):
         """Materialize one wave's compacted readback — the per-wave
         designated sync point of the overlapped replay. Returns host
-        arrays (pods, nodes, zones, count[, counts_row])."""
+        arrays (pods, nodes, zones, count[, counts_row]).
+
+        ``monitored=False`` (the replay phase, wave >= 2) runs the sync
+        INLINE, outside the deadline watchdog: those syncs happen after
+        binds applied, where a DispatchDeadlineExceeded could only
+        escape as a cycle exception whose unwind closes the dispatch
+        window under the still-running program — re-arming donation.
+        The ladder's deadline window is wave 1's readback only; a
+        genuinely slow device trips it there on the next cycle."""
         arrays = (rows.bind_pods, rows.bind_nodes, rows.bind_zones,
                   rows.count)
         if counts_row is not None:
             arrays = arrays + (counts_row,)
-        synced = self._readback_sync(n_shape, *arrays)
+        if monitored:
+            synced = self._readback_sync(n_shape, *arrays, path="fused")
+        else:
+            synced = self._readback_sync_now(n_shape, *arrays)
         scheduler_metrics.READBACK_BYTES.inc(
             int(sum(a.nbytes for a in synced[:4])))
         if counts_row is not None:
@@ -2289,6 +2462,12 @@ class Scheduler:
         deliberate sync of a result we discard."""
         import jax
 
+        # the designated abandoned-wave drain: deliberately unmonitored —
+        # it runs AFTER binds applied (truncation/unwind), where shedding
+        # the wait would only trade a bounded block for a donation hazard
+        # (deadline overruns never reach here: their abort path skips the
+        # drain and rebuilds the mirror instead)
+        # koordlint: disable=naked-device-sync-without-deadline
         jax.block_until_ready(rows.count)
 
     def _abort_chain_window(self, rows, window_open: bool) -> None:
@@ -2405,6 +2584,16 @@ class Scheduler:
                 self._abort_chain_window(rows0, window_open)
                 rows0, window_open = None, False
                 raise hw.__cause__
+            except DispatchDeadlineExceeded as exc:
+                # the slow wave is exactly what we are escaping: never
+                # drain it here (that blocks as long as the overrun) —
+                # the window stays open on the old mirror (donation off
+                # for good) and _on_dispatch_failure swaps in a fresh
+                # one before the retry/demoted re-run
+                rows0, window_open = None, False
+                self._on_dispatch_failure("fused", exc)
+                if self.ladder.level >= LEVEL_SERIAL_WAVES:
+                    raise FusedDispatchDemoted() from exc
             except Exception as exc:
                 self._abort_chain_window(rows0, window_open)
                 rows0, window_open = None, False
@@ -2576,8 +2765,10 @@ class Scheduler:
                         in_flight = None
                         with self.tracer.span("overlap_wait",
                                               wave=str(w + 1)):
-                            synced = self._sync_wave_rows(n_shape, rows_n,
-                                                          crow_n)
+                            # post-bind: inline, unmonitored (see
+                            # _sync_wave_rows)
+                            synced = self._sync_wave_rows(
+                                n_shape, rows_n, crow_n, monitored=False)
                         t_last_sync = time.perf_counter()
                         executed += 1
                     else:

@@ -13,14 +13,27 @@ vs how much surface it exposes, and walks DOWN one rung at a time when
 dispatch attempts keep failing:
 
   level 0  full            — everything as configured
-  level 1  no-mesh         — mesh dispatch off, single-device buffers
-  level 2  serial-waves    — fused multi-wave off, K pinned to 1
-  level 3  no-explain      — koordexplain attribution off
-  level 4  host-fallback   — no device dispatch at all: a pure-host
+  level 1  partial-mesh    — mesh dispatch on the SURVIVING submesh: a
+                             fault attributable to specific mesh devices
+                             sheds only those devices (koordguard) — the
+                             snapshot/step cache rebuild on the smaller
+                             mesh instead of collapsing to single-device
+  level 2  no-mesh         — mesh dispatch off, single-device buffers
+  level 3  serial-waves    — fused multi-wave off, K pinned to 1
+  level 4  no-explain      — koordexplain attribution off
+  level 5  host-fallback   — no device dispatch at all: a pure-host
                              numpy scheduling pass built on the diagnose
                              oracle (scheduler/diagnose.py), the proof
                              that every modeled predicate evaluates on
                              host
+
+The partial-mesh rung exists only for failures that NAME their dead
+devices (``attributable_device_ids``): an anonymous dispatch fault
+cannot pick survivors and skips straight past it. A further attributable
+fault while already AT partial-mesh shrinks the submesh in place (a
+same-level transition) instead of dropping the whole mesh; re-promotion
+to ``full`` always probes the FULL configured mesh back — a still-dead
+device re-records itself when the probe fails.
 
 Policy (scheduler/cycle.py wires it around both the serial and fused
 dispatch windows, strictly BEFORE any binding is applied, so a failed
@@ -62,13 +75,30 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 LEVEL_FULL = 0
-LEVEL_NO_MESH = 1
-LEVEL_SERIAL_WAVES = 2
-LEVEL_NO_EXPLAIN = 3
-LEVEL_HOST_FALLBACK = 4
+LEVEL_PARTIAL_MESH = 1
+LEVEL_NO_MESH = 2
+LEVEL_SERIAL_WAVES = 3
+LEVEL_NO_EXPLAIN = 4
+LEVEL_HOST_FALLBACK = 5
 
-LEVEL_NAMES = ("full", "no-mesh", "serial-waves", "no-explain",
-               "host-fallback")
+LEVEL_NAMES = ("full", "partial-mesh", "no-mesh", "serial-waves",
+               "no-explain", "host-fallback")
+
+
+def attributable_device_ids(exc: BaseException) -> frozenset:
+    """Mesh device ids a dispatch failure NAMES as failed, or an empty
+    set. Read from the exception's ``failed_device_ids`` attribute — the
+    sim's device-loss fault carries it, and a runtime integration can
+    attach the same attribute after parsing an XLA/ICI error. Only an
+    attributable failure can engage the partial-mesh rung: anonymous
+    faults cannot pick survivors."""
+    ids = getattr(exc, "failed_device_ids", None)
+    if not ids:
+        return frozenset()
+    try:
+        return frozenset(int(i) for i in ids)
+    except (TypeError, ValueError):
+        return frozenset()
 
 
 class FusedDispatchDemoted(Exception):
@@ -82,6 +112,11 @@ def _rung_changes_behavior(level: int, features: Dict[str, bool]) -> bool:
     """Does demoting INTO ``level`` change anything for a scheduler with
     these configured features? Skipping no-op rungs keeps the ladder from
     burning retry budget on demotions that would fail identically."""
+    if level == LEVEL_PARTIAL_MESH:
+        # only meaningful when a mesh is configured AND the failure at
+        # hand named dead devices with at least one survivor (the owner
+        # sets this per failure — see Scheduler._on_dispatch_failure)
+        return features.get("partial_mesh", False)
     if level == LEVEL_NO_MESH:
         return features.get("mesh", False)
     if level == LEVEL_SERIAL_WAVES:
@@ -159,10 +194,18 @@ class DegradationLadder:
             self._retried = True
             return "retry"
         target = None
-        for lvl in range(self.level + 1, LEVEL_HOST_FALLBACK + 1):
-            if _rung_changes_behavior(lvl, features):
-                target = lvl
-                break
+        if (self.level == LEVEL_PARTIAL_MESH
+                and features.get("partial_mesh_shrink", False)):
+            # already on a submesh and the new failure named MORE dead
+            # devices: shed those too (a same-level transition — the
+            # observer re-applies settings and rebuilds the smaller
+            # submesh) instead of dropping the whole mesh
+            target = LEVEL_PARTIAL_MESH
+        else:
+            for lvl in range(self.level + 1, LEVEL_HOST_FALLBACK + 1):
+                if _rung_changes_behavior(lvl, features):
+                    target = lvl
+                    break
         if target is None:
             return "exhausted"
         if self._probation_left > 0:

@@ -152,18 +152,31 @@ FLIGHT_DUMPS = REGISTRY.counter(
 )
 
 # dispatch degradation ladder (scheduler/degrade.py): the current rung
-# (0=full, 1=no-mesh, 2=serial-waves, 3=no-explain, 4=host-fallback) and
-# every failed dispatch attempt the ladder absorbed instead of letting it
-# kill the scheduler, labeled by the dispatch stage that failed
+# (0=full, 1=partial-mesh, 2=no-mesh, 3=serial-waves, 4=no-explain,
+# 5=host-fallback) and every failed dispatch attempt the ladder absorbed
+# instead of letting it kill the scheduler, labeled by the dispatch
+# stage that failed
 DEGRADED_LEVEL = REGISTRY.gauge(
     "koord_scheduler_degraded_level",
     "Dispatch degradation-ladder level "
-    "(0=full 1=no-mesh 2=serial-waves 3=no-explain 4=host-fallback)",
+    "(0=full 1=partial-mesh 2=no-mesh 3=serial-waves 4=no-explain "
+    "5=host-fallback)",
 )
 DISPATCH_RETRIES = REGISTRY.counter(
     "koord_scheduler_dispatch_retries_total",
     "Failed device-dispatch attempts absorbed by the degradation "
     "ladder, labeled by stage",
+)
+
+# koordguard dispatch deadline (scheduler/deadline.py,
+# KOORD_TPU_DISPATCH_DEADLINE_MS): monitored device syncs that overran
+# and were abandoned — a slow-not-dead device demoting the ladder
+# instead of wedging the cycle. Labeled by the dispatch path
+# (serial | fused | rebalance).
+DISPATCH_DEADLINE_OVERRUNS = REGISTRY.counter(
+    "koord_scheduler_dispatch_deadline_overruns_total",
+    "Device syncs abandoned after overrunning the dispatch deadline, "
+    "labeled by path",
 )
 
 # mesh-backed dispatch (KOORD_TPU_MESH, parallel/mesh.py): how many

@@ -650,6 +650,23 @@ class SnapshotCache:
 _SCATTER_FRACTION = 0.125
 
 
+class DeviceAllocationError(RuntimeError):
+    """An upload/scatter failed with an allocation-shaped error
+    (RESOURCE_EXHAUSTED / out-of-memory): a DEVICE fault, not a bug in
+    the snapshot. The dispatch windows treat it exactly like any raised
+    device fault — retry once, then demote down the ladder — instead of
+    letting an OOM-shaped transfer failure escape as a cycle exception.
+    The mirror entry for the failed field is rolled back before
+    raising, so a ladder retry re-uploads it from scratch and the
+    donation/double-buffer guard re-arms cleanly."""
+
+
+def _is_resource_exhausted(exc: BaseException) -> bool:
+    text = f"{type(exc).__name__}: {exc}".lower()
+    return ("resource_exhausted" in text or "resource exhausted" in text
+            or "out of memory" in text or "allocation fail" in text)
+
+
 def _pad_bucket(n: int) -> int:
     """Scatter-row pad bucket: 8, 64, 512, 4096, ... (x8 steps, not x2).
     Each distinct pad is a distinct jitted scatter program; with x2
@@ -718,6 +735,10 @@ class DeviceSnapshot:
         # second buffer until the dispatch syncs) — the cycle driver
         # brackets every async kernel window with begin/end_dispatch.
         self._in_flight = 0
+        # sim/test upload-failure hook: callable(field name) invoked
+        # before each field's transfer — raising RESOURCE_EXHAUSTED-
+        # shaped errors from it exercises the OOM-upload fault model
+        self.fault_injector = None
         self.stats = {"reused": 0, "scattered": 0, "scattered_safe": 0,
                       "put": 0, "bytes_put": 0, "bytes_scattered": 0}
 
@@ -787,6 +808,31 @@ class DeviceSnapshot:
         return fn(dev, idx_p, rows_p)
 
     def _one(self, name: str, new) -> object:
+        """One field through the reuse/scatter/put machinery, with
+        allocation-shaped transfer failures CLASSIFIED as device faults
+        (DeviceAllocationError): a failed field never lands in the host
+        mirror, so a ladder retry re-uploads it through the normal
+        put/scatter path with the double-buffer guard intact."""
+        try:
+            if self.fault_injector is not None:
+                self.fault_injector(name)
+            return self._one_transfer(name, new)
+        except Exception as exc:
+            # roll the field's mirror entry back on ANY transfer failure:
+            # a donated scatter may have consumed the old device buffer
+            # before the error surfaced, and a retry gathering against
+            # the stale entry would read a deleted array — the fresh
+            # full put is always safe
+            self._fields.pop(name, None)
+            if isinstance(exc, DeviceAllocationError):
+                raise
+            if _is_resource_exhausted(exc):
+                raise DeviceAllocationError(
+                    f"device allocation failed uploading {name!r} "
+                    f"({type(exc).__name__}: {exc})") from exc
+            raise
+
+    def _one_transfer(self, name: str, new) -> object:
         import jax
 
         new = np.asarray(new)

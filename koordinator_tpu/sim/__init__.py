@@ -15,6 +15,7 @@ the catalog lives in :mod:`koordinator_tpu.sim.scenarios`.
 """
 
 from koordinator_tpu.sim.faults import (  # noqa: F401
+    DeviceLossFault,
     Fault,
     FaultPlan,
     FaultyStore,

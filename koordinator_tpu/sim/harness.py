@@ -99,6 +99,20 @@ class SimReport:
     cycle_exceptions: List[str] = dataclasses.field(default_factory=list)
     faults_injected: int = 0
     sidecar_fallbacks: int = 0
+    # koordguard: monitored-sync overruns (slow-not-dead devices) and
+    # the crash-restart recovery SLO — sim-clock seconds from each
+    # scheduler teardown to the fresh scheduler's first bind
+    deadline_overruns: int = 0
+    restarts: int = 0
+    restart_to_first_bind_seconds: List[float] = dataclasses.field(
+        default_factory=list)
+    # wall-clock recovery (report-only): dominated by the fresh
+    # scheduler's cold compiles — the number the ROADMAP's AOT-warm-up
+    # item will have to beat; sim-clock gates the SLO because wall time
+    # is backend-bound
+    restart_to_first_bind_wall_seconds: List[float] = dataclasses.field(
+        default_factory=list)
+    restart_slo_seconds: float = 0.0
     ladder_transitions: List[dict] = dataclasses.field(default_factory=list)
     cycles_at_level: Dict[str, int] = dataclasses.field(default_factory=dict)
     final_level: str = "full"
@@ -177,6 +191,32 @@ class SimReport:
             "cycle_exception_samples": self.cycle_exceptions[:5],
             "faults_injected": self.faults_injected,
             "sidecar_fallbacks": self.sidecar_fallbacks,
+            "deadline_overruns": self.deadline_overruns,
+            "restart": {
+                "count": self.restarts,
+                "to_first_bind_seconds": {
+                    "count": len(self.restart_to_first_bind_seconds),
+                    "p50": (float(np.percentile(np.asarray(
+                        self.restart_to_first_bind_seconds), 50))
+                        if self.restart_to_first_bind_seconds else 0.0),
+                    "p99": (float(np.percentile(np.asarray(
+                        self.restart_to_first_bind_seconds), 99))
+                        if self.restart_to_first_bind_seconds else 0.0),
+                    "max": (max(self.restart_to_first_bind_seconds)
+                            if self.restart_to_first_bind_seconds else 0.0),
+                },
+                "to_first_bind_wall_seconds": [
+                    round(w, 2)
+                    for w in self.restart_to_first_bind_wall_seconds],
+                "slo_seconds": self.restart_slo_seconds,
+                # every restart must have rebound within the SLO; a
+                # restart that never rebinds can never meet it
+                "met": (self.restarts == 0 or (
+                    self.restart_slo_seconds <= 0) or (
+                    len(self.restart_to_first_bind_seconds) == self.restarts
+                    and max(self.restart_to_first_bind_seconds)
+                    <= self.restart_slo_seconds)),
+            },
             "degradation": {
                 "transitions": self.ladder_transitions,
                 "cycles_at_level": self.cycles_at_level,
@@ -247,7 +287,8 @@ class ChurnSimulator:
             seed=scenario.seed,
             cycles=scenario.cycles,
             slo_target_seconds=scenario.ttb_slo_seconds,
-            dissipate_slo_cycles=scenario.hotspot_dissipate_slo_cycles)
+            dissipate_slo_cycles=scenario.hotspot_dissipate_slo_cycles,
+            restart_slo_seconds=scenario.restart_slo_seconds)
         self._uid = 0
         self._arrival_time: Dict[str, float] = {}   # pod key -> sim arrival
         self._overflow: List[Pod] = []              # waiting room (FIFO)
@@ -263,6 +304,16 @@ class ChurnSimulator:
         self._hotspots: List[Tuple[int, set]] = []
         self._dump_budget = {"invariant_breach": MAX_EVENT_DUMPS,
                              "slo_overrun": MAX_EVENT_DUMPS}
+        # crash-restart (koordguard): sim time of the last restart still
+        # awaiting its first bind, plus the dead schedulers' counters
+        # folded into the final report
+        self._flight_dir = flight_dir
+        self._restart_time: Optional[float] = None
+        self._restart_wall = 0.0
+        self._prior_transitions: List[dict] = []
+        self._prior_flight_dumps = 0
+        self._prior_sidecar_fallbacks = 0
+        self._prior_deadline_overruns = 0
         self._build_world()
         self._build_scheduler(flight_dir)
 
@@ -332,14 +383,20 @@ class ChurnSimulator:
         from koordinator_tpu.scheduler.degrade import DegradationLadder
 
         sc = self.sc
+        self.sched_store = FaultyStore(self.store, self.plan)
         self.sched = Scheduler(
-            FaultyStore(self.store, self.plan),
+            self.sched_store,
             waves=sc.waves,
             explain=sc.explain if sc.explain is not None else "off",
             mesh=sc.mesh if sc.mesh is not None else "off",
             ladder=DegradationLadder(promote_after=sc.promote_after),
+            dispatch_deadline_ms=(sc.dispatch_deadline_ms
+                                  if sc.dispatch_deadline_ms is not None
+                                  else 0),
         )
         self.sched.fault_injector = self.plan.dispatch_hook
+        self.sched.sync_delay_injector = self.plan.sync_delay_hook
+        self.sched.upload_fault_injector = self.plan.upload_hook
         if flight_dir:
             self.sched.flight = FlightRecorder(
                 dump_dir=flight_dir,
@@ -767,11 +824,49 @@ class ChurnSimulator:
             self._dump_budget[reason] -= 1
             self.sched.flight.dump(reason)
 
+    def _crash_restart(self, cycle: int) -> None:
+        """The crash-restart event (koordguard): the scheduler process
+        dies mid-soak — every watch its store view registered is severed
+        (the apiserver dropping a dead client), and ALL in-process state
+        goes with the object graph: device buffers, compiled step
+        caches, the pack memo, plugin assumed/quota state. A fresh
+        Scheduler is then constructed against the SURVIVING store: its
+        plugins and SnapshotCache replay list-then-watch, so the first
+        cycle re-derives assumed/quota/gang state from store-visible
+        binds. The report tracks sim time from here to the fresh
+        scheduler's first bind (the restart-to-first-bind SLO)."""
+        old = self.sched
+        self._prior_transitions.extend(old.ladder.transitions)
+        self._prior_flight_dumps += old.flight.dumps
+        self._prior_sidecar_fallbacks += old.sidecar_fallbacks
+        self._prior_deadline_overruns += old.dispatch_watchdog.overruns
+        if self.desch is not None and self.desch.rebalancer is not None:
+            # the descheduler dies with the scheduler process: its
+            # rebalance-pass overruns must survive into the report too
+            self._prior_deadline_overruns += (
+                self.desch.rebalancer.dispatch_watchdog.overruns)
+        self.sched_store.sever()
+        self.report.restarts += 1
+        # the crash is anchored at the END of the previous cycle: a
+        # fresh scheduler that binds within its first cycle reads one
+        # dt of sim-clock recovery, not a degenerate 0.0
+        self._restart_time = self.now - self.sc.dt_seconds
+        self._restart_wall = time.perf_counter()
+        self._build_scheduler(self._flight_dir)
+        logger.warning("sim cycle %d: scheduler crash-restart (store "
+                       "survives, scheduler state dropped)", cycle)
+
     def _account_bind(self, cycle: int, pod_key: str,
                       node_name: str) -> None:
         """One committed binding into the report: phase bookkeeping is
         the caller's; this records ttb (+ SLO overrun), the bound
-        counter, and the binding-log line."""
+        counter, restart recovery, and the binding-log line."""
+        if self._restart_time is not None:
+            self.report.restart_to_first_bind_seconds.append(
+                self.now - self._restart_time)
+            self.report.restart_to_first_bind_wall_seconds.append(
+                time.perf_counter() - self._restart_wall)
+            self._restart_time = None
         arrived = self._arrival_time.pop(pod_key, None)
         if arrived is not None:
             ttb = self.now - arrived
@@ -809,6 +904,8 @@ class ChurnSimulator:
     def _run_one_cycle(self, cycle: int) -> None:
         sc = self.sc
         self.now += sc.dt_seconds
+        if cycle in sc.restart_at:
+            self._crash_restart(cycle)
         self.plan.begin_cycle(cycle)
         # sidecar fault window: swap a dead client in (the sidecar layer
         # must degrade to the local step, never wedge the cycle)
@@ -896,19 +993,34 @@ class ChurnSimulator:
         self._check_invariants(cycle)
 
     def run(self) -> SimReport:
-        t0 = time.perf_counter()
+        self._t0 = time.perf_counter()
         for cycle in range(self.sc.cycles):
             self._run_one_cycle(cycle)
+        return self.run_report()
+
+    def run_report(self) -> SimReport:
+        """Finalize the report — run() is loop + run_report(); tests
+        that drive cycles manually (inspecting scheduler state between
+        them) call this directly."""
         if self.pipeline is not None:
             self.pipeline.flush()
-        self.report.wall_seconds = time.perf_counter() - t0
+        self.report.wall_seconds = (
+            time.perf_counter() - getattr(self, "_t0", time.perf_counter()))
         self.report.final_pending = self._pending_count()
         self.report.hotspots_open = len(self._hotspots)
         self.report.faults_injected = len(self.plan.injected)
-        self.report.sidecar_fallbacks = self.sched.sidecar_fallbacks
-        self.report.ladder_transitions = list(self.sched.ladder.transitions)
+        self.report.sidecar_fallbacks = (
+            self._prior_sidecar_fallbacks + self.sched.sidecar_fallbacks)
+        self.report.ladder_transitions = (
+            self._prior_transitions + list(self.sched.ladder.transitions))
         self.report.final_level = self.sched.ladder.level_name
-        self.report.flight_dumps = self.sched.flight.dumps
+        self.report.flight_dumps = (
+            self._prior_flight_dumps + self.sched.flight.dumps)
+        overruns = (self._prior_deadline_overruns
+                    + self.sched.dispatch_watchdog.overruns)
+        if self.desch is not None and self.desch.rebalancer is not None:
+            overruns += self.desch.rebalancer.dispatch_watchdog.overruns
+        self.report.deadline_overruns = overruns
         return self.report
 
 

@@ -53,6 +53,18 @@ def check_invariants(store: ObjectStore,
         if over.any():
             breaches.append(
                 f"node {name} overcommitted: {total[over]} > {alloc[over]}")
+        # 1b. pod-count axis: requests vectors carry no pods term (the
+        # kernel adds the +1-per-pod via with_pod_count), so the axis
+        # check above cannot see pod-count overcommit — count directly.
+        # Matters across a crash-restart boundary, where the fresh
+        # scheduler re-derives every per-node sum from the store.
+        from koordinator_tpu.api.resources import RESOURCE_INDEX, ResourceName
+
+        pods_cap = float(alloc[RESOURCE_INDEX[ResourceName.PODS]])
+        if pods_cap > 0 and len(plist) > pods_cap:
+            breaches.append(
+                f"node {name} exceeds its pod capacity: "
+                f"{len(plist)} > {pods_cap:g}")
         # 2. hostPorts: no (protocol, port) bound twice
         seen = set()
         for p in plist:

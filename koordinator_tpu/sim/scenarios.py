@@ -64,6 +64,13 @@ class Scenario:
     # backpressure
     queue_cap: int = 512          # max pending pods admitted to the store
     overflow_cap: int = 2048      # waiting-room bound; beyond it -> shed
+    # koordguard: scheduler crash-restart events (the harness tears the
+    # Scheduler down at these cycles and rebuilds it against the
+    # surviving store) and the recovery SLO; dispatch deadline in ms
+    # (None pins it OFF for determinism — latency faults need it armed)
+    restart_at: Tuple[int, ...] = ()
+    restart_slo_seconds: float = 0.0   # 0 = report-only
+    dispatch_deadline_ms: Optional[float] = None
     # SLOs
     ttb_slo_seconds: float = 120.0  # time-to-bind p99 target
     # scheduler configuration under test
@@ -241,17 +248,57 @@ _register(Scenario(
 _register(Scenario(
     name="fault-ladder",
     description=(
-        "robustness proof: mesh + fused waves + explain all on, a "
-        "dispatch-fault storm deep enough to walk the full ladder "
-        "(mesh -> single-device -> serial -> no-explain -> host "
-        "fallback), then clean cycles to re-promote — the deterministic "
-        "seeded scenario the acceptance test pins"),
-    seed=13, cycles=60, nodes=8,
+        "robustness proof (koordguard): mesh + fused waves + explain "
+        "all on with a dispatch deadline armed; an attributable device "
+        "loss lands the ladder on partial-mesh (surviving submesh), a "
+        "slow-not-dead device (latency > deadline) demotes via the "
+        "watchdog instead of wedging, a dispatch-fault storm walks the "
+        "rest of the ladder to the host fallback, a crash-restart "
+        "tears the scheduler down against the surviving store, and "
+        "clean cycles re-promote after each — the deterministic seeded "
+        "scenario the acceptance test pins"),
+    seed=13, cycles=72, nodes=8,
     arrival_rate=4.0, departure_rate=1.0,
     queue_cap=128,
     ttb_slo_seconds=300.0,
     waves=4, explain="counts", mesh=2,
+    dispatch_deadline_ms=150.0,
+    restart_at=(58,),
+    restart_slo_seconds=60.0,
     promote_after=5,
-    faults=(Fault(cycle=10, kind="dispatch", count=8,
-                  message="ladder walk fault storm"),),
+    faults=(
+        # one mesh device named dead -> partial-mesh (1-device submesh)
+        Fault(cycle=8, kind="device_loss", count=2, devices=(1,),
+              message="ICI link down"),
+        # slow-not-dead device: the monitored sync overruns the 150ms
+        # deadline twice (retry, then demote) — 600ms clears it with
+        # margin on any CI box
+        Fault(cycle=22, kind="latency", count=2, delay_ms=600.0,
+              message="slow-not-dead device"),
+        # anonymous fault storm: walks the remaining rungs to host
+        Fault(cycle=34, kind="dispatch", count=8,
+              message="ladder walk fault storm"),
+    ),
+))
+
+_register(Scenario(
+    name="crash-restart",
+    description=(
+        "koordguard recovery gate: light churn with gangs and quota "
+        "pods, then the scheduler crash-restarts mid-soak — device "
+        "state, step caches and the pack memo all drop, the fresh "
+        "scheduler replays list-then-watch from the surviving store, "
+        "re-derives assumed/quota/gang state from store-visible binds, "
+        "and must reach its first bind inside the restart SLO with "
+        "zero double-booking breaches across the boundary — fixed "
+        "seed, byte-stable binding log (hack/lint.sh runs it twice)"),
+    seed=19, cycles=36, nodes=10, initial_pods=30,
+    arrival_rate=5.0, departure_rate=2.0, be_fraction=0.3,
+    gang_every=7, gang_size=3, gang_lifetime=18,
+    quota_rebalance_every=11,
+    queue_cap=192,
+    ttb_slo_seconds=240.0,
+    restart_at=(16,),
+    restart_slo_seconds=30.0,
+    promote_after=6,
 ))

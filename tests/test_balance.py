@@ -332,6 +332,98 @@ class TestRebalanceLadder:
 
 
 # ---------------------------------------------------------------------------
+# koordguard: the rebalance pass under the shared dispatch deadline
+# ---------------------------------------------------------------------------
+
+class TestRebalanceDeadline:
+    def test_slow_rebalance_walks_ladder_to_host_oracle(self):
+        """The rebalance pass shares the koordguard deadline wrapper: a
+        slow-not-dead rebalance dispatch overruns the monitored sync,
+        dumps its OWN dispatch_deadline flight bundle, walks the
+        rebalance ladder to the host oracle (decision-identical), and
+        clean passes re-promote back to the device engine."""
+        import time as _time
+
+        from koordinator_tpu.obs.flight import load_bundle
+        from koordinator_tpu.scheduler import (
+            metrics as scheduler_metrics,
+        )
+        from koordinator_tpu.scheduler.degrade import (
+            LEVEL_FULL,
+            LEVEL_HOST_FALLBACK,
+        )
+
+        store = _seeded_world(seed=7, nodes=8, pods=60)
+        plugin = LowNodeLoad(store)
+        reb = DeviceRebalancer(promote_after=2, dispatch_deadline_ms=50.0)
+        assert reb.dispatch_deadline_seconds == 0.05
+        plugin.attach_device(reb)
+        host_expected = list(plugin.select_victims_host(
+            plugin._view(NOW)[0]))
+
+        budget = {"left": 2}  # retry-once + demote, one pass
+
+        def slow():
+            if budget["left"] > 0:
+                budget["left"] -= 1
+                _time.sleep(0.4)
+
+        reb.sync_delay_injector = slow
+        overruns0 = (scheduler_metrics.DISPATCH_DEADLINE_OVERRUNS.get(
+            path="rebalance") or 0.0)
+        dumps0 = reb.flight.dumps
+        picked, _src, _v = plugin.select_victims(now=NOW)
+        # the pass survived on the host oracle with identical decisions
+        assert plugin.last_pass_stats["engine"] == "host"
+        assert list(picked) == host_expected
+        assert reb.ladder.level == LEVEL_HOST_FALLBACK
+        assert reb.dispatch_watchdog.overruns == 2
+        assert (scheduler_metrics.DISPATCH_DEADLINE_OVERRUNS.get(
+            path="rebalance") or 0.0) - overruns0 == 2
+        # its OWN flight ring dumped with the dispatch_deadline reason
+        assert reb.flight.dumps == dumps0 + 2
+        body = reb.flight.dump("post")
+        _h, _records, errors = load_bundle(body.splitlines())
+        assert not errors, errors
+        # clean passes re-promote back to the device engine
+        plugin.select_victims(now=NOW)
+        plugin.select_victims(now=NOW)
+        picked2, _s, _v2 = plugin.select_victims(now=NOW)
+        assert reb.ladder.level == LEVEL_FULL
+        assert plugin.last_pass_stats["engine"] == "device"
+        assert list(picked2) == host_expected
+
+    def test_overrun_leaves_private_mirror_dropped_and_window_open(self):
+        """The abandoned pass must not re-arm donation under the slow
+        program: the privately-owned mirror is dropped (the next device
+        pass re-uploads through a fresh one) and the abandoned one's
+        dispatch window stays open."""
+        import time as _time
+
+        store = _seeded_world(seed=9, nodes=8, pods=60)
+        plugin = LowNodeLoad(store)
+        reb = DeviceRebalancer(promote_after=1, dispatch_deadline_ms=50.0)
+        plugin.attach_device(reb)
+        budget = {"left": 2}
+
+        def slow():
+            if budget["left"] > 0:
+                budget["left"] -= 1
+                _time.sleep(0.4)
+
+        reb.sync_delay_injector = slow
+        plugin.select_victims(now=NOW)  # overruns -> host fallback
+        assert not reb._own_snapshots  # abandoned mirror dropped
+        # recovery: the next device pass builds a fresh mirror and its
+        # dispatch window opens/closes cleanly
+        plugin.select_victims(now=NOW)
+        picked, _s, _v = plugin.select_victims(now=NOW)
+        assert plugin.last_pass_stats["engine"] == "device"
+        snap = reb._own_snapshots.get(False)
+        assert snap is not None and snap._in_flight == 0
+
+
+# ---------------------------------------------------------------------------
 # knob + surfaces
 # ---------------------------------------------------------------------------
 

@@ -16,6 +16,7 @@ from koordinator_tpu.scheduler import metrics as scheduler_metrics
 from koordinator_tpu.scheduler.cycle import Scheduler
 from koordinator_tpu.scheduler.degrade import (
     LEVEL_HOST_FALLBACK,
+    LEVEL_NO_MESH,
     DegradationLadder,
 )
 from koordinator_tpu.scheduler.pipeline_parity import build_store_from_state
@@ -137,7 +138,7 @@ def test_degradation_dump_carries_prior_cycles(cpu_devices):
     before = sched.flight.dumps
     res = sched.run_cycle(now=state.now + 10)  # retry fails -> demote, succeeds
     assert res.duration_seconds > 0
-    assert sched.ladder.level == 1  # no-mesh
+    assert sched.ladder.level == LEVEL_NO_MESH
     assert sched.flight.dumps == before + 1
     body = sched.flight.dump("post")
     header, records, errors = load_bundle(body.splitlines())

@@ -1,0 +1,286 @@
+"""koordguard scheduler-level pins: dispatch deadlines and OOM-shaped
+upload failures.
+
+The sim-level walks (partial-mesh survival, the fault-ladder scenario,
+crash-restart recovery) live in tests/test_sim.py; this file pins the
+mechanisms directly against a Scheduler:
+
+  * a slow-not-dead device (sync-delay injection past the armed
+    KOORD_TPU_DISPATCH_DEADLINE_MS) demotes the ladder WITHIN the same
+    cycle instead of wedging it, with the overrun counter, the
+    ``dispatch_deadline`` flight dump, and a rebuilt device mirror;
+  * with no deadline configured the sync path is inline and untouched;
+  * a RESOURCE_EXHAUSTED-shaped upload failure is classified as a
+    ladder-demotable device fault (snapshot_cache.DeviceAllocationError)
+    — never a cycle exception — and the donation/double-buffer guard
+    re-arms cleanly afterwards.
+"""
+
+import time
+
+from koordinator_tpu.scheduler import metrics as scheduler_metrics
+from koordinator_tpu.scheduler.cycle import Scheduler
+from koordinator_tpu.scheduler.deadline import (
+    DeadlineWatchdog,
+    DispatchDeadlineExceeded,
+    deadline_seconds_from,
+)
+from koordinator_tpu.scheduler.degrade import (
+    LEVEL_FULL,
+    LEVEL_HOST_FALLBACK,
+)
+from koordinator_tpu.scheduler.pipeline_parity import build_store_from_state
+from koordinator_tpu.testing import synth_full_cluster
+
+NOW = 1_000_000.0
+
+
+def make_world(nodes=8, pods=24, seed=9):
+    _cluster, state = synth_full_cluster(
+        nodes, pods, seed=seed, num_quotas=0, num_gangs=0)
+    return state, build_store_from_state(state)
+
+
+def _dump_reason_count(reason: str) -> float:
+    return scheduler_metrics.FLIGHT_DUMPS.get(reason=reason) or 0.0
+
+
+def _overruns(path: str) -> float:
+    return (scheduler_metrics.DISPATCH_DEADLINE_OVERRUNS.get(path=path)
+            or 0.0)
+
+
+# ---------------------------------------------------------------------------
+# the watchdog itself
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlineWatchdog:
+    def test_no_deadline_runs_inline(self):
+        wd = DeadlineWatchdog(None)
+        import threading
+
+        main = threading.current_thread()
+        seen = {}
+
+        def fn():
+            seen["thread"] = threading.current_thread()
+            return 42
+
+        assert wd.run(fn, "serial") == 42
+        assert seen["thread"] is main  # no worker was spawned
+        assert wd.overruns == 0
+
+    def test_result_and_exception_pass_through_in_time(self):
+        wd = DeadlineWatchdog(5.0)
+        assert wd.run(lambda: "ok", "serial") == "ok"
+        try:
+            wd.run(lambda: (_ for _ in ()).throw(ValueError("boom")),
+                   "serial")
+        except ValueError as exc:
+            assert "boom" in str(exc)
+        else:
+            raise AssertionError("worker exception was swallowed")
+        assert wd.overruns == 0
+
+    def test_overrun_raises_and_counts(self):
+        fired = []
+        wd = DeadlineWatchdog(0.05, on_overrun=fired.append)
+        t0 = time.perf_counter()
+        try:
+            wd.run(lambda: time.sleep(2.0), "fused")
+        except DispatchDeadlineExceeded as exc:
+            assert exc.path == "fused"
+        else:
+            raise AssertionError("overrun did not raise")
+        # the caller escaped LONG before the slow sync finished
+        assert time.perf_counter() - t0 < 1.0
+        assert wd.overruns == 1
+        assert fired == ["fused"]
+
+    def test_env_resolution(self):
+        assert deadline_seconds_from(250.0) == 0.25
+        assert deadline_seconds_from(0) is None
+        assert deadline_seconds_from(-5) is None
+
+
+# ---------------------------------------------------------------------------
+# slow-not-dead device against the real Scheduler
+# ---------------------------------------------------------------------------
+
+
+def test_slow_device_demotes_within_one_cycle():
+    """The acceptance pin: latency injection past the deadline triggers
+    demotion within ONE cycle instead of hanging — the monitored sync
+    overruns twice (retry-once policy), the ladder demotes, the
+    dispatch re-runs at the demoted rung and the cycle still completes
+    with binds."""
+    state, store = make_world()
+    sched = Scheduler(store, waves=1, dispatch_deadline_ms=60.0)
+    assert sched.dispatch_deadline_seconds == 0.06
+    budget = {"n": 2}
+
+    def slow_sync():
+        if budget["n"] > 0:
+            budget["n"] -= 1
+            time.sleep(0.5)
+
+    sched.sync_delay_injector = slow_sync
+    overruns0 = _overruns("serial")
+    dumps0 = _dump_reason_count("dispatch_deadline")
+    snap_before = sched.device_snapshot
+    t0 = time.perf_counter()
+    result = sched.run_cycle(now=state.now)
+    wall = time.perf_counter() - t0
+    # the cycle COMPLETED (no wedge, no exception) and still bound pods
+    # through the demoted path
+    assert result.bound
+    # no mesh/waves/explain configured: the only demotion target is the
+    # host fallback — demoted within the same cycle
+    assert sched.ladder.level == LEVEL_HOST_FALLBACK
+    assert _overruns("serial") - overruns0 == 2
+    assert _dump_reason_count("dispatch_deadline") - dumps0 == 2
+    # the abandoned windows rebuilt the device mirror: donation can
+    # never re-arm under the still-running syncs
+    assert sched.device_snapshot is not snap_before
+    assert wall < 5.0  # two ~60ms overruns, not two 500ms sleeps... and
+    #                    definitely not a hang
+
+
+def test_no_deadline_means_no_watchdog_and_no_overruns():
+    state, store = make_world(seed=11)
+    sched = Scheduler(store)  # env unset in tests -> deadline off
+    assert sched.dispatch_deadline_seconds is None
+    result = sched.run_cycle(now=state.now)
+    assert result.bound
+    assert sched.dispatch_watchdog.overruns == 0
+    assert sched.ladder.level == LEVEL_FULL
+
+
+# ---------------------------------------------------------------------------
+# OOM-shaped upload failures (satellite: RESOURCE_EXHAUSTED classification)
+# ---------------------------------------------------------------------------
+
+
+def test_oom_upload_is_a_ladder_fault_and_guard_rearms():
+    """A RESOURCE_EXHAUSTED-raising upload is a DEVICE fault: the
+    ladder retries (one transient OOM -> same-level retry succeeds, no
+    demotion), the cycle never raises, and the donation/double-buffer
+    guard re-arms cleanly — the next cycles' scatters run donated
+    again."""
+    state, store = make_world(seed=13)
+    sched = Scheduler(store)
+    assert sched.device_snapshot is not None
+    budget = {"n": 1}
+
+    def oom(field):
+        if budget["n"] > 0:
+            budget["n"] -= 1
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: out of memory allocating "
+                f"device buffer for {field}")
+
+    sched.upload_fault_injector = oom
+    retries0 = (scheduler_metrics.DISPATCH_RETRIES.get(stage="serial")
+                or 0.0)
+    result = sched.run_cycle(now=state.now)
+    assert result.bound  # the retry re-uploaded and the cycle bound
+    assert sched.ladder.level == LEVEL_FULL  # one retry, no demotion
+    assert (scheduler_metrics.DISPATCH_RETRIES.get(stage="serial")
+            or 0.0) == retries0 + 1
+    # the dispatch window closed cleanly: the guard re-armed
+    assert sched.device_snapshot._in_flight == 0
+    sched.run_cycle(now=state.now + 5)
+    assert sched.device_snapshot._in_flight == 0
+
+
+def test_oom_upload_classified_in_transition_reason():
+    """Two OOM attempts exhaust the retry and demote: the transition
+    record names DeviceAllocationError — the classified device fault,
+    not a bare cycle exception."""
+    state, store = make_world(seed=17)
+    sched = Scheduler(store, waves=1)
+    budget = {"n": 2}
+
+    def oom(field):
+        if budget["n"] > 0:
+            budget["n"] -= 1
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+    sched.upload_fault_injector = oom
+    result = sched.run_cycle(now=state.now)
+    assert result.bound  # host fallback still binds plain pods
+    assert sched.ladder.level == LEVEL_HOST_FALLBACK
+    assert "DeviceAllocationError" in sched.ladder.transitions[-1]["reason"]
+    # recovery: clean cycles re-promote and the device path resumes
+    for i in range(1, 20):
+        sched.run_cycle(now=state.now + 5 * i)
+        if sched.ladder.level == LEVEL_FULL:
+            break
+    assert sched.ladder.level == LEVEL_FULL
+    assert sched.device_snapshot._in_flight == 0
+
+
+# ---------------------------------------------------------------------------
+# partial-mesh shrink in place (end-to-end through the dispatch window)
+# ---------------------------------------------------------------------------
+
+
+def test_partial_mesh_shrinks_in_place_on_second_loss(cpu_devices):
+    """A second device loss while already ON a submesh sheds only the
+    newly-named device: 8 -> lose {6,7} -> 6-device submesh -> lose {5}
+    -> 5-device submesh, still at the partial-mesh rung (a same-level
+    shrink), binds continuing throughout — never a collapse to
+    single-device."""
+    from koordinator_tpu.api.objects import ObjectMeta, Pod, PodSpec
+    from koordinator_tpu.api.resources import ResourceList
+    from koordinator_tpu.client.store import KIND_POD
+    from koordinator_tpu.scheduler.degrade import LEVEL_PARTIAL_MESH
+
+    state, store = make_world(seed=23)
+    sched = Scheduler(store, mesh=8, waves=1)
+
+    def lose(ids, budget):
+        holder = {"n": budget}
+
+        def hook(stage):
+            if holder["n"] > 0:
+                holder["n"] -= 1
+                exc = RuntimeError(f"ICI link down on {ids}")
+                exc.failed_device_ids = ids
+                raise exc
+        return hook
+
+    sched.fault_injector = lose((6, 7), 2)
+    sched.run_cycle(now=state.now)
+    assert sched.ladder.level == LEVEL_PARTIAL_MESH
+    assert sched.mesh.devices.size == 6
+    for i in range(4):  # fresh pending pods for the next dispatches
+        store.add(KIND_POD, Pod(
+            meta=ObjectMeta(name=f"fresh-{i}", namespace="t",
+                            uid=f"fresh-{i}",
+                            creation_timestamp=state.now + 1),
+            spec=PodSpec(requests=ResourceList.of(cpu=200,
+                                                  memory=1 << 28))))
+    sched.fault_injector = lose((5,), 2)
+    result = sched.run_cycle(now=state.now + 5)
+    # same rung, smaller mesh: the shrink never collapsed to no-mesh
+    assert sched.ladder.level == LEVEL_PARTIAL_MESH
+    assert sched.mesh.devices.size == 5
+    assert sorted(d.id for d in sched.mesh.devices.flat) == [0, 1, 2, 3, 4]
+    assert result.bound
+    last = sched.ladder.transitions[-1]
+    assert (last["from"], last["to"]) == ("partial-mesh", "partial-mesh")
+
+
+def test_resource_exhausted_classifier():
+    from koordinator_tpu.scheduler.snapshot_cache import (
+        DeviceAllocationError,
+        _is_resource_exhausted,
+    )
+
+    assert _is_resource_exhausted(
+        RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+    assert _is_resource_exhausted(MemoryError("Out of memory while ..."))
+    assert not _is_resource_exhausted(RuntimeError("shape mismatch"))
+    assert issubclass(DeviceAllocationError, RuntimeError)

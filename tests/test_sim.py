@@ -23,10 +23,12 @@ from koordinator_tpu.scheduler.degrade import (
     LEVEL_HOST_FALLBACK,
     LEVEL_NO_EXPLAIN,
     LEVEL_NO_MESH,
+    LEVEL_PARTIAL_MESH,
     LEVEL_SERIAL_WAVES,
     DegradationLadder,
 )
 from koordinator_tpu.sim import (
+    DeviceLossFault,
     Fault,
     FaultPlan,
     InjectedFault,
@@ -34,7 +36,7 @@ from koordinator_tpu.sim import (
     SCENARIOS,
     check_invariants,
 )
-from koordinator_tpu.sim.harness import run_scenario
+from koordinator_tpu.sim.harness import ChurnSimulator, run_scenario
 
 ALL_FEATURES = {"mesh": True, "waves": True, "explain": True}
 NO_FEATURES = {"mesh": False, "waves": False, "explain": False}
@@ -86,8 +88,10 @@ class TestDegradationLadder:
             ladder.note_cycle()
             levels.append(ladder.level)
         # note 1 retires the failed cycle (not clean), then every 3 clean
-        # cycles climb one rung
-        assert levels == [4, 4, 4, 3, 3, 3, 2, 2, 2, 1, 1, 1, 0]
+        # cycles climb one rung; the final climb from no-mesh skips the
+        # partial-mesh rung (no attributable failure engaged it) straight
+        # to full
+        assert levels == [5, 5, 5, 4, 4, 4, 3, 3, 3, 2, 2, 2, 0]
 
     def test_failed_probe_doubles_the_backoff(self):
         ladder = DegradationLadder(promote_after=2, max_promote_after=8)
@@ -142,6 +146,53 @@ class TestDegradationLadder:
         ladder.note_cycle()
         assert ladder.level == LEVEL_HOST_FALLBACK  # only 1 clean so far
         ladder.note_cycle()
+        assert ladder.level == LEVEL_FULL
+
+    # ---- koordguard: the partial-mesh rung ---------------------------
+    def test_attributable_failure_takes_the_partial_mesh_rung(self):
+        ladder = DegradationLadder(promote_after=4)
+        ladder.begin_pass()
+        feats = dict(ALL_FEATURES, partial_mesh=True)
+        assert ladder.on_failure(feats, error="dev 3 down") == "retry"
+        assert ladder.on_failure(feats, error="dev 3 down") == "demoted"
+        assert ladder.level == LEVEL_PARTIAL_MESH
+        # a later ANONYMOUS fault cannot pick survivors: it skips past
+        # partial-mesh to no-mesh
+        ladder.begin_pass()
+        ladder.on_failure(ALL_FEATURES)
+        assert ladder.on_failure(ALL_FEATURES) == "demoted"
+        assert ladder.level == LEVEL_NO_MESH
+
+    def test_partial_mesh_shrinks_in_place_on_new_loss(self):
+        ladder = DegradationLadder(promote_after=4)
+        ladder.begin_pass()
+        feats = dict(ALL_FEATURES, partial_mesh=True)
+        ladder.on_failure(feats)
+        ladder.on_failure(feats)
+        assert ladder.level == LEVEL_PARTIAL_MESH
+        # a NEW attributable loss while already partial sheds more
+        # devices at the same rung (same-level transition) instead of
+        # dropping the whole mesh
+        ladder.begin_pass()
+        shrink = dict(feats, partial_mesh_shrink=True)
+        ladder.on_failure(shrink)
+        assert ladder.on_failure(shrink) == "demoted"
+        assert ladder.level == LEVEL_PARTIAL_MESH
+        last = ladder.transitions[-1]
+        assert (last["from"], last["to"]) == ("partial-mesh",
+                                              "partial-mesh")
+
+    def test_promotion_from_partial_mesh_probes_full(self):
+        ladder = DegradationLadder(promote_after=2)
+        ladder.begin_pass()
+        feats = dict(ALL_FEATURES, partial_mesh=True)
+        ladder.on_failure(feats)
+        ladder.on_failure(feats)
+        assert ladder.level == LEVEL_PARTIAL_MESH
+        for _ in range(3):  # failed cycle + 2 clean
+            ladder.note_cycle()
+        # the probe goes straight to FULL (the owner clears its lost set
+        # and re-probes the whole configured mesh)
         assert ladder.level == LEVEL_FULL
 
 
@@ -229,47 +280,149 @@ def test_smoke_scenario_is_deterministic():
     assert a.pods_created == b.pods_created
 
 
-def test_fault_ladder_walks_mesh_to_host_and_repromotes(cpu_devices):
-    """The acceptance pin: with mesh + fused waves + explain all on and
-    a dispatch-fault storm mid-soak, the scheduler demotes mesh ->
-    single-device -> serial -> no-explain -> host fallback, KEEPS
-    binding pods with zero invariant breaches, records every transition
-    (flight recorder + gauge), and re-promotes to full after N clean
-    cycles."""
+def test_fault_ladder_walks_koordguard_rungs(cpu_devices):
+    """The koordguard acceptance pin: with mesh + fused waves + explain
+    on and a dispatch deadline armed, (1) a device loss NAMING its dead
+    device lands the ladder on partial-mesh (the surviving submesh,
+    still a mesh dispatch) and re-promotes to the FULL mesh after clean
+    cycles; (2) a slow-not-dead device (latency injection > deadline)
+    demotes via the watchdog within one cycle instead of wedging;
+    (3) an anonymous fault storm still walks the remaining rungs to the
+    host fallback — binding pods throughout with zero invariant
+    breaches, every transition flight-dumped."""
     from koordinator_tpu.scheduler import metrics as scheduler_metrics
 
-    sc = dataclasses.replace(SCENARIOS["fault-ladder"], cycles=35)
+    base = scheduler_metrics.DISPATCH_DEADLINE_OVERRUNS
+    overruns0 = sum(v for _l, v in base.samples()) if base.samples() else 0
+    sc = dataclasses.replace(SCENARIOS["fault-ladder"], cycles=42)
     report = run_scenario(sc)
     assert report.invariant_breaches == []
     assert report.cycle_exceptions == []
     walk = [(t["from"], t["to"]) for t in report.ladder_transitions]
     assert walk[:4] == [
+        # cycle 8: attributable loss -> the partial-mesh rung, then the
+        # full-mesh probe succeeds after 5 clean cycles
+        ("full", "partial-mesh"),
+        ("partial-mesh", "full"),
+        # cycle 22: deadline overrun (slow-not-dead) — anonymous, so it
+        # skips partial-mesh; demoted within the SAME cycle, then back
+        ("full", "no-mesh"),
+        ("no-mesh", "full"),
+    ]
+    # cycle 34: the anonymous storm walks the rest of the ladder down
+    assert walk[4:8] == [
         ("full", "no-mesh"),
         ("no-mesh", "serial-waves"),
         ("serial-waves", "no-explain"),
         ("no-explain", "host-fallback"),
     ]
-    # re-promotion probes climb back rung by rung to full
-    assert walk[4:] == [
-        ("host-fallback", "no-explain"),
-        ("no-explain", "serial-waves"),
-        ("serial-waves", "no-mesh"),
-        ("no-mesh", "full"),
-    ]
-    assert report.final_level == "full"
-    assert scheduler_metrics.DEGRADED_LEVEL.get() == 0.0
-    # every rung was lived in AND pods bound while degraded
-    for level in ("no-mesh", "serial-waves", "no-explain", "host-fallback"):
+    # the slow-device demotion came from the WATCHDOG: two monitored
+    # syncs overran (retry, then demote) — the cycle never wedged
+    assert report.deadline_overruns == 2
+    overruns1 = sum(
+        v for _l, v in scheduler_metrics.DISPATCH_DEADLINE_OVERRUNS.samples())
+    assert overruns1 - overruns0 == 2
+    # every koordguard rung was lived in AND pods bound while degraded
+    for level in ("partial-mesh", "no-mesh", "host-fallback"):
         assert report.cycles_at_level.get(level, 0) > 0, level
-    degraded = {c for c in range(10, 30)}
+    degraded = {c for c in range(8, 14)} | {c for c in range(34, 40)}
     assert any(int(line.split("\t")[0]) in degraded
                for line in report.binding_log)
-    # one flight dump per transition, and the retry counters moved
     assert report.flight_dumps >= len(report.ladder_transitions)
     retries = dict(
         (labels["stage"], v)
         for labels, v in scheduler_metrics.DISPATCH_RETRIES.samples())
     assert retries.get("fused", 0) + retries.get("serial", 0) >= 8
+
+
+def test_partial_mesh_survives_losing_two_of_eight_devices(cpu_devices):
+    """The acceptance pin for partial-mesh survival: an 8-device mesh
+    loses 2 named devices -> the ladder lands on partial-mesh with the
+    6 SURVIVING devices, binds continue on the submesh, decisions are
+    byte-identical to a fault-free single-device twin (mesh parity =
+    the host-oracle-grade reference), and clean cycles re-promote to
+    the full 8-device mesh."""
+    import dataclasses as dc
+
+    sc = Scenario(
+        name="partial-mesh-8to6", seed=29, cycles=16, nodes=8,
+        arrival_rate=5.0, departure_rate=1.0, queue_cap=96,
+        ttb_slo_seconds=600.0, mesh=8, promote_after=4,
+        faults=(Fault(cycle=4, kind="device_loss", count=2,
+                      devices=(6, 7), message="two chips lost"),))
+    sim = ChurnSimulator(sc)
+    sizes = {}
+    for cycle in range(sc.cycles):
+        sim._run_one_cycle(cycle)
+        mesh = sim.sched.mesh
+        sizes[cycle] = mesh.devices.size if mesh is not None else 0
+    report = sim.run_report()
+    assert report.invariant_breaches == []
+    assert report.cycle_exceptions == []
+    # before the loss: 8 devices; after: exactly the 6 survivors
+    assert sizes[3] == 8
+    assert sizes[4] == 6
+    walk = [(t["from"], t["to"]) for t in report.ladder_transitions]
+    assert walk[0] == ("full", "partial-mesh")
+    assert ("partial-mesh", "full") in walk  # the full mesh came back
+    assert sizes[sc.cycles - 1] == 8
+    # binds continued WHILE on the submesh
+    partial_window = {c for c in range(4, 9)}
+    assert any(int(line.split("\t")[0]) in partial_window
+               for line in report.binding_log)
+    # submesh parity: the same scenario minus the fault, single-device,
+    # produces a byte-identical binding log (mesh size never changes
+    # decisions — the submesh inherits the proven mesh-parity property)
+    twin = run_scenario(dc.replace(sc, mesh=None, faults=()))
+    assert twin.binding_log == report.binding_log
+
+
+def test_crash_restart_meets_slo_with_clean_invariants():
+    """The acceptance pin for crash-restart recovery: the scheduler is
+    torn down mid-soak (device state, step caches, pack memo all
+    dropped; its store watches severed), a fresh scheduler against the
+    surviving store re-derives assumed/quota/gang state from
+    store-visible binds, meets the restart-to-first-bind SLO, and the
+    double-booking/capacity/gang invariants hold across the boundary."""
+    from koordinator_tpu.client.store import KIND_POD
+
+    sc = SCENARIOS["crash-restart"]
+    sim = ChurnSimulator(sc)
+    for cycle in range(sc.cycles):
+        sim._run_one_cycle(cycle)
+        if cycle == sc.restart_at[0]:
+            # the fresh scheduler re-derived gang state from the store:
+            # its assumed counts equal the store-visible bound members
+            gang = sim.sched.extender.plugin("Coscheduling")
+            bound = {}
+            for p in sim.store.list(KIND_POD):
+                if p.gang_key and p.is_assigned and not p.is_terminated:
+                    bound[p.gang_key] = bound.get(p.gang_key, 0) + 1
+            for name, count in bound.items():
+                assert gang.assumed.get(name, 0) == count, name
+    report = sim.run_report()
+    assert report.invariant_breaches == []
+    assert report.cycle_exceptions == []
+    assert report.restarts == 1
+    rd = report.to_dict()["restart"]
+    assert rd["met"], rd
+    assert rd["to_first_bind_seconds"]["count"] == 1
+    assert rd["to_first_bind_seconds"]["p99"] <= sc.restart_slo_seconds
+    # bindings happened on BOTH sides of the boundary
+    cycles_bound = {int(line.split("\t")[0]) for line in report.binding_log}
+    assert any(c < sc.restart_at[0] for c in cycles_bound)
+    assert any(c >= sc.restart_at[0] for c in cycles_bound)
+    # the dead scheduler's watches were severed: its snapshot cache no
+    # longer receives events from the surviving store
+    assert sim.sched_store._subs  # the LIVE scheduler's watches remain
+
+
+def test_crash_restart_scenario_is_deterministic():
+    sc = dataclasses.replace(SCENARIOS["crash-restart"], cycles=22)
+    a = run_scenario(sc)
+    b = run_scenario(sc)
+    assert a.binding_log == b.binding_log
+    assert a.restarts == b.restarts == 1
 
 
 def test_store_write_fault_dumps_and_recovers():

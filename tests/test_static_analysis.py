@@ -947,6 +947,97 @@ class TestStoreWriteInWaveReplayLoop:
             "flush sites (pragma'd update_many / deferred flush)")
 
 
+class TestNakedDeviceSyncWithoutDeadline:
+    RULE = "naked-device-sync-without-deadline"
+
+    def test_positive_block_until_ready_in_dispatch_dirs(self):
+        src = """
+            import jax
+
+            def drain(rows):
+                jax.block_until_ready(rows.count)
+        """
+        for path in ("koordinator_tpu/scheduler/cycle.py",
+                     "koordinator_tpu/parallel/mesh.py",
+                     "koordinator_tpu/balance/rebalancer.py"):
+            out = findings_for(src, self.RULE, path=path)
+            assert len(out) == 1 and "watchdog" in out[0].message, path
+
+    def test_positive_inline_asarray_in_readback_span(self):
+        src = """
+            import numpy as np
+
+            def _device_pass(self, out):
+                with self.tracer.span("readback"):
+                    sel = np.asarray(out.sel_pod)
+        """
+        out = findings_for(src, self.RULE,
+                           path="koordinator_tpu/balance/rebalancer.py")
+        assert len(out) == 1 and "deadline watchdog" in out[0].message
+
+    def test_negative_monitored_closure_outside_span(self):
+        # the blessed shape: the sync body is a closure handed to the
+        # watchdog; only the monitored call sits in the span
+        src = """
+            import numpy as np
+
+            def _device_pass(self, out):
+                def sync_readback():
+                    return np.asarray(out.sel_pod)
+
+                with self.tracer.span("readback"):
+                    sel = self.dispatch_watchdog.run(sync_readback,
+                                                     "rebalance")
+        """
+        assert findings_for(
+            src, self.RULE,
+            path="koordinator_tpu/balance/rebalancer.py") == []
+
+    def test_negative_pragma_and_other_dirs(self):
+        src = """
+            import jax
+
+            def drain(rows):
+                # koordlint: disable=naked-device-sync-without-deadline
+                jax.block_until_ready(rows.count)
+        """
+        assert findings_for(
+            src, self.RULE,
+            path="koordinator_tpu/scheduler/cycle.py") == []
+        src2 = """
+            import jax
+
+            def wait(x):
+                jax.block_until_ready(x)
+        """
+        assert findings_for(src2, self.RULE,
+                            path="koordinator_tpu/models/fused_waves.py") \
+            == []
+
+    def test_negative_jnp_asarray_in_readback_span(self):
+        src = """
+            import jax.numpy as jnp
+
+            def _device_pass(self, out):
+                with self.tracer.span("readback"):
+                    sel = jnp.asarray(out.sel_pod)
+        """
+        assert findings_for(
+            src, self.RULE,
+            path="koordinator_tpu/balance/rebalancer.py") == []
+
+    def test_shipped_dispatch_modules_are_clean(self):
+        for rel in (("scheduler", "cycle.py"),
+                    ("balance", "rebalancer.py"),
+                    ("parallel", "mesh.py")):
+            target = REPO_ROOT.joinpath("koordinator_tpu", *rel)
+            out = analyze_source(
+                source=target.read_text(),
+                path="koordinator_tpu/" + "/".join(rel),
+                rules={self.RULE: all_rules()[self.RULE]})
+            assert [f for f in out if f.rule == self.RULE] == [], rel
+
+
 class TestHostLoopInRebalancePath:
     RULE = "host-loop-in-rebalance-path"
     PATH = "koordinator_tpu/balance/victims.py"
