@@ -148,6 +148,15 @@ def main() -> None:
         "rebalance block, BENCH_NOTES convention)",
     )
     ap.add_argument(
+        "--colo", action="store_true",
+        help="koordcolo A/B: the overcommit-shift churn scenario run "
+        "with the DEVICE colo pass (KOORD_TPU_COLO=on) vs the host "
+        "oracle (=host) back-to-back — binding logs must be IDENTICAL "
+        "(the control-plane engine may not change a single decision), "
+        "with the batch-bind/staleness SLO report from both runs "
+        "(BENCH_NOTES convention)",
+    )
+    ap.add_argument(
         "--churn", default=None, metavar="SCENARIO",
         help="run a named koordsim churn scenario (python -m "
         "koordinator_tpu.sim --list) TWICE back-to-back in this process "
@@ -218,6 +227,10 @@ def main() -> None:
             args_cli.pods or (500 if args_cli.smoke else 10_000),
             args_cli.nodes or (50 if args_cli.smoke else 5_000),
         )
+        return
+
+    if args_cli.colo:
+        run_colo_ab(args_cli)
         return
 
     num_pods = args_cli.pods or (100 if args_cli.smoke else 10_000)
@@ -341,6 +354,84 @@ def main() -> None:
             }
         )
     )
+
+
+def run_colo_ab(args_cli) -> None:
+    """koordcolo A/B: the overcommit-shift scenario with the DEVICE colo
+    pass vs the host oracle, back to back in this process.
+
+    Unlike the same-config --churn pairs (noise floor), this pair flips
+    the CONTROL-PLANE ENGINE: run A computes batch/mid overcommit +
+    runtime quotas on device (KOORD_TPU_COLO=on, the third consumer of
+    the shared DeviceSnapshot), run B pins the retained host reconcilers
+    (=host). The binding logs must be byte-IDENTICAL — the engine may
+    not change a single scheduling decision (the run_colo_parity
+    property, re-proven under 160 cycles of churn) — and both runs must
+    hold 0 invariant breaches with the batch-bind discipline + the
+    metric-write-to-observing-dispatch staleness SLO met."""
+    import dataclasses
+
+    import jax
+
+    from koordinator_tpu.sim.harness import run_scenario
+    from koordinator_tpu.sim.scenarios import SCENARIOS
+
+    sc = SCENARIOS["overcommit-shift"]
+    if args_cli.churn_cycles is not None:
+        sc = dataclasses.replace(sc, cycles=args_cli.churn_cycles)
+    elif args_cli.smoke:
+        # keep at least one full surge+recede inside the smoke window
+        # (surge at overcommit_surge_every, recede +surge_cycles): a
+        # 30-cycle cap would never exercise an overcommit shift
+        floor = sc.overcommit_surge_every + sc.overcommit_surge_cycles + 8
+        sc = dataclasses.replace(sc, cycles=min(sc.cycles, max(30, floor)))
+    log(f"devices: {jax.devices()}")
+    log(f"config: colo A/B on scenario {sc.name!r} — {sc.cycles} "
+        f"cycles, {sc.nodes} nodes, seed {sc.seed}; run A = device colo "
+        "pass, run B = host oracle (decisions must be identical)")
+    reports = {}
+    for label, engine in (("A", "on"), ("B", "host")):
+        rep = run_scenario(dataclasses.replace(sc, colo=engine))
+        reports[label] = rep
+        colo = rep.to_dict()["colo"]
+        log(f"run {label} ({engine}): bound {rep.pods_bound} "
+            f"({colo['batch_pods_bound']} batch) in "
+            f"{rep.wall_seconds:.1f}s, manager rounds "
+            f"{colo['manager_rounds']} "
+            f"(device/host passes {colo['device_passes']}/"
+            f"{colo['host_passes']}), shifts "
+            f"{colo['overcommit_shifts']}, staleness p99 "
+            f"{colo['staleness_cycles']['p99']:.0f} cycles, "
+            f"{len(rep.invariant_breaches)} breaches")
+    a, b = reports["A"], reports["B"]
+    identical = a.binding_log == b.binding_log
+    log(f"binding logs {'IDENTICAL' if identical else 'DIVERGED'} "
+        f"across the engine pair (sha256 {a.binding_log_sha256[:16]})")
+    a_colo, b_colo = a.to_dict()["colo"], b.to_dict()["colo"]
+    pair = [round(r.pods_bound / max(r.wall_seconds, 1e-9), 1)
+            for r in (a, b)]
+    print(json.dumps({
+        "metric": "colo_bound_pods_per_sec_overcommit_shift",
+        "value": pair[0],
+        "unit": "pods/s",
+        "pair": pair,
+        "pair_ratio": round(pair[1] / pair[0], 3) if pair[0] else 0.0,
+        "scenario": sc.name,
+        "seed": sc.seed,
+        "cycles": sc.cycles,
+        "engine_pair": ["device", "host"],
+        "binding_logs_identical": identical,
+        "binding_log_sha256": a.binding_log_sha256,
+        "colo_device": a_colo,
+        "colo_host": b_colo,
+        "invariant_breaches": (len(a.invariant_breaches)
+                               + len(b.invariant_breaches)),
+        "staleness_slo_met": (a_colo["staleness_slo_met"]
+                              and b_colo["staleness_slo_met"]),
+        "ttb_p99_seconds": round(a.percentile(99), 3),
+        "ttb_slo_met": a.percentile(99) <= sc.ttb_slo_seconds,
+        "platform": jax.default_backend(),
+    }))
 
 
 def run_sim_churn(args_cli, scenario) -> None:

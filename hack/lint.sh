@@ -32,6 +32,13 @@ echo "== serial-vs-pipelined + fused-wave + explain + mesh cycle parity =="
 # the serial-replay twin at K in {1,2,4,8}; the env pin below makes the
 # fused-wave + mesh gates run WITH overlap enabled (both worlds), so
 # every parity property above holds under the overlap architecture too.
+# Also gates koordcolo (colo/): run_colo_parity runs the device
+# control-plane pass (slo-controller batch/mid overcommit + the
+# elastic-quota runtime fold as ONE jitted program over the shared
+# DeviceSnapshot) against the retained host oracles — batch/mid
+# allocatable vectors, degraded-node sets, runtime-quota matrices,
+# revoke-victim lists (order included) and binding logs must be
+# decision-identical at single-device and mesh 1/2/4/8.
 KOORD_TPU_REPLAY_OVERLAP=1 JAX_PLATFORMS=cpu \
     python -m koordinator_tpu.scheduler.pipeline_parity
 
@@ -71,6 +78,18 @@ echo "== koordsim crash-restart scenario (recovery determinism + invariants) =="
 # restart-to-first-bind SLO verdict rides the report JSON; bench.py
 # --churn fault-ladder is the citable wall-clock pair.
 KOORD_TPU_REPLAY_OVERLAP=1 JAX_PLATFORMS=cpu python -m koordinator_tpu.sim crash-restart \
+    --check-determinism --max-breaches 0 --quiet > /dev/null
+
+echo "== koordsim overcommit-shift scenario (colo closed loop) =="
+# koordcolo's soak gate: a co-located koord-manager recomputes batch/mid
+# overcommit on device every cycle while batch-class pods consume it and
+# prod-usage surges shrink/restore it mid-run. Run TWICE with
+# --check-determinism (byte-identical binding logs) and zero breaches —
+# the batch-bind discipline (new binds never exceed the CURRENT
+# overcommit) and the metric-write-to-observing-dispatch staleness SLO
+# both count as invariants here. The device-vs-host-oracle engine pair
+# (logs must also be identical ACROSS engines) is bench.py --colo.
+KOORD_TPU_REPLAY_OVERLAP=1 JAX_PLATFORMS=cpu python -m koordinator_tpu.sim overcommit-shift \
     --check-determinism --max-breaches 0 --quiet > /dev/null
 
 echo "lint OK"

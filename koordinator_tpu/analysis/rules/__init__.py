@@ -2,6 +2,7 @@
 
 from koordinator_tpu.analysis.rules import (  # noqa: F401
     balance,
+    colo,
     concurrency,
     jaxtrace,
     loops,

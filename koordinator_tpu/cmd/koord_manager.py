@@ -15,6 +15,7 @@ from koordinator_tpu.cmd import (
     build_store,
     parse_feature_gates,
     run_ticks,
+    serve_obs,
 )
 
 
@@ -24,14 +25,25 @@ def main(argv=None) -> int:
     add_loop_flags(ap, default_interval=15.0)
     ap.add_argument("--identity", default="koord-manager-0")
     ap.add_argument("--feature-gates", help="Gate=bool[,Gate=bool...]")
+    ap.add_argument("--obs-port", type=int, default=0,
+                    help="serve /metrics + /traces + /healthz (0 = off)")
     args = ap.parse_args(argv)
 
+    from koordinator_tpu import manager_metrics
     from koordinator_tpu.manager import Manager
     from koordinator_tpu.utils.features import MANAGER_GATES
 
     parse_feature_gates(MANAGER_GATES, args.feature_gates)
     store = build_store(args)
     mgr = Manager(store, identity=args.identity)
+    # /healthz carries the colo ladder snapshot under "degraded" and
+    # /debug/flightrecorder serves the colo flight ring (dumps on colo
+    # parity mismatch / dispatch-deadline overrun)
+    obs_server = serve_obs(
+        args.obs_port, manager_metrics.REGISTRY, "koord-manager",
+        tracer=mgr.tracer,
+        health_provider=mgr.health_snapshot,
+        flight=(mgr.colo.flight if mgr.colo is not None else None))
 
     def tick():
         leading = mgr.tick()
@@ -41,6 +53,8 @@ def main(argv=None) -> int:
                 f"changes={mgr.last_changes}", file=sys.stderr)
 
     run_ticks(tick, args.interval, args.max_ticks, "koord-manager")
+    if obs_server is not None:
+        obs_server.shutdown()
     mgr.stop()
     return 0
 

@@ -1,0 +1,19 @@
+"""koordcolo: the control plane's resource model on device.
+
+The THIRD consumer of the scheduler's DeviceSnapshot (after the
+dispatch kernels and the koordbalance descheduler pass): the
+slo-controller's batch/mid overcommit pipeline and the
+quota-controller's elastic-quota runtime fairness run as ONE jitted
+tensor program over packed state the SnapshotCache's existing store
+subscriptions maintain — closing the colocation loop (usage ->
+overcommit -> scheduling -> rebalance -> revoke) entirely on device,
+host-oracle parity-gated by ``pipeline_parity.run_colo_parity``.
+"""
+
+from koordinator_tpu.colo.pack import ColoPack  # noqa: F401
+from koordinator_tpu.colo.reconciler import (  # noqa: F401
+    COLO_NODE_FIELDS,
+    DeviceColoReconciler,
+    colo_from_env,
+)
+from koordinator_tpu.colo.step import ColoOut, build_colo_step  # noqa: F401

@@ -11,6 +11,19 @@ The webhook installs into the ObjectStore's admission-interceptor seam
 (`store.set_admission`) immediately at construction on every replica:
 admission is load-balanced across replicas in the reference too, so it is
 NOT election-gated.
+
+koordcolo (colo/): with ``KOORD_TPU_COLO=on`` (the default) the
+noderesource reconcile runs as the DEVICE colo pass — the slo-controller
+overcommit formula plus the elastic-quota runtime fold as one jitted
+program over the scheduler's shared DeviceSnapshot (the third consumer),
+ladder-protected with the retained host controllers as the fallback
+oracle. A co-located ``scheduler`` wires the pack into the
+SnapshotCache's existing subscriptions and the uploads into the
+scheduler's device mirror; standalone managers own both. ``host`` pins
+the host oracles (the A/B twin), ``off`` detaches the colo subsystem
+entirely. Every controller reconcile is instrumented in the shared obs
+Registry (manager_metrics) and the manager carries a Tracer + flight
+ring for the ``--obs-port`` surfaces.
 """
 
 from __future__ import annotations
@@ -18,8 +31,10 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional
 
+from koordinator_tpu import manager_metrics
 from koordinator_tpu.client.leaderelection import ElectedRunner, LeaderElector
 from koordinator_tpu.client.store import ObjectStore
+from koordinator_tpu.obs import Tracer
 from koordinator_tpu.quotacontroller import QuotaProfileController
 from koordinator_tpu.slocontroller import (
     NodeMetricController,
@@ -42,9 +57,15 @@ class Manager:
         identity: str = "koord-manager-0",
         config: Optional[ColocationConfig] = None,
         lease_duration_seconds: float = 15.0,
+        scheduler=None,
+        colo: Optional[str] = None,
     ) -> None:
+        from koordinator_tpu.colo.reconciler import colo_from_env
+
         self.store = store
         self.identity = identity
+        self.scheduler = scheduler
+        self.tracer = Tracer()
         self.webhook = AdmissionServer(store)
         # webhooks are served by every replica (leader or not)
         store.set_admission("koord-manager-webhook", self.webhook.admit)
@@ -54,6 +75,14 @@ class Manager:
             "nodeslo": NodeSLOController(store),
             "quotaprofile": QuotaProfileController(store),
         }
+        self.colo_mode = colo_from_env() if colo is None else colo
+        if self.colo_mode not in ("on", "off", "host"):
+            raise ValueError(
+                f"colo must be 'on', 'off' or 'host'; "
+                f"got {self.colo_mode!r}")
+        self.colo = None
+        if self.colo_mode != "off":
+            self.colo = self._build_colo()
         self.elector = LeaderElector(
             store, MANAGER_LEASE, identity,
             lease_duration_seconds=lease_duration_seconds)
@@ -61,22 +90,100 @@ class Manager:
         self.last_changes: Dict[str, int] = {}
         self.reconcile_rounds = 0
 
+    def _build_colo(self):
+        """Wire the DeviceColoReconciler: pack from the co-located
+        scheduler's SnapshotCache (one event stream, three consumers)
+        and uploads through its DeviceSnapshot, or standalone pack +
+        quota plugin when the manager runs alone. A co-located
+        reconciler inherits the scheduler's RESOLVED mesh and dispatch
+        deadline (the koordguard determinism discipline)."""
+        from koordinator_tpu.colo.pack import ColoPack
+        from koordinator_tpu.colo.reconciler import DeviceColoReconciler
+
+        controller = self.controllers["noderesource"]
+        config_source = controller.config_source
+        scheduler = self.scheduler
+        if scheduler is not None and scheduler.snapshot_cache is not None:
+            pack = scheduler.snapshot_cache.colo_pack(config_source)
+        else:
+            pack = ColoPack(self.store, config_source, subscribe=True)
+        quota_plugin = (scheduler.extender.plugin("ElasticQuota")
+                        if scheduler is not None else None)
+        if quota_plugin is None:
+            from koordinator_tpu.scheduler.plugins.elasticquota import (
+                ElasticQuotaPlugin,
+            )
+
+            quota_plugin = ElasticQuotaPlugin()
+            quota_plugin.register(self.store)
+        if scheduler is not None:
+            mesh = getattr(scheduler, "_configured_mesh", None)
+            getter = lambda: scheduler.device_snapshot  # noqa: E731
+            dl = getattr(scheduler, "dispatch_deadline_seconds", None)
+            deadline_ms = dl * 1000.0 if dl else 0
+        else:
+            from koordinator_tpu.parallel.mesh import mesh_from_env
+
+            mesh = mesh_from_env()
+            getter = None
+            deadline_ms = None
+        return DeviceColoReconciler(
+            self.store, controller, quota_plugin, pack,
+            mesh=mesh, snapshot_getter=getter,
+            dispatch_deadline_ms=deadline_ms,
+            tracer=self.tracer,
+            engine=("on" if self.colo_mode == "on" else "host"))
+
     @property
     def is_leader(self) -> bool:
         return self.elector.is_leader
 
+    def _reconcile_one(self, name: str, now: float) -> int:
+        t0 = time.perf_counter()
+        if name == "noderesource":
+            if self.colo is not None:
+                changes = self.colo.reconcile(now)
+            else:
+                # KOORD_TPU_COLO=off: the legacy reconcile still gets
+                # its per-controller span (it is the one you are most
+                # likely tracing during a colo incident)
+                with self.tracer.span(name):
+                    changes = self.controllers[name].reconcile(now)
+        else:
+            with self.tracer.span(name):
+                changes = self.controllers[name].reconcile()
+        manager_metrics.RECONCILE_SECONDS.observe(
+            time.perf_counter() - t0, controller=name)
+        manager_metrics.RECONCILES_TOTAL.inc(controller=name)
+        return changes
+
     def _reconcile_all(self, now: float) -> None:
         self.last_changes = {
-            "nodemetric": self.controllers["nodemetric"].reconcile(),
-            "noderesource": self.controllers["noderesource"].reconcile(now),
-            "nodeslo": self.controllers["nodeslo"].reconcile(),
-            "quotaprofile": self.controllers["quotaprofile"].reconcile(),
+            name: self._reconcile_one(name, now)
+            for name in ("nodemetric", "noderesource", "nodeslo",
+                         "quotaprofile")
         }
         self.reconcile_rounds += 1
 
     def tick(self, now: Optional[float] = None) -> bool:
         """One manager round: returns True iff this replica led and ran."""
         return self._runner.tick(time.time() if now is None else now)
+
+    def health_snapshot(self) -> dict:
+        """Liveness payload for the ObsServer /healthz surface: lease
+        state, reconcile rounds, and the colo ladder under "degraded"
+        (the same key the scheduler serves, so one probe grammar covers
+        the binaries)."""
+        out = {
+            "is_leader": self.is_leader,
+            "reconcile_rounds": self.reconcile_rounds,
+            "colo_mode": self.colo_mode,
+        }
+        if self.colo is not None:
+            out["degraded"] = self.colo.ladder.snapshot()
+            out["colo_engine"] = self.colo.last_pass_stats.get(
+                "engine", "none")
+        return out
 
     def stop(self, now: Optional[float] = None) -> None:
         """Graceful shutdown: release the lease (ReleaseOnCancel) and

@@ -31,3 +31,6 @@ from koordinator_tpu.parallel.full_chain_mesh import (  # noqa: F401
 from koordinator_tpu.parallel.rebalance_mesh import (  # noqa: F401
     build_sharded_rebalance_step,
 )
+from koordinator_tpu.parallel.colo_mesh import (  # noqa: F401
+    build_sharded_colo_step,
+)

@@ -29,10 +29,35 @@ class ElasticQuotaPlugin(Plugin):
         self.quotas: Dict[str, ElasticQuota] = {}
         self.used: Dict[str, np.ndarray] = {}     # leaf quota -> used vector
         self.pending: Dict[str, np.ndarray] = {}  # leaf quota -> pending requests
+        # epochs for the tree/runtime memos (koordcolo): the tree epoch
+        # moves on quota CR events, the state epoch on any used/pending
+        # mutation, the node epoch on node events (cluster total)
+        self.tree_epoch = 0
+        self.state_epoch = 0
+        self.nodes_epoch = 0
+        self._tree_memo: Optional[tuple] = None     # (key, tree)
+        self._runtime_memo: Optional[tuple] = None  # (key, runtime)
+        # the device colo pass's published runtime/revoke decisions:
+        # (epoch key, names, runtime[G,R], over[G,R], mask[G]) — consumed
+        # by the revoke controller while the key matches the live epochs
+        self.device_runtime: Optional[tuple] = None
 
     def register(self, store: ObjectStore) -> None:
+        from koordinator_tpu.client.store import KIND_NODE
+
         store.subscribe(KIND_ELASTIC_QUOTA, self._on_quota)
         store.subscribe(KIND_POD, self._on_pod)
+        # cluster total (and hence every runtime quota) moves with node
+        # allocatable — including the batch/mid axes the colo pass
+        # itself publishes; the epoch keeps the runtime memo honest
+        store.subscribe(KIND_NODE, self._on_node, replay=False)
+
+    def _on_node(self, ev: EventType, node, old) -> None:
+        self.nodes_epoch += 1
+
+    @property
+    def epoch_key(self) -> tuple:
+        return (self.tree_epoch, self.state_epoch, self.nodes_epoch)
 
     def services(self):
         """frameworkext services endpoints (/apis/v1/plugins/ElasticQuota/...)."""
@@ -52,6 +77,7 @@ class ElasticQuotaPlugin(Plugin):
             self.quotas.pop(q.meta.name, None)
         else:
             self.quotas[q.meta.name] = q
+        self.tree_epoch += 1
 
     def _vec(self, cache: Dict[str, np.ndarray], name: str) -> np.ndarray:
         if name not in cache:
@@ -73,6 +99,7 @@ class ElasticQuotaPlugin(Plugin):
         cache = self.used if bucket == "used" else self.pending
         self._vec(cache, name)
         cache[name] = np.maximum(cache[name] + sign * vec, 0.0)
+        self.state_epoch += 1
 
     def _on_pod(self, ev: EventType, pod: Pod, old) -> None:
         name = pod.quota_name
@@ -99,30 +126,86 @@ class ElasticQuotaPlugin(Plugin):
 
         return merge_group_request(self.pending, self.used)
 
-    def tree_snapshot(self, store: ObjectStore):
-        """(tree, runtime[G, R]) from the live caches + node totals — the one
-        shared snapshot the revoke controller and the preemptor both derive
-        runtime quotas from. Returns None when no quotas exist."""
+    def packed_tree(self):
+        """The packed QuotaTreeArrays from the live caches, memoized on
+        (tree_epoch, state_epoch) — a reconcile tick on an unchanged
+        cluster reuses the previous build instead of re-walking every
+        quota. Returns None when no quotas exist."""
+        from koordinator_tpu.ops.quota import build_quota_tree
+
+        key = (self.tree_epoch, self.state_epoch)
+        hit = self._tree_memo
+        if hit is not None and hit[0] == key:
+            return hit[1]
+        quotas = self.quota_list()
+        tree = None
+        if quotas:
+            tree = build_quota_tree(
+                quotas,
+                pod_requests_by_quota=self.request_by_quota(),
+                used_by_quota=self.used,
+            )
+        self._tree_memo = (key, tree)
+        return tree
+
+    @staticmethod
+    def cluster_total_vec(store: ObjectStore) -> np.ndarray:
+        """Cluster allocatable total as the packed [R] f32 vector — the
+        exact value the runtime fold divides (single home: the host
+        oracle, the revoke controller, and the colo pack all ship this
+        vector, so the device fold's input is bit-identical)."""
         from koordinator_tpu.api.resources import ResourceList
         from koordinator_tpu.client.store import KIND_NODE
-        from koordinator_tpu.ops.quota import (
-            build_quota_tree,
-            compute_runtime_quotas,
-        )
 
-        quotas = self.quota_list()
-        if not quotas:
-            return None
         total = ResourceList()
         for node in store.list(KIND_NODE):
             total = total.add(node.allocatable)
-        tree = build_quota_tree(
-            quotas,
-            pod_requests_by_quota=self.request_by_quota(),
-            used_by_quota=self.used,
-        )
-        runtime = compute_runtime_quotas(tree, total.to_vector())
+        return total.to_vector()
+
+    def leaf_used_matrix(self, names) -> np.ndarray:
+        """Per-group LEAF used rows aligned to ``names`` — what the
+        overuse revoke loop checks against runtime (the aggregated tree
+        ``used`` rolls children into parents; revocation is leaf-level,
+        quota_overuse_revoke.go walks direct members only)."""
+        out = np.zeros((len(names), NUM_RESOURCES), np.float32)
+        for i, name in enumerate(names):
+            vec = self.used.get(name)
+            if vec is not None:
+                out[i] = vec
+        return out
+
+    def tree_snapshot(self, store: ObjectStore):
+        """(tree, runtime[G, R]) from the live caches + node totals — the one
+        shared snapshot the revoke controller and the preemptor both derive
+        runtime quotas from. Returns None when no quotas exist. Memoized on
+        (tree_epoch, state_epoch, nodes_epoch): nothing changed -> the
+        previous runtime matrix is returned without recomputing the fold."""
+        from koordinator_tpu.ops.quota import compute_runtime_quotas
+
+        tree = self.packed_tree()
+        if tree is None:
+            return None
+        key = self.epoch_key
+        hit = self._runtime_memo
+        if hit is not None and hit[0] == key:
+            return tree, hit[1]
+        runtime = compute_runtime_quotas(tree, self.cluster_total_vec(store))
+        self._runtime_memo = (key, runtime)
         return tree, runtime
+
+    # ---- koordcolo: the device pass's published quota decisions ----------
+    def set_device_runtime(self, names, runtime, over, mask, key) -> None:
+        """The colo reconciler lands the device fold's outputs here;
+        they stay authoritative while ``key`` matches the live epochs
+        (any quota/pod/node event invalidates them until the next colo
+        pass re-publishes)."""
+        self.device_runtime = (tuple(key), list(names), runtime, over, mask)
+
+    def fresh_device_runtime(self) -> Optional[tuple]:
+        hit = self.device_runtime
+        if hit is None or hit[0] != self.epoch_key:
+            return None
+        return hit
 
     def revoke_controller(self, store: ObjectStore, args) -> "QuotaOveruseRevokeController":
         return QuotaOveruseRevokeController(self, store, args)
@@ -158,7 +241,17 @@ class QuotaOveruseRevokeController:
         self._last_run: float = 0.0
         self._over_since: Dict[str, float] = {}
 
-    def _runtime_by_name(self) -> Dict[str, np.ndarray]:
+    def _runtime_by_name(self, device=None) -> Dict[str, np.ndarray]:
+        """Runtime quota per group. With a FRESH device colo pass
+        published on the plugin (koordcolo), its runtime matrix is
+        authoritative — decision-identical to the host fold by the
+        run_colo_parity gate; otherwise the (epoch-memoized) host
+        snapshot computes it. ``device`` is the caller's single
+        fresh_device_runtime() read, so one pass cannot mix a device
+        runtime with a host-path mask decision."""
+        if device is not None:
+            _key, names, runtime, _over, _mask = device
+            return {name: runtime[i] for i, name in enumerate(names)}
         snap = self.plugin.tree_snapshot(self.store)
         if snap is None:
             return {}
@@ -172,16 +265,26 @@ class QuotaOveruseRevokeController:
         if now - self._last_run < self.args.revoke_pod_interval_seconds:
             return []
         self._last_run = now
-        runtime = self._runtime_by_name()
+        device = self.plugin.fresh_device_runtime()
+        runtime = self._runtime_by_name(device)
         if not runtime:
             return []
-        # grace tracking: a group only becomes revocable after delayEvictTime
+        # grace tracking: a group only becomes revocable after delayEvictTime.
+        # With a fresh device pass the over-runtime candidate detection
+        # consumes the kernel's revoke mask (the host compare retained below
+        # as the oracle path and for host/off modes).
         revocable: Dict[str, np.ndarray] = {}
+        device_idx = ({n: i for i, n in enumerate(device[1])}
+                      if device is not None else None)
         for name, used in self.plugin.used.items():
             rt = runtime.get(name)
             if rt is None:
                 continue
-            if (np.maximum(used - rt, 0.0) > 0).any():
+            if device_idx is not None and name in device_idx:
+                over_now = bool(device[4][device_idx[name]])
+            else:
+                over_now = bool((np.maximum(used - rt, 0.0) > 0).any())
+            if over_now:
                 since = self._over_since.setdefault(name, now)
                 if now - since >= self.args.delay_evict_time_seconds:
                     revocable[name] = rt
@@ -207,4 +310,8 @@ class QuotaOveruseRevokeController:
                     continue  # spared; try the next member
                 evicted.append(pod.meta.key)
                 over = over - pod.spec.requests.to_vector()
+        if evicted:
+            from koordinator_tpu import manager_metrics
+
+            manager_metrics.QUOTA_REVOKES_TOTAL.inc(len(evicted))
         return evicted

@@ -125,6 +125,16 @@ class SimReport:
     hotspots_open: int = 0        # flagged node sets still hot at end
     dissipate_cycles: List[int] = dataclasses.field(default_factory=list)
     dissipate_slo_cycles: int = 0
+    # koordcolo: the colocation control loop's activity + SLO
+    manager_rounds: int = 0
+    colo_device_passes: int = 0
+    colo_host_passes: int = 0
+    overcommit_shifts: int = 0
+    batch_pods_bound: int = 0
+    colo_staleness_cycles: List[int] = dataclasses.field(
+        default_factory=list)
+    colo_staleness_slo_cycles: int = 0
+    colo_final_engine: str = ""
     binding_log: List[str] = dataclasses.field(default_factory=list)
     wall_seconds: float = 0.0
     # pipeline-occupancy accounting under realistic arrivals: per-cycle
@@ -248,6 +258,32 @@ class SimReport:
                              or max(self.dissipate_cycles)
                              <= self.dissipate_slo_cycles))),
             },
+            "colo": {
+                "manager_rounds": self.manager_rounds,
+                "device_passes": self.colo_device_passes,
+                "host_passes": self.colo_host_passes,
+                "overcommit_shifts": self.overcommit_shifts,
+                "batch_pods_bound": self.batch_pods_bound,
+                "final_engine": self.colo_final_engine,
+                "staleness_cycles": {
+                    "count": len(self.colo_staleness_cycles),
+                    "p50": (float(np.percentile(
+                        np.asarray(self.colo_staleness_cycles), 50))
+                        if self.colo_staleness_cycles else 0.0),
+                    "p99": (float(np.percentile(
+                        np.asarray(self.colo_staleness_cycles), 99))
+                        if self.colo_staleness_cycles else 0.0),
+                    "max": (max(self.colo_staleness_cycles)
+                            if self.colo_staleness_cycles else 0),
+                },
+                "staleness_slo_cycles": self.colo_staleness_slo_cycles,
+                "staleness_slo_met": (
+                    self.colo_staleness_slo_cycles <= 0
+                    or not self.colo_staleness_cycles
+                    or float(np.percentile(
+                        np.asarray(self.colo_staleness_cycles), 99))
+                    <= self.colo_staleness_slo_cycles),
+            },
             "binding_log_sha256": self.binding_log_sha256,
             "bindings": len(self.binding_log),
             "wall_seconds": round(self.wall_seconds, 2),
@@ -302,6 +338,12 @@ class ChurnSimulator:
         # open hotspot events awaiting dissipation
         self._pod_mult: Dict[str, float] = {}
         self._hotspots: List[Tuple[int, set]] = []
+        # koordcolo: the active prod-usage surge (end cycle, marked pod
+        # keys) and the pending staleness probes — (metric-write cycle,
+        # node -> batch-cpu baseline) awaiting the dispatch that first
+        # observes the shifted overcommit
+        self._surge: Optional[Tuple[int, set]] = None
+        self._colo_pending: List[Tuple[int, Dict[str, int]]] = []
         self._dump_budget = {"invariant_breach": MAX_EVENT_DUMPS,
                              "slo_overrun": MAX_EVENT_DUMPS}
         # crash-restart (koordguard): sim time of the last restart still
@@ -403,6 +445,23 @@ class ChurnSimulator:
                 dump_counter=scheduler_metrics.FLIGHT_DUMPS)
         self.pipeline = (CyclePipeline(self.sched, enabled=True)
                          if sc.pipeline else None)
+        self.manager = None
+        if sc.colo_every > 0:
+            from koordinator_tpu.manager import Manager
+
+            # the co-located koord-manager (koordcolo): shares the
+            # scheduler's SnapshotCache subscriptions (the pack) and
+            # DeviceSnapshot (the uploads) — the third consumer. It
+            # writes through the simulator's own store view (manager
+            # store writes are not the faulted path under test) and its
+            # lease never expires inside a run (one replica).
+            self.manager = Manager(
+                self.store, identity="sim-manager",
+                scheduler=self.sched,
+                colo=(sc.colo if sc.colo is not None else "on"),
+                lease_duration_seconds=1e9)
+            self.report.colo_staleness_slo_cycles = (
+                sc.colo_staleness_slo_cycles)
         self.desch = None
         if sc.descheduler_every > 0:
             from koordinator_tpu.descheduler.descheduler import Descheduler
@@ -430,11 +489,24 @@ class ChurnSimulator:
         name = f"{prefix}{uid}"
         labels = {"app": rng.choice("abc")}
         is_be = rng.random() < self.sc.be_fraction
-        spec = PodSpec(
-            priority=PRIORITY_BE if is_be else PRIORITY_PROD,
-            requests=ResourceList.of(
-                cpu=rng.choice([250, 500, 1000, 2000]),
-                memory=rng.choice([1, 2, 4]) * GIB))
+        is_batch = (is_be and self.sc.batch_fraction > 0
+                    and rng.random() < self.sc.batch_fraction)
+        if is_batch:
+            # koordcolo consumer: a batch-class pod whose requests live
+            # on the overcommit axes the colo pass publishes — it binds
+            # only where batch allocatable (capacity*reclaim% - usage)
+            # currently covers it
+            spec = PodSpec(
+                priority=PRIORITY_BE,
+                requests=ResourceList.of(
+                    batch_cpu=rng.choice([500, 1000, 2000]),
+                    batch_memory=rng.choice([1, 2]) * GIB))
+        else:
+            spec = PodSpec(
+                priority=PRIORITY_BE if is_be else PRIORITY_PROD,
+                requests=ResourceList.of(
+                    cpu=rng.choice([250, 500, 1000, 2000]),
+                    memory=rng.choice([1, 2, 4]) * GIB))
         # controller-owned (ReplicaSet analog): the eviction chain
         # categorically refuses bare pods, so ownerless sim pods would
         # make every migration vacuous. Deterministic owner from uid —
@@ -706,6 +778,95 @@ class ChurnSimulator:
             self._hotspots.append((cycle, names))
             self.report.hotspot_events += 1
 
+    # ------------------------------------------------------------------
+    # overcommit-shift events (koordcolo)
+    # ------------------------------------------------------------------
+    def _batch_cpu_baseline(self, names) -> Dict[str, int]:
+        out = {}
+        for name in names:
+            node = self.store.get(KIND_NODE, f"/{name}")
+            if node is not None:
+                out[name] = node.allocatable[
+                    "kubernetes.io/batch-cpu"] or 0
+        return out
+
+    def _overcommit_surge(self, cycle: int) -> None:
+        """Prod-usage surge: the PROD pods on the busiest nodes run hot
+        for overcommit_surge_cycles (usage-derived NodeMetrics rise, the
+        colo pass shrinks batch allocatable), then recede. Both edges
+        record a staleness probe: the metric-write cycle plus the nodes'
+        batch-cpu baseline — resolved by the first dispatch that runs
+        against a changed value."""
+        sc = self.sc
+        if sc.overcommit_surge_every <= 0:
+            return
+        if self._surge is not None:
+            end, keys = self._surge
+            if cycle >= end:
+                names = set()
+                for key in keys:
+                    self._pod_mult.pop(key, None)
+                    pod = self.store.get(KIND_POD, key)
+                    if pod is not None and pod.spec.node_name:
+                        names.add(pod.spec.node_name)
+                self._surge = None
+                self.report.overcommit_shifts += 1
+                self._colo_pending.append(
+                    (cycle, self._batch_cpu_baseline(names)))
+            return
+        if cycle == 0 or cycle % sc.overcommit_surge_every:
+            return
+        counts: Dict[str, int] = {}
+        for pod in self.store.list(KIND_POD):
+            if (pod.is_assigned and not pod.is_terminated
+                    and not pod.gang_key
+                    and (pod.spec.priority or 0) >= 9000):
+                counts[pod.spec.node_name] = counts.get(
+                    pod.spec.node_name, 0) + 1
+        nodes = sorted(counts, key=lambda n: (-counts[n], n))
+        chosen = set(nodes[: sc.overcommit_surge_nodes])
+        if not chosen:
+            return
+        keys = set()
+        for pod in self.store.list(KIND_POD):
+            if (pod.is_assigned and not pod.is_terminated
+                    and pod.spec.node_name in chosen and not pod.gang_key
+                    and (pod.spec.priority or 0) >= 9000):
+                self._pod_mult[pod.meta.key] = (
+                    sc.overcommit_surge_multiplier)
+                keys.add(pod.meta.key)
+        if keys:
+            self._surge = (cycle + sc.overcommit_surge_cycles, keys)
+            self.report.overcommit_shifts += 1
+            self._colo_pending.append(
+                (cycle, self._batch_cpu_baseline(chosen)))
+
+    def _observe_colo_staleness(self, cycle: int) -> None:
+        """Resolve pending staleness probes: the first cycle whose
+        dispatch ran against a changed batch-cpu on any probed node
+        closes the probe at (cycle - write cycle)."""
+        still = []
+        for write_cycle, baseline in self._colo_pending:
+            if not baseline:
+                # every probed node departed before the edge landed:
+                # nothing left to observe — drop rather than park the
+                # probe forever (the SLO must not claim unmeasured edges)
+                continue
+            changed = False
+            for n, base in baseline.items():
+                node = self.store.get(KIND_NODE, f"/{n}")
+                if node is not None and (
+                        node.allocatable["kubernetes.io/batch-cpu"]
+                        or 0) != base:
+                    changed = True
+                    break
+            if changed:
+                self.report.colo_staleness_cycles.append(
+                    cycle - write_cycle)
+            else:
+                still.append((write_cycle, baseline))
+        self._colo_pending = still
+
     def _refresh_usage_metrics(self) -> None:
         """metrics_follow_usage: NodeMetric usage derives from the pods
         actually bound to each node (x their hot multipliers), so
@@ -845,6 +1006,10 @@ class ChurnSimulator:
             # rebalance-pass overruns must survive into the report too
             self._prior_deadline_overruns += (
                 self.desch.rebalancer.dispatch_watchdog.overruns)
+        if self.manager is not None and self.manager.colo is not None:
+            # so do the co-located manager's colo-pass overruns
+            self._prior_deadline_overruns += (
+                self.manager.colo.dispatch_watchdog.overruns)
         self.sched_store.sever()
         self.report.restarts += 1
         # the crash is anchored at the END of the previous cycle: a
@@ -878,13 +1043,16 @@ class ChurnSimulator:
         self.report.binding_log.append(
             f"{cycle}\t{pod_key}\t{node_name}")
 
-    def _reconcile_store_binds(self, cycle: int) -> None:
+    def _reconcile_store_binds(self, cycle: int):
         """After a mid-cycle exception: bindings the cycle applied before
         the wreck are already store-visible (a store-write fault raises
         mid-bind-loop), but never reached ``result.bound``. Sweep the
         tracked pending pods and account any the store now shows
         assigned, exactly as the normal path would — arrival order, the
-        seeded run's deterministic iteration order."""
+        seeded run's deterministic iteration order. Returns the
+        reconciled keys so the invariant check (batch-bind discipline
+        included) sees the partial cycle's binds."""
+        bound = []
         for key in list(self._arrival_time):
             pod = self.store.get(KIND_POD, key)
             if pod is None or not pod.is_assigned or pod.is_terminated:
@@ -893,9 +1061,20 @@ class ChurnSimulator:
                 pod.phase = "Running"
                 self.store.update(KIND_POD, pod)
             self._account_bind(cycle, key, pod.spec.node_name)
+            bound.append(key)
+        return bound
 
-    def _check_invariants(self, cycle: int) -> None:
-        breaches = check_invariants(self.store, now=self.now)
+    def _check_invariants(self, cycle: int, bound_keys=()) -> None:
+        breaches = check_invariants(
+            self.store, now=self.now,
+            batch_shrink_grace=self.sc.colo_every > 0)
+        if self.sc.colo_every > 0 and bound_keys:
+            from koordinator_tpu.sim.invariants import (
+                check_batch_bind_discipline,
+            )
+
+            breaches = breaches + check_batch_bind_discipline(
+                self.store, bound_keys)
         if breaches:
             self.report.invariant_breaches.extend(
                 f"cycle {cycle}: {b}" for b in breaches)
@@ -920,6 +1099,7 @@ class ChurnSimulator:
         self._quota_rebalance(cycle)
         self._departures()
         self._hotspot_step(cycle)
+        self._overcommit_surge(cycle)
         self._refresh_usage_metrics()
         self._note_hotspot_dissipation(cycle)
         fresh = [self._make_pod() for _ in range(
@@ -934,6 +1114,20 @@ class ChurnSimulator:
         self._admit(fresh)
         self.report.max_pending = max(self.report.max_pending,
                                       self._pending_count())
+
+        # koordcolo: the manager tick BEFORE the dispatch — the very
+        # next scheduling dispatch consumes the overcommit this pass
+        # publishes (the closed-loop ordering the acceptance pins)
+        if (self.manager is not None
+                and cycle % self.sc.colo_every == 0):
+            self.manager.tick(now=self.now)
+            self.report.manager_rounds += 1
+            stats = (self.manager.colo.last_pass_stats
+                     if self.manager.colo is not None else {})
+            if stats.get("engine") == "device":
+                self.report.colo_device_passes += 1
+            elif stats.get("engine"):
+                self.report.colo_host_passes += 1
 
         driver = self.pipeline if self.pipeline is not None else self.sched
         t_cycle = time.perf_counter()
@@ -951,8 +1145,8 @@ class ChurnSimulator:
             # into the report so binding_log/ttb/pods_bound match the
             # store, then still run the invariant check — a partially
             # applied cycle is exactly when it matters
-            self._reconcile_store_binds(cycle)
-            self._check_invariants(cycle)
+            bound_keys = self._reconcile_store_binds(cycle)
+            self._check_invariants(cycle, bound_keys=bound_keys)
             return
         wall = time.perf_counter() - t_cycle
         self.report.cycle_wall_seconds += wall
@@ -973,6 +1167,11 @@ class ChurnSimulator:
             pod.phase = "Running"  # bind -> Running, as the kubelet would
             self.store.update(KIND_POD, pod)
             self._account_bind(cycle, b.pod_key, b.node_name)
+            if (pod.spec.requests["kubernetes.io/batch-cpu"]
+                    or pod.spec.requests["kubernetes.io/batch-memory"]):
+                self.report.batch_pods_bound += 1
+        if self.manager is not None:
+            self._observe_colo_staleness(cycle)
         if (self.desch is not None and cycle > 0
                 and cycle % sc.descheduler_every == 0):
             try:
@@ -990,7 +1189,8 @@ class ChurnSimulator:
             self._sweep_migrated()
         # invariants run AFTER the descheduler so the migration-job and
         # reservation double-booking checks see its writes every cycle
-        self._check_invariants(cycle)
+        self._check_invariants(
+            cycle, bound_keys=[b.pod_key for b in result.bound])
 
     def run(self) -> SimReport:
         self._t0 = time.perf_counter()
@@ -1020,6 +1220,10 @@ class ChurnSimulator:
                     + self.sched.dispatch_watchdog.overruns)
         if self.desch is not None and self.desch.rebalancer is not None:
             overruns += self.desch.rebalancer.dispatch_watchdog.overruns
+        if self.manager is not None and self.manager.colo is not None:
+            overruns += self.manager.colo.dispatch_watchdog.overruns
+            self.report.colo_final_engine = str(
+                self.manager.colo.last_pass_stats.get("engine", ""))
         self.report.deadline_overruns = overruns
         return self.report
 

@@ -28,11 +28,31 @@ from koordinator_tpu.ops.estimator import estimate_node_allocatable
 
 
 def check_invariants(store: ObjectStore,
-                     now: Optional[float] = None) -> List[str]:
+                     now: Optional[float] = None,
+                     batch_shrink_grace: bool = False) -> List[str]:
     """Check the invariant set against the store; [] == clean.
-    ``now`` governs reservation expiry (sim clock); defaults to wall."""
+    ``now`` governs reservation expiry (sim clock); defaults to wall.
+
+    ``batch_shrink_grace`` (koordcolo scenarios): the batch/mid axes are
+    OVERCOMMIT — the colo loop may legitimately shrink a node's batch
+    allocatable below what already-bound batch pods consume (the
+    reference reclaims via BE eviction, asynchronously). With the grace
+    on, the capacity check skips those axes and the bind-time discipline
+    is pinned separately by :func:`check_batch_bind_discipline` (new
+    binds must respect the CURRENT overcommit; existing binds may ride
+    out a shrink)."""
     now = time.time() if now is None else now
     breaches: List[str] = []
+    grace_axes: List[int] = []
+    if batch_shrink_grace:
+        from koordinator_tpu.api.resources import (
+            RESOURCE_INDEX,
+            ResourceName,
+        )
+
+        grace_axes = [RESOURCE_INDEX[rn] for rn in (
+            ResourceName.BATCH_CPU, ResourceName.BATCH_MEMORY,
+            ResourceName.MID_CPU, ResourceName.MID_MEMORY)]
     nodes = {n.meta.name: n for n in store.list(KIND_NODE)}
     pods = [p for p in store.list(KIND_POD)
             if p.is_assigned and not p.is_terminated]
@@ -50,6 +70,8 @@ def check_invariants(store: ObjectStore,
         for p in plist:
             total = total + p.spec.requests.to_vector()
         over = total > alloc + 1e-3
+        if grace_axes:
+            over[grace_axes] = False
         if over.any():
             breaches.append(
                 f"node {name} overcommitted: {total[over]} > {alloc[over]}")
@@ -142,4 +164,56 @@ def check_invariants(store: ObjectStore,
             breaches.append(
                 f"node {name} double-booked by reservations: "
                 f"{total[over]} > {alloc[over]}")
+    return breaches
+
+
+def check_batch_bind_discipline(store: ObjectStore,
+                                bound_keys) -> List[str]:
+    """koordcolo bind-time invariant: a batch-class pod bound THIS cycle
+    must fit the node's CURRENT batch/mid allocatable together with
+    every batch pod already there — the dispatch that placed it consumed
+    the overcommit the colo pass published this very cycle, so a bind
+    into an already-over node means the scheduler read stale overcommit
+    (the closed loop failed). Existing binds riding out a later shrink
+    are legitimate (see check_invariants batch_shrink_grace)."""
+    from koordinator_tpu.api.resources import RESOURCE_INDEX, ResourceName
+
+    axes = [RESOURCE_INDEX[rn] for rn in (
+        ResourceName.BATCH_CPU, ResourceName.BATCH_MEMORY,
+        ResourceName.MID_CPU, ResourceName.MID_MEMORY)]
+    breaches: List[str] = []
+    touched = set()
+    for key in bound_keys:
+        pod = store.get(KIND_POD, key)
+        if pod is None or not pod.is_assigned or pod.is_terminated:
+            continue
+        vec = pod.spec.requests.to_vector()
+        if not any(vec[a] > 0 for a in axes):
+            continue
+        touched.add(pod.spec.node_name)
+    if not touched:
+        return breaches
+    # ONE store walk accumulating per-node totals (the check above
+    # already walks pods once; k touched nodes must not mean k walks)
+    totals: dict = {}
+    for p in store.list(KIND_POD):
+        if (p.is_assigned and not p.is_terminated
+                and p.spec.node_name in touched):
+            node_total = totals.get(p.spec.node_name)
+            vec = p.spec.requests.to_vector()
+            totals[p.spec.node_name] = (
+                vec if node_total is None else node_total + vec)
+    for name in touched:
+        node = store.get(KIND_NODE, f"/{name}")
+        if node is None:
+            continue
+        alloc = estimate_node_allocatable(node)
+        total = totals.get(name)
+        if total is None:
+            continue
+        for a in axes:
+            if total[a] > alloc[a] + 1e-3:
+                breaches.append(
+                    f"batch bind onto {name} exceeds current overcommit "
+                    f"axis {a}: {total[a]} > {alloc[a]}")
     return breaches

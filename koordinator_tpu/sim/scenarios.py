@@ -71,6 +71,19 @@ class Scenario:
     restart_at: Tuple[int, ...] = ()
     restart_slo_seconds: float = 0.0   # 0 = report-only
     dispatch_deadline_ms: Optional[float] = None
+    # koordcolo: the colocation control loop in the sim — a co-located
+    # koord-manager (sharing the scheduler's snapshot) recomputes
+    # batch/mid overcommit + runtime quotas every colo_every cycles
+    colo_every: int = 0           # manager tick cadence (0 = no manager)
+    colo: Optional[str] = None    # KOORD_TPU_COLO pin (None = env default)
+    batch_fraction: float = 0.0   # BE arrivals requesting batch-cpu/mem
+    #                               (the overcommit consumers)
+    overcommit_surge_every: int = 0   # prod-usage surge event cadence
+    overcommit_surge_cycles: int = 8  # cycles until the surge recedes
+    overcommit_surge_nodes: int = 3   # nodes whose prod pods run hot
+    overcommit_surge_multiplier: float = 3.0
+    colo_staleness_slo_cycles: int = 0  # metric write -> observing
+    #                                     dispatch, p99 target (0 = off)
     # SLOs
     ttb_slo_seconds: float = 120.0  # time-to-bind p99 target
     # scheduler configuration under test
@@ -243,6 +256,30 @@ _register(Scenario(
     # target stays loose enough that feature-stuck stragglers (hostPort
     # collisions under load) do not mask a dissipation regression
     ttb_slo_seconds=360.0,
+))
+
+_register(Scenario(
+    name="overcommit-shift",
+    description=(
+        "koordcolo closed loop under load: a co-located koord-manager "
+        "recomputes batch/mid overcommit on device every cycle while "
+        "batch-class BE pods consume it; mid-soak prod-usage surges "
+        "(usage-derived NodeMetrics) shrink batch allocatable and then "
+        "recede, and the invariants pin that batch binds never exceed "
+        "the CURRENT batch allocatable at their dispatch plus a bounded "
+        "metric-write-to-observing-dispatch staleness SLO — fixed seed, "
+        "byte-stable binding log, the bench --colo A/B pair (device vs "
+        "host oracle) must be log-identical"),
+    seed=31, cycles=160, nodes=12, initial_pods=72,
+    arrival_rate=4.0, departure_rate=3.0, be_fraction=0.55,
+    metrics_follow_usage=True, usage_fraction=0.7,
+    colo_every=1, batch_fraction=0.6,
+    overcommit_surge_every=40, overcommit_surge_cycles=12,
+    overcommit_surge_nodes=4, overcommit_surge_multiplier=3.0,
+    colo_staleness_slo_cycles=2,
+    queue_cap=256,
+    ttb_slo_seconds=400.0,
+    promote_after=8,
 ))
 
 _register(Scenario(

@@ -48,11 +48,99 @@ from koordinator_tpu.utils.sloconfig import (
     POLICY_MAX_USAGE_REQUEST,
     POLICY_REQUEST,
     ColocationConfig,
+    ColocationConfigSource,
 )
 
 CPU = RESOURCE_INDEX[ResourceName.CPU]
 MEM = RESOURCE_INDEX[ResourceName.MEMORY]
 ANNOTATION_NODE_RESERVATION = "node.koordinator.sh/reservation"
+
+
+def node_static_row(node: Node, strategy):
+    """The metric-independent packed columns for one node: capacity, the
+    node-reservation annotation split, and the per-node strategy scalars.
+    Shared by the host gather AND the colo pack (colo/pack.py) so the
+    device pass reads bit-identical rows."""
+    R = NUM_RESOURCES
+    capacity = (node.capacity.to_vector() if node.capacity
+                else node.allocatable.to_vector())
+    node_reserved = np.zeros(R, np.float32)
+    system_reserved = np.zeros(R, np.float32)
+    reclaim = np.zeros(R, np.float32)
+    mid_pct = np.zeros(R, np.float32)
+    reclaim[CPU] = strategy.cpu_reclaim_threshold_percent
+    reclaim[MEM] = strategy.memory_reclaim_threshold_percent
+    mid_pct[CPU] = strategy.mid_cpu_threshold_percent
+    mid_pct[MEM] = strategy.mid_memory_threshold_percent
+    raw = node.meta.annotations.get(ANNOTATION_NODE_RESERVATION)
+    if raw:
+        import json
+
+        try:
+            data = json.loads(raw)
+            from koordinator_tpu.api.resources import parse_quantity
+
+            def to_vec(section):
+                return ResourceList(
+                    {
+                        k: parse_quantity(v, cpu=(k == ResourceName.CPU))
+                        for k, v in section.items()
+                    }
+                ).to_vector()
+
+            node_reserved = to_vec(data.get("resources", {}))
+            # the system daemons' reserve feeds both the system-used
+            # floor and the by-request policy subtrahend
+            system_reserved = to_vec(data.get("systemResources", {}))
+        except (ValueError, TypeError):
+            pass
+    degrade_seconds = strategy.degrade_time_minutes * 60.0
+    return capacity, node_reserved, system_reserved, reclaim, mid_pct, \
+        degrade_seconds
+
+
+def node_metric_row(nm: Optional[NodeMetric], pods: List[Pod]):
+    """The metric-dependent packed columns for one node: usage, prod
+    reclaimable, and the per-class pod aggregate sums — accumulated in
+    float64 over the exact f32 per-pod rows (order-free, the
+    SnapshotCache discipline), cast to f32 at the end. ``pods`` is the
+    node's assigned non-terminated set; a missing/zeroed NodeMetric
+    yields all-zero rows (the kernel's degrade gate zeroes the outputs
+    for such nodes anyway)."""
+    R = NUM_RESOURCES
+    node_used = np.zeros(R, np.float32)
+    prod_reclaimable = np.zeros(R, np.float32)
+    pod_all_used = np.zeros(R, np.float64)
+    hp_used = np.zeros(R, np.float64)
+    hp_request = np.zeros(R, np.float64)
+    hp_max = np.zeros(R, np.float64)
+    if nm is None or nm.update_time <= 0:
+        return (node_used, prod_reclaimable,
+                pod_all_used.astype(np.float32),
+                hp_used.astype(np.float32),
+                hp_request.astype(np.float32),
+                hp_max.astype(np.float32))
+    node_used = nm.node_metric.node_usage.to_vector()
+    prod_reclaimable = nm.prod_reclaimable.to_vector()
+    pod_usage = {
+        f"{pm.namespace}/{pm.name}": pm.pod_usage.to_vector()
+        for pm in nm.pods_metric
+    }
+    for pod in pods:
+        used = pod_usage.get(pod.meta.key)
+        if used is not None:
+            pod_all_used += used
+        cls = pod.priority_class
+        if cls in (PriorityClass.PROD, PriorityClass.MID,
+                   PriorityClass.NONE):
+            req = pod.spec.requests.to_vector()
+            u = used if used is not None else np.zeros(R, np.float32)
+            hp_used += u
+            hp_request += req
+            hp_max += np.maximum(req, u)
+    return (node_used, prod_reclaimable,
+            pod_all_used.astype(np.float32), hp_used.astype(np.float32),
+            hp_request.astype(np.float32), hp_max.astype(np.float32))
 
 
 @functools.partial(jax.jit, static_argnames=("cpu_policy", "memory_policy"))
@@ -102,12 +190,25 @@ def _batch_mid_kernel(
 
 
 class NodeResourceController:
+    """The host oracle of the colocation resource pipeline. With
+    koordcolo (colo/) attached, the SAME formula runs as part of the
+    device colo pass and this controller is retained as the
+    decision-parity reference (``run_colo_parity``); ``apply`` is the
+    shared writeback both engines route through. The effective config
+    hot-reloads from the slo-controller-config ConfigMap (memoized on
+    its resourceVersion) with the constructor config as the base."""
+
     def __init__(self, store: ObjectStore, config: Optional[ColocationConfig] = None):
         self.store = store
-        self.config = config or ColocationConfig()
+        self.config_source = ColocationConfigSource(store, config)
+
+    @property
+    def config(self) -> ColocationConfig:
+        return self.config_source.get()
 
     # -- host gather ---------------------------------------------------------
     def _gather(self, nodes: List[Node], now: float):
+        config = self.config
         N = len(nodes)
         R = NUM_RESOURCES
         capacity = np.zeros((N, R), np.float32)
@@ -129,61 +230,23 @@ class NodeResourceController:
                 pods_by_node.setdefault(pod.spec.node_name, []).append(pod)
 
         for i, node in enumerate(nodes):
-            strategy = self.config.strategy_for_node(
+            strategy = config.strategy_for_node(
                 node.meta.labels, node.meta.annotations)
-            capacity[i] = node.capacity.to_vector() if node.capacity else node.allocatable.to_vector()
-            reclaim[i, CPU] = strategy.cpu_reclaim_threshold_percent
-            reclaim[i, MEM] = strategy.memory_reclaim_threshold_percent
-            mid_pct[i, CPU] = strategy.mid_cpu_threshold_percent
-            mid_pct[i, MEM] = strategy.mid_memory_threshold_percent
-            raw = node.meta.annotations.get(ANNOTATION_NODE_RESERVATION)
-            if raw:
-                import json
-
-                try:
-                    data = json.loads(raw)
-                    from koordinator_tpu.api.resources import parse_quantity
-
-                    def to_vec(section):
-                        return ResourceList(
-                            {
-                                k: parse_quantity(v, cpu=(k == ResourceName.CPU))
-                                for k, v in section.items()
-                            }
-                        ).to_vector()
-
-                    node_reserved[i] = to_vec(data.get("resources", {}))
-                    # the system daemons' reserve feeds both the system-used
-                    # floor and the by-request policy subtrahend
-                    system_reserved[i] = to_vec(data.get("systemResources", {}))
-                except (ValueError, TypeError):
-                    pass
+            (capacity[i], node_reserved[i], system_reserved[i],
+             reclaim[i], mid_pct[i], degrade_seconds) = node_static_row(
+                node, strategy)
             nm: Optional[NodeMetric] = self.store.get(
                 KIND_NODE_METRIC, f"/{node.meta.name}"
             )
             if nm is None or nm.update_time <= 0:
                 degraded[i] = True
                 continue
-            if now - nm.update_time > strategy.degrade_time_minutes * 60:
+            if now - nm.update_time > degrade_seconds:
                 degraded[i] = True  # degrade on stale metrics (plugin.go:467-485)
                 continue
-            node_used[i] = nm.node_metric.node_usage.to_vector()
-            prod_reclaimable[i] = nm.prod_reclaimable.to_vector()
-            pod_usage = {
-                f"{pm.namespace}/{pm.name}": pm.pod_usage.to_vector()
-                for pm in nm.pods_metric
-            }
-            for pod in pods_by_node.get(node.meta.name, []):
-                used = pod_usage.get(pod.meta.key)
-                if used is not None:
-                    pod_all_used[i] += used
-                cls = pod.priority_class
-                if cls in (PriorityClass.PROD, PriorityClass.MID, PriorityClass.NONE):
-                    req = pod.spec.requests.to_vector()
-                    u = used if used is not None else np.zeros(R, np.float32)
-                    pod_hp_used[i] += u
-                    pod_hp_request[i] += req
-                    pod_hp_max[i] += np.maximum(req, u)
+            (node_used[i], prod_reclaimable[i], pod_all_used[i],
+             pod_hp_used[i], pod_hp_request[i], pod_hp_max[i]) = (
+                node_metric_row(nm, pods_by_node.get(node.meta.name, [])))
         return (capacity, node_reserved, system_reserved, node_used, pod_all_used,
                 pod_hp_used, pod_hp_request, pod_hp_max, prod_reclaimable,
                 reclaim, mid_pct, degraded)
@@ -202,13 +265,24 @@ class NodeResourceController:
             memory_policy=strategy.memory_calculate_policy,
         )
         batch, mid = np.asarray(batch), np.asarray(mid)
+        return self.apply(nodes, batch[:, CPU], batch[:, MEM],
+                          mid[:, CPU], mid[:, MEM])
+
+    # -- writeback (shared with the device colo pass) -------------------------
+    def apply(self, nodes: List[Node], batch_cpu, batch_mem,
+              mid_cpu, mid_mem) -> int:
+        """Publish the computed batch/mid columns onto node status and
+        run the post-pass plugin chain — the single writeback both the
+        host reconcile and the colo device pass route through, so the
+        store-visible effect of a pass is engine-independent by
+        construction."""
         changes = 0
         for i, node in enumerate(nodes):
             update = ResourceList.of(
-                batch_cpu=int(batch[i, CPU]),
-                batch_memory=int(batch[i, MEM]) * 1024 * 1024,
-                mid_cpu=int(mid[i, CPU]),
-                mid_memory=int(mid[i, MEM]) * 1024 * 1024,
+                batch_cpu=int(batch_cpu[i]),
+                batch_memory=int(batch_mem[i]) * 1024 * 1024,
+                mid_cpu=int(mid_cpu[i]),
+                mid_memory=int(mid_mem[i]) * 1024 * 1024,
             )
             merged = dict(node.allocatable.quantities)
             changed = False

@@ -182,3 +182,49 @@ def validate_colocation_config(cfg: ColocationConfig) -> Optional[str]:
     if s.degrade_time_minutes <= 0:
         return "degradeTimeMinutes must be positive"
     return None
+
+
+class ColocationConfigSource:
+    """Hot-reloadable ColocationConfig: the slo-controller-config
+    ConfigMap's colocation-config section, memoized on the ConfigMap's
+    resourceVersion, falling back to the constructor-provided base when
+    the map (or the key) is absent or fails validation — the reference
+    controllers keep their last good config on a bad update.
+
+    Shared by the NodeResourceController host oracle AND the colo pack
+    (colo/pack.py), so a config hot-reload reaches the device pass's
+    policy scalars through the SAME parsed object the oracle sees.
+    ``epoch`` bumps whenever the effective config object changes — the
+    pack keys its per-node strategy rows on it."""
+
+    def __init__(self, store, base: Optional[ColocationConfig] = None):
+        self.store = store
+        self.base = base or ColocationConfig()
+        self.epoch = 0
+        self._rv_key: object = object()  # never matches the first get()
+        self._effective = self.base
+
+    def get(self) -> ColocationConfig:
+        from koordinator_tpu.client.store import KIND_CONFIG_MAP
+
+        cm = self.store.get(
+            KIND_CONFIG_MAP, f"koordinator-system/{CONFIG_MAP_NAME}")
+        key = (cm.meta.resource_version if cm is not None else None)
+        if key == self._rv_key:
+            return self._effective
+        self._rv_key = key
+        raw = cm.data.get(COLOCATION_CONFIG_KEY) if cm is not None else None
+        if not raw:
+            # the key (or the map) being ABSENT means "no cluster
+            # config" — back to the constructor base, not an error
+            effective = self.base
+        else:
+            cfg, err = parse_colocation_config(cm.data)
+            # a malformed/invalid update keeps the LAST GOOD config: a
+            # typo in the ConfigMap must not rewrite every node's batch
+            # allocatable with defaults
+            effective = self._effective if err else cfg
+        if effective is not self._effective:
+            self.epoch += 1
+            self._effective = effective
+        return self._effective

@@ -1,0 +1,589 @@
+"""koordcolo: the control plane's resource model on device.
+
+Covers the PR's acceptance gates at test granularity:
+  * decision parity vs the host oracles (single-device + mesh),
+  * the closed loop: a NodeMetric shift changes batch allocatable on
+    device and the VERY NEXT dispatch binds/refuses a batch pod,
+  * the shared snapshot (no second watch chain, colo_* fields in the
+    scheduler's DeviceSnapshot),
+  * the degradation ladder + dispatch deadline around the colo pass,
+  * the device quota fold against compute_runtime_quotas (including the
+    AutoScaleMin exact floor-division path),
+  * the epoch memos + the revoke loop consuming the device mask,
+  * slo-config hot-reload reaching the policy scalars without a
+    step-cache leak.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.objects import (
+    ConfigMap,
+    ElasticQuota,
+    LABEL_QUOTA_NAME,
+    Node,
+    NodeMetric,
+    NodeMetricInfo,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from koordinator_tpu.api.resources import (
+    RESOURCE_INDEX,
+    ResourceList,
+    ResourceName,
+)
+from koordinator_tpu.client.store import (
+    KIND_CONFIG_MAP,
+    KIND_ELASTIC_QUOTA,
+    KIND_NODE,
+    KIND_NODE_METRIC,
+    KIND_POD,
+    ObjectStore,
+)
+from koordinator_tpu.manager import Manager
+from koordinator_tpu.scheduler.cycle import Scheduler
+from koordinator_tpu.scheduler.pipeline_parity import run_colo_parity
+
+GIB = 1024 ** 3
+NOW = 1_000_000.0
+BATCH_CPU = ResourceName.BATCH_CPU
+
+
+def _world(nodes=4, usage_cpu=3000):
+    store = ObjectStore()
+    for i in range(nodes):
+        store.add(KIND_NODE, Node(
+            meta=ObjectMeta(name=f"n{i}", namespace=""),
+            allocatable=ResourceList.of(cpu=16_000, memory=64 * GIB,
+                                        pods=64)))
+        store.add(KIND_NODE_METRIC, NodeMetric(
+            meta=ObjectMeta(name=f"n{i}", namespace=""),
+            update_time=NOW,
+            node_metric=NodeMetricInfo(node_usage=ResourceList.of(
+                cpu=usage_cpu, memory=8 * GIB))))
+    return store
+
+
+def _batch_pod(name, cpu=2000, mem_gib=2):
+    return Pod(
+        meta=ObjectMeta(name=name, namespace="t", uid=name,
+                        creation_timestamp=NOW,
+                        owner_kind="ReplicaSet", owner_name="rs"),
+        spec=PodSpec(priority=5500, requests=ResourceList.of(
+            batch_cpu=cpu, batch_memory=mem_gib * GIB)))
+
+
+# ---------------------------------------------------------------------------
+# parity gates (the hack/lint.sh module runs the same functions)
+# ---------------------------------------------------------------------------
+
+class TestColoParity:
+    def test_single_device(self):
+        rep = run_colo_parity()
+        assert rep["ok"], rep["mismatches"]
+
+    @pytest.mark.parametrize("ndev", [1, 2, 4, 8])
+    def test_mesh(self, ndev):
+        import jax
+
+        if ndev > len(jax.devices()):
+            pytest.skip(f"needs {ndev} devices")
+        rep = run_colo_parity(ndev)
+        assert rep["ok"], rep["mismatches"]
+
+
+# ---------------------------------------------------------------------------
+# the closed loop: overcommit shift -> very next dispatch
+# ---------------------------------------------------------------------------
+
+class TestClosedLoop:
+    def test_metric_shift_gates_the_next_dispatch(self):
+        store = _world(nodes=2, usage_cpu=2000)
+        sched = Scheduler(store)
+        mgr = Manager(store, scheduler=sched, colo="on")
+
+        # tick 1: low usage -> generous batch allocatable; a batch pod
+        # binds on the very next dispatch
+        assert mgr.tick(now=NOW + 1)
+        assert mgr.colo.last_pass_stats["engine"] == "device"
+        batch0 = store.get(KIND_NODE, "/n0").allocatable[BATCH_CPU]
+        assert batch0 > 0
+        store.add(KIND_POD, _batch_pod("be-1", cpu=3000))
+        res = sched.run_cycle(now=NOW + 2)
+        assert [b.pod_key for b in res.bound] == ["t/be-1"]
+        pod = store.get(KIND_POD, "t/be-1")
+        pod.phase = "Running"
+        store.update(KIND_POD, pod)
+
+        # prod usage surges: the NodeMetric shift shrinks batch
+        # allocatable ON DEVICE, and the very next dispatch refuses a
+        # batch pod the old overcommit would have taken
+        for nm in store.list(KIND_NODE_METRIC):
+            nm.update_time = NOW + 10
+            nm.node_metric = NodeMetricInfo(node_usage=ResourceList.of(
+                cpu=15_500, memory=60 * GIB))
+            store.update(KIND_NODE_METRIC, nm)
+        assert mgr.tick(now=NOW + 11)
+        assert mgr.colo.last_pass_stats["engine"] == "device"
+        shrunk = store.get(KIND_NODE, "/n0").allocatable[BATCH_CPU]
+        assert shrunk < batch0
+        store.add(KIND_POD, _batch_pod("be-2", cpu=3000))
+        res = sched.run_cycle(now=NOW + 12)
+        assert res.bound == []
+        assert "t/be-2" in res.failed
+
+    def test_staleness_degrade_zeroes_batch(self):
+        store = _world(nodes=2)
+        sched = Scheduler(store)
+        mgr = Manager(store, scheduler=sched, colo="on")
+        assert mgr.tick(now=NOW + 1)
+        assert store.get(KIND_NODE, "/n0").allocatable[BATCH_CPU] > 0
+        # stale metrics degrade the node: batch resets to zero (the
+        # kernel's degrade gate), exactly like the host controller
+        assert mgr.tick(now=NOW + 100_000)
+        stats = mgr.colo.last_pass_stats
+        assert stats["engine"] == "device"
+        assert np.asarray(stats["degraded"]).all()
+        assert store.get(KIND_NODE, "/n0").allocatable[BATCH_CPU] == 0
+
+
+# ---------------------------------------------------------------------------
+# shared snapshot: one event stream, three consumers
+# ---------------------------------------------------------------------------
+
+class TestSharedSnapshot:
+    def test_colo_pack_adds_no_store_subscription(self):
+        store = _world()
+        sched = Scheduler(store)
+        counts_before = {
+            kind: len(store._collections[kind].handlers)
+            for kind in (KIND_POD, KIND_NODE, KIND_NODE_METRIC)}
+        Manager(store, scheduler=sched, colo="on")
+        counts_after = {
+            kind: len(store._collections[kind].handlers)
+            for kind in (KIND_POD, KIND_NODE, KIND_NODE_METRIC)}
+        # the pack rides the SnapshotCache's existing chain — the ONLY
+        # new watch is the quota plugin's node epoch (registered by the
+        # scheduler's own plugin at construction, not by the pack)
+        assert counts_before == counts_after
+
+    def test_device_pass_uses_scheduler_device_snapshot(self):
+        store = _world()
+        sched = Scheduler(store)
+        mgr = Manager(store, scheduler=sched, colo="on")
+        snap = sched.device_snapshot
+        before = dict(snap.stats)
+        assert mgr.tick(now=NOW + 1)
+        assert mgr.colo.last_pass_stats["engine"] == "device"
+        assert snap.stats["put"] > before["put"]  # colo_* fields landed
+
+    def test_pack_matches_host_gather(self):
+        store = _world(nodes=3)
+        store.add(KIND_POD, Pod(
+            meta=ObjectMeta(name="prod-1", namespace="t", uid="prod-1"),
+            spec=PodSpec(node_name="n1", priority=9500,
+                         requests=ResourceList.of(cpu=4000,
+                                                  memory=8 * GIB)),
+            phase="Running"))
+        nm = store.get(KIND_NODE_METRIC, "/n1")
+        from koordinator_tpu.api.objects import PodMetricInfo
+
+        nm.pods_metric = [PodMetricInfo(
+            namespace="t", name="prod-1",
+            pod_usage=ResourceList.of(cpu=3500, memory=6 * GIB))]
+        store.update(KIND_NODE_METRIC, nm)
+        sched = Scheduler(store)
+        mgr = Manager(store, scheduler=sched, colo="on")
+        ctl = mgr.controllers["noderesource"]
+        view = mgr.colo.pack.view(NOW + 5)
+        nodes = store.list(KIND_NODE)
+        (capacity, node_reserved, system_reserved, node_used,
+         pod_all_used, hp_used, hp_request, hp_max, prod_reclaimable,
+         reclaim, mid_pct, degraded) = ctl._gather(nodes, NOW + 5)
+        assert np.array_equal(view["capacity"], capacity)
+        assert np.array_equal(view["node_used"], node_used)
+        assert np.array_equal(view["hp_used"], hp_used)
+        assert np.array_equal(view["hp_request"], hp_request)
+        assert np.array_equal(view["hp_max"], hp_max)
+        assert np.array_equal(view["reclaim_pct"], reclaim)
+        assert list(view["degraded"]) == list(degraded)
+
+
+# ---------------------------------------------------------------------------
+# resilience: ladder + dispatch deadline around the colo pass
+# ---------------------------------------------------------------------------
+
+class TestColoLadder:
+    def test_fault_retries_then_demotes_to_host_and_repromotes(self):
+        from koordinator_tpu.scheduler.degrade import (
+            LEVEL_FULL,
+            LEVEL_HOST_FALLBACK,
+        )
+
+        store = _world(nodes=2)
+        sched = Scheduler(store)
+        mgr = Manager(store, scheduler=sched, colo="on")
+        mgr.colo.ladder.promote_after = 2
+        mgr.colo.ladder._base_promote_after = 2
+        calls = {"n": 0}
+
+        def boom():
+            calls["n"] += 1
+            raise RuntimeError("injected colo fault")
+
+        mgr.colo.fault_injector = boom
+        changes = mgr.colo.reconcile(now=NOW + 1)
+        # retry once at-level, then demote straight to host fallback
+        # (no mesh configured) — decisions still land
+        assert calls["n"] == 2
+        assert mgr.colo.ladder.level == LEVEL_HOST_FALLBACK
+        assert mgr.colo.last_pass_stats["engine"] == "host"
+        assert changes > 0
+        assert store.get(KIND_NODE, "/n0").allocatable[BATCH_CPU] > 0
+        # clean passes re-promote and the device engine returns
+        mgr.colo.fault_injector = None
+        mgr.colo.reconcile(now=NOW + 2)
+        mgr.colo.reconcile(now=NOW + 3)
+        mgr.colo.reconcile(now=NOW + 4)
+        assert mgr.colo.ladder.level == LEVEL_FULL
+        assert mgr.colo.last_pass_stats["engine"] == "device"
+
+    def test_dispatch_deadline_overrun_abandons_and_demotes(self):
+        import time as _time
+
+        store = _world(nodes=2)
+        sched = Scheduler(store)
+        mgr = Manager(store, scheduler=sched, colo="on")
+        mgr.colo.dispatch_deadline_seconds = 0.05
+        mgr.colo.dispatch_watchdog.deadline_seconds = 0.05
+        mgr.colo.sync_delay_injector = lambda: _time.sleep(0.4)
+        dumps_before = mgr.colo.flight.dumps
+        changes = mgr.colo.reconcile(now=NOW + 1)
+        # two overruns (retry once, then demote) -> host oracle decisions
+        assert mgr.colo.dispatch_watchdog.overruns == 2
+        assert mgr.colo.flight.dumps >= dumps_before + 2
+        assert mgr.colo.last_pass_stats["engine"] == "host"
+        assert changes > 0
+
+    def test_flight_dump_is_schema_valid(self, tmp_path):
+        from koordinator_tpu.obs.flight import FlightRecorder, load_bundle
+
+        store = _world(nodes=2)
+        sched = Scheduler(store)
+        mgr = Manager(store, scheduler=sched, colo="on")
+        mgr.colo.flight = FlightRecorder(dump_dir=str(tmp_path))
+        assert mgr.tick(now=NOW + 1)
+        mgr.colo.flight.dump("colo_parity_mismatch")
+        files = list(tmp_path.glob("*.jsonl"))
+        assert len(files) == 1
+        header, cycles, errors = load_bundle(
+            files[0].read_text().splitlines())
+        assert errors == []
+        assert header["reason"] == "colo_parity_mismatch"
+        assert cycles and "colo_device" in cycles[-1]["metrics"]
+
+    def test_host_pin_and_ineligible_guard(self):
+        store = _world(nodes=2)
+        # a non-integer quota min demotes the pass per-pass (exactness
+        # envelope), host oracle decisions intact
+        store.add(KIND_ELASTIC_QUOTA, ElasticQuota(
+            meta=ObjectMeta(name="frac", namespace="t"),
+            min=ResourceList.of(cpu=1000, memory=GIB + 512 * 1024),
+            max=ResourceList.of(cpu=2000, memory=2 * GIB)))
+        sched = Scheduler(store)
+        mgr = Manager(store, scheduler=sched, colo="on")
+        assert mgr.tick(now=NOW + 1)
+        assert mgr.colo.last_pass_stats["engine"] == "host-ineligible"
+        assert store.get(KIND_NODE, "/n0").allocatable[BATCH_CPU] > 0
+
+
+# ---------------------------------------------------------------------------
+# the device quota fold vs compute_runtime_quotas
+# ---------------------------------------------------------------------------
+
+class TestDeviceQuotaFold:
+    def _fold_pair(self, tree, total):
+        import jax.numpy as jnp
+
+        from koordinator_tpu.colo.step import device_runtime_quotas
+        from koordinator_tpu.ops.quota import compute_runtime_quotas
+
+        host = compute_runtime_quotas(tree, np.asarray(total, np.float32))
+        G = len(tree.names)
+        enable = (tree.enable_min_scale
+                  if tree.enable_min_scale.shape[0] == G
+                  else np.ones(G, bool))
+        dev = device_runtime_quotas(
+            jnp.asarray(tree.parent.astype(np.int32)),
+            jnp.asarray(tree.level.astype(np.int32)),
+            jnp.asarray(tree.min.astype(np.float32)),
+            jnp.asarray(tree.max.astype(np.float32)),
+            jnp.asarray(tree.shared_weight.astype(np.float32)),
+            jnp.asarray(tree.guarantee.astype(np.float32)),
+            jnp.asarray(tree.request.astype(np.float32)),
+            jnp.asarray(enable),
+            jnp.asarray(tree.allow_lent.astype(bool)),
+            jnp.asarray(np.ones(G, bool)),
+            jnp.asarray(np.asarray(total, np.float32)))
+        return np.asarray(dev), host
+
+    def _quota(self, name, min_cpu, max_cpu, parent=None, labels=None):
+        labels = dict(labels or {})
+        if parent:
+            labels["quota.scheduling.koordinator.sh/parent"] = parent
+        return ElasticQuota(
+            meta=ObjectMeta(name=name, namespace="t", labels=labels),
+            min=ResourceList.of(cpu=min_cpu, memory=min_cpu * 1024 * 1024),
+            max=ResourceList.of(cpu=max_cpu, memory=max_cpu * 1024 * 1024))
+
+    def test_scaled_min_path_is_exact(self):
+        """AutoScaleMin fires when the cluster total drops below the
+        root mins — the fold's one float64 site (floor(avail*min/sum))
+        must match bit-for-bit via the int32 modular correction."""
+        from koordinator_tpu.ops.quota import build_quota_tree
+
+        quotas = [
+            self._quota("sa", 7_000, 50_000),
+            self._quota("sb", 9_000, 50_000),
+            self._quota("sc", 5_000, 50_000),
+        ]
+        requests = {
+            "sa": ResourceList.of(cpu=30_000, memory=3000 * 1024 * 1024
+                                  ).to_vector(),
+            "sb": ResourceList.of(cpu=10_000, memory=900 * 1024 * 1024
+                                  ).to_vector(),
+            "sc": ResourceList.of(cpu=2_000, memory=100 * 1024 * 1024
+                                  ).to_vector(),
+        }
+        tree = build_quota_tree(quotas, pod_requests_by_quota=requests)
+        # total BELOW the min sum (21000): scaling must engage, and the
+        # 13k/21k proportions exercise non-trivial floors
+        for total_cpu in (13_001, 13_003, 20_999, 21_000, 1, 6_999):
+            total = np.zeros_like(tree.min[0])
+            total[RESOURCE_INDEX[ResourceName.CPU]] = total_cpu
+            total[RESOURCE_INDEX[ResourceName.MEMORY]] = total_cpu
+            dev, host = self._fold_pair(tree, total)
+            assert np.array_equal(dev, host), total_cpu
+
+    def test_water_fill_and_tree_levels(self):
+        from koordinator_tpu.ops.quota import build_quota_tree
+
+        quotas = [
+            self._quota("root", 10_000, 40_000,
+                        labels={"quota.scheduling.koordinator.sh/"
+                                "is-parent": "true"}),
+            self._quota("wa", 4_000, 30_000, parent="root"),
+            self._quota("wb", 6_000, 30_000, parent="root",
+                        labels={"quota.scheduling.koordinator.sh/"
+                                "allow-lent-resource": "false"}),
+        ]
+        requests = {
+            "wa": ResourceList.of(cpu=25_000,
+                                  memory=2500 * 1024 * 1024).to_vector(),
+            "wb": ResourceList.of(cpu=1_000,
+                                  memory=100 * 1024 * 1024).to_vector(),
+        }
+        tree = build_quota_tree(quotas, pod_requests_by_quota=requests)
+        total = np.zeros_like(tree.min[0])
+        total[RESOURCE_INDEX[ResourceName.CPU]] = 100_000
+        total[RESOURCE_INDEX[ResourceName.MEMORY]] = 100_000
+        dev, host = self._fold_pair(tree, total)
+        assert np.array_equal(dev, host)
+
+    def test_exact_floordiv_unit(self):
+        import jax.numpy as jnp
+
+        from koordinator_tpu.colo.step import _exact_floordiv
+
+        rng = np.random.default_rng(7)
+        a = rng.integers(0, 2 ** 24, size=512).astype(np.float32)
+        s = rng.integers(1, 2 ** 24, size=512).astype(np.float32)
+        m = (s * rng.random(512)).astype(np.int64).astype(np.float32)
+        got = np.asarray(_exact_floordiv(
+            jnp.asarray(a), jnp.asarray(m), jnp.asarray(s)))
+        want = (a.astype(np.int64) * m.astype(np.int64)
+                // s.astype(np.int64)).astype(np.float32)
+        assert np.array_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# epoch memos + the revoke loop consuming the device mask
+# ---------------------------------------------------------------------------
+
+class TestRuntimeMemoAndRevoke:
+    def _quota_world(self):
+        store = _world(nodes=2)
+        store.add(KIND_ELASTIC_QUOTA, ElasticQuota(
+            meta=ObjectMeta(name="qa", namespace="t"),
+            min=ResourceList.of(cpu=1000, memory=GIB),
+            max=ResourceList.of(cpu=2000, memory=2 * GIB)))
+        store.add(KIND_POD, Pod(
+            meta=ObjectMeta(name="hog", namespace="t", uid="hog",
+                            owner_kind="ReplicaSet", owner_name="rs",
+                            labels={LABEL_QUOTA_NAME: "qa"}),
+            spec=PodSpec(node_name="n0", priority=9500,
+                         requests=ResourceList.of(cpu=6000,
+                                                  memory=6 * GIB)),
+            phase="Running"))
+        return store
+
+    def test_runtime_memo_hits_on_unchanged_epochs(self, monkeypatch):
+        store = self._quota_world()
+        sched = Scheduler(store)
+        plugin = sched.extender.plugin("ElasticQuota")
+        import koordinator_tpu.scheduler.plugins.elasticquota as eq
+        from koordinator_tpu.ops import quota as quota_ops
+
+        calls = {"n": 0}
+        real = quota_ops.compute_runtime_quotas
+
+        def counted(*a, **k):
+            calls["n"] += 1
+            return real(*a, **k)
+
+        monkeypatch.setattr(quota_ops, "compute_runtime_quotas", counted)
+        assert eq  # silence linters
+        plugin.tree_snapshot(store)
+        plugin.tree_snapshot(store)
+        plugin.tree_snapshot(store)
+        assert calls["n"] == 1  # memoized on (tree, state, node) epochs
+        # an update that does NOT move used/pending keeps the memo
+        pod = store.get(KIND_POD, "t/hog")
+        store.update(KIND_POD, pod)
+        plugin.tree_snapshot(store)
+        assert calls["n"] == 1
+        # a quota member leaving moves the state epoch -> recompute
+        store.delete(KIND_POD, "t/hog")
+        plugin.tree_snapshot(store)
+        assert calls["n"] == 2
+        # a node event moves the cluster total -> recompute
+        node = store.get(KIND_NODE, "/n0")
+        store.update(KIND_NODE, node)
+        plugin.tree_snapshot(store)
+        assert calls["n"] == 3
+
+    def test_revoke_consumes_device_mask(self):
+        store = self._quota_world()
+        sched = Scheduler(store)
+        mgr = Manager(store, scheduler=sched, colo="on")
+        plugin = sched.extender.plugin("ElasticQuota")
+        args = dataclasses.replace(
+            sched.config.elastic_quota, monitor_all_quotas=True,
+            delay_evict_time_seconds=5.0,
+            revoke_pod_interval_seconds=1.0)
+        ctl = plugin.revoke_controller(store, args)
+        assert mgr.tick(now=NOW + 1)
+        dr = plugin.fresh_device_runtime()
+        assert dr is not None
+        assert bool(dr[4][dr[1].index("qa")])  # the device revoke mask
+        assert ctl.reconcile(NOW + 1) == []    # grace window
+        assert mgr.tick(now=NOW + 20)
+        assert plugin.fresh_device_runtime() is not None
+        evicted = ctl.reconcile(NOW + 20)
+        assert evicted == ["t/hog"]
+        # the eviction itself moved the epochs: stale publish withdrawn
+        assert plugin.fresh_device_runtime() is None
+
+
+# ---------------------------------------------------------------------------
+# config hot-reload -> policy scalars, without a step-cache leak
+# ---------------------------------------------------------------------------
+
+class TestConfigHotReload:
+    @staticmethod
+    def _set_cm(store, data):
+        key = "koordinator-system/slo-controller-config"
+        cm = store.get(KIND_CONFIG_MAP, key)
+        if cm is None:
+            store.add(KIND_CONFIG_MAP, ConfigMap(
+                meta=ObjectMeta(name="slo-controller-config",
+                                namespace="koordinator-system"),
+                data=data))
+        else:
+            cm.data = data
+            store.update(KIND_CONFIG_MAP, cm)
+
+    def _cm_data(self, reclaim):
+        return {"colocation-config": json.dumps(
+            {"cpuReclaimThresholdPercent": reclaim})}
+
+    def test_hot_reload_reaches_policy_scalars(self):
+        store = _world(nodes=2, usage_cpu=0)
+        sched = Scheduler(store)
+        mgr = Manager(store, scheduler=sched, colo="on")
+        assert mgr.tick(now=NOW + 1)
+        batch_60 = store.get(KIND_NODE, "/n0").allocatable[BATCH_CPU]
+        assert batch_60 == 9600  # 16000 * 60%
+        self._set_cm(store, self._cm_data(25))
+        assert mgr.tick(now=NOW + 20)
+        assert mgr.colo.last_pass_stats["engine"] == "device"
+        assert store.get(KIND_NODE, "/n0").allocatable[BATCH_CPU] == 4000
+
+    def test_invalid_update_keeps_last_good_config(self):
+        store = _world(nodes=2, usage_cpu=0)
+        sched = Scheduler(store)
+        mgr = Manager(store, scheduler=sched, colo="on")
+        self._set_cm(store, self._cm_data(25))
+        assert mgr.tick(now=NOW + 1)
+        assert store.get(KIND_NODE, "/n0").allocatable[BATCH_CPU] == 4000
+        # a malformed update must NOT revert to the 60% default: the
+        # last good config (25%) stays effective
+        self._set_cm(store, {"colocation-config": "{not json"})
+        assert mgr.tick(now=NOW + 20)
+        assert store.get(KIND_NODE, "/n0").allocatable[BATCH_CPU] == 4000
+        # an out-of-range value is equally held off
+        self._set_cm(store, {"colocation-config": json.dumps(
+            {"cpuReclaimThresholdPercent": 900})})
+        assert mgr.tick(now=NOW + 40)
+        assert store.get(KIND_NODE, "/n0").allocatable[BATCH_CPU] == 4000
+
+    def test_node_update_with_fresh_instance_reaches_the_pass(self):
+        """store.update may swap in a NEW node object: the pack must
+        re-anchor its table entry so the fresh labels reach the device
+        pass and the writeback mutates the live object."""
+        import copy
+
+        store = _world(nodes=2, usage_cpu=0)
+        sched = Scheduler(store)
+        mgr = Manager(store, scheduler=sched, colo="on")
+        assert mgr.tick(now=NOW + 1)
+        assert store.get(KIND_NODE, "/n0").allocatable[BATCH_CPU] == 9600
+        fresh = copy.deepcopy(store.get(KIND_NODE, "/n0"))
+        fresh.meta.labels[
+            "node.koordinator.sh/cpu-reclaim-ratio"] = "0.25"
+        store.update(KIND_NODE, fresh)
+        assert mgr.tick(now=NOW + 20)
+        assert mgr.colo.last_pass_stats["engine"] == "device"
+        assert store.get(KIND_NODE, "/n0").allocatable[BATCH_CPU] == 4000
+
+    def test_no_step_cache_leak_on_config_flips(self):
+        store = _world(nodes=2, usage_cpu=0)
+        sched = Scheduler(store)
+        mgr = Manager(store, scheduler=sched, colo="on")
+        assert mgr.tick(now=NOW + 1)
+        size_after_first = len(mgr.colo._step_cache)
+        # repeated threshold flips change VALUES, not shapes/policies:
+        # the compiled step must be reused every time
+        for i, reclaim in enumerate((25, 60, 25, 60, 25, 60)):
+            self._set_cm(store, self._cm_data(reclaim))
+            assert mgr.tick(now=NOW + 30 + i * 10)
+        assert len(mgr.colo._step_cache) == size_after_first
+        # a calculate-policy flip keys ONE new entry, then flip-flopping
+        # reuses both compiled steps (shape-keyed recompile pinned)
+        policy_data = {"colocation-config": json.dumps(
+            {"cpuReclaimThresholdPercent": 60,
+             "cpuCalculatePolicy": "request"})}
+        self._set_cm(store, policy_data)
+        assert mgr.tick(now=NOW + 200)
+        grown = len(mgr.colo._step_cache)
+        assert grown == size_after_first + 1
+        for i in range(4):
+            self._set_cm(store, self._cm_data(60))
+            assert mgr.tick(now=NOW + 300 + i * 20)
+            self._set_cm(store, policy_data)
+            assert mgr.tick(now=NOW + 310 + i * 20)
+        assert len(mgr.colo._step_cache) == grown

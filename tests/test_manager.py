@@ -229,6 +229,140 @@ class TestQuotaProfileController:
         ).min[ResourceName.CPU] == int(30_000 * 0.9)
 
 
+class TestQuotaProfileLifecycle:
+    """The thin seed controller's update/delete/clamp paths (koordcolo
+    satellite: these feed the quota tree the device fold consumes)."""
+
+    def _profile(self, store, ratio=None, quota_name="quota-z1"):
+        ann = {}
+        if ratio is not None:
+            ann["quota.scheduling.koordinator.sh/total-resource-ratio"] = ratio
+        profile = ElasticQuotaProfile(
+            meta=ObjectMeta(name="profile-z1", namespace="default",
+                            annotations=ann),
+            quota_name=quota_name,
+            node_selector={"zone": "z1"},
+        )
+        store.add(KIND_QUOTA_PROFILE, profile)
+        return profile
+
+    def test_ratio_update_rematerializes(self):
+        store = ObjectStore()
+        _node(store, "a", cores=10, mem_gib=40, labels={"zone": "z1"})
+        profile = self._profile(store, ratio="1.0")
+        ctrl = QuotaProfileController(store)
+        assert ctrl.reconcile() == 1
+        assert store.get(KIND_ELASTIC_QUOTA,
+                         "default/quota-z1").min[ResourceName.CPU] == 10_000
+        profile.meta.annotations[
+            "quota.scheduling.koordinator.sh/total-resource-ratio"] = "0.5"
+        store.update(KIND_QUOTA_PROFILE, profile)
+        assert ctrl.reconcile() == 1
+        assert store.get(KIND_ELASTIC_QUOTA,
+                         "default/quota-z1").min[ResourceName.CPU] == 5_000
+        # idempotent once converged
+        assert ctrl.reconcile() == 0
+
+    def test_invalid_and_out_of_range_ratio_clamped(self):
+        store = ObjectStore()
+        _node(store, "a", cores=10, mem_gib=40, labels={"zone": "z1"})
+        self._profile(store, ratio="7.5")  # clamped to 1.0
+        ctrl = QuotaProfileController(store)
+        ctrl.reconcile()
+        assert store.get(KIND_ELASTIC_QUOTA,
+                         "default/quota-z1").min[ResourceName.CPU] == 10_000
+        store2 = ObjectStore()
+        _node(store2, "a", cores=10, mem_gib=40, labels={"zone": "z1"})
+        self._profile(store2, ratio="not-a-number")
+        QuotaProfileController(store2).reconcile()
+        assert store2.get(KIND_ELASTIC_QUOTA,
+                          "default/quota-z1").min[ResourceName.CPU] == 10_000
+
+    def test_profile_delete_stops_tracking(self):
+        store = ObjectStore()
+        _node(store, "a", cores=10, mem_gib=40, labels={"zone": "z1"})
+        self._profile(store)
+        ctrl = QuotaProfileController(store)
+        assert ctrl.reconcile() == 1
+        store.delete(KIND_QUOTA_PROFILE, "default/profile-z1")
+        # quota is retained (the reference does not GC generated quotas)
+        # but nothing tracks node changes anymore
+        _node(store, "b", cores=10, mem_gib=40, labels={"zone": "z1"})
+        assert ctrl.reconcile() == 0
+        assert store.get(KIND_ELASTIC_QUOTA,
+                         "default/quota-z1").min[ResourceName.CPU] == 10_000
+
+    def test_profile_name_fallback(self):
+        store = ObjectStore()
+        _node(store, "a", cores=10, mem_gib=40, labels={"zone": "z1"})
+        self._profile(store, quota_name="")
+        QuotaProfileController(store).reconcile()
+        assert store.get(KIND_ELASTIC_QUOTA,
+                         "default/profile-z1") is not None
+
+
+class TestNodeMetricSpec:
+    def test_report_interval_follows_config(self):
+        store = ObjectStore()
+        _node(store, "a")
+        cfg = ColocationConfig(cluster_strategy=ColocationStrategy(
+            metric_aggregate_duration_seconds=600))
+        ctrl = NodeMetricController(store, cfg)
+        assert ctrl.reconcile() == 1
+        nm = store.get(KIND_NODE_METRIC, "/a")
+        assert nm.report_interval_seconds == max(60, 600 // 5)
+        # idempotent; a fresh node materializes on the next round
+        assert ctrl.reconcile() == 0
+        _node(store, "b")
+        assert ctrl.reconcile() == 1
+
+
+class TestNodeSLOUpdatePath:
+    def test_config_change_updates_existing_slo(self):
+        store = ObjectStore()
+        _node(store, "a")
+        cm = ConfigMap(
+            meta=ObjectMeta(name="slo-controller-config",
+                            namespace="koordinator-system"),
+            data={"resource-threshold-config": json.dumps(
+                {"clusterStrategy": {"enable": True,
+                                     "cpuSuppressThresholdPercent": 60}})})
+        store.add(KIND_CONFIG_MAP, cm)
+        ctrl = NodeSLOController(store)
+        assert ctrl.reconcile() == 1
+        slo = store.get(KIND_NODE_SLO, "/a")
+        rv = slo.meta.resource_version
+        # hot reload: the SAME CR is updated in place, not re-added
+        cm.data["resource-threshold-config"] = json.dumps(
+            {"clusterStrategy": {"enable": True,
+                                 "cpuSuppressThresholdPercent": 45}})
+        store.update(KIND_CONFIG_MAP, cm)
+        assert ctrl.reconcile() == 1
+        slo2 = store.get(KIND_NODE_SLO, "/a")
+        assert (slo2.resource_used_threshold_with_be
+                .cpu_suppress_threshold_percent == 45)
+        assert slo2.meta.resource_version > rv
+
+    def test_cpu_burst_and_system_strategies_render(self):
+        store = ObjectStore()
+        _node(store, "a")
+        store.add(KIND_CONFIG_MAP, ConfigMap(
+            meta=ObjectMeta(name="slo-controller-config",
+                            namespace="koordinator-system"),
+            data={
+                "cpu-burst-config": json.dumps(
+                    {"clusterStrategy": {"policy": "auto",
+                                         "cpuBurstPercent": 500}}),
+                "system-config": json.dumps(
+                    {"clusterStrategy": {"minFreeKbytesFactor": 200}}),
+            }))
+        NodeSLOController(store).reconcile()
+        slo = store.get(KIND_NODE_SLO, "/a")
+        assert slo.cpu_burst_strategy.policy == "auto"
+        assert slo.cpu_burst_strategy.cpu_burst_percent == 500
+        assert slo.system_strategy.min_free_kbytes_factor == 200
+
+
 class TestWebhooks:
     def test_colocation_profile_mutation(self):
         store = ObjectStore()

@@ -1097,6 +1097,62 @@ class TestHostLoopInRebalancePath:
                 f"(pragma event-maintenance loops)")
 
 
+class TestHostReconcileInColoPath:
+    RULE = "host-reconcile-in-colo-path"
+    PATH = "koordinator_tpu/colo/extra.py"
+
+    def test_positive_for_loop_and_store_walk(self):
+        src = """
+            def reconcile(view, store):
+                total = 0
+                for i in range(len(view)):
+                    total += view[i]
+                nodes = store.list(KIND_NODE)
+                return total, nodes
+        """
+        out = findings_for(src, self.RULE, path=self.PATH)
+        assert len(out) == 2
+        assert any("for-loop" in f.message for f in out)
+        assert any("second state encode" in f.message for f in out)
+
+    def test_negative_outside_colo(self):
+        src = """
+            def reconcile(view, store):
+                for i in range(len(view)):
+                    pass
+                store.list(KIND_NODE)
+        """
+        assert findings_for(
+            src, self.RULE,
+            path="koordinator_tpu/slocontroller/noderesource.py") == []
+        # comprehensions are not the host reconcile loop
+        src2 = """
+            def names(view):
+                return [v.name for v in view]
+        """
+        assert findings_for(src2, self.RULE, path=self.PATH) == []
+
+    def test_pragma_licenses_event_maintenance(self):
+        src = """
+            def refresh(self):
+                # koordlint: disable=host-reconcile-in-colo-path
+                for name in self._dirty:
+                    self._rows[name] = self._build(name)
+        """
+        assert findings_for(src, self.RULE, path=self.PATH) == []
+
+    def test_shipped_colo_package_is_clean(self):
+        for mod in ("pack", "step", "reconciler", "__init__"):
+            path = REPO_ROOT / "koordinator_tpu" / "colo" / f"{mod}.py"
+            out = analyze_source(
+                path.read_text(),
+                path=f"koordinator_tpu/colo/{mod}.py",
+                rules={self.RULE: all_rules()[self.RULE]})
+            assert [f for f in out if f.rule == self.RULE] == [], (
+                f"colo/{mod}.py must stay a tensor pass "
+                f"(pragma event-maintenance loops)")
+
+
 class TestConcurrencyGatedPaths:
     """The concurrency rules must keep covering the modules that share
     state across threads — a path-regex refactor that silently drops one
