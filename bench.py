@@ -479,10 +479,12 @@ def run_sim_churn(args_cli, scenario) -> None:
     # occupancy + per-K throughput under REALISTIC arrivals (not the
     # synthetic 2%-delta loop): both runs of the pair, so the occupancy
     # number itself is citable as a back-to-back pair
-    occ_pair = [r.to_dict()["pipeline"]["occupancy"] for r in reports]
+    a_dict = a.to_dict()  # built once: each call rebuilds the SLO math
+    occ_pair = [a_dict["pipeline"]["occupancy"],
+                b.to_dict()["pipeline"]["occupancy"]]
     log(f"pipeline occupancy (pair): {occ_pair[0]:.3f} / {occ_pair[1]:.3f}; "
         f"pods/s by consumed waves: "
-        f"{a.to_dict()['pipeline']['pods_per_sec_at_k']}")
+        f"{a_dict['pipeline']['pods_per_sec_at_k']}")
     print(json.dumps({
         "metric": f"churn_bound_pods_per_sec_{sc.name}",
         "value": pair[0],
@@ -494,7 +496,7 @@ def run_sim_churn(args_cli, scenario) -> None:
         "cycles": sc.cycles,
         "pipeline_occupancy": occ_pair[0],
         "pipeline_occupancy_pair": occ_pair,
-        "pods_per_sec_at_k": a.to_dict()["pipeline"]["pods_per_sec_at_k"],
+        "pods_per_sec_at_k": a_dict["pipeline"]["pods_per_sec_at_k"],
         "ttb_p50_seconds": round(a.percentile(50), 3),
         "ttb_p99_seconds": round(a.percentile(99), 3),
         "ttb_slo_seconds": sc.ttb_slo_seconds,
@@ -507,12 +509,19 @@ def run_sim_churn(args_cli, scenario) -> None:
         # level (incl. partial-mesh) and the restart-to-first-bind SLO
         "deadline_overruns": a.deadline_overruns,
         "cycles_at_level": a.cycles_at_level,
-        "restart": a.to_dict()["restart"],
+        "restart": a_dict["restart"],
         "pair_deterministic": deterministic,
         "binding_log_sha256": a.binding_log_sha256,
         # koordbalance: migration-job/eviction activity + the hotspot
         # time-to-dissipate SLO (cycles), straight from the SimReport
-        "rebalance": a.to_dict()["rebalance"],
+        "rebalance": a_dict["rebalance"],
+        # koordwatch: the per-scenario demotion profile (fraction of
+        # cycles demoted, by structured reason — the real-traffic data
+        # the ROADMAP demotion burn-down starts from), the queue
+        # depth/wait stats, and the SLO registry dump with burn rates
+        "demotions": a_dict["demotions"],
+        "queue": a_dict["queue"],
+        "slos": a_dict["slos"],
         "platform": jax.default_backend(),
     }))
 
@@ -1080,6 +1089,41 @@ def run_steady_state(args_cli, num_pods: int, num_nodes: int) -> dict:
         "explain_overhead_pct": round(overhead, 1),
         "steady_pods_per_sec_explain_counts": round(pps_counts, 1),
         "steady_pods_per_sec_explain_off": round(pps_off, 1),
+    })
+
+    # ---- koordwatch overhead: the same steady loop with the device
+    # timeline + demotion accounting + queue metrics on vs off, as a
+    # back-to-back A/B pair inside ONE process (BENCH_NOTES convention).
+    # Target <= 2%, the koordexplain budget discipline.
+    def steady_pps_watch(watch_on: bool) -> float:
+        store_w, _state_w = make_store()
+        sched_w = Scheduler(store_w, waves=1, watch=watch_on)
+        pl_w = CyclePipeline(sched_w)
+        pl_w.run_cycle(now=now)  # cold build + compile
+        walls_w, bound_w = [], []
+        for r in range(1, warmup + rounds + 1):
+            apply_delta(store_w, r, now)
+            t = now + 2 * r
+            t0 = time.perf_counter()
+            res_w = pl_w.run_cycle(now=t)
+            wall = time.perf_counter() - t0
+            if r > warmup:
+                walls_w.append(wall)
+                bound_w.append(len(res_w.bound))
+        pl_w.flush()
+        wsum = float(np.sum(walls_w))
+        return float(np.sum(bound_w)) / wsum if wsum else 0.0
+
+    pps_watch_on = steady_pps_watch(True)
+    pps_watch_off = steady_pps_watch(False)
+    watch_overhead = (100.0 * (1.0 - pps_watch_on / pps_watch_off)
+                      if pps_watch_off > 0 else 0.0)
+    log(f"koordwatch overhead (A/B pair): on {pps_watch_on:,.1f} vs off "
+        f"{pps_watch_off:,.1f} pods/s -> {watch_overhead:+.1f}%")
+    out.update({
+        "koordwatch_overhead_pct": round(watch_overhead, 1),
+        "steady_pods_per_sec_watch_on": round(pps_watch_on, 1),
+        "steady_pods_per_sec_watch_off": round(pps_watch_off, 1),
     })
 
     # ---- fused-wave sweep: the same steady loop pinned to each K

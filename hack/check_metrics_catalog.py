@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""README metric-catalog drift gate (koordwatch satellite).
+
+Every metric name registered in code must appear in the README's
+"### Metric catalog" table, and every non-wildcard catalog row must
+correspond to a registered metric — so the catalog can never rot again.
+
+Code side: a plain AST scan (koordlint discipline — no imports of the
+scanned code, no jax) over ``koordinator_tpu/`` for
+``<registry>.counter("koord...") / .gauge(...) / .histogram(...)`` calls
+whose first argument is a string literal starting with ``koord`` (test
+registries use short names and are excluded by that prefix and by path).
+
+README side: the first backtick-quoted token of each table row's first
+cell. A token ending in ``*`` is a prefix wildcard (the koordlet row
+covers its long tail of per-strategy gauges/counters).
+
+Exit 0 clean; exit 1 with the drift diff otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+REGISTER_METHODS = {"counter", "gauge", "histogram"}
+
+
+def registered_names() -> set:
+    names = set()
+    for path in sorted((REPO / "koordinator_tpu").rglob("*.py")):
+        if "_pb2" in path.name:
+            continue
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not (isinstance(func, ast.Attribute)
+                    and func.attr in REGISTER_METHODS):
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                    and arg.value.startswith("koord")):
+                names.add(arg.value)
+    return names
+
+
+def catalog_names() -> set:
+    readme = (REPO / "README.md").read_text()
+    m = re.search(r"### Metric catalog\n(.*?)\n###", readme, re.S)
+    if m is None:
+        m = re.search(r"### Metric catalog\n(.*?)\n## ", readme, re.S)
+    if m is None:
+        print("check_metrics_catalog: no '### Metric catalog' section "
+              "in README.md", file=sys.stderr)
+        sys.exit(1)
+    names = set()
+    for line in m.group(1).splitlines():
+        if not line.startswith("|"):
+            continue
+        cell = line.split("|")[1].strip()
+        token = re.match(r"`([^`]+)`", cell)
+        if token:
+            names.add(token.group(1))
+    return names
+
+
+def main() -> int:
+    code = registered_names()
+    catalog = catalog_names()
+    wildcards = {c[:-1] for c in catalog if c.endswith("*")}
+    exact = {c for c in catalog if not c.endswith("*")}
+
+    def covered(name: str) -> bool:
+        return name in exact or any(name.startswith(w) for w in wildcards)
+
+    missing_from_readme = sorted(n for n in code if not covered(n))
+    stale_in_readme = sorted(n for n in exact if n not in code)
+    if missing_from_readme:
+        print("metrics registered in code but MISSING from the README "
+              "metric catalog:", file=sys.stderr)
+        for n in missing_from_readme:
+            print(f"  {n}", file=sys.stderr)
+    if stale_in_readme:
+        print("README metric-catalog rows with no registration in code:",
+              file=sys.stderr)
+        for n in stale_in_readme:
+            print(f"  {n}", file=sys.stderr)
+    if missing_from_readme or stale_in_readme:
+        return 1
+    print(f"metric catalog in sync: {len(code)} registered names, "
+          f"{len(exact)} catalog rows + {len(wildcards)} wildcard(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

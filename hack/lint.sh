@@ -11,7 +11,8 @@ echo "== koordlint =="
 python -m koordinator_tpu.analysis koordinator_tpu bench.py
 
 echo "== compileall =="
-python -m compileall -q koordinator_tpu bench.py tests hack/microbench.py
+python -m compileall -q koordinator_tpu bench.py tests hack/microbench.py \
+    hack/check_metrics_catalog.py
 
 echo "== serial-vs-pipelined + fused-wave + explain + mesh cycle parity =="
 # same store fixture through the strictly serial path, the CyclePipeline,
@@ -53,6 +54,22 @@ echo "== flight-recorder bundle schema (golden fixture) =="
 # drift against the checked-in bundle must be a conscious
 # FLIGHT_SCHEMA_VERSION bump + fixture regeneration
 python -m koordinator_tpu.obs flight tests/fixtures/flight_golden.jsonl > /dev/null
+
+echo "== koordwatch timeline bundle schema (golden fixture) =="
+# the koordwatch device-timeline JSONL (obs/timeline.py, the
+# /debug/timeline body): drift must be a conscious
+# TIMELINE_SCHEMA_VERSION bump + fixture regeneration
+python -m koordinator_tpu.obs timeline tests/fixtures/timeline_golden.jsonl > /dev/null
+
+echo "== koordwatch slo bundle schema (golden fixture) =="
+# the koordwatch SLO registry JSONL (obs/slo.py, the /debug/slo body)
+python -m koordinator_tpu.obs slo tests/fixtures/slo_golden.jsonl > /dev/null
+
+echo "== README metric-catalog drift gate =="
+# every metric name registered in code must appear in the README metric
+# catalog and vice versa (hack/check_metrics_catalog.py) — the catalog
+# can never rot again
+python hack/check_metrics_catalog.py > /dev/null
 
 echo "== koordsim seeded smoke scenario (determinism + invariants) =="
 # the fixed-seed smoke scenario through the REAL Scheduler (~50 cycles:

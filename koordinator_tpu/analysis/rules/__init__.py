@@ -4,6 +4,7 @@ from koordinator_tpu.analysis.rules import (  # noqa: F401
     balance,
     colo,
     concurrency,
+    demotion,
     jaxtrace,
     loops,
     pipeline,

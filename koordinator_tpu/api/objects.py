@@ -55,6 +55,11 @@ ANNOTATION_NODE_RESERVATION = NODE_DOMAIN_PREFIX + "/reservation"
 # {"cpuset": "0-1", "cpusetExclusive": true} — exclusive (the default) bars
 # LS/LSR/BE pods from those cores
 ANNOTATION_NODE_SYSTEM_QOS = NODE_DOMAIN_PREFIX + "/system-qos-resource"
+# koordwatch decision correlation (obs/timeline.py): the device-window
+# decision id a PodMigrationJob was issued under, copied onto its
+# replacement Reservation — joins descheduler decisions to scheduler
+# timeline windows, spans and flight records
+ANNOTATION_DECISION_ID = DOMAIN_PREFIX + "decision-id"
 # pod operating mode (apis/extension/operating_pod.go:28-50): a pod labeled
 # "Reservation" schedules normally but then acts as a reservation whose
 # owners (JSON ReservationOwner list annotation) consume its resources

@@ -94,13 +94,36 @@ class DeviceRebalancer:
                  promote_after: int = 16,
                  tracer: Optional[Tracer] = None,
                  flight: Optional[FlightRecorder] = None,
-                 dispatch_deadline_ms=None) -> None:
+                 dispatch_deadline_ms=None,
+                 timeline=None) -> None:
         self.mesh = mesh
         self.snapshot_getter = snapshot_getter
         self.ladder = ladder if ladder is not None else DegradationLadder(
             promote_after=promote_after)
         self.tracer = tracer if tracer is not None else Tracer()
         self.flight = flight if flight is not None else FlightRecorder()
+        # koordwatch: the device timeline this pass records its windows
+        # into — the SCHEDULER's ring when co-located (the three
+        # consumers share one device, so they share one timeline and one
+        # decision-id sequence), a private ring standalone. Every pass
+        # mints a decision id; migration jobs carry it (-> Reservation
+        # annotations), joining descheduler decisions to the window.
+        if timeline is None:
+            # standalone: record into the DESCHEDULER's registry — the
+            # one this binary's /metrics actually serves — and honor
+            # the KOORD_TPU_WATCH kill switch like every other ring
+            from koordinator_tpu.descheduler import metrics as dm
+            from koordinator_tpu.obs.timeline import (
+                DeviceTimeline,
+                watch_from_env,
+            )
+
+            timeline = DeviceTimeline(
+                window_histogram=dm.DEVICE_WINDOW_SECONDS,
+                idle_gauge=dm.DEVICE_IDLE_FRACTION,
+                enabled=watch_from_env())
+        self.timeline = timeline
+        self.last_decision_id: Optional[str] = None
         self._step_cache: Dict[Tuple, object] = {}
         self._own_snapshots: Dict[bool, object] = {}  # mesh_on -> mirror
         self._seq = 0
@@ -262,6 +285,13 @@ class DeviceRebalancer:
         t0 = time.perf_counter()
         self._seq += 1
         self.ladder.begin_pass()
+        # koordwatch: one decision id per pass (device OR host — jobs
+        # need the join either way); the timeline window records only
+        # completed device passes
+        win = self.timeline.open(
+            "rebalance",
+            "mesh" if self._active_mesh() is not None else "serial")
+        self.last_decision_id = win.decision_id
         reason = self._device_eligible(view)
         if reason is not None:
             if not self._warned_host_only:
@@ -270,16 +300,26 @@ class DeviceRebalancer:
                 self._warned_host_only = True
             return self._host_pass(plugin, view, now, t0,
                                    engine="host-ineligible")
+        attempts = 0
+        had_deadline = False
+        level0 = self.ladder.level
         while True:
             if self.ladder.level >= LEVEL_HOST_FALLBACK:
                 return self._host_pass(plugin, view, now, t0)
             mesh = self._active_mesh()
             try:
-                picked, stats = self._device_pass(plugin, view, mesh)
+                picked, stats = self._device_pass(plugin, view, mesh, win)
+                outcome = ("deadline" if had_deadline
+                           else "demoted" if self.ladder.level > level0
+                           else "retried" if attempts else "clean")
+                self.timeline.close(win, outcome)
                 self._record(now, t0, stats)
                 self.ladder.note_cycle()
                 return picked, stats
             except Exception as exc:
+                attempts += 1
+                if isinstance(exc, DispatchDeadlineExceeded):
+                    had_deadline = True
                 action = self.ladder.on_failure(
                     self._features(),
                     error=f"{type(exc).__name__}: {exc}")
@@ -301,6 +341,7 @@ class DeviceRebalancer:
                  "candidates": int(plugin.last_pass_stats.get(
                      "candidates", 0)),
                  "victims": int(picked.size),
+                 "decision_id": self.last_decision_id,
                  "ladder_level": self.ladder.level_name}
         self.stats["host_passes"] += 1
         self.stats["candidates"] += stats["candidates"]
@@ -309,7 +350,7 @@ class DeviceRebalancer:
         self.ladder.note_cycle()
         return picked, stats
 
-    def _device_pass(self, plugin, view, mesh):
+    def _device_pass(self, plugin, view, mesh, win):
         if self.fault_injector is not None:
             self.fault_injector()
         with self.tracer.span("classify") as csp:
@@ -339,10 +380,12 @@ class DeviceRebalancer:
                     np.asarray(out.margin)[:n])
 
         snap.begin_dispatch()
+        win.mark_dispatch("mesh" if mesh is not None else "serial")
         abandoned = False
         try:
             with self.tracer.span("score", mesh=str(
-                    mesh.devices.size if mesh is not None else 0)):
+                    mesh.devices.size if mesh is not None else 0),
+                    decision_id=win.decision_id):
                 dev = snap.upload_fields(fields)
                 out = step(dev["rb_usage_pct"], dev["rb_has_metric"],
                            dev["rb_low_thr"], dev["rb_high_thr"],
@@ -376,6 +419,7 @@ class DeviceRebalancer:
                  "victims": sel_count,
                  "is_low": is_low, "is_high": is_high, "margin": margin,
                  "victim_nodes": sel_node, "victim_scores": sel_score,
+                 "decision_id": win.decision_id,
                  "ladder_level": self.ladder.level_name}
         self.stats["device_passes"] += 1
         self.stats["candidates"] += cand_count
@@ -402,6 +446,9 @@ class DeviceRebalancer:
             "duration_ms": duration * 1000.0,
             "waves": 0,
             "bound": [], "failed": [], "rejected": [], "preempted": [],
+            # koordwatch: joins this pass to its timeline window and to
+            # the migration jobs it issued
+            "decision_id": str(stats.get("decision_id") or ""),
             "metrics": {
                 "rebalance_candidates": float(stats.get("candidates", 0)),
                 "rebalance_victims": float(stats.get("victims", 0)),

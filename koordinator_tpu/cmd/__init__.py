@@ -49,10 +49,12 @@ def add_loop_flags(ap, default_interval: float) -> None:
 
 
 def serve_obs(port: int, metrics_registry, name: str, tracer=None,
-              health_provider=None, explain_provider=None, flight=None):
+              health_provider=None, explain_provider=None, flight=None,
+              timeline=None, slo=None):
     """`--obs-port` wiring shared by the binaries: serve /metrics (and
     /traces when a tracer is given, plus the koordexplain surfaces when
-    providers are given) via obs.server.ObsServer and announce the bound
+    providers are given, plus the koordwatch /debug/timeline and
+    /debug/slo bundles) via obs.server.ObsServer and announce the bound
     address. Returns the live server, or None when port is 0; the caller
     shuts it down after its tick loop ends."""
     if not port:
@@ -61,12 +63,17 @@ def serve_obs(port: int, metrics_registry, name: str, tracer=None,
 
     server, _thread = ObsServer(
         metrics_registry, tracer, health_provider=health_provider,
-        explain_provider=explain_provider, flight=flight).serve(port)
+        explain_provider=explain_provider, flight=flight,
+        timeline=timeline, slo=slo).serve(port)
     routes = "/metrics + /traces" if tracer is not None else "/metrics"
     if explain_provider is not None:
         routes += " + /explain"
     if flight is not None:
         routes += " + /debug/flightrecorder"
+    if timeline is not None:
+        routes += " + /debug/timeline"
+    if slo is not None:
+        routes += " + /debug/slo"
     print(f"{name}: {routes} on 127.0.0.1:{server.server_address[1]}",
           file=sys.stderr)
     return server

@@ -39,8 +39,12 @@ def main(argv=None) -> int:
     desched = Descheduler(store, elector=elector)
     from koordinator_tpu.descheduler import metrics as descheduler_metrics
 
-    obs_server = serve_obs(args.obs_port, descheduler_metrics.REGISTRY,
-                           "koord-descheduler")
+    obs_server = serve_obs(
+        args.obs_port, descheduler_metrics.REGISTRY, "koord-descheduler",
+        # koordwatch: the rebalance pass's device-window ring (private
+        # when the descheduler runs without a co-located scheduler)
+        timeline=(desched.rebalancer.timeline
+                  if desched.rebalancer is not None else None))
 
     def tick():
         summary = desched.run_once()

@@ -43,7 +43,9 @@ def main(argv=None) -> int:
         args.obs_port, manager_metrics.REGISTRY, "koord-manager",
         tracer=mgr.tracer,
         health_provider=mgr.health_snapshot,
-        flight=(mgr.colo.flight if mgr.colo is not None else None))
+        flight=(mgr.colo.flight if mgr.colo is not None else None),
+        # koordwatch: the colo pass's device-window ring
+        timeline=(mgr.colo.timeline if mgr.colo is not None else None))
 
     def tick():
         leading = mgr.tick()

@@ -60,7 +60,8 @@ def main(argv=None) -> int:
                            "koord-scheduler", tracer=sched.tracer,
                            health_provider=sched.health_snapshot,
                            explain_provider=sched.explain_record,
-                           flight=sched.flight)
+                           flight=sched.flight,
+                           timeline=sched.timeline)
 
     def tick():
         result = sched.run_cycle()

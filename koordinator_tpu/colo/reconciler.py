@@ -128,7 +128,8 @@ class DeviceColoReconciler:
                  tracer: Optional[Tracer] = None,
                  flight: Optional[FlightRecorder] = None,
                  dispatch_deadline_ms=None,
-                 engine: str = "on") -> None:
+                 engine: str = "on",
+                 timeline=None) -> None:
         self.store = store
         self.controller = controller
         self.quota_plugin = quota_plugin
@@ -140,6 +141,29 @@ class DeviceColoReconciler:
             promote_after=promote_after)
         self.tracer = tracer if tracer is not None else Tracer()
         self.flight = flight if flight is not None else FlightRecorder()
+        # koordwatch: the device timeline this pass records its windows
+        # into — the SCHEDULER's ring when co-located (three consumers,
+        # one device, one ring / decision-id sequence), else private.
+        # The per-pass decision id lands on last_pass_stats and the
+        # flight record; it is deliberately NOT written to the store —
+        # the batch/mid writeback must stay engine-independent byte for
+        # byte (run_colo_parity pins that).
+        if timeline is None:
+            # standalone: record into the MANAGER's registry — the one
+            # this binary's /metrics actually serves — and honor the
+            # KOORD_TPU_WATCH kill switch like every other ring
+            from koordinator_tpu import manager_metrics as mm
+            from koordinator_tpu.obs.timeline import (
+                DeviceTimeline,
+                watch_from_env,
+            )
+
+            timeline = DeviceTimeline(
+                window_histogram=mm.DEVICE_WINDOW_SECONDS,
+                idle_gauge=mm.DEVICE_IDLE_FRACTION,
+                enabled=watch_from_env())
+        self.timeline = timeline
+        self.last_decision_id: Optional[str] = None
         self._step_cache: Dict[Tuple, object] = {}
         self._own_snapshots: Dict[bool, object] = {}  # mesh_on -> mirror
         self._seq = 0
@@ -345,6 +369,12 @@ class DeviceColoReconciler:
         if not view["nodes"]:
             self.last_pass_stats = {"engine": "empty"}
             return 0
+        # koordwatch: one decision id per pass (device or host); only a
+        # completed device pass records a timeline window
+        win = self.timeline.open(
+            "colo",
+            "mesh" if self._active_mesh() is not None else "serial")
+        self.last_decision_id = win.decision_id
         if self.engine != "on":
             return self._host_pass(view, now, t0, engine="host-pinned")
         reason = self._device_eligible(qv)
@@ -354,15 +384,25 @@ class DeviceColoReconciler:
                                "the host oracle", reason)
                 self._warned_host_only = True
             return self._host_pass(view, now, t0, engine="host-ineligible")
+        attempts = 0
+        had_deadline = False
+        level0 = self.ladder.level
         while True:
             if self.ladder.level >= LEVEL_HOST_FALLBACK:
                 return self._host_pass(view, now, t0)
             mesh = self._active_mesh()
             try:
-                changes = self._device_pass(view, qv, now, t0, mesh)
+                changes = self._device_pass(view, qv, now, t0, mesh, win)
+                outcome = ("deadline" if had_deadline
+                           else "demoted" if self.ladder.level > level0
+                           else "retried" if attempts else "clean")
+                self.timeline.close(win, outcome)
                 self.ladder.note_cycle()
                 return changes
             except Exception as exc:
+                attempts += 1
+                if isinstance(exc, DispatchDeadlineExceeded):
+                    had_deadline = True
                 action = self.ladder.on_failure(
                     self._features(),
                     error=f"{type(exc).__name__}: {exc}")
@@ -390,13 +430,15 @@ class DeviceColoReconciler:
         self.last_pass_stats = {
             "engine": engine, "changes": changes,
             "degraded": view["degraded"].copy(),
+            "decision_id": self.last_decision_id,
             "ladder_level": self.ladder.level_name,
         }
         self._record(now, t0, engine, changes, degraded, 0)
         self.ladder.note_cycle()
         return changes
 
-    def _device_pass(self, view, qv, now: float, t0: float, mesh) -> int:
+    def _device_pass(self, view, qv, now: float, t0: float, mesh,
+                     win) -> int:
         if self.fault_injector is not None:
             self.fault_injector()
         with self.tracer.span("encode") as esp:
@@ -426,10 +468,12 @@ class DeviceColoReconciler:
                     np.asarray(out.predicted_total))
 
         snap.begin_dispatch()
+        win.mark_dispatch("mesh" if mesh is not None else "serial")
         abandoned = False
         try:
             with self.tracer.span("kernel", mesh=str(
-                    mesh.devices.size if mesh is not None else 0)):
+                    mesh.devices.size if mesh is not None else 0),
+                    decision_id=win.decision_id):
                 dev = snap.upload_fields(fields)
                 out = step(
                     dev["colo_capacity"], dev["colo_node_reserved"],
@@ -484,6 +528,7 @@ class DeviceColoReconciler:
             "batch_cpu": batch_cpu, "batch_mem": batch_mem,
             "mid_cpu": mid_cpu, "mid_mem": mid_mem,
             "runtime": runtime, "revoke_mask": revoke_mask,
+            "decision_id": win.decision_id,
             "ladder_level": self.ladder.level_name,
         }
         self._record(now, t0, "device", changes, degraded, candidates)
@@ -538,6 +583,9 @@ class DeviceColoReconciler:
             "duration_ms": duration * 1000.0,
             "waves": 0,
             "bound": [], "failed": [], "rejected": [], "preempted": [],
+            # koordwatch: the colo writeback's join key — spans, the
+            # timeline window and this record share it
+            "decision_id": str(self.last_decision_id or ""),
             "metrics": {
                 "colo_nodes_changed": float(changes),
                 "colo_degraded_nodes": float(degraded),

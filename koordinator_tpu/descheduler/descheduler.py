@@ -108,7 +108,12 @@ class Descheduler:
                         deadline_ms = dl * 1000.0 if dl else 0
                         self.rebalancer = DeviceRebalancer(
                             mesh=mesh, snapshot_getter=getter,
-                            dispatch_deadline_ms=deadline_ms)
+                            dispatch_deadline_ms=deadline_ms,
+                            # koordwatch: the co-located pass records
+                            # into the SCHEDULER's device timeline —
+                            # one device, one ring, one id sequence
+                            timeline=getattr(self.scheduler,
+                                             "timeline", None))
                     else:
                         from koordinator_tpu.parallel.mesh import (
                             mesh_from_env,

@@ -305,9 +305,17 @@ class LowNodeLoad:
         return picked
 
     def balance(self, now: Optional[float] = None) -> List[PodMigrationJob]:
+        from koordinator_tpu.api.objects import ANNOTATION_DECISION_ID
+
         now = time.time() if now is None else now
         with self.tracer.span("rebalance"):
             picked, pods_src, _v = self.select_victims(now)
+            # koordwatch decision correlation: the pass's decision id
+            # (minted per device/host rebalance window) rides every job
+            # it issued, and the migration controller copies it onto the
+            # replacement Reservation — flight records, timeline windows
+            # and store objects join on it
+            decision_id = self.last_pass_stats.get("decision_id")
             jobs: List[PodMigrationJob] = []
             with self.tracer.span("migrate",
                                   victims=str(int(len(picked)))):
@@ -318,6 +326,9 @@ class LowNodeLoad:
                             name=f"migrate-{pod.meta.namespace}-{pod.meta.name}",
                             namespace="koordinator-system",
                             creation_timestamp=now,
+                            annotations=(
+                                {ANNOTATION_DECISION_ID: str(decision_id)}
+                                if decision_id else {}),
                         ),
                         pod_namespace=pod.meta.namespace,
                         pod_name=pod.meta.name,

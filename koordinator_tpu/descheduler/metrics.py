@@ -39,3 +39,18 @@ REBALANCE_PASS_SECONDS = REGISTRY.histogram(
     "koord_descheduler_rebalance_pass_seconds",
     "Rebalance victim-selection pass latency (device or host engine)",
 )
+
+# koordwatch (obs/timeline.py): a STANDALONE descheduler's private
+# device timeline records into this registry so its own /metrics shows
+# the windows; a co-located descheduler shares the scheduler's timeline
+# (and that registry's series) instead
+DEVICE_WINDOW_SECONDS = REGISTRY.histogram(
+    "koord_device_window_seconds",
+    "Device-window dispatch-to-last-sync interval, labeled by consumer "
+    "and path",
+    buckets=(0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0),
+)
+DEVICE_IDLE_FRACTION = REGISTRY.gauge(
+    "koord_device_idle_fraction",
+    "Gap time between consecutive device windows over wall time",
+)

@@ -194,13 +194,23 @@ class MigrationController:
         return 1
 
     def _reserve_then_evict(self, job: PodMigrationJob, pod: Pod, now: float) -> int:
+        from koordinator_tpu.api.objects import ANNOTATION_DECISION_ID
+
         if not job.reservation_name:
-            # create the replacement reservation (controller.go:763-846)
+            # create the replacement reservation (controller.go:763-846).
+            # koordwatch: the job's decision id rides onto the
+            # Reservation, so the scheduler-side consumption of the
+            # migration (nomination pre-pass) joins back to the
+            # rebalance window that decided it.
+            decision_id = job.meta.annotations.get(ANNOTATION_DECISION_ID)
             res = Reservation(
                 meta=ObjectMeta(
                     name=f"migrate-{pod.meta.namespace}-{pod.meta.name}",
                     namespace="",
                     creation_timestamp=now,
+                    annotations=(
+                        {ANNOTATION_DECISION_ID: decision_id}
+                        if decision_id else {}),
                 ),
                 template=PodSpec(
                     priority=pod.spec.priority,

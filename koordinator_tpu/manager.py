@@ -132,7 +132,11 @@ class Manager:
             mesh=mesh, snapshot_getter=getter,
             dispatch_deadline_ms=deadline_ms,
             tracer=self.tracer,
-            engine=("on" if self.colo_mode == "on" else "host"))
+            engine=("on" if self.colo_mode == "on" else "host"),
+            # koordwatch: the co-located colo pass records into the
+            # SCHEDULER's device timeline — one device, one ring, one
+            # decision-id sequence across all three consumers
+            timeline=getattr(scheduler, "timeline", None))
 
     @property
     def is_leader(self) -> bool:

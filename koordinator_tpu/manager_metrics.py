@@ -47,3 +47,17 @@ QUOTA_REVOKES_TOTAL = REGISTRY.counter(
     "koord_manager_quota_revokes_total",
     "Pods evicted by the elastic-quota overuse revoke loop",
 )
+
+# koordwatch (obs/timeline.py): a STANDALONE manager's private colo
+# device timeline records into this registry so its own /metrics shows
+# the windows; a co-located manager shares the scheduler's timeline
+DEVICE_WINDOW_SECONDS = REGISTRY.histogram(
+    "koord_device_window_seconds",
+    "Device-window dispatch-to-last-sync interval, labeled by consumer "
+    "and path",
+    buckets=(0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0),
+)
+DEVICE_IDLE_FRACTION = REGISTRY.gauge(
+    "koord_device_idle_fraction",
+    "Gap time between consecutive device windows over wall time",
+)

@@ -116,6 +116,49 @@ def validate_record(obj: object) -> List[str]:
     return errs
 
 
+def load_jsonl_bundle(lines, *, validate_header, validate_record,
+                      count_key: str):
+    """Shared bundle parser for every JSONL dump format in this package
+    (flight, timeline, slo): line 1 validates as the header, every
+    further line as a record, and the header's ``count_key`` field must
+    match the record count. Returns (header, records, errors) — the
+    golden-fixture contract each format's ``load_bundle`` pins."""
+    header = None
+    records: List[dict] = []
+    errors: List[str] = []
+    seen_any = False
+    for lineno, line in enumerate(lines, start=1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as exc:
+            errors.append(f"line {lineno}: invalid JSON ({exc})")
+            continue
+        if not seen_any:
+            seen_any = True
+            errs = validate_header(obj)
+            if errs:
+                errors.extend(f"line {lineno}: {e}" for e in errs)
+            else:
+                header = obj
+            continue
+        errs = validate_record(obj)
+        if errs:
+            errors.extend(f"line {lineno}: {e}" for e in errs)
+        else:
+            records.append(obj)
+    if not seen_any:
+        errors.append("empty bundle: missing header line")
+    elif header is not None and header[count_key] != len(records) and (
+            not errors):
+        errors.append(
+            f"header says {header[count_key]} {count_key}, "
+            f"found {len(records)}")
+    return header, records, errors
+
+
 class Tracer:
     """Nested-span tracer with a bounded finished-root ring.
 

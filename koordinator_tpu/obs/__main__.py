@@ -1,9 +1,11 @@
-"""Trace replay + koordexplain CLI.
+"""Trace replay + koordexplain + koordwatch CLI.
 
     python -m koordinator_tpu.obs trace.jsonl            # span waterfall
     curl -s localhost:9090/traces | python -m koordinator_tpu.obs -
     python -m koordinator_tpu.obs flight bundle.jsonl    # validate bundle
     python -m koordinator_tpu.obs explain bundle.jsonl ns/pod
+    python -m koordinator_tpu.obs timeline timeline.jsonl  # device waterfall
+    python -m koordinator_tpu.obs slo slo.jsonl            # SLO table
 
 Each trace renders as an indented latency waterfall — bar offset is the
 span's monotonic start relative to its root, bar length its share of the
@@ -14,6 +16,13 @@ from a terminal with no tooling.
 schema and prints a per-cycle summary; ``explain`` renders the stage-by-
 stage verdict table for one pod from the newest cycle record that carries
 it — the offline twin of the live ``/explain?pod=`` endpoint.
+
+``timeline`` validates a koordwatch device-timeline bundle
+(obs/timeline.py, the ``/debug/timeline`` body) and renders the
+cross-consumer device waterfall — one bar per window, offset by its
+idle gap, so "who had the device and when" is answerable from a
+terminal. ``slo`` validates an SLO bundle (obs/slo.py, the
+``/debug/slo`` body) and renders the objective table with burn rates.
 
 Exit codes (the `hack/lint.sh` golden-fixture contract, all subcommands):
   0  every record parsed and validated (explain: pod found)
@@ -218,6 +227,83 @@ def explain_main(argv: List[str]) -> int:
     return 0
 
 
+def timeline_main(argv: List[str]) -> int:
+    """`timeline <bundle>`: schema-validate + render the device-window
+    waterfall of a koordwatch timeline bundle."""
+    from koordinator_tpu.obs.timeline import load_bundle
+
+    ap = argparse.ArgumentParser(
+        prog="python -m koordinator_tpu.obs timeline",
+        description="validate and render a koordwatch device-timeline "
+                    "JSONL bundle as a cross-consumer waterfall")
+    ap.add_argument("bundle", help="timeline bundle file, or '-' for stdin")
+    ap.add_argument("--width", type=int, default=40,
+                    help="waterfall bar width in characters")
+    args = ap.parse_args(argv)
+    lines = _read_lines(args.bundle)
+    if lines is None:
+        return 2
+    header, records, errors = load_bundle(lines)
+    if errors:
+        for err in errors:
+            print(f"schema error: {err}", file=sys.stderr)
+        return 1
+    print(f"device timeline · {header['windows']} windows · "
+          f"idle fraction {header['idle_fraction']:.3f}")
+    if not records:
+        return 0
+    width = max(10, args.width)
+    # the waterfall axis: gap-prefixed windows laid end to end
+    offsets, cursor = [], 0.0
+    for rec in records:
+        cursor += rec["gap_ms"]
+        offsets.append(cursor)
+        cursor += rec["duration_ms"]
+    total = cursor or 1.0
+    label_w = max(len(f"{r['consumer']}/{r['path']}") for r in records)
+    id_w = max(len(r["decision_id"]) for r in records)
+    for rec, off in zip(records, offsets):
+        label = f"{rec['consumer']}/{rec['path']}"
+        print(f"  {rec['decision_id']:<{id_w}} {label:<{label_w}} "
+              f"|{_bar(off, rec['duration_ms'], total, width)}| "
+              f"{rec['duration_ms']:8.2f}ms gap {rec['gap_ms']:8.2f}ms "
+              f"{rec['outcome']}")
+    return 0
+
+
+def slo_main(argv: List[str]) -> int:
+    """`slo <bundle>`: schema-validate + render a koordwatch SLO bundle
+    as the objective table."""
+    from koordinator_tpu.obs.slo import load_bundle
+
+    ap = argparse.ArgumentParser(
+        prog="python -m koordinator_tpu.obs slo",
+        description="validate and render a koordwatch SLO JSONL bundle")
+    ap.add_argument("bundle", help="SLO bundle file, or '-' for stdin")
+    args = ap.parse_args(argv)
+    lines = _read_lines(args.bundle)
+    if lines is None:
+        return 2
+    header, records, errors = load_bundle(lines)
+    if errors:
+        for err in errors:
+            print(f"schema error: {err}", file=sys.stderr)
+        return 1
+    print(f"slo registry · {header['slos']} objectives")
+    if not records:
+        return 0
+    name_w = max(len(r["slo"]) for r in records)
+    for rec in records:
+        pct = ("max" if rec["percentile"] >= 100
+               else f"p{rec['percentile']:g}")
+        verdict = "MET" if rec["met"] else "BLOWN"
+        print(f"  {rec['slo']:<{name_w}}  {pct:>4} "
+              f"{rec['observed']:10.3f} / {rec['target']:g} {rec['unit']} "
+              f"· burn {rec['burn_rate']:.2f} · {rec['count']} samples "
+              f"({rec['overruns']} overruns) · {verdict}")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     # subcommand dispatch keeps the historical `obs <trace.jsonl>` call
@@ -226,6 +312,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return flight_main(argv[1:])
     if argv and argv[0] == "explain":
         return explain_main(argv[1:])
+    if argv and argv[0] == "timeline":
+        return timeline_main(argv[1:])
+    if argv and argv[0] == "slo":
+        return slo_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m koordinator_tpu.obs",
         description="replay a koordtrace JSONL dump as a latency waterfall")

@@ -222,6 +222,18 @@ def validate_cycle_record(obj) -> List[str]:
     if not isinstance(preempted, list) or any(
             not isinstance(k, str) for k in preempted):
         errs.append(f"preempted must be a list of strings, got {preempted!r}")
+    # koordwatch (optional, so pre-PR-13 bundles keep validating): the
+    # cycle's structured demotion reasons and device-window decision ids
+    for key in ("demotions", "decision_ids"):
+        val = obj.get(key)
+        if val is not None and (not isinstance(val, list) or any(
+                not isinstance(k, str) for k in val)):
+            errs.append(f"{key} must be a list of strings when present, "
+                        f"got {val!r}")
+    decision_id = obj.get("decision_id")
+    if decision_id is not None and not isinstance(decision_id, str):
+        errs.append(f"decision_id must be a string when present, "
+                    f"got {decision_id!r}")
     metrics = obj.get("metrics")
     if not isinstance(metrics, dict) or not all(
             isinstance(k, str) and _is_num(v)
@@ -243,36 +255,8 @@ def load_bundle(lines) -> Tuple[Optional[dict], List[dict], List[str]]:
     """Parse + validate a bundle; returns (header, cycle_records, errors).
     The contract ``hack/lint.sh`` pins: any error list growth against the
     golden fixture is schema drift and must be a conscious version bump."""
-    header: Optional[dict] = None
-    records: List[dict] = []
-    errors: List[str] = []
-    seen_any = False
-    for lineno, line in enumerate(lines, start=1):
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            obj = json.loads(line)
-        except json.JSONDecodeError as exc:
-            errors.append(f"line {lineno}: invalid JSON ({exc})")
-            continue
-        if not seen_any:
-            seen_any = True
-            errs = validate_header(obj)
-            if errs:
-                errors.extend(f"line {lineno}: {e}" for e in errs)
-            else:
-                header = obj
-            continue
-        errs = validate_cycle_record(obj)
-        if errs:
-            errors.extend(f"line {lineno}: {e}" for e in errs)
-        else:
-            records.append(obj)
-    if not seen_any:
-        errors.append("empty bundle: missing header line")
-    elif header is not None and header["cycles"] != len(records) and (
-            not errors):
-        errors.append(
-            f"header says {header['cycles']} cycles, found {len(records)}")
-    return header, records, errors
+    from koordinator_tpu.obs import load_jsonl_bundle
+
+    return load_jsonl_bundle(lines, validate_header=validate_header,
+                             validate_record=validate_cycle_record,
+                             count_key="cycles")

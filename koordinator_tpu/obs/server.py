@@ -82,15 +82,23 @@ class ObsServer:
       (``explain_provider``: pod key -> record dict or None)
     * ``/debug/flightrecorder`` — GET: ring status; POST: dump the ring as
       a JSONL bundle (``flight``: an obs.flight.FlightRecorder)
+    * ``/debug/timeline`` — the koordwatch device-window ring as a JSONL
+      bundle (``timeline``: an obs.timeline.DeviceTimeline), replayable
+      with ``python -m koordinator_tpu.obs timeline``
+    * ``/debug/slo`` — the koordwatch SLO registry as a JSONL bundle
+      (``slo``: an obs.slo.SloRegistry)
     """
 
     def __init__(self, metrics_registry=None, tracer=None,
-                 health_provider=None, explain_provider=None, flight=None):
+                 health_provider=None, explain_provider=None, flight=None,
+                 timeline=None, slo=None):
         self.metrics_registry = metrics_registry
         self.tracer = tracer
         self.health_provider = health_provider
         self.explain_provider = explain_provider
         self.flight = flight
+        self.timeline = timeline
+        self.slo = slo
 
     def handle(self, path: str, query: Optional[Dict[str, str]] = None,
                method: str = "GET") -> Tuple[int, str, str]:
@@ -133,6 +141,11 @@ class ObsServer:
                 }, sort_keys=True))
             return (200, "application/json",
                     json.dumps({"pod": pod, **record}, sort_keys=True))
+        if parts == ["debug", "timeline"] and self.timeline is not None:
+            return (200, "application/x-ndjson",
+                    self.timeline.export_jsonl())
+        if parts == ["debug", "slo"] and self.slo is not None:
+            return (200, "application/x-ndjson", self.slo.export_jsonl())
         if parts == ["debug", "flightrecorder"] and self.flight is not None:
             if method == "POST":
                 return (200, "application/x-ndjson",

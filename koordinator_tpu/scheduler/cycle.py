@@ -161,6 +161,15 @@ def cycle_deadline_from_env():
     return ms / 1000.0 if ms > 0 else None
 
 
+def watch_from_env() -> bool:
+    """KOORD_TPU_WATCH=0 turns koordwatch off (see the canonical helper
+    in obs/timeline.py — shared with the standalone rebalance/colo
+    timelines so the kill switch covers every consumer)."""
+    from koordinator_tpu.obs.timeline import watch_from_env as _watch
+
+    return _watch()
+
+
 def _auto_waves(queue_depth: int) -> int:
     """Depth-based auto-K: the fused dispatch amortizes the fixed
     dispatch+readback overhead over K dependent rounds, but each extra
@@ -325,6 +334,7 @@ class Scheduler:
         ladder=None,
         replay_overlap=None,
         dispatch_deadline_ms=None,
+        watch=None,
     ):
         from koordinator_tpu.scheduler.config import SchedulerConfiguration
         from koordinator_tpu.scheduler.plugins.reservation import (
@@ -429,9 +439,28 @@ class Scheduler:
         import threading
 
         from koordinator_tpu.obs.flight import FlightRecorder
+        from koordinator_tpu.obs.timeline import DeviceTimeline
 
         self.flight = FlightRecorder(
             dump_counter=scheduler_metrics.FLIGHT_DUMPS)
+        # koordwatch (PR 13): demotion accounting + the cross-consumer
+        # device timeline. KOORD_TPU_WATCH=0 (or watch=False) is the
+        # kill switch / bench A/B off-world — decision ids keep minting
+        # (cheap, and correlation must never go None-shaped) but the
+        # ring stops recording and the chokepoint stops accounting.
+        self.watch_enabled = (watch_from_env() if watch is None
+                              else bool(watch))
+        self.timeline = DeviceTimeline(
+            window_histogram=scheduler_metrics.DEVICE_WINDOW_SECONDS,
+            idle_gauge=scheduler_metrics.DEVICE_IDLE_FRACTION,
+            enabled=self.watch_enabled)
+        # per-cycle koordwatch state, reset at run_cycle start: the
+        # structured demotion reasons (deduped, first-hit order), the
+        # decision ids of this cycle's device windows, and the id of the
+        # window currently open (stamped onto /explain attribution)
+        self._cycle_demotions: List[str] = []
+        self._cycle_decision_ids: List[str] = []
+        self._current_decision_id: Optional[str] = None
         self.cycle_deadline_seconds = cycle_deadline_from_env()
         # /explain surface state: written by the cycle thread, read by the
         # ObsServer thread — lock-guarded (koordlint concurrency gate)
@@ -576,6 +605,48 @@ class Scheduler:
             self.device_snapshot.fault_injector = fn
 
     # ------------------------------------------------------------------
+    # koordwatch: demotion accounting + device-timeline windows
+    # ------------------------------------------------------------------
+    def _note_demotion(self, reason: str, value):
+        """THE demotion chokepoint (koordwatch): every branch that runs
+        a cycle below its configured wave/explain/mesh level routes its
+        fallback value through here — ``return self._note_demotion(
+        "reason", 1)`` — so no demotion is ever silent again. Counted
+        once per cycle per reason (the wave_demotions counter therefore
+        reads as demoted CYCLES, and the sim's per-scenario demotion
+        profile sums exactly). koordlint rule 19 (silent-demotion-branch)
+        errors on demotion-resolving branches that bypass this."""
+        if self.watch_enabled and reason not in self._cycle_demotions:
+            self._cycle_demotions.append(reason)
+            scheduler_metrics.WAVE_DEMOTIONS.inc(reason=reason)
+        return value
+
+    def _window_path(self, base: str) -> str:
+        """The timeline path label for a dispatch window: the mesh
+        placement wins over the program shape (a ladder demotion mid-
+        pass re-stamps via mark_dispatch)."""
+        return "mesh" if self.mesh is not None else base
+
+    def _open_window(self, base: str):
+        """Open a device-timeline window for one dispatch pass; the
+        minted decision id joins spans, flight records and /explain."""
+        win = self.timeline.open("scheduler", self._window_path(base))
+        self._current_decision_id = win.decision_id
+        self._cycle_decision_ids.append(win.decision_id)
+        return win
+
+    def _close_window(self, win, attempts: int, had_deadline: bool,
+                      level0: int, end_mono=None) -> None:
+        """Record a completed dispatch window. Outcome precedence:
+        deadline (a monitored sync was abandoned this pass) > demoted
+        (the ladder moved down) > retried (same level, second attempt)
+        > clean."""
+        outcome = ("deadline" if had_deadline
+                   else "demoted" if self.ladder.level > level0
+                   else "retried" if attempts else "clean")
+        self.timeline.close(win, outcome, end_mono=end_mono)
+
+    # ------------------------------------------------------------------
     def _pending_queue(self, now: float) -> Tuple[List[Pod], Dict[str, Reservation]]:
         pods = [
             p
@@ -605,6 +676,16 @@ class Scheduler:
                 )
                 pods.append(pseudo)
                 reservations[pseudo.meta.key] = res
+        # pending-queue visibility (koordwatch, pre-work for the ROADMAP
+        # admission/queueing item): the depth this cycle drains and every
+        # entry's enqueue-to-dispatch age. creation_timestamp is the
+        # enqueue instant for both real pods and reservation pseudo-pods.
+        if self.watch_enabled:
+            scheduler_metrics.PENDING_QUEUE_DEPTH.set(float(len(pods)))
+            for p in pods:
+                created = p.meta.creation_timestamp or now
+                scheduler_metrics.QUEUE_WAIT_SECONDS.observe(
+                    max(0.0, now - created))
         return pods, reservations
 
     def _process_resizes(self, now: float, result: CycleResult) -> None:
@@ -1029,11 +1110,14 @@ class Scheduler:
         """This cycle's koordexplain level. The sidecar path demotes to
         off: the RPC protocol ships only the chosen vector, so attribution
         falls back to the legacy host recompute. The degradation ladder's
-        no-explain rung (and below) pins it off too."""
+        no-explain rung (and below) pins it off too. Every demotion
+        routes through the koordwatch chokepoint (rule 19 pins that)."""
+        if self.explain_spec is None:
+            return self.explain_spec  # nothing configured: not a demotion
         if self._sidecar_client is not None:
-            return None
+            return self._note_demotion("explain-sidecar", None)
         if self.ladder.level >= LEVEL_NO_EXPLAIN:
-            return None
+            return self._note_demotion("explain-ladder", None)
         return self.explain_spec
 
     def _effective_waves(self, pending: List[Pod],
@@ -1049,22 +1133,25 @@ class Scheduler:
         k = _auto_waves(len(pending)) if spec == "auto" else int(spec)
         k = max(1, min(k, MAX_WAVES))
         if k == 1:
-            return 1
+            return k  # resolved to serial by spec/depth: not a demotion
         if self.ladder.level >= LEVEL_SERIAL_WAVES:
-            return 1  # degradation ladder: fused dispatch demoted off
+            # degradation ladder: fused dispatch demoted off
+            return self._note_demotion("ladder-serial-waves", 1)
         if self._sidecar_client is not None:
-            return 1  # the sidecar RPC protocol is single-round
+            # the sidecar RPC protocol is single-round
+            return self._note_demotion("sidecar", 1)
         if pending_reservations:
             # a Reservation CR bound in wave 1 turns Available and feeds
             # the NEXT cycle's nomination pre-pass — not expressible as
             # carried kernel state
-            return 1
+            return self._note_demotion("pending-reservations", 1)
         if self.args.score_according_prod_usage:
-            return 1  # prod score term is not carried in split form
+            # prod score term is not carried in split form
+            return self._note_demotion("prod-usage-score", 1)
         if any(p.spec.pvc_names for p in pending):
             # the volume-group factorization regroups nodes between
             # cycles once a claim-carrying pod binds
-            return 1
+            return self._note_demotion("claim-pods", 1)
         from koordinator_tpu.scheduler.frameworkext import ScoreTransformer
 
         if any(isinstance(t, ScoreTransformer)
@@ -1073,7 +1160,7 @@ class Scheduler:
             # field) AFTER the build; the fused waves recompute the term
             # from the pre-transform est/adj split every wave, which
             # would silently discard the rewrite
-            return 1
+            return self._note_demotion("score-transformer", 1)
         return k
 
     # ------------------------------------------------------------------
@@ -1088,7 +1175,19 @@ class Scheduler:
         # window, not per cycle — a cycle can open several (initial pass,
         # preemption retry, the serial re-run after a fused demotion) and
         # each is promised its own retry-once before demoting.
+        self._cycle_demotions = []
+        self._cycle_decision_ids = []
+        self._current_decision_id = None
         self._apply_degraded_level()
+        # koordwatch mesh accounting: a cycle dispatching below the
+        # CONFIGURED mesh placement (ladder mesh-off reconfiguration, or
+        # the koordguard partial-mesh submesh) is a demoted cycle —
+        # counted per cycle, like the wave/explain chokepoints, so the
+        # demotion profile's fractions compare across reasons
+        if (self._configured_mesh is not None
+                and self.mesh is not self._configured_mesh):
+            self._note_demotion(
+                "mesh-off" if self.mesh is None else "partial-mesh", None)
         result = CycleResult()
         carried_deferred = bool(self._deferred_diagnose)
         self._flushed_this_cycle = False
@@ -1122,12 +1221,16 @@ class Scheduler:
             # re-raises unchanged
             result.duration_seconds = (root.duration_seconds
                                        if root is not None else 0.0)
+            result.demotions = list(self._cycle_demotions)
+            result.decision_ids = list(self._cycle_decision_ids)
             self.flight.record_cycle(self._flight_record(
                 result, now, root, flight_base,
                 error=f"{type(exc).__name__}: {exc}"))
             self.flight.dump("cycle_exception")
             raise
         result.duration_seconds = root.duration_seconds
+        result.demotions = list(self._cycle_demotions)
+        result.decision_ids = list(self._cycle_decision_ids)
         scheduler_metrics.CYCLE_SECONDS.observe(result.duration_seconds)
         if result.duration_seconds > 0:
             # device-busy fraction of this cycle: the "is the device the
@@ -1196,6 +1299,11 @@ class Scheduler:
             "failed": unbound(result.failed),
             "rejected": unbound(result.rejected),
             "preempted": list(result.preempted_victims),
+            # koordwatch: the cycle's structured demotion reasons and
+            # the decision ids of its device windows (joinable against
+            # the timeline bundle and the kernel spans' decision_id)
+            "demotions": list(result.demotions),
+            "decision_ids": list(result.decision_ids),
             "metrics": {k: end[k] - base.get(k, 0.0) for k in end},
             "spans": ([s.to_record() for s in root.walk()]
                       if root is not None else []),
@@ -1213,6 +1321,10 @@ class Scheduler:
             for b in result.bound:
                 rec: Dict[str, object] = {"verdict": "bound",
                                           "node": b.node_name}
+                if self._current_decision_id is not None:
+                    # koordwatch decision correlation: /explain output
+                    # joins the timeline window that bound the pod
+                    rec["decision_id"] = self._current_decision_id
                 terms = self._cycle_terms.get(b.pod_key)
                 if terms is not None:
                     rec["terms"] = terms
@@ -1564,6 +1676,9 @@ class Scheduler:
         for pod, reason in items:
             entry: Dict[str, object] = {"verdict": "unschedulable",
                                         "reason": reason}
+            if self._current_decision_id is not None:
+                # koordwatch: join the verdict to its device window
+                entry["decision_id"] = self._current_decision_id
             if counts is not None and reason in DIAGNOSED_REASONS:
                 j = last[1].get(pod.meta.key)
                 if j is not None:
@@ -1903,9 +2018,13 @@ class Scheduler:
         """Sidecar-served batch pass: the RPC layer owns its own
         degradation (transport failure falls back to the in-process
         step), so the ladder does not wrap this path."""
+        # the sidecar protocol ships only the chosen vector: explain
+        # resolves to off through the koordwatch chokepoint (the reason
+        # is accounted, the value is None exactly as before)
+        explain = self._effective_explain()
         step = self._get_step(
             (pods.padded_size, nodes.padded_size, fc.quota_runtime.shape[0]),
-            ng, ngroups, active, explain=None,
+            ng, ngroups, active, explain=explain,
         )
         with self.tracer.span(
                 "kernel",
@@ -1920,6 +2039,7 @@ class Scheduler:
             )
             if used_fallback:
                 self.sidecar_fallbacks += 1
+                scheduler_metrics.SIDECAR_FALLBACKS.inc()
             # remote RPC: the call blocked already; asarray is a no-op
             # copy of host data, not a device sync
             # koordlint: disable=blocking-readback-in-pipeline
@@ -1938,8 +2058,16 @@ class Scheduler:
         upload host arrays so every retry re-uploads from scratch
         against the (possibly rebuilt) device snapshot."""
         self.ladder.begin_pass()
+        # koordwatch device window for this pass: the decision id joins
+        # the kernel span, the flight record and /explain; the window
+        # records the SUCCESSFUL attempt's dispatch->last-sync interval
+        win = self._open_window("serial")
+        attempts = 0
+        had_deadline = False
+        level0 = self.ladder.level
         while True:
             if self.ladder.level >= LEVEL_HOST_FALLBACK:
+                # no device dispatch: the window never completes
                 return self._dispatch_host_fallback(fc_host, pods, nodes,
                                                     result)
             explain = self._effective_explain()
@@ -1953,7 +2081,8 @@ class Scheduler:
                 with self.tracer.span(
                         "kernel",
                         compiled="1" if self._last_step_compiled
-                        else "0") as ksp:
+                        else "0",
+                        decision_id=win.decision_id) as ksp:
                     fc = fc_host
                     if self.device_snapshot is not None:
                         # device-resident steady state: unchanged fields
@@ -1964,6 +2093,7 @@ class Scheduler:
                         self._record_upload_deltas()
                         self.device_snapshot.begin_dispatch()
                     t_dispatch = time.perf_counter()
+                    win.mark_dispatch(self._window_path("serial"))
                     n_shape = (len(nodes.names),
                                int(np.shape(fc.base.allocatable)[0]))
                     try:
@@ -2025,6 +2155,7 @@ class Scheduler:
                 result.kernel_seconds += ksp.duration_seconds
                 scheduler_metrics.KERNEL_SECONDS.observe(
                     ksp.duration_seconds)
+                self._close_window(win, attempts, had_deadline, level0)
                 return chosen
             except _HostWriteFailure as hw:
                 # deferred store writes died, not the device: the ladder
@@ -2032,6 +2163,9 @@ class Scheduler:
                 # an unhandled cycle exception
                 raise hw.__cause__
             except Exception as exc:
+                attempts += 1
+                if isinstance(exc, DispatchDeadlineExceeded):
+                    had_deadline = True
                 # retry or demote (settings re-applied by the transition
                 # observer); re-raises when the ladder is exhausted
                 self._on_dispatch_failure("serial", exc)
@@ -2151,6 +2285,10 @@ class Scheduler:
         # host arrays, so a retry after a mesh demotion re-uploads from
         # scratch against the rebuilt device snapshot.
         self.ladder.begin_pass()
+        win = self._open_window("fused")
+        attempts = 0
+        had_deadline = False
+        level0 = self.ladder.level
         while True:
             explain = self._effective_explain()
             ex_out = None
@@ -2163,7 +2301,8 @@ class Scheduler:
                 with self.tracer.span(
                         "kernel",
                         compiled="1" if self._last_step_compiled else "0",
-                        waves=str(k_waves)) as ksp:
+                        waves=str(k_waves),
+                        decision_id=win.decision_id) as ksp:
                     fc = fc_host
                     la_est_d, la_adj_d = la_est, la_adj
                     if self.device_snapshot is not None:
@@ -2176,6 +2315,7 @@ class Scheduler:
                         self._record_upload_deltas()
                         self.device_snapshot.begin_dispatch()
                     t_dispatch = time.perf_counter()
+                    win.mark_dispatch(self._window_path("fused"))
                     n_shape = (len(nodes.names),
                                int(np.shape(fc.base.allocatable)[0]))
                     try:
@@ -2247,6 +2387,9 @@ class Scheduler:
                 # an unhandled cycle exception
                 raise hw.__cause__
             except Exception as exc:
+                attempts += 1
+                if isinstance(exc, DispatchDeadlineExceeded):
+                    had_deadline = True
                 self._on_dispatch_failure("fused", exc)
                 if self.ladder.level >= LEVEL_SERIAL_WAVES:
                     # demoted below fused waves: no binding was applied,
@@ -2254,6 +2397,7 @@ class Scheduler:
                     raise FusedDispatchDemoted() from exc
         result.kernel_seconds += ksp.duration_seconds
         scheduler_metrics.KERNEL_SECONDS.observe(ksp.duration_seconds)
+        self._close_window(win, attempts, had_deadline, level0)
 
         # ---- replay the waves as logical cycles. The state mirror is
         # LAZY: it only exists to diagnose unbound pods against wave-w
@@ -2536,6 +2680,10 @@ class Scheduler:
         # ---- ladder-wrapped dispatch window: step build, upload, the
         # wave-1 dispatch and its readback — strictly before any binding.
         self.ladder.begin_pass()
+        win = self._open_window("chained")
+        attempts = 0
+        had_deadline = False
+        level0 = self.ladder.level
         window_open = False
         rows0 = None  # wave 1 in flight: must drain before the window closes
         while True:
@@ -2549,7 +2697,8 @@ class Scheduler:
                 with self.tracer.span(
                         "kernel",
                         compiled="1" if self._last_step_compiled else "0",
-                        waves=str(k_waves), overlap="1"):
+                        waves=str(k_waves), overlap="1",
+                        decision_id=win.decision_id):
                     fc = fc_host
                     la_est_d, la_adj_d = la_est, la_adj
                     if self.device_snapshot is not None:
@@ -2563,6 +2712,7 @@ class Scheduler:
                         self.device_snapshot.begin_dispatch()
                         window_open = True
                     t_dispatch = time.perf_counter()
+                    win.mark_dispatch(self._window_path("chained"))
                     n_real = len(nodes.names)
                     n_shape = (n_real,
                                int(np.shape(fc.base.allocatable)[0]))
@@ -2591,12 +2741,15 @@ class Scheduler:
                 # for good) and _on_dispatch_failure swaps in a fresh
                 # one before the retry/demoted re-run
                 rows0, window_open = None, False
+                attempts += 1
+                had_deadline = True
                 self._on_dispatch_failure("fused", exc)
                 if self.ladder.level >= LEVEL_SERIAL_WAVES:
                     raise FusedDispatchDemoted() from exc
             except Exception as exc:
                 self._abort_chain_window(rows0, window_open)
                 rows0, window_open = None, False
+                attempts += 1
                 self._on_dispatch_failure("fused", exc)
                 if self.ladder.level >= LEVEL_SERIAL_WAVES:
                     raise FusedDispatchDemoted() from exc
@@ -2618,6 +2771,10 @@ class Scheduler:
         scheduler_metrics.KERNEL_SECONDS.observe(window_seconds)
         result.device_busy_seconds += window_seconds
         scheduler_metrics.WAVES_PER_DISPATCH.observe(float(executed))
+        # the timeline window closes at the chain's LAST device sync —
+        # the same dispatch->last-sync quantity the kernel span measures
+        self._close_window(win, attempts, had_deadline, level0,
+                           end_mono=t_last_sync)
         self._last_batch = None
 
     def _replay_wave_chain(

@@ -54,6 +54,17 @@ class CycleResult:
     # reports the rounds it actually completed, so a driver replaying a
     # K-round budget knows how much remains.
     waves: int = 1
+    # koordwatch demotion accounting: the structured reasons this cycle
+    # ran below its configured wave/explain/mesh level (deduped per
+    # cycle, in first-hit order; empty = no demotion). Every entry also
+    # incremented koord_scheduler_wave_demotions_total{reason} and rides
+    # the cycle's flight record — the sim aggregates these into the
+    # per-scenario demotion profile.
+    demotions: List[str] = field(default_factory=list)
+    # koordwatch decision correlation: the decision ids of the device
+    # windows this cycle opened (obs/timeline.py), joinable against
+    # kernel spans, timeline windows and flight records
+    decision_ids: List[str] = field(default_factory=list)
 
 
 class Plugin:

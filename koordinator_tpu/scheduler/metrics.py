@@ -199,6 +199,73 @@ MESH_SHARD_IMBALANCE = REGISTRY.gauge(
     "Max/mean real node rows per mesh shard in the last dispatch",
 )
 
+# koordwatch (PR 13) demotion accounting: every silent fused-wave /
+# explain / mesh demotion routes through the Scheduler._note_demotion
+# chokepoint and lands here, labeled by the structured reason
+# (ladder-serial-waves | sidecar | pending-reservations |
+# prod-usage-score | claim-pods | score-transformer | explain-sidecar |
+# explain-ladder | mesh-off | partial-mesh). Counted once per cycle per
+# reason, so the counter reads as "cycles demoted for this reason" —
+# the real-traffic data the ROADMAP demotion burn-down starts from.
+WAVE_DEMOTIONS = REGISTRY.counter(
+    "koord_scheduler_wave_demotions_total",
+    "Scheduling cycles demoted below their configured wave/explain/mesh "
+    "level, labeled by structured reason",
+)
+
+# SURVEY 7 step 6 sidecar path: kernel passes that fell back to the
+# in-process step after a sidecar RPC failure (previously a loose
+# Scheduler attribute invisible to /metrics)
+SIDECAR_FALLBACKS = REGISTRY.counter(
+    "koord_scheduler_sidecar_fallbacks_total",
+    "Kernel passes served by the in-process step after a sidecar "
+    "RPC transport failure",
+)
+
+# pending-queue visibility (pre-work for the ROADMAP admission/queueing
+# item): the queue depth each cycle drained and the enqueue-to-dispatch
+# age of every pod observed in it — the front-door latency signal the
+# device-resident queueing work will have to improve
+PENDING_QUEUE_DEPTH = REGISTRY.gauge(
+    "koord_scheduler_pending_queue_depth",
+    "Pods (and pending reservations) in the queue at cycle start",
+)
+QUEUE_WAIT_SECONDS = REGISTRY.histogram(
+    "koord_scheduler_queue_wait_seconds",
+    "Enqueue-to-dispatch age of each queued pod, observed per cycle",
+    buckets=(1.0, 5.0, 15.0, 60.0, 300.0, 1800.0, 7200.0),
+)
+
+# koordwatch device timeline (obs/timeline.py): every device window —
+# scheduler dispatch, koordbalance rebalance pass, koordcolo pass —
+# records its dispatch->last-sync interval and the idle gap before it.
+# The idle fraction is THE number the host-tail / rebalance-overlap
+# ROADMAP items must drive down.
+DEVICE_WINDOW_SECONDS = REGISTRY.histogram(
+    "koord_device_window_seconds",
+    "Device-window dispatch-to-last-sync interval, labeled by consumer "
+    "(scheduler|rebalance|colo) and path (serial|fused|chained|mesh)",
+    buckets=(0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0),
+)
+DEVICE_IDLE_FRACTION = REGISTRY.gauge(
+    "koord_device_idle_fraction",
+    "Gap time between consecutive device windows over wall time",
+)
+
+# koordwatch SLO engine (obs/slo.py): per-objective burn rate
+# (observed/target at the gating percentile; 1.0 = exactly on budget)
+# and the met verdict, labeled by objective name
+SLO_BURN_RATE = REGISTRY.gauge(
+    "koord_slo_burn_rate",
+    "SLO burn rate (observed/target at the gating percentile), "
+    "labeled by objective",
+)
+SLO_MET = REGISTRY.gauge(
+    "koord_slo_met",
+    "Whether the SLO is currently met (1) or blown (0), "
+    "labeled by objective",
+)
+
 # pipeline deferred-diagnose backlog: depth of the queue carrying cycle
 # N's unschedulability writes into cycle N+1's kernel window, plus the
 # total items ever deferred — a growing depth means kernel windows (or

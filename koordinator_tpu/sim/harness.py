@@ -135,6 +135,19 @@ class SimReport:
         default_factory=list)
     colo_staleness_slo_cycles: int = 0
     colo_final_engine: str = ""
+    # koordwatch demotion profile: cycles that ran below their
+    # configured wave/explain/mesh level (CycleResult.demotions), each
+    # attributed to its FIRST structured reason so the per-reason counts
+    # sum exactly to cycles_demoted — zero unattributed demotions
+    cycles_demoted: int = 0
+    demotion_cycles_by_reason: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
+    # koordwatch pending-queue visibility: per-cycle depth at dispatch
+    # and the oldest enqueued entry's age (store-pending + waiting room)
+    queue_depth_by_cycle: List[int] = dataclasses.field(
+        default_factory=list)
+    queue_oldest_wait_by_cycle: List[float] = dataclasses.field(
+        default_factory=list)
     binding_log: List[str] = dataclasses.field(default_factory=list)
     wall_seconds: float = 0.0
     # pipeline-occupancy accounting under realistic arrivals: per-cycle
@@ -151,6 +164,38 @@ class SimReport:
             return 0.0
         return float(np.percentile(np.asarray(self.ttb_seconds), q))
 
+    def slo_registry(self, burn_gauge=None, met_gauge=None):
+        """The report's SLO accounting as koordwatch registrations
+        (obs/slo.py): the four objectives the scenarios gate — ttb p99,
+        restart-to-first-bind (max-gated), hotspot dissipation
+        (max-gated) and colo staleness p99 — registered against one
+        SloRegistry and bulk-observed from the sample lists. to_dict's
+        SLO blocks compute through this registry (shape pinned
+        field-for-field by test), and the ChurnSimulator keeps a live
+        instance feeding the koord_slo_* gauges and /debug/slo."""
+        from koordinator_tpu.obs.slo import SloRegistry
+
+        reg = SloRegistry(burn_gauge=burn_gauge, met_gauge=met_gauge)
+        reg.register("ttb_p99", target=self.slo_target_seconds,
+                     percentile=99.0, unit="seconds")
+        reg.observe_many("ttb_p99", self.ttb_seconds)
+        reg.register("restart_to_first_bind",
+                     target=self.restart_slo_seconds,
+                     percentile=100.0, unit="seconds")
+        reg.observe_many("restart_to_first_bind",
+                         self.restart_to_first_bind_seconds)
+        reg.register("hotspot_dissipate",
+                     target=float(self.dissipate_slo_cycles),
+                     percentile=100.0, unit="cycles")
+        reg.observe_many("hotspot_dissipate",
+                         [float(c) for c in self.dissipate_cycles])
+        reg.register("colo_staleness",
+                     target=float(self.colo_staleness_slo_cycles),
+                     percentile=99.0, unit="cycles")
+        reg.observe_many("colo_staleness",
+                         [float(c) for c in self.colo_staleness_cycles])
+        return reg
+
     @property
     def binding_log_sha256(self) -> str:
         h = hashlib.sha256()
@@ -160,14 +205,26 @@ class SimReport:
         return h.hexdigest()
 
     def to_dict(self, include_log: bool = False) -> dict:
+        # the SLO math routes through the koordwatch registry — ONE
+        # implementation of percentile/target/burn arithmetic for the
+        # sim report, the live gauges and /debug/slo. The JSON shape of
+        # every pre-existing block is preserved field-for-field
+        # (tests/test_koordwatch.py pins it against the legacy
+        # expressions); scenario-specific met rules compose from the
+        # registry's stats below.
+        reg = self.slo_registry()
+        ttb_o = reg.objective("ttb_p99")
+        restart_o = reg.objective("restart_to_first_bind")
+        dissipate_o = reg.objective("hotspot_dissipate")
+        stale_o = reg.objective("colo_staleness")
         ttb = {
-            "count": len(self.ttb_seconds),
-            "p50": round(self.percentile(50), 3),
-            "p90": round(self.percentile(90), 3),
-            "p99": round(self.percentile(99), 3),
-            "max": round(max(self.ttb_seconds), 3) if self.ttb_seconds
+            "count": ttb_o.count(),
+            "p50": round(ttb_o.quantile(50), 3),
+            "p90": round(ttb_o.quantile(90), 3),
+            "p99": round(ttb_o.quantile(99), 3),
+            "max": round(ttb_o.maximum(), 3) if self.ttb_seconds
             else 0.0,
-            "mean": round(float(np.mean(self.ttb_seconds)), 3)
+            "mean": round(ttb_o.mean(), 3)
             if self.ttb_seconds else 0.0,
         }
         out = {
@@ -194,6 +251,24 @@ class SimReport:
             "queue": {
                 "max_pending": self.max_pending,
                 "max_overflow": self.max_overflow,
+                # koordwatch pending-queue visibility (per-cycle stats)
+                "depth": {
+                    "mean": (round(float(np.mean(
+                        self.queue_depth_by_cycle)), 1)
+                        if self.queue_depth_by_cycle else 0.0),
+                    "max": (int(max(self.queue_depth_by_cycle))
+                            if self.queue_depth_by_cycle else 0),
+                },
+                "oldest_wait_seconds": {
+                    "p50": (round(float(np.percentile(np.asarray(
+                        self.queue_oldest_wait_by_cycle), 50)), 3)
+                        if self.queue_oldest_wait_by_cycle else 0.0),
+                    "p99": (round(float(np.percentile(np.asarray(
+                        self.queue_oldest_wait_by_cycle), 99)), 3)
+                        if self.queue_oldest_wait_by_cycle else 0.0),
+                    "max": (round(max(self.queue_oldest_wait_by_cycle), 3)
+                            if self.queue_oldest_wait_by_cycle else 0.0),
+                },
             },
             "invariant_breaches": len(self.invariant_breaches),
             "invariant_breach_samples": self.invariant_breaches[:5],
@@ -205,15 +280,10 @@ class SimReport:
             "restart": {
                 "count": self.restarts,
                 "to_first_bind_seconds": {
-                    "count": len(self.restart_to_first_bind_seconds),
-                    "p50": (float(np.percentile(np.asarray(
-                        self.restart_to_first_bind_seconds), 50))
-                        if self.restart_to_first_bind_seconds else 0.0),
-                    "p99": (float(np.percentile(np.asarray(
-                        self.restart_to_first_bind_seconds), 99))
-                        if self.restart_to_first_bind_seconds else 0.0),
-                    "max": (max(self.restart_to_first_bind_seconds)
-                            if self.restart_to_first_bind_seconds else 0.0),
+                    "count": restart_o.count(),
+                    "p50": restart_o.quantile(50),
+                    "p99": restart_o.quantile(99),
+                    "max": restart_o.maximum(),
                 },
                 "to_first_bind_wall_seconds": [
                     round(w, 2)
@@ -223,15 +293,33 @@ class SimReport:
                 # restart that never rebinds can never meet it
                 "met": (self.restarts == 0 or (
                     self.restart_slo_seconds <= 0) or (
-                    len(self.restart_to_first_bind_seconds) == self.restarts
-                    and max(self.restart_to_first_bind_seconds)
-                    <= self.restart_slo_seconds)),
+                    restart_o.count() == self.restarts
+                    and restart_o.met())),
             },
             "degradation": {
                 "transitions": self.ladder_transitions,
                 "cycles_at_level": self.cycles_at_level,
                 "final_level": self.final_level,
             },
+            # koordwatch demotion profile: first-reason attribution, so
+            # sum(by_reason.values()) == cycles_demoted exactly — zero
+            # unattributed demotions (tests pin this)
+            "demotions": {
+                "cycles_demoted": self.cycles_demoted,
+                "fraction_of_cycles": (
+                    round(self.cycles_demoted / self.cycles, 3)
+                    if self.cycles else 0.0),
+                "by_reason": {
+                    k: self.demotion_cycles_by_reason[k]
+                    for k in sorted(self.demotion_cycles_by_reason)},
+            },
+            # koordwatch SLO registry dump: the same objectives the
+            # blocks above gate, with burn rates — the /debug/slo view
+            # of this run
+            "slos": {
+                name: {k: v for k, v in rec.items()
+                       if k not in ("v", "kind", "slo")}
+                for name, rec in reg.snapshot().items()},
             "flight_dumps": self.flight_dumps,
             "descheduler_runs": self.descheduler_runs,
             "rebalance": {
@@ -240,23 +328,17 @@ class SimReport:
                 "hotspot_events": self.hotspot_events,
                 "hotspots_undissipated": self.hotspots_open,
                 "time_to_dissipate_cycles": {
-                    "count": len(self.dissipate_cycles),
-                    "p50": (float(np.percentile(
-                        np.asarray(self.dissipate_cycles), 50))
-                        if self.dissipate_cycles else 0.0),
-                    "p99": (float(np.percentile(
-                        np.asarray(self.dissipate_cycles), 99))
-                        if self.dissipate_cycles else 0.0),
+                    "count": dissipate_o.count(),
+                    "p50": dissipate_o.quantile(50),
+                    "p99": dissipate_o.quantile(99),
+                    # int in the JSON, as the raw cycle counts are
                     "max": (max(self.dissipate_cycles)
                             if self.dissipate_cycles else 0),
                 },
                 "dissipate_slo_cycles": self.dissipate_slo_cycles,
                 "dissipate_slo_met": (
                     self.dissipate_slo_cycles <= 0
-                    or (self.hotspots_open == 0
-                        and (not self.dissipate_cycles
-                             or max(self.dissipate_cycles)
-                             <= self.dissipate_slo_cycles))),
+                    or (self.hotspots_open == 0 and dissipate_o.met())),
             },
             "colo": {
                 "manager_rounds": self.manager_rounds,
@@ -266,23 +348,15 @@ class SimReport:
                 "batch_pods_bound": self.batch_pods_bound,
                 "final_engine": self.colo_final_engine,
                 "staleness_cycles": {
-                    "count": len(self.colo_staleness_cycles),
-                    "p50": (float(np.percentile(
-                        np.asarray(self.colo_staleness_cycles), 50))
-                        if self.colo_staleness_cycles else 0.0),
-                    "p99": (float(np.percentile(
-                        np.asarray(self.colo_staleness_cycles), 99))
-                        if self.colo_staleness_cycles else 0.0),
+                    "count": stale_o.count(),
+                    "p50": stale_o.quantile(50),
+                    "p99": stale_o.quantile(99),
+                    # int in the JSON, as the raw cycle counts are
                     "max": (max(self.colo_staleness_cycles)
                             if self.colo_staleness_cycles else 0),
                 },
                 "staleness_slo_cycles": self.colo_staleness_slo_cycles,
-                "staleness_slo_met": (
-                    self.colo_staleness_slo_cycles <= 0
-                    or not self.colo_staleness_cycles
-                    or float(np.percentile(
-                        np.asarray(self.colo_staleness_cycles), 99))
-                    <= self.colo_staleness_slo_cycles),
+                "staleness_slo_met": stale_o.met(),
             },
             "binding_log_sha256": self.binding_log_sha256,
             "bindings": len(self.binding_log),
@@ -358,6 +432,17 @@ class ChurnSimulator:
         self._prior_deadline_overruns = 0
         self._build_world()
         self._build_scheduler(flight_dir)
+        # koordwatch: the LIVE SloRegistry — same objectives the report
+        # computes from, observed as samples land, feeding the
+        # koord_slo_burn_rate/koord_slo_met gauges and /debug/slo.
+        # Built AFTER _build_scheduler: the colo staleness target lands
+        # on the report there, and the registry must register the REAL
+        # target, not the dataclass default.
+        from koordinator_tpu.scheduler import metrics as scheduler_metrics
+
+        self.slo = self.report.slo_registry(
+            burn_gauge=scheduler_metrics.SLO_BURN_RATE,
+            met_gauge=scheduler_metrics.SLO_MET)
 
     # ------------------------------------------------------------------
     # world + scheduler construction
@@ -863,6 +948,8 @@ class ChurnSimulator:
             if changed:
                 self.report.colo_staleness_cycles.append(
                     cycle - write_cycle)
+                self.slo.observe("colo_staleness",
+                                 float(cycle - write_cycle))
             else:
                 still.append((write_cycle, baseline))
         self._colo_pending = still
@@ -918,6 +1005,8 @@ class ChurnSimulator:
             if (cycle > event_cycle
                     and not any(self._node_is_hot(n) for n in names)):
                 self.report.dissipate_cycles.append(cycle - event_cycle)
+                self.slo.observe("hotspot_dissipate",
+                                 float(cycle - event_cycle))
             else:
                 still.append((event_cycle, names))
         self._hotspots = still
@@ -1027,8 +1116,9 @@ class ChurnSimulator:
         the caller's; this records ttb (+ SLO overrun), the bound
         counter, restart recovery, and the binding-log line."""
         if self._restart_time is not None:
-            self.report.restart_to_first_bind_seconds.append(
-                self.now - self._restart_time)
+            recovery = self.now - self._restart_time
+            self.report.restart_to_first_bind_seconds.append(recovery)
+            self.slo.observe("restart_to_first_bind", recovery)
             self.report.restart_to_first_bind_wall_seconds.append(
                 time.perf_counter() - self._restart_wall)
             self._restart_time = None
@@ -1036,6 +1126,7 @@ class ChurnSimulator:
         if arrived is not None:
             ttb = self.now - arrived
             self.report.ttb_seconds.append(ttb)
+            self.slo.observe("ttb_p99", ttb)
             if ttb > self.sc.ttb_slo_seconds:
                 self.report.slo_overruns += 1
                 self._dump("slo_overrun")
@@ -1112,8 +1203,15 @@ class ChurnSimulator:
                 fresh.extend(self._make_gang(cycle * 10 + s, cycle))
         self.report.pods_created += len(fresh)
         self._admit(fresh)
-        self.report.max_pending = max(self.report.max_pending,
-                                      self._pending_count())
+        # koordwatch pending-queue visibility: the depth this cycle's
+        # dispatch will drain, plus the oldest enqueued entry's age
+        # (store-pending AND waiting-room pods — both are enqueued)
+        depth = self._pending_count()
+        self.report.max_pending = max(self.report.max_pending, depth)
+        self.report.queue_depth_by_cycle.append(depth)
+        self.report.queue_oldest_wait_by_cycle.append(
+            self.now - min(self._arrival_time.values())
+            if self._arrival_time else 0.0)
 
         # koordcolo: the manager tick BEFORE the dispatch — the very
         # next scheduling dispatch consumes the overcommit this pass
@@ -1151,6 +1249,15 @@ class ChurnSimulator:
         wall = time.perf_counter() - t_cycle
         self.report.cycle_wall_seconds += wall
         self.report.device_busy_seconds += result.device_busy_seconds
+        # koordwatch demotion profile: a cycle that ran below its
+        # configured level carries its structured reasons; attribute the
+        # cycle to the FIRST (the chokepoint appends in hit order), so
+        # per-reason counts sum exactly to cycles_demoted
+        if result.demotions:
+            self.report.cycles_demoted += 1
+            reason = result.demotions[0]
+            self.report.demotion_cycles_by_reason[reason] = (
+                self.report.demotion_cycles_by_reason.get(reason, 0) + 1)
         k = max(1, int(result.waves))
         self.report.wall_by_waves[k] = (
             self.report.wall_by_waves.get(k, 0.0) + wall)

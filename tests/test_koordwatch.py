@@ -1,0 +1,726 @@
+"""koordwatch (PR 13): demotion accounting, device timeline, SLO engine,
+decision correlation — the observability layer's acceptance contracts.
+
+Five layers:
+  * demotion accounting — every silent demotion branch routes through
+    the chokepoint: structured reasons on CycleResult.demotions, the
+    wave_demotions counter, the flight record, and zero unattributed
+    demotions in the sim's per-scenario profile;
+  * device timeline — dispatch windows from all three consumers land in
+    one lock-guarded ring with outcomes, the JSONL bundle validates, and
+    the /debug/timeline surface serves it under concurrent scrape load;
+  * SLO engine — SloRegistry math, gauges, the /debug/slo bundle, and
+    the sim report's SLO JSON pinned field-for-field against the legacy
+    expressions it re-expressed;
+  * decision correlation — ids join kernel spans, flight records,
+    /explain output and the migration-job -> Reservation annotations;
+  * satellites — the sidecar-fallback counter and pending-queue metrics
+    in /metrics exposition, /healthz at every ladder level.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.objects import (
+    ANNOTATION_DECISION_ID,
+    Node,
+    NodeMetric,
+    NodeMetricInfo,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    Reservation,
+)
+from koordinator_tpu.api.resources import ResourceList
+from koordinator_tpu.client.store import (
+    KIND_NODE,
+    KIND_NODE_METRIC,
+    KIND_POD,
+    KIND_RESERVATION,
+    ObjectStore,
+)
+from koordinator_tpu.obs.server import ObsServer
+from koordinator_tpu.obs.slo import SloRegistry
+from koordinator_tpu.obs.slo import load_bundle as load_slo_bundle
+from koordinator_tpu.obs.timeline import DeviceTimeline
+from koordinator_tpu.obs.timeline import load_bundle as load_timeline_bundle
+from koordinator_tpu.scheduler import metrics as scheduler_metrics
+from koordinator_tpu.scheduler.cycle import Scheduler
+from koordinator_tpu.scheduler.degrade import (
+    LEVEL_FULL,
+    LEVEL_HOST_FALLBACK,
+    LEVEL_NAMES,
+    LEVEL_PARTIAL_MESH,
+)
+
+GIB = 1024 ** 3
+NOW = 1_000_000.0
+
+
+def make_store(num_nodes=3):
+    store = ObjectStore()
+    for i in range(num_nodes):
+        store.add(KIND_NODE, Node(
+            meta=ObjectMeta(name=f"node-{i}", namespace=""),
+            allocatable=ResourceList.of(
+                cpu=16_000, memory=64 * GIB, pods=110)))
+        store.add(KIND_NODE_METRIC, NodeMetric(
+            meta=ObjectMeta(name=f"node-{i}", namespace=""),
+            update_time=NOW - 10,
+            node_metric=NodeMetricInfo(
+                node_usage=ResourceList.of(cpu=1000, memory=2 * GIB))))
+    return store
+
+
+def pend_pod(store, name, **spec_kwargs):
+    pod = Pod(
+        meta=ObjectMeta(name=name, creation_timestamp=NOW - 30),
+        spec=PodSpec(priority=9500,
+                     requests=ResourceList.of(cpu=500, memory=GIB),
+                     **spec_kwargs),
+    )
+    store.add(KIND_POD, pod)
+    return pod
+
+
+def demotion_count(reason):
+    return scheduler_metrics.WAVE_DEMOTIONS.get(reason=reason) or 0.0
+
+
+# ---------------------------------------------------------------------------
+# demotion accounting: the chokepoint
+# ---------------------------------------------------------------------------
+
+class TestDemotionAccounting:
+    def test_clean_fused_cycle_has_no_demotions(self):
+        store = make_store()
+        sched = Scheduler(store, waves=4)
+        for i in range(4):
+            pend_pod(store, f"p{i}")
+        res = sched.run_cycle(now=NOW)
+        assert res.demotions == []
+        assert res.waves == 4
+
+    def test_pending_reservation_demotes_with_reason(self):
+        store = make_store()
+        sched = Scheduler(store, waves=4)
+        before = demotion_count("pending-reservations")
+        store.add(KIND_RESERVATION, Reservation(
+            meta=ObjectMeta(name="r1", namespace=""),
+            template=PodSpec(requests=ResourceList.of(cpu=100))))
+        for i in range(3):
+            pend_pod(store, f"p{i}")
+        res = sched.run_cycle(now=NOW)
+        assert res.waves == 1
+        assert "pending-reservations" in res.demotions
+        assert demotion_count("pending-reservations") == before + 1
+        # the flight record carries the reasons too
+        rec = sched.flight.snapshot()[-1]
+        assert rec["demotions"] == res.demotions
+        assert rec["decision_ids"] == res.decision_ids
+
+    def test_claim_pods_and_score_transformer_reasons(self):
+        store = make_store()
+        sched = Scheduler(store, waves=4)
+        pend_pod(store, "claims", pvc_names=["c1"])
+        res = sched.run_cycle(now=NOW)
+        assert "claim-pods" in res.demotions
+
+        from koordinator_tpu.scheduler.frameworkext import ScoreTransformer
+
+        store2 = make_store()
+        sched2 = Scheduler(store2, waves=4)
+        sched2.extender.register_transformer(ScoreTransformer())
+        for i in range(2):
+            pend_pod(store2, f"q{i}")
+        res2 = sched2.run_cycle(now=NOW)
+        assert "score-transformer" in res2.demotions
+
+    def test_prod_usage_scoring_reason(self):
+        from koordinator_tpu.ops.loadaware import LoadAwareArgs
+
+        store = make_store()
+        sched = Scheduler(
+            store, args=LoadAwareArgs(score_according_prod_usage=True),
+            waves=4)
+        pend_pod(store, "p0")
+        res = sched.run_cycle(now=NOW)
+        assert "prod-usage-score" in res.demotions
+
+    def test_sidecar_demotes_waves_and_explain(self):
+        from koordinator_tpu.sim.faults import DeadSidecarClient
+
+        store = make_store()
+        sched = Scheduler(store, waves=4, explain="counts")
+        sched._sidecar_client = DeadSidecarClient()
+        fallbacks0 = (scheduler_metrics.SIDECAR_FALLBACKS.get() or 0.0)
+        for i in range(3):
+            pend_pod(store, f"p{i}")
+        res = sched.run_cycle(now=NOW)
+        assert res.waves == 1
+        assert "sidecar" in res.demotions
+        assert "explain-sidecar" in res.demotions
+        # satellite: the loose attribute is now a real counter, and the
+        # dead sidecar forced the in-process fallback
+        assert sched.sidecar_fallbacks >= 1
+        assert (scheduler_metrics.SIDECAR_FALLBACKS.get() or 0.0) \
+            == fallbacks0 + sched.sidecar_fallbacks
+        text = scheduler_metrics.REGISTRY.expose()
+        assert "koord_scheduler_sidecar_fallbacks_total" in text
+
+    def test_ladder_demotion_reasons_and_mesh_off(self, cpu_devices):
+        store = make_store()
+        sched = Scheduler(store, waves=4, explain="counts", mesh=2)
+        calls = {"n": 0}
+
+        def inj(stage):
+            calls["n"] += 1
+            if calls["n"] <= 4:
+                raise RuntimeError("injected dispatch fault")
+
+        sched.fault_injector = inj
+        for i in range(3):
+            pend_pod(store, f"p{i}")
+        # fault burst: retry, then walk no-mesh -> serial-waves ->
+        # no-explain before the 5th attempt succeeds
+        res = sched.run_cycle(now=NOW)
+        assert sched.ladder.level >= 3  # at least serial-waves
+        # next cycle runs at the demoted settings: both the mesh and the
+        # wave/explain chokepoints attribute it
+        pend_pod(store, "late")
+        res2 = sched.run_cycle(now=NOW + 1)
+        assert "mesh-off" in res2.demotions
+        assert "ladder-serial-waves" in res2.demotions
+        if sched.ladder.level >= 4:
+            assert "explain-ladder" in res2.demotions
+        del res
+
+    def test_reasons_deduped_per_cycle(self):
+        store = make_store()
+        sched = Scheduler(store, waves=4)
+        store.add(KIND_RESERVATION, Reservation(
+            meta=ObjectMeta(name="r1", namespace=""),
+            template=PodSpec(requests=ResourceList.of(cpu=100))))
+        pend_pod(store, "p0")
+        res = sched.run_cycle(now=NOW)
+        assert res.demotions.count("pending-reservations") == 1
+
+    def test_watch_off_disables_accounting_but_not_ids(self):
+        store = make_store()
+        sched = Scheduler(store, waves=4, watch=False)
+        store.add(KIND_RESERVATION, Reservation(
+            meta=ObjectMeta(name="r1", namespace=""),
+            template=PodSpec(requests=ResourceList.of(cpu=100))))
+        pend_pod(store, "p0")
+        res = sched.run_cycle(now=NOW)
+        assert res.waves == 1          # behavior unchanged
+        assert res.demotions == []     # accounting off
+        assert res.decision_ids        # correlation stays wired
+        assert len(sched.timeline) == 0  # ring off
+
+
+# ---------------------------------------------------------------------------
+# device timeline
+# ---------------------------------------------------------------------------
+
+class TestDeviceTimeline:
+    def test_windows_recorded_with_outcomes(self):
+        store = make_store()
+        sched = Scheduler(store, waves=1)
+        for i in range(2):
+            pend_pod(store, f"p{i}")
+        sched.run_cycle(now=NOW)
+        windows = sched.timeline.snapshot()
+        assert len(windows) == 1
+        w = windows[0]
+        assert w["consumer"] == "scheduler"
+        assert w["path"] == "serial"
+        assert w["outcome"] == "clean"
+        assert w["duration_ms"] >= 0
+        assert w["decision_id"] == sched.tracer.roots()[-1].find(
+            "kernel").attributes["decision_id"]
+
+    def test_retried_and_demoted_outcomes(self):
+        store = make_store()
+        sched = Scheduler(store, waves=1, explain="counts")
+        calls = {"n": 0}
+
+        def inj(stage):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("transient")
+
+        sched.fault_injector = inj
+        pend_pod(store, "p0")
+        sched.run_cycle(now=NOW)
+        assert sched.timeline.snapshot()[-1]["outcome"] == "retried"
+
+        calls["n"] = -2  # two more failures: retry then demote
+        pend_pod(store, "p1")
+
+        def inj2(stage):
+            calls["n"] += 1
+            if calls["n"] <= 0:
+                raise RuntimeError("persistent")
+
+        sched.fault_injector = inj2
+        sched.run_cycle(now=NOW + 1)
+        assert sched.timeline.snapshot()[-1]["outcome"] == "demoted"
+
+    def test_bundle_validates_and_gap_accounting(self):
+        t = DeviceTimeline()
+        w1 = t.open("scheduler", "serial")
+        t.close(w1, "clean")
+        w2 = t.open("rebalance", "serial")
+        t.close(w2, "clean")
+        header, records, errors = load_timeline_bundle(
+            t.export_jsonl().splitlines())
+        assert errors == []
+        assert header["windows"] == 2
+        assert [r["consumer"] for r in records] == ["scheduler",
+                                                    "rebalance"]
+        assert records[0]["gap_ms"] == 0.0
+        assert records[1]["gap_ms"] >= 0.0
+        assert 0.0 <= t.idle_fraction() <= 1.0
+
+    def test_ring_is_bounded(self):
+        t = DeviceTimeline(capacity=4)
+        for i in range(10):
+            t.close(t.open("scheduler", "serial"), "clean")
+        assert len(t) == 4
+        assert [r["seq"] for r in t.snapshot()] == [7, 8, 9, 10]
+
+    def test_rejects_bad_outcome_and_path(self):
+        from koordinator_tpu.obs.timeline import validate_window_record
+
+        good = {"v": 1, "kind": "window", "seq": 1,
+                "decision_id": "scheduler-1", "consumer": "scheduler",
+                "path": "serial", "outcome": "clean", "ts": 1.0,
+                "duration_ms": 1.0, "gap_ms": 0.0}
+        assert validate_window_record(good) == []
+        assert validate_window_record({**good, "outcome": "exploded"})
+        assert validate_window_record({**good, "path": "warp"})
+        assert validate_window_record({**good, "duration_ms": -1})
+
+    def test_metrics_exported(self):
+        store = make_store()
+        sched = Scheduler(store, waves=1)
+        pend_pod(store, "p0")
+        sched.run_cycle(now=NOW)
+        text = scheduler_metrics.REGISTRY.expose()
+        assert "koord_device_window_seconds_bucket" in text
+        assert 'consumer="scheduler"' in text
+        assert "koord_device_idle_fraction" in text
+        # pending-queue satellites ride the same exposition
+        assert "koord_scheduler_pending_queue_depth" in text
+        assert "koord_scheduler_queue_wait_seconds_bucket" in text
+
+    def test_queue_metrics_observed(self):
+        store = make_store()
+        sched = Scheduler(store, waves=1)
+        count0 = scheduler_metrics.QUEUE_WAIT_SECONDS.count()
+        for i in range(3):
+            pend_pod(store, f"p{i}")  # created at NOW - 30
+        sched.run_cycle(now=NOW)
+        assert scheduler_metrics.PENDING_QUEUE_DEPTH.get() == 3.0
+        assert scheduler_metrics.QUEUE_WAIT_SECONDS.count() == count0 + 3
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+# ---------------------------------------------------------------------------
+
+class TestSloEngine:
+    def test_registry_math(self):
+        reg = SloRegistry()
+        reg.register("ttb_p99", target=100.0, percentile=99.0)
+        assert reg.objective("ttb_p99").met()  # vacuous
+        reg.observe_many("ttb_p99", [10.0, 50.0, 150.0])
+        o = reg.objective("ttb_p99")
+        assert o.count() == 3
+        assert o.overruns == 1
+        expected = float(np.percentile(np.asarray([10.0, 50.0, 150.0]), 99))
+        assert o.observed() == expected
+        assert o.burn_rate() == pytest.approx(expected / 100.0)
+        assert not o.met()
+        # max-gated objective
+        reg.register("recovery", target=30.0, percentile=100.0)
+        reg.observe("recovery", 12.0)
+        assert reg.objective("recovery").observed() == 12.0
+        assert reg.objective("recovery").met()
+        # report-only objective: always met, zero burn
+        reg.register("advisory", target=0.0)
+        reg.observe("advisory", 1e9)
+        assert reg.objective("advisory").met()
+        assert reg.objective("advisory").burn_rate() == 0.0
+        with pytest.raises(ValueError):
+            reg.register("ttb_p99", target=1.0)
+
+    def test_gauges_refresh(self):
+        reg = SloRegistry(burn_gauge=scheduler_metrics.SLO_BURN_RATE,
+                          met_gauge=scheduler_metrics.SLO_MET)
+        reg.register("test_obj", target=10.0, percentile=100.0)
+        reg.observe("test_obj", 20.0)
+        assert scheduler_metrics.SLO_BURN_RATE.get(slo="test_obj") == 2.0
+        assert scheduler_metrics.SLO_MET.get(slo="test_obj") == 0.0
+        text = scheduler_metrics.REGISTRY.expose()
+        assert 'koord_slo_burn_rate{slo="test_obj"} 2' in text
+
+    def test_bundle_round_trip(self):
+        reg = SloRegistry()
+        reg.register("a", target=10.0)
+        reg.observe_many("a", [1.0, 2.0])
+        reg.register("b", target=0.0, unit="cycles", percentile=100.0)
+        header, records, errors = load_slo_bundle(
+            reg.export_jsonl().splitlines())
+        assert errors == []
+        assert header["slos"] == 2
+        assert [r["slo"] for r in records] == ["a", "b"]
+        assert records[0]["met"] is True
+
+    def test_sim_report_slo_shape_is_pinned_field_for_field(self):
+        """The SloRegistry refactor must not move a single field of the
+        report's SLO JSON: compare against the LEGACY expressions
+        (copied verbatim from the pre-koordwatch to_dict)."""
+        from koordinator_tpu.sim.harness import SimReport
+
+        rep = SimReport(scenario="pin", seed=1, cycles=100,
+                        slo_target_seconds=120.0,
+                        dissipate_slo_cycles=30,
+                        restart_slo_seconds=60.0)
+        rep.ttb_seconds = [0.5, 3.0, 7.5, 130.0, 42.0]
+        rep.slo_overruns = 1
+        rep.restarts = 1
+        rep.restart_to_first_bind_seconds = [12.5]
+        rep.dissipate_cycles = [5, 28]
+        rep.hotspots_open = 0
+        rep.colo_staleness_cycles = [1, 2, 3]
+        rep.colo_staleness_slo_cycles = 2
+        d = rep.to_dict()
+
+        def pct(vals, q):
+            return float(np.percentile(np.asarray(vals), q))
+
+        legacy_ttb = {
+            "count": len(rep.ttb_seconds),
+            "p50": round(pct(rep.ttb_seconds, 50), 3),
+            "p90": round(pct(rep.ttb_seconds, 90), 3),
+            "p99": round(pct(rep.ttb_seconds, 99), 3),
+            "max": round(max(rep.ttb_seconds), 3),
+            "mean": round(float(np.mean(rep.ttb_seconds)), 3),
+        }
+        assert d["time_to_bind_seconds"] == legacy_ttb
+        assert d["slo"] == {
+            "ttb_p99_target_seconds": 120.0,
+            "met": legacy_ttb["p99"] <= 120.0,
+            "overruns": 1,
+        }
+        assert d["restart"]["to_first_bind_seconds"] == {
+            "count": 1,
+            "p50": pct(rep.restart_to_first_bind_seconds, 50),
+            "p99": pct(rep.restart_to_first_bind_seconds, 99),
+            "max": max(rep.restart_to_first_bind_seconds),
+        }
+        assert d["restart"]["met"] is True
+        assert d["rebalance"]["time_to_dissipate_cycles"] == {
+            "count": 2,
+            "p50": pct(rep.dissipate_cycles, 50),
+            "p99": pct(rep.dissipate_cycles, 99),
+            "max": 28,
+        }
+        assert d["rebalance"]["dissipate_slo_met"] is True
+        assert d["colo"]["staleness_cycles"] == {
+            "count": 3,
+            "p50": pct(rep.colo_staleness_cycles, 50),
+            "p99": pct(rep.colo_staleness_cycles, 99),
+            "max": 3,
+        }
+        assert d["colo"]["staleness_slo_met"] is (
+            pct(rep.colo_staleness_cycles, 99) <= 2)
+        # the new slos block mirrors the same objectives with burn rates
+        assert set(d["slos"]) == {"ttb_p99", "restart_to_first_bind",
+                                  "hotspot_dissipate", "colo_staleness"}
+        assert d["slos"]["ttb_p99"]["burn_rate"] == pytest.approx(
+            pct(rep.ttb_seconds, 99) / 120.0)
+
+    def test_empty_report_slo_blocks_match_legacy(self):
+        from koordinator_tpu.sim.harness import SimReport
+
+        rep = SimReport(scenario="empty", seed=1, cycles=10,
+                        slo_target_seconds=120.0)
+        d = rep.to_dict()
+        assert d["time_to_bind_seconds"] == {
+            "count": 0, "p50": 0.0, "p90": 0.0, "p99": 0.0,
+            "max": 0.0, "mean": 0.0}
+        assert d["slo"]["met"] is True
+        assert d["restart"]["met"] is True
+        assert d["rebalance"]["dissipate_slo_met"] is True
+        assert d["colo"]["staleness_slo_met"] is True
+        assert d["demotions"] == {
+            "cycles_demoted": 0, "fraction_of_cycles": 0.0,
+            "by_reason": {}}
+
+
+# ---------------------------------------------------------------------------
+# sim demotion profile: zero unattributed demotions
+# ---------------------------------------------------------------------------
+
+class TestDemotionProfile:
+    def test_fault_ladder_profile_sums_exactly(self, cpu_devices,
+                                               monkeypatch):
+        """Zero unattributed demotions (acceptance): the fault-ladder
+        scenario's demotion profile must match an INDEPENDENT per-cycle
+        tally taken at the Scheduler.run_cycle boundary (class-level
+        spy, so the crash-restart's fresh scheduler is covered too),
+        and per-reason counts must sum to every demoted cycle."""
+        from koordinator_tpu.sim.harness import ChurnSimulator
+        from koordinator_tpu.sim.scenarios import SCENARIOS
+
+        tallied = {"cycles": 0, "by_reason": {}}
+        orig_run = Scheduler.run_cycle
+
+        def spy(self, now=None, waves=None):
+            res = orig_run(self, now=now, waves=waves)
+            if res.demotions:
+                tallied["cycles"] += 1
+                reason = res.demotions[0]
+                tallied["by_reason"][reason] = (
+                    tallied["by_reason"].get(reason, 0) + 1)
+            return res
+
+        monkeypatch.setattr(Scheduler, "run_cycle", spy)
+        ladder_reason0 = demotion_count("ladder-serial-waves")
+        sc = SCENARIOS["fault-ladder"]
+        sim = ChurnSimulator(sc)
+        for cycle in range(sc.cycles):
+            sim._run_one_cycle(cycle)
+        report = sim.run_report()
+        # the fault-ladder scenario MUST demote (waves=4 + the ladder
+        # walk): a zero profile would mean the accounting went blind
+        assert report.cycles_demoted > 0
+        prof = report.to_dict()["demotions"]
+        assert prof["cycles_demoted"] == report.cycles_demoted
+        # EVERY demoted cycle is attributed: per-reason counts sum
+        # exactly to the demoted-cycle count
+        assert sum(prof["by_reason"].values()) == prof["cycles_demoted"]
+        # and the profile matches the independent tally exactly
+        assert prof["cycles_demoted"] == tallied["cycles"]
+        assert prof["by_reason"] == tallied["by_reason"]
+        # the scenario's ladder walk + koordguard events are visible:
+        # mesh demotions lead the profile (noted at cycle start, so
+        # first-reason attribution picks them), and the fused-wave
+        # ladder reason incremented the per-reason counter (it rides
+        # those same cycles as a secondary reason)
+        assert "mesh-off" in prof["by_reason"]
+        assert "partial-mesh" in prof["by_reason"]
+        assert demotion_count("ladder-serial-waves") > ladder_reason0
+
+    def test_soak_short_profile_consistent(self):
+        import dataclasses
+
+        from koordinator_tpu.sim.harness import run_scenario
+        from koordinator_tpu.sim.scenarios import SCENARIOS
+
+        sc = dataclasses.replace(SCENARIOS["soak"], cycles=60)
+        report = run_scenario(sc)
+        prof = report.to_dict()["demotions"]
+        assert sum(prof["by_reason"].values()) == prof["cycles_demoted"]
+        assert prof["cycles_demoted"] <= 60
+        # queue visibility rides the same report
+        q = report.to_dict()["queue"]
+        assert len(report.queue_depth_by_cycle) == 60
+        assert q["depth"]["max"] >= q["depth"]["mean"] >= 0
+        assert q["oldest_wait_seconds"]["max"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# decision correlation
+# ---------------------------------------------------------------------------
+
+class TestDecisionCorrelation:
+    def test_cycle_ids_join_span_flight_and_explain(self):
+        store = make_store()
+        sched = Scheduler(store, waves=1, explain="counts")
+        pend_pod(store, "p0")
+        res = sched.run_cycle(now=NOW)
+        assert len(res.decision_ids) == 1
+        did = res.decision_ids[0]
+        assert sched.tracer.roots()[-1].find(
+            "kernel").attributes["decision_id"] == did
+        rec = sched.flight.snapshot()[-1]
+        assert rec["decision_ids"] == [did]
+        exp = sched.explain_record(res.bound[0].pod_key)
+        assert exp is not None and exp["decision_id"] == did
+
+    def test_ids_are_deterministic(self):
+        def ids():
+            store = make_store()
+            sched = Scheduler(store, waves=1)
+            for c in range(3):
+                pend_pod(store, f"p{c}")
+                sched.run_cycle(now=NOW + c)
+            return [w["decision_id"]
+                    for w in sched.timeline.snapshot()]
+
+        assert ids() == ids()
+
+    def test_migration_job_and_reservation_carry_decision_id(self):
+        """The koordbalance closed loop: rebalance window ->
+        PodMigrationJob annotation -> replacement Reservation."""
+        import dataclasses
+
+        from koordinator_tpu.client.store import (
+            KIND_POD_MIGRATION_JOB,
+        )
+        from koordinator_tpu.sim.harness import ChurnSimulator
+        from koordinator_tpu.sim.scenarios import SCENARIOS
+
+        sc = dataclasses.replace(SCENARIOS["hotspot"], cycles=50)
+        sim = ChurnSimulator(sc)
+        for cycle in range(sc.cycles):
+            sim._run_one_cycle(cycle)
+        jobs = sim.store.list(KIND_POD_MIGRATION_JOB)
+        assert jobs, "hotspot scenario must issue migration jobs"
+        stamped = [j for j in jobs
+                   if ANNOTATION_DECISION_ID in j.meta.annotations]
+        assert stamped, "migration jobs must carry the decision id"
+        for job in stamped:
+            assert job.meta.annotations[
+                ANNOTATION_DECISION_ID].startswith("rebalance-")
+        # jobs that reached the reservation step copied the id onto it
+        linked = 0
+        for job in stamped:
+            if not job.reservation_name:
+                continue
+            res = sim.store.get(KIND_RESERVATION,
+                                f"/{job.reservation_name}")
+            if res is None:
+                continue
+            linked += 1
+            assert res.meta.annotations.get(ANNOTATION_DECISION_ID) == \
+                job.meta.annotations[ANNOTATION_DECISION_ID]
+        assert linked > 0
+
+    def test_shared_timeline_across_consumers(self):
+        """Co-located descheduler + manager record into the SCHEDULER's
+        ring: one device, one timeline, one id sequence."""
+        import dataclasses
+
+        from koordinator_tpu.sim.harness import ChurnSimulator
+        from koordinator_tpu.sim.scenarios import SCENARIOS
+
+        sc = dataclasses.replace(SCENARIOS["overcommit-shift"], cycles=12)
+        sim = ChurnSimulator(sc)
+        for cycle in range(sc.cycles):
+            sim._run_one_cycle(cycle)
+        consumers = {w["consumer"]
+                     for w in sim.sched.timeline.snapshot()}
+        assert "scheduler" in consumers
+        assert "colo" in consumers
+        assert sim.manager.colo.timeline is sim.sched.timeline
+
+
+# ---------------------------------------------------------------------------
+# HTTP surfaces: /debug/timeline, /debug/slo, /healthz, under load
+# ---------------------------------------------------------------------------
+
+class TestObsSurfaces:
+    def test_debug_routes(self):
+        t = DeviceTimeline()
+        t.close(t.open("scheduler", "serial"), "clean")
+        reg = SloRegistry()
+        reg.register("ttb_p99", target=100.0)
+        srv = ObsServer(timeline=t, slo=reg)
+        status, ctype, body = srv.handle("/debug/timeline")
+        assert status == 200 and "ndjson" in ctype
+        assert load_timeline_bundle(body.splitlines())[2] == []
+        status, _, body = srv.handle("/debug/slo")
+        assert status == 200
+        assert load_slo_bundle(body.splitlines())[2] == []
+        # without providers the routes stay dark
+        assert ObsServer().handle("/debug/timeline")[0] == 404
+        assert ObsServer().handle("/debug/slo")[0] == 404
+
+    def test_healthz_reports_every_ladder_level(self, cpu_devices):
+        """The /healthz payload must identify the rung at EVERY ladder
+        level, partial-mesh included — a scheduler surviving demoted
+        must never look healthy."""
+        store = make_store()
+        sched = Scheduler(store, waves=4, explain="counts", mesh=2)
+        sched._lost_device_ids = {1}  # the partial-mesh survivors' set
+        srv = ObsServer(scheduler_metrics.REGISTRY, sched.tracer,
+                        health_provider=sched.health_snapshot)
+        pend_pod(store, "warm")
+        sched.run_cycle(now=NOW)
+        for level in range(LEVEL_FULL, LEVEL_HOST_FALLBACK + 1):
+            sched.ladder.level = level
+            sched._apply_degraded_level()
+            status, _, body = srv.handle("/healthz")
+            assert status == 200
+            payload = json.loads(body)
+            assert payload["degraded"]["level"] == level
+            assert payload["degraded"]["level_name"] == LEVEL_NAMES[level]
+            assert payload["cycles"] >= 1
+            if level == LEVEL_PARTIAL_MESH:
+                assert sched.mesh is not None
+                assert sched.mesh.devices.size < 8
+        # restore full for teardown sanity
+        sched.ladder.level = LEVEL_FULL
+        sched._apply_degraded_level()
+
+    def test_concurrent_scrapes_during_churn(self):
+        """Satellite: concurrent /metrics + /debug/timeline (+ /traces,
+        /debug/slo, /healthz) scrapes while a seeded churn loop runs —
+        no torn exposition, no exception."""
+        from koordinator_tpu.sim.harness import ChurnSimulator
+        from koordinator_tpu.sim.scenarios import SCENARIOS
+
+        sim = ChurnSimulator(SCENARIOS["smoke"].resolved(cycles=14))
+        srv = ObsServer(scheduler_metrics.REGISTRY, sim.sched.tracer,
+                        health_provider=sim.sched.health_snapshot,
+                        flight=sim.sched.flight,
+                        timeline=sim.sched.timeline, slo=sim.slo)
+        stop = threading.Event()
+        errors = []
+        scrapes = {"n": 0}
+
+        def scraper(path):
+            while not stop.is_set():
+                try:
+                    status, _, body = srv.handle(path)
+                    assert status == 200, (path, status)
+                    if path == "/metrics":
+                        assert ("# TYPE koord_scheduler_cycle_seconds "
+                                "histogram") in body
+                    elif path == "/debug/timeline":
+                        assert load_timeline_bundle(
+                            body.splitlines())[2] == []
+                    elif path == "/debug/slo":
+                        assert load_slo_bundle(
+                            body.splitlines())[2] == []
+                    elif path == "/healthz":
+                        json.loads(body)
+                    scrapes["n"] += 1
+                except Exception as exc:  # surfaced via the errors list
+                    errors.append(f"{path}: {type(exc).__name__}: {exc}")
+                    return
+
+        threads = [threading.Thread(target=scraper, args=(p,))
+                   for p in ("/metrics", "/debug/timeline", "/debug/slo",
+                             "/healthz", "/traces")]
+        for th in threads:
+            th.start()
+        try:
+            for cycle in range(14):
+                sim._run_one_cycle(cycle)
+        finally:
+            stop.set()
+            for th in threads:
+                th.join(timeout=30)
+        assert errors == []
+        assert scrapes["n"] > 0
+        report = sim.run_report()
+        assert report.invariant_breaches == []

@@ -1256,3 +1256,125 @@ class TestUnshardedTransferInMeshPath:
             out = analyze_source(source, path=rel,
                                  rules={self.RULE: all_rules()[self.RULE]})
             assert [f for f in out if f.rule == self.RULE] == [], rel
+
+
+class TestSilentDemotionBranch:
+    RULE = "silent-demotion-branch"
+
+    def test_positive_constant_return(self):
+        src = """
+            class Scheduler:
+                def _effective_waves(self, pending):
+                    if self.ladder.level >= 3:
+                        return 1
+                    return k
+        """
+        out = findings_for(src, self.RULE,
+                           path="koordinator_tpu/scheduler/cycle.py")
+        assert len(out) == 1
+        assert "structured reason" in out[0].message
+
+    def test_positive_none_and_bare_return(self):
+        src = """
+            class Scheduler:
+                def _effective_explain(self):
+                    if self._sidecar_client is not None:
+                        return None
+                    if self.ladder.level >= 4:
+                        return
+                    return self.explain_spec
+        """
+        out = findings_for(src, self.RULE,
+                           path="koordinator_tpu/scheduler/cycle.py")
+        assert len(out) == 2
+
+    def test_positive_constant_assignment_to_returned_name(self):
+        src = """
+            class Scheduler:
+                def _effective_waves(self, pending):
+                    k = self.resolve(pending)
+                    if pending_reservations:
+                        k = 1
+                    return k
+        """
+        out = findings_for(src, self.RULE,
+                           path="koordinator_tpu/scheduler/cycle.py")
+        assert len(out) == 1
+        assert "two-statement" in out[0].message
+
+    def test_negative_chokepoint_and_passthrough(self):
+        src = """
+            class Scheduler:
+                def _effective_waves(self, pending):
+                    k = max(1, min(self.spec, 8))
+                    if k == 1:
+                        return k
+                    if self.ladder.level >= 3:
+                        return self._note_demotion("ladder-serial-waves", 1)
+                    return k
+
+                def _effective_explain(self):
+                    if self.explain_spec is None:
+                        return self.explain_spec
+                    if self._sidecar_client is not None:
+                        return self._note_demotion("explain-sidecar", None)
+                    return self.explain_spec
+        """
+        assert findings_for(src, self.RULE,
+                            path="koordinator_tpu/scheduler/cycle.py") == []
+
+    def test_negative_outside_scheduler_and_other_functions(self):
+        src = """
+            class Scheduler:
+                def _effective_waves(self, pending):
+                    return 1
+
+                def resolve(self):
+                    return 1
+        """
+        # non-scheduler path: silent
+        assert findings_for(src, self.RULE,
+                            path="koordinator_tpu/balance/pack.py") == []
+        # only _effective_* functions are demotion resolvers
+        out = findings_for(src, self.RULE,
+                           path="koordinator_tpu/scheduler/cycle.py")
+        assert len(out) == 1  # the _effective_waves one, not resolve()
+
+    def test_pragma_suppresses(self):
+        src = """
+            class Scheduler:
+                def _effective_waves(self, pending):
+                    if special_case:
+                        # koordlint: disable=silent-demotion-branch
+                        return 1
+                    return k
+        """
+        assert findings_for(src, self.RULE,
+                            path="koordinator_tpu/scheduler/cycle.py") == []
+
+    def test_negative_nested_helper_not_flagged(self):
+        """A local helper inside a resolver has its own contract: its
+        constant returns (and names it returns) must not be charged to
+        the outer _effective_* function."""
+        src = """
+            class Scheduler:
+                def _effective_waves(self, pending):
+                    def _cap():
+                        floor = 1
+                        return 1
+                    floor = _cap()
+                    return floor
+        """
+        assert findings_for(src, self.RULE,
+                            path="koordinator_tpu/scheduler/cycle.py") == []
+
+    def test_shipped_scheduler_package_is_clean(self):
+        """The ROADMAP pin: no demotion branch in the shipped scheduler
+        bypasses the chokepoint, with an EMPTY baseline."""
+        for rel in sorted(
+                (REPO_ROOT / "koordinator_tpu" / "scheduler").glob("*.py")):
+            source = rel.read_text()
+            path = f"koordinator_tpu/scheduler/{rel.name}"
+            out = analyze_source(source, path=path,
+                                 rules={self.RULE: all_rules()[self.RULE]})
+            assert [f for f in out if f.rule == self.RULE] == [], path
