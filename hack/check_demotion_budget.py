@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Demotion-budget gate (PR 14): the fused-wave burn-down must not rot.
+
+Runs a seeded soak-derived koordsim scenario through the REAL Scheduler
+and asserts the demoted-cycle fraction stays within budget (the pre-PR-14
+soak demoted 61.1% of cycles — claim-pods 478 / ladder 130 / sidecar 3,
+CHURN_r04/r05; post burn-down the only legitimate demotions left are the
+degradation ladder's fault responses, the sidecar, non-expressible
+transformers and claim entanglement, none of which this scenario
+triggers at scale). A future PR reintroducing a data-driven demotion
+branch fails here fast, with the per-reason profile printed for the
+post-mortem.
+
+Usage: check_demotion_budget.py [--budget 0.15] [--cycles 150]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget", type=float, default=0.15,
+                    help="max fraction of cycles demoted (default 0.15)")
+    ap.add_argument("--cycles", type=int, default=150,
+                    help="soak-scenario cycle budget for the gate run")
+    args = ap.parse_args(argv)
+
+    import dataclasses
+    import os
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from koordinator_tpu.sim.harness import run_scenario
+    from koordinator_tpu.sim.scenarios import SCENARIOS
+
+    sc = dataclasses.replace(SCENARIOS["soak"], cycles=args.cycles)
+    report = run_scenario(sc).to_dict()
+    demo = report["demotions"]
+    frac = demo["fraction_of_cycles"]
+    line = (f"demotion budget: {demo['cycles_demoted']}/{report['cycles']} "
+            f"cycles demoted ({frac:.1%}) vs budget {args.budget:.0%}; "
+            f"profile {json.dumps(demo['by_reason'])}")
+    if frac > args.budget:
+        print(f"FAIL {line}", file=sys.stderr)
+        return 1
+    print(f"ok {line}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

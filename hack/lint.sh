@@ -71,6 +71,15 @@ echo "== README metric-catalog drift gate =="
 # can never rot again
 python hack/check_metrics_catalog.py > /dev/null
 
+echo "== demotion-budget gate (fused-wave burn-down, PR 14) =="
+# the soak-derived seeded scenario through the REAL Scheduler: the
+# demoted-cycle fraction must stay <= 15% (pre-PR-14 soak demoted 61.1%
+# of cycles, CHURN_r04/r05 — claim-pods/reservations/prod/transformer
+# are carried device state now). A PR reintroducing a data-driven
+# demotion branch fails here fast, with the per-reason profile printed.
+KOORD_TPU_REPLAY_OVERLAP=1 JAX_PLATFORMS=cpu \
+    python hack/check_demotion_budget.py --budget 0.15 --cycles 150
+
 echo "== koordsim seeded smoke scenario (determinism + invariants) =="
 # the fixed-seed smoke scenario through the REAL Scheduler (~50 cycles:
 # Poisson churn, a gang storm cadence, a node drain, metric flips, and a

@@ -180,6 +180,13 @@ def build_loadaware_node_state(
     # the identical two operands below)
     est_np_arr = np.zeros((n_pad, R), np.float32)
     adj_np_arr = np.zeros((n_pad, R), np.float32)
+    # the PROD score term split the same way (PR 14): term_pr ==
+    # est_pr_arr + adj_pr_arr holds bit-exactly because the host below
+    # adds exactly those two operands — the fused wave kernel carries the
+    # prod assigned-estimate sum on device and recomputes the prod term
+    # per wave with the identical two-operand association
+    est_pr_arr = np.zeros((n_pad, R), np.float32)
+    adj_pr_arr = np.zeros((n_pad, R), np.float32)
 
     for i, node in enumerate(nodes):
         nm = node_metrics.get(node.meta.name)
@@ -286,14 +293,22 @@ def build_loadaware_node_state(
         est_np_arr[i] = est_np
         term_np[i] = term
 
-        # prod branch (scoreAccordingProdUsage): prod pod metrics only
+        # prod branch (scoreAccordingProdUsage): prod pod metrics only.
+        # The non-estimated prod usages fold into ONE adjusted vector
+        # first (their set is static while a dispatch is in flight: a pod
+        # bound mid-dispatch has no metrics yet, so it joins the estimate
+        # side), then term = est + adjusted — the same two-operand
+        # association the nonprod branch established, so the fused wave
+        # carry (est fold + one add) reproduces this rebuild bit-for-bit
         if args.score_according_prod_usage:
             est_pr, est_pods_pr = assigned_term(pod_metrics_prod, prod_only=True)
-            term = est_pr.copy()
+            adjusted_pr = np.zeros(R, np.float32)
             for key, vec in pod_metrics_prod.items():
                 if key not in est_pods_pr:  # sumPodUsages excludes estimated pods
-                    term += vec
-            term_pr[i] = term
+                    adjusted_pr += vec
+            term_pr[i] = est_pr + adjusted_pr
+            est_pr_arr[i] = est_pr
+            adj_pr_arr[i] = adjusted_pr
 
     return {
         "la_filter_usage": filter_usage,
@@ -308,6 +323,8 @@ def build_loadaware_node_state(
         # consumed only by the fused wave path (not part of ScheduleInputs)
         "la_est_nonprod": est_np_arr,
         "la_adj_nonprod": adj_np_arr,
+        "la_est_prod": est_pr_arr,
+        "la_adj_prod": adj_pr_arr,
     }
 
 

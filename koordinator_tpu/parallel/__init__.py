@@ -27,6 +27,7 @@ from koordinator_tpu.parallel.full_chain_mesh import (  # noqa: F401
     build_sharded_full_chain_step,
     shard_full_chain_inputs,
     wave_carry_shardings,
+    wave_side_shardings,
 )
 from koordinator_tpu.parallel.rebalance_mesh import (  # noqa: F401
     build_sharded_rebalance_step,

@@ -123,17 +123,25 @@ def build_sharded_fused_wave_step(
     mesh: Mesh,
     active_axes=None,
     explain=None,
+    prod: bool = False,
+    claims: bool = False,
+    res: bool = False,
+    score_passes=(),
 ):
     """Fused multi-wave step (models/fused_waves.py) jitted over the mesh.
 
-    Same contract as build_fused_wave_step — (FullChainInputs, la_est[N, R],
-    la_adj[N, R]) -> FusedWaveOut (+ ExplainOut under koordexplain) — with
-    the node-axis carried state sharded exactly like the serial mesh step:
-    each wave's filter/score rows compute shard-locally, the argmax reduces
-    over ICI, and `commit_pod_state`'s node-row updates stay on the owning
-    shard. The compacted (pod, node, zone) readback buffers are pod-axis
-    and pinned REPLICATED, so the host merge reads the same packed order
-    the serial driver replays (parallel/mesh.merge_readback).
+    Same contract as build_fused_wave_step — (FullChainInputs,
+    WaveSideInputs) -> FusedWaveOut (+ ExplainOut under koordexplain) —
+    with the node-axis carried state sharded exactly like the serial mesh
+    step: each wave's filter/score rows compute shard-locally, the argmax
+    reduces over ICI, and `commit_pod_state`'s node-row updates stay on
+    the owning shard. The compacted (pod, node, zone, res) readback
+    buffers are pod-axis and pinned REPLICATED, so the host merge sees
+    one packed buffer identical on every shard
+    (parallel/mesh.merge_readback). The PR 14 carried extensions follow
+    the same split: prod est/adj and hot-claim coverage are node-axis,
+    claim membership and reservation rows replicate
+    (``wave_side_shardings``).
     """
     from koordinator_tpu.models.fused_waves import (
         FusedWaveOut,
@@ -143,9 +151,10 @@ def build_sharded_fused_wave_step(
     raw = build_fused_wave_step(
         args, num_gangs, num_groups, waves=waves, jit=False,
         active_axes=active_axes, explain=explain,
+        prod=prod, claims=claims, res=res, score_passes=score_passes,
     )
     rep = NamedSharding(mesh, P())
-    fw_out = FusedWaveOut(rep, rep, rep, rep, rep)
+    fw_out = FusedWaveOut(rep, rep, rep, rep, rep, rep)
     if explain is None:
         out_shardings = fw_out
     else:
@@ -154,25 +163,64 @@ def build_sharded_fused_wave_step(
     return jax.jit(raw, out_shardings=out_shardings)
 
 
-def wave_carry_shardings(mesh: Mesh, explain=None):
+def wave_carry_shardings(mesh: Mesh, explain=None, prod: bool = False,
+                         claims: bool = False, res: bool = False):
     """Shardings for the chained wave step's carry tuple: node-axis state
     slots sharded flat over the mesh (the same layout the fused carry has
-    inside the sharded while_loop), pod/quota/gang/term slots replicated.
-    Used both for the step's out_shardings (so the carried state never
-    leaves its shard between wave dispatches) and by the driver to place
-    the few host-created wave-0 slots (put_on_mesh)."""
+    inside the sharded while_loop), pod/quota/gang/reservation/term slots
+    replicated, feature-absent slots None (matching the carry's leafless
+    pytree holes). Used both for the step's out_shardings (so the carried
+    state never leaves its shard between wave dispatches) and by the
+    driver to place the few host-created wave-0 slots (put_on_mesh)."""
     from koordinator_tpu.models.fused_waves import (
         NUM_WAVE_STATE,
+        WAVE_STATE_FIELDS,
         WAVE_STATE_NODE_SLOTS,
     )
 
     node = NamedSharding(mesh, _node_axis_spec(mesh, flat=True))
     rep = NamedSharding(mesh, P())
-    carry = tuple(node if i in WAVE_STATE_NODE_SLOTS else rep
-                  for i in range(NUM_WAVE_STATE))
+    present = {
+        "est_sum_prod": prod,
+        "claim_new": claims,
+        "vol_new": claims,
+        "res_avail": res,
+        "res_remain": res,
+        "res_node": res,
+        "res_succ": res,
+    }
+    carry = tuple(
+        (None if not present.get(WAVE_STATE_FIELDS[i], True)
+         else node if i in WAVE_STATE_NODE_SLOTS else rep)
+        for i in range(NUM_WAVE_STATE))
     if explain == "full":
         carry = carry + (rep,)  # per-pod score-term rows
     return carry
+
+
+def wave_side_shardings(mesh: Mesh, prod: bool = False,
+                        claims: bool = False, res: bool = False):
+    """Sharding pytree for WaveSideInputs: [N, ...] operands follow the
+    flat node sharding, pod-axis/reservation operands replicate."""
+    from koordinator_tpu.models.fused_waves import (
+        ClaimSides,
+        ProdSides,
+        ResSides,
+        WaveSideInputs,
+    )
+
+    node = NamedSharding(mesh, _node_axis_spec(mesh, flat=True))
+    rep = NamedSharding(mesh, P())
+    return WaveSideInputs(
+        la_est=node,
+        la_adj=node,
+        prod=ProdSides(est=node, adj=node) if prod else None,
+        claims=(ClaimSides(pod_claim=rep, pod_nonhot=rep, covered0=node)
+                if claims else None),
+        res=(ResSides(owner_match=rep, rank=rep, alloc=rep, once=rep,
+                      row_of=rep, pod_slot=rep, nominate_ok=rep)
+             if res else None),
+    )
 
 
 def build_sharded_chained_wave_step(
@@ -182,6 +230,10 @@ def build_sharded_chained_wave_step(
     mesh: Mesh,
     active_axes=None,
     explain=None,
+    prod: bool = False,
+    claims: bool = False,
+    res: bool = False,
+    score_passes=(),
 ):
     """One chained wave (models/fused_waves.build_chained_wave_step)
     jitted over the mesh: the overlapped-replay dispatch unit.
@@ -189,7 +241,7 @@ def build_sharded_chained_wave_step(
     The carry's node-axis slots are pinned to the flat node sharding on
     OUTPUT, so chaining dispatches keeps every wave's filter/score rows
     shard-local with no resharding between waves; the per-wave compacted
-    (pod, node, zone) rows come back replicated for the host merge
+    (pod, node, zone, res) rows come back replicated for the host merge
     (parallel/mesh.merge_readback), exactly like the fused step's
     buffers."""
     from koordinator_tpu.models.fused_waves import (
@@ -200,10 +252,15 @@ def build_sharded_chained_wave_step(
     raw = build_chained_wave_step(
         args, num_gangs, num_groups, jit=False,
         active_axes=active_axes, explain=explain,
+        prod=prod, claims=claims, res=res, score_passes=score_passes,
     )
     rep = NamedSharding(mesh, P())
-    rows = WaveChainOut(rep, rep, rep, rep)
-    out_shardings = (wave_carry_shardings(mesh, explain=explain), rows)
+    rows = WaveChainOut(rep, rep, rep, rep, rep)
+    out_shardings = (
+        wave_carry_shardings(mesh, explain=explain, prod=prod,
+                             claims=claims, res=res),
+        rows,
+    )
     if explain is not None:
         out_shardings = out_shardings + (rep,)  # this wave's counts row
     return jax.jit(raw, out_shardings=out_shardings)

@@ -50,7 +50,23 @@ from koordinator_tpu.client.store import (
     ObjectStore,
 )
 from koordinator_tpu.models.full_chain import build_best_full_chain_step
+from koordinator_tpu.models.fused_waves import (
+    MAX_WAVES,
+    WAVE_STATE_FIELDS,
+    WAVE_STATE_NODE_SLOTS,
+    ClaimSides,
+    ProdSides,
+    ResSides,
+    WaveSideInputs,
+    initial_wave_carry,
+)
 from koordinator_tpu.obs import Tracer
+from koordinator_tpu.ops.volumes import (
+    analyze_pending_claims,
+    attached_claim_sets,
+    build_claim_pack,
+    host_effective_vol_needed,
+)
 from koordinator_tpu.scheduler.deadline import (
     DeadlineWatchdog,
     DispatchDeadlineExceeded,
@@ -75,6 +91,7 @@ from koordinator_tpu.scheduler.frameworkext import (
     CycleContext,
     CycleResult,
     FrameworkExtender,
+    ScoreTransformer,
 )
 from koordinator_tpu.scheduler import metrics as scheduler_metrics
 from koordinator_tpu.scheduler.plugins import DEFAULT_PLUGINS
@@ -87,6 +104,39 @@ from koordinator_tpu.scheduler.snapshot import (
 
 RESERVATION_POD_PREFIX = "__reservation__/"
 
+# ---------------------------------------------------------------------------
+# koordwatch demotion-reason registry (PR 14): every `_note_demotion` call
+# site must use a registered reason — the chokepoint enforces it at runtime
+# and tests/test_static_analysis.py pins the call-site literals against this
+# set — and RETIRED reasons (the four data-driven fused-wave demotions burned
+# down by the PR-14 carried state) can never silently reappear: re-adding one
+# requires touching BOTH sets, which the registry pin test fails loudly.
+# ---------------------------------------------------------------------------
+DEMOTION_REASONS = frozenset({
+    # wave-depth demotions (_effective_waves)
+    "ladder-serial-waves",      # degradation ladder at/below serial rung
+    "sidecar",                  # the gRPC sidecar protocol is single-round
+    "non-expressible-transformer",  # a ScoreTransformer without device_pass
+    "claim-entangled",          # unbound-WFFC claim interference or claim
+                                # factorization budget overflow (ops/volumes)
+    # koordexplain demotions (_effective_explain)
+    "explain-sidecar",
+    "explain-ladder",
+    # per-cycle mesh reconfiguration accounting (run_cycle)
+    "mesh-off",
+    "partial-mesh",
+})
+RETIRED_DEMOTION_REASONS = frozenset({
+    "pending-reservations",     # carried: reservation rows + in-kernel
+                                # nomination (models/fused_waves.py)
+    "claim-pods",               # carried: hot-claim columns (ops/volumes.py)
+    "prod-usage-score",         # carried: est_sum_prod + la_adj_prod split
+    "score-transformer",        # expressible transformers run as tensor
+                                # passes; the rest demote as
+                                # non-expressible-transformer
+})
+assert not (DEMOTION_REASONS & RETIRED_DEMOTION_REASONS)
+
 # failure reasons whose condition message is recomputed from the packed
 # batch (scheduler/diagnose.py); the deferral path keeps the batch alive
 # only when one of these is present — the two sites must stay in sync
@@ -98,8 +148,6 @@ def waves_from_env():
     device dispatch, models/fused_waves.py); "auto" (the default) picks K
     from the pending-queue depth, K=1 being the exact serial path."""
     import os
-
-    from koordinator_tpu.models.fused_waves import MAX_WAVES
 
     raw = os.environ.get("KOORD_TPU_WAVES", "auto").strip().lower()
     if raw in ("", "auto"):
@@ -246,8 +294,14 @@ class _WaveStateMirror:
     packed batch would contain — a pod that stays unbound across waves
     must report cycle-w's per-stage counts, not cycle-1's."""
 
-    def __init__(self, fc) -> None:
+    def __init__(self, fc, claims=None, res_alloc=None) -> None:
         self._fc = fc
+        # PR-14 carried-state twins: the hot-claim pack (ops/volumes.py
+        # ClaimPack, host arrays) and the reservation rows' packed
+        # allocatable vectors — None when the dispatch carries neither
+        self._claims = claims
+        self._res_alloc = (np.asarray(res_alloc, np.float32)
+                           if res_alloc is not None else None)
         self.requested = np.array(fc.base.requested, np.float32, copy=True)
         self.quota_used = np.array(fc.quota_used, np.float32, copy=True)
         self.numa_free = np.array(fc.numa_free, np.float32, copy=True)
@@ -271,10 +325,62 @@ class _WaveStateMirror:
         self._aff_dom = np.asarray(fc.aff_dom, np.float32)
         self._aff_match = np.asarray(fc.pod_aff_match, bool)
         self._anti_req = np.asarray(fc.pod_anti_req, bool)
+        if self._claims is not None:
+            n = self.requested.shape[0]
+            self._claim_new = np.zeros((n, self._claims.n_claims),
+                                       np.float32)
+            self._vol_new = np.zeros(n, np.float32)
+            self._vol_free0 = np.array(fc.vol_free, np.float32, copy=True)
 
     def commit(self, i: int, node: int, zone: int) -> None:
-        """Apply one committed binding, mirroring commit_pod_state."""
+        """Apply one kernel-committed binding, mirroring
+        commit_pod_state's kept-only replay form."""
         self.requested[node] += self._fit_requests[i]
+        self._commit_footprint(i, node, zone)
+        if self._claims is None:
+            # exemption-free batches: the running count IS the attached-
+            # set rebuild (every claim unique — ops/volumes.py)
+            self.vol_free[node] -= self._vol_needed[i][self._vol_group[node]]
+        else:
+            # hot claims: track set growth; end_wave() rebuilds vol_free
+            cp = self._claims
+            self._claim_new[node] = np.maximum(
+                self._claim_new[node],
+                cp.pod_claim[i] * (1.0 - cp.covered0[node]))
+            self._vol_new[node] += cp.pod_nonhot[i]
+
+    def commit_reservation(self, slot: int, node: int) -> None:
+        """A reservation pseudo-pod row bound: the CR holds capacity but
+        is not a pod — next-wave state carries the restore transformer's
+        allocatable add only (no pod-count slot, no estimate, no NUMA or
+        affinity footprint)."""
+        self.requested[node] += self._res_alloc[slot]
+
+    def commit_nominated(self, i: int, node: int, zone: int) -> None:
+        """A pod nominated onto an Available reservation: its usage
+        lives inside the reservation's already-counted footprint, so the
+        node's requested row is untouched; NUMA/cpuset/affinity effects
+        apply like any bind."""
+        self._commit_footprint(i, node, zone)
+
+    def apply_succeed(self, consumer_row: int, slot: int,
+                      node: int) -> None:
+        """The reconcile's consumed-allocate-once transition, one wave
+        after the consumption: the reservation stops being counted and
+        its consumer falls back to direct accounting."""
+        self.requested[node] = (
+            (self.requested[node] - self._res_alloc[slot])
+            + self._fit_requests[consumer_row])
+
+    def end_wave(self) -> None:
+        """Wave-boundary claim rebuild: vol_free recomputed set-wise
+        from the dispatch-start value (integer-exact, like the host's
+        limit - len(attached) recompute)."""
+        if self._claims is not None:
+            self.vol_free = (self._vol_free0 - self._vol_new
+                             - self._claim_new.sum(axis=1))
+
+    def _commit_footprint(self, i: int, node: int, zone: int) -> None:
         req = self._requests[i]
         if self._needs_numa[i]:
             _np_spread_fill(self.numa_free[node], req, zone)
@@ -284,7 +390,6 @@ class _WaveStateMirror:
             self.port_used[node] = np.maximum(
                 self.port_used[node],
                 self._wants[i].astype(np.float32))
-        self.vol_free[node] -= self._vol_needed[i][self._vol_group[node]]
         qid = int(self._quota_id[i])
         if qid >= 0:
             for g in self._ancestors[qid]:
@@ -304,7 +409,7 @@ class _WaveStateMirror:
         in (copies: the deferred-diagnosis queue may hold it while later
         waves advance the mirror)."""
         fc = self._fc
-        return fc._replace(
+        patched = fc._replace(
             base=fc.base._replace(requested=self.requested.copy()),
             quota_used=self.quota_used.copy(),
             numa_free=self.numa_free.copy(),
@@ -315,6 +420,36 @@ class _WaveStateMirror:
             anti_cover=self.anti_cover.copy(),
             aff_exists=self.aff_exists.copy(),
         )
+        if self._claims is not None:
+            # the per-(pod, node) effective volume view at current claim
+            # state — what the regrouped [P, VG'] gather would produce
+            patched = patched._replace(
+                vol_needed=host_effective_vol_needed(
+                    fc.vol_needed, fc.node_vol_group,
+                    self._claims.pod_claim, self._claim_new),
+                node_vol_group=np.arange(
+                    self.requested.shape[0], dtype=np.int32))
+        return patched
+
+
+def _apply_mirror_op(mirror: _WaveStateMirror, op: Tuple) -> None:
+    """Replay one typed wave-state mirror op (the lazy backlog entries
+    the fused replay accumulates): pod/nominated/reservation commits,
+    the delayed Succeeded transition, and the wave-boundary claim
+    rebuild — in the exact order the device carry applied them."""
+    kind = op[0]
+    if kind == "pod":
+        mirror.commit(op[1], op[2], op[3])
+    elif kind == "nom":
+        mirror.commit_nominated(op[1], op[2], op[3])
+    elif kind == "res":
+        mirror.commit_reservation(op[1], op[2])
+    elif kind == "succ":
+        mirror.apply_succeed(op[1], op[2], op[3])
+    elif kind == "wave_end":
+        mirror.end_wave()
+    else:  # pragma: no cover - programming error
+        raise ValueError(f"unknown mirror op {kind!r}")
 
 
 class Scheduler:
@@ -461,6 +596,10 @@ class Scheduler:
         self._cycle_demotions: List[str] = []
         self._cycle_decision_ids: List[str] = []
         self._current_decision_id: Optional[str] = None
+        # per-cycle claim analysis (ops/volumes.py): set by
+        # _effective_waves when the fused path carries claims, consumed
+        # by the dispatch's side-input encode
+        self._claim_analysis = None
         self.cycle_deadline_seconds = cycle_deadline_from_env()
         # /explain surface state: written by the cycle thread, read by the
         # ObsServer thread — lock-guarded (koordlint concurrency gate)
@@ -615,7 +754,15 @@ class Scheduler:
         once per cycle per reason (the wave_demotions counter therefore
         reads as demoted CYCLES, and the sim's per-scenario demotion
         profile sums exactly). koordlint rule 19 (silent-demotion-branch)
-        errors on demotion-resolving branches that bypass this."""
+        errors on demotion-resolving branches that bypass this. The
+        reason must be registered (DEMOTION_REASONS) — retired reasons
+        (the PR-14 burn-down) can never silently come back."""
+        if reason not in DEMOTION_REASONS:
+            raise ValueError(
+                f"unregistered demotion reason {reason!r}"
+                + (" (RETIRED — the fused path carries this state now)"
+                   if reason in RETIRED_DEMOTION_REASONS else
+                   "; add it to DEMOTION_REASONS"))
         if self.watch_enabled and reason not in self._cycle_demotions:
             self._cycle_demotions.append(reason)
             scheduler_metrics.WAVE_DEMOTIONS.inc(reason=reason)
@@ -884,12 +1031,36 @@ class Scheduler:
         self._step_cache[key] = step
         return step
 
+    def _device_score_passes(self) -> Tuple:
+        """Registered ScoreTransformers' device tensor passes, in
+        registration order (the host before_score order). The fused path
+        only runs when EVERY ScoreTransformer is device-expressible
+        (_effective_waves demotes otherwise)."""
+        return tuple(
+            t.device_pass for t in self.extender.transformers
+            if isinstance(t, ScoreTransformer)
+            and getattr(t, "device_pass", None) is not None)
+
+    def _score_pass_tag(self) -> Tuple:
+        """Step-cache key component for the baked-in transformer passes:
+        a pass is compiled INTO the wave program, so a registration or a
+        declared parameter change (``device_epoch``) must miss the
+        cache."""
+        return tuple(
+            (t.name, getattr(t, "device_epoch", 0))
+            for t in self.extender.transformers
+            if isinstance(t, ScoreTransformer)
+            and getattr(t, "device_pass", None) is not None)
+
     def _get_fused_step(self, signature: Tuple, ng: int, ngroups: int,
-                        active, waves: int, explain=None) -> object:
+                        active, waves: int, explain=None,
+                        sides_tag: Tuple = (0, 0)) -> object:
         from koordinator_tpu.models.fused_waves import build_fused_wave_step
 
+        nc, nres = sides_tag
         key = ("fused", waves, signature, ng, ngroups, tuple(active),
-               explain, self._mesh_tag())
+               explain, self._mesh_tag(), sides_tag,
+               self._score_pass_tag())
         step = self._step_cache.get(key)
         if step is not None:
             self._last_step_compiled = False
@@ -897,6 +1068,8 @@ class Scheduler:
             return step
         self._last_step_compiled = True
         scheduler_metrics.COMPILE_CACHE_MISSES.inc()
+        prod = self.args.score_according_prod_usage
+        passes = self._device_score_passes()
         with self.tracer.span("compile", signature=str(key)):
             if self.mesh is not None:
                 from koordinator_tpu.parallel import (
@@ -905,16 +1078,19 @@ class Scheduler:
 
                 step = build_sharded_fused_wave_step(
                     self.args, ng, ngroups, waves=waves, mesh=self.mesh,
-                    active_axes=active, explain=explain)
+                    active_axes=active, explain=explain, prod=prod,
+                    claims=nc > 0, res=nres > 0, score_passes=passes)
             else:
                 step = build_fused_wave_step(
                     self.args, ng, ngroups, waves=waves, active_axes=active,
-                    explain=explain)
+                    explain=explain, prod=prod, claims=nc > 0,
+                    res=nres > 0, score_passes=passes)
         self._step_cache[key] = step
         return step
 
     def _get_chain_step(self, signature: Tuple, ng: int, ngroups: int,
-                        active, explain=None) -> object:
+                        active, explain=None,
+                        sides_tag: Tuple = (0, 0)) -> object:
         """The chained per-wave step (overlapped replay). NOTE: no wave
         depth in the cache key — one compiled program serves every K,
         which also collapses the fused path's per-K compile fan-out."""
@@ -922,8 +1098,9 @@ class Scheduler:
             build_chained_wave_step,
         )
 
+        nc, nres = sides_tag
         key = ("chain", signature, ng, ngroups, tuple(active), explain,
-               self._mesh_tag())
+               self._mesh_tag(), sides_tag, self._score_pass_tag())
         step = self._step_cache.get(key)
         if step is not None:
             self._last_step_compiled = False
@@ -931,6 +1108,8 @@ class Scheduler:
             return step
         self._last_step_compiled = True
         scheduler_metrics.COMPILE_CACHE_MISSES.inc()
+        prod = self.args.score_according_prod_usage
+        passes = self._device_score_passes()
         with self.tracer.span("compile", signature=str(key)):
             if self.mesh is not None:
                 from koordinator_tpu.parallel import (
@@ -939,11 +1118,13 @@ class Scheduler:
 
                 step = build_sharded_chained_wave_step(
                     self.args, ng, ngroups, mesh=self.mesh,
-                    active_axes=active, explain=explain)
+                    active_axes=active, explain=explain, prod=prod,
+                    claims=nc > 0, res=nres > 0, score_passes=passes)
             else:
                 step = build_chained_wave_step(
                     self.args, ng, ngroups, active_axes=active,
-                    explain=explain)
+                    explain=explain, prod=prod, claims=nc > 0,
+                    res=nres > 0, score_passes=passes)
         self._step_cache[key] = step
         return step
 
@@ -1120,15 +1301,57 @@ class Scheduler:
             return self._note_demotion("explain-ladder", None)
         return self.explain_spec
 
+    def _analyze_claims(self, pending: List[Pod]):
+        """The batch's claim structure (ops/volumes.analyze_pending_claims)
+        for the fused path: None when no pending pod carries claims. The
+        analysis is stashed for the dispatch's side-input encode so the
+        hot-claim factorization is computed exactly once per cycle."""
+        carriers = [p for p in pending if p.spec.pvc_names]
+        if not carriers:
+            return None
+        # volume-aware mode (real PVC/PV/StorageClass objects, the
+        # SHARED gate in ops/volumes.py): a bind can rewrite another
+        # pending pod's CLASSIFICATION through the store — count the
+        # pods whose claims are unbound/missing, the only channel such
+        # a rewrite can travel
+        from koordinator_tpu.ops.volumes import store_volume_aware
+
+        volume_aware = store_volume_aware(self.store)
+        unbound = 0
+        if volume_aware:
+            for pod in carriers:
+                for claim in pod.spec.pvc_names:
+                    pvc = self.store.get(
+                        KIND_PVC, f"{pod.meta.namespace}/{claim}")
+                    if pvc is None or not pvc.is_bound:
+                        unbound += 1
+                        break
+                if unbound >= 2:
+                    break
+        attached = (self.snapshot_cache.attached_sets()
+                    if self.snapshot_cache is not None
+                    else attached_claim_sets(self.store))
+        return analyze_pending_claims(
+            pending, attached, volume_aware=volume_aware,
+            unbound_claim_pods=unbound)
+
     def _effective_waves(self, pending: List[Pod],
                          pending_reservations: Dict[str, Reservation],
                          override=None) -> int:
         """Resolve this cycle's fused-wave depth. Demotions to K=1 keep
         the fused path exactly equivalent to K serial cycles (see
-        models/fused_waves.py module doc for why each case cannot be
-        carried on-device)."""
-        from koordinator_tpu.models.fused_waves import MAX_WAVES
+        models/fused_waves.py module doc for the remaining cases).
 
+        PR 14 burned the four data-driven demotions down: pending
+        Reservation CRs ride the batch as carried rows with an in-kernel
+        nomination pre-pass, claim-carrying pods ride the hot-claim
+        factorization (ops/volumes.py), prod-usage scoring rides the
+        est/adj prod split, and device-expressible ScoreTransformers run
+        as in-kernel tensor passes — only genuinely non-expressible
+        residues (a transformer without ``device_pass``, claim
+        entanglement) still force the serial path, plus the ladder and
+        the single-round sidecar protocol."""
+        self._claim_analysis = None
         spec = self.waves_spec if override is None else override
         k = _auto_waves(len(pending)) if spec == "auto" else int(spec)
         k = max(1, min(k, MAX_WAVES))
@@ -1140,27 +1363,22 @@ class Scheduler:
         if self._sidecar_client is not None:
             # the sidecar RPC protocol is single-round
             return self._note_demotion("sidecar", 1)
-        if pending_reservations:
-            # a Reservation CR bound in wave 1 turns Available and feeds
-            # the NEXT cycle's nomination pre-pass — not expressible as
-            # carried kernel state
-            return self._note_demotion("pending-reservations", 1)
-        if self.args.score_according_prod_usage:
-            # prod score term is not carried in split form
-            return self._note_demotion("prod-usage-score", 1)
-        if any(p.spec.pvc_names for p in pending):
-            # the volume-group factorization regroups nodes between
-            # cycles once a claim-carrying pod binds
-            return self._note_demotion("claim-pods", 1)
-        from koordinator_tpu.scheduler.frameworkext import ScoreTransformer
-
         if any(isinstance(t, ScoreTransformer)
+               and getattr(t, "device_pass", None) is None
                for t in self.extender.transformers):
-            # a ScoreTransformer may rewrite la_term_nonprod (or any fc
-            # field) AFTER the build; the fused waves recompute the term
-            # from the pre-transform est/adj split every wave, which
-            # would silently discard the rewrite
-            return self._note_demotion("score-transformer", 1)
+            # a host-only ScoreTransformer may rewrite any packed field
+            # AFTER the build; the fused waves rebuild the score terms
+            # from carried state every wave, which would silently discard
+            # the rewrite. Transformers implementing the device protocol
+            # (frameworkext.DeviceScoreTransformer) run in-kernel instead.
+            return self._note_demotion("non-expressible-transformer", 1)
+        analysis = self._analyze_claims(pending)
+        if analysis is not None and analysis.entangled is not None:
+            # the narrow claim residue: classification drift through the
+            # PV/PVC objects or a factorization-budget overflow — the
+            # carried columns cannot express it (ops/volumes.py)
+            return self._note_demotion("claim-entangled", 1)
+        self._claim_analysis = analysis
         return k
 
     # ------------------------------------------------------------------
@@ -1829,10 +2047,15 @@ class Scheduler:
         return self._last_admission
 
     def _encode_batch(self, pending: List[Pod], now: float,
-                      ctx: CycleContext):
+                      ctx: CycleContext, transform_score: bool = True):
         """Snapshot + encode: store objects -> packed FullChainInputs.
         Returns (fc, pods, nodes, ng, ngroups, active) or None when no
-        schedulable node exists. Shared by the serial and fused paths."""
+        schedulable node exists. Shared by the serial and fused paths.
+
+        ``transform_score=False`` (the fused dispatchers): registered
+        ScoreTransformers are NOT applied host-side — the wave kernel
+        applies their device passes to every wave's rebuilt inputs
+        instead (applying both would transform twice)."""
         # pods arrive already view-transformed (run_cycle runs BeforePreFilter
         # ahead of the nomination pre-pass); here the state-level transformer
         # chain runs: ClusterState rewrites, then packed-input rewrites
@@ -1876,7 +2099,8 @@ class Scheduler:
                 list(pods.keys),
             )
             self._last_admission = None
-            fc = self.extender.transform_before_score(fc, ctx)
+            if transform_score:
+                fc = self.extender.transform_before_score(fc, ctx)
             fc, active = reduce_to_active_axes(fc)
             # keep the packed batch for end-of-cycle unschedulability
             # diagnosis (scheduler/diagnose.py reads the same arrays the
@@ -2251,6 +2475,184 @@ class Scheduler:
             if gang_plugin is not None:
                 gang_plugin.update_pod_group_status(self.store, now)
 
+    def _encode_wave_sides(self, fc_host, pods, nodes, pending: List[Pod],
+                           pending_reservations: Dict[str, Reservation],
+                           active, now: float):
+        """Build one dispatch's WaveSideInputs (host arrays) + the replay
+        context: the LoadAware term splits, the hot-claim factorization
+        (ops/volumes.py) and the packed reservation rows (owner-match
+        columns, allocatable remainders, nomination eligibility) the
+        in-kernel pre-passes consume. Returns (fields dict for upload,
+        assembler, replay context dict)."""
+        ex = nodes.extras
+        axis_idx = np.asarray(active)
+
+        def take(name):
+            return np.ascontiguousarray(np.take(ex[name], axis_idx,
+                                                axis=-1))
+
+        fields = {"la_est_nonprod": take("la_est_nonprod"),
+                  "la_adj_nonprod": take("la_adj_nonprod")}
+        prod = self.args.score_according_prod_usage
+        if prod:
+            fields["la_est_prod"] = take("la_est_prod")
+            fields["la_adj_prod"] = take("la_adj_prod")
+        n_pad = int(np.shape(fc_host.base.allocatable)[0])
+        p_pad = pods.padded_size
+        claim_pack = None
+        analysis = self._claim_analysis
+        if analysis is not None and analysis.hot:
+            # the attached view rides the analysis (stashed at
+            # _effective_waves time — never materialized twice per cycle)
+            attached = (analysis.attached if analysis.attached is not None
+                        else attached_claim_sets(self.store))
+            claim_pack = build_claim_pack(
+                analysis, pods.keys, nodes.names, attached, p_pad, n_pad)
+        if claim_pack is not None:
+            fields["claim_pod"] = claim_pack.pod_claim
+            fields["claim_nonhot"] = claim_pack.pod_nonhot
+            fields["claim_covered0"] = claim_pack.covered0
+        res_slots: List[Reservation] = []
+        res_ctx: Dict[str, object] = {"claim_pack": claim_pack,
+                                      "res_slots": res_slots,
+                                      "res_slot_of": {},
+                                      "res_alloc": None, "res_once": None}
+        res_plugin = self.extender.plugin("Reservation")
+        slot_keys = [k for k in pods.keys if k in pending_reservations]
+        if slot_keys:
+            nres = len(slot_keys)
+            row_index = {key: i for i, key in enumerate(pods.keys)}
+            row_of = np.full(nres, -1, np.int32)
+            pod_slot = np.full(p_pad, -1, np.int32)
+            alloc = np.zeros((nres, len(axis_idx)), np.float32)
+            once = np.zeros(nres, np.float32)
+            expired = np.zeros(nres, bool)
+            for j, key in enumerate(slot_keys):
+                res = pending_reservations[key]
+                res_slots.append(res)
+                row = row_index[key]
+                row_of[j] = row
+                pod_slot[row] = j
+                alloc[j] = res.template.requests.to_vector()[axis_idx]
+                once[j] = 1.0 if res.allocate_once else 0.0
+                expired[j] = res.is_expired(now)
+            # the host nominator's preference: earliest created wins
+            order = sorted(
+                range(nres),
+                key=lambda j: (res_slots[j].meta.creation_timestamp,
+                               res_slots[j].meta.name))
+            rank = np.zeros(nres, np.int32)
+            for pos, j in enumerate(order):
+                rank[j] = pos
+            owner_match = np.zeros((p_pad, nres), bool)
+            nominate_ok = np.zeros(p_pad, bool)
+            if res_plugin is not None:
+                by_key = {p.meta.key: p for p in pending}
+                for i, key in enumerate(pods.keys):
+                    if key in pending_reservations:
+                        continue
+                    pod = by_key.get(key)
+                    if pod is None:
+                        continue
+                    spec = pod.spec
+                    # the host pre-pass eligibility class (run_cycle's
+                    # nomination loop): gang/quota admission lives in
+                    # the kernel, and hostPort/PVC/affinity/spread
+                    # placement must pass the Filter chain
+                    if (pod.gang_name or pod.quota_name
+                            or spec.host_ports or spec.pvc_names
+                            or spec.pod_affinity or spec.pod_anti_affinity
+                            or spec.topology_spread):
+                        continue
+                    nominate_ok[i] = True
+                    for j, rkey in enumerate(slot_keys):
+                        owner_match[i, j] = (
+                            not expired[j]
+                            and pending_reservations[rkey].matches(pod))
+            fields["res_owner_match"] = owner_match
+            fields["res_rank"] = rank
+            fields["res_alloc"] = alloc
+            fields["res_once"] = once
+            fields["res_row_of"] = row_of
+            fields["res_pod_slot"] = pod_slot
+            fields["res_nominate_ok"] = nominate_ok
+            res_ctx["res_alloc"] = alloc
+            res_ctx["res_once"] = once
+            res_ctx["res_slot_of"] = {k: j for j, k in
+                                      enumerate(slot_keys)}
+
+        def assemble(up: Dict[str, object]) -> WaveSideInputs:
+            return WaveSideInputs(
+                la_est=up["la_est_nonprod"],
+                la_adj=up["la_adj_nonprod"],
+                prod=(ProdSides(est=up["la_est_prod"],
+                                adj=up["la_adj_prod"]) if prod else None),
+                claims=(ClaimSides(pod_claim=up["claim_pod"],
+                                   pod_nonhot=up["claim_nonhot"],
+                                   covered0=up["claim_covered0"])
+                        if claim_pack is not None else None),
+                res=(ResSides(owner_match=up["res_owner_match"],
+                              rank=up["res_rank"],
+                              alloc=up["res_alloc"],
+                              once=up["res_once"],
+                              row_of=up["res_row_of"],
+                              pod_slot=up["res_pod_slot"],
+                              nominate_ok=up["res_nominate_ok"])
+                     if slot_keys else None),
+            )
+
+        res_ctx["tag"] = (
+            claim_pack.n_claims if claim_pack is not None else 0,
+            len(slot_keys))
+        return fields, assemble, res_ctx
+
+    def _new_wave_mirror(self, fc_host, res_ctx) -> "_WaveStateMirror":
+        return _WaveStateMirror(fc_host, claims=res_ctx["claim_pack"],
+                                res_alloc=res_ctx["res_alloc"])
+
+    def _replay_nominated_binds(self, seg_rows, pod_of, nodes, res_ctx,
+                                ctx, result: CycleResult,
+                                failed_pods: List[Tuple[Pod, str]],
+                                txn=None):
+        """Replay ONE wave's in-kernel nominations host-side, FIRST — the
+        serial pre-pass position: via-reservation Reserve hooks +
+        consume(). ``pod_of`` resolves a packed row to its Pod (the two
+        replay paths index differently). Returns (veto, bound_rows,
+        failed_rows, mirror_ops, succ_next_ops) — ONE implementation for
+        both the fused and the overlapped-chain replay, so their
+        nomination semantics can never drift. A Reserve veto truncates
+        the dispatch (serial would retry the pod through the SAME
+        cycle's kernel batch, which the device already excluded — the
+        next dispatch's host pre-pass re-runs it: one lost cycle, the
+        documented envelope)."""
+        res_slots = res_ctx["res_slots"]
+        res_once = res_ctx["res_once"]
+        veto = False
+        bound_rows: set = set()
+        failed_rows: set = set()
+        mirror_ops: List[Tuple] = []
+        succ_next: List[Tuple] = []
+        for row, node_idx, zone, slot in seg_rows:
+            if slot < 0:
+                continue
+            pod = pod_of(row)
+            res = res_slots[slot]
+            err = self._reserve_and_bind(
+                pod, nodes.names[node_idx], ctx, result,
+                via_reservation=res, txn=txn)
+            if err:
+                failed_pods.append((pod, err))
+                failed_rows.add(row)
+                veto = True
+            else:
+                bound_rows.add(row)
+                mirror_ops.append(("nom", row, node_idx, zone))
+                if res_once is not None and res_once[slot] > 0:
+                    # the reconcile's Succeeded transition lands one
+                    # wave later — both on device and in the mirror
+                    succ_next.append(("succ", row, slot, node_idx))
+        return veto, bound_rows, failed_rows, mirror_ops, succ_next
+
     def _fused_wave_dispatch(
         self,
         pending: List[Pod],
@@ -2261,27 +2663,27 @@ class Scheduler:
         originals: Dict[str, Pod],
         k_waves: int,
     ) -> None:
-        assert not pending_reservations, (
-            "_effective_waves demotes to K=1 when reservation CRs pend")
         result.waves = 0
-        enc = self._encode_batch(pending, now, ctx)
+        # transform_score=False: registered ScoreTransformers run as
+        # in-kernel passes on every wave's rebuilt inputs — the host
+        # before_score must NOT also apply at encode (a non-rebuilt
+        # field like the score weights would transform twice; the
+        # transformer parity gate pins this)
+        enc = self._encode_batch(pending, now, ctx, transform_score=False)
         if enc is None:
             self._fused_no_node_cycles(pending, now, result, k_waves)
             return
         fc, pods, nodes, ng, ngroups, active = enc
         fc_host = fc  # the pre-upload host arrays feed the wave mirror
-        ex = nodes.extras
-        axis_idx = np.asarray(active)
-        la_est = np.ascontiguousarray(
-            np.take(ex["la_est_nonprod"], axis_idx, axis=-1))
-        la_adj = np.ascontiguousarray(
-            np.take(ex["la_adj_nonprod"], axis_idx, axis=-1))
+        side_fields, assemble_sides, res_ctx = self._encode_wave_sides(
+            fc_host, pods, nodes, pending, pending_reservations, active,
+            now)
         # ---- the fused dispatch window, wrapped in the degradation
         # ladder: a failure between step construction and readback
         # (strictly before any binding is replayed) retries once, then
         # demotes — a demotion below fused waves raises
         # FusedDispatchDemoted and the cycle driver re-runs this pass
-        # through the serial path. `fc_host`/`la_est`/`la_adj` hold the
+        # through the serial path. `fc_host`/`side_fields` hold the
         # host arrays, so a retry after a mesh demotion re-uploads from
         # scratch against the rebuilt device snapshot.
         self.ladder.begin_pass()
@@ -2297,6 +2699,7 @@ class Scheduler:
                     (pods.padded_size, nodes.padded_size,
                      fc_host.quota_runtime.shape[0]),
                     ng, ngroups, active, k_waves, explain=explain,
+                    sides_tag=res_ctx["tag"],
                 )
                 with self.tracer.span(
                         "kernel",
@@ -2304,16 +2707,14 @@ class Scheduler:
                         waves=str(k_waves),
                         decision_id=win.decision_id) as ksp:
                     fc = fc_host
-                    la_est_d, la_adj_d = la_est, la_adj
+                    up_fields = side_fields
                     if self.device_snapshot is not None:
                         fc = self.device_snapshot.upload(fc)
-                        sides = self.device_snapshot.upload_fields(
-                            {"la_est_nonprod": la_est,
-                             "la_adj_nonprod": la_adj})
-                        la_est_d = sides["la_est_nonprod"]
-                        la_adj_d = sides["la_adj_nonprod"]
+                        up_fields = self.device_snapshot.upload_fields(
+                            side_fields)
                         self._record_upload_deltas()
                         self.device_snapshot.begin_dispatch()
+                    sides = assemble_sides(up_fields)
                     t_dispatch = time.perf_counter()
                     win.mark_dispatch(self._window_path("fused"))
                     n_shape = (len(nodes.names),
@@ -2322,12 +2723,13 @@ class Scheduler:
                         if self.fault_injector is not None:
                             self.fault_injector("fused")
                         if explain is not None:
-                            out, ex_out = step(fc, la_est_d, la_adj_d,
+                            out, ex_out = step(fc, sides,
                                                np.int32(len(nodes.names)))
                         else:
-                            out = step(fc, la_est_d, la_adj_d)  # async
+                            out = step(fc, sides)  # async
                         compacted = (out.bind_pods, out.bind_nodes,
-                                     out.bind_zones, out.wave_counts)
+                                     out.bind_zones, out.bind_res,
+                                     out.wave_counts)
                         if self.pipeline_mode:
                             self._flush_deferred_in_window()
                             with self.tracer.span("overlap_wait"):
@@ -2338,10 +2740,11 @@ class Scheduler:
                                 # (mesh mode reads them from the
                                 # per-shard replicas in one pass)
                                 (bind_pods, bind_nodes, bind_zones,
+                                 bind_res,
                                  wave_counts) = self._readback_sync(
                                      n_shape, *compacted, path="fused")
                         else:
-                            (bind_pods, bind_nodes, bind_zones,
+                            (bind_pods, bind_nodes, bind_zones, bind_res,
                              wave_counts) = self._readback_sync(
                                  n_shape, *compacted, path="fused")
                         waves_run = int(out.waves_run)
@@ -2367,7 +2770,11 @@ class Scheduler:
                             terms_np = np.asarray(ex_out.terms)
                             ex_bytes += terms_np.nbytes
                             kept_mask = np.zeros(len(pods.keys), bool)
-                            kept_mask[bind_pods[bind_pods >= 0]] = True
+                            # nominated rows (res >= 0) carry no term
+                            # rows — the serial twin's pre-pass binds
+                            # never reach the kernel's attribution
+                            kept_mask[bind_pods[(bind_pods >= 0)
+                                                & (bind_res < 0)]] = True
                             self._stash_terms(pods.keys, kept_mask,
                                               terms_np)
                         scheduler_metrics.EXPLAIN_READBACK_BYTES.inc(
@@ -2402,18 +2809,26 @@ class Scheduler:
         # ---- replay the waves as logical cycles. The state mirror is
         # LAZY: it only exists to diagnose unbound pods against wave-w
         # state, so the happy path (every wave binds cleanly) never pays
-        # the array copies or the per-binding numpy replay — committed
-        # bindings accumulate in a backlog that the first diagnosable
-        # wave replays in order.
+        # the array copies or the per-binding numpy replay — typed
+        # mirror ops accumulate in a backlog that the first diagnosable
+        # wave replays in order (pod/nominated/reservation commits,
+        # succeed transitions, wave-boundary claim rebuilds).
         mirror: Optional[_WaveStateMirror] = None
-        mirror_backlog: List[Tuple[int, int, int]] = []
+        mirror_backlog: List[Tuple] = []
+
+        def mirror_apply(ops) -> None:
+            if mirror is None:
+                mirror_backlog.extend(ops)
+            else:
+                for op in ops:
+                    _apply_mirror_op(mirror, op)
 
         def mirror_state() -> _WaveStateMirror:
             nonlocal mirror
             if mirror is None:
-                mirror = _WaveStateMirror(fc_host)
-                for commit in mirror_backlog:
-                    mirror.commit(*commit)
+                mirror = self._new_wave_mirror(fc_host, res_ctx)
+                for op in mirror_backlog:
+                    _apply_mirror_op(mirror, op)
                 mirror_backlog.clear()
             return mirror
 
@@ -2423,31 +2838,69 @@ class Scheduler:
         bound_mask = np.zeros(len(keys), bool)
         gang_plugin = self.extender.plugin("Coscheduling")
         pos = 0
+        pending_succ: List[Tuple] = []
         for w in range(k_waves):
             n_w = int(wave_counts[w]) if w < waves_run else 0
-            seg = range(pos, pos + n_w)
+            seg_rows = [
+                (int(bind_pods[b]), int(bind_nodes[b]),
+                 int(bind_zones[b]), int(bind_res[b]))
+                for b in range(pos, pos + n_w)]
             pos += n_w
-            bind_of = {int(bind_pods[b]): int(bind_nodes[b]) for b in seg}
             rejected_pods: List[Pod] = []
             failed_pods: List[Tuple[Pod, str]] = []
-            veto = False
+            kernel_ops: List[Tuple] = []
+            # the reconcile's Succeeded transition from the previous
+            # wave's allocate-once consumption applies at this wave's
+            # start — before any of this wave's binds touch the mirror
+            if explain_counts is None and pending_succ:
+                mirror_apply(pending_succ)
+            pending_succ = []
             with self.tracer.span("bind", wave=str(w)) as bsp:
                 bound_before = len(result.bound)
+                # nominated binds first (the serial pre-pass position) —
+                # a migration-created Reservation bound in an earlier
+                # wave is consumed HERE, inside the same dispatch
+                (veto, nom_bound, nom_failed, nom_ops,
+                 pending_succ) = self._replay_nominated_binds(
+                    seg_rows, lambda row: by_key[keys[row]], nodes,
+                    res_ctx, ctx, result, failed_pods)
+                for row in nom_bound:
+                    bound_mask[row] = True
+                nominated = nom_bound | nom_failed
+                if explain_counts is None and nom_ops:
+                    # nominations are pre-pass state: serial cycle w
+                    # packed its batch AFTER them, so this wave's
+                    # diagnosis state includes them
+                    mirror_apply(nom_ops)
+                bind_of = {row: (node_idx, zone)
+                           for row, node_idx, zone, slot in seg_rows
+                           if slot < 0}
                 # one walk in packed (queue) order, the serial bind-loop
                 # contract: bind-or-classify each still-pending pod
                 for i, key in enumerate(keys):
-                    if bound_mask[i]:
-                        continue  # bound in an earlier wave: not pending
+                    if bound_mask[i] or i in nominated:
+                        continue  # bound earlier, or handled above
                     pod = by_key[key]
-                    node_idx = bind_of.get(i)
-                    if node_idx is not None:
+                    ent = bind_of.get(i)
+                    if ent is not None:
+                        node_idx, zone = ent
+                        reservation = pending_reservations.get(key)
                         err = self._reserve_and_bind(
-                            pod, nodes.names[node_idx], ctx, result)
+                            pod, nodes.names[node_idx], ctx, result,
+                            reservation_cr=reservation)
                         if err:
                             failed_pods.append((pod, err))
                             veto = True
                         else:
                             bound_mask[i] = True
+                            if reservation is not None:
+                                kernel_ops.append(
+                                    ("res",
+                                     int(res_ctx["res_slot_of"][key]),
+                                     node_idx))
+                            else:
+                                kernel_ops.append(("pod", i, node_idx,
+                                                   zone))
                         continue
                     reason = pods.unschedulable_reasons.get(i)
                     if reason is not None:
@@ -2513,68 +2966,73 @@ class Scheduler:
             if truncate:
                 break
             # advance the mirror with the device's view of this wave's
-            # commits, so the next logical cycle diagnoses against the
-            # state serial cycle w+1 would have packed (kernel counts
-            # make the whole mirror unnecessary — each wave carries its
-            # own attribution)
+            # kernel commits + the wave-boundary claim rebuild, so the
+            # next logical cycle diagnoses against the state serial
+            # cycle w+1 would have packed (kernel counts make the whole
+            # mirror unnecessary — each wave carries its own attribution)
             if explain_counts is None:
-                for b in seg:
-                    commit = (int(bind_pods[b]), int(bind_nodes[b]),
-                              int(bind_zones[b]))
-                    if mirror is not None:
-                        mirror.commit(*commit)
-                    else:
-                        mirror_backlog.append(commit)
+                mirror_apply(kernel_ops + [("wave_end",)])
         self._last_batch = None
 
     # ------------------------------------------------------------------
     # overlapped wave replay (KOORD_TPU_REPLAY_OVERLAP, the default)
     # ------------------------------------------------------------------
-    def _initial_chain_carry(self, fc, la_est, explain):
+    def _initial_chain_carry(self, fc, sides, explain):
         """Wave-0 carried state for the chained dispatch, from the same
         (device-resident when uploaded) arrays the fused init reads."""
-        from koordinator_tpu.models.fused_waves import initial_wave_carry
-
-        carry = initial_wave_carry(fc, la_est, explain=explain)
+        carry = initial_wave_carry(fc, sides, explain=explain)
         if self.mesh is not None:
-            carry = self._place_chain_carry_on_mesh(carry, explain)
+            carry = self._place_chain_carry_on_mesh(carry, explain, sides)
         return carry
 
-    def _place_chain_carry_on_mesh(self, carry, explain):
+    def _place_chain_carry_on_mesh(self, carry, explain, sides):
         """Wave-0 carry placement for the mesh chain: node-axis slots
-        arrived sharded through the DeviceSnapshot upload and pass
+        that arrived sharded through the DeviceSnapshot upload pass
         through untouched; the host-created slots (the assigned mask,
-        the aff_exists coercion, quota/gang state, koordexplain term
-        rows) are placed REPLICATED via put_on_mesh so the first chain
-        dispatch never pays an implicit reshard."""
-        from koordinator_tpu.models.fused_waves import (
-            WAVE_STATE_NODE_SLOTS,
-        )
+        the aff_exists coercion, quota/gang/reservation state, the fresh
+        claim counters, koordexplain term rows) are placed via
+        put_on_mesh — node-axis zeros under the node sharding, the rest
+        replicated — so the first chain dispatch never pays an implicit
+        reshard."""
         from koordinator_tpu.parallel import (
             put_on_mesh,
             wave_carry_shardings,
         )
 
-        shardings = wave_carry_shardings(self.mesh, explain=explain)
-        return tuple(
-            arr if i in WAVE_STATE_NODE_SLOTS else put_on_mesh(arr, sh)
-            for i, (arr, sh) in enumerate(zip(carry, shardings)))
+        shardings = wave_carry_shardings(
+            self.mesh, explain=explain,
+            prod=sides.prod is not None,
+            claims=sides.claims is not None,
+            res=sides.res is not None)
+        # claim_new/vol_new are node-axis but HOST-CREATED zeros (the
+        # other node slots arrive device-resident through the upload)
+        host_node = {WAVE_STATE_FIELDS.index("claim_new"),
+                     WAVE_STATE_FIELDS.index("vol_new")}
+        out = []
+        for i, (arr, sh) in enumerate(zip(carry, shardings)):
+            if arr is None:
+                out.append(None)
+            elif i in WAVE_STATE_NODE_SLOTS and i not in host_node:
+                out.append(arr)
+            else:
+                out.append(put_on_mesh(arr, sh))
+        return tuple(out)
 
-    def _dispatch_chain_wave(self, step, fc, carry, la_adj_d, n_real: int,
+    def _dispatch_chain_wave(self, step, fc, carry, sides, n_real: int,
                              explain):
         """Dispatch ONE chained wave asynchronously. Returns (next
         carry, WaveChainOut, counts_row-or-None) — all device values,
         nothing synced: the caller decides when to block."""
         if explain is not None:
-            return step(fc, carry, la_adj_d, np.int32(n_real))
-        carry, rows = step(fc, carry, la_adj_d)
+            return step(fc, carry, sides, np.int32(n_real))
+        carry, rows = step(fc, carry, sides)
         return carry, rows, None
 
     def _sync_wave_rows(self, n_shape, rows, counts_row,
                         monitored: bool = True):
         """Materialize one wave's compacted readback — the per-wave
         designated sync point of the overlapped replay. Returns host
-        arrays (pods, nodes, zones, count[, counts_row]).
+        arrays (pods, nodes, zones, res, count[, counts_row]).
 
         ``monitored=False`` (the replay phase, wave >= 2) runs the sync
         INLINE, outside the deadline watchdog: those syncs happen after
@@ -2584,7 +3042,7 @@ class Scheduler:
         The ladder's deadline window is wave 1's readback only; a
         genuinely slow device trips it there on the next cycle."""
         arrays = (rows.bind_pods, rows.bind_nodes, rows.bind_zones,
-                  rows.count)
+                  rows.bind_res, rows.count)
         if counts_row is not None:
             arrays = arrays + (counts_row,)
         if monitored:
@@ -2592,10 +3050,10 @@ class Scheduler:
         else:
             synced = self._readback_sync_now(n_shape, *arrays)
         scheduler_metrics.READBACK_BYTES.inc(
-            int(sum(a.nbytes for a in synced[:4])))
+            int(sum(a.nbytes for a in synced[:5])))
         if counts_row is not None:
             scheduler_metrics.EXPLAIN_READBACK_BYTES.inc(
-                int(synced[4].nbytes))
+                int(synced[5].nbytes))
         return synced
 
     def _drain_abandoned_wave(self, rows) -> None:
@@ -2661,21 +3119,21 @@ class Scheduler:
         KOORD_TPU_REPLAY_OVERLAP=0 and, transitively, to K sequential
         serial cycles (run_replay_overlap_parity + run_fused_wave_parity
         gate both)."""
-        assert not pending_reservations, (
-            "_effective_waves demotes to K=1 when reservation CRs pend")
         result.waves = 0
-        enc = self._encode_batch(pending, now, ctx)
+        # transform_score=False: registered ScoreTransformers run as
+        # in-kernel passes on every wave's rebuilt inputs — the host
+        # before_score must NOT also apply at encode (a non-rebuilt
+        # field like the score weights would transform twice; the
+        # transformer parity gate pins this)
+        enc = self._encode_batch(pending, now, ctx, transform_score=False)
         if enc is None:
             self._fused_no_node_cycles(pending, now, result, k_waves)
             return
         fc, pods, nodes, ng, ngroups, active = enc
         fc_host = fc  # the pre-upload host arrays feed the wave mirror
-        ex = nodes.extras
-        axis_idx = np.asarray(active)
-        la_est = np.ascontiguousarray(
-            np.take(ex["la_est_nonprod"], axis_idx, axis=-1))
-        la_adj = np.ascontiguousarray(
-            np.take(ex["la_adj_nonprod"], axis_idx, axis=-1))
+        side_fields, assemble_sides, res_ctx = self._encode_wave_sides(
+            fc_host, pods, nodes, pending, pending_reservations, active,
+            now)
 
         # ---- ladder-wrapped dispatch window: step build, upload, the
         # wave-1 dispatch and its readback — strictly before any binding.
@@ -2693,6 +3151,7 @@ class Scheduler:
                     (pods.padded_size, nodes.padded_size,
                      fc_host.quota_runtime.shape[0]),
                     ng, ngroups, active, explain=explain,
+                    sides_tag=res_ctx["tag"],
                 )
                 with self.tracer.span(
                         "kernel",
@@ -2700,17 +3159,15 @@ class Scheduler:
                         waves=str(k_waves), overlap="1",
                         decision_id=win.decision_id):
                     fc = fc_host
-                    la_est_d, la_adj_d = la_est, la_adj
+                    up_fields = side_fields
                     if self.device_snapshot is not None:
                         fc = self.device_snapshot.upload(fc)
-                        sides = self.device_snapshot.upload_fields(
-                            {"la_est_nonprod": la_est,
-                             "la_adj_nonprod": la_adj})
-                        la_est_d = sides["la_est_nonprod"]
-                        la_adj_d = sides["la_adj_nonprod"]
+                        up_fields = self.device_snapshot.upload_fields(
+                            side_fields)
                         self._record_upload_deltas()
                         self.device_snapshot.begin_dispatch()
                         window_open = True
+                    sides = assemble_sides(up_fields)
                     t_dispatch = time.perf_counter()
                     win.mark_dispatch(self._window_path("chained"))
                     n_real = len(nodes.names)
@@ -2718,10 +3175,9 @@ class Scheduler:
                                int(np.shape(fc.base.allocatable)[0]))
                     if self.fault_injector is not None:
                         self.fault_injector("fused")
-                    carry = self._initial_chain_carry(fc, la_est_d,
-                                                      explain)
+                    carry = self._initial_chain_carry(fc, sides, explain)
                     carry, rows0, crow0 = self._dispatch_chain_wave(
-                        step, fc, carry, la_adj_d, n_real, explain)
+                        step, fc, carry, sides, n_real, explain)
                     if self.pipeline_mode:
                         # the previous cycle's deferred host work drains
                         # while the device runs wave 1
@@ -2755,8 +3211,8 @@ class Scheduler:
                     raise FusedDispatchDemoted() from exc
         try:
             executed, t_last_sync = self._replay_wave_chain(
-                step, fc, fc_host, carry, la_adj_d, synced, n_shape,
-                n_real, pods, nodes, pending, now, ctx, result,
+                step, fc, fc_host, carry, sides, res_ctx, synced,
+                n_shape, n_real, pods, nodes, pending, now, ctx, result,
                 pending_reservations, originals, k_waves, explain)
         finally:
             if window_open:
@@ -2783,7 +3239,8 @@ class Scheduler:
         fc,
         fc_host,
         carry,
-        la_adj_d,
+        sides,
+        res_ctx,
         synced,
         n_shape,
         n_real: int,
@@ -2833,14 +3290,21 @@ class Scheduler:
         gang_plugin = self.extender.plugin("Coscheduling")
 
         mirror: Optional[_WaveStateMirror] = None
-        mirror_backlog: List[Tuple[int, int, int]] = []
+        mirror_backlog: List[Tuple] = []
+
+        def mirror_apply(ops) -> None:
+            if mirror is None:
+                mirror_backlog.extend(ops)
+            else:
+                for op in ops:
+                    _apply_mirror_op(mirror, op)
 
         def mirror_state() -> _WaveStateMirror:
             nonlocal mirror
             if mirror is None:
-                mirror = _WaveStateMirror(fc_host)
-                for commit in mirror_backlog:
-                    mirror.commit(*commit)
+                mirror = self._new_wave_mirror(fc_host, res_ctx)
+                for op in mirror_backlog:
+                    _apply_mirror_op(mirror, op)
                 mirror_backlog.clear()
             return mirror
 
@@ -2860,17 +3324,20 @@ class Scheduler:
         device_kept = (np.zeros(len(keys), bool)
                        if explain == "full" else None)
         retried_keys: set = set()
+        pending_succ: List[Tuple] = []
         try:
             with self.tracer.span("replay_drain",
                                   waves=str(k_waves)) as dsp:
                 for w in range(k_waves):
                     if synced is not None:
-                        pods_w, nodes_w, zones_w = (synced[0], synced[1],
-                                                    synced[2])
-                        cnt_w = int(synced[3])
-                        crow_w = synced[4] if explain is not None else None
+                        seg_rows = [
+                            (int(synced[0][b]), int(synced[1][b]),
+                             int(synced[2][b]), int(synced[3][b]))
+                            for b in range(int(synced[4]))]
+                        cnt_w = int(synced[4])
+                        crow_w = synced[5] if explain is not None else None
                     else:
-                        pods_w = nodes_w = zones_w = None
+                        seg_rows = []
                         cnt_w = 0
                         crow_w = None
                     # one-ahead: launch wave w+1 BEFORE replaying wave w
@@ -2880,20 +3347,26 @@ class Scheduler:
                     if (synced is not None and cnt_w > 0
                             and w + 1 < k_waves):
                         carry, rows_n, crow_n = self._dispatch_chain_wave(
-                            step, fc, carry, la_adj_d, n_real, explain)
+                            step, fc, carry, sides, n_real, explain)
                         in_flight = (rows_n, crow_n)
                     else:
                         in_flight = None
 
                     if device_kept is not None and cnt_w:
-                        device_kept[
-                            np.asarray(pods_w[:cnt_w], np.int64)] = True
-                    replay_out: Dict[str, object] = {}
+                        # nominated rows (res >= 0) have no term rows —
+                        # the serial twin's pre-pass binds never reach
+                        # the kernel's attribution either
+                        device_kept[[row for row, _n, _z, slot
+                                     in seg_rows if slot < 0]] = True
+                    replay_out: Dict[str, object] = {
+                        "apply_succ": pending_succ}
                     truncate = self._replay_logical_cycle(
-                        w, pods_w, nodes_w, cnt_w, crow_w, pending_rows,
-                        mirror_state, index, n_real, nodes, now, ctx,
-                        result, pending_reservations, originals, explain,
+                        w, seg_rows, cnt_w, crow_w, pending_rows,
+                        mirror_state, mirror_apply, res_ctx, index,
+                        n_real, nodes, now, ctx, result,
+                        pending_reservations, originals, explain,
                         reuse_lists, reuse_attrib, replay_out)
+                    pending_succ = replay_out.get("pending_succ", [])
                     pending_rows = replay_out["pending_rows"]
                     reuse_lists = replay_out["reuse_lists"]
                     reuse_attrib = replay_out["reuse_attrib"]
@@ -2905,18 +3378,16 @@ class Scheduler:
                                                             now)
                     if truncate:
                         break
-                    # advance the mirror with the device's committed rows
+                    # advance the mirror with the device's kernel-
+                    # committed rows + the wave-boundary claim rebuild,
                     # so the next logical cycle diagnoses at
                     # wave-(w+1)-start state (kernel counts make the
-                    # mirror unnecessary)
-                    if explain is None and cnt_w:
-                        for b in range(cnt_w):
-                            commit = (int(pods_w[b]), int(nodes_w[b]),
-                                      int(zones_w[b]))
-                            if mirror is not None:
-                                mirror.commit(*commit)
-                            else:
-                                mirror_backlog.append(commit)
+                    # mirror unnecessary; nominated/succeed ops were
+                    # applied pre-diagnosis inside the replay)
+                    if explain is None:
+                        ops = replay_out.get("kernel_mirror_ops", [])
+                        if cnt_w or ops:
+                            mirror_apply(list(ops) + [("wave_end",)])
                     if in_flight is not None:
                         rows_n, crow_n = in_flight
                         in_flight = None
@@ -2976,12 +3447,13 @@ class Scheduler:
     def _replay_logical_cycle(
         self,
         w: int,
-        pods_w,
-        nodes_w,
+        seg_rows,
         cnt_w: int,
         crow_w,
         pending_rows,
         mirror_state,
+        mirror_apply,
+        res_ctx,
         index,
         n_real: int,
         nodes,
@@ -2995,40 +3467,80 @@ class Scheduler:
         reuse_attrib,
         out: dict,
     ) -> bool:
-        """Replay ONE logical cycle of the overlapped chain (bind and
-        classify in packed order, PostFilter preemption, failure records,
-        condition capture). Returns whether the dispatch truncates; the
-        updated pending slice and fixpoint-reuse caches ride ``out``.
-        A pending row's verdict is a string (the static failure reason)
-        or the chain's reject sentinel (any non-string: gang/quota
-        admission rejection)."""
+        """Replay ONE logical cycle of the overlapped chain (nominated
+        via-reservation binds first — the serial pre-pass position —
+        then bind/classify in packed order, PostFilter preemption,
+        failure records, condition capture). Returns whether the
+        dispatch truncates; the updated pending slice, the fixpoint-
+        reuse caches, the kernel mirror ops and the delayed Succeeded
+        transitions ride ``out``. A pending row's verdict is a string
+        (the static failure reason) or the chain's reject sentinel (any
+        non-string: gang/quota admission rejection)."""
         rejected_pods: List[Pod] = []
         failed_pods: List[Tuple[Pod, str]] = []
+        kernel_ops: List[Tuple] = []
         veto = False
         fresh = True
         txn: List[tuple] = []  # (patched, live pod, annotations, node)
         with self.tracer.span("wave_replay", index=str(w)) as wsp:
             bound_before = len(result.bound)
+            # the previous wave's allocate-once consumption lands its
+            # Succeeded transition at THIS wave's start (device pass 0a)
+            if explain is None and out.get("apply_succ"):
+                mirror_apply(out["apply_succ"])
             if cnt_w == 0 and reuse_lists is not None:
                 # fixpoint repeat: same pending slice, same wave-start
                 # state — the previous wave's partition IS this wave's
                 rejected_pods, failed_pods = reuse_lists
                 fresh = False
             else:
-                bind_of = ({int(pods_w[b]): int(nodes_w[b])
-                            for b in range(cnt_w)} if cnt_w else {})
+                pod_of_row = {i: pod for i, pod, _v in pending_rows}
+                bound_mask: Dict[int, bool] = {}
+                nom_failed: set = set()
+                if any(slot >= 0 for _i, _n, _z, slot in seg_rows):
+                    # the SAME nomination replay the fused path runs
+                    # (serial pre-pass position, via-reservation binds)
+                    (nveto, nom_bound, nom_failed, nom_ops,
+                     succ_ops) = self._replay_nominated_binds(
+                        seg_rows, pod_of_row.__getitem__, nodes,
+                        res_ctx, ctx, result, failed_pods, txn=txn)
+                    veto |= nveto
+                    for row in nom_bound:
+                        bound_mask[row] = True
+                    if explain is None and nom_ops:
+                        mirror_apply(nom_ops)
+                    if succ_ops:
+                        out.setdefault("pending_succ", []).extend(
+                            succ_ops)
+                bind_of = {row: (node_idx, zone)
+                           for row, node_idx, zone, slot in seg_rows
+                           if slot < 0}
                 still: List[Tuple[int, Pod, object]] = []
                 for ent in pending_rows:
                     i, pod, verdict = ent
-                    node_idx = bind_of.get(i) if cnt_w else None
-                    if node_idx is not None:
+                    if bound_mask.get(i):
+                        continue  # nominated above: bound, not pending
+                    if i in nom_failed:
+                        still.append(ent)  # veto: stays pending
+                        continue
+                    bnd = bind_of.get(i) if cnt_w else None
+                    if bnd is not None:
+                        node_idx, zone = bnd
+                        key = pod.meta.key
+                        reservation = pending_reservations.get(key)
                         err = self._reserve_and_bind(
                             pod, nodes.names[node_idx], ctx, result,
-                            txn=txn)
+                            reservation_cr=reservation, txn=txn)
                         if err:
                             failed_pods.append((pod, err))
                             veto = True
                             still.append(ent)
+                        elif reservation is not None:
+                            kernel_ops.append(
+                                ("res", int(res_ctx["res_slot_of"][key]),
+                                 node_idx))
+                        else:
+                            kernel_ops.append(("pod", i, node_idx, zone))
                         continue
                     still.append(ent)
                     if isinstance(verdict, str):
@@ -3118,6 +3630,7 @@ class Scheduler:
         out["pending_rows"] = pending_rows
         out["reuse_lists"] = reuse_lists
         out["reuse_attrib"] = reuse_attrib
+        out["kernel_mirror_ops"] = kernel_ops
         return truncate
 
     # ------------------------------------------------------------------

@@ -140,6 +140,50 @@ class ScoreTransformer(SchedulingTransformer):
         """Return replacement FullChainInputs, or None to keep."""
         return None
 
+    # PR 14 device-expressible protocol: a ScoreTransformer whose rewrite
+    # can run INSIDE the fused wave kernel sets ``device_pass`` (see
+    # DeviceScoreTransformer); transformers without it force the fused
+    # dispatch down to the exact serial path (the
+    # ``non-expressible-transformer`` demotion).
+    device_pass = None
+
+
+class DeviceScoreTransformer(ScoreTransformer):
+    """A ScoreTransformer expressible as a pure tensor pass — the shape
+    the fused wave kernel can carry (models/fused_waves.py).
+
+    Implement ``device_pass(inputs) -> inputs``: a jax-traceable, pure,
+    cycle-independent rewrite of the packed FullChainInputs. Contract:
+
+      * SCORE-side fields only (la_term_nonprod / la_term_prod,
+        pref_scores, img_scores, ppref_w, base.weights ...): the kept-
+        only replay commits through the UNtransformed inputs, so a
+        filter/commit-side rewrite would desynchronize carried state.
+      * pure + trace-stable: the pass is compiled INTO the wave program
+        and re-applied to every wave's rebuilt inputs. Parameter changes
+        must bump ``device_epoch`` (a step-cache key component) or a
+        cached program keeps the old constants.
+      * elementwise/gather jnp ops only for bit-stability: the host
+        ``before_score`` (which the SERIAL path still runs) applies the
+        SAME function, so the two paths produce identical floats.
+
+    The default ``before_score`` routes through ``device_pass`` and
+    materializes the result back to host numpy, keeping the serial
+    path's packed batch a plain host array set."""
+
+    device_epoch = 0
+
+    def device_pass(self, inputs):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def before_score(self, inputs, ctx: "CycleContext"):
+        out = self.device_pass(inputs)
+        if out is None:
+            return None
+        import jax
+
+        return jax.tree_util.tree_map(np.asarray, out)
+
 
 class SchedulerMonitor:
     """Slow/stuck cycle watchdog (frameworkext/scheduler_monitor.go:44-108).

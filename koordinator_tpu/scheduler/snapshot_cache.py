@@ -728,7 +728,10 @@ def _mesh_node_fields() -> Set[str]:
                   "pod_valid", "weights"}
     base_node = set(ScheduleInputs._fields) - pod_fields
     return (base_node | set(_FC_NODE_FIELDS)
-            | {"la_est_nonprod", "la_adj_nonprod"}
+            | {"la_est_nonprod", "la_adj_nonprod",
+               # PR 14 fused side arrays: the prod term split and the
+               # hot-claim coverage rows ride the node axis too
+               "la_est_prod", "la_adj_prod", "claim_covered0"}
             | set(RB_NODE_FIELDS) | set(COLO_NODE_FIELDS))
 
 

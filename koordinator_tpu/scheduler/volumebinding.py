@@ -211,6 +211,19 @@ class VolumeBindingPlugin(Plugin):
                 ctx: CycleContext) -> Optional[str]:
         if not pod.spec.pvc_names:
             return None
+        # opaque-token mode (the SHARED volume-aware gate,
+        # ops/volumes.py): pvc_names are CSI count tokens, nothing to
+        # bind — Reserve must not veto them (pre-PR-14 it did, making
+        # every sim claim pod an immortal queue resident). Cached per
+        # cycle on the CycleContext: Reserve runs per binding.
+        aware = ctx.data.get("volume_aware")
+        if aware is None:
+            from koordinator_tpu.ops.volumes import store_volume_aware
+
+            aware = ctx.data["volume_aware"] = store_volume_aware(
+                self._store)
+        if not aware:
+            return None
         node = self._store.get(KIND_NODE, f"/{node_name}")
         node_labels = node.meta.labels if node is not None else {}
         assumed = self._assumed(ctx)

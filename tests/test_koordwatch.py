@@ -104,51 +104,61 @@ class TestDemotionAccounting:
         assert res.demotions == []
         assert res.waves == 4
 
-    def test_pending_reservation_demotes_with_reason(self):
+    def test_retired_reasons_no_longer_demote(self):
+        """PR 14 burn-down: pending reservations, claim pods and prod
+        scoring all run FUSED now — the retired reasons never fire (and
+        the chokepoint would raise if they tried)."""
         store = make_store()
         sched = Scheduler(store, waves=4)
-        before = demotion_count("pending-reservations")
         store.add(KIND_RESERVATION, Reservation(
             meta=ObjectMeta(name="r1", namespace=""),
             template=PodSpec(requests=ResourceList.of(cpu=100))))
         for i in range(3):
             pend_pod(store, f"p{i}")
-        res = sched.run_cycle(now=NOW)
-        assert res.waves == 1
-        assert "pending-reservations" in res.demotions
-        assert demotion_count("pending-reservations") == before + 1
-        # the flight record carries the reasons too
-        rec = sched.flight.snapshot()[-1]
-        assert rec["demotions"] == res.demotions
-        assert rec["decision_ids"] == res.decision_ids
-
-    def test_claim_pods_and_score_transformer_reasons(self):
-        store = make_store()
-        sched = Scheduler(store, waves=4)
         pend_pod(store, "claims", pvc_names=["c1"])
         res = sched.run_cycle(now=NOW)
-        assert "claim-pods" in res.demotions
+        assert res.waves == 4
+        assert res.demotions == []
+        rec = sched.flight.snapshot()[-1]
+        assert rec["demotions"] == []
+        assert rec["decision_ids"] == res.decision_ids
 
+        from koordinator_tpu.ops.loadaware import LoadAwareArgs
+
+        store2 = make_store()
+        sched2 = Scheduler(
+            store2, args=LoadAwareArgs(score_according_prod_usage=True),
+            waves=4)
+        pend_pod(store2, "p0")
+        res2 = sched2.run_cycle(now=NOW)
+        assert res2.demotions == []
+
+    def test_non_expressible_transformer_reason(self):
+        """A host-only ScoreTransformer is the one transformer residue
+        left: it demotes with its own registered reason (the retired
+        'score-transformer' reason is pinned out by the registry)."""
+        from koordinator_tpu.scheduler.cycle import (
+            DEMOTION_REASONS,
+            RETIRED_DEMOTION_REASONS,
+        )
         from koordinator_tpu.scheduler.frameworkext import ScoreTransformer
 
+        before = demotion_count("non-expressible-transformer")
         store2 = make_store()
         sched2 = Scheduler(store2, waves=4)
         sched2.extender.register_transformer(ScoreTransformer())
         for i in range(2):
             pend_pod(store2, f"q{i}")
         res2 = sched2.run_cycle(now=NOW)
-        assert "score-transformer" in res2.demotions
-
-    def test_prod_usage_scoring_reason(self):
-        from koordinator_tpu.ops.loadaware import LoadAwareArgs
-
-        store = make_store()
-        sched = Scheduler(
-            store, args=LoadAwareArgs(score_according_prod_usage=True),
-            waves=4)
-        pend_pod(store, "p0")
-        res = sched.run_cycle(now=NOW)
-        assert "prod-usage-score" in res.demotions
+        assert res2.waves == 1
+        assert "non-expressible-transformer" in res2.demotions
+        assert demotion_count("non-expressible-transformer") == before + 1
+        # registry hygiene: the retired set and the live set are disjoint
+        # and every retired reason raises at the chokepoint
+        assert not (DEMOTION_REASONS & RETIRED_DEMOTION_REASONS)
+        for retired in RETIRED_DEMOTION_REASONS:
+            with pytest.raises(ValueError):
+                sched2._note_demotion(retired, 1)
 
     def test_sidecar_demotes_waves_and_explain(self):
         from koordinator_tpu.sim.faults import DeadSidecarClient
@@ -199,21 +209,21 @@ class TestDemotionAccounting:
         del res
 
     def test_reasons_deduped_per_cycle(self):
+        from koordinator_tpu.scheduler.frameworkext import ScoreTransformer
+
         store = make_store()
         sched = Scheduler(store, waves=4)
-        store.add(KIND_RESERVATION, Reservation(
-            meta=ObjectMeta(name="r1", namespace=""),
-            template=PodSpec(requests=ResourceList.of(cpu=100))))
+        sched.extender.register_transformer(ScoreTransformer())
         pend_pod(store, "p0")
         res = sched.run_cycle(now=NOW)
-        assert res.demotions.count("pending-reservations") == 1
+        assert res.demotions.count("non-expressible-transformer") == 1
 
     def test_watch_off_disables_accounting_but_not_ids(self):
+        from koordinator_tpu.scheduler.frameworkext import ScoreTransformer
+
         store = make_store()
         sched = Scheduler(store, waves=4, watch=False)
-        store.add(KIND_RESERVATION, Reservation(
-            meta=ObjectMeta(name="r1", namespace=""),
-            template=PodSpec(requests=ResourceList.of(cpu=100))))
+        sched.extender.register_transformer(ScoreTransformer())
         pend_pod(store, "p0")
         res = sched.run_cycle(now=NOW)
         assert res.waves == 1          # behavior unchanged

@@ -1378,3 +1378,46 @@ class TestSilentDemotionBranch:
             out = analyze_source(source, path=path,
                                  rules={self.RULE: all_rules()[self.RULE]})
             assert [f for f in out if f.rule == self.RULE] == [], path
+
+    def test_demotion_reason_registry_pins_call_sites(self):
+        """PR 14 registry pin: every literal reason passed to
+        ``_note_demotion`` anywhere in the shipped scheduler is in
+        DEMOTION_REASONS, none is RETIRED (the four burned-down reasons
+        can never silently reappear), and the registry itself stays
+        disjoint from the retired set. Re-adding a data-driven demotion
+        requires touching BOTH the registry and this pin — loudly."""
+        import ast
+
+        from koordinator_tpu.scheduler.cycle import (
+            DEMOTION_REASONS,
+            RETIRED_DEMOTION_REASONS,
+        )
+
+        assert not (DEMOTION_REASONS & RETIRED_DEMOTION_REASONS)
+        # the four PR-14 retirements are exactly the pinned set
+        assert RETIRED_DEMOTION_REASONS == {
+            "pending-reservations", "claim-pods", "prod-usage-score",
+            "score-transformer"}
+        seen = set()
+        for rel in sorted(
+                (REPO_ROOT / "koordinator_tpu" / "scheduler").glob("*.py")):
+            tree = ast.parse(rel.read_text())
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "_note_demotion"
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    reason = node.args[0].value
+                    assert reason in DEMOTION_REASONS, (
+                        f"{rel.name}: unregistered reason {reason!r}")
+                    assert reason not in RETIRED_DEMOTION_REASONS, (
+                        f"{rel.name}: RETIRED reason {reason!r} came back")
+                    seen.add(reason)
+        # the chokepoint is actually exercised: every registered
+        # wave/explain reason has a live call site (mesh accounting uses
+        # a computed value at one site, so mesh reasons may be absent)
+        assert {"ladder-serial-waves", "sidecar",
+                "non-expressible-transformer", "claim-entangled",
+                "explain-sidecar", "explain-ladder"} <= seen
