@@ -172,6 +172,16 @@ def main() -> None:
         "(--smoke caps it at 30)",
     )
     ap.add_argument(
+        "--coldstart", action="store_true",
+        help="persistent compile cache + warm-up ladder A/B: the "
+        "crash-restart scenario as a cold/warm PROCESS pair (cold = no "
+        "cache dir, warm = KOORD_TPU_COMPILE_CACHE_DIR armed with "
+        "KOORD_TPU_WARMUP=sync) — emits cold/warm total and "
+        "restart-to-first-bind walls, the compile/pack split, per-rung "
+        "warm-up counts, and the binding-log determinism verdict "
+        "(COLDSTART_rNN convention)",
+    )
+    ap.add_argument(
         "--device-probe-timeout", type=int, default=150,
         help="seconds per device-init probe attempt (subprocess); after "
         "--device-probe-attempts failures the bench falls back to CPU "
@@ -212,6 +222,10 @@ def main() -> None:
 
     _guard_against_dead_accelerator(args_cli.device_probe_timeout,
                                     args_cli.device_probe_attempts)
+
+    if args_cli.coldstart:
+        run_coldstart(args_cli)
+        return
 
     if churn_scenario is not None:
         run_sim_churn(args_cli, churn_scenario)
@@ -434,16 +448,111 @@ def run_colo_ab(args_cli) -> None:
     }))
 
 
+def run_coldstart(args_cli) -> None:
+    """Coldstart A/B (PR 15): the crash-restart scenario as a cold/warm
+    process pair, plus a dir-reuse third run (the production restart:
+    a whole NEW process against a populated cache).
+
+    cold       — no compile-cache dir: every compile is a fresh XLA
+                 build, at startup AND at the mid-run crash-restart;
+    warm       — KOORD_TPU_COMPILE_CACHE_DIR on a fresh dir with
+                 KOORD_TPU_WARMUP=sync: startup compiles write the
+                 cache, the restart replays the rung index (disk-served
+                 XLA) and binds its first pod with zero steady-state
+                 recompiles;
+    warm-reuse — the same dir again in a NEW process: the whole
+                 startup ladder disk-serves too — the
+                 restart-to-first-bind *wall-clock* story the ROADMAP
+                 host-tail item targets.
+
+    Binding logs must be byte-identical across all three (the cache may
+    never move a decision). BENCH_NOTES convention: wall numbers are a
+    same-box pair; only ratios travel.
+
+    The cold/warm subprocess protocol is hack/check_coldstart.py's —
+    ONE implementation shared with the lint gate, so the env knobs and
+    report keys can never drift between the two."""
+    import os
+    import tempfile
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "hack"))
+    from check_coldstart import (
+        report_restart_wall,
+        run_crash_restart,
+        warm_env,
+    )
+
+    def run(env_extra, label):
+        rep, wall = run_crash_restart(env_extra, label)
+        if rep is None:
+            raise RuntimeError(f"{label} crash-restart run failed")
+        rep["_process_wall_seconds"] = round(wall, 2)
+        return rep
+
+    cache_dir = tempfile.mkdtemp(prefix="koord_coldstart_")
+    runs = {}
+    for label, env in (("cold", {}), ("warm", warm_env(cache_dir)),
+                       ("warm-reuse", warm_env(cache_dir))):
+        rep = runs[label] = run(env, label)
+        log(f"{label}: process wall {rep['_process_wall_seconds']}s, "
+            f"restart-to-first-bind wall "
+            f"{rep['restart']['to_first_bind_wall_seconds']}s "
+            f"(compile {rep['restart']['restart_wall_compile_seconds']} / "
+            f"pack {rep['restart']['restart_wall_pack_seconds']}), "
+            f"warm-up {rep.get('warmup', {}) or 'off'}, "
+            f"{rep['invariant_breaches']} breaches")
+    shas = {label: rep["binding_log_sha256"]
+            for label, rep in runs.items()}
+    deterministic = len(set(shas.values())) == 1
+    log(f"binding logs {'IDENTICAL' if deterministic else 'DIVERGED'} "
+        f"across the trio")
+
+    cold_wall = report_restart_wall(runs["cold"])
+    warm_wall = report_restart_wall(runs["warm"])
+    print(json.dumps({
+        "metric": "coldstart_restart_to_first_bind_wall_seconds",
+        "value": warm_wall,
+        "unit": "s",
+        "pair": [cold_wall, warm_wall],
+        "pair_ratio": round(warm_wall / cold_wall, 3) if cold_wall else 0.0,
+        "scenario": "crash-restart",
+        "waves": 4,
+        "restart_wall_compile_seconds": {
+            label: rep["restart"]["restart_wall_compile_seconds"]
+            for label, rep in runs.items()},
+        "restart_wall_pack_seconds": {
+            label: rep["restart"]["restart_wall_pack_seconds"]
+            for label, rep in runs.items()},
+        "steady_state_compiles": {
+            label: rep["restart"]["steady_state_compiles"]
+            for label, rep in runs.items()},
+        "process_wall_seconds": {
+            label: rep["_process_wall_seconds"]
+            for label, rep in runs.items()},
+        "warmup": {label: rep.get("warmup", {})
+                   for label, rep in runs.items()},
+        "pair_deterministic": deterministic,
+        "binding_log_sha256": shas["cold"],
+        "invariant_breaches": sum(r["invariant_breaches"]
+                                  for r in runs.values()),
+        "platform": "cpu",
+    }))
+
+
 def run_sim_churn(args_cli, scenario) -> None:
     """koordsim scenario as a back-to-back A/B stash pair.
 
-    Runs the named scenario TWICE in this process with the same seed and
-    reports both runs: bound-pods-per-wall-second is the throughput
-    number (pair ratio ~1 is this box's noise floor — BENCH_NOTES
-    convention), the binding-log hashes pin determinism (they MUST be
-    equal: same seed, same code), and time-to-bind p50/p99 plus
-    invariant breaches are the SLO report (the structural deliverable;
-    wall-clock throughput is backend-bound, correctness is not)."""
+    PR 15: the pair is now the PACK-OVERLAP A/B — run A pins
+    KOORD_TPU_PACK_OVERLAP on (the default architecture), run B pins it
+    off (the gap-pack twin). Binding logs MUST still be identical (the
+    overlap is a latency lever, never a decision change — the parity
+    gates pin that too) and the report carries both runs' device idle
+    fractions: the overlap's whole claim is run A's idle fraction
+    strictly below run B's. Everything else is unchanged: bound-pods/s
+    for both runs, time-to-bind p50/p99, invariant breaches and the
+    binding-log hashes (pair determinism), per the BENCH_NOTES
+    noise protocol (same-process pairs only)."""
     import dataclasses
 
     import jax
@@ -458,16 +567,18 @@ def run_sim_churn(args_cli, scenario) -> None:
     log(f"devices: {jax.devices()}")
     log(f"config: churn scenario {sc.name!r} — {sc.cycles} cycles, "
         f"{sc.nodes} nodes, seed {sc.seed}, {len(sc.faults)} scheduled "
-        "faults; two back-to-back runs (A/B pair)")
+        "faults; back-to-back pack-overlap A/B pair (A=on, B=off)")
     reports = []
-    for label in ("A", "B"):
-        rep = run_scenario(sc)
+    for label, overlap in (("A", True), ("B", False)):
+        rep = run_scenario(dataclasses.replace(sc, pack_overlap=overlap))
         reports.append(rep)
-        log(f"run {label}: bound {rep.pods_bound}/{rep.pods_created} in "
+        log(f"run {label} (pack_overlap={'on' if overlap else 'off'}): "
+            f"bound {rep.pods_bound}/{rep.pods_created} in "
             f"{rep.wall_seconds:.1f}s "
             f"({rep.pods_bound / max(rep.wall_seconds, 1e-9):.1f} "
             f"bound/s), ttb p50/p99 {rep.percentile(50):.1f}/"
-            f"{rep.percentile(99):.1f}s, "
+            f"{rep.percentile(99):.1f}s, device idle fraction "
+            f"{rep.device_idle_fraction:.3f}, "
             f"{len(rep.invariant_breaches)} breaches, final ladder "
             f"level {rep.final_level}")
     a, b = reports
@@ -496,6 +607,14 @@ def run_sim_churn(args_cli, scenario) -> None:
         "cycles": sc.cycles,
         "pipeline_occupancy": occ_pair[0],
         "pipeline_occupancy_pair": occ_pair,
+        # pack overlap (PR 15): the pair IS the overlap A/B — A on, B
+        # off. The idle fraction (gap-over-wall between device windows,
+        # koord_device_idle_fraction) is the overlap's deliverable: A
+        # strictly below B, logs identical.
+        "pack_overlap_pair": [True, False],
+        "device_idle_fraction_pair": [
+            round(a.device_idle_fraction, 3),
+            round(b.device_idle_fraction, 3)],
         "pods_per_sec_at_k": a_dict["pipeline"]["pods_per_sec_at_k"],
         "ttb_p50_seconds": round(a.percentile(50), 3),
         "ttb_p99_seconds": round(a.percentile(99), 3),

@@ -40,8 +40,19 @@ echo "== serial-vs-pipelined + fused-wave + explain + mesh cycle parity =="
 # allocatable vectors, degraded-node sets, runtime-quota matrices,
 # revoke-victim lists (order included) and binding logs must be
 # decision-identical at single-device and mesh 1/2/4/8.
-KOORD_TPU_REPLAY_OVERLAP=1 JAX_PLATFORMS=cpu \
+# PR 15: the pack-overlap parity gates ride this run (overlap is
+# default-on, and run_pack_overlap_parity diffs the twin at the
+# ScheduleInputs level), AND the whole suite runs with the persistent
+# compile cache armed — every parity property must hold with on-disk
+# executables serving the deserialized side. The warm-up ladder is
+# pinned OFF here: the suite builds dozens of differently-configured
+# schedulers in one process and each would redundantly replay the
+# shared rung index; the ladder has its own gate (check_coldstart.py).
+_KOORD_CC_DIR="$(mktemp -d)"
+KOORD_TPU_REPLAY_OVERLAP=1 KOORD_TPU_COMPILE_CACHE_DIR="$_KOORD_CC_DIR" \
+    KOORD_TPU_WARMUP=off JAX_PLATFORMS=cpu \
     python -m koordinator_tpu.scheduler.pipeline_parity
+rm -rf "$_KOORD_CC_DIR"
 
 echo "== obs trace schema (golden fixture) =="
 # the CLI exits non-zero on any schema drift against the checked-in trace;
@@ -105,6 +116,18 @@ echo "== koordsim crash-restart scenario (recovery determinism + invariants) =="
 # --churn fault-ladder is the citable wall-clock pair.
 KOORD_TPU_REPLAY_OVERLAP=1 JAX_PLATFORMS=cpu python -m koordinator_tpu.sim crash-restart \
     --check-determinism --max-breaches 0 --quiet > /dev/null
+
+echo "== coldstart gate (persistent compile cache + warm-up ladder) =="
+# PR 15: the crash-restart scenario as a cold/warm process pair — cold
+# pays the full on-demand compile ladder at restart, warm replays the
+# recorded rung index against the persistent cache (KOORD_TPU_WARMUP=
+# sync). Binding logs must be byte-identical, the warm restart must
+# bind its first pod with ZERO steady-state recompiles, and the warm
+# restart-to-first-bind wall must be strictly below cold (one noise
+# re-measure allowed; the margin is the XLA-backend share, which real
+# silicon-scale programs dominate). bench.py --coldstart is the citable
+# number pair (COLDSTART_r01).
+python hack/check_coldstart.py
 
 echo "== koordsim overcommit-shift scenario (colo closed loop) =="
 # koordcolo's soak gate: a co-located koord-manager recomputes batch/mid

@@ -3,6 +3,7 @@
 from koordinator_tpu.analysis.rules import (  # noqa: F401
     balance,
     colo,
+    compilecache,
     concurrency,
     demotion,
     jaxtrace,

@@ -125,6 +125,7 @@ class DeviceRebalancer:
         self.timeline = timeline
         self.last_decision_id: Optional[str] = None
         self._step_cache: Dict[Tuple, object] = {}
+        self._last_step_compiled = False
         self._own_snapshots: Dict[bool, object] = {}  # mesh_on -> mirror
         self._seq = 0
         self._warned_host_only = False
@@ -191,6 +192,7 @@ class DeviceRebalancer:
                     if mesh is not None else ())
         key = (p_pad, n_pad, cap, mesh_tag)
         step = self._step_cache.get(key)
+        self._last_step_compiled = step is None
         if step is None:
             with self.tracer.span("compile", signature=str(key)):
                 if mesh is not None:
@@ -387,12 +389,34 @@ class DeviceRebalancer:
                     mesh.devices.size if mesh is not None else 0),
                     decision_id=win.decision_id):
                 dev = snap.upload_fields(fields)
-                out = step(dev["rb_usage_pct"], dev["rb_has_metric"],
-                           dev["rb_low_thr"], dev["rb_high_thr"],
-                           dev["rb_rhs_hi"], dev["rb_rhs_lo"],
-                           dev["rb_pod_node"], dev["rb_pod_prio"],
-                           dev["rb_pod_cpu"], dev["rb_pod_req"],
-                           dev["rb_pod_ok"])
+                step_args = (dev["rb_usage_pct"], dev["rb_has_metric"],
+                             dev["rb_low_thr"], dev["rb_high_thr"],
+                             dev["rb_rhs_hi"], dev["rb_rhs_lo"],
+                             dev["rb_pod_node"], dev["rb_pod_prio"],
+                             dev["rb_pod_cpu"], dev["rb_pod_req"],
+                             dev["rb_pod_ok"])
+                if self._last_step_compiled:
+                    # persistent warm-up index (scheduler/warmup.py):
+                    # record the fresh rung so a restarted process can
+                    # pre-compile the rebalance pass off the bind path
+                    from koordinator_tpu.scheduler.warmup import (
+                        record_step_compile,
+                    )
+
+                    record_step_compile(
+                        "rebalance",
+                        # p_pad/n_pad ride the meta so the index keeps
+                        # ONE rung per shape bucket (dedupe is on meta;
+                        # without them a grown bucket would evict the
+                        # old bucket's rung)
+                        {"cap": int(
+                            plugin.args.max_pods_to_evict_per_node),
+                         "p_pad": int(p_pad), "n_pad": int(n_pad),
+                         "mesh_tag": [int(d.id)
+                                      for d in mesh.devices.flat]
+                         if mesh is not None else []},
+                        step_args)
+                out = step(*step_args)
             with self.tracer.span("readback"):
                 try:
                     (sel_count, cand_count, sel_pod, sel_node, sel_score,

@@ -165,6 +165,7 @@ class DeviceColoReconciler:
         self.timeline = timeline
         self.last_decision_id: Optional[str] = None
         self._step_cache: Dict[Tuple, object] = {}
+        self._last_step_compiled = False
         self._own_snapshots: Dict[bool, object] = {}  # mesh_on -> mirror
         self._seq = 0
         self._warned_host_only = False
@@ -229,6 +230,7 @@ class DeviceColoReconciler:
         # the next flip instead of leaking a fresh compile per change
         key = (n_pad, g_pad, policies, mesh_tag)
         step = self._step_cache.get(key)
+        self._last_step_compiled = step is None
         if step is None:
             with self.tracer.span("compile", signature=str(key)):
                 if mesh is not None:
@@ -475,7 +477,7 @@ class DeviceColoReconciler:
                     mesh.devices.size if mesh is not None else 0),
                     decision_id=win.decision_id):
                 dev = snap.upload_fields(fields)
-                out = step(
+                step_args = (
                     dev["colo_capacity"], dev["colo_node_reserved"],
                     dev["colo_system_reserved"], dev["colo_node_used"],
                     dev["colo_pod_all_used"], dev["colo_hp_used"],
@@ -489,6 +491,27 @@ class DeviceColoReconciler:
                     dev["colo_q_request"], dev["colo_q_used"],
                     dev["colo_q_allow_lent"], dev["colo_q_enable_scale"],
                     dev["colo_q_valid"], dev["colo_q_total_base"])
+                if self._last_step_compiled:
+                    # persistent warm-up index (scheduler/warmup.py):
+                    # record the fresh rung so a restarted process can
+                    # pre-compile the colo pass off the bind path
+                    from koordinator_tpu.scheduler.warmup import (
+                        record_step_compile,
+                    )
+
+                    record_step_compile(
+                        "colo",
+                        # n_pad/g_pad ride the meta so the index keeps
+                        # ONE rung per shape bucket (dedupe is on meta;
+                        # without them a grown bucket would evict the
+                        # old bucket's rung)
+                        {"policies": [policies[0], policies[1]],
+                         "n_pad": int(n_pad), "g_pad": int(g_pad),
+                         "mesh_tag": [int(d.id)
+                                      for d in mesh.devices.flat]
+                         if mesh is not None else []},
+                        step_args)
+                out = step(*step_args)
             with self.tracer.span("readback"):
                 try:
                     (batch_cpu, batch_mem, mid_cpu, mid_mem, runtime,

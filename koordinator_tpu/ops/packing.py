@@ -112,6 +112,102 @@ class NodeBatch:
         return self.allocatable.shape[0]
 
 
+def queue_key_for(pod: Pod, gang_sort: Dict[str, Tuple[float, str]]) -> tuple:
+    """The scheduling-queue sort key (PrioritySort + coscheduling Less)
+    for one pod under a gang grouping map — ONE implementation shared by
+    pack_pods and the in-window pre-pack (prepack_memo_rows), so a
+    pre-packed queue-key tuple can never drift from the cold fill."""
+    group_time, group_key = gang_sort.get(
+        pod.gang_key,
+        (pod.meta.creation_timestamp, pod.meta.key),
+    )
+    return (
+        -(pod.spec.priority or 0),
+        -pod.sub_priority,
+        group_time,
+        group_key,
+        pod.meta.creation_timestamp,
+        pod.meta.key,
+    )
+
+
+def prepack_memo_rows(
+    cache,
+    pods: Sequence[Pod],
+    resource_weights: Dict[str, int],
+    scaling_factors: Dict[str, int],
+) -> List[Tuple[int, Pod]]:
+    """Pack/device overlap (PR 15): refresh the pack memo's packed-row
+    columns for every pod whose (key, resourceVersion) is stale or
+    absent, IN PLACE — changed keys update their existing row, new keys
+    append — so the next ``pack_pods`` gathers them as hits instead of
+    paying the per-object Python in the inter-window gap. Queue-key
+    tuples are computed under the memo's OWN gang grouping (exactly the
+    tuples ``same_gs`` reuse requires); the estimator runs the same
+    batched call the cold fill uses on the same packed rows, so every
+    written bit equals what the next build's miss path would write.
+
+    Returns the (memo row, pod) pairs refreshed — the snapshot layer
+    fills its flag/sel columns for the same rows."""
+    memo = cache.pack_memo if cache is not None else None
+    if memo is None or "req_wire" not in memo:
+        return []
+    row_of = memo["row_of"]
+    rv = memo["rv"]
+    qk = memo["qk"]
+    gang_sort = memo["gang_sort"]
+    todo: List[Tuple[Optional[int], Pod]] = []
+    for pod in pods:
+        j = row_of.get(pod.meta.key)
+        if j is not None and rv[j] == pod.meta.resource_version:
+            continue
+        todo.append((j, pod))
+    if not todo:
+        return []
+    n_new = sum(1 for j, _p in todo if j is None)
+    if n_new:
+        for col, fill in (("req_wire", 0.0), ("lim_wire", 0.0),
+                          ("prio", 0), ("qos", 5), ("pcls", 0),
+                          ("prod", False), ("ds", False), ("est", 0.0),
+                          ("gang_key", ""), ("quota_name", "")):
+            arr = memo[col]
+            pad = np.full((n_new,) + arr.shape[1:], fill, arr.dtype)
+            memo[col] = np.concatenate([arr, pad])
+    nxt = len(rv)
+    placed: List[Tuple[int, Pod]] = []
+    for j, pod in todo:
+        if j is None:
+            j = nxt
+            nxt += 1
+            row_of[pod.meta.key] = j
+            rv.append(pod.meta.resource_version)
+            qk.append(None)
+        else:
+            rv[j] = pod.meta.resource_version
+        qk[j] = queue_key_for(pod, gang_sort)
+        memo["req_wire"][j] = 0.0
+        memo["lim_wire"][j] = 0.0
+        pod.spec.requests.fill_wire_row(memo["req_wire"][j])
+        pod.spec.limits.fill_wire_row(memo["lim_wire"][j])
+        memo["prio"][j] = pod.spec.priority or 0
+        memo["qos"][j] = int(pod.qos_class)
+        cls = pod.priority_class
+        memo["pcls"][j] = int(cls)
+        memo["prod"][j] = cls in (PriorityClass.PROD, PriorityClass.NONE)
+        memo["ds"][j] = pod.meta.owner_kind == "DaemonSet"
+        memo["gang_key"][j] = pod.gang_key
+        memo["quota_name"][j] = pod.quota_name
+        placed.append((j, pod))
+    idx = np.asarray([j for j, _p in placed])
+    req = (memo["req_wire"][idx] / PACK_SCALE).astype(np.float32)
+    lim = (memo["lim_wire"][idx] / PACK_SCALE).astype(np.float32)
+    memo["est"][idx] = estimate_pods_used_batch(
+        req, lim, memo["pcls"][idx], resource_weights, scaling_factors)
+    cache.stats["pod_rows_prepacked"] = (
+        cache.stats.get("pod_rows_prepacked", 0) + len(placed))
+    return placed
+
+
 def pack_pods(
     pods: Sequence[Pod],
     resource_weights: Dict[str, int],
@@ -145,18 +241,7 @@ def pack_pods(
     same_gs = prev is not None and prev["gang_sort"] == gang_sort
 
     def queue_key_of(pod):
-        group_time, group_key = gang_sort.get(
-            pod.gang_key,
-            (pod.meta.creation_timestamp, pod.meta.key),
-        )
-        return (
-            -(pod.spec.priority or 0),
-            -pod.sub_priority,
-            group_time,
-            group_key,
-            pod.meta.creation_timestamp,
-            pod.meta.key,
-        )
+        return queue_key_for(pod, gang_sort)
 
     # one pass: key/rv lookup against the memo + queue-key tuples (cached
     # tuples reused; this loop is the only O(P) Python the warm path pays).

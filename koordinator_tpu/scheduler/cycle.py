@@ -191,6 +191,20 @@ def replay_overlap_from_env() -> bool:
     return os.environ.get("KOORD_TPU_REPLAY_OVERLAP", "1") != "0"
 
 
+def pack_overlap_from_env() -> bool:
+    """KOORD_TPU_PACK_OVERLAP=0 keeps the incremental pack strictly in
+    the inter-window gap (the pre-PR-15 behavior, and the byte-parity
+    twin). Default on: cycle N's device window pre-packs the next
+    cycle's candidate pod rows into the pack memo (snapshot.py
+    prepack_pending_rows) while the device runs — rows dirtied later in
+    the window reconcile through the (key, resourceVersion) memo keys,
+    so the produced ScheduleInputs are byte-identical either way
+    (run_pack_overlap_parity gates it)."""
+    import os
+
+    return os.environ.get("KOORD_TPU_PACK_OVERLAP", "1") != "0"
+
+
 def cycle_deadline_from_env():
     """KOORD_TPU_CYCLE_DEADLINE_MS=N arms the flight recorder's
     deadline-overrun trigger: a cycle slower than N ms dumps the ring.
@@ -470,6 +484,8 @@ class Scheduler:
         replay_overlap=None,
         dispatch_deadline_ms=None,
         watch=None,
+        pack_overlap=None,
+        warmup=None,
     ):
         from koordinator_tpu.scheduler.config import SchedulerConfiguration
         from koordinator_tpu.scheduler.plugins.reservation import (
@@ -545,7 +561,64 @@ class Scheduler:
         # `python -m koordinator_tpu.obs`
         self.tracer = Tracer()
         self._step_cache: Dict[Tuple, object] = {}
-        self._last_step_compiled = False
+        # per-thread: the background warm-up ladder replays rungs
+        # through _get_*step from its own thread, and its misses must
+        # not leak into the cycle thread's compiled-dispatch
+        # attribution (the flag is always read on the thread that just
+        # called _get_*step)
+        import threading as _threading
+
+        self._step_tls = _threading.local()
+        # host-tail instrumentation (PR 15): cumulative wall seconds of
+        # pack/encode work and of compile work (step builds + the kernel
+        # windows of freshly-built steps, where the lazy XLA build
+        # lands). The crash-restart report splits its recovery wall
+        # clock with these (restart_wall_compile/pack_seconds).
+        # Lock-guarded accumulation: the background warm-up ladder adds
+        # from its own thread, and a lost += would under-report compile.
+        self._wall_lock = _threading.Lock()
+        self.pack_wall_seconds = 0.0
+        self.compile_wall_seconds = 0.0
+        # pack/device overlap (KOORD_TPU_PACK_OVERLAP): pre-pack the
+        # next cycle's candidate pod rows inside this cycle's device
+        # window. An explicit argument pins it (the parity twins and the
+        # bench A/B pair need that).
+        self.pack_overlap = (pack_overlap_from_env()
+                             if pack_overlap is None else bool(pack_overlap))
+        # persistent compile cache + warm-up (scheduler/warmup.py):
+        # KOORD_TPU_COMPILE_CACHE_DIR arms jax's on-disk executable
+        # cache and the rung index; the warm-up ladder (started at the
+        # END of construction, once the mesh/transformers are final)
+        # replays recorded rungs so a restarted scheduler's first cycle
+        # is an in-memory step-cache hit.
+        from koordinator_tpu.scheduler.warmup import (
+            compile_cache_dir_from_env,
+            configure_compile_cache,
+            warmup_mode_from_env,
+        )
+
+        self.compile_cache_dir = configure_compile_cache()
+        self._warmup_mode = (warmup_mode_from_env() if warmup is None
+                             else warmup)
+        if self._warmup_mode == "auto":
+            # keyed on the ENV knob, not the process-global dir: a test
+            # (or co-resident tool) that armed the cache for itself must
+            # not opt every later Scheduler into a background ladder
+            self._warmup_mode = ("background"
+                                 if compile_cache_dir_from_env()
+                                 else "off")
+        self.warmup = None
+        # steady-state compile guard (koordlint rule 20, runtime half):
+        # armed when warm-up completes, dropped on every ladder
+        # transition (those legitimately re-key the step cache). A miss
+        # while armed counts + calls the injectable hook — the sim
+        # harness's runtime assert.
+        self._steady_state = False
+        self.compile_miss_hook = None
+        # parity/test hook: called with the post-reduce host
+        # FullChainInputs at the end of every encode (the
+        # ScheduleInputs-level byte-parity gate for pack overlap)
+        self.encode_observer = None
         # fused multi-wave depth: K rounds per device dispatch
         # (models/fused_waves.py). "auto" picks from queue depth per
         # cycle; an int pins it. K=1 always takes the exact serial path.
@@ -721,6 +794,93 @@ class Scheduler:
             # each cycle. Same condition _apply_degraded_level re-applies
             # on every ladder transition.
             self.device_snapshot = self._new_device_snapshot(self.mesh)
+        # warm-up ladder LAST: it replays rungs through _get_*step, which
+        # reads the final mesh placement and transformer registrations
+        if self._warmup_mode != "off" and self.compile_cache_dir:
+            from koordinator_tpu.scheduler.warmup import WarmupRunner
+
+            self.warmup = WarmupRunner(
+                self, background=self._warmup_mode == "background")
+            self.warmup.start()
+
+    # ------------------------------------------------------------------
+    def note_warmup_complete(self, stats: Dict) -> None:
+        """The warm-up ladder finished: arm the steady-state compile
+        guard (koordlint rule 20's runtime half) — from here on, a
+        step-cache miss in the hot path is flagged until a ladder
+        transition legitimately re-keys the cache. The guard arms only
+        when the ladder actually COVERED something (a first boot
+        against an empty index promised nothing, and flagging its
+        legitimate cold compiles would make the metric unusable)."""
+        scheduler_metrics.WARMUP_COMPLETE.set(1.0)
+        self._steady_state = (
+            stats.get("warmed", 0) + stats.get("built", 0)) > 0
+        logger.info(
+            "warm-up ladder complete: %d/%d rungs warmed in %.2fs "
+            "(%d skipped, %d failed, %d invalidated)",
+            stats["warmed"], stats["rungs"], stats["seconds"],
+            stats["skipped"], stats["failed"], stats["invalidated"])
+
+    def _add_compile_wall(self, seconds: float) -> None:
+        with self._wall_lock:
+            self.compile_wall_seconds += seconds
+
+    def _add_pack_wall(self, seconds: float) -> None:
+        with self._wall_lock:
+            self.pack_wall_seconds += seconds
+
+    @property
+    def _last_step_compiled(self) -> bool:
+        """Whether THIS thread's most recent _get_*step call built a
+        fresh step (thread-local: the background warm-up ladder's
+        misses must never leak into the cycle thread's attribution)."""
+        return getattr(self._step_tls, "compiled", False)
+
+    @_last_step_compiled.setter
+    def _last_step_compiled(self, value: bool) -> None:
+        self._step_tls.compiled = bool(value)
+
+    def _note_compile_miss(self, key: Tuple) -> None:
+        """Shared step-cache miss accounting for the three _get_*step
+        chokepoints — including the steady-state flagging the warm-up
+        contract promises (a warm-cache restart binds its first pod with
+        ZERO of these)."""
+        self._last_step_compiled = True
+        scheduler_metrics.COMPILE_CACHE_MISSES.inc()
+        if self._steady_state:
+            scheduler_metrics.STEADY_STATE_COMPILES.inc()
+            hook = self.compile_miss_hook
+            if hook is not None:
+                hook(key)
+
+    def _record_step_compile(self, kind: str, meta: Dict, args: Tuple) -> None:
+        """Record a freshly-compiled rung into the persistent warm-up
+        index (no-op without KOORD_TPU_COMPILE_CACHE_DIR). Best-effort
+        by contract — the index is for the NEXT process."""
+        if self.compile_cache_dir is None:
+            return
+        from koordinator_tpu.scheduler.warmup import record_step_compile
+
+        record_step_compile(kind, meta, args)
+
+    def _step_meta(self, signature: Tuple, ng: int, ngroups: int, active,
+                   explain, **extra) -> Dict:
+        meta = {
+            "signature": [int(x) for x in signature],
+            "ng": int(ng), "ngroups": int(ngroups),
+            "active": [int(a) for a in active],
+            "explain": explain,
+            "mesh_tag": [int(i) for i in self._mesh_tag()],
+            # config the step STRUCTURE bakes in: a replaying scheduler
+            # whose prod split or transformer registrations differ would
+            # build a different carry pytree than the recorded avals —
+            # warm-up must skip such rungs, not trip over them
+            "prod": bool(self.args.score_according_prod_usage),
+            "score_tag": [[name, int(epoch)]
+                          for name, epoch in self._score_pass_tag()],
+        }
+        meta.update(extra)
+        return meta
 
     # ------------------------------------------------------------------
     def _new_device_snapshot(self, mesh):
@@ -1013,9 +1173,8 @@ class Scheduler:
         # span carries compiled="1" on that cycle. Together with the
         # hit/miss counters that makes the recompile pathology visible
         # (a steady-state cluster should be all hits)
-        self._last_step_compiled = True
-        scheduler_metrics.COMPILE_CACHE_MISSES.inc()
-        with self.tracer.span("compile", signature=str(key)):
+        self._note_compile_miss(key)
+        with self.tracer.span("compile", signature=str(key)) as csp:
             if self.mesh is not None:
                 from koordinator_tpu.parallel import (
                     build_sharded_full_chain_step,
@@ -1028,6 +1187,7 @@ class Scheduler:
                 step = build_best_full_chain_step(
                     self.args, ng, ngroups, active_axes=active,
                     explain=explain)
+        self._add_compile_wall(csp.duration_seconds)
         self._step_cache[key] = step
         return step
 
@@ -1066,11 +1226,10 @@ class Scheduler:
             self._last_step_compiled = False
             scheduler_metrics.COMPILE_CACHE_HITS.inc()
             return step
-        self._last_step_compiled = True
-        scheduler_metrics.COMPILE_CACHE_MISSES.inc()
+        self._note_compile_miss(key)
         prod = self.args.score_according_prod_usage
         passes = self._device_score_passes()
-        with self.tracer.span("compile", signature=str(key)):
+        with self.tracer.span("compile", signature=str(key)) as csp:
             if self.mesh is not None:
                 from koordinator_tpu.parallel import (
                     build_sharded_fused_wave_step,
@@ -1085,6 +1244,7 @@ class Scheduler:
                     self.args, ng, ngroups, waves=waves, active_axes=active,
                     explain=explain, prod=prod, claims=nc > 0,
                     res=nres > 0, score_passes=passes)
+        self._add_compile_wall(csp.duration_seconds)
         self._step_cache[key] = step
         return step
 
@@ -1106,11 +1266,10 @@ class Scheduler:
             self._last_step_compiled = False
             scheduler_metrics.COMPILE_CACHE_HITS.inc()
             return step
-        self._last_step_compiled = True
-        scheduler_metrics.COMPILE_CACHE_MISSES.inc()
+        self._note_compile_miss(key)
         prod = self.args.score_according_prod_usage
         passes = self._device_score_passes()
-        with self.tracer.span("compile", signature=str(key)):
+        with self.tracer.span("compile", signature=str(key)) as csp:
             if self.mesh is not None:
                 from koordinator_tpu.parallel import (
                     build_sharded_chained_wave_step,
@@ -1125,6 +1284,7 @@ class Scheduler:
                     self.args, ng, ngroups, active_axes=active,
                     explain=explain, prod=prod, claims=nc > 0,
                     res=nres > 0, score_passes=passes)
+        self._add_compile_wall(csp.duration_seconds)
         self._step_cache[key] = step
         return step
 
@@ -1150,6 +1310,10 @@ class Scheduler:
         effective settings re-applied, and a flight-recorder dump (the
         preceding cycles' decision records ARE the incident context)."""
         scheduler_metrics.DEGRADED_LEVEL.set(float(record["to_level"]))
+        # a ladder transition legitimately re-keys the step cache (mesh
+        # tag, explain level): drop the steady-state compile guard — it
+        # re-arms only with the next warm-up ladder (i.e. a restart)
+        self._steady_state = False
         log = (logger.warning if record["to_level"] > record["from_level"]
                else logger.info)
         log("dispatch degradation ladder: %s -> %s (%s)",
@@ -1923,6 +2087,68 @@ class Scheduler:
         except Exception as exc:
             raise _HostWriteFailure() from exc
 
+    def _prepack_in_window(self) -> None:
+        """Pack/device overlap (KOORD_TPU_PACK_OVERLAP): pre-pack the
+        NEXT cycle's candidate pod rows into the pack memo while the
+        device executes this cycle's kernel — the store-delta snapshot
+        is taken HERE, at dispatch time, after the deferred flush bumped
+        the condition-written pods. Rows dirtied later in the window
+        (bind patches from the in-flight replay, watch events) simply
+        miss the (key, resourceVersion) memo keys at the real pack and
+        re-pack there, so the produced ScheduleInputs are byte-identical
+        to the non-overlapped pack by construction (and gated by
+        run_pack_overlap_parity + the mid-window-mutation test).
+
+        Purely a memo warm: a failure here costs nothing but the
+        overlap — the next cycle packs in the gap exactly as before —
+        so it is caught, logged and never fed to the ladder.
+
+        A registered BeforePreFilter view transform disables the
+        pre-pack: the real pack consumes TRANSFORMED pod views that
+        keep the store resourceVersion, so a pre-packed raw row would
+        be a (key, rv) hit serving untransformed bytes — the same
+        cannot-see-the-rewrite stance as the fused path's host-only
+        transformer demotion."""
+        if not self.pack_overlap or self.snapshot_cache is None:
+            return
+        from koordinator_tpu.scheduler.frameworkext import (
+            PreFilterTransformer,
+        )
+
+        if any(isinstance(t, PreFilterTransformer)
+               and type(t).before_prefilter
+               is not PreFilterTransformer.before_prefilter
+               for t in self.extender.transformers):
+            return
+        try:
+            from koordinator_tpu.scheduler.snapshot import (
+                prepack_pending_rows,
+            )
+
+            with self.tracer.span("prepack") as sp:
+                pods = [
+                    p for p in self.store.list(KIND_POD)
+                    if not p.is_assigned and not p.is_terminated
+                    and p.spec.scheduler_name == self.scheduler_name
+                ]
+                n = prepack_pending_rows(self.snapshot_cache, pods,
+                                         self.args)
+                sp.attributes["rows"] = str(n)
+            if n:
+                scheduler_metrics.PREPACK_ROWS.inc(n)
+        except Exception:
+            # a pre-pack wreck may have left HALF-updated memo rows
+            # (resourceVersion bumped before every column refreshed) —
+            # rows the next build would serve as hits with stale bytes.
+            # Poison the memo wholesale: the next pack runs the cold
+            # path (bit-identical by the snapshot-cache contract) and
+            # rebuilds it; one expensive build buys back correctness.
+            self.snapshot_cache.pack_memo = None
+            self.snapshot_cache.pack_memo_prev = None
+            logger.exception("in-window pre-pack failed; pack memo "
+                             "dropped — the next cycle repacks cold in "
+                             "the gap")
+
     def flush_deferred(self) -> None:
         """Drain deferred diagnose/condition work (pipeline mode). Runs in
         the next cycle's kernel window — host work the device never waits
@@ -2059,6 +2285,7 @@ class Scheduler:
         # pods arrive already view-transformed (run_cycle runs BeforePreFilter
         # ahead of the nomination pre-pass); here the state-level transformer
         # chain runs: ClusterState rewrites, then packed-input rewrites
+        t_pack = time.perf_counter()
         with self.tracer.span("snapshot") as ssp:
             state = self._cluster_state(pending, now)
             self.extender.transform_after_prefilter(state, ctx)
@@ -2066,6 +2293,7 @@ class Scheduler:
             ssp.attributes["nodes"] = str(len(state.nodes))
             ssp.attributes["pods"] = str(len(pending))
         if not state.nodes:
+            self._add_pack_wall(time.perf_counter() - t_pack)
             return None
         with self.tracer.span("encode"):
             cs = (self.snapshot_cache.stats
@@ -2110,6 +2338,11 @@ class Scheduler:
             self._last_batch = (
                 fc, {key: j for j, key in enumerate(pods.keys)},
                 len(state.nodes), None)
+        self._add_pack_wall(time.perf_counter() - t_pack)
+        if self.encode_observer is not None:
+            # parity/test hook: the post-reduce host arrays — the
+            # ScheduleInputs level the pack-overlap byte-parity gates on
+            self.encode_observer(fc)
         return fc, pods, nodes, ng, ngroups, active
 
     def _record_upload_deltas(self) -> None:
@@ -2316,6 +2549,18 @@ class Scheduler:
                         fc = self.device_snapshot.upload(fc)
                         self._record_upload_deltas()
                         self.device_snapshot.begin_dispatch()
+                    if self._last_step_compiled:
+                        # persistent warm-up index: a fresh compile's
+                        # rung (builder meta + call avals) so the NEXT
+                        # process can pre-build this exact step
+                        self._record_step_compile(
+                            "serial",
+                            self._step_meta(
+                                (pods.padded_size, nodes.padded_size,
+                                 fc_host.quota_runtime.shape[0]),
+                                ng, ngroups, active, explain),
+                            (fc, np.int32(len(nodes.names)))
+                            if explain is not None else (fc,))
                     t_dispatch = time.perf_counter()
                     win.mark_dispatch(self._window_path("serial"))
                     n_shape = (len(nodes.names),
@@ -2335,8 +2580,11 @@ class Scheduler:
                             # overlap window: the previous cycle's
                             # deferred host work (unschedulability
                             # diagnosis + condition writes) runs while
-                            # the device executes this cycle's kernel
+                            # the device executes this cycle's kernel,
+                            # then the next cycle's candidate rows
+                            # pre-pack into the memo (pack overlap)
                             self._flush_deferred_in_window()
+                            self._prepack_in_window()
                             with self.tracer.span("overlap_wait"):
                                 # the pipeline's single designated sync
                                 # point: bind needs the chosen vector,
@@ -2379,6 +2627,10 @@ class Scheduler:
                 result.kernel_seconds += ksp.duration_seconds
                 scheduler_metrics.KERNEL_SECONDS.observe(
                     ksp.duration_seconds)
+                if self._last_step_compiled:
+                    # the lazy XLA build landed in this window: its wall
+                    # is compile time for the restart attribution split
+                    self._add_compile_wall(ksp.duration_seconds)
                 self._close_window(win, attempts, had_deadline, level0)
                 return chosen
             except _HostWriteFailure as hw:
@@ -2715,6 +2967,17 @@ class Scheduler:
                         self._record_upload_deltas()
                         self.device_snapshot.begin_dispatch()
                     sides = assemble_sides(up_fields)
+                    if self._last_step_compiled:
+                        self._record_step_compile(
+                            "fused",
+                            self._step_meta(
+                                (pods.padded_size, nodes.padded_size,
+                                 fc_host.quota_runtime.shape[0]),
+                                ng, ngroups, active, explain,
+                                waves=int(k_waves),
+                                sides_tag=list(res_ctx["tag"])),
+                            (fc, sides, np.int32(len(nodes.names)))
+                            if explain is not None else (fc, sides))
                     t_dispatch = time.perf_counter()
                     win.mark_dispatch(self._window_path("fused"))
                     n_shape = (len(nodes.names),
@@ -2732,6 +2995,7 @@ class Scheduler:
                                      out.wave_counts)
                         if self.pipeline_mode:
                             self._flush_deferred_in_window()
+                            self._prepack_in_window()
                             with self.tracer.span("overlap_wait"):
                                 # the single designated sync point: the
                                 # first readback blocks until the whole
@@ -2804,6 +3068,8 @@ class Scheduler:
                     raise FusedDispatchDemoted() from exc
         result.kernel_seconds += ksp.duration_seconds
         scheduler_metrics.KERNEL_SECONDS.observe(ksp.duration_seconds)
+        if self._last_step_compiled:
+            self._add_compile_wall(ksp.duration_seconds)
         self._close_window(win, attempts, had_deadline, level0)
 
         # ---- replay the waves as logical cycles. The state mirror is
@@ -3168,6 +3434,7 @@ class Scheduler:
                         self.device_snapshot.begin_dispatch()
                         window_open = True
                     sides = assemble_sides(up_fields)
+                    chain_compiled = self._last_step_compiled
                     t_dispatch = time.perf_counter()
                     win.mark_dispatch(self._window_path("chained"))
                     n_real = len(nodes.names)
@@ -3176,12 +3443,27 @@ class Scheduler:
                     if self.fault_injector is not None:
                         self.fault_injector("fused")
                     carry = self._initial_chain_carry(fc, sides, explain)
+                    if chain_compiled:
+                        self._record_step_compile(
+                            "chain",
+                            self._step_meta(
+                                (pods.padded_size, nodes.padded_size,
+                                 fc_host.quota_runtime.shape[0]),
+                                ng, ngroups, active, explain,
+                                sides_tag=list(res_ctx["tag"])),
+                            (fc, carry, sides, np.int32(n_real))
+                            if explain is not None
+                            else (fc, carry, sides))
                     carry, rows0, crow0 = self._dispatch_chain_wave(
                         step, fc, carry, sides, n_real, explain)
                     if self.pipeline_mode:
                         # the previous cycle's deferred host work drains
                         # while the device runs wave 1
                         self._flush_deferred_in_window()
+                    # pack overlap: the chained dispatch always has an
+                    # in-window host phase (wave 1 in flight) — pre-pack
+                    # the next cycle's rows before blocking on it
+                    self._prepack_in_window()
                     with self.tracer.span("overlap_wait"):
                         synced = self._sync_wave_rows(n_shape, rows0,
                                                       crow0)
@@ -3225,6 +3507,8 @@ class Scheduler:
         window_seconds = t_last_sync - t_dispatch
         result.kernel_seconds += window_seconds
         scheduler_metrics.KERNEL_SECONDS.observe(window_seconds)
+        if chain_compiled:
+            self._add_compile_wall(window_seconds)
         result.device_busy_seconds += window_seconds
         scheduler_metrics.WAVES_PER_DISPATCH.observe(float(executed))
         # the timeline window closes at the chain's LAST device sync —

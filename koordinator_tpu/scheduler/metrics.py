@@ -266,6 +266,45 @@ SLO_MET = REGISTRY.gauge(
     "labeled by objective",
 )
 
+# host-tail pack overlap (PR 15): pod rows pre-packed into the pack memo
+# INSIDE a device window (serial/fused pipeline windows, the chained
+# dispatch) — host work that used to run in the inter-window gap. A
+# steady soak should show this tracking the repack counter; zero with
+# KOORD_TPU_PACK_OVERLAP=0.
+PREPACK_ROWS = REGISTRY.counter(
+    "koord_scheduler_prepack_rows_total",
+    "Pod rows pre-packed into the pack memo inside a device window",
+)
+
+# AOT warm-up ladder (scheduler/warmup.py, PR 15): rungs replayed from
+# the persistent compile-cache index at startup, labeled by outcome
+# (warmed | skipped | failed | invalidated — the last is the
+# program-fingerprint discipline), the last ladder's wall seconds, and
+# the completion gauge the steady-state compile guard arms on
+WARMUP_RUNGS = REGISTRY.counter(
+    "koord_scheduler_warmup_rungs_total",
+    "Warm-up ladder rungs replayed from the persistent compile-cache "
+    "index, labeled by outcome",
+)
+WARMUP_SECONDS = REGISTRY.gauge(
+    "koord_scheduler_warmup_seconds",
+    "Wall seconds the last warm-up ladder took",
+)
+WARMUP_COMPLETE = REGISTRY.gauge(
+    "koord_scheduler_warmup_complete",
+    "Whether the warm-up ladder has completed (1) for this scheduler",
+)
+# koordlint rule 20 (compile-in-steady-state), the runtime half: a
+# step-cache MISS in the hot path AFTER warm-up completed — outside the
+# warmup/ladder-transition/restart contexts every legitimate compile
+# belongs to. A warm-cache restart must keep this flat through its
+# first bind (the crash-restart coldstart gate asserts it).
+STEADY_STATE_COMPILES = REGISTRY.counter(
+    "koord_scheduler_steady_state_compiles_total",
+    "Step-cache misses flagged in steady state (after warm-up, outside "
+    "ladder transitions)",
+)
+
 # pipeline deferred-diagnose backlog: depth of the queue carrying cycle
 # N's unschedulability writes into cycle N+1's kernel window, plus the
 # total items ever deferred — a growing depth means kernel windows (or

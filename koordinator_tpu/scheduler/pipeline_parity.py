@@ -380,6 +380,113 @@ def run_fused_wave_parity(k_waves: int, num_nodes: int = 24,
     }
 
 
+def run_pack_overlap_parity(waves: int = 1, ndev: Optional[int] = None,
+                            num_nodes: int = 24, num_pods: int = 70,
+                            rounds: int = 3, seed: int = 11,
+                            arrivals: int = 9) -> dict:
+    """Pack/device overlap (PR 15) vs the gap-pack twin: byte-identical
+    ScheduleInputs, decisions and conditions.
+
+    The overlap world pre-packs the next cycle's candidate pod rows
+    INSIDE the device window (cycle.py _prepack_in_window); the twin
+    pins KOORD_TPU_PACK_OVERLAP=0 — the pack runs strictly in the
+    inter-window gap, today's exact path. Both drive identical churn
+    through the pipeline and BOTH register the encode observer: every
+    post-reduce FullChainInputs array (the ScheduleInputs level) is
+    byte-compared per encode — the overlap may move WHEN rows pack,
+    never a single produced bit. ``waves`` selects the serial (1) or
+    fused-chain path; ``ndev`` shards both worlds over a mesh."""
+    import numpy as np
+
+    from koordinator_tpu.client.store import KIND_POD
+    from koordinator_tpu.scheduler.cycle import CyclePipeline, Scheduler
+    from koordinator_tpu.testing import synth_full_cluster
+
+    def make_world():
+        _cluster, state = synth_full_cluster(
+            num_nodes, num_pods, seed=seed, num_quotas=3, num_gangs=4,
+            topology_fraction=0.5, lsr_fraction=0.2)
+        return state, build_store_from_state(state)
+
+    def snap_fc(fc):
+        out = {}
+        for name in fc._fields:
+            value = getattr(fc, name)
+            if name == "base":
+                for f2 in value._fields:
+                    out["base." + f2] = np.array(
+                        np.asarray(getattr(value, f2)), copy=True)
+            else:
+                out[name] = np.array(np.asarray(value), copy=True)
+        return out
+
+    mesh = ndev if ndev is not None else "off"
+    state_on, store_on = make_world()
+    _state_off, store_off = make_world()
+    sched_on = Scheduler(store_on, waves=waves, explain="off", mesh=mesh,
+                         pack_overlap=True)
+    sched_off = Scheduler(store_off, waves=waves, explain="off", mesh=mesh,
+                          pack_overlap=False)
+    encodes = {True: [], False: []}
+    sched_on.encode_observer = lambda fc: encodes[True].append(snap_fc(fc))
+    sched_off.encode_observer = lambda fc: encodes[False].append(
+        snap_fc(fc))
+    pipe_on = CyclePipeline(sched_on, enabled=True)
+    pipe_off = CyclePipeline(sched_off, enabled=True)
+
+    now = state_on.now
+    mismatches: List[str] = []
+    for r in range(rounds + 1):
+        if r > 0:
+            apply_round_delta(store_on, r, now, arrivals)
+            apply_round_delta(store_off, r, now, arrivals)
+        t = now + 2 * r
+        res_on = pipe_on.run_cycle(now=t)
+        res_off = pipe_off.run_cycle(now=t)
+        if ([(b.pod_key, b.node_name, b.annotations)
+             for b in res_on.bound]
+                != [(b.pod_key, b.node_name, b.annotations)
+                    for b in res_off.bound]):
+            mismatches.append(f"round {r}: bound sequence differs")
+        for f in ("failed", "rejected", "preempted_victims"):
+            if sorted(getattr(res_on, f)) != sorted(getattr(res_off, f)):
+                mismatches.append(f"round {r}: {f} differs")
+    pipe_on.flush()
+    pipe_off.flush()
+
+    if len(encodes[True]) != len(encodes[False]):
+        mismatches.append(
+            f"encode counts differ ({len(encodes[True])} vs "
+            f"{len(encodes[False])})")
+    else:
+        for i, (a, b) in enumerate(zip(encodes[True], encodes[False])):
+            bad = [k for k in a
+                   if a[k].shape != b[k].shape
+                   or not np.array_equal(a[k], b[k])]
+            if bad:
+                mismatches.append(
+                    f"encode {i}: ScheduleInputs fields differ {bad[:4]}")
+    cond_on, cond_off = _conditions(store_on), _conditions(store_off)
+    if cond_on != cond_off:
+        mismatches.append("PodScheduled conditions differ")
+    assign_on = {p.meta.key: p.spec.node_name
+                 for p in store_on.list(KIND_POD)}
+    assign_off = {p.meta.key: p.spec.node_name
+                  for p in store_off.list(KIND_POD)}
+    if assign_on != assign_off:
+        mismatches.append("final pod->node assignments differ")
+    _dump_on_mismatch(mismatches, sched_on, sched_off)
+
+    return {
+        "ok": not mismatches,
+        "mismatches": mismatches,
+        "rounds": rounds + 1,
+        "pods": len(assign_on),
+        "conditions_checked": len(cond_on),
+        "encodes_compared": len(encodes[True]),
+    }
+
+
 def run_replay_overlap_parity(k_waves: int, num_nodes: int = 24,
                               num_pods: int = 70, rounds: int = 2,
                               seed: int = 11, arrivals: int = 9,
@@ -1477,6 +1584,15 @@ def main(argv: List[str]) -> int:
 
     _force_virtual_devices()
     ok = show("pipeline parity", run_pipeline_parity())
+    # pack/device overlap (PR 15): the in-window pre-pack must be a pure
+    # latency lever — ScheduleInputs byte-identical at the encode level,
+    # serial + fused-chain + mesh-sharded (the other gates below run
+    # with the overlap DEFAULT-ON on top, so every parity property also
+    # holds under the overlap architecture)
+    ok = show("pack-overlap parity (serial)",
+              run_pack_overlap_parity(waves=1)) and ok
+    ok = show("pack-overlap parity (fused K=4)",
+              run_pack_overlap_parity(waves=4)) and ok
     for k in (1, 2, 4, 8):
         ok = show(f"fused-wave parity K={k}", run_fused_wave_parity(k)) and ok
     # overlapped wave replay (KOORD_TPU_REPLAY_OVERLAP): the chain-of-
@@ -1506,6 +1622,9 @@ def main(argv: List[str]) -> int:
                   run_mesh_parity(nd)) and ok
         ok = show(f"mesh parity ndev={nd} (fused K=4)",
                   run_mesh_parity(nd, waves=4)) and ok
+    if max_dev >= 2:
+        ok = show("pack-overlap parity (mesh ndev=2, fused K=4)",
+                  run_pack_overlap_parity(waves=4, ndev=2)) and ok
     if max_dev >= 8:
         ok = show("mesh parity ndev=8 (serial, explain=counts)",
                   run_mesh_parity(8, explain="counts")) and ok

@@ -120,6 +120,15 @@ class SidecarServer:
     def __init__(self) -> None:
         self._steps: Dict[Tuple, object] = {}
 
+    def _get_sidecar_step(self, args, request, active):
+        """The server's keyed step build (koordlint rule 20: every step
+        compile in a driver module routes through a _get_*step
+        chokepoint — the caller owns the self._steps keying)."""
+        return build_full_chain_step(
+            args, int(request.num_gangs), int(request.num_groups),
+            active_axes=list(active) if active else None,
+        )
+
     def ScheduleBatch(self, request: sidecar_pb2.ScheduleBatchRequest):
         import time
 
@@ -135,10 +144,8 @@ class SidecarServer:
             active,
         )
         if key not in self._steps:
-            self._steps[key] = build_full_chain_step(
-                args, int(request.num_gangs), int(request.num_groups),
-                active_axes=list(active) if active else None,
-            )
+            self._steps[key] = self._get_sidecar_step(args, request,
+                                                      active)
         t0 = time.perf_counter()
         chosen, requested, quota_used = self._steps[key](fc)
         chosen = np.asarray(chosen)
@@ -198,6 +205,10 @@ def schedule_batch_or_fallback(client, fc, num_gangs: int, num_groups: int,
                        active_axes=active_axes)
 
     def _local_fallback():
+        # transport-failure fallback: the Scheduler passes local_step
+        # from ITS keyed cache; the bare build only runs for standalone
+        # client use, where no step cache exists to route through
+        # koordlint: disable=compile-in-steady-state
         step = local_step or build_full_chain_step(
             args, num_gangs, num_groups,
             active_axes=list(active_axes) if active_axes else None)

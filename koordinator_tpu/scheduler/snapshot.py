@@ -225,6 +225,83 @@ def _pod_cpuset_flags(pod: Pod, default_policy: str = FULL_PCPUS) -> Tuple[bool,
     return True, float(cpu_milli // 1000), policy == FULL_PCPUS
 
 
+def _pod_flag_tuple(pod: Pod) -> tuple:
+    """The per-pod flag row (needs_bind, cores, full_pcpus, needs_numa,
+    vol_needed, has_aff, has_ports, has_img, has_npref) — ONE
+    implementation shared by the build loop and the in-window pre-pack
+    (prepack_pending_rows), so the overlapped pack can never drift from
+    the cold fill."""
+    spec = pod.spec
+    nb, cn, fp = _pod_cpuset_flags(pod)
+    return (nb, cn, fp, bool(spec.requests), float(len(set(spec.pvc_names))),
+            bool(spec.pod_affinity or spec.pod_anti_affinity
+                 or spec.topology_spread or spec.pod_affinity_preferred),
+            bool(spec.host_ports), bool(spec.images),
+            bool(spec.affinity_preferred))
+
+
+def _pod_sel_pairs(pod: Pod) -> frozenset:
+    """The pod's nodeSelector/required-affinity pair set — the "sel"
+    memo column's cold expression, shared with the pre-pack."""
+    return frozenset(pod.spec.node_selector.items()) | frozenset(
+        pod.spec.affinity_required_node_labels.items())
+
+
+def prepack_pending_rows(cache, pods: List[Pod], args: LoadAwareArgs) -> int:
+    """Pack/device overlap (PR 15): refresh the pack memo's rows for
+    every given pod whose (key, resourceVersion) is stale or absent —
+    called from INSIDE a device window (cycle.py _prepack_in_window), so
+    the per-object Python the next build would have paid in the
+    inter-window gap runs while the device executes instead.
+
+    Only memo state is touched: the packed wire rows + estimator output
+    (ops/packing.prepack_memo_rows), the flag columns, the selector-pair
+    sets and the per-pod flag dict. Admission masks are NOT precomputed
+    — their validity is keyed on the admission grouping the NEXT build
+    resolves — so pre-packed rows carry ``mask_valid=False`` and the
+    build recomputes exactly those masks. Rows dirtied AFTER this runs
+    (bind patches, watch events later in the window) bump their
+    resourceVersion and miss the memo at the real pack: reconciliation
+    is the memo keying itself, which is why the produced ScheduleInputs
+    are byte-identical to the non-overlapped pack (parity-gated).
+
+    Returns the number of rows pre-packed."""
+    from koordinator_tpu.ops.packing import prepack_memo_rows
+
+    memo = cache.pack_memo
+    if memo is None or "f_needs_bind" not in memo or "sel" not in memo:
+        return 0  # no completed build yet: nothing to warm against
+    if "mask_valid" not in memo:
+        return 0
+    placed = prepack_memo_rows(cache, pods, args.resource_weights,
+                               args.estimated_scaling_factors)
+    if not placed:
+        return 0
+    flag_cols = ("f_needs_bind", "f_cores", "f_fullp", "f_needs_numa",
+                 "f_vol", "f_aff", "f_ports", "f_img", "f_npref")
+    n_new = max((j for j, _p in placed), default=-1) + 1
+    grown = memo[flag_cols[0]].shape[0]
+    if n_new > grown:
+        pad = n_new - grown
+        for col in flag_cols:
+            memo[col] = np.concatenate(
+                [memo[col], np.zeros(pad, memo[col].dtype)])
+        memo["mask"] = np.concatenate(
+            [memo["mask"], np.ones(pad, memo["mask"].dtype)])
+        memo["mask_valid"] = np.concatenate(
+            [memo["mask_valid"], np.zeros(pad, bool)])
+        sel_pad = np.empty(pad, object)
+        memo["sel"] = np.concatenate([memo["sel"], sel_pad])
+    for j, pod in placed:
+        flags = _pod_flag_tuple(pod)
+        for col, value in zip(flag_cols, flags):
+            memo[col][j] = value
+        memo["mask_valid"][j] = False
+        memo["sel"][j] = _pod_sel_pairs(pod)
+        cache.put_pod_flag(pod, flags)
+    return len(placed)
+
+
 def build_full_chain_inputs(
     state: ClusterState, args: LoadAwareArgs, cache=None
 ) -> Tuple[FullChainInputs, PodBatch, NodeBatch, QuotaTreeArrays, Dict[str, int], int, int]:
@@ -402,10 +479,7 @@ def build_full_chain_inputs(
                 sel_col[sel_hit] = prevm_sel["sel"][pods.reused_src[sel_hit]]
                 sel_done[sel_hit] = True
         for i in np.nonzero(~sel_done)[0]:
-            pod = pods_by_key_pending[pods.keys[i]]
-            sel_col[i] = frozenset(
-                pod.spec.node_selector.items()) | frozenset(
-                pod.spec.affinity_required_node_labels.items())
+            sel_col[i] = _pod_sel_pairs(pods_by_key_pending[pods.keys[i]])
         cache.pack_memo["sel"] = sel_col
         pair_union = (set().union(*set(sel_col.tolist()))
                       if n_valid else set())
@@ -464,7 +538,15 @@ def build_full_chain_inputs(
             # and PVC/PV/StorageClass epoch, and only for volume-less pods
             # (pvc carriers fold VolumeZone/VolumeBinding state into theirs)
             if prevm.get("mask_epoch") == (adm_seq, cache.pvcpv_epoch):
-                m_hit = f_hit[prevm["f_vol"][hsrc] == 0.0]
+                # pre-packed rows (pack overlap) carry mask_valid=False:
+                # their flag/pack columns are exact but the admission
+                # mask is keyed on THIS build's grouping, so it
+                # recomputes below
+                mvalid = prevm.get("mask_valid")
+                m_ok = prevm["f_vol"][hsrc] == 0.0
+                if mvalid is not None:
+                    m_ok = m_ok & mvalid[hsrc].astype(bool)
+                m_hit = f_hit[m_ok]
                 if m_hit.size:
                     pod_taint_mask[m_hit] = prevm["mask"][src[m_hit]]
                     mask_done[m_hit] = True
@@ -478,24 +560,12 @@ def build_full_chain_inputs(
                  needs_numa[i], vol_needed[i], has_aff[i], has_ports[i],
                  has_img[i], has_npref[i]) = flags
             else:
-                spec = pod.spec
-                nb, cn, fp = _pod_cpuset_flags(pod)
-                needs_bind[i], cores_needed[i], full_pcpus[i] = nb, cn, fp
-                needs_numa[i] = bool(spec.requests)
-                vol_needed[i] = len(set(spec.pvc_names))
-                has_aff[i] = bool(spec.pod_affinity or spec.pod_anti_affinity
-                                  or spec.topology_spread
-                                  or spec.pod_affinity_preferred)
-                has_ports[i] = bool(spec.host_ports)
-                has_img[i] = bool(spec.images)
-                has_npref[i] = bool(spec.affinity_preferred)
+                flags = _pod_flag_tuple(pod)
+                (needs_bind[i], cores_needed[i], full_pcpus[i],
+                 needs_numa[i], vol_needed[i], has_aff[i], has_ports[i],
+                 has_img[i], has_npref[i]) = flags
                 if cache is not None:
-                    cache.put_pod_flag(pod, (nb, cn, fp, bool(needs_numa[i]),
-                                             float(vol_needed[i]),
-                                             bool(has_aff[i]),
-                                             bool(has_ports[i]),
-                                             bool(has_img[i]),
-                                             bool(has_npref[i])))
+                    cache.put_pod_flag(pod, flags)
         if mask_done[i]:
             continue
         if key in vb_reason_by_key:
@@ -533,6 +603,9 @@ def build_full_chain_inputs(
         memo["f_img"] = has_img[:n_valid].copy()
         memo["f_npref"] = has_npref[:n_valid].copy()
         memo["mask"] = pod_taint_mask[:n_valid].copy()
+        # build-written masks are all valid; the in-window pre-pack
+        # appends rows with mask_valid=False (see prepack_pending_rows)
+        memo["mask_valid"] = np.ones(n_valid, bool)
         memo["mask_epoch"] = (adm_seq, cache.pvcpv_epoch)
 
     # ---- nodes

@@ -47,6 +47,10 @@ def main(argv=None) -> int:
                     help="override the scenario's cycle count")
     ap.add_argument("--seed", type=int, default=None,
                     help="override the scenario's seed")
+    ap.add_argument("--waves", default=None,
+                    help="override the scenario's fused-wave depth "
+                    "(int or 'auto') — the coldstart gate pins 4 so the "
+                    "compile ladder has real chain programs to warm")
     ap.add_argument("--out", default=None,
                     help="write the SLO report JSON here (default: stdout "
                     "only)")
@@ -77,7 +81,15 @@ def main(argv=None) -> int:
         print(f"unknown scenario {args.scenario!r}; --list shows the "
               "catalog", file=sys.stderr)
         return 4
-    sc = sc.resolved(cycles=args.cycles, seed=args.seed)
+    waves = args.waves
+    if waves is not None and waves != "auto":
+        try:
+            waves = int(waves)
+        except ValueError:
+            print(f"--waves must be an int or 'auto', got {waves!r}",
+                  file=sys.stderr)
+            return 4
+    sc = sc.resolved(cycles=args.cycles, seed=args.seed, waves=waves)
     if sc.mesh is not None:
         _force_cpu_devices_for_mesh()
 

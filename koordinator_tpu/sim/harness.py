@@ -112,6 +112,26 @@ class SimReport:
     # is backend-bound
     restart_to_first_bind_wall_seconds: List[float] = dataclasses.field(
         default_factory=list)
+    # PR 15: the wall-clock recovery split — how much of each restart's
+    # wall was compile (step builds + freshly-compiled kernel windows +
+    # the warm-up ladder) vs pack/encode, so the persistent-cache win is
+    # attributable (the CHURN_r03 comparability note in BENCH_NOTES)
+    restart_wall_compile_seconds: List[float] = dataclasses.field(
+        default_factory=list)
+    restart_wall_pack_seconds: List[float] = dataclasses.field(
+        default_factory=list)
+    # steady-state compile guard (koordlint rule 20, runtime half): step
+    # cache misses flagged AFTER a warm-up ladder completed — per
+    # restart up to its first bind, and the run total
+    restart_steady_state_compiles: List[int] = dataclasses.field(
+        default_factory=list)
+    steady_state_compile_flags: int = 0
+    # warm-up ladder stats of the LAST-BUILT scheduler (the restarted
+    # one, in crash-restart scenarios) — empty dict when warm-up is off
+    warmup: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # koordwatch device timeline: final idle fraction (gap-over-wall) —
+    # THE number the pack-overlap A/B pair must move
+    device_idle_fraction: float = 0.0
     restart_slo_seconds: float = 0.0
     ladder_transitions: List[dict] = dataclasses.field(default_factory=list)
     cycles_at_level: Dict[str, int] = dataclasses.field(default_factory=dict)
@@ -288,6 +308,17 @@ class SimReport:
                 "to_first_bind_wall_seconds": [
                     round(w, 2)
                     for w in self.restart_to_first_bind_wall_seconds],
+                # the wall split (PR 15): compile vs pack attribution of
+                # each recovery — the persistent compile cache's win
+                # shows up as the compile component collapsing
+                "restart_wall_compile_seconds": [
+                    round(w, 2)
+                    for w in self.restart_wall_compile_seconds],
+                "restart_wall_pack_seconds": [
+                    round(w, 3)
+                    for w in self.restart_wall_pack_seconds],
+                "steady_state_compiles": list(
+                    self.restart_steady_state_compiles),
                 "slo_seconds": self.restart_slo_seconds,
                 # every restart must have rebound within the SLO; a
                 # restart that never rebinds can never meet it
@@ -361,7 +392,12 @@ class SimReport:
             "binding_log_sha256": self.binding_log_sha256,
             "bindings": len(self.binding_log),
             "wall_seconds": round(self.wall_seconds, 2),
+            # warm-up ladder + steady-state compile guard (PR 15)
+            "warmup": dict(self.warmup),
+            "steady_state_compile_flags": self.steady_state_compile_flags,
             "pipeline": {
+                "device_idle_fraction": round(
+                    self.device_idle_fraction, 3),
                 "occupancy": (
                     round(self.device_busy_seconds
                           / self.cycle_wall_seconds, 3)
@@ -520,7 +556,19 @@ class ChurnSimulator:
             dispatch_deadline_ms=(sc.dispatch_deadline_ms
                                   if sc.dispatch_deadline_ms is not None
                                   else 0),
+            pack_overlap=sc.pack_overlap,
         )
+        # koordlint rule 20, runtime half: after the warm-up ladder
+        # completes, a step-cache miss in the hot path is flagged — the
+        # report carries the per-restart (to first bind) and run totals
+        # the coldstart gate asserts on
+        self._steady_flags_since_restart = 0
+
+        def _on_steady_miss(_key) -> None:
+            self._steady_flags_since_restart += 1
+            self.report.steady_state_compile_flags += 1
+
+        self.sched.compile_miss_hook = _on_steady_miss
         self.sched.fault_injector = self.plan.dispatch_hook
         self.sched.sync_delay_injector = self.plan.sync_delay_hook
         self.sched.upload_fault_injector = self.plan.upload_hook
@@ -1121,6 +1169,15 @@ class ChurnSimulator:
             self.slo.observe("restart_to_first_bind", recovery)
             self.report.restart_to_first_bind_wall_seconds.append(
                 time.perf_counter() - self._restart_wall)
+            # the recovery wall split (PR 15): the fresh scheduler's
+            # cumulative compile/pack wall IS the restart's — it was
+            # born at the crash, and warm-up ran inside this window
+            self.report.restart_wall_compile_seconds.append(
+                self.sched.compile_wall_seconds)
+            self.report.restart_wall_pack_seconds.append(
+                self.sched.pack_wall_seconds)
+            self.report.restart_steady_state_compiles.append(
+                self._steady_flags_since_restart)
             self._restart_time = None
         arrived = self._arrival_time.pop(pod_key, None)
         if arrived is not None:
@@ -1332,6 +1389,12 @@ class ChurnSimulator:
             self.report.colo_final_engine = str(
                 self.manager.colo.last_pass_stats.get("engine", ""))
         self.report.deadline_overruns = overruns
+        # koordwatch timeline: the final scheduler's idle fraction (the
+        # ring is per-scheduler, so a crash-restart resets the window —
+        # the A/B pack-overlap pair runs restart-free soaks)
+        self.report.device_idle_fraction = self.sched.timeline.idle_fraction()
+        if self.sched.warmup is not None:
+            self.report.warmup = dict(self.sched.warmup.stats)
         return self.report
 
 

@@ -88,6 +88,9 @@ class Scenario:
     ttb_slo_seconds: float = 120.0  # time-to-bind p99 target
     # scheduler configuration under test
     waves: object = 1             # Scheduler(waves=...): int or "auto"
+    pack_overlap: Optional[bool] = None  # KOORD_TPU_PACK_OVERLAP pin
+    #                               (None = env default; the bench
+    #                               --churn A/B pair pins on/off)
     explain: Optional[str] = None  # None keeps explain off ("off" pin)
     mesh: Optional[int] = None    # KOORD_TPU_MESH-style device count
     pipeline: bool = False        # drive through CyclePipeline
@@ -99,13 +102,16 @@ class Scenario:
     faults: Tuple[Fault, ...] = ()
 
     def resolved(self, cycles: Optional[int] = None,
-                 seed: Optional[int] = None) -> "Scenario":
+                 seed: Optional[int] = None,
+                 waves=None) -> "Scenario":
         """CLI overrides without losing the catalog definition."""
         changes = {}
         if cycles is not None:
             changes["cycles"] = cycles
         if seed is not None:
             changes["seed"] = seed
+        if waves is not None:
+            changes["waves"] = waves
         return dataclasses.replace(self, **changes) if changes else self
 
 

@@ -1,0 +1,299 @@
+"""Pack/device overlap (PR 15): the in-window pre-pack is a pure
+latency lever — the produced ScheduleInputs (and every decision) must be
+byte-identical to the non-overlapped pack.
+
+  * overlap-on vs overlap-off twins over churn rounds: every encode's
+    post-reduce FullChainInputs arrays byte-compare, bound sequences and
+    final conditions match — serial (pipeline) and fused-chain paths;
+  * mid-window reconciliation: a store mutation injected INSIDE the
+    device window (after the pre-pack ran) must be re-packed before the
+    next upload — the dirtied pod's row byte-compares against the
+    serial-pack twin (the (key, resourceVersion) memo keying IS the
+    reconciliation);
+  * the memo warm actually happens: pre-packed rows turn the next
+    build's per-object Python into memo hits.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from koordinator_tpu.client.store import KIND_POD
+from koordinator_tpu.scheduler.cycle import CyclePipeline, Scheduler
+from koordinator_tpu.scheduler.pipeline_parity import (
+    apply_round_delta,
+    build_store_from_state,
+)
+from koordinator_tpu.testing import synth_full_cluster
+
+
+def _world(seed=7, nodes=16, pods=40):
+    _cluster, state = synth_full_cluster(
+        nodes, pods, seed=seed, num_quotas=2, num_gangs=2,
+        topology_fraction=0.5, lsr_fraction=0.2)
+    return state, build_store_from_state(state)
+
+
+def _snap_fc(fc):
+    out = {}
+    for name in fc._fields:
+        value = getattr(fc, name)
+        if name == "base":
+            for f2 in value._fields:
+                out["base." + f2] = np.array(
+                    np.asarray(getattr(value, f2)), copy=True)
+        else:
+            out[name] = np.array(np.asarray(value), copy=True)
+    return out
+
+
+def _diff_fields(a, b):
+    bad = []
+    for key in a:
+        if a[key].shape != b[key].shape or not np.array_equal(a[key],
+                                                              b[key]):
+            bad.append(key)
+    return bad
+
+
+def _run_world(waves, overlap, rounds=4, seed=7, mutate_mid_window=None):
+    state, store = _world(seed=seed)
+    sched = Scheduler(store, waves=waves, explain="off",
+                      pack_overlap=overlap)
+    pipe = CyclePipeline(sched, enabled=True)
+    encodes = []
+    sched.encode_observer = lambda fc: encodes.append(_snap_fc(fc))
+    if mutate_mid_window is not None:
+        # the sync-delay hook runs INSIDE every monitored device window
+        # — after the pre-pack snapshotted store deltas — so a mutation
+        # here is exactly a "row dirtied during the window"
+        sched.sync_delay_injector = lambda: mutate_mid_window(store)
+    now = state.now
+    bound = []
+    for r in range(rounds):
+        if r:
+            apply_round_delta(store, r, now, 7)
+        res = pipe.run_cycle(now=now + 2 * r)
+        bound.append([(b.pod_key, b.node_name) for b in res.bound])
+    pipe.flush()
+    conditions = {
+        p.meta.key: (c.status, c.reason, c.message)
+        for p in store.list(KIND_POD)
+        for c in [p.get_condition("PodScheduled")] if c is not None}
+    return encodes, bound, conditions
+
+
+class TestPackOverlapParity:
+    def test_serial_pipeline_byte_parity(self):
+        enc_on, bound_on, cond_on = _run_world(1, True)
+        enc_off, bound_off, cond_off = _run_world(1, False)
+        assert bound_on == bound_off
+        assert cond_on == cond_off
+        assert len(enc_on) == len(enc_off)
+        for i, (a, b) in enumerate(zip(enc_on, enc_off)):
+            assert _diff_fields(a, b) == [], f"encode {i}"
+
+    def test_fused_chain_byte_parity(self):
+        enc_on, bound_on, cond_on = _run_world(4, True)
+        enc_off, bound_off, cond_off = _run_world(4, False)
+        assert bound_on == bound_off
+        assert cond_on == cond_off
+        for i, (a, b) in enumerate(zip(enc_on, enc_off)):
+            assert _diff_fields(a, b) == [], f"encode {i}"
+
+    def test_mid_window_mutation_repacked_before_upload(self):
+        """A pod spec rewritten DURING the device window (bind patches /
+        watch events land exactly like this) bumps its resourceVersion,
+        so the pre-packed row goes stale and the next build re-packs it
+        — the overlapped world's ScheduleInputs stay byte-identical to
+        the serial pack's."""
+        from koordinator_tpu.api.resources import ResourceList
+
+        hit = {"n": 0}
+
+        def mutate(store):
+            # rewrite one still-pending pod's requests mid-window: the
+            # pre-pack already staged its row from the OLD spec
+            for pod in store.list(KIND_POD):
+                if not pod.is_assigned and not pod.is_terminated:
+                    pod.spec.requests = ResourceList.of(
+                        cpu=3000 + 250 * hit["n"], memory=2 * 1024 ** 3,
+                        pods=1)
+                    store.update(KIND_POD, pod)
+                    hit["n"] += 1
+                    break
+
+        enc_on, bound_on, _ = _run_world(1, True,
+                                         mutate_mid_window=mutate)
+        hit["n"] = 0
+        enc_off, bound_off, _ = _run_world(1, False,
+                                           mutate_mid_window=mutate)
+        assert hit["n"] > 0, "the mid-window mutation must have fired"
+        assert bound_on == bound_off
+        for i, (a, b) in enumerate(zip(enc_on, enc_off)):
+            assert _diff_fields(a, b) == [], f"encode {i}"
+
+    def test_prepack_warms_the_memo(self):
+        """The overlap's point: rows the pre-pack staged in the window
+        are memo HITS at the next build instead of per-object repacks."""
+        from koordinator_tpu.api.objects import ObjectMeta, Pod, PodSpec
+        from koordinator_tpu.api.resources import ResourceList
+
+        state, store = _world(seed=11)
+        sched = Scheduler(store, waves=1, explain="off", pack_overlap=True)
+        assert sched.pack_overlap is True
+        pipe = CyclePipeline(sched, enabled=True)
+        now = state.now
+        pipe.run_cycle(now=now)
+        # permanently-pending pods: their failure verdicts defer into
+        # the NEXT cycle's window (pipeline), whose flush bumps their
+        # resourceVersion — exactly the rows the in-window pre-pack
+        # exists to stage for the cycle after
+        for i in range(4):
+            store.add(KIND_POD, Pod(
+                meta=ObjectMeta(name=f"impossible-{i}", namespace="po",
+                                uid=f"impossible-{i}",
+                                creation_timestamp=now),
+                spec=PodSpec(requests=ResourceList.of(
+                    cpu=10_000_000, memory=1024 ** 4, pods=1))))
+        stats = sched.snapshot_cache.stats
+        pipe.run_cycle(now=now + 2)  # verdicts captured, writes deferred
+        pipe.run_cycle(now=now + 4)  # flush dirties rows, prepack stages
+        pipe.run_cycle(now=now + 6)
+        pipe.flush()
+        assert stats.get("pod_rows_prepacked", 0) > 0, (
+            "deferred condition writes inside the window must leave "
+            "rows for the pre-pack to stage")
+
+    def test_prepack_failure_never_wrecks_the_cycle(self, monkeypatch):
+        """The pre-pack is best-effort by contract: a raise inside it
+        must not reach the ladder or the cycle — the next pack simply
+        runs in the gap."""
+        import koordinator_tpu.scheduler.snapshot as snapshot_mod
+
+        def boom(cache, pods, args):
+            raise RuntimeError("prepack wrecked")
+
+        monkeypatch.setattr(snapshot_mod, "prepack_pending_rows", boom)
+        state, store = _world(seed=23)
+        sched = Scheduler(store, waves=4, explain="off", pack_overlap=True)
+        pipe = CyclePipeline(sched, enabled=True)
+        res = pipe.run_cycle(now=state.now)
+        res2 = pipe.run_cycle(now=state.now + 2)
+        pipe.flush()
+        assert res.bound or res2.bound
+        assert sched.ladder.level == 0  # no ladder demotion from host work
+
+    def test_prefilter_view_transform_disables_prepack(self):
+        """A registered BeforePreFilter view transform rewrites pod
+        views the real pack consumes WITHOUT bumping the store
+        resourceVersion — a pre-packed raw row would be a stale (key,
+        rv) hit, so the pre-pack must stand down (and decisions must
+        still match the overlap-off twin)."""
+        import dataclasses
+
+        from koordinator_tpu.api.resources import ResourceList
+        from koordinator_tpu.scheduler.frameworkext import (
+            PreFilterTransformer,
+        )
+
+        class DoubleCpuView(PreFilterTransformer):
+            name = "DoubleCpuView"
+
+            def before_prefilter(self, pod, ctx):
+                req = pod.spec.requests
+                cpu = req["cpu"] or 0
+                if not cpu:
+                    return None
+                doubled = ResourceList.of(
+                    cpu=min(2 * cpu, 16_000),
+                    memory=req["memory"] or 0,
+                    pods=req["pods"] or 0)
+                return dataclasses.replace(
+                    pod, spec=dataclasses.replace(pod.spec,
+                                                  requests=doubled))
+
+        worlds = {}
+        for overlap in (True, False):
+            state, store = _world(seed=31)
+            sched = Scheduler(store, waves=1, explain="off",
+                              pack_overlap=overlap)
+            sched.extender.register_transformer(DoubleCpuView())
+            pipe = CyclePipeline(sched, enabled=True)
+            now = state.now
+            bound = []
+            for r in range(3):
+                if r:
+                    apply_round_delta(store, r, now, 7)
+                res = pipe.run_cycle(now=now + 2 * r)
+                bound.append([(b.pod_key, b.node_name)
+                              for b in res.bound])
+            pipe.flush()
+            worlds[overlap] = (bound,
+                               sched.snapshot_cache.stats.get(
+                                   "pod_rows_prepacked", 0))
+        assert worlds[True][0] == worlds[False][0]
+        assert worlds[True][1] == 0, (
+            "the pre-pack must stand down under a view transform")
+
+    def test_mid_prepack_wreck_poisons_the_memo_not_the_bytes(
+            self, monkeypatch):
+        """A pre-pack that wrecks AFTER bumping some rows'
+        resourceVersions (the pack-column refresh landed, the flag/sel
+        refresh did not) must not leave half-updated memo rows the next
+        build serves as hits — the memo is dropped wholesale and the
+        cold repack keeps decisions identical to the overlap-off
+        twin."""
+        import koordinator_tpu.scheduler.snapshot as snapshot_mod
+        from koordinator_tpu.api.objects import ObjectMeta, Pod, PodSpec
+        from koordinator_tpu.api.resources import ResourceList
+        from koordinator_tpu.ops.packing import prepack_memo_rows
+
+        wrecked = {"n": 0}
+
+        def half_prepack(cache, pods, args):
+            # EXACTLY the hazard: rv bumped + pack columns written,
+            # then a wreck before the flag/sel/mask_valid refresh
+            placed = prepack_memo_rows(cache, pods,
+                                       args.resource_weights,
+                                       args.estimated_scaling_factors)
+            if placed:
+                wrecked["n"] += 1
+                raise RuntimeError("wreck after rv bump")
+            return 0
+
+        def run(overlap):
+            state, store = _world(seed=37)
+            sched = Scheduler(store, waves=1, explain="off",
+                              pack_overlap=overlap)
+            pipe = CyclePipeline(sched, enabled=True)
+            now = state.now
+            for i in range(3):
+                store.add(KIND_POD, Pod(
+                    meta=ObjectMeta(name=f"imp-{i}", namespace="pw",
+                                    uid=f"imp-{i}",
+                                    creation_timestamp=now),
+                    spec=PodSpec(requests=ResourceList.of(
+                        cpu=10_000_000, memory=1024 ** 4, pods=1))))
+            bound = []
+            for r in range(4):
+                if r:
+                    apply_round_delta(store, r, now, 7)
+                res = pipe.run_cycle(now=now + 2 * r)
+                bound.append([(b.pod_key, b.node_name)
+                              for b in res.bound])
+            pipe.flush()
+            conditions = {
+                p.meta.key: (c.status, c.reason, c.message)
+                for p in store.list(KIND_POD)
+                for c in [p.get_condition("PodScheduled")]
+                if c is not None}
+            return bound, conditions
+
+        monkeypatch.setattr(snapshot_mod, "prepack_pending_rows",
+                            half_prepack)
+        bound_on, cond_on = run(True)
+        assert wrecked["n"] > 0, "the mid-prepack wreck must have fired"
+        bound_off, cond_off = run(False)
+        assert bound_on == bound_off
+        assert cond_on == cond_off

@@ -1421,3 +1421,99 @@ class TestSilentDemotionBranch:
         assert {"ladder-serial-waves", "sidecar",
                 "non-expressible-transformer", "claim-entangled",
                 "explain-sidecar", "explain-ladder"} <= seen
+
+
+class TestCompileInSteadyState:
+    RULE = "compile-in-steady-state"
+
+    def test_positive_builder_outside_chokepoint(self):
+        src = """
+            def run_pass(self, fields):
+                step = build_rebalance_step(cap)
+                return step(*fields)
+        """
+        out = findings_for(src, self.RULE,
+                           path="koordinator_tpu/balance/rebalancer.py")
+        assert len(out) == 1
+        assert "_get_*step" in out[0].message
+
+    def test_positive_module_scope_and_attribute_call(self):
+        src = """
+            STEP = build_colo_step("dynamic", "static")
+
+            class Driver:
+                def dispatch(self):
+                    return steps.build_sharded_full_chain_step(
+                        args, ng, groups, mesh)
+        """
+        out = findings_for(src, self.RULE,
+                           path="koordinator_tpu/colo/reconciler.py")
+        assert len(out) == 2
+
+    def test_negative_inside_get_step_chokepoints(self):
+        src = """
+            class Driver:
+                def _get_step(self, key):
+                    return build_rebalance_step(cap)
+
+                def _get_fused_step(self, key):
+                    return build_sharded_fused_wave_step(args, mesh=mesh)
+
+                def _get_chain_step(self, key):
+                    return build_chained_wave_step(args)
+        """
+        out = findings_for(src, self.RULE,
+                           path="koordinator_tpu/scheduler/cycle.py")
+        assert out == []
+
+    def test_negative_closure_inside_chokepoint(self):
+        """A retry/span closure nested inside a _get_*step is still
+        chokepoint-routed — the walk continues through nested frames."""
+        src = """
+            class Driver:
+                def _get_step(self, key):
+                    def _build():
+                        return build_rebalance_step(cap)
+                    return self._with_span(_build)
+        """
+        out = findings_for(src, self.RULE,
+                           path="koordinator_tpu/balance/rebalancer.py")
+        assert out == []
+
+    def test_negative_outside_driver_packages_and_warmup(self):
+        src = """
+            def anywhere():
+                return build_full_chain_step(args, ng, groups)
+        """
+        # builders compose freely where they are DEFINED...
+        for path in ("koordinator_tpu/models/full_chain.py",
+                     "koordinator_tpu/parallel/full_chain_mesh.py",
+                     "koordinator_tpu/ops/fit.py",
+                     # ...and the warm-up ladder replays them by design
+                     "koordinator_tpu/scheduler/warmup.py"):
+            assert findings_for(src, self.RULE, path=path) == []
+
+    def test_pragma_licenses_deliberate_exception(self):
+        src = """
+            def fallback():
+                # koordlint: disable=compile-in-steady-state
+                step = build_full_chain_step(args, ng, groups)
+                return step
+        """
+        out = findings_for(src, self.RULE,
+                           path="koordinator_tpu/scheduler/sidecar.py")
+        assert out == []
+
+    def test_shipped_driver_packages_are_clean(self):
+        """Rule 20's repo pin with an EMPTY baseline: every shipped step
+        compile routes through a keyed _get_*step chokepoint (or a
+        reasoned pragma)."""
+        for pkg in ("scheduler", "balance", "colo"):
+            for rel in sorted(
+                    (REPO_ROOT / "koordinator_tpu" / pkg).glob("*.py")):
+                source = rel.read_text()
+                path = f"koordinator_tpu/{pkg}/{rel.name}"
+                out = analyze_source(
+                    source, path=path,
+                    rules={self.RULE: all_rules()[self.RULE]})
+                assert [f for f in out if f.rule == self.RULE] == [], path
