@@ -10,9 +10,17 @@ cd "$(dirname "$0")/.."
 echo "== koordlint =="
 python -m koordinator_tpu.analysis koordinator_tpu bench.py
 
+echo "== koordlint guard map + orphan-lock self-check =="
+# the whole-program lock-discipline pass (analysis/guards.py): dumps the
+# inferred guard map and fails on any Lock/RLock attribute that guards
+# nothing — every shipped lock must earn its place in the map (or carry
+# a `# koordlint: guards(<resource>)` declaration)
+python -m koordinator_tpu.analysis --guards --check-locks koordinator_tpu \
+    > /dev/null
+
 echo "== compileall =="
 python -m compileall -q koordinator_tpu bench.py tests hack/microbench.py \
-    hack/check_metrics_catalog.py
+    hack/check_metrics_catalog.py hack/check_races.py
 
 echo "== serial-vs-pipelined + fused-wave + explain + mesh cycle parity =="
 # same store fixture through the strictly serial path, the CyclePipeline,
@@ -104,6 +112,18 @@ echo "== koordsim seeded smoke scenario (determinism + invariants) =="
 # identical, so the binding log cannot move)
 KOORD_TPU_REPLAY_OVERLAP=1 JAX_PLATFORMS=cpu python -m koordinator_tpu.sim smoke \
     --check-determinism --max-breaches 0 --quiet > /dev/null
+
+echo "== koordrace deterministic interleaving gate (two fixed seeds) =="
+# the dynamic half of the lock-discipline pass (sim/racecheck.py): the
+# smoke scenario with pipeline overlap, an armed dispatch watchdog and
+# background warm-up, under seeded thread preemption at every
+# guarded-field touchpoint from the static guard map. Two fixed
+# preemption seeds; binding logs must be byte-identical across them,
+# with zero unguarded-touch witnesses, zero canonical-lock-order
+# inversions, zero torn /metrics or /debug/timeline scrapes, and
+# static/dynamic agreement (a runtime witness the analyzer missed is
+# its own failure class).
+python hack/check_races.py
 
 echo "== koordsim crash-restart scenario (recovery determinism + invariants) =="
 # koordguard's crash-restart gate: the scheduler is torn down mid-run

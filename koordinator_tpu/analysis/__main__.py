@@ -1,17 +1,27 @@
 """koordlint CLI.
 
     python -m koordinator_tpu.analysis [paths...]
-        [--baseline FILE] [--write-baseline] [--json] [--list-rules]
+        [--baseline FILE] [--write-baseline] [--json] [--sarif]
+        [--list-rules] [--guards] [--check-locks] [--jobs N]
 
 Exit codes (the CI contract tests/test_static_analysis.py pins):
     0  no non-baselined, non-suppressed findings
-    1  findings reported
+    1  findings reported (or orphan locks under --check-locks)
     2  usage error / unreadable baseline
 
 Default paths: ``koordinator_tpu bench.py`` (the shipped tree). Default
 baseline: ``koordlint_baseline.json`` next to the first scanned tree's
 repo root (CWD), used only when it exists; pass ``--baseline ''`` to
 force a no-baseline run.
+
+``--guards`` dumps the inferred guard map (which attribute is protected
+by which lock — see analysis/guards.py) as JSON so drift is reviewable
+in diffs; ``--check-locks`` additionally fails when any
+``threading.Lock()``/``RLock()`` attribute in the scanned modules guards
+nothing (an orphan lock is either dead weight or a guard the map failed
+to learn — both deserve a look). ``--sarif`` emits SARIF 2.1.0 for
+external CI consumers; ``--jobs`` sizes the per-file worker pool
+(KOORDLINT_JOBS env works too; finding order is identical either way).
 """
 
 from __future__ import annotations
@@ -29,6 +39,48 @@ from koordinator_tpu.analysis.core import (
 )
 
 DEFAULT_BASELINE = "koordlint_baseline.json"
+
+SARIF_VERSION = "2.1.0"
+
+
+def to_sarif(findings, rules) -> dict:
+    """Findings as a SARIF 2.1.0 log (one run, one driver)."""
+    return {
+        "version": SARIF_VERSION,
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "runs": [{
+            "tool": {"driver": {
+                "name": "koordlint",
+                "informationUri": ("https://github.com/koordinator-sh/"
+                                   "koordinator"),
+                "rules": [
+                    {"id": name,
+                     "shortDescription": {"text": rules[name].description}}
+                    for name in sorted(rules)
+                ],
+            }},
+            "results": [
+                {"ruleId": f.rule,
+                 "level": "error" if f.severity == "error" else "warning",
+                 "message": {"text": f.message},
+                 "locations": [{"physicalLocation": {
+                     "artifactLocation": {"uri": f.path},
+                     "region": {"startLine": f.line},
+                 }}]}
+                for f in findings
+            ],
+        }],
+    }
+
+
+def _guard_map_for(paths):
+    from koordinator_tpu.analysis.guards import (
+        build_guard_map,
+        collect_facts_for_paths,
+    )
+
+    return build_guard_map(collect_facts_for_paths(paths))
 
 
 def main(argv=None) -> int:
@@ -48,8 +100,18 @@ def main(argv=None) -> int:
                          "file and exit 0")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit findings as a JSON array")
+    ap.add_argument("--sarif", action="store_true",
+                    help="emit findings as SARIF 2.1.0")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--guards", action="store_true",
+                    help="emit the inferred guard map as JSON and exit")
+    ap.add_argument("--check-locks", action="store_true",
+                    help="with --guards semantics: exit 1 when any "
+                         "Lock/RLock attribute guards nothing (orphan)")
+    ap.add_argument("--jobs", type=int, default=None,
+                    help="per-file worker processes (default: auto; "
+                         "KOORDLINT_JOBS env overrides)")
     args = ap.parse_args(argv)
 
     rules = all_rules()
@@ -75,6 +137,19 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
+    if args.guards or args.check_locks:
+        gm = _guard_map_for(args.paths)
+        print(json.dumps(gm.to_dict(), indent=2, sort_keys=True))
+        if args.check_locks:
+            orphans = gm.orphan_locks()
+            if orphans:
+                for path, d in orphans:
+                    print(f"koordlint: orphan lock: {path}:{d.line} "
+                          f"{d.owner}.{d.attr} ({d.kind}) guards no field",
+                          file=sys.stderr)
+                return 1
+        return 0
+
     baseline_path = args.baseline
     if baseline_path is None:
         baseline_path = (DEFAULT_BASELINE
@@ -88,7 +163,8 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
 
-    findings = analyze_paths(args.paths, baseline=baseline)
+    findings = analyze_paths(args.paths, baseline=baseline,
+                             jobs=args.jobs)
 
     if args.write_baseline:
         target = baseline_path or DEFAULT_BASELINE
@@ -96,7 +172,9 @@ def main(argv=None) -> int:
         print(f"koordlint: wrote {len(findings)} finding(s) to {target}")
         return 0
 
-    if args.as_json:
+    if args.sarif:
+        print(json.dumps(to_sarif(findings, rules), indent=2))
+    elif args.as_json:
         print(json.dumps([
             {"rule": f.rule, "severity": f.severity, "path": f.path,
              "line": f.line, "message": f.message}

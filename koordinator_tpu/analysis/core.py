@@ -109,6 +109,60 @@ class Rule:
                        message=message)
 
 
+class ProgramContext:
+    """Everything a whole-program rule may consult: the concurrency
+    facts of every scanned module (see analysis/guards.py) plus the
+    guard map and lock graph derived from them, built lazily and shared
+    across the program rules."""
+
+    def __init__(self, facts_list) -> None:
+        self.facts_list = list(facts_list)
+        self._guard_map = None
+        self._lock_graph = None
+        self._caller_held: Dict[str, Dict] = {}
+
+    @property
+    def guard_map(self):
+        if self._guard_map is None:
+            from koordinator_tpu.analysis.guards import build_guard_map
+            self._guard_map = build_guard_map(self.facts_list)
+        return self._guard_map
+
+    @property
+    def lock_graph(self):
+        if self._lock_graph is None:
+            from koordinator_tpu.analysis.guards import LockGraph
+            self._lock_graph = LockGraph(self.guard_map)
+        return self._lock_graph
+
+    def caller_held(self, path: str) -> Dict:
+        """(owner, method) -> locks provably held by every caller, for
+        the module at `path` (see guards.caller_held_locks)."""
+        if path not in self._caller_held:
+            from koordinator_tpu.analysis.guards import caller_held_locks
+            facts = next((f for f in self.facts_list if f.path == path),
+                         None)
+            self._caller_held[path] = (
+                caller_held_locks(facts) if facts is not None else {})
+        return self._caller_held[path]
+
+
+class ProgramRule(Rule):
+    """A rule that needs the whole program: it sees every module's facts
+    at once instead of one ModuleContext. Per-module check() is a no-op;
+    the engine calls check_program() after the per-file pass."""
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        return iter(())
+
+    def check_program(self, program: ProgramContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding_at(self, path: str, line: int, message: str) -> Finding:
+        return Finding(rule=self.name, severity=self.severity,
+                       path=path, line=line, message=message)
+
+
 _REGISTRY: Dict[str, Rule] = {}
 
 
@@ -217,10 +271,50 @@ def iter_python_files(paths: Iterable[str]) -> Iterator[Path]:
             yield p
 
 
+def _module_findings(ctx: ModuleContext, suppress: Dict[int, Set[str]],
+                     rules: Dict[str, Rule]) -> List[Finding]:
+    out: List[Finding] = []
+    seen: Set[Finding] = set()
+    for rule in rules.values():
+        for f in rule.check(ctx):
+            # dedup identical reports (e.g. a jit call inside two nested
+            # loops is one site, not two findings)
+            if not is_suppressed(f, suppress) and f not in seen:
+                seen.add(f)
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def program_findings(facts_list,
+                     suppress_by_path: Dict[str, Dict[int, Set[str]]],
+                     rules: Optional[Dict[str, Rule]] = None
+                     ) -> List[Finding]:
+    """Run the whole-program rules over the collected facts; the
+    per-file suppression maps apply at whatever line a program finding
+    lands on."""
+    rules = all_rules() if rules is None else rules
+    program = ProgramContext([f for f in facts_list if f is not None])
+    out: List[Finding] = []
+    seen: Set[Finding] = set()
+    for rule in rules.values():
+        if not isinstance(rule, ProgramRule):
+            continue
+        for f in rule.check_program(program):
+            sup = suppress_by_path.get(f.path, {})
+            if not is_suppressed(f, sup) and f not in seen:
+                seen.add(f)
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
 def analyze_source(source: str, path: str = "<memory>",
                    rules: Optional[Dict[str, Rule]] = None) -> List[Finding]:
     """Run the rule set over one source text (suppressions applied,
-    baseline NOT applied — that is the caller's policy layer)."""
+    baseline NOT applied — that is the caller's policy layer). The
+    whole-program rules run over this single module, so a snippet test
+    exercises them without a directory walk."""
     rules = all_rules() if rules is None else rules
     try:
         tree = ast.parse(source)
@@ -231,15 +325,11 @@ def analyze_source(source: str, path: str = "<memory>",
                         message=f"could not parse: {e.msg}")]
     ctx = ModuleContext(path, source, tree)
     suppress = suppressed_lines(source)
-    out: List[Finding] = []
-    seen: Set[Finding] = set()
-    for rule in rules.values():
-        for f in rule.check(ctx):
-            # dedup identical reports (e.g. a jit call inside two nested
-            # loops is one site, not two findings)
-            if not is_suppressed(f, suppress) and f not in seen:
-                seen.add(f)
-                out.append(f)
+    out = _module_findings(ctx, suppress, rules)
+    from koordinator_tpu.analysis.guards import collect_module_facts
+
+    facts = collect_module_facts(ctx.path, source, tree)
+    out.extend(program_findings([facts], {ctx.path: suppress}, rules))
     out.sort(key=lambda f: (f.path, f.line, f.rule))
     return out
 
@@ -256,16 +346,85 @@ def _canonical_path(p: Path) -> str:
         return p.as_posix()
 
 
-def analyze_paths(paths: Iterable[str],
-                  baseline: Optional[Set[str]] = None) -> List[Finding]:
-    """Analyze files/directories; findings present in `baseline` are
-    dropped."""
+def _scan_file(path_str: str):
+    """Worker unit: per-file findings + concurrency facts + suppression
+    map. Top-level (and returning only picklable dataclasses/dicts) so a
+    ProcessPoolExecutor can run it; the whole-program passes consume the
+    facts back in the parent."""
+    from koordinator_tpu.analysis.guards import collect_module_facts
+
     rules = all_rules()
+    p = Path(path_str)
+    source = p.read_text()
+    cpath = _canonical_path(p)
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        f = Finding(rule="parse-error", severity="error", path=cpath,
+                    line=e.lineno or 1, message=f"could not parse: {e.msg}")
+        return [f], None, {}
+    ctx = ModuleContext(cpath, source, tree)
+    suppress = suppressed_lines(source)
+    findings = _module_findings(ctx, suppress, rules)
+    facts = collect_module_facts(cpath, source, tree)
+    return findings, facts, suppress
+
+
+def default_jobs(n_files: int) -> int:
+    """Worker count for the per-file pass: KOORDLINT_JOBS wins, else
+    scale with the machine but keep small scans serial (pool startup
+    costs more than it saves under ~2 dozen files)."""
+    import os
+
+    env = os.environ.get("KOORDLINT_JOBS", "")
+    if env.strip():
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    if n_files < 24:
+        return 1
+    return max(1, min(8, os.cpu_count() or 1))
+
+
+def analyze_paths(paths: Iterable[str],
+                  baseline: Optional[Set[str]] = None,
+                  jobs: Optional[int] = None) -> List[Finding]:
+    """Analyze files/directories; findings present in `baseline` are
+    dropped. The per-file pass fans out to `jobs` worker processes
+    (default: scale with the machine; finding order is identical to the
+    serial run — workers return results in input order and the
+    whole-program passes always run once, in the parent)."""
+    all_rules()  # fail fast on registration errors before forking
     baseline = baseline or set()
+    files = [str(f) for f in iter_python_files(paths)]
+    jobs = default_jobs(len(files)) if jobs is None else max(1, jobs)
+    results = None
+    if jobs > 1 and len(files) > 1:
+        try:
+            from concurrent.futures import ProcessPoolExecutor
+            from concurrent.futures.process import BrokenProcessPool
+
+            with ProcessPoolExecutor(max_workers=jobs) as pool:
+                results = list(pool.map(_scan_file, files, chunksize=4))
+        except (OSError, ImportError, BrokenProcessPool):
+            # sandboxes without working process pools fall back to the
+            # serial path rather than failing the lint run
+            results = None
+    if results is None:
+        results = [_scan_file(f) for f in files]
+
     out: List[Finding] = []
-    for f in iter_python_files(paths):
-        source = f.read_text()
-        for finding in analyze_source(source, _canonical_path(f), rules):
+    facts_list = []
+    suppress_by_path: Dict[str, Dict[int, Set[str]]] = {}
+    for (findings, facts, suppress) in results:
+        for finding in findings:
             if finding.key not in baseline:
                 out.append(finding)
+        if facts is not None:
+            facts_list.append(facts)
+            suppress_by_path[facts.path] = suppress
+    for finding in program_findings(facts_list, suppress_by_path):
+        if finding.key not in baseline:
+            out.append(finding)
     return out

@@ -9,5 +9,6 @@ from koordinator_tpu.analysis.rules import (  # noqa: F401
     jaxtrace,
     loops,
     pipeline,
+    race,
     wire,
 )

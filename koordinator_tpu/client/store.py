@@ -132,7 +132,8 @@ class ObjectStore:
     def resource_version(self) -> int:
         """Global mutation counter: bumps on every add/update/delete.
         Cheap cache-invalidation key for derived indexes."""
-        return self._rv
+        with self._lock:
+            return self._rv
 
     def add(self, kind: str, obj: Any) -> Any:
         self._admit(kind, obj)

@@ -560,14 +560,22 @@ class Scheduler:
         # split (snapshot/encode/kernel/bind); dump via /traces or
         # `python -m koordinator_tpu.obs`
         self.tracer = Tracer()
+        import threading as _threading
+
+        # the compiled-step memo is shared with the background warm-up
+        # ladder: its thread replays rungs through the same _get_*step
+        # chokepoints while the cycle thread dispatches. The lock covers
+        # only the dict probes — never a step BUILD, which can hold XLA
+        # for seconds (a racing miss costs one duplicate compile, last
+        # write wins; torn dict state would cost correctness).
+        self._step_lock = _threading.Lock()
+        # koordlint: guarded-by(_step_lock)
         self._step_cache: Dict[Tuple, object] = {}
         # per-thread: the background warm-up ladder replays rungs
         # through _get_*step from its own thread, and its misses must
         # not leak into the cycle thread's compiled-dispatch
         # attribution (the flag is always read on the thread that just
         # called _get_*step)
-        import threading as _threading
-
         self._step_tls = _threading.local()
         # host-tail instrumentation (PR 15): cumulative wall seconds of
         # pack/encode work and of compile work (step builds + the kernel
@@ -577,8 +585,8 @@ class Scheduler:
         # Lock-guarded accumulation: the background warm-up ladder adds
         # from its own thread, and a lost += would under-report compile.
         self._wall_lock = _threading.Lock()
-        self.pack_wall_seconds = 0.0
-        self.compile_wall_seconds = 0.0
+        self.pack_wall_seconds = 0.0     # koordlint: guarded-by(_wall_lock)
+        self.compile_wall_seconds = 0.0  # koordlint: guarded-by(_wall_lock)
         # pack/device overlap (KOORD_TPU_PACK_OVERLAP): pre-pack the
         # next cycle's candidate pod rows inside this cycle's device
         # window. An explicit argument pins it (the parity twins and the
@@ -612,8 +620,11 @@ class Scheduler:
         # armed when warm-up completes, dropped on every ladder
         # transition (those legitimately re-key the step cache). A miss
         # while armed counts + calls the injectable hook — the sim
-        # harness's runtime assert.
-        self._steady_state = False
+        # harness's runtime assert. Single-writer bool handoff (warm-up
+        # thread arms it once, the cycle thread reads/clears): a GIL-
+        # atomic flip with no compound read-modify-write, so it is
+        # deliberately lock-free.
+        self._steady_state = False   # koordlint: guarded-by(none)
         self.compile_miss_hook = None
         # parity/test hook: called with the post-reduce host
         # FullChainInputs at the end of every encode (the
@@ -1162,7 +1173,8 @@ class Scheduler:
                   explain=None) -> object:
         key = (signature, ng, ngroups, tuple(active), explain,
                self._mesh_tag())
-        step = self._step_cache.get(key)
+        with self._step_lock:
+            step = self._step_cache.get(key)
         if step is not None:
             self._last_step_compiled = False
             scheduler_metrics.COMPILE_CACHE_HITS.inc()
@@ -1188,7 +1200,8 @@ class Scheduler:
                     self.args, ng, ngroups, active_axes=active,
                     explain=explain)
         self._add_compile_wall(csp.duration_seconds)
-        self._step_cache[key] = step
+        with self._step_lock:
+            self._step_cache[key] = step
         return step
 
     def _device_score_passes(self) -> Tuple:
@@ -1221,7 +1234,8 @@ class Scheduler:
         key = ("fused", waves, signature, ng, ngroups, tuple(active),
                explain, self._mesh_tag(), sides_tag,
                self._score_pass_tag())
-        step = self._step_cache.get(key)
+        with self._step_lock:
+            step = self._step_cache.get(key)
         if step is not None:
             self._last_step_compiled = False
             scheduler_metrics.COMPILE_CACHE_HITS.inc()
@@ -1245,7 +1259,8 @@ class Scheduler:
                     explain=explain, prod=prod, claims=nc > 0,
                     res=nres > 0, score_passes=passes)
         self._add_compile_wall(csp.duration_seconds)
-        self._step_cache[key] = step
+        with self._step_lock:
+            self._step_cache[key] = step
         return step
 
     def _get_chain_step(self, signature: Tuple, ng: int, ngroups: int,
@@ -1261,7 +1276,8 @@ class Scheduler:
         nc, nres = sides_tag
         key = ("chain", signature, ng, ngroups, tuple(active), explain,
                self._mesh_tag(), sides_tag, self._score_pass_tag())
-        step = self._step_cache.get(key)
+        with self._step_lock:
+            step = self._step_cache.get(key)
         if step is not None:
             self._last_step_compiled = False
             scheduler_metrics.COMPILE_CACHE_HITS.inc()
@@ -1285,7 +1301,8 @@ class Scheduler:
                     explain=explain, prod=prod, claims=nc > 0,
                     res=nres > 0, score_passes=passes)
         self._add_compile_wall(csp.duration_seconds)
-        self._step_cache[key] = step
+        with self._step_lock:
+            self._step_cache[key] = step
         return step
 
     # ------------------------------------------------------------------

@@ -89,7 +89,7 @@ class DeadlineWatchdog:
         self.deadline_seconds = deadline_seconds
         self.on_overrun = on_overrun
         self._lock = threading.Lock()
-        self.overruns = 0
+        self.overruns = 0  # koordlint: guarded-by(_lock)
 
     def run(self, fn: Callable[[], object], path: str):
         """Run the blocking sync ``fn`` under the deadline. No deadline

@@ -40,6 +40,7 @@ changed fields before the next build).
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
@@ -768,8 +769,12 @@ class DeviceSnapshot:
         # scatter just overwrote. While any dispatch is outstanding the
         # scatter runs WITHOUT donation (the old buffer stays live as the
         # second buffer until the dispatch syncs) — the cycle driver
-        # brackets every async kernel window with begin/end_dispatch.
-        self._in_flight = 0
+        # brackets every async kernel window with begin/end_dispatch. The
+        # rebalance mirror shares this snapshot across the cycle thread
+        # and the descheduler pass, so the ledger takes a lock; this is
+        # the OUTERMOST leg of the canonical order (obs/lockorder.py).
+        self._lock = threading.Lock()
+        self._in_flight = 0  # koordlint: guarded-by(_lock)
         # sim/test upload-failure hook: callable(field name) invoked
         # before each field's transfer — raising RESOURCE_EXHAUSTED-
         # shaped errors from it exercises the OOM-upload fault model
@@ -781,10 +786,12 @@ class DeviceSnapshot:
         """A kernel consuming this snapshot's buffers was dispatched and
         not yet synced: donation of those buffers is unsafe until
         ``end_dispatch``."""
-        self._in_flight += 1
+        with self._lock:
+            self._in_flight += 1
 
     def end_dispatch(self) -> None:
-        self._in_flight = max(0, self._in_flight - 1)
+        with self._lock:
+            self._in_flight = max(0, self._in_flight - 1)
 
     def _sharding(self, node_axis: bool):
         """The field's NamedSharding under the mesh: node-axis fields flat
@@ -816,7 +823,8 @@ class DeviceSnapshot:
         rows_p = np.broadcast_to(
             rows[-1], (pad,) + rows.shape[1:]).copy()
         rows_p[: idx.size] = rows
-        donate = self._in_flight == 0
+        with self._lock:
+            donate = self._in_flight == 0
         # the sharding itself (hashable) keys the cache: node-sharded and
         # replicated fields of equal shape/dtype must NOT share a jitted
         # fn, or the pinned out_shardings of whichever compiled first

@@ -262,7 +262,7 @@ def zeros_from_spec(spec):
 # in one process serialize here (cross-process writers are last-writer-
 # wins on the atomic rename, which can drop a concurrent rung but can
 # never corrupt the file: every writer renames its OWN unique tmp)
-_index_lock = threading.Lock()
+_index_lock = threading.Lock()  # koordlint: guards(rung-index-file)
 
 
 class CompileCacheIndex:
@@ -275,7 +275,8 @@ class CompileCacheIndex:
     ladder (pinned by tests)."""
 
     def __init__(self, dir_path: str) -> None:
-        self.path = os.path.join(dir_path, INDEX_NAME)
+        # immutable after construction; only the index FILE needs the lock
+        self.path = os.path.join(dir_path, INDEX_NAME)  # koordlint: guarded-by(none)
         self._lock = _index_lock
 
     def load(self) -> Dict[str, dict]:
@@ -351,6 +352,7 @@ def record_step_compile(kind: str, meta: dict, args: Tuple) -> bool:
 # once would just contend for the same XLA compile threads, and the
 # atexit join below must have a bounded set to wait on
 _ladder_lock = threading.Lock()
+# koordlint: guarded-by(_ladder_lock)
 _live_threads: List[threading.Thread] = []
 _atexit_registered = False
 
@@ -359,8 +361,12 @@ def _join_live_ladders() -> None:
     """Interpreter-exit guard: a daemon ladder thread killed MID-XLA-
     COMPILE aborts the process in native teardown ("terminate called
     without an active exception") — give outstanding ladders a bounded
-    window to finish before the runtime unwinds."""
-    for t in list(_live_threads):
+    window to finish before the runtime unwinds. The ladder lock is
+    held for a whole run(), so taking it here would turn the bounded
+    join into an unbounded wait — the bare snapshot is a list() copy
+    (atomic under the GIL) of threads only ever appended before start;
+    the pragma below records that deliberate exception."""
+    for t in list(_live_threads):  # koordlint: disable=unguarded-shared-field
         t.join(timeout=30.0)
 
 
@@ -536,7 +542,8 @@ class WarmupRunner:
             _atexit_registered = True
         self._thread = threading.Thread(
             target=self._run_guarded, name="koord-warmup", daemon=True)
-        _live_threads.append(self._thread)
+        with _ladder_lock:
+            _live_threads.append(self._thread)
         self._thread.start()
 
     def _run_guarded(self) -> None:
@@ -547,7 +554,8 @@ class WarmupRunner:
             logger.exception("warm-up ladder failed")
         finally:
             try:
-                _live_threads.remove(self._thread)
+                with _ladder_lock:
+                    _live_threads.remove(self._thread)
             except ValueError:  # pragma: no cover - defensive
                 pass
 

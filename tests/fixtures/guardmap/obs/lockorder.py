@@ -1,0 +1,8 @@
+"""Fixture twin of koordinator_tpu/obs/lockorder.py: the analyzer
+parses any ``obs/lockorder.py`` for the declared order, so the golden
+dump pins the ``canonical_lock_order`` field shape too."""
+
+CANONICAL_LOCK_ORDER = (
+    "Sampler._lock",
+    "Sampler._alias",
+)

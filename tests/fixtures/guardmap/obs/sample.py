@@ -1,0 +1,57 @@
+"""Guard-map golden fixture: pins the ``--guards`` dump schema.
+
+Deliberately exercises every shape the dump can emit: an annotated
+field, an inferred guard (majority vote over locked touches), a
+``guarded-by(none)`` pin, a module-level lock guarding a module global,
+an instance alias of a module lock, and a ``guards(<resource>)``
+declaration. koordlint itself never scans this directory — only the
+schema-pin test (tests/test_static_analysis.py) drives ``--guards``
+over it and diffs the dump against tests/fixtures/guardmap_golden.json.
+Any field added, renamed or re-typed in the dump is schema drift and
+must be a conscious GUARD_MAP_VERSION bump + fixture regeneration.
+"""
+
+import threading
+
+_mod_lock = threading.Lock()
+_file_lock = threading.Lock()  # koordlint: guards(sample-file)
+
+# koordlint: guarded-by(_mod_lock)
+_events = []
+
+
+def record(ev):
+    with _mod_lock:
+        _events.append(ev)
+
+
+def drain():
+    with _mod_lock:
+        out = list(_events)
+        _events.clear()
+    return out
+
+
+class Sampler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._alias = _mod_lock
+        self.count = 0  # koordlint: guarded-by(_lock)
+        self.window = []
+        self.label = ""  # koordlint: guarded-by(none)
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+            self.window.append(self.count)
+
+    def rotate(self):
+        with self._lock:
+            self.window = self.window[-8:]
+
+    def read(self):
+        with self._lock:
+            return list(self.window)
+
+    def name(self):
+        return self.label

@@ -1517,3 +1517,529 @@ class TestCompileInSteadyState:
                     source, path=path,
                     rules={self.RULE: all_rules()[self.RULE]})
                 assert [f for f in out if f.rule == self.RULE] == [], path
+
+
+# ---------------------------------------------------------------------------
+# PR 16 — koordrace: the whole-program lock-discipline pass
+# ---------------------------------------------------------------------------
+
+from koordinator_tpu.analysis.guards import (  # noqa: E402
+    MODULE_OWNER,
+    build_guard_map,
+    collect_module_facts,
+    is_guard_scanned_path,
+)
+
+_FAKE = "koordinator_tpu/obs/fake.py"
+
+
+def _facts(src: str, path: str = _FAKE):
+    import ast as _ast
+    source = textwrap.dedent(src)
+    return collect_module_facts(path, source, _ast.parse(source))
+
+
+class TestGuardMap:
+    """analysis/guards.py: annotation parsing, majority inference, the
+    orphan-lock self-check and the declared canonical order — the facts
+    layer every race rule (and sim/racecheck.py) consumes."""
+
+    def test_scan_gate(self):
+        assert is_guard_scanned_path("koordinator_tpu/obs/metrics.py")
+        assert is_guard_scanned_path("koordinator_tpu/client/store.py")
+        assert is_guard_scanned_path("koordinator_tpu/koordlet/metrics.py")
+        assert not is_guard_scanned_path("koordinator_tpu/ops/fit.py")
+        assert not is_guard_scanned_path("pkg/mod.py")
+
+    def test_annotation_beats_inference(self):
+        # every non-init touch holds _other, but the annotation pins _lock
+        facts = _facts("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._other = threading.Lock()
+                    self.n = 0  # koordlint: guarded-by(_lock)
+
+                def a(self):
+                    with self._other:
+                        self.n += 1
+
+                def b(self):
+                    with self._other:
+                        self.n += 1
+        """)
+        gm = build_guard_map([facts])
+        gf = gm.guard_for(_FAKE, "C", "n")
+        assert gf.guard == "_lock"
+        assert gf.source == "annotation"
+
+    def test_guarded_by_none_disables_inference(self):
+        facts = _facts("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0  # koordlint: guarded-by(none)
+
+                def a(self):
+                    with self._lock:
+                        self.n += 1
+
+                def b(self):
+                    with self._lock:
+                        self.n += 1
+        """)
+        gm = build_guard_map([facts])
+        assert gm.guard_for(_FAKE, "C", "n").guard is None
+
+    def test_inference_needs_min_locked_and_strict_majority(self):
+        # one locked touch: below _INFER_MIN_LOCKED, no guard inferred
+        facts = _facts("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def a(self):
+                    with self._lock:
+                        self.n += 1
+        """)
+        assert build_guard_map([facts]).guard_for(_FAKE, "C", "n").guard is None
+        # two locked vs two bare: no strict majority, no guard
+        facts = _facts("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def a(self):
+                    with self._lock:
+                        self.n += 1
+
+                def b(self):
+                    with self._lock:
+                        self.n += 1
+
+                def c(self):
+                    return self.n
+
+                def d(self):
+                    return self.n
+        """)
+        assert build_guard_map([facts]).guard_for(_FAKE, "C", "n").guard is None
+        # three locked vs one bare: inferred
+        facts = _facts("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def a(self):
+                    with self._lock:
+                        self.n += 1
+
+                def b(self):
+                    with self._lock:
+                        self.n += 1
+
+                def c(self):
+                    with self._lock:
+                        self.n += 1
+
+                def d(self):
+                    return self.n
+        """)
+        gf = build_guard_map([facts]).guard_for(_FAKE, "C", "n")
+        assert gf.guard == "_lock"
+        assert gf.source == "inferred"
+
+    def test_module_level_fields_use_module_owner(self):
+        facts = _facts("""
+            import threading
+
+            _lk = threading.Lock()
+            # koordlint: guarded-by(_lk)
+            _events = []
+
+            def add(ev):
+                with _lk:
+                    _events.append(ev)
+        """)
+        gm = build_guard_map([facts])
+        assert gm.guard_for(_FAKE, MODULE_OWNER, "_events").guard == "_lk"
+
+    def test_orphan_lock_flagged_resource_and_alias_exempt(self):
+        facts = _facts("""
+            import threading
+
+            _used = threading.Lock()
+            # koordlint: guarded-by(_used)
+            _n = []
+
+            def bump(x):
+                with _used:
+                    _n.append(x)
+
+            class C:
+                def __init__(self):
+                    self._dead = threading.Lock()
+                    self._file_lock = threading.Lock()  # koordlint: guards(index-file)
+                    self._alias = _used
+        """)
+        gm = build_guard_map([facts])
+        orphans = {d.attr for _, d in gm.orphan_locks()}
+        assert "_dead" in orphans          # guards nothing
+        assert "_file_lock" not in orphans  # guards(<resource>) declared
+        assert "_used" not in orphans       # in the guard map
+        assert "_alias" not in orphans      # alias of a used lock
+
+    def test_canonical_order_parsed_only_from_lockorder_module(self):
+        src = """
+            CANONICAL_LOCK_ORDER = ("A._lock", "B._lock")
+        """
+        facts = _facts(src, path="koordinator_tpu/obs/lockorder.py")
+        assert build_guard_map([facts]).canonical_order == (
+            "A._lock", "B._lock")
+        # the same assignment anywhere else is just a tuple
+        facts = _facts(src, path="koordinator_tpu/obs/other.py")
+        assert build_guard_map([facts]).canonical_order == ()
+
+    def test_shipped_canonical_order_matches_declaration(self):
+        """Satellite 2: obs/lockorder.py is the ONE documented home of
+        the order; the analyzer parses (never imports) it and must
+        recover exactly what the module declares."""
+        from koordinator_tpu.analysis.guards import collect_facts_for_paths
+        from koordinator_tpu.obs.lockorder import CANONICAL_LOCK_ORDER
+        facts = collect_facts_for_paths(
+            [str(REPO_ROOT / "koordinator_tpu" / "obs" / "lockorder.py")])
+        assert build_guard_map(facts).canonical_order == CANONICAL_LOCK_ORDER
+        assert CANONICAL_LOCK_ORDER[0] == "DeviceSnapshot._lock"
+        assert CANONICAL_LOCK_ORDER[-1].endswith("._lock")
+
+
+class TestUnguardedSharedField:
+    RULE = "unguarded-shared-field"
+    PATH = "koordinator_tpu/obs/fake.py"
+
+    def test_bare_touch_of_annotated_field_fires(self):
+        src = """
+            import threading
+
+            class Ring:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.events = []  # koordlint: guarded-by(_lock)
+
+                def add(self, ev):
+                    with self._lock:
+                        self.events.append(ev)
+
+                def peek(self):
+                    return list(self.events)
+        """
+        out = findings_for(src, self.RULE, path=self.PATH)
+        assert len(out) == 1
+        assert "Ring.events" in out[0].message
+        assert "'peek'" in out[0].message
+        assert "'_lock'" in out[0].message
+
+    def test_locked_touches_and_init_writes_are_silent(self):
+        src = """
+            import threading
+
+            class Ring:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.events = []  # koordlint: guarded-by(_lock)
+
+                def add(self, ev):
+                    with self._lock:
+                        self.events.append(ev)
+
+                def drain(self):
+                    with self._lock:
+                        out = list(self.events)
+                        self.events = []
+                    return out
+        """
+        assert findings_for(src, self.RULE, path=self.PATH) == []
+
+    def test_caller_held_private_method_is_silent(self):
+        # _snap is only ever called with the lock held; the one-hop
+        # caller-held propagation must credit it
+        src = """
+            import threading
+
+            class Ring:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.events = []  # koordlint: guarded-by(_lock)
+
+                def add(self, ev):
+                    with self._lock:
+                        self.events.append(ev)
+                        return self._snap()
+
+                def _snap(self):
+                    return list(self.events)
+        """
+        assert findings_for(src, self.RULE, path=self.PATH) == []
+
+    def test_pragma_suppresses(self):
+        src = """
+            import threading
+
+            class Ring:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.events = []  # koordlint: guarded-by(_lock)
+
+                def add(self, ev):
+                    with self._lock:
+                        self.events.append(ev)
+
+                def peek(self):
+                    return list(self.events)  # koordlint: disable=unguarded-shared-field
+        """
+        assert findings_for(src, self.RULE, path=self.PATH) == []
+
+    def test_unscanned_path_is_silent(self):
+        src = """
+            import threading
+
+            class Ring:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.events = []  # koordlint: guarded-by(_lock)
+
+                def add(self, ev):
+                    with self._lock:
+                        self.events.append(ev)
+
+                def peek(self):
+                    return list(self.events)
+        """
+        assert findings_for(src, self.RULE, path="pkg/mod.py") == []
+
+
+class TestLockOrderInversion:
+    RULE = "lock-order-inversion"
+    PATH = "koordinator_tpu/obs/fake.py"
+
+    ABBA = """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def fwd():
+            with _a:
+                with _b:
+                    pass
+
+        def rev():
+            with _b:
+                with _a:
+                    pass
+    """
+
+    def test_abba_cycle_fires(self):
+        out = findings_for(self.ABBA, self.RULE, path=self.PATH)
+        assert out, "ABBA module-lock cycle must be reported"
+        assert any("cycle" in f.message for f in out)
+
+    def test_consistent_nesting_is_silent(self):
+        src = """
+            import threading
+
+            _a = threading.Lock()
+            _b = threading.Lock()
+
+            def fwd():
+                with _a:
+                    with _b:
+                        pass
+
+            def also_fwd():
+                with _a:
+                    with _b:
+                        pass
+        """
+        assert findings_for(src, self.RULE, path=self.PATH) == []
+
+    DECLARED = """
+        import threading
+
+        CANONICAL_LOCK_ORDER = ({order})
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0  # koordlint: guarded-by(_lock)
+
+            def bump(self):
+                with self._lock:
+                    self.n += 1
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.a = A()
+                self.m = 0  # koordlint: guarded-by(_lock)
+
+            def push(self):
+                with self._lock:
+                    self.m += 1
+                    self.a.bump()
+    """
+
+    def test_declared_order_violation_fires(self):
+        # declared A-before-B, but push() acquires A._lock while
+        # holding B._lock — the declared-order leg, no cycle needed
+        src = self.DECLARED.format(order='"A._lock", "B._lock"')
+        out = findings_for(src, self.RULE,
+                           path="koordinator_tpu/obs/lockorder.py")
+        assert len(out) == 1
+        assert "declared canonical lock order" in out[0].message
+        assert "A._lock" in out[0].message
+        assert "B._lock" in out[0].message
+
+    def test_declared_order_respected_is_silent(self):
+        src = self.DECLARED.format(order='"B._lock", "A._lock"')
+        assert findings_for(
+            src, self.RULE,
+            path="koordinator_tpu/obs/lockorder.py") == []
+
+
+class TestBlockingCallUnderLock:
+    RULE = "blocking-call-under-lock"
+    PATH = "koordinator_tpu/obs/fake.py"
+
+    def test_device_sync_under_lock_fires(self):
+        src = """
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def wait(self, fut):
+                    with self._lock:
+                        fut.block_until_ready()
+        """
+        out = findings_for(src, self.RULE, path=self.PATH)
+        assert len(out) == 1
+        assert "block_until_ready" in out[0].message
+        assert "Cache.wait" in out[0].message
+
+    def test_sleep_under_lock_fires(self):
+        src = """
+            import threading
+            import time
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def park(self):
+                    with self._lock:
+                        time.sleep(0.5)
+        """
+        out = findings_for(src, self.RULE, path=self.PATH)
+        assert len(out) == 1
+        assert "time.sleep" in out[0].message
+
+    def test_blocking_outside_lock_is_silent(self):
+        src = """
+            import threading
+            import time
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def park(self, fut):
+                    with self._lock:
+                        n = 1
+                    time.sleep(0.5)
+                    fut.block_until_ready()
+        """
+        assert findings_for(src, self.RULE, path=self.PATH) == []
+
+
+class TestGuardsCLI:
+    """The analyzer's new surface: --guards dump (schema-pinned against
+    the golden fixture), --check-locks exit code, --sarif shape, and the
+    worker-pool path's output parity with the serial run."""
+
+    def test_guards_dump_matches_golden_fixture(self):
+        proc = _run_cli("--guards", "tests/fixtures/guardmap")
+        assert proc.returncode == 0, proc.stderr
+        got = json.loads(proc.stdout)
+        want = json.loads(
+            (REPO_ROOT / "tests" / "fixtures" /
+             "guardmap_golden.json").read_text())
+        assert got == want, (
+            "guard-map dump drifted from tests/fixtures/guardmap_golden."
+            "json — a deliberate schema change must bump "
+            "GUARD_MAP_VERSION and regenerate the fixture")
+
+    def test_guards_dump_schema_header(self):
+        got = json.loads(
+            (REPO_ROOT / "tests" / "fixtures" /
+             "guardmap_golden.json").read_text())
+        assert got["schema"] == "koordlint-guard-map"
+        assert got["version"] == 1
+        assert list(got["canonical_lock_order"]) == [
+            "Sampler._lock", "Sampler._alias"]
+
+    def test_check_locks_flags_orphan(self, tmp_path):
+        mod = tmp_path / "obs"
+        mod.mkdir()
+        (mod / "dead.py").write_text(textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._dead = threading.Lock()
+        """))
+        proc = _run_cli("--guards", "--check-locks", str(mod))
+        assert proc.returncode == 1
+        assert "_dead" in proc.stderr
+
+    def test_shipped_tree_has_no_orphan_locks(self):
+        proc = _run_cli("--guards", "--check-locks", "koordinator_tpu")
+        assert proc.returncode == 0, proc.stderr
+
+    def test_sarif_output_shape(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import jax.numpy as jnp\nx = jnp.arange(5)\n")
+        proc = _run_cli(str(bad), "--sarif", "--baseline", "")
+        assert proc.returncode == 1
+        doc = json.loads(proc.stdout)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert "jax-implicit-dtype" in rule_ids
+        res = run["results"][0]
+        assert res["ruleId"] == "jax-implicit-dtype"
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("bad.py")
+        assert loc["region"]["startLine"] == 2
+
+    def test_parallel_pass_matches_serial(self):
+        """jobs>1 fans the per-file pass out to worker processes; the
+        finding list (content AND order) must be identical to jobs=1."""
+        target = str(REPO_ROOT / "koordinator_tpu" / "obs")
+        serial = analyze_paths([target], jobs=1)
+        fanned = analyze_paths([target], jobs=2)
+        assert fanned == serial
